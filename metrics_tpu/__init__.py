@@ -1,0 +1,23 @@
+"""metrics_tpu: a TPU-native (JAX/XLA/pjit/Pallas) metrics framework.
+
+Capability parity with TorchMetrics (reference at ``/root/reference``, see SURVEY.md)
+built from scratch TPU-first: metric state is a pytree, update/compute are pure
+jit-compiled XLA functions, and distributed sync lowers to XLA collectives over a
+``jax.sharding.Mesh``.
+"""
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from metrics_tpu.metric import CompositionalMetric, Metric
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MinMetric",
+    "SumMetric",
+    "__version__",
+]

@@ -1,0 +1,218 @@
+"""Aggregation metrics.
+
+Parity with reference ``torchmetrics/aggregation.py`` (``BaseAggregator :31``,
+``MaxMetric :114``, ``MinMetric :219``, ``SumMetric :324``, ``CatMetric :429``,
+``MeanMetric :493``; Running variants are re-exported from ``wrappers/running``).
+
+TPU notes: NaN handling is branch-free under jit for the ``ignore``/replace
+strategies (``jnp.where`` with the reduction's identity element); the ``error``/
+``warn`` strategies need a host-visible value check and therefore run the update
+eagerly (still pure jnp ops, just not one fused executable).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["BaseAggregator", "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric"]
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregation metrics (reference ``aggregation.py:31-111``).
+
+    Args:
+        fn: reduction applied at update ("sum", "max", "min", "cat" or callable)
+        default_value: default state value
+        nan_strategy: "error", "warn", "ignore", "disable" or a float replacement value
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore", "disable")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        if nan_strategy in ("error", "warn"):
+            self._jit_update_opt = False  # value inspection needs the host
+        self.state_name = state_name
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+
+    @property
+    def value(self) -> Any:
+        return self._state[self.state_name]
+
+    @value.setter
+    def value(self, new_value: Any) -> None:
+        self._state[self.state_name] = new_value
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Optional[Union[float, Array]] = None):
+        """Convert input ``x`` to a float array and apply the NaN strategy (reference ``aggregation.py:63-103``).
+
+        Returns ``(x, weight, keep_mask)`` — under the ``ignore``/replace strategies the
+        mask marks elements to drop, applied branch-free by the caller.
+        """
+        x = jnp.asarray(x, dtype=self._dtype)
+        weight = jnp.asarray(1.0 if weight is None else weight, dtype=self._dtype)
+        weight = jnp.broadcast_to(weight, x.shape)
+        nan_mask = jnp.isnan(x)
+        if self.nan_strategy in ("error", "warn"):
+            from metrics_tpu.utils.checks import _is_traced
+
+            if _is_traced(x):
+                # inside jit: a host-visible value check is impossible. "warn" degrades to
+                # a trace-time notice + branch-free drop; "error" must fail loudly at trace
+                # time since raising on data is unrepresentable in XLA.
+                if self.nan_strategy == "error":
+                    raise RuntimeError(
+                        "nan_strategy='error' requires a host-side value check and cannot run "
+                        "inside jit. Use 'warn', 'ignore', 'disable' or a float replacement."
+                    )
+                rank_zero_warn(
+                    "nan_strategy='warn' inside jit cannot inspect values; NaNs are dropped "
+                    "branch-free without a runtime warning.",
+                    UserWarning,
+                )
+                return x, weight, ~nan_mask
+            if bool(jnp.any(nan_mask)):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                return x, weight, ~nan_mask
+            return x, weight, jnp.ones_like(nan_mask, dtype=bool) | True
+        if self.nan_strategy == "ignore":
+            return x, weight, ~nan_mask
+        if self.nan_strategy == "disable":
+            return x, weight, jnp.ones_like(nan_mask) | True
+        # float replacement
+        return jnp.where(nan_mask, jnp.asarray(self.nan_strategy, dtype=x.dtype), x), weight, jnp.ones_like(nan_mask) | True
+
+    def update(self, value: Union[float, Array]) -> None:  # noqa: D102
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        """Aggregated value."""
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Aggregate a stream of values into their maximum (reference ``aggregation.py:114``).
+
+    >>> from metrics_tpu.aggregation import MaxMetric
+    >>> metric = MaxMetric()
+    >>> metric.update(1.0)
+    >>> metric.update(3.0)
+    >>> float(metric.compute())
+    3.0
+    """
+
+    full_state_update = True
+    plot_lower_bound = None
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, state_name="max_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _, keep = self._cast_and_nan_check_input(value)
+        masked = jnp.where(keep, value, -jnp.inf)
+        self.max_value = jnp.maximum(self.max_value, jnp.max(masked) if masked.size else self.max_value)
+
+
+class MinMetric(BaseAggregator):
+    """Aggregate a stream of values into their minimum (reference ``aggregation.py:219``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, state_name="min_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _, keep = self._cast_and_nan_check_input(value)
+        masked = jnp.where(keep, value, jnp.inf)
+        self.min_value = jnp.minimum(self.min_value, jnp.min(masked) if masked.size else self.min_value)
+
+
+class SumMetric(BaseAggregator):
+    """Aggregate a stream of values into their sum (reference ``aggregation.py:324``).
+
+    >>> from metrics_tpu.aggregation import SumMetric
+    >>> metric = SumMetric()
+    >>> metric.update(1.0)
+    >>> metric.update(2.0)
+    >>> float(metric.compute())
+    3.0
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="sum_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _, keep = self._cast_and_nan_check_input(value)
+        self.sum_value = self.sum_value + jnp.sum(jnp.where(keep, value, 0.0))
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate a stream of values (reference ``aggregation.py:429``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _, keep = self._cast_and_nan_check_input(value)
+        import numpy as np
+
+        kept = value.reshape(-1)[np.asarray(keep).reshape(-1)]  # list state → host-side filter OK
+        if kept.size:
+            self.value.append(kept)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value if not isinstance(self.value, list) else jnp.zeros(0, dtype=self._dtype)
+
+
+class MeanMetric(BaseAggregator):
+    """Aggregate a stream of values into their (weighted) mean (reference ``aggregation.py:493``).
+
+    >>> from metrics_tpu.aggregation import MeanMetric
+    >>> metric = MeanMetric()
+    >>> metric.update(1.0)
+    >>> metric.update(3.0)
+    >>> float(metric.compute())
+    2.0
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        """Update state with data; ``weight`` is broadcast to ``value``'s shape."""
+        value, weight, keep = self._cast_and_nan_check_input(value, weight)
+        self.mean_value = self.mean_value + jnp.sum(jnp.where(keep, value * weight, 0.0))
+        self.weight = self.weight + jnp.sum(jnp.where(keep, weight, 0.0))
+
+    def compute(self) -> Array:
+        from metrics_tpu.utils.compute import _safe_divide
+
+        return _safe_divide(self.mean_value, self.weight)

@@ -1,0 +1,11 @@
+"""Distributed/mesh layer: state sync over ICI/DCN via XLA collectives (SURVEY §2.2)."""
+
+from metrics_tpu.parallel.sync import (
+    allreduce_over_mesh,
+    build_mesh,
+    gather_all_states,
+    pad_to_capacity,
+    sync_states,
+)
+
+__all__ = ["allreduce_over_mesh", "build_mesh", "gather_all_states", "pad_to_capacity", "sync_states"]

@@ -1,0 +1,168 @@
+"""Distributed state synchronization over a jax device mesh.
+
+TPU-native replacement for the reference comm layer
+(``torchmetrics/utilities/distributed.py:100-153`` ``gather_all_tensors`` +
+``metric.py:501-540`` ``_sync_dist``): instead of NCCL all_gather-then-reduce of
+replicated torch states, metric states here live on a ``jax.sharding.Mesh`` and the
+per-state ``dist_reduce_fx`` lowers directly to the matching XLA collective over
+ICI/DCN:
+
+    sum → lax.psum       mean → lax.pmean      min/max → lax.pmin/pmax
+    cat / None / custom  → lax.all_gather (+ concat / custom fold)
+
+Sum-reducible states therefore never pay a gather at all — ``psum`` rides ICI as a
+single fused all-reduce, strictly cheaper than the reference's gather+sum. Ragged
+"cat" states use the reference's own robustness contract (ranks may hold unequal or
+no data) via fixed-capacity buffers + counts (:func:`pad_to_capacity`) instead of the
+dynamic pad-gather-trim of ``distributed.py:138-151``, which XLA cannot express.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
+
+__all__ = [
+    "sync_states",
+    "gather_all_states",
+    "allreduce_over_mesh",
+    "pad_to_capacity",
+    "build_mesh",
+]
+
+
+def build_mesh(axis_names: Sequence[str] = ("data",), shape: Optional[Sequence[int]] = None, devices=None) -> Mesh:
+    """Construct a mesh over the available devices.
+
+    The replacement for the reference's ``process_group`` concept (``metric.py:131``):
+    a named mesh axis identifies the set of replicas a metric syncs across.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    return Mesh(devices.reshape(shape), tuple(axis_names))
+
+
+def sync_states(state: Dict[str, Any], reductions: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+    """Reduce a metric state pytree across a mesh axis — call INSIDE ``shard_map``/``pjit``.
+
+    This is the reference's ``Metric._sync_dist`` (``metric.py:501-540``) re-expressed
+    as XLA collectives; used with :meth:`Metric.functional` to keep the entire
+    train-step + metric-sync inside one compiled program.
+    """
+    out: Dict[str, Any] = {}
+    for name, value in state.items():
+        fx = reductions.get(name)
+        if fx is dim_zero_sum or fx == "sum":
+            out[name] = lax.psum(value, axis_name)
+        elif fx is dim_zero_mean or fx == "mean":
+            out[name] = lax.pmean(value, axis_name)
+        elif fx is dim_zero_max or fx == "max":
+            out[name] = lax.pmax(value, axis_name)
+        elif fx is dim_zero_min or fx == "min":
+            out[name] = lax.pmin(value, axis_name)
+        elif fx is dim_zero_cat or fx == "cat":
+            v = jnp.concatenate([jnp.atleast_1d(x) for x in value]) if isinstance(value, list) else value
+            gathered = lax.all_gather(v, axis_name)  # (world, ...) → concat along sample dim
+            out[name] = gathered.reshape((-1,) + gathered.shape[2:])
+        elif fx is None:
+            out[name] = lax.all_gather(value, axis_name)
+        elif callable(fx):
+            out[name] = fx(lax.all_gather(value, axis_name))
+        else:  # pragma: no cover
+            raise TypeError(f"Unsupported dist_reduce_fx for state {name!r}: {fx}")
+    return out
+
+
+def allreduce_over_mesh(
+    per_rank_states: Sequence[Dict[str, Any]],
+    reductions: Dict[str, Any],
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "data",
+) -> Dict[str, Any]:
+    """Fan-in N per-rank state pytrees through the real collective path on a mesh.
+
+    Stacks the states, shards the stack over ``axis_name``, and runs
+    :func:`sync_states` under ``shard_map`` — i.e. the exact code path a multi-chip
+    deployment uses, exercised here with N local (or host-platform virtual) devices.
+    This is the test rig replacing the reference's 2-process gloo pool
+    (``tests/unittests/conftest.py:47-84``).
+    """
+    n = len(per_rank_states)
+    if mesh is None:
+        mesh = build_mesh((axis_name,), devices=jax.devices()[:n])
+    # list states: pre-concat per rank (reference metric.py:506-507), pad to common capacity
+    prepped: List[Dict[str, Any]] = []
+    for st in per_rank_states:
+        d = {}
+        for k, v in st.items():
+            d[k] = jnp.concatenate([jnp.atleast_1d(x) for x in v]) if isinstance(v, list) else jnp.asarray(v)
+        prepped.append(d)
+    stacked = {k: jnp.stack([p[k] for p in prepped]) for k in prepped[0]}
+    specs = {k: P(axis_name, *([None] * (stacked[k].ndim - 1))) for k in stacked}
+
+    def _body(state):
+        local = {k: v[0] for k, v in state.items()}  # strip the per-rank leading dim
+        return sync_states(local, reductions, axis_name)
+
+    synced = jax.shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs={k: P() for k in stacked},
+        check_vma=False,
+    )(stacked)
+    return synced
+
+
+def gather_all_states(states: List[Any], group: Any = None) -> List[List[Any]]:
+    """Eagerly gather each state across JAX processes (multi-host).
+
+    Analog of ``gather_all_tensors`` (``distributed.py:100-153``); used by the OO
+    ``Metric.sync`` path when ``jax.process_count() > 1``. Uneven leading dims are
+    padded to the max then trimmed, mirroring the reference's ragged contract.
+    """
+    if jax.process_count() == 1:
+        return [[s] if not isinstance(s, list) else [s] for s in states]
+    from jax.experimental import multihost_utils
+
+    world = jax.process_count()
+    out: List[List[Any]] = []
+    for s in states:
+        if isinstance(s, list):
+            s = jnp.concatenate([jnp.atleast_1d(x) for x in s]) if s else jnp.zeros((0,))
+        s = jnp.asarray(s)
+        # ragged leading dim: share sizes first, pad, gather, trim (distributed.py:138-151)
+        local_size = jnp.asarray(s.shape[0] if s.ndim else 1)
+        sizes = multihost_utils.process_allgather(local_size)
+        max_size = int(np.max(np.asarray(sizes)))
+        if s.ndim == 0:
+            gathered = multihost_utils.process_allgather(s)
+            out.append([gathered[i] for i in range(world)])
+            continue
+        pad = [(0, max_size - s.shape[0])] + [(0, 0)] * (s.ndim - 1)
+        padded = jnp.pad(s, pad)
+        gathered = multihost_utils.process_allgather(padded)
+        out.append([gathered[i, : int(sizes[i])] for i in range(world)])
+    return out
+
+
+def pad_to_capacity(x: Array, capacity: int, axis: int = 0, fill_value: float = 0.0) -> Tuple[Array, Array]:
+    """Pad ``x`` to a static ``capacity`` along ``axis``; returns (padded, valid_count).
+
+    The static-shape strategy (SURVEY §7.1-2b) for sample-storing states inside jit:
+    fixed-capacity buffer + count scalar instead of a dynamically-shaped array.
+    """
+    n = x.shape[axis]
+    if n > capacity:
+        raise ValueError(f"Buffer overflow: {n} > capacity {capacity}")
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, capacity - n)
+    return jnp.pad(x, pad, constant_values=fill_value), jnp.asarray(n, dtype=jnp.int32)

@@ -1,0 +1,42 @@
+"""Utility layer (L0): reductions, kernels, checks, enums.
+
+Parity with reference ``torchmetrics/utilities/`` (SURVEY §2.3).
+"""
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_divide, _safe_xlogy, auc, interp
+from metrics_tpu.utils.data import (
+    bincount,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utils.exceptions import TPUMetricsUserError, TPUMetricsUserWarning
+from metrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+
+__all__ = [
+    "TPUMetricsUserError",
+    "TPUMetricsUserWarning",
+    "_check_same_shape",
+    "_safe_divide",
+    "_safe_xlogy",
+    "auc",
+    "bincount",
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "interp",
+    "rank_zero_debug",
+    "rank_zero_info",
+    "rank_zero_warn",
+    "select_topk",
+    "to_categorical",
+    "to_onehot",
+]

@@ -1,0 +1,74 @@
+"""Input-validation helpers.
+
+Parity with reference ``torchmetrics/utilities/checks.py`` (``_check_same_shape :38``,
+retrieval checks ``:508-618``). TPU design note (SURVEY §7.1-3): validation that
+branches on data *values* cannot live under ``jit``; these helpers therefore run
+eagerly in the public API layer (gated by ``validate_args``) BEFORE the jitted
+update kernel is entered. Shape/dtype checks are trace-safe (shapes are static).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array, core
+
+
+def _is_traced(*xs) -> bool:
+    """True if any input is an abstract tracer (inside jit/vmap) — skip value checks then."""
+    return any(isinstance(x, core.Tracer) for x in xs)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Check that predictions and target have the same shape, else raise (reference ``checks.py:38``)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def _check_retrieval_shape(indexes: Array, preds: Array, target: Array) -> None:
+    """Check retrieval input shapes match (reference ``checks.py:508``)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise IndexError("`indexes`, `preds` and `target` must be of the same shape")
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Check ``indexes``, ``preds`` and ``target`` for retrieval metrics (reference ``checks.py:508-575``).
+
+    Flattens all inputs; validates dtypes eagerly (never under jit).
+    """
+    _check_retrieval_shape(indexes, preds, target)
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of integers")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or jnp.issubdtype(target.dtype, jnp.bool_)):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    indexes, preds, target = indexes.reshape(-1), preds.reshape(-1), target.reshape(-1)
+    if ignore_index is not None:
+        valid = target != ignore_index
+        # dynamic filter: host-side only (retrieval states are list states, never jitted)
+        import numpy as np
+
+        mask = np.asarray(valid)
+        indexes, preds, target = indexes[mask], preds[mask], target[mask]
+    if not _is_traced(target) and not allow_non_binary_target:
+        mx = jnp.max(target) if target.size else jnp.asarray(0)
+        if int(mx) > 1 or int(jnp.min(target) if target.size else jnp.asarray(0)) < 0:
+            raise ValueError("`target` must contain binary values")
+    return indexes.astype(jnp.int32), preds.astype(jnp.float32), target
+
+def _check_data_range(x: Array, lower: float, upper: float, name: str) -> None:
+    """Eagerly validate value range; silently skipped under tracing."""
+    if _is_traced(x):
+        return
+    if x.size and (float(jnp.min(x)) < lower or float(jnp.max(x)) > upper):
+        raise ValueError(f"Expected `{name}` to be in range [{lower}, {upper}].")

@@ -1,0 +1,112 @@
+"""Numeric helper kernels.
+
+Parity with reference ``torchmetrics/utilities/compute.py`` (``_safe_matmul :21``,
+``_safe_xlogy :32``, ``_safe_divide :47``, ``_adjust_weights_safe_divide :71``,
+``_auc_compute :101-138``, ``interp :157``, ``normalize_logits_if_needed :190``).
+All are branch-free jnp formulations safe under ``jit`` — the reference's in-place
+masking becomes ``jnp.where``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul; on TPU there is no fp16 overflow cliff to work around (reference ``compute.py:21``)."""
+    return x @ y.T
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y) with 0*log(0) := 0 (reference ``compute.py:32``)."""
+    res = jax.scipy.special.xlogy(x, y)
+    return res
+
+
+def _safe_log(x: Array) -> Array:
+    """log with log(0) clamped to a large negative finite value instead of -inf."""
+    return jnp.log(jnp.clip(x, a_min=jnp.finfo(jnp.result_type(x, jnp.float32)).tiny))
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Element-wise division, 0 (or ``zero_division``) where denominator is 0 (reference ``compute.py:47``).
+
+    >>> import jax.numpy as jnp
+    >>> _safe_divide(jnp.array([1.0, 2.0]), jnp.array([2.0, 0.0]))
+    Array([0.5, 0. ], dtype=float32)
+    """
+    num = num if jnp.issubdtype(jnp.asarray(num).dtype, jnp.floating) else jnp.asarray(num, jnp.float32)
+    denom = denom if jnp.issubdtype(jnp.asarray(denom).dtype, jnp.floating) else jnp.asarray(denom, jnp.float32)
+    zero_mask = denom == 0
+    safe_denom = jnp.where(zero_mask, 1.0, denom)
+    return jnp.where(zero_mask, jnp.asarray(zero_division, dtype=safe_denom.dtype), num / safe_denom)
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array, top_k: int = 1
+) -> Array:
+    """Apply micro/macro/weighted averaging to per-class scores (reference ``compute.py:71-98``)."""
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = tp + fn
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel:
+            weights = weights * ((tp + fp + fn) > 0)
+    return _safe_divide(weights * score, jnp.sum(weights, axis=-1, keepdims=True)).sum(-1)
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal AUC given monotone x (reference ``compute.py:118-137``)."""
+    dx = jnp.diff(x, axis=axis)
+    y_avg = (jax.lax.slice_in_dim(y, 1, None, axis=axis) + jax.lax.slice_in_dim(y, 0, -1, axis=axis)) / 2.0
+    return jnp.sum(dx * y_avg, axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Trapezoidal AUC with optional reorder by x (reference ``compute.py:101-116``).
+
+    The reference raises on non-monotone x; under jit we cannot branch on data, so the
+    direction is derived from the sign of the total x-span (matching behavior for
+    monotone inputs, which is the library-internal contract).
+    """
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+    direction = jnp.where(x[-1] >= x[0], 1.0, -1.0)
+    return _auc_compute_without_check(x, y, 1.0) * direction
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the curve using the trapezoidal rule (public functional; reference ``functional/classification/auc``)."""
+    return _auc_compute(x, y, reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """One-dimensional linear interpolation (reference ``compute.py:157-187``)."""
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(tensor: Array, normalization: str) -> Array:
+    """Sigmoid/softmax the input iff values fall outside [0,1] (reference ``compute.py:190-229``).
+
+    The reference's data-dependent Python branch becomes a ``jnp.where`` on a traced
+    predicate so the op stays inside one XLA program (no host sync).
+
+    >>> import jax.numpy as jnp
+    >>> normalize_logits_if_needed(jnp.array([0.1, 0.5, 0.9]), "sigmoid")
+    Array([0.1, 0.5, 0.9], dtype=float32)
+    """
+    if normalization not in ("sigmoid", "softmax"):
+        raise ValueError(f"Unknown normalization: {normalization}")
+    out_of_bounds = jnp.logical_or(jnp.min(tensor) < 0, jnp.max(tensor) > 1)
+    if normalization == "sigmoid":
+        normed = jax.nn.sigmoid(tensor)
+    else:
+        normed = jax.nn.softmax(tensor, axis=-1)
+    return jnp.where(out_of_bounds, normed, tensor)

@@ -1,0 +1,163 @@
+"""Core data-movement kernels, designed XLA-first.
+
+Capability parity with reference ``torchmetrics/utilities/data.py`` (dim_zero reductions
+``:29-56``, ``to_onehot :81``, ``select_topk :124``, ``to_categorical :151``,
+``_bincount :178``, ``_cumsum :209``, ``_flexible_bincount :223``, ``interp :249``)
+— but implemented as static-shape jnp ops:
+
+* ``bincount`` takes a **static** ``minlength`` so it lowers to one scatter-add /
+  one-hot contraction (the reference's deterministic fallback is already this form);
+  no data-dependent output shape ever reaches XLA.
+* list-state concatenation (``dim_zero_cat``) accepts Python lists of arrays and is
+  host-side glue — it only runs at ``compute()`` boundaries, never inside the jitted
+  update hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenation along the zero dimension (reference ``data.py:29``)."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return x
+    x = [y if y.ndim else y.reshape(1) for y in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    """Summation along the zero dimension (reference ``data.py:40``)."""
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    """Average along the zero dimension (reference ``data.py:45``)."""
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    """Max along the zero dimension (reference ``data.py:50``)."""
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    """Min along the zero dimension (reference ``data.py:55``)."""
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists into single list (reference ``data.py:59``)."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> tuple[Dict, bool]:
+    """Flatten dict of dicts into single dict; returns (flat, duplicates_found) (reference ``data.py:63``)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert a dense label tensor to one-hot format (reference ``data.py:81-121``).
+
+    Output has the class dim inserted at axis 1 (N, C, ...), matching the reference's
+    scatter layout; implemented as a comparison against an iota so XLA fuses it.
+
+    >>> import jax.numpy as jnp
+    >>> to_onehot(jnp.array([0, 1, 2]), num_classes=3)
+    Array([[1, 0, 0],
+           [0, 1, 0],
+           [0, 0, 1]], dtype=int32)
+    """
+    classes = jnp.arange(num_classes, dtype=label_tensor.dtype)
+    shape = (label_tensor.shape[0], num_classes) + tuple(label_tensor.shape[1:])
+    onehot = label_tensor[:, None] == classes.reshape((1, num_classes) + (1,) * (label_tensor.ndim - 1))
+    return onehot.astype(jnp.int32).reshape(shape)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """One-hot mask of the top-k entries along ``dim`` (reference ``data.py:124-148``).
+
+    >>> import jax.numpy as jnp
+    >>> select_topk(jnp.array([[1.1, 2.0, 3.0], [2.0, 1.0, 0.5]]), topk=2)
+    Array([[0, 1, 1],
+           [1, 1, 0]], dtype=int32)
+    """
+    if topk == 1:  # cheap argmax path, no sort
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    mask = jnp.zeros_like(moved, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Convert probability-like tensor to categorical labels (reference ``data.py:151-175``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def bincount(x: Array, minlength: int) -> Array:
+    """Static-shape bincount (reference ``data.py:178-206`` ``_bincount``).
+
+    The reference's deterministic / XLA / MPS fallback (arange+eq one-hot sum) is the
+    native formulation here; ``jnp.bincount`` with a static ``length`` lowers to a
+    single scatter-add which XLA schedules deterministically on TPU.
+
+    >>> import jax.numpy as jnp
+    >>> bincount(jnp.array([0, 2, 2, 5]), minlength=6)
+    Array([1, 0, 2, 0, 0, 1], dtype=int32)
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength).astype(jnp.int32)
+
+
+def bincount_weighted(x: Array, weights: Array, minlength: int) -> Array:
+    """Weighted static-shape bincount via segment-sum (no reference equivalent; used by calibration)."""
+    return jax.ops.segment_sum(weights.reshape(-1), x.reshape(-1), num_segments=minlength)
+
+
+def _cumsum(x: Array, axis: Optional[int] = 0) -> Array:
+    """Cumulative sum (reference ``data.py:209-220``); XLA's associative scan is deterministic on TPU."""
+    return jnp.cumsum(x, axis=axis)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Count occurrences of each unique value (reference ``data.py:223-246``).
+
+    Data-dependent output shape — host-side / compute-boundary only, never jitted.
+    """
+    x = x - jnp.min(x)
+    unique_x = jnp.unique(x)
+    output = bincount(x, minlength=int(jnp.max(x)) + 1)
+    return output[unique_x]
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """One-dimensional linear interpolation (reference ``data.py:249-271``)."""
+    return jnp.interp(x, xp, fp)
+
+
+def allclose(tensor1: Array, tensor2: Array) -> bool:
+    """Wrapper of jnp.allclose that is robust towards dtype difference (reference ``data.py:274``)."""
+    if tensor1.dtype != tensor2.dtype:
+        tensor2 = tensor2.astype(tensor1.dtype)
+    return bool(jnp.allclose(tensor1, tensor2))

@@ -1,0 +1,118 @@
+"""String enums used across the framework.
+
+Parity with reference ``torchmetrics/utilities/enums.py:19-153`` (EnumStr, DataType,
+AverageMethod, MDMCAverageMethod, ClassificationTask and variants). Pure Python —
+identical semantics are fine on TPU since enums are static config, never traced.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """Type of any enumerator with allowed comparison to string invariant to cases.
+
+    >>> ClassificationTask.from_str("Binary") == ClassificationTask.BINARY
+    True
+    """
+
+    @staticmethod
+    def _name() -> str:
+        return "Task"
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "Key") -> "EnumStr":
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError as err:
+            _allowed = [m.lower() for m in cls._member_names_]
+            raise ValueError(f"Invalid {cls._name()}: expected one of {_allowed}, but got {value}.") from err
+
+    @classmethod
+    def try_from_str(cls, value: str, source: str = "Key") -> Optional["EnumStr"]:
+        try:
+            return cls.from_str(value, source)
+        except ValueError:
+            return None
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Enum):
+            other = other.value
+        return self.value.lower() == str(other).lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Enum to represent data type of inputs (reference ``enums.py:55``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Data type"
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Enum to represent average method (reference ``enums.py:73``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Average method"
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Enum to represent multi-dim multi-class average method."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"
+
+
+class ClassificationTask(EnumStr):
+    """Enum to represent the different tasks in classification metrics (reference ``enums.py:107``).
+
+    >>> "binary" in list(ClassificationTask)
+    True
+    """
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoBinary(EnumStr):
+    """Classification tasks excluding binary (reference ``enums.py:124``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoMultilabel(EnumStr):
+    """Classification tasks excluding multilabel (reference ``enums.py:140``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"

@@ -1,0 +1,15 @@
+"""Framework exceptions.
+
+Capability parity with reference ``torchmetrics/utilities/exceptions.py:1-21``
+(TorchMetricsUserError / TorchMetricsUserWarning), renamed for this framework.
+"""
+
+from __future__ import annotations
+
+
+class TPUMetricsUserError(Exception):
+    """Error raised when user-facing API contracts are violated."""
+
+
+class TPUMetricsUserWarning(UserWarning):
+    """Warning for recoverable user-facing issues (e.g. degraded precision paths)."""

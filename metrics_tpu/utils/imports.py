@@ -1,0 +1,34 @@
+"""Optional-dependency capability flags.
+
+Parity with reference ``torchmetrics/utilities/imports.py:22-66`` (RequirementCache
+gates). Here the flag system gates host-side optional features (matplotlib plotting,
+transformers-backed text metrics, scipy test oracles); the TPU compute path has no
+optional native deps — everything is jnp/Pallas in-tree.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+_MATPLOTLIB_AVAILABLE = _package_available("matplotlib")
+_SCIPY_AVAILABLE = _package_available("scipy")
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_REGEX_AVAILABLE = _package_available("regex")
+_NLTK_AVAILABLE = _package_available("nltk")
+_IPADIC_AVAILABLE = _package_available("ipadic")
+_MECAB_AVAILABLE = _package_available("MeCab")
+_SENTENCEPIECE_AVAILABLE = _package_available("sentencepiece")
+_LIBROSA_AVAILABLE = _package_available("librosa")
+_ONNXRUNTIME_AVAILABLE = _package_available("onnxruntime")
+_GAMMATONE_AVAILABLE = _package_available("gammatone")
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")

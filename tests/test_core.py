@@ -1,0 +1,267 @@
+"""Core runtime tests — reference ``tests/unittests/bases/test_metric.py`` analog."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.metric import CompositionalMetric, Metric
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+
+class DummySum(Metric):
+    """Reference ``DummyMetricSum`` (``testers.py:591-665``)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.x
+
+
+class DummyList(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(jnp.asarray(x))
+
+    def compute(self):
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        return dim_zero_cat(self.vals)
+
+
+class DummyMeanState(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("m", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+    def update(self, x):
+        self.m = jnp.asarray(x, dtype=jnp.float32).mean()
+
+    def compute(self):
+        return self.m
+
+
+def test_add_state_and_reset():
+    m = DummySum()
+    m.update(5.0)
+    assert float(m.compute()) == 5.0
+    m.reset()
+    assert float(m.compute()) == 0.0
+    assert m._update_count == 0
+
+
+def test_update_count_and_cache():
+    m = DummySum()
+    m.update(1.0)
+    v1 = m.compute()
+    assert m._computed is not None
+    m.update(1.0)
+    assert m._computed is None  # update invalidates cache
+    assert float(m.compute()) == 2.0
+
+
+def test_jitted_update_single_executable():
+    m = DummySum()
+    for i in range(5):
+        m.update(float(i))
+    assert float(m.compute()) == 10.0
+    assert m._jitted_update is not None  # eager updates went through the jitted path
+
+
+def test_forward_returns_batch_value_and_accumulates():
+    m = DummySum()
+    b1 = m(2.0)
+    b2 = m(3.0)
+    assert float(b1) == 2.0 and float(b2) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_forward_full_state_update_path():
+    class FullDummy(DummySum):
+        full_state_update = True
+
+    m = FullDummy()
+    assert float(m(2.0)) == 2.0
+    assert float(m(3.0)) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_forward_with_list_state():
+    m = DummyList()
+    out = m(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(out), [1.0, 2.0])
+    m(jnp.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_merge_state_metric_and_dict():
+    a, b = DummySum(), DummySum()
+    a.update(1.0)
+    b.update(2.0)
+    a.merge_state(b)
+    assert float(a.compute()) == 3.0
+    c = DummySum()
+    c.update(1.0)
+    c.merge_state({"x": jnp.asarray(2.0)})
+    assert float(c.compute()) == 3.0
+
+
+def test_merge_state_raises_for_full_state_update():
+    class FullDummy(DummySum):
+        full_state_update = True
+
+    m = FullDummy()
+    with pytest.raises(RuntimeError, match="not supported"):
+        m.merge_state({"x": jnp.asarray(1.0)})
+
+
+def test_merge_state_wrong_type():
+    m = DummySum()
+    with pytest.raises(ValueError, match="Expected incoming state"):
+        m.merge_state(5)
+
+
+def test_compositional_ops():
+    a, b = DummySum(), DummySum()
+    a.update(4.0)
+    b.update(2.0)
+    assert float((a + b).compute()) == 6.0
+    assert float((a - b).compute()) == 2.0
+    assert float((a * b).compute()) == 8.0
+    assert float((a / b).compute()) == 2.0
+    assert float((a**2).compute()) == 16.0
+    assert float(abs(a).compute()) == 4.0
+    assert bool((a > b).compute())
+
+
+def test_compositional_forward():
+    a, b = DummySum(), DummySum()
+    comp = a + b
+    out = comp(3.0)
+    assert float(out) == 6.0
+
+
+def test_pickle_roundtrip():
+    m = DummySum()
+    m.update(7.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 7.0
+    m2.update(1.0)
+    assert float(m2.compute()) == 8.0
+
+
+def test_clone_independent():
+    m = DummySum()
+    m.update(1.0)
+    c = m.clone()
+    c.update(1.0)
+    assert float(m.compute()) == 1.0
+    assert float(c.compute()) == 2.0
+
+
+def test_state_dict_persistence():
+    m = DummySum()
+    m.update(3.0)
+    assert m.state_dict() == {"_update_count": 1}  # non-persistent by default
+    m.persistent(True)
+    sd = m.state_dict()
+    assert float(sd["x"]) == 3.0
+    m2 = DummySum()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 3.0
+
+
+def test_functional_quadruple_jit():
+    m = DummySum()
+    fns = m.functional()
+    state = fns.init()
+
+    @jax.jit
+    def step(state, x):
+        return fns.update(state, x)
+
+    for i in range(4):
+        state = step(state, jnp.asarray(float(i)))
+    assert float(fns.compute(state)) == 6.0
+    merged = fns.merge(state, state)
+    assert float(fns.compute(merged)) == 12.0
+
+
+def test_functional_inside_shard_map():
+    """The metric update+sync embedded in a sharded step — the TPU deployment shape."""
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.parallel.sync import build_mesh, sync_states
+
+    m = DummySum()
+    fns = m.functional()
+    mesh = build_mesh(("data",))
+    data = jnp.arange(16.0).reshape(8, 2)
+
+    def step(x):
+        state = fns.update(fns.init(), x[0])
+        synced = sync_states(state, fns.reductions, "data")
+        return synced
+
+    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs={"x": P()}, check_vma=False)(data)
+    assert float(out["x"]) == float(data.sum())
+
+
+def test_double_sync_raises():
+    m = DummySum()
+    m.update(1.0)
+    m.sync(distributed_available=True, dist_sync_fn=lambda states, group: [[s] for s in states])
+    with pytest.raises(TPUMetricsUserError, match="already been synced"):
+        m.sync(distributed_available=True)
+    m.unsync()
+    with pytest.raises(TPUMetricsUserError, match="already been un-synced"):
+        m.unsync()
+
+
+def test_update_after_sync_raises():
+    m = DummySum()
+    m.update(1.0)
+    m.sync(distributed_available=True, dist_sync_fn=lambda states, group: [[s] for s in states])
+    with pytest.raises(TPUMetricsUserError):
+        m.update(1.0)
+
+
+def test_set_dtype():
+    m = DummySum()
+    m.update(1.0)
+    m.set_dtype(jnp.bfloat16)
+    assert m.metric_state["x"].dtype == jnp.bfloat16
+
+
+def test_hash_distinct_instances():
+    a, b = DummySum(), DummySum()
+    assert hash(a) != hash(b) or a is b
+
+
+def test_invalid_kwarg():
+    with pytest.raises(ValueError, match="Unexpected keyword"):
+        DummySum(bogus=1)
+
+
+def test_mean_state_forward_running_mean():
+    m = DummyMeanState()
+    m(jnp.asarray([2.0]))
+    m(jnp.asarray([4.0]))
+    assert float(m.compute()) == pytest.approx(3.0)

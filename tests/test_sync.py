@@ -1,0 +1,98 @@
+"""Distributed sync tests over the 8-device CPU mesh.
+
+Replaces the reference's raw DDP semantics suite (``tests/unittests/bases/test_ddp.py:35-343``):
+sum/mean/min/max/cat reductions, mixed-state metrics, empty-rank cat states — all through
+the REAL collective path (``shard_map`` + ``lax.psum``/``all_gather`` over the mesh).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.parallel.sync import allreduce_over_mesh, build_mesh, pad_to_capacity, sync_states
+
+
+def _reductions(**kw):
+    return dict(kw)
+
+
+def test_allreduce_sum_over_8_ranks():
+    states = [{"tp": jnp.asarray(float(i))} for i in range(8)]
+    out = allreduce_over_mesh(states, _reductions(tp="sum"))
+    assert float(out["tp"]) == sum(range(8))
+
+
+def test_allreduce_mean_min_max():
+    states = [{"m": jnp.asarray(float(i)), "lo": jnp.asarray(float(i)), "hi": jnp.asarray(float(i))} for i in range(8)]
+    out = allreduce_over_mesh(states, _reductions(m="mean", lo="min", hi="max"))
+    assert float(out["m"]) == pytest.approx(3.5)
+    assert float(out["lo"]) == 0.0
+    assert float(out["hi"]) == 7.0
+
+
+def test_allreduce_cat():
+    states = [{"v": jnp.asarray([float(i), float(i) + 0.5])} for i in range(8)]
+    out = allreduce_over_mesh(states, _reductions(v="cat"))
+    assert out["v"].shape == (16,)
+    np.testing.assert_allclose(np.asarray(out["v"][:2]), [0.0, 0.5])
+
+
+def test_allreduce_list_state_cat():
+    states = [{"v": [jnp.asarray([float(i)]), jnp.asarray([float(i) + 0.5])]} for i in range(4)]
+    out = allreduce_over_mesh(states, _reductions(v="cat"))
+    assert out["v"].shape == (8,)
+
+
+def test_allreduce_vector_sum():
+    states = [{"conf": jnp.ones((5, 5)) * i} for i in range(8)]
+    out = allreduce_over_mesh(states, _reductions(conf="sum"))
+    np.testing.assert_allclose(np.asarray(out["conf"]), np.ones((5, 5)) * sum(range(8)))
+
+
+def test_sync_states_inside_shard_map_mixed():
+    """Mixed reductions in ONE compiled program (reference test_ddp mixed-state cases)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_mesh(("data",))
+    stacked = {
+        "s": jnp.arange(8.0),
+        "mx": jnp.arange(8.0),
+        "c": jnp.arange(16.0).reshape(8, 2),
+    }
+
+    def body(st):
+        local = {k: v[0] for k, v in st.items()}
+        return sync_states(local, {"s": "sum", "mx": "max", "c": "cat"}, "data")
+
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=({k: P("data", *([None] * (v.ndim - 1))) for k, v in stacked.items()},),
+        out_specs={"s": P(), "mx": P(), "c": P()},
+        check_vma=False,
+    )(stacked)
+    assert float(out["s"]) == 28.0
+    assert float(out["mx"]) == 7.0
+    assert out["c"].shape == (16,)
+
+
+def test_pad_to_capacity():
+    x = jnp.arange(5.0)
+    padded, n = pad_to_capacity(x, 8)
+    assert padded.shape == (8,)
+    assert int(n) == 5
+    with pytest.raises(ValueError, match="overflow"):
+        pad_to_capacity(x, 3)
+
+
+def test_metric_state_through_mesh_equals_sequential():
+    """End-to-end: 8 per-rank DummySum states synced over the mesh == sequential result."""
+    from tests.test_core import DummySum
+
+    ms = [DummySum() for _ in range(8)]
+    data = np.random.randn(8, 16).astype(np.float32)
+    for m, row in zip(ms, data):
+        m.update(jnp.asarray(row))
+    out = allreduce_over_mesh([m.metric_state for m in ms], ms[0]._reductions)
+    np.testing.assert_allclose(float(out["x"]), data.sum(), rtol=1e-4)
