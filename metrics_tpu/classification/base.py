@@ -1,0 +1,27 @@
+"""Task-dispatch base for umbrella classification metrics (reference ``classification/base.py:19-32``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from metrics_tpu.metric import Metric
+
+
+class _ClassificationTaskWrapper(Metric):
+    """Base class for classification metrics that dispatch on a ``task`` argument.
+
+    Umbrella classes (``Accuracy``, ``Precision``, …) override ``__new__`` to return
+    the Binary/Multiclass/Multilabel variant; instantiating the wrapper directly is an
+    error (reference ``classification/base.py:22-31``).
+    """
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update state with data (unreachable: ``__new__`` returns a task class)."""
+        raise NotImplementedError(
+            f"{self.__class__.__name__} metric does not have an update method. This means you likely tried"
+            " to inherit from the task wrapper instead of one of its task-specific versions."
+        )
+
+    def compute(self) -> None:
+        """Compute metric (unreachable: ``__new__`` returns a task class)."""
+        raise NotImplementedError(f"{self.__class__.__name__} metric does not have a compute method.")

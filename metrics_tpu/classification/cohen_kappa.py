@@ -1,0 +1,123 @@
+"""Modular Cohen's kappa metrics (reference ``classification/cohen_kappa.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
+from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_reduce
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Calculate Cohen's kappa for binary tasks (reference ``classification/cohen_kappa.py:41-123``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([1, 1, 0, 0])
+    >>> preds = jnp.array([0, 1, 0, 0])
+    >>> metric = BinaryCohenKappa()
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array(0.5, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            threshold=threshold, ignore_index=ignore_index, normalize=None, validate_args=validate_args, **kwargs
+        )
+        if validate_args and weights not in (None, "none", "linear", "quadratic"):
+            raise ValueError(f"Expected argument `weights` to be one of None, 'linear' or 'quadratic' but got {weights}")
+        self.weights = weights
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+
+class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    """Calculate Cohen's kappa for multiclass tasks (reference ``classification/cohen_kappa.py:126-211``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([2, 1, 0, 0])
+    >>> preds = jnp.array([2, 1, 0, 1])
+    >>> metric = MulticlassCohenKappa(num_classes=3)
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array(0.6363636, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, ignore_index=ignore_index, normalize=None, validate_args=validate_args, **kwargs
+        )
+        if validate_args and weights not in (None, "none", "linear", "quadratic"):
+            raise ValueError(f"Expected argument `weights` to be one of None, 'linear' or 'quadratic' but got {weights}")
+        self.weights = weights
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+
+class CohenKappa(_ClassificationTaskWrapper):
+    """Task-dispatching Cohen's kappa (reference ``classification/cohen_kappa.py:214-266``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([1, 1, 0, 0])
+    >>> preds = jnp.array([0, 1, 0, 0])
+    >>> metric = CohenKappa(task="binary")
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array(0.5, dtype=float32)
+    """
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        weights: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"weights": weights, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCohenKappa(threshold, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassCohenKappa(num_classes, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,219 @@
+"""Modular ConfusionMatrix metrics (reference ``classification/confusion_matrix.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _binary_confusion_matrix_compute,
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_compute,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_update,
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_confusion_matrix_compute,
+    _multilabel_confusion_matrix_format,
+    _multilabel_confusion_matrix_tensor_validation,
+    _multilabel_confusion_matrix_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryConfusionMatrix(Metric):
+    """Compute the confusion matrix for binary tasks (reference ``classification/confusion_matrix.py:46-142``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([1, 1, 0, 0])
+    >>> preds = jnp.array([0, 1, 0, 0])
+    >>> metric = BinaryConfusionMatrix()
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([[2, 0],
+           [1, 1]], dtype=int32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    confmat: Array
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        normalize: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.normalize = normalize
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.validate_args:
+            _binary_confusion_matrix_tensor_validation(preds, target, self.ignore_index)
+        preds, target = _binary_confusion_matrix_format(preds, target, self.threshold, self.ignore_index)
+        confmat = _binary_confusion_matrix_update(preds, target)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Compute confusion matrix."""
+        return _binary_confusion_matrix_compute(self.confmat, self.normalize)
+
+
+class MulticlassConfusionMatrix(Metric):
+    """Compute the confusion matrix for multiclass tasks (reference ``classification/confusion_matrix.py:145-248``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([2, 1, 0, 0])
+    >>> preds = jnp.array([2, 1, 0, 1])
+    >>> metric = MulticlassConfusionMatrix(num_classes=3)
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([[1, 1, 0],
+           [0, 1, 0],
+           [0, 0, 1]], dtype=int32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        normalize: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
+        self.num_classes = num_classes
+        self.ignore_index = ignore_index
+        self.normalize = normalize
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.validate_args:
+            _multiclass_confusion_matrix_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        preds, target = _multiclass_confusion_matrix_format(preds, target, self.ignore_index)
+        confmat = _multiclass_confusion_matrix_update(preds, target, self.num_classes)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Compute confusion matrix."""
+        return _multiclass_confusion_matrix_compute(self.confmat, self.normalize)
+
+
+class MultilabelConfusionMatrix(Metric):
+    """Compute the confusion matrix for multilabel tasks (reference ``classification/confusion_matrix.py:251-357``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+    >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+    >>> metric = MultilabelConfusionMatrix(num_labels=3)
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([[[1, 0], [0, 1]],
+           [[1, 0], [1, 0]],
+           [[0, 1], [0, 1]]], dtype=int32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    confmat: Array
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        normalize: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.normalize = normalize
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.validate_args:
+            _multilabel_confusion_matrix_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target = _multilabel_confusion_matrix_format(
+            preds, target, self.num_labels, self.threshold, self.ignore_index
+        )
+        confmat = _multilabel_confusion_matrix_update(preds, target, self.num_labels)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Compute confusion matrix."""
+        return _multilabel_confusion_matrix_compute(self.confmat, self.normalize)
+
+
+class ConfusionMatrix(_ClassificationTaskWrapper):
+    """Task-dispatching ConfusionMatrix (reference ``classification/confusion_matrix.py:360-423``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([1, 1, 0, 0])
+    >>> preds = jnp.array([0, 1, 0, 0])
+    >>> confmat = ConfusionMatrix(task="binary")
+    >>> confmat.update(preds, target)
+    >>> confmat.compute()
+    Array([[2, 0],
+           [1, 1]], dtype=int32)
+    """
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        normalize: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"normalize": normalize, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryConfusionMatrix(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassConfusionMatrix(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+            return MultilabelConfusionMatrix(num_labels, threshold, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

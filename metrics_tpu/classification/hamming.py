@@ -1,0 +1,118 @@
+"""Modular Hamming distance metrics (reference ``classification/hamming.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.stat_scores import BinaryStatScores, MulticlassStatScores, MultilabelStatScores
+from metrics_tpu.functional.classification._reduce import _hamming_distance_reduce
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryHammingDistance(BinaryStatScores):
+    """Compute Hamming distance for binary tasks (reference ``classification/hamming.py:44-131``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+    >>> metric = BinaryHammingDistance()
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array(0.3333333, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        tp, fp, tn, fn = self._final_state()
+        return _hamming_distance_reduce(tp, fp, tn, fn, average="binary", multidim_average=self.multidim_average)
+
+
+class MulticlassHammingDistance(MulticlassStatScores):
+    """Compute Hamming distance for multiclass tasks (reference ``classification/hamming.py:134-252``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        tp, fp, tn, fn = self._final_state()
+        return _hamming_distance_reduce(tp, fp, tn, fn, average=self.average, multidim_average=self.multidim_average)
+
+
+class MultilabelHammingDistance(MultilabelStatScores):
+    """Compute Hamming distance for multilabel tasks (reference ``classification/hamming.py:255-374``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        tp, fp, tn, fn = self._final_state()
+        return _hamming_distance_reduce(
+            tp, fp, tn, fn, average=self.average, multidim_average=self.multidim_average, multilabel=True
+        )
+
+
+class HammingDistance(_ClassificationTaskWrapper):
+    """Task-dispatching Hamming distance (reference ``classification/hamming.py:377-450``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([1, 1, 0, 1])
+    >>> preds = jnp.array([0, 1, 0, 1])
+    >>> hamming = HammingDistance(task="binary")
+    >>> hamming.update(preds, target)
+    >>> hamming.compute()
+    Array(0.25, dtype=float32)
+    """
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: Optional[int] = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTask.from_str(task)
+        kwargs.update({
+            "multidim_average": multidim_average,
+            "ignore_index": ignore_index,
+            "validate_args": validate_args,
+        })
+        if task == ClassificationTask.BINARY:
+            return BinaryHammingDistance(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)}` was passed.")
+            return MulticlassHammingDistance(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+            return MultilabelHammingDistance(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

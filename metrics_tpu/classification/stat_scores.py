@@ -1,0 +1,286 @@
+"""Modular stat-scores metrics (reference ``classification/stat_scores.py``).
+
+The counter-state archetype (SURVEY §2.5-1): ``tp/fp/tn/fn`` sum-states for
+``multidim_average="global"`` (synced with one ``psum``) or "cat" list states for
+``samplewise``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_compute,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_compute,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multiclass_stat_scores_update,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_compute,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+    _multilabel_stat_scores_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class _AbstractStatScores(Metric):
+    """Common state plumbing for tp/fp/tn/fn metrics (reference ``classification/stat_scores.py:43-89``)."""
+
+    tp: Union[List[Array], Array]
+    fp: Union[List[Array], Array]
+    tn: Union[List[Array], Array]
+    fn: Union[List[Array], Array]
+
+    def _create_state(self, size: int, multidim_average: str = "global") -> None:
+        """Initialize the states for the different statistics."""
+        if multidim_average == "samplewise":
+            default: Any = list
+            dist_reduce_fx = "cat"
+        else:
+            default = lambda: jnp.zeros(size, dtype=jnp.int32)  # noqa: E731
+            dist_reduce_fx = "sum"
+        self.add_state("tp", default(), dist_reduce_fx=dist_reduce_fx)
+        self.add_state("fp", default(), dist_reduce_fx=dist_reduce_fx)
+        self.add_state("tn", default(), dist_reduce_fx=dist_reduce_fx)
+        self.add_state("fn", default(), dist_reduce_fx=dist_reduce_fx)
+
+    def _update_state(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
+        """Accumulate batch statistics into the states."""
+        if self.multidim_average == "samplewise":
+            self.tp.append(jnp.atleast_1d(tp))
+            self.fp.append(jnp.atleast_1d(fp))
+            self.tn.append(jnp.atleast_1d(tn))
+            self.fn.append(jnp.atleast_1d(fn))
+        else:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+
+    def _final_state(self) -> Tuple[Array, Array, Array, Array]:
+        """Aggregate list states and return final tp/fp/tn/fn."""
+        return (
+            dim_zero_cat(self.tp),
+            dim_zero_cat(self.fp),
+            dim_zero_cat(self.tn),
+            dim_zero_cat(self.fn),
+        )
+
+
+class BinaryStatScores(_AbstractStatScores):
+    """Compute tp/fp/tn/fn/support for binary tasks (reference ``classification/stat_scores.py:92-230``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+    >>> metric = BinaryStatScores()
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([2, 1, 2, 1, 3], dtype=int32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(size=1, multidim_average=multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.validate_args:
+            _binary_stat_scores_tensor_validation(preds, target, self.multidim_average, self.ignore_index)
+        preds, target = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+        tp, fp, tn, fn = _binary_stat_scores_update(preds, target, self.multidim_average)
+        self._update_state(tp, fp, tn, fn)
+
+    def compute(self) -> Array:
+        """Compute the final statistics."""
+        tp, fp, tn, fn = self._final_state()
+        return _binary_stat_scores_compute(tp, fp, tn, fn, self.multidim_average)
+
+
+class MulticlassStatScores(_AbstractStatScores):
+    """Compute tp/fp/tn/fn/support for multiclass tasks (reference ``classification/stat_scores.py:233-378``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([2, 1, 0, 0])
+    >>> preds = jnp.array([2, 1, 0, 1])
+    >>> metric = MulticlassStatScores(num_classes=3, average='micro')
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([3, 1, 7, 1, 4], dtype=int32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        top_k: int = 1,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        self.num_classes = num_classes
+        self.top_k = top_k
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(
+            size=1 if (average == "micro" and top_k == 1) else (num_classes or 1), multidim_average=multidim_average
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(
+                preds, target, self.num_classes, self.multidim_average, self.ignore_index
+            )
+        preds, target = _multiclass_stat_scores_format(preds, target, self.top_k)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(
+            preds, target, self.num_classes, self.top_k, self.average, self.multidim_average, self.ignore_index
+        )
+        self._update_state(tp, fp, tn, fn)
+
+    def compute(self) -> Array:
+        """Compute the final statistics."""
+        tp, fp, tn, fn = self._final_state()
+        return _multiclass_stat_scores_compute(tp, fp, tn, fn, self.average, self.multidim_average)
+
+
+class MultilabelStatScores(_AbstractStatScores):
+    """Compute tp/fp/tn/fn/support for multilabel tasks (reference ``classification/stat_scores.py:381-528``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+    >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+    >>> metric = MultilabelStatScores(num_labels=3, average='micro')
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([2, 1, 2, 1, 3], dtype=int32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(size=num_labels, multidim_average=multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.validate_args:
+            _multilabel_stat_scores_tensor_validation(
+                preds, target, self.num_labels, self.multidim_average, self.ignore_index
+            )
+        preds, target = _multilabel_stat_scores_format(
+            preds, target, self.num_labels, self.threshold, self.ignore_index
+        )
+        tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, self.multidim_average)
+        self._update_state(tp, fp, tn, fn)
+
+    def compute(self) -> Array:
+        """Compute the final statistics."""
+        tp, fp, tn, fn = self._final_state()
+        return _multilabel_stat_scores_compute(tp, fp, tn, fn, self.average, self.multidim_average)
+
+
+class StatScores(_ClassificationTaskWrapper):
+    """Task-dispatching StatScores (reference ``classification/stat_scores.py:531-589``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+    >>> metric = StatScores(task='binary')
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([2, 1, 2, 1, 3], dtype=int32)
+    """
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: Optional[int] = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTask.from_str(task)
+        assert multidim_average is not None  # noqa: S101
+        kwargs.update({
+            "multidim_average": multidim_average,
+            "ignore_index": ignore_index,
+            "validate_args": validate_args,
+        })
+        if task == ClassificationTask.BINARY:
+            return BinaryStatScores(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)}` was passed.")
+            return MulticlassStatScores(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+            return MultilabelStatScores(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,5 @@
+"""Stateless functional metrics layer (reference ``torchmetrics/functional/__init__.py``)."""
+
+from metrics_tpu.functional import classification
+
+__all__ = ["classification"]

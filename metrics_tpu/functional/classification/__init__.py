@@ -1,0 +1,93 @@
+"""Functional classification metrics (reference ``torchmetrics/functional/classification/__init__.py``)."""
+
+from metrics_tpu.functional.classification.accuracy import (
+    accuracy,
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+)
+from metrics_tpu.functional.classification.cohen_kappa import binary_cohen_kappa, cohen_kappa, multiclass_cohen_kappa
+from metrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from metrics_tpu.functional.classification.exact_match import (
+    exact_match,
+    multiclass_exact_match,
+    multilabel_exact_match,
+)
+from metrics_tpu.functional.classification.f_beta import (
+    binary_f1_score,
+    binary_fbeta_score,
+    f1_score,
+    fbeta_score,
+    multiclass_f1_score,
+    multiclass_fbeta_score,
+    multilabel_f1_score,
+    multilabel_fbeta_score,
+)
+from metrics_tpu.functional.classification.hamming import (
+    binary_hamming_distance,
+    hamming_distance,
+    multiclass_hamming_distance,
+    multilabel_hamming_distance,
+)
+from metrics_tpu.functional.classification.jaccard import (
+    binary_jaccard_index,
+    jaccard_index,
+    multiclass_jaccard_index,
+    multilabel_jaccard_index,
+)
+from metrics_tpu.functional.classification.matthews_corrcoef import (
+    binary_matthews_corrcoef,
+    matthews_corrcoef,
+    multiclass_matthews_corrcoef,
+    multilabel_matthews_corrcoef,
+)
+from metrics_tpu.functional.classification.negative_predictive_value import (
+    binary_negative_predictive_value,
+    multiclass_negative_predictive_value,
+    multilabel_negative_predictive_value,
+    negative_predictive_value,
+)
+from metrics_tpu.functional.classification.precision_recall import (
+    binary_precision,
+    binary_recall,
+    multiclass_precision,
+    multiclass_recall,
+    multilabel_precision,
+    multilabel_recall,
+    precision,
+    recall,
+)
+from metrics_tpu.functional.classification.specificity import (
+    binary_specificity,
+    multiclass_specificity,
+    multilabel_specificity,
+    specificity,
+)
+from metrics_tpu.functional.classification.stat_scores import (
+    binary_stat_scores,
+    multiclass_stat_scores,
+    multilabel_stat_scores,
+)
+
+__all__ = [
+    "accuracy", "binary_accuracy", "multiclass_accuracy", "multilabel_accuracy",
+    "binary_cohen_kappa", "cohen_kappa", "multiclass_cohen_kappa",
+    "binary_confusion_matrix", "confusion_matrix", "multiclass_confusion_matrix", "multilabel_confusion_matrix",
+    "exact_match", "multiclass_exact_match", "multilabel_exact_match",
+    "binary_f1_score", "binary_fbeta_score", "f1_score", "fbeta_score",
+    "multiclass_f1_score", "multiclass_fbeta_score", "multilabel_f1_score", "multilabel_fbeta_score",
+    "binary_hamming_distance", "hamming_distance", "multiclass_hamming_distance", "multilabel_hamming_distance",
+    "binary_jaccard_index", "jaccard_index", "multiclass_jaccard_index", "multilabel_jaccard_index",
+    "binary_matthews_corrcoef", "matthews_corrcoef", "multiclass_matthews_corrcoef", "multilabel_matthews_corrcoef",
+    "binary_negative_predictive_value", "multiclass_negative_predictive_value",
+    "multilabel_negative_predictive_value", "negative_predictive_value",
+    "binary_precision", "binary_recall", "multiclass_precision", "multiclass_recall",
+    "multilabel_precision", "multilabel_recall", "precision", "recall",
+    "binary_specificity", "multiclass_specificity", "multilabel_specificity", "specificity",
+    "binary_stat_scores", "multiclass_stat_scores", "multilabel_stat_scores",
+]

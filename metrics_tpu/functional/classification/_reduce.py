@@ -1,0 +1,156 @@
+"""Shared stat-score → score reductions.
+
+One module instead of the reference's per-file copies:
+``_accuracy_reduce`` (``functional/classification/accuracy.py:37-89``),
+``_precision_recall_reduce`` (``precision_recall.py:37-59``),
+``_fbeta_reduce`` (``f_beta.py:37-58``), ``_specificity_reduce``
+(``specificity.py:37-54``), ``_negative_predictive_value_reduce``
+(``negative_predictive_value.py:37-57``), ``_hamming_distance_reduce``
+(``hamming.py:37-83``). All are branch-free jnp formulas over tp/fp/tn/fn.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.compute import _adjust_weights_safe_divide, _safe_divide
+
+
+def _micro_sum(x: Array, multidim_average: str) -> Array:
+    if x.ndim == 0:  # micro-path stats are already scalars (torch's sum(dim=0) on 0-d is a no-op)
+        return x
+    return x.sum(axis=0 if multidim_average == "global" else 1)
+
+
+def _accuracy_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+) -> Array:
+    """Reduce statistics into accuracy score (reference ``accuracy.py:37-89``)."""
+    if average == "binary":
+        return _safe_divide(tp + tn, tp + tn + fp + fn)
+    if average == "micro":
+        tp, fn = _micro_sum(tp, multidim_average), _micro_sum(fn, multidim_average)
+        if multilabel:
+            fp, tn = _micro_sum(fp, multidim_average), _micro_sum(tn, multidim_average)
+            return _safe_divide(tp + tn, tp + tn + fp + fn)
+        return _safe_divide(tp, tp + fn)
+    score = _safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else _safe_divide(tp, tp + fn)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+def _precision_recall_reduce(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0,
+) -> Array:
+    """Reduce statistics into precision or recall (reference ``precision_recall.py:37-59``)."""
+    different_stat = fp if stat == "precision" else fn
+    if average == "binary":
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    if average == "micro":
+        tp = _micro_sum(tp, multidim_average)
+        different_stat = _micro_sum(different_stat, multidim_average)
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    score = _safe_divide(tp, tp + different_stat, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k=top_k)
+
+
+def _fbeta_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    zero_division: float = 0,
+) -> Array:
+    """Reduce statistics into f-beta score (reference ``f_beta.py:37-58``)."""
+    beta2 = beta**2
+    if average == "binary":
+        return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    if average == "micro":
+        tp, fn, fp = (_micro_sum(x, multidim_average) for x in (tp, fn, fp))
+        return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    score = _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def _specificity_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    """Reduce statistics into specificity (reference ``specificity.py:37-54``)."""
+    if average == "binary":
+        return _safe_divide(tn, tn + fp)
+    if average == "micro":
+        tn, fp = _micro_sum(tn, multidim_average), _micro_sum(fp, multidim_average)
+        return _safe_divide(tn, tn + fp)
+    score = _safe_divide(tn, tn + fp)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def _negative_predictive_value_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0,
+) -> Array:
+    """Reduce statistics into negative predictive value (reference ``negative_predictive_value.py:37-57``)."""
+    if average == "binary":
+        return _safe_divide(tn, tn + fn, zero_division)
+    if average == "micro":
+        tn, fn_ = _micro_sum(tn, multidim_average), _micro_sum(fn, multidim_average)
+        return _safe_divide(tn, tn + fn_, zero_division)
+    score = _safe_divide(tn, tn + fn, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k=top_k)
+
+
+def _hamming_distance_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    """Reduce statistics into hamming distance (reference ``hamming.py:37-83``)."""
+    if average == "binary":
+        return 1 - _safe_divide(tp + tn, tp + fp + tn + fn)
+    if average == "micro":
+        tp, fn_s = _micro_sum(tp, multidim_average), _micro_sum(fn, multidim_average)
+        if multilabel:
+            fp_s, tn_s = _micro_sum(fp, multidim_average), _micro_sum(tn, multidim_average)
+            return 1 - _safe_divide(tp + tn_s, tp + tn_s + fp_s + fn_s)
+        return 1 - _safe_divide(tp, tp + fn_s)
+    score = 1 - _safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else 1 - _safe_divide(tp, tp + fn)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)

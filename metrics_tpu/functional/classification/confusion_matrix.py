@@ -1,0 +1,343 @@
+"""Confusion-matrix kernels (reference ``functional/classification/confusion_matrix.py``).
+
+The update is ONE static-shape scatter-add: ``bincount(target*C + preds)`` with a
+dead overflow bin for ``ignore_index`` entries (replacing the reference's dynamic
+boolean filtering, ``confusion_matrix.py:141-146,316-321``) — the XLA-native form.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape, _is_traced
+from metrics_tpu.utils.compute import _safe_divide, normalize_logits_if_needed
+from metrics_tpu.utils.data import bincount
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalize an un-normalized confusion matrix (reference ``confusion_matrix.py:27-62``)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            return _safe_divide(confmat, confmat.sum(axis=-1, keepdims=True))
+        if normalize == "pred":
+            return _safe_divide(confmat, confmat.sum(axis=-2, keepdims=True))
+        return _safe_divide(confmat, confmat.sum(axis=(-2, -1), keepdims=True))
+    return confmat
+
+
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    """Validate non-tensor args (reference ``confusion_matrix.py:65-79``)."""
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if normalize not in ("true", "pred", "all", "none", None):
+        raise ValueError(f"Argument `normalize` needs to one of the following: ('true','pred','all','none',None)")
+
+
+def _binary_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``confusion_matrix.py:82-120``)."""
+    _check_same_shape(preds, target)
+    if _is_traced(preds, target):
+        return
+    import numpy as np
+
+    allowed = {0, 1} | ({ignore_index} if ignore_index is not None else set())
+    uniq = set(np.asarray(jnp.unique(target)).tolist())
+    if not uniq.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(uniq)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        uniq_p = set(np.asarray(jnp.unique(preds)).tolist())
+        if not uniq_p.issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {sorted(uniq_p)} but expected only binary values."
+            )
+
+
+def _binary_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    convert_to_labels: bool = True,
+) -> Tuple[Array, Array]:
+    """Flatten + threshold; ignored positions flagged -1 (reference ``confusion_matrix.py:123-145``)."""
+    preds = preds.reshape(-1)
+    target = target.reshape(-1).astype(jnp.int32)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if convert_to_labels:
+            preds = (preds > threshold).astype(jnp.int32)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array) -> Array:
+    """One scatter-add into 2x2 bins; negatives (ignored) go to a dead bin (reference ``confusion_matrix.py:148-152``)."""
+    valid = target >= 0
+    idx = jnp.where(valid, target * 2 + preds, 4)
+    return bincount(idx, 5)[:4].reshape(2, 2)
+
+
+def _binary_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def binary_confusion_matrix(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute the confusion matrix for binary tasks (reference ``confusion_matrix.py:166-246``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([1, 1, 0, 0])
+    >>> preds = jnp.array([0, 1, 0, 0])
+    >>> binary_confusion_matrix(preds, target)
+    Array([[2, 0],
+           [1, 1]], dtype=int32)
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target)
+    return _binary_confusion_matrix_compute(confmat, normalize)
+
+
+def _multiclass_confusion_matrix_arg_validation(
+    num_classes: int, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    """Validate non-tensor args (reference ``confusion_matrix.py:249-262``)."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if normalize not in ("true", "pred", "all", "none", None):
+        raise ValueError(f"Argument `normalize` needs to one of the following: ('true','pred','all','none',None)")
+
+
+def _multiclass_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``confusion_matrix.py:265-302``)."""
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                             " (N, C, ...), and the shape of `target` should be (N, ...).")
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape,"
+                             f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.")
+    else:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target`"
+                         " should be (N, ...) and `preds` should be (N, C, ...).")
+    if _is_traced(preds, target):
+        return
+    check_value = num_classes if ignore_index is None else num_classes + 1
+    to_check = [(target, "target")]
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        to_check.append((preds, "preds"))
+    for t, name in to_check:
+        uniq = jnp.unique(t)
+        if uniq.size > check_value:
+            raise RuntimeError(
+                f"Detected more unique values in `{name}` than expected. Expected only {check_value} but found"
+                f" {uniq.size} in `{name}`."
+            )
+
+
+def _multiclass_confusion_matrix_format(
+    preds: Array, target: Array, ignore_index: Optional[int] = None, convert_to_labels: bool = True
+) -> Tuple[Array, Array]:
+    """Argmax + flatten; ignored positions flagged -1 (reference ``confusion_matrix.py:305-321``)."""
+    if preds.ndim == target.ndim + 1 and convert_to_labels:
+        preds = jnp.argmax(preds, axis=1)
+    preds = preds.reshape(-1) if convert_to_labels else preds.reshape(preds.shape[0], -1)
+    target = target.reshape(-1).astype(jnp.int32)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes: int) -> Array:
+    """One scatter-add into C² bins + dead bin for ignored entries (reference ``confusion_matrix.py:324-328``)."""
+    valid = target >= 0
+    safe_t = jnp.clip(target, 0, num_classes - 1)
+    safe_p = jnp.clip(preds, 0, num_classes - 1)
+    idx = jnp.where(valid, safe_t * num_classes + safe_p, num_classes * num_classes)
+    return bincount(idx, num_classes * num_classes + 1)[: num_classes * num_classes].reshape(num_classes, num_classes)
+
+
+def _multiclass_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multiclass_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute the confusion matrix for multiclass tasks (reference ``confusion_matrix.py:342-430``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([2, 1, 0, 0])
+    >>> preds = jnp.array([2, 1, 0, 1])
+    >>> multiclass_confusion_matrix(preds, target, num_classes=3)
+    Array([[1, 1, 0],
+           [0, 1, 0],
+           [0, 0, 1]], dtype=int32)
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes)
+    return _multiclass_confusion_matrix_compute(confmat, normalize)
+
+
+def _multilabel_confusion_matrix_arg_validation(
+    num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    """Validate non-tensor args (reference ``confusion_matrix.py:433-449``)."""
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if normalize not in ("true", "pred", "all", "none", None):
+        raise ValueError(f"Argument `normalize` needs to one of the following: ('true','pred','all','none',None)")
+
+
+def _multilabel_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``confusion_matrix.py:452-490``)."""
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            f"Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and {num_labels}"
+        )
+    if _is_traced(preds, target):
+        return
+    import numpy as np
+
+    allowed = {0, 1} | ({ignore_index} if ignore_index is not None else set())
+    uniq = set(np.asarray(jnp.unique(target)).tolist())
+    if not uniq.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(uniq)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _multilabel_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    should_threshold: bool = True,
+) -> Tuple[Array, Array]:
+    """Sigmoid+threshold; move label dim last and flatten (reference ``confusion_matrix.py:493-508``)."""
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if should_threshold:
+            preds = (preds > threshold).astype(jnp.int32)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(target.astype(jnp.int32), 1, -1).reshape(-1, num_labels)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, num_labels: int) -> Array:
+    """Scatter-add into (L,2,2) bins with a dead bin for ignored entries (reference ``confusion_matrix.py:511-516``)."""
+    valid = target >= 0
+    safe_t = jnp.clip(target, 0, 1)
+    idx = jnp.where(valid, 2 * safe_t + preds + 4 * jnp.arange(num_labels), 4 * num_labels)
+    return bincount(idx, 4 * num_labels + 1)[: 4 * num_labels].reshape(num_labels, 2, 2)
+
+
+def _multilabel_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multilabel_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute the confusion matrix for multilabel tasks (reference ``confusion_matrix.py:529-619``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+    >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+    >>> multilabel_confusion_matrix(preds, target, num_labels=3)
+    Array([[[1, 0], [0, 1]],
+           [[1, 0], [1, 0]],
+           [[0, 1], [0, 1]]], dtype=int32)
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, num_labels)
+    return _multilabel_confusion_matrix_compute(confmat, normalize)
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching confusion matrix (reference ``confusion_matrix.py:622-692``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_confusion_matrix(preds, target, num_labels, threshold, normalize, ignore_index, validate_args)

@@ -1,0 +1,154 @@
+"""Matthews correlation coefficient functional entry points (reference ``functional/classification/matthews_corrcoef.py``).
+
+The reference's data-dependent Python branches (``matthews_corrcoef.py:37-82``) are
+re-expressed branch-free with ``jnp.where`` so the reduce stays jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_update,
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_confusion_matrix_format,
+    _multilabel_confusion_matrix_tensor_validation,
+    _multilabel_confusion_matrix_update,
+)
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    """Reduce an un-normalized confusion matrix into the MCC score (reference ``matthews_corrcoef.py:37-85``)."""
+    confmat = confmat.sum(0) if confmat.ndim == 3 else confmat  # multilabel → binary
+    confmat = confmat.astype(jnp.float32)
+
+    tk = confmat.sum(axis=-1)
+    pk = confmat.sum(axis=-2)
+    c = jnp.trace(confmat)
+    s = confmat.sum()
+
+    cov_ytyp = c * s - jnp.sum(tk * pk)
+    cov_ypyp = s**2 - jnp.sum(pk * pk)
+    cov_ytyt = s**2 - jnp.sum(tk * tk)
+    denom = cov_ypyp * cov_ytyt
+
+    general = jnp.where(denom > 0, cov_ytyp / jnp.sqrt(jnp.where(denom > 0, denom, 1.0)), 0.0)
+
+    if confmat.size != 4:
+        return general
+
+    # binary degenerate cases (reference :46-82), selected branch-free
+    tn, fp, fn, tp = confmat.reshape(-1)
+    eps = jnp.finfo(jnp.float32).eps
+    # pick (a, b) by which row/column of the matrix collapsed
+    a = jnp.where((fn == 0) & (tn == 0), tp,
+        jnp.where((fp == 0) & (tn == 0), tp,
+        jnp.where((tp == 0) & (fn == 0), tn, tn)))
+    b = jnp.where((fn == 0) & (tn == 0), fp,
+        jnp.where((fp == 0) & (tn == 0), fn,
+        jnp.where((tp == 0) & (fn == 0), fp, fn)))
+    eps_num = jnp.sqrt(eps) * (a - b)
+    eps_denom = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
+    degenerate = eps_num / jnp.sqrt(eps_denom)
+
+    out = jnp.where(denom == 0, degenerate, general)
+    out = jnp.where((tp + tn != 0) & (fp + fn == 0), 1.0, out)
+    out = jnp.where((tp + tn == 0) & (fp + fn != 0), -1.0, out)
+    return out
+
+
+def binary_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Calculate MCC for binary tasks (reference ``matthews_corrcoef.py:88-144``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([1, 1, 0, 0])
+    >>> preds = jnp.array([0, 1, 0, 0])
+    >>> binary_matthews_corrcoef(preds, target)
+    Array(0.5773503, dtype=float32)
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multiclass_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Calculate MCC for multiclass tasks (reference ``matthews_corrcoef.py:147-212``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([2, 1, 0, 0])
+    >>> preds = jnp.array([2, 1, 0, 1])
+    >>> multiclass_matthews_corrcoef(preds, target, num_classes=3)
+    Array(0.7, dtype=float32)
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multilabel_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Calculate MCC for multilabel tasks (reference ``matthews_corrcoef.py:215-280``)."""
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, num_labels)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching MCC (reference ``matthews_corrcoef.py:283-337``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)

@@ -158,7 +158,10 @@ class Metric(ABC):
         style sample store — host-side between jit calls, per SURVEY §7.1-2b).
         ``dist_reduce_fx`` ∈ {"sum","mean","cat","min","max", None, callable}.
         """
-        if not isinstance(default, list) or default:
+        if isinstance(default, list):
+            if default:
+                raise ValueError("state variable must be an array or an empty list (non-empty lists are ambiguous)")
+        else:
             if isinstance(default, (int, float)) or not hasattr(default, "shape"):
                 default = jnp.asarray(default)
             if not isinstance(default, (jax.Array, np.ndarray)):
@@ -314,7 +317,10 @@ class Metric(ABC):
                 self._jitted_update = jax.jit(self._functional_update)
             try:
                 self.__dict__["_state"] = self._jitted_update(self._state, *args, **kwargs)
-            except Exception:
+            except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
+                    jax.errors.TracerIntegerConversionError):
+                # update body is genuinely un-traceable → latch eager mode for this metric
                 self._jit_failed = True
                 self._jitted_update = None
                 self._update_impl(*args, **kwargs)
@@ -745,6 +751,10 @@ def _squeeze_if_scalar(data: Any) -> Any:
 
 class CompositionalMetric(Metric):
     """Composition of two metrics with a specific operator applied at compute (reference ``metric.py:1188-1311``)."""
+
+    # update delegates to child metrics whose own states live outside this metric's
+    # state pytree — jitting it would leak tracers into the children
+    __jit_ineligible__ = True
 
     def __init__(self, operator: Callable, metric_a: Union[Metric, float, Array], metric_b: Union[Metric, float, Array, None]):
         super().__init__()

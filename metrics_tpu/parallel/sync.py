@@ -130,7 +130,7 @@ def gather_all_states(states: List[Any], group: Any = None) -> List[List[Any]]:
     padded to the max then trimmed, mirroring the reference's ragged contract.
     """
     if jax.process_count() == 1:
-        return [[s] if not isinstance(s, list) else [s] for s in states]
+        return [[s] for s in states]
     from jax.experimental import multihost_utils
 
     world = jax.process_count()

@@ -57,7 +57,8 @@ def _adjust_weights_safe_divide(
     else:
         weights = jnp.ones_like(score)
         if not multilabel:
-            weights = weights * ((tp + fp + fn) > 0)
+            present = ((tp + fp + fn) > 0) if top_k == 1 else ((tp + fn) > 0)
+            weights = weights * present
     return _safe_divide(weights * score, jnp.sum(weights, axis=-1, keepdims=True)).sum(-1)
 
 

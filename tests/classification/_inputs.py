@@ -1,0 +1,29 @@
+"""Random classification test inputs (reference ``tests/unittests/classification/_inputs.py``)."""
+
+import numpy as np
+
+from tests.conftest import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+_rng = np.random.RandomState(42)
+
+# binary
+binary_probs = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+binary_logits = _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32) * 3
+binary_labels_preds = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+binary_target = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+
+# multiclass
+mc_probs = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+mc_probs = mc_probs / mc_probs.sum(-1, keepdims=True)
+mc_logits = _rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+mc_labels_preds = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+mc_target = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+# multiclass multidim
+mdmc_preds = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))
+mdmc_target = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))
+
+# multilabel
+ml_probs = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+ml_labels_preds = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+ml_target = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))

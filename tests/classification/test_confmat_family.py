@@ -1,0 +1,118 @@
+"""Golden-reference tests for confusion-matrix-based metrics vs sklearn."""
+
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+from metrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryConfusionMatrix,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MultilabelConfusionMatrix,
+    MultilabelExactMatch,
+    MultilabelJaccardIndex,
+)
+from tests.classification._inputs import (
+    binary_probs,
+    binary_target,
+    mc_labels_preds,
+    mc_target,
+    ml_probs,
+    ml_target,
+)
+from tests.conftest import NUM_CLASSES, THRESHOLD
+from tests.helpers import run_class_test
+
+
+def _binarize(p):
+    return (p > THRESHOLD).astype(int) if np.issubdtype(p.dtype, np.floating) else p
+
+
+@pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+def test_binary_confusion_matrix(normalize):
+    def ref(p, t):
+        return sk.confusion_matrix(t.reshape(-1), _binarize(p).reshape(-1), labels=[0, 1], normalize=normalize)
+
+    run_class_test(BinaryConfusionMatrix, {"normalize": normalize}, binary_probs, binary_target, ref)
+
+
+@pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+def test_multiclass_confusion_matrix(normalize):
+    def ref(p, t):
+        return sk.confusion_matrix(
+            t.reshape(-1), p.reshape(-1), labels=list(range(NUM_CLASSES)), normalize=normalize
+        )
+
+    run_class_test(
+        MulticlassConfusionMatrix, {"num_classes": NUM_CLASSES, "normalize": normalize},
+        mc_labels_preds, mc_target, ref,
+    )
+
+
+def test_multilabel_confusion_matrix():
+    def ref(p, t):
+        p = _binarize(p).reshape(-1, NUM_CLASSES)
+        return sk.multilabel_confusion_matrix(t.reshape(-1, NUM_CLASSES), p)
+
+    run_class_test(MultilabelConfusionMatrix, {"num_labels": NUM_CLASSES}, ml_probs, ml_target, ref)
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_cohen_kappa_vs_sklearn(weights):
+    def ref_b(p, t):
+        return sk.cohen_kappa_score(t.reshape(-1), _binarize(p).reshape(-1), weights=weights)
+
+    run_class_test(BinaryCohenKappa, {"weights": weights}, binary_probs, binary_target, ref_b)
+
+    def ref_mc(p, t):
+        return sk.cohen_kappa_score(t.reshape(-1), p.reshape(-1), weights=weights)
+
+    run_class_test(
+        MulticlassCohenKappa, {"num_classes": NUM_CLASSES, "weights": weights}, mc_labels_preds, mc_target, ref_mc
+    )
+
+
+def test_matthews_corrcoef_vs_sklearn():
+    run_class_test(
+        BinaryMatthewsCorrCoef, {}, binary_probs, binary_target,
+        lambda p, t: sk.matthews_corrcoef(t.reshape(-1), _binarize(p).reshape(-1)),
+    )
+    run_class_test(
+        MulticlassMatthewsCorrCoef, {"num_classes": NUM_CLASSES}, mc_labels_preds, mc_target,
+        lambda p, t: sk.matthews_corrcoef(t.reshape(-1), p.reshape(-1)),
+    )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+def test_jaccard_vs_sklearn(average):
+    run_class_test(
+        MulticlassJaccardIndex, {"num_classes": NUM_CLASSES, "average": average}, mc_labels_preds, mc_target,
+        lambda p, t: sk.jaccard_score(
+            t.reshape(-1), p.reshape(-1), labels=list(range(NUM_CLASSES)), average=average, zero_division=0
+        ),
+    )
+    run_class_test(
+        MultilabelJaccardIndex, {"num_labels": NUM_CLASSES, "average": average}, ml_probs, ml_target,
+        lambda p, t: sk.jaccard_score(
+            t.reshape(-1, NUM_CLASSES), _binarize(p).reshape(-1, NUM_CLASSES), average=average, zero_division=0
+        ),
+    )
+
+
+def test_binary_jaccard_vs_sklearn():
+    run_class_test(
+        BinaryJaccardIndex, {}, binary_probs, binary_target,
+        lambda p, t: sk.jaccard_score(t.reshape(-1), _binarize(p).reshape(-1)),
+    )
+
+
+def test_multilabel_exact_match_vs_sklearn():
+    run_class_test(
+        MultilabelExactMatch, {"num_labels": NUM_CLASSES}, ml_probs, ml_target,
+        lambda p, t: sk.accuracy_score(t.reshape(-1, NUM_CLASSES), _binarize(p).reshape(-1, NUM_CLASSES)),
+    )

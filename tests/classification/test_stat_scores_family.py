@@ -1,0 +1,173 @@
+"""Golden-reference tests for the stat-scores family vs sklearn (reference ``tests/unittests/classification/``)."""
+
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+from metrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    BinaryStatScores,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    MultilabelF1Score,
+    MultilabelPrecision,
+    MultilabelRecall,
+)
+from tests.classification._inputs import (
+    binary_labels_preds,
+    binary_probs,
+    binary_target,
+    mc_labels_preds,
+    mc_logits,
+    mc_probs,
+    mc_target,
+    ml_probs,
+    ml_target,
+)
+from tests.conftest import NUM_CLASSES, THRESHOLD
+from tests.helpers import run_class_test
+
+
+def _binarize(p):
+    return (p > THRESHOLD).astype(int) if np.issubdtype(p.dtype, np.floating) else p
+
+
+@pytest.mark.parametrize("preds", [binary_probs, binary_labels_preds])
+@pytest.mark.parametrize(
+    ("metric_cls", "sk_fn"),
+    [
+        (BinaryAccuracy, sk.accuracy_score),
+        (BinaryPrecision, sk.precision_score),
+        (BinaryRecall, sk.recall_score),
+        (BinaryF1Score, sk.f1_score),
+    ],
+)
+def test_binary_metrics_vs_sklearn(preds, metric_cls, sk_fn):
+    run_class_test(
+        metric_cls, {}, preds, binary_target,
+        lambda p, t: sk_fn(t.reshape(-1), _binarize(p).reshape(-1)),
+    )
+
+
+def test_binary_specificity_vs_sklearn():
+    run_class_test(
+        BinarySpecificity, {}, binary_probs, binary_target,
+        lambda p, t: sk.recall_score(1 - t.reshape(-1), 1 - _binarize(p).reshape(-1)),
+    )
+
+
+def test_binary_stat_scores_values():
+    def ref(p, t):
+        p, t = _binarize(p).reshape(-1), t.reshape(-1)
+        tp = ((p == 1) & (t == 1)).sum()
+        fp = ((p == 1) & (t == 0)).sum()
+        tn = ((p == 0) & (t == 0)).sum()
+        fn = ((p == 0) & (t == 1)).sum()
+        return np.array([tp, fp, tn, fn, tp + fn])
+
+    run_class_test(BinaryStatScores, {}, binary_probs, binary_target, ref)
+
+
+@pytest.mark.parametrize("preds", [mc_probs, mc_logits, mc_labels_preds])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+@pytest.mark.parametrize(
+    ("metric_cls", "sk_fn", "is_acc"),
+    [
+        (MulticlassAccuracy, sk.recall_score, True),
+        (MulticlassPrecision, sk.precision_score, False),
+        (MulticlassRecall, sk.recall_score, False),
+        (MulticlassF1Score, sk.f1_score, False),
+    ],
+)
+def test_multiclass_metrics_vs_sklearn(preds, average, metric_cls, sk_fn, is_acc):
+    labels = list(range(NUM_CLASSES))
+
+    def ref(p, t):
+        p = p.argmax(-1) if p.ndim > t.ndim else p
+        p, t = p.reshape(-1), t.reshape(-1)
+        if is_acc and average == "micro":
+            return sk.accuracy_score(t, p)
+        return sk_fn(t, p, average=average, labels=labels, zero_division=0)
+
+    run_class_test(metric_cls, {"num_classes": NUM_CLASSES, "average": average}, preds, mc_target, ref)
+
+
+@pytest.mark.parametrize("top_k", [2, 3])
+def test_multiclass_accuracy_topk_vs_sklearn(top_k):
+    def ref(p, t):
+        return sk.top_k_accuracy_score(t.reshape(-1), p.reshape(-1, NUM_CLASSES), k=top_k, labels=list(range(NUM_CLASSES)))
+
+    run_class_test(
+        MulticlassAccuracy,
+        {"num_classes": NUM_CLASSES, "average": "micro", "top_k": top_k},
+        mc_probs, mc_target, ref,
+    )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+@pytest.mark.parametrize(
+    ("metric_cls", "sk_fn"),
+    [
+        (MultilabelPrecision, sk.precision_score),
+        (MultilabelRecall, sk.recall_score),
+        (MultilabelF1Score, sk.f1_score),
+    ],
+)
+def test_multilabel_metrics_vs_sklearn(average, metric_cls, sk_fn):
+    def ref(p, t):
+        p = _binarize(p).reshape(-1, NUM_CLASSES)
+        return sk_fn(t.reshape(-1, NUM_CLASSES), p, average=average, zero_division=0)
+
+    run_class_test(
+        metric_cls, {"num_labels": NUM_CLASSES, "average": average}, ml_probs, ml_target, ref,
+    )
+
+
+def test_multilabel_accuracy_macro():
+    """Per-label accuracy averaged (the reference's multilabel accuracy semantic)."""
+
+    def ref(p, t):
+        p = _binarize(p).reshape(-1, NUM_CLASSES)
+        t = t.reshape(-1, NUM_CLASSES)
+        return np.mean([(p[:, i] == t[:, i]).mean() for i in range(NUM_CLASSES)])
+
+    run_class_test(MultilabelAccuracy, {"num_labels": NUM_CLASSES, "average": "macro"}, ml_probs, ml_target, ref)
+
+
+def test_multiclass_ignore_index():
+    rng = np.random.RandomState(7)
+    target = mc_target.copy()
+    mask = rng.rand(*target.shape) < 0.2
+    target[mask] = -1
+
+    def ref(p, t):
+        p, t = p.reshape(-1), t.reshape(-1)
+        keep = t != -1
+        return sk.accuracy_score(t[keep], p[keep])
+
+    run_class_test(
+        MulticlassAccuracy,
+        {"num_classes": NUM_CLASSES, "average": "micro", "ignore_index": -1},
+        mc_labels_preds, target, ref,
+    )
+
+
+def test_binary_samplewise_multidim():
+    from tests.classification._inputs import mdmc_preds, mdmc_target
+
+    preds = (mdmc_preds > 2).astype(np.int32)
+    target = (mdmc_target > 2).astype(np.int32)
+
+    def ref(p, t):
+        return np.array([sk.accuracy_score(tt.reshape(-1), pp.reshape(-1)) for pp, tt in zip(p, t)])
+
+    run_class_test(
+        BinaryAccuracy, {"multidim_average": "samplewise"}, preds, target, ref, check_ddp=False,
+    )
