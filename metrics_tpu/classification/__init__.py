@@ -1,5 +1,56 @@
 """Modular classification metrics (reference ``torchmetrics/classification/__init__.py``)."""
 
+from metrics_tpu.classification.calibration_error import (
+    BinaryCalibrationError,
+    CalibrationError,
+    MulticlassCalibrationError,
+)
+from metrics_tpu.classification.group_fairness import BinaryFairness, BinaryGroupStatRates
+from metrics_tpu.classification.hinge import BinaryHingeLoss, HingeLoss, MulticlassHingeLoss
+from metrics_tpu.classification.logauc import BinaryLogAUC, LogAUC, MulticlassLogAUC, MultilabelLogAUC
+from metrics_tpu.classification.precision_fixed_recall import (
+    BinaryPrecisionAtFixedRecall,
+    MulticlassPrecisionAtFixedRecall,
+    MultilabelPrecisionAtFixedRecall,
+    PrecisionAtFixedRecall,
+)
+from metrics_tpu.classification.ranking import (
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from metrics_tpu.classification.recall_fixed_precision import (
+    BinaryRecallAtFixedPrecision,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    RecallAtFixedPrecision,
+)
+from metrics_tpu.classification.sensitivity_specificity import (
+    BinarySensitivityAtSpecificity,
+    MulticlassSensitivityAtSpecificity,
+    MultilabelSensitivityAtSpecificity,
+    SensitivityAtSpecificity,
+)
+from metrics_tpu.classification.specificity_sensitivity import (
+    BinarySpecificityAtSensitivity,
+    MulticlassSpecificityAtSensitivity,
+    MultilabelSpecificityAtSensitivity,
+    SpecificityAtSensitivity,
+)
+from metrics_tpu.classification.auroc import AUROC, BinaryAUROC, MulticlassAUROC, MultilabelAUROC
+from metrics_tpu.classification.average_precision import (
+    AveragePrecision,
+    BinaryAveragePrecision,
+    MulticlassAveragePrecision,
+    MultilabelAveragePrecision,
+)
+from metrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+    PrecisionRecallCurve,
+)
+from metrics_tpu.classification.roc import ROC, BinaryROC, MulticlassROC, MultilabelROC
 from metrics_tpu.classification.accuracy import Accuracy, BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
 from metrics_tpu.classification.cohen_kappa import BinaryCohenKappa, CohenKappa, MulticlassCohenKappa
 from metrics_tpu.classification.confusion_matrix import (
@@ -67,6 +118,24 @@ from metrics_tpu.classification.stat_scores import (
 )
 
 __all__ = [
+    "BinaryCalibrationError", "CalibrationError", "MulticlassCalibrationError",
+    "BinaryFairness", "BinaryGroupStatRates",
+    "BinaryHingeLoss", "HingeLoss", "MulticlassHingeLoss",
+    "BinaryLogAUC", "LogAUC", "MulticlassLogAUC", "MultilabelLogAUC",
+    "BinaryPrecisionAtFixedRecall", "MulticlassPrecisionAtFixedRecall", "MultilabelPrecisionAtFixedRecall",
+    "PrecisionAtFixedRecall",
+    "MultilabelCoverageError", "MultilabelRankingAveragePrecision", "MultilabelRankingLoss",
+    "BinaryRecallAtFixedPrecision", "MulticlassRecallAtFixedPrecision", "MultilabelRecallAtFixedPrecision",
+    "RecallAtFixedPrecision",
+    "BinarySensitivityAtSpecificity", "MulticlassSensitivityAtSpecificity", "MultilabelSensitivityAtSpecificity",
+    "SensitivityAtSpecificity",
+    "BinarySpecificityAtSensitivity", "MulticlassSpecificityAtSensitivity", "MultilabelSpecificityAtSensitivity",
+    "SpecificityAtSensitivity",
+    "AUROC", "BinaryAUROC", "MulticlassAUROC", "MultilabelAUROC",
+    "AveragePrecision", "BinaryAveragePrecision", "MulticlassAveragePrecision", "MultilabelAveragePrecision",
+    "BinaryPrecisionRecallCurve", "MulticlassPrecisionRecallCurve", "MultilabelPrecisionRecallCurve",
+    "PrecisionRecallCurve",
+    "ROC", "BinaryROC", "MulticlassROC", "MultilabelROC",
     "Accuracy", "BinaryAccuracy", "MulticlassAccuracy", "MultilabelAccuracy",
     "BinaryCohenKappa", "CohenKappa", "MulticlassCohenKappa",
     "BinaryConfusionMatrix", "ConfusionMatrix", "MulticlassConfusionMatrix", "MultilabelConfusionMatrix",

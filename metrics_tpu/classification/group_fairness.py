@@ -1,0 +1,155 @@
+"""Modular group-fairness metrics (reference ``classification/group_fairness.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.group_fairness import (
+    _binary_groups_stat_scores_tensor,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+)
+from metrics_tpu.functional.classification.stat_scores import _binary_stat_scores_arg_validation
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class _AbstractGroupStatScores(Metric):
+    """Per-group tp/fp/tn/fn states (reference ``classification/group_fairness.py:36-57``)."""
+
+    tp: Array
+    fp: Array
+    tn: Array
+    fn: Array
+
+    def _create_states(self, num_groups: int) -> None:
+        default = lambda: jnp.zeros(num_groups, dtype=jnp.int32)  # noqa: E731
+        self.add_state("tp", default(), dist_reduce_fx="sum")
+        self.add_state("fp", default(), dist_reduce_fx="sum")
+        self.add_state("tn", default(), dist_reduce_fx="sum")
+        self.add_state("fn", default(), dist_reduce_fx="sum")
+
+    def _update_states(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.tn = self.tn + tn
+        self.fn = self.fn + fn
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """True/false positive and negative rates by group (reference ``classification/group_fairness.py:60-155``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+    >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> metric = BinaryGroupStatRates(num_groups=2)
+    >>> metric.update(preds, target, groups)
+    >>> metric.compute()
+    {'group_0': Array([0., 0., 1., 0.], dtype=float32), 'group_1': Array([1., 0., 0., 0.], dtype=float32)}
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        """Update state with predictions, targets and group identifiers."""
+        tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(tp, fp, tn, fn)
+
+    def compute(self) -> Dict[str, Array]:
+        """Compute per-group rates."""
+        stacked = jnp.stack([self.tp, self.fp, self.tn, self.fn]).astype(jnp.float32)
+        rates = stacked / stacked.sum(axis=0, keepdims=True)
+        return {f"group_{g}": rates[:, g] for g in range(self.num_groups)}
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity and equal opportunity ratios (reference ``classification/group_fairness.py:158-310``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+    >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> metric = BinaryFairness(num_groups=2)
+    >>> metric.update(preds, target, groups)
+    >>> metric.compute()
+    {'DP_0_1': Array(0., dtype=float32), 'EO_0_1': Array(0., dtype=float32)}
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ("demographic_parity", "equal_opportunity", "all"):
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.task = task
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Optional[Array], groups: Array) -> None:
+        """Update state with predictions, targets and group identifiers."""
+        if self.task == "demographic_parity":
+            if target is not None:
+                rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
+            target = jnp.zeros(preds.shape, dtype=jnp.int32)
+        tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(tp, fp, tn, fn)
+
+    def compute(self) -> Dict[str, Array]:
+        """Compute fairness criteria."""
+        if self.task == "demographic_parity":
+            return _compute_binary_demographic_parity(self.tp, self.fp, self.tn, self.fn)
+        if self.task == "equal_opportunity":
+            return _compute_binary_equal_opportunity(self.tp, self.fp, self.tn, self.fn)
+        out = {}
+        out.update(_compute_binary_demographic_parity(self.tp, self.fp, self.tn, self.fn))
+        out.update(_compute_binary_equal_opportunity(self.tp, self.fp, self.tn, self.fn))
+        return out

@@ -1,0 +1,167 @@
+"""Modular LogAUC metrics (reference ``classification/logauc.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from metrics_tpu.functional.classification.logauc import (
+    _binary_logauc_compute,
+    _reduce_logauc,
+    _validate_fpr_range,
+)
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryLogAUC(BinaryPrecisionRecallCurve):
+    """Log-AUC for binary tasks (reference ``classification/logauc.py:42-151``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.75, 0.05, 0.05, 0.05, 0.05])
+    >>> target = jnp.array([1, 0, 0, 0, 0])
+    >>> metric = BinaryLogAUC()
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array(1., dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        fpr_range: Tuple[float, float] = (0.001, 0.1),
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_fpr_range(fpr_range)
+        self.validate_args = validate_args
+        self.fpr_range = fpr_range
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        fpr, tpr, _ = _binary_roc_compute(state, self.thresholds)
+        return _binary_logauc_compute(fpr, tpr, self.fpr_range)
+
+
+class MulticlassLogAUC(MulticlassPrecisionRecallCurve):
+    """Log-AUC for multiclass tasks (reference ``classification/logauc.py:154-268``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        fpr_range: Tuple[float, float] = (0.001, 0.1),
+        average: Optional[str] = None,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_fpr_range(fpr_range)
+        self.validate_args = validate_args
+        self.fpr_range = fpr_range
+        self.average = average  # type: ignore[assignment]
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        fpr, tpr, _ = _multiclass_roc_compute(state, self.num_classes, self.thresholds)
+        return _reduce_logauc(fpr, tpr, self.fpr_range, self.average)
+
+
+class MultilabelLogAUC(MultilabelPrecisionRecallCurve):
+    """Log-AUC for multilabel tasks (reference ``classification/logauc.py:271-385``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        fpr_range: Tuple[float, float] = (0.001, 0.1),
+        average: Optional[str] = None,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_fpr_range(fpr_range)
+        self.validate_args = validate_args
+        self.fpr_range = fpr_range
+        self.average = average
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        fpr, tpr, _ = _multilabel_roc_compute(state, self.num_labels, self.thresholds, self.ignore_index)
+        return _reduce_logauc(fpr, tpr, self.fpr_range, self.average)
+
+
+class LogAUC(_ClassificationTaskWrapper):
+    """Task-dispatching LogAUC (reference ``classification/logauc.py:388-442``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        fpr_range: Tuple[float, float] = (0.001, 0.1),
+        average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryLogAUC(fpr_range=fpr_range, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassLogAUC(num_classes, fpr_range=fpr_range, average=average, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelLogAUC(num_labels, fpr_range=fpr_range, average=average, **kwargs)

@@ -1,0 +1,116 @@
+"""Modular multilabel ranking metrics (reference ``classification/ranking.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _multilabel_confusion_matrix_format
+from metrics_tpu.functional.classification.ranking import (
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+    _ranking_reduce,
+)
+from metrics_tpu.metric import Metric
+
+
+class _MultilabelRankingBase(Metric):
+    """Shared plumbing for the three ranking metrics."""
+
+    is_differentiable = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    measure: Array
+    total: Array
+
+    _update_fn = None  # set by subclasses
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args and (not isinstance(num_labels, int) or num_labels < 2):
+            raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target = _multilabel_confusion_matrix_format(
+            preds, target, self.num_labels, threshold=0.0, ignore_index=self.ignore_index, should_threshold=False
+        )
+        measure, total = type(self)._update_fn(preds, target)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _ranking_reduce(self.measure, self.total)
+
+
+class MultilabelCoverageError(_MultilabelRankingBase):
+    """Multilabel coverage error (reference ``classification/ranking.py:38-125``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(10, 5).astype(np.float32))
+    >>> target = jnp.asarray(rng.randint(2, size=(10, 5)))
+    >>> mcr = MultilabelCoverageError(num_labels=5)
+    >>> mcr.update(preds, target)
+    >>> mcr.compute()
+    Array(3.9, dtype=float32)
+    """
+
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_MultilabelRankingBase):
+    """Label ranking average precision (reference ``classification/ranking.py:128-215``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(10, 5).astype(np.float32))
+    >>> target = jnp.asarray(rng.randint(2, size=(10, 5)))
+    >>> mlrap = MultilabelRankingAveragePrecision(num_labels=5)
+    >>> mlrap.update(preds, target)
+    >>> mlrap.compute()
+    Array(0.7744048, dtype=float32)
+    """
+
+    higher_is_better = True
+    plot_upper_bound = 1.0
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_MultilabelRankingBase):
+    """Label ranking loss (reference ``classification/ranking.py:218-307``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(10, 5).astype(np.float32))
+    >>> target = jnp.asarray(rng.randint(2, size=(10, 5)))
+    >>> mlrl = MultilabelRankingLoss(num_labels=5)
+    >>> mlrl.update(preds, target)
+    >>> mlrl.compute()
+    Array(0.4155556, dtype=float32)
+    """
+
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

@@ -1,0 +1,152 @@
+"""Modular RecallAtFixedPrecision metrics (reference ``classification/recall_fixed_precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from metrics_tpu.functional.classification._fixed_point import _per_class_reduce
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _multiclass_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_compute,
+)
+from metrics_tpu.functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_compute,
+    _recall_at_precision,
+)
+from metrics_tpu.functional.classification.sensitivity_specificity import _validate_min_arg
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
+    """Highest recall at given precision, binary (reference ``classification/recall_fixed_precision.py:36-130``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.1, 0.4, 0.6, 0.8])
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5, thresholds=None)
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    (Array(1., dtype=float32), Array(0.6, dtype=float32))
+    """
+
+    def __init__(
+        self,
+        min_precision: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_min_arg(min_precision, "min_precision")
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _binary_recall_at_fixed_precision_compute(state, self.thresholds, self.min_precision)
+
+
+class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
+    """Highest recall at given precision, multiclass (reference ``classification/recall_fixed_precision.py:133-246``)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min_arg(min_precision, "min_precision")
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        precision, recall, thres = _multiclass_precision_recall_curve_compute(state, self.num_classes, self.thresholds)
+        return _per_class_reduce(
+            (precision, recall, thres), self.num_classes,
+            lambda p, r, t: _recall_at_precision(p, r, t, self.min_precision),
+        )
+
+
+class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+    """Highest recall at given precision, multilabel (reference ``classification/recall_fixed_precision.py:249-362``)."""
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_precision: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min_arg(min_precision, "min_precision")
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        precision, recall, thres = _multilabel_precision_recall_curve_compute(
+            state, self.num_labels, self.thresholds, self.ignore_index
+        )
+        return _per_class_reduce(
+            (precision, recall, thres), self.num_labels,
+            lambda p, r, t: _recall_at_precision(p, r, t, self.min_precision),
+        )
+
+
+class RecallAtFixedPrecision(_ClassificationTaskWrapper):
+    """Task-dispatching RecallAtFixedPrecision (reference ``classification/recall_fixed_precision.py:365-419``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_precision: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinaryRecallAtFixedPrecision(min_precision, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassRecallAtFixedPrecision(
+                num_classes, min_precision, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelRecallAtFixedPrecision(
+            num_labels, min_precision, thresholds, ignore_index, validate_args, **kwargs
+        )

@@ -1,0 +1,142 @@
+"""Modular SensitivityAtSpecificity metrics (reference ``classification/sensitivity_specificity.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from metrics_tpu.functional.classification.sensitivity_specificity import (
+    _binary_sensitivity_at_specificity_compute,
+    _multiclass_sensitivity_at_specificity_compute,
+    _multilabel_sensitivity_at_specificity_compute,
+    _validate_min_arg,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinarySensitivityAtSpecificity(BinaryPrecisionRecallCurve):
+    """Highest sensitivity at given specificity, binary (reference ``classification/sensitivity_specificity.py:37-134``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.1, 0.4, 0.6, 0.8])
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> metric = BinarySensitivityAtSpecificity(min_specificity=0.5, thresholds=None)
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    (Array(1., dtype=float32), Array(0.6, dtype=float32))
+    """
+
+    def __init__(
+        self,
+        min_specificity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_min_arg(min_specificity, "min_specificity")
+        self.validate_args = validate_args
+        self.min_specificity = min_specificity
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _binary_sensitivity_at_specificity_compute(state, self.thresholds, self.min_specificity)
+
+
+class MulticlassSensitivityAtSpecificity(MulticlassPrecisionRecallCurve):
+    """Highest sensitivity at given specificity, multiclass (reference ``classification/sensitivity_specificity.py:137-252``)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_specificity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min_arg(min_specificity, "min_specificity")
+        self.validate_args = validate_args
+        self.min_specificity = min_specificity
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _multiclass_sensitivity_at_specificity_compute(
+            state, self.num_classes, self.thresholds, self.min_specificity
+        )
+
+
+class MultilabelSensitivityAtSpecificity(MultilabelPrecisionRecallCurve):
+    """Highest sensitivity at given specificity, multilabel (reference ``classification/sensitivity_specificity.py:255-370``)."""
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_specificity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min_arg(min_specificity, "min_specificity")
+        self.validate_args = validate_args
+        self.min_specificity = min_specificity
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _multilabel_sensitivity_at_specificity_compute(
+            state, self.num_labels, self.thresholds, self.ignore_index, self.min_specificity
+        )
+
+
+class SensitivityAtSpecificity(_ClassificationTaskWrapper):
+    """Task-dispatching SensitivityAtSpecificity (reference ``classification/sensitivity_specificity.py:373-426``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_specificity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySensitivityAtSpecificity(min_specificity, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassSensitivityAtSpecificity(
+                num_classes, min_specificity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelSensitivityAtSpecificity(
+            num_labels, min_specificity, thresholds, ignore_index, validate_args, **kwargs
+        )

@@ -1,0 +1,142 @@
+"""Modular SpecificityAtSensitivity metrics (reference ``classification/specificity_sensitivity.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+from jax import Array
+
+from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from metrics_tpu.functional.classification.sensitivity_specificity import _validate_min_arg
+from metrics_tpu.functional.classification.specificity_sensitivity import (
+    _binary_specificity_at_sensitivity_compute,
+    _multiclass_specificity_at_sensitivity_compute,
+    _multilabel_specificity_at_sensitivity_compute,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
+    """Highest specificity at given sensitivity, binary (reference ``classification/specificity_sensitivity.py:37-136``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.1, 0.4, 0.6, 0.8])
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> metric = BinarySpecificityAtSensitivity(min_sensitivity=0.5, thresholds=None)
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    (Array(1., dtype=float32), Array(0.8, dtype=float32))
+    """
+
+    def __init__(
+        self,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_min_arg(min_sensitivity, "min_sensitivity")
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _binary_specificity_at_sensitivity_compute(state, self.thresholds, self.min_sensitivity)
+
+
+class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
+    """Highest specificity at given sensitivity, multiclass (reference ``classification/specificity_sensitivity.py:139-256``)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min_arg(min_sensitivity, "min_sensitivity")
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _multiclass_specificity_at_sensitivity_compute(
+            state, self.num_classes, self.thresholds, self.min_sensitivity
+        )
+
+
+class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
+    """Highest specificity at given sensitivity, multilabel (reference ``classification/specificity_sensitivity.py:259-377``)."""
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min_arg(min_sensitivity, "min_sensitivity")
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute metric."""
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _multilabel_specificity_at_sensitivity_compute(
+            state, self.num_labels, self.thresholds, self.ignore_index, self.min_sensitivity
+        )
+
+
+class SpecificityAtSensitivity(_ClassificationTaskWrapper):
+    """Task-dispatching SpecificityAtSensitivity (reference ``classification/specificity_sensitivity.py:380-434``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        """Initialize task metric."""
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificityAtSensitivity(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassSpecificityAtSensitivity(
+                num_classes, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelSpecificityAtSensitivity(
+            num_labels, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+        )

@@ -1,5 +1,66 @@
 """Functional classification metrics (reference ``torchmetrics/functional/classification/__init__.py``)."""
 
+from metrics_tpu.functional.classification.calibration_error import (
+    binary_calibration_error,
+    calibration_error,
+    multiclass_calibration_error,
+)
+from metrics_tpu.functional.classification.group_fairness import (
+    binary_fairness,
+    binary_groups_stat_rates,
+    demographic_parity,
+    equal_opportunity,
+)
+from metrics_tpu.functional.classification.hinge import binary_hinge_loss, hinge_loss, multiclass_hinge_loss
+from metrics_tpu.functional.classification.logauc import (
+    binary_logauc,
+    logauc,
+    multiclass_logauc,
+    multilabel_logauc,
+)
+from metrics_tpu.functional.classification.precision_fixed_recall import (
+    binary_precision_at_fixed_recall,
+    multiclass_precision_at_fixed_recall,
+    multilabel_precision_at_fixed_recall,
+    precision_at_fixed_recall,
+)
+from metrics_tpu.functional.classification.ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+from metrics_tpu.functional.classification.recall_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multiclass_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+    recall_at_fixed_precision,
+)
+from metrics_tpu.functional.classification.sensitivity_specificity import (
+    binary_sensitivity_at_specificity,
+    multiclass_sensitivity_at_specificity,
+    multilabel_sensitivity_at_specificity,
+    sensitivity_at_specificity,
+)
+from metrics_tpu.functional.classification.specificity_sensitivity import (
+    binary_specificity_at_sensitivity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_specificity_at_sensitivity,
+    specificity_at_sensitivity,
+)
+from metrics_tpu.functional.classification.auroc import auroc, binary_auroc, multiclass_auroc, multilabel_auroc
+from metrics_tpu.functional.classification.average_precision import (
+    average_precision,
+    binary_average_precision,
+    multiclass_average_precision,
+    multilabel_average_precision,
+)
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
+    precision_recall_curve,
+)
+from metrics_tpu.functional.classification.roc import binary_roc, multiclass_roc, multilabel_roc, roc
 from metrics_tpu.functional.classification.accuracy import (
     accuracy,
     binary_accuracy,
@@ -75,6 +136,24 @@ from metrics_tpu.functional.classification.stat_scores import (
 )
 
 __all__ = [
+    "binary_calibration_error", "calibration_error", "multiclass_calibration_error",
+    "binary_fairness", "binary_groups_stat_rates", "demographic_parity", "equal_opportunity",
+    "binary_hinge_loss", "hinge_loss", "multiclass_hinge_loss",
+    "binary_logauc", "logauc", "multiclass_logauc", "multilabel_logauc",
+    "binary_precision_at_fixed_recall", "multiclass_precision_at_fixed_recall",
+    "multilabel_precision_at_fixed_recall", "precision_at_fixed_recall",
+    "multilabel_coverage_error", "multilabel_ranking_average_precision", "multilabel_ranking_loss",
+    "binary_recall_at_fixed_precision", "multiclass_recall_at_fixed_precision",
+    "multilabel_recall_at_fixed_precision", "recall_at_fixed_precision",
+    "binary_sensitivity_at_specificity", "multiclass_sensitivity_at_specificity",
+    "multilabel_sensitivity_at_specificity", "sensitivity_at_specificity",
+    "binary_specificity_at_sensitivity", "multiclass_specificity_at_sensitivity",
+    "multilabel_specificity_at_sensitivity", "specificity_at_sensitivity",
+    "auroc", "binary_auroc", "multiclass_auroc", "multilabel_auroc",
+    "average_precision", "binary_average_precision", "multiclass_average_precision", "multilabel_average_precision",
+    "binary_precision_recall_curve", "multiclass_precision_recall_curve", "multilabel_precision_recall_curve",
+    "precision_recall_curve",
+    "binary_roc", "multiclass_roc", "multilabel_roc", "roc",
     "accuracy", "binary_accuracy", "multiclass_accuracy", "multilabel_accuracy",
     "binary_cohen_kappa", "cohen_kappa", "multiclass_cohen_kappa",
     "binary_confusion_matrix", "confusion_matrix", "multiclass_confusion_matrix", "multilabel_confusion_matrix",

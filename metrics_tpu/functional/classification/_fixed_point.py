@@ -1,0 +1,72 @@
+"""Shared machinery for the "best X at fixed Y" curve metrics.
+
+One generic implementation behind ``sensitivity_at_specificity``,
+``specificity_at_sensitivity``, ``precision_at_fixed_recall`` and
+``recall_at_fixed_precision`` (reference keeps four near-identical files:
+``functional/classification/{sensitivity_specificity,specificity_sensitivity,
+precision_fixed_recall,recall_fixed_precision}.py``).
+
+These run at the eager ``compute()`` boundary, so the constrained lex-argmax uses
+host numpy (mirroring the reference's ``_lexargmax``, ``recall_fixed_precision.py:38-55``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _lex_best(primary: Array, secondary: Array, thresholds: Array, min_secondary: float) -> Tuple[Array, Array]:
+    """Maximize ``primary`` subject to ``secondary >= min_secondary``.
+
+    Ties broken lexicographically by (primary, secondary, threshold); returns
+    (0.0, 1e6) when the constraint is infeasible (reference ``recall_fixed_precision.py:58-76``).
+    """
+    p = np.asarray(primary, dtype=np.float64)
+    s = np.asarray(secondary, dtype=np.float64)
+    t = np.asarray(thresholds, dtype=np.float64)
+    n = min(p.shape[0], s.shape[0], t.shape[0])
+    p, s, t = p[:n], s[:n], t[:n]
+    ok = s >= min_secondary
+    if not ok.any():
+        return jnp.asarray(0.0, dtype=jnp.float32), jnp.asarray(1e6, dtype=jnp.float32)
+    p, s, t = p[ok], s[ok], t[ok]
+    order = np.lexsort((t, s, p))  # last key is primary
+    idx = order[-1]
+    best_p, best_t = p[idx], t[idx]
+    if best_p == 0.0:
+        best_t = 1e6
+    return jnp.asarray(best_p, dtype=jnp.float32), jnp.asarray(best_t, dtype=jnp.float32)
+
+
+def _constrained_argmax(values: Array, constraint: Array, thresholds: Array, min_constraint: float) -> Tuple[Array, Array]:
+    """Maximize ``values`` where ``constraint >= min_constraint`` (plain argmax variant,
+    reference ``sensitivity_specificity.py:47-70`` / ``specificity_sensitivity.py:48-70``)."""
+    v = np.asarray(values, dtype=np.float64)
+    c = np.asarray(constraint, dtype=np.float64)
+    t = np.asarray(thresholds, dtype=np.float64)
+    n = min(v.shape[0], c.shape[0], t.shape[0])
+    v, c, t = v[:n], c[:n], t[:n]
+    ok = c >= min_constraint
+    if not ok.any():
+        return jnp.asarray(0.0, dtype=jnp.float32), jnp.asarray(1e6, dtype=jnp.float32)
+    v, t = v[ok], t[ok]
+    idx = int(np.argmax(v))
+    return jnp.asarray(v[idx], dtype=jnp.float32), jnp.asarray(t[idx], dtype=jnp.float32)
+
+
+def _per_class_reduce(
+    curves: Tuple, num_classes: int, reduce_one: Callable
+) -> Tuple[Array, Array]:
+    """Apply a binary fixed-point reduce per class/label and stack the results."""
+    a_curves, b_curves, t_curves = curves
+    vals, thrs = [], []
+    for i in range(num_classes):
+        t = t_curves[i] if isinstance(t_curves, list) else t_curves  # binned: one shared grid
+        v, th = reduce_one(a_curves[i], b_curves[i], t)
+        vals.append(v)
+        thrs.append(th)
+    return jnp.stack(vals), jnp.stack(thrs)

@@ -1,0 +1,269 @@
+"""AUROC functional entry points (reference ``functional/classification/auroc.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from metrics_tpu.utils.compute import _auc_compute_without_check, _safe_divide
+from metrics_tpu.utils.data import bincount
+from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _nan_masked_mean(res: Array) -> Array:
+    nan = jnp.isnan(res)
+    count = (~nan).sum()
+    mean = jnp.where(nan, 0.0, res).sum() / jnp.maximum(count, 1)
+    return jnp.where(count > 0, mean, jnp.nan)  # all-NaN stays NaN (reference res[idx].mean())
+
+
+def _reduce_auroc(
+    fpr: Union[Array, List[Array]],
+    tpr: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+    direction: float = 1.0,
+) -> Array:
+    """Reduce per-class AUCs into one number (reference ``auroc.py:45-70``); NaN classes dropped branch-free."""
+    if isinstance(fpr, (jax.Array, jnp.ndarray)) and not isinstance(fpr, list):
+        res = _auc_compute_without_check(fpr, tpr, direction=direction, axis=1)
+    else:
+        res = jnp.stack([_auc_compute_without_check(x, y, direction=direction) for x, y in zip(fpr, tpr)])
+    if average is None or average == "none":
+        return res
+    if bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
+            UserWarning,
+        )
+    nan = jnp.isnan(res)
+    if average == "macro":
+        return _nan_masked_mean(res)
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(nan, 0.0, weights)
+        weights = _safe_divide(weights, weights.sum())
+        return jnp.where(nan, 0.0, res * weights).sum()
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_auroc_arg_validation(
+    max_fpr: Optional[float] = None,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``auroc.py:73-80``)."""
+    if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+        raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _binary_auroc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    max_fpr: Optional[float] = None,
+    pos_label: int = 1,
+) -> Array:
+    """AUROC with optional partial-AUC McClish correction (reference ``auroc.py:83-107``)."""
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
+    if max_fpr is None or max_fpr == 1 or bool(jnp.sum(fpr) == 0) or bool(jnp.sum(tpr) == 0):
+        return _auc_compute_without_check(fpr, tpr, 1.0)
+
+    max_area = jnp.asarray(max_fpr, dtype=fpr.dtype)
+    stop = int(jnp.searchsorted(fpr, max_area, side="right"))
+    weight = (max_area - fpr[stop - 1]) / (fpr[stop] - fpr[stop - 1])
+    interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
+    tpr = jnp.concatenate([tpr[:stop], interp_tpr.reshape(1)])
+    fpr = jnp.concatenate([fpr[:stop], max_area.reshape(1)])
+    partial_auc = _auc_compute_without_check(fpr, tpr, 1.0)
+    min_area = 0.5 * max_area**2
+    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+
+
+def binary_auroc(
+    preds: Array,
+    target: Array,
+    max_fpr: Optional[float] = None,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute AUROC for binary tasks (reference ``auroc.py:110-190``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.0, 0.5, 0.7, 0.8])
+    >>> target = jnp.array([0, 1, 1, 0])
+    >>> binary_auroc(preds, target, thresholds=None)
+    Array(0.5, dtype=float32)
+    """
+    if validate_args:
+        _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_auroc_compute(state, thresholds, max_fpr)
+
+
+def _multiclass_auroc_arg_validation(
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``auroc.py:160-170``)."""
+    if average not in ("macro", "weighted", "none", None):
+        raise ValueError(f"Expected argument `average` to be one of ('macro','weighted','none',None), got {average}")
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+
+
+def _multiclass_auroc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Array] = None,
+) -> Array:
+    """Per-class AUROC reduced (reference ``auroc.py:193-205``)."""
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
+    return _reduce_auroc(
+        fpr,
+        tpr,
+        average,
+        weights=(
+            bincount(jnp.clip(state[1], 0, num_classes - 1), minlength=num_classes).astype(jnp.float32)
+            if thresholds is None
+            else state[0][:, 1, :].sum(-1).astype(jnp.float32)
+        ),
+    )
+
+
+def multiclass_auroc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute AUROC for multiclass tasks (reference ``auroc.py:208-303``)."""
+    if validate_args:
+        _multiclass_auroc_arg_validation(num_classes, average, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_auroc_compute(state, num_classes, average, thresholds)
+
+
+def _multilabel_auroc_arg_validation(
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``auroc.py:270-280``)."""
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro','macro','weighted','none',None), got {average}"
+        )
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+
+
+def _multilabel_auroc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Per-label AUROC reduced (reference ``auroc.py:283-318``)."""
+    if average == "micro":
+        if not isinstance(state, tuple) and thresholds is not None:
+            return _binary_auroc_compute(state.sum(1), thresholds, max_fpr=None)
+        import numpy as np
+
+        preds, target = state[0].reshape(-1), state[1].reshape(-1)
+        if ignore_index is not None:
+            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)
+            preds, target = preds[keep], target[keep]
+        return _binary_auroc_compute((preds, target), thresholds, max_fpr=None)
+
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _reduce_auroc(
+        fpr,
+        tpr,
+        average,
+        weights=(
+            (state[1] == 1).sum(0).astype(jnp.float32)
+            if thresholds is None
+            else state[0][:, 1, :].sum(-1).astype(jnp.float32)
+        ),
+    )
+
+
+def multilabel_auroc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute AUROC for multilabel tasks (reference ``auroc.py:321-419``)."""
+    if validate_args:
+        _multilabel_auroc_arg_validation(num_labels, average, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_auroc_compute(state, num_labels, average, thresholds, ignore_index)
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching AUROC (reference ``auroc.py:422-493``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)

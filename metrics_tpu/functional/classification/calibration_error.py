@@ -1,0 +1,199 @@
+"""Calibration error kernels (reference ``functional/classification/calibration_error.py``).
+
+The bucketize+scatter_add binning (reference ``:30-60``) lowers to one
+``segment_sum`` per statistic — static ``n_bins`` shapes, fully jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from metrics_tpu.utils.compute import normalize_logits_if_needed
+from metrics_tpu.utils.data import bincount, bincount_weighted
+from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries: Array
+) -> Tuple[Array, Array, Array]:
+    """Per-bin mean accuracy/confidence/mass (reference ``calibration_error.py:30-60``).
+
+    Elements with negative confidence (flagged ignored) fall into a dead bin.
+    """
+    n_bins = bin_boundaries.shape[0]
+    valid = confidences >= 0
+    indices = jnp.searchsorted(bin_boundaries, jnp.clip(confidences, 0.0, 1.0), side="right") - 1
+    indices = jnp.clip(indices, 0, n_bins - 1)
+    indices = jnp.where(valid, indices, n_bins)
+
+    count_bin = bincount(indices, n_bins + 1)[:n_bins].astype(confidences.dtype)
+    conf_bin = bincount_weighted(indices, jnp.where(valid, confidences, 0.0), n_bins + 1)[:n_bins]
+    acc_bin = bincount_weighted(indices, jnp.where(valid, accuracies.astype(confidences.dtype), 0.0), n_bins + 1)[:n_bins]
+
+    safe = jnp.maximum(count_bin, 1.0)
+    conf_bin = jnp.where(count_bin > 0, conf_bin / safe, 0.0)
+    acc_bin = jnp.where(count_bin > 0, acc_bin / safe, 0.0)
+    prop_bin = count_bin / count_bin.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Union[Array, int],
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    """Calibration error over the given binning (reference ``calibration_error.py:63-110``)."""
+    if isinstance(bin_boundaries, int):
+        bin_boundaries = jnp.linspace(0, 1, bin_boundaries + 1, dtype=confidences.dtype)
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * accuracies.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    """Validate non-tensor args (reference ``calibration_error.py:113-124``)."""
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Expected argument `norm` to be one of ('l1', 'l2', 'max'), but got {norm}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``calibration_error.py:127-134``)."""
+    _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Confidences are the raw positive-class probabilities, accuracies the targets
+    (reference ``calibration_error.py:137-139``). Ignored positions (target flagged
+    -1) get confidence -1 → dead bin downstream."""
+    confidences = jnp.where(target < 0, -1.0, preds)
+    accuracies = jnp.clip(target, 0, 1).astype(preds.dtype)
+    return confidences, accuracies
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for binary tasks (reference ``calibration_error.py:142-219``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+    >>> target = jnp.array([0, 0, 1, 1, 1])
+    >>> binary_calibration_error(preds, target, n_bins=2, norm='l1')
+    Array(0.29, dtype=float32)
+    """
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(
+        preds, target, threshold=0.5, ignore_index=ignore_index, convert_to_labels=False
+    )
+    confidences, accuracies = _binary_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int, n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    """Validate non-tensor args (reference ``calibration_error.py:222-229``)."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``calibration_error.py:232-236``)."""
+    _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidence + correctness (reference ``calibration_error.py:239-246``)."""
+    preds = normalize_logits_if_needed(preds, "softmax")
+    confidences = jnp.max(preds, axis=1)
+    predictions = jnp.argmax(preds, axis=1)
+    accuracies = (predictions == target).astype(jnp.float32)
+    confidences = jnp.where(target < 0, -1.0, confidences.astype(jnp.float32))
+    return confidences, accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for multiclass tasks (reference ``calibration_error.py:249-329``)."""
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        _multiclass_calibration_error_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index, convert_to_labels=False)
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching calibration error (reference ``calibration_error.py:332-390``)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if not isinstance(num_classes, int):
+        raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+    return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)

@@ -1,0 +1,172 @@
+"""Group-fairness kernels (reference ``functional/classification/group_fairness.py``).
+
+The reference sorts by group and splits into per-group chunks (``:74-90``, dynamic
+shapes); here per-group tp/fp/tn/fn are FOUR segment-sums over the group ids — one
+static-shape scatter-add each, jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from metrics_tpu.utils.checks import _is_traced
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.data import bincount_weighted
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    """Validate group tensor eagerly (reference ``group_fairness.py:29-41``)."""
+    if not jnp.issubdtype(groups.dtype, jnp.integer):
+        raise ValueError(f"Expected dtype of argument groups to be int, but got {groups.dtype}.")
+    if _is_traced(groups):
+        return
+    if int(jnp.max(groups)) > num_groups - 1:
+        raise ValueError(f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger"
+                         f" than the specified number of groups {num_groups}.")
+
+
+def _groups_format(groups: Array) -> Array:
+    """Flatten group ids (reference ``group_fairness.py:44-49``)."""
+    return groups.reshape(groups.shape[0], -1)
+
+
+def _binary_groups_stat_scores_tensor(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-group (tp, fp, tn, fn), each shape (num_groups,) (reference ``group_fairness.py:52-90``)."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    groups = _groups_format(groups).reshape(-1)
+    p, t = preds.reshape(-1), target.reshape(-1)
+    tp = bincount_weighted(groups, ((t == p) & (t == 1)).astype(jnp.float32), num_groups).astype(jnp.int32)
+    fn = bincount_weighted(groups, ((t != p) & (t == 1)).astype(jnp.float32), num_groups).astype(jnp.int32)
+    fp = bincount_weighted(groups, ((t != p) & (t == 0)).astype(jnp.float32), num_groups).astype(jnp.int32)
+    tn = bincount_weighted(groups, ((t == p) & (t == 0)).astype(jnp.float32), num_groups).astype(jnp.int32)
+    return tp, fp, tn, fn
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Per-group tp/fp/tn/fn rates (reference ``group_fairness.py:105-161``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+    >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> binary_groups_stat_rates(preds, target, groups, 2)
+    {'group_0': Array([0., 0., 1., 0.], dtype=float32), 'group_1': Array([1., 0., 0., 0.], dtype=float32)}
+    """
+    tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    stacked = jnp.stack([tp, fp, tn, fn]).astype(jnp.float32)  # (4, G)
+    rates = stacked / stacked.sum(axis=0, keepdims=True)
+    return {f"group_{g}": rates[:, g] for g in range(num_groups)}
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Demographic parity from group stats (reference ``group_fairness.py:164-174``)."""
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    min_id = int(jnp.argmin(pos_rates))
+    max_id = int(jnp.argmax(pos_rates))
+    return {f"DP_{min_id}_{max_id}": _safe_divide(pos_rates[min_id], pos_rates[max_id])}
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Equal opportunity from group stats (reference ``group_fairness.py:243-255``)."""
+    tpr = _safe_divide(tp, tp + fn)
+    min_id = int(jnp.argmin(tpr))
+    max_id = int(jnp.argmax(tpr))
+    return {f"EO_{min_id}_{max_id}": _safe_divide(tpr[min_id], tpr[max_id])}
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity between all groups (reference ``group_fairness.py:177-240``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+    >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+    >>> demographic_parity(preds, groups)
+    {'DP_0_1': Array(0., dtype=float32)}
+    """
+    num_groups = int(jnp.max(groups)) + 1
+    target = jnp.zeros(preds.shape, dtype=jnp.int32)
+    tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    return _compute_binary_demographic_parity(tp, fp, tn, fn)
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Equal opportunity between all groups (reference ``group_fairness.py:258-324``)."""
+    num_groups = int(jnp.max(groups)) + 1
+    tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    return _compute_binary_equal_opportunity(tp, fp, tn, fn)
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Both fairness criteria (reference ``group_fairness.py:327-407``)."""
+    if task not in ("demographic_parity", "equal_opportunity", "all"):
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    num_groups = int(jnp.max(groups)) + 1
+    if task == "demographic_parity":
+        target = jnp.zeros(preds.shape, dtype=jnp.int32)
+    tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    out: Dict[str, Array] = {}
+    if task in ("demographic_parity", "all"):
+        out.update(_compute_binary_demographic_parity(tp, fp, tn, fn))
+    if task in ("equal_opportunity", "all"):
+        out.update(_compute_binary_equal_opportunity(tp, fp, tn, fn))
+    return out

@@ -1,0 +1,178 @@
+"""Hinge loss kernels (reference ``functional/classification/hinge.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from metrics_tpu.utils.compute import normalize_logits_if_needed
+from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    """Final reduction (reference ``hinge.py:31-32``)."""
+    return measure / total
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    """Validate non-tensor args (reference ``hinge.py:35-39``)."""
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_tensor_validation(preds: Array, target: Array, ignore_index: Optional[int] = None) -> None:
+    """Validate tensor inputs eagerly (reference ``hinge.py:42-48``)."""
+    _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    """Accumulate hinge measures; flagged (-1) targets contribute 0 (reference ``hinge.py:51-68``)."""
+    valid = target >= 0
+    margin = jnp.where(target == 1, preds, -preds)
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = measures**2
+    measures = jnp.where(valid, measures, 0.0)
+    total = jnp.sum(valid)
+    return measures.sum(axis=0), total
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Compute hinge loss for binary tasks (reference ``hinge.py:71-126``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+    >>> target = jnp.array([0, 0, 1, 1, 1])
+    >>> binary_hinge_loss(preds, target)
+    Array(0.69, dtype=float32)
+    """
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+        _binary_hinge_loss_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(
+        preds, target, threshold=0.0, ignore_index=ignore_index, convert_to_labels=False
+    )
+    measures, total = _binary_hinge_loss_update(preds, target, squared)
+    return _hinge_loss_compute(measures, total)
+
+
+def _multiclass_hinge_loss_arg_validation(
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``hinge.py:129-139``)."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_hinge_loss_arg_validation(squared, ignore_index)
+    if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+        raise ValueError(
+            f"Expected argument `multiclass_mode` to be one of ('crammer-singer', 'one-vs-all'),"
+            f" but got {multiclass_mode}."
+        )
+
+
+def _multiclass_hinge_loss_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``hinge.py:142-148``)."""
+    _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array,
+    target: Array,
+    squared: bool,
+    multiclass_mode: str = "crammer-singer",
+) -> Tuple[Array, Array]:
+    """Accumulate hinge measures (reference ``hinge.py:151-177``)."""
+    preds = normalize_logits_if_needed(preds, "softmax")
+    valid = target >= 0
+    safe_target = jnp.clip(target, 0, preds.shape[1] - 1)
+    target_oh = safe_target[:, None] == jnp.arange(preds.shape[1])
+    if multiclass_mode == "crammer-singer":
+        margin = jnp.sum(jnp.where(target_oh, preds, 0.0), axis=1)
+        margin = margin - jnp.max(jnp.where(target_oh, -jnp.inf, preds), axis=1)
+        measures = jnp.clip(1 - margin, 0, None)
+        if squared:
+            measures = measures**2
+        measures = jnp.where(valid, measures, 0.0)
+    else:
+        margin = jnp.where(target_oh, preds, -preds)
+        measures = jnp.clip(1 - margin, 0, None)
+        if squared:
+            measures = measures**2
+        measures = jnp.where(valid[:, None], measures, 0.0)
+    total = jnp.sum(valid)
+    return measures.sum(axis=0), total
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Compute hinge loss for multiclass tasks (reference ``hinge.py:180-245``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+    >>> target = jnp.array([0, 1, 2, 0])
+    >>> multiclass_hinge_loss(preds, target, num_classes=3)
+    Array(0.9125, dtype=float32)
+    """
+    if validate_args:
+        _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        _multiclass_hinge_loss_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index, convert_to_labels=False)
+    measures, total = _multiclass_hinge_loss_update(preds, target, squared, multiclass_mode)
+    return _hinge_loss_compute(measures, total)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching hinge loss (reference ``hinge.py:248-306``)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if not isinstance(num_classes, int):
+        raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+    return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)

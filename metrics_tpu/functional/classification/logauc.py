@@ -1,0 +1,184 @@
+"""Log-AUC functional entry points (reference ``functional/classification/logauc.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from metrics_tpu.utils.compute import _auc_compute_without_check, interp
+from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _validate_fpr_range(fpr_range: Tuple[float, float]) -> None:
+    """Validate the ``fpr_range`` argument (reference ``logauc.py:27-32``)."""
+    if not isinstance(fpr_range, tuple) or len(fpr_range) != 2:
+        raise ValueError(f"The `fpr_range` should be a tuple of two floats, but got {type(fpr_range)}.")
+    if not (0 <= fpr_range[0] < fpr_range[1] <= 1):
+        raise ValueError(f"The `fpr_range` should be a tuple of two floats in the range [0, 1], but got {fpr_range}.")
+
+
+def _binary_logauc_compute(
+    fpr: Array,
+    tpr: Array,
+    fpr_range: Tuple[float, float] = (0.001, 0.1),
+) -> Array:
+    """Area under the log10-fpr ROC slice, rescaled (reference ``logauc.py:35-61``)."""
+    if fpr.size < 2 or tpr.size < 2:
+        rank_zero_warn(
+            "At least two values on for the fpr and tpr are required to compute the log AUC. Returns 0 score."
+        )
+        return jnp.asarray(0.0)
+    fpr_rng = jnp.asarray(fpr_range, dtype=fpr.dtype)
+    tpr = jnp.sort(jnp.concatenate([tpr, interp(fpr_rng, fpr, tpr)]))
+    fpr = jnp.sort(jnp.concatenate([fpr, fpr_rng]))
+
+    log_fpr = jnp.log10(fpr)
+    bounds = jnp.log10(fpr_rng)
+
+    lower_bound_idx = int(jnp.nonzero(log_fpr == bounds[0])[0][-1])
+    upper_bound_idx = int(jnp.nonzero(log_fpr == bounds[1])[0][-1])
+    trimmed_log_fpr = log_fpr[lower_bound_idx : upper_bound_idx + 1]
+    trimmed_tpr = tpr[lower_bound_idx : upper_bound_idx + 1]
+    return _auc_compute_without_check(trimmed_log_fpr, trimmed_tpr, 1.0) / (bounds[1] - bounds[0])
+
+
+def _reduce_logauc(
+    fpr: Union[Array, List[Array]],
+    tpr: Union[Array, List[Array]],
+    fpr_range: Tuple[float, float] = (0.001, 0.1),
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Reduce per-class log-AUC scores (reference ``logauc.py:64-90``)."""
+    scores = jnp.stack([_binary_logauc_compute(f, t, fpr_range) for f, t in zip(fpr, tpr)])
+    if average is None or average == "none":
+        return scores
+    nan = jnp.isnan(scores)
+    if bool(nan.any()):
+        rank_zero_warn(f"Some classes had `nan` log AUC. Ignoring these classes in {average}-average", UserWarning)
+    if average == "macro":
+        return jnp.where(nan, 0.0, scores).sum() / jnp.maximum((~nan).sum(), 1)
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(nan, 0.0, weights)
+        weights = weights / weights.sum()
+        return jnp.where(nan, 0.0, scores * weights).sum()
+    raise ValueError(f"Got unknown average parameter: {average}. Please choose one of ['macro', 'weighted', 'none']")
+
+
+def binary_logauc(
+    preds: Array,
+    target: Array,
+    fpr_range: Tuple[float, float] = (0.001, 0.1),
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute log-AUC for binary tasks (reference ``logauc.py:93-170``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.75, 0.05, 0.05, 0.05, 0.05])
+    >>> target = jnp.array([1, 0, 0, 0, 0])
+    >>> binary_logauc(preds, target)
+    Array(1., dtype=float32)
+    """
+    if validate_args:
+        _validate_fpr_range(fpr_range)
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds)
+    return _binary_logauc_compute(fpr, tpr, fpr_range)
+
+
+def multiclass_logauc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    fpr_range: Tuple[float, float] = (0.001, 0.1),
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute log-AUC for multiclass tasks (reference ``logauc.py:173-262``)."""
+    if validate_args:
+        _validate_fpr_range(fpr_range)
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
+    return _reduce_logauc(fpr, tpr, fpr_range, average)
+
+
+def multilabel_logauc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    fpr_range: Tuple[float, float] = (0.001, 0.1),
+    average: Optional[str] = "macro",
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute log-AUC for multilabel tasks (reference ``logauc.py:265-354``)."""
+    if validate_args:
+        _validate_fpr_range(fpr_range)
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _reduce_logauc(fpr, tpr, fpr_range, average)
+
+
+def logauc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    fpr_range: Tuple[float, float] = (0.001, 0.1),
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching log-AUC (reference ``logauc.py:357-417``; default is per-class scores)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_logauc(preds, target, fpr_range, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_logauc(preds, target, num_classes, fpr_range, average, thresholds, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_logauc(preds, target, num_labels, fpr_range, average, thresholds, ignore_index, validate_args)

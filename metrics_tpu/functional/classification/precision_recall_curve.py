@@ -1,0 +1,541 @@
+"""Precision-recall curve kernels — the curve-state archetype (SURVEY §2.5-2).
+
+Capability parity with reference ``functional/classification/precision_recall_curve.py``
+(``_binary_clf_curve :30-83``, ``_adjust_threshold_arg :85-94``, binned vectorized
+update ``:211-227``, memory-saving loop ``:229-252``, computes ``:255-289``,
+multiclass ``:430-598``, multilabel ``:745-860``).
+
+TPU-first deltas:
+* **Binned path is the native default**: one static-shape scatter-add per update into
+  a ``(T, …, 2, 2)`` confusion tensor; ``ignore_index`` rides a dead overflow bin
+  instead of the reference's dynamic boolean filter, so the update jits whole.
+* The reference's memory-saving Python loop over thresholds is unnecessary — XLA
+  tiles the broadcast compare; there is ONE update kernel.
+* The exact path (``thresholds=None``) stores samples in list states and computes
+  host-side at the ``compute()`` boundary (sort + cumsum, dynamic output shapes are
+  inherent to "all unique thresholds").
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape, _is_traced
+from metrics_tpu.utils.compute import _safe_divide, interp, normalize_logits_if_needed
+from metrics_tpu.utils.data import bincount, to_onehot
+from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+# --------------------------------------------------------------------------- shared helpers
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps at every distinct prediction value (reference ``precision_recall_curve.py:30-83``).
+
+    Host-side (dynamic output shape) — used only on the exact (``thresholds=None``) path.
+    """
+    if sample_weights is not None and not isinstance(sample_weights, (jax.Array, jnp.ndarray)):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc = jnp.argsort(-preds, stable=True)
+    preds = preds[desc]
+    target = target[desc]
+    weight = sample_weights[desc] if sample_weights is not None else 1.0
+
+    distinct_value_indices = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.concatenate([distinct_value_indices, jnp.asarray([target.shape[0] - 1])])
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return fps, tps, preds[threshold_idxs]
+
+
+def _adjust_threshold_arg(thresholds: Optional[Union[int, List[float], Array]] = None) -> Optional[Array]:
+    """Convert thresholds arg to tensor form (reference ``precision_recall_curve.py:85-94``)."""
+    if isinstance(thresholds, int):
+        return jnp.linspace(0, 1, thresholds)
+    if isinstance(thresholds, list):
+        return jnp.asarray(thresholds, dtype=jnp.float32)
+    return thresholds
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``precision_recall_curve.py:97-124``)."""
+    if thresholds is not None and not isinstance(thresholds, (list, int, jax.Array, jnp.ndarray)):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or"
+            f" tensor of floats, but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(
+            f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}"
+        )
+    if isinstance(thresholds, list) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(
+            "If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range,"
+            f" but got {thresholds}"
+        )
+    if isinstance(thresholds, (jax.Array, jnp.ndarray)) and thresholds.ndim != 1:
+        raise ValueError("If argument `thresholds` is a tensor, expected the tensor to be 1d")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``precision_recall_curve.py:127-161``)."""
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("Expected argument `preds` to be a float tensor with probability/logit scores,"
+                         f" but got tensor with dtype {preds.dtype}")
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int tensor, but got float")
+    if _is_traced(preds, target):
+        return
+    import numpy as np
+
+    allowed = {0, 1} | ({ignore_index} if ignore_index is not None else set())
+    uniq = set(np.asarray(jnp.unique(target)).tolist())
+    if not uniq.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(uniq)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+# --------------------------------------------------------------------------- binary
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """Flatten, sigmoid-if-needed, materialize thresholds (reference ``precision_recall_curve.py:163-187``).
+
+    On the exact path (``thresholds=None``, eager) ignored samples are physically
+    dropped; on the binned path they are flagged ``target=-1`` and masked into the
+    dead bin by the update (static shapes under jit).
+    """
+    preds = preds.reshape(-1)
+    target = target.reshape(-1).astype(jnp.int32)
+    if ignore_index is not None:
+        if thresholds is None and not _is_traced(preds, target):
+            import numpy as np
+
+            keep = np.asarray(target != ignore_index)
+            preds, target = preds[keep], target[keep]
+        else:
+            target = jnp.where(target == ignore_index, -1, target)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """State update (reference ``precision_recall_curve.py:189-252``): samples (exact) or one
+    scatter-add into the (T,2,2) multi-threshold confusion tensor (binned)."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    valid = target >= 0
+    preds_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.int32)  # (N, T)
+    unique_mapping = preds_t + 2 * jnp.clip(target, 0, 1)[:, None] + 4 * jnp.arange(len_t)
+    unique_mapping = jnp.where(valid[:, None], unique_mapping, 4 * len_t)
+    bins = bincount(unique_mapping, 4 * len_t + 1)[: 4 * len_t]
+    return bins.reshape(len_t, 2, 2)
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Final pr-curve (reference ``precision_recall_curve.py:255-289``)."""
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+        return precision, recall, thresholds
+
+    fps, tps, thres = _binary_clf_curve(state[0], state[1], pos_label=pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+    if bool((state[1] == pos_label).sum() == 0):
+        rank_zero_warn(
+            "No positive samples found in target, recall is undefined. Setting recall to one for all thresholds.",
+            UserWarning,
+        )
+        recall = jnp.ones_like(recall)
+    precision = jnp.concatenate([jnp.flip(precision, 0), jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([jnp.flip(recall, 0), jnp.zeros(1, dtype=recall.dtype)])
+    thres = jnp.flip(thres, 0)
+    return precision, recall, thres
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Compute the precision-recall curve for binary tasks (reference ``precision_recall_curve.py:292-376``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.0, 0.5, 0.7, 0.8])
+    >>> target = jnp.array([0, 1, 1, 0])
+    >>> precision, recall, thresholds = binary_precision_recall_curve(preds, target, thresholds=5)
+    >>> precision
+    Array([0.5      , 0.6666667, 0.6666667, 0.5      , 0.       , 1.       ],      dtype=float32)
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# --------------------------------------------------------------------------- multiclass
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
+) -> None:
+    """Validate non-tensor args (reference ``precision_recall_curve.py:379-397``)."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if average not in (None, "micro", "macro"):
+        raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``precision_recall_curve.py:400-427``)."""
+    if not preds.ndim == target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target` but got"
+                         f" {preds.ndim} and {target.ndim}")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int tensor, but got float")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to be equal to the number of classes")
+    if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+        raise ValueError("Expected the shape of `preds` should be (N, C, ...) and the shape of `target` should be"
+                         " (N, ...).")
+    if _is_traced(preds, target):
+        return
+    import numpy as np
+
+    uniq = np.asarray(jnp.unique(target))
+    num_unique = (uniq >= 0).sum() if ignore_index is None else ((uniq >= 0) & (uniq != ignore_index)).sum()
+    check = num_unique > num_classes or (uniq.min() < 0 and ignore_index is None)
+    if check:
+        raise RuntimeError(
+            f"Detected more unique values in `target` than expected. Expected only {num_classes} but found"
+            f" {num_unique}."
+        )
+
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """Reshape to (M, C), softmax-if-needed, flatten for micro (reference ``precision_recall_curve.py:430-461``)."""
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    target = target.reshape(-1).astype(jnp.int32)
+    if ignore_index is not None:
+        if thresholds is None and not _is_traced(preds, target):
+            import numpy as np
+
+            keep = np.asarray(target != ignore_index)
+            preds, target = preds[keep], target[keep]
+        else:
+            target = jnp.where(target == ignore_index, -1, target)
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if average == "micro":
+        valid = target >= 0
+        target_oh = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
+        target_oh = jnp.where(valid[:, None], target_oh, -1)
+        preds = preds.reshape(-1)
+        target = target_oh.reshape(-1)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """State update (reference ``precision_recall_curve.py:464-533``): ONE vectorized
+    scatter-add into (T, C, 2, 2); ignored samples ride the dead bin."""
+    if thresholds is None:
+        return preds, target
+    if average == "micro":
+        return _binary_precision_recall_curve_update(preds, target, thresholds)
+    len_t = thresholds.shape[0]
+    valid = target >= 0
+    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)  # (N, C, T)
+    target_t = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)  # (N, C)
+    unique_mapping = preds_t + 2 * target_t[:, :, None]
+    unique_mapping = unique_mapping + 4 * jnp.arange(num_classes)[None, :, None]
+    unique_mapping = unique_mapping + 4 * num_classes * jnp.arange(len_t)[None, None, :]
+    unique_mapping = jnp.where(valid[:, None, None], unique_mapping, 4 * num_classes * len_t)
+    bins = bincount(unique_mapping, 4 * num_classes * len_t + 1)[: 4 * num_classes * len_t]
+    return bins.reshape(len_t, num_classes, 2, 2)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Final pr-curve (reference ``precision_recall_curve.py:536-598``)."""
+    if average == "micro":
+        return _binary_precision_recall_curve_compute(state, thresholds)
+
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)])
+        precision = precision.T
+        recall = recall.T
+        thres = thresholds
+        tensor_state = True
+    else:
+        precision_list, recall_list, thres_list = [], [], []
+        for i in range(num_classes):
+            res = _binary_precision_recall_curve_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
+            precision_list.append(res[0])
+            recall_list.append(res[1])
+            thres_list.append(res[2])
+        tensor_state = False
+
+    if average == "macro":
+        thres = jnp.tile(thres, num_classes) if tensor_state else jnp.concatenate(thres_list, 0)
+        thres = jnp.sort(thres)
+        mean_precision = precision.reshape(-1) if tensor_state else jnp.concatenate(precision_list, 0)
+        mean_precision = jnp.sort(mean_precision)
+        mean_recall = jnp.zeros_like(mean_precision)
+        for i in range(num_classes):
+            mean_recall = mean_recall + interp(
+                mean_precision,
+                precision[i] if tensor_state else precision_list[i],
+                recall[i] if tensor_state else recall_list[i],
+            )
+        mean_recall = mean_recall / num_classes
+        return mean_precision, mean_recall, thres
+
+    if tensor_state:
+        return precision, recall, thres
+    return precision_list, recall_list, thres_list
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Compute the precision-recall curve for multiclass tasks (reference ``precision_recall_curve.py:601-705``)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, average)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds, average)
+
+
+# --------------------------------------------------------------------------- multilabel
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``precision_recall_curve.py:708-717``)."""
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate tensor inputs eagerly (reference ``precision_recall_curve.py:720-742``)."""
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            "Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and {num_labels}"
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if _is_traced(preds, target):
+        return
+    import numpy as np
+
+    allowed = {0, 1} | ({ignore_index} if ignore_index is not None else set())
+    uniq = set(np.asarray(jnp.unique(target)).tolist())
+    if not uniq.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(uniq)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """Reshape to (M, L), sigmoid-if-needed, flag ignored (reference ``precision_recall_curve.py:745-774``)."""
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(target.astype(jnp.int32), 1, -1).reshape(-1, num_labels)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """State update (reference ``precision_recall_curve.py:777-799``): one scatter-add into (T, L, 2, 2)."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    valid = target >= 0
+    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)
+    unique_mapping = preds_t + 2 * jnp.clip(target, 0, 1)[:, :, None]
+    unique_mapping = unique_mapping + 4 * jnp.arange(num_labels)[None, :, None]
+    unique_mapping = unique_mapping + 4 * num_labels * jnp.arange(len_t)[None, None, :]
+    unique_mapping = jnp.where(valid[:, :, None], unique_mapping, 4 * num_labels * len_t)
+    bins = bincount(unique_mapping, 4 * num_labels * len_t + 1)[: 4 * num_labels * len_t]
+    return bins.reshape(len_t, num_labels, 2, 2)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Final pr-curve (reference ``precision_recall_curve.py:802-835``)."""
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_labels), dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_labels), dtype=recall.dtype)])
+        return precision.T, recall.T, thresholds
+    import numpy as np
+
+    precision_list, recall_list, thres_list = [], [], []
+    for i in range(num_labels):
+        preds_i = state[0][:, i]
+        target_i = state[1][:, i]
+        if ignore_index is not None:
+            keep = np.asarray(target_i != ignore_index) & np.asarray(target_i >= 0)
+            preds_i, target_i = preds_i[keep], target_i[keep]
+        res = _binary_precision_recall_curve_compute((preds_i, target_i), thresholds=None)
+        precision_list.append(res[0])
+        recall_list.append(res[1])
+        thres_list.append(res[2])
+    return precision_list, recall_list, thres_list
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Compute the precision-recall curve for multilabel tasks (reference ``precision_recall_curve.py:838-940``)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Task-dispatching precision-recall curve (reference ``precision_recall_curve.py:943-1023``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_precision_recall_curve(
+            preds, target, num_classes, thresholds, None, ignore_index, validate_args
+        )
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)

@@ -1,0 +1,232 @@
+"""ROC curve functional entry points (reference ``functional/classification/roc.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.utils.compute import _safe_divide, interp
+from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Compute fpr/tpr/thresholds (reference ``roc.py:40-80``)."""
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        tns = state[:, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0)
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0)
+        return fpr, tpr, jnp.flip(thresholds, 0)
+
+    fps, tps, thres = _binary_clf_curve(preds=state[0], target=state[1], pos_label=pos_label)
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thres = jnp.concatenate([jnp.ones(1, dtype=thres.dtype), thres])
+
+    if bool(fps[-1] <= 0):
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = jnp.zeros_like(thres)
+    else:
+        fpr = fps / fps[-1]
+    if bool(tps[-1] <= 0):
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = jnp.zeros_like(thres)
+    else:
+        tpr = tps / tps[-1]
+    return fpr, tpr, thres
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Compute the ROC for binary tasks (reference ``roc.py:83-159``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.0, 0.5, 0.7, 0.8])
+    >>> target = jnp.array([0, 1, 1, 0])
+    >>> fpr, tpr, thresholds = binary_roc(preds, target, thresholds=5)
+    >>> fpr
+    Array([0. , 0.5, 0.5, 0.5, 1. ], dtype=float32)
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Compute per-class (or averaged) ROC (reference ``roc.py:162-204``)."""
+    if average == "micro":
+        return _binary_roc_compute(state, thresholds, pos_label=1)
+
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
+        thres = jnp.flip(thresholds, 0)
+        tensor_state = True
+    else:
+        fpr_list, tpr_list, thres_list = [], [], []
+        for i in range(num_classes):
+            res = _binary_roc_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
+            fpr_list.append(res[0])
+            tpr_list.append(res[1])
+            thres_list.append(res[2])
+        tensor_state = False
+
+    if average == "macro":
+        thres = jnp.tile(thres, num_classes) if tensor_state else jnp.concatenate(thres_list, 0)
+        thres = -jnp.sort(-thres)
+        mean_fpr = fpr.reshape(-1) if tensor_state else jnp.concatenate(fpr_list, 0)
+        mean_fpr = jnp.sort(mean_fpr)
+        mean_tpr = jnp.zeros_like(mean_fpr)
+        for i in range(num_classes):
+            mean_tpr = mean_tpr + interp(
+                mean_fpr, fpr[i] if tensor_state else fpr_list[i], tpr[i] if tensor_state else tpr_list[i]
+            )
+        mean_tpr = mean_tpr / num_classes
+        return mean_fpr, mean_tpr, thres
+
+    if tensor_state:
+        return fpr, tpr, thres
+    return fpr_list, tpr_list, thres_list
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Compute the ROC for multiclass tasks (reference ``roc.py:207-326``)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, average)
+    return _multiclass_roc_compute(state, num_classes, thresholds, average)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Compute per-label ROC (reference ``roc.py:329-356``)."""
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
+        return fpr, tpr, jnp.flip(thresholds, 0)
+    import numpy as np
+
+    fpr_list, tpr_list, thres_list = [], [], []
+    for i in range(num_labels):
+        preds = state[0][:, i]
+        target = state[1][:, i]
+        if ignore_index is not None:
+            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)
+            preds, target = preds[keep], target[keep]
+        res = _binary_roc_compute((preds, target), thresholds=None, pos_label=1)
+        fpr_list.append(res[0])
+        tpr_list.append(res[1])
+        thres_list.append(res[2])
+    return fpr_list, tpr_list, thres_list
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Compute the ROC for multilabel tasks (reference ``roc.py:359-470``)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Task-dispatching ROC (reference ``roc.py:473-545``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_roc(preds, target, num_classes, thresholds, None, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)

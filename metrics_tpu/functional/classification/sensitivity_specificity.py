@@ -1,0 +1,163 @@
+"""Sensitivity-at-specificity functional entry points (reference ``functional/classification/sensitivity_specificity.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification._fixed_point import _constrained_argmax, _per_class_reduce
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _validate_min_arg(value: float, name: str) -> None:
+    if not isinstance(value, float) or not (0 <= value <= 1):
+        raise ValueError(f"Expected argument `{name}` to be a float in the [0,1] range, but got {value}")
+
+
+def _binary_sensitivity_at_specificity_compute(
+    state, thresholds: Optional[Array], min_specificity: float, pos_label: int = 1
+) -> Tuple[Array, Array]:
+    """Best sensitivity subject to specificity ≥ min (reference ``sensitivity_specificity.py:85-93``)."""
+    fpr, sensitivity, thres = _binary_roc_compute(state, thresholds, pos_label)
+    specificity = 1 - fpr
+    return _constrained_argmax(sensitivity, specificity, thres, min_specificity)
+
+
+def binary_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity given minimum specificity, binary (reference ``sensitivity_specificity.py:96-171``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.1, 0.4, 0.6, 0.8])
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> binary_sensitivity_at_specificity(preds, target, min_specificity=0.5)
+    (Array(1., dtype=float32), Array(0.6, dtype=float32))
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _validate_min_arg(min_specificity, "min_specificity")
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_sensitivity_at_specificity_compute(state, thresholds, min_specificity)
+
+
+def _multiclass_sensitivity_at_specificity_compute(
+    state, num_classes: int, thresholds: Optional[Array], min_specificity: float
+) -> Tuple[Array, Array]:
+    """Per-class variant (reference ``sensitivity_specificity.py:202-220``)."""
+    fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
+
+    def reduce_one(f, t, th):
+        return _constrained_argmax(t, 1 - f, th, min_specificity)
+
+    return _per_class_reduce((fpr, tpr, thres), num_classes, reduce_one)
+
+
+def multiclass_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity given minimum specificity, multiclass (reference ``sensitivity_specificity.py:223-303``)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _validate_min_arg(min_specificity, "min_specificity")
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_sensitivity_at_specificity_compute(state, num_classes, thresholds, min_specificity)
+
+
+def _multilabel_sensitivity_at_specificity_compute(
+    state, num_labels: int, thresholds: Optional[Array], ignore_index: Optional[int], min_specificity: float
+) -> Tuple[Array, Array]:
+    """Per-label variant (reference ``sensitivity_specificity.py:334-355``)."""
+    fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+    def reduce_one(f, t, th):
+        return _constrained_argmax(t, 1 - f, th, min_specificity)
+
+    return _per_class_reduce((fpr, tpr, thres), num_labels, reduce_one)
+
+
+def multilabel_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity given minimum specificity, multilabel (reference ``sensitivity_specificity.py:358-437``)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _validate_min_arg(min_specificity, "min_specificity")
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_sensitivity_at_specificity_compute(state, num_labels, thresholds, ignore_index, min_specificity)
+
+
+def sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching sensitivity@specificity (reference ``sensitivity_specificity.py:440-490``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_sensitivity_at_specificity(preds, target, min_specificity, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_sensitivity_at_specificity(
+            preds, target, num_classes, min_specificity, thresholds, ignore_index, validate_args
+        )
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_sensitivity_at_specificity(
+        preds, target, num_labels, min_specificity, thresholds, ignore_index, validate_args
+    )

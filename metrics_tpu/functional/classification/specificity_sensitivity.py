@@ -1,0 +1,162 @@
+"""Specificity-at-sensitivity functional entry points (reference ``functional/classification/specificity_sensitivity.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+from jax import Array
+
+from metrics_tpu.functional.classification._fixed_point import _constrained_argmax, _per_class_reduce
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from metrics_tpu.functional.classification.sensitivity_specificity import _validate_min_arg
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _binary_specificity_at_sensitivity_compute(
+    state, thresholds: Optional[Array], min_sensitivity: float, pos_label: int = 1
+) -> Tuple[Array, Array]:
+    """Best specificity subject to sensitivity ≥ min (reference ``specificity_sensitivity.py:85-93``)."""
+    fpr, sensitivity, thres = _binary_roc_compute(state, thresholds, pos_label)
+    specificity = 1 - fpr
+    return _constrained_argmax(specificity, sensitivity, thres, min_sensitivity)
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity given minimum sensitivity, binary (reference ``specificity_sensitivity.py:96-172``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.1, 0.4, 0.6, 0.8])
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> binary_specificity_at_sensitivity(preds, target, min_sensitivity=0.5)
+    (Array(1., dtype=float32), Array(0.8, dtype=float32))
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _validate_min_arg(min_sensitivity, "min_sensitivity")
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_specificity_at_sensitivity_compute(state, thresholds, min_sensitivity)
+
+
+def _multiclass_specificity_at_sensitivity_compute(
+    state, num_classes: int, thresholds: Optional[Array], min_sensitivity: float
+) -> Tuple[Array, Array]:
+    """Per-class variant (reference ``specificity_sensitivity.py:203-222``)."""
+    fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
+
+    def reduce_one(f, t, th):
+        return _constrained_argmax(1 - f, t, th, min_sensitivity)
+
+    return _per_class_reduce((fpr, tpr, thres), num_classes, reduce_one)
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity given minimum sensitivity, multiclass (reference ``specificity_sensitivity.py:225-305``)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _validate_min_arg(min_sensitivity, "min_sensitivity")
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_specificity_at_sensitivity_compute(state, num_classes, thresholds, min_sensitivity)
+
+
+def _multilabel_specificity_at_sensitivity_compute(
+    state, num_labels: int, thresholds: Optional[Array], ignore_index: Optional[int], min_sensitivity: float
+) -> Tuple[Array, Array]:
+    """Per-label variant (reference ``specificity_sensitivity.py:336-357``)."""
+    fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+    def reduce_one(f, t, th):
+        return _constrained_argmax(1 - f, t, th, min_sensitivity)
+
+    return _per_class_reduce((fpr, tpr, thres), num_labels, reduce_one)
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity given minimum sensitivity, multilabel (reference ``specificity_sensitivity.py:360-438``)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _validate_min_arg(min_sensitivity, "min_sensitivity")
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_specificity_at_sensitivity_compute(
+        state, num_labels, thresholds, ignore_index, min_sensitivity
+    )
+
+
+def specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching specificity@sensitivity (reference ``specificity_sensitivity.py:441-498``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_specificity_at_sensitivity(
+            preds, target, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_specificity_at_sensitivity(
+            preds, target, num_classes, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_specificity_at_sensitivity(
+        preds, target, num_labels, min_sensitivity, thresholds, ignore_index, validate_args
+    )

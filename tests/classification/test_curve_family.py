@@ -1,0 +1,174 @@
+"""Golden-reference tests for the curve family (PRC/ROC/AUROC/AP) vs sklearn."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+from metrics_tpu.classification import (
+    AUROC,
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MulticlassPrecisionRecallCurve,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+from tests.classification._inputs import binary_probs, binary_target, mc_probs, mc_target, ml_probs, ml_target
+from tests.conftest import NUM_CLASSES
+from tests.helpers import run_class_test
+
+
+def test_binary_prc_exact_vs_sklearn():
+    def ref(p, t):
+        prec, rec, _ = sk.precision_recall_curve(t.reshape(-1), p.reshape(-1))
+        return prec, rec
+
+    m = BinaryPrecisionRecallCurve(thresholds=None)
+    for p, t in zip(binary_probs, binary_target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    precision, recall, thres = m.compute()
+    sk_prec, sk_rec, sk_thres = sk.precision_recall_curve(binary_target.reshape(-1), binary_probs.reshape(-1))
+    np.testing.assert_allclose(np.asarray(precision), sk_prec, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(recall), sk_rec, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(thres), sk_thres, atol=1e-5)
+
+
+def test_binary_roc_exact_vs_sklearn():
+    m = BinaryROC(thresholds=None)
+    for p, t in zip(binary_probs, binary_target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    fpr, tpr, _ = m.compute()
+    sk_fpr, sk_tpr, _ = sk.roc_curve(binary_target.reshape(-1), binary_probs.reshape(-1), drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-5)
+
+
+def test_binary_auroc_exact_vs_sklearn():
+    run_class_test(
+        BinaryAUROC, {"thresholds": None}, binary_probs, binary_target,
+        lambda p, t: sk.roc_auc_score(t.reshape(-1), p.reshape(-1)),
+    )
+
+
+def test_binary_auroc_binned_close_to_sklearn():
+    run_class_test(
+        BinaryAUROC, {"thresholds": 500}, binary_probs, binary_target,
+        lambda p, t: sk.roc_auc_score(t.reshape(-1), p.reshape(-1)),
+        atol=0.01, check_pickle=False,
+    )
+
+
+@pytest.mark.parametrize("max_fpr", [0.5, 0.9])
+def test_binary_auroc_max_fpr(max_fpr):
+    run_class_test(
+        BinaryAUROC, {"thresholds": None, "max_fpr": max_fpr}, binary_probs, binary_target,
+        lambda p, t: sk.roc_auc_score(t.reshape(-1), p.reshape(-1), max_fpr=max_fpr),
+        check_ddp=False,
+    )
+
+
+def test_binary_average_precision_vs_sklearn():
+    run_class_test(
+        BinaryAveragePrecision, {"thresholds": None}, binary_probs, binary_target,
+        lambda p, t: sk.average_precision_score(t.reshape(-1), p.reshape(-1)),
+    )
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", None])
+@pytest.mark.parametrize("thresholds", [None, 500])
+def test_multiclass_auroc_vs_sklearn(average, thresholds):
+    atol = 1e-5 if thresholds is None else 0.01
+
+    def ref(p, t):
+        return sk.roc_auc_score(
+            t.reshape(-1), p.reshape(-1, NUM_CLASSES), multi_class="ovr",
+            average=average if average else None, labels=list(range(NUM_CLASSES)),
+        )
+
+    run_class_test(
+        MulticlassAUROC, {"num_classes": NUM_CLASSES, "average": average, "thresholds": thresholds},
+        mc_probs, mc_target, ref, atol=atol, check_pickle=thresholds is None,
+    )
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", None])
+def test_multiclass_average_precision_vs_sklearn(average):
+    def ref(p, t):
+        p = p.reshape(-1, NUM_CLASSES)
+        t = t.reshape(-1)
+        t_oh = np.eye(NUM_CLASSES)[t]
+        return sk.average_precision_score(t_oh, p, average=average)
+
+    run_class_test(
+        MulticlassAveragePrecision, {"num_classes": NUM_CLASSES, "average": average, "thresholds": None},
+        mc_probs, mc_target, ref,
+    )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+def test_multilabel_auroc_vs_sklearn(average):
+    def ref(p, t):
+        return sk.roc_auc_score(t.reshape(-1, NUM_CLASSES), p.reshape(-1, NUM_CLASSES), average=average)
+
+    run_class_test(
+        MultilabelAUROC, {"num_labels": NUM_CLASSES, "average": average, "thresholds": None},
+        ml_probs, ml_target, ref,
+    )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+def test_multilabel_average_precision_vs_sklearn(average):
+    def ref(p, t):
+        return sk.average_precision_score(t.reshape(-1, NUM_CLASSES), p.reshape(-1, NUM_CLASSES), average=average)
+
+    run_class_test(
+        MultilabelAveragePrecision, {"num_labels": NUM_CLASSES, "average": average, "thresholds": None},
+        ml_probs, ml_target, ref,
+    )
+
+
+def test_binned_prc_matches_exact_at_data_thresholds():
+    """Binned with a fine grid ≈ exact curve interpolated on the same grid."""
+    m = BinaryPrecisionRecallCurve(thresholds=1000)
+    m.update(jnp.asarray(binary_probs.reshape(-1)), jnp.asarray(binary_target.reshape(-1)))
+    precision, recall, thres = m.compute()
+    assert precision.shape == (1001,)
+    assert float(precision[-1]) == 1.0 and float(recall[-1]) == 0.0
+    # recall along growing thresholds must be non-increasing
+    assert bool(jnp.all(jnp.diff(recall[:-1]) <= 1e-6))
+
+
+def test_auroc_dispatcher_and_ignore_index():
+    rng = np.random.RandomState(3)
+    target = binary_target.copy()
+    mask = rng.rand(*target.shape) < 0.2
+    target[mask] = -1
+
+    def ref(p, t):
+        keep = t.reshape(-1) != -1
+        return sk.roc_auc_score(t.reshape(-1)[keep], p.reshape(-1)[keep])
+
+    run_class_test(
+        BinaryAUROC, {"thresholds": None, "ignore_index": -1}, binary_probs, target, ref, check_ddp=False,
+    )
+    a = AUROC(task="binary")
+    assert type(a).__name__ == "BinaryAUROC"
+
+
+def test_binned_auroc_with_ignore_index_jitted_update():
+    """ignore_index on the binned path must ride the dead bin inside ONE jitted update."""
+    rng = np.random.RandomState(5)
+    target = binary_target.copy()
+    mask = rng.rand(*target.shape) < 0.2
+    target[mask] = -1
+    m = BinaryAUROC(thresholds=500, ignore_index=-1)
+    for p, t in zip(binary_probs, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    keep = target.reshape(-1) != -1
+    ref = sk.roc_auc_score(target.reshape(-1)[keep], binary_probs.reshape(-1)[keep])
+    assert abs(float(m.compute()) - ref) < 0.01
+    assert m._jitted_update is not None
