@@ -6,7 +6,17 @@ jit-compiled XLA functions, and distributed sync lowers to XLA collectives over 
 ``jax.sharding.Mesh``.
 """
 
-from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from metrics_tpu import classification, functional, parallel, regression, utils, wrappers
+from metrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from metrics_tpu.collections import MetricCollection
 from metrics_tpu.metric import CompositionalMetric, Metric
 
 __version__ = "0.1.0"
@@ -17,7 +27,16 @@ __all__ = [
     "MaxMetric",
     "MeanMetric",
     "Metric",
+    "MetricCollection",
     "MinMetric",
+    "RunningMean",
+    "RunningSum",
     "SumMetric",
     "__version__",
+    "classification",
+    "functional",
+    "parallel",
+    "regression",
+    "utils",
+    "wrappers",
 ]

@@ -22,7 +22,7 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
 
-__all__ = ["BaseAggregator", "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric"]
+__all__ = ["BaseAggregator", "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "RunningMean", "RunningSum", "SumMetric"]
 
 
 class BaseAggregator(Metric):
@@ -216,3 +216,36 @@ class MeanMetric(BaseAggregator):
         from metrics_tpu.utils.compute import _safe_divide
 
         return _safe_divide(self.mean_value, self.weight)
+
+
+from metrics_tpu.wrappers.running import Running  # noqa: E402  (bottom import avoids a cycle at package init)
+
+
+class RunningMean(Running):
+    """Mean over a running window of updates (reference ``aggregation.py:616``).
+
+    >>> from metrics_tpu.aggregation import RunningMean
+    >>> metric = RunningMean(window=2)
+    >>> for i in range(5):
+    ...     metric.update(float(i))
+    >>> float(metric.compute())  # mean of [3, 4]
+    3.5
+    """
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(MeanMetric(nan_strategy=nan_strategy, **kwargs), window=window)
+
+
+class RunningSum(Running):
+    """Sum over a running window of updates (reference ``aggregation.py:673``).
+
+    >>> from metrics_tpu.aggregation import RunningSum
+    >>> metric = RunningSum(window=2)
+    >>> for i in range(5):
+    ...     metric.update(float(i))
+    >>> float(metric.compute())  # 3 + 4
+    7.0
+    """
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

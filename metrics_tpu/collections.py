@@ -1,0 +1,359 @@
+"""MetricCollection with on-device compute-group state sharing.
+
+Capability parity with reference ``torchmetrics/collections.py`` (``MetricCollection
+:59``, ``update :231``, ``_merge_compute_groups :264-298``, ``_equal_metric_states
+:300-323``, ``_compute_groups_create_state_ref :325-343``, ``compute :345``,
+``_compute_and_reduce :349-394``).
+
+TPU redesign (SURVEY §7.1-4): the reference shares states *by Python reference* and
+must copy on ``.items()`` to protect against user mutation (``collections.py:551-574``).
+JAX arrays are immutable, so group members simply hold the same array objects as the
+leader — sharing is free AND safe; no copy-on-read is needed. Group detection keeps
+the reference's behavior: after the first update, metrics whose states compare equal
+are merged, and later updates run only once per group.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import _flatten_dict, allclose
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["MetricCollection"]
+
+
+class MetricCollection:
+    """Collection of metrics updated from the same inputs (reference ``collections.py:59-170``).
+
+    Args:
+        metrics: a single metric, a sequence of metrics, or a dict mapping names to metrics.
+        *additional_metrics: more metrics (when ``metrics`` is a single metric or sequence).
+        prefix: string prepended to every result key.
+        postfix: string appended to every result key.
+        compute_groups: share state between metrics with identical update behavior
+            (auto-detected after the first update), or an explicit list of name groups.
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision, MulticlassRecall
+    >>> target = jnp.array([0, 2, 0, 2, 0, 1, 0, 2])
+    >>> preds = jnp.array([2, 1, 2, 0, 1, 2, 2, 2])
+    >>> metrics = MetricCollection([MulticlassAccuracy(num_classes=3, average='micro'),
+    ...                             MulticlassPrecision(num_classes=3, average='macro'),
+    ...                             MulticlassRecall(num_classes=3, average='macro')])
+    >>> metrics.update(preds, target)
+    >>> sorted(metrics.compute())
+    ['MulticlassAccuracy', 'MulticlassPrecision', 'MulticlassRecall']
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked = False
+        self._state_is_copy = False
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ------------------------------------------------------------------ container protocol
+    def __getitem__(self, key: str) -> Metric:
+        return self._modules[key]
+
+    def __setitem__(self, key: str, value: Metric) -> None:
+        if not isinstance(value, Metric):
+            raise ValueError(f"Value for key {key!r} should be a Metric but got {type(value)}")
+        self._modules[key] = value
+        self._groups_checked = False
+
+    def __iter__(self):
+        return iter(self._modules)
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules
+
+    def keys(self, keep_base: bool = False):
+        """Return metric names; adorned with prefix/postfix unless ``keep_base``."""
+        if keep_base:
+            return self._modules.keys()
+        return [self._set_name(k) for k in self._modules]
+
+    def values(self):
+        """Return the metric instances."""
+        return self._modules.values()
+
+    def items(self, keep_base: bool = False):
+        """Return (name, metric) pairs."""
+        if keep_base:
+            return self._modules.items()
+        return [(self._set_name(k), v) for k, v in self._modules.items()]
+
+    # ------------------------------------------------------------------ construction
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Add new metrics to the collection (reference ``collections.py:576-648``)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passes extra arguments {remain} which are not Metrics so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary."
+            )
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+
+    def _init_compute_groups(self) -> None:
+        """Initialize compute groups (reference ``collections.py:250-262``)."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the collection."
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    # ------------------------------------------------------------------ lifecycle
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each metric (once per compute group after groups stabilize; reference ``collections.py:231-248``)."""
+        if self._state_is_copy:
+            self._groups_checked = False
+            self._state_is_copy = False
+        if self._groups_checked:
+            for cg in self._groups.values():
+                mi = self._modules[cg[0]]
+                mi.update(*args, **mi._filter_kwargs(**kwargs))
+            # members share the leader's (immutable) state arrays — zero-copy
+            for cg in self._groups.values():
+                leader = self._modules[cg[0]]
+                for name in cg[1:]:
+                    member = self._modules[name]
+                    # arrays are immutable → share; list containers are mutable → shallow-copy
+                    # the container (elements still shared) so a later independent update
+                    # cannot append into both metrics at once
+                    member.__dict__["_state"].update({
+                        k: (list(leader._state[k]) if isinstance(leader._state[k], list) else leader._state[k])
+                        for k in member._defaults
+                    })
+                    member._update_count = leader._update_count
+                    member._computed = None
+        else:
+            for m in self._modules.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+            self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """Merge metrics with identical post-update states (reference ``collections.py:264-298``)."""
+        num_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+            else:
+                break
+            if len(self._groups) == num_groups:
+                break
+            num_groups = len(self._groups)
+        self._groups = {i: v for i, v in enumerate(self._groups.values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Check whether two metrics have identical states (reference ``collections.py:300-323``)."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            s1, s2 = metric1._state[key], metric2._state[key]
+            if type(s1) != type(s2):  # noqa: E721
+                return False
+            if isinstance(s1, list):
+                if len(s1) != len(s2):
+                    return False
+                if not all(x.shape == y.shape and allclose(x, y) for x, y in zip(s1, s2)):
+                    return False
+            else:
+                if s1.shape != s2.shape or not allclose(s1, s2):
+                    return False
+        return True
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call forward on each metric, returning batch values (reference ``collections.py:222-229``)."""
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()}
+        # forward mutates states independently, so group sharing must be re-derived
+        self._groups_checked = False
+        res, duplicates = _flatten_dict(res)
+        if duplicates:
+            rank_zero_warn("Metric output keys overlap after flattening; some results were overwritten.")
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute the result for each metric (reference ``collections.py:345-347``)."""
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Run compute/forward per metric and flatten outputs (reference ``collections.py:349-394``)."""
+        result = {}
+        for k, m in self._modules.items():
+            if method_name == "compute":
+                res = m.compute()
+            else:
+                res = m(*args, **m._filter_kwargs(**kwargs))
+            result[k] = res
+        _, duplicates = _flatten_dict(result)
+        flat_result = {}
+        for k, res in result.items():
+            if isinstance(res, dict):
+                for key, v in res.items():
+                    if duplicates:
+                        stripped = key.replace(self.prefix, "") if self.prefix else key
+                        stripped = stripped.replace(self.postfix, "") if self.postfix else stripped
+                        key = f"{k}_{stripped}"
+                    flat_result[key] = v
+            else:
+                flat_result[k] = res
+        return {self._set_name(k): v for k, v in flat_result.items()}
+
+    def reset(self) -> None:
+        """Call reset for each metric (reference ``collections.py:396-402``)."""
+        for m in self._modules.values():
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked:
+            self._init_compute_groups()
+            # explicit user-specified groups survive reset; auto-detected ones re-derive
+            self._groups_checked = isinstance(self._enable_compute_groups, list)
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Make a copy of the collection (reference ``collections.py:404-419``)."""
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        """Change if metric states should be saved to state_dict (reference ``collections.py:421-424``)."""
+        for m in self._modules.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Export all member state dicts keyed by metric name."""
+        return {name: m.state_dict() for name, m in self._modules.items()}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        """Load member state dicts."""
+        for name, sd in state_dict.items():
+            if name in self._modules:
+                self._modules[name].load_state_dict(sd)
+
+    def set_dtype(self, dst_type) -> "MetricCollection":
+        """Transfer all metric states to ``dst_type``."""
+        for m in self._modules.values():
+            m.set_dtype(dst_type)
+        return self
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """Return the current compute groups."""
+        return self._groups
+
+    @property
+    def metric_state(self) -> Dict[str, Dict[str, Any]]:
+        """Return the state of each metric in the collection."""
+        return {name: m.metric_state for name, m in self._modules.items()}
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        for name, m in self._modules.items():
+            repr_str += f"\n  {name}: {m!r}"
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix}"
+        return repr_str + "\n)"

@@ -1,0 +1,35 @@
+"""Concordance correlation kernels (reference ``functional/regression/concordance.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.pearson import _pearson_corrcoef_update
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array, mean_y: Array, var_x: Array, var_y: Array, corr_xy: Array, nb: Array
+) -> Array:
+    """CCC = 2·cov / (var_x + var_y + (mean_x - mean_y)²) (reference ``concordance.py:24-39``)."""
+    var_x = var_x / nb
+    var_y = var_y / nb
+    corr_xy = corr_xy / nb
+    return jnp.squeeze(2.0 * corr_xy / (var_x + var_y + (mean_x - mean_y) ** 2))
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute concordance correlation coefficient (reference ``concordance.py:42-76``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3., -0.5, 2., 7.])
+    >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+    >>> concordance_corrcoef(preds, target)
+    Array(0.97679, dtype=float32)
+    """
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    zeros = jnp.zeros(d) if d > 1 else jnp.zeros(())
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zeros, zeros, zeros, zeros, zeros, zeros, num_outputs=d
+    )
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)

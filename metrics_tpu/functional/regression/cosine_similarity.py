@@ -1,0 +1,46 @@
+"""Cosine similarity kernels (reference ``functional/regression/cosine_similarity.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Pass through batches for concatenation (reference ``cosine_similarity.py:24-40``)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    return preds, target
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Per-sample cosine then reduce (reference ``cosine_similarity.py:43-66``)."""
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    reduction_mapping = {
+        "sum": jnp.sum,
+        "mean": jnp.mean,
+        "none": lambda x: x,
+        None: lambda x: x,
+    }
+    return reduction_mapping[reduction](similarity)
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Compute cosine similarity (reference ``cosine_similarity.py:69-100``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.]])
+    >>> preds = jnp.array([[1., 2., 3., 4.], [-1., -2., -3., -4.]])
+    >>> cosine_similarity(preds, target, 'none')
+    Array([ 1., -1.], dtype=float32)
+    """
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

@@ -1,0 +1,45 @@
+"""Critical success index kernels (reference ``functional/regression/csi.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _critical_success_index_update(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Binarize at ``threshold`` and count hits/misses/false-alarms (reference ``csi.py:25-56``)."""
+    _check_same_shape(preds, target)
+    preds_bin = preds >= threshold
+    target_bin = target >= threshold
+    sum_axes = None if not keep_sequence_dim else tuple(range(1, preds.ndim))
+    hits = jnp.sum(preds_bin & target_bin, axis=sum_axes)
+    misses = jnp.sum(~preds_bin & target_bin, axis=sum_axes)
+    false_alarms = jnp.sum(preds_bin & ~target_bin, axis=sum_axes)
+    return hits, misses, false_alarms
+
+
+def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Array) -> Array:
+    """CSI = hits / (hits + misses + false alarms) (reference ``csi.py:59-72``)."""
+    return _safe_divide(hits, hits + misses + false_alarms)
+
+
+def critical_success_index(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: bool = False
+) -> Array:
+    """Compute critical success index (reference ``csi.py:75-105``).
+
+    >>> import jax.numpy as jnp
+    >>> x = jnp.array([[0.2, 0.7], [0.9, 0.3]])
+    >>> y = jnp.array([[0.4, 0.2], [0.8, 0.6]])
+    >>> critical_success_index(x, y, 0.5)
+    Array(0.33333334, dtype=float32)
+    """
+    hits, misses, false_alarms = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
+    return _critical_success_index_compute(hits, misses, false_alarms)

@@ -1,0 +1,72 @@
+"""Explained variance kernels (reference ``functional/regression/explained_variance.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    """Accumulate moment sums (reference ``explained_variance.py:26-48``)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    num_obs = preds.shape[0]
+    sum_error = jnp.sum(target - preds, axis=0)
+    diff = target - preds
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    num_obs: Union[int, Array],
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Explained variance (reference ``explained_variance.py:51-96``)."""
+    diff_avg = sum_error / num_obs
+    numerator = sum_squared_error / num_obs - diff_avg**2
+    target_avg = sum_target / num_obs
+    denominator = sum_squared_target / num_obs - target_avg**2
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid_score = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.ones_like(diff_avg)
+    output_scores = jnp.where(
+        valid_score, 1.0 - (numerator / jnp.where(valid_score, denominator, 1.0)), output_scores
+    )
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, output_scores)
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    denom_sum = jnp.sum(denominator)
+    return jnp.sum(denominator / denom_sum * output_scores)
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """Compute explained variance (reference ``explained_variance.py:99-138``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3., -0.5, 2., 7.])
+    >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+    >>> explained_variance(preds, target)
+    Array(0.9572, dtype=float32)
+    """
+    if multioutput not in ALLOWED_MULTIOUTPUT:
+        raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}")
+    num_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(num_obs, sum_error, ss_error, sum_target, ss_target, multioutput)

@@ -1,0 +1,51 @@
+"""KL divergence kernels (reference ``functional/regression/kl_divergence.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_xlogy
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
+    """Per-sample KL(P||Q) (reference ``kl_divergence.py:25-55``)."""
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / p.sum(axis=-1, keepdims=True)
+        q = q / q.sum(axis=-1, keepdims=True)
+        q = jnp.clip(q, jnp.finfo(q.dtype).eps, None)
+        measures = jnp.sum(_safe_xlogy(p, p / q), axis=-1)
+    return measures, total
+
+
+def _kld_compute(measures: Array, total: int, reduction: Optional[str] = "mean") -> Array:
+    """Reduce per-sample KL values (reference ``kl_divergence.py:58-82``)."""
+    if reduction == "sum":
+        return measures.sum()
+    if reduction == "mean":
+        return measures.sum() / total
+    if reduction is None or reduction == "none":
+        return measures
+    return measures / total
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """Compute KL divergence (reference ``kl_divergence.py:85-118``).
+
+    >>> import jax.numpy as jnp
+    >>> p = jnp.array([[0.36, 0.48, 0.16]])
+    >>> q = jnp.array([[1/3, 1/3, 1/3]])
+    >>> kl_divergence(p, q)
+    Array(0.0853, dtype=float32)
+    """
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)

@@ -1,0 +1,49 @@
+"""Log-cosh error kernels (reference ``functional/regression/log_cosh.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.ndim == 2:
+        return preds, target
+    return preds[:, None], target[:, None]
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    """Accumulate Σ logcosh(p-t) per output (reference ``log_cosh.py:26-44``).
+
+    Numerically stable form: logcosh(x) = x + softplus(-2x) - log(2).
+    """
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds, target = _unsqueeze_tensors(preds.astype(jnp.float32), target.astype(jnp.float32))
+    diff = preds - target
+    sum_log_cosh_error = jnp.sum(diff + jax.nn.softplus(-2 * diff) - jnp.log(2.0), axis=0)
+    return sum_log_cosh_error, preds.shape[0]
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, total: int) -> Array:
+    """(reference ``log_cosh.py:47-49``)."""
+    return jnp.squeeze(sum_log_cosh_error / total)
+
+
+def log_cosh_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    """Compute log-cosh error (reference ``log_cosh.py:52-84``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([3.0, 5.0, 2.5, 7.0])
+    >>> target = jnp.array([2.5, 5.0, 4.0, 8.0])
+    >>> log_cosh_error(preds, target)
+    Array(0.3752, dtype=float32)
+    """
+    sum_log_cosh_error, total = _log_cosh_error_update(preds, target, num_outputs)
+    return _log_cosh_error_compute(sum_log_cosh_error, total)

@@ -1,0 +1,38 @@
+"""Mean absolute error kernels (reference ``functional/regression/mae.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_absolute_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, int]:
+    """Accumulate Σ|p-t| and count (reference ``mae.py:25-40``)."""
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    sum_abs_error = jnp.sum(jnp.abs(preds.astype(jnp.float32) - target.astype(jnp.float32)), axis=0)
+    return sum_abs_error, target.shape[0]
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, total: Union[int, Array]) -> Array:
+    """MAE (reference ``mae.py:43-57``)."""
+    return sum_abs_error / total
+
+
+def mean_absolute_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    """Compute mean absolute error (reference ``mae.py:60-82``).
+
+    >>> import jax.numpy as jnp
+    >>> x = jnp.array([0., 1., 2., 3.])
+    >>> y = jnp.array([0., 1., 2., 1.])
+    >>> mean_absolute_error(x, y)
+    Array(0.5, dtype=float32)
+    """
+    sum_abs_error, total = _mean_absolute_error_update(preds, target, num_outputs)
+    return _mean_absolute_error_compute(sum_abs_error, total)

@@ -1,0 +1,97 @@
+"""Mean absolute percentage error kernels (reference ``functional/regression/mape.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+_EPSILON = 1.17e-06
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    """Accumulate Σ|p-t|/max(|t|,eps) and count (reference ``mape.py:25-43``)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), epsilon, None)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Union[int, Array]) -> Array:
+    """MAPE (reference ``mape.py:46-60``)."""
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute mean absolute percentage error (reference ``mape.py:63-90``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.5, 1., 2., 8.])
+    >>> target = jnp.array([1., 2., 2., 4.])
+    >>> mean_absolute_percentage_error(preds, target)
+    Array(0.5, dtype=float32)
+    """
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    """Accumulate Σ 2|p-t|/max(|t|+|p|,eps) and count (reference ``symmetric_mape.py:25-45``)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    abs_per_error = 2 * jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), epsilon, None)
+    return jnp.sum(abs_per_error), target.size
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute symmetric MAPE (reference ``symmetric_mape.py:63-92``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.5, 1., 2., 8.])
+    >>> target = jnp.array([1., 2., 2., 4.])
+    >>> symmetric_mean_absolute_percentage_error(preds, target)
+    Array(0.5555556, dtype=float32)
+    """
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return sum_abs_per_error / num_obs
+
+
+def _weighted_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, Array]:
+    """Accumulate Σ|p-t| and Σ|t| (reference ``wmape.py:24-41``)."""
+    _check_same_shape(preds, target)
+    preds = preds.reshape(-1).astype(jnp.float32)
+    target = target.reshape(-1).astype(jnp.float32)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    sum_scale = jnp.sum(jnp.abs(target))
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = _EPSILON
+) -> Array:
+    """WMAPE (reference ``wmape.py:44-56``)."""
+    return sum_abs_error / jnp.clip(sum_scale, epsilon, None)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute weighted MAPE (reference ``wmape.py:59-85``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.5, 1., 2., 8.])
+    >>> target = jnp.array([1., 2., 2., 4.])
+    >>> weighted_mean_absolute_percentage_error(preds, target)
+    Array(0.6111111, dtype=float32)
+    """
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

@@ -1,0 +1,36 @@
+"""Minkowski distance kernels (reference ``functional/regression/minkowski.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+
+def _minkowski_distance_update(preds: Array, targets: Array, p: float) -> Array:
+    """Accumulate Σ|p-t|^p (reference ``minkowski.py:24-44``)."""
+    _check_same_shape(preds, targets)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TPUMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    difference = jnp.abs(preds.astype(jnp.float32) - targets.astype(jnp.float32))
+    return jnp.sum(jnp.power(difference, p))
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    """(Σ|p-t|^p)^(1/p) (reference ``minkowski.py:47-59``)."""
+    return jnp.power(distance, 1.0 / p)
+
+
+def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
+    """Compute Minkowski distance (reference ``minkowski.py:62-87``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0.0, 1.0, 3.0, 2.0])
+    >>> targets = jnp.array([1.0, 2.0, 3.0, 1.0])
+    >>> minkowski_distance(preds, targets, p=3)
+    Array(1.4422495, dtype=float32)
+    """
+    minkowski_dist_sum = _minkowski_distance_update(preds, targets, p)
+    return _minkowski_distance_compute(minkowski_dist_sum, p)

@@ -1,0 +1,40 @@
+"""Mean squared error kernels (reference ``functional/regression/mse.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    """Accumulate Σ(p-t)² and count (reference ``mse.py:26-45``)."""
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds.astype(jnp.float32) - target.astype(jnp.float32)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, target.shape[0]
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, total: Union[int, Array], squared: bool = True) -> Array:
+    """MSE or RMSE (reference ``mse.py:48-66``)."""
+    mse = sum_squared_error / total
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """Compute mean squared error (reference ``mse.py:69-97``).
+
+    >>> import jax.numpy as jnp
+    >>> x = jnp.array([0., 1., 2., 3.])
+    >>> y = jnp.array([0., 1., 2., 2.])
+    >>> mean_squared_error(x, y)
+    Array(0.25, dtype=float32)
+    """
+    sum_squared_error, total = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, total, squared)

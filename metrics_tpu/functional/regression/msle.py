@@ -1,0 +1,37 @@
+"""Mean squared log error kernels (reference ``functional/regression/log_mse.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Accumulate Σ(log1p(p)-log1p(t))² and count (reference ``log_mse.py:25-39``)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    sum_squared_log_error = jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2)
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, total: Union[int, Array]) -> Array:
+    """MSLE (reference ``log_mse.py:42-56``)."""
+    return sum_squared_log_error / total
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Compute mean squared log error (reference ``log_mse.py:59-81``).
+
+    >>> import jax.numpy as jnp
+    >>> x = jnp.array([0., 1., 2., 3.])
+    >>> y = jnp.array([0., 1., 2., 2.])
+    >>> mean_squared_log_error(x, y)
+    Array(0.02068142, dtype=float32)
+    """
+    sum_squared_log_error, total = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, total)

@@ -1,0 +1,57 @@
+"""Normalized RMSE kernels (reference ``functional/regression/nrmse.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.mse import _mean_squared_error_update
+
+
+def _normalized_root_mean_squared_error_update(
+    preds: Array, target: Array, num_outputs: int, normalization: str = "mean"
+) -> Tuple[Array, int, Array]:
+    """Σ(p-t)², count, and the batch-local denominator statistic (reference ``nrmse.py:23-50``)."""
+    sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
+    target = target.reshape(-1) if num_outputs == 1 else target
+    target = target.astype(jnp.float32)
+    if normalization == "mean":
+        denom = jnp.mean(target, axis=0)
+    elif normalization == "range":
+        denom = jnp.max(target, axis=0) - jnp.min(target, axis=0)
+    elif normalization == "std":
+        denom = jnp.std(target, axis=0)
+    elif normalization == "l2":
+        denom = jnp.linalg.norm(target, axis=0)
+    else:
+        raise ValueError(
+            f"Argument `normalization` should be either 'mean', 'range', 'std' or 'l2' but got {normalization}"
+        )
+    return sum_squared_error, num_obs, denom
+
+
+def _normalized_root_mean_squared_error_compute(
+    sum_squared_error: Array, num_obs: Union[int, Array], denom: Array
+) -> Array:
+    """RMSE / denom (reference ``nrmse.py:53-58``)."""
+    rmse = jnp.sqrt(sum_squared_error / num_obs)
+    return rmse / denom
+
+
+def normalized_root_mean_squared_error(
+    preds: Array, target: Array, normalization: str = "mean", num_outputs: int = 1
+) -> Array:
+    """Compute normalized RMSE / scatter index (reference ``nrmse.py:61-110``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([0., 1, 2, 3])
+    >>> target = jnp.array([0., 1, 2, 2])
+    >>> normalized_root_mean_squared_error(preds, target, normalization="mean")
+    Array(0.4, dtype=float32)
+    """
+    sum_squared_error, num_obs, denom = _normalized_root_mean_squared_error_update(
+        preds, target, num_outputs, normalization
+    )
+    return _normalized_root_mean_squared_error_compute(sum_squared_error, num_obs, denom)

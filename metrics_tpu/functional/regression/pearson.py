@@ -1,0 +1,133 @@
+"""Pearson correlation kernels — the canonical custom-reduction showcase.
+
+Parity with reference ``functional/regression/pearson.py:24-110`` (streaming
+mean/var/cov update) and ``regression/pearson.py:29-75`` (``_final_aggregation``
+pairwise merge across replicas). The merge is what runs under the mesh collective:
+per-device moment states are all-gathered and folded with this exact formula.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Streaming update of mean/var/cov states (reference ``pearson.py:24-76``)."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    num_obs = preds.shape[0]
+    cond = (num_prior.mean() > 0) | (num_obs == 1)
+
+    sum_p = preds.sum(0)
+    sum_t = target.sum(0)
+    mx_new = jnp.where(cond, (num_prior * mean_x + sum_p) / (num_prior + num_obs), sum_p / num_obs)
+    my_new = jnp.where(cond, (num_prior * mean_y + sum_t) / (num_prior + num_obs), sum_t / num_obs)
+    num_prior = num_prior + num_obs
+
+    var_x = var_x + jnp.where(
+        cond,
+        ((preds - mx_new) * (preds - mean_x)).sum(0),
+        jnp.var(preds, axis=0, ddof=1) * (num_obs - 1) if num_obs > 1 else jnp.zeros_like(var_x),
+    )
+    var_y = var_y + jnp.where(
+        cond,
+        ((target - my_new) * (target - mean_y)).sum(0),
+        jnp.var(target, axis=0, ddof=1) * (num_obs - 1) if num_obs > 1 else jnp.zeros_like(var_y),
+    )
+    corr_xy = corr_xy + ((preds - mx_new) * (target - mean_y)).sum(0)
+    return mx_new, my_new, var_x, var_y, corr_xy, num_prior
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Final correlation from accumulated statistics (reference ``pearson.py:79-110``)."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    bound = math.sqrt(jnp.finfo(jnp.float32).eps)
+    if bool((var_x < bound).any()) or bool((var_y < bound).any()):
+        rank_zero_warn(
+            "The variance of predictions or target is close to zero. This can cause instability in Pearson correlation"
+            " coefficient, leading to wrong results.",
+            UserWarning,
+        )
+    corrcoef = jnp.clip(corr_xy / jnp.sqrt(var_x * var_y), -1.0, 1.0)
+    return jnp.squeeze(corrcoef)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute Pearson correlation coefficient (reference ``pearson.py:113-147``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3., -0.5, 2., 7.])
+    >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+    >>> pearson_corrcoef(preds, target)
+    Array(0.98541, dtype=float32)
+    """
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    zeros = jnp.zeros(d) if d > 1 else jnp.zeros(())
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zeros, zeros, zeros, zeros, zeros, zeros, num_outputs=d
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Pairwise fold of per-replica moment states (reference ``regression/pearson.py:29-71``).
+
+    Used as the custom ``dist_reduce_fx``: the mesh all-gathers each state to shape
+    ``(world, ...)`` and this fold reproduces the single-stream statistics exactly.
+    """
+    if means_x.shape[0] == 1:
+        return means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+
+        element_x1 = (n1 + 1) * mean_x - n1 * mx1
+        vx1 = vx1 + (element_x1 - mx1) * (element_x1 - mean_x) - (element_x1 - mean_x) ** 2
+        element_x2 = (n2 + 1) * mean_x - n2 * mx2
+        vx2 = vx2 + (element_x2 - mx2) * (element_x2 - mean_x) - (element_x2 - mean_x) ** 2
+        var_x = vx1 + vx2
+
+        element_y1 = (n1 + 1) * mean_y - n1 * my1
+        vy1 = vy1 + (element_y1 - my1) * (element_y1 - mean_y) - (element_y1 - mean_y) ** 2
+        element_y2 = (n2 + 1) * mean_y - n2 * my2
+        vy2 = vy2 + (element_y2 - my2) * (element_y2 - mean_y) - (element_y2 - mean_y) ** 2
+        var_y = vy1 + vy2
+
+        cxy1 = cxy1 + (element_x1 - mx1) * (element_y1 - mean_y) - (element_x1 - mean_x) * (element_y1 - mean_y)
+        cxy2 = cxy2 + (element_x2 - mx2) * (element_y2 - mean_y) - (element_x2 - mean_x) * (element_y2 - mean_y)
+        corr_xy = cxy1 + cxy2
+
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return mx1, my1, vx1, vy1, cxy1, n1

@@ -1,0 +1,118 @@
+"""R² score kernels (reference ``functional/regression/r2.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    """Accumulate Σ(t-p)², Σt, Σt², n (reference ``r2.py:26-50``)."""
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            f"Expected both prediction and target to be 1D or 2D tensors, but received tensors with dimension"
+            f" {preds.shape}"
+        )
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = target - preds
+    rss = jnp.sum(residual * residual, axis=0)
+    return sum_squared_obs, sum_obs, rss, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    num_obs: Union[int, Array],
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """R² from accumulated sums (reference ``r2.py:53-113``)."""
+    mean_obs = sum_obs / num_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    cond = tss != 0
+    raw_scores = 1 - (rss / jnp.where(cond, tss, 1.0))
+    raw_scores = jnp.where(cond, raw_scores, 0.0)
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+            f" Received {multioutput}."
+        )
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        if adjusted > num_obs - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif adjusted == num_obs - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Compute R² score (reference ``r2.py:116-161``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3., -0.5, 2., 7.])
+    >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+    >>> r2_score(preds, target)
+    Array(0.9486081, dtype=float32)
+    """
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    if num_obs < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+    return _r2_score_compute(sum_squared_obs, sum_obs, rss, num_obs, adjusted, multioutput)
+
+
+def _relative_squared_error_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    num_obs: Union[int, Array],
+    squared: bool = True,
+) -> Array:
+    """RSE = Σ(t-p)² / Σ(t-t̄)² (reference ``rse.py:24-44``)."""
+    epsilon = jnp.finfo(jnp.float32).eps
+    mean_obs = sum_obs / num_obs
+    tss = jnp.maximum(sum_squared_obs - sum_obs * mean_obs, epsilon)
+    rse = jnp.sum(rss) / jnp.sum(tss)
+    return rse if squared else jnp.sqrt(rse)
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Compute relative squared error (reference ``rse.py:47-80``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3., -0.5, 2., 7.])
+    >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+    >>> relative_squared_error(preds, target)
+    Array(0.05139197, dtype=float32)
+    """
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared=squared)

@@ -1,0 +1,75 @@
+"""Spearman rank correlation kernels (reference ``functional/regression/spearman.py``).
+
+``_rank_data`` uses mean-rank tie handling like the reference (``spearman.py:35-53``)
+but vectorized: ranks from a double argsort, tie-groups averaged with one
+segment-sum instead of the reference's Python loop over repeated values.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _rank_data(data: Array) -> Array:
+    """Rank 1d data with ties assigned their mean rank (reference ``spearman.py:35-53``)."""
+    n = data.shape[0]
+    order = jnp.argsort(data)
+    rank = jnp.empty_like(data).at[order].set(jnp.arange(1, n + 1, dtype=data.dtype))
+    # average tied ranks: group identical values, give each the group-mean rank
+    sorted_data = data[order]
+    is_new = jnp.concatenate([jnp.ones(1, dtype=jnp.int32), (sorted_data[1:] != sorted_data[:-1]).astype(jnp.int32)])
+    group_id_sorted = jnp.cumsum(is_new) - 1
+    group_id = jnp.empty_like(group_id_sorted).at[order].set(group_id_sorted)
+    group_sum = jax.ops.segment_sum(rank, group_id, num_segments=n)
+    group_cnt = jax.ops.segment_sum(jnp.ones_like(rank), group_id, num_segments=n)
+    return group_sum[group_id] / group_cnt[group_id]
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    """Validate and pass batches through for concatenation (reference ``spearman.py:56-77``)."""
+    if not (jnp.issubdtype(preds.dtype, jnp.floating) and jnp.issubdtype(target.dtype, jnp.floating)):
+        raise TypeError(
+            "Expected `preds` and `target` both to be floating point tensors, but got"
+            f" {preds.dtype} and {target.dtype}"
+        )
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    return preds, target
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    """Rank then Pearson on the ranks (reference ``spearman.py:80-109``)."""
+    if preds.ndim == 1:
+        preds = _rank_data(preds)
+        target = _rank_data(target)
+    else:
+        preds = jnp.stack([_rank_data(preds[:, i]) for i in range(preds.shape[1])], axis=-1)
+        target = jnp.stack([_rank_data(target[:, i]) for i in range(target.shape[1])], axis=-1)
+    preds_diff = preds - preds.mean(0)
+    target_diff = target - target.mean(0)
+    cov = (preds_diff * target_diff).mean(0)
+    preds_std = jnp.sqrt((preds_diff * preds_diff).mean(0))
+    target_std = jnp.sqrt((target_diff * target_diff).mean(0))
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.squeeze(jnp.clip(corrcoef, -1.0, 1.0))
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute Spearman rank correlation (reference ``spearman.py:112-142``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3., -0.5, 2., 7.])
+    >>> preds = jnp.array([2.5, 0.0, 2., 8.])
+    >>> spearman_corrcoef(preds, target)
+    Array(1., dtype=float32)
+    """
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    preds, target = _spearman_corrcoef_update(preds, target, num_outputs=d)
+    return _spearman_corrcoef_compute(preds, target)

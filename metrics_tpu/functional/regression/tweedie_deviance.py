@@ -1,0 +1,72 @@
+"""Tweedie deviance kernels (reference ``functional/regression/tweedie_deviance.py``).
+
+The reference's power-dependent Python branches operate on static config, so they
+stay Python ``if``s; the data path is branch-free jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_xlogy
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    """Accumulate deviance sum and count (reference ``tweedie_deviance.py:26-79``)."""
+    _check_same_shape(preds, targets)
+    preds = preds.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    if power < 0:
+        if power <= 1:
+            deviance_score = 2 * (
+                jnp.power(jnp.clip(targets, 0, None), 2 - power) / ((1 - power) * (2 - power))
+                - targets * jnp.power(preds, 1 - power) / (1 - power)
+                + jnp.power(preds, 2 - power) / (2 - power)
+            )
+        else:  # pragma: no cover
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+    elif power == 0:
+        deviance_score = jnp.power(targets - preds, 2)
+    elif power == 1:
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)
+    elif 1 < power < 2:
+        deviance_score = 2 * (
+            jnp.power(targets, 2 - power) / ((1 - power) * (2 - power))
+            - targets * jnp.power(preds, 1 - power) / (1 - power)
+            + jnp.power(preds, 2 - power) / (2 - power)
+        )
+    elif power > 2:
+        deviance_score = 2 * (
+            jnp.power(targets, 2 - power) / ((1 - power) * (2 - power))
+            - targets * jnp.power(preds, 1 - power) / (1 - power)
+            + jnp.power(preds, 2 - power) / (2 - power)
+        )
+    else:
+        raise ValueError(
+            f"Deviance Score is not defined for power={power}. Set power to be in (-inf, 0] u [1, inf)."
+        )
+    return jnp.sum(deviance_score), jnp.asarray(deviance_score.size)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    """(reference ``tweedie_deviance.py:82-96``)."""
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Compute Tweedie deviance score (reference ``tweedie_deviance.py:99-136``).
+
+    >>> import jax.numpy as jnp
+    >>> targets = jnp.array([1.0, 2.0, 3.0, 4.0])
+    >>> preds = jnp.array([4.0, 3.0, 2.0, 1.0])
+    >>> tweedie_deviance_score(preds, targets, power=2)
+    Array(1.2083, dtype=float32)
+    """
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

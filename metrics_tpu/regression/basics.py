@@ -1,0 +1,415 @@
+"""Sum-state regression metrics.
+
+One module for the simple accumulator metrics (each a small class in the reference:
+``regression/mse.py``, ``mae.py``, ``log_mse.py``, ``mape.py``, ``symmetric_mape.py``,
+``wmape.py``, ``log_cosh.py``, ``minkowski.py``, ``tweedie_deviance.py``, ``csi.py``,
+``nrmse.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.csi import _critical_success_index_compute, _critical_success_index_update
+from metrics_tpu.functional.regression.log_cosh import _log_cosh_error_compute, _log_cosh_error_update
+from metrics_tpu.functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
+from metrics_tpu.functional.regression.mape import (
+    _mean_absolute_percentage_error_compute,
+    _mean_absolute_percentage_error_update,
+    _symmetric_mean_absolute_percentage_error_update,
+    _weighted_mean_absolute_percentage_error_compute,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from metrics_tpu.functional.regression.minkowski import _minkowski_distance_compute, _minkowski_distance_update
+from metrics_tpu.functional.regression.mse import _mean_squared_error_compute, _mean_squared_error_update
+from metrics_tpu.functional.regression.msle import (
+    _mean_squared_log_error_compute,
+    _mean_squared_log_error_update,
+)
+from metrics_tpu.functional.regression.nrmse import (
+    _normalized_root_mean_squared_error_compute,
+    _normalized_root_mean_squared_error_update,
+)
+from metrics_tpu.functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+__all__ = [
+    "CriticalSuccessIndex",
+    "LogCoshError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "MinkowskiDistance",
+    "NormalizedRootMeanSquaredError",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]
+
+
+class MeanSquaredError(Metric):
+    """Compute mean squared error (reference ``regression/mse.py:27``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = MeanSquaredError()
+    >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
+    >>> metric.compute()
+    Array(0.875, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_squared_error", jnp.zeros(num_outputs) if num_outputs > 1 else jnp.zeros(()), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, self.squared)
+
+
+class MeanAbsoluteError(Metric):
+    """Compute mean absolute error (reference ``regression/mae.py:26``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = MeanAbsoluteError()
+    >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
+    >>> metric.compute()
+    Array(0.5, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_abs_error", jnp.zeros(num_outputs) if num_outputs > 1 else jnp.zeros(()), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_abs_error, num_obs = _mean_absolute_error_update(preds, target, self.num_outputs)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)
+
+
+class MeanSquaredLogError(Metric):
+    """Compute mean squared log error (reference ``regression/log_mse.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", jnp.zeros(()), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_squared_log_error, num_obs = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + sum_squared_log_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _mean_squared_log_error_compute(self.sum_squared_log_error, self.total)
+
+
+class MeanAbsolutePercentageError(Metric):
+    """Compute mean absolute percentage error (reference ``regression/mape.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.zeros(()), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
+
+
+class SymmetricMeanAbsolutePercentageError(Metric):
+    """Compute symmetric MAPE (reference ``regression/symmetric_mape.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.zeros(()), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return self.sum_abs_per_error / self.total
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """Compute weighted MAPE (reference ``regression/wmape.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.zeros(()), "sum")
+        self.add_state("sum_scale", jnp.zeros(()), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.sum_scale = self.sum_scale + sum_scale
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)
+
+
+class LogCoshError(Metric):
+    """Compute log-cosh error (reference ``regression/log_cosh.py:25``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = LogCoshError()
+    >>> metric.update(jnp.array([3.0, 5.0, 2.5, 7.0]), jnp.array([2.5, 5.0, 4.0, 8.0]))
+    >>> metric.compute()
+    Array(0.3752, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", jnp.zeros(num_outputs), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_log_cosh_error, num_obs = _log_cosh_error_update(preds, target, self.num_outputs)
+        self.sum_log_cosh_error = self.sum_log_cosh_error + sum_log_cosh_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _log_cosh_error_compute(self.sum_log_cosh_error, self.total)
+
+
+class MinkowskiDistance(Metric):
+    """Compute Minkowski distance (reference ``regression/minkowski.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TPUMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.zeros(()), "sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        """Update state with predictions and targets."""
+        self.minkowski_dist_sum = self.minkowski_dist_sum + _minkowski_distance_update(preds, targets, self.p)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)
+
+
+class TweedieDevianceScore(Metric):
+    """Compute Tweedie deviance score (reference ``regression/tweedie_deviance.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.zeros(()), "sum")
+        self.add_state("num_observations", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)
+
+
+class CriticalSuccessIndex(Metric):
+    """Compute critical success index (reference ``regression/csi.py:25``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float, keep_sequence_dim: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise ValueError(f"Expected argument `threshold` to be a float but got {threshold}")
+        self.threshold = float(threshold)
+        if keep_sequence_dim is None:
+            self.keep_sequence_dim = None
+            self.add_state("hits", jnp.zeros((), dtype=jnp.int32), "sum")
+            self.add_state("misses", jnp.zeros((), dtype=jnp.int32), "sum")
+            self.add_state("false_alarms", jnp.zeros((), dtype=jnp.int32), "sum")
+        else:
+            if not isinstance(keep_sequence_dim, int) or keep_sequence_dim < 0:
+                raise ValueError(f"Expected keep_sequence_dim to be int or None but got {keep_sequence_dim}")
+            self.keep_sequence_dim = keep_sequence_dim
+            self.add_state("hits", [], "cat")
+            self.add_state("misses", [], "cat")
+            self.add_state("false_alarms", [], "cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        if self.keep_sequence_dim is not None and self.keep_sequence_dim != 0:
+            preds = jnp.moveaxis(preds, self.keep_sequence_dim, 0)
+            target = jnp.moveaxis(target, self.keep_sequence_dim, 0)
+        hits, misses, false_alarms = _critical_success_index_update(
+            preds, target, self.threshold, self.keep_sequence_dim is not None
+        )
+        if self.keep_sequence_dim is None:
+            self.hits = self.hits + hits
+            self.misses = self.misses + misses
+            self.false_alarms = self.false_alarms + false_alarms
+        else:
+            self.hits.append(hits)
+            self.misses.append(misses)
+            self.false_alarms.append(false_alarms)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        hits = dim_zero_cat(self.hits)
+        misses = dim_zero_cat(self.misses)
+        false_alarms = dim_zero_cat(self.false_alarms)
+        return _critical_success_index_compute(hits, misses, false_alarms)
+
+
+class NormalizedRootMeanSquaredError(Metric):
+    """Compute normalized RMSE (reference ``regression/nrmse.py:30``).
+
+    The denominator statistic is itself accumulated streaming-style with a custom
+    per-normalization merge (mean→weighted mean, range→min/max, std→moments, l2→sq-sum).
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, normalization: str = "mean", num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if normalization not in ("mean", "range", "std", "l2"):
+            raise ValueError(
+                f"Argument `normalization` should be either 'mean', 'range', 'std' or 'l2', but got {normalization}"
+            )
+        self.normalization = normalization
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        self.add_state("sum_squared_error", jnp.zeros(shape), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("target_sum", jnp.zeros(shape), "sum")
+        self.add_state("target_squared_sum", jnp.zeros(shape), "sum")
+        self.add_state("min_val", jnp.full(shape, jnp.inf), "min")
+        self.add_state("max_val", jnp.full(shape, -jnp.inf), "max")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + num_obs
+        t = (target.reshape(-1) if self.num_outputs == 1 else target).astype(jnp.float32)
+        self.target_sum = self.target_sum + t.sum(0)
+        self.target_squared_sum = self.target_squared_sum + (t * t).sum(0)
+        self.min_val = jnp.minimum(self.min_val, t.min(0))
+        self.max_val = jnp.maximum(self.max_val, t.max(0))
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        if self.normalization == "mean":
+            denom = self.target_sum / self.total
+        elif self.normalization == "range":
+            denom = self.max_val - self.min_val
+        elif self.normalization == "std":
+            denom = jnp.sqrt(self.target_squared_sum / self.total - (self.target_sum / self.total) ** 2)
+        else:
+            denom = jnp.sqrt(self.target_squared_sum)
+        return _normalized_root_mean_squared_error_compute(self.sum_squared_error, self.total, denom)

@@ -1,0 +1,414 @@
+"""Correlation and variance-decomposition regression metrics.
+
+Covers reference ``regression/pearson.py`` (custom-reduce showcase), ``spearman.py``,
+``kendall.py``, ``concordance.py``, ``r2.py``, ``rse.py``, ``explained_variance.py``,
+``cosine_similarity.py``, ``kl_divergence.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.concordance import _concordance_corrcoef_compute
+from metrics_tpu.functional.regression.cosine_similarity import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+)
+from metrics_tpu.functional.regression.explained_variance import (
+    ALLOWED_MULTIOUTPUT,
+    _explained_variance_compute,
+    _explained_variance_update,
+)
+from metrics_tpu.functional.regression.kendall import _kendall_corrcoef_compute, _kendall_corrcoef_update
+from metrics_tpu.functional.regression.kl_divergence import _kld_compute, _kld_update
+from metrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from metrics_tpu.functional.regression.r2 import (
+    _r2_score_compute,
+    _r2_score_update,
+    _relative_squared_error_compute,
+)
+from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "KLDivergence",
+    "KendallRankCorrCoef",
+    "PearsonCorrCoef",
+    "R2Score",
+    "RelativeSquaredError",
+    "SpearmanCorrCoef",
+]
+
+
+class PearsonCorrCoef(Metric):
+    """Compute Pearson correlation coefficient (reference ``regression/pearson.py:78``).
+
+    States carry streaming mean/var/cov moments with ``dist_reduce_fx=None``; the
+    cross-replica reduction is the pairwise moment merge ``_final_aggregation``
+    (reference ``regression/pearson.py:29-75,139-167``) applied to the gathered stack.
+
+    >>> import jax.numpy as jnp
+    >>> metric = PearsonCorrCoef()
+    >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
+    >>> metric.compute()
+    Array(0.98541, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0")
+        self.num_outputs = num_outputs
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        # custom reduce: gather → pairwise moment fold (exact, not approximate)
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"):
+            self.add_state(name, jnp.zeros(shape), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total,
+            self.num_outputs,
+        )
+
+    def _sync_reduce(self) -> tuple:
+        """Fold possibly-stacked per-replica states into one (used by compute after sync)."""
+        if self.mean_x.ndim > (1 if self.num_outputs > 1 else 0):
+            return _final_aggregation(self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total)
+        return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        _, _, var_x, var_y, corr_xy, n_total = self._sync_reduce()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """Compute concordance correlation coefficient (reference ``regression/concordance.py:25``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = ConcordanceCorrCoef()
+    >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
+    >>> metric.compute()
+    Array(0.97679, dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._sync_reduce()
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total)
+
+
+class SpearmanCorrCoef(Metric):
+    """Compute Spearman rank correlation (reference ``regression/spearman.py:32``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = SpearmanCorrCoef()
+    >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
+    >>> metric.compute()
+    Array(1., dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        preds, target = _spearman_corrcoef_update(
+            preds.astype(jnp.float32), target.astype(jnp.float32), self.num_outputs
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _spearman_corrcoef_compute(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class KendallRankCorrCoef(Metric):
+    """Compute Kendall rank correlation (reference ``regression/kendall.py:31``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = KendallRankCorrCoef()
+    >>> metric.update(jnp.array([2.5, 1.0, 4.0, 7.0]), jnp.array([3.0, -0.5, 2.0, 1.0]))
+    >>> metric.compute()
+    Array(0.3333333, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if variant not in ("a", "b", "c"):
+            raise ValueError(f"Argument `variant` is expected to be one of 'a', 'b', 'c' but got {variant!r}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {type(t_test)}.")
+        if t_test and alternative not in ("two-sided", "less", "greater"):
+            raise ValueError("Argument `alternative` is expected to be one of 'two-sided', 'less' or 'greater'.")
+        self.variant = variant
+        self.t_test = t_test
+        self.alternative = alternative if t_test else None
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        preds, target = _kendall_corrcoef_update(
+            preds.astype(jnp.float32), target.astype(jnp.float32), self.num_outputs
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self):
+        """Compute metric."""
+        from metrics_tpu.functional.regression.kendall import kendall_rank_corrcoef
+
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return kendall_rank_corrcoef(preds, target, self.variant, self.t_test, self.alternative)
+
+
+class R2Score(Metric):
+    """Compute R² score (reference ``regression/r2.py:29``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = R2Score()
+    >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
+    >>> metric.compute()
+    Array(0.9486081, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, adjusted: int = 0, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        if multioutput not in ("raw_values", "uniform_average", "variance_weighted"):
+            raise ValueError(
+                "Invalid input to argument `multioutput`. Choose one of the following:"
+                " ('raw_values', 'uniform_average', 'variance_weighted')"
+            )
+        self.multioutput = multioutput
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        self.add_state("sum_squared_error", jnp.zeros(shape), "sum")
+        self.add_state("sum_error", jnp.zeros(shape), "sum")
+        self.add_state("residual", jnp.zeros(shape), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _r2_score_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+        )
+
+
+class RelativeSquaredError(Metric):
+    """Compute relative squared error (reference ``regression/rse.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        self.add_state("sum_squared_error", jnp.zeros(shape), "sum")
+        self.add_state("sum_error", jnp.zeros(shape), "sum")
+        self.add_state("residual", jnp.zeros(shape), "sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _relative_squared_error_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.squared
+        )
+
+
+class ExplainedVariance(Metric):
+    """Compute explained variance (reference ``regression/explained_variance.py:26``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = ExplainedVariance()
+    >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
+    >>> metric.compute()
+    Array(0.9572, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if multioutput not in ALLOWED_MULTIOUTPUT:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}"
+            )
+        self.multioutput = multioutput
+        self.add_state("sum_error", jnp.zeros(()), "sum")
+        self.add_state("sum_squared_error", jnp.zeros(()), "sum")
+        self.add_state("sum_target", jnp.zeros(()), "sum")
+        self.add_state("sum_squared_target", jnp.zeros(()), "sum")
+        self.add_state("num_obs", jnp.zeros(()), "sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(
+            preds, target
+        )
+        self.num_obs = self.num_obs + num_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _explained_variance_compute(
+            self.num_obs, self.sum_error, self.sum_squared_error, self.sum_target, self.sum_squared_target,
+            self.multioutput,
+        )
+
+
+class CosineSimilarity(Metric):
+    """Compute cosine similarity (reference ``regression/cosine_similarity.py:25``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = CosineSimilarity(reduction='mean')
+    >>> metric.update(jnp.array([[1., 2., 3., 4.]]), jnp.array([[1., 2., 3., 4.]]))
+    >>> metric.compute()
+    Array(1., dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("sum", "mean", "none", None):
+            raise ValueError(f"Expected reduction to be one of ('sum', 'mean', 'none', None) but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        preds, target = _cosine_similarity_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _cosine_similarity_compute(dim_zero_cat(self.preds), dim_zero_cat(self.target), self.reduction)
+
+
+class KLDivergence(Metric):
+    """Compute KL divergence (reference ``regression/kl_divergence.py:27``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = KLDivergence()
+    >>> metric.update(jnp.array([[0.36, 0.48, 0.16]]), jnp.array([[1/3, 1/3, 1/3]]))
+    >>> metric.compute()
+    Array(0.0853, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+        if reduction not in ("mean", "sum", "none", None):
+            raise ValueError(f"Expected argument `reduction` to be one of ('mean', 'sum', 'none', None)")
+        self.reduction = reduction
+        if self.reduction in ("mean", "sum"):
+            self.add_state("measures", jnp.zeros(()), "sum")
+        else:
+            self.add_state("measures", [], "cat")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        """Update state with two probability distributions."""
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction in ("none", None):
+            self.measures.append(measures)
+        else:
+            self.measures = self.measures + measures.sum()
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        if self.reduction in ("none", None):
+            return _kld_compute(dim_zero_cat(self.measures), self.total, self.reduction)
+        value = self.measures
+        return value / self.total if self.reduction == "mean" else value

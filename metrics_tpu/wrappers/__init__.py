@@ -1,0 +1,29 @@
+"""Wrapper metrics (reference ``torchmetrics/wrappers/__init__.py``)."""
+
+from metrics_tpu.wrappers.abstract import WrapperMetric
+from metrics_tpu.wrappers.bootstrapping import BootStrapper
+from metrics_tpu.wrappers.classwise import ClasswiseWrapper
+from metrics_tpu.wrappers.minmax import MinMaxMetric
+from metrics_tpu.wrappers.multioutput import MultioutputWrapper
+from metrics_tpu.wrappers.multitask import MultitaskWrapper
+from metrics_tpu.wrappers.running import Running
+from metrics_tpu.wrappers.tracker import MetricTracker
+from metrics_tpu.wrappers.transformations import (
+    BinaryTargetTransformer,
+    LambdaInputTransformer,
+    MetricInputTransformer,
+)
+
+__all__ = [
+    "BinaryTargetTransformer",
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "LambdaInputTransformer",
+    "MetricInputTransformer",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "Running",
+    "WrapperMetric",
+]

@@ -1,0 +1,20 @@
+"""Abstract wrapper base (reference ``wrappers/abstract.py:19-42``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from metrics_tpu.metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Abstract base class for wrapper metrics.
+
+    Wrapper metrics hold inner metrics whose states they manage explicitly; the
+    wrapper itself registers no states of its own.
+    """
+
+    __jit_ineligible__ = True  # wrappers delegate to child metrics with external state
+
+    def _wrap_update_children(self) -> None:  # parity hook, unused
+        pass

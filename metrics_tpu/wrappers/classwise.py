@@ -1,0 +1,80 @@
+"""ClasswiseWrapper (reference ``wrappers/classwise.py:32-236``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class ClasswiseWrapper(WrapperMetric):
+    """Split a per-class tensor output into a labeled dict (reference ``classwise.py:32``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.classification import MulticlassAccuracy
+    >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+    >>> metric.update(jnp.array([2, 1, 0, 1]), jnp.array([2, 1, 0, 0]))
+    >>> sorted(metric.compute())
+    ['multiclassaccuracy_0', 'multiclassaccuracy_1', 'multiclassaccuracy_2']
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        labels: Optional[List[str]] = None,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        if prefix is not None and not isinstance(prefix, str):
+            raise ValueError(f"Expected argument `prefix` to either be `None` or a string but got {prefix}")
+        if postfix is not None and not isinstance(postfix, str):
+            raise ValueError(f"Expected argument `postfix` to either be `None` or a string but got {postfix}")
+        self.metric = metric
+        self.labels = labels
+        self._prefix = prefix
+        self._postfix = postfix
+        self._update_count = 1
+
+    def _convert_output(self, x: Array) -> Dict[str, Array]:
+        """Convert the per-class output into a labeled dict."""
+        if not self._prefix and not self._postfix:
+            prefix = f"{self.metric.__class__.__name__.lower()}_"
+            postfix = ""
+        else:
+            prefix = self._prefix or ""
+            postfix = self._postfix or ""
+        if self.labels is None:
+            return {f"{prefix}{i}{postfix}": val for i, val in enumerate(x)}
+        return {f"{prefix}{lab}{postfix}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the underlying metric."""
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Compute the underlying metric and split the result."""
+        return self._convert_output(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Forward the underlying metric and split the batch result."""
+        return self._convert_output(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        """Reset the underlying metric."""
+        self.metric.reset()
+
+    @property
+    def metric_state(self):
+        return self.metric.metric_state
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.metric._filter_kwargs(**kwargs)

@@ -1,0 +1,70 @@
+"""MinMaxMetric (reference ``wrappers/minmax.py:30-160``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MinMaxMetric(WrapperMetric):
+    """Track the min and max of a base metric's compute over time (reference ``minmax.py:30``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.classification import BinaryAccuracy
+    >>> metric = MinMaxMetric(BinaryAccuracy())
+    >>> metric.update(jnp.array([1, 0, 1, 1]), jnp.array([1, 0, 1, 0]))
+    >>> sorted(metric.compute())
+    ['max', 'min', 'raw']
+    """
+
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the underlying metric and the running min/max."""
+        self._base_metric.update(*args, **kwargs)
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}")
+        self.max_val = jnp.where(self.max_val < val, jnp.asarray(val, dtype=jnp.float32), self.max_val)
+        self.min_val = jnp.where(self.min_val > val, jnp.asarray(val, dtype=jnp.float32), self.min_val)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Update (once) and return the current raw/min/max values.
+
+        The inherited full-state forward would feed the base metric twice; wrappers
+        own their children's state, so forward is simply update + compute.
+        """
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def compute(self) -> Dict[str, Array]:
+        """Return a dict with raw/min/max values."""
+        return {"raw": self._base_metric.compute(), "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        """Reset the wrapper and the underlying metric."""
+        super().reset()
+        self._base_metric.reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if hasattr(val, "size"):
+            return val.size == 1
+        return False

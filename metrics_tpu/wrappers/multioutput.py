@@ -1,0 +1,108 @@
+"""MultioutputWrapper (reference ``wrappers/multioutput.py:44-203``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Evaluate a metric independently per output dimension (reference ``multioutput.py:44``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.regression import R2Score
+    >>> preds = jnp.array([[0.25, 0.5], [0.5, 1.0], [0.75, 1.5], [1.0, 2.0]])
+    >>> target = jnp.array([[0.25, 0.5], [0.5, 1.0], [0.75, 1.5], [1.0, 2.0]])
+    >>> metric = MultioutputWrapper(R2Score(), num_outputs=2)
+    >>> metric.update(preds, target)
+    >>> metric.compute()
+    Array([1., 1.], dtype=float32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array):
+        """Slice args/kwargs along the output dimension (reference ``multioutput.py:120-139``)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = [
+                jnp.take(arg, jnp.asarray([i]), axis=self.output_dim) if hasattr(arg, "ndim") else arg
+                for arg in args
+            ]
+            selected_kwargs = {
+                k: (jnp.take(v, jnp.asarray([i]), axis=self.output_dim) if hasattr(v, "ndim") else v)
+                for k, v in kwargs.items()
+            }
+            if self.remove_nans:
+                import numpy as np
+
+                arrays = [a for a in selected_args if hasattr(a, "ndim")] + [
+                    v for v in selected_kwargs.values() if hasattr(v, "ndim")
+                ]
+                if arrays:
+                    nan_idxs = np.zeros(arrays[0].shape[0], dtype=bool)
+                    for a in arrays:
+                        nan_idxs |= np.asarray(jnp.isnan(a)).reshape(a.shape[0], -1).any(-1)
+                    if nan_idxs.any():
+                        selected_args = [a[~nan_idxs] if hasattr(a, "ndim") else a for a in selected_args]
+                        selected_kwargs = {
+                            k: (v[~nan_idxs] if hasattr(v, "ndim") else v) for k, v in selected_kwargs.items()
+                        }
+            if self.squeeze_outputs:
+                selected_args = [
+                    jnp.squeeze(a, axis=self.output_dim) if hasattr(a, "ndim") else a for a in selected_args
+                ]
+                selected_kwargs = {
+                    k: (jnp.squeeze(v, axis=self.output_dim) if hasattr(v, "ndim") else v)
+                    for k, v in selected_kwargs.items()
+                }
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each output's metric."""
+        for (selected_args, selected_kwargs), metric in zip(
+            self._get_args_kwargs_by_output(*args, **kwargs), self.metrics
+        ):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        """Stack per-output computes."""
+        return jnp.stack([m.compute() for m in self.metrics], 0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Array:
+        """Forward each output's metric, returning stacked batch values."""
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for (selected_args, selected_kwargs), metric in zip(
+                self._get_args_kwargs_by_output(*args, **kwargs), self.metrics
+            )
+        ]
+        return jnp.stack(results, 0)
+
+    def reset(self) -> None:
+        """Reset all underlying metrics."""
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

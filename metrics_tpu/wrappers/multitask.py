@@ -1,0 +1,115 @@
+"""MultitaskWrapper (reference ``wrappers/multitask.py:31-366``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MultitaskWrapper(WrapperMetric):
+    """Apply different metrics to different tasks from per-task inputs (reference ``multitask.py:31``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.classification import BinaryAccuracy
+    >>> from metrics_tpu.regression import MeanSquaredError
+    >>> metrics = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+    >>> metrics.update(
+    ...     {"cls": jnp.array([0, 1]), "reg": jnp.array([2.5, 5.0])},
+    ...     {"cls": jnp.array([1, 1]), "reg": jnp.array([3.0, 5.0])},
+    ... )
+    >>> sorted(metrics.compute())
+    ['cls', 'reg']
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        task_metrics: Dict[str, Union[Metric, MetricCollection]],
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(task_metrics, dict):
+            raise TypeError(f"Expected argument `task_metrics` to be a dict. Found task_metrics = {task_metrics}")
+        for metric in task_metrics.values():
+            if not isinstance(metric, (Metric, MetricCollection)):
+                raise TypeError(
+                    "Expected each task's metric to be a Metric or a MetricCollection. "
+                    f"Found a metric of type {type(metric)}"
+                )
+        self.task_metrics = task_metrics
+        if prefix is not None and not isinstance(prefix, str):
+            raise ValueError(f"Expected argument `prefix` to either be `None` or a string but got {prefix}")
+        if postfix is not None and not isinstance(postfix, str):
+            raise ValueError(f"Expected argument `postfix` to either be `None` or a string but got {postfix}")
+        self._prefix = prefix or ""
+        self._postfix = postfix or ""
+
+    def items(self, flatten: bool = True):
+        """Iterate over task names and metrics."""
+        for task_name, metric in self.task_metrics.items():
+            if flatten and isinstance(metric, MetricCollection):
+                for sub_name, sub_metric in metric.items():
+                    yield f"{task_name}_{sub_name}", sub_metric
+            else:
+                yield task_name, metric
+
+    def keys(self, flatten: bool = True):
+        """Iterate over task names."""
+        for name, _ in self.items(flatten=flatten):
+            yield name
+
+    def values(self, flatten: bool = True):
+        """Iterate over metrics."""
+        for _, metric in self.items(flatten=flatten):
+            yield metric
+
+    def update(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> None:
+        """Update each task's metric from its inputs."""
+        if not self.task_metrics.keys() == task_preds.keys() == task_targets.keys():
+            raise ValueError(
+                "Expected arguments `task_preds` and `task_targets` to have the same keys as the wrapped `task_metrics`."
+                f" Found task_preds.keys() = {task_preds.keys()}, task_targets.keys() = {task_targets.keys()} "
+                f"and self.task_metrics.keys() = {self.task_metrics.keys()}"
+            )
+        for task_name, metric in self.task_metrics.items():
+            metric.update(task_preds[task_name], task_targets[task_name])
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute each task's metric."""
+        return {f"{self._prefix}{n}{self._postfix}": m.compute() for n, m in self.task_metrics.items()}
+
+    def forward(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> Dict[str, Any]:
+        """Forward each task's metric."""
+        return {
+            f"{self._prefix}{n}{self._postfix}": m(task_preds[n], task_targets[n])
+            for n, m in self.task_metrics.items()
+        }
+
+    def reset(self) -> None:
+        """Reset all task metrics."""
+        for metric in self.task_metrics.values():
+            metric.reset()
+        super().reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
+        """Make a copy, optionally changing prefix/postfix."""
+        from copy import deepcopy
+
+        mt = deepcopy(self)
+        if prefix is not None:
+            mt._prefix = self._check_str(prefix, "prefix")
+        if postfix is not None:
+            mt._postfix = self._check_str(postfix, "postfix")
+        return mt
+
+    @staticmethod
+    def _check_str(arg: str, name: str) -> str:
+        if not isinstance(arg, str):
+            raise ValueError(f"Expected argument `{name}` to be a string but got {arg}")
+        return arg

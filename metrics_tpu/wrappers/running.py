@@ -1,0 +1,76 @@
+"""Running-window wrapper (reference ``wrappers/running.py:28-183``).
+
+The reference keeps ``window`` duplicated state copies ``_states_i`` inside the base
+metric. Here the window is a deque of per-batch state pytrees (immutable arrays, so
+the deque is cheap); the global view is a pure merge-fold of the window — no state
+duplication machinery.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class Running(WrapperMetric):
+    """Running view over the last ``window`` updates of a base metric (reference ``running.py:28``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.aggregation import SumMetric
+    >>> metric = Running(SumMetric(), window=2)
+    >>> for i in range(5):
+    ...     _ = metric.update(jnp.asarray(float(i)))
+    >>> metric.compute()  # 3 + 4
+    Array(7., dtype=float32)
+    """
+
+    def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update or base_metric.full_state_update is None:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._window_states: deque = deque(maxlen=window)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update: push this batch's state onto the window."""
+        fns = self.base_metric.functional()
+        batch_state = fns.update(fns.init(), *args, **kwargs)
+        self._window_states.append(batch_state)
+        self._apply_window()
+
+    def _apply_window(self) -> None:
+        fns = self.base_metric.functional()
+        states = list(self._window_states)
+        merged = states[0]
+        for st in states[1:]:
+            merged = fns.merge(merged, st)
+        self.base_metric.__dict__["_state"].update(merged)
+        self.base_metric._update_count = len(states)
+        self.base_metric._computed = None
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Update the window and return the windowed value."""
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def compute(self) -> Any:
+        """Compute over the current window."""
+        return self.base_metric.compute()
+
+    def reset(self) -> None:
+        """Clear the window and the base metric."""
+        super().reset()
+        self.base_metric.reset()
+        self._window_states.clear()

@@ -1,0 +1,131 @@
+"""MetricTracker (reference ``wrappers/tracker.py:32-343``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MetricTracker(WrapperMetric):
+    """Track a metric (or collection) over a sequence of epochs (reference ``tracker.py:32``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.classification import MulticlassAccuracy
+    >>> tracker = MetricTracker(MulticlassAccuracy(num_classes=3, average='micro'))
+    >>> for epoch in range(3):
+    ...     tracker.increment()
+    ...     tracker.update(jnp.array([0, 1, 2, 2]), jnp.array([0, 1, 2, epoch % 3]))
+    >>> best, which = tracker.best_metric(return_step=True)
+    >>> bool(best >= 0.75)
+    True
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        super().__init__()
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a Metric or MetricCollection but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and not all(isinstance(m, bool) for m in maximize):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        if isinstance(metric, Metric) and not isinstance(maximize, bool):
+            raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        self.maximize = maximize
+        self._history: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of steps tracked so far."""
+        return len(self._history)
+
+    def increment(self) -> None:
+        """Create a fresh copy of the base metric for a new step (reference ``tracker.py:103``)."""
+        self._increment_called = True
+        self._history.append(deepcopy(self._base_metric))
+        self._history[-1].reset()
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the current step's metric."""
+        self._check_for_increment("update")
+        self._history[-1].update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward the current step's metric."""
+        self._check_for_increment("forward")
+        return self._history[-1](*args, **kwargs)
+
+    def compute(self) -> Any:
+        """Compute the current step's metric."""
+        self._check_for_increment("compute")
+        return self._history[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Compute all tracked steps (reference ``tracker.py:146``)."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._history]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([r[k] for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[Array, Tuple[Array, int], Dict, Tuple[Dict, Dict]]:
+        """Return the best value seen (and optionally the step it occurred) (reference ``tracker.py:181``)."""
+        res = self.compute_all()
+
+        def _best_1d(v: np.ndarray, maximize: bool):
+            if v.ndim != 1:
+                raise ValueError("per-step values are not scalar")
+            if np.isnan(v).any():
+                raise ValueError("nan values present")
+            best = int(np.argmax(v)) if maximize else int(np.argmin(v))
+            return v[best], best
+
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    value[k], idx[k] = _best_1d(np.asarray(v), maximize[i])
+                except ValueError:
+                    rank_zero_warn(
+                        f"Encountered nan values or non-scalar output for metric {k}; returning None for it."
+                    )
+                    value[k], idx[k] = None, None
+            return (value, idx) if return_step else value
+        try:
+            best_val, best_idx = _best_1d(np.asarray(res), bool(self.maximize))
+        except ValueError:
+            rank_zero_warn("Encountered nan values or non-scalar output in best_metric; returning None.")
+            return (None, None) if return_step else None
+        return (best_val, best_idx) if return_step else best_val
+
+    def reset(self) -> None:
+        """Reset the current step's metric."""
+        if self._history:
+            self._history[-1].reset()
+
+    def reset_all(self) -> None:
+        """Reset all steps."""
+        for metric in self._history:
+            metric.reset()
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")

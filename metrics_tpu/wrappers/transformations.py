@@ -1,0 +1,103 @@
+"""Input-transformation wrappers (reference ``wrappers/transformations.py:23-175``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MetricInputTransformer(WrapperMetric):
+    """Base class: transform inputs before passing to the wrapped metric (reference ``transformations.py:23``)."""
+
+    def __init__(self, wrapped_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(wrapped_metric, Metric):
+            raise TypeError(f"Expected wrapped metric to be an instance of `metrics_tpu.Metric` but received"
+                            f" {wrapped_metric}")
+        self.wrapped_metric = wrapped_metric
+
+    def transform_pred(self, pred: Array) -> Array:
+        """Identity by default; override to transform predictions."""
+        return pred
+
+    def transform_target(self, target: Array) -> Array:
+        """Identity by default; override to transform targets."""
+        return target
+
+    def update(self, pred: Array, target: Array, **kwargs: Any) -> None:
+        """Transform then update the wrapped metric."""
+        self.wrapped_metric.update(self.transform_pred(pred), self.transform_target(target), **kwargs)
+
+    def compute(self) -> Any:
+        """Compute the wrapped metric."""
+        return self.wrapped_metric.compute()
+
+    def forward(self, pred: Array, target: Array, **kwargs: Any) -> Any:
+        """Transform then forward the wrapped metric."""
+        return self.wrapped_metric(self.transform_pred(pred), self.transform_target(target), **kwargs)
+
+    def reset(self) -> None:
+        """Reset the wrapped metric."""
+        self.wrapped_metric.reset()
+
+
+class LambdaInputTransformer(MetricInputTransformer):
+    """Apply user lambdas to predictions/targets (reference ``transformations.py:79``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.classification import BinaryAccuracy
+    >>> metric = LambdaInputTransformer(BinaryAccuracy(), transform_pred=lambda p: 1 - p)
+    >>> metric.update(jnp.array([0.1, 0.9]), jnp.array([1, 0]))
+    >>> metric.compute()
+    Array(1., dtype=float32)
+    """
+
+    def __init__(
+        self,
+        wrapped_metric: Metric,
+        transform_pred: Optional[Callable] = None,
+        transform_target: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        if transform_pred is not None and not callable(transform_pred):
+            raise TypeError(f"Expected `transform_pred` to be callable, but received {transform_pred}")
+        if transform_target is not None and not callable(transform_target):
+            raise TypeError(f"Expected `transform_target` to be callable, but received {transform_target}")
+        super().__init__(wrapped_metric, **kwargs)
+        self._transform_pred_fn = transform_pred
+        self._transform_target_fn = transform_target
+
+    def transform_pred(self, pred: Array) -> Array:
+        """Apply the prediction lambda."""
+        return self._transform_pred_fn(pred) if self._transform_pred_fn is not None else pred
+
+    def transform_target(self, target: Array) -> Array:
+        """Apply the target lambda."""
+        return self._transform_target_fn(target) if self._transform_target_fn is not None else target
+
+
+class BinaryTargetTransformer(MetricInputTransformer):
+    """Binarize targets at a threshold (reference ``transformations.py:132``).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.classification import BinaryAccuracy
+    >>> metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=2.0)
+    >>> metric.update(jnp.array([1, 0]), jnp.array([3.0, 1.0]))
+    >>> metric.compute()
+    Array(1., dtype=float32)
+    """
+
+    def __init__(self, wrapped_metric: Metric, threshold: float = 0.0, **kwargs: Any) -> None:
+        if not isinstance(threshold, (int, float)):
+            raise TypeError(f"Expected `threshold` to be a float, but received {threshold}")
+        super().__init__(wrapped_metric, **kwargs)
+        self.threshold = threshold
+
+    def transform_target(self, target: Array) -> Array:
+        """Binarize the target."""
+        return (target > self.threshold).astype(jnp.int32)

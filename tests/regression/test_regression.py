@@ -1,0 +1,225 @@
+"""Regression metrics vs sklearn/scipy golden references."""
+
+import numpy as np
+import pytest
+from scipy import stats
+from sklearn import metrics as sk
+
+from metrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    CriticalSuccessIndex,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    NormalizedRootMeanSquaredError,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from tests.helpers import run_class_test
+
+_rng = np.random.RandomState(123)
+preds = _rng.randn(4, 32).astype(np.float32)
+target = (preds + 0.5 * _rng.randn(4, 32)).astype(np.float32)
+preds_pos = np.abs(preds) + 0.1
+target_pos = np.abs(target) + 0.1
+
+
+def _flat(fn):
+    return lambda p, t: fn(t.reshape(-1), p.reshape(-1))
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "args", "ref"),
+    [
+        (MeanSquaredError, {}, _flat(sk.mean_squared_error)),
+        (MeanSquaredError, {"squared": False}, lambda p, t: np.sqrt(sk.mean_squared_error(t.reshape(-1), p.reshape(-1)))),
+        (MeanAbsoluteError, {}, _flat(sk.mean_absolute_error)),
+        (MeanAbsolutePercentageError, {}, _flat(sk.mean_absolute_percentage_error)),
+        (R2Score, {}, _flat(sk.r2_score)),
+        (ExplainedVariance, {}, _flat(sk.explained_variance_score)),
+    ],
+)
+def test_basic_vs_sklearn(metric_cls, args, ref):
+    run_class_test(metric_cls, args, preds, target, ref)
+
+
+def test_msle_vs_sklearn():
+    run_class_test(
+        MeanSquaredLogError, {}, preds_pos, target_pos,
+        lambda p, t: sk.mean_squared_log_error(t.reshape(-1), p.reshape(-1)),
+    )
+
+
+def test_smape_and_wmape():
+    def smape_ref(p, t):
+        p, t = p.reshape(-1), t.reshape(-1)
+        return np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+
+    run_class_test(SymmetricMeanAbsolutePercentageError, {}, preds_pos, target_pos, smape_ref)
+
+    def wmape_ref(p, t):
+        p, t = p.reshape(-1), t.reshape(-1)
+        return np.abs(p - t).sum() / np.abs(t).sum()
+
+    run_class_test(WeightedMeanAbsolutePercentageError, {}, preds, target, wmape_ref)
+
+
+def test_log_cosh():
+    run_class_test(
+        LogCoshError, {}, preds, target,
+        lambda p, t: np.mean(np.log(np.cosh(np.clip(p.reshape(-1) - t.reshape(-1), -50, 50)))),
+    )
+
+
+def test_minkowski():
+    run_class_test(
+        MinkowskiDistance, {"p": 3.0}, preds, target,
+        lambda p, t: (np.abs(p.reshape(-1) - t.reshape(-1)) ** 3).sum() ** (1 / 3),
+        atol=1e-3, check_forward=False,
+    )
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 2.0, 1.5])
+def test_tweedie_vs_sklearn(power):
+    run_class_test(
+        TweedieDevianceScore, {"power": power}, preds_pos, target_pos,
+        lambda p, t: sk.mean_tweedie_deviance(t.reshape(-1), p.reshape(-1), power=power),
+        atol=1e-4,
+    )
+
+
+def test_pearson_vs_scipy():
+    run_class_test(
+        PearsonCorrCoef, {}, preds, target,
+        lambda p, t: stats.pearsonr(p.reshape(-1), t.reshape(-1))[0],
+        check_forward=False,  # full_state_update metric: batch value uses batch-only stats anyway
+    )
+
+
+def test_pearson_merge_across_replicas_exact():
+    """The custom pairwise moment merge must equal single-stream statistics exactly."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.regression.pearson import _final_aggregation
+
+    ms = [PearsonCorrCoef() for _ in range(4)]
+    for m, p, t in zip(ms, preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    stacked = [jnp.stack([m.metric_state[k] for m in ms]) for k in
+               ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total")]
+    _, _, var_x, var_y, corr_xy, n = _final_aggregation(*stacked)
+    from metrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute
+
+    merged = float(_pearson_corrcoef_compute(var_x, var_y, corr_xy, n))
+    ref = stats.pearsonr(preds.reshape(-1), target.reshape(-1))[0]
+    np.testing.assert_allclose(merged, ref, atol=1e-5)
+
+
+def test_concordance():
+    def ccc_ref(p, t):
+        p, t = p.reshape(-1), t.reshape(-1)
+        cor = np.corrcoef(p, t)[0, 1]
+        sp, st = p.std(), t.std()
+        return 2 * cor * sp * st / (sp**2 + st**2 + (p.mean() - t.mean()) ** 2)
+
+    run_class_test(ConcordanceCorrCoef, {}, preds, target, ccc_ref, check_forward=False, atol=1e-4)
+
+
+def test_spearman_vs_scipy():
+    run_class_test(
+        SpearmanCorrCoef, {}, preds, target,
+        lambda p, t: stats.spearmanr(p.reshape(-1), t.reshape(-1))[0],
+        atol=1e-4,
+    )
+
+
+def test_spearman_with_ties():
+    import jax.numpy as jnp
+
+    p = np.array([1.0, 2.0, 2.0, 3.0, 3.0, 3.0, 4.0], dtype=np.float32)
+    t = np.array([1.0, 3.0, 2.0, 4.0, 4.0, 5.0, 6.0], dtype=np.float32)
+    m = SpearmanCorrCoef()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(m.compute()), stats.spearmanr(p, t)[0], atol=1e-4)
+
+
+@pytest.mark.parametrize("variant", ["a", "b", "c"])
+def test_kendall_vs_scipy(variant):
+    scipy_variant = {"a": "b", "b": "b", "c": "c"}[variant]  # scipy has no tau-a; random floats have no ties
+    run_class_test(
+        KendallRankCorrCoef, {"variant": variant}, preds, target,
+        lambda p, t: stats.kendalltau(p.reshape(-1), t.reshape(-1), variant=scipy_variant)[0],
+        atol=1e-4 if variant != "c" else 0.02,
+    )
+
+
+def test_cosine_similarity():
+    p2 = preds.reshape(4, 8, 4)
+    t2 = target.reshape(4, 8, 4)
+
+    def ref(p, t):
+        p = p.reshape(-1, 4)
+        t = t.reshape(-1, 4)
+        sims = (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+        return sims.mean()
+
+    run_class_test(CosineSimilarity, {"reduction": "mean"}, p2, t2, ref)
+
+
+def test_kl_divergence():
+    p = np.abs(_rng.randn(4, 16, 8)).astype(np.float32) + 0.1
+    q = np.abs(_rng.randn(4, 16, 8)).astype(np.float32) + 0.1
+    p = p / p.sum(-1, keepdims=True)
+    q = q / q.sum(-1, keepdims=True)
+
+    def ref(pp, qq):
+        pp = pp.reshape(-1, 8)
+        qq = qq.reshape(-1, 8)
+        return np.mean([stats.entropy(a, b) for a, b in zip(pp, qq)])
+
+    run_class_test(KLDivergence, {}, p, q, ref)
+
+
+def test_relative_squared_error():
+    def ref(p, t):
+        p, t = p.reshape(-1), t.reshape(-1)
+        return ((t - p) ** 2).sum() / ((t - t.mean()) ** 2).sum()
+
+    run_class_test(RelativeSquaredError, {}, preds, target, ref, check_forward=False)
+
+
+def test_csi():
+    def ref(p, t):
+        pb, tb = p.reshape(-1) >= 0.0, t.reshape(-1) >= 0.0
+        return (pb & tb).sum() / ((pb & tb).sum() + (~pb & tb).sum() + (pb & ~tb).sum())
+
+    run_class_test(CriticalSuccessIndex, {"threshold": 0.0}, preds, target, ref)
+
+
+@pytest.mark.parametrize("normalization", ["mean", "range", "std", "l2"])
+def test_nrmse(normalization):
+    def ref(p, t):
+        p, t = p.reshape(-1), t.reshape(-1)
+        rmse = np.sqrt(np.mean((p - t) ** 2))
+        denom = {
+            "mean": t.mean(),
+            "range": t.max() - t.min(),
+            "std": t.std(),
+            "l2": np.linalg.norm(t),
+        }[normalization]
+        return rmse / denom
+
+    run_class_test(NormalizedRootMeanSquaredError, {"normalization": normalization}, preds, target, ref,
+                   check_forward=normalization in ("l2",), atol=1e-4)

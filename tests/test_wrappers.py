@@ -1,0 +1,135 @@
+"""Wrapper metric tests — reference ``tests/unittests/wrappers/`` analog."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import RunningMean, RunningSum, SumMetric
+from metrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.regression import MeanSquaredError, R2Score
+from metrics_tpu.wrappers import (
+    BinaryTargetTransformer,
+    BootStrapper,
+    ClasswiseWrapper,
+    LambdaInputTransformer,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+
+_rng = np.random.RandomState(11)
+
+
+def test_classwise_wrapper():
+    m = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["a", "b", "c"])
+    m.update(jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 2, 0]))
+    res = m.compute()
+    assert set(res) == {"multiclassaccuracy_a", "multiclassaccuracy_b", "multiclassaccuracy_c"}
+    np.testing.assert_allclose(float(res["multiclassaccuracy_b"]), 1.0)
+
+
+def test_minmax_metric():
+    m = MinMaxMetric(BinaryAccuracy())
+    m.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))  # acc 1.0
+    m.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))  # acc drops to 0.5
+    res = m.compute()
+    assert float(res["max"]) == 1.0
+    assert float(res["min"]) == 0.5
+    assert float(res["raw"]) == 0.5
+
+
+def test_multioutput_wrapper_matches_per_output():
+    preds = _rng.randn(64, 2).astype(np.float32)
+    target = (preds + 0.3 * _rng.randn(64, 2)).astype(np.float32)
+    m = MultioutputWrapper(R2Score(), num_outputs=2)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    res = np.asarray(m.compute())
+    for i in range(2):
+        single = R2Score()
+        single.update(jnp.asarray(preds[:, i]), jnp.asarray(target[:, i]))
+        np.testing.assert_allclose(res[i], float(single.compute()), rtol=1e-5)
+
+
+def test_multitask_wrapper():
+    mt = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+    mt.update(
+        {"cls": jnp.asarray([0, 1, 1]), "reg": jnp.asarray([1.0, 2.0, 3.0])},
+        {"cls": jnp.asarray([1, 1, 1]), "reg": jnp.asarray([1.0, 2.0, 2.0])},
+    )
+    res = mt.compute()
+    np.testing.assert_allclose(float(res["cls"]), 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(float(res["reg"]), 1 / 3, rtol=1e-6)
+
+
+def test_running_window():
+    m = Running(SumMetric(), window=3)
+    for i in range(10):
+        m.update(float(i))
+    assert float(m.compute()) == 7 + 8 + 9
+
+
+def test_running_aggregators():
+    rm = RunningMean(window=2)
+    rs = RunningSum(window=2)
+    for i in range(5):
+        rm.update(float(i))
+        rs.update(float(i))
+    assert float(rm.compute()) == 3.5
+    assert float(rs.compute()) == 7.0
+
+
+def test_tracker_best_metric():
+    tracker = MetricTracker(BinaryAccuracy(), maximize=True)
+    accs = []
+    for epoch in range(3):
+        tracker.increment()
+        preds = jnp.asarray([1, 1, 1, 1])
+        target = jnp.asarray([1] * (epoch + 2) + [0] * (2 - epoch))
+        tracker.update(preds, target)
+        accs.append(float(tracker.compute()))
+    best, step = tracker.best_metric(return_step=True)
+    assert step == int(np.argmax(accs))
+    np.testing.assert_allclose(best, max(accs))
+    all_vals = np.asarray(tracker.compute_all())
+    np.testing.assert_allclose(all_vals, accs)
+
+
+def test_tracker_with_collection():
+    col = MetricCollection({"acc": BinaryAccuracy()})
+    tracker = MetricTracker(col, maximize=[True])
+    tracker.increment()
+    tracker.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+    best = tracker.best_metric()
+    assert "acc" in best
+
+
+def test_tracker_raises_before_increment():
+    tracker = MetricTracker(BinaryAccuracy())
+    with pytest.raises(ValueError, match="increment"):
+        tracker.update(jnp.asarray([1]), jnp.asarray([1]))
+
+
+def test_bootstrapper_mean_close_to_point_estimate():
+    np.random.seed(0)
+    preds = _rng.rand(512).astype(np.float32)
+    target = _rng.randint(0, 2, 512)
+    bs = BootStrapper(BinaryAccuracy(), num_bootstraps=20)
+    bs.update(jnp.asarray(preds), jnp.asarray(target))
+    res = bs.compute()
+    point = BinaryAccuracy()
+    point.update(jnp.asarray(preds), jnp.asarray(target))
+    assert abs(float(res["mean"]) - float(point.compute())) < 0.05
+    assert float(res["std"]) < 0.1
+
+
+def test_lambda_and_binary_target_transformers():
+    m = LambdaInputTransformer(BinaryAccuracy(), transform_pred=lambda p: 1 - p)
+    m.update(jnp.asarray([0.1, 0.9]), jnp.asarray([1, 0]))
+    assert float(m.compute()) == 1.0
+
+    bt = BinaryTargetTransformer(BinaryAccuracy(), threshold=2.0)
+    bt.update(jnp.asarray([1, 0]), jnp.asarray([3.0, 1.0]))
+    assert float(bt.compute()) == 1.0
