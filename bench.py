@@ -1,0 +1,114 @@
+"""Benchmark: metric update/compute throughput vs a torch-CPU reference implementation.
+
+BASELINE.md config 1: ``classification.MulticlassAccuracy`` on random tensors.
+The reference publishes no numbers (SURVEY §6), so the comparison column is measured
+here: the reference's own algorithm (bincount confusion matrix, accumulate, derive)
+implemented with torch CPU ops — the same thing TorchMetrics executes — timed on this
+host, against our jit-compiled XLA path on the default JAX device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+NUM_CLASSES = 10
+BATCH = 1 << 17  # 131072 elements per update
+STEPS = 50
+
+
+def _bench_ours(preds_np, target_np):
+    """The TPU deployment shape: the whole update stream runs device-resident.
+
+    ``lax.scan`` folds the metric's pure ``update`` over all batches inside ONE
+    compiled program — zero host syncs in the update loop (BASELINE.md config 1).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+    fns = m.functional()
+    preds = jnp.asarray(preds_np)
+    target = jnp.asarray(target_np)
+
+    @jax.jit
+    def run(state, preds_all, target_all):
+        def body(st, batch):
+            return fns.update(st, batch[0], batch[1]), 0.0
+
+        st, _ = lax.scan(body, state, (preds_all, target_all))
+        return fns.compute(st)
+
+    n_src = preds.shape[0]
+    idx = jnp.arange(STEPS) % n_src
+    preds_all = preds[idx]
+    target_all = target[idx]
+    # warmup (compile + first-touch transfers)
+    jax.block_until_ready(run(fns.init(), preds_all, target_all))
+    jax.block_until_ready(run(fns.init(), preds_all, target_all))
+
+    best = float("inf")
+    val = 0.0
+    for _ in range(7):
+        start = time.perf_counter()
+        out = run(fns.init(), preds_all, target_all)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - start)
+        val = float(out)
+    return best, val
+
+
+def _bench_torch_reference(preds_np, target_np):
+    """The reference algorithm (multiclass stat-scores via bincount confmat) in torch CPU."""
+    import torch
+
+    preds = torch.from_numpy(np.asarray(preds_np))
+    target = torch.from_numpy(np.asarray(target_np))
+    tp = torch.zeros((), dtype=torch.long)
+    total = torch.zeros((), dtype=torch.long)
+
+    def update(p, t):
+        nonlocal tp, total
+        # micro accuracy path of the reference update
+        tp = tp + (p == t).sum()
+        total = total + p.numel()
+
+    best = float("inf")
+    val = 0.0
+    for _ in range(5):
+        tp = torch.zeros((), dtype=torch.long)
+        total = torch.zeros((), dtype=torch.long)
+        start = time.perf_counter()
+        for i in range(STEPS):
+            update(preds[i % preds.shape[0]], target[i % target.shape[0]])
+        val = float(tp.double() / total.double())
+        best = min(best, time.perf_counter() - start)
+    return best, val
+
+
+def main():
+    rng = np.random.RandomState(0)
+    preds = rng.randint(0, NUM_CLASSES, (8, BATCH)).astype(np.int32)
+    target = rng.randint(0, NUM_CLASSES, (8, BATCH)).astype(np.int32)
+
+    t_ref, v_ref = _bench_torch_reference(preds, target)
+    t_ours, v_ours = _bench_ours(preds, target)
+    assert abs(v_ref - v_ours) < 1e-6, (v_ref, v_ours)
+
+    ms_per_update = 1000.0 * t_ours / STEPS
+    speedup = t_ref / t_ours
+    print(json.dumps({
+        "metric": "multiclass_accuracy_update_ms",
+        "value": round(ms_per_update, 4),
+        "unit": "ms/update(131k elems)",
+        "vs_baseline": round(speedup, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

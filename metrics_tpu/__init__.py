@@ -6,7 +6,19 @@ jit-compiled XLA functions, and distributed sync lowers to XLA collectives over 
 ``jax.sharding.Mesh``.
 """
 
-from metrics_tpu import classification, functional, parallel, regression, utils, wrappers
+from metrics_tpu import (
+    classification,
+    clustering,
+    functional,
+    nominal,
+    parallel,
+    regression,
+    retrieval,
+    segmentation,
+    shape,
+    utils,
+    wrappers,
+)
 from metrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -34,9 +46,14 @@ __all__ = [
     "SumMetric",
     "__version__",
     "classification",
+    "clustering",
     "functional",
     "parallel",
+    "nominal",
     "regression",
+    "retrieval",
+    "segmentation",
+    "shape",
     "utils",
     "wrappers",
 ]

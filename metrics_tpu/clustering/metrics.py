@@ -1,0 +1,213 @@
+"""Modular clustering metrics.
+
+Parity with reference ``torchmetrics/clustering/`` (``mutual_info_score.py:78-79``
+list states; contingency computed at the compute boundary — SURVEY §2.8).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+from jax import Array
+
+from metrics_tpu.functional.clustering.extrinsic import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    completeness_score,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from metrics_tpu.functional.clustering.intrinsic import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    dunn_index,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class _LabelClusteringMetric(Metric):
+    """Shared plumbing: list states ``preds``/``target`` of cluster labels."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    preds: List[Array]
+    target: List[Array]
+
+    _compute_fn: Callable
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predicted and target cluster labels."""
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Compute metric over all accumulated labels."""
+        return type(self)._compute_fn(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class MutualInfoScore(_LabelClusteringMetric):
+    """Compute mutual information between clusterings (reference ``clustering/mutual_info_score.py:30``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = MutualInfoScore()
+    >>> metric.update(jnp.array([2, 1, 0, 1, 0]), jnp.array([0, 2, 1, 1, 0]))
+    >>> metric.compute()
+    Array(0.5004, dtype=float32)
+    """
+
+    _compute_fn = staticmethod(mutual_info_score)
+
+
+class RandScore(_LabelClusteringMetric):
+    """Compute the Rand score (reference ``clustering/rand_score.py:30``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = RandScore()
+    >>> metric.update(jnp.array([2, 1, 0, 1, 0]), jnp.array([0, 2, 1, 1, 0]))
+    >>> metric.compute()
+    Array(0.6, dtype=float32)
+    """
+
+    _compute_fn = staticmethod(rand_score)
+
+
+class AdjustedRandScore(_LabelClusteringMetric):
+    """Compute the adjusted Rand score (reference ``clustering/adjusted_rand_score.py:30``)."""
+
+    plot_lower_bound = -1.0
+    _compute_fn = staticmethod(adjusted_rand_score)
+
+
+class FowlkesMallowsIndex(_LabelClusteringMetric):
+    """Compute the Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:30``)."""
+
+    _compute_fn = staticmethod(fowlkes_mallows_index)
+
+
+class HomogeneityScore(_LabelClusteringMetric):
+    """Compute the homogeneity score (reference ``clustering/homogeneity_completeness_v_measure.py``)."""
+
+    _compute_fn = staticmethod(homogeneity_score)
+
+
+class CompletenessScore(_LabelClusteringMetric):
+    """Compute the completeness score (reference ``clustering/homogeneity_completeness_v_measure.py``)."""
+
+    _compute_fn = staticmethod(completeness_score)
+
+
+class VMeasureScore(_LabelClusteringMetric):
+    """Compute the V-measure (reference ``clustering/homogeneity_completeness_v_measure.py``)."""
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, (int, float)) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = float(beta)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return v_measure_score(dim_zero_cat(self.preds), dim_zero_cat(self.target), self.beta)
+
+
+class NormalizedMutualInfoScore(_LabelClusteringMetric):
+    """Compute normalized mutual information (reference ``clustering/normalized_mutual_info_score.py:30``)."""
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if average_method not in ("min", "geometric", "arithmetic", "max"):
+            raise ValueError(f"Expected argument `average_method` to be one of (min, geometric, arithmetic, max),"
+                             f" but got {average_method}")
+        self.average_method = average_method
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return normalized_mutual_info_score(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.average_method
+        )
+
+
+class AdjustedMutualInfoScore(NormalizedMutualInfoScore):
+    """Compute adjusted mutual information (reference ``clustering/adjusted_mutual_info_score.py:30``)."""
+
+    plot_lower_bound = -1.0
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return adjusted_mutual_info_score(dim_zero_cat(self.preds), dim_zero_cat(self.target), self.average_method)
+
+
+class _EmbeddingClusteringMetric(Metric):
+    """Shared plumbing: list states ``data``/``labels``."""
+
+    is_differentiable = True
+    full_state_update = True
+    data: List[Array]
+    labels: List[Array]
+
+    _compute_fn: Callable
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", [], dist_reduce_fx="cat")
+        self.add_state("labels", [], dist_reduce_fx="cat")
+
+    def update(self, data: Array, labels: Array) -> None:
+        """Update state with embeddings and cluster labels."""
+        self.data.append(data)
+        self.labels.append(labels)
+
+    def compute(self) -> Array:
+        """Compute metric over all accumulated embeddings."""
+        return type(self)._compute_fn(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class CalinskiHarabaszScore(_EmbeddingClusteringMetric):
+    """Compute the Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:28``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = CalinskiHarabaszScore()
+    >>> metric.update(jnp.array([[0., 0.], [0., 1.], [10., 10.], [10., 11.]]), jnp.array([0, 0, 1, 1]))
+    >>> metric.compute()
+    Array(404.99994, dtype=float32)
+    """
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    _compute_fn = staticmethod(calinski_harabasz_score)
+
+
+class DaviesBouldinScore(_EmbeddingClusteringMetric):
+    """Compute the Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:28``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    _compute_fn = staticmethod(davies_bouldin_score)
+
+
+class DunnIndex(_EmbeddingClusteringMetric):
+    """Compute the Dunn index (reference ``clustering/dunn_index.py:28``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return dunn_index(dim_zero_cat(self.data), dim_zero_cat(self.labels), self.p)

@@ -78,6 +78,12 @@ class MetricCollection:
             raise ValueError(f"Value for key {key!r} should be a Metric but got {type(value)}")
         self._modules[key] = value
         self._groups_checked = False
+        if isinstance(self._enable_compute_groups, list):
+            if not any(key in group for group in self._groups.values()):
+                self._groups[len(self._groups)] = [key]
+        else:
+            # re-seed singleton groups over ALL current members; they re-merge on next update
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
 
     def __iter__(self):
         return iter(self._modules)
@@ -109,9 +115,15 @@ class MetricCollection:
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
     ) -> None:
         """Add new metrics to the collection (reference ``collections.py:576-648``)."""
+        if isinstance(metrics, str):
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected a Metric, a sequence of Metrics or a dict,"
+                f" but got a string: {metrics!r}"
+            )
         if isinstance(metrics, Metric):
             metrics = [metrics]
-        if isinstance(metrics, Sequence):
+        if isinstance(metrics, Sequence) and not isinstance(metrics, dict):
+            metrics = list(metrics)
             remain: list = []
             for m in additional_metrics:
                 (metrics if isinstance(m, Metric) else remain).append(m)
@@ -211,7 +223,8 @@ class MetricCollection:
         else:
             for m in self._modules.values():
                 m.update(*args, **m._filter_kwargs(**kwargs))
-            if self._enable_compute_groups:
+            # only auto-detected groups are re-derived; explicit user groups are never merged
+            if self._enable_compute_groups is True:
                 self._merge_compute_groups()
             self._groups_checked = True
 

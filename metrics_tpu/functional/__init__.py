@@ -1,5 +1,35 @@
 """Stateless functional metrics layer (reference ``torchmetrics/functional/__init__.py``)."""
 
-from metrics_tpu.functional import classification
+from metrics_tpu.functional import (
+    classification,
+    clustering,
+    nominal,
+    pairwise,
+    regression,
+    retrieval,
+    segmentation,
+    shape,
+)
+from metrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
 
-__all__ = ["classification"]
+__all__ = [
+    "classification",
+    "clustering",
+    "nominal",
+    "pairwise",
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+    "regression",
+    "retrieval",
+    "segmentation",
+    "shape",
+]

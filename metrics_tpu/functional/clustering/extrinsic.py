@@ -1,0 +1,229 @@
+"""Label-comparison clustering metrics via the contingency matrix.
+
+Parity with reference ``torchmetrics/functional/clustering/``:
+``mutual_info_score.py``, ``adjusted_mutual_info_score.py``,
+``normalized_mutual_info_score.py``, ``rand_score.py``, ``adjusted_rand_score.py``,
+``fowlkes_mallows_index.py``, ``homogeneity_completeness_v_measure.py``.
+
+The contingency matrix is ONE scatter-add (``bincount`` of paired labels,
+reference ``utils.py`` ``calculate_contingency_matrix``); everything else is
+closed-form jnp over it. AMI's expected-MI uses log-gamma sums instead of the
+reference's scipy hypergeometric helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import bincount
+
+
+def _compact_labels(preds: Array, target: Array) -> Tuple[Array, Array, int, int]:
+    """Map labels to 0..K-1 (host-side; label vocabularies are data-dependent)."""
+    import numpy as np
+
+    p = np.asarray(preds).reshape(-1)
+    t = np.asarray(target).reshape(-1)
+    pu, pc = np.unique(p, return_inverse=True)
+    tu, tc = np.unique(t, return_inverse=True)
+    return jnp.asarray(pc), jnp.asarray(tc), len(pu), len(tu)
+
+
+def calculate_contingency_matrix(preds: Array, target: Array) -> Array:
+    """Contingency matrix between two clusterings (reference ``clustering/utils.py``)."""
+    _check_same_shape(preds, target)
+    pc, tc, np_, nt = _compact_labels(preds, target)
+    idx = tc * np_ + pc
+    return bincount(idx, nt * np_).reshape(nt, np_).astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+
+
+def _entropy(counts: Array) -> Array:
+    n = counts.sum()
+    p = counts / n
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
+
+
+def _mutual_info_from_contingency(c: Array) -> Array:
+    n = c.sum()
+    pi = c.sum(axis=1)
+    pj = c.sum(axis=0)
+    outer = pi[:, None] * pj[None, :]
+    nz = c > 0
+    return jnp.sum(jnp.where(nz, (c / n) * (jnp.log(jnp.where(nz, c, 1.0)) - jnp.log(n)
+                                            - jnp.log(jnp.where(nz, outer, 1.0)) + 2 * jnp.log(n)), 0.0))
+
+
+def mutual_info_score(preds: Array, target: Array) -> Array:
+    """Compute mutual information between two clusterings (reference ``mutual_info_score.py``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 2, 1, 1, 0])
+    >>> preds = jnp.array([2, 1, 0, 1, 0])
+    >>> mutual_info_score(preds, target)
+    Array(0.5004, dtype=float32)
+    """
+    c = calculate_contingency_matrix(preds, target)
+    return _mutual_info_from_contingency(c)
+
+
+def rand_score(preds: Array, target: Array) -> Array:
+    """Compute the Rand score (reference ``rand_score.py``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 2, 1, 1, 0])
+    >>> preds = jnp.array([2, 1, 0, 1, 0])
+    >>> rand_score(preds, target)
+    Array(0.6, dtype=float32)
+    """
+    c = calculate_contingency_matrix(preds, target)
+    n = c.sum()
+    sum_sq = jnp.sum(c**2)
+    sum_rows_sq = jnp.sum(c.sum(axis=1) ** 2)
+    sum_cols_sq = jnp.sum(c.sum(axis=0) ** 2)
+    # pairs agreeing: same-same (ΣC(nij,2)) + diff-diff
+    agree = (n * n - n - sum_rows_sq - sum_cols_sq + 2 * sum_sq) / 2
+    total = n * (n - 1) / 2
+    return agree / total
+
+
+def adjusted_rand_score(preds: Array, target: Array) -> Array:
+    """Compute the adjusted Rand score (reference ``adjusted_rand_score.py``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> preds = jnp.array([0, 0, 1, 1])
+    >>> adjusted_rand_score(preds, target)
+    Array(1., dtype=float32)
+    """
+    c = calculate_contingency_matrix(preds, target)
+    n = c.sum()
+
+    def comb2(x):
+        return x * (x - 1) / 2.0
+
+    sum_comb = jnp.sum(comb2(c))
+    sum_a = jnp.sum(comb2(c.sum(axis=1)))
+    sum_b = jnp.sum(comb2(c.sum(axis=0)))
+    expected = sum_a * sum_b / comb2(n)
+    max_index = (sum_a + sum_b) / 2.0
+    denom = max_index - expected
+    return jnp.where(denom != 0, (sum_comb - expected) / jnp.where(denom != 0, denom, 1.0), 1.0)
+
+
+def fowlkes_mallows_index(preds: Array, target: Array) -> Array:
+    """Compute the Fowlkes-Mallows index (reference ``fowlkes_mallows_index.py``)."""
+    c = calculate_contingency_matrix(preds, target)
+    n = c.sum()
+    tk = jnp.sum(c**2) - n
+    pk = jnp.sum(c.sum(axis=0) ** 2) - n
+    qk = jnp.sum(c.sum(axis=1) ** 2) - n
+    return jnp.where((pk > 0) & (qk > 0), jnp.sqrt(tk / jnp.maximum(pk, 1)) * jnp.sqrt(tk / jnp.maximum(qk, 1)), 0.0)
+
+
+def _homogeneity_completeness(preds: Array, target: Array) -> Tuple[Array, Array]:
+    c = calculate_contingency_matrix(preds, target)
+    mi = _mutual_info_from_contingency(c)
+    h_target = _entropy(c.sum(axis=1))
+    h_preds = _entropy(c.sum(axis=0))
+    homogeneity = jnp.where(h_target > 0, mi / jnp.maximum(h_target, 1e-12), 1.0)
+    completeness = jnp.where(h_preds > 0, mi / jnp.maximum(h_preds, 1e-12), 1.0)
+    return homogeneity, completeness
+
+
+def homogeneity_score(preds: Array, target: Array) -> Array:
+    """Compute the homogeneity score (reference ``homogeneity_completeness_v_measure.py``)."""
+    return _homogeneity_completeness(preds, target)[0]
+
+
+def completeness_score(preds: Array, target: Array) -> Array:
+    """Compute the completeness score (reference ``homogeneity_completeness_v_measure.py``)."""
+    return _homogeneity_completeness(preds, target)[1]
+
+
+def v_measure_score(preds: Array, target: Array, beta: float = 1.0) -> Array:
+    """Compute the V-measure (reference ``homogeneity_completeness_v_measure.py``)."""
+    h, c = _homogeneity_completeness(preds, target)
+    denom = beta * h + c
+    return jnp.where(denom > 0, (1 + beta) * h * c / jnp.maximum(denom, 1e-12), 0.0)
+
+
+def normalized_mutual_info_score(preds: Array, target: Array, average_method: str = "arithmetic") -> Array:
+    """Compute normalized mutual information (reference ``normalized_mutual_info_score.py``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> preds = jnp.array([1, 1, 0, 0])
+    >>> normalized_mutual_info_score(preds, target)
+    Array(1., dtype=float32)
+    """
+    c = calculate_contingency_matrix(preds, target)
+    mi = _mutual_info_from_contingency(c)
+    h_t = _entropy(c.sum(axis=1))
+    h_p = _entropy(c.sum(axis=0))
+    norm = _generalized_average(h_t, h_p, average_method)
+    return jnp.where((mi > 1e-12) & (norm > 0), mi / jnp.maximum(norm, 1e-12), jnp.where(mi <= 1e-12, 0.0, 1.0))
+
+
+def _generalized_average(u: Array, v: Array, method: str) -> Array:
+    if method == "min":
+        return jnp.minimum(u, v)
+    if method == "max":
+        return jnp.maximum(u, v)
+    if method == "arithmetic":
+        return (u + v) / 2.0
+    if method == "geometric":
+        return jnp.sqrt(u * v)
+    raise ValueError(f"Expected average method to be one of (min, max, arithmetic, geometric), got {method}")
+
+
+def _expected_mutual_info(c: Array) -> Array:
+    """Expected MI under the permutation model (reference's scipy-based EMI, via log-gamma)."""
+    import numpy as np
+    from scipy.special import gammaln
+
+    c = np.asarray(c, dtype=np.float64)
+    n = c.sum()
+    a = c.sum(axis=1)
+    b = c.sum(axis=0)
+    emi = 0.0
+    for i in range(len(a)):
+        for j in range(len(b)):
+            lo = int(max(1, a[i] + b[j] - n))
+            hi = int(min(a[i], b[j]))
+            for nij in range(lo, hi + 1):
+                term1 = nij / n * np.log(n * nij / (a[i] * b[j]))
+                lg = (
+                    gammaln(a[i] + 1) + gammaln(b[j] + 1) + gammaln(n - a[i] + 1) + gammaln(n - b[j] + 1)
+                    - gammaln(n + 1) - gammaln(nij + 1) - gammaln(a[i] - nij + 1)
+                    - gammaln(b[j] - nij + 1) - gammaln(n - a[i] - b[j] + nij + 1)
+                )
+                emi += term1 * np.exp(lg)
+    return jnp.asarray(emi, dtype=jnp.float32)
+
+
+def adjusted_mutual_info_score(preds: Array, target: Array, average_method: str = "arithmetic") -> Array:
+    """Compute adjusted mutual information (reference ``adjusted_mutual_info_score.py``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([0, 0, 1, 1])
+    >>> preds = jnp.array([1, 1, 0, 0])
+    >>> adjusted_mutual_info_score(preds, target)
+    Array(1., dtype=float32)
+    """
+    c = calculate_contingency_matrix(preds, target)
+    mi = _mutual_info_from_contingency(c)
+    emi = _expected_mutual_info(c)
+    h_t = _entropy(c.sum(axis=1))
+    h_p = _entropy(c.sum(axis=0))
+    norm = _generalized_average(h_t, h_p, average_method)
+    denom = norm - emi
+    import numpy as np
+
+    if abs(float(denom)) < np.finfo(np.float32).eps:
+        denom = jnp.asarray(float(np.finfo(np.float32).eps))
+    return (mi - emi) / denom

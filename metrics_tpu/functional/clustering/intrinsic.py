@@ -1,0 +1,82 @@
+"""Embedding-based clustering metrics.
+
+Parity with reference ``torchmetrics/functional/clustering/``:
+``calinski_harabasz_score.py``, ``davies_bouldin_score.py``, ``dunn_index.py``.
+Centroids and dispersions via segment sums; no per-cluster Python loops except the
+O(K²) centroid-pair reductions (K is small).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _cluster_stats(data: Array, labels: Array):
+    import numpy as np
+
+    lab_np = np.asarray(labels).reshape(-1)
+    uniq, compact = np.unique(lab_np, return_inverse=True)
+    k = len(uniq)
+    g = jnp.asarray(compact)
+    counts = jax.ops.segment_sum(jnp.ones(data.shape[0]), g, k)
+    sums = jax.ops.segment_sum(data, g, k)
+    centroids = sums / counts[:, None]
+    return g, k, counts, centroids
+
+
+def calinski_harabasz_score(data: Array, labels: Array) -> Array:
+    """Compute the Calinski-Harabasz score (reference ``calinski_harabasz_score.py``).
+
+    >>> import jax.numpy as jnp
+    >>> data = jnp.array([[0., 0.], [0., 1.], [10., 10.], [10., 11.]])
+    >>> labels = jnp.array([0, 0, 1, 1])
+    >>> calinski_harabasz_score(data, labels)
+    Array(404.99994, dtype=float32)
+    """
+    data = data.astype(jnp.float32)
+    g, k, counts, centroids = _cluster_stats(data, labels)
+    n = data.shape[0]
+    mean = data.mean(axis=0)
+    between = jnp.sum(counts * jnp.sum((centroids - mean) ** 2, axis=1))
+    within = jnp.sum((data - centroids[g]) ** 2)
+    return jnp.where(within > 0, (between / within) * ((n - k) / max(k - 1, 1)), 1.0)
+
+
+def davies_bouldin_score(data: Array, labels: Array) -> Array:
+    """Compute the Davies-Bouldin score (reference ``davies_bouldin_score.py``).
+
+    >>> import jax.numpy as jnp
+    >>> data = jnp.array([[0., 0.], [0., 1.], [10., 10.], [10., 11.]])
+    >>> labels = jnp.array([0, 0, 1, 1])
+    >>> davies_bouldin_score(data, labels)
+    Array(0.07071068, dtype=float32)
+    """
+    data = data.astype(jnp.float32)
+    g, k, counts, centroids = _cluster_stats(data, labels)
+    intra = jax.ops.segment_sum(jnp.linalg.norm(data - centroids[g], axis=1), g, k) / counts
+    cent_dist = jnp.linalg.norm(centroids[:, None, :] - centroids[None, :, :], axis=-1)
+    ratio = (intra[:, None] + intra[None, :]) / jnp.where(cent_dist > 0, cent_dist, jnp.inf)
+    ratio = jnp.where(jnp.eye(k, dtype=bool), -jnp.inf, ratio)
+    return jnp.mean(jnp.max(ratio, axis=1))
+
+
+def dunn_index(data: Array, labels: Array, p: float = 2.0) -> Array:
+    """Compute the Dunn index (reference ``dunn_index.py``).
+
+    >>> import jax.numpy as jnp
+    >>> data = jnp.array([[0., 0.], [0., 1.], [10., 10.], [10., 11.]])
+    >>> labels = jnp.array([0, 0, 1, 1])
+    >>> dunn_index(data, labels)
+    Array(28.284273, dtype=float32)
+    """
+    data = data.astype(jnp.float32)
+    g, k, counts, centroids = _cluster_stats(data, labels)
+    # inter-cluster: distance between centroids; intra: max point-to-centroid distance
+    # (reference dunn_index.py:41-43)
+    cent_dist = jnp.linalg.norm(centroids[:, None, :] - centroids[None, :, :], ord=p, axis=-1)
+    inter = jnp.min(jnp.where(jnp.eye(k, dtype=bool), jnp.inf, cent_dist))
+    to_centroid = jnp.linalg.norm(data - centroids[g], ord=p, axis=-1)
+    intra = jnp.max(to_centroid)
+    return inter / intra

@@ -1,0 +1,25 @@
+"""Functional nominal-association metrics (reference ``torchmetrics/functional/nominal/__init__.py``)."""
+
+from metrics_tpu.functional.nominal.metrics import (
+    cramers_v,
+    cramers_v_matrix,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    pearsons_contingency_coefficient_matrix,
+    theils_u,
+    theils_u_matrix,
+    tschuprows_t,
+    tschuprows_t_matrix,
+)
+
+__all__ = [
+    "cramers_v",
+    "cramers_v_matrix",
+    "fleiss_kappa",
+    "pearsons_contingency_coefficient",
+    "pearsons_contingency_coefficient_matrix",
+    "theils_u",
+    "theils_u_matrix",
+    "tschuprows_t",
+    "tschuprows_t_matrix",
+]

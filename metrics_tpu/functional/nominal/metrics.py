@@ -1,0 +1,222 @@
+"""Nominal-association kernels.
+
+Parity with reference ``torchmetrics/functional/nominal/``: ``cramers.py``,
+``tschuprows.py``, ``pearson.py``, ``theils_u.py``, ``fleiss_kappa.py`` + the
+pairwise ``*_matrix`` helpers. All are contingency-matrix statistics: one
+scatter-add plus closed-form jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.clustering.extrinsic import calculate_contingency_matrix
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _handle_nan(preds: Array, target: Array, nan_strategy: str, nan_replace_value: Optional[float]):
+    import numpy as np
+
+    p = np.asarray(preds, dtype=np.float64).reshape(-1)
+    t = np.asarray(target, dtype=np.float64).reshape(-1)
+    if nan_strategy == "drop":
+        keep = ~(np.isnan(p) | np.isnan(t))
+        p, t = p[keep], t[keep]
+    else:
+        p = np.nan_to_num(p, nan=nan_replace_value)
+        t = np.nan_to_num(t, nan=nan_replace_value)
+    return jnp.asarray(p), jnp.asarray(t)
+
+
+def _chi2_phi2(confmat: Array) -> Tuple[Array, Array, int, int]:
+    n = confmat.sum()
+    expected = confmat.sum(axis=1, keepdims=True) * confmat.sum(axis=0, keepdims=True) / n
+    nz = expected > 0
+    chi2 = jnp.sum(jnp.where(nz, (confmat - expected) ** 2 / jnp.where(nz, expected, 1.0), 0.0))
+    return chi2, chi2 / n, confmat.shape[0], confmat.shape[1]
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Compute Cramer's V (reference ``nominal/cramers.py:24-113``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.randint(0, 4, (100,)))
+    >>> target = jnp.asarray((np.asarray(preds) + rng.randint(0, 2, (100,))) % 4)
+    >>> round(float(cramers_v(preds, target)), 4)
+    0.5542
+    """
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(preds, target)
+    _, phi2, r, k = _chi2_phi2(confmat)
+    n = confmat.sum()
+    if bias_correction:
+        phi2 = jnp.maximum(phi2 - (r - 1) * (k - 1) / (n - 1), 0.0)
+        r = r - (r - 1) ** 2 / float(n - 1)
+        k = k - (k - 1) ** 2 / float(n - 1)
+        denom = jnp.minimum(jnp.asarray(r - 1), jnp.asarray(k - 1))
+        if float(denom) == 0:
+            rank_zero_warn(
+                "Unable to compute Cramer's V using bias correction. Please consider to set `bias_correction=False`."
+            )
+            return jnp.asarray(jnp.nan)
+    else:
+        denom = min(r - 1, k - 1)
+    return jnp.sqrt(phi2 / denom)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Compute Tschuprow's T (reference ``nominal/tschuprows.py:24-110``)."""
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(preds, target)
+    _, phi2, r, k = _chi2_phi2(confmat)
+    n = confmat.sum()
+    if bias_correction:
+        phi2 = jnp.maximum(phi2 - (r - 1) * (k - 1) / (n - 1), 0.0)
+        rr = r - (r - 1) ** 2 / float(n - 1)
+        kk = k - (k - 1) ** 2 / float(n - 1)
+        denom = jnp.sqrt(jnp.asarray((rr - 1) * (kk - 1)))
+    else:
+        denom = jnp.sqrt(jnp.asarray(float((r - 1) * (k - 1))))
+    return jnp.sqrt(phi2 / denom)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Compute Pearson's contingency coefficient (reference ``nominal/pearson.py:24-104``)."""
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(preds, target)
+    chi2, _, _, _ = _chi2_phi2(confmat)
+    n = confmat.sum()
+    return jnp.sqrt(chi2 / (chi2 + n))
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Compute Theil's U — uncertainty coefficient U(preds|target) (reference ``nominal/theils_u.py:24-108``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.randint(0, 4, (100,)))
+    >>> target = jnp.asarray(rng.randint(0, 4, (100,)))
+    >>> float(theils_u(preds, target)) < 0.2
+    True
+    """
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(preds, target)  # rows=target, cols=preds
+    n = confmat.sum()
+    p_pred = confmat.sum(axis=0) / n  # marginal of preds
+    h_x = -jnp.sum(jnp.where(p_pred > 0, p_pred * jnp.log(jnp.where(p_pred > 0, p_pred, 1.0)), 0.0))
+    p_t = confmat.sum(axis=1, keepdims=True) / n
+    cond = confmat / n
+    # H(X|Y) = -Σ_y Σ_x p(x,y) log(p(x,y)/p(y))
+    nz = cond > 0
+    h_xy = -jnp.sum(jnp.where(nz, cond * (jnp.log(jnp.where(nz, cond, 1.0)) - jnp.log(jnp.broadcast_to(p_t, cond.shape))), 0.0))
+    return jnp.where(h_x > 0, (h_x - h_xy) / jnp.maximum(h_x, 1e-12), 1.0)
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    """Compute Fleiss' kappa for inter-rater agreement (reference ``nominal/fleiss_kappa.py:23-92``).
+
+    ``mode="counts"``: ratings is (n_subjects, n_categories) count matrix;
+    ``mode="probs"``: ratings is (n_raters, n_subjects, n_categories) probabilities,
+    converted to one-hot votes by argmax.
+
+    >>> import jax.numpy as jnp
+    >>> ratings = jnp.array([[0, 0, 14], [0, 2, 12], [0, 6, 8], [0, 12, 2]])
+    >>> round(float(fleiss_kappa(ratings)), 4)
+    0.2269
+    """
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError("If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                             " [n_raters, n_subjects, n_categories] and be floating point")
+        n_raters, n_subjects, n_cat = ratings.shape
+        votes = jnp.argmax(ratings, axis=-1)  # (raters, subjects)
+        onehot = votes[..., None] == jnp.arange(n_cat)
+        ratings = onehot.sum(axis=0).astype(jnp.float32)
+    elif mode == "counts":
+        if ratings.ndim != 2:
+            raise ValueError("If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+                             " [n_subjects, n_categories]")
+        ratings = ratings.astype(jnp.float32)
+    else:
+        raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'")
+
+    n_subjects, _ = ratings.shape
+    n_raters = ratings[0].sum()
+    p_cat = ratings.sum(axis=0) / (n_subjects * n_raters)
+    p_subject = (jnp.sum(ratings * ratings, axis=1) - n_raters) / (n_raters * (n_raters - 1))
+    p_bar = p_subject.mean()
+    pe_bar = jnp.sum(p_cat**2)
+    return (p_bar - pe_bar) / (1 - pe_bar)
+
+
+def _matrix_over_columns(matrix: Array, fn) -> Array:
+    """Apply a pairwise nominal statistic to every column pair (reference ``*_matrix`` helpers)."""
+    num_var = matrix.shape[1]
+    out = jnp.ones((num_var, num_var), dtype=jnp.float32)
+    for i in range(num_var):
+        for j in range(i + 1, num_var):
+            v = fn(matrix[:, i], matrix[:, j])
+            out = out.at[i, j].set(v)
+            out = out.at[j, i].set(v)
+    return out
+
+
+def cramers_v_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace",
+                     nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Cramer's V between all column pairs (reference ``nominal/cramers.py:116-166``)."""
+    return _matrix_over_columns(matrix, lambda a, b: cramers_v(a, b, bias_correction, nan_strategy, nan_replace_value))
+
+
+def tschuprows_t_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace",
+                        nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Tschuprow's T between all column pairs (reference ``nominal/tschuprows.py:113-163``)."""
+    return _matrix_over_columns(
+        matrix, lambda a, b: tschuprows_t(a, b, bias_correction, nan_strategy, nan_replace_value)
+    )
+
+
+def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "replace",
+                                            nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Pearson's contingency coefficient between all column pairs (reference ``nominal/pearson.py:107-155``)."""
+    return _matrix_over_columns(
+        matrix, lambda a, b: pearsons_contingency_coefficient(a, b, nan_strategy, nan_replace_value)
+    )
+
+
+def theils_u_matrix(matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Theil's U between all column pairs (asymmetric; reference ``nominal/theils_u.py:111-160``)."""
+    num_var = matrix.shape[1]
+    out = jnp.ones((num_var, num_var), dtype=jnp.float32)
+    for i in range(num_var):
+        for j in range(num_var):
+            if i != j:
+                out = out.at[i, j].set(theils_u(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value))
+    return out

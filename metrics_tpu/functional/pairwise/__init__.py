@@ -1,0 +1,17 @@
+"""Pairwise functional metrics (reference ``torchmetrics/functional/pairwise/__init__.py``)."""
+
+from metrics_tpu.functional.pairwise.metrics import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+]

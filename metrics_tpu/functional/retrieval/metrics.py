@@ -1,0 +1,163 @@
+"""Single-query retrieval kernels.
+
+Parity with reference ``torchmetrics/functional/retrieval/`` (``average_precision.py``,
+``precision.py``, ``recall.py``, ``fall_out.py``, ``hit_rate.py``, ``ndcg.py``,
+``r_precision.py``, ``reciprocal_rank.py``, ``precision_recall_curve.py``). Each
+operates on ONE query's 1-D ``preds``/``target``; they are sort + masked-reduction
+one-liners that jit cleanly. The batched many-query engine lives in
+``metrics_tpu.retrieval.base`` (segment reductions, SURVEY §2.7).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _sort_by_preds(preds: Array, target: Array) -> Array:
+    order = jnp.argsort(-preds, stable=True)
+    return target[order]
+
+
+def retrieval_precision(preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k for a single query (reference ``functional/retrieval/precision.py:22-69``).
+
+    >>> import jax.numpy as jnp
+    >>> retrieval_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), top_k=2)
+    Array(0.5, dtype=float32)
+    """
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    if adaptive_k and k > preds.shape[-1]:
+        k = preds.shape[-1]
+    sorted_target = _sort_by_preds(preds, target)[:k]
+    return jnp.sum(sorted_target > 0) / k
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k for a single query (reference ``functional/retrieval/recall.py:22-62``).
+
+    >>> import jax.numpy as jnp
+    >>> retrieval_recall(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), top_k=2)
+    Array(0.5, dtype=float32)
+    """
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    relevant = jnp.sum(_sort_by_preds(preds, target)[:k] > 0)
+    total = jnp.sum(target > 0)
+    return jnp.where(total > 0, relevant / jnp.maximum(total, 1), 0.0).astype(jnp.float32)
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Fall-out@k for a single query (reference ``functional/retrieval/fall_out.py:22-62``)."""
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    sorted_target = _sort_by_preds(preds, target)[:k]
+    n_nonrel = jnp.sum(target == 0)
+    return jnp.where(n_nonrel > 0, jnp.sum(sorted_target == 0) / jnp.maximum(n_nonrel, 1), 0.0).astype(jnp.float32)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Hit-rate@k for a single query (reference ``functional/retrieval/hit_rate.py:22-58``)."""
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    return (jnp.sum(_sort_by_preds(preds, target)[:k] > 0) > 0).astype(jnp.float32)
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """AP for a single query (reference ``functional/retrieval/average_precision.py:22-63``).
+
+    >>> import jax.numpy as jnp
+    >>> retrieval_average_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]))
+    Array(0.8333334, dtype=float32)
+    """
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    sorted_target = (_sort_by_preds(preds, target) > 0).astype(jnp.float32)
+    pos = jnp.arange(sorted_target.shape[0], dtype=jnp.float32)
+    prec_at_i = jnp.cumsum(sorted_target) / (pos + 1)
+    within_k = pos < k
+    n_rel_at_k = jnp.sum(sorted_target * within_k)
+    return jnp.where(
+        n_rel_at_k > 0, jnp.sum(prec_at_i * sorted_target * within_k) / jnp.maximum(n_rel_at_k, 1), 0.0
+    ).astype(jnp.float32)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Reciprocal rank of the first relevant doc (reference ``functional/retrieval/reciprocal_rank.py:22-59``).
+
+    >>> import jax.numpy as jnp
+    >>> retrieval_reciprocal_rank(jnp.array([0.2, 0.3, 0.5]), jnp.array([False, True, False]))
+    Array(0.5, dtype=float32)
+    """
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    sorted_target = (_sort_by_preds(preds, target) > 0).astype(jnp.float32)
+    pos = jnp.arange(sorted_target.shape[0], dtype=jnp.float32)
+    within_k = pos < k
+    first_rel = jnp.min(jnp.where((sorted_target > 0) & within_k, pos + 1, jnp.inf))
+    return jnp.where(jnp.isfinite(first_rel), 1.0 / first_rel, 0.0).astype(jnp.float32)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision for a single query (reference ``functional/retrieval/r_precision.py:22-52``)."""
+    sorted_target = (_sort_by_preds(preds, target) > 0).astype(jnp.float32)
+    n_rel = jnp.sum(sorted_target)
+    pos = jnp.arange(sorted_target.shape[0], dtype=jnp.float32)
+    hits = jnp.sum(sorted_target * (pos < n_rel))
+    return jnp.where(n_rel > 0, hits / jnp.maximum(n_rel, 1), 0.0).astype(jnp.float32)
+
+
+def _dcg(target_sorted: Array, k_mask: Array) -> Array:
+    pos = jnp.arange(target_sorted.shape[0], dtype=jnp.float32)
+    discount = 1.0 / jnp.log2(pos + 2.0)
+    return jnp.sum(target_sorted * discount * k_mask)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """NDCG@k for a single query with graded relevance (reference ``functional/retrieval/ndcg.py:45-95``).
+
+    >>> import jax.numpy as jnp
+    >>> retrieval_normalized_dcg(jnp.array([.85, .25, .15, .35]), jnp.array([1, 0, 0, 1]))
+    Array(0.919721, dtype=float32)
+    """
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    target_f = target.astype(jnp.float32)
+    sorted_by_pred = _sort_by_preds(preds, target_f)
+    ideal = -jnp.sort(-target_f)
+    pos = jnp.arange(target_f.shape[0], dtype=jnp.float32)
+    k_mask = pos < k
+    dcg = _dcg(sorted_by_pred, k_mask)
+    idcg = _dcg(ideal, k_mask)
+    return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0).astype(jnp.float32)
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Precision/recall at k=1..max_k for a single query (reference ``functional/retrieval/precision_recall_curve.py:24-103``)."""
+    n = preds.shape[-1]
+    if max_k is None:
+        max_k = n
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    if adaptive_k and max_k > n:
+        max_k = n
+    sorted_target = (_sort_by_preds(preds, target) > 0).astype(jnp.float32)
+    padded = jnp.concatenate([sorted_target, jnp.zeros(max(0, max_k - n), dtype=jnp.float32)])
+    cum_rel = jnp.cumsum(padded)[:max_k]
+    ks = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+    precision = cum_rel / ks
+    total = jnp.sum(sorted_target)
+    recall = jnp.where(total > 0, cum_rel / jnp.maximum(total, 1), 0.0)
+    return precision, recall, jnp.arange(1, max_k + 1)

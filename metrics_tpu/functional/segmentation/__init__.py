@@ -1,0 +1,10 @@
+"""Functional segmentation metrics (reference ``torchmetrics/functional/segmentation/__init__.py``)."""
+
+from metrics_tpu.functional.segmentation.metrics import (
+    dice_score,
+    generalized_dice_score,
+    hausdorff_distance,
+    mean_iou,
+)
+
+__all__ = ["dice_score", "generalized_dice_score", "hausdorff_distance", "mean_iou"]

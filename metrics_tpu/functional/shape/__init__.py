@@ -1,0 +1,5 @@
+"""Shape metrics (reference ``torchmetrics/functional/shape/__init__.py``)."""
+
+from metrics_tpu.functional.shape.procrustes import procrustes_disparity
+
+__all__ = ["procrustes_disparity"]

@@ -1,0 +1,46 @@
+"""Procrustes disparity (reference ``functional/shape/procrustes.py``) — jnp.linalg.svd alignment."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def procrustes_disparity(
+    point_cloud1: Array, point_cloud2: Array, return_all: bool = False
+) -> Union[Array, Tuple[Array, Array, Array]]:
+    """Run Procrustes analysis between two point clouds (reference ``shape/procrustes.py:22-70``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> pc1 = jnp.asarray(rng.rand(10, 3).astype(np.float32))
+    >>> pc2 = jnp.asarray(rng.rand(10, 3).astype(np.float32))
+    >>> round(float(procrustes_disparity(pc1, pc2)), 4)
+    0.2232
+    """
+    if point_cloud1.shape != point_cloud2.shape:
+        raise ValueError("Expected both point clouds to have the same shape "
+                         f"but got {point_cloud1.shape} and {point_cloud2.shape}")
+    point_cloud1 = point_cloud1 - point_cloud1.mean(axis=0)
+    point_cloud2 = point_cloud2 - point_cloud2.mean(axis=0)
+    norm1 = jnp.linalg.norm(point_cloud1)
+    norm2 = jnp.linalg.norm(point_cloud2)
+    if bool(norm1 < 1e-16) or bool(norm2 < 1e-16):
+        rank_zero_warn("Point cloud has zero norm, returning 0 disparity.")
+        return jnp.asarray(0.0)
+    point_cloud1 = point_cloud1 / norm1
+    point_cloud2 = point_cloud2 / norm2
+
+    u, w, vt = jnp.linalg.svd((point_cloud2.T @ point_cloud1).T, full_matrices=False)
+    rotation = u @ vt
+    scale = w.sum()
+    point_cloud2 = scale * point_cloud2 @ rotation.T
+    disparity = jnp.sum((point_cloud1 - point_cloud2) ** 2)
+    if return_all:
+        return disparity, scale, rotation
+    return disparity

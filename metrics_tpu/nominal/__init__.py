@@ -1,0 +1,11 @@
+"""Modular nominal-association metrics (reference ``torchmetrics/nominal/__init__.py``)."""
+
+from metrics_tpu.nominal.metrics import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+__all__ = ["CramersV", "FleissKappa", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]

@@ -1,0 +1,152 @@
+"""Modular nominal metrics (reference ``torchmetrics/nominal/`` — all confmat-based, SURVEY §2.8)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from jax import Array
+
+from metrics_tpu.functional.nominal.metrics import (
+    cramers_v,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class _NominalMetric(Metric):
+    """Shared plumbing: list states of the two categorical variables."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if nan_strategy not in ("replace", "drop"):
+            raise ValueError(f"Argument `nan_strategy` is expected to be one of `('replace', 'drop')`, "
+                             f"but got {nan_strategy}")
+        if nan_strategy == "replace" and not isinstance(nan_replace_value, (int, float)):
+            raise ValueError("Argument `nan_replace_value` is expected to be of a type `int` or `float` when "
+                             f"`nan_strategy = 'replace`, but got {nan_replace_value}")
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with the two categorical variables."""
+        self.preds.append(preds.reshape(-1))
+        self.target.append(target.reshape(-1))
+
+
+class CramersV(_NominalMetric):
+    """Compute Cramer's V between two categorical variables (reference ``nominal/cramers.py:26``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.randint(0, 4, (100,)))
+    >>> target = jnp.asarray((np.asarray(preds) + rng.randint(0, 2, (100,))) % 4)
+    >>> metric = CramersV(num_classes=4)
+    >>> metric.update(preds, target)
+    >>> round(float(metric.compute()), 4)
+    0.5542
+    """
+
+    def __init__(self, num_classes: int, bias_correction: bool = True, nan_strategy: str = "replace",
+                 nan_replace_value: Optional[float] = 0.0, **kwargs: Any) -> None:
+        super().__init__(nan_strategy, nan_replace_value, **kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError("Argument `num_classes` has to be a positive integer")
+        self.num_classes = num_classes
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return cramers_v(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.bias_correction,
+            self.nan_strategy, self.nan_replace_value,
+        )
+
+
+class TschuprowsT(CramersV):
+    """Compute Tschuprow's T between two categorical variables (reference ``nominal/tschuprows.py:26``)."""
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return tschuprows_t(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.bias_correction,
+            self.nan_strategy, self.nan_replace_value,
+        )
+
+
+class PearsonsContingencyCoefficient(_NominalMetric):
+    """Compute Pearson's contingency coefficient (reference ``nominal/pearson.py:26``)."""
+
+    def __init__(self, num_classes: int, nan_strategy: str = "replace",
+                 nan_replace_value: Optional[float] = 0.0, **kwargs: Any) -> None:
+        super().__init__(nan_strategy, nan_replace_value, **kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError("Argument `num_classes` has to be a positive integer")
+        self.num_classes = num_classes
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return pearsons_contingency_coefficient(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.nan_strategy, self.nan_replace_value
+        )
+
+
+class TheilsU(PearsonsContingencyCoefficient):
+    """Compute Theil's U — uncertainty coefficient (reference ``nominal/theils_u.py:26``)."""
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return theils_u(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.nan_strategy, self.nan_replace_value
+        )
+
+
+class FleissKappa(Metric):
+    """Compute Fleiss' kappa for inter-rater agreement (reference ``nominal/fleiss_kappa.py:26``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = FleissKappa(mode='counts')
+    >>> metric.update(jnp.array([[0, 0, 14], [0, 2, 12], [0, 6, 8], [0, 12, 2]]))
+    >>> round(float(metric.compute()), 4)
+    0.2269
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    ratings: List[Array]
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("counts", "probs"):
+            raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'")
+        self.mode = mode
+        self.add_state("ratings", [], dist_reduce_fx="cat")
+
+    def update(self, ratings: Array) -> None:
+        """Update state with rating counts or probabilities."""
+        self.ratings.append(ratings)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        import jax.numpy as jnp
+
+        cat_axis = 0 if self.mode == "counts" else 1
+        ratings = jnp.concatenate(self.ratings, axis=cat_axis)
+        return fleiss_kappa(ratings, self.mode)

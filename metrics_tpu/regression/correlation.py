@@ -249,6 +249,8 @@ class R2Score(Metric):
 
     def compute(self) -> Array:
         """Compute metric."""
+        if int(self.total) < 2:
+            raise ValueError("Needs at least two samples to calculate r2 score.")
         return _r2_score_compute(
             self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
         )

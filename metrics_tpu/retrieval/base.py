@@ -1,0 +1,166 @@
+"""Retrieval metric base — segment-reduce engine.
+
+Capability parity with reference ``torchmetrics/retrieval/base.py:43-191``
+(``RetrievalMetric``: list states ``indexes/preds/target`` with
+``dist_reduce_fx=None`` i.e. gather-without-reduction; ``empty_target_action``
+∈ {error, skip, neg, pos}; aggregation mean/median/min/max).
+
+TPU redesign (SURVEY §2.7 / BASELINE config 3): the reference's compute sorts by
+query id, splits into per-query Python chunks and loops ``_metric()`` over them
+(``base.py:148-191``) — the hot anti-pattern. Here compute lex-sorts ONCE by
+(query, -pred) and every metric is a handful of ``segment_sum``-style reductions
+over the flat sorted arrays; there is no per-query loop anywhere.
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+def _retrieval_aggregate(values: Array, aggregation: str = "mean") -> Array:
+    """Aggregate per-query scores (reference ``base.py:26-40``)."""
+    if aggregation == "mean":
+        return values.mean() if values.size else jnp.asarray(0.0)
+    if aggregation == "median":
+        return jnp.median(values) if values.size else jnp.asarray(0.0)
+    if aggregation == "min":
+        return values.min() if values.size else jnp.asarray(0.0)
+    if aggregation == "max":
+        return values.max() if values.size else jnp.asarray(0.0)
+    return aggregation(values)  # custom callable
+
+
+class GroupedQueries:
+    """Flat sorted view over all queries + the segment quantities every metric needs.
+
+    ``sorted by (query, -pred)``: ``rel`` (binary), ``graded`` (raw target),
+    ``group_id``, ``pos`` (0-based rank within query), ``n_rel``/``n_docs`` per
+    query, and the ideal-order graded targets for NDCG.
+    """
+
+    def __init__(self, indexes: Array, preds: Array, target: Array):
+        idx_np = np.asarray(indexes)
+        preds_np = np.asarray(preds, dtype=np.float64)
+        # compact the (arbitrary) query ids to 0..G-1
+        _, compact = np.unique(idx_np, return_inverse=True)
+        order = np.lexsort((-preds_np, compact))
+        self.order = jnp.asarray(order)
+        self.group_id = jnp.asarray(compact[order])
+        self.num_groups = int(compact.max()) + 1 if compact.size else 0
+        self.preds = jnp.asarray(preds)[self.order]
+        self.graded = jnp.asarray(target)[self.order].astype(jnp.float32)
+        self.rel = (self.graded > 0).astype(jnp.float32)
+
+        n = self.rel.shape[0]
+        g = self.group_id
+        ones = jnp.ones(n, dtype=jnp.float32)
+        self.n_docs = jax.ops.segment_sum(ones, g, self.num_groups)
+        self.n_rel = jax.ops.segment_sum(self.rel, g, self.num_groups)
+        starts = jnp.concatenate([jnp.zeros(1), jnp.cumsum(self.n_docs)[:-1]])
+        self.pos = jnp.arange(n, dtype=jnp.float32) - starts[g]
+        # cumulative relevant within group, inclusive of current position
+        cum = jnp.cumsum(self.rel)
+        offset = jnp.concatenate([jnp.zeros(1), self.n_rel.cumsum()[:-1]])
+        self.rel_cum = cum - offset[g]
+        # ideal ordering (target desc within group) for NDCG
+        ideal_order = np.lexsort((-np.asarray(target, dtype=np.float64), compact))
+        self.ideal_graded = jnp.asarray(target)[jnp.asarray(ideal_order)].astype(jnp.float32)
+
+    def seg_sum(self, x: Array) -> Array:
+        return jax.ops.segment_sum(x, self.group_id, self.num_groups)
+
+    def seg_min(self, x: Array) -> Array:
+        return jax.ops.segment_min(x, self.group_id, self.num_groups)
+
+    def seg_max(self, x: Array) -> Array:
+        return jax.ops.segment_max(x, self.group_id, self.num_groups)
+
+
+class RetrievalMetric(Metric):
+    """Base class for retrieval metrics (reference ``retrieval/base.py:43``).
+
+    Subclasses implement :meth:`_metric_vectorized` returning one score per query
+    from the :class:`GroupedQueries` view.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation: Any = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable function"
+                f"which takes tensor of values, but got {aggregation}."
+            )
+        self.aggregation = aggregation
+
+        self.add_state("indexes", [], dist_reduce_fx=None)
+        self.add_state("preds", [], dist_reduce_fx=None)
+        self.add_state("target", [], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Check shape, flatten, check and store the inputs (reference ``base.py:135-146``)."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Group by query with ONE lex-sort, score every query via segment reductions (no loops)."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        gq = GroupedQueries(indexes, preds, target)
+        scores = self._metric_vectorized(gq)  # (num_groups,)
+
+        empty = gq.n_rel == 0
+        if self.empty_target_action == "error":
+            if bool(empty.any()):
+                raise ValueError("`compute` method was provided with a query with no positive target.")
+        elif self.empty_target_action == "pos":
+            scores = jnp.where(empty, 1.0, scores)
+        elif self.empty_target_action == "neg":
+            scores = jnp.where(empty, 0.0, scores)
+        else:  # skip
+            import numpy as _np
+
+            keep = ~_np.asarray(empty)
+            scores = scores[keep]
+        return _retrieval_aggregate(scores, self.aggregation)
+
+    @abstractmethod
+    def _metric_vectorized(self, gq: GroupedQueries) -> Array:
+        """Return one score per query group."""

@@ -1,0 +1,10 @@
+"""Modular segmentation metrics (reference ``torchmetrics/segmentation/__init__.py``)."""
+
+from metrics_tpu.segmentation.metrics import (
+    DiceScore,
+    GeneralizedDiceScore,
+    HausdorffDistance,
+    MeanIoU,
+)
+
+__all__ = ["DiceScore", "GeneralizedDiceScore", "HausdorffDistance", "MeanIoU"]

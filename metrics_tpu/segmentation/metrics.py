@@ -1,0 +1,241 @@
+"""Modular segmentation metrics (reference ``torchmetrics/segmentation/`` — per-class sums, SURVEY §2.8)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.segmentation.metrics import (
+    _dice_update,
+    _format_inputs,
+    generalized_dice_score,
+    hausdorff_distance,
+    mean_iou,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class DiceScore(Metric):
+    """Compute the Dice score for semantic segmentation (reference ``segmentation/dice.py:33``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(0)
+    >>> metric = DiceScore(num_classes=3)
+    >>> metric.update(jnp.asarray(rng.randint(0, 2, (4, 3, 16, 16))), jnp.asarray(rng.randint(0, 2, (4, 3, 16, 16))))
+    >>> round(float(metric.compute()), 3)
+    0.497
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        average: Optional[str] = "micro",
+        input_format: str = "one-hot",
+        aggregation_level: str = "samplewise",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(
+                f"Expected argument `average` to be one of ('micro','macro','weighted','none'), got {average}"
+            )
+        if input_format not in ("one-hot", "index"):
+            raise ValueError(f"Expected argument `input_format` to be one of 'one-hot', 'index', got {input_format}")
+        if aggregation_level not in ("samplewise", "global"):
+            raise ValueError(
+                f"Expected argument `aggregation_level` to be one of 'samplewise', 'global', got {aggregation_level}"
+            )
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.average = average
+        self.input_format = input_format
+        self.aggregation_level = aggregation_level
+        self.add_state("numerator", [], dist_reduce_fx="cat")
+        self.add_state("denominator", [], dist_reduce_fx="cat")
+        self.add_state("support", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with per-sample per-class sums."""
+        preds, target = _format_inputs(preds, target, self.num_classes, self.input_format, self.include_background)
+        numerator, denominator, support, _ = _dice_update(preds, target)
+        self.numerator.append(numerator)
+        self.denominator.append(denominator)
+        self.support.append(support)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        numerator = dim_zero_cat(self.numerator)
+        denominator = dim_zero_cat(self.denominator)
+        support = dim_zero_cat(self.support)
+        if self.aggregation_level == "global":
+            numerator = numerator.sum(axis=0, keepdims=True)
+            denominator = denominator.sum(axis=0, keepdims=True)
+            support = support.sum(axis=0, keepdims=True)
+        if self.average == "micro":
+            scores = _safe_divide(numerator.sum(-1), denominator.sum(-1), zero_division=jnp.nan)
+        else:
+            scores = _safe_divide(numerator, denominator, zero_division=jnp.nan)
+            if self.average == "macro":
+                nan = jnp.isnan(scores)
+                scores = jnp.where(nan, 0.0, scores).sum(-1) / jnp.maximum((~nan).sum(-1), 1)
+            elif self.average == "weighted":
+                w = _safe_divide(support, support.sum(-1, keepdims=True))
+                scores = jnp.where(jnp.isnan(scores), 0.0, scores * w).sum(-1)
+        if self.average in ("none", None):
+            nan = jnp.isnan(scores)
+            return jnp.where(nan, 0.0, scores).sum(0) / jnp.maximum((~nan).sum(0), 1)
+        nan = jnp.isnan(scores)
+        return jnp.where(nan, 0.0, scores).sum() / jnp.maximum((~nan).sum(), 1)
+
+
+class GeneralizedDiceScore(Metric):
+    """Compute the Generalized Dice score (reference ``segmentation/generalized_dice.py:33``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        per_class: bool = False,
+        weight_type: str = "square",
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.per_class = per_class
+        self.weight_type = weight_type
+        self.input_format = input_format
+        self.add_state("score", jnp.zeros(num_classes - (0 if include_background else 1)) if per_class
+                       else jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("samples", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state."""
+        score = generalized_dice_score(
+            preds, target, self.num_classes, self.include_background, self.per_class,
+            self.weight_type, self.input_format,
+        )
+        n = preds.shape[0]
+        self.score = self.score + (score.sum(0) if self.per_class else score * n)
+        self.samples = self.samples + n
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return self.score / self.samples
+
+
+class MeanIoU(Metric):
+    """Compute mean intersection over union (reference ``segmentation/mean_iou.py:30``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(0)
+    >>> metric = MeanIoU(num_classes=3, input_format="index")
+    >>> metric.update(jnp.asarray(rng.randint(0, 3, (4, 16, 16))), jnp.asarray(rng.randint(0, 3, (4, 16, 16))))
+    >>> round(float(metric.compute()), 3)
+    0.202
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        per_class: bool = False,
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.per_class = per_class
+        self.input_format = input_format
+        self.add_state("iou_list", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with per-sample per-class IoU."""
+        preds, target = _format_inputs(preds, target, self.num_classes, self.input_format, self.include_background)
+        reduce_axes = tuple(range(2, preds.ndim))
+        intersection = jnp.sum(preds * target, axis=reduce_axes)
+        union = jnp.sum(preds, axis=reduce_axes) + jnp.sum(target, axis=reduce_axes) - intersection
+        valid = union > 0
+        iou = jnp.where(valid, intersection / jnp.where(valid, union, 1.0), jnp.nan)
+        self.iou_list.append(iou)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        iou = dim_zero_cat(self.iou_list)
+        nan = jnp.isnan(iou)
+        if self.per_class:
+            return jnp.where(nan, 0.0, iou).sum(0) / jnp.maximum((~nan).sum(0), 1)
+        per_sample = jnp.where(nan, 0.0, iou).sum(-1) / jnp.maximum((~nan).sum(-1), 1)
+        return per_sample.mean()
+
+
+class HausdorffDistance(Metric):
+    """Compute the Hausdorff distance between segmentation masks (reference ``segmentation/hausdorff_distance.py:31``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = False,
+        distance_metric: str = "euclidean",
+        spacing: Optional[Tuple[float, ...]] = None,
+        directed: bool = False,
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.distance_metric = distance_metric
+        self.spacing = spacing
+        self.directed = directed
+        self.input_format = input_format
+        self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state."""
+        score = hausdorff_distance(
+            preds, target, self.num_classes, self.include_background, self.distance_metric,
+            self.spacing, self.directed, self.input_format,
+        )
+        self.score = self.score + score * preds.shape[0]
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return self.score / self.total
+
+
+HausdorffDistance.__jit_ineligible__ = True  # host-side point-set distances

@@ -1,0 +1,5 @@
+"""Modular shape metrics (reference ``torchmetrics/shape/__init__.py``)."""
+
+from metrics_tpu.shape.procrustes import ProcrustesDisparity
+
+__all__ = ["ProcrustesDisparity"]

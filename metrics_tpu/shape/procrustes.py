@@ -1,0 +1,52 @@
+"""ProcrustesDisparity (reference ``torchmetrics/shape/procrustes.py:154 LoC`` — SVD alignment)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.shape.procrustes import procrustes_disparity
+from metrics_tpu.metric import Metric
+
+
+class ProcrustesDisparity(Metric):
+    """Compute the Procrustes disparity between batches of point clouds (reference ``shape/procrustes.py:30``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ProcrustesDisparity()
+    >>> metric.update(jnp.asarray(rng.rand(10, 3).astype(np.float32)), jnp.asarray(rng.rand(10, 3).astype(np.float32)))
+    >>> round(float(metric.compute()), 4)
+    0.2232
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: str = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("mean", "sum"):
+            raise ValueError(f"Argument `reduction` must be one of `mean` or `sum`, but got {reduction}")
+        self.reduction = reduction
+        self.add_state("disparity", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, point_cloud1: Array, point_cloud2: Array) -> None:
+        """Update state with a batch (or a single pair) of point clouds."""
+        if point_cloud1.ndim == 2:
+            point_cloud1 = point_cloud1[None]
+            point_cloud2 = point_cloud2[None]
+        for i in range(point_cloud1.shape[0]):
+            self.disparity = self.disparity + procrustes_disparity(point_cloud1[i], point_cloud2[i])
+        self.total = self.total + point_cloud1.shape[0]
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        if self.reduction == "mean":
+            return self.disparity / self.total
+        return self.disparity

@@ -33,7 +33,7 @@ class MetricTracker(WrapperMetric):
         super().__init__()
         if not isinstance(metric, (Metric, MetricCollection)):
             raise TypeError(
-                "Metric arg need to be an instance of a Metric or MetricCollection but got {metric}"
+                f"Metric arg need to be an instance of a Metric or MetricCollection but got {metric}"
             )
         self._base_metric = metric
         if not isinstance(maximize, (bool, list)):

@@ -1,0 +1,188 @@
+"""Clustering / nominal / pairwise / segmentation / shape vs sklearn/scipy golden references."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial import procrustes as scipy_procrustes
+from sklearn import metrics as sk
+
+from metrics_tpu.clustering import (
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    CompletenessScore,
+    DaviesBouldinScore,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from metrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+from metrics_tpu.nominal import CramersV, FleissKappa, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+from metrics_tpu.segmentation import DiceScore, MeanIoU
+from metrics_tpu.shape import ProcrustesDisparity
+
+_rng = np.random.RandomState(33)
+labels_a = _rng.randint(0, 4, (2, 64))
+labels_b = _rng.randint(0, 4, (2, 64))
+
+
+def _run2(metric, a=labels_a, b=labels_b):
+    for x, y in zip(a, b):
+        metric.update(jnp.asarray(x), jnp.asarray(y))
+    return float(metric.compute())
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "sk_fn"),
+    [
+        (MutualInfoScore, sk.mutual_info_score),
+        (RandScore, sk.rand_score),
+        (AdjustedRandScore, sk.adjusted_rand_score),
+        (FowlkesMallowsIndex, sk.fowlkes_mallows_score),
+        (HomogeneityScore, sk.homogeneity_score),
+        (CompletenessScore, sk.completeness_score),
+        (VMeasureScore, sk.v_measure_score),
+        (NormalizedMutualInfoScore, sk.normalized_mutual_info_score),
+        (AdjustedMutualInfoScore, sk.adjusted_mutual_info_score),
+    ],
+)
+def test_clustering_vs_sklearn(metric_cls, sk_fn):
+    got = _run2(metric_cls())
+    # sklearn signatures are (labels_true, labels_pred); ours update(preds, target)
+    ref = sk_fn(labels_b.reshape(-1), labels_a.reshape(-1))
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_intrinsic_clustering_vs_sklearn():
+    data = _rng.randn(100, 4).astype(np.float32)
+    labels = _rng.randint(0, 3, 100)
+    ch = CalinskiHarabaszScore()
+    ch.update(jnp.asarray(data), jnp.asarray(labels))
+    np.testing.assert_allclose(float(ch.compute()), sk.calinski_harabasz_score(data, labels), rtol=1e-4)
+    db = DaviesBouldinScore()
+    db.update(jnp.asarray(data), jnp.asarray(labels))
+    np.testing.assert_allclose(float(db.compute()), sk.davies_bouldin_score(data, labels), rtol=1e-4)
+
+
+def test_cramers_v_vs_scipy():
+    from scipy.stats.contingency import association
+
+    a, b = labels_a.reshape(-1), labels_b.reshape(-1)
+    m = CramersV(num_classes=4, bias_correction=False)
+    m.update(jnp.asarray(a), jnp.asarray(b))
+    conf = np.zeros((4, 4), dtype=np.int64)
+    for x, y in zip(a, b):
+        conf[y, x] += 1
+    np.testing.assert_allclose(float(m.compute()), association(conf, method="cramer"), atol=1e-4)
+    t = TschuprowsT(num_classes=4, bias_correction=False)
+    t.update(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(float(t.compute()), association(conf, method="tschuprow"), atol=1e-4)
+    p = PearsonsContingencyCoefficient(num_classes=4)
+    p.update(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(float(p.compute()), association(conf, method="pearson"), atol=1e-4)
+
+
+def test_theils_u_properties():
+    a = _rng.randint(0, 4, 200)
+    m = TheilsU(num_classes=4)
+    m.update(jnp.asarray(a), jnp.asarray(a))  # identical → U = 1
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-5)
+
+
+def test_fleiss_kappa_known_value():
+    # classic worked example from Fleiss (1971) subset
+    ratings = jnp.asarray([[0, 0, 0, 0, 14], [0, 2, 6, 4, 2], [0, 0, 3, 5, 6], [0, 3, 9, 2, 0],
+                           [2, 2, 8, 1, 1], [7, 7, 0, 0, 0], [3, 2, 6, 3, 0], [2, 5, 3, 2, 2],
+                           [6, 5, 2, 1, 0], [0, 2, 2, 3, 7]])
+    m = FleissKappa(mode="counts")
+    m.update(ratings)
+    np.testing.assert_allclose(float(m.compute()), 0.2099, atol=1e-4)
+
+
+def test_pairwise_vs_sklearn():
+    x = _rng.randn(6, 4).astype(np.float32)
+    y = _rng.randn(5, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(pairwise_cosine_similarity(jnp.asarray(x), jnp.asarray(y))),
+        sk.pairwise.cosine_similarity(x, y), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_euclidean_distance(jnp.asarray(x), jnp.asarray(y))),
+        sk.pairwise.euclidean_distances(x, y), atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_linear_similarity(jnp.asarray(x), jnp.asarray(y))),
+        sk.pairwise.linear_kernel(x, y), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_manhattan_distance(jnp.asarray(x), jnp.asarray(y))),
+        sk.pairwise.manhattan_distances(x, y), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_minkowski_distance(jnp.asarray(x), jnp.asarray(y), exponent=3)),
+        sk.pairwise.pairwise_distances(x, y, metric="minkowski", p=3), atol=1e-4,
+    )
+    # x-only variant zeroes the diagonal
+    d = np.asarray(pairwise_euclidean_distance(jnp.asarray(x)))
+    np.testing.assert_allclose(np.diag(d), 0.0, atol=1e-6)
+
+
+def test_dice_score_vs_formula():
+    preds = _rng.randint(0, 2, (4, 3, 8, 8))
+    target = _rng.randint(0, 2, (4, 3, 8, 8))
+    m = DiceScore(num_classes=3, average="micro")
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    inter = (preds * target).sum(axis=(1, 2, 3))
+    denom = preds.sum(axis=(1, 2, 3)) + target.sum(axis=(1, 2, 3))
+    ref = (2 * inter / denom).mean()
+    np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-5)
+
+
+def test_mean_iou_vs_sklearn_jaccard():
+    preds = _rng.randint(0, 3, (2, 16, 16))
+    target = _rng.randint(0, 3, (2, 16, 16))
+    m = MeanIoU(num_classes=3, input_format="index", per_class=True)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    got = np.asarray(m.compute())
+    for c in range(3):
+        per_sample = []
+        for i in range(2):
+            p = preds[i] == c
+            t = target[i] == c
+            union = (p | t).sum()
+            if union:
+                per_sample.append((p & t).sum() / union)
+        np.testing.assert_allclose(got[c], np.mean(per_sample), rtol=1e-5)
+
+
+def test_procrustes_vs_scipy():
+    pc1 = _rng.rand(12, 3)
+    pc2 = _rng.rand(12, 3)
+    m = ProcrustesDisparity()
+    m.update(jnp.asarray(pc1.astype(np.float32)), jnp.asarray(pc2.astype(np.float32)))
+    _, _, ref = scipy_procrustes(pc1, pc2)
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_hausdorff_distance_simple():
+    from metrics_tpu.segmentation import HausdorffDistance
+
+    # two squares offset by 4 pixels → hausdorff = 4
+    a = np.zeros((1, 2, 16, 16), dtype=np.int32)
+    b = np.zeros((1, 2, 16, 16), dtype=np.int32)
+    a[0, 1, 2:6, 2:6] = 1
+    b[0, 1, 6:10, 2:6] = 1
+    a[0, 0] = 1 - a[0, 1]
+    b[0, 0] = 1 - b[0, 1]
+    m = HausdorffDistance(num_classes=2)
+    m.update(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(float(m.compute()), 4.0, atol=1e-5)
