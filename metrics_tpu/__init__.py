@@ -10,6 +10,7 @@ from metrics_tpu import (
     classification,
     clustering,
     functional,
+    image,
     nominal,
     parallel,
     regression,
@@ -48,6 +49,7 @@ __all__ = [
     "classification",
     "clustering",
     "functional",
+    "image",
     "parallel",
     "nominal",
     "regression",

@@ -3,6 +3,7 @@
 from metrics_tpu.functional import (
     classification,
     clustering,
+    image,
     nominal,
     pairwise,
     regression,
@@ -21,6 +22,7 @@ from metrics_tpu.functional.pairwise import (
 __all__ = [
     "classification",
     "clustering",
+    "image",
     "nominal",
     "pairwise",
     "pairwise_cosine_similarity",

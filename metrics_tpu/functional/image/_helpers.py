@@ -1,0 +1,92 @@
+"""Shared image-metric helpers: reductions, gaussian/uniform windows, depthwise conv.
+
+Parity with reference ``functional/image/utils.py`` (``_gaussian :9``,
+``_gaussian_kernel_2d :28``, uniform kernels) and ``utilities/distributed.py``
+``reduce``. The window convolution is a depthwise ``lax.conv_general_dilated``
+(``feature_group_count=C``) — exactly the op XLA tiles onto the TPU convolution
+unit; inputs are reflect-padded first like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+
+def reduce(x: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    """Reduce a tensor of per-sample values (reference ``utilities/distributed.py:22-40``)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def _gaussian(kernel_size: int, sigma: float) -> Array:
+    """1D gaussian kernel (reference ``image/utils.py:9-25``)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0)
+    gauss = jnp.exp(-(dist**2) / (2 * sigma**2))
+    return (gauss / gauss.sum())[None, :]
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float]) -> Array:
+    """2D depthwise gaussian kernel of shape (C, 1, kh, kw) (reference ``image/utils.py:28-55``)."""
+    g1 = _gaussian(kernel_size[0], sigma[0])
+    g2 = _gaussian(kernel_size[1], sigma[1])
+    kernel2d = g1.T @ g2
+    return jnp.broadcast_to(kernel2d, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float]) -> Array:
+    """3D depthwise gaussian kernel (reference ``image/utils.py:58-85``)."""
+    g1 = _gaussian(kernel_size[0], sigma[0])[0]
+    g2 = _gaussian(kernel_size[1], sigma[1])[0]
+    g3 = _gaussian(kernel_size[2], sigma[2])[0]
+    kernel3d = g1[:, None, None] * g2[None, :, None] * g3[None, None, :]
+    return jnp.broadcast_to(kernel3d, (channel, 1, *kernel3d.shape))
+
+
+def _uniform_kernel(channel: int, kernel_size: Sequence[int]) -> Array:
+    """Uniform depthwise kernel."""
+    import numpy as np
+
+    k = jnp.ones((channel, 1, *kernel_size)) / float(np.prod(kernel_size))
+    return k
+
+
+def _reflect_pad(x: Array, pads: Sequence[int]) -> Array:
+    """Reflect-pad the trailing spatial dims; ``pads`` is one per spatial dim."""
+    cfg = [(0, 0, 0), (0, 0, 0)] + [(p, p, 0) for p in pads]
+    # jnp.pad reflect is fine; lax.pad has no reflect mode
+    pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return jnp.pad(x, pad_width, mode="reflect")
+
+
+def depthwise_conv(x: Array, kernel: Array) -> Array:
+    """Depthwise VALID convolution; x is (B, C, *spatial), kernel (C, 1, *window)."""
+    spatial = x.ndim - 2
+    if spatial == 2:
+        dn = lax.conv_dimension_numbers(x.shape, kernel.shape, ("NCHW", "OIHW", "NCHW"))
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, kernel.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    return lax.conv_general_dilated(
+        x, kernel, window_strides=(1,) * spatial, padding="VALID",
+        dimension_numbers=dn, feature_group_count=x.shape[1],
+    )
+
+
+def avg_pool2d(x: Array, kernel: int = 2) -> Array:
+    """Average pool with stride=kernel (for MS-SSIM downsampling)."""
+    window = (1, 1, kernel, kernel)
+    out = lax.reduce_window(x, 0.0, lax.add, window, window, "VALID")
+    return out / (kernel * kernel)
+
+
+def _uniform_window_conv(x: Array, channel: int, window: int) -> Array:
+    """Mean filter via depthwise conv (for UQI/RMSE-SW style sliding windows)."""
+    return depthwise_conv(x, _uniform_kernel(channel, (window, window)))

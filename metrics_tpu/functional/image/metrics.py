@@ -1,0 +1,401 @@
+"""Remaining image-quality kernels.
+
+Parity with reference ``functional/image/``: ``uqi.py``, ``sam.py``, ``ergas.py``,
+``rmse_sw.py``, ``rase.py``, ``tv.py``, ``scc.py``, ``psnrb.py``, ``vif.py``,
+``d_lambda.py``, ``d_s.py``, ``qnr.py``. All window passes reuse the depthwise-conv
+machinery from ``_helpers`` (one conv per statistic, fused epilogues).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image._helpers import (
+    _gaussian_kernel_2d,
+    _reflect_pad,
+    _uniform_kernel,
+    depthwise_conv,
+    reduce,
+)
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+# --------------------------------------------------------------------------- UQI
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Universal image quality index (reference ``uqi.py:24-103``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(2, 3, 32, 32).astype(np.float32))
+    >>> target = jnp.asarray(np.asarray(preds) * 0.75)
+    >>> round(float(universal_image_quality_index(preds, target)), 4)
+    0.9216
+    """
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    channel = preds.shape[1]
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma)
+    pads = [(k - 1) // 2 for k in kernel_size]
+    preds_p = _reflect_pad(preds, pads)
+    target_p = _reflect_pad(target, pads)
+    input_list = jnp.concatenate((preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p))
+    outputs = depthwise_conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_p, mu_t, s_pp, s_tt, s_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
+    mu_p_sq, mu_t_sq, mu_pt = mu_p**2, mu_t**2, mu_p * mu_t
+    sigma_p_sq = s_pp - mu_p_sq
+    sigma_t_sq = s_tt - mu_t_sq
+    sigma_pt = s_pt - mu_pt
+    upper = 2 * sigma_pt
+    lower = sigma_p_sq + sigma_t_sq
+    eps = jnp.finfo(jnp.float32).eps
+    uqi_map = ((2 * mu_pt) * upper) / ((mu_p_sq + mu_t_sq) * lower + eps)
+    return reduce(uqi_map.reshape(b, -1).mean(-1), reduction)
+
+
+# --------------------------------------------------------------------------- SAM
+def spectral_angle_mapper(
+    preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Spectral angle mapper in radians (reference ``sam.py:24-87``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> target = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> round(float(spectral_angle_mapper(preds, target)), 4)
+    0.5914
+    """
+    _check_same_shape(preds, target)
+    if preds.ndim != 4 or preds.shape[1] <= 1:
+        raise ValueError(
+            f"Expected both `preds` and `target` to have BxCxHxW shape with C > 1. Got preds: {preds.shape}"
+        )
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    dot = jnp.sum(preds * target, axis=1)
+    denom = jnp.linalg.norm(preds, axis=1) * jnp.linalg.norm(target, axis=1)
+    angle = jnp.arccos(jnp.clip(dot / jnp.maximum(denom, 1e-12), -1.0, 1.0))
+    return reduce(angle.reshape(angle.shape[0], -1).mean(-1), reduction)
+
+
+# --------------------------------------------------------------------------- ERGAS
+def error_relative_global_dimensionless_synthesis(
+    preds: Array, target: Array, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """ERGAS (reference ``ergas.py:24-86``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> target = jnp.asarray(np.asarray(preds) * 0.75)
+    >>> float(error_relative_global_dimensionless_synthesis(preds, target)) > 0
+    True
+    """
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}")
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    b, c = preds.shape[:2]
+    diff = (preds - target).reshape(b, c, -1)
+    rmse_per_band = jnp.sqrt(jnp.mean(diff**2, axis=2))
+    mean_target = jnp.mean(target.reshape(b, c, -1), axis=2)
+    ergas_score = 100 / ratio * jnp.sqrt(jnp.mean((rmse_per_band / mean_target) ** 2, axis=1))
+    return reduce(ergas_score, reduction)
+
+
+# --------------------------------------------------------------------------- RMSE-SW / RASE
+def _rmse_sw_maps(preds: Array, target: Array, window_size: int) -> Tuple[Array, Array]:
+    """Sliding-window RMSE map and windowed target mean (shared by rmse_sw/rase)."""
+    channel = preds.shape[1]
+    kernel = _uniform_kernel(channel, (window_size, window_size))
+    mse_map = depthwise_conv((preds - target) ** 2, kernel)
+    mu_target = depthwise_conv(target, kernel)
+    return jnp.sqrt(jnp.clip(mse_map, 0.0, None)), mu_target
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
+):
+    """Sliding-window RMSE (reference ``rmse_sw.py:24-87``)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    rmse_map, _ = _rmse_sw_maps(preds, target, window_size)
+    rmse = rmse_map.mean()
+    if return_rmse_map:
+        return rmse, rmse_map
+    return rmse
+
+
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """RASE (reference ``rase.py:24-77``): 100/μ_window · RMS over bands of windowed RMSE."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    rmse_map, mu_target = _rmse_sw_maps(preds, target, window_size)
+    # mean over bands of squared windowed rmse, normalized by the window mean intensity
+    rase_map = 100.0 / jnp.mean(mu_target, axis=1) * jnp.sqrt(jnp.mean(rmse_map**2, axis=1))
+    return rase_map.mean()
+
+
+# --------------------------------------------------------------------------- Total variation
+def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
+    """Total variation (reference ``tv.py:22-67``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> img = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> float(total_variation(img)) > 0
+    True
+    """
+    if img.ndim != 4:
+        raise RuntimeError(f"Expected input `img` to be an 4D tensor, but got {img.shape}")
+    diff1 = img[..., 1:, :] - img[..., :-1, :]
+    diff2 = img[..., :, 1:] - img[..., :, :-1]
+    res1 = jnp.abs(diff1).reshape(img.shape[0], -1).sum(-1)
+    res2 = jnp.abs(diff2).reshape(img.shape[0], -1).sum(-1)
+    score = res1 + res2
+    if reduction == "mean":
+        return score.mean()
+    return reduce(score, reduction)
+
+
+# --------------------------------------------------------------------------- SCC
+def spatial_correlation_coefficient(
+    preds: Array,
+    target: Array,
+    hp_filter: Optional[Array] = None,
+    window_size: int = 8,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Spatial correlation coefficient (reference ``scc.py:25-112``).
+
+    High-pass (laplacian) filter both images, then per-window Pearson correlation of
+    the filtered responses, averaged.
+    """
+    if hp_filter is None:
+        hp_filter = jnp.asarray([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
+    if preds.ndim == 3:
+        preds = preds[:, None]
+        target = target[:, None]
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    channel = preds.shape[1]
+    hp_kernel = jnp.broadcast_to(hp_filter, (channel, 1, *hp_filter.shape))
+    pads = [(s - 1) // 2 for s in hp_filter.shape]
+    hp_p = depthwise_conv(_reflect_pad(preds, pads), hp_kernel)
+    hp_t = depthwise_conv(_reflect_pad(target, pads), hp_kernel)
+
+    window = _uniform_kernel(channel, (window_size, window_size))
+    stack = jnp.concatenate((hp_p, hp_t, hp_p * hp_p, hp_t * hp_t, hp_p * hp_t))
+    out = depthwise_conv(stack, window)
+    b = preds.shape[0]
+    mu_p, mu_t, s_pp, s_tt, s_pt = (out[i * b : (i + 1) * b] for i in range(5))
+    var_p = s_pp - mu_p**2
+    var_t = s_tt - mu_t**2
+    cov = s_pt - mu_p * mu_t
+    eps = jnp.finfo(jnp.float32).eps
+    den = var_p * var_t
+    scc_map = jnp.where(den > eps, cov / jnp.sqrt(jnp.where(den > eps, den, 1.0)), 0.0)
+    return reduce(scc_map.reshape(b, -1).mean(-1), reduction)
+
+
+# --------------------------------------------------------------------------- PSNRB
+def _blocking_effect_factor(img: Array, block_size: int = 8) -> Array:
+    """Blocking effect factor of JPEG-style 8x8 blocks (reference ``psnrb.py`` helper)."""
+    h, w = img.shape[-2:]
+    h_idx = jnp.arange(block_size - 1, h - 1, block_size)
+    w_idx = jnp.arange(block_size - 1, w - 1, block_size)
+    # boundary differences
+    d_b_h = ((img[..., h_idx, :] - img[..., h_idx + 1, :]) ** 2).sum(axis=(-2, -1))
+    d_b_w = ((img[..., :, w_idx] - img[..., :, w_idx + 1]) ** 2).sum(axis=(-2, -1))
+    # non-boundary differences
+    all_h = jnp.arange(0, h - 1)
+    all_w = jnp.arange(0, w - 1)
+    nb_h = jnp.setdiff1d(all_h, h_idx, size=len(all_h) - len(h_idx))
+    nb_w = jnp.setdiff1d(all_w, w_idx, size=len(all_w) - len(w_idx))
+    d_nb_h = ((img[..., nb_h, :] - img[..., nb_h + 1, :]) ** 2).sum(axis=(-2, -1))
+    d_nb_w = ((img[..., :, nb_w] - img[..., :, nb_w + 1]) ** 2).sum(axis=(-2, -1))
+
+    n_b = img.shape[-1] * len(h_idx) + img.shape[-2] * len(w_idx)
+    n_nb = img.shape[-1] * len(nb_h) + img.shape[-2] * len(nb_w)
+    d_b = (d_b_h + d_b_w) / n_b
+    d_nb = (d_nb_h + d_nb_w) / n_nb
+    t = jnp.log2(jnp.asarray(float(block_size))) / jnp.log2(jnp.asarray(float(min(h, w))))
+    return jnp.where(d_b > d_nb, t * (d_b - d_nb), 0.0).sum(axis=-1)
+
+
+def peak_signal_noise_ratio_with_blocked_effect(preds: Array, target: Array, block_size: int = 8) -> Array:
+    """PSNR-B (reference ``psnrb.py:25-76``): PSNR penalized by the blocking effect factor.
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(2, 1, 16, 16).astype(np.float32))
+    >>> target = jnp.asarray(rng.rand(2, 1, 16, 16).astype(np.float32))
+    >>> float(peak_signal_noise_ratio_with_blocked_effect(preds, target)) > 0
+    True
+    """
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    data_range = target.max() - target.min()
+    bef = _blocking_effect_factor(preds, block_size)
+    mse = ((preds - target) ** 2).reshape(preds.shape[0], -1).mean(-1)
+    mse_b = mse + bef
+    return (10 * jnp.log10(data_range**2 / mse_b)).mean()
+
+
+# --------------------------------------------------------------------------- VIF
+def visual_information_fidelity(preds: Array, target: Array, sigma_n_sq: float = 2.0) -> Array:
+    """VIF-p, pixel domain (reference ``vif.py:23-86``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(2, 1, 41, 41).astype(np.float32))
+    >>> float(visual_information_fidelity(preds, jnp.asarray(np.asarray(preds)))) > 0.99
+    True
+    """
+    if preds.shape[-2] < 41 or preds.shape[-1] < 41:
+        raise ValueError(f"Invalid size of preds. Expected at least 41x41, but got {preds.shape[-2:]}!")
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32).mean(axis=1, keepdims=True)  # luminance
+    target = target.astype(jnp.float32).mean(axis=1, keepdims=True)
+    eps = 1e-10
+    preds_vif = jnp.zeros(preds.shape[0])
+    target_vif = jnp.zeros(preds.shape[0])
+    cur_p, cur_t = preds, target
+    for scale in range(4):
+        n = 2.0 ** (4 - scale) + 1
+        sigma = n / 5.0
+        ksize = int(n)
+        kernel = _gaussian_kernel_2d(1, (ksize, ksize), (sigma, sigma))
+        if scale > 0:
+            cur_p = depthwise_conv(cur_p, kernel)[..., ::2, ::2]
+            cur_t = depthwise_conv(cur_t, kernel)[..., ::2, ::2]
+        stack = jnp.concatenate((cur_t, cur_p, cur_t * cur_t, cur_p * cur_p, cur_t * cur_p))
+        out = depthwise_conv(stack, kernel)
+        b = cur_p.shape[0]
+        mu_t, mu_p, s_tt, s_pp, s_tp = (out[i * b : (i + 1) * b] for i in range(5))
+        sigma_t_sq = jnp.clip(s_tt - mu_t**2, 0.0, None)
+        sigma_p_sq = jnp.clip(s_pp - mu_p**2, 0.0, None)
+        sigma_tp = s_tp - mu_t * mu_p
+        g = sigma_tp / (sigma_t_sq + eps)
+        sv_sq = sigma_p_sq - g * sigma_tp
+        g = jnp.where(sigma_t_sq >= eps, g, 0.0)
+        sv_sq = jnp.where(sigma_t_sq >= eps, sv_sq, sigma_p_sq)
+        sigma_t_sq = jnp.where(sigma_t_sq >= eps, sigma_t_sq, 0.0)
+        g = jnp.where(sigma_p_sq >= eps, g, 0.0)
+        sv_sq = jnp.where(sigma_p_sq >= eps, sv_sq, 0.0)
+        sv_sq = jnp.where(g >= 0, sv_sq, sigma_p_sq)
+        g = jnp.clip(g, 0.0, None)
+        sv_sq = jnp.clip(sv_sq, eps, None)
+        preds_vif_scale = jnp.log10(1.0 + (g**2) * sigma_t_sq / (sv_sq + sigma_n_sq))
+        preds_vif = preds_vif + preds_vif_scale.reshape(b, -1).sum(-1)
+        target_vif = target_vif + jnp.log10(1.0 + sigma_t_sq / sigma_n_sq).reshape(b, -1).sum(-1)
+    return (preds_vif / target_vif).mean()
+
+
+# --------------------------------------------------------------------------- D_lambda / D_s / QNR
+def spectral_distortion_index(
+    preds: Array, target: Array, p: int = 1, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Spectral distortion index D_λ for pan-sharpening (reference ``d_lambda.py:24-89``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> float(spectral_distortion_index(preds, jnp.asarray(np.asarray(preds)))) < 1e-4
+    True
+    """
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+    _check_same_shape(preds, target)
+    c = preds.shape[1]
+    # pairwise UQI between all band pairs for fused (preds) and low-res (target)
+    def band_uqi_matrix(x, y):
+        mat = jnp.zeros((c, c))
+        for i in range(c):
+            for j in range(c):
+                q = universal_image_quality_index(x[:, i : i + 1], y[:, j : j + 1], reduction="elementwise_mean")
+                mat = mat.at[i, j].set(q)
+        return mat
+
+    if c == 1:
+        q_fused = universal_image_quality_index(preds, preds)
+        q_lr = universal_image_quality_index(target, target)
+        return jnp.abs(q_fused - q_lr) ** (1.0 / p)
+    q_fused = band_uqi_matrix(preds, preds)
+    q_lr = band_uqi_matrix(target, target)
+    diff = jnp.abs(q_fused - q_lr) ** p
+    # off-diagonal mean
+    mask = ~jnp.eye(c, dtype=bool)
+    return (diff[mask].mean()) ** (1.0 / p)
+
+
+def spatial_distortion_index(
+    preds: Array, target: Dict[str, Array], norm_order: int = 1, window_size: int = 7
+) -> Array:
+    """Spatial distortion index D_s (reference ``d_s.py:27-120``).
+
+    ``target`` is a dict with keys ``ms`` (low-res multispectral) and ``pan``
+    (high-res panchromatic); optional ``pan_lr``.
+    """
+    if not isinstance(target, dict) or "ms" not in target or "pan" not in target:
+        raise ValueError("Expected `target` to be a dict with keys ('ms', 'pan').")
+    ms, pan = target["ms"], target["pan"]
+    c = preds.shape[1]
+    pan_lr = target.get("pan_lr")
+    if pan_lr is None:
+        # degrade pan to ms resolution: low-pass with the window filter, then average-pool down
+        from metrics_tpu.functional.image._helpers import _reflect_pad, _uniform_kernel, avg_pool2d, depthwise_conv
+
+        pads = [(window_size - 1) // 2] * 2
+        pan_lr = depthwise_conv(_reflect_pad(pan, pads), _uniform_kernel(pan.shape[1], (window_size, window_size)))
+        while pan_lr.shape[-1] > ms.shape[-1]:
+            pan_lr = avg_pool2d(pan_lr, 2)
+    vals = []
+    for i in range(c):
+        # pair band i with pan channel i when pan is multi-channel (reference d_s.py pairing)
+        pc = i if pan.shape[1] == c else 0
+        q_hr = universal_image_quality_index(preds[:, i : i + 1], pan[:, pc : pc + 1])
+        q_lr = universal_image_quality_index(ms[:, i : i + 1], pan_lr[:, pc : pc + 1])
+        vals.append(jnp.abs(q_hr - q_lr) ** norm_order)
+    return (jnp.stack(vals).mean()) ** (1.0 / norm_order)
+
+
+def quality_with_no_reference(
+    preds: Array,
+    target: Dict[str, Array],
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    norm_order: int = 1,
+    window_size: int = 7,
+) -> Array:
+    """QNR (reference ``qnr.py:26-90``): (1-D_λ)^α (1-D_s)^β."""
+    d_lambda = spectral_distortion_index(preds, target["ms"], p=norm_order)
+    d_s = spatial_distortion_index(preds, target, norm_order, window_size)
+    return (1 - d_lambda) ** alpha * (1 - d_s) ** beta

@@ -1,0 +1,80 @@
+"""PSNR kernels (reference ``functional/image/psnr.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image._helpers import reduce
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """PSNR from accumulated squared error (reference ``psnr.py:26-57``)."""
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(base))
+    return reduce(psnr_vals, reduction)
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    """Σ(p-t)² and count, optionally per-dim (reference ``psnr.py:60-88``)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if dim is None:
+        sum_squared_error = jnp.sum((preds - target) ** 2)
+        num_obs = jnp.asarray(target.size)
+        return sum_squared_error, num_obs
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    num = 1
+    for d in dim_list:
+        num *= preds.shape[d]
+    return sum_squared_error, jnp.asarray(num)
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """Compute peak signal-to-noise ratio (reference ``psnr.py:91-149``).
+
+    >>> import jax.numpy as jnp
+    >>> pred = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+    >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+    >>> peak_signal_noise_ratio(pred, target)
+    Array(2.5527, dtype=float32)
+    """
+    if dim is None and reduction != "elementwise_mean":
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range_t = jnp.maximum(jnp.max(target), jnp.max(preds)) - jnp.minimum(jnp.min(target), jnp.min(preds))
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range_t = jnp.asarray(data_range[1] - data_range[0], dtype=jnp.float32)
+    else:
+        data_range_t = jnp.asarray(float(data_range))
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, num_obs, data_range_t, base=base, reduction=reduction)

@@ -1,0 +1,228 @@
+"""SSIM / MS-SSIM kernels — the designated conv hot path (SURVEY §2.8, BASELINE config 4).
+
+Parity with reference ``functional/image/ssim.py`` (``_ssim_update :46-188``,
+``_multiscale_ssim_update``; gaussian windows from ``image/utils.py``). The window
+pass is ONE depthwise convolution over a stacked ``(5·B, C, H, W)`` batch —
+pred/target/pred²/target²/pred·target share the kernel, so XLA lowers the whole
+SSIM map to a single conv + fused elementwise epilogue on the TPU conv unit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image._helpers import (
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reflect_pad,
+    _uniform_kernel,
+    avg_pool2d,
+    depthwise_conv,
+    reduce,
+)
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Shape/dtype validation (reference ``ssim.py:33-43``)."""
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape. Got preds: {preds.shape}"
+        )
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Per-image SSIM via one stacked depthwise conv (reference ``ssim.py:46-188``)."""
+    is_3d = preds.ndim == 5
+    n_spatial = 3 if is_3d else 2
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = n_spatial * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = n_spatial * [sigma]
+    if len(kernel_size) != n_spatial or len(sigma) != n_spatial:
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)}, but expected to be two less than target"
+            f" dimensionality, which is: {preds.ndim}"
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+    if return_full_image and return_contrast_sensitivity:
+        raise ValueError("Arguments `return_full_image` and `return_contrast_sensitivity` are mutually exclusive.")
+
+    if data_range is None:
+        data_range = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = data_range[1] - data_range[0]
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    channel = preds.shape[1]
+    gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    eff_size = gauss_kernel_size if gaussian_kernel else kernel_size
+    pads = [(k - 1) // 2 for k in eff_size]
+
+    preds_p = _reflect_pad(preds, pads)
+    target_p = _reflect_pad(target, pads)
+    if gaussian_kernel:
+        kernel = (
+            _gaussian_kernel_3d(channel, gauss_kernel_size, sigma)
+            if is_3d
+            else _gaussian_kernel_2d(channel, gauss_kernel_size, sigma)
+        )
+    else:
+        kernel = _uniform_kernel(channel, kernel_size)
+
+    input_list = jnp.concatenate(
+        (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
+    )  # (5·B, C, *spatial)
+    outputs = depthwise_conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target, s_pp, s_tt, s_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+    sigma_pred_sq = jnp.clip(s_pp - mu_pred_sq, 0.0, None)
+    sigma_target_sq = jnp.clip(s_tt - mu_target_sq, 0.0, None)
+    sigma_pred_target = s_pt - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+    ssim_full = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    per_image = ssim_full.reshape(b, -1).mean(-1)
+    if return_contrast_sensitivity:
+        return per_image, (upper / lower).reshape(b, -1).mean(-1)
+    if return_full_image:
+        return per_image, ssim_full
+    return per_image
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Compute SSIM (reference ``ssim.py:213-276``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(3, 3, 32, 32).astype(np.float32))
+    >>> target = jnp.asarray(np.asarray(preds) * 0.75)
+    >>> round(float(structural_similarity_index_measure(preds, target)), 4)
+    0.9219
+    """
+    preds, target = _ssim_check_inputs(preds, target)
+    out = _ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+        return_full_image, return_contrast_sensitivity,
+    )
+    if isinstance(out, tuple):
+        return reduce(out[0], reduction), out[1]
+    return reduce(out, reduction)
+
+
+def _multiscale_ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """Per-image MS-SSIM (reference ``ssim.py:290-370``)."""
+    if preds.ndim == 5:
+        raise ValueError("`multiscale_ssim` does not support 3D images")
+    sizes = kernel_size if isinstance(kernel_size, Sequence) else [kernel_size] * 2
+    if preds.shape[-1] < 2 ** len(betas) * sizes[-1] // 2 or preds.shape[-2] < 2 ** len(betas) * sizes[0] // 2:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width should be larger"
+            f" than {(2 ** len(betas)) * sizes[0] // 2} after being reduced {len(betas) - 1} times."
+        )
+    sim_list = []
+    cur_p, cur_t = preds, target
+    for i in range(len(betas)):
+        sim, contrast = _ssim_update(
+            cur_p, cur_t, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+            return_contrast_sensitivity=True,
+        )
+        sim_list.append(sim if i == len(betas) - 1 else contrast)
+        if i < len(betas) - 1:
+            cur_p = avg_pool2d(cur_p, 2)
+            cur_t = avg_pool2d(cur_t, 2)
+    stacked = jnp.stack(sim_list)  # (S, B)
+    if normalize == "relu":
+        stacked = jnp.clip(stacked, 0.0, None)
+    betas_arr = jnp.asarray(betas)[:, None]
+    mcs_weighted = stacked**betas_arr
+    out = jnp.prod(mcs_weighted, axis=0)
+    if normalize == "simple":
+        out = (out + 1) / 2
+    return out
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """Compute MS-SSIM (reference ``ssim.py:373-442``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> preds = jnp.asarray(rng.rand(3, 3, 180, 180).astype(np.float32))
+    >>> target = jnp.asarray(np.asarray(preds) * 0.75)
+    >>> round(float(multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)), 4)
+    0.9558
+    """
+    if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
+        raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+    if normalize not in ("relu", "simple", None):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+    preds, target = _ssim_check_inputs(preds, target)
+    out = _multiscale_ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas, normalize
+    )
+    return reduce(out, reduction)

@@ -88,6 +88,10 @@ def kendall_rank_corrcoef(
     """
     if variant not in ("a", "b", "c"):
         raise ValueError(f"Argument `variant` is expected to be one of 'a', 'b', 'c' but got {variant!r}")
+    if t_test and alternative not in ("two-sided", "less", "greater"):
+        raise ValueError(
+            f"Argument `alternative` is expected to be one of 'two-sided', 'less', 'greater' but got {alternative!r}"
+        )
     d = preds.shape[1] if preds.ndim == 2 else 1
     preds, target = _kendall_corrcoef_update(
         preds.astype(jnp.float32), target.astype(jnp.float32), num_outputs=d

@@ -199,23 +199,35 @@ class RetrievalAUROC(_TopKRetrievalMetric):
     """
 
     def _metric_vectorized(self, gq: GroupedQueries) -> Array:
-        km = self._k_mask(gq)
-        # restrict to top-k if requested (reference slices before computing)
-        rel = gq.rel * km
-        nonrel = (1.0 - gq.rel) * km
-        n_rel = gq.seg_sum(rel)
-        n_nonrel = gq.seg_sum(nonrel)
-        # negatives ranked strictly above each relevant doc
-        nonrel_cum = gq.rel_cum * 0  # placeholder to keep dtype
-        cum_nonrel = jnp.cumsum(nonrel)
-        offset = jnp.concatenate([jnp.zeros(1), gq.seg_sum(nonrel).cumsum()[:-1]])
-        nonrel_above_incl = cum_nonrel - offset[gq.group_id]  # inclusive of current (current is rel → not counted)
-        # tie handling: among equal preds within a query, order is arbitrary → give half credit
-        # detect ties via average of "above" counts over tied spans; random float scores rarely tie,
-        # so we use the strict count (matches the reference's sort-based behaviour)
-        credit = jnp.where(rel > 0, n_nonrel[gq.group_id] - nonrel_above_incl, 0.0)
-        u = gq.seg_sum(credit)
-        return _safe_divide(u, n_rel * n_nonrel)
+        import numpy as np
+
+        km = np.asarray(self._k_mask(gq))
+        rel = np.asarray(gq.rel) * km
+        nonrel = (1.0 - np.asarray(gq.rel)) * km
+        g = np.asarray(gq.group_id)
+        pred = np.asarray(gq.preds)
+        # tie runs: consecutive rows (already sorted by (group, -pred)) with equal pred
+        new_run = np.ones(len(g), dtype=bool)
+        if len(g) > 1:
+            new_run[1:] = (g[1:] != g[:-1]) | (pred[1:] != pred[:-1])
+        run_id = np.cumsum(new_run) - 1
+        n_runs = run_id[-1] + 1 if len(g) else 0
+        nonrel_in_run = np.bincount(run_id, weights=nonrel, minlength=n_runs)
+        # nonrel strictly above a run = cumulative nonrel up to the run start, minus group offset
+        cum_nonrel = np.cumsum(nonrel)
+        run_start = np.flatnonzero(new_run)
+        nonrel_before_run = np.concatenate([[0.0], cum_nonrel[run_start[1:] - 1]]) if n_runs else np.zeros(0)
+        group_of_run = g[run_start] if n_runs else np.zeros(0, dtype=g.dtype)
+        group_nonrel_offset = np.concatenate([[0.0], np.bincount(g, weights=nonrel).cumsum()[:-1]])
+        strictly_above = nonrel_before_run - group_nonrel_offset[group_of_run]
+
+        n_rel = np.bincount(g, weights=rel)
+        n_nonrel = np.bincount(g, weights=nonrel)
+        # U-statistic with half credit for prediction ties (trapezoidal ROC):
+        # credit = strictly-below + 0.5 · tied = n_nonrel − strictly_above − 0.5 · tied
+        per_row_credit = n_nonrel[g] - strictly_above[run_id] - 0.5 * nonrel_in_run[run_id]
+        u = np.bincount(g, weights=np.where(rel > 0, per_row_credit, 0.0))
+        return _safe_divide(jnp.asarray(u, dtype=jnp.float32), jnp.asarray(n_rel * n_nonrel, dtype=jnp.float32))
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):

@@ -1,0 +1,157 @@
+"""Image metric tests vs independent scipy/numpy references."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.ndimage import correlate
+
+from metrics_tpu.image import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    PeakSignalNoiseRatioWithBlockedEffect,
+    RootMeanSquaredErrorUsingSlidingWindow,
+    SpatialCorrelationCoefficient,
+    SpectralAngleMapper,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+    VisualInformationFidelity,
+)
+
+_rng = np.random.RandomState(55)
+preds = _rng.rand(2, 3, 48, 48).astype(np.float32)
+target = np.clip(preds + 0.1 * _rng.randn(2, 3, 48, 48).astype(np.float32), 0, 1)
+
+
+def _np_gaussian_kernel(sigma=1.5):
+    size = int(3.5 * sigma + 0.5) * 2 + 1
+    dist = np.arange((1 - size) / 2, (1 + size) / 2)
+    g = np.exp(-(dist**2) / (2 * sigma**2))
+    g = g / g.sum()
+    return np.outer(g, g)
+
+
+def _np_ssim(p, t, data_range=1.0, sigma=1.5, k1=0.01, k2=0.03):
+    """Independent SSIM using scipy.ndimage reflect-mode correlation."""
+    kernel = _np_gaussian_kernel(sigma)
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    vals = []
+    for b in range(p.shape[0]):
+        per_ch = []
+        for c in range(p.shape[1]):
+            x, y = p[b, c].astype(np.float64), t[b, c].astype(np.float64)
+            f = lambda im: correlate(im, kernel, mode="reflect")
+            mx, my = f(x), f(y)
+            sxx = np.clip(f(x * x) - mx**2, 0, None)
+            syy = np.clip(f(y * y) - my**2, 0, None)
+            sxy = f(x * y) - mx * my
+            ssim_map = ((2 * mx * my + c1) * (2 * sxy + c2)) / ((mx**2 + my**2 + c1) * (sxx + syy + c2))
+            per_ch.append(ssim_map)
+        vals.append(np.mean(per_ch))
+    return np.mean(vals)
+
+
+def test_ssim_vs_scipy():
+    m = StructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), _np_ssim(preds, target), atol=2e-4)
+
+
+def test_ssim_identical_is_one():
+    m = StructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(jnp.asarray(preds), jnp.asarray(preds))
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-5)
+
+
+def test_ssim_uniform_kernel_and_full_image():
+    m = StructuralSimilarityIndexMeasure(data_range=1.0, gaussian_kernel=False, kernel_size=7,
+                                         return_full_image=True)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    val, img = m.compute()
+    assert img.shape == preds.shape
+    assert 0 < float(val) <= 1.0
+
+
+def test_ms_ssim_runs_and_bounds():
+    big_p = _rng.rand(2, 1, 200, 200).astype(np.float32)
+    big_t = np.clip(big_p + 0.05 * _rng.randn(2, 1, 200, 200).astype(np.float32), 0, 1)
+    m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(jnp.asarray(big_p), jnp.asarray(big_t))
+    v = float(m.compute())
+    assert 0.5 < v <= 1.0
+    m2 = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+    m2.update(jnp.asarray(big_p), jnp.asarray(big_p))
+    np.testing.assert_allclose(float(m2.compute()), 1.0, atol=1e-5)
+
+
+def test_psnr_vs_numpy():
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    mse = np.mean((preds - target) ** 2)
+    np.testing.assert_allclose(float(m.compute()), 10 * np.log10(1.0 / mse), rtol=1e-5)
+
+
+def test_psnr_auto_data_range_accumulates():
+    m = PeakSignalNoiseRatio()
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p[None]), jnp.asarray(t[None]))
+    dr = target.max() - target.min()
+    mse = np.mean((preds - target) ** 2)
+    np.testing.assert_allclose(float(m.compute()), 10 * np.log10(dr**2 / mse), rtol=1e-4)
+
+
+def test_uqi_identical_is_one():
+    m = UniversalImageQualityIndex()
+    m.update(jnp.asarray(preds), jnp.asarray(preds))
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-4)
+
+
+def test_sam_vs_numpy():
+    m = SpectralAngleMapper()
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    dot = (preds * target).sum(1)
+    den = np.linalg.norm(preds, axis=1) * np.linalg.norm(target, axis=1)
+    ref = np.arccos(np.clip(dot / den, -1, 1)).mean()
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_total_variation_vs_numpy():
+    m = TotalVariation()
+    m.update(jnp.asarray(preds))
+    ref = (np.abs(np.diff(preds, axis=2)).sum() + np.abs(np.diff(preds, axis=3)).sum())
+    np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+
+def test_rmse_sw_identical_zero():
+    m = RootMeanSquaredErrorUsingSlidingWindow()
+    m.update(jnp.asarray(preds), jnp.asarray(preds))
+    np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-6)
+
+
+def test_scc_identical_is_one():
+    m = SpatialCorrelationCoefficient()
+    m.update(jnp.asarray(preds), jnp.asarray(preds))
+    v = float(m.compute())
+    assert v > 0.95  # windows with ~zero variance contribute 0, rest are exactly 1
+
+
+def test_psnrb_greater_for_identical():
+    m1 = PeakSignalNoiseRatioWithBlockedEffect()
+    m1.update(jnp.asarray(preds[:, :1]), jnp.asarray(target[:, :1]))
+    v = float(m1.compute())
+    assert np.isfinite(v) and v > 0
+
+
+def test_vif_identical_near_one():
+    big = _rng.rand(1, 1, 64, 64).astype(np.float32)
+    m = VisualInformationFidelity()
+    m.update(jnp.asarray(big), jnp.asarray(big))
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-3)
+
+
+def test_ergas_zero_for_identical():
+    from metrics_tpu.image import ErrorRelativeGlobalDimensionlessSynthesis
+
+    m = ErrorRelativeGlobalDimensionlessSynthesis()
+    m.update(jnp.asarray(preds), jnp.asarray(preds))
+    np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-5)
