@@ -7,6 +7,7 @@ jit-compiled XLA functions, and distributed sync lowers to XLA collectives over 
 """
 
 from metrics_tpu import (
+    audio,
     classification,
     clustering,
     functional,
@@ -17,6 +18,7 @@ from metrics_tpu import (
     retrieval,
     segmentation,
     shape,
+    text,
     utils,
     wrappers,
 )
@@ -35,6 +37,7 @@ from metrics_tpu.metric import CompositionalMetric, Metric
 __version__ = "0.1.0"
 
 __all__ = [
+    "audio",
     "CatMetric",
     "CompositionalMetric",
     "MaxMetric",
@@ -56,6 +59,7 @@ __all__ = [
     "retrieval",
     "segmentation",
     "shape",
+    "text",
     "utils",
     "wrappers",
 ]

@@ -1,0 +1,25 @@
+"""Modular audio metrics (reference ``torchmetrics/audio/__init__.py``).
+
+PESQ/STOI/SRMR/DNSMOS/NISQA depend on optional host-side packages (C libs /
+onnxruntime, SURVEY §2.9) and are import-gated like the reference.
+"""
+
+from metrics_tpu.audio.metrics import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+
+__all__ = [
+    "ComplexScaleInvariantSignalNoiseRatio",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+    "SourceAggregatedSignalDistortionRatio",
+]

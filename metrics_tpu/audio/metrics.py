@@ -1,0 +1,190 @@
+"""Modular audio metrics (reference ``torchmetrics/audio/`` — sum-of-values + total states)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.audio.metrics import (
+    complex_scale_invariant_signal_noise_ratio,
+    permutation_invariant_training,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from metrics_tpu.metric import Metric
+
+
+class _AveragedAudioMetric(Metric):
+    """Shared plumbing: Σ metric values + count."""
+
+    is_differentiable = True
+    full_state_update = False
+    sum_value: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_value", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _metric(self, preds: Array, target: Array) -> Array:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        values = self._metric(preds, target)
+        self.sum_value = self.sum_value + values.sum()
+        self.total = self.total + values.size
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return (self.sum_value / self.total).astype(jnp.float32)
+
+
+class SignalNoiseRatio(_AveragedAudioMetric):
+    """SNR (reference ``audio/snr.py:27``).
+
+    >>> import jax.numpy as jnp
+    >>> metric = SignalNoiseRatio()
+    >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+    >>> metric.compute()
+    Array(16.1805, dtype=float32)
+    """
+
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return signal_noise_ratio(preds, target, self.zero_mean)
+
+
+class ScaleInvariantSignalDistortionRatio(_AveragedAudioMetric):
+    """SI-SDR (reference ``audio/sdr.py`` class).
+
+    >>> import jax.numpy as jnp
+    >>> metric = ScaleInvariantSignalDistortionRatio()
+    >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+    >>> metric.compute()
+    Array(18.4030, dtype=float32)
+    """
+
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_distortion_ratio(preds, target, self.zero_mean)
+
+
+class ScaleInvariantSignalNoiseRatio(_AveragedAudioMetric):
+    """SI-SNR (reference ``audio/snr.py`` class)."""
+
+    higher_is_better = True
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_noise_ratio(preds, target)
+
+
+class ComplexScaleInvariantSignalNoiseRatio(_AveragedAudioMetric):
+    """C-SI-SNR (reference ``audio/snr.py`` class)."""
+
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return complex_scale_invariant_signal_noise_ratio(preds, target, self.zero_mean)
+
+
+class SignalDistortionRatio(_AveragedAudioMetric):
+    """SDR with the optimal distortion filter (reference ``audio/sdr.py:30``)."""
+
+    higher_is_better = True
+
+    def __init__(
+        self,
+        use_cg_iter: Any = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Any = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+
+
+class SourceAggregatedSignalDistortionRatio(_AveragedAudioMetric):
+    """SA-SDR (reference ``audio/sdr.py`` class)."""
+
+    higher_is_better = True
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        self.scale_invariant = scale_invariant
+        self.zero_mean = zero_mean
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return source_aggregated_signal_distortion_ratio(preds, target, self.scale_invariant, self.zero_mean)
+
+
+class PermutationInvariantTraining(_AveragedAudioMetric):
+    """PIT wrapper (reference ``audio/pit.py:28``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from metrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+    >>> rng = np.random.RandomState(42)
+    >>> target = jnp.asarray(rng.randn(2, 2, 100).astype(np.float32))
+    >>> preds = jnp.asarray(np.asarray(target)[:, ::-1])
+    >>> metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
+    >>> metric.update(preds, target)
+    >>> float(metric.compute()) > 30
+    True
+    """
+
+    higher_is_better = True
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        mode: str = "speaker-wise",
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        base_kwargs = {k: kwargs.pop(k) for k in list(kwargs) if k in (
+            "compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn",
+            "distributed_available_fn", "sync_on_compute", "compute_with_cache", "jit_update",
+        )}
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.metric_kwargs = kwargs
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        best_metric, _ = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.metric_kwargs
+        )
+        return best_metric

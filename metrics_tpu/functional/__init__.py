@@ -1,6 +1,7 @@
 """Stateless functional metrics layer (reference ``torchmetrics/functional/__init__.py``)."""
 
 from metrics_tpu.functional import (
+    audio,
     classification,
     clustering,
     image,
@@ -10,6 +11,7 @@ from metrics_tpu.functional import (
     retrieval,
     segmentation,
     shape,
+    text,
 )
 from metrics_tpu.functional.pairwise import (
     pairwise_cosine_similarity,
@@ -20,6 +22,7 @@ from metrics_tpu.functional.pairwise import (
 )
 
 __all__ = [
+    "audio",
     "classification",
     "clustering",
     "image",
@@ -34,4 +37,5 @@ __all__ = [
     "retrieval",
     "segmentation",
     "shape",
+    "text",
 ]

@@ -1,0 +1,23 @@
+"""Functional audio metrics (reference ``torchmetrics/functional/audio/__init__.py``)."""
+
+from metrics_tpu.functional.audio.metrics import (
+    complex_scale_invariant_signal_noise_ratio,
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+
+__all__ = [
+    "complex_scale_invariant_signal_noise_ratio",
+    "permutation_invariant_training",
+    "pit_permutate",
+    "scale_invariant_signal_distortion_ratio",
+    "scale_invariant_signal_noise_ratio",
+    "signal_distortion_ratio",
+    "signal_noise_ratio",
+    "source_aggregated_signal_distortion_ratio",
+]

@@ -1,0 +1,244 @@
+"""Audio kernels.
+
+Parity with reference ``functional/audio/``: ``snr.py``, ``sdr.py`` (Toeplitz
+autocorrelation + linear solve, ``:28-199``), ``pit.py`` (permutation search,
+``:42-66``), ``sa_sdr.py``. TPU-first choices:
+
+* SDR's Toeplitz system is built with one FFT autocorrelation and solved with a
+  dense ``jnp.linalg.solve`` (512×512) — batched over (batch, channel) by vmap.
+* PIT enumerates permutations statically (itertools at trace time) and reduces with
+  one stacked max/min — no host loop, no scipy Hungarian on the hot path (valid for
+  the ≤8-source regime; SURVEY §2.8).
+"""
+
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR (reference ``snr.py:24-72``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+    >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+    >>> signal_noise_ratio(preds, target)
+    Array(16.1805, dtype=float32)
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(jnp.float32).eps
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    noise = target - preds
+    return 10 * jnp.log10((jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps))
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR (reference ``sdr.py`` ``scale_invariant_signal_distortion_ratio``).
+
+    >>> import jax.numpy as jnp
+    >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+    >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+    >>> scale_invariant_signal_distortion_ratio(preds, target)
+    Array(18.4030, dtype=float32)
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(jnp.float32).eps
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    return 10 * jnp.log10((jnp.sum(target_scaled**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps))
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR (reference ``snr.py`` ``scale_invariant_signal_noise_ratio``): SI-SDR with zero-mean."""
+    return scale_invariant_signal_distortion_ratio(preds, target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """C-SI-SNR on complex spectra (reference ``snr.py`` ``complex_scale_invariant_signal_noise_ratio``).
+
+    Inputs either complex arrays (..., F, T) or real arrays (..., F, T, 2).
+    """
+    if not jnp.iscomplexobj(preds):
+        if preds.shape[-1] != 2:
+            raise RuntimeError(
+                "Expected `preds` and `target` to be complex tensors or real tensors with last dim 2,"
+                f" but got {preds.shape}"
+            )
+        preds = preds[..., 0] + 1j * preds[..., 1]
+        target = target[..., 0] + 1j * target[..., 1]
+    p = jnp.stack([preds.real, preds.imag], axis=-1).reshape(*preds.shape[:-2], -1)
+    t = jnp.stack([target.real, target.imag], axis=-1).reshape(*target.shape[:-2], -1)
+    return scale_invariant_signal_distortion_ratio(p, t, zero_mean=zero_mean)
+
+
+def source_aggregated_signal_distortion_ratio(
+    preds: Array, target: Array, scale_invariant: bool = True, zero_mean: bool = False
+) -> Array:
+    """SA-SDR (reference ``sa_sdr.py:24-80``): one ratio over all sources' concatenated energy."""
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(jnp.float32).eps
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    if scale_invariant:
+        alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+            jnp.sum(target**2, axis=-1, keepdims=True) + eps
+        )
+        target = alpha * target
+    distortion = target - preds
+    # aggregate energies over the source dim (second to last)
+    num = jnp.sum(target**2, axis=(-2, -1))
+    den = jnp.sum(distortion**2, axis=(-2, -1))
+    return 10 * jnp.log10((num + eps) / (den + eps))
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Any = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Any = None,
+) -> Array:
+    """Full BSS-eval SDR with an optimal distortion filter (reference ``sdr.py:28-199``).
+
+    The length-L FIR that best maps target→preds is found by solving the L×L
+    Toeplitz normal equations; the autocorrelation/cross-correlation are computed
+    with one rfft of length ≥ 2·n (XLA-native), and the solve is a dense batched
+    ``jnp.linalg.solve`` (L=512) on the MXU.
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> target = jnp.asarray(rng.randn(8000).astype(np.float32))
+    >>> preds = jnp.asarray(np.asarray(target) + 0.1 * rng.randn(8000).astype(np.float32))
+    >>> float(signal_distortion_ratio(preds, target)) > 15
+    True
+    """
+    if use_cg_iter is not None:
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(
+            "`use_cg_iter` is ignored: the Toeplitz system is solved densely on the MXU,"
+            " which is faster than CG at filter_length=512.",
+            UserWarning,
+        )
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    target = target.astype(preds.dtype)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    eps = jnp.finfo(preds.dtype).eps
+
+    n = preds.shape[-1]
+    lag = filter_length
+    fft_len = 1
+    while fft_len < n + lag:
+        fft_len *= 2
+
+    tf = jnp.fft.rfft(target, fft_len, axis=-1)
+    pf = jnp.fft.rfft(preds, fft_len, axis=-1)
+    # autocorrelation of target (first `lag` lags) and cross-correlation target↔preds
+    acf = jnp.fft.irfft(tf * jnp.conj(tf), fft_len, axis=-1)[..., :lag]
+    xcorr = jnp.fft.irfft(jnp.conj(tf) * pf, fft_len, axis=-1)[..., :lag]
+
+    # Toeplitz normal equations R w = b
+    idx = jnp.abs(jnp.arange(lag)[:, None] - jnp.arange(lag)[None, :])
+    r_mat = acf[..., idx]  # (..., L, L)
+    if load_diag is not None:
+        r_mat = r_mat + load_diag * jnp.eye(lag, dtype=r_mat.dtype)
+    else:
+        r_mat = r_mat + eps * acf[..., :1, None].max() * jnp.eye(lag, dtype=r_mat.dtype)
+    sol = jnp.linalg.solve(r_mat, xcorr[..., None])[..., 0]
+
+    # projection energy of preds onto the span of shifted targets
+    num = jnp.sum(sol * xcorr, axis=-1)
+    den = jnp.sum(preds**2, axis=-1) - num
+    ratio = (num + eps) / (den + eps)
+    return (10 * jnp.log10(jnp.clip(ratio, eps, None))).astype(jnp.float32)
+
+
+def permutation_invariant_training(
+    preds: Array,
+    target: Array,
+    metric_func: Callable,
+    mode: str = "speaker-wise",
+    eval_func: str = "max",
+    **kwargs: Any,
+) -> Tuple[Array, Array]:
+    """PIT (reference ``pit.py:42-135``): best metric over source permutations.
+
+    ``preds``/``target`` are (batch, spk, time). The S! permutations are enumerated
+    statically and reduced with one stacked max/min (S ≤ 8 regime).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> target = jnp.asarray(rng.randn(2, 2, 100).astype(np.float32))
+    >>> preds = jnp.asarray(np.asarray(target)[:, ::-1])  # swapped speakers
+    >>> best, perm = permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio)
+    >>> np.asarray(perm[0])
+    array([1, 0], dtype=int32)
+    """
+    if preds.ndim < 2:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {preds.shape}")
+    if eval_func not in ("max", "min"):
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if mode not in ("speaker-wise", "permutation-wise"):
+        raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+    spk = preds.shape[1]
+    perms = list(permutations(range(spk)))
+    if mode == "speaker-wise":
+        # metric matrix (batch, pred_spk, target_spk), then sum per permutation
+        metric_mtx = jnp.stack(
+            [
+                jnp.stack([metric_func(preds[:, i], target[:, j], **kwargs) for j in range(spk)], axis=-1)
+                for i in range(spk)
+            ],
+            axis=-2,
+        )  # (batch, pred, target)
+        perm_scores = jnp.stack(
+            [metric_mtx[:, jnp.arange(spk), jnp.asarray(p)].mean(-1) for p in perms], axis=-1
+        )  # (batch, n_perms)
+    else:
+        perm_scores = jnp.stack(
+            [metric_func(preds[:, jnp.asarray(p)], target, **kwargs).mean(-1) for p in perms], axis=-1
+        )
+    best_idx = jnp.argmax(perm_scores, axis=-1) if eval_func == "max" else jnp.argmin(perm_scores, axis=-1)
+    best_metric = jnp.take_along_axis(perm_scores, best_idx[:, None], axis=-1)[:, 0]
+    # convention (reference pit.py): best_perm[j] = index of the prediction matching
+    # target j, so ``pit_permutate(preds, best_perm)`` aligns preds to the targets.
+    # speaker-wise scored pred i ↔ target p[i] (needs inversion); permutation-wise
+    # already scored preds[:, p] against target directly.
+    perm_arr = jnp.asarray(perms, dtype=jnp.int32)
+    if mode == "speaker-wise":
+        perm_arr = jnp.argsort(perm_arr, axis=-1)
+    best_perm = perm_arr[best_idx]
+    return best_metric, best_perm
+
+
+def pit_permutate(preds: Array, perm: Array) -> Array:
+    """Reorder sources by the PIT permutation (reference ``pit.py:138-160``)."""
+    return jnp.take_along_axis(preds, perm[..., None], axis=1)

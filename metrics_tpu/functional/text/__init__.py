@@ -1,0 +1,32 @@
+"""Functional text metrics (reference ``torchmetrics/functional/text/__init__.py``)."""
+
+from metrics_tpu.functional.text.bleu import bleu_score, sacre_bleu_score
+from metrics_tpu.functional.text.chrf import chrf_score
+from metrics_tpu.functional.text.error_rates import (
+    char_error_rate,
+    edit_distance,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from metrics_tpu.functional.text.misc import extended_edit_distance, squad, translation_edit_rate
+from metrics_tpu.functional.text.perplexity import perplexity
+from metrics_tpu.functional.text.rouge import rouge_score
+
+__all__ = [
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "edit_distance",
+    "extended_edit_distance",
+    "match_error_rate",
+    "perplexity",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
+]

@@ -1,0 +1,151 @@
+"""BLEU / SacreBLEU kernels (reference ``functional/text/bleu.py``, ``sacre_bleu.py``).
+
+Host-side n-gram counting (tokenization never belongs on the TPU); the states are
+four counter vectors + two length scalars, all sum-reducible across the mesh.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _ngram_counts, _tokenize_13a, _tokenize_chars, _tokenize_words
+
+
+def _get_tokenizer(tokenize: str):
+    if tokenize == "13a":
+        return _tokenize_13a
+    if tokenize == "char":
+        return _tokenize_chars
+    if tokenize == "none":
+        return _tokenize_words
+    if tokenize == "intl":  # approximation: 13a covers the latin-script behaviour
+        return _tokenize_13a
+    raise ValueError(f"Unsupported tokenizer selected. Please, choose one of ('none', '13a', 'intl', 'char')")
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: np.ndarray,
+    denominator: np.ndarray,
+    preds_len: float,
+    target_len: float,
+    n_gram: int = 4,
+    tokenizer=_tokenize_words,
+) -> Tuple[np.ndarray, np.ndarray, float, float]:
+    """Accumulate clipped n-gram matches (reference ``bleu.py:29-79``)."""
+    target_corpus = [[tokenizer(t) for t in ref_group] for ref_group in target]
+    preds_tokens = [tokenizer(p) for p in preds]
+    for pred, refs in zip(preds_tokens, target_corpus):
+        preds_len += len(pred)
+        target_len_list = [len(r) for r in refs]
+        target_len += min(target_len_list, key=lambda x: (abs(x - len(pred)), x))
+        pred_counter = _ngram_counts(pred, n_gram)
+        target_counter: Counter = Counter()
+        for r in refs:
+            target_counter |= _ngram_counts(r, n_gram)
+        clipped = pred_counter & target_counter
+        for ngram, count in clipped.items():
+            numerator[len(ngram) - 1] += count
+        for ngram, count in pred_counter.items():
+            denominator[len(ngram) - 1] += count
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int = 4,
+    weights: Optional[Sequence[float]] = None,
+    smooth: bool = False,
+) -> Array:
+    """BLEU from accumulated counters (reference ``bleu.py:82-120``)."""
+    weights_arr = jnp.asarray(weights if weights is not None else [1.0 / n_gram] * n_gram)
+    device_numerator = jnp.asarray(numerator, dtype=jnp.float32)
+    device_denominator = jnp.asarray(denominator, dtype=jnp.float32)
+    if smooth:
+        precision_scores = jnp.concatenate(
+            [
+                ((device_numerator[:1] ) / (device_denominator[:1])),
+                (device_numerator[1:] + 1.0) / (device_denominator[1:] + 1.0),
+            ]
+        )
+    else:
+        precision_scores = jnp.where(
+            device_denominator > 0, device_numerator / jnp.maximum(device_denominator, 1.0), 0.0
+        )
+    zero_match = device_numerator.sum() == 0
+    log_precision = jnp.where(precision_scores > 0, jnp.log(jnp.where(precision_scores > 0, precision_scores, 1.0)),
+                              -jnp.inf)
+    geometric_mean = jnp.exp(jnp.sum(weights_arr * log_precision))
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
+    bleu = brevity_penalty * geometric_mean
+    return jnp.where(zero_match, 0.0, bleu).astype(jnp.float32)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[Sequence[str], Sequence[Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """Compute BLEU score (reference ``bleu.py:123-178``).
+
+    >>> preds = ['the cat is on the mat']
+    >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+    >>> bleu_score(preds, target)
+    Array(0.7598, dtype=float32)
+    """
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, 0.0, 0.0, n_gram
+    )
+    return _bleu_score_compute(
+        jnp.asarray(preds_len), jnp.asarray(target_len), numerator, denominator, n_gram, weights, smooth
+    )
+
+
+def sacre_bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """Compute SacreBLEU (reference ``sacre_bleu.py:89-160``).
+
+    >>> preds = ['the cat is on the mat']
+    >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+    >>> sacre_bleu_score(preds, target)
+    Array(0.7598, dtype=float32)
+    """
+    tokenizer = _get_tokenizer(tokenize)
+    preds_ = [p.lower() if lowercase else p for p in preds]
+    target_ = [[(t.lower() if lowercase else t) for t in refs] for refs in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, 0.0, 0.0, n_gram, tokenizer
+    )
+    return _bleu_score_compute(
+        jnp.asarray(preds_len), jnp.asarray(target_len), numerator, denominator, n_gram, weights, smooth
+    )

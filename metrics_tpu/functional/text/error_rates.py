@@ -1,0 +1,163 @@
+"""Word/char error-rate kernels.
+
+Parity with reference ``functional/text/``: ``wer.py``, ``cer.py``, ``mer.py``,
+``wil.py``, ``wip.py``, ``edit.py``. Host-side DP produces the counter increments;
+the states are plain sums.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _edit_distance, _edit_distance_counts, _tokenize_words
+
+
+def _as_list(x: Union[str, List[str]]) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Σ edit distance and Σ target words (reference ``wer.py:24-45``)."""
+    preds, target = _as_list(preds), _as_list(target)
+    errors = 0
+    total = 0
+    for p, t in zip(preds, target):
+        pt, tt = _tokenize_words(p), _tokenize_words(t)
+        errors += _edit_distance(pt, tt)
+        total += len(tt)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word error rate (reference ``wer.py:48-80``).
+
+    >>> preds = ["this is the prediction", "there is an other sample"]
+    >>> target = ["this is the reference", "there is another one"]
+    >>> word_error_rate(preds, target)
+    Array(0.5, dtype=float32)
+    """
+    errors, total = _wer_update(preds, target)
+    return (errors / total).astype(jnp.float32)
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Σ char edit distance and Σ target chars (reference ``cer.py:24-45``)."""
+    preds, target = _as_list(preds), _as_list(target)
+    errors = 0
+    total = 0
+    for p, t in zip(preds, target):
+        errors += _edit_distance(list(p), list(t))
+        total += len(t)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Character error rate (reference ``cer.py:48-78``).
+
+    >>> char_error_rate(["this is the prediction"], ["this is the reference"])
+    Array(0.3181818, dtype=float32)
+    """
+    errors, total = _cer_update(preds, target)
+    return (errors / total).astype(jnp.float32)
+
+
+def _mer_wil_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array, Array]:
+    """(errors, total_mer, hits·H/N1 pieces) for MER/WIL/WIP (reference ``mer.py``/``wil.py``/``wip.py``)."""
+    preds, target = _as_list(preds), _as_list(target)
+    errors = 0
+    total_mer = 0
+    total_hits = 0.0
+    target_total = 0
+    preds_total = 0
+    for p, t in zip(preds, target):
+        pt, tt = _tokenize_words(p), _tokenize_words(t)
+        s, d, i, h = _edit_distance_counts(pt, tt)
+        errors += s + d + i
+        total_mer += s + d + h + i
+        total_hits += h
+        target_total += len(tt)
+        preds_total += len(pt)
+    return (
+        jnp.asarray(float(errors)),
+        jnp.asarray(float(total_mer)),
+        jnp.asarray(float(total_hits)),
+        jnp.asarray([float(target_total), float(preds_total)]),
+    )
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate (reference ``mer.py:47-77``).
+
+    >>> preds = ["this is the prediction", "there is an other sample"]
+    >>> target = ["this is the reference", "there is another one"]
+    >>> match_error_rate(preds, target)
+    Array(0.44444445, dtype=float32)
+    """
+    errors, total, _, _ = _mer_wil_update(preds, target)
+    return (errors / total).astype(jnp.float32)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information preserved (reference ``wip.py:45-74``).
+
+    >>> preds = ["this is the prediction", "there is an other sample"]
+    >>> target = ["this is the reference", "there is another one"]
+    >>> word_information_preserved(preds, target)
+    Array(0.3472222, dtype=float32)
+    """
+    _, _, hits, lens = _mer_wil_update(preds, target)
+    return (hits / lens[0] * hits / lens[1]).astype(jnp.float32)
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information lost (reference ``wil.py:45-76``).
+
+    >>> preds = ["this is the prediction", "there is an other sample"]
+    >>> target = ["this is the reference", "there is another one"]
+    >>> word_information_lost(preds, target)
+    Array(0.6527778, dtype=float32)
+    """
+    return (1 - word_information_preserved(preds, target)).astype(jnp.float32)
+
+
+def edit_distance(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    substitution_cost: int = 1,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Character edit distance (reference ``edit.py:24-81``).
+
+    >>> edit_distance(["rain"], ["shine"])
+    Array(3., dtype=float32)
+    """
+    preds, target = _as_list(preds), _as_list(target)
+    if substitution_cost == 1:
+        dists = [_edit_distance(list(p), list(t)) for p, t in zip(preds, target)]
+    else:
+        dists = []
+        for p, t in zip(preds, target):
+            import numpy as np
+
+            m, n = len(p), len(t)
+            dp = np.zeros((m + 1, n + 1), dtype=np.int64)
+            dp[:, 0] = np.arange(m + 1)
+            dp[0, :] = np.arange(n + 1)
+            for i in range(1, m + 1):
+                for j in range(1, n + 1):
+                    cost = 0 if p[i - 1] == t[j - 1] else substitution_cost
+                    dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1, dp[i - 1, j - 1] + cost)
+            dists.append(int(dp[m, n]))
+    arr = jnp.asarray(dists, dtype=jnp.float32)
+    if reduction == "mean":
+        return arr.mean()
+    if reduction == "sum":
+        return arr.sum()
+    if reduction is None or reduction == "none":
+        return arr
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")

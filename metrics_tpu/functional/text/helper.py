@@ -1,0 +1,114 @@
+"""Text-metric helpers: edit distance, tokenization, n-gram counting.
+
+Parity with reference ``functional/text/helper.py`` (edit-distance DP) and the
+tokenizer scaffolding in ``functional/text/``. Tokenization never belongs on the
+TPU (SURVEY §2.8) — these run host-side; only the resulting counters become device
+state.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
+    """Levenshtein distance via numpy DP rows (reference ``text/helper.py`` ``_edit_distance``)."""
+    n = len(reference_tokens)
+    prev = np.arange(n + 1)
+    for i, p_tok in enumerate(prediction_tokens, start=1):
+        cur = np.empty(n + 1, dtype=np.int64)
+        cur[0] = i
+        sub = prev[:-1] + np.asarray([p_tok != r_tok for r_tok in reference_tokens])
+        # cur[j] = min(prev[j]+1, cur[j-1]+1, sub[j-1]) — resolve the cur[j-1] chain with a scan
+        best = np.minimum(prev[1:] + 1, sub)
+        cur_j = cur[0]
+        for j in range(1, n + 1):
+            cur_j = min(best[j - 1], cur_j + 1)
+            cur[j] = cur_j
+        prev = cur
+    return int(prev[-1])
+
+
+def _edit_distance_counts(pred_tokens: Sequence, ref_tokens: Sequence) -> Tuple[int, int, int, int]:
+    """(substitutions, deletions, insertions, hits) via full DP backtrack-free counting."""
+    m, n = len(pred_tokens), len(ref_tokens)
+    dp = np.zeros((m + 1, n + 1), dtype=np.int64)
+    dp[:, 0] = np.arange(m + 1)
+    dp[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            cost = 0 if pred_tokens[i - 1] == ref_tokens[j - 1] else 1
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1, dp[i - 1, j - 1] + cost)
+    # backtrack to count operation types
+    i, j = m, n
+    s = d = ins = h = 0
+    while i > 0 or j > 0:
+        if i > 0 and j > 0 and dp[i, j] == dp[i - 1, j - 1] + (0 if pred_tokens[i - 1] == ref_tokens[j - 1] else 1):
+            if pred_tokens[i - 1] == ref_tokens[j - 1]:
+                h += 1
+            else:
+                s += 1
+            i, j = i - 1, j - 1
+        elif i > 0 and dp[i, j] == dp[i - 1, j] + 1:
+            ins += 1
+            i -= 1
+        else:
+            d += 1
+            j -= 1
+    return s, d, ins, h
+
+
+def _tokenize_words(text: str) -> List[str]:
+    return text.split()
+
+
+def _tokenize_chars(text: str) -> List[str]:
+    return list(text)
+
+
+_13A_RE = [
+    (re.compile(r"<skipped>"), ""),
+    (re.compile(r"-\n"), ""),
+    (re.compile(r"\n"), " "),
+]
+_13A_TOK = [
+    (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+    (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+    (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+    (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+]
+
+
+def _tokenize_13a(line: str) -> List[str]:
+    """Moses/mteval-13a tokenization (reference ``sacre_bleu.py`` ``_SacreBLEUTokenizer``)."""
+    for pat, rep in _13A_RE:
+        line = pat.sub(rep, line)
+    line = f" {line} "
+    for pat, rep in _13A_TOK:
+        line = pat.sub(rep, line)
+    return line.split()
+
+
+def _ngram_counts(tokens: Sequence, max_n: int) -> Counter:
+    """Counter over n-grams of order 1..max_n (reference ``bleu.py`` ``_count_ngram``)."""
+    counts: Counter = Counter()
+    for n in range(1, max_n + 1):
+        for i in range(len(tokens) - n + 1):
+            counts[tuple(tokens[i : i + n])] += 1
+    return counts
+
+
+_SQUAD_ARTICLES = re.compile(r"\b(a|an|the)\b")
+_SQUAD_PUNCT = re.compile(r"[^\w\s]")
+
+
+def _squad_normalize(text: str) -> str:
+    """SQuAD answer normalization: lowercase, strip punctuation/articles/whitespace."""
+    text = text.lower()
+    text = _SQUAD_PUNCT.sub("", text)
+    text = _SQUAD_ARTICLES.sub(" ", text)
+    return " ".join(text.split())

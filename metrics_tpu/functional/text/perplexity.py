@@ -1,0 +1,59 @@
+"""Perplexity kernels (reference ``functional/text/perplexity.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """Σ -log p(target) and token count, mask-based ignore (reference ``perplexity.py:26-69``)."""
+    if preds.ndim != 3:
+        raise ValueError(f"Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+                         f" but got {preds.ndim}.")
+    if target.ndim != 2:
+        raise ValueError(f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len],"
+                         f" but got {target.ndim}.")
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            f"Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    import jax
+
+    preds = preds.reshape(-1, preds.shape[-1]).astype(jnp.float32)
+    target = target.reshape(-1)
+    # reference semantics (perplexity.py): preds are ALWAYS treated as logits
+    log_probs = jax.nn.log_softmax(preds, axis=-1)
+    if ignore_index is not None:
+        valid = target != ignore_index
+        safe_target = jnp.where(valid, target, 0)
+    else:
+        valid = jnp.ones_like(target, dtype=bool)
+        safe_target = target
+    picked = jnp.take_along_axis(log_probs, safe_target[:, None], axis=-1)[:, 0]
+    total_log_probs = -jnp.sum(jnp.where(valid, picked, 0.0))
+    count = jnp.sum(valid)
+    return total_log_probs, count
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    """exp(mean nll) (reference ``perplexity.py:72-84``)."""
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Compute perplexity (reference ``perplexity.py:87-118``).
+
+    >>> import jax, jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(22)
+    >>> preds = jnp.asarray(rng.rand(2, 8, 5).astype(np.float32) * 10)
+    >>> target = jnp.asarray(rng.randint(5, size=(2, 8)))
+    >>> float(perplexity(preds, target)) > 1
+    True
+    """
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

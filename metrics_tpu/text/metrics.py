@@ -1,0 +1,510 @@
+"""Modular text metrics.
+
+Parity with reference ``torchmetrics/text/``: ``wer.py``, ``cer.py``, ``mer.py``,
+``wil.py``, ``wip.py``, ``edit.py:113-116``, ``perplexity.py:78-79``, ``bleu.py``,
+``sacre_bleu.py``, ``chrf.py``, ``rouge.py:144``, ``ter.py``, ``eed.py``,
+``squad.py``. Text metrics keep sum-counter states (mesh-reducible); strings are
+processed host-side at update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _get_tokenizer
+from metrics_tpu.functional.text.chrf import _chrf_counters
+from metrics_tpu.functional.text.error_rates import (
+    _as_list,
+    _cer_update,
+    _mer_wil_update,
+    _wer_update,
+    edit_distance as _edit_distance_fn,
+)
+from metrics_tpu.functional.text.helper import _tokenize_words
+from metrics_tpu.functional.text.misc import extended_edit_distance, squad, translation_edit_rate
+from metrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+from metrics_tpu.functional.text.rouge import rouge_score
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+_TEXT_KW = {"__jit_ineligible__": True}
+
+
+class _ErrorRateMetric(Metric):
+    """Shared plumbing: errors/total sum states over host-side token DP."""
+
+    __jit_ineligible__ = True  # string inputs are host data
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    errors: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return (self.errors / self.total).astype(jnp.float32)
+
+
+class WordErrorRate(_ErrorRateMetric):
+    """Word error rate (reference ``text/wer.py:27``).
+
+    >>> preds = ["this is the prediction", "there is an other sample"]
+    >>> target = ["this is the reference", "there is another one"]
+    >>> wer = WordErrorRate()
+    >>> wer.update(preds, target)
+    >>> wer.compute()
+    Array(0.5, dtype=float32)
+    """
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Update state with predictions and targets."""
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+
+class CharErrorRate(_ErrorRateMetric):
+    """Character error rate (reference ``text/cer.py:27``)."""
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Update state with predictions and targets."""
+        errors, total = _cer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+
+class MatchErrorRate(_ErrorRateMetric):
+    """Match error rate (reference ``text/mer.py:27``)."""
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Update state with predictions and targets."""
+        errors, total, _, _ = _mer_wil_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+
+class WordInfoPreserved(Metric):
+    """Word information preserved (reference ``text/wip.py:27``)."""
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("total_hits", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Update state with predictions and targets."""
+        _, _, hits, lens = _mer_wil_update(preds, target)
+        self.total_hits = self.total_hits + hits
+        self.target_total = self.target_total + lens[0]
+        self.preds_total = self.preds_total + lens[1]
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return (self.total_hits / self.target_total * self.total_hits / self.preds_total).astype(jnp.float32)
+
+
+class WordInfoLost(WordInfoPreserved):
+    """Word information lost (reference ``text/wil.py:27``)."""
+
+    higher_is_better = False
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return (1 - super().compute()).astype(jnp.float32)
+
+
+class EditDistance(Metric):
+    """Character edit distance (reference ``text/edit.py:26``, states ``:113-116``).
+
+    >>> metric = EditDistance()
+    >>> metric.update(["rain"], ["shine"])
+    >>> metric.compute()
+    Array(3., dtype=float32)
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, substitution_cost: int = 1, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(substitution_cost, int) and substitution_cost >= 0):
+            raise ValueError("Expected argument `substitution_cost` to be a positive integer")
+        self.substitution_cost = substitution_cost
+        if reduction not in ("mean", "sum", "none", None):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        if reduction in ("mean", "sum"):
+            self.add_state("edit_scores_list", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("num_elements", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.add_state("edit_scores", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Update state with predictions and targets."""
+        dists = _edit_distance_fn(preds, target, self.substitution_cost, reduction="none")
+        if self.reduction in ("mean", "sum"):
+            self.edit_scores_list = self.edit_scores_list + dists.sum()
+            self.num_elements = self.num_elements + dists.shape[0]
+        else:
+            self.edit_scores.append(dists)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        if self.reduction == "mean":
+            return self.edit_scores_list / self.num_elements
+        if self.reduction == "sum":
+            return self.edit_scores_list
+        return dim_zero_cat(self.edit_scores)
+
+
+class Perplexity(Metric):
+    """Perplexity (reference ``text/perplexity.py:27``, states ``:78-79``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(22)
+    >>> metric = Perplexity()
+    >>> metric.update(jnp.asarray(rng.rand(2, 8, 5).astype(np.float32) * 10), jnp.asarray(rng.randint(5, size=(2, 8))))
+    >>> float(metric.compute()) > 1
+    True
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with log-probs/logits and targets."""
+        total, count = _perplexity_update(preds, target, self.ignore_index)
+        self.total_log_probs = self.total_log_probs + total
+        self.count = self.count + count
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _perplexity_compute(self.total_log_probs, self.count)
+
+
+class BLEUScore(Metric):
+    """BLEU score (reference ``text/bleu.py:30``).
+
+    >>> preds = ['the cat is on the mat']
+    >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+    >>> bleu = BLEUScore()
+    >>> bleu.update(preds, target)
+    >>> bleu.compute()
+    Array(0.7598, dtype=float32)
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+        self._tokenizer = _tokenize_words
+        self.add_state("preds_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        """Update state with predictions and reference corpora."""
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+        numerator = np.zeros(self.n_gram)
+        denominator = np.zeros(self.n_gram)
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_, target_, numerator, denominator, 0.0, 0.0, self.n_gram, self._tokenizer
+        )
+        self.numerator = self.numerator + jnp.asarray(numerator)
+        self.denominator = self.denominator + jnp.asarray(denominator)
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.weights, self.smooth
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    """SacreBLEU score (reference ``text/sacre_bleu.py:38``)."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        self._tokenizer = _get_tokenizer(tokenize)
+        self.lowercase = lowercase
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        """Update state with predictions and reference corpora."""
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+        if self.lowercase:
+            preds_ = [p.lower() for p in preds_]
+            target_ = [[t.lower() for t in refs] for refs in target_]
+        super().update(preds_, target_)
+
+
+class CHRFScore(Metric):
+    """chrF / chrF++ score (reference ``text/chrf.py:32``).
+
+    >>> preds = ['the cat is on the mat']
+    >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+    >>> chrf = CHRFScore()
+    >>> chrf.update(preds, target)
+    >>> round(float(chrf.compute()), 4)
+    0.8491
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        total = n_char_order + n_word_order
+        self.add_state("matches", jnp.zeros(total), dist_reduce_fx="sum")
+        self.add_state("preds_totals", jnp.zeros(total), dist_reduce_fx="sum")
+        self.add_state("target_totals", jnp.zeros(total), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        """Update state with predictions and reference corpora."""
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+        matches, pred_totals, target_totals = _chrf_counters(
+            preds_, target_, self.n_char_order, self.n_word_order, self.lowercase, self.whitespace
+        )
+        self.matches = self.matches + jnp.asarray(matches)
+        self.preds_totals = self.preds_totals + jnp.asarray(pred_totals)
+        self.target_totals = self.target_totals + jnp.asarray(target_totals)
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        p_vec = jnp.where(self.preds_totals > 0, self.matches / jnp.maximum(self.preds_totals, 1), 0.0)
+        r_vec = jnp.where(self.target_totals > 0, self.matches / jnp.maximum(self.target_totals, 1), 0.0)
+        b2 = self.beta**2
+        denom = b2 * p_vec + r_vec
+        f_vec = jnp.where(denom > 0, (1 + b2) * p_vec * r_vec / jnp.where(denom > 0, denom, 1.0), 0.0)
+        return f_vec.mean().astype(jnp.float32)
+
+
+class _StringStoreMetric(Metric):
+    """Shared plumbing for text metrics whose compute runs on the raw strings."""
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        # string payloads live outside the array-state system
+        self._preds_store: List = []
+        self._target_store: List = []
+
+    def update(self, preds, target) -> None:
+        """Store inputs for compute."""
+        self._preds_store.extend([preds] if isinstance(preds, str) else list(preds))
+        if isinstance(target, str):
+            self._target_store.append(target)
+        else:
+            self._target_store.extend(list(target))
+
+    def reset(self) -> None:
+        """Reset stored strings too."""
+        super().reset()
+        self._preds_store = []
+        self._target_store = []
+
+
+class ROUGEScore(_StringStoreMetric):
+    """ROUGE score (reference ``text/rouge.py:31``, list states ``:144``).
+
+    >>> rouge = ROUGEScore()
+    >>> rouge.update("My name is John", "Is your name John")
+    >>> sorted(rouge.compute())[:2]
+    ['rouge1_fmeasure', 'rouge1_precision']
+    """
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, use_stemmer: bool = False, accumulate: str = "best",
+                 rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.rouge_keys = rouge_keys
+        self.accumulate = accumulate
+        self.use_stemmer = use_stemmer  # stemming requires nltk; plain tokenization otherwise
+
+    def compute(self) -> Dict[str, Array]:
+        """Compute metric."""
+        return rouge_score(
+            self._preds_store, self._target_store, self.accumulate, self.use_stemmer, self.rouge_keys
+        )
+
+
+class TranslationEditRate(_StringStoreMetric):
+    """Translation edit rate (reference ``text/ter.py:30``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, normalize: bool = False, no_punctuation: bool = False, lowercase: bool = True,
+                 asian_support: bool = False, return_sentence_level_score: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
+        self.return_sentence_level_score = return_sentence_level_score
+
+    def compute(self):
+        """Compute metric."""
+        return translation_edit_rate(
+            self._preds_store, self._target_store, self.normalize, self.no_punctuation, self.lowercase,
+            self.asian_support, self.return_sentence_level_score,
+        )
+
+
+class ExtendedEditDistance(_StringStoreMetric):
+    """Extended edit distance (reference ``text/eed.py:30``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, language: str = "en", return_sentence_level_score: bool = False, alpha: float = 2.0,
+                 rho: float = 0.3, deletion: float = 0.2, insertion: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+    def compute(self):
+        """Compute metric."""
+        return extended_edit_distance(
+            self._preds_store, self._target_store, self.language, self.return_sentence_level_score,
+            self.alpha, self.rho, self.deletion, self.insertion,
+        )
+
+
+class SQuAD(Metric):
+    """SQuAD EM/F1 (reference ``text/squad.py:27``).
+
+    >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+    >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+    >>> metric = SQuAD()
+    >>> metric.update(preds, target)
+    >>> {k: float(v) for k, v in sorted(metric.compute().items())}
+    {'exact_match': 100.0, 'f1': 100.0}
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._preds_store: List[Dict] = []
+        self._target_store: List[Dict] = []
+
+    def update(self, preds, target) -> None:
+        """Store QA predictions/targets for compute."""
+        self._preds_store.extend([preds] if isinstance(preds, dict) else list(preds))
+        self._target_store.extend([target] if isinstance(target, dict) else list(target))
+
+    def compute(self) -> Dict[str, Array]:
+        """Compute metric."""
+        return squad(self._preds_store, self._target_store)
+
+    def reset(self) -> None:
+        """Reset stored dicts too."""
+        super().reset()
+        self._preds_store = []
+        self._target_store = []

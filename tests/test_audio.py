@@ -1,0 +1,142 @@
+"""Audio metric tests vs numpy references and invariance properties."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.audio import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+from metrics_tpu.functional.audio import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+)
+
+_rng = np.random.RandomState(77)
+target = _rng.randn(4, 1000).astype(np.float32)
+preds = (target + 0.3 * _rng.randn(4, 1000)).astype(np.float32)
+
+
+def _np_snr(p, t, zero_mean=False):
+    if zero_mean:
+        p = p - p.mean(-1, keepdims=True)
+        t = t - t.mean(-1, keepdims=True)
+    return 10 * np.log10((t**2).sum(-1) / ((t - p) ** 2).sum(-1))
+
+
+def _np_si_sdr(p, t, zero_mean=False):
+    if zero_mean:
+        p = p - p.mean(-1, keepdims=True)
+        t = t - t.mean(-1, keepdims=True)
+    alpha = (p * t).sum(-1, keepdims=True) / (t**2).sum(-1, keepdims=True)
+    ts = alpha * t
+    return 10 * np.log10((ts**2).sum(-1) / ((ts - p) ** 2).sum(-1))
+
+
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_snr_vs_numpy(zero_mean):
+    m = SignalNoiseRatio(zero_mean=zero_mean)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), _np_snr(preds, target, zero_mean).mean(), rtol=1e-4)
+
+
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_si_sdr_vs_numpy(zero_mean):
+    m = ScaleInvariantSignalDistortionRatio(zero_mean=zero_mean)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(m.compute()), _np_si_sdr(preds, target, zero_mean).mean(), rtol=1e-4)
+
+
+def test_si_snr_scale_invariance():
+    m1 = ScaleInvariantSignalNoiseRatio()
+    m1.update(jnp.asarray(preds * 5.0), jnp.asarray(target))
+    m2 = ScaleInvariantSignalNoiseRatio()
+    m2.update(jnp.asarray(preds), jnp.asarray(target))
+    # SI-SDR is invariant to rescaling of the TARGET; rescaling preds shifts it,
+    # but rescaling target must not:
+    m3 = ScaleInvariantSignalNoiseRatio()
+    m3.update(jnp.asarray(preds), jnp.asarray(target * 5.0))
+    np.testing.assert_allclose(float(m2.compute()), float(m3.compute()), rtol=1e-3)
+
+
+def test_complex_si_snr():
+    spec = _rng.randn(2, 33, 50).astype(np.float32) + 1j * _rng.randn(2, 33, 50).astype(np.float32)
+    m = ComplexScaleInvariantSignalNoiseRatio()
+    m.update(jnp.asarray(spec), jnp.asarray(spec))
+    assert float(m.compute()) > 50  # identical → huge ratio
+
+
+def test_sdr_properties():
+    # identical signals → very high SDR; noisier → lower
+    clean = _rng.randn(2, 4000).astype(np.float32)
+    m = SignalDistortionRatio(filter_length=64)
+    m.update(jnp.asarray(clean), jnp.asarray(clean))
+    high = float(m.compute())
+    assert high > 40
+    noisy = clean + 0.5 * _rng.randn(2, 4000).astype(np.float32)
+    m2 = SignalDistortionRatio(filter_length=64)
+    m2.update(jnp.asarray(noisy), jnp.asarray(clean))
+    low = float(m2.compute())
+    assert low < high and 0 < low < 15
+
+
+def test_sdr_filter_invariance():
+    """SDR must be (near-)invariant to mild FIR filtering of the prediction."""
+    clean = _rng.randn(1, 4000).astype(np.float32)
+    fir = np.array([0.8, 0.2], dtype=np.float32)
+    filtered = np.stack([np.convolve(clean[0], fir, mode="same")])
+    v_filtered = float(
+        signal_distortion_ratio(jnp.asarray(filtered), jnp.asarray(clean), filter_length=64)[0]
+    )
+    assert v_filtered > 30  # the optimal filter absorbs the FIR distortion
+
+
+def test_sa_sdr():
+    t = _rng.randn(2, 3, 500).astype(np.float32)
+    p = t + 0.2 * _rng.randn(2, 3, 500).astype(np.float32)
+    m = SourceAggregatedSignalDistortionRatio()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    v = float(m.compute())
+    assert 5 < v < 30
+
+
+def test_pit_finds_permutation():
+    t = _rng.randn(3, 3, 200).astype(np.float32)
+    perm = np.array([2, 0, 1])
+    p = t[:, perm]
+    best, best_perm = permutation_invariant_training(
+        jnp.asarray(p), jnp.asarray(t), scale_invariant_signal_distortion_ratio
+    )
+    # applying the returned permutation to preds must recover target order
+    restored = pit_permutate(jnp.asarray(p), best_perm)
+    np.testing.assert_allclose(np.asarray(restored), t, rtol=1e-5)
+    assert float(best.mean()) > 50
+
+
+def test_pit_metric_class():
+    from metrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+
+    t = _rng.randn(2, 2, 300).astype(np.float32)
+    p = t[:, ::-1] + 0.01 * _rng.randn(2, 2, 300).astype(np.float32)
+    m = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    assert float(m.compute()) > 20
+
+
+def test_pit_min_mode():
+    t = _rng.randn(2, 2, 100).astype(np.float32)
+    p = t + 0.1 * _rng.randn(2, 2, 100).astype(np.float32)
+
+    def neg_mse(a, b):
+        return ((a - b) ** 2).mean(-1)
+
+    best, _ = permutation_invariant_training(jnp.asarray(p), jnp.asarray(t), neg_mse, eval_func="min")
+    assert np.asarray(best).shape == (2,)

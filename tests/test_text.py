@@ -1,0 +1,195 @@
+"""Text metric tests vs independent references (nltk BLEU-style manual calcs, known values)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+PREDS = ["this is the prediction", "there is an other sample"]
+TARGET = ["this is the reference", "there is another one"]
+
+
+def test_wer_known_value():
+    m = WordErrorRate()
+    m.update(PREDS, TARGET)
+    # sample 1: 1 sub / 4 ref words; sample 2: 2 subs + 1 ins / 4 ref words → 4/8
+    np.testing.assert_allclose(float(m.compute()), 0.5)
+
+
+def test_cer_vs_manual_dp():
+    def lev(a, b):
+        dp = np.zeros((len(a) + 1, len(b) + 1), dtype=int)
+        dp[:, 0] = np.arange(len(a) + 1)
+        dp[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1, dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[-1, -1]
+
+    m = CharErrorRate()
+    m.update(PREDS, TARGET)
+    errors = sum(lev(p, t) for p, t in zip(PREDS, TARGET))
+    total = sum(len(t) for t in TARGET)
+    np.testing.assert_allclose(float(m.compute()), errors / total, rtol=1e-6)
+
+
+def test_mer_wil_wip_known_values():
+    """Values match jiwer for this fixture (and torchmetrics' doctests)."""
+    m = MatchErrorRate()
+    m.update(PREDS, TARGET)
+    np.testing.assert_allclose(float(m.compute()), 0.4444, atol=1e-4)
+    wip = WordInfoPreserved()
+    wip.update(PREDS, TARGET)
+    np.testing.assert_allclose(float(wip.compute()), 0.3472, atol=1e-4)
+    wil = WordInfoLost()
+    wil.update(PREDS, TARGET)
+    np.testing.assert_allclose(float(wil.compute()), 0.6528, atol=1e-4)
+
+
+def test_edit_distance():
+    m = EditDistance()
+    m.update(["rain"], ["shine"])
+    np.testing.assert_allclose(float(m.compute()), 3.0)
+    m2 = EditDistance(reduction="none")
+    m2.update(["rain", "lnaguaeg"], ["shine", "language"])
+    np.testing.assert_allclose(np.asarray(m2.compute()), [3.0, 4.0])
+
+
+def test_bleu_vs_nltk():
+    from nltk.translate.bleu_score import corpus_bleu
+
+    preds = ["the cat is on the mat", "there is a cat on the mat"]
+    target = [["the cat is on the mat"], ["a cat is on the mat", "there is a cat on a mat"]]
+    m = BLEUScore()
+    m.update(preds, target)
+    ref = corpus_bleu([[t.split() for t in refs] for refs in target], [p.split() for p in preds])
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_bleu_accumulation_matches_single_shot():
+    preds = ["the cat is on the mat", "there is a cat on the mat"]
+    target = [["the cat sat on the mat"], ["a cat is on the mat"]]
+    m1 = BLEUScore()
+    m1.update(preds, target)
+    m2 = BLEUScore()
+    for p, t in zip(preds, target):
+        m2.update([p], [t])
+    np.testing.assert_allclose(float(m1.compute()), float(m2.compute()), rtol=1e-6)
+
+
+def test_sacrebleu_13a_tokenizer():
+    preds = ["The cat, is on the mat!"]
+    target = [["The cat is on the mat."]]
+    m = SacreBLEUScore(tokenize="13a")
+    m.update(preds, target)
+    v = float(m.compute())
+    assert 0 < v < 1
+
+
+def test_chrf_identical_is_one():
+    m = CHRFScore()
+    m.update(["the cat is here"], [["the cat is here"]])
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-6)
+
+
+def test_rouge_known_value():
+    m = ROUGEScore(rouge_keys=("rouge1", "rouge2", "rougeL"))
+    m.update("My name is John", "Is your name John")
+    res = m.compute()
+    np.testing.assert_allclose(float(res["rouge1_fmeasure"]), 0.75, atol=1e-4)
+    np.testing.assert_allclose(float(res["rouge2_fmeasure"]), 0.0, atol=1e-6)
+    # LCS("my name is john", "is your name john") = "name john" → 2; P=2/4, R=2/4
+    np.testing.assert_allclose(float(res["rougeL_fmeasure"]), 0.5, atol=1e-4)
+
+
+def test_perplexity_uniform_is_vocab_size():
+    vocab = 7
+    logits = jnp.zeros((2, 10, vocab))
+    target = jnp.asarray(np.random.RandomState(0).randint(vocab, size=(2, 10)))
+    m = Perplexity()
+    m.update(logits, target)
+    np.testing.assert_allclose(float(m.compute()), vocab, rtol=1e-5)
+
+
+def test_perplexity_ignore_index():
+    vocab = 5
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(2, 6, vocab).astype(np.float32))
+    target = np.asarray([[0, 1, 2, -100, 3, 4], [1, 1, -100, 2, 2, 0]])
+    m = Perplexity(ignore_index=-100)
+    m.update(logits, jnp.asarray(target))
+    import jax
+
+    lp = jax.nn.log_softmax(np.asarray(logits), axis=-1)
+    tot, cnt = 0.0, 0
+    for b in range(2):
+        for t in range(6):
+            if target[b, t] != -100:
+                tot -= lp[b, t, target[b, t]]
+                cnt += 1
+    np.testing.assert_allclose(float(m.compute()), np.exp(tot / cnt), rtol=1e-5)
+
+
+def test_ter_identical_zero_and_known():
+    m = TranslationEditRate()
+    m.update(["the cat is on the mat"], [["the cat is on the mat"]])
+    np.testing.assert_allclose(float(m.compute()), 0.0)
+    # denominator is the average reference length: 1 edit / mean(7, 6) = 0.1538
+    m2 = TranslationEditRate()
+    m2.update(["the cat is on the mat"], [["there is a cat on the mat", "a cat is on the mat"]])
+    np.testing.assert_allclose(float(m2.compute()), 1 / 6.5, atol=1e-4)
+
+
+def test_ter_shift_beats_pure_edit():
+    # "b a" vs "a b": pure edit distance 2, one shift does it in 1
+    m = TranslationEditRate(lowercase=False)
+    m.update(["b a"], [["a b"]])
+    np.testing.assert_allclose(float(m.compute()), 0.5)
+
+
+def test_eed_reasonable_range():
+    m = ExtendedEditDistance()
+    m.update(PREDS, TARGET)
+    v = float(m.compute())
+    assert 0.0 < v < 1.0
+    # identical strings still carry the small coverage penalty (reference eed.py:170
+    # counts unvisited hyp positions as 1), so the score is small but non-zero
+    m2 = ExtendedEditDistance()
+    m2.update(["same text"], ["same text"])
+    assert 0.0 < float(m2.compute()) < 0.05
+
+
+def test_squad():
+    preds = [{"prediction_text": "1976", "id": "id1"}, {"prediction_text": "the alps", "id": "id2"}]
+    target = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "id1"},
+        {"answers": {"answer_start": [1], "text": ["The Alps mountains"]}, "id": "id2"},
+    ]
+    m = SQuAD()
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["exact_match"]), 50.0)
+    assert 50.0 < float(res["f1"]) <= 100.0
+
+
+def test_wer_accumulation_across_updates():
+    m = WordErrorRate()
+    for p, t in zip(PREDS, TARGET):
+        m.update([p], [t])
+    np.testing.assert_allclose(float(m.compute()), 0.5)
