@@ -10,6 +10,7 @@ from metrics_tpu import (
     audio,
     classification,
     clustering,
+    detection,
     functional,
     image,
     nominal,
@@ -51,6 +52,7 @@ __all__ = [
     "__version__",
     "classification",
     "clustering",
+    "detection",
     "functional",
     "image",
     "parallel",

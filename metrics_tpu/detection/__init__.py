@@ -1,0 +1,20 @@
+"""Modular detection metrics (reference ``torchmetrics/detection/__init__.py``)."""
+
+from metrics_tpu.detection.iou_metrics import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+)
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision
+from metrics_tpu.detection.panoptic_quality import ModifiedPanopticQuality, PanopticQuality
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
+]

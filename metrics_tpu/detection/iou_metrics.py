@@ -1,0 +1,135 @@
+"""Modular box-IoU metrics (reference ``detection/iou.py``, ``giou.py``, ``diou.py``, ``ciou.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.detection.iou import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+)
+from metrics_tpu.metric import Metric
+
+
+class IntersectionOverUnion(Metric):
+    """IoU for object detection (reference ``detection/iou.py:30``).
+
+    Matches each prediction to ground truths of the same label (unless
+    ``respect_labels=False``) and averages the pairwise scores above threshold.
+
+    >>> import jax.numpy as jnp
+    >>> preds = [{"boxes": jnp.array([[296.55, 93.96, 314.97, 152.79]]),
+    ...           "scores": jnp.array([0.236]), "labels": jnp.array([4])}]
+    >>> target = [{"boxes": jnp.array([[300.00, 100.0, 315.0, 150.0]]), "labels": jnp.array([4])}]
+    >>> metric = IntersectionOverUnion()
+    >>> metric.update(preds, target)
+    >>> round(float(metric.compute()["iou"]), 4)
+    0.6314
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    _iou_fn = staticmethod(intersection_over_union)
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if box_format not in ("xyxy", "xywh", "cxcywh"):
+            raise ValueError(f"Expected argument `box_format` to be one of ('xyxy', 'xywh', 'cxcywh') but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        self.class_metrics = class_metrics
+        self.respect_labels = respect_labels
+        self.add_state("iou_sum", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self._class_sums: Dict[int, List[float]] = {}
+
+    def _to_xyxy(self, boxes: Array) -> Array:
+        if self.box_format == "xyxy" or boxes.size == 0:
+            return boxes
+        if self.box_format == "xywh":
+            return jnp.concatenate([boxes[:, :2], boxes[:, :2] + boxes[:, 2:]], axis=1)
+        return jnp.concatenate([boxes[:, :2] - boxes[:, 2:] / 2, boxes[:, :2] + boxes[:, 2:] / 2], axis=1)
+
+    def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
+        """Update state with per-image box dicts."""
+        for p, t in zip(preds, target):
+            p_boxes = self._to_xyxy(jnp.asarray(p["boxes"]).reshape(-1, 4))
+            t_boxes = self._to_xyxy(jnp.asarray(t["boxes"]).reshape(-1, 4))
+            if p_boxes.shape[0] == 0 or t_boxes.shape[0] == 0:
+                continue
+            matrix = type(self)._iou_fn(p_boxes, t_boxes, None, self._invalid_val, aggregate=False)
+            if self.respect_labels:
+                p_lab = np.asarray(p["labels"]).reshape(-1)
+                t_lab = np.asarray(t["labels"]).reshape(-1)
+                mask = p_lab[:, None] == t_lab[None, :]
+                matrix = jnp.where(jnp.asarray(mask), matrix, self._invalid_val)
+            if self.iou_threshold is not None:
+                matrix = jnp.where(matrix >= self.iou_threshold, matrix, self._invalid_val)
+            valid = matrix > self._invalid_val
+            self.iou_sum = self.iou_sum + jnp.where(valid, matrix, 0.0).sum()
+            self.total = self.total + valid.sum()
+            if self.class_metrics:
+                p_lab = np.asarray(p["labels"]).reshape(-1)
+                for ci, cls in enumerate(np.unique(p_lab)):
+                    sel = jnp.asarray(p_lab == cls)
+                    vals = jnp.where(valid & sel[:, None], matrix, jnp.nan)
+                    arr = np.asarray(vals).reshape(-1)
+                    arr = arr[~np.isnan(arr)]
+                    self._class_sums.setdefault(int(cls), []).extend(arr.tolist())
+
+    def compute(self) -> Dict[str, Array]:
+        """Compute metric."""
+        key = self._iou_type
+        out = {key: jnp.where(self.total > 0, self.iou_sum / jnp.maximum(self.total, 1), 0.0).astype(jnp.float32)}
+        if self.class_metrics:
+            for cls, vals in sorted(self._class_sums.items()):
+                out[f"{key}/cl_{cls}"] = jnp.asarray(float(np.mean(vals)) if vals else 0.0, dtype=jnp.float32)
+        return out
+
+    def reset(self) -> None:
+        """Reset per-class accumulators too."""
+        super().reset()
+        self._class_sums = {}
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """GIoU for object detection (reference ``detection/giou.py:30``)."""
+
+    _iou_fn = staticmethod(generalized_intersection_over_union)
+    _iou_type = "giou"
+    plot_lower_bound = -1.0
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """DIoU for object detection (reference ``detection/diou.py:30``)."""
+
+    _iou_fn = staticmethod(distance_intersection_over_union)
+    _iou_type = "diou"
+    plot_lower_bound = -1.0
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """CIoU for object detection (reference ``detection/ciou.py:30``)."""
+
+    _iou_fn = staticmethod(complete_intersection_over_union)
+    _iou_type = "ciou"
+    plot_lower_bound = -1.0

@@ -1,0 +1,182 @@
+"""Panoptic Quality (reference ``detection/panoptic_qualities.py`` +
+``functional/detection/_panoptic_quality_common.py``).
+
+Segment statistics (intersection areas between (category, instance) pairs) come
+from ONE flattened bincount over paired ids — the same dead-bin scatter-add pattern
+as the classification confusion matrices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Collection, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.metric import Metric
+
+
+def _panoptic_stats(
+    preds: np.ndarray,
+    target: np.ndarray,
+    things: set,
+    stuffs: set,
+    modified: bool = False,
+) -> Dict[int, Tuple[float, int, int, int]]:
+    """Per-category (iou_sum, tp, fp, fn) for one image via paired-segment areas."""
+    cats = things | stuffs
+    # collapse stuff instance ids (stuff is one segment per category)
+    p_cat, p_inst = preds[..., 0].copy(), preds[..., 1].copy()
+    t_cat, t_inst = target[..., 0].copy(), target[..., 1].copy()
+    for arr_cat, arr_inst in ((p_cat, p_inst), (t_cat, t_inst)):
+        stuff_mask = np.isin(arr_cat, list(stuffs))
+        arr_inst[stuff_mask] = 0
+
+    def segments(cat, inst):
+        keys = cat.astype(np.int64) * (inst.max() + 2 if inst.size else 1) + inst
+        return keys
+
+    # unique segment ids
+    p_seg = (p_cat.astype(np.int64) << 32) | p_inst.astype(np.int64)
+    t_seg = (t_cat.astype(np.int64) << 32) | t_inst.astype(np.int64)
+    valid = np.isin(p_cat, list(cats)) | np.isin(t_cat, list(cats))
+
+    p_ids, p_idx = np.unique(p_seg.reshape(-1), return_inverse=True)
+    t_ids, t_idx = np.unique(t_seg.reshape(-1), return_inverse=True)
+    pair = p_idx.astype(np.int64) * len(t_ids) + t_idx
+    inter = np.bincount(pair, minlength=len(p_ids) * len(t_ids)).reshape(len(p_ids), len(t_ids))
+    p_areas = inter.sum(1)
+    t_areas = inter.sum(0)
+    p_cats = (p_ids >> 32).astype(np.int64)
+    t_cats = (t_ids >> 32).astype(np.int64)
+
+    stats: Dict[int, list] = {c: [0.0, 0, 0, 0] for c in cats}
+    matched_p = np.zeros(len(p_ids), dtype=bool)
+    matched_t = np.zeros(len(t_ids), dtype=bool)
+    for pi in range(len(p_ids)):
+        if p_cats[pi] not in cats:
+            continue
+        for tj in range(len(t_ids)):
+            if t_cats[tj] != p_cats[pi] or inter[pi, tj] == 0:
+                continue
+            union = p_areas[pi] + t_areas[tj] - inter[pi, tj]
+            iou = inter[pi, tj] / union
+            is_stuff = int(p_cats[pi]) in stuffs
+            # modified PQ: stuff segments score their IoU without the 0.5 match rule
+            if iou > 0.5 or (modified and is_stuff and iou > 0):
+                c = int(p_cats[pi])
+                stats[c][0] += iou
+                stats[c][1] += 1
+                matched_p[pi] = True
+                matched_t[tj] = True
+    for pi in range(len(p_ids)):
+        if p_cats[pi] in cats and not matched_p[pi] and p_areas[pi] > 0:
+            stats[int(p_cats[pi])][2] += 1
+    for tj in range(len(t_ids)):
+        if t_cats[tj] in cats and not matched_t[tj] and t_areas[tj] > 0:
+            stats[int(t_cats[tj])][3] += 1
+    return {c: tuple(v) for c, v in stats.items()}
+
+
+class PanopticQuality(Metric):
+    """Panoptic Quality for panoptic segmentation (reference ``detection/panoptic_qualities.py:36``).
+
+    Inputs are ``(..., H, W, 2)`` arrays of (category_id, instance_id).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> preds = jnp.asarray(np.array([[[[6, 0], [0, 0]], [[6, 0], [6, 0]]]]))
+    >>> target = jnp.asarray(np.array([[[[6, 0], [0, 1]], [[6, 0], [6, 0]]]]))
+    >>> pq = PanopticQuality(things={0, 6}, stuffs=set())
+    >>> pq.update(preds, target)
+    >>> float(pq.compute()) > 0
+    True
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        return_sq_and_rq: bool = False,
+        return_per_class: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        things, stuffs = set(int(t) for t in things), set(int(s) for s in stuffs)
+        if things & stuffs:
+            raise ValueError(f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things & stuffs}")
+        self.things = things
+        self.stuffs = stuffs
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        self.return_sq_and_rq = return_sq_and_rq
+        self.return_per_class = return_per_class
+        cats = sorted(things | stuffs)
+        self._cat_index = {c: i for i, c in enumerate(cats)}
+        n = len(cats)
+        self.add_state("iou_sum", jnp.zeros(n), dist_reduce_fx="sum")
+        self.add_state("true_positives", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with panoptic label maps."""
+        p = np.asarray(preds)
+        t = np.asarray(target)
+        if p.shape != t.shape or p.shape[-1] != 2:
+            raise ValueError(
+                f"Expected argument `preds` and `target` to have shape (..., H, W, 2) but got {p.shape} and {t.shape}"
+            )
+        if not self.allow_unknown_preds_category:
+            unknown = set(np.unique(p[..., 0]).tolist()) - self.things - self.stuffs
+            if unknown:
+                raise ValueError(f"Unknown categories found in `preds`: {unknown}")
+        p2 = p.reshape(-1, *p.shape[-3:]) if p.ndim > 3 else p[None]
+        t2 = t.reshape(-1, *t.shape[-3:]) if t.ndim > 3 else t[None]
+        iou_sum = np.zeros(len(self._cat_index))
+        tp = np.zeros(len(self._cat_index), dtype=np.int64)
+        fp = np.zeros(len(self._cat_index), dtype=np.int64)
+        fn = np.zeros(len(self._cat_index), dtype=np.int64)
+        for img_p, img_t in zip(p2, t2):
+            stats = _panoptic_stats(img_p, img_t, self.things, self.stuffs, getattr(self, '_modified', False))
+            for c, (isum, tpc, fpc, fnc) in stats.items():
+                i = self._cat_index[c]
+                iou_sum[i] += isum
+                tp[i] += tpc
+                fp[i] += fpc
+                fn[i] += fnc
+        self.iou_sum = self.iou_sum + jnp.asarray(iou_sum)
+        self.true_positives = self.true_positives + jnp.asarray(tp, dtype=jnp.int32)
+        self.false_positives = self.false_positives + jnp.asarray(fp, dtype=jnp.int32)
+        self.false_negatives = self.false_negatives + jnp.asarray(fn, dtype=jnp.int32)
+
+    def compute(self) -> Array:
+        """Compute metric: PQ = Σ IoU / (TP + FP/2 + FN/2), averaged over categories."""
+        denom = self.true_positives + 0.5 * self.false_positives + 0.5 * self.false_negatives
+        valid = denom > 0
+        sq = jnp.where(self.true_positives > 0, self.iou_sum / jnp.maximum(self.true_positives, 1), 0.0)
+        rq = jnp.where(valid, self.true_positives / jnp.where(valid, denom, 1.0), 0.0)
+        pq = sq * rq
+        pq_avg = jnp.where(valid, pq, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+        if self.return_per_class:
+            return pq[None] if not self.return_sq_and_rq else jnp.stack([pq, sq, rq])[None]
+        if self.return_sq_and_rq:
+            sq_avg = jnp.where(valid, sq, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+            rq_avg = jnp.where(valid, rq, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+            return jnp.stack([pq_avg, sq_avg, rq_avg])
+        return pq_avg
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """Modified PQ (reference ``detection/panoptic_qualities.py`` second class):
+    stuff segments score their IoU directly without the 0.5 matching threshold."""
+
+    _modified = True

@@ -4,6 +4,7 @@ from metrics_tpu.functional import (
     audio,
     classification,
     clustering,
+    detection,
     image,
     nominal,
     pairwise,
@@ -25,6 +26,7 @@ __all__ = [
     "audio",
     "classification",
     "clustering",
+    "detection",
     "image",
     "nominal",
     "pairwise",

@@ -223,9 +223,11 @@ def permutation_invariant_training(
             [metric_mtx[:, jnp.arange(spk), jnp.asarray(p)].mean(-1) for p in perms], axis=-1
         )  # (batch, n_perms)
     else:
-        perm_scores = jnp.stack(
-            [metric_func(preds[:, jnp.asarray(p)], target, **kwargs).mean(-1) for p in perms], axis=-1
-        )
+        def _per_batch(p):
+            v = metric_func(preds[:, jnp.asarray(p)], target, **kwargs)
+            return v.reshape(v.shape[0], -1).mean(-1)  # (batch,) regardless of metric output rank
+
+        perm_scores = jnp.stack([_per_batch(p) for p in perms], axis=-1)
     best_idx = jnp.argmax(perm_scores, axis=-1) if eval_func == "max" else jnp.argmin(perm_scores, axis=-1)
     best_metric = jnp.take_along_axis(perm_scores, best_idx[:, None], axis=-1)[:, 0]
     # convention (reference pit.py): best_perm[j] = index of the prediction matching

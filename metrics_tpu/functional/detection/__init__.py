@@ -1,0 +1,15 @@
+"""Functional detection metrics (reference ``torchmetrics/functional/detection/__init__.py``)."""
+
+from metrics_tpu.functional.detection.iou import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+)
+
+__all__ = [
+    "complete_intersection_over_union",
+    "distance_intersection_over_union",
+    "generalized_intersection_over_union",
+    "intersection_over_union",
+]

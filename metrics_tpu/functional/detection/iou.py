@@ -1,0 +1,124 @@
+"""Box-IoU kernels: IoU, GIoU, DIoU, CIoU.
+
+Parity with reference ``functional/detection/{iou,giou,diou,ciou}.py`` (which call
+torchvision's C++ box ops — SURVEY §2.9). Here the pairwise matrices are pure
+broadcast jnp (xyxy boxes), fully batched.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _box_area(boxes: Array) -> Array:
+    return jnp.clip(boxes[..., 2] - boxes[..., 0], 0, None) * jnp.clip(boxes[..., 3] - boxes[..., 1], 0, None)
+
+
+def _box_inter_union(preds: Array, target: Array):
+    lt = jnp.maximum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.minimum(preds[:, None, 2:], target[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _box_area(preds)[:, None] + _box_area(target)[None, :] - inter
+    return inter, union
+
+
+def intersection_over_union(
+    preds: Array, target: Array, iou_threshold: float = None, replacement_val: float = 0, aggregate: bool = True
+) -> Array:
+    """Pairwise IoU matrix (reference ``functional/detection/iou.py:25-86``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
+    >>> target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
+    >>> intersection_over_union(preds, target)
+    Array(0.6807, dtype=float32)
+    """
+    inter, union = _box_inter_union(preds.astype(jnp.float32), target.astype(jnp.float32))
+    iou = inter / jnp.clip(union, 1e-9, None)
+    if iou_threshold is not None:
+        iou = jnp.where(iou >= iou_threshold, iou, replacement_val)
+    if aggregate:
+        return jnp.diagonal(iou).mean()  # paired boxes (reference _iou_compute diag mean)
+    return iou
+
+
+def generalized_intersection_over_union(
+    preds: Array, target: Array, iou_threshold: float = None, replacement_val: float = 0, aggregate: bool = True
+) -> Array:
+    """Pairwise GIoU (reference ``functional/detection/giou.py:25-86``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
+    >>> target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
+    >>> generalized_intersection_over_union(preds, target)
+    Array(0.6641, dtype=float32)
+    """
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    inter, union = _box_inter_union(preds, target)
+    iou = inter / jnp.clip(union, 1e-9, None)
+    # smallest enclosing box
+    lt = jnp.minimum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.maximum(preds[:, None, 2:], target[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    area_c = wh[..., 0] * wh[..., 1]
+    giou = iou - (area_c - union) / jnp.clip(area_c, 1e-9, None)
+    if iou_threshold is not None:
+        giou = jnp.where(iou >= iou_threshold, giou, replacement_val)
+    if aggregate:
+        return jnp.diagonal(giou).mean()
+    return giou
+
+
+def distance_intersection_over_union(
+    preds: Array, target: Array, iou_threshold: float = None, replacement_val: float = 0, aggregate: bool = True
+) -> Array:
+    """Pairwise DIoU (reference ``functional/detection/diou.py:25-86``)."""
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    inter, union = _box_inter_union(preds, target)
+    iou = inter / jnp.clip(union, 1e-9, None)
+    cp = (preds[:, :2] + preds[:, 2:]) / 2
+    ct = (target[:, :2] + target[:, 2:]) / 2
+    center_dist = jnp.sum((cp[:, None, :] - ct[None, :, :]) ** 2, axis=-1)
+    lt = jnp.minimum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.maximum(preds[:, None, 2:], target[None, :, 2:])
+    diag = jnp.sum((rb - lt) ** 2, axis=-1)
+    diou = iou - center_dist / jnp.clip(diag, 1e-9, None)
+    if iou_threshold is not None:
+        diou = jnp.where(iou >= iou_threshold, diou, replacement_val)
+    if aggregate:
+        return jnp.diagonal(diou).mean()
+    return diou
+
+
+def complete_intersection_over_union(
+    preds: Array, target: Array, iou_threshold: float = None, replacement_val: float = 0, aggregate: bool = True
+) -> Array:
+    """Pairwise CIoU (reference ``functional/detection/ciou.py:25-86``)."""
+    import math
+
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    inter, union = _box_inter_union(preds, target)
+    iou = inter / jnp.clip(union, 1e-9, None)
+    cp = (preds[:, :2] + preds[:, 2:]) / 2
+    ct = (target[:, :2] + target[:, 2:]) / 2
+    center_dist = jnp.sum((cp[:, None, :] - ct[None, :, :]) ** 2, axis=-1)
+    lt = jnp.minimum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.maximum(preds[:, None, 2:], target[None, :, 2:])
+    diag = jnp.sum((rb - lt) ** 2, axis=-1)
+    wp = jnp.clip(preds[:, 2] - preds[:, 0], 1e-9, None)
+    hp = jnp.clip(preds[:, 3] - preds[:, 1], 1e-9, None)
+    wt = jnp.clip(target[:, 2] - target[:, 0], 1e-9, None)
+    ht = jnp.clip(target[:, 3] - target[:, 1], 1e-9, None)
+    v = (4 / math.pi**2) * (jnp.arctan(wt / ht)[None, :] - jnp.arctan(wp / hp)[:, None]) ** 2
+    alpha = v / jnp.clip(1 - iou + v, 1e-9, None)
+    ciou = iou - center_dist / jnp.clip(diag, 1e-9, None) - alpha * v
+    if iou_threshold is not None:
+        ciou = jnp.where(iou >= iou_threshold, ciou, replacement_val)
+    if aggregate:
+        return jnp.diagonal(ciou).mean()
+    return ciou

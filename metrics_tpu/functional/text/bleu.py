@@ -137,6 +137,8 @@ def sacre_bleu_score(
     Array(0.7598, dtype=float32)
     """
     tokenizer = _get_tokenizer(tokenize)
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
     preds_ = [p.lower() if lowercase else p for p in preds]
     target_ = [[(t.lower() if lowercase else t) for t in refs] for refs in target]
     if len(preds_) != len(target_):

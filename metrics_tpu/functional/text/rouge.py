@@ -83,10 +83,10 @@ def _lcs_positions(a: Sequence[str], b: Sequence[str]) -> set:
     return hits
 
 
-def _rouge_lsum(pred_text: str, target_text: str) -> Tuple[float, float, float]:
+def _rouge_lsum(pred_text: str, target_text: str, use_stemmer: bool = False) -> Tuple[float, float, float]:
     """Summary-level rouge-L: UNION-LCS over sentence splits (rouge_score semantics)."""
-    pred_sents = [_rouge_tokenize(s) for s in pred_text.split("\n") if s]
-    target_sents = [_rouge_tokenize(s) for s in target_text.split("\n") if s]
+    pred_sents = [_rouge_tokenize(s, use_stemmer) for s in pred_text.split("\n") if s]
+    target_sents = [_rouge_tokenize(s, use_stemmer) for s in target_text.split("\n") if s]
     pred_total = sum(len(s) for s in pred_sents)
     target_total = sum(len(s) for s in target_sents)
     match = 0
@@ -133,7 +133,7 @@ def rouge_score(
                 if key == "rougeL":
                     scores.append(_rouge_l(pred_tok, ref_tok))
                 elif key == "rougeLsum":
-                    scores.append(_rouge_lsum(pred_text, ref_text))
+                    scores.append(_rouge_lsum(pred_text, ref_text, use_stemmer))
                 else:
                     scores.append(_rouge_n(pred_tok, ref_tok, int(key[5:])))
             if accumulate == "best":

@@ -339,10 +339,13 @@ class CHRFScore(Metric):
         self.beta = beta
         self.lowercase = lowercase
         self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
         total = n_char_order + n_word_order
         self.add_state("matches", jnp.zeros(total), dist_reduce_fx="sum")
         self.add_state("preds_totals", jnp.zeros(total), dist_reduce_fx="sum")
         self.add_state("target_totals", jnp.zeros(total), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf", [], dist_reduce_fx="cat")
 
     def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
         """Update state with predictions and reference corpora."""
@@ -354,6 +357,14 @@ class CHRFScore(Metric):
         self.matches = self.matches + jnp.asarray(matches)
         self.preds_totals = self.preds_totals + jnp.asarray(pred_totals)
         self.target_totals = self.target_totals + jnp.asarray(target_totals)
+        if self.return_sentence_level_score:
+            from metrics_tpu.functional.text.chrf import chrf_score
+
+            _, sentence = chrf_score(
+                preds_, target_, self.n_char_order, self.n_word_order, self.beta, self.lowercase,
+                self.whitespace, return_sentence_level_score=True,
+            )
+            self.sentence_chrf.append(sentence)
 
     def compute(self) -> Array:
         """Compute metric."""
@@ -362,7 +373,10 @@ class CHRFScore(Metric):
         b2 = self.beta**2
         denom = b2 * p_vec + r_vec
         f_vec = jnp.where(denom > 0, (1 + b2) * p_vec * r_vec / jnp.where(denom > 0, denom, 1.0), 0.0)
-        return f_vec.mean().astype(jnp.float32)
+        corpus = f_vec.mean().astype(jnp.float32)
+        if self.return_sentence_level_score:
+            return corpus, dim_zero_cat(self.sentence_chrf)
+        return corpus
 
 
 class _StringStoreMetric(Metric):

@@ -1,0 +1,225 @@
+"""Detection tests: hand-verified COCO-protocol scenarios + IoU formula checks."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    PanopticQuality,
+)
+from metrics_tpu.functional.detection import generalized_intersection_over_union, intersection_over_union
+
+
+def _np_iou(a, b):
+    lt = np.maximum(a[:2], b[:2])
+    rb = np.minimum(a[2:], b[2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[0] * wh[1]
+    area_a = (a[2] - a[0]) * (a[3] - a[1])
+    area_b = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / (area_a + area_b - inter)
+
+
+def test_iou_matrix_vs_numpy():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(5, 4) * 100, axis=-1)[:, [0, 1, 2, 3]]
+    a = np.stack([a[:, 0], a[:, 1], a[:, 0] + a[:, 2] + 1, a[:, 1] + a[:, 3] + 1], axis=1)
+    b = np.stack([a[:, 0] + 5, a[:, 1] + 5, a[:, 2] + 5, a[:, 3] + 5], axis=1)
+    mat = np.asarray(intersection_over_union(jnp.asarray(a), jnp.asarray(b), aggregate=False))
+    for i in range(5):
+        for j in range(5):
+            np.testing.assert_allclose(mat[i, j], _np_iou(a[i], b[j]), rtol=1e-5)
+
+
+def test_giou_known_value():
+    # disjoint boxes: giou = -(hull - union)/hull
+    a = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    b = jnp.asarray([[20.0, 0.0, 30.0, 10.0]])
+    v = float(generalized_intersection_over_union(a, b, aggregate=False)[0, 0])
+    hull = 30 * 10
+    union = 200
+    np.testing.assert_allclose(v, 0 - (hull - union) / hull, rtol=1e-5)
+
+
+def test_iou_metric_classes():
+    preds = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), "scores": jnp.asarray([0.9]),
+              "labels": jnp.asarray([1])}]
+    target = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), "labels": jnp.asarray([1])}]
+    for cls, key in [(IntersectionOverUnion, "iou"), (GeneralizedIntersectionOverUnion, "giou"),
+                     (DistanceIntersectionOverUnion, "diou"), (CompleteIntersectionOverUnion, "ciou")]:
+        m = cls()
+        m.update(preds, target)
+        np.testing.assert_allclose(float(m.compute()[key]), 1.0, atol=1e-6)
+
+
+def test_iou_respect_labels():
+    preds = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), "scores": jnp.asarray([0.9]),
+              "labels": jnp.asarray([1])}]
+    target = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), "labels": jnp.asarray([2])}]
+    m = IntersectionOverUnion(respect_labels=True)
+    m.update(preds, target)
+    assert float(m.compute()["iou"]) == 0.0
+    m2 = IntersectionOverUnion(respect_labels=False)
+    m2.update(preds, target)
+    np.testing.assert_allclose(float(m2.compute()["iou"]), 1.0, atol=1e-6)
+
+
+def _map_fixture(score2=0.8):
+    preds = [
+        {
+            "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+            "scores": jnp.asarray([0.9, score2]),
+            "labels": jnp.asarray([0, 0]),
+        }
+    ]
+    target = [
+        {
+            "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+            "labels": jnp.asarray([0, 0]),
+        }
+    ]
+    return preds, target
+
+
+def test_map_perfect_detection():
+    preds, target = _map_fixture()
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+
+def test_map_false_positive_halves_ap():
+    """One TP at high score + one FP at lower score + one missed GT: known AP."""
+    preds = [{
+        "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [50.0, 50.0, 60.0, 60.0]]),
+        "scores": jnp.asarray([0.9, 0.8]),
+        "labels": jnp.asarray([0, 0]),
+    }]
+    target = [{
+        "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+        "labels": jnp.asarray([0, 0]),
+    }]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    # PR curve: first det TP (P=1, R=0.5), second det FP (P=0.5, R=0.5).
+    # 101-pt interp: precision 1.0 for recall ≤ 0.5, 0 beyond → AP = 51/101
+    np.testing.assert_allclose(float(res["map_50"]), 51 / 101, atol=1e-3)
+    np.testing.assert_allclose(float(res["mar_100"]), 0.5, atol=1e-6)
+
+
+def test_map_localization_quality_spread():
+    """A det with IoU ~0.68 counts at low thresholds but not high ones."""
+    preds = [{"boxes": jnp.asarray([[100.0, 100.0, 200.0, 200.0]]), "scores": jnp.asarray([0.9]),
+              "labels": jnp.asarray([0])}]
+    target = [{"boxes": jnp.asarray([[110.0, 110.0, 210.0, 210.0]]), "labels": jnp.asarray([0])}]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(res["map_75"]), 0.0, atol=1e-6)
+    # thresholds 0.5, 0.55, ..., 0.65 pass (iou = 0.6807): 4 of 10
+    np.testing.assert_allclose(float(res["map"]), 0.4, atol=1e-6)
+
+
+def test_map_crowd_ignored():
+    """Matches to crowd GTs are neither TP nor FP."""
+    preds = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+              "scores": jnp.asarray([0.9, 0.8]), "labels": jnp.asarray([0, 0])}]
+    target = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+               "labels": jnp.asarray([0, 0]), "iscrowd": jnp.asarray([0, 1])}]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)  # crowd det ignored, clean TP remains
+
+
+def test_map_max_detections():
+    preds, target = _map_fixture()
+    m = MeanAveragePrecision(max_detection_thresholds=[1, 10, 100])
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["mar_1"]), 0.5, atol=1e-6)  # only top-1 det counted
+
+
+def test_map_class_metrics_and_accumulation():
+    preds, target = _map_fixture()
+    preds2 = [{"boxes": jnp.asarray([[5.0, 5.0, 15.0, 15.0]]), "scores": jnp.asarray([0.7]),
+               "labels": jnp.asarray([1])}]
+    target2 = [{"boxes": jnp.asarray([[5.0, 5.0, 15.0, 15.0]]), "labels": jnp.asarray([1])}]
+    m = MeanAveragePrecision(class_metrics=True)
+    m.update(preds, target)
+    m.update(preds2, target2)
+    res = m.compute()
+    assert list(np.asarray(res["classes"])) == [0, 1]
+    np.testing.assert_allclose(np.asarray(res["map_per_class"]), [1.0, 1.0], atol=1e-6)
+
+
+def test_map_area_ranges():
+    # a tiny (small) and a big (large) box
+    preds = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [0.0, 0.0, 200.0, 200.0]]),
+              "scores": jnp.asarray([0.9, 0.8]), "labels": jnp.asarray([0, 1])}]
+    target = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [0.0, 0.0, 200.0, 200.0]]),
+               "labels": jnp.asarray([0, 1])}]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["map_small"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(res["map_large"]), 1.0, atol=1e-6)
+    assert float(res["map_medium"]) == -1.0  # no medium boxes
+
+
+def test_map_empty_predictions():
+    preds = [{"boxes": jnp.zeros((0, 4)), "scores": jnp.zeros(0), "labels": jnp.zeros(0, dtype=jnp.int32)}]
+    target = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), "labels": jnp.asarray([0])}]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["map"]), 0.0, atol=1e-6)
+
+
+def test_map_xywh_format():
+    preds = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), "scores": jnp.asarray([0.9]),
+              "labels": jnp.asarray([0])}]
+    target = [{"boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]), "labels": jnp.asarray([0])}]
+    m = MeanAveragePrecision(box_format="xywh")
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()["map_50"]), 1.0, atol=1e-6)
+
+
+def test_panoptic_quality_simple():
+    # two images, one thing class (1) + one stuff class (7)
+    h = w = 8
+    pred = np.zeros((1, h, w, 2), dtype=np.int64)
+    tgt = np.zeros((1, h, w, 2), dtype=np.int64)
+    pred[..., 0] = 7  # stuff everywhere
+    tgt[..., 0] = 7
+    pred[0, :4, :4, 0] = 1  # thing instance
+    pred[0, :4, :4, 1] = 1
+    tgt[0, :4, :4, 0] = 1
+    tgt[0, :4, :4, 1] = 5  # different instance id, same overlap → still matches
+    pq = PanopticQuality(things={1}, stuffs={7})
+    pq.update(jnp.asarray(pred), jnp.asarray(tgt))
+    np.testing.assert_allclose(float(pq.compute()), 1.0, atol=1e-6)
+
+
+def test_panoptic_quality_partial_overlap():
+    h = w = 8
+    pred = np.zeros((1, h, w, 2), dtype=np.int64)
+    tgt = np.zeros((1, h, w, 2), dtype=np.int64)
+    pred[..., 0] = 7
+    tgt[..., 0] = 7
+    tgt[0, :4, :, 0] = 1  # gt thing covers rows 0-3
+    pred[0, 1:4, :, 0] = 1  # pred covers rows 1-3 → IoU 0.75 > 0.5
+    pq = PanopticQuality(things={1}, stuffs={7})
+    pq.update(jnp.asarray(pred), jnp.asarray(tgt))
+    v = float(pq.compute())
+    assert 0.5 < v < 1.0
