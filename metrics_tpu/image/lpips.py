@@ -1,0 +1,160 @@
+"""LPIPS and PerceptualPathLength with injectable backbones.
+
+Parity with reference ``image/lpips.py`` (torchvision VGG/Alex/Squeeze + vendored
+``lpips_models/*.pth`` weights — SURVEY §2.9) and ``image/perceptual_path_length.py``.
+Offline build: the per-layer feature function is injected; the metric implements
+the LPIPS distance math (unit-normalize per channel, squared diff, spatial mean,
+layer sum).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.metric import Metric
+
+
+def _lpips_distance(feats_a: Sequence[Array], feats_b: Sequence[Array],
+                    weights: Optional[Sequence[Callable]] = None) -> Array:
+    """LPIPS distance given per-layer feature maps (N, C, H, W)."""
+    total = None
+    for i, (fa, fb) in enumerate(zip(feats_a, feats_b)):
+        na = fa / jnp.clip(jnp.linalg.norm(fa, axis=1, keepdims=True), 1e-10, None)
+        nb = fb / jnp.clip(jnp.linalg.norm(fb, axis=1, keepdims=True), 1e-10, None)
+        diff = (na - nb) ** 2
+        if weights is not None:
+            diff = weights[i](diff)
+            layer = diff.reshape(diff.shape[0], -1).mean(-1) if diff.ndim > 1 else diff
+        else:
+            layer = diff.sum(1).reshape(diff.shape[0], -1).mean(-1)
+        total = layer if total is None else total + layer
+    return total
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS (reference ``image/lpips.py:55``).
+
+    Args:
+        net: callable mapping an image batch to a LIST of per-layer feature maps
+            (the reference's pretrained VGG/Alex backbones need downloaded weights,
+            unavailable offline — inject your flax backbone here).
+        reduction: 'mean' or 'sum' over the accumulated pairs.
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> net = lambda x: [x, x[:, :, ::2, ::2]]  # toy 2-layer feature pyramid
+    >>> lpips = LearnedPerceptualImagePatchSimilarity(net=net)
+    >>> a = jnp.asarray(rng.rand(4, 3, 16, 16).astype(np.float32))
+    >>> b = jnp.asarray(rng.rand(4, 3, 16, 16).astype(np.float32))
+    >>> lpips.update(a, b)
+    >>> float(lpips.compute()) > 0
+    True
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        net: Optional[Callable] = None,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if net is None:
+            raise ModuleNotFoundError(
+                f"The pretrained '{net_type}' backbone requires downloaded weights, unavailable in this"
+                " offline build. Pass `net=<callable returning per-layer features>` instead."
+            )
+        self.net = net
+        if reduction not in ("mean", "sum"):
+            raise ValueError(f"Argument `reduction` must be one of 'sum' or 'mean' but got {reduction}")
+        self.reduction = reduction
+        self.normalize = normalize
+        self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Update with a pair of image batches."""
+        if self.normalize:
+            img1 = 2 * img1 - 1
+            img2 = 2 * img2 - 1
+        d = _lpips_distance(self.net(img1), self.net(img2))
+        self.sum_scores = self.sum_scores + d.sum()
+        self.total = self.total + d.shape[0]
+
+    def compute(self) -> Array:
+        """Compute metric."""
+        if self.reduction == "mean":
+            return (self.sum_scores / self.total).astype(jnp.float32)
+        return self.sum_scores.astype(jnp.float32)
+
+
+class PerceptualPathLength(Metric):
+    """Perceptual Path Length (reference ``image/perceptual_path_length.py:36``).
+
+    Measures LPIPS distance between images generated from perturbed latent
+    interpolations. Requires a generator callable and an LPIPS ``net`` (see
+    :class:`LearnedPerceptualImagePatchSimilarity`).
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        generator: Optional[Callable] = None,
+        net: Optional[Callable] = None,
+        num_samples: int = 10000,
+        conditional: bool = False,
+        epsilon: float = 1e-4,
+        resize: Optional[int] = 64,
+        lower_discard: Optional[float] = 0.01,
+        upper_discard: Optional[float] = 0.99,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if generator is None or net is None:
+            raise ModuleNotFoundError(
+                "PerceptualPathLength needs a `generator` callable (z -> images) and an LPIPS `net`"
+                " feature callable; pretrained defaults are unavailable in this offline build."
+            )
+        self.generator = generator
+        self.net = net
+        self.num_samples = num_samples
+        self.epsilon = epsilon
+        self.lower_discard = lower_discard
+        self.upper_discard = upper_discard
+        self.add_state("distances", [], dist_reduce_fx="cat")
+
+    def update(self, z0: Array, z1: Array) -> None:
+        """Update with latent pairs: generates endpoints of an ε-step interpolation."""
+        t = np.random.RandomState(0).rand(z0.shape[0]).astype(np.float32)[:, None]
+        zt0 = z0 * (1 - t) + z1 * t
+        zt1 = z0 * (1 - (t + self.epsilon)) + z1 * (t + self.epsilon)
+        img0 = self.generator(zt0)
+        img1 = self.generator(zt1)
+        d = _lpips_distance(self.net(img0), self.net(img1)) / (self.epsilon**2)
+        self.distances.append(d)
+
+    def compute(self) -> Array:
+        """Mean PPL with tail discards."""
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        d = np.asarray(dim_zero_cat(self.distances))
+        lo = np.quantile(d, self.lower_discard) if self.lower_discard else d.min()
+        hi = np.quantile(d, self.upper_discard) if self.upper_discard else d.max()
+        kept = d[(d >= lo) & (d <= hi)]
+        return jnp.asarray(kept.mean() if kept.size else 0.0, dtype=jnp.float32)

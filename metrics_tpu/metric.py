@@ -685,6 +685,26 @@ class Metric(ABC):
     def half(self) -> "Metric":
         return self.set_dtype(jnp.bfloat16)
 
+    def plot(self, val: Any = None, ax: Any = None):
+        """Plot a single or multiple values from the metric (reference ``metric.py`` ``plot`` / ``utilities/plot.py:65``).
+
+        Args:
+            val: value(s) to plot; defaults to ``compute()`` of this metric.
+            ax: existing matplotlib axis to draw into.
+        """
+        from metrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            ax=ax,
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+            name=self.__class__.__name__,
+        )
+
     def __hash__(self) -> int:
         hash_vals: List[Any] = [self.__class__.__name__]
         for key in self._defaults:

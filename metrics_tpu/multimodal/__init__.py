@@ -1,0 +1,5 @@
+"""Multimodal metrics (reference ``torchmetrics/multimodal/__init__.py``)."""
+
+from metrics_tpu.multimodal.clip_score import CLIPImageQualityAssessment, CLIPScore
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore"]

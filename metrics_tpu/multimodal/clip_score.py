@@ -1,0 +1,178 @@
+"""CLIP-based multimodal metrics with injectable encoders.
+
+Parity with reference ``multimodal/clip_score.py:43`` and ``clip_iqa.py`` (which
+pull HF transformers CLIP checkpoints — SURVEY §2.9). Offline build: inject
+``image_encoder``/``text_encoder`` callables returning embeddings; the metric owns
+the score math (cosine similarity ×100, clamped at 0; score list state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+def _unit(x: Array) -> Array:
+    return x / jnp.clip(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12, None)
+
+
+class CLIPScore(Metric):
+    """CLIPScore: 100 · max(cos(img_emb, txt_emb), 0) (reference ``multimodal/clip_score.py:43``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> img_enc = lambda imgs: jnp.asarray(rng.rand(len(imgs), 8).astype(np.float32))
+    >>> txt_enc = lambda txts: jnp.asarray(rng.rand(len(txts), 8).astype(np.float32))
+    >>> metric = CLIPScore(image_encoder=img_enc, text_encoder=txt_enc)
+    >>> metric.update([object(), object()], ["a cat", "a dog"])
+    >>> float(metric.compute()) > 0
+    True
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        image_encoder: Optional[Callable] = None,
+        text_encoder: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if image_encoder is None or text_encoder is None:
+            raise ModuleNotFoundError(
+                f"The pretrained CLIP checkpoint {model_name_or_path!r} requires downloaded weights,"
+                " unavailable in this offline build. Pass `image_encoder=` and `text_encoder=` callables"
+                " returning embeddings."
+            )
+        self.image_encoder = image_encoder
+        self.text_encoder = text_encoder
+        self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, images: Union[Array, Sequence], text: Union[str, Sequence[str]]) -> None:
+        """Update with images and matching captions."""
+        text_ = [text] if isinstance(text, str) else list(text)
+        if hasattr(images, "ndim") and images.ndim == 3:
+            images = images[None]
+        if len(images) != len(text_):
+            raise ValueError(
+                f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text_)}"
+            )
+        img_emb = _unit(jnp.asarray(self.image_encoder(images)))
+        txt_emb = _unit(jnp.asarray(self.text_encoder(text_)))
+        score = 100 * jnp.sum(img_emb * txt_emb, axis=-1)
+        self.score = self.score + jnp.clip(score, 0, None).sum()
+        self.n_samples = self.n_samples + score.shape[0]
+
+    def compute(self) -> Array:
+        """Average CLIPScore."""
+        return jnp.maximum(self.score / self.n_samples, 0.0).astype(jnp.float32)
+
+
+class CLIPImageQualityAssessment(Metric):
+    """CLIP-IQA (reference ``multimodal/clip_iqa.py:72``): softmax over paired
+    positive/negative prompt similarities.
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> img_enc = lambda imgs: jnp.asarray(rng.rand(len(imgs), 8).astype(np.float32))
+    >>> txt_enc = lambda txts: jnp.asarray(rng.rand(len(txts), 8).astype(np.float32))
+    >>> metric = CLIPImageQualityAssessment(image_encoder=img_enc, text_encoder=txt_enc)
+    >>> metric.update(jnp.zeros((2, 3, 8, 8)))
+    >>> out = metric.compute()
+    >>> bool((np.asarray(out) >= 0).all() and (np.asarray(out) <= 1).all())
+    True
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    _PROMPTS: Dict[str, Tuple[str, str]] = {
+        "quality": ("Good photo.", "Bad photo."),
+        "brightness": ("Bright photo.", "Dark photo."),
+        "noisiness": ("Clean photo.", "Noisy photo."),
+        "colorfullness": ("Colorful photo.", "Dull photo."),
+        "sharpness": ("Sharp photo.", "Blurry photo."),
+        "contrast": ("High contrast photo.", "Low contrast photo."),
+        "complexity": ("Complex photo.", "Simple photo."),
+        "natural": ("Natural photo.", "Synthetic photo."),
+        "happy": ("Happy photo.", "Sad photo."),
+        "scary": ("Scary photo.", "Peaceful photo."),
+        "new": ("New photo.", "Old photo."),
+        "warm": ("Warm photo.", "Cold photo."),
+        "real": ("Real photo.", "Abstract photo."),
+        "beautiful": ("Beautiful photo.", "Ugly photo."),
+        "lonely": ("Lonely photo.", "Sociable photo."),
+        "relaxing": ("Relaxing photo.", "Stressful photo."),
+    }
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        image_encoder: Optional[Callable] = None,
+        text_encoder: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if image_encoder is None or text_encoder is None:
+            raise ModuleNotFoundError(
+                "Pretrained CLIP weights are unavailable offline. Pass `image_encoder=` and `text_encoder=`"
+                " callables returning embeddings."
+            )
+        self.image_encoder = image_encoder
+        self.text_encoder = text_encoder
+        resolved = []
+        names = []
+        for p in prompts:
+            if isinstance(p, str):
+                if p not in self._PROMPTS:
+                    raise ValueError(f"Unknown prompt {p!r}; expected one of {sorted(self._PROMPTS)} or a (pos, neg) tuple")
+                resolved.append(self._PROMPTS[p])
+                names.append(p)
+            elif isinstance(p, tuple) and len(p) == 2:
+                resolved.append(p)
+                names.append(f"user_defined_{len(names)}")
+            else:
+                raise ValueError("Argument `prompts` must contain strings or (positive, negative) tuples")
+        self.prompt_pairs = resolved
+        self.prompt_names = names
+        self.add_state("scores", [], dist_reduce_fx="cat")
+
+    def update(self, images: Array) -> None:
+        """Update with an image batch."""
+        img_emb = _unit(jnp.asarray(self.image_encoder(images)))
+        per_prompt = []
+        for pos, neg in self.prompt_pairs:
+            txt_emb = _unit(jnp.asarray(self.text_encoder([pos, neg])))
+            import jax
+
+            logits = 100 * img_emb @ txt_emb.T  # (N, 2)
+            probs = jax.nn.softmax(logits, axis=-1)[:, 0]  # max-subtracted, no f32 overflow
+            per_prompt.append(probs)
+        self.scores.append(jnp.stack(per_prompt, axis=-1))  # (N, P)
+
+    def compute(self) -> Union[Array, Dict[str, Array]]:
+        """Per-image scores (single prompt) or dict of per-prompt score vectors."""
+        scores = dim_zero_cat(self.scores)
+        if len(self.prompt_names) == 1:
+            return scores[:, 0]
+        return {name: scores[:, i] for i, name in enumerate(self.prompt_names)}

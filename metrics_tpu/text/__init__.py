@@ -1,5 +1,6 @@
 """Modular text metrics (reference ``torchmetrics/text/__init__.py``)."""
 
+from metrics_tpu.text.model_based import BERTScore, InfoLM
 from metrics_tpu.text.metrics import (
     BLEUScore,
     CharErrorRate,
@@ -18,11 +19,13 @@ from metrics_tpu.text.metrics import (
 )
 
 __all__ = [
+    "BERTScore",
     "BLEUScore",
     "CHRFScore",
     "CharErrorRate",
     "EditDistance",
     "ExtendedEditDistance",
+    "InfoLM",
     "MatchErrorRate",
     "Perplexity",
     "ROUGEScore",

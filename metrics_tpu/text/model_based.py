@@ -1,0 +1,192 @@
+"""Model-based text metrics: BERTScore and InfoLM with injectable encoders.
+
+Parity with reference ``text/bert.py:55`` and ``text/infolm.py`` (which download HF
+transformers checkpoints — SURVEY §2.9). Offline build: inject an ``encoder``
+callable mapping a list of strings to per-token embedding arrays (list of (T_i, D));
+the metric owns the greedy cosine-matching P/R/F math (BERTScore) and the
+information-measure aggregation (InfoLM, given a token-distribution callable).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.metric import Metric
+
+
+class BERTScore(Metric):
+    """BERTScore (reference ``text/bert.py:55``): greedy cosine matching of token embeddings.
+
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> vocab = {w: rng.rand(8) for w in "the cat sat on mat a dog ran".split()}
+    >>> encoder = lambda texts: [np.stack([vocab[w] for w in t.split()]) for t in texts]
+    >>> metric = BERTScore(encoder=encoder)
+    >>> metric.update(["the cat sat"], ["the cat sat"])
+    >>> round(float(metric.compute()["f1"]), 4)
+    1.0
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        encoder: Optional[Callable] = None,
+        idf: bool = False,
+        rescale_with_baseline: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if encoder is None:
+            raise ModuleNotFoundError(
+                f"The pretrained checkpoint {model_name_or_path!r} requires downloaded transformers weights,"
+                " unavailable in this offline build. Pass `encoder=` returning per-token embeddings."
+            )
+        self.encoder = encoder
+        self.idf = idf
+        self.rescale_with_baseline = rescale_with_baseline
+        self._pairs: List = []
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        """Store prediction/reference pairs."""
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        self._pairs.extend(zip(preds_, target_))
+
+    def compute(self) -> Dict[str, Array]:
+        """Greedy-match P/R/F1 per pair, averaged."""
+        ps, rs, fs = [], [], []
+        pred_embs = self.encoder([p for p, _ in self._pairs])
+        tgt_embs = self.encoder([t for _, t in self._pairs])
+        for pe, te in zip(pred_embs, tgt_embs):
+            pe = np.asarray(pe, dtype=np.float64)
+            te = np.asarray(te, dtype=np.float64)
+            pe = pe / np.clip(np.linalg.norm(pe, axis=-1, keepdims=True), 1e-12, None)
+            te = te / np.clip(np.linalg.norm(te, axis=-1, keepdims=True), 1e-12, None)
+            sim = pe @ te.T  # (Tp, Tt)
+            p = sim.max(axis=1).mean() if sim.size else 0.0
+            r = sim.max(axis=0).mean() if sim.size else 0.0
+            f = 2 * p * r / (p + r) if (p + r) else 0.0
+            ps.append(p)
+            rs.append(r)
+            fs.append(f)
+        return {
+            "precision": jnp.asarray(np.mean(ps) if ps else 0.0, dtype=jnp.float32),
+            "recall": jnp.asarray(np.mean(rs) if rs else 0.0, dtype=jnp.float32),
+            "f1": jnp.asarray(np.mean(fs) if fs else 0.0, dtype=jnp.float32),
+        }
+
+    def reset(self) -> None:
+        """Reset stored pairs too."""
+        super().reset()
+        self._pairs = []
+
+
+class InfoLM(Metric):
+    """InfoLM (reference ``text/infolm.py:40``): information measures between masked-LM
+    token distributions of prediction and reference.
+
+    Requires a ``distribution_fn`` mapping a list of strings to per-text token
+    probability arrays (T_i, V) — e.g. a masked-LM apply fn.
+    """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    _MEASURES = ("kl_divergence", "alpha_divergence", "beta_divergence", "ab_divergence",
+                 "renyi_divergence", "l1_distance", "l2_distance", "l_infinity_distance",
+                 "fisher_rao_distance")
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        distribution_fn: Optional[Callable] = None,
+        information_measure: str = "kl_divergence",
+        idf: bool = False,
+        alpha: float = 0.25,
+        beta: float = 0.25,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if distribution_fn is None:
+            raise ModuleNotFoundError(
+                f"The pretrained checkpoint {model_name_or_path!r} requires downloaded transformers weights,"
+                " unavailable offline. Pass `distribution_fn=` returning per-token distributions."
+            )
+        if information_measure not in self._MEASURES:
+            raise ValueError(f"Expected `information_measure` to be one of {self._MEASURES}")
+        self.distribution_fn = distribution_fn
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self._pairs: List = []
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        """Store prediction/reference pairs."""
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        self._pairs.extend(zip(preds_, target_))
+
+    def _measure(self, p: np.ndarray, q: np.ndarray) -> float:
+        eps = 1e-12
+        p = np.clip(p, eps, None)
+        q = np.clip(q, eps, None)
+        m = self.information_measure
+        if m == "kl_divergence":
+            return float(np.sum(p * np.log(p / q)))
+        if m == "l1_distance":
+            return float(np.abs(p - q).sum())
+        if m == "l2_distance":
+            return float(np.sqrt(((p - q) ** 2).sum()))
+        if m == "l_infinity_distance":
+            return float(np.abs(p - q).max())
+        if m == "fisher_rao_distance":
+            return float(2 * np.arccos(np.clip(np.sum(np.sqrt(p * q)), 0, 1)))
+        if m == "alpha_divergence":
+            a = self.alpha
+            return float((1 - np.sum(p**a * q ** (1 - a))) / (a * (1 - a)))
+        if m == "renyi_divergence":
+            a = self.alpha
+            return float(np.log(np.sum(p**a * q ** (1 - a))) / (a - 1))
+        if m == "beta_divergence":
+            b = self.beta
+            return float(
+                np.sum(p ** (b + 1)) / (b * (b + 1)) + np.sum(q ** (b + 1)) / (b + 1) - np.sum(p * q**b) / b
+            )
+        # ab_divergence
+        a, b = self.alpha, self.beta
+        return float(
+            np.log(np.sum(p ** (a + b))) / (b * (a + b)) + np.log(np.sum(q ** (a + b))) / (a * (a + b))
+            - np.log(np.sum(p**a * q**b)) / (a * b)
+        )
+
+    def compute(self) -> Array:
+        """Average information measure over pairs (mean-pooled token distributions)."""
+        pred_dists = self.distribution_fn([p for p, _ in self._pairs])
+        tgt_dists = self.distribution_fn([t for _, t in self._pairs])
+        vals = []
+        for pd, td in zip(pred_dists, tgt_dists):
+            p = np.asarray(pd, dtype=np.float64).mean(0)
+            q = np.asarray(td, dtype=np.float64).mean(0)
+            p = p / p.sum()
+            q = q / q.sum()
+            vals.append(self._measure(p, q))
+        return jnp.asarray(np.mean(vals) if vals else 0.0, dtype=jnp.float32)
+
+    def reset(self) -> None:
+        """Reset stored pairs too."""
+        super().reset()
+        self._pairs = []

@@ -1,0 +1,140 @@
+"""Plotting helpers (reference ``torchmetrics/utilities/plot.py``).
+
+Host-side matplotlib (gated like the reference): ``plot_single_or_multi_val :65``,
+``plot_confusion_matrix :221``, ``plot_curve :297``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from metrics_tpu.utils.imports import _MATPLOTLIB_AVAILABLE
+
+
+def _error_on_missing_matplotlib() -> None:
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(
+            "Plot function expects `matplotlib` to be installed. Please install with `pip install matplotlib`"
+        )
+
+
+def plot_single_or_multi_val(
+    val,
+    ax=None,
+    higher_is_better: Optional[bool] = None,
+    lower_bound: Optional[float] = None,
+    upper_bound: Optional[float] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Plot a single scalar, a vector of per-class values, or a sequence over steps
+    (reference ``plot.py:65-218``)."""
+    _error_on_missing_matplotlib()
+    import matplotlib.pyplot as plt
+
+    fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    if isinstance(val, dict):
+        for key, item in val.items():
+            arr = np.atleast_1d(np.asarray(item))
+            ax.plot(np.arange(len(arr)), arr, marker="o", label=key)
+        ax.legend()
+    elif isinstance(val, (list, tuple)) or (hasattr(val, "ndim") and np.asarray(val).ndim > 0 and np.asarray(val).size > 1):
+        arr = np.asarray([np.asarray(v) for v in val]) if isinstance(val, (list, tuple)) else np.asarray(val)
+        if arr.ndim == 1:
+            ax.plot(np.arange(len(arr)), arr, marker="o", label=legend_name)
+        else:
+            for ci in range(arr.shape[-1]):
+                ax.plot(np.arange(arr.shape[0]), arr[:, ci], marker="o",
+                        label=f"{legend_name or 'series'} {ci}")
+        if legend_name:
+            ax.legend()
+    else:
+        ax.bar(0, float(np.asarray(val)), width=0.4)
+        ax.set_xticks([])
+    if lower_bound is not None or upper_bound is not None:
+        ax.set_ylim(bottom=lower_bound, top=upper_bound)
+    if name:
+        ax.set_title(name)
+    ax.grid(True, alpha=0.3)
+    return fig, ax
+
+
+def plot_confusion_matrix(
+    confmat,
+    ax=None,
+    add_text: bool = True,
+    labels: Optional[Sequence[str]] = None,
+    cmap: Optional[str] = None,
+):
+    """Plot a (C, C) or (L, 2, 2) confusion matrix (reference ``plot.py:221-294``)."""
+    _error_on_missing_matplotlib()
+    import matplotlib.pyplot as plt
+
+    confmat = np.asarray(confmat)
+    if confmat.ndim == 3:
+        nb, fig_label = confmat.shape[0], labels or [str(i) for i in range(confmat.shape[0])]
+        fig, axs = plt.subplots(nrows=1, ncols=nb, figsize=(4 * nb, 4))
+        axs = np.atleast_1d(axs)
+        for i in range(nb):
+            ax_i = axs[i]
+            ax_i.imshow(confmat[i], cmap=cmap)
+            ax_i.set_title(f"Label {fig_label[i]}")
+            if add_text:
+                for r in range(2):
+                    for c in range(2):
+                        ax_i.text(c, r, f"{confmat[i, r, c]:.0f}", ha="center", va="center")
+        return fig, axs
+    fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    im = ax.imshow(confmat, cmap=cmap)
+    fig.colorbar(im, ax=ax)
+    n = confmat.shape[0]
+    tick_labels = labels or [str(i) for i in range(n)]
+    ax.set_xticks(range(n), tick_labels)
+    ax.set_yticks(range(n), tick_labels)
+    ax.set_xlabel("Predicted")
+    ax.set_ylabel("True")
+    if add_text:
+        for r in range(n):
+            for c in range(n):
+                ax.text(c, r, f"{confmat[r, c]:.0f}", ha="center", va="center")
+    return fig, ax
+
+
+def plot_curve(
+    curve: Tuple,
+    score=None,
+    ax=None,
+    label_names: Optional[Tuple[str, str]] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Plot an (x, y[, thresholds]) curve, e.g. ROC/PR (reference ``plot.py:297-366``)."""
+    _error_on_missing_matplotlib()
+    import matplotlib.pyplot as plt
+
+    x, y = np.asarray(curve[0]), np.asarray(curve[1])
+    fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    if isinstance(curve[0], (list, tuple)) and not hasattr(curve[0], "ndim"):
+        for i, (xi, yi) in enumerate(zip(curve[0], curve[1])):
+            ax.plot(np.asarray(xi), np.asarray(yi), label=f"{legend_name or 'class'} {i}")
+        ax.legend()
+    elif x.ndim == 2:
+        for i in range(x.shape[0]):
+            ax.plot(x[i], y[i], label=f"{legend_name or 'class'} {i}")
+        ax.legend()
+    else:
+        lbl = None
+        if score is not None:
+            lbl = f"AUC={float(np.asarray(score)):.3f}"
+        ax.plot(x, y, label=lbl)
+        if lbl:
+            ax.legend()
+    if label_names:
+        ax.set_xlabel(label_names[0])
+        ax.set_ylabel(label_names[1])
+    if name:
+        ax.set_title(name)
+    ax.grid(True, alpha=0.3)
+    return fig, ax

@@ -3,6 +3,7 @@
 from metrics_tpu.wrappers.abstract import WrapperMetric
 from metrics_tpu.wrappers.bootstrapping import BootStrapper
 from metrics_tpu.wrappers.classwise import ClasswiseWrapper
+from metrics_tpu.wrappers.feature_share import FeatureShare, NetworkCache
 from metrics_tpu.wrappers.minmax import MinMaxMetric
 from metrics_tpu.wrappers.multioutput import MultioutputWrapper
 from metrics_tpu.wrappers.multitask import MultitaskWrapper
@@ -18,12 +19,14 @@ __all__ = [
     "BinaryTargetTransformer",
     "BootStrapper",
     "ClasswiseWrapper",
+    "FeatureShare",
     "LambdaInputTransformer",
     "MetricInputTransformer",
     "MetricTracker",
     "MinMaxMetric",
     "MultioutputWrapper",
     "MultitaskWrapper",
+    "NetworkCache",
     "Running",
     "WrapperMetric",
 ]

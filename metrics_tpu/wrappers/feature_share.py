@@ -1,0 +1,85 @@
+"""FeatureShare wrapper (reference ``wrappers/feature_share.py:27-127``).
+
+Wraps metrics that each own a feature-extractor callable (e.g. FID/KID/IS sharing
+one InceptionV3) so the backbone forward runs ONCE per batch: the shared network is
+memoized on the input's object id for the duration of an update — the functional
+equivalent of the reference's ``NetworkCache`` lru_cache.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+
+
+class NetworkCache:
+    """Memoize a feature network on argument identity (reference ``feature_share.py:27-43``)."""
+
+    def __init__(self, network: Callable, max_size: int = 100) -> None:
+        self.network = network
+        self.max_size = max_size
+        self._cache: Dict[int, Any] = {}
+        self._order: list = []
+
+    def __call__(self, x):
+        key = id(x)
+        hit = self._cache.get(key)
+        # hold a strong reference to the keyed object: id() values are reused after
+        # GC, so a hit is only valid if it is literally the same live object
+        if hit is not None and hit[0] is x:
+            return hit[1]
+        out = self.network(x)
+        self._cache[key] = (x, out)
+        self._order.append(key)
+        if len(self._order) > self.max_size:
+            oldest = self._order.pop(0)
+            self._cache.pop(oldest, None)
+        return out
+
+
+class FeatureShare(MetricCollection):
+    """Share one feature-network forward across member metrics (reference ``feature_share.py:46``).
+
+    Each member must expose the feature callable under ``feature_extractor`` (or
+    ``net``); it is replaced by a shared :class:`NetworkCache` around the first
+    member's network (or an explicitly provided one).
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        max_cache_size: Optional[int] = None,
+        network: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(metrics, **kwargs)
+        if max_cache_size is None:
+            max_cache_size = len(self)
+        if not isinstance(max_cache_size, int):
+            raise TypeError(f"max_cache_size should be an integer, but got {max_cache_size}")
+
+        shared_net = network
+        attr_names = ("feature_extractor", "net")
+        if shared_net is None:
+            for m in self.values():
+                for attr in attr_names:
+                    fn = getattr(m, attr, None)
+                    if callable(fn):
+                        shared_net = fn
+                        break
+                if shared_net is not None:
+                    break
+        if shared_net is None:
+            raise AttributeError(
+                "Tried to extract the network to share from the first metric, but it did not have a"
+                " `feature_extractor` or `net` attribute. Please provide the `network` argument."
+            )
+        cache = NetworkCache(shared_net, max_size=max_cache_size)
+        for m in self.values():
+            for attr in attr_names:
+                if callable(getattr(m, attr, None)):
+                    setattr(m, attr, cache)
+        self.network_cache = cache

@@ -1,0 +1,190 @@
+"""Tests for injectable-backbone metrics (FID/KID/IS/MiFID/LPIPS/CLIP/BERTScore) + plotting + FeatureShare."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MemorizationInformedFrechetInceptionDistance,
+)
+from metrics_tpu.multimodal import CLIPScore
+from metrics_tpu.text import BERTScore, InfoLM
+from metrics_tpu.wrappers import FeatureShare
+
+_rng = np.random.RandomState(99)
+
+
+def test_fid_vs_closed_form():
+    """FID between two gaussians must match the analytic Fréchet distance."""
+    d = 8
+    real = _rng.randn(5000, d)
+    fake = _rng.randn(5000, d) * 1.5 + 1.0
+    fid = FrechetInceptionDistance(feature=None)
+    fid.update(jnp.asarray(real.astype(np.float32)), real=True)
+    fid.update(jnp.asarray(fake.astype(np.float32)), real=False)
+    got = float(fid.compute())
+    # analytic for the *empirical* moments
+    mu1, mu2 = real.mean(0), fake.mean(0)
+    c1, c2 = np.cov(real, rowvar=False), np.cov(fake, rowvar=False)
+    from scipy.linalg import sqrtm
+
+    ref = float((mu1 - mu2) @ (mu1 - mu2) + np.trace(c1 + c2 - 2 * sqrtm(c1 @ c2).real))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_fid_identical_near_zero_and_reset_real():
+    feats = _rng.randn(500, 6).astype(np.float32)
+    fid = FrechetInceptionDistance(feature=None, reset_real_features=False)
+    fid.update(jnp.asarray(feats), real=True)
+    fid.update(jnp.asarray(feats), real=False)
+    np.testing.assert_allclose(float(fid.compute()), 0.0, atol=1e-4)
+    fid.reset()
+    # real stats kept; adding identical fakes again → still ~0
+    fid.update(jnp.asarray(feats), real=False)
+    np.testing.assert_allclose(float(fid.compute()), 0.0, atol=1e-4)
+
+
+def test_fid_requires_two_samples():
+    fid = FrechetInceptionDistance()
+    fid.update(jnp.asarray(_rng.randn(1, 4).astype(np.float32)), real=True)
+    fid.update(jnp.asarray(_rng.randn(5, 4).astype(np.float32)), real=False)
+    with pytest.raises(RuntimeError, match="More than one sample"):
+        fid.compute()
+
+
+def test_fid_int_feature_gated():
+    with pytest.raises(ModuleNotFoundError, match="offline"):
+        FrechetInceptionDistance(feature=2048)
+
+
+def test_kid_separated_vs_identical():
+    x = _rng.randn(200, 8).astype(np.float32)
+    kid_same = KernelInceptionDistance(subsets=5, subset_size=50)
+    kid_same.update(jnp.asarray(x), real=True)
+    kid_same.update(jnp.asarray(x.copy()), real=False)
+    mean_same, _ = kid_same.compute()
+    kid_diff = KernelInceptionDistance(subsets=5, subset_size=50)
+    kid_diff.update(jnp.asarray(x), real=True)
+    kid_diff.update(jnp.asarray(x + 2.0), real=False)
+    mean_diff, _ = kid_diff.compute()
+    assert abs(float(mean_same)) < 0.1
+    assert float(mean_diff) > float(mean_same)
+
+
+def test_inception_score_uniform_vs_confident():
+    n, k = 200, 10
+    uniform_logits = np.zeros((n, k), dtype=np.float32)
+    confident = np.full((n, k), -20.0, dtype=np.float32)
+    confident[np.arange(n), _rng.randint(0, k, n)] = 20.0
+    m1 = InceptionScore(splits=4)
+    m1.update(jnp.asarray(uniform_logits))
+    low, _ = m1.compute()
+    m2 = InceptionScore(splits=4)
+    m2.update(jnp.asarray(confident))
+    high, _ = m2.compute()
+    np.testing.assert_allclose(float(low), 1.0, atol=1e-4)  # uniform → IS = 1
+    assert float(high) > 5.0  # confident diverse → close to k
+
+
+def test_mifid_runs():
+    real = _rng.randn(300, 8).astype(np.float32)
+    fake = (_rng.randn(300, 8) + 0.5).astype(np.float32)
+    m = MemorizationInformedFrechetInceptionDistance()
+    m.update(jnp.asarray(real), real=True)
+    m.update(jnp.asarray(fake), real=False)
+    assert float(m.compute()) > 0
+
+
+def test_lpips_identical_zero():
+    net = lambda x: [x, x[:, :, ::2, ::2]]
+    m = LearnedPerceptualImagePatchSimilarity(net=net)
+    a = jnp.asarray(_rng.rand(4, 3, 16, 16).astype(np.float32))
+    m.update(a, a)
+    np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-6)
+    with pytest.raises(ModuleNotFoundError, match="offline"):
+        LearnedPerceptualImagePatchSimilarity()
+
+
+def test_clip_score_injectable():
+    # encoders that map matching pairs to the same embedding
+    def img_enc(imgs):
+        return jnp.asarray([[1.0, 0.0], [0.0, 1.0]][: len(imgs)])
+
+    def txt_enc(texts):
+        return jnp.asarray([[1.0, 0.0], [0.0, 1.0]][: len(texts)])
+
+    m = CLIPScore(image_encoder=img_enc, text_encoder=txt_enc)
+    m.update([object(), object()], ["a", "b"])
+    np.testing.assert_allclose(float(m.compute()), 100.0, atol=1e-4)
+    with pytest.raises(ModuleNotFoundError):
+        CLIPScore()
+
+
+def test_bert_score_injectable():
+    vocab = {w: _rng.rand(8) for w in "the cat sat on mat a dog".split()}
+    encoder = lambda texts: [np.stack([vocab[w] for w in t.split()]) for t in texts]
+    m = BERTScore(encoder=encoder)
+    m.update(["the cat sat"], ["the cat sat on mat"])
+    res = m.compute()
+    assert float(res["recall"]) <= 1.0 and float(res["precision"]) > 0.9
+    with pytest.raises(ModuleNotFoundError):
+        BERTScore()
+
+
+def test_infolm_injectable():
+    def dist_fn(texts):
+        out = []
+        for t in texts:
+            n = len(t.split())
+            d = np.ones((n, 5)) / 5
+            out.append(d)
+        return out
+
+    m = InfoLM(distribution_fn=dist_fn)
+    m.update(["a b c"], ["a b c"])
+    np.testing.assert_allclose(float(m.compute()), 0.0, atol=1e-6)  # identical dists → KL 0
+
+
+def test_feature_share_single_forward():
+    calls = {"n": 0}
+
+    def net(x):
+        calls["n"] += 1
+        return x
+
+    fid = FrechetInceptionDistance(feature=net)
+    kid = KernelInceptionDistance(feature=net, subsets=2, subset_size=20)
+    fs = FeatureShare([fid, kid])
+    batch = jnp.asarray(_rng.randn(50, 6).astype(np.float32))
+    fs.update(batch, real=True)
+    assert calls["n"] == 1  # ONE shared forward for both metrics
+    fs.update(jnp.asarray(_rng.randn(50, 6).astype(np.float32)), real=False)
+    assert calls["n"] == 2
+
+
+def test_metric_plot():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from metrics_tpu.classification import BinaryAccuracy, BinaryConfusionMatrix, BinaryROC
+    from metrics_tpu.utils.plot import plot_confusion_matrix, plot_curve
+
+    m = BinaryAccuracy()
+    m.update(jnp.asarray([1, 0, 1]), jnp.asarray([1, 1, 1]))
+    fig, ax = m.plot()
+    assert fig is not None
+
+    cm = BinaryConfusionMatrix()
+    cm.update(jnp.asarray([1, 0, 1]), jnp.asarray([1, 1, 1]))
+    fig2, _ = plot_confusion_matrix(cm.compute())
+    assert fig2 is not None
+
+    roc = BinaryROC(thresholds=10)
+    roc.update(jnp.asarray([0.2, 0.8, 0.6]), jnp.asarray([0, 1, 1]))
+    fpr, tpr, _ = roc.compute()
+    fig3, _ = plot_curve((fpr, tpr), label_names=("fpr", "tpr"))
+    assert fig3 is not None
