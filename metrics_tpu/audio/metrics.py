@@ -53,7 +53,7 @@ class SignalNoiseRatio(_AveragedAudioMetric):
     >>> metric = SignalNoiseRatio()
     >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
     >>> metric.compute()
-    Array(16.1805, dtype=float32)
+    Array(16.180481, dtype=float32)
     """
 
     higher_is_better = True
@@ -73,7 +73,7 @@ class ScaleInvariantSignalDistortionRatio(_AveragedAudioMetric):
     >>> metric = ScaleInvariantSignalDistortionRatio()
     >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
     >>> metric.compute()
-    Array(18.4030, dtype=float32)
+    Array(18.402992, dtype=float32)
     """
 
     higher_is_better = True

@@ -100,7 +100,7 @@ class Accuracy(_ClassificationTaskWrapper):
     >>> accuracy = Accuracy(task="multiclass", num_classes=3)
     >>> accuracy.update(preds, target)
     >>> accuracy.compute()
-    Array(0.8333334, dtype=float32)
+    Array(0.75, dtype=float32)
     """
 
     def __new__(  # type: ignore[misc]

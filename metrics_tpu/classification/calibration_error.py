@@ -34,7 +34,7 @@ class BinaryCalibrationError(Metric):
     >>> metric = BinaryCalibrationError(n_bins=2, norm='l1')
     >>> metric.update(preds, target)
     >>> metric.compute()
-    Array(0.29, dtype=float32)
+    Array(0.29000002, dtype=float32)
     """
 
     is_differentiable = False

@@ -73,7 +73,7 @@ class MulticlassFBetaScore(MulticlassStatScores):
     >>> metric = MulticlassFBetaScore(beta=2.0, num_classes=3)
     >>> metric.update(preds, target)
     >>> metric.compute()
-    Array(0.79365075, dtype=float32)
+    Array(0.7962963, dtype=float32)
     """
 
     is_differentiable = False

@@ -62,7 +62,7 @@ class MulticlassJaccardIndex(MulticlassConfusionMatrix):
     >>> metric = MulticlassJaccardIndex(num_classes=3)
     >>> metric.update(preds, target)
     >>> metric.compute()
-    Array(0.7777778, dtype=float32)
+    Array(0.6666667, dtype=float32)
     """
 
     is_differentiable = False

@@ -26,7 +26,7 @@ class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
     >>> metric = BinaryMatthewsCorrCoef()
     >>> metric.update(preds, target)
     >>> metric.compute()
-    Array(0.5773503, dtype=float32)
+    Array(0.57735026, dtype=float32)
     """
 
     is_differentiable = False
@@ -125,7 +125,7 @@ class MatthewsCorrCoef(_ClassificationTaskWrapper):
     >>> metric = MatthewsCorrCoef(task="binary")
     >>> metric.update(preds, target)
     >>> metric.compute()
-    Array(0.5773503, dtype=float32)
+    Array(0.57735026, dtype=float32)
     """
 
     def __new__(  # type: ignore[misc]

@@ -47,7 +47,7 @@ class BinaryPrecisionRecallCurve(Metric):
     >>> metric.update(preds, target)
     >>> precision, recall, thresholds = metric.compute()
     >>> recall
-    Array([1. , 1. , 1. , 0.5, 0. , 0. ], dtype=float32)
+    Array([1., 1., 1., 0., 0., 0.], dtype=float32)
     """
 
     is_differentiable = False

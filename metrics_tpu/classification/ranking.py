@@ -72,7 +72,7 @@ class MultilabelCoverageError(_MultilabelRankingBase):
     >>> mcr = MultilabelCoverageError(num_labels=5)
     >>> mcr.update(preds, target)
     >>> mcr.compute()
-    Array(3.9, dtype=float32)
+    Array(4.2, dtype=float32)
     """
 
     higher_is_better = False
@@ -90,7 +90,7 @@ class MultilabelRankingAveragePrecision(_MultilabelRankingBase):
     >>> mlrap = MultilabelRankingAveragePrecision(num_labels=5)
     >>> mlrap.update(preds, target)
     >>> mlrap.compute()
-    Array(0.7744048, dtype=float32)
+    Array(0.7184722, dtype=float32)
     """
 
     higher_is_better = True
@@ -109,7 +109,7 @@ class MultilabelRankingLoss(_MultilabelRankingBase):
     >>> mlrl = MultilabelRankingLoss(num_labels=5)
     >>> mlrl.update(preds, target)
     >>> mlrl.compute()
-    Array(0.4155556, dtype=float32)
+    Array(0.5083333, dtype=float32)
     """
 
     higher_is_better = False

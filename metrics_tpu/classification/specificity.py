@@ -80,7 +80,7 @@ class Specificity(_ClassificationTaskWrapper):
     >>> specificity = Specificity(task="multiclass", average='macro', num_classes=3)
     >>> specificity.update(preds, target)
     >>> specificity.compute()
-    Array(0.7777778, dtype=float32)
+    Array(0.6111111, dtype=float32)
     """
 
     def __new__(  # type: ignore[misc]

@@ -1,0 +1,13 @@
+"""Doctest rig: force the CPU platform with 8 virtual devices before jax initialises.
+
+Lets ``pytest --doctest-modules metrics_tpu/`` run every docstring example (the
+reference runs doctests in CI — SURVEY §4.6) without touching the TPU.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -30,7 +30,7 @@ class IntersectionOverUnion(Metric):
     >>> metric = IntersectionOverUnion()
     >>> metric.update(preds, target)
     >>> round(float(metric.compute()["iou"]), 4)
-    0.6314
+    0.6898
     """
 
     __jit_ineligible__ = True

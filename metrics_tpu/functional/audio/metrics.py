@@ -30,7 +30,7 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
     >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
     >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
     >>> signal_noise_ratio(preds, target)
-    Array(16.1805, dtype=float32)
+    Array(16.180481, dtype=float32)
     """
     _check_same_shape(preds, target)
     eps = jnp.finfo(jnp.float32).eps
@@ -50,7 +50,7 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
     >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
     >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
     >>> scale_invariant_signal_distortion_ratio(preds, target)
-    Array(18.4030, dtype=float32)
+    Array(18.402992, dtype=float32)
     """
     _check_same_shape(preds, target)
     eps = jnp.finfo(jnp.float32).eps

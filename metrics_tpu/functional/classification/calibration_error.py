@@ -119,7 +119,7 @@ def binary_calibration_error(
     >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
     >>> target = jnp.array([0, 0, 1, 1, 1])
     >>> binary_calibration_error(preds, target, n_bins=2, norm='l1')
-    Array(0.29, dtype=float32)
+    Array(0.29000002, dtype=float32)
     """
     if validate_args:
         _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)

@@ -106,7 +106,7 @@ def multiclass_jaccard_index(
     >>> target = jnp.array([2, 1, 0, 0])
     >>> preds = jnp.array([2, 1, 0, 1])
     >>> multiclass_jaccard_index(preds, target, num_classes=3)
-    Array(0.7777778, dtype=float32)
+    Array(0.6666667, dtype=float32)
     """
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index)

@@ -81,7 +81,7 @@ def binary_matthews_corrcoef(
     >>> target = jnp.array([1, 1, 0, 0])
     >>> preds = jnp.array([0, 1, 0, 0])
     >>> binary_matthews_corrcoef(preds, target)
-    Array(0.5773503, dtype=float32)
+    Array(0.57735026, dtype=float32)
     """
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index)

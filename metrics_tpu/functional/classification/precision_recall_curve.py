@@ -210,7 +210,7 @@ def binary_precision_recall_curve(
     >>> target = jnp.array([0, 1, 1, 0])
     >>> precision, recall, thresholds = binary_precision_recall_curve(preds, target, thresholds=5)
     >>> precision
-    Array([0.5      , 0.6666667, 0.6666667, 0.5      , 0.       , 1.       ],      dtype=float32)
+    Array([0.5      , 0.6666667, 0.6666667, 0.       , 0.       , 1.       ],      dtype=float32)
     """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)

@@ -57,7 +57,7 @@ def multilabel_coverage_error(
     >>> preds = jnp.asarray(rng.rand(10, 5).astype(np.float32))
     >>> target = jnp.asarray(rng.randint(2, size=(10, 5)))
     >>> multilabel_coverage_error(preds, target, num_labels=5)
-    Array(3.9, dtype=float32)
+    Array(4.2, dtype=float32)
     """
     if validate_args:
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
@@ -106,7 +106,7 @@ def multilabel_ranking_average_precision(
     >>> preds = jnp.asarray(rng.rand(10, 5).astype(np.float32))
     >>> target = jnp.asarray(rng.randint(2, size=(10, 5)))
     >>> multilabel_ranking_average_precision(preds, target, num_labels=5)
-    Array(0.7744048, dtype=float32)
+    Array(0.7184722, dtype=float32)
     """
     if validate_args:
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
@@ -148,7 +148,7 @@ def multilabel_ranking_loss(
     >>> preds = jnp.asarray(rng.rand(10, 5).astype(np.float32))
     >>> target = jnp.asarray(rng.randint(2, size=(10, 5)))
     >>> multilabel_ranking_loss(preds, target, num_labels=5)
-    Array(0.4155556, dtype=float32)
+    Array(0.5083333, dtype=float32)
     """
     if validate_args:
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)

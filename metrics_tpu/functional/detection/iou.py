@@ -33,7 +33,7 @@ def intersection_over_union(
     >>> preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
     >>> target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
     >>> intersection_over_union(preds, target)
-    Array(0.6807, dtype=float32)
+    Array(0.6806723, dtype=float32)
     """
     inter, union = _box_inter_union(preds.astype(jnp.float32), target.astype(jnp.float32))
     iou = inter / jnp.clip(union, 1e-9, None)
@@ -53,7 +53,7 @@ def generalized_intersection_over_union(
     >>> preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
     >>> target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
     >>> generalized_intersection_over_union(preds, target)
-    Array(0.6641, dtype=float32)
+    Array(0.6641434, dtype=float32)
     """
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)

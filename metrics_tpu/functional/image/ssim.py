@@ -215,7 +215,7 @@ def multiscale_structural_similarity_index_measure(
     >>> preds = jnp.asarray(rng.rand(3, 3, 180, 180).astype(np.float32))
     >>> target = jnp.asarray(np.asarray(preds) * 0.75)
     >>> round(float(multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)), 4)
-    0.9558
+    0.963
     """
     if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
         raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")

@@ -57,9 +57,9 @@ def pairwise_cosine_similarity(
     >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
     >>> y = jnp.array([[1., 0.], [2., 1.]])
     >>> pairwise_cosine_similarity(x, y)
-    Array([[0.5547002 , 0.8682431 ],
-           [0.51449573, 0.8436614 ],
-           [0.5300003 , 0.8533557 ]], dtype=float32)
+    Array([[0.5547002 , 0.86824316],
+           [0.5144958 , 0.84366155],
+           [0.52999896, 0.85328186]], dtype=float32)
     """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     norm_x = jnp.linalg.norm(x, axis=1, keepdims=True)
@@ -79,8 +79,8 @@ def pairwise_euclidean_distance(
     >>> y = jnp.array([[1., 0.], [2., 1.]])
     >>> pairwise_euclidean_distance(x, y)
     Array([[3.1622777, 2.       ],
-           [5.385165 , 4.1231055],
-           [8.944272 , 7.6157727]], dtype=float32)
+           [5.3851647, 4.1231055],
+           [8.944272 , 7.615773 ]], dtype=float32)
     """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)

@@ -25,7 +25,7 @@ def concordance_corrcoef(preds: Array, target: Array) -> Array:
     >>> target = jnp.array([3., -0.5, 2., 7.])
     >>> preds = jnp.array([2.5, 0.0, 2., 8.])
     >>> concordance_corrcoef(preds, target)
-    Array(0.97679, dtype=float32)
+    Array(0.9767892, dtype=float32)
     """
     d = preds.shape[1] if preds.ndim == 2 else 1
     zeros = jnp.zeros(d) if d > 1 else jnp.zeros(())

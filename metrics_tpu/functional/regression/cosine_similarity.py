@@ -40,7 +40,7 @@ def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "s
     >>> target = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.]])
     >>> preds = jnp.array([[1., 2., 3., 4.], [-1., -2., -3., -4.]])
     >>> cosine_similarity(preds, target, 'none')
-    Array([ 1., -1.], dtype=float32)
+    Array([ 0.99999994, -0.99999994], dtype=float32)
     """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

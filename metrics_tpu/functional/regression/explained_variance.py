@@ -64,7 +64,7 @@ def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_
     >>> target = jnp.array([3., -0.5, 2., 7.])
     >>> preds = jnp.array([2.5, 0.0, 2., 8.])
     >>> explained_variance(preds, target)
-    Array(0.9572, dtype=float32)
+    Array(0.95717347, dtype=float32)
     """
     if multioutput not in ALLOWED_MULTIOUTPUT:
         raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}")

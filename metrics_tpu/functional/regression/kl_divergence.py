@@ -45,7 +45,7 @@ def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optiona
     >>> p = jnp.array([[0.36, 0.48, 0.16]])
     >>> q = jnp.array([[1/3, 1/3, 1/3]])
     >>> kl_divergence(p, q)
-    Array(0.0853, dtype=float32)
+    Array(0.0852996, dtype=float32)
     """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, total, reduction)

@@ -43,7 +43,7 @@ def log_cosh_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
     >>> preds = jnp.array([3.0, 5.0, 2.5, 7.0])
     >>> target = jnp.array([2.5, 5.0, 4.0, 8.0])
     >>> log_cosh_error(preds, target)
-    Array(0.3752, dtype=float32)
+    Array(0.3523339, dtype=float32)
     """
     sum_log_cosh_error, total = _log_cosh_error_update(preds, target, num_outputs)
     return _log_cosh_error_compute(sum_log_cosh_error, total)

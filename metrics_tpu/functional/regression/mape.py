@@ -59,7 +59,7 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
     >>> preds = jnp.array([0.5, 1., 2., 8.])
     >>> target = jnp.array([1., 2., 2., 4.])
     >>> symmetric_mean_absolute_percentage_error(preds, target)
-    Array(0.5555556, dtype=float32)
+    Array(0.5, dtype=float32)
     """
     sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return sum_abs_per_error / num_obs

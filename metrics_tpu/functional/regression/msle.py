@@ -31,7 +31,7 @@ def mean_squared_log_error(preds: Array, target: Array) -> Array:
     >>> x = jnp.array([0., 1., 2., 3.])
     >>> y = jnp.array([0., 1., 2., 2.])
     >>> mean_squared_log_error(x, y)
-    Array(0.02068142, dtype=float32)
+    Array(0.02069024, dtype=float32)
     """
     sum_squared_log_error, total = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(sum_squared_log_error, total)

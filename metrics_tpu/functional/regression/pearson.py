@@ -81,7 +81,7 @@ def pearson_corrcoef(preds: Array, target: Array) -> Array:
     >>> target = jnp.array([3., -0.5, 2., 7.])
     >>> preds = jnp.array([2.5, 0.0, 2., 8.])
     >>> pearson_corrcoef(preds, target)
-    Array(0.98541, dtype=float32)
+    Array(0.98486954, dtype=float32)
     """
     d = preds.shape[1] if preds.ndim == 2 else 1
     zeros = jnp.zeros(d) if d > 1 else jnp.zeros(())

@@ -82,7 +82,7 @@ def r2_score(
     >>> target = jnp.array([3., -0.5, 2., 7.])
     >>> preds = jnp.array([2.5, 0.0, 2., 8.])
     >>> r2_score(preds, target)
-    Array(0.9486081, dtype=float32)
+    Array(0.94860816, dtype=float32)
     """
     sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
     if num_obs < 2:
@@ -112,7 +112,7 @@ def relative_squared_error(preds: Array, target: Array, squared: bool = True) ->
     >>> target = jnp.array([3., -0.5, 2., 7.])
     >>> preds = jnp.array([2.5, 0.0, 2., 8.])
     >>> relative_squared_error(preds, target)
-    Array(0.05139197, dtype=float32)
+    Array(0.05139186, dtype=float32)
     """
     sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
     return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared=squared)

@@ -68,7 +68,7 @@ def spearman_corrcoef(preds: Array, target: Array) -> Array:
     >>> target = jnp.array([3., -0.5, 2., 7.])
     >>> preds = jnp.array([2.5, 0.0, 2., 8.])
     >>> spearman_corrcoef(preds, target)
-    Array(1., dtype=float32)
+    Array(0.9999992, dtype=float32)
     """
     d = preds.shape[1] if preds.ndim == 2 else 1
     preds, target = _spearman_corrcoef_update(preds, target, num_outputs=d)

@@ -66,7 +66,7 @@ def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> 
     >>> targets = jnp.array([1.0, 2.0, 3.0, 4.0])
     >>> preds = jnp.array([4.0, 3.0, 2.0, 1.0])
     >>> tweedie_deviance_score(preds, targets, power=2)
-    Array(1.2083, dtype=float32)
+    Array(1.2083333, dtype=float32)
     """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

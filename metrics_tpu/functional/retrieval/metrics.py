@@ -127,7 +127,7 @@ def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] =
 
     >>> import jax.numpy as jnp
     >>> retrieval_normalized_dcg(jnp.array([.85, .25, .15, .35]), jnp.array([1, 0, 0, 1]))
-    Array(0.919721, dtype=float32)
+    Array(1., dtype=float32)
     """
     k = preds.shape[-1] if top_k is None else top_k
     if not (isinstance(k, int) and k > 0):

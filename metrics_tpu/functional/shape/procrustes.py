@@ -21,7 +21,7 @@ def procrustes_disparity(
     >>> pc1 = jnp.asarray(rng.rand(10, 3).astype(np.float32))
     >>> pc2 = jnp.asarray(rng.rand(10, 3).astype(np.float32))
     >>> round(float(procrustes_disparity(pc1, pc2)), 4)
-    0.2232
+    0.7251
     """
     if point_cloud1.shape != point_cloud2.shape:
         raise ValueError("Expected both point clouds to have the same shape "

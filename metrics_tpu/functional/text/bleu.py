@@ -102,7 +102,7 @@ def bleu_score(
     >>> preds = ['the cat is on the mat']
     >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
     >>> bleu_score(preds, target)
-    Array(0.7598, dtype=float32)
+    Array(0.75983566, dtype=float32)
     """
     preds_ = [preds] if isinstance(preds, str) else list(preds)
     target_ = [[t] if isinstance(t, str) else list(t) for t in target]
@@ -134,7 +134,7 @@ def sacre_bleu_score(
     >>> preds = ['the cat is on the mat']
     >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
     >>> sacre_bleu_score(preds, target)
-    Array(0.7598, dtype=float32)
+    Array(0.75983566, dtype=float32)
     """
     tokenizer = _get_tokenizer(tokenize)
     if weights is not None and len(weights) != n_gram:

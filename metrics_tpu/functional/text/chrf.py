@@ -79,7 +79,7 @@ def chrf_score(
     >>> preds = ['the cat is on the mat']
     >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
     >>> round(float(chrf_score(preds, target)), 4)
-    0.8491
+    0.864
     """
     if not isinstance(n_char_order, int) or n_char_order < 1:
         raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")

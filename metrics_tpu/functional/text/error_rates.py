@@ -58,7 +58,7 @@ def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
     """Character error rate (reference ``cer.py:48-78``).
 
     >>> char_error_rate(["this is the prediction"], ["this is the reference"])
-    Array(0.3181818, dtype=float32)
+    Array(0.3809524, dtype=float32)
     """
     errors, total = _cer_update(preds, target)
     return (errors / total).astype(jnp.float32)

@@ -58,7 +58,7 @@ class CramersV(_NominalMetric):
     >>> metric = CramersV(num_classes=4)
     >>> metric.update(preds, target)
     >>> round(float(metric.compute()), 4)
-    0.5542
+    0.577
     """
 
     def __init__(self, num_classes: int, bias_correction: bool = True, nan_strategy: str = "replace",
@@ -122,7 +122,7 @@ class FleissKappa(Metric):
     >>> metric = FleissKappa(mode='counts')
     >>> metric.update(jnp.array([[0, 0, 14], [0, 2, 12], [0, 6, 8], [0, 12, 2]]))
     >>> round(float(metric.compute()), 4)
-    0.2269
+    0.4256
     """
 
     is_differentiable = False

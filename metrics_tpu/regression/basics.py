@@ -62,7 +62,7 @@ class MeanSquaredError(Metric):
     >>> metric = MeanSquaredError()
     >>> metric.update(jnp.array([2.5, 0.0, 2., 8.]), jnp.array([3., -0.5, 2., 7.]))
     >>> metric.compute()
-    Array(0.875, dtype=float32)
+    Array(0.375, dtype=float32)
     """
 
     is_differentiable = True
@@ -229,7 +229,7 @@ class LogCoshError(Metric):
     >>> metric = LogCoshError()
     >>> metric.update(jnp.array([3.0, 5.0, 2.5, 7.0]), jnp.array([2.5, 5.0, 4.0, 8.0]))
     >>> metric.compute()
-    Array(0.3752, dtype=float32)
+    Array(0.3523339, dtype=float32)
     """
 
     is_differentiable = True

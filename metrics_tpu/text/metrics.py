@@ -223,7 +223,7 @@ class BLEUScore(Metric):
     >>> bleu = BLEUScore()
     >>> bleu.update(preds, target)
     >>> bleu.compute()
-    Array(0.7598, dtype=float32)
+    Array(0.75983566, dtype=float32)
     """
 
     __jit_ineligible__ = True
@@ -307,7 +307,7 @@ class CHRFScore(Metric):
     >>> chrf = CHRFScore()
     >>> chrf.update(preds, target)
     >>> round(float(chrf.compute()), 4)
-    0.8491
+    0.864
     """
 
     __jit_ineligible__ = True
