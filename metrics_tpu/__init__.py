@@ -13,8 +13,10 @@ from metrics_tpu import (
     detection,
     functional,
     image,
+    models,
     multimodal,
     nominal,
+    ops,
     parallel,
     regression,
     retrieval,
@@ -56,9 +58,11 @@ __all__ = [
     "detection",
     "functional",
     "image",
+    "models",
     "multimodal",
-    "parallel",
     "nominal",
+    "ops",
+    "parallel",
     "regression",
     "retrieval",
     "segmentation",

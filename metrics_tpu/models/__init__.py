@@ -1,0 +1,13 @@
+"""Model backbones for model-based metrics.
+
+The reference reaches its backbones through torch-fidelity / torchvision /
+transformers downloads (SURVEY §2.9); this build keeps backbones **injectable**
+(every model-based metric takes a callable) and ships a small flax feature CNN
+for testing the injection path end-to-end. Pretrained flax ports (InceptionV3
+for FID/KID/IS, VGG/Alex for LPIPS, CLIP for CLIPScore) slot in here when their
+weights are present locally — see ``load_feature_extractor``.
+"""
+
+from metrics_tpu.models.simple_cnn import SimpleFeatureCNN, load_feature_extractor
+
+__all__ = ["SimpleFeatureCNN", "load_feature_extractor"]

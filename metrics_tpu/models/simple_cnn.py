@@ -1,0 +1,74 @@
+"""A small flax feature extractor + the local-weights loader hook.
+
+Stands in for the reference's downloaded InceptionV3/VGG backbones so the
+FID/KID/IS/LPIPS injection path can be exercised end-to-end offline; the loader
+resolves named pretrained backbones from a local directory when available.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+try:
+    import flax.linen as nn
+
+    _FLAX_AVAILABLE = True
+except Exception:  # pragma: no cover
+    _FLAX_AVAILABLE = False
+
+
+if _FLAX_AVAILABLE:
+
+    class SimpleFeatureCNN(nn.Module):
+        """Tiny conv tower producing (N, features) embeddings from NCHW images."""
+
+        features: int = 64
+        widths: Sequence[int] = (16, 32)
+
+        @nn.compact
+        def __call__(self, x: Array) -> Array:
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW → NHWC
+            for w in self.widths:
+                x = nn.Conv(w, (3, 3), strides=(2, 2))(x)
+                x = nn.relu(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(self.features)(x)
+
+        def bind_apply(self, rng_seed: int = 0, image_shape=(1, 3, 32, 32)) -> Callable:
+            """Initialize params and return a pure ``images -> features`` callable."""
+            params = self.init(jax.random.PRNGKey(rng_seed), jnp.zeros(image_shape))
+            apply = jax.jit(lambda imgs: self.apply(params, imgs))
+            return apply
+
+else:  # pragma: no cover
+
+    class SimpleFeatureCNN:  # type: ignore[no-redef]
+        def __init__(self, *a, **k):
+            raise ModuleNotFoundError("SimpleFeatureCNN requires flax to be installed.")
+
+
+def load_feature_extractor(name: str, weights_dir: Optional[str] = None) -> Callable:
+    """Resolve a named pretrained backbone from a LOCAL weights directory.
+
+    No downloads happen here (no-egress build): ``weights_dir`` (or the
+    ``METRICS_TPU_WEIGHTS`` env var) must contain ``<name>.msgpack`` flax params
+    for a known architecture. Raises a clear error otherwise.
+    """
+    weights_dir = weights_dir or os.environ.get("METRICS_TPU_WEIGHTS")
+    if not weights_dir:
+        raise ModuleNotFoundError(
+            f"Pretrained backbone {name!r} needs local weights: set METRICS_TPU_WEIGHTS or pass"
+            " weights_dir. (This offline build never downloads; model-based metrics also accept"
+            " any injected callable instead.)"
+        )
+    path = os.path.join(weights_dir, f"{name}.msgpack")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"No local weights found at {path}")
+    raise NotImplementedError(
+        f"Found weights at {path}, but the {name!r} architecture port lands in the next round."
+    )

@@ -1,0 +1,12 @@
+"""Custom TPU kernels (Pallas).
+
+XLA already fuses the overwhelming majority of this framework's compute (the
+SURVEY §7 design keeps every hot path as fusable jnp/conv/scatter ops). This
+package holds the hand-written kernels for the cases worth owning the schedule:
+currently the SSIM epilogue (``ssim_map``), with the windowed-conv kernel planned
+next (see ``/opt/skills/guides/pallas_guide.md``).
+"""
+
+from metrics_tpu.ops.ssim_epilogue import ssim_map_pallas
+
+__all__ = ["ssim_map_pallas"]

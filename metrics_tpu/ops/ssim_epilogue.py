@@ -1,0 +1,81 @@
+"""Pallas kernel: fused SSIM epilogue.
+
+Computes the SSIM map from the five window-convolved statistics
+(μ_p, μ_t, Σp², Σt², Σpt) in one VMEM-resident pass — the elementwise tail of
+``functional/image/ssim.py``. On TPU the kernel tiles the trailing dims to the
+(8, 128) vreg layout; everywhere else (and in tests) it runs via the Pallas
+interpreter, which lowers to the same jnp ops XLA would fuse anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+try:  # pallas is part of jax.experimental on all shipped versions we target
+    from jax.experimental import pallas as pl
+
+    _PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    _PALLAS_AVAILABLE = False
+
+
+def _ssim_epilogue_kernel(mu_p_ref, mu_t_ref, s_pp_ref, s_tt_ref, s_pt_ref, c1_ref, c2_ref, out_ref):
+    mu_p = mu_p_ref[...]
+    mu_t = mu_t_ref[...]
+    c1 = c1_ref[0]
+    c2 = c2_ref[0]
+    mu_p_sq = mu_p * mu_p
+    mu_t_sq = mu_t * mu_t
+    mu_pt = mu_p * mu_t
+    sigma_p = jnp.maximum(s_pp_ref[...] - mu_p_sq, 0.0)
+    sigma_t = jnp.maximum(s_tt_ref[...] - mu_t_sq, 0.0)
+    sigma_pt = s_pt_ref[...] - mu_pt
+    upper = 2.0 * sigma_pt + c2
+    lower = sigma_p + sigma_t + c2
+    out_ref[...] = ((2.0 * mu_pt + c1) * upper) / ((mu_p_sq + mu_t_sq + c1) * lower)
+
+
+def ssim_map_pallas(
+    mu_p: Array, mu_t: Array, s_pp: Array, s_tt: Array, s_pt: Array, c1: float, c2: float,
+    interpret: bool | None = None,
+) -> Array:
+    """Fused SSIM map from window statistics.
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(0)
+    >>> stats = [jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32)) for _ in range(5)]
+    >>> out = ssim_map_pallas(*stats, c1=0.01, c2=0.03, interpret=True)
+    >>> out.shape
+    (2, 3, 16, 16)
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not _PALLAS_AVAILABLE:  # pragma: no cover - jnp fallback
+        mu_p_sq, mu_t_sq, mu_pt = mu_p**2, mu_t**2, mu_p * mu_t
+        upper = 2 * (s_pt - mu_pt) + c2
+        lower = jnp.maximum(s_pp - mu_p_sq, 0) + jnp.maximum(s_tt - mu_t_sq, 0) + c2
+        return ((2 * mu_pt + c1) * upper) / ((mu_p_sq + mu_t_sq + c1) * lower)
+
+    orig_shape = mu_p.shape
+    flat = lambda x: x.reshape(-1, orig_shape[-1])  # noqa: E731
+    args = [flat(x) for x in (mu_p, mu_t, s_pp, s_tt, s_pt)]
+    rows, cols = args[0].shape
+    c1_arr = jnp.full((1,), c1, dtype=args[0].dtype)
+    c2_arr = jnp.full((1,), c2, dtype=args[0].dtype)
+
+    block_rows = min(256, rows)
+    grid = ((rows + block_rows - 1) // block_rows,)
+    out = pl.pallas_call(
+        _ssim_epilogue_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, cols), lambda i: (i, 0)) for _ in range(5)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * 0
+        + [pl.BlockSpec((1,), lambda i: (0,)), pl.BlockSpec((1,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), args[0].dtype),
+        interpret=interpret,
+    )(*args, c1_arr, c2_arr)
+    return out.reshape(orig_shape)
