@@ -10,8 +10,6 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
-import subprocess
-import sys
 import time
 
 import numpy as np
@@ -21,48 +19,6 @@ BATCH = 1 << 17  # 131072 elements per update
 STEPS = 50
 
 
-def _ensure_live_backend(timeout_s: float = 120.0) -> None:
-    """Probe the default jax backend in a subprocess; fall back to CPU if it hangs.
-
-    The accelerator tunnel can wedge in a way that blocks backend init forever; a
-    benchmark that never prints is worse than a CPU number.
-    """
-    import os
-    import signal
-    import tempfile
-
-    if os.environ.get("JAX_PLATFORMS", "").lower() in ("cpu",):
-        return  # already pinned to CPU; nothing to probe
-    # own session + stderr to a file: after the deadline we kill the whole process
-    # group and stop waiting — no post-kill pipe reads that could block forever
-    with tempfile.TemporaryFile() as err:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax, jax.numpy as jnp; (jnp.ones(2)+1).block_until_ready()"],
-            stdout=subprocess.DEVNULL, stderr=err, start_new_session=True,
-        )
-        deadline = time.monotonic() + timeout_s
-        ok = False
-        while time.monotonic() < deadline:
-            rc = proc.poll()
-            if rc is not None:
-                ok = rc == 0
-                break
-            time.sleep(0.5)
-        else:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except OSError:
-                pass
-        if not ok:
-            err.seek(0)
-            tail = err.read()[-500:].decode(errors="replace").strip()
-            msg = "# default backend unreachable; benchmarking on CPU"
-            if tail:
-                msg += f" (probe stderr tail: {tail!r})"
-            print(msg, file=sys.stderr)
-            import jax
-
-            jax.config.update("jax_platforms", "cpu")
 
 
 def _bench_ours(preds_np, target_np):
@@ -138,7 +94,11 @@ def _bench_torch_reference(preds_np, target_np):
 
 
 def main():
-    _ensure_live_backend()
+    # probe the backend first: the accelerator tunnel can wedge in a way that blocks
+    # backend init forever, and a benchmark that never prints is worse than a CPU number
+    from metrics_tpu.utils.backend import ensure_backend
+
+    ensure_backend(min_devices=1)
     rng = np.random.RandomState(0)
     preds = rng.randint(0, NUM_CLASSES, (8, BATCH)).astype(np.int32)
     target = rng.randint(0, NUM_CLASSES, (8, BATCH)).astype(np.int32)

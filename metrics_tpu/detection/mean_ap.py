@@ -192,8 +192,8 @@ class MeanAveragePrecision(Metric):
             self.gt_area.append(area_arr)
 
     # ------------------------------------------------------------------ evaluation core
-    def _evaluate(self):
-        micro = self.average == "micro"
+    def _evaluate(self, average: Optional[str] = None):
+        micro = (average or self.average) == "micro"
         iou_thrs = np.asarray(self.iou_thresholds)
         rec_thrs = np.asarray(self.rec_thresholds)
         max_dets = self.max_detection_thresholds
@@ -300,10 +300,7 @@ class MeanAveragePrecision(Metric):
             hits = np.where(np.isclose(iou_thrs, v))[0]
             return int(hits[0]) if len(hits) else None
 
-        res = {
-            "map": self._summarize(precision, None, None, "all", md_idx),
-            "mar_1": self._summarize(None, recall, None, "all", 0) if len(self.max_detection_thresholds) > 0 else -1.0,
-        }
+        res = {"map": self._summarize(precision, None, None, "all", md_idx)}
         i50, i75 = t_idx(0.5), t_idx(0.75)
         res["map_50"] = self._summarize(precision, None, i50, "all", md_idx) if i50 is not None else -1.0
         res["map_75"] = self._summarize(precision, None, i75, "all", md_idx) if i75 is not None else -1.0
@@ -314,13 +311,20 @@ class MeanAveragePrecision(Metric):
             res[f"mar_{md}"] = self._summarize(None, recall, None, "all", mi)
         res["classes"] = jnp.asarray(classes, dtype=jnp.int32)
         if self.class_metrics and len(classes):
+            if self.average == "micro":
+                # micro pooled everything into one pseudo-class; per-class numbers
+                # need a second macro pass (reference computes per-class regardless).
+                # Bind to separate names: extended_summary must keep the micro arrays.
+                cls_precision, cls_recall, _, _ = self._evaluate(average="macro")
+            else:
+                cls_precision, cls_recall = precision, recall
             map_per_class = []
             mar_per_class = []
             for ki in range(len(classes)):
-                p = precision[:, :, ki, 0, md_idx]
+                p = cls_precision[:, :, ki, 0, md_idx]
                 p = p[p > -1]
                 map_per_class.append(float(np.mean(p)) if p.size else -1.0)
-                r = recall[:, ki, 0, md_idx]
+                r = cls_recall[:, ki, 0, md_idx]
                 r = r[r > -1]
                 mar_per_class.append(float(np.mean(r)) if r.size else -1.0)
             res["map_per_class"] = jnp.asarray(map_per_class, dtype=jnp.float32)

@@ -43,7 +43,7 @@ from metrics_tpu.utils.data import _flatten, dim_zero_cat, dim_zero_max, dim_zer
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
-__all__ = ["Metric", "CompositionalMetric", "jit_update_enabled"]
+__all__ = ["Metric", "CompositionalMetric", "clear_jit_cache", "jit_update_enabled"]
 
 _REDUCE_ALIASES: Dict[Any, Any] = {
     "sum": dim_zero_sum,
@@ -60,6 +60,48 @@ def jit_update_enabled(enable: bool) -> None:
     """Globally toggle jit-compilation of eager ``Metric.update`` calls (debugging aid)."""
     global _JIT_UPDATE_DEFAULT
     _JIT_UPDATE_DEFAULT = enable
+
+
+# Shared compiled-update cache: (cls, static-config key) -> jitted pure update.
+# N instances of one metric class with equal config share ONE compilation (the
+# reference has no analog — torch Modules re-dispatch per call; under XLA a
+# per-instance `jax.jit` would recompile per instance, which dominates
+# MetricCollection startup cost).
+_SHARED_JIT_CACHE: Dict[Any, Callable] = {}
+
+
+def clear_jit_cache() -> None:
+    """Drop all shared compiled updates (frees the representative instances too)."""
+    _SHARED_JIT_CACHE.clear()
+
+
+# Instance fields that do not affect how `update` traces: runtime bookkeeping and
+# the sync-orchestration kwargs (those act outside the jitted region).
+_JIT_KEY_EXCLUDE = frozenset({
+    "_defaults", "_state", "_persistent", "_reductions", "_computed", "_update_count",
+    "_to_sync", "_should_unsync", "_is_synced", "_cache", "_update_signature",
+    "_update_impl", "_compute_impl", "update", "compute", "_jitted_update",
+    "_jit_failed", "_jit_update_opt", "compute_on_cpu", "dist_sync_on_step",
+    "process_group", "dist_sync_fn", "distributed_available_fn", "sync_on_compute",
+    "compute_with_cache",
+})
+
+
+def _hashable_config_value(v: Any) -> Any:
+    """Convert a config attribute to a hashable key component; raise TypeError if impossible."""
+    if isinstance(v, (jax.Array, np.ndarray)):
+        a = np.asarray(v)
+        return ("__arr__", a.dtype.str, a.shape, a.tobytes())
+    if isinstance(v, (list, tuple)):
+        return ("__seq__", tuple(_hashable_config_value(x) for x in v))
+    if isinstance(v, dict):
+        return ("__map__", tuple(sorted((k, _hashable_config_value(x)) for k, x in v.items())))
+    if isinstance(v, Metric):
+        # metrics holding child metrics never share compiled updates (an id()-based
+        # key could collide after the child is garbage-collected)
+        raise TypeError("child metrics are not shareable config")
+    hash(v)  # raises TypeError for unhashable values → caller falls back
+    return v
 
 
 class MetricFunctions:
@@ -166,6 +208,10 @@ class Metric(ABC):
                 default = jnp.asarray(default)
             if not isinstance(default, (jax.Array, np.ndarray)):
                 raise ValueError("state variable must be an array or an empty list")
+            if isinstance(default, jax.Array) and getattr(default, "weak_type", False):
+                # strong-type the default: a weak-typed initial state would change
+                # aval after the first update (weak → strong) and force a retrace
+                default = jax.lax.convert_element_type(default, default.dtype)
         if isinstance(dist_reduce_fx, str):
             if dist_reduce_fx not in _REDUCE_ALIASES:
                 raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max']")
@@ -305,6 +351,40 @@ class Metric(ABC):
             for a in list(args) + list(kwargs.values())
         )
 
+    def _jit_cache_key(self) -> Optional[Any]:
+        """Static-config key for the shared compiled-update cache; None = not shareable.
+
+        Sound because the traced ``update`` reads only (a) the state passed as an
+        argument — covered by jit's own aval cache — and (b) static config held in
+        instance attributes, all of which enter this key.
+        """
+        try:
+            items = tuple(
+                (k, _hashable_config_value(v))
+                for k, v in sorted(self.__dict__.items())
+                if k not in _JIT_KEY_EXCLUDE
+            )
+        except TypeError:
+            return None
+        return (type(self), items)
+
+    def _lookup_shared_jit(self) -> Callable:
+        """Return the compiled pure update for this config, compiling at most once per config."""
+        key = self._jit_cache_key()
+        if key is None:
+            return jax.jit(self._functional_update)
+        fn = _SHARED_JIT_CACHE.get(key)
+        if fn is None:
+            # A dedicated pristine clone becomes the representative whose bound
+            # update body is traced; config-equal instances replay its executable.
+            # Cloning (rather than caching `self`) keeps user instances — and any
+            # large states they later accumulate — out of the process-lifetime cache.
+            rep = self.clone()
+            rep.reset()
+            fn = jax.jit(rep._functional_update)
+            _SHARED_JIT_CACHE[key] = fn
+        return fn
+
     def _wrapped_update(self, *args: Any, **kwargs: Any) -> None:
         """``_wrap_update`` analog (reference ``metric.py:542-564``): cache invalidation + counting."""
         self._computed = None
@@ -314,7 +394,7 @@ class Metric(ABC):
         if self._jit_eligible(args, kwargs):
             if self._jitted_update is None:
                 # NOTE: no buffer donation — default arrays are shared across resets.
-                self._jitted_update = jax.jit(self._functional_update)
+                self._jitted_update = self._lookup_shared_jit()
             try:
                 self.__dict__["_state"] = self._jitted_update(self._state, *args, **kwargs)
             except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,

@@ -265,3 +265,56 @@ def test_mean_state_forward_running_mean():
     m(jnp.asarray([2.0]))
     m(jnp.asarray([4.0]))
     assert float(m.compute()) == pytest.approx(3.0)
+
+
+class _TraceCountingMetric(Metric):
+    """Python body runs only when jax traces → counts compilations."""
+
+    full_state_update = False
+    traces = 0
+
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        type(self).traces += 1
+        self.total = self.total + self.scale * jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.total
+
+
+def test_shared_jit_cache_compiles_once_per_config():
+    from metrics_tpu.metric import clear_jit_cache
+
+    clear_jit_cache()
+    _TraceCountingMetric.traces = 0
+    metrics = [_TraceCountingMetric(scale=2.0) for _ in range(10)]
+    for i, m in enumerate(metrics):
+        m.update(float(i))
+        m.update(float(i))
+    assert _TraceCountingMetric.traces == 1  # ten instances, one trace
+    for i, m in enumerate(metrics):
+        assert float(m.compute()) == 4.0 * i
+
+    # a different static config must NOT reuse the executable
+    other = _TraceCountingMetric(scale=3.0)
+    other.update(1.0)
+    assert _TraceCountingMetric.traces == 2
+    assert float(other.compute()) == 3.0
+    clear_jit_cache()
+
+
+def test_shared_jit_cache_distinct_shapes_still_correct():
+    from metrics_tpu.metric import clear_jit_cache
+
+    clear_jit_cache()
+    a, b = DummySum(), DummySum()
+    a.update(jnp.ones(4))
+    b.update(jnp.ones((2, 3)))  # new aval → retrace inside the same shared jit fn
+    assert float(a.compute()) == 4.0
+    assert float(b.compute()) == 6.0
+    assert a._jitted_update is b._jitted_update
+    clear_jit_cache()
