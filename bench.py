@@ -1,96 +1,325 @@
-"""Benchmark: metric update/compute throughput vs a torch-CPU reference implementation.
+"""Benchmark: metrics_tpu vs the ACTUAL reference package on the five BASELINE.md configs.
 
-BASELINE.md config 1: ``classification.MulticlassAccuracy`` on random tensors.
-The reference publishes no numbers (SURVEY §6), so the comparison column is measured
-here: the reference's own algorithm (bincount confusion matrix, accumulate, derive)
-implemented with torch CPU ops — the same thing TorchMetrics executes — timed on this
-host, against our jit-compiled XLA path on the default JAX device.
+The reference publishes no numbers (SURVEY §6), so the comparison column is
+measured here by importing the real TorchMetrics from ``/root/reference/src``
+(with the tiny test-infra shims for its utility imports) and timing its own code
+paths on this host's CPU — torch-CPU is the reference's native deployment for
+metric aggregation. Our side runs on the default JAX device (TPU when the chip
+is live, CPU fallback otherwise — see ``metrics_tpu.utils.backend``).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Configs (BASELINE.md "Targets"):
+  1. accuracy   — MulticlassAccuracy update stream + compute
+  2. collection — MetricCollection(Precision, Recall, F1) update stream + compute
+  3. retrieval  — RetrievalMAP + RetrievalMRR grouped evaluation
+  4. ssim_psnr  — SSIM + PSNR on 256×256 batches
+  5. mean_ap    — detection MeanAveragePrecision full evaluation
+     (reference side = its pure-torch tensor backend `_mean_ap`; the C
+     pycocotools backend is not installable in this environment)
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "configs": {...}}
+where value/vs_baseline is the geometric-mean speedup across configs and
+"configs" carries per-config wall times + speedups.
 """
 
 import json
+import math
+import os
+import sys
 import time
 
 import numpy as np
 
-NUM_CLASSES = 10
-BATCH = 1 << 17  # 131072 elements per update
-STEPS = 50
+REPO = os.path.dirname(os.path.abspath(__file__))
+_REF_PATHS = (os.path.join(REPO, "tests", "_ref_shim"), "/root/reference/src")
+
+ACC_CLASSES = 10
+ACC_BATCH = 1 << 17
+ACC_STEPS = 50
+COL_BATCH = 1 << 14
+COL_STEPS = 20
+RET_QUERIES = 512
+RET_DOCS = 100
+SSIM_SHAPE = (4, 3, 256, 256)
+SSIM_STEPS = 10
+MAP_IMGS = 50
+MAP_CLASSES = 5
 
 
+def _best_of(fn, repeats=5):
+    best = float("inf")
+    out = None
+    for _ in range(repeats):
+        start = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - start)
+    return best, out
 
 
-def _bench_ours(preds_np, target_np):
-    """The TPU deployment shape: the whole update stream runs device-resident.
+def _reference_available() -> bool:
+    return os.path.isdir("/root/reference/src")
 
-    ``lax.scan`` folds the metric's pure ``update`` over all batches inside ONE
-    compiled program — zero host syncs in the update loop (BASELINE.md config 1).
-    """
+
+def _import_reference():
+    for p in _REF_PATHS:
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import torchmetrics  # noqa: F401
+
+    return torchmetrics
+
+
+# --------------------------------------------------------------------- config 1
+def bench_accuracy():
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from metrics_tpu.classification import MulticlassAccuracy
 
-    m = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+    rng = np.random.RandomState(0)
+    preds_np = rng.randint(0, ACC_CLASSES, (8, ACC_BATCH)).astype(np.int32)
+    target_np = rng.randint(0, ACC_CLASSES, (8, ACC_BATCH)).astype(np.int32)
+
+    m = MulticlassAccuracy(num_classes=ACC_CLASSES, average="micro", validate_args=False)
     fns = m.functional()
-    preds = jnp.asarray(preds_np)
-    target = jnp.asarray(target_np)
+    idx = jnp.arange(ACC_STEPS) % preds_np.shape[0]
+    preds_all = jnp.asarray(preds_np)[idx]
+    target_all = jnp.asarray(target_np)[idx]
 
     @jax.jit
-    def run(state, preds_all, target_all):
+    def run(state, preds, target):
         def body(st, batch):
             return fns.update(st, batch[0], batch[1]), 0.0
 
-        st, _ = lax.scan(body, state, (preds_all, target_all))
+        st, _ = lax.scan(body, state, (preds, target))
         return fns.compute(st)
 
-    n_src = preds.shape[0]
-    idx = jnp.arange(STEPS) % n_src
-    preds_all = preds[idx]
-    target_all = target[idx]
-    # warmup (compile + first-touch transfers)
-    jax.block_until_ready(run(fns.init(), preds_all, target_all))
-    jax.block_until_ready(run(fns.init(), preds_all, target_all))
+    jax.block_until_ready(run(fns.init(), preds_all, target_all))  # compile
 
-    best = float("inf")
-    val = 0.0
-    for _ in range(7):
-        start = time.perf_counter()
+    def ours():
         out = run(fns.init(), preds_all, target_all)
         jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - start)
-        val = float(out)
-    return best, val
+        return float(out)
 
+    t_ours, v_ours = _best_of(ours)
 
-def _bench_torch_reference(preds_np, target_np):
-    """The reference algorithm (multiclass stat-scores via bincount confmat) in torch CPU."""
     import torch
+    from torchmetrics.classification import MulticlassAccuracy as RefAcc
 
-    preds = torch.from_numpy(np.asarray(preds_np))
-    target = torch.from_numpy(np.asarray(target_np))
-    tp = torch.zeros((), dtype=torch.long)
-    total = torch.zeros((), dtype=torch.long)
+    tp = torch.from_numpy(preds_np)
+    tt = torch.from_numpy(target_np)
 
-    def update(p, t):
-        nonlocal tp, total
-        # micro accuracy path of the reference update
-        tp = tp + (p == t).sum()
-        total = total + p.numel()
+    def ref():
+        metric = RefAcc(num_classes=ACC_CLASSES, average="micro", validate_args=False)
+        for i in range(ACC_STEPS):
+            metric.update(tp[i % 8], tt[i % 8])
+        return float(metric.compute())
 
-    best = float("inf")
-    val = 0.0
-    for _ in range(5):
-        tp = torch.zeros((), dtype=torch.long)
-        total = torch.zeros((), dtype=torch.long)
-        start = time.perf_counter()
-        for i in range(STEPS):
-            update(preds[i % preds.shape[0]], target[i % target.shape[0]])
-        val = float(tp.double() / total.double())
-        best = min(best, time.perf_counter() - start)
-    return best, val
+    t_ref, v_ref = _best_of(ref, repeats=3)
+    assert abs(v_ours - v_ref) < 1e-6, (v_ours, v_ref)
+    return t_ours, t_ref, f"{ACC_STEPS}x131k elems"
+
+
+# --------------------------------------------------------------------- config 2
+def bench_collection():
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.classification import MulticlassF1Score, MulticlassPrecision, MulticlassRecall
+    from metrics_tpu.collections import MetricCollection
+
+    rng = np.random.RandomState(1)
+    preds_np = rng.randint(0, ACC_CLASSES, (4, COL_BATCH)).astype(np.int32)
+    target_np = rng.randint(0, ACC_CLASSES, (4, COL_BATCH)).astype(np.int32)
+    preds = [jnp.asarray(p) for p in preds_np]
+    target = [jnp.asarray(t) for t in target_np]
+
+    def ours():
+        col = MetricCollection(
+            [
+                MulticlassPrecision(num_classes=ACC_CLASSES, validate_args=False),
+                MulticlassRecall(num_classes=ACC_CLASSES, validate_args=False),
+                MulticlassF1Score(num_classes=ACC_CLASSES, validate_args=False),
+            ]
+        )
+        for i in range(COL_STEPS):
+            col.update(preds[i % 4], target[i % 4])
+        out = col.compute()
+        jax.block_until_ready(list(out.values()))
+        return {k: float(v) for k, v in out.items()}
+
+    ours()  # compile
+    t_ours, v_ours = _best_of(ours)
+
+    import torch
+    from torchmetrics import MetricCollection as RefCollection
+    from torchmetrics.classification import (
+        MulticlassF1Score as RefF1,
+        MulticlassPrecision as RefP,
+        MulticlassRecall as RefR,
+    )
+
+    tp = [torch.from_numpy(p) for p in preds_np]
+    tt = [torch.from_numpy(t) for t in target_np]
+
+    def ref():
+        col = RefCollection(
+            [
+                RefP(num_classes=ACC_CLASSES, validate_args=False),
+                RefR(num_classes=ACC_CLASSES, validate_args=False),
+                RefF1(num_classes=ACC_CLASSES, validate_args=False),
+            ]
+        )
+        for i in range(COL_STEPS):
+            col.update(tp[i % 4], tt[i % 4])
+        return {k: float(v) for k, v in col.compute().items()}
+
+    t_ref, v_ref = _best_of(ref, repeats=3)
+    for k_ours, k_ref in (
+        ("MulticlassPrecision", "MulticlassPrecision"),
+        ("MulticlassF1Score", "MulticlassF1Score"),
+    ):
+        assert abs(v_ours[k_ours] - v_ref[k_ref]) < 1e-5, (k_ours, v_ours[k_ours], v_ref[k_ref])
+    return t_ours, t_ref, f"3 metrics x {COL_STEPS} updates"
+
+
+# --------------------------------------------------------------------- config 3
+def bench_retrieval():
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.retrieval import RetrievalMAP, RetrievalMRR
+
+    rng = np.random.RandomState(2)
+    n = RET_QUERIES * RET_DOCS
+    indexes_np = np.repeat(np.arange(RET_QUERIES), RET_DOCS).astype(np.int64)
+    preds_np = rng.rand(n).astype(np.float32)
+    target_np = (rng.rand(n) < 0.1).astype(np.int64)
+    target_np[:: RET_DOCS] = 1  # every query has at least one positive
+    indexes, preds, target = jnp.asarray(indexes_np), jnp.asarray(preds_np), jnp.asarray(target_np)
+
+    def ours():
+        res = []
+        for cls in (RetrievalMAP, RetrievalMRR):
+            m = cls()
+            m.update(preds, target, indexes=indexes)
+            res.append(float(m.compute()))
+        return res
+
+    ours()  # compile
+    t_ours, v_ours = _best_of(ours)
+
+    import torch
+    from torchmetrics.retrieval import RetrievalMAP as RefMAP, RetrievalMRR as RefMRR
+
+    ti, tp, tt = torch.from_numpy(indexes_np), torch.from_numpy(preds_np), torch.from_numpy(target_np)
+
+    def ref():
+        res = []
+        for cls in (RefMAP, RefMRR):
+            m = cls()
+            m.update(tp, tt, indexes=ti)
+            res.append(float(m.compute()))
+        return res
+
+    t_ref, v_ref = _best_of(ref, repeats=3)
+    np.testing.assert_allclose(v_ours, v_ref, atol=1e-5)
+    return t_ours, t_ref, f"{RET_QUERIES} queries x {RET_DOCS} docs, MAP+MRR"
+
+
+# --------------------------------------------------------------------- config 4
+def bench_ssim_psnr():
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+    from metrics_tpu.functional.image.ssim import structural_similarity_index_measure
+
+    rng = np.random.RandomState(3)
+    a_np = rng.rand(*SSIM_SHAPE).astype(np.float32)
+    b_np = (a_np + rng.randn(*SSIM_SHAPE).astype(np.float32) * 0.05).clip(0, 1)
+    a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+
+    @jax.jit
+    def both(x, y):
+        return (
+            structural_similarity_index_measure(x, y, data_range=1.0),
+            peak_signal_noise_ratio(x, y, data_range=1.0),
+        )
+
+    jax.block_until_ready(both(a, b))
+
+    def ours():
+        vals = []
+        for _ in range(SSIM_STEPS):
+            vals = both(a, b)
+        jax.block_until_ready(vals)
+        return [float(v) for v in vals]
+
+    t_ours, v_ours = _best_of(ours)
+
+    import torch
+    from torchmetrics.functional.image import peak_signal_noise_ratio as ref_psnr
+    from torchmetrics.functional.image import structural_similarity_index_measure as ref_ssim
+
+    ta, tb = torch.from_numpy(a_np), torch.from_numpy(b_np)
+
+    def ref():
+        vals = []
+        for _ in range(SSIM_STEPS):
+            vals = [ref_ssim(ta, tb, data_range=1.0), ref_psnr(ta, tb, data_range=1.0)]
+        return [float(v) for v in vals]
+
+    t_ref, v_ref = _best_of(ref, repeats=3)
+    np.testing.assert_allclose(v_ours, v_ref, atol=1e-3)
+    return t_ours, t_ref, f"{SSIM_STEPS}x SSIM+PSNR on {'x'.join(map(str, SSIM_SHAPE))}"
+
+
+# --------------------------------------------------------------------- config 5
+def bench_mean_ap():
+    import jax.numpy as jnp
+
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.RandomState(4)
+    preds, target = [], []
+    for _ in range(MAP_IMGS):
+        ng = rng.randint(2, 12)
+        gb = rng.rand(ng, 4) * 150
+        gb[:, 2:] = gb[:, :2] + 2 + rng.rand(ng, 2) * 100
+        glab = rng.randint(0, MAP_CLASSES, ng)
+        nd = ng + rng.randint(0, 4)
+        db = np.concatenate([gb + rng.randn(ng, 4) * 4, rng.rand(nd - ng, 4) * 150])
+        db[:, 2:] = np.maximum(db[:, 2:], db[:, :2] + 1)
+        preds.append({"boxes": db, "scores": rng.rand(nd), "labels": rng.randint(0, MAP_CLASSES, nd)})
+        target.append({"boxes": gb, "labels": glab})
+
+    j_preds = [{k: jnp.asarray(v) for k, v in d.items()} for d in preds]
+    j_target = [{k: jnp.asarray(v) for k, v in d.items()} for d in target]
+
+    def ours():
+        m = MeanAveragePrecision()
+        m.update(j_preds, j_target)
+        return float(m.compute()["map"])
+
+    ours()  # compile the matching kernel
+    t_ours, v_ours = _best_of(ours, repeats=3)
+
+    import torch
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
+
+    t_preds = [{k: torch.tensor(np.asarray(v), dtype=torch.long if k == "labels" else torch.float32) for k, v in d.items()} for d in preds]
+    t_target = [{k: torch.tensor(np.asarray(v), dtype=torch.long if k == "labels" else torch.float32) for k, v in d.items()} for d in target]
+
+    def ref():
+        m = RefMAP()
+        m.update(t_preds, t_target)
+        return float(m.compute()["map"])
+
+    t_ref, v_ref = _best_of(ref, repeats=2)
+    # area-'all' map agreement (legacy f32/area quirks documented in tests)
+    assert abs(v_ours - v_ref) < 5e-3, (v_ours, v_ref)
+    return t_ours, t_ref, f"{MAP_IMGS} imgs, {MAP_CLASSES} classes, full COCO eval"
 
 
 def main():
@@ -99,21 +328,39 @@ def main():
     from metrics_tpu.utils.backend import ensure_backend
 
     ensure_backend(min_devices=1)
-    rng = np.random.RandomState(0)
-    preds = rng.randint(0, NUM_CLASSES, (8, BATCH)).astype(np.int32)
-    target = rng.randint(0, NUM_CLASSES, (8, BATCH)).astype(np.int32)
+    if not _reference_available():
+        print(json.dumps({"metric": "bench_suite", "value": -1, "unit": "reference checkout missing", "vs_baseline": -1}))
+        return
+    _import_reference()
 
-    t_ref, v_ref = _bench_torch_reference(preds, target)
-    t_ours, v_ours = _bench_ours(preds, target)
-    assert abs(v_ref - v_ours) < 1e-6, (v_ref, v_ours)
-
-    ms_per_update = 1000.0 * t_ours / STEPS
-    speedup = t_ref / t_ours
+    configs = {}
+    speedups = []
+    for name, fn in (
+        ("accuracy", bench_accuracy),
+        ("collection", bench_collection),
+        ("retrieval", bench_retrieval),
+        ("ssim_psnr", bench_ssim_psnr),
+        ("mean_ap", bench_mean_ap),
+    ):
+        try:
+            t_ours, t_ref, what = fn()
+            speedup = t_ref / t_ours
+            configs[name] = {
+                "ours_ms": round(1000 * t_ours, 3),
+                "ref_ms": round(1000 * t_ref, 3),
+                "speedup": round(speedup, 3),
+                "workload": what,
+            }
+            speedups.append(speedup)
+        except Exception as err:  # noqa: BLE001 — a failed config must not kill the bench line
+            configs[name] = {"error": f"{type(err).__name__}: {err}"}
+    geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups)) if speedups else -1.0
     print(json.dumps({
-        "metric": "multiclass_accuracy_update_ms",
-        "value": round(ms_per_update, 4),
-        "unit": "ms/update(131k elems)",
-        "vs_baseline": round(speedup, 3),
+        "metric": "bench_suite_speedup_geomean",
+        "value": round(geomean, 3),
+        "unit": "x vs reference (torch-CPU), 5 configs",
+        "vs_baseline": round(geomean, 3),
+        "configs": configs,
     }))
 
 

@@ -1,0 +1,148 @@
+"""Device-native COCO matching: the hot core of MeanAveragePrecision.
+
+The reference delegates matching to pycocotools' C loops on CPU
+(``/root/reference/src/torchmetrics/detection/mean_ap.py:521-600``); the exact
+algorithm in tensor form is documented by the legacy implementation
+(``/root/reference/src/torchmetrics/detection/_mean_ap.py``). Here the whole
+match phase is ONE jitted XLA program:
+
+* evaluation units are (image, class) pairs with any detections or ground
+  truths, padded to fixed capacities ``(U, D, 4)`` / ``(U, G, 4)`` — the
+  fixed-capacity strategy of SURVEY §7.1-2(b);
+* the pairwise IoU matrix for every unit is one broadcast kernel ``(U, D, G)``;
+* greedy score-ordered matching is a single ``lax.scan`` over the D detection
+  slots, vectorized over units × area-ranges × IoU-thresholds × gts — each
+  step is pure masked ``argmax``/``where`` ops, XLA-fusible, no host syncs.
+
+COCOeval matching semantics reproduced exactly:
+
+* gts are considered non-ignored-first; an ignored gt is only matched when NO
+  non-ignored gt clears the threshold ("break" rule);
+* equal-IoU ties go to the LATER gt in per-area-range order (the reference's
+  ratchet updates on ``>=``);
+* already-matched gts are out, except crowd gts which may be re-matched;
+* a detection matched to an ignored gt is itself ignored; unmatched detections
+  outside the area range are ignored rather than counted as false positives.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+
+def batched_box_iou(det_boxes: Array, gt_boxes: Array, gt_crowd: Array) -> Array:
+    """IoU matrices for all units at once: ``(U, D, 4) × (U, G, 4) → (U, D, G)``.
+
+    COCO crowd semantics: for a crowd gt the denominator is the detection's own
+    area (a detection fully inside a crowd region has IoU 1 with it).
+    """
+    det_boxes = det_boxes.astype(jnp.float32)
+    gt_boxes = gt_boxes.astype(jnp.float32)
+    lt = jnp.maximum(det_boxes[:, :, None, :2], gt_boxes[:, None, :, :2])
+    rb = jnp.minimum(det_boxes[:, :, None, 2:], gt_boxes[:, None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    det_area = jnp.clip(det_boxes[..., 2] - det_boxes[..., 0], 0, None) * jnp.clip(
+        det_boxes[..., 3] - det_boxes[..., 1], 0, None
+    )
+    gt_area = jnp.clip(gt_boxes[..., 2] - gt_boxes[..., 0], 0, None) * jnp.clip(
+        gt_boxes[..., 3] - gt_boxes[..., 1], 0, None
+    )
+    union = det_area[:, :, None] + gt_area[:, None, :] - inter
+    union = jnp.where(gt_crowd[:, None, :], det_area[:, :, None], union)
+    return inter / jnp.clip(union, 1e-9, None)
+
+
+def batched_mask_iou(det_masks: Array, gt_masks: Array, gt_crowd: Array) -> Array:
+    """Mask-IoU matrices ``(U, D, P) × (U, G, P) → (U, D, G)`` via one einsum.
+
+    P is the flattened pixel count. The intersection matrix is a batched matmul —
+    on TPU this rides the MXU, replacing pycocotools' C run-length loops.
+    """
+    det_masks = det_masks.astype(jnp.float32)
+    gt_masks = gt_masks.astype(jnp.float32)
+    inter = jnp.einsum("udp,ugp->udg", det_masks, gt_masks)
+    det_area = det_masks.sum(-1)
+    gt_area = gt_masks.sum(-1)
+    union = det_area[:, :, None] + gt_area[:, None, :] - inter
+    union = jnp.where(gt_crowd[:, None, :], det_area[:, :, None], union)
+    return inter / jnp.clip(union, 1e-9, None)
+
+
+def _last_argmax(values: Array, mask: Array) -> Tuple[Array, Array]:
+    """Argmax over the last axis where ``mask``; equal maxima resolve to the LAST index.
+
+    Returns ``(index, any_valid)``.
+    """
+    neg = jnp.where(mask, values, -jnp.inf)
+    rev = neg[..., ::-1]
+    g = values.shape[-1]
+    idx = g - 1 - jnp.argmax(rev, axis=-1)
+    any_valid = jnp.any(mask, axis=-1)
+    return idx, any_valid
+
+
+def match_units(
+    ious: Array,
+    gt_valid: Array,
+    gt_crowd: Array,
+    gt_ignore: Array,
+    det_valid: Array,
+    det_out_of_range: Array,
+    iou_thresholds: Array,
+) -> Tuple[Array, Array]:
+    """Greedy COCO matching for all units/area-ranges/thresholds in one scan.
+
+    Args:
+        ious: ``(U, D, G)`` pairwise IoU per unit, detections pre-sorted by
+            descending score (stable), gts in original per-image order.
+        gt_valid: ``(U, G)`` padding mask.
+        gt_crowd: ``(U, G)`` COCO iscrowd flags.
+        gt_ignore: ``(U, A, G)`` per-area-range ignore (crowd or out of range).
+        det_valid: ``(U, D)`` padding mask.
+        det_out_of_range: ``(U, A, D)`` detection area outside the range.
+        iou_thresholds: ``(T,)``.
+
+    Returns:
+        ``(dtm, dtig)`` each ``(U, A, T, D)`` bool: matched / ignored flags per
+        detection slot.
+    """
+    u, d_cap, g_cap = ious.shape
+    a_n = gt_ignore.shape[1]
+    t_n = iou_thresholds.shape[0]
+    thr = jnp.minimum(iou_thresholds, 1 - 1e-10)[None, None, :, None]  # (1,1,T,1)
+
+    gt_avail_base = gt_valid[:, None, None, :]  # (U,1,1,G)
+    gt_ig = gt_ignore[:, :, None, :]  # (U,A,1,G)
+    gt_cr = gt_crowd[:, None, None, :]  # (U,1,1,G)
+
+    def step(gtm, d):
+        # gtm: (U,A,T,G) bool — gt already matched at this area-range/threshold
+        iou_d = ious[:, d, :][:, None, None, :]  # (U,1,1,G)
+        cand = gt_avail_base & (~gtm | gt_cr) & (iou_d >= thr) & det_valid[:, d][:, None, None, None]
+        # non-ignored gts take absolute precedence (COCOeval's break rule)
+        idx_non, has_non = _last_argmax(jnp.broadcast_to(iou_d, cand.shape), cand & ~gt_ig)
+        idx_ign, has_ign = _last_argmax(jnp.broadcast_to(iou_d, cand.shape), cand & gt_ig)
+        matched = has_non | has_ign
+        m_idx = jnp.where(has_non, idx_non, idx_ign)
+        one_hot = jax.nn.one_hot(m_idx, g_cap, dtype=bool) & matched[..., None]
+        gtm = gtm | one_hot
+        dtig_d = matched & ~has_non  # matched to an ignored gt
+        return gtm, (matched, dtig_d)
+
+    gtm0 = jnp.zeros((u, a_n, t_n, g_cap), dtype=bool)
+    _, (dtm_steps, dtig_steps) = lax.scan(step, gtm0, jnp.arange(d_cap))
+    dtm = jnp.moveaxis(dtm_steps, 0, -1)  # (U,A,T,D)
+    dtig = jnp.moveaxis(dtig_steps, 0, -1)
+    # unmatched detections outside the area range are ignored, not false positives
+    oor = det_out_of_range[:, :, None, :]  # (U,A,1,D)
+    dtig = dtig | (~dtm & oor & det_valid[:, None, None, :])
+    return dtm, dtig
+
+
+match_units_jit = jax.jit(match_units)
+batched_box_iou_jit = jax.jit(batched_box_iou)

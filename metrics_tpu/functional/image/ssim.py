@@ -15,13 +15,11 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.image._helpers import (
-    _gaussian_kernel_2d,
-    _gaussian_kernel_3d,
+    _gaussian,
     _reflect_pad,
-    _uniform_kernel,
     avg_pool2d,
-    depthwise_conv,
     reduce,
+    separable_depthwise_conv,
 )
 from metrics_tpu.utils.checks import _check_same_shape
 
@@ -83,19 +81,16 @@ def _ssim_update(
 
     preds_p = _reflect_pad(preds, pads)
     target_p = _reflect_pad(target, pads)
+    # both window types are outer products of 1-D kernels → separable cascade
     if gaussian_kernel:
-        kernel = (
-            _gaussian_kernel_3d(channel, gauss_kernel_size, sigma)
-            if is_3d
-            else _gaussian_kernel_2d(channel, gauss_kernel_size, sigma)
-        )
+        kernels_1d = [_gaussian(k, s)[0] for k, s in zip(gauss_kernel_size, sigma)]
     else:
-        kernel = _uniform_kernel(channel, kernel_size)
+        kernels_1d = [jnp.ones(k) / k for k in kernel_size]
 
     input_list = jnp.concatenate(
         (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
     )  # (5·B, C, *spatial)
-    outputs = depthwise_conv(input_list, kernel)
+    outputs = separable_depthwise_conv(input_list, kernels_1d)
     b = preds.shape[0]
     mu_pred, mu_target, s_pp, s_tt, s_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
 

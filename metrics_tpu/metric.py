@@ -31,6 +31,7 @@ from __future__ import annotations
 import functools
 import inspect
 from abc import ABC, abstractmethod
+from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -66,8 +67,10 @@ def jit_update_enabled(enable: bool) -> None:
 # N instances of one metric class with equal config share ONE compilation (the
 # reference has no analog — torch Modules re-dispatch per call; under XLA a
 # per-instance `jax.jit` would recompile per instance, which dominates
-# MetricCollection startup cost).
-_SHARED_JIT_CACHE: Dict[Any, Callable] = {}
+# MetricCollection startup cost). LRU-bounded: sweeping configs (e.g. a fresh
+# per-epoch weight array) must not pin representatives forever.
+_SHARED_JIT_CACHE: "OrderedDict[Any, Callable]" = OrderedDict()
+_SHARED_JIT_CACHE_MAX = 256
 
 
 def clear_jit_cache() -> None:
@@ -378,11 +381,15 @@ class Metric(ABC):
             # A dedicated pristine clone becomes the representative whose bound
             # update body is traced; config-equal instances replay its executable.
             # Cloning (rather than caching `self`) keeps user instances — and any
-            # large states they later accumulate — out of the process-lifetime cache.
+            # large states they later accumulate — out of the cache.
             rep = self.clone()
             rep.reset()
             fn = jax.jit(rep._functional_update)
             _SHARED_JIT_CACHE[key] = fn
+            if len(_SHARED_JIT_CACHE) > _SHARED_JIT_CACHE_MAX:
+                _SHARED_JIT_CACHE.popitem(last=False)
+        else:
+            _SHARED_JIT_CACHE.move_to_end(key)
         return fn
 
     def _wrapped_update(self, *args: Any, **kwargs: Any) -> None:

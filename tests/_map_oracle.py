@@ -1,0 +1,141 @@
+"""Test oracle: COCOeval-faithful greedy matching in plain numpy loops.
+
+This is the round-1 host implementation of the COCO protocol (sequential
+triple loop, transcribed from the published COCOeval algorithm). It is kept as
+an independent oracle for the device-native matcher — in particular for crowd
+and area-range semantics, which the reference's pure-torch legacy
+implementation (`torchmetrics/detection/_mean_ap.py`, used as the other
+oracle) does not model.
+"""
+
+import numpy as np
+
+AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+
+
+def np_box_iou(dets, gts, iscrowd):
+    if len(dets) == 0 or len(gts) == 0:
+        return np.zeros((len(dets), len(gts)))
+    lt = np.maximum(dets[:, None, :2], gts[None, :, :2])
+    rb = np.minimum(dets[:, None, 2:], gts[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    det_area = np.clip(dets[:, 2] - dets[:, 0], 0, None) * np.clip(dets[:, 3] - dets[:, 1], 0, None)
+    gt_area = np.clip(gts[:, 2] - gts[:, 0], 0, None) * np.clip(gts[:, 3] - gts[:, 1], 0, None)
+    union = det_area[:, None] + gt_area[None, :] - inter
+    union = np.where(iscrowd[None, :], det_area[:, None], union)
+    return inter / np.clip(union, 1e-9, None)
+
+
+def match_image(ious, gt_ignore, gt_crowd, det_areas, area_rng, iou_thrs, max_det):
+    """COCOeval greedy matching for one image/class: returns (dt_matched, dt_ignore), each (T, D)."""
+    n_det = min(ious.shape[0], max_det)
+    n_gt = ious.shape[1]
+    t_n = len(iou_thrs)
+    gt_order = np.argsort(gt_ignore, kind="stable")  # non-ignored gts first
+    dtm = np.zeros((t_n, n_det), dtype=bool)
+    dtig = np.zeros((t_n, n_det), dtype=bool)
+    for ti, t in enumerate(iou_thrs):
+        gtm = np.full(n_gt, -1)
+        for d in range(n_det):
+            iou = min(t, 1 - 1e-10)
+            m = -1
+            for gi in gt_order:
+                if gtm[gi] >= 0 and not gt_crowd[gi]:
+                    continue  # already matched; only crowd gts may be re-matched
+                if m > -1 and not gt_ignore[m] and gt_ignore[gi]:
+                    break  # can't do better than a non-ignored match
+                if ious[d, gi] < iou:
+                    continue
+                iou = ious[d, gi]
+                m = gi
+            if m == -1:
+                continue
+            dtig[ti, d] = gt_ignore[m]
+            dtm[ti, d] = True
+            gtm[m] = d
+        out_of_rng = (det_areas[:n_det] < area_rng[0]) | (det_areas[:n_det] > area_rng[1])
+        dtig[ti] = dtig[ti] | (~dtm[ti] & out_of_rng)
+    return dtm, dtig
+
+
+def evaluate_full(preds, target, iou_thrs=None, rec_thrs=None, max_dets=(1, 10, 100)):
+    """Full sequential COCO evaluation (loops everywhere): the end-to-end oracle.
+
+    preds/target: per-image dicts of numpy arrays (boxes xyxy, scores, labels,
+    optional iscrowd/area). Returns (precision, recall) shaped like COCOeval's
+    accumulate: (T, R, K, A, M) / (T, K, A, M), plus the sorted class list.
+    """
+    iou_thrs = np.linspace(0.5, 0.95, 10) if iou_thrs is None else np.asarray(iou_thrs)
+    rec_thrs = np.linspace(0.0, 1.0, 101) if rec_thrs is None else np.asarray(rec_thrs)
+    max_dets = sorted(max_dets)
+    n_imgs = len(preds)
+    classes = sorted(
+        set(np.concatenate([np.asarray(t["labels"]).reshape(-1) for t in target]).tolist())
+        | set(np.concatenate([np.asarray(p["labels"]).reshape(-1) for p in preds]).tolist())
+    ) if n_imgs else []
+    area_names = list(AREA_RANGES)
+    t_n, r_n, k_n, a_n, m_n = len(iou_thrs), len(rec_thrs), len(classes), len(area_names), len(max_dets)
+    precision = -np.ones((t_n, r_n, k_n, a_n, m_n))
+    recall = -np.ones((t_n, k_n, a_n, m_n))
+
+    for ki, cls in enumerate(classes):
+        per_img = []
+        for i in range(n_imgs):
+            dmask = np.asarray(preds[i]["labels"]) == cls
+            gmask = np.asarray(target[i]["labels"]) == cls
+            dboxes = np.asarray(preds[i]["boxes"], dtype=np.float64).reshape(-1, 4)[dmask]
+            dscores = np.asarray(preds[i]["scores"], dtype=np.float64)[dmask]
+            order = np.argsort(-dscores, kind="stable")
+            dboxes, dscores = dboxes[order], dscores[order]
+            gboxes = np.asarray(target[i]["boxes"], dtype=np.float64).reshape(-1, 4)[gmask]
+            ng_all = len(np.asarray(target[i]["labels"]).reshape(-1))
+            gcrowd = np.asarray(target[i].get("iscrowd", np.zeros(ng_all))).astype(bool)[gmask]
+            garea_in = target[i].get("area")
+            if garea_in is None:
+                garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+            else:
+                garea = np.asarray(garea_in, dtype=np.float64)[gmask]
+            ious = np_box_iou(dboxes.astype(np.float32), gboxes.astype(np.float32), gcrowd).astype(np.float64)
+            det_areas = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
+            per_img.append((dscores, det_areas, gcrowd, garea, ious))
+
+        for ai, aname in enumerate(area_names):
+            rng_a = AREA_RANGES[aname]
+            for mi, max_det in enumerate(max_dets):
+                all_scores, all_tps, all_ig = [], [], []
+                npig = 0
+                for dscores, det_areas, gcrowd, garea, ious in per_img:
+                    gt_ignore = gcrowd | (garea < rng_a[0]) | (garea > rng_a[1])
+                    npig += int((~gt_ignore).sum())
+                    dtm, dtig = match_image(ious, gt_ignore, gcrowd, det_areas, rng_a, iou_thrs, max_det=max(max_dets))
+                    keep = min(dtm.shape[1], max_det)
+                    all_scores.append(dscores[:keep])
+                    all_tps.append(dtm[:, :keep])
+                    all_ig.append(dtig[:, :keep])
+                if npig == 0:
+                    continue
+                scores_cat = np.concatenate(all_scores) if all_scores else np.zeros(0)
+                order = np.argsort(-scores_cat, kind="mergesort")
+                tps = np.concatenate(all_tps, axis=1)[:, order]
+                ig = np.concatenate(all_ig, axis=1)[:, order]
+                scores_sorted = scores_cat[order]
+                tp_c = np.cumsum(tps & ~ig, axis=1).astype(np.float64)
+                fp_c = np.cumsum(~tps & ~ig, axis=1).astype(np.float64)
+                for ti in range(t_n):
+                    tp, fp = tp_c[ti], fp_c[ti]
+                    rc = tp / npig
+                    pr = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
+                    recall[ti, ki, ai, mi] = rc[-1] if len(rc) else 0.0
+                    pr = np.maximum.accumulate(pr[::-1])[::-1] if len(pr) else pr
+                    inds = np.searchsorted(rc, rec_thrs, side="left")
+                    q = np.zeros(r_n)
+                    valid = inds < len(pr)
+                    q[valid] = pr[inds[valid]]
+                    precision[ti, :, ki, ai, mi] = q
+    return precision, recall, classes

@@ -1,0 +1,8 @@
+"""Minimal stand-in for the `lightning_utilities` package, test-infra only.
+
+Provides just the four symbols the reference package imports so that
+`/root/reference/src` can be imported as a golden oracle in tests and
+benchmarks (zero-egress environment; the real package is not installed).
+"""
+
+from lightning_utilities.core.apply_func import apply_to_collection  # noqa: F401

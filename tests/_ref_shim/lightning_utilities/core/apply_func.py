@@ -1,0 +1,14 @@
+from typing import Any, Callable
+
+
+def apply_to_collection(data: Any, dtype, function: Callable, *args: Any, **kwargs: Any) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` elements of a nested collection."""
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+    if isinstance(data, dict):
+        return type(data)({k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()})
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data))
+    if isinstance(data, (list, tuple, set)):
+        return type(data)(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data)
+    return data

@@ -1,0 +1,34 @@
+from enum import Enum
+from typing import Optional
+
+
+class StrEnum(str, Enum):
+    """String-valued enum with case/sep-insensitive lookup."""
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> Optional["StrEnum"]:
+        if not isinstance(value, str):
+            return None
+        norm = value.replace("-", "_").lower()
+        for member in cls:
+            if source in ("key", "any") and member.name.lower() == norm:
+                return member
+            if source in ("value", "any") and member.value.lower() == value.lower():
+                return member
+        return None
+
+    @classmethod
+    def _allowed_matches(cls, source: str = "key"):
+        return [m.name for m in cls] if source == "key" else [m.value for m in cls]
+
+    @classmethod
+    def _name(cls) -> str:
+        return cls.__name__
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, str):
+            return self.value.lower() == other.replace("-", "_").lower() or self.name.lower() == other.replace("-", "_").lower()
+        return super().__eq__(other)
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())

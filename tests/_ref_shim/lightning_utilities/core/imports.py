@@ -1,0 +1,30 @@
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache
+def package_available(package_name: str) -> bool:
+    try:
+        return importlib.util.find_spec(package_name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+class RequirementCache:
+    """Importability probe: truthy iff the requirement's module can be imported."""
+
+    def __init__(self, requirement: str, module: str = None) -> None:
+        self.requirement = requirement
+        self.module = module
+
+    def _check(self) -> bool:
+        name = self.module or self.requirement.split(">")[0].split("=")[0].split("<")[0].strip()
+        return package_available(name)
+
+    def __bool__(self) -> bool:
+        return self._check()
+
+    def __str__(self) -> str:
+        return f"Requirement {self.requirement} {'met' if self._check() else 'not met (shim probe)'}"
+
+    __repr__ = __str__

@@ -1,0 +1,1 @@
+"""Minimal pycocotools stand-in (test infra) backed by metrics_tpu's RLE codec."""

@@ -1,0 +1,33 @@
+"""pycocotools.mask API surface delegating to metrics_tpu.detection.rle."""
+
+import numpy as np
+
+from metrics_tpu.detection.rle import (
+    mask_to_rle,
+    rle_area,
+    rle_iou,
+    rle_to_mask,
+)
+
+
+def encode(mask: np.ndarray):
+    """Encode mask(s); accepts (h, w) or (h, w, n) Fortran-order uint8 arrays."""
+    mask = np.asarray(mask)
+    if mask.ndim == 2:
+        return mask_to_rle(mask)
+    return [mask_to_rle(mask[:, :, i]) for i in range(mask.shape[2])]
+
+
+def decode(rles):
+    if isinstance(rles, dict):
+        return rle_to_mask(rles)
+    return np.stack([rle_to_mask(r) for r in rles], axis=-1)
+
+
+def area(rles):
+    out = rle_area(rles)
+    return out[0] if isinstance(rles, dict) else out
+
+
+def iou(dt, gt, iscrowd):
+    return rle_iou(dt, gt, iscrowd)

@@ -1,0 +1,3 @@
+"""Minimal torchvision stand-in (test infra): just the box ops the reference imports."""
+__version__ = "0.0.shim"
+from torchvision import ops  # noqa: F401

@@ -1,0 +1,302 @@
+"""MeanAveragePrecision parity: device-native matcher vs two independent oracles.
+
+1. The reference's pure-torch legacy implementation (`/root/reference/src/
+   torchmetrics/detection/_mean_ap.py` — the tensor-form COCO algorithm,
+   SURVEY §3.4) on synthetic datasets, bbox and segm — crowd-free, since the
+   legacy implementation does not model crowds.
+2. A sequential numpy COCOeval transcription (`tests/_map_oracle.py`) for the
+   matching core including crowd re-matching and area-range ignores.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REF = "/root/reference/src"
+_SHIM = os.path.join(REPO, "tests", "_ref_shim")
+_HAS_REF = os.path.isdir(_REF)
+
+if _HAS_REF:
+    for p in (_SHIM, _REF):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+
+import jax.numpy as jnp  # noqa: E402
+
+from metrics_tpu.detection import MeanAveragePrecision  # noqa: E402
+
+
+def _synth_boxes(rng, n_imgs, n_classes, crowd_prob=0.0, img_hw=200.0):
+    """Detections = jittered ground truths + false positives; some gts dropped."""
+    preds, target = [], []
+    for _ in range(n_imgs):
+        ng = rng.randint(0, 7)
+        gb = rng.rand(ng, 4) * (img_hw * 0.7)
+        gb[:, 2:] = gb[:, :2] + 2 + rng.rand(ng, 2) * (img_hw * 0.45)
+        glab = rng.randint(0, n_classes, ng)
+        crowd = rng.rand(ng) < crowd_prob
+        db, dlab, dsc = [], [], []
+        for j in range(ng):
+            if rng.rand() < 0.8:  # detected, jittered
+                jit = gb[j] + rng.randn(4) * 3.0
+                jit[2:] = np.maximum(jit[2:], jit[:2] + 1)
+                db.append(jit)
+                dlab.append(glab[j] if rng.rand() < 0.9 else rng.randint(0, n_classes))
+                dsc.append(rng.rand())
+        for _ in range(rng.randint(0, 3)):  # false positives
+            fp = rng.rand(4) * (img_hw * 0.7)
+            fp[2:] = fp[:2] + 2 + rng.rand(2) * (img_hw * 0.45)
+            db.append(fp)
+            dlab.append(rng.randint(0, n_classes))
+            dsc.append(rng.rand())
+        db = np.asarray(db).reshape(-1, 4)
+        preds.append({"boxes": db, "scores": np.asarray(dsc), "labels": np.asarray(dlab, dtype=np.int64)})
+        tgt = {"boxes": gb, "labels": glab.astype(np.int64)}
+        if crowd_prob > 0:
+            tgt["iscrowd"] = crowd.astype(np.int64)
+        target.append(tgt)
+    return preds, target
+
+
+def _to_torch(dicts):
+    import torch
+
+    out = []
+    for d in dicts:
+        item = {}
+        for k, v in d.items():
+            v = np.asarray(v)
+            if k in ("labels", "iscrowd"):
+                item[k] = torch.tensor(v, dtype=torch.long)
+            elif k == "masks":
+                item[k] = torch.tensor(v, dtype=torch.bool)
+            else:
+                item[k] = torch.tensor(v, dtype=torch.float32)
+        out.append(item)
+    return out
+
+
+def _to_jnp(dicts):
+    return [{k: (v if k == "masks" else jnp.asarray(np.asarray(v, dtype=np.float64 if k != "labels" else np.int32)))
+             for k, v in d.items()} for d in dicts]
+
+
+_SCALAR_KEYS = [
+    "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+    "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+]
+
+
+# Recall thresholds that no rational tp/npig can hit exactly: the legacy oracle
+# runs searchsorted in float32 while we follow pycocotools' float64, so a recall
+# value landing EXACTLY on a threshold resolves differently (e.g. rc == 0.7 vs
+# linspace's 0.7000000000000001). Off-grid thresholds make strict parity testable.
+_OFFGRID_REC = (np.linspace(0.0, 1.0, 101) * 0.99871 + 0.000137).clip(0, 1).tolist()
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("n_imgs", [40, 120])
+def test_bbox_parity_vs_reference_legacy(seed, n_imgs):
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
+
+    rng = np.random.RandomState(seed)
+    preds, target = _synth_boxes(rng, n_imgs=n_imgs, n_classes=4)
+
+    ours = MeanAveragePrecision(class_metrics=True, rec_thresholds=_OFFGRID_REC)
+    ours.update(_to_jnp(preds), _to_jnp(target))
+    got = ours.compute()
+
+    ref = RefMAP(class_metrics=True, rec_thresholds=_OFFGRID_REC)
+    ref.update(_to_torch(preds), _to_torch(target))
+    want = ref.compute()
+
+    # Area-'all' keys only: the legacy oracle deviates from the COCO protocol on
+    # area-range ignores (it refuses to match ignored gts, COCOeval matches and
+    # ignores the detection) — small/medium/large are validated end-to-end against
+    # the sequential COCOeval transcription in test_full_pipeline_vs_numpy_cocoeval.
+    for key in ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100"):
+        assert float(got[key]) == pytest.approx(float(want[key]), abs=1e-6), key
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+def test_bbox_default_thresholds_close_to_reference_legacy():
+    """Default COCO thresholds, area-'all' keys: agreement within the oracle's
+    f32 searchsorted boundary noise (area-specific keys diverge for the protocol
+    reason documented above and are oracle-checked elsewhere)."""
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
+
+    rng = np.random.RandomState(0)
+    preds, target = _synth_boxes(rng, n_imgs=80, n_classes=4)
+    ours = MeanAveragePrecision()
+    ours.update(_to_jnp(preds), _to_jnp(target))
+    got = ours.compute()
+    ref = RefMAP()
+    ref.update(_to_torch(preds), _to_torch(target))
+    want = ref.compute()
+    for key in ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100"):
+        assert float(got[key]) == pytest.approx(float(want[key]), abs=5e-3), key
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("crowd_prob", [0.0, 0.25])
+def test_full_pipeline_vs_numpy_cocoeval(seed, crowd_prob):
+    """End-to-end device pipeline vs the sequential COCOeval transcription.
+
+    Covers every semantic the legacy torch oracle cannot: crowd re-matching,
+    matched-to-ignored detections, area-range ignores — across all area ranges,
+    maxDets, and the full precision/recall tensors.
+    """
+    from tests._map_oracle import evaluate_full
+
+    rng = np.random.RandomState(seed)
+    preds, target = _synth_boxes(rng, n_imgs=60, n_classes=4, crowd_prob=crowd_prob)
+
+    m = MeanAveragePrecision(extended_summary=True)
+    m.update(_to_jnp(preds), _to_jnp(target))
+    got = m.compute()
+
+    want_p, want_r, want_classes = evaluate_full(
+        [{k: np.asarray(v) for k, v in d.items()} for d in preds],
+        [{k: np.asarray(v) for k, v in d.items()} for d in target],
+    )
+    assert np.asarray(got["classes"]).tolist() == want_classes
+    np.testing.assert_allclose(np.asarray(got["precision"]), want_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["recall"]), want_r, atol=1e-6)
+
+
+def _rect_mask(h, w, box):
+    m = np.zeros((h, w), dtype=np.uint8)
+    x0, y0, x1, y1 = (int(round(v)) for v in box)
+    m[max(y0, 0) : max(y1, 0), max(x0, 0) : max(x1, 0)] = 1
+    return m
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+def test_segm_parity_vs_reference_legacy():
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
+
+    rng = np.random.RandomState(7)
+    preds, target = _synth_boxes(rng, n_imgs=30, n_classes=3, img_hw=96.0)
+    h = w = 96
+    for d in preds + target:
+        d["masks"] = np.stack([_rect_mask(h, w, b) for b in d["boxes"]]) if len(d["boxes"]) else np.zeros((0, h, w), np.uint8)
+
+    ours = MeanAveragePrecision(iou_type="segm", rec_thresholds=_OFFGRID_REC)
+    ours.update(_to_jnp(preds), _to_jnp(target))
+    got = ours.compute()
+
+    ref = RefMAP(iou_type="segm", rec_thresholds=_OFFGRID_REC)
+    ref.update(_to_torch(preds), _to_torch(target))
+    want = ref.compute()
+
+    # area-'all' keys: see the area-range protocol note on the bbox test above
+    for key in ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100"):
+        assert float(got[key]) == pytest.approx(float(want[key]), abs=1e-6), key
+
+
+def test_matching_kernel_vs_numpy_cocoeval_crowd_and_area():
+    """Device matcher vs the sequential COCOeval transcription, with crowds."""
+    from tests._map_oracle import AREA_RANGES, match_image, np_box_iou
+    from metrics_tpu.functional.detection.map_matching import match_units
+
+    import jax
+
+    rng = np.random.RandomState(3)
+    iou_thrs = np.linspace(0.5, 0.95, 10)
+    area_names = list(AREA_RANGES)
+    for _ in range(25):
+        nd, ng = rng.randint(1, 9), rng.randint(1, 7)
+        gb = rng.rand(ng, 4) * 120
+        gb[:, 2:] = gb[:, :2] + 1 + rng.rand(ng, 2) * 90
+        db = np.concatenate([gb[rng.randint(0, ng, nd // 2 + 1)] + rng.randn(nd // 2 + 1, 4) * 4, rng.rand(nd - nd // 2 - 1, 4) * 120])
+        db[:, 2:] = np.maximum(db[:, 2:], db[:, :2] + 1)
+        scores = rng.rand(len(db))
+        order = np.argsort(-scores, kind="stable")
+        db = db[order]
+        crowd = rng.rand(ng) < 0.3
+        det_areas = (db[:, 2] - db[:, 0]) * (db[:, 3] - db[:, 1])
+        gt_areas = (gb[:, 2] - gb[:, 0]) * (gb[:, 3] - gb[:, 1])
+        ious = np_box_iou(db, gb, crowd)
+
+        # oracle per area range
+        want_dtm, want_dtig = [], []
+        for aname in area_names:
+            rng_a = AREA_RANGES[aname]
+            gt_ignore = crowd | (gt_areas < rng_a[0]) | (gt_areas > rng_a[1])
+            dtm, dtig = match_image(ious, gt_ignore, crowd, det_areas, rng_a, iou_thrs, max_det=100)
+            want_dtm.append(dtm)
+            want_dtig.append(dtig)
+        want_dtm = np.stack(want_dtm)  # (A, T, D)
+        want_dtig = np.stack(want_dtig)
+
+        # device kernel (single unit)
+        a_n = len(area_names)
+        ranges = np.asarray([AREA_RANGES[a] for a in area_names])
+        gt_ignore_a = crowd[None, :] | (gt_areas[None, :] < ranges[:, :1]) | (gt_areas[None, :] > ranges[:, 1:])
+        det_oor = (det_areas[None, :] < ranges[:, :1]) | (det_areas[None, :] > ranges[:, 1:])
+        dtm, dtig = match_units(
+            jnp.asarray(ious[None]),
+            jnp.ones((1, ng), bool),
+            jnp.asarray(crowd[None]),
+            jnp.asarray(gt_ignore_a[None]),
+            jnp.ones((1, len(db)), bool),
+            jnp.asarray(det_oor[None]),
+            jnp.asarray(iou_thrs),
+        )
+        np.testing.assert_array_equal(np.asarray(dtm[0]), want_dtm)
+        np.testing.assert_array_equal(np.asarray(dtig[0]), want_dtig)
+
+
+def test_micro_average_and_class_metrics():
+    rng = np.random.RandomState(5)
+    preds, target = _synth_boxes(rng, n_imgs=25, n_classes=3)
+    m = MeanAveragePrecision(average="micro", class_metrics=True)
+    m.update(_to_jnp(preds), _to_jnp(target))
+    out = m.compute()
+    assert float(out["map"]) >= 0
+    assert np.asarray(out["map_per_class"]).shape == (len(np.asarray(out["classes"])),)
+    assert "mar_100_per_class" in out
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+def test_bbox_parity_with_explicit_iscrowd_ignored_gts():
+    """Crowd gts: our result must treat them as ignore regions (COCO protocol).
+
+    The legacy oracle has no crowd model, so assert protocol *properties* instead:
+    a detection matching only a crowd gt is neither TP nor FP (score unchanged by
+    adding such a detection).
+    """
+    box = np.asarray([[10.0, 10.0, 60.0, 60.0]])
+    target = [{"boxes": box, "labels": np.asarray([0]), "iscrowd": np.asarray([1])}]
+    base = [{"boxes": np.zeros((0, 4)), "scores": np.zeros(0), "labels": np.zeros(0, np.int64)}]
+    with_crowd_hit = [{"boxes": box + 1.0, "scores": np.asarray([0.9]), "labels": np.asarray([0])}]
+
+    m0 = MeanAveragePrecision()
+    m0.update(_to_jnp(base), _to_jnp(target))
+    m1 = MeanAveragePrecision()
+    m1.update(_to_jnp(with_crowd_hit), _to_jnp(target))
+    # no non-crowd gts anywhere → npig == 0 → all -1 in both cases
+    assert float(m0.compute()["map"]) == float(m1.compute()["map"]) == -1.0
+
+    # now add one real gt of another class; crowd-matched det must not change its AP
+    target2 = [{
+        "boxes": np.concatenate([box, [[100.0, 100.0, 150.0, 150.0]]]),
+        "labels": np.asarray([0, 1]),
+        "iscrowd": np.asarray([1, 0]),
+    }]
+    hit_real = {"boxes": np.asarray([[100.0, 100.0, 150.0, 150.0]]), "scores": np.asarray([0.8]), "labels": np.asarray([1])}
+    preds_a = [hit_real]
+    preds_b = [{
+        "boxes": np.concatenate([hit_real["boxes"], box + 1.0]),
+        "scores": np.asarray([0.8, 0.9]),
+        "labels": np.asarray([1, 0]),
+    }]
+    ma = MeanAveragePrecision()
+    ma.update(_to_jnp(preds_a), _to_jnp(target2))
+    mb = MeanAveragePrecision()
+    mb.update(_to_jnp(preds_b), _to_jnp(target2))
+    assert float(ma.compute()["map"]) == pytest.approx(float(mb.compute()["map"]))
