@@ -17,8 +17,6 @@ from jax import Array
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.imports import (
-    _GAMMATONE_AVAILABLE,
-    _LIBROSA_AVAILABLE,
     _ONNXRUNTIME_AVAILABLE,
     _PESQ_AVAILABLE,
     _PYSTOI_AVAILABLE,
@@ -94,22 +92,112 @@ class ShortTimeObjectiveIntelligibility(_HostAudioMetric):
 
 
 class SpeechReverberationModulationEnergyRatio(_HostAudioMetric):
-    """SRMR via gammatone filterbanks (reference ``audio/srmr.py:30``)."""
+    """SRMR via a native jnp gammatone/modulation filterbank (reference ``audio/srmr.py:30``).
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
-        if not (_GAMMATONE_AVAILABLE and _LIBROSA_AVAILABLE):
-            raise ModuleNotFoundError(
-                "SpeechReverberationModulationEnergyRatio metric requires that `gammatone` and"
-                " `torchaudio`/`librosa` are installed."
-            )
-        raise NotImplementedError(
-            "SpeechReverberationModulationEnergyRatio is not yet implemented in this build even with"
-            " the optional packages present; it lands with the pretrained-model round."
+    Unlike the reference, this needs NO optional packages — the filterbanks are
+    implemented in-framework (:mod:`metrics_tpu.functional.audio.srmr`).
+
+    >>> import numpy as np, jax.numpy as jnp
+    >>> rng = np.random.RandomState(0)
+    >>> t = np.arange(8000) / 8000.0
+    >>> m = SpeechReverberationModulationEnergyRatio(fs=8000)
+    >>> m.update(jnp.asarray((1 + np.sin(2 * np.pi * 8 * t)) * rng.randn(8000)))
+    >>> bool(m.compute() > 1.0)
+    True
+    """
+
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125,
+        min_cf: float = 4,
+        max_cf: Any = None,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if fs <= 0:
+            raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")
+        self.fs = fs
+        self.n_cochlear_filters = n_cochlear_filters
+        self.low_freq = low_freq
+        self.min_cf = min_cf
+        self.max_cf = max_cf
+        self.norm = norm
+        self.fast = fast
+
+    def update(self, preds: Array) -> None:
+        """Update with waveform(s) ``(..., time)``."""
+        from metrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
+
+        scores = speech_reverberation_modulation_energy_ratio(
+            preds, self.fs, self.n_cochlear_filters, self.low_freq, self.min_cf,
+            self.max_cf, self.norm, self.fast,
         )
+        scores = jnp.atleast_1d(scores)
+        self.sum_value = self.sum_value + scores.sum()
+        self.total = self.total + scores.size
+
+
+def _local_model_path(filename: str, what: str) -> str:
+    """Resolve a pretrained scorer file against METRICS_TPU_WEIGHTS (no downloads)."""
+    import os
+
+    weights_dir = os.environ.get("METRICS_TPU_WEIGHTS")
+    path = os.path.join(weights_dir, filename) if weights_dir else None
+    if not path or not os.path.exists(path):
+        raise ModuleNotFoundError(
+            f"{what} needs the pretrained model file {filename!r} in the directory given by"
+            " METRICS_TPU_WEIGHTS. This offline build never downloads."
+        )
+    return path
+
+
+def _log_power_mel(audio: np.ndarray, sr: int, n_mels: int = 120, frame_size: int = 320, hop: int = 160) -> np.ndarray:
+    """Host-side log-power mel spectrogram (the DNSMOS input featurization)."""
+    n_fft = frame_size + 1
+    window = np.hanning(n_fft)
+    if len(audio) < n_fft:  # zero-pad very short input to one full frame
+        audio = np.pad(audio, (0, n_fft - len(audio)))
+    n_frames = 1 + (len(audio) - n_fft) // hop
+    frames = np.stack([audio[i * hop : i * hop + n_fft] * window for i in range(max(n_frames, 1))])
+    spec = np.abs(np.fft.rfft(frames, axis=-1)) ** 2
+    # triangular mel filterbank
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mel_pts = mel_to_hz(np.linspace(hz_to_mel(0), hz_to_mel(sr / 2), n_mels + 2))
+    bins = np.floor((n_fft + 1) * mel_pts / sr).astype(int)
+    fb = np.zeros((n_mels, spec.shape[-1]))
+    for m in range(1, n_mels + 1):
+        lo, ce, hi = bins[m - 1], bins[m], bins[m + 1]
+        for k in range(lo, ce):
+            if ce > lo:
+                fb[m - 1, k] = (k - lo) / (ce - lo)
+        for k in range(ce, hi):
+            if hi > ce:
+                fb[m - 1, k] = (hi - k) / (hi - ce)
+    mel = spec @ fb.T
+    ref = max(mel.max(), 1e-20)
+    db = 10.0 * np.log10(np.maximum(mel, 1e-20) / ref)
+    return ((db + 40.0) / 40.0).astype(np.float32)
 
 
 class DeepNoiseSuppressionMeanOpinionScore(_HostAudioMetric):
-    """DNSMOS via pretrained onnxruntime scorers (reference ``audio/dnsmos.py:30``)."""
+    """DNSMOS via pretrained onnxruntime scorers (reference ``audio/dnsmos.py:30``).
+
+    Host-side pipeline (the scorer is a CPU onnx net — it never belongs on TPU):
+    9.01 s segments → log-power mel features → the local ``sig_bak_ovr.onnx``
+    (or personalized variant) session → polynomial MOS calibration. Model files
+    are resolved from ``METRICS_TPU_WEIGHTS`` (zero-egress build).
+    """
+
+    _INPUT_LEN_S = 9.01
 
     def __init__(self, fs: int, personalized: bool = False, **kwargs: Any) -> None:
         if not _ONNXRUNTIME_AVAILABLE:
@@ -117,14 +205,50 @@ class DeepNoiseSuppressionMeanOpinionScore(_HostAudioMetric):
                 "DeepNoiseSuppressionMeanOpinionScore metric requires that `onnxruntime` is installed."
                 " Install as `pip install onnxruntime`."
             )
-        raise NotImplementedError(
-            "DeepNoiseSuppressionMeanOpinionScore needs the pretrained DNSMOS onnx models, which are"
-            " not bundled in this offline build; it lands with the pretrained-model round."
-        )
+        super().__init__(**kwargs)
+        self.fs = fs
+        self.personalized = personalized
+        self._session = None
+
+    def _scores_for(self, audio: np.ndarray) -> np.ndarray:
+        import onnxruntime as ort
+
+        name = ("p" if self.personalized else "") + "sig_bak_ovr.onnx"
+        if self._session is None:
+            self._session = ort.InferenceSession(
+                _local_model_path(name, "DNSMOS"), providers=["CPUExecutionProvider"]
+            )
+        need = int(self._INPUT_LEN_S * self.fs)
+        seg = np.tile(audio, -(-need // max(len(audio), 1)))[:need] if len(audio) < need else audio[:need]
+        inp = seg.astype(np.float32)[None]
+        raw = self._session.run(None, {self._session.get_inputs()[0].name: inp})[0].reshape(-1)
+        sig, bak, ovr = raw[:3]
+        # published polynomial calibration (p835 fit)
+        if self.personalized:
+            sig = -0.00566666 * sig**2 + 1.16812 * sig - 0.08397
+            bak = -0.13166888 * bak**2 + 2.23310668 * bak - 4.30155127
+            ovr = -0.06766283 * ovr**2 + 1.11546468 * ovr + 0.04602535
+        else:
+            sig = -0.08397 + 1.22083953 * sig - 0.00524 * sig**2
+            bak = -4.26828 + 2.32298 * bak - 0.14423 * bak**2
+            ovr = 0.06116 + 1.1086 * ovr - 0.04109 * ovr**2
+        return np.asarray([sig, bak, ovr], dtype=np.float64)
+
+    def update(self, preds: Array) -> None:
+        """Update with waveform(s) ``(..., time)``; accumulates the overall MOS."""
+        flat = np.asarray(preds, dtype=np.float32).reshape(-1, np.asarray(preds).shape[-1])
+        for wav in flat:
+            self.sum_value = self.sum_value + float(self._scores_for(wav)[2])
+            self.total = self.total + 1
 
 
 class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
-    """NISQA via pretrained onnx model (reference ``audio/nisqa.py:30``)."""
+    """NISQA via a pretrained onnx export of the published model (reference ``audio/nisqa.py:30``).
+
+    Host-side: 48 kHz mel segments → local ``nisqa.onnx`` session → 5 MOS
+    dimensions; the overall MOS is accumulated. Model file resolved from
+    ``METRICS_TPU_WEIGHTS`` (zero-egress build).
+    """
 
     def __init__(self, fs: int, **kwargs: Any) -> None:
         if not _ONNXRUNTIME_AVAILABLE:
@@ -132,7 +256,23 @@ class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
                 "NonIntrusiveSpeechQualityAssessment metric requires that `onnxruntime` is installed."
                 " Install as `pip install onnxruntime`."
             )
-        raise NotImplementedError(
-            "NonIntrusiveSpeechQualityAssessment needs the pretrained NISQA onnx model, which is not"
-            " bundled in this offline build; it lands with the pretrained-model round."
-        )
+        super().__init__(**kwargs)
+        if fs <= 0:
+            raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")
+        self.fs = fs
+        self._session = None
+
+    def update(self, preds: Array) -> None:
+        """Update with waveform(s) ``(..., time)``."""
+        import onnxruntime as ort
+
+        if self._session is None:
+            self._session = ort.InferenceSession(
+                _local_model_path("nisqa.onnx", "NISQA"), providers=["CPUExecutionProvider"]
+            )
+        flat = np.asarray(preds, dtype=np.float32).reshape(-1, np.asarray(preds).shape[-1])
+        for wav in flat:
+            feats = _log_power_mel(wav, self.fs, n_mels=48, frame_size=960, hop=480)[None]
+            out = self._session.run(None, {self._session.get_inputs()[0].name: feats})[0].reshape(-1)
+            self.sum_value = self.sum_value + float(out[0])
+            self.total = self.total + 1

@@ -53,6 +53,7 @@ from metrics_tpu.classification.precision_recall_curve import (
 from metrics_tpu.classification.roc import ROC, BinaryROC, MulticlassROC, MultilabelROC
 from metrics_tpu.classification.accuracy import Accuracy, BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
 from metrics_tpu.classification.cohen_kappa import BinaryCohenKappa, CohenKappa, MulticlassCohenKappa
+from metrics_tpu.classification.dice import Dice
 from metrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     ConfusionMatrix,
@@ -138,7 +139,8 @@ __all__ = [
     "ROC", "BinaryROC", "MulticlassROC", "MultilabelROC",
     "Accuracy", "BinaryAccuracy", "MulticlassAccuracy", "MultilabelAccuracy",
     "BinaryCohenKappa", "CohenKappa", "MulticlassCohenKappa",
-    "BinaryConfusionMatrix", "ConfusionMatrix", "MulticlassConfusionMatrix", "MultilabelConfusionMatrix",
+    "BinaryConfusionMatrix", "ConfusionMatrix",
+    "Dice", "MulticlassConfusionMatrix", "MultilabelConfusionMatrix",
     "ExactMatch", "MulticlassExactMatch", "MultilabelExactMatch",
     "BinaryF1Score", "BinaryFBetaScore", "F1Score", "FBetaScore",
     "MulticlassF1Score", "MulticlassFBetaScore", "MultilabelF1Score", "MultilabelFBetaScore",

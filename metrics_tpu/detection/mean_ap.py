@@ -311,10 +311,14 @@ class MeanAveragePrecision(Metric):
         for start in range(0, len(order_by_size), chunk_size):
             sel_idx = order_by_size[start : start + chunk_size]
             chunk = [units[i] for i in sel_idx]
-            u_n = len(chunk)
+            # bucket the unit axis too (pad rows are all-invalid) so a varying
+            # dataset size replays cached executables instead of recompiling
+            u_n = _next_capacity(len(chunk), quantum=32)
             d_cap = _next_capacity(max((len(u["didx"]) for u in chunk), default=1))
             g_cap = _next_capacity(max((len(u["gidx"]) for u in chunk), default=1))
             ious = self._unit_ious(chunk, i_type, d_cap, g_cap)
+            if ious.shape[0] < u_n:
+                ious = np.concatenate([ious, np.zeros((u_n - ious.shape[0], d_cap, g_cap))])
             det_valid = np.zeros((u_n, d_cap), bool)
             gt_valid = np.zeros((u_n, g_cap), bool)
             gt_crowd = np.zeros((u_n, g_cap), bool)

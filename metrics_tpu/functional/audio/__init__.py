@@ -1,5 +1,8 @@
 """Functional audio metrics (reference ``torchmetrics/functional/audio/__init__.py``)."""
 
+from metrics_tpu.functional.audio.srmr import (
+    speech_reverberation_modulation_energy_ratio,
+)
 from metrics_tpu.functional.audio.metrics import (
     complex_scale_invariant_signal_noise_ratio,
     permutation_invariant_training,
@@ -20,4 +23,5 @@ __all__ = [
     "signal_distortion_ratio",
     "signal_noise_ratio",
     "source_aggregated_signal_distortion_ratio",
+    "speech_reverberation_modulation_energy_ratio",
 ]

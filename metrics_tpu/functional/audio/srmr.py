@@ -1,0 +1,144 @@
+"""Speech Reverberation Modulation energy Ratio (SRMR) — native jnp.
+
+The reference wraps the ``gammatone`` package's IIR filterbank
+(``/root/reference/src/torchmetrics/functional/audio/srmr.py``). Here the whole
+pipeline — the published SRMR algorithm (Falk et al., 2010) — is frequency-domain
+jnp, which is the TPU-friendly formulation (large batched FFTs instead of
+sequential IIR recursions):
+
+1. 4th-order gammatone filterbank, ``n_cochlear_filters`` ERB-spaced center
+   frequencies from ``low_freq`` to ``fs/2``, applied as FFT products of the
+   truncated impulse responses;
+2. temporal envelope per cochlear band via the analytic signal (FFT Hilbert);
+3. 8-band modulation filterbank (2nd-order resonators, Q=2, center frequencies
+   log-spaced ``min_cf``..``max_cf``) applied on the envelope spectra;
+4. 256 ms / 64 ms framed modulation energies;
+5. SRMR = energy in the 4 low modulation bands / energy in the 4 high bands.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+__all__ = ["speech_reverberation_modulation_energy_ratio"]
+
+_EAR_Q = 9.26449
+_MIN_BW = 24.7
+
+
+def _erb_center_freqs(low_freq: float, high_freq: float, n: int) -> Array:
+    """ERB-rate-spaced center frequencies (Glasberg & Moore), descending from high_freq."""
+    c = _EAR_Q * _MIN_BW
+    idx = jnp.arange(1, n + 1, dtype=jnp.float32)
+    return -c + jnp.exp(idx * (-jnp.log(high_freq + c) + jnp.log(low_freq + c)) / n) * (high_freq + c)
+
+
+def _gammatone_fir(fs: float, cfs: Array, n_taps: int) -> Array:
+    """(n_bands, n_taps) 4th-order gammatone impulse responses, peak-gain normalized."""
+    t = jnp.arange(n_taps, dtype=jnp.float32) / fs
+    erb = ((cfs / _EAR_Q) + _MIN_BW)  # ERB bandwidth per cf
+    b = 1.019 * erb
+    ir = t**3 * jnp.exp(-2 * jnp.pi * b[:, None] * t[None, :]) * jnp.cos(2 * jnp.pi * cfs[:, None] * t[None, :])
+    gain = jnp.max(jnp.abs(jnp.fft.rfft(ir, axis=-1)), axis=-1, keepdims=True)
+    return ir / jnp.maximum(gain, 1e-20)
+
+
+def _analytic_envelope(x: Array) -> Array:
+    """|analytic signal| along the last axis (FFT Hilbert transform)."""
+    n = x.shape[-1]
+    spec = jnp.fft.fft(x, axis=-1)
+    h = jnp.zeros(n)
+    h = h.at[0].set(1.0)
+    if n % 2 == 0:
+        h = h.at[n // 2].set(1.0)
+        h = h.at[1 : n // 2].set(2.0)
+    else:
+        h = h.at[1 : (n + 1) // 2].set(2.0)
+    return jnp.abs(jnp.fft.ifft(spec * h, axis=-1))
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _srmr_one(
+    x: Array,
+    fs: int,
+    n_cochlear_filters: int,
+    low_freq: float,
+    min_cf: float,
+    max_cf: float,
+    norm: bool,
+    fast: bool,
+) -> Array:
+    x = x.astype(jnp.float32)
+    n = x.shape[-1]
+    fir_len = min(n, int(0.128 * fs))
+    cfs = _erb_center_freqs(low_freq, fs / 2 * 0.9, n_cochlear_filters)
+    fir = _gammatone_fir(fs, cfs, fir_len)
+
+    pad = n + fir_len
+    spec_x = jnp.fft.rfft(x, pad)
+    spec_f = jnp.fft.rfft(fir, pad, axis=-1)
+    bands = jnp.fft.irfft(spec_x[None, :] * spec_f, pad, axis=-1)[:, :n]  # (B, T)
+
+    env = _analytic_envelope(bands)  # (B, T)
+
+    # modulation filterbank: 2nd-order resonator magnitude responses on the envelope spectrum
+    n_mod = 8
+    ratio = (max_cf / min_cf) ** (1.0 / (n_mod - 1))
+    mod_cfs = min_cf * ratio ** jnp.arange(n_mod)  # (M,)
+    freqs = jnp.fft.rfftfreq(n, 1.0 / fs)  # (F,)
+    q = 2.0
+    f_safe = jnp.maximum(freqs[None, :], 1e-6)
+    resp = 1.0 / jnp.sqrt(1.0 + q**2 * (f_safe / mod_cfs[:, None] - mod_cfs[:, None] / f_safe) ** 2)  # (M, F)
+    env_spec = jnp.fft.rfft(env, axis=-1)  # (B, F)
+    mod_sig = jnp.fft.irfft(env_spec[:, None, :] * resp[None, :, :], n, axis=-1)  # (B, M, T)
+
+    # framed energies: 256 ms window, 64 ms hop
+    win = max(int(0.256 * fs), 1)
+    hop = max(int(0.064 * fs), 1)
+    n_frames = max((n - win) // hop + 1, 1)
+    starts = jnp.arange(n_frames) * hop
+    frames = jax.vmap(lambda s: jax.lax.dynamic_slice_in_dim(mod_sig, s, min(win, n), axis=-1))(starts)
+    energy = (frames**2).sum(-1)  # (n_frames, B, M)
+    if norm:
+        peak = energy.max()
+        floor = peak / (10 ** (30.0 / 10.0))  # 30 dB dynamic range
+        energy = jnp.clip(energy, floor, None)
+    total = energy.sum(axis=(0, 1))  # (M,)
+    return total[:4].sum() / jnp.maximum(total[4:].sum(), 1e-20)
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125,
+    min_cf: float = 4,
+    max_cf: Optional[float] = None,
+    norm: bool = False,
+    fast: bool = False,
+) -> Array:
+    """SRMR of waveform(s) ``(..., time)`` → per-waveform scores ``(...)``.
+
+    Reference signature parity: ``functional/audio/srmr.py:176``; ``max_cf``
+    defaults to 128 Hz (30 Hz when ``norm=True``, as published).
+
+    >>> import numpy as np, jax.numpy as jnp
+    >>> rng = np.random.RandomState(0)
+    >>> t = np.arange(8000) / 8000.0
+    >>> am = (1 + np.sin(2 * np.pi * 8 * t)) * rng.randn(8000)  # 8 Hz modulated noise
+    >>> float(speech_reverberation_modulation_energy_ratio(jnp.asarray(am), 8000)) > 1.0
+    True
+    """
+    if max_cf is None:
+        max_cf = 30.0 if norm else 128.0
+    preds = jnp.asarray(preds)
+    flat = preds.reshape(-1, preds.shape[-1])
+    scores = jnp.stack(
+        [_srmr_one(w, int(fs), n_cochlear_filters, float(low_freq), float(min_cf), float(max_cf), bool(norm), bool(fast)) for w in flat]
+    )
+    return scores.reshape(preds.shape[:-1]) if preds.ndim > 1 else scores[0]

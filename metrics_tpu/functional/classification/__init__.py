@@ -68,6 +68,7 @@ from metrics_tpu.functional.classification.accuracy import (
     multilabel_accuracy,
 )
 from metrics_tpu.functional.classification.cohen_kappa import binary_cohen_kappa, cohen_kappa, multiclass_cohen_kappa
+from metrics_tpu.functional.classification.dice import dice
 from metrics_tpu.functional.classification.confusion_matrix import (
     binary_confusion_matrix,
     confusion_matrix,
@@ -131,11 +132,13 @@ from metrics_tpu.functional.classification.specificity import (
 )
 from metrics_tpu.functional.classification.stat_scores import (
     binary_stat_scores,
+    stat_scores,
     multiclass_stat_scores,
     multilabel_stat_scores,
 )
 
 __all__ = [
+    "dice",
     "binary_calibration_error", "calibration_error", "multiclass_calibration_error",
     "binary_fairness", "binary_groups_stat_rates", "demographic_parity", "equal_opportunity",
     "binary_hinge_loss", "hinge_loss", "multiclass_hinge_loss",
@@ -168,5 +171,5 @@ __all__ = [
     "binary_precision", "binary_recall", "multiclass_precision", "multiclass_recall",
     "multilabel_precision", "multilabel_recall", "precision", "recall",
     "binary_specificity", "multiclass_specificity", "multilabel_specificity", "specificity",
-    "binary_stat_scores", "multiclass_stat_scores", "multilabel_stat_scores",
+    "binary_stat_scores", "multiclass_stat_scores", "multilabel_stat_scores", "stat_scores",
 ]

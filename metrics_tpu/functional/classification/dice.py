@@ -1,0 +1,137 @@
+"""Dice coefficient — legacy-API classification metric (reference ``functional/classification/dice.py``).
+
+``dice = 2·TP / (2·TP + FP + FN)`` over stat scores, with the reference's
+legacy parameter surface: ``average`` ∈ micro|macro|weighted|none|samples,
+``mdmc_average`` ∈ global|samplewise, probability ``threshold``, multiclass
+``top_k``, ``ignore_index`` and ``zero_division``. Input kind is inferred from
+shapes/dtypes like the reference's ``_input_format_classification``
+(``utilities/checks.py:314``): hard labels, binary/multilabel probabilities
+(thresholded), or multiclass probabilities ``(N, C, ...)`` (top-k).
+
+All stages are shape-static jnp; the only Python branching is on static
+shapes/dtypes, so the kernels jit cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.compute import _safe_divide
+
+__all__ = ["dice"]
+
+_AVERAGES = ("micro", "macro", "weighted", "none", None, "samples")
+_MDMC = ("global", "samplewise", None)
+
+
+def _dice_format(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+) -> Tuple[Array, Array, int]:
+    """Return one-hot-ish (N, C, S) stat tensors (preds_oh, target_oh, C)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    floating = jnp.issubdtype(preds.dtype, jnp.floating)
+    if floating and preds.ndim == target.ndim + 1:
+        # multiclass probabilities (N, C, ...) — top-k membership
+        c = preds.shape[1]
+        k = top_k or 1
+        # top-k membership: rank of each class along the class axis
+        rank = jnp.argsort(jnp.argsort(-preds, axis=1), axis=1)
+        preds_oh = rank < k
+        target_oh = (
+            jnp.arange(c).reshape(1, c, *([1] * (target.ndim - 1))) == target[:, None]
+        )
+        n = preds.shape[0]
+        return preds_oh.reshape(n, c, -1), target_oh.reshape(n, c, -1), c
+    if floating:
+        # binary / multilabel probabilities, same shape as target
+        preds_hard = preds >= threshold
+        target_b = target.astype(bool)
+        if preds.ndim >= 2 and (num_classes is None or preds.shape[1] == num_classes) and preds.ndim > 1:
+            c = preds.shape[1] if preds.ndim > 1 else 1
+            n = preds.shape[0]
+            return preds_hard.reshape(n, c, -1), target_b.reshape(n, c, -1), c
+        return preds_hard.reshape(-1, 1, 1), target_b.reshape(-1, 1, 1), 1
+    # hard labels: infer classes
+    c = num_classes or int(max(int(preds.max()), int(target.max())) + 1)
+    n = preds.shape[0] if preds.ndim else 1
+    preds_oh = jnp.arange(c).reshape(1, c, *([1] * max(preds.ndim - 1, 0))) == preds[:, None]
+    target_oh = jnp.arange(c).reshape(1, c, *([1] * max(target.ndim - 1, 0))) == target[:, None]
+    return preds_oh.reshape(n, c, -1), target_oh.reshape(n, c, -1), c
+
+
+def _dice_stats(
+    preds_oh: Array, target_oh: Array, target_raw: Array, ignore_index: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    """Per-(sample, class) tp/fp/fn over the flattened extra dims.
+
+    Legacy ``ignore_index`` semantics (reference ``utilities/checks.py`` column
+    deletion): the ignored CLASS column is removed from the stats — other-class
+    predictions on ignored-target samples still count.
+    """
+    tp = (preds_oh & target_oh).sum(-1)
+    fp = (preds_oh & ~target_oh).sum(-1)
+    fn = (~preds_oh & target_oh).sum(-1)
+    if ignore_index is not None and 0 <= ignore_index < tp.shape[1]:
+        keep = jnp.arange(tp.shape[1]) != ignore_index
+        tp = tp * keep
+        fp = fp * keep
+        fn = fn * keep
+    return tp, fp, fn
+
+
+def _dice_reduce(tp: Array, fp: Array, fn: Array, average: Optional[str], zero_division: float) -> Array:
+    """Reduce (..., C) stats by the average mode (trailing axis = classes)."""
+    if average == "micro":
+        tp, fp, fn = tp.sum(-1), fp.sum(-1), fn.sum(-1)
+        denom = 2 * tp + fp + fn
+        return jnp.where(denom == 0, zero_division, _safe_divide(2 * tp, denom))
+    score = jnp.where(2 * tp + fp + fn == 0, zero_division, _safe_divide(2 * tp, 2 * tp + fp + fn))
+    present = (tp + fp + fn) > 0
+    if average == "macro":
+        return _safe_divide((jnp.where(present, score, 0.0)).sum(-1), present.sum(-1))
+    if average == "weighted":
+        support = tp + fn
+        return _safe_divide((score * support).sum(-1), support.sum(-1))
+    # none: absent classes are reported as zero_division is NOT applied — keep score
+    return score
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Compute the Dice coefficient (reference ``functional/classification/dice.py:68``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.asarray([2, 0, 2, 1])
+    >>> target = jnp.asarray([1, 1, 2, 0])
+    >>> float(dice(preds, target, average="micro"))
+    0.25
+    """
+    if average not in _AVERAGES:
+        raise ValueError(f"The `average` has to be one of {_AVERAGES}, got {average}.")
+    if mdmc_average not in _MDMC:
+        raise ValueError(f"The `mdmc_average` has to be one of {_MDMC}, got {mdmc_average}.")
+    preds_oh, target_oh, _ = _dice_format(preds, target, threshold, top_k, num_classes)
+    tp, fp, fn = _dice_stats(preds_oh, target_oh, target, ignore_index)  # (N, C)
+    if average == "samples" or mdmc_average == "samplewise":
+        inner = "micro" if average == "samples" else average
+        per_sample = _dice_reduce(tp, fp, fn, inner, zero_division)  # (N,) or (N,...)
+        return per_sample.mean()
+    tp, fp, fn = tp.sum(0), fp.sum(0), fn.sum(0)  # global accumulation → (C,)
+    return _dice_reduce(tp, fp, fn, average, zero_division)

@@ -1,5 +1,9 @@
 """Functional detection metrics (reference ``torchmetrics/functional/detection/__init__.py``)."""
 
+from metrics_tpu.functional.detection.panoptic_quality import (
+    modified_panoptic_quality,
+    panoptic_quality,
+)
 from metrics_tpu.functional.detection.iou import (
     complete_intersection_over_union,
     distance_intersection_over_union,
@@ -12,4 +16,6 @@ __all__ = [
     "distance_intersection_over_union",
     "generalized_intersection_over_union",
     "intersection_over_union",
+    "modified_panoptic_quality",
+    "panoptic_quality",
 ]

@@ -1,0 +1,70 @@
+"""Stateless panoptic-quality functionals (reference ``functional/detection/panoptic_quality.py``)."""
+
+from __future__ import annotations
+
+from typing import Collection
+
+from jax import Array
+
+__all__ = ["modified_panoptic_quality", "panoptic_quality"]
+
+
+def panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    return_sq_and_rq: bool = False,
+    return_per_class: bool = False,
+) -> Array:
+    """Panoptic Quality for panoptic segmentations (reference ``functional/detection/panoptic_quality.py:24``).
+
+    >>> import jax.numpy as jnp
+    >>> preds = jnp.array([[[[6, 0], [0, 0], [6, 0], [6, 0]],
+    ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+    ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+    ...                     [[0, 0], [7, 0], [6, 0], [1, 0]],
+    ...                     [[0, 0], [7, 0], [7, 0], [7, 0]]]])
+    >>> target = jnp.array([[[[6, 0], [0, 1], [6, 0], [0, 1]],
+    ...                      [[0, 1], [0, 1], [6, 0], [0, 1]],
+    ...                      [[0, 1], [0, 1], [6, 0], [1, 0]],
+    ...                      [[0, 1], [7, 0], [1, 0], [1, 0]],
+    ...                      [[0, 1], [7, 0], [7, 0], [7, 0]]]])
+    >>> panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})
+    Array(0.5463, dtype=float32)
+    """
+    from metrics_tpu.detection.panoptic_quality import PanopticQuality
+
+    metric = PanopticQuality(
+        things=set(things),
+        stuffs=set(stuffs),
+        allow_unknown_preds_category=allow_unknown_preds_category,
+        return_sq_and_rq=return_sq_and_rq,
+        return_per_class=return_per_class,
+    )
+    metric.update(preds, target)
+    return metric.compute()
+
+
+def modified_panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    return_sq_and_rq: bool = False,
+    return_per_class: bool = False,
+) -> Array:
+    """Modified Panoptic Quality (reference ``functional/detection/_panoptic_quality.py`` modified variant)."""
+    from metrics_tpu.detection.panoptic_quality import ModifiedPanopticQuality
+
+    metric = ModifiedPanopticQuality(
+        things=set(things),
+        stuffs=set(stuffs),
+        allow_unknown_preds_category=allow_unknown_preds_category,
+        return_sq_and_rq=return_sq_and_rq,
+        return_per_class=return_per_class,
+    )
+    metric.update(preds, target)
+    return metric.compute()

@@ -1,6 +1,7 @@
 """Functional image metrics (reference ``torchmetrics/functional/image/__init__.py``)."""
 
 from metrics_tpu.functional.image.metrics import (
+    image_gradients,
     error_relative_global_dimensionless_synthesis,
     peak_signal_noise_ratio_with_blocked_effect,
     quality_with_no_reference,
@@ -32,6 +33,7 @@ __all__ = [
     "spatial_distortion_index",
     "spectral_angle_mapper",
     "spectral_distortion_index",
+    "image_gradients",
     "structural_similarity_index_measure",
     "total_variation",
     "universal_image_quality_index",

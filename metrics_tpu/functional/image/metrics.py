@@ -399,3 +399,20 @@ def quality_with_no_reference(
     d_lambda = spectral_distortion_index(preds, target["ms"], p=norm_order)
     d_s = spatial_distortion_index(preds, target, norm_order, window_size)
     return (1 - d_lambda) ** alpha * (1 - d_s) ** beta
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Finite-difference image gradients ``(dy, dx)`` (reference ``functional/image/gradients.py:45``).
+
+    >>> import jax.numpy as jnp
+    >>> image = jnp.arange(25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    >>> dy, dx = image_gradients(image)
+    >>> dy[0, 0, 0, :]
+    Array([5., 5., 5., 5., 5.], dtype=float32)
+    """
+    img = jnp.asarray(img)
+    if img.ndim != 4:
+        raise RuntimeError(f"The size of the image tensor {img.shape} does not match (N, C, H, W)")
+    dy = jnp.pad(img[..., 1:, :] - img[..., :-1, :], ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(img[..., :, 1:] - img[..., :, :-1], ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx

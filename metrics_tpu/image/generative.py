@@ -80,11 +80,13 @@ class FrechetInceptionDistance(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if isinstance(feature, int):
-            raise ModuleNotFoundError(
-                "Integer `feature` selects the torch-fidelity InceptionV3, which needs downloaded weights"
-                " that are unavailable in this offline build. Pass a feature-extractor callable instead,"
-                " or update with precomputed feature arrays."
-            )
+            # the reference's default path (torch-fidelity InceptionV3, fid.py:30-45):
+            # resolved against LOCAL weights via the hub — raises a clear error if absent
+            from metrics_tpu.models.hub import load_feature_extractor
+
+            if num_features is None:
+                num_features = feature
+            feature = load_feature_extractor("inception_v3_fid", feature=feature)
         self.feature_extractor = feature
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
@@ -92,8 +94,12 @@ class FrechetInceptionDistance(Metric):
         if not isinstance(normalize, bool):
             raise ValueError("Argument `normalize` expected to be a bool")
         self.normalize = normalize
-        self._num_features = num_features
         self._initialized = False
+        if num_features is not None:
+            # declared feature dimension: initialize states eagerly so the metric
+            # is mergeable/serializable before the first update, and mismatched
+            # extractor outputs fail loudly in _update_features' shape check
+            self._init_states(int(num_features))
 
     def _init_states(self, d: int) -> None:
         self.add_state("real_features_sum", jnp.zeros(d), "sum")
@@ -121,6 +127,12 @@ class FrechetInceptionDistance(Metric):
             raise ValueError(f"Expected features to be 2d (N, D) but got shape {feats.shape}")
         if not self._initialized:
             self._init_states(feats.shape[1])
+        expected = self._state["real_features_sum"].shape[0]
+        if feats.shape[1] != expected:
+            raise ValueError(
+                f"Expected features of dimension {expected} (from `num_features`/first update)"
+                f" but the extractor returned dimension {feats.shape[1]}"
+            )
         key = "real" if real else "fake"
         # INCREMENTAL accumulation on the registered states: merge_state/sync/forward
         # combine these like any other sum state (float32 on device; the float64
@@ -192,10 +204,9 @@ class KernelInceptionDistance(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if isinstance(feature, int):
-            raise ModuleNotFoundError(
-                "Integer `feature` needs downloaded InceptionV3 weights (unavailable offline)."
-                " Pass a feature-extractor callable or precomputed features."
-            )
+            from metrics_tpu.models.hub import load_feature_extractor
+
+            feature = load_feature_extractor("inception_v3_fid", feature=feature)
         self.feature_extractor = feature
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")

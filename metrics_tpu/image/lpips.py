@@ -71,11 +71,16 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        if net_type not in ("alex", "vgg", "squeeze"):
+            raise ValueError(f"Argument `net_type` must be one of 'alex', 'vgg', 'squeeze', but got {net_type}")
+        self._scorer: Optional[Callable] = None
         if net is None:
-            raise ModuleNotFoundError(
-                f"The pretrained '{net_type}' backbone requires downloaded weights, unavailable in this"
-                " offline build. Pass `net=<callable returning per-layer features>` instead."
-            )
+            # default path = named backbone resolved against local weights (the
+            # reference vendors lin heads + downloads torchvision towers,
+            # functional/image/lpips.py:63-150); raises a clear error if absent
+            from metrics_tpu.models.hub import load_lpips
+
+            self._scorer = load_lpips(net_type)
         self.net = net
         if reduction not in ("mean", "sum"):
             raise ValueError(f"Argument `reduction` must be one of 'sum' or 'mean' but got {reduction}")
@@ -86,6 +91,11 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
 
     def update(self, img1: Array, img2: Array) -> None:
         """Update with a pair of image batches."""
+        if self._scorer is not None:
+            d = self._scorer(img1, img2, self.normalize)
+            self.sum_scores = self.sum_scores + d.sum()
+            self.total = self.total + d.shape[0]
+            return
         if self.normalize:
             img1 = 2 * img1 - 1
             img2 = 2 * img2 - 1

@@ -1,13 +1,52 @@
-"""Model backbones for model-based metrics.
+"""Model backbones for model-based metrics — native flax, local weights only.
 
 The reference reaches its backbones through torch-fidelity / torchvision /
-transformers downloads (SURVEY §2.9); this build keeps backbones **injectable**
-(every model-based metric takes a callable) and ships a small flax feature CNN
-for testing the injection path end-to-end. Pretrained flax ports (InceptionV3
-for FID/KID/IS, VGG/Alex for LPIPS, CLIP for CLIPScore) slot in here when their
-weights are present locally — see ``load_feature_extractor``.
+transformers downloads (SURVEY §2.9); this build ships native flax ports and a
+zero-egress loader hub:
+
+* :class:`InceptionV3FID` — full FID InceptionV3 (taps 64/192/768/2048/logits)
+  for FID/KID/IS/MiFID, with a torch-state-dict converter;
+* :class:`VGG16Features` / :class:`AlexNetFeatures` + LPIPS lin heads;
+* HF Flax CLIP / text encoders resolved from local checkpoint directories;
+* :func:`load_feature_extractor` / :func:`load_lpips` / :func:`load_clip` /
+  :func:`load_text_encoder` — the local-weights resolution layer.
+
+Every model-based metric also accepts injected callables, so the metric math is
+usable with any user model.
 """
 
-from metrics_tpu.models.simple_cnn import SimpleFeatureCNN, load_feature_extractor
+from metrics_tpu.models.hub import (
+    load_clip,
+    load_feature_extractor,
+    load_lpips,
+    load_text_encoder,
+)
+from metrics_tpu.models.inception_v3 import (
+    InceptionV3FID,
+    convert_torch_state_dict,
+    init_inception_params,
+    make_feature_extractor,
+)
+from metrics_tpu.models.lpips_nets import (
+    AlexNetFeatures,
+    VGG16Features,
+    build_lpips,
+    init_lpips,
+)
+from metrics_tpu.models.simple_cnn import SimpleFeatureCNN
 
-__all__ = ["SimpleFeatureCNN", "load_feature_extractor"]
+__all__ = [
+    "AlexNetFeatures",
+    "InceptionV3FID",
+    "SimpleFeatureCNN",
+    "VGG16Features",
+    "build_lpips",
+    "convert_torch_state_dict",
+    "init_inception_params",
+    "init_lpips",
+    "load_clip",
+    "load_feature_extractor",
+    "load_lpips",
+    "load_text_encoder",
+    "make_feature_extractor",
+]

@@ -1,0 +1,187 @@
+"""Local-weights backbone hub: resolve named pretrained models without egress.
+
+The reference downloads its backbones at first use (torch-fidelity InceptionV3,
+torchvision VGG/Alex, HF CLIP/BERT — SURVEY §2.9). This build never downloads:
+:func:`load_feature_extractor` resolves a name against a local weights directory
+(``weights_dir`` argument or ``METRICS_TPU_WEIGHTS`` env var) and returns ready
+callables. Accepted on-disk formats per name:
+
+============================  =====================================================
+name                          files searched in the weights dir
+============================  =====================================================
+``inception_v3_fid``          ``inception_v3_fid.msgpack`` (flax) or ``pt_inception*.pth`` /
+                              ``inception_v3_fid.pth`` (torch state dict → converted)
+``vgg16_lpips`` /             ``<name>.msgpack`` or torchvision ``vgg16.pth`` /
+``alexnet_lpips``             ``alexnet.pth`` + LPIPS ``lpips_vgg.pth`` / ``lpips_alex.pth``
+``clip-vit-base-patch16`` …   a HF checkpoint directory of that name (Flax CLIP)
+``bert-*`` / ``roberta-*`` …  a HF checkpoint directory of that name (Flax AutoModel)
+============================  =====================================================
+
+torch state dicts are read with the baked-in CPU torch; msgpack with flax
+serialization. Every model-based metric ALSO accepts an injected callable, so
+nothing below is required to use the metric math.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional, Tuple
+
+__all__ = ["load_feature_extractor", "load_clip", "load_text_encoder", "resolve_weights_dir"]
+
+
+def resolve_weights_dir(weights_dir: Optional[str] = None) -> Optional[str]:
+    return weights_dir or os.environ.get("METRICS_TPU_WEIGHTS")
+
+
+def _missing(name: str, looked_for: str) -> "ModuleNotFoundError":
+    return ModuleNotFoundError(
+        f"Pretrained backbone {name!r} needs local weights ({looked_for}) in the directory given by"
+        " `weights_dir` or the METRICS_TPU_WEIGHTS env var. This offline build never downloads;"
+        " model-based metrics also accept any injected callable instead."
+    )
+
+
+def _load_torch_sd(path: str):
+    import torch
+
+    return torch.load(path, map_location="cpu")
+
+
+def _find(weights_dir: str, *candidates: str) -> Optional[str]:
+    import glob
+
+    for c in candidates:
+        hits = sorted(glob.glob(os.path.join(weights_dir, c)))
+        if hits:
+            return hits[0]
+    return None
+
+
+def load_feature_extractor(
+    name: str, weights_dir: Optional[str] = None, feature: Any = 2048
+) -> Callable:
+    """Resolve a named image backbone into a pure ``images → features`` callable."""
+    weights_dir = resolve_weights_dir(weights_dir)
+    if name in ("inception_v3_fid", "inception-v3-compat", "inception_v3"):
+        from metrics_tpu.models.inception_v3 import convert_torch_state_dict, make_feature_extractor
+
+        if not weights_dir:
+            raise _missing(name, "inception_v3_fid.msgpack or pt_inception*.pth")
+        msgpack = _find(weights_dir, "inception_v3_fid.msgpack")
+        if msgpack:
+            variables = _read_msgpack_variables(msgpack)
+            return make_feature_extractor(variables, feature)
+        pth = _find(weights_dir, "pt_inception*.pth", "inception_v3_fid.pth", "inception*.pth")
+        if pth:
+            variables = convert_torch_state_dict(_load_torch_sd(pth))
+            return make_feature_extractor(variables, feature)
+        raise _missing(name, "inception_v3_fid.msgpack or pt_inception*.pth")
+    if name in ("vgg16_lpips", "alexnet_lpips", "vgg", "alex"):
+        net_type = "vgg" if "vgg" in name else "alex"
+        score = load_lpips(net_type, weights_dir)
+        return score
+    if name == "simple_cnn":
+        from metrics_tpu.models.simple_cnn import SimpleFeatureCNN
+
+        return SimpleFeatureCNN().bind_apply()
+    raise ValueError(f"Unknown backbone name {name!r}")
+
+
+def _read_msgpack_variables(path: str):
+    from flax.serialization import msgpack_restore
+
+    with open(path, "rb") as fh:
+        return msgpack_restore(fh.read())
+
+
+def load_lpips(net_type: str, weights_dir: Optional[str] = None) -> Callable:
+    """Resolve an LPIPS scorer ``(img1, img2, normalize=False) → (N,)`` for vgg/alex."""
+    from metrics_tpu.models.lpips_nets import (
+        build_lpips,
+        convert_torch_backbone,
+        convert_torch_lin,
+    )
+
+    weights_dir = resolve_weights_dir(weights_dir)
+    if not weights_dir:
+        raise _missing(f"{net_type}_lpips", f"{net_type}*.pth + lpips_{net_type}.pth")
+    backbone_name = {"vgg": "vgg16", "alex": "alexnet", "squeeze": "squeezenet1_1"}[net_type]
+    backbone_pth = _find(weights_dir, f"{backbone_name}*.pth", f"{net_type}_backbone.pth")
+    lin_pth = _find(weights_dir, f"lpips_{net_type}.pth", f"{net_type}_lin.pth", f"{net_type}.pth")
+    if not backbone_pth or not lin_pth:
+        raise _missing(f"{net_type}_lpips", f"{net_type} backbone .pth + lin .pth")
+    variables = convert_torch_backbone(_load_torch_sd(backbone_pth), net_type)
+    lin = convert_torch_lin(_load_torch_sd(lin_pth))
+    return build_lpips(net_type, variables, lin)
+
+
+def load_clip(
+    model_name_or_path: str, weights_dir: Optional[str] = None
+) -> Tuple[Callable, Callable]:
+    """Resolve a local HF CLIP checkpoint into (image_encoder, text_encoder) callables.
+
+    Uses the transformers Flax CLIP classes against a LOCAL directory only —
+    ``<weights_dir>/<basename>`` or an absolute path (reference call site:
+    ``multimodal/clip_score.py:30``).
+    """
+    path = model_name_or_path
+    if not os.path.isdir(path):
+        weights_dir = resolve_weights_dir(weights_dir)
+        candidate = os.path.join(weights_dir, os.path.basename(model_name_or_path)) if weights_dir else None
+        if candidate and os.path.isdir(candidate):
+            path = candidate
+        else:
+            raise _missing(model_name_or_path, "a local HF CLIP checkpoint directory")
+    import jax.numpy as jnp
+    from transformers import AutoProcessor, FlaxCLIPModel
+
+    model = FlaxCLIPModel.from_pretrained(path, local_files_only=True)
+    processor = AutoProcessor.from_pretrained(path, local_files_only=True)
+
+    def image_encoder(images):
+        import numpy as np
+
+        arr = [np.asarray(i) for i in images] if isinstance(images, (list, tuple)) else np.asarray(images)
+        inputs = processor(images=list(arr) if isinstance(arr, list) else [a for a in arr], return_tensors="np")
+        return jnp.asarray(model.get_image_features(pixel_values=jnp.asarray(inputs["pixel_values"])))
+
+    def text_encoder(texts):
+        inputs = processor(text=list(texts), return_tensors="np", padding=True, truncation=True)
+        return jnp.asarray(
+            model.get_text_features(
+                input_ids=jnp.asarray(inputs["input_ids"]),
+                attention_mask=jnp.asarray(inputs["attention_mask"]),
+            )
+        )
+
+    return image_encoder, text_encoder
+
+
+def load_text_encoder(model_name_or_path: str, weights_dir: Optional[str] = None) -> Callable:
+    """Resolve a local HF encoder checkpoint into a ``texts → list[(L_i, D)]`` callable.
+
+    The BERTScore default path (reference ``text/bert.py:55``) via Flax AutoModel;
+    per-text embeddings are trimmed to real (non-padding) tokens.
+    """
+    path = model_name_or_path
+    if not os.path.isdir(path):
+        weights_dir = resolve_weights_dir(weights_dir)
+        candidate = os.path.join(weights_dir, os.path.basename(model_name_or_path)) if weights_dir else None
+        if candidate and os.path.isdir(candidate):
+            path = candidate
+        else:
+            raise _missing(model_name_or_path, "a local HF encoder checkpoint directory")
+    import numpy as np
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    model = FlaxAutoModel.from_pretrained(path, local_files_only=True)
+    tokenizer = AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+    def encoder(texts):
+        batch = tokenizer(list(texts), return_tensors="np", padding=True, truncation=True)
+        out = model(**{k: batch[k] for k in ("input_ids", "attention_mask")}).last_hidden_state
+        out = np.asarray(out)
+        return [out[i, batch["attention_mask"][i].astype(bool)] for i in range(out.shape[0])]
+
+    return encoder

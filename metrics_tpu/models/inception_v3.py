@@ -1,0 +1,282 @@
+"""Flax InceptionV3, FID variant — the default backbone for FID/KID/IS/MiFID.
+
+The reference obtains this network from the ``torch-fidelity`` package
+(``/root/reference/src/torchmetrics/image/fid.py:30-45``: ``NoTrainInceptionV3``
+with feature taps ``64 | 192 | 768 | 2048 | logits_unbiased``). Here the
+architecture is implemented natively in flax from the published FID network
+definition (TF-slim InceptionV3 with the FID-specific pooling quirks):
+
+* all convolutions are bias-free and followed by BatchNorm(eps=1e-3) + ReLU;
+* InceptionA/C use average pooling that EXCLUDES padding from the divisor
+  (``count_include_pad=False`` semantics);
+* the two InceptionE blocks differ: Mixed_7b pools with the padding-excluding
+  average, Mixed_7c uses MAX pooling — the known quirk of the original FID
+  weights;
+* inputs are uint8-range images resized to 299×299 (bilinear) and scaled to
+  roughly [-1, 1] with the FID normalization ``(x - 128) / 128``.
+
+Module names mirror the torch-fidelity state-dict layout 1:1 so that
+:func:`convert_torch_state_dict` is a mechanical rename — point it at a local
+``pt_inception-2015-12-05`` checkpoint and the port runs with the real FID
+weights (no downloads happen here; SURVEY §2.9's zero-egress constraint).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+import flax.linen as nn
+
+FEATURE_DIMS = {64: 64, 192: 192, 768: 768, 2048: 2048}
+
+
+class BasicConv2d(nn.Module):
+    """Conv (no bias) + BatchNorm(eps=1e-3) + ReLU, NHWC."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = (0, 0)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        pad = self.padding
+        if isinstance(pad, tuple) and isinstance(pad[0], int):
+            pad = [(pad[0], pad[0]), (pad[1], pad[1])]
+        x = nn.Conv(self.features, self.kernel, strides=self.strides, padding=pad, use_bias=False, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, name="bn")(x)
+        return nn.relu(x)
+
+
+def _avg_pool_nopad(x: Array, window: int = 3) -> Array:
+    """3×3 stride-1 average pool with pad excluded from the divisor (FID quirk)."""
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    summed = nn.avg_pool(x, (window, window), strides=(1, 1), padding=[(1, 1), (1, 1)], count_include_pad=True)
+    counts = nn.avg_pool(ones, (window, window), strides=(1, 1), padding=[(1, 1), (1, 1)], count_include_pad=True)
+    return summed / counts
+
+
+class FIDInceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(64, (1, 1), name="branch1x1")(x)
+        b5 = BasicConv2d(48, (1, 1), name="branch5x5_1")(x)
+        b5 = BasicConv2d(64, (5, 5), padding=(2, 2), name="branch5x5_2")(b5)
+        b3 = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+        b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(b3)
+        b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_3")(b3)
+        bp = _avg_pool_nopad(x)
+        bp = BasicConv2d(self.pool_features, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class FIDInceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = BasicConv2d(384, (3, 3), strides=(2, 2), name="branch3x3")(x)
+        bd = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+        bd = BasicConv2d(96, (3, 3), strides=(2, 2), name="branch3x3dbl_3")(bd)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class FIDInceptionC(nn.Module):
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        c7 = self.channels_7x7
+        b1 = BasicConv2d(192, (1, 1), name="branch1x1")(x)
+        b7 = BasicConv2d(c7, (1, 1), name="branch7x7_1")(x)
+        b7 = BasicConv2d(c7, (1, 7), padding=(0, 3), name="branch7x7_2")(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=(3, 0), name="branch7x7_3")(b7)
+        bd = BasicConv2d(c7, (1, 1), name="branch7x7dbl_1")(x)
+        bd = BasicConv2d(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_2")(bd)
+        bd = BasicConv2d(c7, (1, 7), padding=(0, 3), name="branch7x7dbl_3")(bd)
+        bd = BasicConv2d(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_4")(bd)
+        bd = BasicConv2d(192, (1, 7), padding=(0, 3), name="branch7x7dbl_5")(bd)
+        bp = _avg_pool_nopad(x)
+        bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class FIDInceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = BasicConv2d(192, (1, 1), name="branch3x3_1")(x)
+        b3 = BasicConv2d(320, (3, 3), strides=(2, 2), name="branch3x3_2")(b3)
+        b7 = BasicConv2d(192, (1, 1), name="branch7x7x3_1")(x)
+        b7 = BasicConv2d(192, (1, 7), padding=(0, 3), name="branch7x7x3_2")(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=(3, 0), name="branch7x7x3_3")(b7)
+        b7 = BasicConv2d(192, (3, 3), strides=(2, 2), name="branch7x7x3_4")(b7)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class FIDInceptionE(nn.Module):
+    """Mixed_7b (pool="avg", padding-excluding) / Mixed_7c (pool="max")."""
+
+    pool: str = "avg"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(320, (1, 1), name="branch1x1")(x)
+        b3 = BasicConv2d(384, (1, 1), name="branch3x3_1")(x)
+        b3a = BasicConv2d(384, (1, 3), padding=(0, 1), name="branch3x3_2a")(b3)
+        b3b = BasicConv2d(384, (3, 1), padding=(1, 0), name="branch3x3_2b")(b3)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        bd = BasicConv2d(448, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv2d(384, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+        bda = BasicConv2d(384, (1, 3), padding=(0, 1), name="branch3x3dbl_3a")(bd)
+        bdb = BasicConv2d(384, (3, 1), padding=(1, 0), name="branch3x3dbl_3b")(bd)
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+        if self.pool == "avg":
+            bp = _avg_pool_nopad(x)
+        else:
+            bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
+        bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class _FC(nn.Module):
+    """Final classifier exposing bias-free logits (torch-fidelity 'logits_unbiased')."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, Array]:
+        kernel = self.param("kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.num_classes))
+        bias = self.param("bias", nn.initializers.zeros, (self.num_classes,))
+        unbiased = x @ kernel
+        return unbiased, unbiased + bias
+
+
+class InceptionV3FID(nn.Module):
+    """Full FID InceptionV3; ``__call__`` returns the requested feature taps.
+
+    Taps (torch-fidelity names): ``64`` after the first maxpool, ``192`` after
+    the second, ``768`` after Mixed_6e, ``2048`` after global average pooling,
+    ``"logits_unbiased"`` = final fc without bias.
+    """
+
+    num_classes: int = 1008
+
+    @nn.compact
+    def __call__(self, x: Array, features: Sequence[Any] = (2048,)) -> Dict[Any, Array]:
+        # x: (N, 3, H, W) in [0, 255]; resize + FID normalization
+        x = jnp.transpose(x.astype(jnp.float32), (0, 2, 3, 1))
+        x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear")
+        x = (x - 128.0) / 128.0
+
+        out: Dict[Any, Array] = {}
+        x = BasicConv2d(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
+        x = BasicConv2d(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = BasicConv2d(64, (3, 3), padding=(1, 1), name="Conv2d_2b_3x3")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        if 64 in features:
+            out[64] = x.transpose(0, 3, 1, 2)
+        x = BasicConv2d(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = BasicConv2d(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        if 192 in features:
+            out[192] = x.transpose(0, 3, 1, 2)
+        x = FIDInceptionA(32, name="Mixed_5b")(x)
+        x = FIDInceptionA(64, name="Mixed_5c")(x)
+        x = FIDInceptionA(64, name="Mixed_5d")(x)
+        x = FIDInceptionB(name="Mixed_6a")(x)
+        x = FIDInceptionC(128, name="Mixed_6b")(x)
+        x = FIDInceptionC(160, name="Mixed_6c")(x)
+        x = FIDInceptionC(160, name="Mixed_6d")(x)
+        x = FIDInceptionC(192, name="Mixed_6e")(x)
+        if 768 in features:
+            out[768] = x.transpose(0, 3, 1, 2)
+        x = FIDInceptionD(name="Mixed_7a")(x)
+        x = FIDInceptionE(pool="avg", name="Mixed_7b")(x)
+        x = FIDInceptionE(pool="max", name="Mixed_7c")(x)
+        x = x.mean(axis=(1, 2))  # global average pool → (N, 2048)
+        if 2048 in features:
+            out[2048] = x
+        if "logits_unbiased" in features or "logits" in features:
+            unbiased, logits = _FC(self.num_classes, name="fc")(x)
+            if "logits" in features:
+                out["logits"] = logits
+            if "logits_unbiased" in features:
+                out["logits_unbiased"] = unbiased
+        return out
+
+
+def init_inception_params(rng_seed: int = 0) -> Dict:
+    """Random-init parameter tree (for offline testing; real weights via converter)."""
+    model = InceptionV3FID()
+    variables = model.init(
+        jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 299, 299)), features=(64, 192, 768, 2048, "logits_unbiased")
+    )
+    return variables
+
+
+def make_feature_extractor(variables: Dict, feature: Any = 2048):
+    """Pure jitted ``images (N,3,H,W) → features`` callable for one tap."""
+    model = InceptionV3FID()
+
+    @jax.jit
+    def extract(imgs: Array) -> Array:
+        feats = model.apply(variables, imgs, features=(feature,))
+        f = feats[feature]
+        if f.ndim == 4:  # spatial taps → global average pool like torch-fidelity
+            f = f.mean(axis=(2, 3))
+        return f
+
+    return extract
+
+
+def convert_torch_state_dict(state_dict: Dict[str, "np.ndarray"]) -> Dict:
+    """Convert a torch-fidelity/pytorch-fid InceptionV3 state dict to flax variables.
+
+    Accepts ``{name: ndarray}`` (call ``.numpy()`` on torch tensors first, or pass
+    a ``torch.load(..., map_location='cpu')`` result — tensors are converted).
+    Layout mapping: ``<block>.<branch>.conv.weight`` (O,I,kH,kW) → flax
+    ``params/<block>/<branch>/conv/kernel`` (kH,kW,I,O); BatchNorm
+    weight/bias/running_mean/running_var → scale/bias + batch_stats mean/var;
+    ``fc.weight`` (O,I) → ``fc/kernel`` (I,O).
+    """
+    params: Dict = {}
+    batch_stats: Dict = {}
+
+    def _np(v):
+        return v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+
+    def _set(tree, path, value):
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = jnp.asarray(value)
+
+    for name, value in state_dict.items():
+        arr = _np(value)
+        parts = name.split(".")
+        if parts[-2:] == ["conv", "weight"]:
+            _set(params, parts[:-1] + ["kernel"], np.transpose(arr, (2, 3, 1, 0)))
+        elif parts[-2] == "bn":
+            leaf = parts[-1]
+            if leaf == "weight":
+                _set(params, parts[:-1] + ["scale"], arr)
+            elif leaf == "bias":
+                _set(params, parts[:-1] + ["bias"], arr)
+            elif leaf == "running_mean":
+                _set(batch_stats, parts[:-1] + ["mean"], arr)
+            elif leaf == "running_var":
+                _set(batch_stats, parts[:-1] + ["var"], arr)
+        elif parts == ["fc", "weight"]:
+            _set(params, ["fc", "kernel"], arr.T)
+        elif parts == ["fc", "bias"]:
+            _set(params, ["fc", "bias"], arr)
+        # num_batches_tracked and aux-logits entries are dropped
+    return {"params": params, "batch_stats": batch_stats}

@@ -1,0 +1,239 @@
+"""Flax VGG16 / AlexNet feature towers + LPIPS linear heads.
+
+The reference builds these from torchvision checkpoints plus vendored 1×1 "lin"
+head weights (``/root/reference/src/torchmetrics/functional/image/lpips.py:63-150``,
+``lpips_models/{alex,vgg}.pth``). Here both towers are native flax with the five
+canonical LPIPS tap points; :func:`convert_torch_backbone` /
+:func:`convert_torch_lin` turn locally-available torch state dicts (torchvision
+layout / LPIPS lin layout) into flax params — no downloads.
+
+LPIPS pipeline (as published): normalize input with the fixed shift/scale,
+run the tower, unit-normalize each tap across channels, square the difference,
+apply the 1×1 lin head (non-negative weights), average spatially, sum taps.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+import flax.linen as nn
+
+# fixed input normalization constants from the published LPIPS implementation
+_SHIFT = np.asarray([-0.030, -0.088, -0.188], dtype=np.float32)
+_SCALE = np.asarray([0.458, 0.448, 0.450], dtype=np.float32)
+
+VGG16_TAPS = (64, 128, 256, 512, 512)
+ALEX_TAPS = (64, 192, 384, 256, 256)
+SQUEEZE_TAPS = (64, 128, 256, 384, 384, 512, 512)
+
+
+class VGG16Features(nn.Module):
+    """torchvision-layout VGG16 ``features`` trunk, taps after relu{1_2,2_2,3_3,4_3,5_3}.
+
+    Layer indices in the torchvision Sequential (0-30) are used as flax module
+    names (``conv_<idx>``) so weight conversion is mechanical.
+    """
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        cfg = [  # (sequential_idx, out_channels) per conv; 'M' = maxpool
+            (0, 64), (2, 64), "M",
+            (5, 128), (7, 128), "M",
+            (10, 256), (12, 256), (14, 256), "M",
+            (17, 512), (19, 512), (21, 512), "M",
+            (24, 512), (26, 512), (28, 512),
+        ]
+        tap_after = {2, 7, 14, 21, 28}
+        taps: List[Array] = []
+        for item in cfg:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                continue
+            idx, ch = item
+            x = nn.Conv(ch, (3, 3), padding=[(1, 1), (1, 1)], name=f"conv_{idx}")(x)
+            x = nn.relu(x)
+            if idx in tap_after:
+                taps.append(x)
+        return taps
+
+
+class AlexNetFeatures(nn.Module):
+    """torchvision-layout AlexNet ``features`` trunk, taps after each of the 5 ReLUs."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        taps: List[Array] = []
+        x = nn.Conv(64, (11, 11), strides=(4, 4), padding=[(2, 2), (2, 2)], name="conv_0")(x)
+        x = nn.relu(x)
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(192, (5, 5), padding=[(2, 2), (2, 2)], name="conv_3")(x)
+        x = nn.relu(x)
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding=[(1, 1), (1, 1)], name="conv_6")(x)
+        x = nn.relu(x)
+        taps.append(x)
+        x = nn.Conv(256, (3, 3), padding=[(1, 1), (1, 1)], name="conv_8")(x)
+        x = nn.relu(x)
+        taps.append(x)
+        x = nn.Conv(256, (3, 3), padding=[(1, 1), (1, 1)], name="conv_10")(x)
+        x = nn.relu(x)
+        taps.append(x)
+        return taps
+
+
+class _Fire(nn.Module):
+    """SqueezeNet fire module: 1×1 squeeze → relu → (1×1 ∥ 3×3) expand → relu."""
+
+    squeeze: int
+    expand: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = nn.relu(nn.Conv(self.squeeze, (1, 1), name="squeeze")(x))
+        e1 = nn.relu(nn.Conv(self.expand, (1, 1), name="expand1x1")(x))
+        e3 = nn.relu(nn.Conv(self.expand, (3, 3), padding=[(1, 1), (1, 1)], name="expand3x3")(x))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNetFeatures(nn.Module):
+    """torchvision SqueezeNet-1.1 ``features`` trunk with the 7 LPIPS tap points."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        taps: List[Array] = []
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(2, 2), name="conv_0")(x))
+        taps.append(x)  # 64
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _Fire(16, 64, name="fire_3")(x)
+        x = _Fire(16, 64, name="fire_4")(x)
+        taps.append(x)  # 128
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _Fire(32, 128, name="fire_6")(x)
+        x = _Fire(32, 128, name="fire_7")(x)
+        taps.append(x)  # 256
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _Fire(48, 192, name="fire_9")(x)
+        taps.append(x)  # 384
+        x = _Fire(48, 192, name="fire_10")(x)
+        taps.append(x)  # 384
+        x = _Fire(64, 256, name="fire_11")(x)
+        taps.append(x)  # 512
+        x = _Fire(64, 256, name="fire_12")(x)
+        taps.append(x)  # 512
+        return taps
+
+
+def _net_for(net_type: str) -> nn.Module:
+    if net_type == "vgg":
+        return VGG16Features()
+    if net_type == "squeeze":
+        return SqueezeNetFeatures()
+    return AlexNetFeatures()
+
+
+def _unit_normalize(x: Array, eps: float = 1e-10) -> Array:
+    norm = jnp.sqrt(jnp.sum(x**2, axis=-1, keepdims=True))
+    return x / (norm + eps)
+
+
+def lpips_score(
+    net_apply: Callable[[Array], List[Array]],
+    lin_weights: Sequence[Array],
+    img1: Array,
+    img2: Array,
+    normalize: bool = False,
+) -> Array:
+    """Per-pair LPIPS distance from a tower and its lin-head weights.
+
+    ``img*``: (N, 3, H, W); [-1, 1] by default, [0, 1] with ``normalize=True``.
+    ``lin_weights[i]``: (C_i,) non-negative 1×1 head for tap i.
+    """
+    if normalize:
+        img1 = 2 * img1 - 1
+        img2 = 2 * img2 - 1
+    shift = jnp.asarray(_SHIFT).reshape(1, 3, 1, 1)
+    scale = jnp.asarray(_SCALE).reshape(1, 3, 1, 1)
+    a = jnp.transpose((img1 - shift) / scale, (0, 2, 3, 1))  # NHWC
+    b = jnp.transpose((img2 - shift) / scale, (0, 2, 3, 1))
+    feats_a = net_apply(a)
+    feats_b = net_apply(b)
+    total = 0.0
+    for fa, fb, w in zip(feats_a, feats_b, lin_weights):
+        diff = (_unit_normalize(fa) - _unit_normalize(fb)) ** 2
+        weighted = (diff * w.reshape(1, 1, 1, -1)).sum(-1)  # 1x1 conv, no bias
+        total = total + weighted.mean(axis=(1, 2))
+    return total
+
+
+def build_lpips(net_type: str, variables: Dict, lin_weights: Sequence[Array]) -> Callable:
+    """Jitted ``(img1, img2, normalize) → (N,) distances`` for a tower + heads."""
+    net = _net_for(net_type)
+
+    def apply_tower(x: Array) -> List[Array]:
+        return net.apply(variables, x)
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=2)
+    def score(img1: Array, img2: Array, normalize: bool = False) -> Array:
+        return lpips_score(apply_tower, lin_weights, img1, img2, normalize)
+
+    return score
+
+
+def init_lpips(net_type: str, rng_seed: int = 0) -> Tuple[Dict, List[Array]]:
+    """Random-init tower + uniform lin heads (offline testing; real weights via converters)."""
+    net = _net_for(net_type)
+    taps = {"vgg": VGG16_TAPS, "squeeze": SQUEEZE_TAPS}.get(net_type, ALEX_TAPS)
+    variables = net.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 64, 64, 3)))
+    lin = [jnp.ones(c) / c for c in taps]
+    return variables, lin
+
+
+def convert_torch_backbone(state_dict: Dict[str, "np.ndarray"], net_type: str) -> Dict:
+    """torchvision ``features.*`` state dicts → flax params.
+
+    vgg/alex: ``features.<idx>.weight/bias`` → ``conv_<idx>/kernel|bias``;
+    squeeze (SqueezeNet-1.1): ``features.<idx>.<sub>.weight`` →
+    ``fire_<idx>/<sub>/kernel`` (sub ∈ squeeze|expand1x1|expand3x3), plus the
+    stem ``features.0`` → ``conv_0``.
+    """
+    params: Dict = {}
+
+    def _np(v):
+        return v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+
+    for name, value in state_dict.items():
+        parts = name.split(".")
+        if parts[0] == "features":
+            parts = parts[1:]
+        if parts[-1] not in ("weight", "bias"):
+            continue
+        arr = _np(value)
+        leaf = "kernel" if parts[-1] == "weight" else "bias"
+        val = jnp.asarray(np.transpose(arr, (2, 3, 1, 0)) if arr.ndim == 4 else arr)
+        if len(parts) == 2:
+            params.setdefault(f"conv_{parts[0]}", {})[leaf] = val
+        elif len(parts) == 3:  # squeeze fire module
+            params.setdefault(f"fire_{parts[0]}", {}).setdefault(parts[1], {})[leaf] = val
+    return {"params": params}
+
+
+def convert_torch_lin(state_dict: Dict[str, "np.ndarray"]) -> List[Array]:
+    """LPIPS lin-head state dict (``lin<i>.model.1.weight`` (1,C,1,1)) → list of (C,) arrays."""
+
+    def _np(v):
+        return v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+
+    out = []
+    for i in range(len([k for k in state_dict if ".weight" in k])):
+        key = next(k for k in state_dict if k.startswith(f"lin{i}.") and k.endswith("weight"))
+        out.append(jnp.asarray(_np(state_dict[key]).reshape(-1)))
+    return out

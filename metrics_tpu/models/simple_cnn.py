@@ -52,23 +52,4 @@ else:  # pragma: no cover
             raise ModuleNotFoundError("SimpleFeatureCNN requires flax to be installed.")
 
 
-def load_feature_extractor(name: str, weights_dir: Optional[str] = None) -> Callable:
-    """Resolve a named pretrained backbone from a LOCAL weights directory.
-
-    No downloads happen here (no-egress build): ``weights_dir`` (or the
-    ``METRICS_TPU_WEIGHTS`` env var) must contain ``<name>.msgpack`` flax params
-    for a known architecture. Raises a clear error otherwise.
-    """
-    weights_dir = weights_dir or os.environ.get("METRICS_TPU_WEIGHTS")
-    if not weights_dir:
-        raise ModuleNotFoundError(
-            f"Pretrained backbone {name!r} needs local weights: set METRICS_TPU_WEIGHTS or pass"
-            " weights_dir. (This offline build never downloads; model-based metrics also accept"
-            " any injected callable instead.)"
-        )
-    path = os.path.join(weights_dir, f"{name}.msgpack")
-    if not os.path.exists(path):
-        raise FileNotFoundError(f"No local weights found at {path}")
-    raise NotImplementedError(
-        f"Found weights at {path}, but the {name!r} architecture port lands in the next round."
-    )
+# load_feature_extractor moved to metrics_tpu.models.hub (real architecture ports)

@@ -52,10 +52,12 @@ class CLIPScore(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if image_encoder is None or text_encoder is None:
-            raise ModuleNotFoundError(
-                f"The pretrained CLIP checkpoint {model_name_or_path!r} requires downloaded weights,"
-                " unavailable in this offline build. Pass `image_encoder=` and `text_encoder=` callables"
-                " returning embeddings."
+            # default path = local HF Flax CLIP checkpoint (reference downloads it,
+            # multimodal/clip_score.py:30); raises a clear error if absent on disk
+            from metrics_tpu.models.hub import load_clip
+
+            image_encoder, text_encoder = load_clip(
+                model_name_or_path or "openai/clip-vit-large-patch14"
             )
         self.image_encoder = image_encoder
         self.text_encoder = text_encoder
@@ -134,9 +136,10 @@ class CLIPImageQualityAssessment(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if image_encoder is None or text_encoder is None:
-            raise ModuleNotFoundError(
-                "Pretrained CLIP weights are unavailable offline. Pass `image_encoder=` and `text_encoder=`"
-                " callables returning embeddings."
+            from metrics_tpu.models.hub import load_clip
+
+            image_encoder, text_encoder = load_clip(
+                model_name_or_path or "openai/clip-vit-large-patch14"
             )
         self.image_encoder = image_encoder
         self.text_encoder = text_encoder

@@ -48,10 +48,11 @@ class BERTScore(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if encoder is None:
-            raise ModuleNotFoundError(
-                f"The pretrained checkpoint {model_name_or_path!r} requires downloaded transformers weights,"
-                " unavailable in this offline build. Pass `encoder=` returning per-token embeddings."
-            )
+            # default path = local HF Flax encoder checkpoint (reference downloads
+            # roberta-large, text/bert.py:55); raises a clear error if absent on disk
+            from metrics_tpu.models.hub import load_text_encoder
+
+            encoder = load_text_encoder(model_name_or_path or "roberta-large")
         self.encoder = encoder
         self.idf = idf
         self.rescale_with_baseline = rescale_with_baseline

@@ -1,0 +1,94 @@
+"""Dice vs the actual reference implementation (imported from the checkout)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_HAS_REF = os.path.isdir("/root/reference/src")
+if _HAS_REF:
+    for p in (os.path.join(REPO, "tests", "_ref_shim"), "/root/reference/src"):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+
+import jax.numpy as jnp  # noqa: E402
+
+from metrics_tpu.classification import Dice  # noqa: E402
+from metrics_tpu.functional.classification import dice  # noqa: E402
+
+NUM_CLASSES = 4
+
+
+def _ref_dice(preds, target, **kw):
+    import torch
+    from torchmetrics.functional.classification import dice as ref
+
+    return ref(torch.tensor(np.asarray(preds)), torch.tensor(np.asarray(target)), **kw)
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+@pytest.mark.parametrize("average", ["micro", "macro", "samples"])
+def test_dice_labels_vs_reference(average):
+    rng = np.random.RandomState(0)
+    preds = rng.randint(0, NUM_CLASSES, 64)
+    target = rng.randint(0, NUM_CLASSES, 64)
+    kw = {"average": average}
+    if average in ("macro",):
+        kw["num_classes"] = NUM_CLASSES
+    got = dice(jnp.asarray(preds), jnp.asarray(target), **kw)
+    want = _ref_dice(preds, target, **kw)
+    np.testing.assert_allclose(float(got), float(want), atol=1e-6)
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+def test_dice_multiclass_probs_topk_vs_reference():
+    rng = np.random.RandomState(1)
+    preds = rng.rand(32, NUM_CLASSES).astype(np.float32)
+    preds /= preds.sum(1, keepdims=True)
+    target = rng.randint(0, NUM_CLASSES, 32)
+    for top_k in (1, 2):
+        got = dice(jnp.asarray(preds), jnp.asarray(target), top_k=top_k, num_classes=NUM_CLASSES)
+        want = _ref_dice(preds, target, top_k=top_k, num_classes=NUM_CLASSES)
+        np.testing.assert_allclose(float(got), float(want), atol=1e-6)
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+def test_dice_mdmc_samplewise_vs_reference():
+    rng = np.random.RandomState(2)
+    preds = rng.randint(0, NUM_CLASSES, (16, 10))
+    target = rng.randint(0, NUM_CLASSES, (16, 10))
+    got = dice(jnp.asarray(preds), jnp.asarray(target), mdmc_average="samplewise", num_classes=NUM_CLASSES)
+    want = _ref_dice(preds, target, mdmc_average="samplewise", num_classes=NUM_CLASSES)
+    np.testing.assert_allclose(float(got), float(want), atol=1e-6)
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+def test_dice_ignore_index_vs_reference():
+    rng = np.random.RandomState(3)
+    preds = rng.randint(0, NUM_CLASSES, 100)
+    target = rng.randint(0, NUM_CLASSES, 100)
+    got = dice(jnp.asarray(preds), jnp.asarray(target), ignore_index=0, num_classes=NUM_CLASSES, average="micro")
+    want = _ref_dice(preds, target, ignore_index=0, num_classes=NUM_CLASSES, average="micro")
+    np.testing.assert_allclose(float(got), float(want), atol=1e-6)
+
+
+def test_dice_metric_accumulates_like_functional():
+    rng = np.random.RandomState(4)
+    batches = [(rng.randint(0, NUM_CLASSES, 32), rng.randint(0, NUM_CLASSES, 32)) for _ in range(3)]
+    m = Dice(average="micro")
+    for p, t in batches:
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    all_p = np.concatenate([p for p, _ in batches])
+    all_t = np.concatenate([t for _, t in batches])
+    np.testing.assert_allclose(
+        float(m.compute()), float(dice(jnp.asarray(all_p), jnp.asarray(all_t))), atol=1e-6
+    )
+
+
+def test_dice_validation_errors():
+    with pytest.raises(ValueError, match="average"):
+        Dice(average="bogus")
+    with pytest.raises(ValueError, match="number of classes"):
+        Dice(average="macro")

@@ -1,0 +1,247 @@
+"""Backbone ports: InceptionV3-FID, LPIPS towers, loader hub, metric default paths."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.models import (
+    AlexNetFeatures,
+    InceptionV3FID,
+    VGG16Features,
+    build_lpips,
+    convert_torch_state_dict,
+    init_inception_params,
+    init_lpips,
+    load_feature_extractor,
+    make_feature_extractor,
+)
+from metrics_tpu.models.lpips_nets import ALEX_TAPS, SQUEEZE_TAPS, VGG16_TAPS, convert_torch_lin
+
+_rng = np.random.RandomState(0)
+_REF_LPIPS = "/root/reference/src/torchmetrics/functional/image/lpips_models"
+
+
+@pytest.fixture(scope="module")
+def inception_vars():
+    return init_inception_params()
+
+
+def test_inception_tap_shapes(inception_vars):
+    """Feature taps must match torch-fidelity's exactly (fid.py:30-45 contract)."""
+    model = InceptionV3FID()
+    x = jnp.asarray(_rng.randint(0, 255, (2, 3, 299, 299)).astype(np.float32))
+    out = model.apply(inception_vars, x, features=(64, 192, 768, 2048, "logits_unbiased"))
+    assert out[64].shape == (2, 64, 73, 73)
+    assert out[192].shape == (2, 192, 35, 35)
+    assert out[768].shape == (2, 768, 17, 17)
+    assert out[2048].shape == (2, 2048)
+    assert out["logits_unbiased"].shape == (2, 1008)
+
+
+def test_inception_resizes_any_input(inception_vars):
+    ext = make_feature_extractor(inception_vars, 2048)
+    small = jnp.asarray(_rng.randint(0, 255, (3, 3, 64, 64)).astype(np.float32))
+    assert ext(small).shape == (3, 2048)
+
+
+def _flax_to_torch_layout(variables):
+    """Synthetic torch-fidelity-layout state dict from flax variables (test fixture)."""
+    sd = {}
+
+    def walk(tree, prefix, kind):
+        for k, v in tree.items():
+            p = f"{prefix}.{k}" if prefix else k
+            if isinstance(v, dict):
+                walk(v, p, kind)
+                continue
+            a = np.asarray(v)
+            if k == "kernel" and a.ndim == 4:
+                sd[p.replace(".kernel", ".weight")] = np.transpose(a, (3, 2, 0, 1))
+            elif k == "kernel":
+                sd[p.replace(".kernel", ".weight")] = a.T
+            elif k == "scale":
+                sd[p.replace(".scale", ".weight")] = a
+            elif kind == "batch_stats" and k == "mean":
+                sd[p.replace(".mean", ".running_mean")] = a
+            elif kind == "batch_stats" and k == "var":
+                sd[p.replace(".var", ".running_var")] = a
+            else:
+                sd[p] = a
+
+    walk(variables["params"], "", "params")
+    walk(variables["batch_stats"], "", "batch_stats")
+    return sd
+
+
+def test_inception_torch_state_dict_converter_roundtrip(inception_vars):
+    model = InceptionV3FID()
+    x = jnp.asarray(_rng.randint(0, 255, (2, 3, 128, 128)).astype(np.float32))
+    want = model.apply(inception_vars, x, features=(2048,))[2048]
+    converted = convert_torch_state_dict(_flax_to_torch_layout(inception_vars))
+    got = model.apply(converted, x, features=(2048,))[2048]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_fid_integer_feature_resolves_from_local_msgpack(tmp_path, monkeypatch, inception_vars):
+    """The reference's `FrechetInceptionDistance(feature=2048)` contract, offline."""
+    from flax.serialization import msgpack_serialize
+
+    from metrics_tpu.image import FrechetInceptionDistance
+
+    (tmp_path / "inception_v3_fid.msgpack").write_bytes(msgpack_serialize(jax.device_get(inception_vars)))
+    monkeypatch.setenv("METRICS_TPU_WEIGHTS", str(tmp_path))
+    fid = FrechetInceptionDistance(feature=2048)
+    real = jnp.asarray(_rng.randint(0, 255, (8, 3, 32, 32)).astype(np.float32))
+    fake = jnp.asarray(_rng.randint(0, 255, (8, 3, 32, 32)).astype(np.float32))
+    fid.update(real, real=True)
+    fid.update(fake, real=False)
+    assert np.isfinite(float(fid.compute()))
+
+
+def test_fid_integer_feature_resolves_from_torch_pth(tmp_path, monkeypatch, inception_vars):
+    torch = pytest.importorskip("torch")
+    from metrics_tpu.image import FrechetInceptionDistance
+
+    sd = {k: torch.tensor(v) for k, v in _flax_to_torch_layout(inception_vars).items()}
+    torch.save(sd, tmp_path / "pt_inception-2015-12-05.pth")
+    monkeypatch.setenv("METRICS_TPU_WEIGHTS", str(tmp_path))
+    fid = FrechetInceptionDistance(feature=192)
+    imgs = jnp.asarray(_rng.randint(0, 255, (6, 3, 32, 32)).astype(np.float32))
+    fid.update(imgs, real=True)
+    fid.update(imgs + 5, real=False)
+    assert np.isfinite(float(fid.compute()))
+
+
+@pytest.mark.parametrize("net_type,taps", [("vgg", VGG16_TAPS), ("alex", ALEX_TAPS), ("squeeze", SQUEEZE_TAPS)])
+def test_lpips_tower_tap_channels(net_type, taps):
+    from metrics_tpu.models.lpips_nets import _net_for
+    net = _net_for(net_type)
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    feats = net.apply(variables, jnp.zeros((2, 64, 64, 3)))
+    assert tuple(f.shape[-1] for f in feats) == taps
+
+
+@pytest.mark.parametrize("net_type", ["vgg", "alex", "squeeze"])
+def test_lpips_scorer_properties(net_type):
+    variables, lin = init_lpips(net_type)
+    score = build_lpips(net_type, variables, lin)
+    a = jnp.asarray(_rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+    assert np.allclose(np.asarray(score(a, a)), 0.0, atol=1e-6)
+    assert (np.asarray(score(a, -a)) > 0).all()
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF_LPIPS), reason="reference lin weights not on disk")
+def test_vendored_lin_weights_convert():
+    torch = pytest.importorskip("torch")
+    for name, taps in (("alex", ALEX_TAPS), ("vgg", VGG16_TAPS), ("squeeze", SQUEEZE_TAPS)):
+        sd = torch.load(os.path.join(_REF_LPIPS, f"{name}.pth"), map_location="cpu")
+        lin = convert_torch_lin(sd)
+        assert tuple(int(w.shape[0]) for w in lin) == taps
+        assert all((np.asarray(w) >= 0).all() for w in lin)  # published heads are non-negative
+
+
+def test_lpips_metric_resolves_local_weights(tmp_path, monkeypatch):
+    torch = pytest.importorskip("torch")
+    from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+    from metrics_tpu.models.lpips_nets import AlexNetFeatures
+
+    net = AlexNetFeatures()
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    # synthetic torchvision-layout backbone + LPIPS-layout lin heads on disk
+    sd = {}
+    for mod_name, leaves in variables["params"].items():
+        idx = mod_name.split("_")[1]
+        sd[f"features.{idx}.weight"] = torch.tensor(np.transpose(np.asarray(leaves["kernel"]), (3, 2, 0, 1)))
+        sd[f"features.{idx}.bias"] = torch.tensor(np.asarray(leaves["bias"]))
+    torch.save(sd, tmp_path / "alexnet.pth")
+    lin_sd = {f"lin{i}.model.1.weight": torch.rand(1, c, 1, 1) for i, c in enumerate(ALEX_TAPS)}
+    torch.save(lin_sd, tmp_path / "lpips_alex.pth")
+    monkeypatch.setenv("METRICS_TPU_WEIGHTS", str(tmp_path))
+
+    metric = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    a = jnp.asarray(_rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+    metric.update(a, a)
+    assert float(metric.compute()) == pytest.approx(0.0, abs=1e-6)
+    metric2 = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    metric2.update(a, jnp.clip(-a, -1, 1))
+    assert float(metric2.compute()) > 0
+
+
+def test_clip_and_bert_loaders_error_without_local_checkpoint(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_WEIGHTS", raising=False)
+    from metrics_tpu.models import load_clip, load_text_encoder
+
+    with pytest.raises(ModuleNotFoundError, match="local"):
+        load_clip("openai/clip-vit-large-patch14")
+    with pytest.raises(ModuleNotFoundError, match="local"):
+        load_text_encoder("roberta-large")
+
+
+def test_clip_score_from_local_flax_checkpoint(tmp_path):
+    """A tiny random Flax CLIP checkpoint saved locally drives CLIPScore end-to-end."""
+    transformers = pytest.importorskip("transformers")
+    from transformers import CLIPConfig, FlaxCLIPModel
+
+    cfg = CLIPConfig.from_text_vision_configs(
+        transformers.CLIPTextConfig(hidden_size=32, intermediate_size=37, num_attention_heads=4,
+                                    num_hidden_layers=2, vocab_size=99, max_position_embeddings=32),
+        transformers.CLIPVisionConfig(hidden_size=32, intermediate_size=37, num_attention_heads=4,
+                                      num_hidden_layers=2, image_size=30, patch_size=15),
+        projection_dim=16,
+    )
+    model = FlaxCLIPModel(cfg)
+    ckpt = tmp_path / "tiny-clip"
+    model.save_pretrained(str(ckpt))
+    # minimal CLIP tokenizer + processor files
+    import json
+
+    vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1, "a</w>": 2, "photo</w>": 3, "cat</w>": 4, "dog</w>": 5}
+    (ckpt / "vocab.json").write_text(json.dumps(vocab))
+    (ckpt / "merges.txt").write_text("#version: 0.2\n")
+    (ckpt / "tokenizer_config.json").write_text(json.dumps({"model_max_length": 32, "processor_class": "CLIPProcessor", "tokenizer_class": "CLIPTokenizer"}))
+    (ckpt / "special_tokens_map.json").write_text(json.dumps(
+        {"bos_token": "<|startoftext|>", "eos_token": "<|endoftext|>", "unk_token": "<|endoftext|>", "pad_token": "<|endoftext|>"}
+    ))
+    (ckpt / "preprocessor_config.json").write_text(json.dumps({
+        "crop_size": 30, "do_center_crop": True, "do_normalize": True, "do_resize": True,
+        "image_mean": [0.48145466, 0.4578275, 0.40821073], "image_std": [0.26862954, 0.26130258, 0.27577711],
+        "size": 30, "image_processor_type": "CLIPImageProcessor", "processor_class": "CLIPProcessor",
+    }))
+
+    from metrics_tpu.multimodal import CLIPScore
+
+    metric = CLIPScore(model_name_or_path=str(ckpt))
+    imgs = _rng.randint(0, 255, (2, 3, 30, 30)).astype(np.uint8)
+    metric.update(jnp.asarray(imgs), ["a photo cat", "a photo dog"])
+    assert np.isfinite(float(metric.compute()))
+
+
+def test_bertscore_from_local_flax_checkpoint(tmp_path):
+    """A tiny random Flax BERT checkpoint saved locally drives BERTScore end-to-end."""
+    transformers = pytest.importorskip("transformers")
+    import json
+
+    from transformers import BertConfig, FlaxBertModel
+
+    cfg = BertConfig(vocab_size=40, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=37, max_position_embeddings=64)
+    model = FlaxBertModel(cfg)
+    ckpt = tmp_path / "tiny-bert"
+    model.save_pretrained(str(ckpt))
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "a", "photo", "cat", "dog", "the"]
+    (ckpt / "vocab.txt").write_text("\n".join(vocab))
+    (ckpt / "tokenizer_config.json").write_text(json.dumps({"tokenizer_class": "BertTokenizer", "do_lower_case": True}))
+
+    from metrics_tpu.text import BERTScore
+
+    metric = BERTScore(model_name_or_path=str(ckpt))
+    metric.update(["a photo cat"], ["a photo dog"])
+    out = metric.compute()
+    assert np.isfinite(float(np.asarray(out["f1"]).mean()))
+    # identical sentences → perfect match under any encoder
+    metric2 = BERTScore(model_name_or_path=str(ckpt))
+    metric2.update(["the cat"], ["the cat"])
+    assert float(np.asarray(metric2.compute()["f1"]).mean()) == pytest.approx(1.0, abs=1e-5)

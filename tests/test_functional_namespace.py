@@ -1,0 +1,59 @@
+"""Top-level functional namespace parity with the reference's 100 exports."""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as F
+
+_REF_INIT = "/root/reference/src/torchmetrics/functional/__init__.py"
+
+
+@pytest.mark.skipif(not os.path.exists(_REF_INIT), reason="reference checkout not available")
+def test_functional_all_covers_reference():
+    src = open(_REF_INIT).read()
+    block = re.search(r"__all__ = \[(.*?)\]", src, re.S).group(1)
+    ref_names = set(re.findall(r'"([^"]+)"', block))
+    ours = set(F.__all__)
+    missing = sorted(ref_names - ours)
+    assert not missing, f"functional names missing vs reference: {missing}"
+    for name in ref_names:
+        assert callable(getattr(F, name)), name
+
+
+def test_srmr_metric_and_functional():
+    import jax.numpy as jnp
+
+    from metrics_tpu.audio import SpeechReverberationModulationEnergyRatio
+
+    rng = np.random.RandomState(0)
+    fs = 8000
+    t = np.arange(fs) / fs
+    clean = (1 + np.sin(2 * np.pi * 8 * t)) * rng.randn(fs)
+    ir = np.exp(-t[: fs // 3] / 0.1) * rng.randn(fs // 3)
+    ir[0] = 1.0
+    reverb = np.convolve(clean, ir)[: len(t)]
+
+    s_clean = float(F.speech_reverberation_modulation_energy_ratio(jnp.asarray(clean), fs))
+    s_reverb = float(F.speech_reverberation_modulation_energy_ratio(jnp.asarray(reverb), fs))
+    assert s_clean > s_reverb > 0  # reverberation smears modulation energy upward
+
+    m = SpeechReverberationModulationEnergyRatio(fs=fs)
+    m.update(jnp.asarray(np.stack([clean, clean])))
+    assert float(m.compute()) == pytest.approx(s_clean, rel=1e-5)
+
+
+def test_dnsmos_nisqa_gates():
+    from metrics_tpu.audio import (
+        DeepNoiseSuppressionMeanOpinionScore,
+        NonIntrusiveSpeechQualityAssessment,
+    )
+    from metrics_tpu.utils.imports import _ONNXRUNTIME_AVAILABLE
+
+    if not _ONNXRUNTIME_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
+            DeepNoiseSuppressionMeanOpinionScore(fs=16000)
+        with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
+            NonIntrusiveSpeechQualityAssessment(fs=16000)

@@ -46,8 +46,11 @@ def test_simple_cnn_feeds_fid():
     assert np.isfinite(float(fid.compute()))
 
 
-def test_load_feature_extractor_offline_errors():
+def test_load_feature_extractor_offline_errors(tmp_path, monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_WEIGHTS", raising=False)
     with pytest.raises(ModuleNotFoundError, match="local weights"):
         load_feature_extractor("inception_v3", weights_dir=None)
-    with pytest.raises(FileNotFoundError):
-        load_feature_extractor("inception_v3", weights_dir="/tmp")
+    with pytest.raises(ModuleNotFoundError, match="local weights"):
+        load_feature_extractor("inception_v3", weights_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="Unknown backbone"):
+        load_feature_extractor("not_a_model", weights_dir=str(tmp_path))
