@@ -8,6 +8,7 @@ jit-compiled XLA functions, and distributed sync lowers to XLA collectives over 
 
 from metrics_tpu import (
     audio,
+    integration,
     classification,
     clustering,
     detection,
@@ -26,6 +27,7 @@ from metrics_tpu import (
     utils,
     wrappers,
 )
+from metrics_tpu.integration import MetricLogbook
 from metrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,

@@ -188,16 +188,35 @@ def _log_power_mel(audio: np.ndarray, sr: int, n_mels: int = 120, frame_size: in
     return ((db + 40.0) / 40.0).astype(np.float32)
 
 
-class DeepNoiseSuppressionMeanOpinionScore(_HostAudioMetric):
+def _resample(audio: np.ndarray, sr_in: int, sr_out: int) -> np.ndarray:
+    if sr_in == sr_out:
+        return audio
+    from math import gcd
+
+    from scipy.signal import resample_poly
+
+    g = gcd(sr_in, sr_out)
+    return resample_poly(audio, sr_out // g, sr_in // g).astype(np.float32)
+
+
+class DeepNoiseSuppressionMeanOpinionScore(Metric):
     """DNSMOS via pretrained onnxruntime scorers (reference ``audio/dnsmos.py:30``).
 
-    Host-side pipeline (the scorer is a CPU onnx net — it never belongs on TPU):
-    9.01 s segments → log-power mel features → the local ``sig_bak_ovr.onnx``
-    (or personalized variant) session → polynomial MOS calibration. Model files
-    are resolved from ``METRICS_TPU_WEIGHTS`` (zero-egress build).
+    Host-side pipeline matching the published method (the scorers are CPU onnx
+    nets — they never belong on TPU): resample to 16 kHz, tile to ≥ 9.01 s, hop
+    in 1 s steps; per hop run ``model_v8.onnx`` (P.808, on log-power mel
+    features) and ``[p]sig_bak_ovr.onnx`` (P.835, on raw audio), apply the
+    published polynomial calibrations, average over hops. Model files are
+    resolved from ``METRICS_TPU_WEIGHTS`` (zero-egress build). ``compute``
+    returns the 4-vector ``[p808_mos, mos_sig, mos_bak, mos_ovr]``.
     """
 
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
     _INPUT_LEN_S = 9.01
+    _FS = 16000
 
     def __init__(self, fs: int, personalized: bool = False, **kwargs: Any) -> None:
         if not _ONNXRUNTIME_AVAILABLE:
@@ -208,38 +227,58 @@ class DeepNoiseSuppressionMeanOpinionScore(_HostAudioMetric):
         super().__init__(**kwargs)
         self.fs = fs
         self.personalized = personalized
-        self._session = None
+        self._sessions = None
+        self.add_state("sum_dnsmos", jnp.zeros(4), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    # published DNSMOS P.835/P.808 calibration polynomials (highest degree first)
+    _POLY_PERSONALIZED = {
+        "sig": (-0.01019296, 0.02751166, 1.19576786, -0.24348726),
+        "bak": (-0.04976499, 0.44276479, -0.1644611, 0.96883132),
+        "ovr": (-0.00533021, 0.005101, 1.18058466, -0.11236046),
+    }
+    _POLY_DEFAULT = {
+        "sig": (-0.08397278, 1.22083953, 0.0052439),
+        "bak": (-0.13166888, 1.60915514, -0.39604546),
+        "ovr": (-0.06766283, 1.11546468, 0.04602535),
+    }
 
     def _scores_for(self, audio: np.ndarray) -> np.ndarray:
         import onnxruntime as ort
 
-        name = ("p" if self.personalized else "") + "sig_bak_ovr.onnx"
-        if self._session is None:
-            self._session = ort.InferenceSession(
-                _local_model_path(name, "DNSMOS"), providers=["CPUExecutionProvider"]
+        if self._sessions is None:
+            name = ("p" if self.personalized else "") + "sig_bak_ovr.onnx"
+            self._sessions = (
+                ort.InferenceSession(_local_model_path(name, "DNSMOS"), providers=["CPUExecutionProvider"]),
+                ort.InferenceSession(_local_model_path("model_v8.onnx", "DNSMOS (P.808)"), providers=["CPUExecutionProvider"]),
             )
-        need = int(self._INPUT_LEN_S * self.fs)
-        seg = np.tile(audio, -(-need // max(len(audio), 1)))[:need] if len(audio) < need else audio[:need]
-        inp = seg.astype(np.float32)[None]
-        raw = self._session.run(None, {self._session.get_inputs()[0].name: inp})[0].reshape(-1)
-        sig, bak, ovr = raw[:3]
-        # published polynomial calibration (p835 fit)
-        if self.personalized:
-            sig = -0.00566666 * sig**2 + 1.16812 * sig - 0.08397
-            bak = -0.13166888 * bak**2 + 2.23310668 * bak - 4.30155127
-            ovr = -0.06766283 * ovr**2 + 1.11546468 * ovr + 0.04602535
-        else:
-            sig = -0.08397 + 1.22083953 * sig - 0.00524 * sig**2
-            bak = -4.26828 + 2.32298 * bak - 0.14423 * bak**2
-            ovr = 0.06116 + 1.1086 * ovr - 0.04109 * ovr**2
-        return np.asarray([sig, bak, ovr], dtype=np.float64)
+        sess_835, sess_808 = self._sessions
+        audio = _resample(audio, self.fs, self._FS)
+        need = int(self._INPUT_LEN_S * self._FS)
+        while audio.shape[-1] < need:
+            audio = np.concatenate([audio, audio], axis=-1)
+        num_hops = int(np.floor(audio.shape[-1] / self._FS) - self._INPUT_LEN_S) + 1
+        polys = self._POLY_PERSONALIZED if self.personalized else self._POLY_DEFAULT
+        hop_scores = []
+        for idx in range(max(num_hops, 1)):
+            seg = audio[int(idx * self._FS) : int((idx + self._INPUT_LEN_S) * self._FS)].astype(np.float32)
+            mel = _log_power_mel(seg[:-160], self._FS)[None].astype(np.float32)
+            p808 = float(sess_808.run(None, {sess_808.get_inputs()[0].name: mel})[0].reshape(-1)[0])
+            raw = sess_835.run(None, {sess_835.get_inputs()[0].name: seg[None]})[0].reshape(-1)
+            sig, bak, ovr = (float(np.polyval(polys[k], v)) for k, v in zip(("sig", "bak", "ovr"), raw[:3]))
+            hop_scores.append([p808, sig, bak, ovr])
+        return np.mean(np.asarray(hop_scores), axis=0)
 
     def update(self, preds: Array) -> None:
-        """Update with waveform(s) ``(..., time)``; accumulates the overall MOS."""
+        """Update with waveform(s) ``(..., time)``."""
         flat = np.asarray(preds, dtype=np.float32).reshape(-1, np.asarray(preds).shape[-1])
         for wav in flat:
-            self.sum_value = self.sum_value + float(self._scores_for(wav)[2])
+            self.sum_dnsmos = self.sum_dnsmos + jnp.asarray(self._scores_for(wav), dtype=jnp.float32)
             self.total = self.total + 1
+
+    def compute(self) -> Array:
+        """Average ``[p808_mos, mos_sig, mos_bak, mos_ovr]`` over all waveforms."""
+        return (self.sum_dnsmos / jnp.maximum(self.total, 1)).astype(jnp.float32)
 
 
 class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
@@ -262,8 +301,10 @@ class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
         self.fs = fs
         self._session = None
 
+    _FS = 48000  # the published model's native rate; 20 ms / 10 ms framing below
+
     def update(self, preds: Array) -> None:
-        """Update with waveform(s) ``(..., time)``."""
+        """Update with waveform(s) ``(..., time)``; input is resampled to 48 kHz."""
         import onnxruntime as ort
 
         if self._session is None:
@@ -272,7 +313,8 @@ class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
             )
         flat = np.asarray(preds, dtype=np.float32).reshape(-1, np.asarray(preds).shape[-1])
         for wav in flat:
-            feats = _log_power_mel(wav, self.fs, n_mels=48, frame_size=960, hop=480)[None]
+            wav48 = _resample(wav, self.fs, self._FS)
+            feats = _log_power_mel(wav48, self._FS, n_mels=48, frame_size=960, hop=480)[None]
             out = self._session.run(None, {self._session.get_inputs()[0].name: feats})[0].reshape(-1)
             self.sum_value = self.sum_value + float(out[0])
             self.total = self.total + 1

@@ -68,7 +68,9 @@ class Dice(Metric):
 
         self._samplewise = average == "samples" or mdmc_average == "samplewise"
         if self._samplewise:
-            self.add_state("score_sum", jnp.zeros(()), dist_reduce_fx="sum")
+            # per-class axis survives samplewise averaging for average='none'/None
+            score_shape = (num_classes,) if average in ("none", None) else ()
+            self.add_state("score_sum", jnp.zeros(score_shape), dist_reduce_fx="sum")
             self.add_state("n_samples", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
         elif average == "micro":
             self.add_state("tp", jnp.zeros(()), dist_reduce_fx="sum")
@@ -85,10 +87,8 @@ class Dice(Metric):
         tp, fp, fn = _dice_stats(preds_oh, target_oh, target, self.ignore_index)  # (N, C)
         if self._samplewise:
             inner = "micro" if self.average == "samples" else self.average
-            per_sample = _dice_reduce(tp, fp, fn, inner, self.zero_division)
-            if per_sample.ndim > 1:
-                per_sample = per_sample.mean(axis=tuple(range(1, per_sample.ndim)))
-            self.score_sum = self.score_sum + per_sample.sum()
+            per_sample = _dice_reduce(tp, fp, fn, inner, self.zero_division)  # (N,) or (N, C)
+            self.score_sum = self.score_sum + per_sample.sum(axis=0)
             self.n_samples = self.n_samples + per_sample.shape[0]
         elif self.average == "micro":
             self.tp = self.tp + tp.sum()

@@ -134,11 +134,19 @@ def speech_reverberation_modulation_energy_ratio(
     >>> float(speech_reverberation_modulation_energy_ratio(jnp.asarray(am), 8000)) > 1.0
     True
     """
+    if fast:
+        raise NotImplementedError(
+            "`fast=True` selects the toolbox's gammatonegram pipeline, which produces materially"
+            " different numbers; it is not implemented here — use the default fast=False path."
+        )
     if max_cf is None:
         max_cf = 30.0 if norm else 128.0
     preds = jnp.asarray(preds)
     flat = preds.reshape(-1, preds.shape[-1])
-    scores = jnp.stack(
-        [_srmr_one(w, int(fs), n_cochlear_filters, float(low_freq), float(min_cf), float(max_cf), bool(norm), bool(fast)) for w in flat]
+    batched = jax.vmap(
+        lambda w: _srmr_one(
+            w, int(fs), n_cochlear_filters, float(low_freq), float(min_cf), float(max_cf), bool(norm), bool(fast)
+        )
     )
+    scores = batched(flat)  # one compiled program for the whole batch
     return scores.reshape(preds.shape[:-1]) if preds.ndim > 1 else scores[0]

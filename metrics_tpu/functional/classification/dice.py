@@ -54,8 +54,8 @@ def _dice_format(
         # binary / multilabel probabilities, same shape as target
         preds_hard = preds >= threshold
         target_b = target.astype(bool)
-        if preds.ndim >= 2 and (num_classes is None or preds.shape[1] == num_classes) and preds.ndim > 1:
-            c = preds.shape[1] if preds.ndim > 1 else 1
+        if preds.ndim >= 2 and (num_classes is None or preds.shape[1] == num_classes):
+            c = preds.shape[1]
             n = preds.shape[0]
             return preds_hard.reshape(n, c, -1), target_b.reshape(n, c, -1), c
         return preds_hard.reshape(-1, 1, 1), target_b.reshape(-1, 1, 1), 1
@@ -131,7 +131,7 @@ def dice(
     tp, fp, fn = _dice_stats(preds_oh, target_oh, target, ignore_index)  # (N, C)
     if average == "samples" or mdmc_average == "samplewise":
         inner = "micro" if average == "samples" else average
-        per_sample = _dice_reduce(tp, fp, fn, inner, zero_division)  # (N,) or (N,...)
-        return per_sample.mean()
+        per_sample = _dice_reduce(tp, fp, fn, inner, zero_division)  # (N,) or (N, C) for 'none'
+        return per_sample.mean(axis=0)  # average over samples only; per-class axis survives
     tp, fp, fn = tp.sum(0), fp.sum(0), fn.sum(0)  # global accumulation → (C,)
     return _dice_reduce(tp, fp, fn, average, zero_division)

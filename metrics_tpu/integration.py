@@ -1,0 +1,119 @@
+"""Training-loop integration — the Lightning-contract equivalent for flax/optax loops.
+
+The reference's L5 integration (SURVEY §1, §4.8; validated by
+``/root/reference/tests/integrations/lightning/test_lightning.py``) gives metrics
+a managed lifecycle inside a trainer: ``self.log(metric)`` values surface per
+step and per epoch, metrics sync across processes when epoch results are read,
+and every logged metric is reset automatically at epoch end.
+
+JAX training loops are hand-written, so the equivalent here is an explicit
+manager object with the same contract:
+
+* :meth:`MetricLogbook.log` registers a metric under a name (once; re-logging
+  the same name is a no-op so the call can live inside the step function);
+* :meth:`MetricLogbook.log_batch` = ``self.log(metric, on_step=True)``: runs
+  ``forward`` — the batch-local value comes back, the global state accumulates;
+* :meth:`MetricLogbook.epoch_end` = the trainer's epoch boundary: computes every
+  logged metric (``sync_on_compute`` applies, so multi-process state is
+  all-gathered exactly once per epoch) and resets them afterwards;
+* :meth:`MetricLogbook.epoch` is the same as a context manager for eval loops.
+
+The manager is deliberately tiny: metrics keep their own functional core, so a
+fully-jitted training step can instead carry metric state pytrees explicitly
+(``metric.functional()``) and only hand final states to the logbook.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+
+__all__ = ["MetricLogbook"]
+
+
+class MetricLogbook:
+    """Lightning-``self.log`` lifecycle for hand-written JAX loops.
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu.aggregation import MeanMetric
+    >>> book = MetricLogbook()
+    >>> for epoch_data in ([1.0, 2.0], [10.0]):
+    ...     for batch in epoch_data:
+    ...         _ = book.log_batch("train_loss", MeanMetric, jnp.asarray(batch))
+    ...     print(sorted((k, float(v)) for k, v in book.epoch_end().items()))
+    [('train_loss', 1.5)]
+    [('train_loss', 10.0)]
+    """
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Metric] = {}
+        self._history: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------------ registration
+    def log(self, name: str, metric: Any) -> Metric:
+        """Register ``metric`` under ``name`` (idempotent, so it can sit in the step fn).
+
+        ``metric`` may be a :class:`Metric`, a :class:`MetricCollection`, or a
+        zero-arg factory/class producing one.
+        """
+        if name not in self._metrics:
+            if not isinstance(metric, (Metric, MetricCollection)):
+                metric = metric()
+            if not isinstance(metric, (Metric, MetricCollection)):
+                raise ValueError(f"Expected a Metric/MetricCollection (or factory) for {name!r}, got {type(metric)}")
+            self._metrics[name] = metric
+        return self._metrics[name]
+
+    def __getitem__(self, name: str) -> Metric:
+        return self._metrics[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._metrics
+
+    # ------------------------------------------------------------------ step / epoch
+    def log_batch(self, name: str, metric: Any, *args: Any, **kwargs: Any) -> Any:
+        """``self.log(metric, on_step=True)``: forward → batch value + accumulation."""
+        m = self.log(name, metric)
+        return m(*args, **kwargs)
+
+    def update(self, name: str, metric: Any, *args: Any, **kwargs: Any) -> None:
+        """``self.log(metric)`` without a step value: update only (no batch compute)."""
+        m = self.log(name, metric)
+        m.update(*args, **kwargs)
+
+    def epoch_end(self, reset: bool = True) -> Dict[str, Any]:
+        """Compute every logged metric (distributed sync applies), then reset.
+
+        Mirrors the Lightning epoch boundary: compute-once-per-epoch, values
+        recorded into :attr:`history`, state cleared for the next epoch.
+        """
+        values: Dict[str, Any] = {}
+        for name, metric in self._metrics.items():
+            out = metric.compute()
+            if isinstance(out, dict):
+                values.update({f"{name}_{k}" if k != name else k: v for k, v in out.items()})
+                values[name] = out
+            else:
+                values[name] = out
+        self._history.append(values)
+        if reset:
+            self.reset()
+        return values
+
+    @contextmanager
+    def epoch(self) -> Iterator["MetricLogbook"]:
+        """Context manager over one eval epoch: compute+reset on exit."""
+        yield self
+        self.epoch_end()
+
+    def reset(self) -> None:
+        for metric in self._metrics.values():
+            metric.reset()
+
+    @property
+    def history(self) -> List[Dict[str, Any]]:
+        """Per-epoch computed values, oldest first (the logger's scalar trace)."""
+        return self._history

@@ -27,7 +27,9 @@ from __future__ import annotations
 import os
 from typing import Any, Callable, Optional, Tuple
 
-__all__ = ["load_feature_extractor", "load_clip", "load_text_encoder", "resolve_weights_dir"]
+__all__ = ["load_clip", "load_feature_extractor", "load_lpips", "load_text_encoder", "resolve_weights_dir"]
+
+_INCEPTION_FEATURES = (64, 192, 768, 2048, "logits_unbiased", "logits")
 
 
 def resolve_weights_dir(weights_dir: Optional[str] = None) -> Optional[str]:
@@ -66,6 +68,12 @@ def load_feature_extractor(
     if name in ("inception_v3_fid", "inception-v3-compat", "inception_v3"):
         from metrics_tpu.models.inception_v3 import convert_torch_state_dict, make_feature_extractor
 
+        if feature not in _INCEPTION_FEATURES:
+            raise ValueError(
+                f"Integer `feature` must be one of {_INCEPTION_FEATURES} for the FID InceptionV3,"
+                f" but got {feature!r}."
+            )
+
         if not weights_dir:
             raise _missing(name, "inception_v3_fid.msgpack or pt_inception*.pth")
         msgpack = _find(weights_dir, "inception_v3_fid.msgpack")
@@ -77,10 +85,11 @@ def load_feature_extractor(
             variables = convert_torch_state_dict(_load_torch_sd(pth))
             return make_feature_extractor(variables, feature)
         raise _missing(name, "inception_v3_fid.msgpack or pt_inception*.pth")
-    if name in ("vgg16_lpips", "alexnet_lpips", "vgg", "alex"):
-        net_type = "vgg" if "vgg" in name else "alex"
-        score = load_lpips(net_type, weights_dir)
-        return score
+    if name in ("vgg16_lpips", "alexnet_lpips", "squeeze_lpips", "vgg", "alex", "squeeze"):
+        raise ValueError(
+            f"{name!r} is an LPIPS scorer, not an image→features extractor — use"
+            " metrics_tpu.models.load_lpips(net_type) instead (its callable takes TWO image batches)."
+        )
     if name == "simple_cnn":
         from metrics_tpu.models.simple_cnn import SimpleFeatureCNN
 

@@ -7,8 +7,7 @@ resolves named pretrained backbones from a local directory when available.
 
 from __future__ import annotations
 
-import os
-from typing import Callable, Optional, Sequence
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -27,54 +27,70 @@ from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat
 
 
-def _retrieval_aggregate(values: Array, aggregation: str = "mean") -> Array:
-    """Aggregate per-query scores (reference ``base.py:26-40``)."""
+def _retrieval_aggregate(values: Array, aggregation: str = "mean", mask: Optional[Array] = None) -> Array:
+    """Masked aggregation of per-query scores (reference ``base.py:26-40``).
+
+    ``mask`` marks valid groups; invalid entries never contribute (jit-safe
+    replacement for boolean indexing).
+    """
+    if mask is None:
+        mask = jnp.ones(values.shape, bool)
+    count = mask.sum()
     if aggregation == "mean":
-        return values.mean() if values.size else jnp.asarray(0.0)
+        return jnp.where(count > 0, (jnp.where(mask, values, 0.0)).sum() / jnp.maximum(count, 1), 0.0)
     if aggregation == "median":
-        return jnp.median(values) if values.size else jnp.asarray(0.0)
+        med = jnp.nanmedian(jnp.where(mask, values, jnp.nan))
+        return jnp.where(count > 0, jnp.nan_to_num(med), 0.0)
     if aggregation == "min":
-        return values.min() if values.size else jnp.asarray(0.0)
+        return jnp.where(count > 0, jnp.where(mask, values, jnp.inf).min(), 0.0)
     if aggregation == "max":
-        return values.max() if values.size else jnp.asarray(0.0)
-    return aggregation(values)  # custom callable
+        return jnp.where(count > 0, jnp.where(mask, values, -jnp.inf).max(), 0.0)
+    # custom callable: host semantics (not jittable in general)
+    return aggregation(values[np.asarray(mask)])
 
 
 class GroupedQueries:
     """Flat sorted view over all queries + the segment quantities every metric needs.
 
-    ``sorted by (query, -pred)``: ``rel`` (binary), ``graded`` (raw target),
-    ``group_id``, ``pos`` (0-based rank within query), ``n_rel``/``n_docs`` per
-    query, and the ideal-order graded targets for NDCG.
+    Fully on-device (SURVEY §2.7): ONE ``jnp.lexsort`` by (query, -pred), group
+    ids compacted by neighbor comparison on the sorted keys, and every per-query
+    quantity a ``segment_sum``-style reduction. ``num_groups`` is the static
+    upper bound ``n`` (padding groups have ``n_docs == 0`` and are masked out),
+    so the whole view — and every metric built on it — traces under ``jit``.
+
+    Fields: ``rel`` (binary), ``graded`` (raw target), ``group_id``, ``pos``
+    (0-based rank within query), ``n_rel``/``n_docs`` per group, and the
+    ideal-order graded targets for NDCG.
     """
 
     def __init__(self, indexes: Array, preds: Array, target: Array):
-        idx_np = np.asarray(indexes)
-        preds_np = np.asarray(preds, dtype=np.float64)
-        # compact the (arbitrary) query ids to 0..G-1
-        _, compact = np.unique(idx_np, return_inverse=True)
-        order = np.lexsort((-preds_np, compact))
-        self.order = jnp.asarray(order)
-        self.group_id = jnp.asarray(compact[order])
-        self.num_groups = int(compact.max()) + 1 if compact.size else 0
-        self.preds = jnp.asarray(preds)[self.order]
-        self.graded = jnp.asarray(target)[self.order].astype(jnp.float32)
+        indexes = jnp.asarray(indexes)
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        n = int(preds.shape[0])
+        self.num_groups = n  # static bound; true group count is dynamic
+        order = jnp.lexsort((-preds.astype(jnp.float32), indexes))
+        self.order = order
+        idx_sorted = indexes[order]
+        new_group = jnp.concatenate([jnp.ones(1, bool), idx_sorted[1:] != idx_sorted[:-1]]) if n else jnp.zeros(0, bool)
+        g = jnp.cumsum(new_group) - 1
+        self.group_id = g
+        self.preds = preds[order]
+        self.graded = target[order].astype(jnp.float32)
         self.rel = (self.graded > 0).astype(jnp.float32)
 
-        n = self.rel.shape[0]
-        g = self.group_id
         ones = jnp.ones(n, dtype=jnp.float32)
         self.n_docs = jax.ops.segment_sum(ones, g, self.num_groups)
         self.n_rel = jax.ops.segment_sum(self.rel, g, self.num_groups)
-        starts = jnp.concatenate([jnp.zeros(1), jnp.cumsum(self.n_docs)[:-1]])
+        starts = jnp.concatenate([jnp.zeros(1), jnp.cumsum(self.n_docs)[:-1]]) if n else jnp.zeros(0)
         self.pos = jnp.arange(n, dtype=jnp.float32) - starts[g]
         # cumulative relevant within group, inclusive of current position
         cum = jnp.cumsum(self.rel)
-        offset = jnp.concatenate([jnp.zeros(1), self.n_rel.cumsum()[:-1]])
+        offset = jnp.concatenate([jnp.zeros(1), self.n_rel.cumsum()[:-1]]) if n else jnp.zeros(0)
         self.rel_cum = cum - offset[g]
         # ideal ordering (target desc within group) for NDCG
-        ideal_order = np.lexsort((-np.asarray(target, dtype=np.float64), compact))
-        self.ideal_graded = jnp.asarray(target)[jnp.asarray(ideal_order)].astype(jnp.float32)
+        ideal_order = jnp.lexsort((-target.astype(jnp.float32), indexes))
+        self.ideal_graded = target[ideal_order].astype(jnp.float32)
 
     def seg_sum(self, x: Array) -> Array:
         return jax.ops.segment_sum(x, self.group_id, self.num_groups)
@@ -138,28 +154,52 @@ class RetrievalMetric(Metric):
         self.preds.append(preds)
         self.target.append(target)
 
+    _empty_error_msg = "`compute` method was provided with a query with no positive target."
+
+    def _empty_mask(self, gq: GroupedQueries) -> Array:
+        """Which (valid) groups count as "empty" for ``empty_target_action``."""
+        return gq.n_rel == 0
+
     def compute(self) -> Array:
         """Group by query with ONE lex-sort, score every query via segment reductions (no loops)."""
         indexes = dim_zero_cat(self.indexes)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
+        if self.empty_target_action == "error" and preds.shape[0]:
+            # data-dependent raise: eager-only, via cheap host bincounts (no need to
+            # build the full sorted GroupedQueries view twice per compute)
+            idx_np = np.asarray(indexes)
+            _, compact = np.unique(idx_np, return_inverse=True)
+            n_rel = np.bincount(compact, weights=np.asarray(target) > 0)
+            if bool((self._empty_counts_host(n_rel, np.bincount(compact))).any()):
+                raise ValueError(self._empty_error_msg)
+        return self.compute_flat(preds, target, indexes)
+
+    @staticmethod
+    def _empty_counts_host(n_rel: "np.ndarray", n_docs: "np.ndarray") -> "np.ndarray":
+        """Host-side form of :meth:`_empty_mask` for the eager error check."""
+        return n_rel == 0
+
+    def compute_flat(self, preds: Array, target: Array, indexes: Array) -> Array:
+        """Pure, fully jittable evaluation over flat arrays — embed this in a jitted
+        eval step to run grouping, scoring and aggregation as ONE XLA program.
+
+        ``empty_target_action="error"`` is treated as "neg" here (a data-dependent
+        raise cannot trace); the eager :meth:`compute` performs the raise.
+        """
+        if preds.shape[0] == 0:
+            return jnp.asarray(0.0)
         gq = GroupedQueries(indexes, preds, target)
-        scores = self._metric_vectorized(gq)  # (num_groups,)
-
-        empty = gq.n_rel == 0
-        if self.empty_target_action == "error":
-            if bool(empty.any()):
-                raise ValueError("`compute` method was provided with a query with no positive target.")
-        elif self.empty_target_action == "pos":
+        scores = self._metric_vectorized(gq)  # (num_groups,) under the static bound
+        valid = gq.n_docs > 0
+        empty = self._empty_mask(gq) & valid
+        if self.empty_target_action == "pos":
             scores = jnp.where(empty, 1.0, scores)
-        elif self.empty_target_action == "neg":
+        elif self.empty_target_action == "neg" or self.empty_target_action == "error":
             scores = jnp.where(empty, 0.0, scores)
-        else:  # skip
-            import numpy as _np
-
-            keep = ~_np.asarray(empty)
-            scores = scores[keep]
-        return _retrieval_aggregate(scores, self.aggregation)
+        else:  # skip: masked aggregation instead of boolean indexing
+            valid = valid & ~empty
+        return _retrieval_aggregate(scores, self.aggregation, valid)
 
     @abstractmethod
     def _metric_vectorized(self, gq: GroupedQueries) -> Array:

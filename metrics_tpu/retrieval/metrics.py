@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.retrieval.base import GroupedQueries, RetrievalMetric, _retrieval_aggregate
+from metrics_tpu.retrieval.base import GroupedQueries, RetrievalMetric
 from metrics_tpu.utils.compute import _safe_divide
 
 __all__ = [
@@ -134,28 +134,15 @@ class RetrievalFallOut(_TopKRetrievalMetric):
         hits = gq.seg_sum(nonrel * self._k_mask(gq))
         return _safe_divide(hits, n_nonrel)
 
-    def compute(self) -> Array:
-        """Like the base compute but the empty-query condition is "no negative docs" (reference ``fall_out.py:118-139``)."""
-        from metrics_tpu.utils.data import dim_zero_cat
+    def _empty_mask(self, gq: GroupedQueries) -> Array:
+        """The empty-query condition is "no NEGATIVE docs" (reference ``fall_out.py:118-139``)."""
+        return (gq.n_docs - gq.n_rel) == 0
 
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        gq = GroupedQueries(indexes, preds, target)
-        scores = self._metric_vectorized(gq)
-        empty = (gq.n_docs - gq.n_rel) == 0
-        if self.empty_target_action == "error":
-            if bool(empty.any()):
-                raise ValueError("`compute` method was provided with a query with no negative target.")
-        elif self.empty_target_action == "pos":
-            scores = jnp.where(empty, 1.0, scores)
-        elif self.empty_target_action == "neg":
-            scores = jnp.where(empty, 0.0, scores)
-        else:
-            import numpy as np
+    @staticmethod
+    def _empty_counts_host(n_rel, n_docs):
+        return (n_docs - n_rel) == 0
 
-            scores = scores[~np.asarray(empty)]
-        return _retrieval_aggregate(scores, self.aggregation)
+    _empty_error_msg = "`compute` method was provided with a query with no negative target."
 
 
 class RetrievalHitRate(_TopKRetrievalMetric):
@@ -199,35 +186,29 @@ class RetrievalAUROC(_TopKRetrievalMetric):
     """
 
     def _metric_vectorized(self, gq: GroupedQueries) -> Array:
-        import numpy as np
-
-        km = np.asarray(self._k_mask(gq))
-        rel = np.asarray(gq.rel) * km
-        nonrel = (1.0 - np.asarray(gq.rel)) * km
-        g = np.asarray(gq.group_id)
-        pred = np.asarray(gq.preds)
+        km = self._k_mask(gq)
+        rel = gq.rel * km
+        nonrel = (1.0 - gq.rel) * km
+        g = gq.group_id
+        pred = gq.preds
+        n = pred.shape[0]
         # tie runs: consecutive rows (already sorted by (group, -pred)) with equal pred
-        new_run = np.ones(len(g), dtype=bool)
-        if len(g) > 1:
-            new_run[1:] = (g[1:] != g[:-1]) | (pred[1:] != pred[:-1])
-        run_id = np.cumsum(new_run) - 1
-        n_runs = run_id[-1] + 1 if len(g) else 0
-        nonrel_in_run = np.bincount(run_id, weights=nonrel, minlength=n_runs)
-        # nonrel strictly above a run = cumulative nonrel up to the run start, minus group offset
-        cum_nonrel = np.cumsum(nonrel)
-        run_start = np.flatnonzero(new_run)
-        nonrel_before_run = np.concatenate([[0.0], cum_nonrel[run_start[1:] - 1]]) if n_runs else np.zeros(0)
-        group_of_run = g[run_start] if n_runs else np.zeros(0, dtype=g.dtype)
-        group_nonrel_offset = np.concatenate([[0.0], np.bincount(g, weights=nonrel).cumsum()[:-1]])
-        strictly_above = nonrel_before_run - group_nonrel_offset[group_of_run]
+        new_run = jnp.concatenate([jnp.ones(1, bool), (g[1:] != g[:-1]) | (pred[1:] != pred[:-1])]) if n else jnp.zeros(0, bool)
+        run_id = jnp.cumsum(new_run) - 1
+        nonrel_in_run = jax.ops.segment_sum(nonrel, run_id, n)
+        # exclusive cumulative nonrel; its minimum over a segment = value at the segment start
+        ex_cum = jnp.cumsum(nonrel) - nonrel
+        run_start_val = jax.ops.segment_min(ex_cum, run_id, n)
+        group_start_val = jax.ops.segment_min(ex_cum, g, n)
+        strictly_above = run_start_val[run_id] - group_start_val[g]
 
-        n_rel = np.bincount(g, weights=rel)
-        n_nonrel = np.bincount(g, weights=nonrel)
+        n_rel = jax.ops.segment_sum(rel, g, gq.num_groups)
+        n_nonrel = jax.ops.segment_sum(nonrel, g, gq.num_groups)
         # U-statistic with half credit for prediction ties (trapezoidal ROC):
         # credit = strictly-below + 0.5 · tied = n_nonrel − strictly_above − 0.5 · tied
-        per_row_credit = n_nonrel[g] - strictly_above[run_id] - 0.5 * nonrel_in_run[run_id]
-        u = np.bincount(g, weights=np.where(rel > 0, per_row_credit, 0.0))
-        return _safe_divide(jnp.asarray(u, dtype=jnp.float32), jnp.asarray(n_rel * n_nonrel, dtype=jnp.float32))
+        per_row_credit = n_nonrel[g] - strictly_above - 0.5 * nonrel_in_run[run_id]
+        u = jax.ops.segment_sum(jnp.where(rel > 0, per_row_credit, 0.0), g, gq.num_groups)
+        return _safe_divide(u.astype(jnp.float32), (n_rel * n_nonrel).astype(jnp.float32))
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
@@ -262,7 +243,8 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         k_eff = jnp.minimum(ks[:, None], gq.n_docs[None, :]) if self.adaptive_k else ks[:, None]
         precision_kg = _safe_divide(rel_hits, k_eff)
         recall_kg = _safe_divide(rel_hits, gq.n_rel[None, :])
-        empty = gq.n_rel == 0
+        valid = gq.n_docs > 0  # mask out the static-bound padding groups
+        empty = (gq.n_rel == 0) & valid
         if self.empty_target_action == "error" and bool(empty.any()):
             raise ValueError("`compute` method was provided with a query with no positive target.")
         if self.empty_target_action == "pos":
@@ -271,13 +253,12 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         elif self.empty_target_action == "neg":
             precision_kg = jnp.where(empty[None, :], 0.0, precision_kg)
             recall_kg = jnp.where(empty[None, :], 0.0, recall_kg)
-        else:
-            import numpy as np
-
-            keep = ~np.asarray(empty)
-            precision_kg = precision_kg[:, keep]
-            recall_kg = recall_kg[:, keep]
-        return precision_kg.mean(axis=1), recall_kg.mean(axis=1), jnp.arange(1, max_k + 1)
+        else:  # skip: masked mean instead of boolean indexing
+            valid = valid & ~empty
+        denom = jnp.maximum(valid.sum(), 1)
+        precision_k = (precision_kg * valid[None, :]).sum(axis=1) / denom
+        recall_k = (recall_kg * valid[None, :]).sum(axis=1) / denom
+        return precision_k, recall_k, jnp.arange(1, max_k + 1)
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):

@@ -92,3 +92,15 @@ def test_dice_validation_errors():
         Dice(average="bogus")
     with pytest.raises(ValueError, match="number of classes"):
         Dice(average="macro")
+
+
+@pytest.mark.skipif(not _HAS_REF, reason="reference checkout not available")
+def test_dice_samplewise_average_none_keeps_class_axis():
+    rng = np.random.RandomState(5)
+    preds = rng.randint(0, NUM_CLASSES, (8, 12))
+    target = rng.randint(0, NUM_CLASSES, (8, 12))
+    got = dice(jnp.asarray(preds), jnp.asarray(target), average="none",
+               mdmc_average="samplewise", num_classes=NUM_CLASSES)
+    want = _ref_dice(preds, target, average="none", mdmc_average="samplewise", num_classes=NUM_CLASSES)
+    assert np.asarray(got).shape == (NUM_CLASSES,)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)

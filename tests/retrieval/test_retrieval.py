@@ -195,3 +195,36 @@ def test_ignore_index():
     m.update(jnp.asarray(preds), jnp.asarray(t), indexes=jnp.asarray(indexes))
     v = float(m.compute())
     assert 0.0 <= v <= 1.0
+
+
+def test_retrieval_update_and_compute_jit_one_program():
+    """The whole retrieval evaluation — grouping, scoring, aggregation — traces as ONE jitted program."""
+    import jax
+
+    rng = np.random.RandomState(0)
+    n_q, n_d = 16, 20
+    indexes = jnp.asarray(np.repeat(np.arange(n_q), n_d))
+    preds = jnp.asarray(rng.rand(n_q * n_d).astype(np.float32))
+    target = jnp.asarray((rng.rand(n_q * n_d) < 0.2).astype(np.int64))
+
+    for cls in (RetrievalMAP, RetrievalMRR, RetrievalNormalizedDCG, RetrievalAUROC):
+        m = cls()
+
+        @jax.jit
+        def program(p, t, i, m=m):
+            return m.compute_flat(p, t, i)
+
+        jitted = float(program(preds, target, indexes))
+        m.update(preds, target, indexes=indexes)
+        eager = float(m.compute())
+        np.testing.assert_allclose(jitted, eager, rtol=1e-6), cls.__name__
+
+
+def test_retrieval_skip_action_masked_aggregation():
+    indexes = jnp.asarray([0, 0, 1, 1, 2, 2])
+    preds = jnp.asarray([0.9, 0.1, 0.8, 0.2, 0.7, 0.3])
+    target = jnp.asarray([1, 0, 0, 0, 1, 0])  # query 1 has no positives
+    m = RetrievalMAP(empty_target_action="skip")
+    m.update(preds, target, indexes=indexes)
+    # queries 0 and 2 both have AP=1; query 1 skipped
+    assert float(m.compute()) == pytest.approx(1.0)

@@ -1,0 +1,88 @@
+"""Training-loop integration tests — reference ``tests/integrations/lightning`` analog.
+
+The semantics under test (reference ``test_lightning.py``): per-step values via
+forward, per-epoch compute with automatic reset between epochs, collections,
+and a real optax training loop whose logged loss trace matches the manual one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import MeanMetric, SumMetric
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.integration import MetricLogbook
+
+
+def test_epoch_values_do_not_leak_across_epochs():
+    book = MetricLogbook()
+    for epoch, values in enumerate(([1.0, 2.0, 3.0], [10.0, 20.0])):
+        for v in values:
+            book.update("loss", MeanMetric, jnp.asarray(v))
+        out = book.epoch_end()
+        assert float(out["loss"]) == pytest.approx(np.mean(values))
+    assert [float(h["loss"]) for h in book.history] == [2.0, 15.0]
+
+
+def test_log_batch_returns_step_value_and_accumulates():
+    book = MetricLogbook()
+    b1 = book.log_batch("s", SumMetric, jnp.asarray([1.0, 2.0]))
+    b2 = book.log_batch("s", SumMetric, jnp.asarray([3.0]))
+    assert float(b1) == 3.0 and float(b2) == 3.0  # per-batch values (forward)
+    assert float(book.epoch_end()["s"]) == 6.0  # epoch accumulation
+    assert float(book.epoch_end()["s"]) == 0.0  # reset happened
+
+
+def test_collection_logging():
+    book = MetricLogbook()
+    col = MetricCollection([MulticlassAccuracy(num_classes=3, average="micro")])
+    book.update("val", col, jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 1, 1]))
+    out = book.epoch_end()
+    assert float(out["val"]["MulticlassAccuracy"]) == pytest.approx(0.75)
+
+
+def test_epoch_context_manager():
+    book = MetricLogbook()
+    with book.epoch():
+        book.update("m", MeanMetric, jnp.asarray([4.0]))
+    assert float(book.history[-1]["m"]) == 4.0
+    assert book["m"].update_count == 0  # reset on exit
+
+
+def test_optax_training_loop_with_logbook():
+    """A real jitted flax-style train loop: logged loss matches the manual trace."""
+    optax = pytest.importorskip("optax")
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(64, 3).astype(np.float32))
+    true_w = jnp.asarray([[1.0], [-2.0], [0.5]])
+    y = x @ true_w
+
+    params = {"w": jnp.zeros((3, 1))}
+    opt = optax.sgd(0.5)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    book = MetricLogbook()
+    manual = []
+    for epoch in range(3):
+        epoch_losses = []
+        for i in range(0, 64, 16):
+            params, opt_state, loss = step(params, opt_state, x[i : i + 16], y[i : i + 16])
+            book.update("train_mse", MeanMetric, loss)
+            epoch_losses.append(float(loss))
+        book.epoch_end()
+        manual.append(np.mean(epoch_losses))
+    got = [float(h["train_mse"]) for h in book.history]
+    np.testing.assert_allclose(got, manual, rtol=1e-6)
+    assert manual[-1] < manual[0]  # it actually trained
