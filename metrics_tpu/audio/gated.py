@@ -193,8 +193,13 @@ def _resample(audio: np.ndarray, sr_in: int, sr_out: int) -> np.ndarray:
         return audio
     from math import gcd
 
-    from scipy.signal import resample_poly
-
+    try:
+        from scipy.signal import resample_poly
+    except ImportError as err:
+        raise ModuleNotFoundError(
+            f"Resampling {sr_in} Hz input to the model's native {sr_out} Hz requires `scipy`."
+            " Install it, or provide audio at the native rate."
+        ) from err
     g = gcd(sr_in, sr_out)
     return resample_poly(audio, sr_out // g, sr_in // g).astype(np.float32)
 
@@ -253,6 +258,8 @@ class DeepNoiseSuppressionMeanOpinionScore(Metric):
                 ort.InferenceSession(_local_model_path("model_v8.onnx", "DNSMOS (P.808)"), providers=["CPUExecutionProvider"]),
             )
         sess_835, sess_808 = self._sessions
+        if audio.shape[-1] == 0:
+            raise ValueError("DNSMOS received an empty waveform")
         audio = _resample(audio, self.fs, self._FS)
         need = int(self._INPUT_LEN_S * self._FS)
         while audio.shape[-1] < need:

@@ -23,9 +23,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import weakref
+
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import _flatten_dict, allclose
 from metrics_tpu.utils.prints import rank_zero_warn
+
+# Fused leader-update programs. Primary cache: keyed by the tuple of the leaders'
+# static-config keys, so config-equal collections (even short-lived ones) share
+# ONE compilation — the same economics as Metric's shared jit cache. Fallback for
+# unhashable configs: weakly keyed per collection (deepcopy/pickle never see a
+# compiled closure either way).
+_FUSED_SHARED_CACHE: Dict[Any, Any] = {}
+_FUSED_UPDATE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 __all__ = ["MetricCollection"]
 
@@ -203,9 +213,10 @@ class MetricCollection:
             self._groups_checked = False
             self._state_is_copy = False
         if self._groups_checked:
-            for cg in self._groups.values():
-                mi = self._modules[cg[0]]
-                mi.update(*args, **mi._filter_kwargs(**kwargs))
+            if not self._fused_group_update(args, kwargs):
+                for cg in self._groups.values():
+                    mi = self._modules[cg[0]]
+                    mi.update(*args, **mi._filter_kwargs(**kwargs))
             # members share the leader's (immutable) state arrays — zero-copy
             for cg in self._groups.values():
                 leader = self._modules[cg[0]]
@@ -227,6 +238,57 @@ class MetricCollection:
             if self._enable_compute_groups is True:
                 self._merge_compute_groups()
             self._groups_checked = True
+            _FUSED_UPDATE_CACHE.pop(self, None)  # leader set may have changed
+
+    def _fused_group_update(self, args: Tuple, kwargs: Dict) -> bool:
+        """Run ALL group leaders' updates as ONE jitted program (one dispatch, not L).
+
+        Only for the homogeneous hot path: positional array args, every leader
+        jit-eligible with pure-array fixed-shape states. Returns False to fall
+        back to the per-leader loop.
+        """
+        if kwargs or not args:
+            return False
+        leaders = [self._modules[cg[0]] for cg in self._groups.values()]
+        if len(leaders) < 2:
+            return False
+        if any(lm._is_synced for lm in leaders):
+            return False  # the per-leader loop raises the proper synced-state error
+        if any(not lm._jit_eligible(args, {}) for lm in leaders):
+            return False
+        shared_key = tuple(lm._jit_cache_key() for lm in leaders)
+        shareable = all(k is not None for k in shared_key)
+        fused = _FUSED_SHARED_CACHE.get(shared_key) if shareable else _FUSED_UPDATE_CACHE.get(self)
+        if fused is None:
+            # representatives are pristine clones so no live collection is pinned
+            reps = [lm.clone() for lm in leaders] if shareable else leaders
+            for r in (reps if shareable else []):
+                r.reset()
+            fns = [r._functional_update for r in reps]
+
+            def _fused(states, *a):
+                return tuple(fn(s, *a) for fn, s in zip(fns, states))
+
+            fused = jax.jit(_fused)
+            if shareable:
+                _FUSED_SHARED_CACHE[shared_key] = fused
+                if len(_FUSED_SHARED_CACHE) > 64:
+                    _FUSED_SHARED_CACHE.pop(next(iter(_FUSED_SHARED_CACHE)))
+            else:
+                _FUSED_UPDATE_CACHE[self] = fused
+        states = tuple({k: lm._state[k] for k in lm._defaults} for lm in leaders)
+        try:
+            new_states = fused(states, *args)
+        except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
+                jax.errors.TracerIntegerConversionError):
+            _FUSED_UPDATE_CACHE.pop(self, None)
+            return False
+        for lm, ns in zip(leaders, new_states):
+            lm.__dict__["_state"].update(ns)
+            lm._computed = None
+            lm._update_count += 1
+        return True
 
     def _merge_compute_groups(self) -> None:
         """Merge metrics with identical post-update states (reference ``collections.py:264-298``)."""

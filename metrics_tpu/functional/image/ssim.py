@@ -24,6 +24,12 @@ from metrics_tpu.functional.image._helpers import (
 from metrics_tpu.utils.checks import _check_same_shape
 
 
+def _use_pallas() -> bool:
+    from metrics_tpu.ops.ssim_window import use_pallas_window
+
+    return use_pallas_window()
+
+
 def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
     """Shape/dtype validation (reference ``ssim.py:33-43``)."""
     _check_same_shape(preds, target)
@@ -90,7 +96,12 @@ def _ssim_update(
     input_list = jnp.concatenate(
         (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
     )  # (5·B, C, *spatial)
-    outputs = separable_depthwise_conv(input_list, kernels_1d)
+    if not is_3d and _use_pallas():
+        from metrics_tpu.ops.ssim_window import windowed_sum_nchw
+
+        outputs = windowed_sum_nchw(input_list, kernels_1d)
+    else:
+        outputs = separable_depthwise_conv(input_list, kernels_1d)
     b = preds.shape[0]
     mu_pred, mu_target, s_pp, s_tt, s_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
 

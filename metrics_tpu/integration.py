@@ -95,7 +95,9 @@ class MetricLogbook:
             out = metric.compute()
             if isinstance(out, dict):
                 values.update({f"{name}_{k}" if k != name else k: v for k, v in out.items()})
-                values[name] = out
+                # the dict itself is reachable under the bare name unless a member
+                # metric already claimed it (scalar entries win)
+                values.setdefault(name, out)
             else:
                 values[name] = out
         self._history.append(values)

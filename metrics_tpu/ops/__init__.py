@@ -3,10 +3,14 @@
 XLA already fuses the overwhelming majority of this framework's compute (the
 SURVEY §7 design keeps every hot path as fusable jnp/conv/scatter ops). This
 package holds the hand-written kernels for the cases worth owning the schedule:
-currently the SSIM epilogue (``ssim_map``), with the windowed-conv kernel planned
-next (see ``/opt/skills/guides/pallas_guide.md``).
+
+* ``ssim_window`` — the SSIM separable gaussian-window pass (SURVEY P8): both
+  1-D tap loops fused over a VMEM-resident plane; auto-selected on real TPU
+  backends (``METRICS_TPU_SSIM_KERNEL`` overrides).
+* ``ssim_epilogue`` — the fused SSIM elementwise tail (``ssim_map``).
 """
 
 from metrics_tpu.ops.ssim_epilogue import ssim_map_pallas
+from metrics_tpu.ops.ssim_window import ssim_window_pallas, use_pallas_window
 
-__all__ = ["ssim_map_pallas"]
+__all__ = ["ssim_map_pallas", "ssim_window_pallas", "use_pallas_window"]

@@ -1,0 +1,87 @@
+"""Pallas TPU kernel for the SSIM gaussian-window pass (SURVEY P8, BASELINE config 4).
+
+The SSIM hot loop is a separable windowed sum over the stacked
+``(5·B·C, H+K-1, W+K-1)`` planes (pred/target/pred²/target²/pred·target share
+one window). On TPU the XLA fallback is the shifted-slice stencil in
+``functional/image/_helpers.py``; this kernel fuses both 1-D passes over a
+plane held in VMEM, so each input element is read once from HBM and the
+K_h + K_w multiply-adds run on the VPU without intermediate HBM round-trips.
+
+Grid: one program per plane. The window taps are compile-time constants baked
+into the unrolled tap loops (K ≤ ~33 for the SSIM kernels in practice).
+
+Selection is automatic (:func:`use_pallas_window`): compiled Pallas on a real
+TPU backend, interpret mode or the XLA stencil elsewhere; override with
+``METRICS_TPU_SSIM_KERNEL=pallas|stencil``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+__all__ = ["ssim_window_pallas", "use_pallas_window"]
+
+
+def use_pallas_window() -> bool:
+    """Route SSIM's window pass through the Pallas kernel?"""
+    choice = os.environ.get("METRICS_TPU_SSIM_KERNEL", "auto").lower()
+    if choice == "pallas":
+        return True
+    if choice == "stencil":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend probe failed — stay on the XLA path
+        return False
+
+
+def _window_kernel(x_ref, o_ref, *, kh: Tuple[float, ...], kw: Tuple[float, ...], h: int, w: int):
+    """One plane: vertical taps then horizontal taps, fully unrolled in VMEM."""
+    x = x_ref[0]
+    acc = None
+    for i, tap in enumerate(kh):
+        term = x[i : i + h, :] * tap
+        acc = term if acc is None else acc + term
+    out = None
+    for j, tap in enumerate(kw):
+        term = acc[:, j : j + w] * tap
+        out = term if out is None else out + term
+    o_ref[0] = out
+
+
+@functools.partial(jax.jit, static_argnames=("kh", "kw", "interpret"))
+def ssim_window_pallas(x: Array, kh: Tuple[float, ...], kw: Tuple[float, ...], interpret: bool = False) -> Array:
+    """Separable VALID windowed sum over ``(N, H_pad, W_pad)`` planes → ``(N, H, W)``.
+
+    ``kh``/``kw`` are static tap tuples (baked into the kernel); ``interpret``
+    runs the Pallas interpreter (CPU testing).
+    """
+    n, h_pad, w_pad = x.shape
+    h = h_pad - len(kh) + 1
+    w = w_pad - len(kw) + 1
+    kernel = functools.partial(_window_kernel, kh=kh, kw=kw, h=h, w=w)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, h_pad, w_pad), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, h, w), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w), x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def windowed_sum_nchw(x: Array, kernels_1d: Sequence[Array], interpret: bool = False) -> Array:
+    """(B, C, H_pad, W_pad) → (B, C, H, W) through the Pallas kernel."""
+    b, c, h_pad, w_pad = x.shape
+    kh = tuple(float(v) for v in kernels_1d[0])
+    kw = tuple(float(v) for v in kernels_1d[1])
+    flat = x.reshape(b * c, h_pad, w_pad)
+    out = ssim_window_pallas(flat, kh, kw, interpret=interpret)
+    return out.reshape(b, c, out.shape[1], out.shape[2])

@@ -26,6 +26,11 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat
 
+# Jitted compute_flat programs, keyed by (class, static-config) with pristine
+# clone representatives — config-equal instances share one compilation and no
+# live metric is ever pinned by the cache.
+_JITTED_COMPUTE: Dict[Any, Any] = {}
+
 
 def _retrieval_aggregate(values: Array, aggregation: str = "mean", mask: Optional[Array] = None) -> Array:
     """Masked aggregation of per-query scores (reference ``base.py:26-40``).
@@ -45,7 +50,12 @@ def _retrieval_aggregate(values: Array, aggregation: str = "mean", mask: Optiona
         return jnp.where(count > 0, jnp.where(mask, values, jnp.inf).min(), 0.0)
     if aggregation == "max":
         return jnp.where(count > 0, jnp.where(mask, values, -jnp.inf).max(), 0.0)
-    # custom callable: host semantics (not jittable in general)
+    # custom callable: host semantics (not jittable — see compute_flat's docstring)
+    if isinstance(values, jax.core.Tracer) or isinstance(mask, jax.core.Tracer):
+        raise TypeError(
+            "A callable `aggregation` runs host-side and cannot be traced under jit;"
+            " evaluate eagerly (Metric.compute) or use a string aggregation."
+        )
     return aggregation(values[np.asarray(mask)])
 
 
@@ -68,12 +78,19 @@ class GroupedQueries:
         preds = jnp.asarray(preds)
         target = jnp.asarray(target)
         n = int(preds.shape[0])
-        self.num_groups = n  # static bound; true group count is dynamic
         order = jnp.lexsort((-preds.astype(jnp.float32), indexes))
         self.order = order
         idx_sorted = indexes[order]
         new_group = jnp.concatenate([jnp.ones(1, bool), idx_sorted[1:] != idx_sorted[:-1]]) if n else jnp.zeros(0, bool)
         g = jnp.cumsum(new_group) - 1
+        if isinstance(new_group, jax.core.Tracer):
+            # under jit the group count is dynamic → static upper bound n; padding
+            # groups have n_docs == 0 and are masked out of every aggregation
+            self.num_groups = n
+        else:
+            # eager: one cheap host sync buys segment arrays sized to the TRUE
+            # group count instead of n (often 100× smaller)
+            self.num_groups = int(new_group.sum()) if n else 0
         self.group_id = g
         self.preds = preds[order]
         self.graded = target[order].astype(jnp.float32)
@@ -173,7 +190,25 @@ class RetrievalMetric(Metric):
             n_rel = np.bincount(compact, weights=np.asarray(target) > 0)
             if bool((self._empty_counts_host(n_rel, np.bincount(compact))).any()):
                 raise ValueError(self._empty_error_msg)
-        return self.compute_flat(preds, target, indexes)
+        if callable(self.aggregation) and not isinstance(self.aggregation, str):
+            return self.compute_flat(preds, target, indexes)  # host-side aggregation
+        # ONE compiled program for grouping + scoring + aggregation: ~3× faster
+        # than the eager op-by-op path even with the static n-bound segments.
+        # Keyed by static config with a pristine-clone representative (same
+        # economics as Metric._lookup_shared_jit) so live instances — and their
+        # accumulated list states — are never pinned by the cache.
+        key = self._jit_cache_key()
+        if key is None:
+            return self.compute_flat(preds, target, indexes)
+        jitted = _JITTED_COMPUTE.get(key)
+        if jitted is None:
+            rep = self.clone()
+            rep.reset()
+            jitted = jax.jit(rep.compute_flat)
+            _JITTED_COMPUTE[key] = jitted
+            if len(_JITTED_COMPUTE) > 128:
+                _JITTED_COMPUTE.pop(next(iter(_JITTED_COMPUTE)))
+        return jitted(preds, target, indexes)
 
     @staticmethod
     def _empty_counts_host(n_rel: "np.ndarray", n_docs: "np.ndarray") -> "np.ndarray":
@@ -185,7 +220,9 @@ class RetrievalMetric(Metric):
         eval step to run grouping, scoring and aggregation as ONE XLA program.
 
         ``empty_target_action="error"`` is treated as "neg" here (a data-dependent
-        raise cannot trace); the eager :meth:`compute` performs the raise.
+        raise cannot trace); the eager :meth:`compute` performs the raise. A
+        CALLABLE ``aggregation`` is host-side and not jittable — only the string
+        aggregations trace; call this eagerly (or use :meth:`compute`) otherwise.
         """
         if preds.shape[0] == 0:
             return jnp.asarray(0.0)

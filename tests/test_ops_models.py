@@ -54,3 +54,44 @@ def test_load_feature_extractor_offline_errors(tmp_path, monkeypatch):
         load_feature_extractor("inception_v3", weights_dir=str(tmp_path))
     with pytest.raises(ValueError, match="Unknown backbone"):
         load_feature_extractor("not_a_model", weights_dir=str(tmp_path))
+
+
+def test_pallas_ssim_window_matches_stencil():
+    """Interpret-mode Pallas window pass == the XLA shifted-slice stencil."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.image._helpers import _gaussian, separable_depthwise_conv
+    from metrics_tpu.ops.ssim_window import ssim_window_pallas, windowed_sum_nchw
+
+    rng = np.random.RandomState(0)
+    k1 = _gaussian(11, 1.5)[0]
+    x = jnp.asarray(rng.rand(4, 3, 42, 74).astype(np.float32))
+    want = separable_depthwise_conv(x, [k1, k1])
+    got = windowed_sum_nchw(x, [k1, k1], interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=1e-6)
+
+    # plane-level entry point with asymmetric taps
+    k2 = _gaussian(5, 0.8)[0]
+    planes = jnp.asarray(rng.rand(6, 20, 40).astype(np.float32))
+    want2 = separable_depthwise_conv(planes[:, None], [k1, k2])[:, 0]
+    got2 = ssim_window_pallas(planes, tuple(float(v) for v in k1), tuple(float(v) for v in k2), interpret=True)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), rtol=2e-5, atol=1e-6)
+
+
+def test_ssim_through_pallas_kernel_matches_default(monkeypatch):
+    """Full SSIM routed through the Pallas kernel (interpret) == the stencil path."""
+    import jax.numpy as jnp
+
+    import metrics_tpu.ops.ssim_window as win
+    from metrics_tpu.functional.image.ssim import structural_similarity_index_measure
+
+    rng = np.random.RandomState(1)
+    a = jnp.asarray(rng.rand(2, 3, 48, 48).astype(np.float32))
+    b = jnp.asarray((rng.rand(2, 3, 48, 48) * 0.1 + np.asarray(a) * 0.9).astype(np.float32))
+    base = float(structural_similarity_index_measure(a, b, data_range=1.0))
+
+    monkeypatch.setenv("METRICS_TPU_SSIM_KERNEL", "pallas")
+    orig = win.ssim_window_pallas
+    monkeypatch.setattr(win, "ssim_window_pallas", lambda x, kh, kw, interpret=False: orig(x, kh, kw, interpret=True))
+    via_pallas = float(structural_similarity_index_measure(a, b, data_range=1.0))
+    np.testing.assert_allclose(via_pallas, base, rtol=1e-5)
