@@ -46,13 +46,33 @@ def _runs_from_mask(mask: np.ndarray) -> np.ndarray:
     return runs.astype(np.int64)
 
 
+def _native():
+    from metrics_tpu.native import load_rle_codec
+
+    return load_rle_codec()
+
+
 def compress_counts(counts: Sequence[int]) -> bytes:
     """Encode run lengths into the COCO compressed string form.
 
     Each value (delta-coded against the count two positions back, from the third
     on) is written as little-endian 5-bit groups with a continuation bit, offset
-    into printable ASCII by 48.
+    into printable ASCII by 48. Byte-level loop runs in the native codec when
+    available (``metrics_tpu/native/rle_codec.cpp``), pure Python otherwise.
     """
+    lib = _native()
+    if lib is not None:
+        import ctypes
+
+        arr = np.ascontiguousarray(counts, dtype=np.int64)
+        # worst case 13 output bytes per value: ceil(64 data bits / 5 bits-per-group)
+        out = np.empty(max(13 * len(arr), 16), dtype=np.uint8)
+        n = lib.rle_compress_counts(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            len(arr),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        )
+        return out[:n].tobytes()
     out = bytearray()
     counts = list(int(c) for c in counts)
     for i, c in enumerate(counts):
@@ -73,6 +93,21 @@ def decompress_counts(data: Union[bytes, str]) -> np.ndarray:
     """Decode the COCO compressed string form back into run lengths."""
     if isinstance(data, str):
         data = data.encode("ascii")
+    if data and ((data[-1] - 48) & 0x20):
+        # uniform behavior across native/Python paths for corrupt input
+        raise ValueError("truncated RLE counts string: final byte has the continuation bit set")
+    lib = _native()
+    if lib is not None and data:
+        import ctypes
+
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(len(buf), dtype=np.int64)
+        n = lib.rle_decompress_counts(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            len(buf),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        )
+        return out[:n].copy()
     counts: List[int] = []
     pos = 0
     n = len(data)

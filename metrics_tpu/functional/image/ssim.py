@@ -97,9 +97,13 @@ def _ssim_update(
         (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
     )  # (5·B, C, *spatial)
     if not is_3d and _use_pallas():
+        import jax
+
         from metrics_tpu.ops.ssim_window import windowed_sum_nchw
 
-        outputs = windowed_sum_nchw(input_list, kernels_1d)
+        # compiled Pallas needs a real TPU; forcing the kernel elsewhere runs the interpreter
+        interpret = jax.default_backend() != "tpu"
+        outputs = windowed_sum_nchw(input_list, kernels_1d, interpret=interpret)
     else:
         outputs = separable_depthwise_conv(input_list, kernels_1d)
     b = preds.shape[0]

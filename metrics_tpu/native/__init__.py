@@ -1,0 +1,68 @@
+"""Native (C++) host helpers, loaded via ctypes with build-on-demand.
+
+The compute path of this framework is jax/XLA/Pallas; the runtime around it
+uses native code where the reference leaned on C/C++ dependencies (SURVEY
+§2.9: pycocotools' codec loops). The shared library is compiled once from the
+in-tree source with the system compiler and cached beside it; everything has a
+pure-numpy fallback, so the package works without any toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+__all__ = ["load_rle_codec"]
+
+_SRC = os.path.join(os.path.dirname(__file__), "rle_codec.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), f"_rle_codec_{sys.platform}.so")
+_lock = threading.Lock()
+_lib_cache: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    for cc in ("g++", "clang++", "c++"):
+        try:
+            proc = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                capture_output=True, timeout=120,
+            )
+            if proc.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def load_rle_codec() -> Optional[ctypes.CDLL]:
+    """The compiled codec library, building it on first use; None if unavailable."""
+    global _lib_cache, _build_failed
+    if _lib_cache is not None or _build_failed:
+        return _lib_cache
+    with _lock:
+        if _lib_cache is not None or _build_failed:
+            return _lib_cache
+        if not os.path.exists(_LIB) and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build_failed = True
+            return None
+        ll = ctypes.c_longlong
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        llp = ctypes.POINTER(ll)
+        lib.rle_compress_counts.restype = ll
+        lib.rle_compress_counts.argtypes = [llp, ll, u8p]
+        lib.rle_decompress_counts.restype = ll
+        lib.rle_decompress_counts.argtypes = [u8p, ll, llp]
+        lib.rle_expand.restype = ctypes.c_int
+        lib.rle_expand.argtypes = [llp, ll, ll, u8p]
+        _lib_cache = lib
+        return lib

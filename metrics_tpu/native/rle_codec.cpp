@@ -1,0 +1,70 @@
+// COCO RLE codec hot loops, C ABI for ctypes (see metrics_tpu/native/__init__.py).
+//
+// The reference reaches equivalent functionality through pycocotools' C
+// extension (SURVEY §2.9); here the array math of the codec lives in numpy
+// (already vectorized) and ONLY the genuinely loopy byte-level parts are
+// native: the LEB128-style compressed-counts string codec and a batch
+// run-expansion used when decoding many masks at once.
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// Encode run lengths into the COCO compressed string form.
+// counts[n] -> out bytes; returns number of bytes written (out must hold 8*n).
+long long rle_compress_counts(const long long* counts, long long n, unsigned char* out) {
+    long long pos = 0;
+    for (long long i = 0; i < n; ++i) {
+        long long x = counts[i];
+        if (i > 2) x -= counts[i - 2];  // delta against two back, from the third on
+        bool more = true;
+        while (more) {
+            long long bits = x & 0x1f;
+            x >>= 5;
+            more = !((x == 0 && !(bits & 0x10)) || (x == -1 && (bits & 0x10)));
+            if (more) bits |= 0x20;
+            out[pos++] = (unsigned char)(bits + 48);
+        }
+    }
+    return pos;
+}
+
+// Decode the compressed string form back into run lengths.
+// data[len] -> counts_out; returns number of counts (counts_out must hold len).
+long long rle_decompress_counts(const unsigned char* data, long long len, long long* counts_out) {
+    long long n = 0;
+    long long pos = 0;
+    while (pos < len) {
+        long long x = 0;
+        int k = 0;
+        bool more = true;
+        while (more && pos < len) {
+            long long byte = (long long)data[pos] - 48;
+            x |= (byte & 0x1f) << (5 * k);
+            more = (byte & 0x20) != 0;
+            ++pos;
+            ++k;
+            if (!more && (byte & 0x10)) x |= -1LL << (5 * k);
+        }
+        if (n > 2) x += counts_out[n - 2];
+        counts_out[n++] = x;
+    }
+    return n;
+}
+
+// Expand run lengths into a column-major binary plane (one mask).
+// Returns 0 on success, -1 if runs do not sum to h*w.
+int rle_expand(const long long* counts, long long n, long long hw, unsigned char* plane) {
+    long long idx = 0;
+    unsigned char val = 0;
+    for (long long i = 0; i < n; ++i) {
+        long long run = counts[i];
+        if (idx + run > hw) return -1;
+        for (long long j = 0; j < run; ++j) plane[idx++] = val;
+        val = 1 - val;
+    }
+    return idx == hw ? 0 : -1;
+}
+
+}  // extern "C"

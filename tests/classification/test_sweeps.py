@@ -32,11 +32,13 @@ from metrics_tpu.functional.classification import (
 
 NUM_CLASSES = 5
 NUM_LABELS = 4
-_rng = np.random.RandomState(1234)
+def _fresh_rng(*key):
+    import zlib
+
+    return np.random.RandomState(zlib.crc32(repr(key).encode()) % (2**31))
 
 
-def _inject_ignore(target, ignore_index, frac=0.2, rng=None):
-    rng = rng or _rng
+def _inject_ignore(target, ignore_index, rng, frac=0.2):
     out = target.copy()
     mask = rng.rand(*target.shape) < frac
     out[mask] = ignore_index
@@ -47,10 +49,11 @@ def _inject_ignore(target, ignore_index, frac=0.2, rng=None):
 @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
 @pytest.mark.parametrize("ignore_index", [None, -1, 0])
 def test_multiclass_precision_recall_f1_sweep(average, ignore_index):
-    preds = _rng.randint(0, NUM_CLASSES, 200)
-    target = _rng.randint(0, NUM_CLASSES, 200)
+    rng = _fresh_rng("test_multiclass_precision_recall_f1_sweep", average, ignore_index)
+    preds = rng.randint(0, NUM_CLASSES, 200)
+    target = rng.randint(0, NUM_CLASSES, 200)
     if ignore_index is not None:
-        target, _ = _inject_ignore(target, ignore_index)
+        target, _ = _inject_ignore(target, ignore_index, rng)
         # ALL positions whose target equals ignore_index are dropped — including
         # genuine ones when ignore_index collides with a real class id
         keep = target != ignore_index
@@ -72,9 +75,10 @@ def test_multiclass_precision_recall_f1_sweep(average, ignore_index):
 @pytest.mark.parametrize("top_k", [1, 2, 3])
 @pytest.mark.parametrize("average", ["micro", "macro"])
 def test_multiclass_accuracy_top_k_sweep(top_k, average):
-    preds = _rng.rand(150, NUM_CLASSES).astype(np.float32)
+    rng = _fresh_rng("test_multiclass_accuracy_top_k_sweep", top_k, average)
+    preds = rng.rand(150, NUM_CLASSES).astype(np.float32)
     preds /= preds.sum(1, keepdims=True)
-    target = _rng.randint(0, NUM_CLASSES, 150)
+    target = rng.randint(0, NUM_CLASSES, 150)
     got = float(multiclass_accuracy(jnp.asarray(preds), jnp.asarray(target),
                                     num_classes=NUM_CLASSES, average=average, top_k=top_k))
     topk_sets = np.argsort(-preds, axis=1)[:, :top_k]
@@ -89,10 +93,11 @@ def test_multiclass_accuracy_top_k_sweep(top_k, average):
 @pytest.mark.parametrize("multidim_average", ["global", "samplewise"])
 @pytest.mark.parametrize("ignore_index", [None, 0])
 def test_multiclass_stat_scores_multidim_sweep(multidim_average, ignore_index):
-    preds = _rng.randint(0, NUM_CLASSES, (12, 25))
-    target = _rng.randint(0, NUM_CLASSES, (12, 25))
+    rng = _fresh_rng("test_multiclass_stat_scores_multidim_sweep", multidim_average, ignore_index)
+    preds = rng.randint(0, NUM_CLASSES, (12, 25))
+    target = rng.randint(0, NUM_CLASSES, (12, 25))
     if ignore_index is not None:
-        target, _ = _inject_ignore(target, ignore_index)
+        target, _ = _inject_ignore(target, ignore_index, rng)
     got = np.asarray(multiclass_stat_scores(
         jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES,
         average=None, multidim_average=multidim_average, ignore_index=ignore_index,
@@ -121,10 +126,11 @@ def test_multiclass_stat_scores_multidim_sweep(multidim_average, ignore_index):
 @pytest.mark.parametrize("average", ["micro", "macro"])
 @pytest.mark.parametrize("ignore_index", [None, -1])
 def test_multilabel_f1_sweep(average, ignore_index):
-    preds = (_rng.rand(120, NUM_LABELS) > 0.5).astype(np.int64)
-    target = _rng.randint(0, 2, (120, NUM_LABELS))
+    rng = _fresh_rng("test_multilabel_f1_sweep", average, ignore_index)
+    preds = (rng.rand(120, NUM_LABELS) > 0.5).astype(np.int64)
+    target = rng.randint(0, 2, (120, NUM_LABELS))
     if ignore_index is not None:
-        target, keep = _inject_ignore(target, ignore_index)
+        target, keep = _inject_ignore(target, ignore_index, rng)
     got = float(multilabel_f1_score(jnp.asarray(preds), jnp.asarray(target),
                                     num_labels=NUM_LABELS, average=average, ignore_index=ignore_index))
     # sklearn equivalent: per-label filtering of ignored positions
@@ -145,10 +151,11 @@ def test_multilabel_f1_sweep(average, ignore_index):
 @pytest.mark.parametrize("ignore_index", [None, -1])
 @pytest.mark.parametrize("thresholds", [None, 200])
 def test_binary_auroc_ap_sweep(ignore_index, thresholds):
-    preds = _rng.rand(300).astype(np.float64)
-    target = (_rng.rand(300) < 0.4).astype(np.int64)
+    rng = _fresh_rng("test_binary_auroc_ap_sweep", ignore_index, thresholds)
+    preds = rng.rand(300).astype(np.float64)
+    target = (rng.rand(300) < 0.4).astype(np.int64)
     if ignore_index is not None:
-        target, keep = _inject_ignore(target, ignore_index)
+        target, keep = _inject_ignore(target, ignore_index, rng)
     else:
         keep = np.ones_like(target, bool)
     got_auroc = float(binary_auroc(jnp.asarray(preds), jnp.asarray(target),
@@ -162,8 +169,9 @@ def test_binary_auroc_ap_sweep(ignore_index, thresholds):
 
 @pytest.mark.parametrize("multidim_average", ["global", "samplewise"])
 def test_binary_stat_scores_multidim(multidim_average):
-    preds = _rng.randint(0, 2, (8, 30))
-    target = _rng.randint(0, 2, (8, 30))
+    rng = _fresh_rng("test_binary_stat_scores_multidim", multidim_average)
+    preds = rng.randint(0, 2, (8, 30))
+    target = rng.randint(0, 2, (8, 30))
     got = np.asarray(binary_stat_scores(jnp.asarray(preds), jnp.asarray(target),
                                         multidim_average=multidim_average))
 
@@ -178,3 +186,58 @@ def test_binary_stat_scores_multidim(multidim_average):
         np.testing.assert_array_equal(got, counts(preds.ravel(), target.ravel()))
     else:
         np.testing.assert_array_equal(got, np.stack([counts(p, t) for p, t in zip(preds, target)]))
+
+
+# --------------------------------------------------- multiclass/multilabel curves
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+@pytest.mark.parametrize("thresholds", [None, 150])
+def test_multiclass_auroc_sweep(average, thresholds):
+    rng = _fresh_rng("test_multiclass_auroc_sweep", average, thresholds)
+    from sklearn.metrics import roc_auc_score as sk_auroc
+
+    from metrics_tpu.functional.classification import multiclass_auroc
+
+    preds = rng.rand(250, NUM_CLASSES).astype(np.float64)
+    preds /= preds.sum(1, keepdims=True)
+    target = rng.randint(0, NUM_CLASSES, 250)
+    got = float(multiclass_auroc(jnp.asarray(preds), jnp.asarray(target),
+                                 num_classes=NUM_CLASSES, average=average, thresholds=thresholds))
+    want = sk_auroc(target, preds, multi_class="ovr", average=average, labels=list(range(NUM_CLASSES)))
+    tol = 1e-5 if thresholds is None else 0.02
+    np.testing.assert_allclose(got, want, atol=tol)
+
+
+@pytest.mark.parametrize("thresholds", [None, 150])
+def test_multilabel_average_precision_sweep(thresholds):
+    rng = _fresh_rng("test_multilabel_average_precision_sweep", thresholds)
+    from sklearn.metrics import average_precision_score as sk_ap
+
+    from metrics_tpu.functional.classification import multilabel_average_precision
+
+    preds = rng.rand(250, NUM_LABELS).astype(np.float64)
+    target = (rng.rand(250, NUM_LABELS) < 0.35).astype(np.int64)
+    got = float(multilabel_average_precision(jnp.asarray(preds), jnp.asarray(target),
+                                             num_labels=NUM_LABELS, average="macro", thresholds=thresholds))
+    want = np.mean([sk_ap(target[:, l], preds[:, l]) for l in range(NUM_LABELS)])
+    tol = 1e-5 if thresholds is None else 0.02
+    np.testing.assert_allclose(got, want, atol=tol)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_multiclass_average_precision_ignore_sweep(ignore_index):
+    rng = _fresh_rng("test_multiclass_average_precision_ignore_sweep", ignore_index)
+    from sklearn.metrics import average_precision_score as sk_ap
+
+    from metrics_tpu.functional.classification import multiclass_average_precision
+
+    preds = rng.rand(250, NUM_CLASSES).astype(np.float64)
+    preds /= preds.sum(1, keepdims=True)
+    target = rng.randint(0, NUM_CLASSES, 250)
+    if ignore_index is not None:
+        target, keep = _inject_ignore(target, ignore_index, rng)
+    else:
+        keep = np.ones_like(target, bool)
+    got = float(multiclass_average_precision(jnp.asarray(preds), jnp.asarray(target),
+                                             num_classes=NUM_CLASSES, average="macro", ignore_index=ignore_index))
+    want = np.mean([sk_ap((target[keep] == c).astype(int), preds[keep, c]) for c in range(NUM_CLASSES)])
+    np.testing.assert_allclose(got, want, atol=1e-5)
