@@ -26,7 +26,9 @@ import numpy as np
 import weakref
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import _flatten_dict, allclose
+from jax import Array
+
+from metrics_tpu.utils.data import _flatten_dict
 from metrics_tpu.utils.prints import rank_zero_warn
 
 # Fused leader-update programs. Primary cache: keyed by the tuple of the leaders'
@@ -37,7 +39,21 @@ from metrics_tpu.utils.prints import rank_zero_warn
 _FUSED_SHARED_CACHE: Dict[Any, Any] = {}
 _FUSED_UPDATE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
-__all__ = ["MetricCollection"]
+__all__ = ["CollectionFunctions", "MetricCollection"]
+
+
+class CollectionFunctions:
+    """Pure ``(init, update, compute)`` triple for a whole :class:`MetricCollection`.
+
+    Mirrors :class:`metrics_tpu.metric.MetricFunctions` at collection scope;
+    state is a ``{leader_name: state_pytree}`` dict, so the triple composes with
+    ``jax.jit`` / ``lax.scan`` / ``shard_map`` like any other pytree program.
+    """
+
+    def __init__(self, init, update, compute):
+        self.init = init
+        self.update = update
+        self.compute = compute
 
 
 class MetricCollection:
@@ -291,16 +307,24 @@ class MetricCollection:
         return True
 
     def _merge_compute_groups(self) -> None:
-        """Merge metrics with identical post-update states (reference ``collections.py:264-298``)."""
+        """Merge metrics with identical post-update states (reference ``collections.py:264-298``).
+
+        Merging never mutates the leaders' states, so the pairwise equality
+        matrix over the current leader set is computed up front — all value
+        comparisons run as async device ops and ONE host fetch resolves every
+        pair. On high-latency devices (a tunneled TPU) this replaces a
+        ~70 ms device→host sync per comparison with a single sync total.
+        """
+        keys = list(self._groups.keys())
+        leaders = {k: self._modules[self._groups[k][0]] for k in keys}
+        equal = self._pairwise_equal_states(keys, leaders)
         num_groups = len(self._groups)
         while True:
-            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
-                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+            for cg_idx1 in list(self._groups):
+                for cg_idx2 in list(self._groups):
                     if cg_idx1 == cg_idx2:
                         continue
-                    metric1 = self._modules[cg_members1[0]]
-                    metric2 = self._modules[cg_members2[0]]
-                    if self._equal_metric_states(metric1, metric2):
+                    if equal[(cg_idx1, cg_idx2)]:
                         self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
                         break
                 else:
@@ -313,26 +337,64 @@ class MetricCollection:
             num_groups = len(self._groups)
         self._groups = {i: v for i, v in enumerate(self._groups.values())}
 
+    @classmethod
+    def _pairwise_equal_states(cls, keys: List, leaders: Dict) -> Dict:
+        """Equality over all leader pairs with at most one device→host sync."""
+        equal: Dict = {}
+        pending: List = []  # (key-pair, 0-d bool device array)
+        for i, k1 in enumerate(keys):
+            for k2 in keys[i + 1 :]:
+                verdict = cls._structural_equal_states(leaders[k1], leaders[k2])
+                if verdict is None:
+                    pending.append(((k1, k2), cls._value_equal_device(leaders[k1], leaders[k2])))
+                    continue
+                equal[(k1, k2)] = equal[(k2, k1)] = verdict
+        if pending:
+            flat = np.asarray(jnp.stack([arr for _, arr in pending]))  # one fetch
+            for ((k1, k2), _), ok in zip(pending, flat):
+                equal[(k1, k2)] = equal[(k2, k1)] = bool(ok)
+        return equal
+
     @staticmethod
-    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
-        """Check whether two metrics have identical states (reference ``collections.py:300-323``)."""
+    def _structural_equal_states(metric1: Metric, metric2: Metric) -> Optional[bool]:
+        """Host-side part of the state equality check (reference ``collections.py:300-323``).
+
+        Returns False on any structural mismatch, True when states are the very
+        same arrays, and None when a device value comparison is still needed.
+        """
         if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
             return False
         if metric1._defaults.keys() != metric2._defaults.keys():
             return False
+        all_shared = True
         for key in metric1._defaults:
             s1, s2 = metric1._state[key], metric2._state[key]
             if type(s1) != type(s2):  # noqa: E721
                 return False
             if isinstance(s1, list):
-                if len(s1) != len(s2):
+                if len(s1) != len(s2) or any(x.shape != y.shape for x, y in zip(s1, s2)):
                     return False
-                if not all(x.shape == y.shape and allclose(x, y) for x, y in zip(s1, s2)):
-                    return False
+                all_shared = all_shared and all(x is y for x, y in zip(s1, s2))
             else:
-                if s1.shape != s2.shape or not allclose(s1, s2):
+                if s1.shape != s2.shape:
                     return False
-        return True
+                all_shared = all_shared and s1 is s2
+        return True if all_shared else None
+
+    @staticmethod
+    def _value_equal_device(metric1: Metric, metric2: Metric) -> Array:
+        """0-d bool array: all states allclose (async — caller batches the fetch)."""
+        checks = []
+        for key in metric1._defaults:
+            s1, s2 = metric1._state[key], metric2._state[key]
+            pairs = zip(s1, s2) if isinstance(s1, list) else [(s1, s2)]
+            for x, y in pairs:
+                if x.dtype != y.dtype:
+                    y = y.astype(x.dtype)
+                checks.append(jnp.allclose(x, y))
+        if not checks:
+            return jnp.asarray(True)
+        return jnp.stack(checks).all()
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Call forward on each metric, returning batch values (reference ``collections.py:222-229``)."""
@@ -351,6 +413,40 @@ class MetricCollection:
         """Compute the result for each metric (reference ``collections.py:345-347``)."""
         return self._compute_and_reduce("compute")
 
+    def functional(self) -> "CollectionFunctions":
+        """Pure ``(init, update, compute)`` over the whole collection for jit/scan use.
+
+        The TPU-native deployment of a collection: embed ``update`` in a jitted
+        eval step (or ``lax.scan`` over a batch stream) and carry one state
+        pytree. When compute groups have been detected (after the first eager
+        ``update``) only one state per group is carried and updated; before
+        detection every metric carries its own state — XLA's CSE then dedupes
+        the identical group-mate updates inside the compiled program, which is
+        the compiler-native form of the reference's compute-group sharing
+        (reference ``collections.py:231-298``).
+        """
+        names = list(self._modules)
+        if self._groups_checked:
+            leader_of = {n: cg[0] for cg in self._groups.values() for n in cg}
+        else:
+            leader_of = {n: n for n in names}
+        leaders = sorted({leader_of[n] for n in names}, key=names.index)
+        lead_fns = {n: self._modules[n].functional() for n in leaders}
+        member_fns = {n: (self._modules[n].functional() if n not in lead_fns else lead_fns[n]) for n in names}
+        filters = {n: self._modules[n]._filter_kwargs for n in leaders}
+
+        def init() -> Dict[str, Any]:
+            return {n: lead_fns[n].init() for n in leaders}
+
+        def update(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+            return {n: lead_fns[n].update(state[n], *args, **filters[n](**kwargs)) for n in leaders}
+
+        def compute(state: Dict[str, Any]) -> Dict[str, Any]:
+            result = {n: member_fns[n].compute(state[leader_of[n]]) for n in names}
+            return self._flatten_results(result)
+
+        return CollectionFunctions(init=init, update=update, compute=compute)
+
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Run compute/forward per metric and flatten outputs (reference ``collections.py:349-394``)."""
         result = {}
@@ -360,6 +456,11 @@ class MetricCollection:
             else:
                 res = m(*args, **m._filter_kwargs(**kwargs))
             result[k] = res
+        return self._flatten_results(result)
+
+    def _flatten_results(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        """Flatten per-metric results into one dict — shared by the eager and
+        functional compute paths so both emit identical key sets."""
         _, duplicates = _flatten_dict(result)
         flat_result = {}
         for k, res in result.items():

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -241,7 +242,9 @@ class MeanAveragePrecision(Metric):
                 db[i, : len(u["didx"])] = self.detection_box[u["img"]][u["didx"]]
                 gb[i, : len(u["gidx"])] = self.gt_box[u["img"]][u["gidx"]]
                 gc[i, : len(u["gidx"])] = u["gt_crowd"]
-            return np.asarray(batched_box_iou_jit(jnp.asarray(db), jnp.asarray(gb), jnp.asarray(gc)))
+            # stays on device: the caller feeds this straight into the matching
+            # kernel and fetches IoUs + match results with ONE device→host sync
+            return batched_box_iou_jit(jnp.asarray(db), jnp.asarray(gb), jnp.asarray(gc))
 
         ious = np.zeros((u_n, d_cap, g_cap))
         by_shape: Dict[Tuple[int, int], List[int]] = {}
@@ -316,9 +319,9 @@ class MeanAveragePrecision(Metric):
             u_n = _next_capacity(len(chunk), quantum=32)
             d_cap = _next_capacity(max((len(u["didx"]) for u in chunk), default=1))
             g_cap = _next_capacity(max((len(u["gidx"]) for u in chunk), default=1))
-            ious = self._unit_ious(chunk, i_type, d_cap, g_cap)
-            if ious.shape[0] < u_n:
-                ious = np.concatenate([ious, np.zeros((u_n - ious.shape[0], d_cap, g_cap))])
+            ious_j = jnp.asarray(self._unit_ious(chunk, i_type, d_cap, g_cap))
+            if ious_j.shape[0] < u_n:
+                ious_j = jnp.concatenate([ious_j, jnp.zeros((u_n - ious_j.shape[0], d_cap, g_cap), ious_j.dtype)])
             det_valid = np.zeros((u_n, d_cap), bool)
             gt_valid = np.zeros((u_n, g_cap), bool)
             gt_crowd = np.zeros((u_n, g_cap), bool)
@@ -333,7 +336,7 @@ class MeanAveragePrecision(Metric):
                 gt_ignore[row, :, :ng] = u["gt_crowd"][None, :] | out_rng_gt
                 det_oor[row, :, :nd] = (u["det_areas"][None, :] < ranges[:, :1]) | (u["det_areas"][None, :] > ranges[:, 1:])
             dtm_c, dtig_c = match_units_jit(
-                jnp.asarray(ious),
+                ious_j,
                 jnp.asarray(gt_valid),
                 jnp.asarray(gt_crowd),
                 jnp.asarray(gt_ignore),
@@ -341,8 +344,8 @@ class MeanAveragePrecision(Metric):
                 jnp.asarray(det_oor),
                 jnp.asarray(iou_thrs),
             )
-            dtm_c = np.asarray(dtm_c)  # (u, A, T, D)
-            dtig_c = np.asarray(dtig_c)
+            # (u, A, T, D) + (u, D, G): everything this chunk needs, one sync
+            ious, dtm_c, dtig_c = jax.device_get((ious_j, dtm_c, dtig_c))
             for row, i in enumerate(sel_idx):
                 nd, ng = len(units[i]["didx"]), len(units[i]["gidx"])
                 unit_dtm[i] = dtm_c[row, :, :, :nd]

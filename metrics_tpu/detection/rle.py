@@ -107,6 +107,8 @@ def decompress_counts(data: Union[bytes, str]) -> np.ndarray:
             len(buf),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
         )
+        if n < 0:
+            raise ValueError("malformed RLE counts string: value wider than 13 5-bit groups")
         return out[:n].copy()
     counts: List[int] = []
     pos = 0
@@ -116,13 +118,20 @@ def decompress_counts(data: Union[bytes, str]) -> np.ndarray:
         k = 0
         more = True
         while more:
+            if k >= 13:  # int64 maximum — same bound as the native codec
+                raise ValueError("malformed RLE counts string: value wider than 13 5-bit groups")
             byte = data[pos] - 48
-            x |= (byte & 0x1F) << (5 * k)
+            if 5 * k < 64:
+                x |= (byte & 0x1F) << (5 * k)
             more = bool(byte & 0x20)
             pos += 1
             k += 1
-            if not more and (byte & 0x10):
+            if not more and (byte & 0x10) and 5 * k < 64:
                 x |= -1 << (5 * k)  # sign-extend
+        # int64 wraparound semantics, matching the native codec exactly
+        x &= (1 << 64) - 1
+        if x >= 1 << 63:
+            x -= 1 << 64
         if len(counts) > 2:
             x += counts[-2]
         counts.append(x)
@@ -163,6 +172,21 @@ def rle_to_mask(rle: RLE) -> np.ndarray:
     """
     h, w = (int(s) for s in rle["size"])
     counts = _counts_of(rle)
+    lib = _native()
+    if lib is not None:
+        import ctypes
+
+        c = np.ascontiguousarray(counts, dtype=np.int64)
+        flat = np.empty(h * w, dtype=np.uint8)
+        rc = lib.rle_expand(
+            c.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            len(c),
+            h * w,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        )
+        if rc != 0:
+            raise ValueError(f"RLE counts sum to {int(counts.sum())}, expected {h * w}")
+        return flat.reshape((w, h)).T  # column-major layout
     vals = np.zeros(len(counts), dtype=np.uint8)
     vals[1::2] = 1
     flat = np.repeat(vals, counts)

@@ -88,7 +88,7 @@ def _binary_auroc_compute(
 ) -> Array:
     """AUROC with optional partial-AUC McClish correction (reference ``auroc.py:83-107``)."""
     fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
-    if max_fpr is None or max_fpr == 1 or bool(jnp.sum(fpr) == 0) or bool(jnp.sum(tpr) == 0):
+    if max_fpr is None or max_fpr == 1 or bool((jnp.sum(fpr) == 0) | (jnp.sum(tpr) == 0)):
         return _auc_compute_without_check(fpr, tpr, 1.0)
 
     max_area = jnp.asarray(max_fpr, dtype=fpr.dtype)

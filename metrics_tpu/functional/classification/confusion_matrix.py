@@ -1,8 +1,9 @@
 """Confusion-matrix kernels (reference ``functional/classification/confusion_matrix.py``).
 
-The update is ONE static-shape scatter-add: ``bincount(target*C + preds)`` with a
-dead overflow bin for ``ignore_index`` entries (replacing the reference's dynamic
-boolean filtering, ``confusion_matrix.py:141-146,316-321``) — the XLA-native form.
+The update is ONE static-shape ``bincount(target*C + preds)`` with a dead
+overflow bin for ``ignore_index`` entries (replacing the reference's dynamic
+boolean filtering, ``confusion_matrix.py:141-146,316-321``); the count itself is
+an MXU ``ones @ one_hot`` matmul (``utils/data.py::bincount``) — the TPU-native form.
 """
 
 from __future__ import annotations

@@ -8,8 +8,9 @@ Capability parity with reference ``functional/classification/stat_scores.py``
   boolean indexing; here ignored positions are *masked* (targets routed to a dead
   bin / one-hot rows poisoned with ``-1``), so every op keeps static shapes and the
   whole update jits into one executable.
-* **Confusion-matrix path uses one scatter-add** (``bincount`` of ``target*C+preds``
-  with a C²+1-th dead bin for ignored entries).
+* **Confusion-matrix path is one MXU matmul-bincount** (``bincount`` of
+  ``target*C+preds`` with a C²+1-th dead bin for ignored entries; the count is a
+  ``ones @ one_hot`` dot — see ``utils/data.py::bincount``).
 * The five-stage split (validate → format → update → compute) is preserved because
   the stateless stages are exactly what the ``Metric`` layer jit-compiles.
 """

@@ -12,16 +12,23 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from metrics_tpu.functional.image._helpers import (
-    _gaussian,
     _reflect_pad,
     avg_pool2d,
     reduce,
     separable_depthwise_conv,
 )
 from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _gaussian_taps_np(kernel_size: int, sigma: float) -> "np.ndarray":
+    """Static host-side 1-D gaussian taps — same formula as ``_helpers._gaussian``."""
+    dist = np.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=np.float32)
+    gauss = np.exp(-(dist**2) / np.float32(2 * sigma**2))
+    return (gauss / gauss.sum()).astype(np.float32)
 
 
 def _use_pallas() -> bool:
@@ -87,11 +94,16 @@ def _ssim_update(
 
     preds_p = _reflect_pad(preds, pads)
     target_p = _reflect_pad(target, pads)
-    # both window types are outer products of 1-D kernels → separable cascade
+    # both window types are outer products of 1-D kernels → separable cascade.
+    # kernel_size/sigma are static Python numbers, so the taps are computed
+    # host-side (numpy) — they stay concrete even when the caller wraps the
+    # whole metric in jax.jit, and both the Pallas and the XLA stencil path
+    # consume the exact same values.
     if gaussian_kernel:
-        kernels_1d = [_gaussian(k, s)[0] for k, s in zip(gauss_kernel_size, sigma)]
+        taps_np = [_gaussian_taps_np(k, s) for k, s in zip(gauss_kernel_size, sigma)]
     else:
-        kernels_1d = [jnp.ones(k) / k for k in kernel_size]
+        taps_np = [np.ones(k, dtype=np.float32) / k for k in kernel_size]
+    kernels_1d = [jnp.asarray(t) for t in taps_np]
 
     input_list = jnp.concatenate(
         (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
@@ -103,7 +115,7 @@ def _ssim_update(
 
         # compiled Pallas needs a real TPU; forcing the kernel elsewhere runs the interpreter
         interpret = jax.default_backend() != "tpu"
-        outputs = windowed_sum_nchw(input_list, kernels_1d, interpret=interpret)
+        outputs = windowed_sum_nchw(input_list, taps_np, interpret=interpret)
     else:
         outputs = separable_depthwise_conv(input_list, kernels_1d)
     b = preds.shape[0]

@@ -26,16 +26,26 @@ _build_failed = False
 
 
 def _build() -> bool:
+    # compile to a temp path and atomically replace: a killed compile (or two
+    # processes racing) must never leave a truncated .so at the final path
+    tmp = f"{_LIB}.build.{os.getpid()}"
     for cc in ("g++", "clang++", "c++"):
         try:
             proc = subprocess.run(
-                [cc, "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
                 capture_output=True, timeout=120,
             )
             if proc.returncode == 0:
+                os.replace(tmp, _LIB)
                 return True
         except (OSError, subprocess.TimeoutExpired):
             continue
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
     return False
 
 
@@ -47,14 +57,27 @@ def load_rle_codec() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib_cache is not None or _build_failed:
             return _lib_cache
-        if not os.path.exists(_LIB) and not _build():
+        stale = os.path.exists(_LIB) and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        if (not os.path.exists(_LIB) or stale) and not _build():
             _build_failed = True
             return None
         try:
             lib = ctypes.CDLL(_LIB)
         except OSError:
-            _build_failed = True
-            return None
+            # a corrupt cached .so (e.g. from an older interrupted build) —
+            # rebuild once before giving up on the native path
+            try:
+                os.remove(_LIB)
+            except OSError:
+                pass
+            if not _build():
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError:
+                _build_failed = True
+                return None
         ll = ctypes.c_longlong
         u8p = ctypes.POINTER(ctypes.c_ubyte)
         llp = ctypes.POINTER(ll)

@@ -4,7 +4,7 @@
 // extension (SURVEY §2.9); here the array math of the codec lives in numpy
 // (already vectorized) and ONLY the genuinely loopy byte-level parts are
 // native: the LEB128-style compressed-counts string codec and a batch
-// run-expansion used when decoding many masks at once.
+// run-expansion behind rle_to_mask.
 
 #include <cstdint>
 #include <cstddef>
@@ -12,7 +12,8 @@
 extern "C" {
 
 // Encode run lengths into the COCO compressed string form.
-// counts[n] -> out bytes; returns number of bytes written (out must hold 8*n).
+// counts[n] -> out bytes; returns number of bytes written (out must hold 13*n:
+// an int64 value spans at most 13 five-bit groups).
 long long rle_compress_counts(const long long* counts, long long n, unsigned char* out) {
     long long pos = 0;
     for (long long i = 0; i < n; ++i) {
@@ -31,24 +32,30 @@ long long rle_compress_counts(const long long* counts, long long n, unsigned cha
 }
 
 // Decode the compressed string form back into run lengths.
-// data[len] -> counts_out; returns number of counts (counts_out must hold len).
+// data[len] -> counts_out; returns number of counts (counts_out must hold len),
+// or -1 for a malformed value wider than 13 5-bit groups (the int64 maximum —
+// anything the matching compressor can emit decodes back; shifts run in
+// unsigned arithmetic so even the 13th group's overflow past bit 63 is
+// well-defined wraparound, mirroring the Python fallback's masked bigints).
 long long rle_decompress_counts(const unsigned char* data, long long len, long long* counts_out) {
     long long n = 0;
     long long pos = 0;
     while (pos < len) {
-        long long x = 0;
+        unsigned long long x = 0;
         int k = 0;
         bool more = true;
         while (more && pos < len) {
-            long long byte = (long long)data[pos] - 48;
-            x |= (byte & 0x1f) << (5 * k);
+            if (k >= 13) return -1;
+            unsigned long long byte = (unsigned long long)data[pos] - 48;
+            if (5 * k < 64) x |= (byte & 0x1f) << (5 * k);
             more = (byte & 0x20) != 0;
             ++pos;
             ++k;
-            if (!more && (byte & 0x10)) x |= -1LL << (5 * k);
+            if (!more && (byte & 0x10) && 5 * k < 64) x |= ~0ULL << (5 * k);
         }
-        if (n > 2) x += counts_out[n - 2];
-        counts_out[n++] = x;
+        long long v = (long long)x;
+        if (n > 2) v += counts_out[n - 2];
+        counts_out[n++] = v;
     }
     return n;
 }

@@ -60,9 +60,9 @@ def _check_retrieval_inputs(
 
         mask = np.asarray(valid)
         indexes, preds, target = indexes[mask], preds[mask], target[mask]
-    if not _is_traced(target) and not allow_non_binary_target:
-        mx = jnp.max(target) if target.size else jnp.asarray(0)
-        if int(mx) > 1 or int(jnp.min(target) if target.size else jnp.asarray(0)) < 0:
+    if not _is_traced(target) and not allow_non_binary_target and target.size:
+        # one fused device predicate → one host sync (not one per bound)
+        if bool((jnp.max(target) > 1) | (jnp.min(target) < 0)):
             raise ValueError("`target` must contain binary values")
     return indexes.astype(jnp.int32), preds.astype(jnp.float32), target
 
@@ -70,5 +70,5 @@ def _check_data_range(x: Array, lower: float, upper: float, name: str) -> None:
     """Eagerly validate value range; silently skipped under tracing."""
     if _is_traced(x):
         return
-    if x.size and (float(jnp.min(x)) < lower or float(jnp.max(x)) > upper):
+    if x.size and bool((jnp.min(x) < lower) | (jnp.max(x) > upper)):
         raise ValueError(f"Expected `{name}` to be in range [{lower}, {upper}].")

@@ -1,0 +1,209 @@
+"""CollectionFunctions facade: parity with the eager MetricCollection paths.
+
+The facade is the TPU-native deployment of a collection (one jitted program per
+eval step); these tests pin its contract to the eager API — same values, same
+key sets (including the duplicate-key flattening rules of
+``_compute_and_reduce``, reference ``collections.py:349-394``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import (
+    BinaryGroupStatRates,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from metrics_tpu.collections import MetricCollection
+
+
+def _data(seed=0, n=512, c=4):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randint(0, c, n).astype(np.int32)),
+        jnp.asarray(rng.randint(0, c, n).astype(np.int32)),
+    )
+
+
+def _col():
+    return MetricCollection(
+        [
+            MulticlassPrecision(num_classes=4, validate_args=False),
+            MulticlassRecall(num_classes=4, validate_args=False),
+            MulticlassF1Score(num_classes=4, validate_args=False),
+        ]
+    )
+
+
+def test_facade_matches_eager_values_and_keys():
+    col_eager, col_fn = _col(), _col()
+    fns = col_fn.functional()
+    state = fns.init()
+    for seed in range(4):
+        p, t = _data(seed)
+        col_eager.update(p, t)
+        state = fns.update(state, p, t)
+    eager = col_eager.compute()
+    functional = fns.compute(state)
+    assert set(eager) == set(functional)
+    for k in eager:
+        np.testing.assert_allclose(np.asarray(functional[k]), np.asarray(eager[k]), rtol=1e-6)
+
+
+def test_facade_grouped_state_after_detection_matches():
+    col = _col()
+    p, t = _data(1)
+    col.update(p, t)  # detect compute groups
+    assert len(col._groups) == 1
+    fns = col.functional()
+    state = fns.init()
+    assert len(state) == 1, "detected groups should carry ONE state per group"
+    for seed in range(3):
+        pp, tt = _data(seed + 10)
+        state = fns.update(state, pp, tt)
+    col2 = _col()
+    for seed in range(3):
+        pp, tt = _data(seed + 10)
+        col2.update(pp, tt)
+    eager = col2.compute()
+    functional = fns.compute(state)
+    for k in eager:
+        np.testing.assert_allclose(np.asarray(functional[k]), np.asarray(eager[k]), rtol=1e-6)
+
+
+def test_facade_jits_as_one_program():
+    col = _col()
+    fns = col.functional()
+
+    @jax.jit
+    def step(state, p, t):
+        return fns.update(state, p, t)
+
+    state = fns.init()
+    for seed in range(3):
+        p, t = _data(seed)
+        state = step(state, p, t)
+    out = jax.jit(fns.compute)(state)
+    assert set(out) == {"MulticlassPrecision", "MulticlassRecall", "MulticlassF1Score"}
+
+
+def test_facade_duplicate_dict_keys_flatten_like_eager():
+    # two dict-returning metrics with identical inner keys → every entry gets
+    # the metric-name prefix, in BOTH paths
+    rng = np.random.RandomState(3)
+    n = 256
+    p = jnp.asarray(rng.randint(0, 2, n).astype(np.int32))
+    t = jnp.asarray(rng.randint(0, 2, n).astype(np.int32))
+    g = jnp.asarray(rng.randint(0, 2, n).astype(np.int32))
+    col_eager = MetricCollection(
+        {
+            "a": BinaryGroupStatRates(num_groups=2),
+            "b": BinaryGroupStatRates(num_groups=2),
+        }
+    )
+    col_fn = MetricCollection(
+        {
+            "a": BinaryGroupStatRates(num_groups=2),
+            "b": BinaryGroupStatRates(num_groups=2),
+        }
+    )
+    col_eager.update(p, t, g)
+    eager = col_eager.compute()
+    fns = col_fn.functional()
+    state = fns.update(fns.init(), p, t, g)
+    functional = fns.compute(state)
+    assert set(eager) == set(functional)
+    for k in eager:
+        np.testing.assert_allclose(np.asarray(functional[k]), np.asarray(eager[k]), rtol=1e-6)
+
+
+def test_facade_with_prefix_postfix():
+    col = MetricCollection([MulticlassAccuracy(num_classes=4)], prefix="val_", postfix="_ep")
+    fns = col.functional()
+    p, t = _data(2)
+    out = fns.compute(fns.update(fns.init(), p, t))
+    assert list(out) == ["val_MulticlassAccuracy_ep"]
+
+
+@pytest.mark.parametrize("mode", ["matmul", "scatter"])
+@pytest.mark.parametrize("minlength", [6, 2048])
+def test_bincount_both_paths_match_numpy(monkeypatch, mode, minlength):
+    from metrics_tpu.utils.data import bincount
+
+    monkeypatch.setenv("METRICS_TPU_BINCOUNT", mode)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, minlength, 10_000).astype(np.int32))
+    got = np.asarray(bincount(x, minlength))
+    want = np.bincount(np.asarray(x), minlength=minlength)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("mode", ["matmul", "scatter"])
+def test_bincount_weighted_both_paths_match_numpy(monkeypatch, mode):
+    from metrics_tpu.utils.data import bincount_weighted
+
+    monkeypatch.setenv("METRICS_TPU_BINCOUNT", mode)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, 64, 5_000).astype(np.int32))
+    w = jnp.asarray(rng.rand(5_000).astype(np.float32))
+    got = np.asarray(bincount_weighted(x, w, 64))
+    want = np.zeros(64, np.float64)
+    np.add.at(want, np.asarray(x), np.asarray(w, np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bincount_falls_back_above_caps(monkeypatch):
+    from metrics_tpu.utils import data
+
+    monkeypatch.setenv("METRICS_TPU_BINCOUNT", "matmul")
+    assert data._bincount_matmul_ok(10_000, 64)
+    assert not data._bincount_matmul_ok(1 << 20, 2048)  # product over the cap
+    assert not data._bincount_matmul_ok(1 << 25, 2)  # size over the cap
+    assert not data._bincount_matmul_ok(100, 4096)  # bins over the cap
+    monkeypatch.setenv("METRICS_TPU_BINCOUNT", "scatter")
+    assert not data._bincount_matmul_ok(10_000, 64)
+
+
+def test_stat_scores_same_under_both_bincount_paths(monkeypatch):
+    from metrics_tpu.functional.classification import multiclass_f1_score
+
+    rng = np.random.RandomState(1)
+    p = jnp.asarray(rng.randint(0, 7, 4_000).astype(np.int32))
+    t = jnp.asarray(rng.randint(0, 7, 4_000).astype(np.int32))
+    vals = {}
+    for mode in ("matmul", "scatter"):
+        monkeypatch.setenv("METRICS_TPU_BINCOUNT", mode)
+        vals[mode] = float(multiclass_f1_score(p, t, num_classes=7, average="macro"))
+    assert vals["matmul"] == pytest.approx(vals["scatter"], abs=1e-7)
+
+
+def test_rle_malformed_counts_rejected_native_and_python():
+    from metrics_tpu.detection import rle as rle_mod
+
+    bad = b"P" * 14 + b"0"
+    with pytest.raises(ValueError, match="wider than 13"):
+        rle_mod.decompress_counts(bad)
+    # force the pure-python fallback too
+    import unittest.mock as mock
+
+    with mock.patch.object(rle_mod, "_native", lambda: None):
+        with pytest.raises(ValueError, match="wider than 13"):
+            rle_mod.decompress_counts(bad)
+
+
+def test_rle_roundtrip_huge_values_native_and_python():
+    from metrics_tpu.detection import rle as rle_mod
+
+    import unittest.mock as mock
+
+    vals = np.array([1, 1, 2**62, 3, -(2**60)], dtype=np.int64)
+    enc = rle_mod.compress_counts(vals)
+    np.testing.assert_array_equal(rle_mod.decompress_counts(enc), vals)
+    with mock.patch.object(rle_mod, "_native", lambda: None):
+        enc2 = rle_mod.compress_counts(vals)
+        np.testing.assert_array_equal(rle_mod.decompress_counts(enc2), vals)
+    assert enc == enc2

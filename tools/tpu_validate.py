@@ -1,0 +1,262 @@
+"""Real-TPU validation sweep: every domain's headline metrics on the actual chip.
+
+Runs a representative metric from each domain twice — once on the default
+backend (the real TPU when the tunnel is live) and once pinned to the host CPU
+backend — and records the worst elementwise deviation plus the TPU wall time.
+This is the evidence that the compute paths (MXU matmul-bincount, Pallas SSIM
+window kernel, segment-reduce retrieval, batched IoU matching, FFT audio paths)
+produce correct numbers ON TPU, not just under the CPU test rig.
+
+Writes ``TPU_VALIDATION.json`` at the repo root and prints one JSON line.
+Usage: ``python tools/tpu_validate.py`` (skips gracefully to a "cpu-only"
+record when no accelerator is reachable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _tree_max_diff(a, b) -> float:
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    worst = 0.0
+    for x, y in zip(la, lb):
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        if x.shape != y.shape:
+            return float("inf")
+        if x.size:
+            denom = np.maximum(np.abs(y), 1.0)
+            worst = max(worst, float(np.max(np.abs(x - y) / denom)))
+    return worst
+
+
+def build_cases():
+    """(name, fn) pairs; each fn is a zero-arg closure returning a pytree of arrays."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    cases = []
+
+    # ---------------- classification: stat scores + curve + calibration
+    p_cls = rng.randint(0, 10, 100_000).astype(np.int32)
+    t_cls = rng.randint(0, 10, 100_000).astype(np.int32)
+    p_soft = rng.rand(50_000, 5).astype(np.float32)
+    p_soft /= p_soft.sum(1, keepdims=True)
+    t_soft = rng.randint(0, 5, 50_000).astype(np.int32)
+    p_bin = rng.rand(100_000).astype(np.float32)
+    t_bin = rng.randint(0, 2, 100_000).astype(np.int32)
+
+    def classification():
+        from metrics_tpu.functional.classification import (
+            binary_auroc,
+            binary_average_precision,
+            binary_calibration_error,
+            multiclass_accuracy,
+            multiclass_confusion_matrix,
+            multiclass_f1_score,
+        )
+
+        return (
+            multiclass_accuracy(jnp.asarray(p_cls), jnp.asarray(t_cls), num_classes=10, average="macro"),
+            multiclass_f1_score(jnp.asarray(p_cls), jnp.asarray(t_cls), num_classes=10, average="weighted"),
+            multiclass_confusion_matrix(jnp.asarray(p_soft), jnp.asarray(t_soft), num_classes=5),
+            binary_auroc(jnp.asarray(p_bin), jnp.asarray(t_bin)),
+            binary_average_precision(jnp.asarray(p_bin), jnp.asarray(t_bin)),
+            binary_calibration_error(jnp.asarray(p_bin), jnp.asarray(t_bin), n_bins=15),
+        )
+
+    cases.append(("classification", classification))
+
+    # ---------------- regression
+    pr = rng.rand(200_000).astype(np.float32)
+    tr = (pr + rng.randn(200_000).astype(np.float32) * 0.1).astype(np.float32)
+
+    def regression():
+        from metrics_tpu.functional.regression import (
+            mean_squared_error,
+            pearson_corrcoef,
+            r2_score,
+            spearman_corrcoef,
+        )
+
+        return (
+            mean_squared_error(jnp.asarray(pr), jnp.asarray(tr)),
+            pearson_corrcoef(jnp.asarray(pr), jnp.asarray(tr)),
+            spearman_corrcoef(jnp.asarray(pr), jnp.asarray(tr)),
+            r2_score(jnp.asarray(pr), jnp.asarray(tr)),
+        )
+
+    cases.append(("regression", regression))
+
+    # ---------------- retrieval (segment-reduce engine)
+    q_n, d_n = 1024, 50
+    ret_idx = np.repeat(np.arange(q_n), d_n).astype(np.int64)
+    ret_p = rng.rand(q_n * d_n).astype(np.float32)
+    ret_t = (rng.rand(q_n * d_n) < 0.15).astype(np.int64)
+    ret_t[::d_n] = 1
+
+    def retrieval():
+        from metrics_tpu.functional.retrieval import (
+            retrieval_average_precision,
+            retrieval_normalized_dcg,
+            retrieval_reciprocal_rank,
+        )
+        from metrics_tpu.retrieval import RetrievalMAP
+
+        m = RetrievalMAP()
+        m.update(jnp.asarray(ret_p), jnp.asarray(ret_t), indexes=jnp.asarray(ret_idx))
+        one_p, one_t = jnp.asarray(ret_p[:d_n]), jnp.asarray(ret_t[:d_n])
+        return (
+            m.compute(),
+            retrieval_average_precision(one_p, one_t),
+            retrieval_reciprocal_rank(one_p, one_t),
+            retrieval_normalized_dcg(one_p, one_t),
+        )
+
+    cases.append(("retrieval", retrieval))
+
+    # ---------------- image (SSIM rides Pallas on TPU, XLA stencil on CPU;
+    # MS-SSIM's 5-beta cascade needs ≥176px after 4 halvings)
+    img_a = rng.rand(2, 3, 192, 192).astype(np.float32)
+    img_b = np.clip(img_a + rng.randn(2, 3, 192, 192).astype(np.float32) * 0.05, 0, 1)
+
+    def image():
+        from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+        from metrics_tpu.functional.image.ssim import (
+            multiscale_structural_similarity_index_measure,
+            structural_similarity_index_measure,
+        )
+        from metrics_tpu.functional.image.metrics import universal_image_quality_index
+
+        return (
+            structural_similarity_index_measure(jnp.asarray(img_a), jnp.asarray(img_b), data_range=1.0),
+            multiscale_structural_similarity_index_measure(jnp.asarray(img_a), jnp.asarray(img_b), data_range=1.0),
+            peak_signal_noise_ratio(jnp.asarray(img_a), jnp.asarray(img_b), data_range=1.0),
+            universal_image_quality_index(jnp.asarray(img_a), jnp.asarray(img_b)),
+        )
+
+    cases.append(("image", image))
+
+    # ---------------- audio (FFT autocorr + Toeplitz solve)
+    sig_t = rng.randn(2, 8000).astype(np.float32)
+    sig_p = (sig_t + rng.randn(2, 8000).astype(np.float32) * 0.3).astype(np.float32)
+
+    def audio():
+        from metrics_tpu.functional.audio.metrics import (
+            scale_invariant_signal_distortion_ratio,
+            signal_distortion_ratio,
+            signal_noise_ratio,
+        )
+
+        return (
+            scale_invariant_signal_distortion_ratio(jnp.asarray(sig_p), jnp.asarray(sig_t)),
+            signal_noise_ratio(jnp.asarray(sig_p), jnp.asarray(sig_t)),
+            signal_distortion_ratio(jnp.asarray(sig_p), jnp.asarray(sig_t)),
+        )
+
+    cases.append(("audio", audio))
+
+    # ---------------- detection (batched IoU + device-native COCO matching)
+    n_img, n_cls = 12, 3
+    det_p, det_t = [], []
+    for _ in range(n_img):
+        ng = rng.randint(2, 8)
+        gb = rng.rand(ng, 4) * 100
+        gb[:, 2:] = gb[:, :2] + 2 + rng.rand(ng, 2) * 60
+        nd = ng + rng.randint(0, 3)
+        db = np.concatenate([gb + rng.randn(ng, 4) * 3, rng.rand(nd - ng, 4) * 100])
+        db[:, 2:] = np.maximum(db[:, 2:], db[:, :2] + 1)
+        det_p.append({"boxes": db.astype(np.float32), "scores": rng.rand(nd).astype(np.float32),
+                      "labels": rng.randint(0, n_cls, nd)})
+        det_t.append({"boxes": gb.astype(np.float32), "labels": rng.randint(0, n_cls, ng)})
+
+    def detection():
+        from metrics_tpu.detection import MeanAveragePrecision
+        from metrics_tpu.functional.detection.iou import intersection_over_union
+
+        m = MeanAveragePrecision()
+        m.update([{k: jnp.asarray(v) for k, v in d.items()} for d in det_p],
+                 [{k: jnp.asarray(v) for k, v in d.items()} for d in det_t])
+        res = m.compute()
+        iou = intersection_over_union(jnp.asarray(det_p[0]["boxes"]), jnp.asarray(det_t[0]["boxes"]))
+        return (res["map"], res["map_50"], res["mar_100"], iou)
+
+    cases.append(("detection", detection))
+
+    # ---------------- clustering + pairwise + segmentation + text
+    lab_a = rng.randint(0, 8, 20_000)
+    lab_b = rng.randint(0, 8, 20_000)
+    seg_p = rng.randint(0, 2, (4, 1, 64, 64)).astype(np.int32)
+    seg_t = rng.randint(0, 2, (4, 1, 64, 64)).astype(np.int32)
+    emb = rng.rand(512, 64).astype(np.float32)
+    logits = rng.randn(4, 50, 1000).astype(np.float32)
+    tok = rng.randint(0, 1000, (4, 50))
+
+    def small_domains():
+        from metrics_tpu.functional.clustering import adjusted_rand_score, normalized_mutual_info_score
+        from metrics_tpu.functional.pairwise import pairwise_cosine_similarity
+        from metrics_tpu.functional.segmentation import dice_score
+        from metrics_tpu.functional.text import perplexity
+
+        return (
+            adjusted_rand_score(jnp.asarray(lab_a), jnp.asarray(lab_b)),
+            normalized_mutual_info_score(jnp.asarray(lab_a), jnp.asarray(lab_b)),
+            pairwise_cosine_similarity(jnp.asarray(emb[:64])),
+            dice_score(jnp.asarray(seg_p), jnp.asarray(seg_t), num_classes=2, input_format="index"),
+            perplexity(jnp.asarray(logits), jnp.asarray(tok)),
+        )
+
+    cases.append(("small_domains", small_domains))
+
+    return cases
+
+
+def main():
+    from metrics_tpu.utils.backend import ensure_backend
+
+    ensure_backend(min_devices=1)
+
+    import jax
+
+    backend = jax.default_backend()
+    cpu_dev = jax.devices("cpu")[0]
+    records = {}
+    for name, fn in build_cases():
+        try:
+            jax.block_until_ready(fn())  # compile both executables (slow on a tunneled chip)
+            t0 = time.perf_counter()
+            accel = fn()
+            jax.block_until_ready(accel)
+            t_accel = time.perf_counter() - t0
+            with jax.default_device(cpu_dev):
+                host = fn()
+            diff = _tree_max_diff(accel, host)
+            records[name] = {"ok": bool(diff < 5e-3), "max_rel_diff": float(diff),
+                             "accel_ms": round(1000 * t_accel, 2)}
+        except Exception as err:  # noqa: BLE001 — record, keep sweeping
+            records[name] = {"ok": False, "error": f"{type(err).__name__}: {err}"}
+    summary = {
+        "backend": backend,
+        "device": str(jax.devices()[0]),
+        "all_ok": all(r.get("ok") for r in records.values()),
+        "domains": records,
+    }
+    with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
