@@ -102,8 +102,10 @@ def source_aggregated_signal_distortion_ratio(
         target = target - target.mean(-1, keepdims=True)
         preds = preds - preds.mean(-1, keepdims=True)
     if scale_invariant:
-        alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
-            jnp.sum(target**2, axis=-1, keepdims=True) + eps
+        # ONE alpha shared by all speakers — summed over both time and the
+        # source dim (reference ``sdr.py:294-298``), not per-speaker
+        alpha = (jnp.sum(preds * target, axis=(-2, -1), keepdims=True) + eps) / (
+            jnp.sum(target**2, axis=(-2, -1), keepdims=True) + eps
         )
         target = alpha * target
     distortion = target - preds

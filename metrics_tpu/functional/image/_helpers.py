@@ -113,6 +113,37 @@ def separable_depthwise_conv(x: Array, kernels_1d: Sequence[Array]) -> Array:
     return x
 
 
+def scipy_uniform_filter(x: Array, window_size: int) -> Array:
+    """Same-size mean filter with scipy-style asymmetric reflect padding.
+
+    Mirrors reference ``image/utils.py:77-132`` (``_single_dimension_pad`` +
+    ``_uniform_filter``): left pad = ``ws//2`` reflected rows, right pad =
+    ``ws//2 + ws%2 - 1`` reflected rows, then a VALID uniform window — so the
+    output keeps the input's spatial shape for both odd and even windows.
+    """
+    pad, outer = window_size // 2, window_size % 2
+    for dim in (2, 3):
+        n = x.shape[dim]
+        parts = []
+        if pad:
+            parts.append(jnp.flip(lax.slice_in_dim(x, 0, pad, axis=dim), axis=dim))
+        parts.append(x)
+        if pad + outer - 1 > 0:
+            parts.append(jnp.flip(lax.slice_in_dim(x, n - pad - outer + 1, n, axis=dim), axis=dim))
+        x = jnp.concatenate(parts, axis=dim)
+    taps = jnp.ones(window_size, dtype=x.dtype) / window_size
+    return separable_depthwise_conv(x, [taps, taps])
+
+
+def resize_bilinear(x: Array, size: Tuple[int, int]) -> Array:
+    """Half-pixel-centers bilinear resize of (B, C, H, W) to ``size``.
+
+    Matches ``torchvision.transforms.functional.resize(antialias=False)`` as
+    used by the reference D_s pan degradation (``d_s.py:189-191``).
+    """
+    return jax.image.resize(x, (*x.shape[:2], *size), method="linear")
+
+
 def avg_pool2d(x: Array, kernel: int = 2) -> Array:
     """Average pool with stride=kernel (for MS-SSIM downsampling)."""
     window = (1, 1, kernel, kernel)

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from metrics_tpu.functional.image._helpers import (
@@ -19,6 +20,8 @@ from metrics_tpu.functional.image._helpers import (
     _uniform_kernel,
     depthwise_conv,
     reduce,
+    resize_bilinear,
+    scipy_uniform_filter,
 )
 from metrics_tpu.utils.checks import _check_same_shape
 
@@ -54,14 +57,17 @@ def universal_image_quality_index(
     b = preds.shape[0]
     mu_p, mu_t, s_pp, s_tt, s_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
     mu_p_sq, mu_t_sq, mu_pt = mu_p**2, mu_t**2, mu_p * mu_t
-    sigma_p_sq = s_pp - mu_p_sq
-    sigma_t_sq = s_tt - mu_t_sq
+    sigma_p_sq = jnp.clip(s_pp - mu_p_sq, 0.0, None)
+    sigma_t_sq = jnp.clip(s_tt - mu_t_sq, 0.0, None)
     sigma_pt = s_pt - mu_pt
     upper = 2 * sigma_pt
     lower = sigma_p_sq + sigma_t_sq
     eps = jnp.finfo(jnp.float32).eps
     uqi_map = ((2 * mu_pt) * upper) / ((mu_p_sq + mu_t_sq) * lower + eps)
-    return reduce(uqi_map.reshape(b, -1).mean(-1), reduction)
+    # the reference averages over the UNPADDED region of the full map
+    # (``uqi.py:115-118``) — reduction applies to the map, not per-image means
+    uqi_map = uqi_map[..., pads[0] : uqi_map.shape[-2] - pads[0], pads[1] : uqi_map.shape[-1] - pads[1]]
+    return reduce(uqi_map, reduction)
 
 
 # --------------------------------------------------------------------------- SAM
@@ -119,42 +125,52 @@ def error_relative_global_dimensionless_synthesis(
 
 
 # --------------------------------------------------------------------------- RMSE-SW / RASE
-def _rmse_sw_maps(preds: Array, target: Array, window_size: int) -> Tuple[Array, Array]:
-    """Sliding-window RMSE map and windowed target mean (shared by rmse_sw/rase)."""
-    channel = preds.shape[1]
-    kernel = _uniform_kernel(channel, (window_size, window_size))
-    mse_map = depthwise_conv((preds - target) ** 2, kernel)
-    mu_target = depthwise_conv(target, kernel)
-    return jnp.sqrt(jnp.clip(mse_map, 0.0, None)), mu_target
+def _rmse_sw_maps(preds: Array, target: Array, window_size: int) -> Array:
+    """Per-image sliding-window RMSE maps (reference ``rmse_sw.py:71-74``)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. But got {preds.shape}.")
+    if round(window_size / 2) >= preds.shape[2] or round(window_size / 2) >= preds.shape[3]:
+        raise ValueError(
+            f"Parameter `round(window_size / 2)` is expected to be smaller than"
+            f" {min(preds.shape[2], preds.shape[3])} but got {round(window_size / 2)}."
+        )
+    err = scipy_uniform_filter((target.astype(jnp.float32) - preds.astype(jnp.float32)) ** 2, window_size)
+    return jnp.sqrt(jnp.clip(err, 0.0, None))
 
 
 def root_mean_squared_error_using_sliding_window(
     preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
 ):
-    """Sliding-window RMSE (reference ``rmse_sw.py:24-87``)."""
-    if not isinstance(window_size, int) or window_size < 1:
-        raise ValueError("Argument `window_size` is expected to be a positive integer.")
-    _check_same_shape(preds, target)
-    preds = preds.astype(jnp.float32)
-    target = target.astype(jnp.float32)
-    rmse_map, _ = _rmse_sw_maps(preds, target, window_size)
-    rmse = rmse_map.mean()
+    """Sliding-window RMSE (reference ``rmse_sw.py:24-87``).
+
+    The scalar averages over the map with ``round(ws/2)`` border rows cropped;
+    the optional map return is the batch-mean of the UNcropped per-image maps —
+    both exactly the reference's accumulate-then-divide semantics.
+    """
+    rmse_map = _rmse_sw_maps(preds, target, window_size)
+    crop = round(window_size / 2)
+    rmse = rmse_map[..., crop:-crop, crop:-crop].mean()
     if return_rmse_map:
-        return rmse, rmse_map
+        return rmse, rmse_map.mean(0)
     return rmse
 
 
 def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
-    """RASE (reference ``rase.py:24-77``): 100/μ_window · RMS over bands of windowed RMSE."""
-    if not isinstance(window_size, int) or window_size < 1:
-        raise ValueError("Argument `window_size` is expected to be a positive integer.")
-    _check_same_shape(preds, target)
-    preds = preds.astype(jnp.float32)
-    target = target.astype(jnp.float32)
-    rmse_map, mu_target = _rmse_sw_maps(preds, target, window_size)
-    # mean over bands of squared windowed rmse, normalized by the window mean intensity
-    rase_map = 100.0 / jnp.mean(mu_target, axis=1) * jnp.sqrt(jnp.mean(rmse_map**2, axis=1))
-    return rase_map.mean()
+    """RASE (reference ``rase.py:23-101``).
+
+    Batch-averages the windowed-RMSE and windowed-target maps FIRST, then forms
+    one RASE map (not per-image RASE averaged after). The reference divides the
+    windowed target mean by ``window_size**2`` a second time (``rase.py:44``) —
+    a quirk preserved verbatim for parity, scaling the result by ``ws²``.
+    """
+    rmse_map = _rmse_sw_maps(preds, target, window_size).mean(0)  # (C, H, W)
+    target_mean = (scipy_uniform_filter(target.astype(jnp.float32), window_size) / window_size**2).mean(0).mean(0)
+    rase_map = 100.0 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    crop = round(window_size / 2)
+    return rase_map[crop:-crop, crop:-crop].mean()
 
 
 # --------------------------------------------------------------------------- Total variation
@@ -199,55 +215,75 @@ def spatial_correlation_coefficient(
         preds = preds[:, None]
         target = target[:, None]
     _check_same_shape(preds, target)
+    if reduction is None:
+        reduction = "none"
+    if reduction not in ("mean", "none", "elementwise_mean"):
+        raise ValueError(f"Expected reduction to be 'mean' or 'none', but got {reduction}")
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)
     channel = preds.shape[1]
-    hp_kernel = jnp.broadcast_to(hp_filter, (channel, 1, *hp_filter.shape))
-    pads = [(s - 1) // 2 for s in hp_filter.shape]
-    hp_p = depthwise_conv(_reflect_pad(preds, pads), hp_kernel)
-    hp_t = depthwise_conv(_reflect_pad(target, pads), hp_kernel)
+    kh, kw = hp_filter.shape
+    # true convolution with SYMMETRIC (edge-including) padding, ×2 — reference
+    # ``scc.py:76-107`` (``_signal_convolve_2d`` flips the kernel; pads are
+    # floor-left/ceil-right of (k-1)/2)
+    hp_kernel = jnp.broadcast_to(jnp.flip(hp_filter, (0, 1)), (channel, 1, kh, kw))
+    pad_cfg = [(0, 0), (0, 0), ((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)]
+    hp_p = depthwise_conv(jnp.pad(preds, pad_cfg, mode="symmetric"), hp_kernel) * 2.0
+    hp_t = depthwise_conv(jnp.pad(target, pad_cfg, mode="symmetric"), hp_kernel) * 2.0
 
+    # window stats over ZERO-padded maps, ceil-left/floor-right (``scc.py:111-125``)
     window = _uniform_kernel(channel, (window_size, window_size))
     stack = jnp.concatenate((hp_p, hp_t, hp_p * hp_p, hp_t * hp_t, hp_p * hp_t))
-    out = depthwise_conv(stack, window)
+    zpad = [(0, 0), (0, 0), (window_size // 2, (window_size - 1) // 2), (window_size // 2, (window_size - 1) // 2)]
+    out = depthwise_conv(jnp.pad(stack, zpad), window)
     b = preds.shape[0]
     mu_p, mu_t, s_pp, s_tt, s_pt = (out[i * b : (i + 1) * b] for i in range(5))
-    var_p = s_pp - mu_p**2
-    var_t = s_tt - mu_t**2
+    var_p = jnp.clip(s_pp - mu_p**2, 0.0, None)
+    var_t = jnp.clip(s_tt - mu_t**2, 0.0, None)
     cov = s_pt - mu_p * mu_t
-    eps = jnp.finfo(jnp.float32).eps
-    den = var_p * var_t
-    scc_map = jnp.where(den > eps, cov / jnp.sqrt(jnp.where(den > eps, den, 1.0)), 0.0)
-    return reduce(scc_map.reshape(b, -1).mean(-1), reduction)
+    den = jnp.sqrt(var_t) * jnp.sqrt(var_p)
+    scc_map = jnp.where(den == 0, 0.0, cov / jnp.where(den == 0, 1.0, den))
+    if reduction == "none":
+        return scc_map.reshape(b, -1).mean(-1)
+    return scc_map.mean()
 
 
 # --------------------------------------------------------------------------- PSNRB
 def _blocking_effect_factor(img: Array, block_size: int = 8) -> Array:
-    """Blocking effect factor of JPEG-style 8x8 blocks (reference ``psnrb.py`` helper)."""
-    h, w = img.shape[-2:]
-    h_idx = jnp.arange(block_size - 1, h - 1, block_size)
-    w_idx = jnp.arange(block_size - 1, w - 1, block_size)
-    # boundary differences
-    d_b_h = ((img[..., h_idx, :] - img[..., h_idx + 1, :]) ** 2).sum(axis=(-2, -1))
-    d_b_w = ((img[..., :, w_idx] - img[..., :, w_idx + 1]) ** 2).sum(axis=(-2, -1))
-    # non-boundary differences
-    all_h = jnp.arange(0, h - 1)
-    all_w = jnp.arange(0, w - 1)
-    nb_h = jnp.setdiff1d(all_h, h_idx, size=len(all_h) - len(h_idx))
-    nb_w = jnp.setdiff1d(all_w, w_idx, size=len(all_w) - len(w_idx))
-    d_nb_h = ((img[..., nb_h, :] - img[..., nb_h + 1, :]) ** 2).sum(axis=(-2, -1))
-    d_nb_w = ((img[..., :, nb_w] - img[..., :, nb_w + 1]) ** 2).sum(axis=(-2, -1))
+    """Blocking effect factor, batch-pooled (reference ``psnrb.py:20-64``).
 
-    n_b = img.shape[-1] * len(h_idx) + img.shape[-2] * len(w_idx)
-    n_nb = img.shape[-1] * len(nb_h) + img.shape[-2] * len(nb_w)
-    d_b = (d_b_h + d_b_w) / n_b
-    d_nb = (d_nb_h + d_nb_w) / n_nb
-    t = jnp.log2(jnp.asarray(float(block_size))) / jnp.log2(jnp.asarray(float(min(h, w))))
-    return jnp.where(d_b > d_nb, t * (d_b - d_nb), 0.0).sum(axis=-1)
+    All boundary/non-boundary squared differences are summed over the WHOLE
+    batch but normalized by the reference's single-image counts
+    (``n_hb = H·(W/bs) − 1`` etc., float division) — quirks preserved verbatim.
+    """
+    if img.shape[1] > 1:
+        raise ValueError(f"`psnrb` metric expects grayscale images, but got images with {img.shape[1]} channels.")
+    h, w = img.shape[-2:]
+    h_b = np.arange(block_size - 1, w - 1, block_size)
+    h_bc = np.setdiff1d(np.arange(w - 1), h_b)
+    v_b = np.arange(block_size - 1, h - 1, block_size)
+    v_bc = np.setdiff1d(np.arange(h - 1), v_b)
+
+    d_b = ((img[..., :, h_b] - img[..., :, h_b + 1]) ** 2).sum()
+    d_bc = ((img[..., :, h_bc] - img[..., :, h_bc + 1]) ** 2).sum()
+    d_b += ((img[..., v_b, :] - img[..., v_b + 1, :]) ** 2).sum()
+    d_bc += ((img[..., v_bc, :] - img[..., v_bc + 1, :]) ** 2).sum()
+
+    n_hb = h * (w / block_size) - 1
+    n_hbc = (h * (w - 1)) - n_hb
+    n_vb = w * (h / block_size) - 1
+    n_vbc = (w * (h - 1)) - n_vb
+    d_b = d_b / (n_hb + n_vb)
+    d_bc = d_bc / (n_hbc + n_vbc)
+    t = float(np.log2(block_size) / np.log2(min(h, w)))
+    return jnp.where(d_b > d_bc, t * (d_b - d_bc), 0.0)
 
 
 def peak_signal_noise_ratio_with_blocked_effect(preds: Array, target: Array, block_size: int = 8) -> Array:
-    """PSNR-B (reference ``psnrb.py:25-76``): PSNR penalized by the blocking effect factor.
+    """PSNR-B (reference ``psnrb.py:67-135``): PSNR penalized by the blocking effect factor.
+
+    One score over the pooled batch (not per-image-then-mean); when the data
+    range is ≤ 2 the numerator is fixed to 1.0 (reference ``psnrb.py:82-84``).
 
     >>> import jax.numpy as jnp
     >>> import numpy as np
@@ -262,9 +298,8 @@ def peak_signal_noise_ratio_with_blocked_effect(preds: Array, target: Array, blo
     target = target.astype(jnp.float32)
     data_range = target.max() - target.min()
     bef = _blocking_effect_factor(preds, block_size)
-    mse = ((preds - target) ** 2).reshape(preds.shape[0], -1).mean(-1)
-    mse_b = mse + bef
-    return (10 * jnp.log10(data_range**2 / mse_b)).mean()
+    mse_b = ((preds - target) ** 2).mean() + bef
+    return jnp.where(data_range > 2, 10 * jnp.log10(data_range**2 / mse_b), 10 * jnp.log10(1.0 / mse_b))
 
 
 # --------------------------------------------------------------------------- VIF
@@ -333,7 +368,15 @@ def spectral_distortion_index(
     """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
-    _check_same_shape(preds, target)
+    # batch/channel must match, but spatial sizes may differ (QNR feeds the
+    # low-res ms as target — reference ``d_lambda.py:40-43`` checks shape[:2] only)
+    if preds.ndim != 4 or target.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if preds.shape[:2] != target.shape[:2]:
+        raise ValueError(
+            f"Expected `preds` and `target` to have the same batch and channel sizes."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
     c = preds.shape[1]
     # pairwise UQI between all band pairs for fused (preds) and low-res (target)
     def band_uqi_matrix(x, y):
@@ -347,57 +390,88 @@ def spectral_distortion_index(
     if c == 1:
         q_fused = universal_image_quality_index(preds, preds)
         q_lr = universal_image_quality_index(target, target)
-        return jnp.abs(q_fused - q_lr) ** (1.0 / p)
-    q_fused = band_uqi_matrix(preds, preds)
-    q_lr = band_uqi_matrix(target, target)
-    diff = jnp.abs(q_fused - q_lr) ** p
-    # off-diagonal mean
-    mask = ~jnp.eye(c, dtype=bool)
-    return (diff[mask].mean()) ** (1.0 / p)
+        out = jnp.abs(q_fused - q_lr) ** (1.0 / p)
+    else:
+        q_fused = band_uqi_matrix(preds, preds)
+        q_lr = band_uqi_matrix(target, target)
+        diff = jnp.abs(q_fused - q_lr) ** p
+        # off-diagonal mean
+        mask = ~jnp.eye(c, dtype=bool)
+        out = (diff[mask].mean()) ** (1.0 / p)
+    # the output is already a scalar; reduce is the reference's (no-op) tail
+    # (``d_lambda.py:100-106``), kept so reduction="sum"/"none" round-trips
+    return reduce(out, "elementwise_mean" if reduction in ("mean", "elementwise_mean") else reduction)
+
+
+def _unpack_ms_pan(ms, pan, pan_lr):
+    """Accept either the reference functional signature (``ms, pan`` arrays) or
+    the modular-API target dict (``{"ms": ..., "pan": ..., "pan_lr": ...}``)."""
+    if isinstance(ms, dict):
+        if "ms" not in ms or "pan" not in ms:
+            raise ValueError("Expected `target` to be a dict with keys ('ms', 'pan').")
+        return ms["ms"], ms["pan"], ms.get("pan_lr")
+    if ms is None or pan is None:
+        raise ValueError("Expected `ms` and `pan` inputs.")
+    return ms, pan, pan_lr
 
 
 def spatial_distortion_index(
-    preds: Array, target: Dict[str, Array], norm_order: int = 1, window_size: int = 7
+    preds: Array,
+    ms=None,
+    pan: Optional[Array] = None,
+    pan_lr: Optional[Array] = None,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """Spatial distortion index D_s (reference ``d_s.py:27-120``).
+    """Spatial distortion index D_s (reference ``d_s.py:139-203``).
 
-    ``target`` is a dict with keys ``ms`` (low-res multispectral) and ``pan``
-    (high-res panchromatic); optional ``pan_lr``.
+    When ``pan_lr`` is absent, pan is degraded with the scipy-style uniform
+    filter then bilinear-resized to the ms grid (reference ``d_s.py:179-191``).
     """
-    if not isinstance(target, dict) or "ms" not in target or "pan" not in target:
-        raise ValueError("Expected `target` to be a dict with keys ('ms', 'pan').")
-    ms, pan = target["ms"], target["pan"]
-    c = preds.shape[1]
-    pan_lr = target.get("pan_lr")
+    ms, pan, pan_lr = _unpack_ms_pan(ms, pan, pan_lr)
+    if not isinstance(norm_order, int) or norm_order <= 0:
+        raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+    # reference ``d_s.py:80-91``: batch/channel sizes must agree everywhere
+    for name, arr in (("ms", ms), ("pan", pan)) + ((("pan_lr", pan_lr),) if pan_lr is not None else ()):
+        if arr.ndim != 4:
+            raise ValueError(f"Expected `{name}` to have BxCxHxW shape. Got {name}: {arr.shape}.")
+        if preds.shape[:2] != arr.shape[:2]:
+            raise ValueError(
+                f"Expected `preds` and `{name}` to have the same batch and channel sizes."
+                f" Got preds: {preds.shape} and {name}: {arr.shape}."
+            )
+    ms_h, ms_w = ms.shape[-2:]
+    if window_size >= ms_h or window_size >= ms_w:
+        raise ValueError(
+            f"Expected `window_size` to be smaller than dimension of `ms`. Got window_size: {window_size}."
+        )
     if pan_lr is None:
-        # degrade pan to ms resolution: low-pass with the window filter, then average-pool down
-        from metrics_tpu.functional.image._helpers import _reflect_pad, _uniform_kernel, avg_pool2d, depthwise_conv
-
-        pads = [(window_size - 1) // 2] * 2
-        pan_lr = depthwise_conv(_reflect_pad(pan, pads), _uniform_kernel(pan.shape[1], (window_size, window_size)))
-        while pan_lr.shape[-1] > ms.shape[-1]:
-            pan_lr = avg_pool2d(pan_lr, 2)
+        pan_lr = resize_bilinear(scipy_uniform_filter(pan.astype(jnp.float32), window_size), (ms_h, ms_w))
+    c = preds.shape[1]
     vals = []
     for i in range(c):
-        # pair band i with pan channel i when pan is multi-channel (reference d_s.py pairing)
-        pc = i if pan.shape[1] == c else 0
-        q_hr = universal_image_quality_index(preds[:, i : i + 1], pan[:, pc : pc + 1])
-        q_lr = universal_image_quality_index(ms[:, i : i + 1], pan_lr[:, pc : pc + 1])
-        vals.append(jnp.abs(q_hr - q_lr) ** norm_order)
-    return (jnp.stack(vals).mean()) ** (1.0 / norm_order)
+        q_lr = universal_image_quality_index(ms[:, i : i + 1], pan_lr[:, i : i + 1])
+        q_hr = universal_image_quality_index(preds[:, i : i + 1], pan[:, i : i + 1])
+        vals.append(jnp.abs(q_lr - q_hr) ** norm_order)
+    return reduce(jnp.stack(vals), reduction) ** (1.0 / norm_order)
 
 
 def quality_with_no_reference(
     preds: Array,
-    target: Dict[str, Array],
+    ms=None,
+    pan: Optional[Array] = None,
+    pan_lr: Optional[Array] = None,
     alpha: float = 1.0,
     beta: float = 1.0,
     norm_order: int = 1,
     window_size: int = 7,
+    reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """QNR (reference ``qnr.py:26-90``): (1-D_λ)^α (1-D_s)^β."""
-    d_lambda = spectral_distortion_index(preds, target["ms"], p=norm_order)
-    d_s = spatial_distortion_index(preds, target, norm_order, window_size)
+    """QNR (reference ``qnr.py:28-104``): (1-D_λ)^α (1-D_s)^β."""
+    ms, pan, pan_lr = _unpack_ms_pan(ms, pan, pan_lr)
+    d_lambda = spectral_distortion_index(preds, ms, p=norm_order, reduction=reduction)
+    d_s = spatial_distortion_index(preds, ms, pan, pan_lr, norm_order, window_size, reduction)
     return (1 - d_lambda) ** alpha * (1 - d_s) ** beta
 
 

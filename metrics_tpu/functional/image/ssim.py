@@ -134,7 +134,13 @@ def _ssim_update(
 
     per_image = ssim_full.reshape(b, -1).mean(-1)
     if return_contrast_sensitivity:
-        return per_image, (upper / lower).reshape(b, -1).mean(-1)
+        # the reference averages the contrast term over the UNPADDED region only
+        # (``ssim.py:172-177``), unlike the ssim map itself which keeps the border
+        cs = upper / lower
+        for d, p in enumerate(pads):
+            if p:
+                cs = jnp.take(cs, jnp.arange(p, cs.shape[2 + d] - p), axis=2 + d)
+        return per_image, cs.reshape(b, -1).mean(-1)
     if return_full_image:
         return per_image, ssim_full
     return per_image

@@ -547,7 +547,7 @@ class SpatialDistortionIndex(Metric):
     def compute(self) -> Array:
         """Compute metric."""
         return spatial_distortion_index(
-            dim_zero_cat(self.preds), self._target_dict(), self.norm_order, self.window_size
+            dim_zero_cat(self.preds), self._target_dict(), norm_order=self.norm_order, window_size=self.window_size
         )
 
 
@@ -565,5 +565,10 @@ class QualityWithNoReference(SpatialDistortionIndex):
     def compute(self) -> Array:
         """Compute metric."""
         return quality_with_no_reference(
-            dim_zero_cat(self.preds), self._target_dict(), self.alpha, self.beta, self.norm_order, self.window_size
+            dim_zero_cat(self.preds),
+            self._target_dict(),
+            alpha=self.alpha,
+            beta=self.beta,
+            norm_order=self.norm_order,
+            window_size=self.window_size,
         )

@@ -57,7 +57,9 @@ def load_rle_codec() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib_cache is not None or _build_failed:
             return _lib_cache
-        stale = os.path.exists(_LIB) and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        stale = (
+            os.path.exists(_LIB) and os.path.exists(_SRC) and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
         if (not os.path.exists(_LIB) or stale) and not _build():
             _build_failed = True
             return None

@@ -1,0 +1,2 @@
+"""Minimal transforms stand-in: only ``functional.resize`` (used by reference D_s)."""
+from torchvision.transforms import functional  # noqa: F401

@@ -1,0 +1,9 @@
+"""``resize`` stand-in with torchvision's antialias=False bilinear semantics."""
+
+import torch
+
+
+def resize(img: torch.Tensor, size, antialias=None) -> torch.Tensor:
+    if isinstance(size, int):
+        size = (size, size)
+    return torch.nn.functional.interpolate(img, size=tuple(size), mode="bilinear", align_corners=False)

@@ -1,0 +1,88 @@
+"""Shared oracle loader: the ACTUAL reference package as ground truth.
+
+The strongest parity evidence available in this image: `/root/reference/src`
+is the importable TorchMetrics 1.7.0dev source (pure torch, CPU), and
+``tests/_ref_shim`` supplies the minimal stand-ins (torchvision box ops,
+pycocotools gates, lightning_utilities) its import graph needs.  Every
+``test_parity_*`` module funnels through :func:`reference` so path setup and
+skip behavior live in one place.
+
+Reference test strategy analog: ``tests/unittests/_helpers/testers.py:85-250``
+(the reference compares itself against sklearn; we compare against the
+reference itself, which transitively carries those sklearn-validated
+semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REF = "/root/reference/src"
+_SHIM = os.path.join(REPO, "tests", "_ref_shim")
+
+HAS_REF = os.path.isdir(_REF)
+
+
+def reference():
+    """Import and return the reference ``torchmetrics`` package (or skip)."""
+    if not HAS_REF:
+        pytest.skip("reference package not available")
+    for p in (_SHIM, _REF):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import torchmetrics  # noqa: PLC0415
+
+    return torchmetrics
+
+
+def torch():
+    if not HAS_REF:
+        pytest.skip("reference package not available")
+    import torch as _torch  # noqa: PLC0415
+
+    return _torch
+
+
+def t(x):
+    """numpy → torch tensor (copies; preserves integer/bool dtypes)."""
+    import torch as _torch  # noqa: PLC0415
+
+    return _torch.as_tensor(np.asarray(x))
+
+
+def to_np(x):
+    """torch tensor / jax array / scalar / dict / tuple / list → numpy."""
+    if isinstance(x, dict):
+        return {k: to_np(v) for k, v in x.items()}
+    if isinstance(x, (tuple, list)):
+        return type(x)(to_np(v) for v in x)
+    if hasattr(x, "detach"):  # torch tensor
+        return x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def assert_close(ours, ref, rtol=1e-5, atol=1e-5, label=""):
+    """Structure-aware allclose between our output and the reference's."""
+    ours, ref = to_np(ours), to_np(ref)
+    if isinstance(ref, dict):
+        assert isinstance(ours, dict), f"{label}: ours is {type(ours)}, ref is dict"
+        assert set(ours) == set(ref), f"{label}: key mismatch {set(ours) ^ set(ref)}"
+        for k in ref:
+            assert_close(ours[k], ref[k], rtol, atol, label=f"{label}[{k}]")
+        return
+    if isinstance(ref, (tuple, list)):
+        assert len(ours) == len(ref), f"{label}: length {len(ours)} vs {len(ref)}"
+        for i, (a, b) in enumerate(zip(ours, ref)):
+            assert_close(a, b, rtol, atol, label=f"{label}[{i}]")
+        return
+    a = np.asarray(ours, dtype=np.float64)
+    b = np.asarray(ref, dtype=np.float64)
+    assert a.shape == b.shape or a.squeeze().shape == b.squeeze().shape, f"{label}: shape {a.shape} vs {b.shape}"
+    np.testing.assert_allclose(
+        a.squeeze(), b.squeeze(), rtol=rtol, atol=atol, err_msg=f"parity failure at {label}"
+    )
