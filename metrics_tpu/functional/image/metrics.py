@@ -8,7 +8,7 @@ machinery from ``_helpers`` (one conv per statistic, fused epilogues).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple, Union
+from typing import Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -378,13 +378,20 @@ def spectral_distortion_index(
             f" Got preds: {preds.shape} and target: {target.shape}."
         )
     c = preds.shape[1]
-    # pairwise UQI between all band pairs for fused (preds) and low-res (target)
-    def band_uqi_matrix(x, y):
+    # UQI between band pairs — symmetric with a masked diagonal, so only the
+    # upper triangle is computed, and all pairs ride ONE batched UQI call
+    # (stacked along the batch dim) instead of c² sequential conv passes
+    def band_uqi_matrix(x):
+        pairs = [(i, j) for i in range(c) for j in range(i + 1, c)]
+        lhs = jnp.concatenate([x[:, i : i + 1] for i, _ in pairs])
+        rhs = jnp.concatenate([x[:, j : j + 1] for _, j in pairs])
+        maps = universal_image_quality_index(lhs, rhs, reduction="none")
+        b = x.shape[0]
         mat = jnp.zeros((c, c))
-        for i in range(c):
-            for j in range(c):
-                q = universal_image_quality_index(x[:, i : i + 1], y[:, j : j + 1], reduction="elementwise_mean")
-                mat = mat.at[i, j].set(q)
+        for k, (i, j) in enumerate(pairs):
+            q = maps[k * b : (k + 1) * b].mean()
+            mat = mat.at[i, j].set(q)
+            mat = mat.at[j, i].set(q)
         return mat
 
     if c == 1:
@@ -392,8 +399,8 @@ def spectral_distortion_index(
         q_lr = universal_image_quality_index(target, target)
         out = jnp.abs(q_fused - q_lr) ** (1.0 / p)
     else:
-        q_fused = band_uqi_matrix(preds, preds)
-        q_lr = band_uqi_matrix(target, target)
+        q_fused = band_uqi_matrix(preds)
+        q_lr = band_uqi_matrix(target)
         diff = jnp.abs(q_fused - q_lr) ** p
         # off-diagonal mean
         mask = ~jnp.eye(c, dtype=bool)
@@ -409,6 +416,13 @@ def _unpack_ms_pan(ms, pan, pan_lr):
     if isinstance(ms, dict):
         if "ms" not in ms or "pan" not in ms:
             raise ValueError("Expected `target` to be a dict with keys ('ms', 'pan').")
+        if pan is not None or pan_lr is not None:
+            # a dict target carries everything; extra positionals are almost
+            # certainly old-signature (norm_order/window_size) call sites
+            raise ValueError(
+                "When the target is a dict, pass norm_order/window_size as keyword arguments"
+                " — positional arguments after the dict are not accepted."
+            )
         return ms["ms"], ms["pan"], ms.get("pan_lr")
     if ms is None or pan is None:
         raise ValueError("Expected `ms` and `pan` inputs.")

@@ -143,9 +143,10 @@ def theils_u(
 def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
     """Compute Fleiss' kappa for inter-rater agreement (reference ``nominal/fleiss_kappa.py:23-92``).
 
-    ``mode="counts"``: ratings is (n_subjects, n_categories) count matrix;
-    ``mode="probs"``: ratings is (n_raters, n_subjects, n_categories) probabilities,
-    converted to one-hot votes by argmax.
+    ``mode="counts"``: ratings is (n_samples, n_categories) count matrix;
+    ``mode="probs"``: ratings is (n_samples, n_categories, n_raters) probabilities,
+    converted to per-rater votes by argmax over the category dim (reference
+    ``fleiss_kappa.py:27-35``).
 
     >>> import jax.numpy as jnp
     >>> ratings = jnp.array([[0, 0, 14], [0, 2, 12], [0, 6, 8], [0, 12, 2]])
@@ -155,11 +156,11 @@ def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
     if mode == "probs":
         if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
             raise ValueError("If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
-                             " [n_raters, n_subjects, n_categories] and be floating point")
-        n_raters, n_subjects, n_cat = ratings.shape
-        votes = jnp.argmax(ratings, axis=-1)  # (raters, subjects)
-        onehot = votes[..., None] == jnp.arange(n_cat)
-        ratings = onehot.sum(axis=0).astype(jnp.float32)
+                             " [n_samples, n_categories, n_raters] and be floating point")
+        n_cat = ratings.shape[1]
+        votes = jnp.argmax(ratings, axis=1)  # (samples, raters)
+        onehot = votes[..., None] == jnp.arange(n_cat)  # (samples, raters, categories)
+        ratings = onehot.sum(axis=1).astype(jnp.float32)
     elif mode == "counts":
         if ratings.ndim != 2:
             raise ValueError("If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"

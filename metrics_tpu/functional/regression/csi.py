@@ -12,13 +12,22 @@ from metrics_tpu.utils.compute import _safe_divide
 
 
 def _critical_success_index_update(
-    preds: Array, target: Array, threshold: float, keep_sequence_dim: bool = False
+    preds: Array, target: Array, threshold: float, keep_sequence_dim=None
 ) -> Tuple[Array, Array, Array]:
-    """Binarize at ``threshold`` and count hits/misses/false-alarms (reference ``csi.py:25-56``)."""
+    """Binarize at ``threshold`` and count hits/misses/false-alarms (reference ``csi.py:23-58``).
+
+    ``keep_sequence_dim`` is the INDEX of the dimension to keep (or None to
+    reduce over everything), matching the reference signature.
+    """
     _check_same_shape(preds, target)
+    if keep_sequence_dim is None:
+        sum_axes = None
+    elif not 0 <= keep_sequence_dim < preds.ndim:
+        raise ValueError(f"Expected keep_sequence dim to be in range [0, {preds.ndim}] but got {keep_sequence_dim}")
+    else:
+        sum_axes = tuple(i for i in range(preds.ndim) if i != keep_sequence_dim)
     preds_bin = preds >= threshold
     target_bin = target >= threshold
-    sum_axes = None if not keep_sequence_dim else tuple(range(1, preds.ndim))
     hits = jnp.sum(preds_bin & target_bin, axis=sum_axes)
     misses = jnp.sum(~preds_bin & target_bin, axis=sum_axes)
     false_alarms = jnp.sum(preds_bin & ~target_bin, axis=sum_axes)
@@ -31,7 +40,7 @@ def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Ar
 
 
 def critical_success_index(
-    preds: Array, target: Array, threshold: float, keep_sequence_dim: bool = False
+    preds: Array, target: Array, threshold: float, keep_sequence_dim=None
 ) -> Array:
     """Compute critical success index (reference ``csi.py:75-105``).
 

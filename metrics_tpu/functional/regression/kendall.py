@@ -28,6 +28,7 @@ def _kendall_tau_1d(preds: Array, target: Array, variant: str) -> Array:
     """
     n = preds.shape[0]
     con_min_dis = jnp.zeros(())
+    con_plus_dis = jnp.zeros(())
     tx = jnp.zeros(())
     ty = jnp.zeros(())
     idx = jnp.arange(n)
@@ -37,11 +38,13 @@ def _kendall_tau_1d(preds: Array, target: Array, variant: str) -> Array:
         sy = jnp.sign(target[rows, None] - target[None, :])
         upper = idx[None, :] > idx[rows, None]  # only count each pair once
         con_min_dis = con_min_dis + jnp.sum(jnp.where(upper, sx * sy, 0.0))
+        con_plus_dis = con_plus_dis + jnp.sum(upper & (sx * sy != 0))
         tx = tx + jnp.sum(upper & (sx == 0))
         ty = ty + jnp.sum(upper & (sy == 0))
     n0 = n * (n - 1) / 2.0
     if variant == "a":
-        return con_min_dis / n0
+        # tied pairs are excluded from the denominator (reference ``kendall.py:164-165``)
+        return con_min_dis / con_plus_dis
     if variant == "b":
         denom = jnp.sqrt((n0 - tx) * (n0 - ty))
         return con_min_dis / denom

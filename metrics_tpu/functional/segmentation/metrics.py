@@ -49,6 +49,22 @@ def _dice_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Arra
     return numerator, denominator, target_sum, pred_sum
 
 
+def _dice_score_compute(
+    numerator: Array, denominator: Array, average: Optional[str], support: Optional[Array] = None
+) -> Array:
+    """Per-sample Dice from per-sample stats (reference ``segmentation/dice.py:74-90``)."""
+    if average == "micro":
+        numerator = numerator.sum(-1)
+        denominator = denominator.sum(-1)
+    dice = _safe_divide(numerator, denominator, zero_division=1.0)
+    if average == "macro":
+        dice = dice.mean(-1)
+    elif average == "weighted" and support is not None:
+        weights = _safe_divide(support, support.sum(-1, keepdims=True), zero_division=1.0)
+        dice = (dice * weights).sum(-1)
+    return dice
+
+
 def dice_score(
     preds: Array,
     target: Array,
@@ -58,14 +74,19 @@ def dice_score(
     input_format: str = "one-hot",
     aggregation_level: str = "samplewise",
 ) -> Array:
-    """Compute the Dice score for semantic segmentation (reference ``segmentation/dice.py:27-121``).
+    """Per-sample Dice scores (reference ``segmentation/dice.py:93-154``).
+
+    Returns shape ``(N,)`` (or ``(N, C)`` for ``average="none"``) exactly like
+    the reference; empty-everywhere classes score 1.0 (``zero_division=1.0``).
+    ``aggregation_level="global"`` is our extension: stats pool over the batch
+    first, giving a single pooled score row.
 
     >>> import jax.numpy as jnp
     >>> import numpy as np
     >>> rng = np.random.RandomState(0)
     >>> preds = jnp.asarray(rng.randint(0, 2, (4, 3, 16, 16)))
     >>> target = jnp.asarray(rng.randint(0, 2, (4, 3, 16, 16)))
-    >>> round(float(dice_score(preds, target, num_classes=3)), 3)
+    >>> round(float(dice_score(preds, target, num_classes=3).mean()), 3)
     0.494
     """
     if average not in ("micro", "macro", "weighted", "none", None):
@@ -83,21 +104,7 @@ def dice_score(
     elif aggregation_level != "samplewise":
         raise ValueError(f"Expected argument `aggregation_level` to be one of 'samplewise', 'global',"
                          f" but got {aggregation_level}")
-
-    if average == "micro":
-        scores = _safe_divide(numerator.sum(-1), denominator.sum(-1), zero_division=jnp.nan)
-    else:
-        scores = _safe_divide(numerator, denominator, zero_division=jnp.nan)
-        if average == "macro":
-            nan = jnp.isnan(scores)
-            scores = jnp.where(nan, 0.0, scores).sum(-1) / jnp.maximum((~nan).sum(-1), 1)
-        elif average == "weighted":
-            w = _safe_divide(support, support.sum(-1, keepdims=True))
-            scores = jnp.where(jnp.isnan(scores), 0.0, scores * w).sum(-1)
-    if average in ("none", None):
-        return jnp.where(jnp.isnan(scores), 0.0, scores)  # per-sample per-class, unreduced
-    nan = jnp.isnan(scores)
-    return jnp.where(nan, 0.0, scores).sum() / jnp.maximum((~nan).sum(), 1) if scores.ndim else scores
+    return _dice_score_compute(numerator, denominator, average, support=support if average == "weighted" else None)
 
 
 def generalized_dice_score(
@@ -118,19 +125,27 @@ def generalized_dice_score(
     target_sum = jnp.sum(target, axis=reduce_axes)
     pred_sum = jnp.sum(preds, axis=reduce_axes)
     if weight_type == "square":
-        weights = _safe_divide(jnp.ones_like(target_sum), target_sum**2)
+        weights = 1.0 / target_sum**2
     elif weight_type == "simple":
-        weights = _safe_divide(jnp.ones_like(target_sum), target_sum)
+        weights = 1.0 / target_sum
     else:
         weights = jnp.ones_like(target_sum)
-    # infinite weights (empty classes) replaced by the max finite weight (reference utils)
-    w_max = jnp.max(jnp.where(target_sum > 0, weights, 0.0), axis=-1, keepdims=True)
-    weights = jnp.where(target_sum > 0, weights, w_max)
+    # infinite weights (empty classes) replaced via the reference's
+    # repeat().T.flatten() indexing (``generalized_dice.py:84-90``): cell (i, j)
+    # receives the batch-max (infs zeroed first) of class ``(i*C + j) // N`` —
+    # NOT of class j. A reference quirk for N > 1, replicated verbatim.
+    infs = jnp.isinf(weights)
+    weights = jnp.where(infs, 0.0, weights)
+    w_max = jnp.max(weights, axis=0)  # (C,) batch-max per class
+    n_s, n_c = weights.shape
+    repl = w_max[jnp.arange(n_s * n_c) // n_s].reshape(n_s, n_c)
+    weights = jnp.where(infs, repl, weights)
     numerator = 2 * weights * intersection
     denominator = weights * (pred_sum + target_sum)
+    # per-sample scores, shape (N, C) or (N,) (reference ``generalized_dice.py:98-104``)
     if per_class:
         return _safe_divide(numerator, denominator)
-    return _safe_divide(numerator.sum(-1), denominator.sum(-1)).mean()
+    return _safe_divide(numerator.sum(-1), denominator.sum(-1))
 
 
 def mean_iou(
@@ -148,7 +163,7 @@ def mean_iou(
     >>> rng = np.random.RandomState(0)
     >>> preds = jnp.asarray(rng.randint(0, 3, (4, 16, 16)))
     >>> target = jnp.asarray(rng.randint(0, 3, (4, 16, 16)))
-    >>> round(float(mean_iou(preds, target, num_classes=3, input_format="index")), 3)
+    >>> round(float(mean_iou(preds, target, num_classes=3, input_format="index").mean()), 3)
     0.198
     """
     if input_format == "index" and num_classes is None:
@@ -158,14 +173,10 @@ def mean_iou(
     reduce_axes = tuple(range(2, preds.ndim))
     intersection = jnp.sum(preds * target, axis=reduce_axes)
     union = jnp.sum(preds, axis=reduce_axes) + jnp.sum(target, axis=reduce_axes) - intersection
-    valid = union > 0
-    iou = jnp.where(valid, intersection / jnp.where(valid, union, 1.0), jnp.nan)
-    if per_class:
-        nan = jnp.isnan(iou)
-        return jnp.where(nan, 0.0, iou).sum(0) / jnp.maximum((~nan).sum(0), 1)
-    nan = jnp.isnan(iou)
-    per_sample = jnp.where(nan, 0.0, iou).sum(-1) / jnp.maximum((~nan).sum(-1), 1)
-    return per_sample.mean()
+    # per-sample scores; absent classes contribute 0 to the class mean
+    # (reference ``mean_iou.py:66-73`` — _safe_divide's zero_division=0 default)
+    iou = _safe_divide(intersection, union)
+    return iou if per_class else iou.mean(-1)
 
 
 def _edges(mask: Array) -> Array:
@@ -216,8 +227,13 @@ def hausdorff_distance(
         for j in range(c):
             e1 = np.argwhere(np.asarray(_edges(preds[i, j])))
             e2 = np.argwhere(np.asarray(_edges(target[i, j])))
-            if len(e1) == 0 or len(e2) == 0:
+            if len(e1) == 0 and len(e2) == 0:
                 out[i, j] = 0.0
+                continue
+            if len(e1) == 0 or len(e2) == 0:
+                # one empty edge set → infinite surface distance (reference
+                # ``segmentation/utils.py:382-388``)
+                out[i, j] = np.inf
                 continue
             d = point_dist(e1.astype(np.float64), e2.astype(np.float64))
             fwd = d.min(axis=1).max()
@@ -225,4 +241,5 @@ def hausdorff_distance(
                 out[i, j] = fwd
             else:
                 out[i, j] = max(fwd, d.min(axis=0).max())
-    return jnp.asarray(out.mean())
+    # per-(sample, class) distance matrix (reference ``hausdorff_distance.py:101-115``)
+    return jnp.asarray(out)

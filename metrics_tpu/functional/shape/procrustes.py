@@ -7,40 +7,43 @@ from typing import Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.utils.checks import _check_same_shape
 
 
 def procrustes_disparity(
     point_cloud1: Array, point_cloud2: Array, return_all: bool = False
 ) -> Union[Array, Tuple[Array, Array, Array]]:
-    """Run Procrustes analysis between two point clouds (reference ``shape/procrustes.py:22-70``).
+    """Run batched Procrustes analysis (reference ``shape/procrustes.py:23-66``).
+
+    Inputs are ``(N, M, D)`` batches of M D-dimensional points; returns the
+    per-batch disparity ``(N,)`` (and scale/rotation when ``return_all``).
 
     >>> import jax.numpy as jnp
     >>> import numpy as np
     >>> rng = np.random.RandomState(42)
-    >>> pc1 = jnp.asarray(rng.rand(10, 3).astype(np.float32))
-    >>> pc2 = jnp.asarray(rng.rand(10, 3).astype(np.float32))
-    >>> round(float(procrustes_disparity(pc1, pc2)), 4)
+    >>> pc1 = jnp.asarray(rng.rand(1, 10, 3).astype(np.float32))
+    >>> pc2 = jnp.asarray(rng.rand(1, 10, 3).astype(np.float32))
+    >>> round(float(procrustes_disparity(pc1, pc2)[0]), 4)
     0.7251
     """
-    if point_cloud1.shape != point_cloud2.shape:
-        raise ValueError("Expected both point clouds to have the same shape "
-                         f"but got {point_cloud1.shape} and {point_cloud2.shape}")
-    point_cloud1 = point_cloud1 - point_cloud1.mean(axis=0)
-    point_cloud2 = point_cloud2 - point_cloud2.mean(axis=0)
-    norm1 = jnp.linalg.norm(point_cloud1)
-    norm2 = jnp.linalg.norm(point_cloud2)
-    if bool(norm1 < 1e-16) or bool(norm2 < 1e-16):
-        rank_zero_warn("Point cloud has zero norm, returning 0 disparity.")
-        return jnp.asarray(0.0)
-    point_cloud1 = point_cloud1 / norm1
-    point_cloud2 = point_cloud2 / norm2
+    _check_same_shape(point_cloud1, point_cloud2)
+    if point_cloud1.ndim != 3:
+        raise ValueError(
+            "Expected both datasets to be 3D tensors of shape (N, M, D), where N is the batch size, M is the number of"
+            f" data points and D is the dimensionality of the data points, but got {point_cloud1.ndim} dimensions."
+        )
+    point_cloud1 = point_cloud1 - point_cloud1.mean(axis=1, keepdims=True)
+    point_cloud2 = point_cloud2 - point_cloud2.mean(axis=1, keepdims=True)
+    point_cloud1 = point_cloud1 / jnp.linalg.norm(point_cloud1, axis=(1, 2), keepdims=True)
+    point_cloud2 = point_cloud2 / jnp.linalg.norm(point_cloud2, axis=(1, 2), keepdims=True)
 
-    u, w, vt = jnp.linalg.svd((point_cloud2.T @ point_cloud1).T, full_matrices=False)
-    rotation = u @ vt
-    scale = w.sum()
-    point_cloud2 = scale * point_cloud2 @ rotation.T
-    disparity = jnp.sum((point_cloud1 - point_cloud2) ** 2)
+    u, w, vt = jnp.linalg.svd(
+        jnp.swapaxes(jnp.matmul(jnp.swapaxes(point_cloud2, 1, 2), point_cloud1), 1, 2), full_matrices=False
+    )
+    rotation = jnp.matmul(u, vt)
+    scale = w.sum(1, keepdims=True)
+    point_cloud2 = scale[:, None] * jnp.matmul(point_cloud2, jnp.swapaxes(rotation, 1, 2))
+    disparity = ((point_cloud1 - point_cloud2) ** 2).sum(axis=(1, 2))
     if return_all:
         return disparity, scale, rotation
     return disparity

@@ -67,7 +67,9 @@ def _tokenize_words(text: str) -> List[str]:
 
 
 def _tokenize_chars(text: str) -> List[str]:
-    return list(text)
+    # the reference space-joins every char then re-splits on whitespace
+    # (``sacre_bleu.py:_tokenize_char``) — so whitespace chars are NOT tokens
+    return " ".join(text).split()
 
 
 _13A_RE = [

@@ -340,7 +340,7 @@ class CriticalSuccessIndex(Metric):
             preds = jnp.moveaxis(preds, self.keep_sequence_dim, 0)
             target = jnp.moveaxis(target, self.keep_sequence_dim, 0)
         hits, misses, false_alarms = _critical_success_index_update(
-            preds, target, self.threshold, self.keep_sequence_dim is not None
+            preds, target, self.threshold, 0 if self.keep_sequence_dim is not None else None
         )
         if self.keep_sequence_dim is None:
             self.hits = self.hits + hits

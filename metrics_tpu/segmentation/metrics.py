@@ -8,6 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.segmentation.metrics import (
+    _dice_score_compute,
     _dice_update,
     _format_inputs,
     generalized_dice_score,
@@ -15,7 +16,6 @@ from metrics_tpu.functional.segmentation.metrics import (
     mean_iou,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.compute import _safe_divide
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -75,7 +75,7 @@ class DiceScore(Metric):
         self.support.append(support)
 
     def compute(self) -> Array:
-        """Compute metric."""
+        """Sample-mean of per-sample Dice (reference ``segmentation/dice.py:136-143``)."""
         numerator = dim_zero_cat(self.numerator)
         denominator = dim_zero_cat(self.denominator)
         support = dim_zero_cat(self.support)
@@ -83,21 +83,9 @@ class DiceScore(Metric):
             numerator = numerator.sum(axis=0, keepdims=True)
             denominator = denominator.sum(axis=0, keepdims=True)
             support = support.sum(axis=0, keepdims=True)
-        if self.average == "micro":
-            scores = _safe_divide(numerator.sum(-1), denominator.sum(-1), zero_division=jnp.nan)
-        else:
-            scores = _safe_divide(numerator, denominator, zero_division=jnp.nan)
-            if self.average == "macro":
-                nan = jnp.isnan(scores)
-                scores = jnp.where(nan, 0.0, scores).sum(-1) / jnp.maximum((~nan).sum(-1), 1)
-            elif self.average == "weighted":
-                w = _safe_divide(support, support.sum(-1, keepdims=True))
-                scores = jnp.where(jnp.isnan(scores), 0.0, scores * w).sum(-1)
-        if self.average in ("none", None):
-            nan = jnp.isnan(scores)
-            return jnp.where(nan, 0.0, scores).sum(0) / jnp.maximum((~nan).sum(0), 1)
-        nan = jnp.isnan(scores)
-        return jnp.where(nan, 0.0, scores).sum() / jnp.maximum((~nan).sum(), 1)
+        return _dice_score_compute(
+            numerator, denominator, self.average, support=support if self.average == "weighted" else None
+        ).mean(0)
 
 
 class GeneralizedDiceScore(Metric):
@@ -135,7 +123,7 @@ class GeneralizedDiceScore(Metric):
             self.weight_type, self.input_format,
         )
         n = preds.shape[0]
-        self.score = self.score + (score.sum(0) if self.per_class else score * n)
+        self.score = self.score + score.sum(0)
         self.samples = self.samples + n
 
     def compute(self) -> Array:
@@ -174,26 +162,21 @@ class MeanIoU(Metric):
         self.include_background = include_background
         self.per_class = per_class
         self.input_format = input_format
-        self.add_state("iou_list", [], dist_reduce_fx="cat")
+        n_out = num_classes - (0 if include_background else 1)
+        self.add_state("score", jnp.zeros(n_out) if per_class else jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_batches", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        """Update state with per-sample per-class IoU."""
-        preds, target = _format_inputs(preds, target, self.num_classes, self.input_format, self.include_background)
-        reduce_axes = tuple(range(2, preds.ndim))
-        intersection = jnp.sum(preds * target, axis=reduce_axes)
-        union = jnp.sum(preds, axis=reduce_axes) + jnp.sum(target, axis=reduce_axes) - intersection
-        valid = union > 0
-        iou = jnp.where(valid, intersection / jnp.where(valid, union, 1.0), jnp.nan)
-        self.iou_list.append(iou)
+        """Accumulate batch-mean IoU (reference ``segmentation/mean_iou.py:117-124``)."""
+        score = mean_iou(
+            preds, target, self.num_classes, self.include_background, self.per_class, self.input_format
+        )
+        self.score = self.score + (score.mean(0) if self.per_class else score.mean())
+        self.num_batches = self.num_batches + 1
 
     def compute(self) -> Array:
         """Compute metric."""
-        iou = dim_zero_cat(self.iou_list)
-        nan = jnp.isnan(iou)
-        if self.per_class:
-            return jnp.where(nan, 0.0, iou).sum(0) / jnp.maximum((~nan).sum(0), 1)
-        per_sample = jnp.where(nan, 0.0, iou).sum(-1) / jnp.maximum((~nan).sum(-1), 1)
-        return per_sample.mean()
+        return self.score / self.num_batches
 
 
 class HausdorffDistance(Metric):
@@ -230,8 +213,9 @@ class HausdorffDistance(Metric):
             preds, target, self.num_classes, self.include_background, self.distance_metric,
             self.spacing, self.directed, self.input_format,
         )
-        self.score = self.score + score * preds.shape[0]
-        self.total = self.total + preds.shape[0]
+        # mean over every (sample, class) cell (reference ``hausdorff_distance.py:110-127``)
+        self.score = self.score + score.sum()
+        self.total = self.total + score.size
 
     def compute(self) -> Array:
         """Compute metric."""

@@ -41,8 +41,7 @@ class ProcrustesDisparity(Metric):
         if point_cloud1.ndim == 2:
             point_cloud1 = point_cloud1[None]
             point_cloud2 = point_cloud2[None]
-        for i in range(point_cloud1.shape[0]):
-            self.disparity = self.disparity + procrustes_disparity(point_cloud1[i], point_cloud2[i])
+        self.disparity = self.disparity + procrustes_disparity(point_cloud1, point_cloud2).sum()
         self.total = self.total + point_cloud1.shape[0]
 
     def compute(self) -> Array:

@@ -36,6 +36,9 @@ def reference():
         if p not in sys.path:
             sys.path.insert(0, p)
     import torchmetrics  # noqa: PLC0415
+    import torchmetrics.functional.clustering  # noqa: F401, PLC0415
+    import torchmetrics.functional.segmentation  # noqa: F401, PLC0415
+    import torchmetrics.functional.shape  # noqa: F401, PLC0415
 
     return torchmetrics
 

@@ -1,0 +1,215 @@
+"""Classification special-family parity vs the ACTUAL reference package.
+
+Covers the families the sklearn sweeps can't reach directly: calibration error
+(all norms × bin counts), hinge variants, ranking metrics, LogAUC ranges,
+Cohen's kappa weighting, exact match, MCC, confusion-matrix normalization, and
+the exact (thresholds=None) curve path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.functional.classification as ours
+from tests._reference import assert_close, reference, t
+
+NC = 4
+NL = 3
+
+
+def _bin(rng, n=200):
+    return rng.rand(n).astype(np.float32), rng.randint(0, 2, n)
+
+
+def _mc(rng, n=200):
+    logits = rng.randn(n, NC).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    return probs.astype(np.float32), rng.randint(0, NC, n)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+@pytest.mark.parametrize("n_bins", [10, 15, 30])
+def test_binary_calibration_error(norm, n_bins):
+    tm = reference()
+    rng = np.random.RandomState(71)
+    p, g = _bin(rng)
+    ref = tm.functional.classification.binary_calibration_error(t(p), t(g), n_bins=n_bins, norm=norm)
+    got = ours.binary_calibration_error(jnp.asarray(p), jnp.asarray(g), n_bins=n_bins, norm=norm)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"bce[{norm}]")
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_multiclass_calibration_error(norm):
+    tm = reference()
+    rng = np.random.RandomState(72)
+    p, g = _mc(rng)
+    ref = tm.functional.classification.multiclass_calibration_error(t(p), t(g), num_classes=NC, norm=norm)
+    got = ours.multiclass_calibration_error(jnp.asarray(p), jnp.asarray(g), num_classes=NC, norm=norm)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"mcce[{norm}]")
+
+
+@pytest.mark.parametrize("squared", [True, False])
+def test_binary_hinge(squared):
+    tm = reference()
+    rng = np.random.RandomState(73)
+    p = rng.randn(150).astype(np.float32)
+    g = rng.randint(0, 2, 150)
+    ref = tm.functional.classification.binary_hinge_loss(t(p), t(g), squared=squared)
+    got = ours.binary_hinge_loss(jnp.asarray(p), jnp.asarray(g), squared=squared)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="binary_hinge")
+
+
+@pytest.mark.parametrize("multiclass_mode", ["crammer-singer", "one-vs-all"])
+@pytest.mark.parametrize("squared", [True, False])
+def test_multiclass_hinge(multiclass_mode, squared):
+    tm = reference()
+    rng = np.random.RandomState(74)
+    p, g = _mc(rng)
+    ref = tm.functional.classification.multiclass_hinge_loss(
+        t(p), t(g), num_classes=NC, squared=squared, multiclass_mode=multiclass_mode
+    )
+    got = ours.multiclass_hinge_loss(
+        jnp.asarray(p), jnp.asarray(g), num_classes=NC, squared=squared, multiclass_mode=multiclass_mode
+    )
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="mc_hinge")
+
+
+def test_ranking_metrics():
+    tm = reference()
+    rng = np.random.RandomState(75)
+    p = rng.rand(60, NL).astype(np.float32)
+    g = rng.randint(0, 2, (60, NL))
+    for name in ("multilabel_coverage_error", "multilabel_ranking_average_precision", "multilabel_ranking_loss"):
+        ref = getattr(tm.functional.classification, name)(t(p), t(g), num_labels=NL)
+        got = getattr(ours, name)(jnp.asarray(p), jnp.asarray(g), num_labels=NL)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=name)
+
+
+@pytest.mark.parametrize("fpr_range", [(0.001, 0.1), (0.01, 0.5)])
+def test_binary_logauc(fpr_range):
+    tm = reference()
+    rng = np.random.RandomState(76)
+    p, g = _bin(rng, 300)
+    ref = tm.functional.classification.binary_logauc(t(p), t(g), fpr_range=fpr_range)
+    got = ours.binary_logauc(jnp.asarray(p), jnp.asarray(g), fpr_range=fpr_range)
+    assert_close(got, ref, rtol=1e-3, atol=1e-4, label="logauc")
+
+
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_multiclass_logauc(average):
+    tm = reference()
+    rng = np.random.RandomState(77)
+    p, g = _mc(rng, 300)
+    ref = tm.functional.classification.multiclass_logauc(t(p), t(g), num_classes=NC, average=average)
+    got = ours.multiclass_logauc(jnp.asarray(p), jnp.asarray(g), num_classes=NC, average=average)
+    assert_close(got, ref, rtol=1e-3, atol=1e-4, label="mc_logauc")
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_cohen_kappa(weights):
+    tm = reference()
+    rng = np.random.RandomState(78)
+    p, g = _mc(rng)
+    ref = tm.functional.classification.multiclass_cohen_kappa(t(p), t(g), num_classes=NC, weights=weights)
+    got = ours.multiclass_cohen_kappa(jnp.asarray(p), jnp.asarray(g), num_classes=NC, weights=weights)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="kappa")
+
+
+@pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+def test_confusion_matrix_normalize(normalize):
+    tm = reference()
+    rng = np.random.RandomState(79)
+    p, g = _mc(rng)
+    ref = tm.functional.classification.multiclass_confusion_matrix(
+        t(p), t(g), num_classes=NC, normalize=normalize
+    )
+    got = ours.multiclass_confusion_matrix(jnp.asarray(p), jnp.asarray(g), num_classes=NC, normalize=normalize)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="confmat")
+
+
+def test_exact_match():
+    tm = reference()
+    rng = np.random.RandomState(80)
+    p = rng.randint(0, NC, (50, 6))
+    g = rng.randint(0, NC, (50, 6))
+    ref = tm.functional.classification.multiclass_exact_match(t(p), t(g), num_classes=NC)
+    got = ours.multiclass_exact_match(jnp.asarray(p), jnp.asarray(g), num_classes=NC)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="mc_exact")
+    pl = rng.rand(50, NL).astype(np.float32)
+    gl = rng.randint(0, 2, (50, NL))
+    ref = tm.functional.classification.multilabel_exact_match(t(pl), t(gl), num_labels=NL)
+    got = ours.multilabel_exact_match(jnp.asarray(pl), jnp.asarray(gl), num_labels=NL)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="ml_exact")
+
+
+def test_mcc_and_jaccard():
+    tm = reference()
+    rng = np.random.RandomState(81)
+    p, g = _mc(rng)
+    ref = tm.functional.classification.multiclass_matthews_corrcoef(t(p), t(g), num_classes=NC)
+    got = ours.multiclass_matthews_corrcoef(jnp.asarray(p), jnp.asarray(g), num_classes=NC)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="mcc")
+    for average in ("macro", "micro", "weighted"):
+        ref = tm.functional.classification.multiclass_jaccard_index(t(p), t(g), num_classes=NC, average=average)
+        got = ours.multiclass_jaccard_index(jnp.asarray(p), jnp.asarray(g), num_classes=NC, average=average)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"jaccard[{average}]")
+
+
+def test_exact_curve_path():
+    """thresholds=None exact curves: PRC, ROC, AUROC, AP vs reference."""
+    tm = reference()
+    rng = np.random.RandomState(82)
+    p, g = _bin(rng, 250)
+    for name in ("binary_precision_recall_curve", "binary_roc"):
+        ref = getattr(tm.functional.classification, name)(t(p), t(g), thresholds=None)
+        got = getattr(ours, name)(jnp.asarray(p), jnp.asarray(g), thresholds=None)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=name)
+    for name in ("binary_auroc", "binary_average_precision"):
+        ref = getattr(tm.functional.classification, name)(t(p), t(g), thresholds=None)
+        got = getattr(ours, name)(jnp.asarray(p), jnp.asarray(g), thresholds=None)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=name)
+
+
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_multiclass_exact_curves(average):
+    tm = reference()
+    rng = np.random.RandomState(83)
+    p, g = _mc(rng, 150)
+    ref = tm.functional.classification.multiclass_auroc(t(p), t(g), num_classes=NC, average=average, thresholds=None)
+    got = ours.multiclass_auroc(jnp.asarray(p), jnp.asarray(g), num_classes=NC, average=average, thresholds=None)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="mc_auroc_exact")
+    ref = tm.functional.classification.multiclass_average_precision(
+        t(p), t(g), num_classes=NC, average=average, thresholds=None
+    )
+    got = ours.multiclass_average_precision(
+        jnp.asarray(p), jnp.asarray(g), num_classes=NC, average=average, thresholds=None
+    )
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="mc_ap_exact")
+
+
+def test_group_fairness():
+    tm = reference()
+    rng = np.random.RandomState(84)
+    p, g = _bin(rng, 200)
+    groups = rng.randint(0, 2, 200)
+    ref = tm.functional.classification.binary_fairness(t(p), t(g), t(groups))
+    got = ours.binary_fairness(jnp.asarray(p), jnp.asarray(g), jnp.asarray(groups))
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="fairness")
+
+
+@pytest.mark.parametrize(
+    "name", ["binary_sensitivity_at_specificity", "binary_specificity_at_sensitivity",
+             "binary_precision_at_fixed_recall", "binary_recall_at_fixed_precision"]
+)
+def test_at_fixed_x(name):
+    tm = reference()
+    rng = np.random.RandomState(85)
+    p, g = _bin(rng, 250)
+    kw = {"min_specificity": 0.7} if "at_specificity" in name else (
+        {"min_sensitivity": 0.7} if "at_sensitivity" in name else (
+            {"min_recall": 0.7} if "fixed_recall" in name else {"min_precision": 0.7}))
+    for thresholds in (None, 100):
+        ref = getattr(tm.functional.classification, name)(t(p), t(g), thresholds=thresholds, **kw)
+        got = getattr(ours, name)(jnp.asarray(p), jnp.asarray(g), thresholds=thresholds, **kw)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"{name}[{thresholds}]")

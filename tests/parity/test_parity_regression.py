@@ -1,0 +1,196 @@
+"""Regression-domain parity vs the ACTUAL reference package, across config axes."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.functional.regression as ours
+from tests._reference import assert_close, reference, t
+
+
+def _xy(rng, shape, positive=False):
+    x = rng.randn(*shape).astype(np.float32)
+    y = rng.randn(*shape).astype(np.float32)
+    if positive:
+        x, y = np.abs(x) + 0.1, np.abs(y) + 0.1
+    return x, y
+
+
+SIMPLE = [
+    ("mean_absolute_percentage_error", {}, False),
+    ("symmetric_mean_absolute_percentage_error", {}, False),
+    ("weighted_mean_absolute_percentage_error", {}, False),
+    ("mean_squared_log_error", {}, True),
+    ("concordance_corrcoef", {}, False),
+    ("pearson_corrcoef", {}, False),
+    ("spearman_corrcoef", {}, False),
+    ("relative_squared_error", {}, False),
+    ("relative_squared_error", {"squared": False}, False),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,positive", SIMPLE)
+def test_simple_regression(name, kwargs, positive):
+    tm = reference()
+    rng = np.random.RandomState(31)
+    x, y = _xy(rng, (120,), positive)
+    ref = getattr(tm.functional, name)(t(x), t(y), **kwargs)
+    got = getattr(ours, name)(jnp.asarray(x), jnp.asarray(y), **kwargs)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=name)
+
+
+@pytest.mark.parametrize("num_outputs", [1, 3])
+@pytest.mark.parametrize("squared", [True, False])
+def test_mse_num_outputs(num_outputs, squared):
+    tm = reference()
+    rng = np.random.RandomState(32)
+    shape = (50, num_outputs) if num_outputs > 1 else (50,)
+    x, y = _xy(rng, shape)
+    ref = tm.functional.mean_squared_error(t(x), t(y), squared=squared, num_outputs=num_outputs)
+    got = ours.mean_squared_error(jnp.asarray(x), jnp.asarray(y), squared=squared, num_outputs=num_outputs)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="mse")
+
+
+@pytest.mark.parametrize("num_outputs", [1, 3])
+def test_mae_logcosh_multioutput(num_outputs):
+    tm = reference()
+    rng = np.random.RandomState(33)
+    shape = (40, num_outputs) if num_outputs > 1 else (40,)
+    x, y = _xy(rng, shape)
+    ref = tm.functional.mean_absolute_error(t(x), t(y), num_outputs=num_outputs)
+    got = ours.mean_absolute_error(jnp.asarray(x), jnp.asarray(y), num_outputs=num_outputs)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="mae")
+    ref = tm.functional.log_cosh_error(t(x), t(y))
+    got = ours.log_cosh_error(jnp.asarray(x), jnp.asarray(y), num_outputs=num_outputs)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="log_cosh")
+
+
+@pytest.mark.parametrize(
+    "multioutput", ["uniform_average", "raw_values", "variance_weighted"]
+)
+def test_explained_variance_r2(multioutput):
+    tm = reference()
+    rng = np.random.RandomState(34)
+    x, y = _xy(rng, (60, 3))
+    ref = tm.functional.explained_variance(t(x), t(y), multioutput=multioutput)
+    got = ours.explained_variance(jnp.asarray(x), jnp.asarray(y), multioutput=multioutput)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="explained_variance")
+    if multioutput != "variance_weighted":
+        ref = tm.functional.r2_score(t(x), t(y), multioutput=multioutput)
+        got = ours.r2_score(jnp.asarray(x), jnp.asarray(y), multioutput=multioutput)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label="r2")
+
+
+def test_r2_adjusted_and_variance_weighted():
+    tm = reference()
+    rng = np.random.RandomState(35)
+    x, y = _xy(rng, (80,))
+    ref = tm.functional.r2_score(t(x), t(y), adjusted=5)
+    got = ours.r2_score(jnp.asarray(x), jnp.asarray(y), adjusted=5)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="r2_adjusted")
+    x, y = _xy(rng, (80, 4))
+    ref = tm.functional.r2_score(t(x), t(y), multioutput="variance_weighted")
+    got = ours.r2_score(jnp.asarray(x), jnp.asarray(y), multioutput="variance_weighted")
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="r2_vw")
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+def test_cosine_similarity(reduction):
+    tm = reference()
+    rng = np.random.RandomState(36)
+    x, y = _xy(rng, (20, 8))
+    ref = tm.functional.cosine_similarity(t(x), t(y), reduction=reduction)
+    got = ours.cosine_similarity(jnp.asarray(x), jnp.asarray(y), reduction=reduction)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="cosine")
+
+
+@pytest.mark.parametrize("log_prob", [True, False])
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_kl_divergence(log_prob, reduction):
+    tm = reference()
+    rng = np.random.RandomState(37)
+    p = rng.rand(12, 6).astype(np.float32) + 0.05
+    q = rng.rand(12, 6).astype(np.float32) + 0.05
+    if log_prob:
+        p = np.log(p / p.sum(-1, keepdims=True)).astype(np.float32)
+        q = np.log(q / q.sum(-1, keepdims=True)).astype(np.float32)
+    ref = tm.functional.kl_divergence(t(p), t(q), log_prob=log_prob, reduction=reduction)
+    got = ours.kl_divergence(jnp.asarray(p), jnp.asarray(q), log_prob=log_prob, reduction=reduction)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="kl")
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 2.0, 1.5, 3.0])
+def test_tweedie(power):
+    tm = reference()
+    rng = np.random.RandomState(38)
+    x = (np.abs(rng.randn(100)) + 0.1).astype(np.float32)
+    y = (np.abs(rng.randn(100)) + 0.1).astype(np.float32)
+    ref = tm.functional.tweedie_deviance_score(t(x), t(y), power=power)
+    got = ours.tweedie_deviance_score(jnp.asarray(x), jnp.asarray(y), power=power)
+    assert_close(got, ref, rtol=1e-3, atol=1e-4, label="tweedie")
+
+
+@pytest.mark.parametrize("p", [1.0, 2.0, 3.5])
+def test_minkowski(p):
+    tm = reference()
+    rng = np.random.RandomState(39)
+    x, y = _xy(rng, (64,))
+    ref = tm.functional.minkowski_distance(t(x), t(y), p=p)
+    got = ours.minkowski_distance(jnp.asarray(x), jnp.asarray(y), p=p)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="minkowski")
+
+
+@pytest.mark.parametrize("normalization", ["mean", "range", "std", "l2"])
+def test_nrmse(normalization):
+    tm = reference()
+    rng = np.random.RandomState(40)
+    x, y = _xy(rng, (90,))
+    ref = tm.functional.normalized_root_mean_squared_error(t(x), t(y), normalization=normalization)
+    got = ours.normalized_root_mean_squared_error(jnp.asarray(x), jnp.asarray(y), normalization=normalization)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"nrmse[{normalization}]")
+
+
+@pytest.mark.parametrize("keep_sequence_dim", [None, 0, 1])
+def test_csi(keep_sequence_dim):
+    tm = reference()
+    rng = np.random.RandomState(41)
+    x = rng.rand(4, 25).astype(np.float32)
+    y = rng.rand(4, 25).astype(np.float32)
+    ref = tm.functional.critical_success_index(t(x), t(y), 0.5, keep_sequence_dim=keep_sequence_dim)
+    got = ours.critical_success_index(jnp.asarray(x), jnp.asarray(y), 0.5, keep_sequence_dim=keep_sequence_dim)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="csi")
+
+
+@pytest.mark.parametrize("variant", ["a", "b", "c"])
+def test_kendall(variant):
+    tm = reference()
+    rng = np.random.RandomState(42)
+    # integer draws create ties, exercising the tie-handling branches
+    x = rng.randint(0, 10, 60).astype(np.float32)
+    y = (x + rng.randint(0, 6, 60)).astype(np.float32)
+    ref = tm.functional.kendall_rank_corrcoef(t(x), t(y), variant=variant)
+    got = ours.kendall_rank_corrcoef(jnp.asarray(x), jnp.asarray(y), variant=variant)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"kendall[{variant}]")
+
+
+def test_kendall_t_test():
+    tm = reference()
+    rng = np.random.RandomState(43)
+    x = rng.randn(50).astype(np.float32)
+    y = (x + rng.randn(50)).astype(np.float32)
+    ref = tm.functional.kendall_rank_corrcoef(t(x), t(y), t_test=True, alternative="two-sided")
+    got = ours.kendall_rank_corrcoef(jnp.asarray(x), jnp.asarray(y), t_test=True, alternative="two-sided")
+    assert_close(got, ref, rtol=1e-3, atol=1e-4, label="kendall_t")
+
+
+def test_pearson_multioutput_and_spearman_2d():
+    tm = reference()
+    rng = np.random.RandomState(44)
+    x, y = _xy(rng, (70, 3))
+    ref = tm.functional.pearson_corrcoef(t(x), t(y))
+    got = ours.pearson_corrcoef(jnp.asarray(x), jnp.asarray(y))
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="pearson_2d")
+    ref = tm.functional.spearman_corrcoef(t(x), t(y))
+    got = ours.spearman_corrcoef(jnp.asarray(x), jnp.asarray(y))
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="spearman_2d")

@@ -1,0 +1,232 @@
+"""Clustering / nominal / segmentation / pairwise / shape parity vs the reference package."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+
+# ------------------------------------------------------------------ clustering
+EXTRINSIC = [
+    ("mutual_info_score", {}),
+    ("adjusted_mutual_info_score", {}),
+    ("adjusted_mutual_info_score", {"average_method": "max"}),
+    ("normalized_mutual_info_score", {}),
+    ("normalized_mutual_info_score", {"average_method": "min"}),
+    ("rand_score", {}),
+    ("adjusted_rand_score", {}),
+    ("fowlkes_mallows_index", {}),
+    ("homogeneity_score", {}),
+    ("completeness_score", {}),
+    ("v_measure_score", {}),
+    ("v_measure_score", {"beta": 0.5}),
+]
+
+
+@pytest.mark.parametrize("name,kwargs", EXTRINSIC)
+def test_clustering_extrinsic(name, kwargs):
+    tm = reference()
+    import metrics_tpu.functional.clustering as ours
+
+    rng = np.random.RandomState(51)
+    a = rng.randint(0, 6, 150)
+    b = rng.randint(0, 5, 150)
+    ref = getattr(tm.functional.clustering, name)(t(a), t(b), **kwargs)
+    got = getattr(ours, name)(jnp.asarray(a), jnp.asarray(b), **kwargs)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=name)
+
+
+@pytest.mark.parametrize("name", ["calinski_harabasz_score", "davies_bouldin_score", "dunn_index"])
+def test_clustering_intrinsic(name):
+    tm = reference()
+    import metrics_tpu.functional.clustering as ours
+
+    rng = np.random.RandomState(52)
+    data = rng.randn(100, 4).astype(np.float32) + rng.randint(0, 3, (100, 1)) * 3.0
+    labels = rng.randint(0, 3, 100)
+    ref = getattr(tm.functional.clustering, name)(t(data), t(labels))
+    got = getattr(ours, name)(jnp.asarray(data), jnp.asarray(labels))
+    assert_close(got, ref, rtol=1e-3, atol=1e-4, label=name)
+
+
+# ------------------------------------------------------------------ nominal
+@pytest.mark.parametrize(
+    "name,kwargs",
+    [
+        ("cramers_v", {}),
+        ("cramers_v", {"bias_correction": False}),
+        ("tschuprows_t", {}),
+        ("tschuprows_t", {"bias_correction": False}),
+        ("pearsons_contingency_coefficient", {}),
+        ("theils_u", {}),
+    ],
+)
+def test_nominal(name, kwargs):
+    tm = reference()
+    import metrics_tpu.functional.nominal as ours
+
+    rng = np.random.RandomState(53)
+    a = rng.randint(0, 5, 400)
+    b = (a + rng.randint(0, 3, 400)) % 5
+    ref = getattr(tm.functional.nominal, name)(t(a), t(b), **kwargs)
+    got = getattr(ours, name)(jnp.asarray(a), jnp.asarray(b), **kwargs)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=name)
+
+
+def test_fleiss_kappa():
+    tm = reference()
+    import metrics_tpu.functional.nominal as ours
+
+    rng = np.random.RandomState(54)
+    # counts mode: (n_samples, n_categories) rater counts
+    counts = rng.multinomial(10, [0.3, 0.4, 0.3], size=40).astype(np.int64)
+    ref = tm.functional.nominal.fleiss_kappa(t(counts), mode="counts")
+    got = ours.fleiss_kappa(jnp.asarray(counts), mode="counts")
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="fleiss_counts")
+    probs = rng.rand(40, 3, 10).astype(np.float32)
+    ref = tm.functional.nominal.fleiss_kappa(t(probs), mode="probs")
+    got = ours.fleiss_kappa(jnp.asarray(probs), mode="probs")
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="fleiss_probs")
+
+
+# ------------------------------------------------------------------ segmentation
+def _seg_inputs(rng, input_format, n=3, c=4, hw=24):
+    if input_format == "index":
+        return rng.randint(0, c, (n, hw, hw)), rng.randint(0, c, (n, hw, hw))
+    p = np.eye(c, dtype=np.int64)[rng.randint(0, c, (n, hw, hw))].transpose(0, 3, 1, 2)
+    g = np.eye(c, dtype=np.int64)[rng.randint(0, c, (n, hw, hw))].transpose(0, 3, 1, 2)
+    return p, g
+
+
+@pytest.mark.parametrize("input_format", ["one-hot", "index"])
+@pytest.mark.parametrize("include_background", [True, False])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+def test_dice_score(input_format, include_background, average):
+    tm = reference()
+    import metrics_tpu.functional.segmentation as ours
+
+    rng = np.random.RandomState(55)
+    p, g = _seg_inputs(rng, input_format)
+    ref = tm.functional.segmentation.dice_score(
+        t(p), t(g), num_classes=4, include_background=include_background, average=average, input_format=input_format
+    )
+    got = ours.dice_score(
+        jnp.asarray(p), jnp.asarray(g), num_classes=4, include_background=include_background,
+        average=average, input_format=input_format,
+    )
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="dice_score")
+
+
+@pytest.mark.parametrize("input_format", ["one-hot", "index"])
+@pytest.mark.parametrize("per_class", [True, False])
+def test_mean_iou(input_format, per_class):
+    tm = reference()
+    import metrics_tpu.functional.segmentation as ours
+
+    rng = np.random.RandomState(56)
+    p, g = _seg_inputs(rng, input_format)
+    ref = tm.functional.segmentation.mean_iou(
+        t(p), t(g), num_classes=4, per_class=per_class, input_format=input_format
+    )
+    got = ours.mean_iou(jnp.asarray(p), jnp.asarray(g), num_classes=4, per_class=per_class, input_format=input_format)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="mean_iou")
+
+
+@pytest.mark.parametrize("weight_type", ["square", "simple", "linear"])
+def test_generalized_dice(weight_type):
+    tm = reference()
+    import metrics_tpu.functional.segmentation as ours
+
+    rng = np.random.RandomState(57)
+    p, g = _seg_inputs(rng, "one-hot")
+    ref = tm.functional.segmentation.generalized_dice_score(t(p), t(g), num_classes=4, weight_type=weight_type)
+    got = ours.generalized_dice_score(jnp.asarray(p), jnp.asarray(g), num_classes=4, weight_type=weight_type)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="generalized_dice")
+
+
+def test_generalized_dice_empty_classes_batch():
+    """Empty classes in a batch>1 input exercise the reference's scrambled
+    inf-weight replacement (generalized_dice.py:84-90) — parity must hold."""
+    tm = reference()
+    rng = np.random.RandomState(570)
+    p, g = _seg_inputs(rng, "one-hot", n=3, c=4, hw=12)
+    g[0, 1] = 0  # class 1 absent in sample 0's target
+    g[2, 3] = 0  # class 3 absent in sample 2's target
+    for weight_type in ("square", "simple"):
+        ref = tm.functional.segmentation.generalized_dice_score(t(p), t(g), num_classes=4, weight_type=weight_type)
+        got = ours_seg().generalized_dice_score(jnp.asarray(p), jnp.asarray(g), num_classes=4, weight_type=weight_type)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"gds_empty[{weight_type}]")
+
+
+def ours_seg():
+    import metrics_tpu.functional.segmentation as m
+
+    return m
+
+
+@pytest.mark.parametrize("distance_metric", ["euclidean", "chessboard", "taxicab"])
+@pytest.mark.parametrize("directed", [True, False])
+def test_hausdorff(distance_metric, directed):
+    tm = reference()
+    import metrics_tpu.functional.segmentation as ours
+
+    rng = np.random.RandomState(58)
+    p, g = _seg_inputs(rng, "one-hot", n=2, c=3, hw=16)
+    ref = tm.functional.segmentation.hausdorff_distance(
+        t(p), t(g), num_classes=3, distance_metric=distance_metric, directed=directed
+    )
+    got = ours.hausdorff_distance(
+        jnp.asarray(p), jnp.asarray(g), num_classes=3, distance_metric=distance_metric, directed=directed
+    )
+    assert_close(got, ref, rtol=1e-4, atol=1e-4, label="hausdorff")
+
+
+# ------------------------------------------------------------------ pairwise
+@pytest.mark.parametrize(
+    "name,kwargs",
+    [
+        ("pairwise_cosine_similarity", {}),
+        ("pairwise_euclidean_distance", {}),
+        ("pairwise_manhattan_distance", {}),
+        ("pairwise_linear_similarity", {}),
+        ("pairwise_minkowski_distance", {"exponent": 3}),
+    ],
+)
+@pytest.mark.parametrize("with_y", [True, False])
+def test_pairwise(name, kwargs, with_y):
+    tm = reference()
+    import metrics_tpu.functional.pairwise as ours
+
+    rng = np.random.RandomState(59)
+    x = rng.randn(12, 5).astype(np.float32)
+    y = rng.randn(7, 5).astype(np.float32) if with_y else None
+    ref = getattr(tm.functional, name)(t(x), t(y) if with_y else None, **kwargs)
+    got = getattr(ours, name)(jnp.asarray(x), jnp.asarray(y) if with_y else None, **kwargs)
+    assert_close(got, ref, rtol=1e-3, atol=1e-4, label=name)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_pairwise_reduction_and_zero_diagonal(reduction):
+    tm = reference()
+    import metrics_tpu.functional.pairwise as ours
+
+    rng = np.random.RandomState(60)
+    x = rng.randn(9, 4).astype(np.float32)
+    ref = tm.functional.pairwise_euclidean_distance(t(x), reduction=reduction, zero_diagonal=True)
+    got = ours.pairwise_euclidean_distance(jnp.asarray(x), reduction=reduction, zero_diagonal=True)
+    assert_close(got, ref, rtol=1e-4, atol=1e-4, label="pairwise_red")
+
+
+# ------------------------------------------------------------------ shape
+def test_procrustes():
+    tm = reference()
+    import metrics_tpu.functional.shape as ours
+
+    rng = np.random.RandomState(61)
+    a = rng.randn(4, 50, 3).astype(np.float32)
+    b = rng.randn(4, 50, 3).astype(np.float32)
+    ref = tm.functional.shape.procrustes_disparity(t(a), t(b))
+    got = ours.procrustes_disparity(jnp.asarray(a), jnp.asarray(b))
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="procrustes")

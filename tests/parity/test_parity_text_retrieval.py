@@ -1,0 +1,207 @@
+"""Text + retrieval parity vs the ACTUAL reference package.
+
+Text metrics run the reference's own tokenizers/DP algorithms as the oracle
+(stronger than the hand-picked fixtures in ``tests/test_text.py``); retrieval
+sweeps k and empty_target_action against the reference's per-query loop.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+CORPUS_PREDS = [
+    "the cat is on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "hello world",
+    "transformers are sequence models with attention",
+]
+CORPUS_TARGETS = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["the quick brown fox jumped over the lazy dog"],
+    ["hello beautiful world"],
+    ["transformers are attention based sequence models"],
+]
+FLAT_TARGETS = [tgt[0] for tgt in CORPUS_TARGETS]
+
+
+@pytest.mark.parametrize("n_gram", [2, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu(n_gram, smooth):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.bleu_score(CORPUS_PREDS, CORPUS_TARGETS, n_gram=n_gram, smooth=smooth)
+    got = ours.bleu_score(CORPUS_PREDS, CORPUS_TARGETS, n_gram=n_gram, smooth=smooth)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="bleu")
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "none", "char"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu(tokenize, lowercase):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.sacre_bleu_score(CORPUS_PREDS, CORPUS_TARGETS, tokenize=tokenize, lowercase=lowercase)
+    got = ours.sacre_bleu_score(CORPUS_PREDS, CORPUS_TARGETS, tokenize=tokenize, lowercase=lowercase)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="sacrebleu")
+
+
+@pytest.mark.parametrize("n_char_order,n_word_order", [(6, 2), (4, 0)])
+@pytest.mark.parametrize("whitespace", [False, True])
+def test_chrf(n_char_order, n_word_order, whitespace):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.chrf_score(
+        CORPUS_PREDS, CORPUS_TARGETS, n_char_order=n_char_order, n_word_order=n_word_order, whitespace=whitespace
+    )
+    got = ours.chrf_score(
+        CORPUS_PREDS, CORPUS_TARGETS, n_char_order=n_char_order, n_word_order=n_word_order, whitespace=whitespace
+    )
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="chrf")
+
+
+def test_wer_family():
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    for name in ("word_error_rate", "char_error_rate", "match_error_rate",
+                 "word_information_lost", "word_information_preserved"):
+        ref = getattr(tm.functional.text, name)(CORPUS_PREDS, FLAT_TARGETS)
+        got = getattr(ours, name)(CORPUS_PREDS, FLAT_TARGETS)
+        assert_close(got, ref, rtol=1e-5, atol=1e-6, label=name)
+
+
+@pytest.mark.parametrize("substitution_cost", [1, 2])
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_edit_distance(substitution_cost, reduction):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.edit_distance(
+        CORPUS_PREDS, FLAT_TARGETS, substitution_cost=substitution_cost, reduction=reduction
+    )
+    got = ours.edit_distance(CORPUS_PREDS, FLAT_TARGETS, substitution_cost=substitution_cost, reduction=reduction)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="edit_distance")
+
+
+@pytest.mark.parametrize("normalize,no_punctuation,lowercase", [(False, False, False), (True, True, True)])
+def test_ter(normalize, no_punctuation, lowercase):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.translation_edit_rate(
+        CORPUS_PREDS, CORPUS_TARGETS, normalize=normalize, no_punctuation=no_punctuation, lowercase=lowercase
+    )
+    got = ours.translation_edit_rate(
+        CORPUS_PREDS, CORPUS_TARGETS, normalize=normalize, no_punctuation=no_punctuation, lowercase=lowercase
+    )
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="ter")
+
+
+def test_extended_edit_distance():
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.extended_edit_distance(CORPUS_PREDS, CORPUS_TARGETS)
+    got = ours.extended_edit_distance(CORPUS_PREDS, CORPUS_TARGETS)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label="eed")
+
+
+@pytest.mark.parametrize("use_stemmer", [False, True])
+def test_rouge(use_stemmer):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    try:
+        ref = tm.functional.text.rouge_score(CORPUS_PREDS, FLAT_TARGETS, use_stemmer=use_stemmer)
+    except (ModuleNotFoundError, ValueError, LookupError, OSError) as err:
+        pytest.skip(f"reference rouge unavailable: {err}")
+    got = ours.rouge_score(CORPUS_PREDS, FLAT_TARGETS, use_stemmer=use_stemmer)
+    assert_close({k: v for k, v in got.items()}, {k: v for k, v in ref.items()}, rtol=1e-4, atol=1e-5, label="rouge")
+
+
+def test_perplexity():
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+    import torch
+
+    rng = np.random.RandomState(91)
+    logits = rng.randn(3, 12, 20).astype(np.float32)
+    target = rng.randint(0, 20, (3, 12))
+    target[0, :3] = -100
+    ref = tm.functional.text.perplexity(torch.as_tensor(logits), torch.as_tensor(target), ignore_index=-100)
+    got = ours.perplexity(jnp.asarray(logits), jnp.asarray(target), ignore_index=-100)
+    assert_close(got, ref, rtol=1e-4, atol=1e-4, label="perplexity")
+
+
+def test_squad():
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+    target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+    ref = tm.functional.text.squad(preds, target)
+    got = ours.squad(preds, target)
+    assert_close(got, ref, rtol=1e-5, atol=1e-6, label="squad")
+
+
+# ------------------------------------------------------------------ retrieval
+def _retrieval_data(rng, n=300, groups=12):
+    indexes = rng.randint(0, groups, n)
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n)
+    return indexes, preds, target
+
+
+RETRIEVAL_FNS = [
+    ("retrieval_average_precision", {}),
+    ("retrieval_average_precision", {"top_k": 5}),
+    ("retrieval_reciprocal_rank", {}),
+    ("retrieval_precision", {"top_k": 5}),
+    ("retrieval_precision", {"top_k": 5, "adaptive_k": True}),
+    ("retrieval_recall", {"top_k": 5}),
+    ("retrieval_hit_rate", {"top_k": 5}),
+    ("retrieval_fall_out", {"top_k": 5}),
+    ("retrieval_r_precision", {}),
+    ("retrieval_normalized_dcg", {}),
+    ("retrieval_normalized_dcg", {"top_k": 5}),
+]
+
+
+@pytest.mark.parametrize("name,kwargs", RETRIEVAL_FNS)
+def test_retrieval_functional_per_query(name, kwargs):
+    """Stateless kernels agree query-by-query with the reference."""
+    tm = reference()
+    import metrics_tpu.functional.retrieval as ours
+
+    rng = np.random.RandomState(92)
+    indexes, preds, target = _retrieval_data(rng)
+    for q in range(12):
+        mask = indexes == q
+        if not target[mask].any():
+            continue
+        ref = getattr(tm.functional, name)(t(preds[mask]), t(target[mask]), **kwargs)
+        got = getattr(ours, name)(jnp.asarray(preds[mask]), jnp.asarray(target[mask]), **kwargs)
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"{name}[q{q}]")
+
+
+@pytest.mark.parametrize("empty_target_action", ["skip", "neg", "pos"])
+def test_retrieval_modular_map_mrr(empty_target_action):
+    """Modular RetrievalMAP/MRR match the reference under each empty-target action."""
+    tm = reference()
+    from metrics_tpu.retrieval import RetrievalMAP, RetrievalMRR
+    import torch
+
+    rng = np.random.RandomState(93)
+    indexes, preds, target = _retrieval_data(rng)
+    target[indexes == 3] = 0  # force one empty-target group
+    for ref_cls, our_cls in ((tm.retrieval.RetrievalMAP, RetrievalMAP), (tm.retrieval.RetrievalMRR, RetrievalMRR)):
+        ref_m = ref_cls(empty_target_action=empty_target_action)
+        ref_m.update(t(preds), t(target), indexes=torch.as_tensor(indexes))
+        our_m = our_cls(empty_target_action=empty_target_action)
+        our_m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        assert_close(our_m.compute(), ref_m.compute(), rtol=1e-4, atol=1e-5, label=ref_cls.__name__)
