@@ -75,7 +75,9 @@ class BaseAggregator(Metric):
         x = jnp.asarray(x, dtype=self._dtype)
         weight = jnp.asarray(1.0 if weight is None else weight, dtype=self._dtype)
         weight = jnp.broadcast_to(weight, x.shape)
-        nan_mask = jnp.isnan(x)
+        # drop/replace where EITHER the value or its weight is NaN
+        # (reference ``aggregation.py:84-102``)
+        nan_mask = jnp.isnan(x) | jnp.isnan(weight)
         if self.nan_strategy in ("error", "warn"):
             from metrics_tpu.utils.checks import _is_traced
 
@@ -104,8 +106,15 @@ class BaseAggregator(Metric):
             return x, weight, ~nan_mask
         if self.nan_strategy == "disable":
             return x, weight, jnp.ones_like(nan_mask) | True
-        # float replacement
-        return jnp.where(nan_mask, jnp.asarray(self.nan_strategy, dtype=x.dtype), x), weight, jnp.ones_like(nan_mask) | True
+        # float replacement: both the value AND its weight take the replacement
+        # (reference ``aggregation.py:101-102``), element-wise — we do not
+        # replicate the reference's broadcast-view write-through quirk
+        repl = jnp.asarray(self.nan_strategy, dtype=x.dtype)
+        return (
+            jnp.where(nan_mask, repl, x),
+            jnp.where(nan_mask, repl, weight),
+            jnp.ones_like(nan_mask) | True,
+        )
 
     def update(self, value: Union[float, Array]) -> None:  # noqa: D102
         raise NotImplementedError

@@ -402,9 +402,9 @@ def spectral_distortion_index(
         q_fused = band_uqi_matrix(preds)
         q_lr = band_uqi_matrix(target)
         diff = jnp.abs(q_fused - q_lr) ** p
-        # off-diagonal mean
-        mask = ~jnp.eye(c, dtype=bool)
-        out = (diff[mask].mean()) ** (1.0 / p)
+        # off-diagonal mean; the diagonal is identically zero, so the full sum
+        # over L(L-1) entries is jit-safe (reference ``d_lambda.py:100-105``)
+        out = (diff.sum() / (c * (c - 1))) ** (1.0 / p)
     # the output is already a scalar; reduce is the reference's (no-op) tail
     # (``d_lambda.py:100-106``), kept so reduction="sum"/"none" round-trips
     return reduce(out, "elementwise_mean" if reduction in ("mean", "elementwise_mean") else reduction)

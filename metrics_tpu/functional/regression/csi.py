@@ -20,6 +20,13 @@ def _critical_success_index_update(
     reduce over everything), matching the reference signature.
     """
     _check_same_shape(preds, target)
+    if isinstance(keep_sequence_dim, bool):
+        # the argument is a dimension INDEX (or None); a bool here is almost
+        # certainly a caller of the old boolean API — fail loudly rather than
+        # silently reinterpreting True/False as dims 1/0
+        raise ValueError(
+            "`keep_sequence_dim` takes the index of the dimension to keep (or None), not a bool."
+        )
     if keep_sequence_dim is None:
         sum_axes = None
     elif not 0 <= keep_sequence_dim < preds.ndim:

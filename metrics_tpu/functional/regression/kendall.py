@@ -38,7 +38,8 @@ def _kendall_tau_1d(preds: Array, target: Array, variant: str) -> Array:
         sy = jnp.sign(target[rows, None] - target[None, :])
         upper = idx[None, :] > idx[rows, None]  # only count each pair once
         con_min_dis = con_min_dis + jnp.sum(jnp.where(upper, sx * sy, 0.0))
-        con_plus_dis = con_plus_dis + jnp.sum(upper & (sx * sy != 0))
+        if variant == "a":  # only tau-a needs the untied-pair count
+            con_plus_dis = con_plus_dis + jnp.sum(upper & (sx * sy != 0))
         tx = tx + jnp.sum(upper & (sx == 0))
         ty = ty + jnp.sum(upper & (sy == 0))
     n0 = n * (n - 1) / 2.0

@@ -34,8 +34,15 @@ def procrustes_disparity(
         )
     point_cloud1 = point_cloud1 - point_cloud1.mean(axis=1, keepdims=True)
     point_cloud2 = point_cloud2 - point_cloud2.mean(axis=1, keepdims=True)
-    point_cloud1 = point_cloud1 / jnp.linalg.norm(point_cloud1, axis=(1, 2), keepdims=True)
-    point_cloud2 = point_cloud2 / jnp.linalg.norm(point_cloud2, axis=(1, 2), keepdims=True)
+    n1 = jnp.linalg.norm(point_cloud1, axis=(1, 2), keepdims=True)
+    n2 = jnp.linalg.norm(point_cloud2, axis=(1, 2), keepdims=True)
+    # degenerate (constant) point clouds would divide by zero and poison the
+    # SVD with NaNs; the reference catches the SVD failure and reports 0
+    # disparity (``procrustes.py:48-58``) — here the guard is branch-free so
+    # it also holds under jit, and per-batch rather than all-or-nothing
+    degenerate = ((n1 == 0) | (n2 == 0)).reshape(-1)
+    point_cloud1 = point_cloud1 / jnp.where(n1 == 0, 1.0, n1)
+    point_cloud2 = point_cloud2 / jnp.where(n2 == 0, 1.0, n2)
 
     u, w, vt = jnp.linalg.svd(
         jnp.swapaxes(jnp.matmul(jnp.swapaxes(point_cloud2, 1, 2), point_cloud1), 1, 2), full_matrices=False
@@ -43,7 +50,12 @@ def procrustes_disparity(
     rotation = jnp.matmul(u, vt)
     scale = w.sum(1, keepdims=True)
     point_cloud2 = scale[:, None] * jnp.matmul(point_cloud2, jnp.swapaxes(rotation, 1, 2))
-    disparity = ((point_cloud1 - point_cloud2) ** 2).sum(axis=(1, 2))
+    disparity = jnp.where(degenerate, 0.0, ((point_cloud1 - point_cloud2) ** 2).sum(axis=(1, 2)))
     if return_all:
-        return disparity, scale, rotation
+        eye = jnp.broadcast_to(jnp.eye(point_cloud1.shape[2]), rotation.shape)
+        return (
+            disparity,
+            jnp.where(degenerate[:, None], 1.0, scale),
+            jnp.where(degenerate[:, None, None], eye, rotation),
+        )
     return disparity

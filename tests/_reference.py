@@ -52,10 +52,16 @@ def torch():
 
 
 def t(x):
-    """numpy → torch tensor (copies; preserves integer/bool dtypes)."""
+    """numpy → torch tensor (a true copy; preserves integer/bool dtypes).
+
+    Must NOT share memory with the numpy input: some reference code mutates
+    its inputs in place (e.g. ``aggregation.py:101`` writes the nan
+    replacement into the tensor), which would corrupt the array our side
+    consumes afterwards.
+    """
     import torch as _torch  # noqa: PLC0415
 
-    return _torch.as_tensor(np.asarray(x))
+    return _torch.as_tensor(np.asarray(x)).clone()
 
 
 def to_np(x):

@@ -1,0 +1,239 @@
+"""Wrapper / aggregation / composition-layer parity vs the ACTUAL reference package.
+
+Exercises the L4 composition layer (SURVEY §2.4) head-to-head: aggregation
+metrics with nan strategies, MinMax/Multioutput/Multitask/Tracker/Running/
+Classwise wrappers, and CompositionalMetric arithmetic — identical update
+streams into both packages, identical outputs required.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+
+# ------------------------------------------------------------------ aggregation
+@pytest.mark.parametrize(
+    "name,values",
+    [
+        ("MeanMetric", [[1.0, 2.0, 3.0], [4.0, 5.0]]),
+        ("SumMetric", [[1.0, 2.0], [3.0]]),
+        ("MaxMetric", [[1.0, 9.0], [3.0]]),
+        ("MinMetric", [[4.0, 2.0], [3.0]]),
+        ("CatMetric", [[1.0, 2.0], [3.0, 4.0]]),
+    ],
+)
+def test_aggregation(name, values):
+    tm = reference()
+    import metrics_tpu as ours
+
+    ref_m = getattr(tm, name)()
+    our_m = getattr(ours, name)()
+    for batch in values:
+        ref_m.update(t(np.asarray(batch)))
+        our_m.update(jnp.asarray(batch))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-6, atol=1e-7, label=name)
+
+
+def test_aggregation_nan_ignore():
+    tm = reference()
+    import metrics_tpu as ours
+
+    vals = np.array([1.0, np.nan, 3.0, np.nan, 5.0], dtype=np.float32)
+    ref_m = tm.MeanMetric(nan_strategy="ignore")
+    our_m = ours.MeanMetric(nan_strategy="ignore")
+    ref_m.update(t(vals))
+    our_m.update(jnp.asarray(vals))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-6, atol=1e-7, label="mean_nan[ignore]")
+
+
+def test_aggregation_nan_float_documented_divergence():
+    """INTENTIONAL divergence from the reference (documented oracle bug).
+
+    With a float nan_strategy and the default scalar weight, the reference's
+    ``aggregation.py:71`` broadcasts the weight with ``torch.broadcast_to`` (a
+    single-memory-cell view) and then writes the replacement through the mask
+    (``:101-102``) — the write lands in the one shared cell, poisoning EVERY
+    weight and yielding NaN (0.0 strategy) or a globally-rescaled mean. We
+    implement the documented per-element semantics instead: nan values and
+    their weights are replaced element-wise.
+    """
+    tm = reference()
+    import torch
+    import metrics_tpu as ours
+
+    vals = np.array([1.0, np.nan, 3.0, np.nan, 5.0], dtype=np.float32)
+    ref_m = tm.MeanMetric(nan_strategy=0.0)
+    ref_m.update(t(vals))
+    assert np.isnan(float(ref_m.compute()))  # the reference quirk, pinned
+    our_m = ours.MeanMetric(nan_strategy=0.0)
+    our_m.update(jnp.asarray(vals))
+    assert float(our_m.compute()) == pytest.approx(9.0 / 3.0)  # per-element semantics
+    # with an explicit per-element weight vector the reference takes the sane
+    # path too, and both agree
+    ref_m2 = tm.MeanMetric(nan_strategy=0.0)
+    ref_m2.update(t(vals), t(np.ones(5, dtype=np.float32)))
+    our_m2 = ours.MeanMetric(nan_strategy=0.0)
+    our_m2.update(jnp.asarray(vals), jnp.ones(5))
+    assert_close(our_m2.compute(), ref_m2.compute(), rtol=1e-6, atol=1e-7, label="mean_nan[0.0,weights]")
+
+
+def test_mean_metric_weights():
+    tm = reference()
+    import metrics_tpu as ours
+
+    vals = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    w = np.array([0.2, 0.3, 0.5], dtype=np.float32)
+    ref_m = tm.MeanMetric()
+    our_m = ours.MeanMetric()
+    ref_m.update(t(vals), t(w))
+    our_m.update(jnp.asarray(vals), jnp.asarray(w))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-6, atol=1e-7, label="mean_weighted")
+
+
+def test_running_mean_sum():
+    tm = reference()
+    import metrics_tpu as ours
+
+    stream = [float(x) for x in range(1, 9)]
+    ref_m = tm.RunningMean(window=3)
+    our_m = ours.RunningMean(window=3)
+    for v in stream:
+        ref_m.update(t(np.float32(v)))
+        our_m.update(jnp.float32(v))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-6, atol=1e-7, label="running_mean")
+
+
+# ------------------------------------------------------------------ wrappers
+def test_minmax_wrapper():
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import MinMaxMetric
+
+    rng = np.random.RandomState(101)
+    ref_m = tm.wrappers.MinMaxMetric(tm.classification.BinaryAccuracy())
+    our_m = MinMaxMetric(ours.classification.BinaryAccuracy())
+    for _ in range(4):
+        p = rng.rand(50).astype(np.float32)
+        g = rng.randint(0, 2, 50)
+        ref_m.update(t(p), t(g))
+        our_m.update(jnp.asarray(p), jnp.asarray(g))
+        ref_m.compute()
+        our_m.compute()
+    assert_close(dict(our_m.compute()), dict(ref_m.compute()), rtol=1e-6, atol=1e-7, label="minmax")
+
+
+def test_multioutput_wrapper():
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import MultioutputWrapper
+
+    rng = np.random.RandomState(102)
+    ref_m = tm.wrappers.MultioutputWrapper(tm.regression.R2Score(), num_outputs=3)
+    our_m = MultioutputWrapper(ours.regression.R2Score(), num_outputs=3)
+    for _ in range(3):
+        p = rng.randn(40, 3).astype(np.float32)
+        g = rng.randn(40, 3).astype(np.float32)
+        ref_m.update(t(p), t(g))
+        our_m.update(jnp.asarray(p), jnp.asarray(g))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-4, atol=1e-5, label="multioutput")
+
+
+def test_multitask_wrapper():
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import MultitaskWrapper
+
+    rng = np.random.RandomState(103)
+    ref_m = tm.wrappers.MultitaskWrapper(
+        {"cls": tm.classification.BinaryAccuracy(), "reg": tm.regression.MeanSquaredError()}
+    )
+    our_m = MultitaskWrapper(
+        {"cls": ours.classification.BinaryAccuracy(), "reg": ours.regression.MeanSquaredError()}
+    )
+    for _ in range(3):
+        pc, gc = rng.rand(30).astype(np.float32), rng.randint(0, 2, 30)
+        pr, gr = rng.randn(30).astype(np.float32), rng.randn(30).astype(np.float32)
+        ref_m.update({"cls": t(pc), "reg": t(pr)}, {"cls": t(gc), "reg": t(gr)})
+        our_m.update({"cls": jnp.asarray(pc), "reg": jnp.asarray(pr)}, {"cls": jnp.asarray(gc), "reg": jnp.asarray(gr)})
+    assert_close(dict(our_m.compute()), dict(ref_m.compute()), rtol=1e-5, atol=1e-6, label="multitask")
+
+
+def test_classwise_wrapper():
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import ClasswiseWrapper
+
+    rng = np.random.RandomState(104)
+    ref_m = tm.wrappers.ClasswiseWrapper(tm.classification.MulticlassAccuracy(num_classes=3, average=None))
+    our_m = ClasswiseWrapper(ours.classification.MulticlassAccuracy(num_classes=3, average=None))
+    p, g = rng.randint(0, 3, 100), rng.randint(0, 3, 100)
+    ref_m.update(t(p), t(g))
+    our_m.update(jnp.asarray(p), jnp.asarray(g))
+    assert_close(dict(our_m.compute()), dict(ref_m.compute()), rtol=1e-5, atol=1e-6, label="classwise")
+
+
+def test_tracker():
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import MetricTracker
+
+    rng = np.random.RandomState(105)
+    ref_m = tm.wrappers.MetricTracker(tm.classification.BinaryAccuracy(), maximize=True)
+    our_m = MetricTracker(ours.classification.BinaryAccuracy(), maximize=True)
+    for _ in range(3):
+        ref_m.increment()
+        our_m.increment()
+        for _ in range(2):
+            p = rng.rand(40).astype(np.float32)
+            g = rng.randint(0, 2, 40)
+            ref_m.update(t(p), t(g))
+            our_m.update(jnp.asarray(p), jnp.asarray(g))
+    ref_best, ref_idx = ref_m.best_metric(return_step=True)
+    our_best, our_idx = our_m.best_metric(return_step=True)
+    assert_close(our_best, ref_best, rtol=1e-6, atol=1e-7, label="tracker_best")
+    assert int(our_idx) == int(ref_idx)
+
+
+# ------------------------------------------------------------------ composition
+def test_compositional_arithmetic():
+    tm = reference()
+    import metrics_tpu as ours
+
+    rng = np.random.RandomState(106)
+    ref_a, ref_b = tm.SumMetric(), tm.SumMetric()
+    our_a, our_b = ours.SumMetric(), ours.SumMetric()
+    combos = [
+        ref_a + ref_b, ref_a * 2.0, ref_a - ref_b, abs(ref_a - ref_b * 3.0),
+    ]
+    ours_combos = [
+        our_a + our_b, our_a * 2.0, our_a - our_b, abs(our_a - our_b * 3.0),
+    ]
+    va, vb = rng.rand(5).astype(np.float32), rng.rand(5).astype(np.float32)
+    ref_a.update(t(va)); ref_b.update(t(vb))
+    our_a.update(jnp.asarray(va)); our_b.update(jnp.asarray(vb))
+    for rc, oc in zip(combos, ours_combos):
+        assert_close(oc.compute(), rc.compute(), rtol=1e-5, atol=1e-6, label="compositional")
+
+
+# ------------------------------------------------------------------ collections
+def test_metric_collection_outputs():
+    tm = reference()
+    import metrics_tpu as ours
+
+    rng = np.random.RandomState(107)
+    ref_c = tm.MetricCollection(
+        [tm.classification.MulticlassPrecision(num_classes=4), tm.classification.MulticlassRecall(num_classes=4)],
+        prefix="train_",
+    )
+    our_c = ours.MetricCollection(
+        [ours.classification.MulticlassPrecision(num_classes=4), ours.classification.MulticlassRecall(num_classes=4)],
+        prefix="train_",
+    )
+    for _ in range(3):
+        p, g = rng.randint(0, 4, 80), rng.randint(0, 4, 80)
+        ref_c.update(t(p), t(g))
+        our_c.update(jnp.asarray(p), jnp.asarray(g))
+    assert_close(dict(our_c.compute()), dict(ref_c.compute()), rtol=1e-5, atol=1e-6, label="collection")
