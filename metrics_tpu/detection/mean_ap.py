@@ -357,10 +357,12 @@ class MeanAveragePrecision(Metric):
         ious_dict = {(u["img"], (classes[u["ki"]] if not micro else -1)): unit_ious[i]
                      for i, u in enumerate(units)}
         unit_ki = np.asarray([u["ki"] for u in units])
+        unit_npig = np.stack([(~g).sum(axis=1) for g in unit_gtig])  # (U, A) non-ignored gts
         for ki in range(k_n):
             sel = np.nonzero(unit_ki == ki)[0]
             if not len(sel):
                 continue
+            npig_per_area = unit_npig[sel].sum(axis=0)
             for mi, max_det in enumerate(max_dets):
                 scores_cat = np.concatenate([units[i]["scores"][:max_det] for i in sel]) if len(sel) else np.zeros(0)
                 order = np.argsort(-scores_cat, kind="mergesort")
@@ -371,24 +373,35 @@ class MeanAveragePrecision(Metric):
                 scores_sorted = scores_cat[order]
                 tp_c = np.cumsum(tps & ~igs, axis=2, dtype=np.float64)
                 fp_c = np.cumsum(~tps & ~igs, axis=2, dtype=np.float64)
+                n = tp_c.shape[2]
+                if n == 0:
+                    for ai in np.nonzero(npig_per_area)[0]:
+                        recall[:, ki, ai, mi] = 0.0
+                        precision[:, :, ki, ai, mi] = 0.0
+                        scores_out[:, :, ki, ai, mi] = 0.0
+                    continue
+                # all (area, threshold) cells at once: the per-cell math is a
+                # cumsum ratio + reverse running max + a batched searchsorted
+                # (``rc`` is nondecreasing, so ``searchsorted(rc, thr, 'left')``
+                # == count of entries < thr, a broadcast sum)
+                live = npig_per_area > 0  # (A,)
+                npig_safe = np.maximum(npig_per_area, 1).astype(np.float64)
+                rc = tp_c / npig_safe[:, None, None]  # (A, T, N)
+                pr = tp_c / np.maximum(tp_c + fp_c, np.finfo(np.float64).eps)
+                recall[:, ki, live, mi] = rc[live, :, -1].T
+                pr = np.maximum.accumulate(pr[:, :, ::-1], axis=2)[:, :, ::-1]
+                # per-(area, threshold) searchsorted: O(A·T·R·log N), avoiding
+                # an (A, T, N, R) boolean intermediate at COCO-scale N
+                inds = np.empty((a_n, t_n, r_n), dtype=np.int64)
                 for ai in range(a_n):
-                    npig = int(sum((~unit_gtig[i][ai]).sum() for i in sel))
-                    if npig == 0:
-                        continue
                     for ti in range(t_n):
-                        tp, fp = tp_c[ai, ti], fp_c[ai, ti]
-                        rc = tp / npig
-                        pr = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
-                        recall[ti, ki, ai, mi] = rc[-1] if len(rc) else 0.0
-                        pr = np.maximum.accumulate(pr[::-1])[::-1] if len(pr) else pr
-                        inds = np.searchsorted(rc, rec_thrs, side="left")
-                        q = np.zeros(r_n)
-                        s = np.zeros(r_n)
-                        valid = inds < len(pr)
-                        q[valid] = pr[inds[valid]]
-                        s[valid] = scores_sorted[inds[valid]]
-                        precision[ti, :, ki, ai, mi] = q
-                        scores_out[ti, :, ki, ai, mi] = s
+                        inds[ai, ti] = np.searchsorted(rc[ai, ti], rec_thrs, side="left")
+                valid = inds < n
+                inds_c = np.minimum(inds, n - 1)
+                q = np.where(valid, np.take_along_axis(pr, inds_c.reshape(a_n, t_n, -1), axis=2), 0.0)
+                s = np.where(valid, scores_sorted[inds_c], 0.0)
+                precision[:, :, ki, live, mi] = q[live].transpose(1, 2, 0)
+                scores_out[:, :, ki, live, mi] = s[live].transpose(1, 2, 0)
         return precision, recall, scores_out, classes, ious_dict
 
     @staticmethod

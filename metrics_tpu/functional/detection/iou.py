@@ -66,7 +66,9 @@ def generalized_intersection_over_union(
     area_c = wh[..., 0] * wh[..., 1]
     giou = iou - (area_c - union) / jnp.clip(area_c, 1e-9, None)
     if iou_threshold is not None:
-        giou = jnp.where(iou >= iou_threshold, giou, replacement_val)
+        # the threshold applies to the metric's OWN value (reference
+        # ``giou.py:40-41``), which can be negative — not to the plain IoU
+        giou = jnp.where(giou >= iou_threshold, giou, replacement_val)
     if aggregate:
         return jnp.diagonal(giou).mean()
     return giou
@@ -88,7 +90,9 @@ def distance_intersection_over_union(
     diag = jnp.sum((rb - lt) ** 2, axis=-1)
     diou = iou - center_dist / jnp.clip(diag, 1e-9, None)
     if iou_threshold is not None:
-        diou = jnp.where(iou >= iou_threshold, diou, replacement_val)
+        # the threshold applies to the metric's OWN value (reference
+        # ``diou.py:40-41``), which can be negative — not to the plain IoU
+        diou = jnp.where(diou >= iou_threshold, diou, replacement_val)
     if aggregate:
         return jnp.diagonal(diou).mean()
     return diou
@@ -118,7 +122,9 @@ def complete_intersection_over_union(
     alpha = v / jnp.clip(1 - iou + v, 1e-9, None)
     ciou = iou - center_dist / jnp.clip(diag, 1e-9, None) - alpha * v
     if iou_threshold is not None:
-        ciou = jnp.where(iou >= iou_threshold, ciou, replacement_val)
+        # the threshold applies to the metric's OWN value (reference
+        # ``ciou.py:40-41``), which can be negative — not to the plain IoU
+        ciou = jnp.where(ciou >= iou_threshold, ciou, replacement_val)
     if aggregate:
         return jnp.diagonal(ciou).mean()
     return ciou

@@ -20,7 +20,9 @@ def _critical_success_index_update(
     reduce over everything), matching the reference signature.
     """
     _check_same_shape(preds, target)
-    if isinstance(keep_sequence_dim, bool):
+    if isinstance(keep_sequence_dim, (bool, jnp.bool_)) or (
+        hasattr(keep_sequence_dim, "dtype") and keep_sequence_dim.dtype == jnp.bool_
+    ):
         # the argument is a dimension INDEX (or None); a bool here is almost
         # certainly a caller of the old boolean API — fail loudly rather than
         # silently reinterpreting True/False as dims 1/0

@@ -15,6 +15,58 @@ def box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor) -> torch.Tensor:
     return torch.where(union > 0, inter / union, torch.zeros_like(inter))
 
 
+def generalized_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor) -> torch.Tensor:
+    """GIoU = IoU - (hull - union) / hull (Rezatofighi et al. 2019)."""
+    area1, area2 = box_area(boxes1), box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    iou = torch.where(union > 0, inter / union, torch.zeros_like(inter))
+    lt_h = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb_h = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh_h = (rb_h - lt_h).clamp(min=0)
+    hull = wh_h[..., 0] * wh_h[..., 1]
+    return iou - torch.where(hull > 0, (hull - union) / hull, torch.zeros_like(hull))
+
+
+def _center_dist_sq_and_diag_sq(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float):
+    cx1 = (boxes1[:, None, 0] + boxes1[:, None, 2]) / 2
+    cy1 = (boxes1[:, None, 1] + boxes1[:, None, 3]) / 2
+    cx2 = (boxes2[None, :, 0] + boxes2[None, :, 2]) / 2
+    cy2 = (boxes2[None, :, 1] + boxes2[None, :, 3]) / 2
+    rho2 = (cx2 - cx1) ** 2 + (cy2 - cy1) ** 2
+    lt_h = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb_h = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh_h = (rb_h - lt_h).clamp(min=0)
+    diag2 = wh_h[..., 0] ** 2 + wh_h[..., 1] ** 2 + eps
+    return rho2, diag2
+
+
+def distance_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float = 1e-7) -> torch.Tensor:
+    """DIoU = IoU - rho²/c² (Zheng et al. 2020)."""
+    iou = box_iou(boxes1, boxes2)
+    rho2, diag2 = _center_dist_sq_and_diag_sq(boxes1, boxes2, eps)
+    return iou - rho2 / diag2
+
+
+def complete_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float = 1e-7) -> torch.Tensor:
+    """CIoU = DIoU - alpha·v with aspect-ratio penalty v (Zheng et al. 2020)."""
+    import math
+
+    iou = box_iou(boxes1, boxes2)
+    rho2, diag2 = _center_dist_sq_and_diag_sq(boxes1, boxes2, eps)
+    w1 = (boxes1[:, None, 2] - boxes1[:, None, 0])
+    h1 = (boxes1[:, None, 3] - boxes1[:, None, 1])
+    w2 = (boxes2[None, :, 2] - boxes2[None, :, 0])
+    h2 = (boxes2[None, :, 3] - boxes2[None, :, 1])
+    v = (4 / math.pi**2) * (torch.atan(w2 / h2) - torch.atan(w1 / h1)) ** 2
+    with torch.no_grad():
+        alpha = v / (1 - iou + v + eps)
+    return iou - rho2 / diag2 - alpha * v
+
+
 def box_convert(boxes: torch.Tensor, in_fmt: str, out_fmt: str) -> torch.Tensor:
     if in_fmt == out_fmt:
         return boxes.clone()

@@ -1,0 +1,124 @@
+"""Detection-domain parity vs the ACTUAL reference package.
+
+IoU/GIoU/DIoU/CIoU (functional + modular with aggregate/respect_labels
+configs) and PanopticQuality head-to-head. (MeanAveragePrecision has its own
+two-oracle parity module, ``tests/test_detection_map_parity.py``.)
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+
+def _boxes(rng, n, scale=100.0):
+    b = rng.rand(n, 4).astype(np.float32) * scale * 0.6
+    b[:, 2:] = b[:, :2] + 1.0 + rng.rand(n, 2).astype(np.float32) * scale * 0.4
+    return b
+
+
+FUNCTIONAL = [
+    ("intersection_over_union", "iou"),
+    ("generalized_intersection_over_union", "giou"),
+    ("distance_intersection_over_union", "diou"),
+    ("complete_intersection_over_union", "ciou"),
+]
+
+
+@pytest.mark.parametrize("fn_name,short", FUNCTIONAL)
+@pytest.mark.parametrize("aggregate", [True, False])
+def test_iou_functional(fn_name, short, aggregate):
+    tm = reference()
+    import metrics_tpu.functional.detection as ours
+    import torchmetrics.functional.detection as ref_fns
+
+    rng = np.random.RandomState(111)
+    a, b = _boxes(rng, 8), _boxes(rng, 6)
+    ref = getattr(ref_fns, fn_name)(t(a), t(b), aggregate=aggregate)
+    got = getattr(ours, fn_name)(jnp.asarray(a), jnp.asarray(b), aggregate=aggregate)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=fn_name)
+
+
+@pytest.mark.parametrize("fn_name,short", FUNCTIONAL)
+def test_iou_functional_threshold(fn_name, short):
+    tm = reference()
+    import metrics_tpu.functional.detection as ours
+    import torchmetrics.functional.detection as ref_fns
+
+    rng = np.random.RandomState(112)
+    a, b = _boxes(rng, 10), _boxes(rng, 10)
+    ref = getattr(ref_fns, fn_name)(t(a), t(b), iou_threshold=0.3, aggregate=False)
+    got = getattr(ours, fn_name)(jnp.asarray(a), jnp.asarray(b), iou_threshold=0.3, aggregate=False)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"{fn_name}_thr")
+
+
+@pytest.mark.parametrize(
+    "cls_name",
+    ["IntersectionOverUnion", "GeneralizedIntersectionOverUnion",
+     "DistanceIntersectionOverUnion", "CompleteIntersectionOverUnion"],
+)
+@pytest.mark.parametrize("respect_labels", [True, False])
+def test_iou_modular(cls_name, respect_labels):
+    tm = reference()
+    import metrics_tpu.detection as ours
+    import torchmetrics.detection as ref_mod
+
+    rng = np.random.RandomState(113)
+    ref_m = getattr(ref_mod, cls_name)(respect_labels=respect_labels)
+    our_m = getattr(ours, cls_name)(respect_labels=respect_labels)
+    for _ in range(2):
+        pb, gb = _boxes(rng, 5), _boxes(rng, 4)
+        pl = rng.randint(0, 3, 5)
+        gl = rng.randint(0, 3, 4)
+        sc = rng.rand(5).astype(np.float32)
+        preds_ref = [{"boxes": t(pb), "scores": t(sc), "labels": t(pl)}]
+        target_ref = [{"boxes": t(gb), "labels": t(gl)}]
+        ref_m.update(preds_ref, target_ref)
+        our_m.update(
+            [{"boxes": jnp.asarray(pb), "scores": jnp.asarray(sc), "labels": jnp.asarray(pl)}],
+            [{"boxes": jnp.asarray(gb), "labels": jnp.asarray(gl)}],
+        )
+    assert_close(dict(our_m.compute()), dict(ref_m.compute()), rtol=1e-4, atol=1e-5, label=cls_name)
+
+
+@pytest.mark.parametrize("modified", [False, True])
+def test_panoptic_quality(modified):
+    tm = reference()
+    import metrics_tpu.detection as ours
+    import torchmetrics.detection as ref_mod
+
+    rng = np.random.RandomState(114)
+    things, stuffs = {0, 1}, {2, 3}
+    # (H, W, 2) maps of (category, instance id)
+    def _pan_map():
+        cat = rng.randint(0, 4, (24, 24))
+        inst = rng.randint(0, 3, (24, 24))
+        return np.stack([cat, inst], axis=-1)
+
+    cls_name = "ModifiedPanopticQuality" if modified else "PanopticQuality"
+    ref_m = getattr(ref_mod, cls_name)(things=things, stuffs=stuffs)
+    our_m = getattr(ours, cls_name)(things=things, stuffs=stuffs)
+    for _ in range(2):
+        p, g = _pan_map(), _pan_map()
+        ref_m.update(t(p)[None], t(g)[None])
+        our_m.update(jnp.asarray(p)[None], jnp.asarray(g)[None])
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-4, atol=1e-5, label=cls_name)
+
+
+def test_panoptic_quality_return_per_class():
+    tm = reference()
+    import metrics_tpu.detection as ours
+    import torchmetrics.detection as ref_mod
+
+    rng = np.random.RandomState(115)
+    things, stuffs = {0, 1}, {2}
+    cat = rng.randint(0, 3, (2, 20, 20))
+    inst = rng.randint(0, 2, (2, 20, 20))
+    maps = np.stack([cat, inst], axis=-1)
+    ref_m = ref_mod.PanopticQuality(things=things, stuffs=stuffs, return_per_class=True)
+    our_m = ours.PanopticQuality(things=things, stuffs=stuffs, return_per_class=True)
+    ref_m.update(t(maps), t(maps))
+    our_m.update(jnp.asarray(maps), jnp.asarray(maps))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-4, atol=1e-5, label="pq_per_class")
