@@ -188,6 +188,47 @@ def test_multiclass_exact_curves(average):
     assert_close(got, ref, rtol=1e-4, atol=1e-5, label="mc_ap_exact")
 
 
+@pytest.mark.parametrize("average", ["macro", "micro", "none"])
+@pytest.mark.parametrize("thresholds", [None, 50])
+def test_multilabel_auroc_ap(average, thresholds):
+    tm = reference()
+    rng = np.random.RandomState(86)
+    p = rng.rand(120, NL).astype(np.float32)
+    g = rng.randint(0, 2, (120, NL))
+    ref = tm.functional.classification.multilabel_auroc(
+        t(p), t(g), num_labels=NL, average=average, thresholds=thresholds
+    )
+    got = ours.multilabel_auroc(jnp.asarray(p), jnp.asarray(g), num_labels=NL, average=average, thresholds=thresholds)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"ml_auroc[{average},{thresholds}]")
+    if average != "micro":
+        ref = tm.functional.classification.multilabel_average_precision(
+            t(p), t(g), num_labels=NL, average=average, thresholds=thresholds
+        )
+        got = ours.multilabel_average_precision(
+            jnp.asarray(p), jnp.asarray(g), num_labels=NL, average=average, thresholds=thresholds
+        )
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"ml_ap[{average},{thresholds}]")
+
+
+@pytest.mark.parametrize("ignore_index", [None, -1])
+@pytest.mark.parametrize("thresholds", [None, 50])
+def test_multilabel_roc_prc_curves(ignore_index, thresholds):
+    tm = reference()
+    rng = np.random.RandomState(87)
+    p = rng.rand(100, NL).astype(np.float32)
+    g = rng.randint(0, 2, (100, NL))
+    if ignore_index is not None:
+        g[rng.rand(100, NL) < 0.15] = ignore_index
+    for name in ("multilabel_roc", "multilabel_precision_recall_curve"):
+        ref = getattr(tm.functional.classification, name)(
+            t(p), t(g), num_labels=NL, thresholds=thresholds, ignore_index=ignore_index
+        )
+        got = getattr(ours, name)(
+            jnp.asarray(p), jnp.asarray(g), num_labels=NL, thresholds=thresholds, ignore_index=ignore_index
+        )
+        assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"{name}[{ignore_index},{thresholds}]")
+
+
 def test_group_fairness():
     tm = reference()
     rng = np.random.RandomState(84)
