@@ -173,7 +173,9 @@ class InceptionV3FID(nn.Module):
     def __call__(self, x: Array, features: Sequence[Any] = (2048,)) -> Dict[Any, Array]:
         # x: (N, 3, H, W) in [0, 255]; resize + FID normalization
         x = jnp.transpose(x.astype(jnp.float32), (0, 2, 3, 1))
-        x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear")
+        # antialias=False: torch-fidelity resizes with F.interpolate(bilinear,
+        # align_corners=False), which never antialiases — keep downsampling identical
+        x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear", antialias=False)
         x = (x - 128.0) / 128.0
 
         out: Dict[Any, Array] = {}

@@ -102,23 +102,33 @@ class _Fire(nn.Module):
         return jnp.concatenate([e1, e3], axis=-1)
 
 
+def _max_pool_ceil(x: Array) -> Array:
+    """3×3 stride-2 max pool with torch ``ceil_mode=True`` semantics.
+
+    torchvision SqueezeNet pools with ceil_mode; shapes are static under jit, so
+    the required right/bottom -inf padding is computed from the traced shape.
+    """
+    pads = [(0, (d - 3) % 2) for d in x.shape[1:3]]
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding=pads)
+
+
 class SqueezeNetFeatures(nn.Module):
     """torchvision SqueezeNet-1.1 ``features`` trunk with the 7 LPIPS tap points."""
 
     @nn.compact
     def __call__(self, x: Array) -> List[Array]:
         taps: List[Array] = []
-        x = nn.relu(nn.Conv(64, (3, 3), strides=(2, 2), name="conv_0")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(2, 2), padding="VALID", name="conv_0")(x))
         taps.append(x)  # 64
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _max_pool_ceil(x)
         x = _Fire(16, 64, name="fire_3")(x)
         x = _Fire(16, 64, name="fire_4")(x)
         taps.append(x)  # 128
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _max_pool_ceil(x)
         x = _Fire(32, 128, name="fire_6")(x)
         x = _Fire(32, 128, name="fire_7")(x)
         taps.append(x)  # 256
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _max_pool_ceil(x)
         x = _Fire(48, 192, name="fire_9")(x)
         taps.append(x)  # 384
         x = _Fire(48, 192, name="fire_10")(x)

@@ -1,3 +1,3 @@
 """Minimal torchvision stand-in (test infra): just the box ops the reference imports."""
 __version__ = "0.0.shim"
-from torchvision import ops  # noqa: F401
+from torchvision import models, ops  # noqa: F401

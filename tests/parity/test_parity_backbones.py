@@ -1,0 +1,242 @@
+"""Torch-vs-flax backbone forward parity (round-2 VERDICT "Next round" item 1).
+
+Converts RANDOM torch weights with the production converters and asserts the
+flax forward pass equals the torch forward pass per tap — then end-to-end
+LPIPS against the reference's actual ``_LPIPS`` scorer (in-tree torch nets at
+``/root/reference/src/torchmetrics/functional/image/lpips.py:63-150`` +
+vendored trained lin heads in ``functional/image/lpips_models/``), and
+end-to-end FID against the reference metric on identical converted weights.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests import _reference as R
+
+torch = pytest.importorskip("torch")
+
+from metrics_tpu.models.inception_v3 import (  # noqa: E402
+    InceptionV3FID,
+    convert_torch_state_dict,
+)
+from metrics_tpu.models.lpips_nets import (  # noqa: E402
+    ALEX_TAPS,
+    SQUEEZE_TAPS,
+    VGG16_TAPS,
+    _net_for,
+    build_lpips,
+    convert_torch_backbone,
+    convert_torch_lin,
+)
+
+_REF_LPIPS_DIR = "/root/reference/src/torchmetrics/functional/image/lpips_models"
+_rng = np.random.RandomState(7)
+
+
+def _ref_lpips_module(net_type: str):
+    """The reference's in-tree ``_LPIPS`` with a random tower + vendored lin heads."""
+    R.reference()  # puts the shim torchvision + reference on sys.path
+    from torchmetrics.functional.image.lpips import _LPIPS
+
+    torch.manual_seed(3)
+    return _LPIPS(net=net_type, pretrained=True, pnet_rand=True).eval()
+
+
+def _tower_state_dict(ref_net) -> dict:
+    """Reference slice-layout state dict → torchvision ``features.<idx>`` layout.
+
+    The reference towers register the original torchvision Sequential indices
+    as submodule names inside each slice (``slice1.0.weight`` /
+    ``slices.2.3.squeeze.weight``), so the features-layout name is everything
+    after the slice prefix.
+    """
+    out = {}
+    for name, value in ref_net.state_dict().items():
+        parts = name.split(".")
+        rest = parts[2:] if parts[0] == "slices" else parts[1:]
+        out["features." + ".".join(rest)] = value
+    return out
+
+
+@pytest.mark.parametrize(
+    ("net_type", "taps"), [("vgg", VGG16_TAPS), ("alex", ALEX_TAPS), ("squeeze", SQUEEZE_TAPS)]
+)
+def test_lpips_tower_forward_parity_per_tap(net_type, taps):
+    ref = _ref_lpips_module(net_type)
+    variables = convert_torch_backbone(_tower_state_dict(ref.net), net_type)
+
+    # non-square; H=66 makes the squeeze tower hit a ceil-mode pool boundary
+    x = _rng.rand(2, 3, 66, 64).astype(np.float32) * 2 - 1
+    scaled = ref.scaling_layer(torch.from_numpy(x))
+    with torch.no_grad():
+        torch_taps = ref.net(scaled)
+    flax_taps = _net_for(net_type).apply(variables, jnp.transpose(jnp.asarray(scaled.numpy()), (0, 2, 3, 1)))
+
+    assert len(torch_taps) == len(flax_taps) == len(taps)
+    for i, (t_tap, f_tap) in enumerate(zip(torch_taps, flax_taps)):
+        got = np.transpose(np.asarray(f_tap), (0, 3, 1, 2))
+        np.testing.assert_allclose(got, t_tap.numpy(), rtol=1e-4, atol=1e-4, err_msg=f"{net_type} tap {i}")
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF_LPIPS_DIR), reason="vendored lin weights not on disk")
+@pytest.mark.parametrize("net_type", ["vgg", "alex", "squeeze"])
+@pytest.mark.parametrize("normalize", [False, True])
+def test_lpips_end_to_end_parity_vs_reference_scorer(net_type, normalize):
+    """Same random tower + the reference's own trained lin heads, both sides."""
+    ref = _ref_lpips_module(net_type)
+    variables = convert_torch_backbone(_tower_state_dict(ref.net), net_type)
+    lin = convert_torch_lin(torch.load(os.path.join(_REF_LPIPS_DIR, f"{net_type}.pth"), map_location="cpu"))
+    score = build_lpips(net_type, variables, lin)
+
+    x = _rng.rand(3, 3, 64, 64).astype(np.float32)
+    y = _rng.rand(3, 3, 64, 64).astype(np.float32)
+    if not normalize:
+        x, y = x * 2 - 1, y * 2 - 1
+    with torch.no_grad():
+        want = ref(torch.from_numpy(x), torch.from_numpy(y), normalize=normalize).flatten().numpy()
+    got = np.asarray(score(jnp.asarray(x), jnp.asarray(y), normalize))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def inception_pair():
+    from tests._torch_inception import TorchInceptionV3FID
+
+    torch.manual_seed(11)
+    tnet = TorchInceptionV3FID().eval()
+    # non-trivial running stats so BN conversion is actually exercised
+    with torch.no_grad():
+        for mod in tnet.modules():
+            if isinstance(mod, torch.nn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.2, 0.2)
+                mod.running_var.uniform_(0.5, 1.5)
+    variables = convert_torch_state_dict(tnet.state_dict())
+    return tnet, variables
+
+
+def test_inception_forward_parity_all_taps(inception_pair):
+    tnet, variables = inception_pair
+    x = _rng.randint(0, 255, (2, 3, 299, 299)).astype(np.float32)
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(x))
+    got = InceptionV3FID().apply(
+        variables, jnp.asarray(x), features=(64, 192, 768, 2048, "logits_unbiased", "logits")
+    )
+    for tap in (64, 192, 768):
+        np.testing.assert_allclose(
+            np.asarray(got[tap]), want[tap].numpy(), rtol=1e-3, atol=1e-3, err_msg=f"tap {tap}"
+        )
+    np.testing.assert_allclose(np.asarray(got[2048]), want[2048].numpy(), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(got["logits_unbiased"]), want["logits_unbiased"].numpy(), rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(np.asarray(got["logits"]), want["logits"].numpy(), rtol=1e-3, atol=1e-3)
+
+
+def test_inception_resize_parity_downsampling(inception_pair):
+    """jax.image.resize(antialias=False) must match torch F.interpolate exactly enough
+    that the 2048-d features agree on non-299 inputs (both down- and upsampling)."""
+    tnet, variables = inception_pair
+    for hw in ((2, 3, 350, 340), (2, 3, 128, 128)):
+        x = _rng.randint(0, 255, hw).astype(np.float32)
+        resized = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(299, 299), mode="bilinear", align_corners=False
+        )
+        with torch.no_grad():
+            want = tnet(resized)[2048].numpy()
+        got = np.asarray(InceptionV3FID().apply(variables, jnp.asarray(x), features=(2048,))[2048])
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3, err_msg=str(hw))
+
+
+def test_fid_metric_end_to_end_parity(inception_pair, tmp_path, monkeypatch):
+    """Our FID vs the reference FID, both running the SAME converted random weights."""
+    tm = R.reference()
+    tnet, variables = inception_pair
+
+    from flax.serialization import msgpack_serialize
+    import jax
+
+    from metrics_tpu.image import FrechetInceptionDistance
+
+    (tmp_path / "inception_v3_fid.msgpack").write_bytes(msgpack_serialize(jax.device_get(variables)))
+    monkeypatch.setenv("METRICS_TPU_WEIGHTS", str(tmp_path))
+
+    class _Wrap(torch.nn.Module):
+        def __init__(self, net):
+            super().__init__()
+            self.net = net
+
+        def forward(self, x):
+            return self.net(x.float())[2048]
+
+    real = _rng.randint(0, 255, (9, 3, 299, 299)).astype(np.uint8)
+    fake = _rng.randint(0, 255, (9, 3, 299, 299)).astype(np.uint8)
+
+    ref_fid = tm.image.fid.FrechetInceptionDistance(feature=_Wrap(tnet))
+    ref_fid.update(torch.from_numpy(real), real=True)
+    ref_fid.update(torch.from_numpy(fake), real=False)
+    want = float(ref_fid.compute())
+
+    fid = FrechetInceptionDistance(feature=2048)
+    fid.update(jnp.asarray(real.astype(np.float32)), real=True)
+    fid.update(jnp.asarray(fake.astype(np.float32)), real=False)
+    got = float(fid.compute())
+    assert got == pytest.approx(want, rel=1e-3, abs=1e-3)
+
+
+def test_bert_loader_cross_framework_parity(tmp_path):
+    """Flax checkpoint loaded by our hub == torch BERT loaded from the same checkpoint."""
+    transformers = pytest.importorskip("transformers")
+    from transformers import BertConfig, BertModel, FlaxBertModel
+
+    cfg = BertConfig(
+        vocab_size=50, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=37, max_position_embeddings=64,
+    )
+    torch.manual_seed(5)
+    tmodel = BertModel(cfg).eval()
+    ckpt = tmp_path / "tiny-bert"
+    tmodel.save_pretrained(str(ckpt), safe_serialization=False)
+    fmodel = FlaxBertModel.from_pretrained(str(ckpt), from_pt=True)
+
+    ids = _rng.randint(0, 50, (2, 9))
+    mask = np.ones_like(ids)
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).last_hidden_state.numpy()
+    got = np.asarray(fmodel(jnp.asarray(ids), attention_mask=jnp.asarray(mask)).last_hidden_state)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_clip_loader_cross_framework_parity(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    from transformers import CLIPConfig, CLIPModel, FlaxCLIPModel
+
+    cfg = CLIPConfig.from_text_vision_configs(
+        transformers.CLIPTextConfig(
+            hidden_size=32, intermediate_size=37, num_attention_heads=4,
+            num_hidden_layers=2, vocab_size=60, max_position_embeddings=32,
+        ),
+        transformers.CLIPVisionConfig(
+            hidden_size=32, intermediate_size=37, num_attention_heads=4,
+            num_hidden_layers=2, image_size=30, patch_size=15,
+        ),
+        projection_dim=16,
+    )
+    torch.manual_seed(5)
+    tmodel = CLIPModel(cfg).eval()
+    ckpt = tmp_path / "tiny-clip"
+    tmodel.save_pretrained(str(ckpt), safe_serialization=False)
+    fmodel = FlaxCLIPModel.from_pretrained(str(ckpt), from_pt=True)
+
+    ids = _rng.randint(0, 60, (2, 7))
+    pix = _rng.rand(2, 3, 30, 30).astype(np.float32)
+    with torch.no_grad():
+        t_img = tmodel.get_image_features(pixel_values=torch.from_numpy(pix)).numpy()
+        t_txt = tmodel.get_text_features(torch.from_numpy(ids)).numpy()
+    f_img = np.asarray(fmodel.get_image_features(pixel_values=jnp.asarray(pix)))
+    f_txt = np.asarray(fmodel.get_text_features(jnp.asarray(ids)))
+    np.testing.assert_allclose(f_img, t_img, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f_txt, t_txt, rtol=1e-4, atol=1e-4)
