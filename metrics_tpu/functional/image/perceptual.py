@@ -1,0 +1,65 @@
+"""Functional LPIPS / PerceptualPathLength entry points.
+
+Mirrors the reference's public functional API
+(``functional/image/lpips.py:227``, ``functional/image/perceptual_path_length.py:154``).
+Imports are deferred so ``metrics_tpu.functional.image`` stays cycle-free with
+the modular ``metrics_tpu.image`` package.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net_type: str = "alex",
+    reduction: str = "mean",
+    normalize: bool = False,
+) -> Array:
+    """LPIPS between two image batches using the named backbone from local weights.
+
+    ``reduction``: 'mean' or 'sum' over the batch (reference semantics).
+    """
+    if net_type not in ("alex", "vgg", "squeeze"):
+        raise ValueError(f"Argument `net_type` must be one of 'alex', 'vgg', 'squeeze', but got {net_type}")
+    if reduction not in ("mean", "sum"):
+        raise ValueError(f"Argument `reduction` must be one of 'sum' or 'mean' but got {reduction}")
+    from metrics_tpu.models.hub import load_lpips
+
+    d = load_lpips(net_type)(img1, img2, normalize)
+    return d.mean() if reduction == "mean" else d.sum()
+
+
+def perceptual_path_length(
+    generator: Any,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Optional[Callable] = None,
+    seed: int = 0,
+) -> tuple:
+    """Perceptual path length of a generator — see :func:`metrics_tpu.image.lpips.perceptual_path_length`."""
+    from metrics_tpu.image.lpips import perceptual_path_length as _ppl
+
+    return _ppl(
+        generator,
+        num_samples=num_samples,
+        conditional=conditional,
+        batch_size=batch_size,
+        interpolation_method=interpolation_method,
+        epsilon=epsilon,
+        resize=resize,
+        lower_discard=lower_discard,
+        upper_discard=upper_discard,
+        sim_net=sim_net,
+        seed=seed,
+    )

@@ -110,61 +110,267 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         return self.sum_scores.astype(jnp.float32)
 
 
+def _interpolate_latents(z1: Array, z2: Array, epsilon: float, method: str) -> Array:
+    """ε-step from ``z1`` toward ``z2`` (reference ``functional/image/perceptual_path_length.py:107-151``)."""
+    eps = 1e-7
+    if z1.shape != z2.shape:
+        raise ValueError("Latents must have the same shape.")
+    if method == "lerp":
+        return z1 + (z2 - z1) * epsilon
+    if method in ("slerp_any", "slerp_unit"):
+        n1 = z1 / jnp.clip(jnp.sqrt((z1**2).sum(-1, keepdims=True)), eps, None)
+        n2 = z2 / jnp.clip(jnp.sqrt((z2**2).sum(-1, keepdims=True)), eps, None)
+        d = (n1 * n2).sum(-1, keepdims=True)
+        degenerate = (
+            (jnp.linalg.norm(n1, axis=-1, keepdims=True) < eps)
+            | (jnp.linalg.norm(n2, axis=-1, keepdims=True) < eps)
+            | (d > 1 - eps)
+            | (d < -1 + eps)
+        )
+        omega = jnp.arccos(jnp.clip(d, -1.0, 1.0))
+        denom = jnp.clip(jnp.sin(omega), eps, None)
+        out = (jnp.sin((1 - epsilon) * omega) / denom) * z1 + (jnp.sin(epsilon * omega) / denom) * z2
+        out = jnp.where(degenerate, z1 + (z2 - z1) * epsilon, out)
+        if method == "slerp_unit":
+            out = out / jnp.clip(jnp.sqrt((out**2).sum(-1, keepdims=True)), eps, None)
+        return out
+    raise ValueError(f"Interpolation method {method} not supported. Choose from 'lerp', 'slerp_any', 'slerp_unit'.")
+
+
+def _adaptive_avg_matrix(n_in: int, n_out: int) -> np.ndarray:
+    """(n_out, n_in) row-stochastic matrix equal to torch adaptive_avg_pool1d:
+    output i averages whole input pixels [floor(i*n/o), ceil((i+1)*n/o))."""
+    m = np.zeros((n_out, n_in), dtype=np.float32)
+    for i in range(n_out):
+        start = (i * n_in) // n_out
+        end = -(-((i + 1) * n_in) // n_out)  # ceil
+        m[i, start:end] = 1.0 / (end - start)
+    return m
+
+
+def _resize_images(x: Array, size: Optional[int]) -> Array:
+    """Resize (N, C, H, W) images to ``(size, size)`` with the reference's
+    ``_resize_tensor`` rule (``functional/image/lpips.py:219-224``): torch
+    ``area`` mode (= adaptive average pooling) when BOTH dims are strictly
+    larger than ``size``, bilinear (align_corners=False) otherwise."""
+    if size is None:
+        return x
+    n, c, h, w = x.shape
+    if h > size and w > size:
+        mh = jnp.asarray(_adaptive_avg_matrix(h, size))
+        mw = jnp.asarray(_adaptive_avg_matrix(w, size))
+        return jnp.einsum("oh,nchw,pw->ncop", mh, x, mw)
+    import jax
+
+    return jax.image.resize(x, (n, c, size, size), method="bilinear", antialias=False)
+
+
+def _ppl_validate_args(
+    num_samples: int,
+    conditional: bool,
+    batch_size: int,
+    interpolation_method: str,
+    epsilon: float,
+    resize: Optional[int],
+    lower_discard: Optional[float],
+    upper_discard: Optional[float],
+) -> None:
+    """Reference ``_perceptual_path_length_validate_arguments`` (``functional/image/perceptual_path_length.py:71``)."""
+    if not (isinstance(num_samples, int) and num_samples > 0):
+        raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")
+    if not isinstance(conditional, bool):
+        raise ValueError(f"Argument `conditional` must be a boolean, but got {conditional}.")
+    if not (isinstance(batch_size, int) and batch_size > 0):
+        raise ValueError(f"Argument `batch_size` must be a positive integer, but got {batch_size}.")
+    if interpolation_method not in ("lerp", "slerp_any", "slerp_unit"):
+        raise ValueError(
+            f"Argument `interpolation_method` must be one of 'lerp', 'slerp_any', 'slerp_unit',"
+            f"got {interpolation_method}."
+        )
+    if not (isinstance(epsilon, float) and epsilon > 0):
+        raise ValueError(f"Argument `epsilon` must be a positive float, but got {epsilon}.")
+    if resize is not None and not (isinstance(resize, int) and resize > 0):
+        raise ValueError(f"Argument `resize` must be a positive integer or `None`, but got {resize}.")
+    if lower_discard is not None and not (isinstance(lower_discard, float) and 0 <= lower_discard <= 1):
+        raise ValueError(
+            f"Argument `lower_discard` must be a float between 0 and 1 or `None`, but got {lower_discard}."
+        )
+    if upper_discard is not None and not (isinstance(upper_discard, float) and 0 <= upper_discard <= 1):
+        raise ValueError(
+            f"Argument `upper_discard` must be a float between 0 and 1 or `None`, but got {upper_discard}."
+        )
+
+
+def _resolve_sim_net(sim_net: Any, resize: Optional[int]) -> Callable:
+    """``None``/name → LPIPS scorer from local weights (with the reference's
+    in-net resize); custom callables pass through untouched; anything else raises."""
+    if sim_net is None or isinstance(sim_net, str):
+        name = sim_net or "vgg"
+        if name not in ("alex", "vgg", "squeeze"):
+            raise ValueError(f"sim_net must be a callable or one of 'alex', 'vgg', 'squeeze', got {sim_net}")
+        from metrics_tpu.models.hub import load_lpips
+
+        scorer = load_lpips(name)
+        # resampling (bilinear or area) commutes with the scorer's per-channel
+        # affine input normalization (resampling weights sum to 1), so pre-resizing
+        # here equals the reference's post-scaling-layer resize inside _LPIPS
+        return lambda a, b: scorer(_resize_images(a, resize), _resize_images(b, resize), False)
+    if not callable(sim_net):
+        raise ValueError(f"sim_net must be a callable or one of 'alex', 'vgg', 'squeeze', got {sim_net}")
+    return sim_net
+
+
+def _validate_ppl_generator(generator: Any, conditional: bool) -> None:
+    """Reference ``_validate_generator_model`` contract (sample method, num_classes when conditional)."""
+    if not hasattr(generator, "sample"):
+        raise NotImplementedError(
+            "The generator must have a `sample` method with signature `sample(num_samples: int) -> Array` where the"
+            " returned array has shape `(num_samples, z_size)`."
+        )
+    if not callable(generator.sample):
+        raise ValueError("The generator's `sample` method must be callable.")
+    if conditional and not hasattr(generator, "num_classes"):
+        raise AttributeError("The generator must have a `num_classes` attribute when `conditional=True`.")
+    if conditional and not isinstance(getattr(generator, "num_classes", None), int):
+        raise ValueError("The generator's `num_classes` attribute must be an integer when `conditional=True`.")
+
+
+def perceptual_path_length(
+    generator: Any,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Optional[Callable] = None,
+    seed: int = 0,
+) -> tuple:
+    """Perceptual path length of a generator (reference ``functional/image/perceptual_path_length.py:154``).
+
+    ``generator``: object with ``sample(n) -> (n, z)`` latents and ``__call__(z[, labels]) -> images``
+    scaled to [0, 255]. ``sim_net``: similarity callable ``(img1, img2) -> (N,)`` distances
+    (e.g. an LPIPS scorer from :func:`metrics_tpu.models.lpips_nets.build_lpips` partially
+    applied); when ``None``, the vgg LPIPS backbone is resolved from local weights.
+    ``resize``: only the built-in LPIPS path resizes its inputs to ``(resize, resize)``
+    (area-averaged for integer downsampling, bilinear otherwise) — a custom ``sim_net``
+    receives the raw generator output, exactly as in the reference, where ``resize`` is a
+    ``_LPIPS`` constructor argument and custom similarity modules are used as-is.
+
+    Returns ``(mean, std, distances)`` after quantile tail discards — the reference's contract.
+    """
+    _ppl_validate_args(
+        num_samples, conditional, batch_size, interpolation_method, epsilon, resize, lower_discard, upper_discard
+    )
+    _validate_ppl_generator(generator, conditional)
+    sim_net = _resolve_sim_net(sim_net, resize)
+
+    latent1 = generator.sample(num_samples)
+    latent2 = generator.sample(num_samples)
+    latent2 = _interpolate_latents(latent1, latent2, epsilon, interpolation_method)
+    labels = None
+    if conditional:
+        labels = jnp.asarray(np.random.default_rng(seed).integers(0, generator.num_classes, (num_samples,)))
+
+    distances = []
+    num_batches = int(np.ceil(num_samples / batch_size))
+    for i in range(num_batches):
+        b1 = latent1[i * batch_size : (i + 1) * batch_size]
+        b2 = latent2[i * batch_size : (i + 1) * batch_size]
+        if conditional:
+            lab = labels[i * batch_size : (i + 1) * batch_size]
+            outputs = generator(jnp.concatenate([b1, b2], 0), jnp.concatenate([lab, lab], 0))
+        else:
+            outputs = generator(jnp.concatenate([b1, b2], 0))
+        out1, out2 = jnp.split(outputs, 2, axis=0)
+        # rescale to the LPIPS domain: [0, 255] -> [-1, 1]
+        out1 = 2 * (out1 / 255) - 1
+        out2 = 2 * (out2 / 255) - 1
+        distances.append(np.asarray(sim_net(out1, out2)).reshape(-1) / epsilon**2)
+
+    d = np.concatenate(distances)
+    # reference uses torch.quantile(interpolation="lower")
+    lower = np.quantile(d, lower_discard, method="lower") if lower_discard is not None else 0.0
+    upper = np.quantile(d, upper_discard, method="lower") if upper_discard is not None else d.max()
+    kept = d[(d >= lower) & (d <= upper)]
+    return (
+        jnp.asarray(kept.mean(), dtype=jnp.float32),
+        jnp.asarray(kept.std(ddof=1), dtype=jnp.float32),
+        jnp.asarray(kept),
+    )
+
+
 class PerceptualPathLength(Metric):
     """Perceptual Path Length (reference ``image/perceptual_path_length.py:36``).
 
-    Measures LPIPS distance between images generated from perturbed latent
-    interpolations. Requires a generator callable and an LPIPS ``net`` (see
-    :class:`LearnedPerceptualImagePatchSimilarity`).
+    Measures LPIPS distance between images generated from ε-separated latent
+    interpolations. ``update(generator)`` stores the generator; ``compute()``
+    samples ``num_samples`` latent pairs through it and returns
+    ``(mean, std, distances)`` — the reference's exact lifecycle.
+
+    ``sim_net``: similarity callable ``(img1, img2) -> (N,)``; defaults to the
+    named LPIPS backbone resolved from local weights (offline build).
     """
 
     __jit_ineligible__ = True
     is_differentiable = False
-    higher_is_better = False
-    full_state_update = False
+    higher_is_better = True
+    full_state_update = True
 
     def __init__(
         self,
-        generator: Optional[Callable] = None,
-        net: Optional[Callable] = None,
-        num_samples: int = 10000,
+        num_samples: int = 10_000,
         conditional: bool = False,
+        batch_size: int = 128,
+        interpolation_method: str = "lerp",
         epsilon: float = 1e-4,
         resize: Optional[int] = 64,
         lower_discard: Optional[float] = 0.01,
         upper_discard: Optional[float] = 0.99,
+        sim_net: Optional[Callable] = None,
+        seed: int = 0,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if generator is None or net is None:
-            raise ModuleNotFoundError(
-                "PerceptualPathLength needs a `generator` callable (z -> images) and an LPIPS `net`"
-                " feature callable; pretrained defaults are unavailable in this offline build."
-            )
-        self.generator = generator
-        self.net = net
+        _ppl_validate_args(
+            num_samples, conditional, batch_size, interpolation_method, epsilon, resize, lower_discard, upper_discard
+        )
+        # resolve once at construction (the reference builds its _LPIPS in
+        # __init__ too): weights load a single time, and a misconfigured
+        # offline environment fails here, not at compute()
+        self._sim = _resolve_sim_net(sim_net, resize)
         self.num_samples = num_samples
+        self.conditional = conditional
+        self.batch_size = batch_size
+        self.interpolation_method = interpolation_method
         self.epsilon = epsilon
+        self.resize = resize
         self.lower_discard = lower_discard
         self.upper_discard = upper_discard
-        self.add_state("distances", [], dist_reduce_fx="cat")
+        self.seed = seed
+        self.generator: Optional[Any] = None
 
-    def update(self, z0: Array, z1: Array) -> None:
-        """Update with latent pairs: generates endpoints of an ε-step interpolation."""
-        t = np.random.RandomState(0).rand(z0.shape[0]).astype(np.float32)[:, None]
-        zt0 = z0 * (1 - t) + z1 * t
-        zt1 = z0 * (1 - (t + self.epsilon)) + z1 * (t + self.epsilon)
-        img0 = self.generator(zt0)
-        img1 = self.generator(zt1)
-        d = _lpips_distance(self.net(img0), self.net(img1)) / (self.epsilon**2)
-        self.distances.append(d)
+    def update(self, generator: Any) -> None:
+        """Store the generator model (validated against the reference's contract)."""
+        _validate_ppl_generator(generator, self.conditional)
+        self.generator = generator
 
-    def compute(self) -> Array:
-        """Mean PPL with tail discards."""
-        from metrics_tpu.utils.data import dim_zero_cat
-
-        d = np.asarray(dim_zero_cat(self.distances))
-        lo = np.quantile(d, self.lower_discard) if self.lower_discard else d.min()
-        hi = np.quantile(d, self.upper_discard) if self.upper_discard else d.max()
-        kept = d[(d >= lo) & (d <= hi)]
-        return jnp.asarray(kept.mean() if kept.size else 0.0, dtype=jnp.float32)
+    def compute(self) -> tuple:
+        """Sample latent pairs through the stored generator and compute PPL."""
+        if self.generator is None:
+            raise RuntimeError("`update(generator)` must be called before `compute()`.")
+        return perceptual_path_length(
+            generator=self.generator,
+            num_samples=self.num_samples,
+            conditional=self.conditional,
+            batch_size=self.batch_size,
+            interpolation_method=self.interpolation_method,
+            epsilon=self.epsilon,
+            resize=self.resize,
+            lower_discard=self.lower_discard,
+            upper_discard=self.upper_discard,
+            sim_net=self._sim,
+            seed=self.seed,
+        )
