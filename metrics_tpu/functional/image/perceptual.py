@@ -28,10 +28,30 @@ def learned_perceptual_image_patch_similarity(
         raise ValueError(f"Argument `net_type` must be one of 'alex', 'vgg', 'squeeze', but got {net_type}")
     if reduction not in ("mean", "sum"):
         raise ValueError(f"Argument `reduction` must be one of 'sum' or 'mean' but got {reduction}")
+    _validate_lpips_images(img1, img2, normalize)
     from metrics_tpu.models.hub import load_lpips
 
     d = load_lpips(net_type)(img1, img2, normalize)
     return d.mean() if reduction == "mean" else d.sum()
+
+
+def _validate_lpips_images(img1: Array, img2: Array, normalize: bool) -> None:
+    """Reference ``_valid_img`` contract (``functional/image/lpips.py:374-397``):
+    (N, 3, H, W) inputs in [0, 1] when ``normalize`` else [-1, 1]."""
+
+    def ok(img: Array) -> bool:
+        if img.ndim != 4 or img.shape[1] != 3:
+            return False
+        lo, hi = float(img.min()), float(img.max())
+        return (hi <= 1.0 and lo >= 0.0) if normalize else lo >= -1.0
+
+    if not (ok(img1) and ok(img2)):
+        raise ValueError(
+            "Expected both input arguments to be normalized tensors with shape [N, 3, H, W]."
+            f" Got input with shape {img1.shape} and {img2.shape} and values in range"
+            f" {[float(img1.min()), float(img1.max())]} and {[float(img2.min()), float(img2.max())]}"
+            f" when all values are expected to be in the {[0, 1] if normalize else [-1, 1]} range."
+        )
 
 
 def perceptual_path_length(

@@ -91,6 +91,9 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
 
     def update(self, img1: Array, img2: Array) -> None:
         """Update with a pair of image batches."""
+        from metrics_tpu.functional.image.perceptual import _validate_lpips_images
+
+        _validate_lpips_images(img1, img2, self.normalize)
         if self._scorer is not None:
             d = self._scorer(img1, img2, self.normalize)
             self.sum_scores = self.sum_scores + d.sum()
@@ -205,7 +208,7 @@ def _resolve_sim_net(sim_net: Any, resize: Optional[int]) -> Callable:
     """``None``/name → LPIPS scorer from local weights (with the reference's
     in-net resize); custom callables pass through untouched; anything else raises."""
     if sim_net is None or isinstance(sim_net, str):
-        name = sim_net or "vgg"
+        name = "vgg" if sim_net is None else sim_net
         if name not in ("alex", "vgg", "squeeze"):
             raise ValueError(f"sim_net must be a callable or one of 'alex', 'vgg', 'squeeze', got {sim_net}")
         from metrics_tpu.models.hub import load_lpips

@@ -84,6 +84,9 @@ def evaluate_full(preds, target, iou_thrs=None, rec_thrs=None, max_dets=(1, 10, 
     precision = -np.ones((t_n, r_n, k_n, a_n, m_n))
     recall = -np.ones((t_n, k_n, a_n, m_n))
 
+    has_masks = ["masks" in d for d in list(preds) + list(target)]
+    segm = any(has_masks)
+    assert not segm or all(has_masks), "oracle inputs must carry masks on every dict or none"
     for ki, cls in enumerate(classes):
         per_img = []
         for i in range(n_imgs):
@@ -96,13 +99,24 @@ def evaluate_full(preds, target, iou_thrs=None, rec_thrs=None, max_dets=(1, 10, 
             gboxes = np.asarray(target[i]["boxes"], dtype=np.float64).reshape(-1, 4)[gmask]
             ng_all = len(np.asarray(target[i]["labels"]).reshape(-1))
             gcrowd = np.asarray(target[i].get("iscrowd", np.zeros(ng_all))).astype(bool)[gmask]
-            garea_in = target[i].get("area")
-            if garea_in is None:
-                garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+            if segm:
+                # segm evaluation: IoUs and ALL areas come from the masks via the
+                # independent test-side RLE codec (tests/_independent_rle.py)
+                from tests._independent_rle import encode_mask, mask_iou, rle_area
+
+                drles = [encode_mask(m) for m in np.asarray(preds[i]["masks"])[dmask][order]]
+                grles = [encode_mask(m) for m in np.asarray(target[i]["masks"])[gmask]]
+                ious = mask_iou(drles, grles, gcrowd) if drles and grles else np.zeros((len(drles), len(grles)))
+                garea = np.asarray([rle_area(r) for r in grles], dtype=np.float64)
+                det_areas = np.asarray([rle_area(r) for r in drles], dtype=np.float64)
             else:
-                garea = np.asarray(garea_in, dtype=np.float64)[gmask]
-            ious = np_box_iou(dboxes.astype(np.float32), gboxes.astype(np.float32), gcrowd).astype(np.float64)
-            det_areas = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
+                garea_in = target[i].get("area")
+                if garea_in is None:
+                    garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+                else:
+                    garea = np.asarray(garea_in, dtype=np.float64)[gmask]
+                ious = np_box_iou(dboxes.astype(np.float32), gboxes.astype(np.float32), gcrowd).astype(np.float64)
+                det_areas = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
             per_img.append((dscores, det_areas, gcrowd, garea, ious))
 
         for ai, aname in enumerate(area_names):

@@ -251,6 +251,63 @@ def test_matching_kernel_vs_numpy_cocoeval_crowd_and_area():
         np.testing.assert_array_equal(np.asarray(dtig[0]), want_dtig)
 
 
+@pytest.mark.parametrize("crowd_prob", [0.0, 0.3])
+def test_segm_full_pipeline_vs_numpy_cocoeval(crowd_prob):
+    """Segm end-to-end (extended summary) vs the oracle running the INDEPENDENT
+    test-side RLE codec (tests/_independent_rle.py) — mask IoU, mask areas,
+    crowd semantics all cross-implementation."""
+    from tests._map_oracle import evaluate_full
+
+    rng = np.random.RandomState(4)
+    preds, target = _synth_boxes(rng, n_imgs=25, n_classes=3, crowd_prob=crowd_prob, img_hw=64.0)
+    h = w = 64
+    for d in preds + target:
+        d["masks"] = (
+            np.stack([_rect_mask(h, w, b) for b in d["boxes"]]) if len(d["boxes"]) else np.zeros((0, h, w), np.uint8)
+        )
+
+    m = MeanAveragePrecision(iou_type="segm", extended_summary=True)
+    m.update(_to_jnp(preds), _to_jnp(target))
+    got = m.compute()
+
+    want_p, want_r, want_classes = evaluate_full(
+        [{k: np.asarray(v) for k, v in d.items()} for d in preds],
+        [{k: np.asarray(v) for k, v in d.items()} for d in target],
+    )
+    assert np.asarray(got["classes"]).tolist() == want_classes
+    np.testing.assert_allclose(np.asarray(got["precision"]), want_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["recall"]), want_r, atol=1e-6)
+
+
+@pytest.mark.parametrize("iou_type", ["bbox", "segm"])
+def test_micro_average_vs_numpy_cocoeval(iou_type):
+    """average='micro' == the oracle evaluated with every label collapsed to one class."""
+    from tests._map_oracle import evaluate_full
+
+    rng = np.random.RandomState(9)
+    preds, target = _synth_boxes(rng, n_imgs=30, n_classes=3, crowd_prob=0.2, img_hw=64.0)
+    if iou_type == "segm":
+        h = w = 64
+        for d in preds + target:
+            d["masks"] = (
+                np.stack([_rect_mask(h, w, b) for b in d["boxes"]])
+                if len(d["boxes"])
+                else np.zeros((0, h, w), np.uint8)
+            )
+
+    m = MeanAveragePrecision(iou_type=iou_type, average="micro", extended_summary=True)
+    m.update(_to_jnp(preds), _to_jnp(target))
+    got = m.compute()
+
+    relabel = lambda ds: [{**{k: np.asarray(v) for k, v in d.items()}, "labels": np.zeros_like(np.asarray(d["labels"]))} for d in ds]  # noqa: E731
+    want_p, want_r, _ = evaluate_full(relabel(preds), relabel(target))
+    np.testing.assert_allclose(np.asarray(got["precision"]), want_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["recall"]), want_r, atol=1e-6)
+    valid = want_p[:, :, :, 0, -1]
+    want_map = valid[valid > -1].mean()
+    assert float(got["map"]) == pytest.approx(float(want_map), abs=1e-6)
+
+
 def test_micro_average_and_class_metrics():
     rng = np.random.RandomState(5)
     preds, target = _synth_boxes(rng, n_imgs=25, n_classes=3)
