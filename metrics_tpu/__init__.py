@@ -4,30 +4,13 @@ Capability parity with TorchMetrics (reference at ``/root/reference``, see SURVE
 built from scratch TPU-first: metric state is a pytree, update/compute are pure
 jit-compiled XLA functions, and distributed sync lowers to XLA collectives over a
 ``jax.sharding.Mesh``.
+
+Root namespace parity: every metric class the reference exports from its root
+(``/root/reference/src/torchmetrics/__init__.py``, 106 names) is importable from
+``metrics_tpu`` directly.  Resolution is lazy (PEP 562) so ``import metrics_tpu``
+stays light; subpackages load on first attribute access.
 """
 
-from metrics_tpu import (
-    audio,
-    integration,
-    classification,
-    clustering,
-    detection,
-    functional,
-    image,
-    models,
-    multimodal,
-    nominal,
-    ops,
-    parallel,
-    regression,
-    retrieval,
-    segmentation,
-    shape,
-    text,
-    utils,
-    wrappers,
-)
-from metrics_tpu.integration import MetricLogbook
 from metrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -42,34 +25,134 @@ from metrics_tpu.metric import CompositionalMetric, Metric
 
 __version__ = "0.1.0"
 
-__all__ = [
-    "audio",
-    "CatMetric",
-    "CompositionalMetric",
-    "MaxMetric",
-    "MeanMetric",
-    "Metric",
-    "MetricCollection",
-    "MinMetric",
-    "RunningMean",
-    "RunningSum",
-    "SumMetric",
-    "__version__",
-    "classification",
-    "clustering",
-    "detection",
-    "functional",
-    "image",
-    "models",
-    "multimodal",
-    "nominal",
-    "ops",
-    "parallel",
-    "regression",
-    "retrieval",
-    "segmentation",
-    "shape",
-    "text",
-    "utils",
-    "wrappers",
-]
+# name -> defining module, for every reference root export not imported above
+_LAZY_EXPORTS = {
+    "PermutationInvariantTraining": "metrics_tpu.audio",
+    "ScaleInvariantSignalDistortionRatio": "metrics_tpu.audio",
+    "ScaleInvariantSignalNoiseRatio": "metrics_tpu.audio",
+    "SignalDistortionRatio": "metrics_tpu.audio",
+    "SignalNoiseRatio": "metrics_tpu.audio",
+    "AUROC": "metrics_tpu.classification",
+    "Accuracy": "metrics_tpu.classification",
+    "AveragePrecision": "metrics_tpu.classification",
+    "CalibrationError": "metrics_tpu.classification",
+    "CohenKappa": "metrics_tpu.classification",
+    "ConfusionMatrix": "metrics_tpu.classification",
+    "Dice": "metrics_tpu.classification",
+    "ExactMatch": "metrics_tpu.classification",
+    "F1Score": "metrics_tpu.classification",
+    "FBetaScore": "metrics_tpu.classification",
+    "HammingDistance": "metrics_tpu.classification",
+    "HingeLoss": "metrics_tpu.classification",
+    "JaccardIndex": "metrics_tpu.classification",
+    "LogAUC": "metrics_tpu.classification",
+    "MatthewsCorrCoef": "metrics_tpu.classification",
+    "NegativePredictiveValue": "metrics_tpu.classification",
+    "Precision": "metrics_tpu.classification",
+    "PrecisionAtFixedRecall": "metrics_tpu.classification",
+    "PrecisionRecallCurve": "metrics_tpu.classification",
+    "ROC": "metrics_tpu.classification",
+    "Recall": "metrics_tpu.classification",
+    "RecallAtFixedPrecision": "metrics_tpu.classification",
+    "SensitivityAtSpecificity": "metrics_tpu.classification",
+    "Specificity": "metrics_tpu.classification",
+    "SpecificityAtSensitivity": "metrics_tpu.classification",
+    "StatScores": "metrics_tpu.classification",
+    "MetricLogbook": "metrics_tpu.integration",
+    "ModifiedPanopticQuality": "metrics_tpu.detection",
+    "PanopticQuality": "metrics_tpu.detection",
+    "ErrorRelativeGlobalDimensionlessSynthesis": "metrics_tpu.image",
+    "MultiScaleStructuralSimilarityIndexMeasure": "metrics_tpu.image",
+    "PeakSignalNoiseRatio": "metrics_tpu.image",
+    "RelativeAverageSpectralError": "metrics_tpu.image",
+    "RootMeanSquaredErrorUsingSlidingWindow": "metrics_tpu.image",
+    "SpectralAngleMapper": "metrics_tpu.image",
+    "SpectralDistortionIndex": "metrics_tpu.image",
+    "StructuralSimilarityIndexMeasure": "metrics_tpu.image",
+    "TotalVariation": "metrics_tpu.image",
+    "UniversalImageQualityIndex": "metrics_tpu.image",
+    "CramersV": "metrics_tpu.nominal",
+    "FleissKappa": "metrics_tpu.nominal",
+    "PearsonsContingencyCoefficient": "metrics_tpu.nominal",
+    "TheilsU": "metrics_tpu.nominal",
+    "TschuprowsT": "metrics_tpu.nominal",
+    "ConcordanceCorrCoef": "metrics_tpu.regression",
+    "CosineSimilarity": "metrics_tpu.regression",
+    "CriticalSuccessIndex": "metrics_tpu.regression",
+    "ExplainedVariance": "metrics_tpu.regression",
+    "KLDivergence": "metrics_tpu.regression",
+    "KendallRankCorrCoef": "metrics_tpu.regression",
+    "LogCoshError": "metrics_tpu.regression",
+    "MeanAbsoluteError": "metrics_tpu.regression",
+    "MeanAbsolutePercentageError": "metrics_tpu.regression",
+    "MeanSquaredError": "metrics_tpu.regression",
+    "MeanSquaredLogError": "metrics_tpu.regression",
+    "MinkowskiDistance": "metrics_tpu.regression",
+    "NormalizedRootMeanSquaredError": "metrics_tpu.regression",
+    "PearsonCorrCoef": "metrics_tpu.regression",
+    "R2Score": "metrics_tpu.regression",
+    "RelativeSquaredError": "metrics_tpu.regression",
+    "SpearmanCorrCoef": "metrics_tpu.regression",
+    "SymmetricMeanAbsolutePercentageError": "metrics_tpu.regression",
+    "TweedieDevianceScore": "metrics_tpu.regression",
+    "WeightedMeanAbsolutePercentageError": "metrics_tpu.regression",
+    "RetrievalFallOut": "metrics_tpu.retrieval",
+    "RetrievalHitRate": "metrics_tpu.retrieval",
+    "RetrievalMAP": "metrics_tpu.retrieval",
+    "RetrievalMRR": "metrics_tpu.retrieval",
+    "RetrievalNormalizedDCG": "metrics_tpu.retrieval",
+    "RetrievalPrecision": "metrics_tpu.retrieval",
+    "RetrievalPrecisionRecallCurve": "metrics_tpu.retrieval",
+    "RetrievalRPrecision": "metrics_tpu.retrieval",
+    "RetrievalRecall": "metrics_tpu.retrieval",
+    "RetrievalRecallAtFixedPrecision": "metrics_tpu.retrieval",
+    "BLEUScore": "metrics_tpu.text",
+    "CHRFScore": "metrics_tpu.text",
+    "CharErrorRate": "metrics_tpu.text",
+    "ExtendedEditDistance": "metrics_tpu.text",
+    "MatchErrorRate": "metrics_tpu.text",
+    "Perplexity": "metrics_tpu.text",
+    "SQuAD": "metrics_tpu.text",
+    "SacreBLEUScore": "metrics_tpu.text",
+    "TranslationEditRate": "metrics_tpu.text",
+    "WordErrorRate": "metrics_tpu.text",
+    "WordInfoLost": "metrics_tpu.text",
+    "WordInfoPreserved": "metrics_tpu.text",
+    "BootStrapper": "metrics_tpu.wrappers",
+    "ClasswiseWrapper": "metrics_tpu.wrappers",
+    "MetricTracker": "metrics_tpu.wrappers",
+    "MinMaxMetric": "metrics_tpu.wrappers",
+    "MultioutputWrapper": "metrics_tpu.wrappers",
+    "MultitaskWrapper": "metrics_tpu.wrappers",
+}
+
+_LAZY_SUBPACKAGES = (
+    "audio", "classification", "clustering", "detection", "functional", "image",
+    "integration", "models", "multimodal", "nominal", "ops", "parallel",
+    "regression", "retrieval", "segmentation", "shape", "text", "utils", "wrappers",
+)
+
+
+def __getattr__(name):
+    """Lazily resolve root metric exports and subpackages (PEP 562)."""
+    import importlib
+
+    if name in _LAZY_EXPORTS:
+        value = getattr(importlib.import_module(_LAZY_EXPORTS[name]), name)
+    elif name in _LAZY_SUBPACKAGES:
+        value = importlib.import_module(f"metrics_tpu.{name}")
+    else:
+        raise AttributeError(f"module 'metrics_tpu' has no attribute {name!r}")
+    globals()[name] = value  # cache: subsequent accesses skip __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS) | set(_LAZY_SUBPACKAGES))
+
+
+__all__ = sorted(set(_LAZY_EXPORTS) | set(_LAZY_SUBPACKAGES) | {
+    "CatMetric", "CompositionalMetric", "MaxMetric", "MeanMetric", "Metric",
+    "MetricCollection", "MinMetric", "RunningMean", "RunningSum",
+    "SumMetric", "__version__",
+})

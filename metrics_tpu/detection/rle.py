@@ -55,8 +55,9 @@ def _native():
 def compress_counts(counts: Sequence[int]) -> bytes:
     """Encode run lengths into the COCO compressed string form.
 
-    Each value (delta-coded against the count two positions back, from the third
-    on) is written as little-endian 5-bit groups with a continuation bit, offset
+    Each value (delta-coded against the count two positions back, from index 3
+    on; the first three counts are absolute) is written as little-endian 5-bit
+    groups with a continuation bit, offset
     into printable ASCII by 48. Byte-level loop runs in the native codec when
     available (``metrics_tpu/native/rle_codec.cpp``), pure Python otherwise.
     """

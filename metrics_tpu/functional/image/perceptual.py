@@ -39,9 +39,15 @@ def _validate_lpips_images(img1: Array, img2: Array, normalize: bool) -> None:
     """Reference ``_valid_img`` contract (``functional/image/lpips.py:374-397``):
     (N, 3, H, W) inputs in [0, 1] when ``normalize`` else [-1, 1]."""
 
+    import jax
+
     def ok(img: Array) -> bool:
         if img.ndim != 4 or img.shape[1] != 3:
             return False
+        if isinstance(img, jax.core.Tracer):
+            # under jit the values are abstract — shape checks still apply,
+            # range checks would force a host sync / ConcretizationTypeError
+            return True
         lo, hi = float(img.min()), float(img.max())
         return (hi <= 1.0 and lo >= 0.0) if normalize else lo >= -1.0
 

@@ -13,19 +13,44 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.functional.text.helper import _ngram_counts, _tokenize_13a, _tokenize_chars, _tokenize_words
+from metrics_tpu.functional.text.helper import (
+    _ngram_counts,
+    _tokenize_13a,
+    _tokenize_chars,
+    _tokenize_international,
+    _tokenize_words,
+    _tokenize_zh,
+)
+
+
+_GATED_TOKENIZERS = {
+    "ja-mecab": "MeCab + ipadic",
+    "ko-mecab": "MeCab + mecab-ko-dic",
+    "flores101": "sentencepiece + the flores101 model download",
+    "flores200": "sentencepiece + the flores200 model download",
+}
+
+_ALL_TOKENIZERS = ("none", "13a", "zh", "intl", "char", "ja-mecab", "ko-mecab", "flores101", "flores200")
 
 
 def _get_tokenizer(tokenize: str):
+    """Resolve a sacrebleu tokenizer name (reference ``sacre_bleu.py`` ``_TOKENIZE_FN``)."""
     if tokenize == "13a":
         return _tokenize_13a
     if tokenize == "char":
         return _tokenize_chars
     if tokenize == "none":
         return _tokenize_words
-    if tokenize == "intl":  # approximation: 13a covers the latin-script behaviour
-        return _tokenize_13a
-    raise ValueError(f"Unsupported tokenizer selected. Please, choose one of ('none', '13a', 'intl', 'char')")
+    if tokenize == "intl":
+        return _tokenize_international
+    if tokenize == "zh":
+        return _tokenize_zh
+    if tokenize in _GATED_TOKENIZERS:
+        raise ModuleNotFoundError(
+            f"Tokenizer '{tokenize}' requires {_GATED_TOKENIZERS[tokenize]}, which is not available"
+            " in this offline build."
+        )
+    raise ValueError(f"Unsupported tokenizer selected. Please, choose one of {_ALL_TOKENIZERS}")
 
 
 def _bleu_score_update(

@@ -95,6 +95,75 @@ def _tokenize_13a(line: str) -> List[str]:
     return line.split()
 
 
+# CJK/fullwidth/symbol ranges from the sacrebleu zh tokenizer (reference
+# ``sacre_bleu.py:64-88``).  The two astral entries are copied verbatim,
+# including the reference's quirk that "\\u20000" parses as "\\u2000"+"0" —
+# bug-compatibility matters more than typographic correctness here.
+_UCODE_RANGES = (
+    ("\u3400", "\u4db5"),
+    ("\u4e00", "\u9fa5"),
+    ("\u9fa6", "\u9fbb"),
+    ("\uf900", "\ufa2d"),
+    ("\ufa30", "\ufa6a"),
+    ("\ufa70", "\ufad9"),
+    ("\u20000", "\u2a6d6"),
+    ("\u2f800", "\u2fa1d"),
+    ("\uff00", "\uffef"),
+    ("\u2e80", "\u2eff"),
+    ("\u3000", "\u303f"),
+    ("\u31c0", "\u31ef"),
+    ("\u2f00", "\u2fdf"),
+    ("\u2ff0", "\u2fff"),
+    ("\u3100", "\u312f"),
+    ("\u31a0", "\u31bf"),
+    ("\ufe10", "\ufe1f"),
+    ("\ufe30", "\ufe4f"),
+    ("\u2600", "\u26ff"),
+    ("\u2700", "\u27bf"),
+    ("\u3200", "\u32ff"),
+    ("\u3300", "\u33ff"),
+)
+
+
+def _is_chinese_char(uchar: str) -> bool:
+    return any(start <= uchar <= end for start, end in _UCODE_RANGES)
+
+
+def _tokenize_zh(line: str) -> List[str]:
+    """sacrebleu ``zh``: space out every CJK character, then the mteval regex part
+    (reference ``sacre_bleu.py`` ``_tokenize_zh``)."""
+    line = line.strip()
+    pieces = []
+    for char in line:
+        pieces.append(f" {char} " if _is_chinese_char(char) else char)
+    line = "".join(pieces)
+    for pat, rep in _13A_TOK:
+        line = pat.sub(rep, line)
+    return line.split()
+
+
+_INT_PATTERNS: List = []
+
+
+def _tokenize_international(line: str) -> List[str]:
+    r"""mteval-v14 international tokenization (reference ``_tokenize_international``):
+    split on unicode punctuation (``\p{P}``) unless between digits, and on every
+    unicode symbol (``\p{S}``)."""
+    if not _INT_PATTERNS:
+        import regex  # third-party unicode-property regex, same dep as the reference
+
+        _INT_PATTERNS.extend(
+            (
+                (regex.compile(r"(\P{N})(\p{P})"), r"\1 \2 "),
+                (regex.compile(r"(\p{P})(\P{N})"), r" \1 \2"),
+                (regex.compile(r"(\p{S})"), r" \1 "),
+            )
+        )
+    for pat, rep in _INT_PATTERNS:
+        line = pat.sub(rep, line)
+    return line.split()
+
+
 def _ngram_counts(tokens: Sequence, max_n: int) -> Counter:
     """Counter over n-grams of order 1..max_n (reference ``bleu.py`` ``_count_ngram``)."""
     counts: Counter = Counter()

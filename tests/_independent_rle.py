@@ -2,8 +2,9 @@
 
 Written directly from the published COCO mask specification (column-major runs
 alternating background/foreground; string form = per-count delta against the
-count two back from the third element on, emitted as little-endian 5-bit groups
-with a continuation bit at 0x20, sign bit at 0x10, offset by ASCII 48).
+count two back, applied from index 3 on — the first three counts are absolute —
+emitted as little-endian 5-bit groups with a continuation bit at 0x20, sign bit
+at 0x10, offset by ASCII 48).
 
 Deliberately shares NO code with ``metrics_tpu.detection.rle`` — this module is
 what makes the segm-MAP oracle independent of the code under test (round-2

@@ -1,0 +1,48 @@
+"""Top-level namespace parity with the reference root (round-2 VERDICT missing #3).
+
+``from torchmetrics import X`` working implies ``from metrics_tpu import X``
+works for the same 106 root names (``/root/reference/src/torchmetrics/__init__.py``).
+"""
+
+import re
+
+import pytest
+
+import metrics_tpu
+
+_REF_INIT = "/root/reference/src/torchmetrics/__init__.py"
+
+
+def _ref_root_names():
+    try:
+        src = open(_REF_INIT).read()
+    except OSError:
+        pytest.skip("reference checkout not available")
+    return re.findall(r'"([^"]+)"', re.search(r"__all__ = \[(.*?)\]", src, re.S).group(1))
+
+
+def test_every_reference_root_export_resolves():
+    names = _ref_root_names()
+    assert len(names) >= 106
+    for name in names:
+        obj = getattr(metrics_tpu, name)  # AttributeError = parity break
+        assert obj is not None, name
+
+
+def test_reference_root_names_are_subset_of_our_all():
+    missing = set(_ref_root_names()) - set(metrics_tpu.__all__)
+    assert not missing, f"reference root exports absent from metrics_tpu.__all__: {sorted(missing)}"
+
+
+def test_lazy_exports_are_metric_classes():
+    from metrics_tpu.metric import Metric
+
+    for name in ("Accuracy", "SignalNoiseRatio", "RetrievalMAP", "BLEUScore", "PanopticQuality"):
+        cls = getattr(metrics_tpu, name)
+        assert isinstance(cls, type) and issubclass(cls, Metric), name
+
+
+def test_dir_covers_all_and_unknown_attribute_raises():
+    assert set(metrics_tpu.__all__) <= set(dir(metrics_tpu))
+    with pytest.raises(AttributeError, match="Bogus"):
+        metrics_tpu.Bogus

@@ -38,6 +38,64 @@ def test_bleu(n_gram, smooth):
     assert_close(got, ref, rtol=1e-4, atol=1e-5, label="bleu")
 
 
+MIXED_SCRIPT_PREDS = [
+    "我喜欢 apples, 真的很喜欢!",
+    "Das Café kostet 1,000.5 ¥ — «wirklich»?",
+    "日本語のテスト文です。punctuation...mixed",
+    "£100 plus ₹2-3 (approx.) ☃",
+]
+MIXED_SCRIPT_TARGETS = [
+    ["我喜欢 apples, 非常喜欢!", "我爱 apples!"],
+    ["Das Café kostet 1,000.50 ¥ «wirklich»"],
+    ["日本語のテスト文です。punctuation mixed"],
+    ["£100 plus ₹2-3 approx ☃"],
+]
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "intl", "zh", "char", "none"])
+def test_sacre_bleu_tokenizer_parity_per_line(tokenize):
+    """Token-level parity with the reference's _SacreBLEUTokenizer on mixed scripts."""
+    reference()
+    from torchmetrics.functional.text.sacre_bleu import _SacreBLEUTokenizer
+
+    from metrics_tpu.functional.text.bleu import _get_tokenizer
+
+    ours = _get_tokenizer(tokenize)
+    lines = MIXED_SCRIPT_PREDS + [t for refs in MIXED_SCRIPT_TARGETS for t in refs] + [
+        "ends with a year 1999.",
+        "a—dash and an ellipsis… plus ±5%",
+        "  leading/trailing  whitespace  ",
+        "«1,000.5» ¥3 ①②③",
+        "",
+    ]
+    for line in lines:
+        want = _SacreBLEUTokenizer.tokenize(line, tokenize)
+        assert ours(line) == want, (tokenize, line)
+
+
+@pytest.mark.parametrize("tokenize", ["intl", "zh"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_mixed_script_corpus(tokenize, lowercase):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.sacre_bleu_score(
+        MIXED_SCRIPT_PREDS, MIXED_SCRIPT_TARGETS, tokenize=tokenize, lowercase=lowercase
+    )
+    got = ours.sacre_bleu_score(MIXED_SCRIPT_PREDS, MIXED_SCRIPT_TARGETS, tokenize=tokenize, lowercase=lowercase)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"sacrebleu-{tokenize}")
+
+
+def test_sacre_bleu_gated_tokenizers_error_clearly():
+    from metrics_tpu.functional.text.bleu import _get_tokenizer
+
+    for name in ("ja-mecab", "ko-mecab", "flores101", "flores200"):
+        with pytest.raises(ModuleNotFoundError, match=name):
+            _get_tokenizer(name)
+    with pytest.raises(ValueError, match="Unsupported tokenizer"):
+        _get_tokenizer("klingon")
+
+
 @pytest.mark.parametrize("tokenize", ["13a", "none", "char"])
 @pytest.mark.parametrize("lowercase", [False, True])
 def test_sacre_bleu(tokenize, lowercase):
