@@ -50,10 +50,23 @@ class CollectionFunctions:
     ``jax.jit`` / ``lax.scan`` / ``shard_map`` like any other pytree program.
     """
 
-    def __init__(self, init, update, compute):
+    def __init__(self, init, update, compute, reductions=None):
         self.init = init
         self.update = update
         self.compute = compute
+        #: per-leader ``{state_name: dist_reduce_fx}`` dicts, for cross-mesh sync
+        self.reductions = reductions or {}
+
+    def sync(self, state, axis_name):
+        """Reduce every leader's state across a mesh axis — call INSIDE ``shard_map``.
+
+        Collection-scope analog of :func:`metrics_tpu.parallel.sync_states`: each
+        leader state syncs with its own per-state ``dist_reduce_fx``, so one call
+        reduces the whole collection in the same compiled program.
+        """
+        from metrics_tpu.parallel.sync import sync_states
+
+        return {n: sync_states(st, self.reductions[n], axis_name) for n, st in state.items()}
 
 
 class MetricCollection:
@@ -445,7 +458,12 @@ class MetricCollection:
             result = {n: member_fns[n].compute(state[leader_of[n]]) for n in names}
             return self._flatten_results(result)
 
-        return CollectionFunctions(init=init, update=update, compute=compute)
+        return CollectionFunctions(
+            init=init,
+            update=update,
+            compute=compute,
+            reductions={n: lead_fns[n].reductions for n in leaders},
+        )
 
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Run compute/forward per metric and flatten outputs (reference ``collections.py:349-394``)."""

@@ -64,7 +64,10 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
     var_y = var_y / (nb - 1)
     corr_xy = corr_xy / (nb - 1)
     bound = math.sqrt(jnp.finfo(jnp.float32).eps)
-    if bool((var_x < bound).any()) or bool((var_y < bound).any()):
+    import jax
+
+    concrete = not isinstance(var_x, jax.core.Tracer) and not isinstance(var_y, jax.core.Tracer)
+    if concrete and (bool((var_x < bound).any()) or bool((var_y < bound).any())):
         rank_zero_warn(
             "The variance of predictions or target is close to zero. This can cause instability in Pearson correlation"
             " coefficient, leading to wrong results.",

@@ -7,10 +7,12 @@ package holds the hand-written kernels for the cases worth owning the schedule:
 * ``ssim_window`` — the SSIM separable gaussian-window pass (SURVEY P8): both
   1-D tap loops fused over a VMEM-resident plane; auto-selected on real TPU
   backends (``METRICS_TPU_SSIM_KERNEL`` overrides).
-* ``ssim_epilogue`` — the fused SSIM elementwise tail (``ssim_map``).
+
+The SSIM elementwise tail deliberately stays as jnp ops in
+``functional/image/ssim.py`` — XLA fuses it with the following mean-reduce,
+which a standalone kernel would prevent.
 """
 
-from metrics_tpu.ops.ssim_epilogue import ssim_map_pallas
 from metrics_tpu.ops.ssim_window import ssim_window_pallas, use_pallas_window
 
-__all__ = ["ssim_map_pallas", "ssim_window_pallas", "use_pallas_window"]
+__all__ = ["ssim_window_pallas", "use_pallas_window"]

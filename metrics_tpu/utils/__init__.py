@@ -3,7 +3,7 @@
 Parity with reference ``torchmetrics/utilities/`` (SURVEY §2.3).
 """
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _check_same_shape, check_forward_full_state_property
 from metrics_tpu.utils.compute import _safe_divide, _safe_xlogy, auc, interp
 from metrics_tpu.utils.data import (
     bincount,
@@ -27,6 +27,7 @@ __all__ = [
     "_safe_xlogy",
     "auc",
     "bincount",
+    "check_forward_full_state_property",
     "dim_zero_cat",
     "dim_zero_max",
     "dim_zero_mean",

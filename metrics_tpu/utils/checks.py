@@ -72,3 +72,92 @@ def _check_data_range(x: Array, lower: float, upper: float, name: str) -> None:
         return
     if x.size and bool((jnp.min(x) < lower) | (jnp.max(x) > upper)):
         raise ValueError(f"Expected `{name}` to be in range [{lower}, {upper}].")
+
+
+def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
+    """Recursively check two metric results for closeness (reference ``checks.py:620-632``)."""
+    import numpy as np
+
+    if isinstance(res1, str):
+        return res1 == res2
+    if isinstance(res1, dict):
+        return set(res1) == set(res2) and all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    if isinstance(res1, (list, tuple)):
+        return len(res1) == len(res2) and all(_allclose_recursive(a, b, atol) for a, b in zip(res1, res2))
+    if isinstance(res1, (jnp.ndarray, np.ndarray, int, float, bool)):
+        return bool(jnp.allclose(jnp.asarray(res1), jnp.asarray(res2), atol=atol))
+    return res1 == res2
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare=(10, 100, 1000),
+    reps: int = 5,
+) -> bool:
+    """Empirically validate whether ``full_state_update=False`` is safe for a metric.
+
+    Parity with reference ``utilities/checks.py:635-737``: runs the metric's
+    ``forward`` both ways — the two-update full-state path and the single-update
+    reduce-state path — over identical inputs, compares every batch value and the
+    final ``compute``, and (when both agree) times the two variants. Returns
+    ``True`` when ``full_state_update=False`` is both correct and not slower
+    (the reference prints a recommendation; here the recommendation is also the
+    return value so tests can assert on it).
+
+    TPU note: both paths run eagerly through the shared jit-cached update, so the
+    timing comparison reflects the number of compiled-update launches per
+    ``forward`` (2 for full, 1+merge for reduce), which is the quantity that
+    matters on an accelerator with nontrivial dispatch latency.
+    """
+    from time import perf_counter
+
+    import jax
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class _FullState(metric_class):
+        full_state_update = True
+
+    class _PartState(metric_class):
+        full_state_update = False
+
+    fullstate = _FullState(**init_args)
+    partstate = _PartState(**init_args)
+
+    equal = True
+    try:  # a failure here means update depends on the accumulated global state
+        for _ in range(num_update_to_compare[0]):
+            equal = equal and _allclose_recursive(fullstate(**input_args), partstate(**input_args))
+        res1 = fullstate.compute()
+        res2 = partstate.compute()
+        equal = equal and _allclose_recursive(res1, res2)
+    except (RuntimeError, ValueError, TypeError):
+        equal = False
+
+    if not equal:
+        print("Recommended setting `full_state_update=True`")
+        return False
+
+    timings = [[0.0] * len(num_update_to_compare) for _ in range(2)]
+    for i, metric in enumerate((fullstate, partstate)):
+        for j, steps in enumerate(num_update_to_compare):
+            best = float("inf")
+            for _ in range(reps):
+                metric.reset()
+                start = perf_counter()
+                for _ in range(steps):
+                    out = metric(**input_args)
+                jax.block_until_ready(out)
+                best = min(best, perf_counter() - start)
+            timings[i][j] = best
+
+    for j, steps in enumerate(num_update_to_compare):
+        print(f"Full state for {steps} steps took: {timings[0][j]:0.4f}s")
+        print(f"Partial state for {steps} steps took: {timings[1][j]:0.4f}s")
+
+    faster = timings[1][-1] < timings[0][-1]
+    print(f"Recommended setting `full_state_update={not faster}`")
+    return faster

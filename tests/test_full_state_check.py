@@ -1,0 +1,73 @@
+"""check_forward_full_state_property — empirical full_state_update validation.
+
+Mirrors the reference util's two documented scenarios
+(``/root/reference/src/torchmetrics/utilities/checks.py:635-737``): a metric whose
+update is state-independent (flag can be False) and one whose update branches on
+the accumulated state (flag must stay True).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import MulticlassConfusionMatrix
+from metrics_tpu.utils.checks import _allclose_recursive, check_forward_full_state_property
+
+
+def _inputs():
+    rng = np.random.RandomState(7)
+    return {
+        "preds": jnp.asarray(rng.randint(0, 3, 100)),
+        "target": jnp.asarray(rng.randint(0, 3, 100)),
+    }
+
+
+def test_independent_states_paths_agree(capsys):
+    """Both forward paths agree for a state-independent update → recommendation printed."""
+    check_forward_full_state_property(
+        MulticlassConfusionMatrix,
+        init_args={"num_classes": 3, "validate_args": False},
+        input_args=_inputs(),
+        num_update_to_compare=(4, 8),
+        reps=1,
+    )
+    out = capsys.readouterr().out
+    assert "Recommended setting `full_state_update=" in out
+    # correctness phase passed: both batch values and computes matched, so the
+    # timing phase ran and printed per-step-count lines
+    assert "Full state for 4 steps took" in out
+
+
+def test_state_dependent_update_recommends_true(capsys):
+    class ResettingConfusionMatrix(MulticlassConfusionMatrix):
+        def update(self, preds, target):
+            super().update(preds, target)
+            # future states depend on prior states (reference doc example)
+            if float(self.confmat.sum()) > 20:
+                self.reset()
+
+    result = check_forward_full_state_property(
+        ResettingConfusionMatrix,
+        init_args={"num_classes": 3, "validate_args": False},
+        input_args={"preds": jnp.asarray(np.arange(10) % 3), "target": jnp.asarray((np.arange(10) + 1) % 3)},
+        num_update_to_compare=(10, 20),
+        reps=1,
+    )
+    assert result is False
+    assert "Recommended setting `full_state_update=True`" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize(
+    ("a", "b", "want"),
+    [
+        (jnp.ones(3), jnp.ones(3), True),
+        (jnp.ones(3), jnp.zeros(3), False),
+        ({"x": jnp.ones(2), "y": "s"}, {"x": jnp.ones(2), "y": "s"}, True),
+        ({"x": jnp.ones(2)}, {"y": jnp.ones(2)}, False),
+        ([jnp.ones(2), 1.0], [jnp.ones(2), 1.0], True),
+        ([jnp.ones(2)], [jnp.ones(2), jnp.ones(2)], False),
+        ("abc", "abc", True),
+    ],
+)
+def test_allclose_recursive(a, b, want):
+    assert _allclose_recursive(a, b) is want

@@ -5,31 +5,16 @@ import numpy as np
 import pytest
 
 from metrics_tpu.models import SimpleFeatureCNN, load_feature_extractor
-from metrics_tpu.ops import ssim_map_pallas
 
 _rng = np.random.RandomState(5)
 
 
-def test_ssim_epilogue_pallas_matches_jnp():
-    stats = [jnp.asarray(_rng.rand(2, 3, 16, 32).astype(np.float32)) for _ in range(5)]
-    c1, c2 = 0.01, 0.03
-    out = ssim_map_pallas(*stats, c1=c1, c2=c2, interpret=True)
-    mu_p, mu_t, s_pp, s_tt, s_pt = stats
-    mu_p_sq, mu_t_sq, mu_pt = mu_p**2, mu_t**2, mu_p * mu_t
-    upper = 2 * (s_pt - mu_pt) + c2
-    lower = jnp.clip(s_pp - mu_p_sq, 0, None) + jnp.clip(s_tt - mu_t_sq, 0, None) + c2
-    ref = ((2 * mu_pt + c1) * upper) / ((mu_p_sq + mu_t_sq + c1) * lower)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
-
-
-def test_ssim_epilogue_matches_ssim_update_tail():
-    """The kernel output must equal the SSIM map the metric itself computes."""
+def test_ssim_full_image_consistent_with_per_image_mean():
     from metrics_tpu.functional.image.ssim import _ssim_update
 
     preds = jnp.asarray(_rng.rand(1, 1, 24, 24).astype(np.float32))
     target = jnp.asarray(_rng.rand(1, 1, 24, 24).astype(np.float32))
     per_img, full = _ssim_update(preds, target, data_range=1.0, return_full_image=True)
-    # recompute the epilogue from the conv stats path by reusing internal machinery
     assert full.shape == preds.shape
     np.testing.assert_allclose(float(per_img[0]), float(full.mean()), rtol=1e-5)
 
