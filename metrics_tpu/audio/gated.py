@@ -155,37 +155,78 @@ def _local_model_path(filename: str, what: str) -> str:
     return path
 
 
-def _log_power_mel(audio: np.ndarray, sr: int, n_mels: int = 120, frame_size: int = 320, hop: int = 160) -> np.ndarray:
-    """Host-side log-power mel spectrogram (the DNSMOS input featurization)."""
-    n_fft = frame_size + 1
-    window = np.hanning(n_fft)
-    if len(audio) < n_fft:  # zero-pad very short input to one full frame
-        audio = np.pad(audio, (0, n_fft - len(audio)))
-    n_frames = 1 + (len(audio) - n_fft) // hop
-    frames = np.stack([audio[i * hop : i * hop + n_fft] * window for i in range(max(n_frames, 1))])
-    spec = np.abs(np.fft.rfft(frames, axis=-1)) ** 2
-    # triangular mel filterbank
-    def hz_to_mel(f):
-        return 2595.0 * np.log10(1.0 + f / 700.0)
+def _dnsmos_melspec(audio: np.ndarray, sr: int) -> np.ndarray:
+    """DNSMOS P.808 input featurization, shape ``(n_frames, 120)``.
 
-    def mel_to_hz(m):
-        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    Librosa-exact port of the reference ``_audio_melspec``
+    (``functional/audio/dnsmos.py:121-153``): ``melspectrogram(n_fft=321,
+    hop=160, n_mels=120, power=2)`` with a centered zero-padded STFT (the
+    librosa ≥0.10 default, which the reference's ``librosa <0.11`` pin hits)
+    and the Slaney filterbank, then ``(power_to_db(ref=max) + 40) / 40``. For
+    the standard 9.01 s hop trimmed by 160 samples this yields the ``(900,
+    120)`` frame grid ``model_v8.onnx`` was exported for.
+    """
+    from metrics_tpu.functional.audio.melspec import melspectrogram, power_to_db
 
-    mel_pts = mel_to_hz(np.linspace(hz_to_mel(0), hz_to_mel(sr / 2), n_mels + 2))
-    bins = np.floor((n_fft + 1) * mel_pts / sr).astype(int)
-    fb = np.zeros((n_mels, spec.shape[-1]))
-    for m in range(1, n_mels + 1):
-        lo, ce, hi = bins[m - 1], bins[m], bins[m + 1]
-        for k in range(lo, ce):
-            if ce > lo:
-                fb[m - 1, k] = (k - lo) / (ce - lo)
-        for k in range(ce, hi):
-            if hi > ce:
-                fb[m - 1, k] = (hi - k) / (hi - ce)
-    mel = spec @ fb.T
-    ref = max(mel.max(), 1e-20)
-    db = 10.0 * np.log10(np.maximum(mel, 1e-20) / ref)
+    mel = melspectrogram(
+        audio, sr, n_fft=321, hop_length=160, n_mels=120, power=2.0, pad_mode="constant"
+    ).T  # (T', 120)
+    db = power_to_db(mel, ref=float(mel.max()))
     return ((db + 40.0) / 40.0).astype(np.float32)
+
+
+# Published NISQA v2.0 featurization constants (the reference reads the same
+# values out of its downloaded checkpoint's ``args`` dict, ``nisqa.py:135``).
+_NISQA_ARGS = {
+    "ms_n_fft": 4096,
+    "ms_hop_length": 0.01,  # seconds
+    "ms_win_length": 0.02,  # seconds
+    "ms_n_mels": 48,
+    "ms_fmax": 20000.0,
+    "ms_seg_length": 15,
+    "ms_seg_hop_length": 1,
+    "ms_max_segments": 1300,
+}
+
+
+def _nisqa_features(audio: np.ndarray, sr: int, args: dict = _NISQA_ARGS) -> tuple:
+    """NISQA input featurization: segmented mel windows + window count.
+
+    Librosa-exact port of the reference ``_get_librosa_melspec`` + ``_segment_specs``
+    (``functional/audio/nisqa.py:322-391``): magnitude (power=1) melspectrogram at
+    ``n_fft=4096``, 10 ms hop / 20 ms window, 48 Slaney mels to 20 kHz,
+    ``amplitude_to_db(ref=1, amin=1e-4, top_db=80)``; then every ``seg_length=15``-frame
+    window at ``seg_hop`` stride, zero-padded to ``max_segments=1300``.
+
+    Returns ``(segments, n_wins)`` with ``segments`` of shape
+    ``(1, max_segments, n_mels, seg_length)`` float32 and ``n_wins`` the number of
+    valid windows — the two inputs the onnx export of the published NISQA model
+    takes (outputs: ``(1, 5)`` = [mos, noi, dis, col, loud]).
+    """
+    from metrics_tpu.functional.audio.melspec import amplitude_to_db, melspectrogram
+
+    hop = int(sr * args["ms_hop_length"])
+    win = int(sr * args["ms_win_length"])
+    mel = melspectrogram(
+        audio, sr, n_fft=args["ms_n_fft"], hop_length=hop, win_length=win,
+        n_mels=args["ms_n_mels"], fmax=args["ms_fmax"], power=1.0,
+        pad_mode="reflect",  # NISQA passes pad_mode explicitly (``nisqa.py:349``)
+    )
+    spec = amplitude_to_db(mel, ref=1.0, amin=1e-4, top_db=80.0).astype(np.float32)  # (n_mels, T)
+    seg_length = args["ms_seg_length"]
+    seg_hop = args["ms_seg_hop_length"]
+    max_length = args["ms_max_segments"]
+    n_wins = spec.shape[1] - (seg_length - 1)
+    if n_wins < 1:
+        raise RuntimeError("Input signal is too short.")
+    idx = np.arange(seg_length)[None, :] + np.arange(n_wins)[:, None]
+    segments = spec.T[idx].transpose(0, 2, 1)[::seg_hop]  # (n_wins', n_mels, seg_length)
+    n_wins = -(-n_wins // seg_hop)
+    if max_length < n_wins:
+        raise RuntimeError("Maximum number of mel spectrogram windows exceeded. Use shorter audio.")
+    padded = np.zeros((1, max_length, spec.shape[0], seg_length), dtype=np.float32)
+    padded[0, :n_wins] = segments
+    return padded, n_wins
 
 
 def _resample(audio: np.ndarray, sr_in: int, sr_out: int) -> np.ndarray:
@@ -269,7 +310,7 @@ class DeepNoiseSuppressionMeanOpinionScore(Metric):
         hop_scores = []
         for idx in range(max(num_hops, 1)):
             seg = audio[int(idx * self._FS) : int((idx + self._INPUT_LEN_S) * self._FS)].astype(np.float32)
-            mel = _log_power_mel(seg[:-160], self._FS)[None].astype(np.float32)
+            mel = _dnsmos_melspec(seg[:-160], self._FS)[None].astype(np.float32)
             p808 = float(sess_808.run(None, {sess_808.get_inputs()[0].name: mel})[0].reshape(-1)[0])
             raw = sess_835.run(None, {sess_835.get_inputs()[0].name: seg[None]})[0].reshape(-1)
             sig, bak, ovr = (float(np.polyval(polys[k], v)) for k, v in zip(("sig", "bak", "ovr"), raw[:3]))
@@ -288,13 +329,20 @@ class DeepNoiseSuppressionMeanOpinionScore(Metric):
         return (self.sum_dnsmos / jnp.maximum(self.total, 1)).astype(jnp.float32)
 
 
-class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
+class NonIntrusiveSpeechQualityAssessment(Metric):
     """NISQA via a pretrained onnx export of the published model (reference ``audio/nisqa.py:30``).
 
-    Host-side: 48 kHz mel segments → local ``nisqa.onnx`` session → 5 MOS
-    dimensions; the overall MOS is accumulated. Model file resolved from
-    ``METRICS_TPU_WEIGHTS`` (zero-egress build).
+    Host-side: 48 kHz mel segments → local ``nisqa.onnx`` session → the 5 MOS
+    dimensions ``[mos, noisiness, discontinuity, coloration, loudness]``, all
+    accumulated (reference ``audio/nisqa.py:99-115``); ``compute`` returns the
+    averaged 5-vector. Model file resolved from ``METRICS_TPU_WEIGHTS``
+    (zero-egress build).
     """
+
+    __jit_ineligible__ = True
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
 
     def __init__(self, fs: int, **kwargs: Any) -> None:
         if not _ONNXRUNTIME_AVAILABLE:
@@ -307,6 +355,8 @@ class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
             raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")
         self.fs = fs
         self._session = None
+        self.add_state("sum_nisqa", jnp.zeros(5), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
 
     _FS = 48000  # the published model's native rate; 20 ms / 10 ms framing below
 
@@ -318,10 +368,19 @@ class NonIntrusiveSpeechQualityAssessment(_HostAudioMetric):
             self._session = ort.InferenceSession(
                 _local_model_path("nisqa.onnx", "NISQA"), providers=["CPUExecutionProvider"]
             )
+        inputs = self._session.get_inputs()
+        has_n_wins_input = len(inputs) > 1  # exports carrying the explicit window-count input
         flat = np.asarray(preds, dtype=np.float32).reshape(-1, np.asarray(preds).shape[-1])
         for wav in flat:
             wav48 = _resample(wav, self.fs, self._FS)
-            feats = _log_power_mel(wav48, self._FS, n_mels=48, frame_size=960, hop=480)[None]
-            out = self._session.run(None, {self._session.get_inputs()[0].name: feats})[0].reshape(-1)
-            self.sum_value = self.sum_value + float(out[0])
+            segments, n_wins = _nisqa_features(wav48, self._FS)
+            feed = {inputs[0].name: segments}
+            if has_n_wins_input:
+                feed[inputs[1].name] = np.asarray([n_wins], dtype=np.int64)
+            out = self._session.run(None, feed)[0].reshape(-1)
+            self.sum_nisqa = self.sum_nisqa + jnp.asarray(out[:5], dtype=jnp.float32)
             self.total = self.total + 1
+
+    def compute(self) -> Array:
+        """Average ``[mos, noi, dis, col, loud]`` over all waveforms (reference ``nisqa.py:113-115``)."""
+        return (self.sum_nisqa / jnp.maximum(self.total, 1)).astype(jnp.float32)

@@ -1,0 +1,182 @@
+"""Validation of the librosa-exact DNSMOS/NISQA featurization (round-2 VERDICT #8).
+
+The pretrained scorers consume ``librosa.feature.melspectrogram`` features;
+librosa itself is absent in this image, so correctness is established three
+independent ways:
+
+1. ``_independent_melspec`` below — a from-the-published-formulas reimplementation
+   (per-filter loops, explicit per-frame DFT) sharing NO code with the production
+   module, mirroring the ``tests/_independent_rle.py`` strategy.
+2. scipy cross-checks where scipy implements the same primitive (the periodic
+   Hann window).
+3. Closed-form golden values of the Slaney mel scale and dB conversions.
+"""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.audio.melspec import (
+    amplitude_to_db,
+    hann_periodic,
+    mel_filterbank,
+    mel_frequencies,
+    melspectrogram,
+    power_to_db,
+    stft_power,
+)
+
+_rng = np.random.RandomState(11)
+
+
+# ---------------------------------------------------------------- independent oracle
+def _ind_hz_to_mel(f):
+    # Slaney scale, published definition: linear below 1 kHz, log above
+    if f < 1000.0:
+        return f * 3.0 / 200.0
+    return 15.0 + 27.0 * np.log(f / 1000.0) / np.log(6.4)
+
+
+def _ind_mel_to_hz(m):
+    if m < 15.0:
+        return m * 200.0 / 3.0
+    return 1000.0 * 6.4 ** ((m - 15.0) / 27.0)
+
+
+def _ind_filterbank(sr, n_fft, n_mels, fmin=0.0, fmax=None):
+    fmax = sr / 2.0 if fmax is None else fmax
+    pts = [_ind_mel_to_hz(m) for m in np.linspace(_ind_hz_to_mel(fmin), _ind_hz_to_mel(fmax), n_mels + 2)]
+    n_bins = 1 + n_fft // 2
+    fb = np.zeros((n_mels, n_bins))
+    for i in range(n_mels):
+        lo, ce, hi = pts[i], pts[i + 1], pts[i + 2]
+        for k in range(n_bins):
+            f = k * sr / n_fft
+            if lo < f < ce:
+                fb[i, k] = (f - lo) / (ce - lo)
+            elif f == ce:
+                fb[i, k] = 1.0
+            elif ce < f < hi:
+                fb[i, k] = (hi - f) / (hi - ce)
+        fb[i] *= 2.0 / (hi - lo)  # slaney area normalization
+    return fb
+
+
+def _ind_melspec(y, sr, n_fft, hop, win, n_mels, fmax, power, pad_mode):
+    # centered STFT, frame by frame, straight from the definitions
+    y = np.pad(np.asarray(y, float), (n_fft // 2, n_fft // 2), mode=pad_mode)
+    w = np.array([0.5 - 0.5 * np.cos(2 * np.pi * n / win) for n in range(win)])
+    lpad = (n_fft - win) // 2
+    w = np.concatenate([np.zeros(lpad), w, np.zeros(n_fft - win - lpad)])
+    frames = []
+    t = 0
+    while t + n_fft <= len(y):
+        seg = y[t : t + n_fft] * w
+        frames.append(np.abs(np.fft.rfft(seg)) ** power)
+        t += hop
+    spec = np.stack(frames, axis=1)  # (n_freq, T)
+    return _ind_filterbank(sr, n_fft, n_mels, 0.0, fmax) @ spec
+
+
+# ---------------------------------------------------------------- closed-form goldens
+def test_slaney_mel_scale_golden_points():
+    # linear region: 200/3 Hz per mel
+    assert mel_frequencies(3, 0.0, 1000.0) == pytest.approx([0.0, 500.0, 1000.0])
+    # the 1 kHz knee sits exactly at mel 15; one log step above is 1000*6.4^(1/27)
+    np.testing.assert_allclose(mel_frequencies(2, 0.0, 1000.0)[1], 1000.0)
+    f = mel_frequencies(17, 0.0, float(1000.0 * 6.4 ** (1.0 / 27.0)))
+    np.testing.assert_allclose(f[-2], 1000.0, rtol=1e-9)
+
+
+def test_power_to_db_golden():
+    s = np.array([1.0, 0.1, 1e-12])
+    # ref=1: 0 dB, -10 dB, then amin clamps 1e-12→1e-10 = -100 dB, then top_db=80 clamps to -80
+    np.testing.assert_allclose(power_to_db(s, ref=1.0), [0.0, -10.0, -80.0])
+    # amplitude flavor is 20·log10 with amin on the amplitude
+    np.testing.assert_allclose(amplitude_to_db(np.array([1.0, 0.1]), ref=1.0, amin=1e-4), [0.0, -20.0])
+    np.testing.assert_allclose(amplitude_to_db(np.array([1.0, 1e-6]), ref=1.0, amin=1e-4, top_db=None), [0.0, -80.0])
+
+
+def test_hann_window_matches_scipy():
+    from scipy.signal import get_window
+
+    for win, n_fft in ((321, 321), (960, 4096)):
+        w = hann_periodic(win, n_fft)
+        ref = get_window("hann", win, fftbins=True)
+        lpad = (n_fft - win) // 2
+        np.testing.assert_allclose(w[lpad : lpad + win], ref, atol=1e-12)
+        assert np.all(w[:lpad] == 0) and np.all(w[lpad + win :] == 0)
+
+
+# ---------------------------------------------------------------- independent-oracle parity
+@pytest.mark.parametrize(
+    ("sr", "n_fft", "n_mels", "fmax"),
+    [(16000, 321, 120, None), (48000, 4096, 48, 20000.0)],  # DNSMOS and NISQA configs
+)
+def test_filterbank_matches_independent(sr, n_fft, n_mels, fmax):
+    ours = mel_filterbank(sr, n_fft, n_mels, fmax=fmax)
+    ind = _ind_filterbank(sr, n_fft, n_mels, fmax=fmax)
+    assert ours.shape == (n_mels, 1 + n_fft // 2)
+    np.testing.assert_allclose(ours, ind, atol=1e-12)
+
+
+@pytest.mark.parametrize(
+    ("sr", "n_fft", "hop", "win", "n_mels", "fmax", "power", "pad_mode"),
+    [
+        # DNSMOS config: librosa-0.10-default constant (zero) centering
+        (16000, 321, 160, 321, 120, None, 2.0, "constant"),
+        # NISQA config: explicit reflect centering
+        (48000, 4096, 480, 960, 48, 20000.0, 1.0, "reflect"),
+    ],
+)
+def test_melspectrogram_matches_independent(sr, n_fft, hop, win, n_mels, fmax, power, pad_mode):
+    y = _rng.randn(sr // 4).astype(np.float64)  # 250 ms
+    ours = melspectrogram(
+        y, sr, n_fft=n_fft, hop_length=hop, win_length=win, n_mels=n_mels, fmax=fmax, power=power, pad_mode=pad_mode
+    )
+    ind = _ind_melspec(y, sr, n_fft, hop, win, n_mels, fmax if fmax else sr / 2.0, power, pad_mode)
+    assert ours.shape == ind.shape
+    np.testing.assert_allclose(ours, ind, rtol=1e-9, atol=1e-12)
+
+
+def test_sine_peaks_in_matching_mel_band():
+    sr, f0 = 16000, 440.0
+    t = np.arange(sr) / sr
+    mel = melspectrogram(np.sin(2 * np.pi * f0 * t), sr, n_fft=321, hop_length=160, n_mels=120)
+    band_energy = mel.mean(axis=1)
+    centers = mel_frequencies(122, 0.0, sr / 2.0)[1:-1]
+    expect = int(np.argmin(np.abs(centers - f0)))
+    assert abs(int(np.argmax(band_energy)) - expect) <= 1
+
+
+# ---------------------------------------------------------------- scorer input contracts
+def test_dnsmos_featurization_contract():
+    from metrics_tpu.audio.gated import _dnsmos_melspec
+
+    seg = _rng.randn(int(9.01 * 16000)).astype(np.float32)
+    feats = _dnsmos_melspec(seg[:-160], 16000)
+    # the (900, 120) frame grid model_v8.onnx was exported for
+    assert feats.shape == (900, 120)
+    assert feats.dtype == np.float32
+    # (power_to_db(ref=max)+40)/40 ⇒ max exactly 1, min ≥ (40-80)/40 = -1
+    assert feats.max() == pytest.approx(1.0)
+    assert feats.min() >= -1.0 - 1e-6
+
+
+def test_nisqa_featurization_contract():
+    from metrics_tpu.audio.gated import _nisqa_features
+
+    wav = _rng.randn(2 * 48000).astype(np.float32)  # 2 s at the native 48 kHz
+    segments, n_wins = _nisqa_features(wav, 48000)
+    assert segments.shape == (1, 1300, 48, 15)
+    assert segments.dtype == np.float32
+    # 2 s / 10 ms hop (centered) = 201 frames → 201 - 14 windows at stride 1
+    assert n_wins == 187
+    assert np.any(segments[0, n_wins - 1] != 0)
+    assert np.all(segments[0, n_wins:] == 0)
+
+
+def test_nisqa_too_short_raises():
+    from metrics_tpu.audio.gated import _nisqa_features
+
+    with pytest.raises(RuntimeError, match="too short"):
+        _nisqa_features(np.zeros(480, dtype=np.float32), 48000)
