@@ -44,8 +44,12 @@ def _retrieval_aggregate(values: Array, aggregation: str = "mean", mask: Optiona
     if aggregation == "mean":
         return jnp.where(count > 0, (jnp.where(mask, values, 0.0)).sum() / jnp.maximum(count, 1), 0.0)
     if aggregation == "median":
-        med = jnp.nanmedian(jnp.where(mask, values, jnp.nan))
-        return jnp.where(count > 0, jnp.nan_to_num(med), 0.0)
+        # torch.median semantics (reference ``base.py:34``): for an even count
+        # the LOWER of the two middle values, not their average — sort the
+        # valid entries to the front and index (count-1)//2 directly
+        filled = jnp.sort(jnp.where(mask, values, jnp.inf))
+        med = filled[jnp.maximum(count - 1, 0) // 2]
+        return jnp.where(count > 0, med, 0.0)
     if aggregation == "min":
         return jnp.where(count > 0, jnp.where(mask, values, jnp.inf).min(), 0.0)
     if aggregation == "max":

@@ -1,0 +1,247 @@
+"""Deep parity sweeps vs the ACTUAL reference package (round-2 VERDICT next #9).
+
+Four blocks the round-2 review called out as thin:
+
+- BootStrapper under BOTH samplers (poisson + multinomial): output structure
+  head-to-head, and statistical closeness of the bootstrap mean to the raw
+  metric (RNG streams differ across frameworks, so exact resample parity is
+  impossible by construction).
+- MetricTracker best-metric semantics: maximize=False, per-metric maximize
+  lists over a MetricCollection, compute_all/n_steps.
+- samplewise/multidim sweeps across the stat-scores consumer classes
+  (Accuracy/Precision/Recall/F1/Specificity), average × ignore_index.
+- retrieval ``empty_target_action`` × ``aggregation`` grid, incl. queries with
+  no positives.
+
+Reference property coverage analog: ``tests/unittests/_helpers/testers.py:85-250``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+# ------------------------------------------------------------------ bootstrapper
+
+
+@pytest.mark.parametrize("sampler", ["poisson", "multinomial"])
+def test_bootstrapper_mean_tracks_raw_metric(sampler):
+    """Bootstrap mean over many replicates ≈ the un-resampled metric, both samplers."""
+    reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import BootStrapper
+
+    rng = np.random.RandomState(42)
+    np.random.seed(7)  # _bootstrap_sampler's default stream
+    base = ours.classification.MulticlassAccuracy(num_classes=4, average="micro")
+    boot = BootStrapper(base, num_bootstraps=50, sampling_strategy=sampler)
+    raw = ours.classification.MulticlassAccuracy(num_classes=4, average="micro")
+    for _ in range(3):
+        p, g = rng.randint(0, 4, 200), rng.randint(0, 4, 200)
+        boot.update(jnp.asarray(p), jnp.asarray(g))
+        raw.update(jnp.asarray(p), jnp.asarray(g))
+    out = boot.compute()
+    assert float(abs(out["mean"] - raw.compute())) < 0.05
+    assert 0.0 < float(out["std"]) < 0.1
+
+
+@pytest.mark.parametrize("sampler", ["poisson", "multinomial"])
+def test_bootstrapper_output_structure_matches_reference(sampler):
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import BootStrapper
+
+    rng = np.random.RandomState(43)
+    p, g = rng.rand(80).astype(np.float32), rng.randint(0, 2, 80)
+    kwargs = dict(num_bootstraps=6, mean=True, std=True, quantile=0.95, raw=True, sampling_strategy=sampler)
+    ref_b = tm.wrappers.BootStrapper(tm.classification.BinaryAccuracy(), **kwargs)
+    our_b = BootStrapper(ours.classification.BinaryAccuracy(), **kwargs)
+    ref_b.update(t(p), t(g))
+    our_b.update(jnp.asarray(p), jnp.asarray(g))
+    ref_out, our_out = ref_b.compute(), our_b.compute()
+    assert set(our_out) == set(ref_out)
+    for key in ref_out:
+        assert tuple(our_out[key].shape) == tuple(ref_out[key].shape), key
+    # raw replicate values are valid accuracies
+    assert np.all((np.asarray(our_out["raw"]) >= 0) & (np.asarray(our_out["raw"]) <= 1))
+
+
+def test_bootstrapper_rejects_non_metric_and_bad_sampler():
+    from metrics_tpu.wrappers import BootStrapper
+
+    import metrics_tpu as ours
+
+    with pytest.raises(ValueError, match="base metric"):
+        BootStrapper(lambda x: x)
+    with pytest.raises(ValueError, match="sampling_strategy"):
+        BootStrapper(ours.MeanMetric(), sampling_strategy="jackknife")
+
+
+# ------------------------------------------------------------------ tracker deep
+
+
+def _fill_tracker(ref_m, our_m, rng, n_steps=4, batches=2):
+    for _ in range(n_steps):
+        ref_m.increment()
+        our_m.increment()
+        for _ in range(batches):
+            p = rng.rand(60).astype(np.float32)
+            g = rng.randint(0, 2, 60)
+            ref_m.update(t(p), t(g))
+            our_m.update(jnp.asarray(p), jnp.asarray(g))
+
+
+@pytest.mark.parametrize("maximize", [True, False])
+def test_tracker_single_metric_best(maximize):
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import MetricTracker
+
+    rng = np.random.RandomState(110)
+    ref_m = tm.wrappers.MetricTracker(tm.classification.BinaryAccuracy(), maximize=maximize)
+    our_m = MetricTracker(ours.classification.BinaryAccuracy(), maximize=maximize)
+    _fill_tracker(ref_m, our_m, rng)
+    ref_best, ref_idx = ref_m.best_metric(return_step=True)
+    our_best, our_idx = our_m.best_metric(return_step=True)
+    assert_close(our_best, ref_best, rtol=1e-6, atol=1e-7, label=f"tracker[max={maximize}]")
+    assert int(our_idx) == int(ref_idx)
+    assert our_m.n_steps == ref_m.n_steps
+
+
+def test_tracker_collection_with_per_metric_maximize():
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import MetricTracker
+
+    rng = np.random.RandomState(111)
+    ref_m = tm.wrappers.MetricTracker(
+        tm.MetricCollection([tm.classification.BinaryAccuracy(), tm.classification.BinaryHingeLoss()]),
+        maximize=[True, False],
+    )
+    our_m = MetricTracker(
+        ours.MetricCollection([ours.classification.BinaryAccuracy(), ours.classification.BinaryHingeLoss()]),
+        maximize=[True, False],
+    )
+    _fill_tracker(ref_m, our_m, rng)
+    ref_best, ref_idx = ref_m.best_metric(return_step=True)
+    our_best, our_idx = our_m.best_metric(return_step=True)
+    assert set(our_best) == set(ref_best)
+    for k in ref_best:
+        assert_close(our_best[k], ref_best[k], rtol=1e-5, atol=1e-6, label=f"tracker_best[{k}]")
+        assert int(our_idx[k]) == int(ref_idx[k]), k
+
+
+def test_tracker_compute_all_matches_reference():
+    tm = reference()
+    import metrics_tpu as ours
+    from metrics_tpu.wrappers import MetricTracker
+
+    rng = np.random.RandomState(112)
+    ref_m = tm.wrappers.MetricTracker(tm.classification.BinaryAccuracy())
+    our_m = MetricTracker(ours.classification.BinaryAccuracy())
+    _fill_tracker(ref_m, our_m, rng, n_steps=3)
+    assert_close(our_m.compute_all(), ref_m.compute_all(), rtol=1e-6, atol=1e-7, label="tracker_compute_all")
+
+
+# --------------------------------------------- stat-scores consumers: samplewise sweeps
+
+_CONSUMERS = ["Accuracy", "Precision", "Recall", "F1Score", "Specificity"]
+
+
+@pytest.mark.parametrize("name", _CONSUMERS)
+@pytest.mark.parametrize("average", ["micro", "macro", None])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+def test_multiclass_samplewise_sweep(name, average, ignore_index):
+    """multidim_average='samplewise' over (B, extra) int inputs, every consumer."""
+    tm = reference()
+    import metrics_tpu.classification as ours_cls
+
+    rng = np.random.RandomState(120)
+    p = rng.randint(0, 4, (6, 25))
+    g = rng.randint(0, 4, (6, 25))
+    kwargs = dict(num_classes=4, average=average, ignore_index=ignore_index, multidim_average="samplewise")
+    ref_m = getattr(tm.classification, f"Multiclass{name}")(**kwargs)
+    our_m = getattr(ours_cls, f"Multiclass{name}")(**kwargs, validate_args=False)
+    ref_m.update(t(p), t(g))
+    our_m.update(jnp.asarray(p), jnp.asarray(g))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-5, atol=1e-6, label=f"{name}[{average},{ignore_index}]")
+
+
+@pytest.mark.parametrize("name", _CONSUMERS)
+@pytest.mark.parametrize("multidim_average", ["global", "samplewise"])
+def test_multilabel_multidim_sweep(name, multidim_average):
+    tm = reference()
+    import metrics_tpu.classification as ours_cls
+
+    rng = np.random.RandomState(121)
+    p = rng.rand(6, 3, 25).astype(np.float32)
+    g = rng.randint(0, 2, (6, 3, 25))
+    kwargs = dict(num_labels=3, average="macro", multidim_average=multidim_average)
+    ref_m = getattr(tm.classification, f"Multilabel{name}")(**kwargs)
+    our_m = getattr(ours_cls, f"Multilabel{name}")(**kwargs, validate_args=False)
+    ref_m.update(t(p), t(g))
+    our_m.update(jnp.asarray(p), jnp.asarray(g))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-5, atol=1e-6, label=f"ml-{name}[{multidim_average}]")
+
+
+@pytest.mark.parametrize("name", _CONSUMERS)
+def test_binary_samplewise_sweep(name):
+    tm = reference()
+    import metrics_tpu.classification as ours_cls
+
+    rng = np.random.RandomState(122)
+    p = rng.rand(5, 30).astype(np.float32)
+    g = rng.randint(0, 2, (5, 30))
+    kwargs = dict(multidim_average="samplewise")
+    ref_m = getattr(tm.classification, f"Binary{name}")(**kwargs)
+    our_m = getattr(ours_cls, f"Binary{name}")(**kwargs, validate_args=False)
+    ref_m.update(t(p), t(g))
+    our_m.update(jnp.asarray(p), jnp.asarray(g))
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-5, atol=1e-6, label=f"bin-{name}[samplewise]")
+
+
+# ------------------------------------------------------------------ retrieval grid
+
+
+@pytest.mark.parametrize("metric_name", ["RetrievalMAP", "RetrievalMRR", "RetrievalHitRate"])
+@pytest.mark.parametrize("empty_target_action", ["skip", "neg", "pos"])
+@pytest.mark.parametrize("aggregation", ["mean", "median", "min", "max"])
+def test_retrieval_empty_action_aggregation_grid(metric_name, empty_target_action, aggregation):
+    tm = reference()
+    import metrics_tpu.retrieval as ours_ret
+
+    rng = np.random.RandomState(130)
+    n = 400
+    indexes = rng.randint(0, 24, n)
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n)
+    target[np.isin(indexes, [3, 11, 17])] = 0  # three all-negative queries
+
+    kwargs = dict(empty_target_action=empty_target_action, aggregation=aggregation)
+    ref_m = getattr(tm.retrieval, metric_name)(**kwargs)
+    our_m = getattr(ours_ret, metric_name)(**kwargs)
+    ref_m.update(t(preds), t(target), indexes=t(indexes))
+    our_m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    assert_close(
+        our_m.compute(), ref_m.compute(), rtol=1e-5, atol=1e-6,
+        label=f"{metric_name}[{empty_target_action},{aggregation}]",
+    )
+
+
+def test_retrieval_empty_action_error_raises_both_sides():
+    tm = reference()
+    import metrics_tpu.retrieval as ours_ret
+
+    indexes = np.array([0, 0, 1, 1])
+    preds = np.array([0.3, 0.6, 0.2, 0.7], dtype=np.float32)
+    target = np.array([1, 0, 0, 0])  # query 1 has no positives
+    ref_m = tm.retrieval.RetrievalMAP(empty_target_action="error")
+    ref_m.update(t(preds), t(target), indexes=t(indexes))
+    with pytest.raises(Exception):
+        ref_m.compute()
+    our_m = ours_ret.RetrievalMAP(empty_target_action="error")
+    our_m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    with pytest.raises(Exception):
+        our_m.compute()

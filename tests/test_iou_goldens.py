@@ -1,0 +1,115 @@
+"""Hand-computed analytic IoU-family goldens (round-2 VERDICT weak #2).
+
+The IoU parity suite compares against the reference THROUGH the builder-written
+torchvision shim (``tests/_ref_shim/torchvision/ops.py``), so a shared
+misreading of the published formulas would pass silently. These cases are
+worked out by hand from the definitions (IoU; GIoU = IoU − (hull−union)/hull,
+Rezatofighi 2019; DIoU = IoU − ρ²/c², CIoU = DIoU − αv, Zheng 2020) and pin
+BOTH our implementation and the shim to the arithmetic.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+# Geometry, worked by hand:
+#   A = [0, 0, 10, 10]                    area 100
+#   B = [5, 5, 15, 15]                    area 100; A∩B = [5,5,10,10] = 25
+#       union = 175, hull = [0,0,15,15] = 225
+#       IoU  = 25/175 = 1/7
+#       GIoU = 1/7 − (225−175)/225 = 1/7 − 2/9 = −5/63
+#       centers (5,5) vs (10,10): ρ² = 50; hull diag c² = 225+225 = 450
+#       DIoU = 1/7 − 50/450 = 1/7 − 1/9 = 2/63
+#       aspect ratios equal (both square) ⇒ v = 0 ⇒ CIoU = DIoU
+#   C = [20, 20, 30, 30]  disjoint from A: inter 0, union 200,
+#       hull = [0,0,30,30] = 900 ⇒ GIoU = 0 − 700/900 = −7/9
+#       centers (5,5) vs (25,25): ρ² = 800; c² = 900+900 = 1800
+#       DIoU = 0 − 800/1800 = −4/9; squares again ⇒ CIoU = DIoU
+#   D = A exactly ⇒ IoU = GIoU = DIoU = CIoU = 1
+A = [0.0, 0.0, 10.0, 10.0]
+B = [5.0, 5.0, 15.0, 15.0]
+C = [20.0, 20.0, 30.0, 30.0]
+
+GOLDENS = {
+    "iou": {(0, 0): 1.0, (0, 1): 1.0 / 7.0, (0, 2): 0.0},
+    "giou": {(0, 0): 1.0, (0, 1): -5.0 / 63.0, (0, 2): -7.0 / 9.0},
+    "diou": {(0, 0): 1.0, (0, 1): 2.0 / 63.0, (0, 2): -4.0 / 9.0},
+    "ciou": {(0, 0): 1.0, (0, 1): 2.0 / 63.0, (0, 2): -4.0 / 9.0},
+}
+
+
+def _our_fn(kind):
+    from metrics_tpu.functional.detection import iou as mod
+
+    return {
+        "iou": mod.intersection_over_union,
+        "giou": mod.generalized_intersection_over_union,
+        "diou": mod.distance_intersection_over_union,
+        "ciou": mod.complete_intersection_over_union,
+    }[kind]
+
+
+def _shim_fn(kind):
+    import os
+    import sys
+
+    shim = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "_ref_shim")
+    if shim not in sys.path:
+        sys.path.insert(0, shim)
+    from torchvision import ops
+
+    return {
+        "iou": ops.box_iou,
+        "giou": ops.generalized_box_iou,
+        "diou": ops.distance_box_iou,
+        "ciou": ops.complete_box_iou,
+    }[kind]
+
+
+@pytest.mark.parametrize("kind", ["iou", "giou", "diou", "ciou"])
+def test_ours_matches_hand_computed(kind):
+    fn = _our_fn(kind)
+    preds = jnp.asarray([A])
+    targets = jnp.asarray([A, B, C])
+    mat = np.asarray(fn(preds, targets, aggregate=False))
+    for (i, j), want in GOLDENS[kind].items():
+        assert mat[i, j] == pytest.approx(want, abs=1e-5), (kind, i, j)
+
+
+@pytest.mark.parametrize("kind", ["iou", "giou", "diou", "ciou"])
+def test_oracle_shim_matches_hand_computed(kind):
+    """The test-side torchvision stand-in itself is pinned to the same arithmetic."""
+    import torch
+
+    fn = _shim_fn(kind)
+    mat = fn(torch.tensor([A]), torch.tensor([A, B, C])).numpy()
+    for (i, j), want in GOLDENS[kind].items():
+        assert mat[i, j] == pytest.approx(want, abs=1e-5), (kind, i, j)
+
+
+def test_ciou_aspect_ratio_penalty_hand_case():
+    """Non-square pair where the CIoU α·v term is nonzero, worked by hand.
+
+    A = [0,0,10,10] (w=h=10), E = [0,0,20,10] (w=20, h=10), x-y aligned:
+      inter = 100, union = 200 − 100 = 100 ⇒ wait: areas 100 and 200, inter 100
+      ⇒ union = 200, IoU = 0.5; hull = E ⇒ GIoU = IoU = 0.5
+      centers (5,5) vs (10,5): ρ² = 25; c² = 400 + 100 = 500
+      DIoU = 0.5 − 0.05 = 0.45
+      v = 4/π² · (atan(1) − atan(2))² = 4/π² · (π/4 − atan 2)²
+      α = v / (1 − IoU + v)
+      CIoU = DIoU − α·v
+    """
+    import math
+
+    E = [0.0, 0.0, 20.0, 10.0]
+    v = 4.0 / math.pi**2 * (math.atan(1.0) - math.atan(2.0)) ** 2
+    alpha = v / (0.5 + v)
+    want = 0.45 - alpha * v
+
+    ours = float(np.asarray(_our_fn("ciou")(jnp.asarray([A]), jnp.asarray([E]), aggregate=False))[0, 0])
+    assert ours == pytest.approx(want, abs=1e-5)
+    import torch
+
+    shim = float(_shim_fn("ciou")(torch.tensor([A]), torch.tensor([E])).numpy()[0, 0])
+    assert shim == pytest.approx(want, abs=1e-5)

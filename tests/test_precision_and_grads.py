@@ -19,37 +19,103 @@ _IMG_A = _rng.rand(2, 3, 32, 32).astype(np.float32)
 _IMG_B = _rng.rand(2, 3, 32, 32).astype(np.float32)
 
 
-def _bf16_cases():
+def _half_cases():
+    """(name, fn, a, b, bf16_tol, fp16_tol) per domain — classification, regression,
+    image, audio, pairwise, segmentation, detection, aggregation."""
+    from metrics_tpu.functional.audio.metrics import (
+        scale_invariant_signal_distortion_ratio,
+        signal_noise_ratio,
+    )
+    from metrics_tpu.functional.classification import binary_auroc, multiclass_accuracy, multiclass_f1_score
+    from metrics_tpu.functional.detection.iou import intersection_over_union
     from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
     from metrics_tpu.functional.image.ssim import structural_similarity_index_measure
+    from metrics_tpu.functional.pairwise import pairwise_cosine_similarity, pairwise_euclidean_distance
     from metrics_tpu.functional.regression import (
+        concordance_corrcoef,
         cosine_similarity,
         explained_variance,
         mean_absolute_error,
         mean_squared_error,
         pearson_corrcoef,
         r2_score,
+        spearman_corrcoef,
     )
+    from metrics_tpu.functional.segmentation import dice_score
+
+    cls_target = jnp.asarray(_rng.randint(0, 4, 64))
+    probs = jax.nn.softmax(jnp.asarray(_rng.randn(64, 4).astype(np.float32)), axis=-1)
+    bin_target = jnp.asarray(_rng.randint(0, 2, 64))
+    seg_onehot_t = jnp.asarray(np.eye(3, dtype=np.float32)[_rng.randint(0, 3, (2, 64))].transpose(0, 2, 1))
+    boxes_a = jnp.asarray(np.abs(_rng.rand(6, 4)) * 50 + np.array([0, 0, 60, 60]))
+    boxes_b = jnp.asarray(np.abs(_rng.rand(6, 4)) * 50 + np.array([0, 0, 60, 60]))
+    seg_probs = jax.nn.softmax(jnp.asarray(_rng.randn(2, 3, 64).astype(np.float32)), axis=1)
 
     return [
-        ("mse", lambda p, t: mean_squared_error(p, t), _X, _Y, 2e-2),
-        ("mae", lambda p, t: mean_absolute_error(p, t), _X, _Y, 2e-2),
-        ("pearson", lambda p, t: pearson_corrcoef(p, t), _X, _Y, 5e-2),
-        ("r2", lambda p, t: r2_score(p, t), _X, _Y, 2e-1),
-        ("explained_variance", lambda p, t: explained_variance(p, t), _X, _Y, 2e-1),
-        ("cosine", lambda p, t: cosine_similarity(p.reshape(8, 8), t.reshape(8, 8)), _X, _Y, 2e-2),
-        ("psnr", lambda p, t: peak_signal_noise_ratio(p, t, data_range=1.0), _IMG_A, _IMG_B, 5e-1),
-        ("ssim", lambda p, t: structural_similarity_index_measure(p, t, data_range=1.0), _IMG_A, _IMG_B, 5e-2),
+        # regression
+        ("mse", lambda p, t: mean_squared_error(p, t), _X, _Y, 2e-2, 2e-3),
+        ("mae", lambda p, t: mean_absolute_error(p, t), _X, _Y, 2e-2, 2e-3),
+        ("pearson", lambda p, t: pearson_corrcoef(p, t), _X, _Y, 5e-2, 8e-3),
+        ("spearman", lambda p, t: spearman_corrcoef(p, t), _X, _Y, 5e-2, 8e-3),
+        ("concordance", lambda p, t: concordance_corrcoef(p, t), _X, _Y, 5e-2, 8e-3),
+        ("r2", lambda p, t: r2_score(p, t), _X, _Y, 2e-1, 3e-2),
+        ("explained_variance", lambda p, t: explained_variance(p, t), _X, _Y, 2e-1, 3e-2),
+        ("cosine", lambda p, t: cosine_similarity(p.reshape(8, 8), t.reshape(8, 8)), _X, _Y, 2e-2, 2e-3),
+        # image
+        ("psnr", lambda p, t: peak_signal_noise_ratio(p, t, data_range=1.0), _IMG_A, _IMG_B, 5e-1, 5e-2),
+        ("ssim", lambda p, t: structural_similarity_index_measure(p, t, data_range=1.0), _IMG_A, _IMG_B, 5e-2, 8e-3),
+        # classification (float probs in half precision, int targets)
+        ("mc_accuracy", lambda p, _t: multiclass_accuracy(p, cls_target, num_classes=4, average="micro",
+                                                          validate_args=False), probs, probs, 2e-2, 2e-3),
+        ("mc_f1", lambda p, _t: multiclass_f1_score(p, cls_target, num_classes=4, average="macro",
+                                                    validate_args=False), probs, probs, 2e-2, 2e-3),
+        ("auroc", lambda p, _t: binary_auroc(p[:, 0], bin_target, validate_args=False), probs, probs, 2e-2, 5e-3),
+        # audio
+        ("snr", lambda p, t: signal_noise_ratio(p, t).mean(), _X, _Y, 2e-1, 5e-2),
+        ("si_sdr", lambda p, t: scale_invariant_signal_distortion_ratio(p, t).mean(), _X, _Y, 5e-1, 8e-2),
+        # pairwise
+        ("pairwise_cos", lambda p, t: pairwise_cosine_similarity(p.reshape(8, 8), t.reshape(8, 8)).mean(),
+         _X, _Y, 2e-2, 2e-3),
+        ("pairwise_l2", lambda p, t: pairwise_euclidean_distance(p.reshape(8, 8), t.reshape(8, 8)).mean(),
+         _X, _Y, 2e-2, 2e-3),
+        # detection
+        ("box_iou", lambda p, t: intersection_over_union(p, t), boxes_a, boxes_b, 2e-2, 2e-3),
+        # segmentation: float one-hot probabilities actually carry the half dtype
+        ("dice", lambda p, _t: dice_score(p, seg_onehot_t.astype(p.dtype), num_classes=3,
+                                          input_format="one-hot").mean(),
+         seg_probs, seg_probs, 2e-2, 2e-3),
     ]
 
 
-@pytest.mark.parametrize("name,fn,a,b,tol", _bf16_cases(), ids=[c[0] for c in _bf16_cases()])
-def test_bfloat16_close_to_float32(name, fn, a, b, tol):
-    """bf16 inputs must track the fp32 result within the declared tolerance."""
+_HALF_IDS = [c[0] for c in _half_cases()]
+
+
+@pytest.mark.parametrize("dtype_name,tol_idx", [("bfloat16", 4), ("float16", 5)])
+@pytest.mark.parametrize("case", _half_cases(), ids=_HALF_IDS)
+def test_half_precision_close_to_float32(case, dtype_name, tol_idx):
+    """bf16 (TPU compute dtype) and fp16 inputs track fp32 within declared tolerance.
+
+    The reference's fp16 smoke coverage (``testers.py:486-540``) analog, swept
+    across every domain with float inputs.
+    """
+    name, fn, a, b = case[:4]
+    tol = case[tol_idx]
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float16
     full = float(fn(jnp.asarray(a), jnp.asarray(b)))
-    half = float(fn(jnp.asarray(a, dtype=jnp.bfloat16), jnp.asarray(b, dtype=jnp.bfloat16)))
+    half = float(fn(jnp.asarray(a, dtype=dtype), jnp.asarray(b, dtype=dtype)))
     assert np.isfinite(half)
     assert abs(full - half) <= tol * max(1.0, abs(full)), (name, full, half)
+
+
+def test_aggregation_metrics_accept_half_inputs():
+    from metrics_tpu import MaxMetric, MeanMetric, SumMetric
+
+    for dtype in (jnp.bfloat16, jnp.float16):
+        for cls, want in ((MeanMetric, _X.mean()), (SumMetric, _X.sum()), (MaxMetric, _X.max())):
+            m = cls()
+            m.update(jnp.asarray(_X, dtype=dtype))
+            got = float(m.compute())
+            assert abs(got - float(want)) <= 2e-1 * max(1.0, abs(float(want))), (cls.__name__, dtype, got)
 
 
 def _grad_cases():

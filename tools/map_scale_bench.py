@@ -1,0 +1,164 @@
+"""COCO-val-scale MeanAveragePrecision benchmark (round-2 VERDICT next #4).
+
+Synthesizes a COCO-val-like workload — 5 000 images, 80 classes, ~7 gts and
+~8 detections per image with realistic size spread — and times the full
+evaluate (update stream + compute) of :class:`metrics_tpu.detection.MeanAveragePrecision`
+on the default backend (real TPU when the tunnel is live, CPU otherwise; the
+backend is probed via ``ensure_backend`` so a wedged tunnel cannot hang the run).
+
+Usage::
+
+    python tools/map_scale_bench.py              # ours only (JSON line to stdout)
+    python tools/map_scale_bench.py --reference  # also time the reference's
+                                                 # pure-torch backend (slow!)
+    python tools/map_scale_bench.py --images 500 # smaller sweep
+
+Writes ``MAP_SCALE_BENCH.json`` at the repo root with the machine-readable
+result alongside the stdout line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def synth_dataset(n_images: int, n_classes: int, seed: int = 0):
+    """COCO-val-like predictions/targets: mixed object sizes, crowd flags, score noise."""
+    rng = np.random.RandomState(seed)
+    preds, target = [], []
+    for _ in range(n_images):
+        ng = rng.randint(1, 15)  # COCO val avg ≈ 7.3 gts/img
+        # log-uniform object scale: many small, few large (COCO size dist)
+        wh = np.exp(rng.uniform(np.log(6), np.log(300), (ng, 2)))
+        xy = rng.rand(ng, 2) * (640 - wh.clip(max=600))
+        gb = np.concatenate([xy, xy + wh], axis=1)
+        glab = rng.randint(0, n_classes, ng)
+        crowd = (rng.rand(ng) < 0.03).astype(np.int64)
+
+        # detections: jittered copies of most gts (localization noise ∝ size),
+        # some dropped, plus false positives
+        keep = rng.rand(ng) < 0.85
+        jitter = rng.randn(ng, 4) * (wh.mean(axis=1, keepdims=True) * 0.08)
+        db_tp = (gb + jitter)[keep]
+        lab_tp = glab[keep]
+        n_fp = rng.randint(0, 6)
+        wh_fp = np.exp(rng.uniform(np.log(6), np.log(300), (n_fp, 2)))
+        xy_fp = rng.rand(n_fp, 2) * (640 - wh_fp.clip(max=600))
+        db = np.concatenate([db_tp, np.concatenate([xy_fp, xy_fp + wh_fp], axis=1)])
+        db[:, 2:] = np.maximum(db[:, 2:], db[:, :2] + 1)
+        dlab = np.concatenate([lab_tp, rng.randint(0, n_classes, n_fp)])
+        scores = np.clip(np.concatenate([rng.uniform(0.5, 1.0, keep.sum()), rng.uniform(0.05, 0.6, n_fp)]), 0, 1)
+
+        preds.append({"boxes": db.astype(np.float32), "scores": scores.astype(np.float32), "labels": dlab})
+        target.append({"boxes": gb.astype(np.float32), "labels": glab, "iscrowd": crowd})
+    return preds, target
+
+
+def bench_ours(preds, target, repeats: int = 2):
+    import jax.numpy as jnp
+
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    j_preds = [{k: jnp.asarray(v) for k, v in d.items()} for d in preds]
+    j_target = [{k: jnp.asarray(v) for k, v in d.items()} for d in target]
+
+    def run():
+        m = MeanAveragePrecision()
+        m.update(j_preds, j_target)
+        return float(m.compute()["map"])
+
+    value = run()  # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        got = run()
+        best = min(best, time.perf_counter() - t0)
+        assert got == value
+    return best, value
+
+
+def bench_reference(preds, target, repeats: int = 1):
+    sys.path.insert(0, os.path.join(REPO, "tests", "_ref_shim"))
+    sys.path.insert(0, "/root/reference/src")
+    import torch
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
+
+    t_preds = [
+        {k: torch.tensor(np.asarray(v), dtype=torch.long if k in ("labels", "iscrowd") else torch.float32)
+         for k, v in d.items()}
+        for d in preds
+    ]
+    t_target = [
+        {k: torch.tensor(np.asarray(v), dtype=torch.long if k in ("labels", "iscrowd") else torch.float32)
+         for k, v in d.items()}
+        for d in target
+    ]
+
+    def run():
+        m = RefMAP()
+        m.update(t_preds, t_target)
+        return float(m.compute()["map"])
+
+    value = run()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        got = run()
+        best = min(best, time.perf_counter() - t0)
+        assert got == value
+    return best, value
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=5000)
+    ap.add_argument("--classes", type=int, default=80)
+    ap.add_argument("--reference", action="store_true", help="also time the reference torch backend")
+    ap.add_argument("--repeats", type=int, default=2)
+    args = ap.parse_args()
+
+    from metrics_tpu.utils.backend import ensure_backend
+
+    platform = ensure_backend(min_devices=1)
+    import jax
+
+    backend = jax.default_backend()
+
+    preds, target = synth_dataset(args.images, args.classes)
+    n_det = int(sum(len(p["scores"]) for p in preds))
+    n_gt = int(sum(len(t["labels"]) for t in target))
+
+    t_ours, v_ours = bench_ours(preds, target, repeats=args.repeats)
+    out = {
+        "metric": "mean_ap_coco_val_scale",
+        "images": args.images,
+        "classes": args.classes,
+        "detections": n_det,
+        "gts": n_gt,
+        "backend": backend,
+        "platform_probe": platform,
+        "ours_s": round(t_ours, 3),
+        "map": round(v_ours, 5),
+    }
+    if args.reference:
+        t_ref, v_ref = bench_reference(preds, target)
+        assert abs(v_ours - v_ref) < 5e-3, (v_ours, v_ref)
+        out["reference_s"] = round(t_ref, 3)
+        out["speedup"] = round(t_ref / t_ours, 2)
+
+    print(json.dumps(out))
+    with open(os.path.join(REPO, "MAP_SCALE_BENCH.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
