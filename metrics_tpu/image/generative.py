@@ -326,8 +326,10 @@ class InceptionScore(Metric):
 class MemorizationInformedFrechetInceptionDistance(FrechetInceptionDistance):
     """MiFID (reference ``image/mifid.py:35``): FID scaled by a memorization penalty.
 
-    Keeps full feature sets (needed for the per-sample nearest-cosine memorization
-    distance) in addition to the streaming FID statistics.
+    The full feature sets (needed for the per-sample nearest-cosine memorization
+    distance) are REGISTERED cat-reduce list states alongside the streaming FID
+    statistics — the generic merge/pickle/sync/forward machinery handles them
+    like KID's and InceptionScore's feature lists.
     """
 
     def __init__(self, feature: Union[Callable, int, None] = None, cosine_distance_eps: float = 0.1,
@@ -336,20 +338,24 @@ class MemorizationInformedFrechetInceptionDistance(FrechetInceptionDistance):
         if not (isinstance(cosine_distance_eps, float) and 0 < cosine_distance_eps <= 1):
             raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less than 1")
         self.cosine_distance_eps = cosine_distance_eps
-        self._real_store: list = []
-        self._fake_store: list = []
+        self.add_state("real_feature_store", [], dist_reduce_fx="cat")
+        self.add_state("fake_feature_store", [], dist_reduce_fx="cat")
 
     def update(self, imgs: Array, real: bool) -> None:
         """Update streaming stats and keep the features for the memorization term."""
         feats = self._extract(imgs)  # extract ONCE; shared by FID stats and memorization term
         self._update_features(feats, real)
-        (self._real_store if real else self._fake_store).append(np.asarray(feats, dtype=np.float64))
+        (self.real_feature_store if real else self.fake_feature_store).append(
+            jnp.asarray(feats, dtype=jnp.float32)
+        )
 
     def compute(self) -> Array:
         """FID / max(memorization distance, eps)."""
+        from metrics_tpu.utils.data import dim_zero_cat
+
         fid = float(super().compute())
-        real = np.concatenate(self._real_store)
-        fake = np.concatenate(self._fake_store)
+        real = np.asarray(dim_zero_cat(self.real_feature_store), dtype=np.float64)
+        fake = np.asarray(dim_zero_cat(self.fake_feature_store), dtype=np.float64)
         real_n = real / np.clip(np.linalg.norm(real, axis=1, keepdims=True), 1e-12, None)
         fake_n = fake / np.clip(np.linalg.norm(fake, axis=1, keepdims=True), 1e-12, None)
         cos = fake_n @ real_n.T
@@ -358,9 +364,32 @@ class MemorizationInformedFrechetInceptionDistance(FrechetInceptionDistance):
         penalty = mem_dist if mem_dist < self.cosine_distance_eps else 1.0
         return jnp.asarray(fid / penalty, dtype=jnp.float32)
 
+    def forward(self, *args: Any, **kwargs: Any) -> Array:
+        """Generic forward + all-or-nothing rollback.
+
+        ``update`` is one-sided (real XOR fake), so the batch-local compute
+        raises whenever the batch lacks the other distribution; roll the whole
+        forward back (state, counters, sync flags) instead of leaving the
+        batch-only state the generic path stops in.
+        """
+        state_backup = self._copy_state()
+        count_backup = self._update_count
+        try:
+            return super().forward(*args, **kwargs)
+        except Exception:
+            self.__dict__["_state"] = state_backup
+            self._update_count = count_backup
+            self._computed = None
+            self._to_sync = self.sync_on_compute
+            self._should_unsync = True
+            self._is_synced = False
+            raise
+
     def reset(self) -> None:
-        """Reset stored features too."""
-        super().reset()
-        self._fake_store = []
-        if self.reset_real_features:
-            self._real_store = []
+        """Reset; optionally keep the real features (stats AND store)."""
+        if self._initialized and not self.reset_real_features:
+            keep = list(self.real_feature_store)
+            super().reset()  # FID.reset keeps the streaming real stats
+            self._state["real_feature_store"] = keep
+        else:
+            super().reset()

@@ -400,6 +400,32 @@ class _StringStoreMetric(Metric):
         else:
             self._target_store.extend(list(target))
 
+    def forward(self, *args: Any, **kwargs: Any):
+        """Batch-local value + accumulation (reference ``metric.py:287-317``).
+
+        The string payloads live outside the array-state system, so the generic
+        reduce-state forward (which resets only ``_state``) would leak the
+        running store into the batch value; swap a fresh store in for the batch
+        compute, then splice the histories back together.
+        """
+        prev_preds, prev_target = self._preds_store, self._target_store
+        prev_count = self._update_count
+        self._preds_store, self._target_store = [], []
+        try:
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
+        except Exception:
+            # all-or-nothing: a half-appended batch (e.g. preds stored, targets
+            # invalid) would misalign every later compute
+            self._preds_store, self._target_store = prev_preds, prev_target
+            self._update_count = prev_count
+            self._computed = None
+            raise
+        self._preds_store = prev_preds + self._preds_store
+        self._target_store = prev_target + self._target_store
+        self._computed = None  # running compute must not reuse the batch value
+        return batch_val
+
     def reset(self) -> None:
         """Reset stored strings too."""
         super().reset()
@@ -485,8 +511,11 @@ class ExtendedEditDistance(_StringStoreMetric):
         )
 
 
-class SQuAD(Metric):
+class SQuAD(_StringStoreMetric):
     """SQuAD EM/F1 (reference ``text/squad.py:27``).
+
+    Shares the string-store plumbing (stores, batch-local ``forward``, reset)
+    with the other raw-payload text metrics; only the payloads are QA dicts.
 
     >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
     >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
@@ -496,17 +525,9 @@ class SQuAD(Metric):
     {'exact_match': 100.0, 'f1': 100.0}
     """
 
-    __jit_ineligible__ = True
-    is_differentiable = False
     higher_is_better = True
-    full_state_update = False
     plot_lower_bound = 0.0
     plot_upper_bound = 100.0
-
-    def __init__(self, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
-        self._preds_store: List[Dict] = []
-        self._target_store: List[Dict] = []
 
     def update(self, preds, target) -> None:
         """Store QA predictions/targets for compute."""
@@ -516,9 +537,3 @@ class SQuAD(Metric):
     def compute(self) -> Dict[str, Array]:
         """Compute metric."""
         return squad(self._preds_store, self._target_store)
-
-    def reset(self) -> None:
-        """Reset stored dicts too."""
-        super().reset()
-        self._preds_store = []
-        self._target_store = []

@@ -1,0 +1,120 @@
+"""Stream-lifecycle parity for text class metrics vs the ACTUAL reference.
+
+The array-based harness (``tests/helpers.py``) can't drive string inputs, so
+this file covers the same property set by hand for the text domain: multi-batch
+accumulation, per-batch ``forward`` values, pickle round-trip, and reset —
+each goldened by the reference package fed the identical stream.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from tests._reference import assert_close, reference
+
+BATCHES_PREDS = [
+    ["the cat sat on the mat", "a quick brown fox"],
+    ["jumps over the lazy dog", "hello world again"],
+    ["metrics frameworks measure things", "the mat sat on the cat"],
+]
+BATCHES_TARGET = [
+    [["the cat sat on the mat"], ["a fast brown fox"]],
+    [["jumps over a lazy dog"], ["hello wide world again"]],
+    [["metric frameworks measure many things"], ["the mat sat under the cat"]],
+]
+WER_TARGET = [[refs[0] for refs in batch] for batch in BATCHES_TARGET]
+
+
+# (class name, ctor kwargs, target style) — resolved lazily inside each test so
+# collection never imports the reference package (it may be absent → skip, not error)
+_SPECS = {
+    "bleu": ("BLEUScore", {}, "multi"),
+    "bleu_smooth_2gram": ("BLEUScore", {"n_gram": 2, "smooth": True}, "multi"),
+    "sacre_bleu": ("SacreBLEUScore", {}, "multi"),
+    "chrf": ("CHRFScore", {}, "multi"),
+    "wer": ("WordErrorRate", {}, "single"),
+    "cer": ("CharErrorRate", {}, "single"),
+    "mer": ("MatchErrorRate", {}, "single"),
+    "wil": ("WordInfoLost", {}, "single"),
+    "wip": ("WordInfoPreserved", {}, "single"),
+    "ter": ("TranslationEditRate", {}, "multi"),
+    "eed": ("ExtendedEditDistance", {}, "multi"),
+    "edit": ("EditDistance", {}, "single"),
+}
+_IDS = list(_SPECS)
+
+
+def _resolve(name):
+    tm = reference()
+    import metrics_tpu.text as ours
+
+    cls_name, kwargs, style = _SPECS[name]
+    targets = BATCHES_TARGET if style == "multi" else WER_TARGET
+    return getattr(ours, cls_name)(**kwargs), getattr(tm.text, cls_name)(**kwargs), targets
+
+
+@pytest.mark.parametrize("name", _IDS)
+def test_text_stream_accumulation(name):
+    our_m, ref_m, targets = _resolve(name)
+    for preds, tgt in zip(BATCHES_PREDS, targets):
+        our_m.update(preds, tgt)
+        ref_m.update(preds, tgt)
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-5, atol=1e-6, label=f"{name} stream")
+
+
+@pytest.mark.parametrize("name", _IDS)
+def test_text_forward_batch_values(name):
+    our_m, ref_m, targets = _resolve(name)
+    for preds, tgt in zip(BATCHES_PREDS, targets):
+        our_b = our_m(preds, tgt)
+        ref_b = ref_m(preds, tgt)
+        assert_close(our_b, ref_b, rtol=1e-5, atol=1e-6, label=f"{name} forward batch")
+    assert_close(our_m.compute(), ref_m.compute(), rtol=1e-5, atol=1e-6, label=f"{name} forward total")
+
+
+def test_rouge_forward_batch_values():
+    """ROUGE shares the string-store base; its forward must also be batch-local."""
+    tm = reference()
+    import metrics_tpu.text as ours
+
+    # rougeLsum needs nltk sentence-splitting data the zero-egress env lacks
+    keys = ("rouge1", "rouge2", "rougeL")
+    our_m, ref_m = ours.ROUGEScore(rouge_keys=keys), tm.text.ROUGEScore(rouge_keys=keys)
+    for preds, tgt in zip(BATCHES_PREDS, WER_TARGET):
+        our_b, ref_b = our_m(preds, tgt), ref_m(preds, tgt)
+        assert_close(dict(our_b), {k: v.numpy() for k, v in ref_b.items()},
+                     rtol=1e-5, atol=1e-6, label="rouge forward batch")
+    assert_close(dict(our_m.compute()), {k: v.numpy() for k, v in ref_m.compute().items()},
+                 rtol=1e-5, atol=1e-6, label="rouge forward total")
+
+
+def test_squad_forward_batch_local():
+    """SQuAD shares the string-store base; forward must be batch-local (vs reference)."""
+    tm = reference()
+    from metrics_tpu.text import SQuAD
+
+    b1_p = [{"prediction_text": "1976", "id": "a"}]
+    b1_t = [{"answers": {"answer_start": [0], "text": ["1976"]}, "id": "a"}]
+    b2_p = [{"prediction_text": "wrong", "id": "b"}]
+    b2_t = [{"answers": {"answer_start": [0], "text": ["right"]}, "id": "b"}]
+    our_m, ref_m = SQuAD(), tm.text.SQuAD()
+    for preds, tgt in ((b1_p, b1_t), (b2_p, b2_t)):
+        our_b, ref_b = our_m(preds, tgt), ref_m(preds, tgt)
+        assert_close(dict(our_b), {k: v.numpy() for k, v in ref_b.items()},
+                     rtol=1e-6, atol=1e-7, label="squad forward batch")
+    assert_close(dict(our_m.compute()), {k: v.numpy() for k, v in ref_m.compute().items()},
+                 rtol=1e-6, atol=1e-7, label="squad forward total")
+
+
+@pytest.mark.parametrize("name", _IDS[:6])
+def test_text_pickle_and_reset(name):
+    m, _ref_m, targets = _resolve(name)
+    m.update(BATCHES_PREDS[0], targets[0])
+    restored = pickle.loads(pickle.dumps(m))
+    assert_close(restored.compute(), m.compute(), rtol=1e-6, atol=1e-7, label=f"{name} pickle")
+    before = np.asarray(m.compute())
+    m.update(BATCHES_PREDS[1], targets[1])
+    m.reset()
+    m.update(BATCHES_PREDS[0], targets[0])
+    assert_close(m.compute(), before, rtol=1e-6, atol=1e-7, label=f"{name} reset")

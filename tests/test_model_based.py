@@ -99,6 +99,23 @@ def test_mifid_runs():
     assert float(m.compute()) > 0
 
 
+def test_mifid_forward_does_not_mix_batch_and_history():
+    """forward swaps the feature stores with the array states: the batch value
+    must be computed from batch-only features on BOTH terms — with only one
+    side in the batch that is impossible, so it raises instead of silently
+    mixing batch FID stats with full-history memorization features."""
+    real = _rng.randn(100, 8).astype(np.float32)
+    fake = (_rng.randn(100, 8) + 0.5).astype(np.float32)
+    m = MemorizationInformedFrechetInceptionDistance()
+    m.update(jnp.asarray(real), real=True)
+    m.update(jnp.asarray(fake), real=False)
+    running = float(m.compute())
+    with pytest.raises((RuntimeError, ValueError)):
+        m(jnp.asarray(fake), real=False)  # batch has no real features
+    # the failed forward rolls everything back (state, count, compute cache)
+    np.testing.assert_allclose(float(m.compute()), running, rtol=1e-6)
+
+
 def test_lpips_identical_zero():
     net = lambda x: [x, x[:, :, ::2, ::2]]
     m = LearnedPerceptualImagePatchSimilarity(net=net)
