@@ -1,0 +1,80 @@
+"""Full-lifecycle sweeps for the clustering family via the shared harness.
+
+Label-based clustering metrics run the complete property set (accumulate vs
+sklearn golden, per-batch forward, pickle, 8-device mesh-sync); embedding
+metrics accumulate data + labels, so they get accumulate/pickle coverage with
+data batches. Reference analog: ``tests/unittests/clustering/``.
+"""
+
+import numpy as np
+import pytest
+
+from tests.helpers import run_class_test
+
+NUM_BATCHES = 5
+BATCH = 40
+_rng = np.random.RandomState(55)
+PREDS = [_rng.randint(0, 4, BATCH) for _ in range(NUM_BATCHES)]
+TARGET = [np.where(_rng.rand(BATCH) < 0.7, p, _rng.randint(0, 4, BATCH)) for p in PREDS]
+
+
+def _sk(name):
+    import sklearn.metrics as sk
+
+    return getattr(sk, name)
+
+
+def _cases():
+    from metrics_tpu.clustering import (
+        AdjustedMutualInfoScore,
+        AdjustedRandScore,
+        CompletenessScore,
+        FowlkesMallowsIndex,
+        HomogeneityScore,
+        MutualInfoScore,
+        NormalizedMutualInfoScore,
+        RandScore,
+        VMeasureScore,
+    )
+
+    return [
+        ("mutual_info", MutualInfoScore, {}, lambda p, t: _sk("mutual_info_score")(t, p)),
+        ("rand", RandScore, {}, lambda p, t: _sk("rand_score")(t, p)),
+        ("adjusted_rand", AdjustedRandScore, {}, lambda p, t: _sk("adjusted_rand_score")(t, p)),
+        ("fowlkes_mallows", FowlkesMallowsIndex, {}, lambda p, t: _sk("fowlkes_mallows_score")(t, p)),
+        ("homogeneity", HomogeneityScore, {}, lambda p, t: _sk("homogeneity_score")(t, p)),
+        ("completeness", CompletenessScore, {}, lambda p, t: _sk("completeness_score")(t, p)),
+        ("v_measure", VMeasureScore, {}, lambda p, t: _sk("v_measure_score")(t, p)),
+        ("nmi", NormalizedMutualInfoScore, {}, lambda p, t: _sk("normalized_mutual_info_score")(t, p)),
+        ("ami", AdjustedMutualInfoScore, {}, lambda p, t: _sk("adjusted_mutual_info_score")(t, p)),
+    ]
+
+
+@pytest.mark.parametrize("case", _cases(), ids=[c[0] for c in _cases()])
+def test_clustering_lifecycle(case):
+    name, cls, kwargs, ref = case
+    # clustering scores are not batch-decomposable → forward batch values are
+    # still exact (fresh-state compute on the batch), checked by the harness
+    run_class_test(cls, kwargs, PREDS, TARGET, ref, atol=1e-4)
+
+
+def test_embedding_metrics_accumulate_and_pickle():
+    import pickle
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.clustering import CalinskiHarabaszScore, DaviesBouldinScore
+
+    data = [_rng.randn(30, 5).astype(np.float32) + lab for lab, _ in enumerate(range(3))]
+    labels = [np.full(30, i) for i in range(3)]
+    import sklearn.metrics as sk
+
+    for cls, golden in ((CalinskiHarabaszScore, sk.calinski_harabasz_score),
+                        (DaviesBouldinScore, sk.davies_bouldin_score)):
+        m = cls()
+        for d, lab in zip(data, labels):
+            m.update(jnp.asarray(d), jnp.asarray(lab))
+        want = golden(np.concatenate(data), np.concatenate(labels))
+        np.testing.assert_allclose(float(m.compute()), want, rtol=1e-4)
+        restored = pickle.loads(pickle.dumps(m))
+        np.testing.assert_allclose(float(restored.compute()), want, rtol=1e-4)
