@@ -103,8 +103,34 @@ def allreduce_over_mesh(
     for st in per_rank_states:
         d = {}
         for k, v in st.items():
-            d[k] = jnp.concatenate([jnp.atleast_1d(x) for x in v]) if isinstance(v, list) else jnp.asarray(v)
+            if isinstance(v, list):
+                # a rank that never updated holds an empty list (reference
+                # no-data-rank contract, ``distributed.py:138-151``)
+                d[k] = jnp.concatenate([jnp.atleast_1d(x) for x in v]) if v else jnp.zeros((0,))
+            else:
+                d[k] = jnp.asarray(v)
         prepped.append(d)
+
+    # Ragged cat/gather states — ranks holding unequal sample counts, the
+    # reference's uneven-batch DDP contract (``distributed.py:138-151``) — ride
+    # the same collective at a fixed capacity: pad each rank to the max leading
+    # dim, sync, then trim the pad rows back out rank-by-rank on the host.
+    ragged: Dict[str, List[int]] = {}
+    for k in prepped[0]:
+        fx = reductions.get(k)
+        is_gatherish = fx is None or fx is dim_zero_cat or fx == "cat"
+        dims = [p[k].shape[0] if p[k].ndim else 0 for p in prepped]
+        if len(set(dims)) > 1 and not is_gatherish and callable(fx) and fx is not dim_zero_cat:
+            raise NotImplementedError(
+                f"State {k!r} has a custom dist_reduce_fx with unequal per-rank sizes {dims}; "
+                "the fold would consume pad rows inside the collective. Pad the per-rank states "
+                "to a common capacity (pad_to_capacity) before calling allreduce_over_mesh."
+            )
+        if is_gatherish and prepped[0][k].ndim and len(set(dims)) > 1:
+            cap = max(dims)
+            for p in prepped:
+                p[k], _ = pad_to_capacity(p[k], cap)
+            ragged[k] = dims
     stacked = {k: jnp.stack([p[k] for p in prepped]) for k in prepped[0]}
     specs = {k: P(axis_name, *([None] * (stacked[k].ndim - 1))) for k in stacked}
 
@@ -119,6 +145,15 @@ def allreduce_over_mesh(
         out_specs={k: P() for k in stacked},
         check_vma=False,
     )(stacked)
+    for k, dims in ragged.items():
+        cap = max(dims)
+        v = synced[k]
+        if reductions.get(k) is None:
+            # (world, cap, ...) gathered stack: trim each rank's pad rows → list of ragged arrays
+            synced[k] = [v[r, : dims[r]] for r in range(n)]
+        else:
+            # cat: (world*cap, ...) rank-major concat: splice out the valid spans
+            synced[k] = jnp.concatenate([v[r * cap : r * cap + dims[r]] for r in range(n)])
     return synced
 
 

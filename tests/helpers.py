@@ -113,12 +113,17 @@ def run_class_test(
             synced = allreduce_over_mesh(
                 [m.metric_state for m in rank_metrics], rank_metrics[0]._reductions
             )
-        except (TypeError, ValueError):
-            # ragged cat states can't ride the stacked mesh path; fall back to merge
+        except NotImplementedError:
+            # ragged custom-reduce states: explicitly unsupported by the stacked path
             synced = None
         if synced is not None:
             agg = metric_cls(**metric_args)
             agg._update_count = sum(m._update_count for m in rank_metrics)
             for k, v in synced.items():
-                agg._state[k] = [v] if isinstance(agg._state[k], list) else v
+                if isinstance(v, list):
+                    agg._state[k] = list(v)  # ragged None-reduce: per-rank arrays
+                elif isinstance(agg._state[k], list):
+                    agg._state[k] = [v]
+                else:
+                    agg._state[k] = v
             assert_allclose(agg.compute(), ref_total, atol=atol, msg=f"{metric_cls.__name__} mesh-sync")

@@ -44,6 +44,62 @@ def test_allreduce_list_state_cat():
     assert out["v"].shape == (8,)
 
 
+def test_allreduce_ragged_cat():
+    """Uneven per-rank sample counts (reference uneven-batch DDP, ``distributed.py:138-151``)."""
+    sizes = [3, 1, 4, 2]
+    states = [{"v": jnp.arange(s, dtype=jnp.float32) + 10.0 * r} for r, s in enumerate(sizes)]
+    out = allreduce_over_mesh(states, _reductions(v="cat"))
+    want = np.concatenate([np.arange(s, dtype=np.float32) + 10.0 * r for r, s in enumerate(sizes)])
+    assert out["v"].shape == (sum(sizes),)
+    np.testing.assert_allclose(np.asarray(out["v"]), want)
+
+
+def test_allreduce_ragged_none_reduce_keeps_per_rank_lists():
+    sizes = [2, 5, 1]
+    states = [{"v": jnp.ones((s, 3)) * r} for r, s in enumerate(sizes)]
+    out = allreduce_over_mesh(states, _reductions(v=None))
+    assert isinstance(out["v"], list) and len(out["v"]) == 3
+    for r, s in enumerate(sizes):
+        assert out["v"][r].shape == (s, 3)
+        np.testing.assert_allclose(np.asarray(out["v"][r]), np.ones((s, 3)) * r)
+
+
+def test_allreduce_ragged_spearman_matches_sequential():
+    """A real cat-state metric with uneven batches across ranks == single stream."""
+    from metrics_tpu.regression import SpearmanCorrCoef
+
+    rng = np.random.RandomState(8)
+    batches = [rng.rand(s).astype(np.float32) for s in (10, 4, 7, 3)]
+    targets = [rng.rand(s).astype(np.float32) for s in (10, 4, 7, 3)]
+    rank_metrics = [SpearmanCorrCoef() for _ in range(4)]
+    for m, p, t in zip(rank_metrics, batches, targets):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    synced = allreduce_over_mesh([m.metric_state for m in rank_metrics], rank_metrics[0]._reductions)
+    agg = SpearmanCorrCoef()
+    agg._update_count = 4
+    for k, v in synced.items():
+        agg._state[k] = [v] if isinstance(agg._state[k], list) else v
+    seq = SpearmanCorrCoef()
+    seq.update(jnp.asarray(np.concatenate(batches)), jnp.asarray(np.concatenate(targets)))
+    np.testing.assert_allclose(float(agg.compute()), float(seq.compute()), rtol=1e-5)
+
+
+def test_allreduce_empty_rank_cat():
+    """A rank that never updated (empty list state) contributes nothing (reference no-data contract)."""
+    states = [{"v": []}, {"v": [jnp.asarray([1.0, 2.0])]}, {"v": []}, {"v": [jnp.asarray([3.0])]}]
+    out = allreduce_over_mesh(states, _reductions(v="cat"))
+    np.testing.assert_allclose(np.asarray(out["v"]), [1.0, 2.0, 3.0])
+
+
+def test_allreduce_ragged_custom_reduce_raises_clearly():
+    def fold(stack):
+        return stack.sum(0)
+
+    states = [{"v": jnp.ones(2)}, {"v": jnp.ones(3)}]
+    with pytest.raises(NotImplementedError, match="pad_to_capacity"):
+        allreduce_over_mesh(states, _reductions(v=fold))
+
+
 def test_allreduce_vector_sum():
     states = [{"conf": jnp.ones((5, 5)) * i} for i in range(8)]
     out = allreduce_over_mesh(states, _reductions(conf="sum"))
