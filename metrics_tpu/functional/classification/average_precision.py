@@ -36,12 +36,22 @@ def _reduce_average_precision(
     recall: Union[Array, List[Array]],
     average: Optional[str] = "macro",
     weights: Optional[Array] = None,
+    nan_zero_positive_classes: bool = False,
 ) -> Array:
     """Reduce per-class AP into one number (reference ``average_precision.py:43-67``)."""
     if isinstance(precision, (jax.Array, jnp.ndarray)) and not isinstance(precision, list):
         res = -jnp.sum((recall[:, 1:] - recall[:, :-1]) * precision[:, :-1], axis=1)
     else:
         res = jnp.stack([-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)])
+        if nan_zero_positive_classes and weights is not None:
+            # MULTICLASS exact path only: a class with zero positives is NaN in
+            # the reference (its per-class compute passes class-index targets,
+            # so torch hits 0/0 recall); our curve substitutes recall=1
+            # (sklearn convention), so restore the NaN at the AP level.
+            # Multilabel's binarized targets DO trigger the reference's own
+            # recall=1 substitution (``precision_recall_curve.py:275-283``) —
+            # finite there — and the binned path stays -0.0 on both sides.
+            res = jnp.where(weights == 0, jnp.nan, res)
     if average is None or average == "none":
         return res
     nan = jnp.isnan(res)
@@ -122,6 +132,7 @@ def _multiclass_average_precision_compute(
             if thresholds is None
             else state[0][:, 1, :].sum(-1).astype(jnp.float32)
         ),
+        nan_zero_positive_classes=True,
     )
 
 

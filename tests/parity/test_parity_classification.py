@@ -254,3 +254,38 @@ def test_at_fixed_x(name):
         ref = getattr(tm.functional.classification, name)(t(p), t(g), thresholds=thresholds, **kw)
         got = getattr(ours, name)(jnp.asarray(p), jnp.asarray(g), thresholds=thresholds, **kw)
         assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"{name}[{thresholds}]")
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+@pytest.mark.parametrize("thresholds", [None, 50])
+@pytest.mark.parametrize("ignore_index", [None, 1])
+def test_multiclass_auroc_ap_full_grid(average, thresholds, ignore_index):
+    """average × thresholds × ignore_index grid for multiclass AUROC/AP (STATUS backlog)."""
+    tm = reference()
+    rng = np.random.RandomState(91)
+    p, g = _mc(rng, 180)
+    kwargs = dict(num_classes=NC, average=average, thresholds=thresholds, ignore_index=ignore_index)
+    ref = tm.functional.classification.multiclass_auroc(t(p), t(g), **kwargs)
+    got = ours.multiclass_auroc(jnp.asarray(p), jnp.asarray(g), **kwargs)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"mc_auroc[{average},{thresholds},{ignore_index}]")
+    ref = tm.functional.classification.multiclass_average_precision(t(p), t(g), **kwargs)
+    got = ours.multiclass_average_precision(jnp.asarray(p), jnp.asarray(g), **kwargs)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"mc_ap[{average},{thresholds},{ignore_index}]")
+
+
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_multilabel_ap_zero_positive_label_stays_finite(average):
+    """A label with zero positives: the reference's binarized-target path substitutes
+    recall=1 and returns a finite AP (unlike multiclass, which yields NaN)."""
+    tm = reference()
+    rng = np.random.RandomState(93)
+    p = rng.rand(80, NL).astype(np.float32)
+    g = rng.randint(0, 2, (80, NL))
+    g[:, 1] = 0  # label 1 never positive
+    ref = tm.functional.classification.multilabel_average_precision(
+        t(p), t(g), num_labels=NL, average=average, thresholds=None
+    )
+    got = ours.multilabel_average_precision(jnp.asarray(p), jnp.asarray(g), num_labels=NL,
+                                            average=average, thresholds=None)
+    assert not np.isnan(np.asarray(got)).any()
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"ml_ap_zero_pos[{average}]")
