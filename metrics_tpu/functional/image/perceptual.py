@@ -52,10 +52,17 @@ def _validate_lpips_images(img1: Array, img2: Array, normalize: bool) -> None:
         return (hi <= 1.0 and lo >= 0.0) if normalize else lo >= -1.0
 
     if not (ok(img1) and ok(img2)):
+        if isinstance(img1, jax.core.Tracer) or isinstance(img2, jax.core.Tracer):
+            # abstract values under jit: only shapes are known, so only shapes go in the message
+            ranges = ""
+        else:
+            ranges = (
+                f" and values in range {[float(img1.min()), float(img1.max())]}"
+                f" and {[float(img2.min()), float(img2.max())]}"
+            )
         raise ValueError(
             "Expected both input arguments to be normalized tensors with shape [N, 3, H, W]."
-            f" Got input with shape {img1.shape} and {img2.shape} and values in range"
-            f" {[float(img1.min()), float(img1.max())]} and {[float(img2.min()), float(img2.max())]}"
+            f" Got input with shape {img1.shape} and {img2.shape}{ranges}"
             f" when all values are expected to be in the {[0, 1] if normalize else [-1, 1]} range."
         )
 

@@ -100,16 +100,30 @@ def allreduce_over_mesh(
         mesh = build_mesh((axis_name,), devices=jax.devices()[:n])
     # list states: pre-concat per rank (reference metric.py:506-507), pad to common capacity
     prepped: List[Dict[str, Any]] = []
-    for st in per_rank_states:
+    empty_slots: List[Tuple[int, str]] = []
+    for i, st in enumerate(per_rank_states):
         d = {}
         for k, v in st.items():
             if isinstance(v, list):
                 # a rank that never updated holds an empty list (reference
                 # no-data-rank contract, ``distributed.py:138-151``)
-                d[k] = jnp.concatenate([jnp.atleast_1d(x) for x in v]) if v else jnp.zeros((0,))
+                if v:
+                    d[k] = jnp.concatenate([jnp.atleast_1d(x) for x in v])
+                else:
+                    d[k] = None
+                    empty_slots.append((i, k))
             else:
                 d[k] = jnp.asarray(v)
         prepped.append(d)
+    # Empty-rank placeholders take their dtype and trailing shape from a non-empty
+    # peer so the merged state is not silently promoted to float32 / flattened to 1-D;
+    # all-empty keys fall back to float32 (0,).
+    for i, k in empty_slots:
+        peer = next((p[k] for p in prepped if p[k] is not None), None)
+        if peer is not None:
+            prepped[i][k] = jnp.zeros((0,) + peer.shape[1:], peer.dtype)
+        else:
+            prepped[i][k] = jnp.zeros((0,))
 
     # Ragged cat/gather states — ranks holding unequal sample counts, the
     # reference's uneven-batch DDP contract (``distributed.py:138-151``) — ride
@@ -120,11 +134,12 @@ def allreduce_over_mesh(
         fx = reductions.get(k)
         is_gatherish = fx is None or fx is dim_zero_cat or fx == "cat"
         dims = [p[k].shape[0] if p[k].ndim else 0 for p in prepped]
-        if len(set(dims)) > 1 and not is_gatherish and callable(fx) and fx is not dim_zero_cat:
+        if len(set(dims)) > 1 and not is_gatherish:
             raise NotImplementedError(
-                f"State {k!r} has a custom dist_reduce_fx with unequal per-rank sizes {dims}; "
-                "the fold would consume pad rows inside the collective. Pad the per-rank states "
-                "to a common capacity (pad_to_capacity) before calling allreduce_over_mesh."
+                f"State {k!r} has dist_reduce_fx={fx!r} with unequal per-rank sizes {dims}; "
+                "non-concatenating reductions would consume pad rows inside the collective. Pad "
+                "the per-rank states to a common capacity (pad_to_capacity) before calling "
+                "allreduce_over_mesh."
             )
         if is_gatherish and prepped[0][k].ndim and len(set(dims)) > 1:
             cap = max(dims)

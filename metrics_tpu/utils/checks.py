@@ -135,6 +135,9 @@ def check_forward_full_state_property(
         res2 = partstate.compute()
         equal = equal and _allclose_recursive(res1, res2)
     except (RuntimeError, ValueError, TypeError):
+        # covers jax runtime failures too: XlaRuntimeError subclasses RuntimeError and
+        # ConcretizationTypeError subclasses TypeError. Anything else (AttributeError,
+        # KeyError, …) is a genuine metric bug and should propagate with its traceback.
         equal = False
 
     if not equal:

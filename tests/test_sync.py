@@ -100,6 +100,21 @@ def test_allreduce_ragged_custom_reduce_raises_clearly():
         allreduce_over_mesh(states, _reductions(v=fold))
 
 
+def test_allreduce_ragged_string_reduce_raises_clearly():
+    """A string reduction over unequal per-rank dims hits the same explicit guard."""
+    states = [{"v": jnp.ones(2)}, {"v": jnp.ones(3)}]
+    with pytest.raises(NotImplementedError, match="pad_to_capacity"):
+        allreduce_over_mesh(states, _reductions(v="sum"))
+
+
+def test_allreduce_empty_rank_cat_keeps_dtype_and_trailing_shape():
+    """Empty-rank placeholder inherits a non-empty peer's dtype and trailing dims."""
+    states = [{"v": []}, {"v": [jnp.ones((2, 3), dtype=jnp.int32)]}]
+    out = allreduce_over_mesh(states, _reductions(v="cat"))
+    assert out["v"].dtype == jnp.int32
+    assert out["v"].shape == (2, 3)
+
+
 def test_allreduce_vector_sum():
     states = [{"conf": jnp.ones((5, 5)) * i} for i in range(8)]
     out = allreduce_over_mesh(states, _reductions(conf="sum"))
