@@ -6,7 +6,7 @@ from typing import Any, List, Optional, Union
 
 from jax import Array
 
-from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.base import _plot_as_scalar, _ClassificationTaskWrapper
 from metrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
@@ -153,3 +153,5 @@ class AveragePrecision(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
             return MultilabelAveragePrecision(num_labels, average, **kwargs)
         raise ValueError(f"Not handled value: {task}")
+
+_plot_as_scalar(BinaryAveragePrecision, MulticlassAveragePrecision, MultilabelAveragePrecision)

@@ -25,3 +25,15 @@ class _ClassificationTaskWrapper(Metric):
     def compute(self) -> None:
         """Compute metric (unreachable: ``__new__`` returns a task class)."""
         raise NotImplementedError(f"{self.__class__.__name__} metric does not have a compute method.")
+
+
+def _plot_as_scalar(*classes: type) -> None:
+    """Rebind ``plot`` on scalar metrics that inherit curve/confmat state machinery.
+
+    AUROC, AveragePrecision, Jaccard, … subclass the PRC/ConfusionMatrix classes for
+    their states but produce plain values, so they must plot with the generic value
+    renderer, not the parent's curve/heatmap plot (the reference defines an explicit
+    generic ``plot`` on each such class, e.g. ``auroc.py:159``).
+    """
+    for cls in classes:
+        cls.plot = Metric.plot

@@ -6,7 +6,7 @@ from typing import Any, Optional
 
 from jax import Array
 
-from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.base import _plot_as_scalar, _ClassificationTaskWrapper
 from metrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
 from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_reduce
 from metrics_tpu.metric import Metric
@@ -121,3 +121,5 @@ class CohenKappa(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
             return MulticlassCohenKappa(num_classes, **kwargs)
         raise ValueError(f"Not handled value: {task}")
+
+_plot_as_scalar(BinaryCohenKappa, MulticlassCohenKappa)

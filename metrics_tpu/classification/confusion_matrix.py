@@ -29,6 +29,26 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utils.enums import ClassificationTask
 
 
+def _confusion_matrix_plot(self, val=None, ax=None, add_text: bool = True, labels=None, cmap=None):
+    """Render the confusion matrix as a heatmap (reference ``confusion_matrix.py:148-196``).
+
+    Args:
+        val: a ``compute()``/``forward()`` result to plot; defaults to ``compute()``.
+        ax: existing matplotlib axis to draw into.
+        add_text: write each cell's count into the heatmap.
+        labels: class-name strings for the axis ticks.
+        cmap: matplotlib colormap name.
+    """
+    from metrics_tpu.utils.plot import plot_confusion_matrix
+
+    import numpy as np
+
+    val = np.asarray(val if val is not None else self.compute())
+    if val.ndim not in (2, 3):
+        raise ValueError(f"Expected a (C, C) or (L, 2, 2) confusion matrix to plot, got shape {val.shape}")
+    return plot_confusion_matrix(val, ax=ax, add_text=add_text, labels=labels, cmap=cmap)
+
+
 class BinaryConfusionMatrix(Metric):
     """Compute the confusion matrix for binary tasks (reference ``classification/confusion_matrix.py:46-142``).
 
@@ -75,6 +95,8 @@ class BinaryConfusionMatrix(Metric):
     def compute(self) -> Array:
         """Compute confusion matrix."""
         return _binary_confusion_matrix_compute(self.confmat, self.normalize)
+
+    plot = _confusion_matrix_plot
 
 
 class MulticlassConfusionMatrix(Metric):
@@ -124,6 +146,8 @@ class MulticlassConfusionMatrix(Metric):
     def compute(self) -> Array:
         """Compute confusion matrix."""
         return _multiclass_confusion_matrix_compute(self.confmat, self.normalize)
+
+    plot = _confusion_matrix_plot
 
 
 class MultilabelConfusionMatrix(Metric):
@@ -177,6 +201,8 @@ class MultilabelConfusionMatrix(Metric):
     def compute(self) -> Array:
         """Compute confusion matrix."""
         return _multilabel_confusion_matrix_compute(self.confmat, self.normalize)
+
+    plot = _confusion_matrix_plot
 
 
 class ConfusionMatrix(_ClassificationTaskWrapper):

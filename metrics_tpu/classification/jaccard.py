@@ -6,7 +6,7 @@ from typing import Any, Optional
 
 from jax import Array
 
-from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.base import _plot_as_scalar, _ClassificationTaskWrapper
 from metrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     MulticlassConfusionMatrix,
@@ -185,3 +185,5 @@ class JaccardIndex(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
             return MultilabelJaccardIndex(num_labels, threshold, average, **kwargs)
         raise ValueError(f"Not handled value: {task}")
+
+_plot_as_scalar(BinaryJaccardIndex, MulticlassJaccardIndex, MultilabelJaccardIndex)

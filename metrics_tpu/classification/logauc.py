@@ -6,7 +6,7 @@ from typing import Any, List, Optional, Tuple, Union
 
 from jax import Array
 
-from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.base import _plot_as_scalar, _ClassificationTaskWrapper
 from metrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
@@ -165,3 +165,5 @@ class LogAUC(_ClassificationTaskWrapper):
         if not isinstance(num_labels, int):
             raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
         return MultilabelLogAUC(num_labels, fpr_range=fpr_range, average=average, **kwargs)
+
+_plot_as_scalar(BinaryLogAUC, MulticlassLogAUC, MultilabelLogAUC)

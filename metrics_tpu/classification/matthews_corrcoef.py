@@ -6,7 +6,7 @@ from typing import Any, Optional
 
 from jax import Array
 
-from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.base import _plot_as_scalar, _ClassificationTaskWrapper
 from metrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     MulticlassConfusionMatrix,
@@ -152,3 +152,5 @@ class MatthewsCorrCoef(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
             return MultilabelMatthewsCorrCoef(num_labels, threshold, **kwargs)
         raise ValueError(f"Not handled value: {task}")
+
+_plot_as_scalar(BinaryMatthewsCorrCoef, MulticlassMatthewsCorrCoef, MultilabelMatthewsCorrCoef)

@@ -6,7 +6,7 @@ from typing import Any, List, Optional, Tuple, Union
 
 from jax import Array
 
-from metrics_tpu.classification.base import _ClassificationTaskWrapper
+from metrics_tpu.classification.base import _plot_as_scalar, _ClassificationTaskWrapper
 from metrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
@@ -149,3 +149,5 @@ class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
         return MultilabelPrecisionAtFixedRecall(
             num_labels, min_recall, thresholds, ignore_index, validate_args, **kwargs
         )
+
+_plot_as_scalar(BinaryPrecisionAtFixedRecall, MulticlassPrecisionAtFixedRecall, MultilabelPrecisionAtFixedRecall)

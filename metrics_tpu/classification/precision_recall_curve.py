@@ -37,6 +37,35 @@ from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
+def _curve_family_plot(self, curve=None, score=None, ax=None, *, swap_xy, label_names, auc_direction):
+    """Shared curve renderer for the PRC/ROC families (reference ``precision_recall_curve.py:179-226``).
+
+    ``score=True`` (single-curve results only) annotates the plot with the trapezoidal
+    area under the drawn curve; an explicitly passed ``curve`` is plotted as-is.
+    """
+    from metrics_tpu.utils.compute import _auc_compute_without_check
+    from metrics_tpu.utils.plot import plot_curve
+
+    computed = curve if curve is not None else self.compute()
+    if swap_xy:  # standard presentation: recall along x, precision along y
+        computed = (computed[1], computed[0]) + tuple(computed[2:])
+    auc_score = None
+    if curve is None and score is True:
+        x, y = computed[0], computed[1]
+        if not isinstance(x, (list, tuple)) and jnp.asarray(x).ndim == 1:
+            auc_score = _auc_compute_without_check(jnp.asarray(x), jnp.asarray(y), auc_direction)
+    return plot_curve(
+        computed, score=auc_score, ax=ax, label_names=label_names, name=self.__class__.__name__
+    )
+
+
+def _precision_recall_curve_plot(self, curve=None, score=None, ax=None):
+    """Plot the precision-recall curve; see :func:`_curve_family_plot`."""
+    return _curve_family_plot(
+        self, curve, score, ax, swap_xy=True, label_names=("Recall", "Precision"), auc_direction=-1.0
+    )
+
+
 class BinaryPrecisionRecallCurve(Metric):
     """Precision-recall curve for binary tasks (reference ``classification/precision_recall_curve.py:40-195``).
 
@@ -98,6 +127,8 @@ class BinaryPrecisionRecallCurve(Metric):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _binary_precision_recall_curve_compute(state, self.thresholds)
 
+    plot = _precision_recall_curve_plot
+
 
 class MulticlassPrecisionRecallCurve(Metric):
     """Precision-recall curve for multiclass tasks (reference ``classification/precision_recall_curve.py:198-394``)."""
@@ -157,6 +188,8 @@ class MulticlassPrecisionRecallCurve(Metric):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multiclass_precision_recall_curve_compute(state, self.num_classes, self.thresholds, self.average)
 
+    plot = _precision_recall_curve_plot
+
 
 class MultilabelPrecisionRecallCurve(Metric):
     """Precision-recall curve for multilabel tasks (reference ``classification/precision_recall_curve.py:397-560``)."""
@@ -213,6 +246,8 @@ class MultilabelPrecisionRecallCurve(Metric):
         """Compute the curve."""
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multilabel_precision_recall_curve_compute(state, self.num_labels, self.thresholds, self.ignore_index)
+
+    plot = _precision_recall_curve_plot
 
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):

@@ -11,6 +11,7 @@ from metrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
     MultilabelPrecisionRecallCurve,
+    _curve_family_plot,
 )
 from metrics_tpu.functional.classification.roc import (
     _binary_roc_compute,
@@ -20,6 +21,16 @@ from metrics_tpu.functional.classification.roc import (
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _roc_plot(self, curve=None, score=None, ax=None):
+    """Plot the ROC curve: fpr along x, tpr along y (reference ``roc.py:125-131``)."""
+    return _curve_family_plot(
+        self, curve, score, ax,
+        swap_xy=False,
+        label_names=("False positive rate", "True positive rate"),
+        auc_direction=1.0,
+    )
 
 
 class BinaryROC(BinaryPrecisionRecallCurve):
@@ -40,6 +51,8 @@ class BinaryROC(BinaryPrecisionRecallCurve):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _binary_roc_compute(state, self.thresholds)
 
+    plot = _roc_plot
+
 
 class MulticlassROC(MulticlassPrecisionRecallCurve):
     """ROC for multiclass tasks (reference ``classification/roc.py:155-307``)."""
@@ -49,6 +62,8 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multiclass_roc_compute(state, self.num_classes, self.thresholds, self.average)
 
+    plot = _roc_plot
+
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
     """ROC for multilabel tasks (reference ``classification/roc.py:310-442``)."""
@@ -57,6 +72,8 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
         """Compute the ROC."""
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multilabel_roc_compute(state, self.num_labels, self.thresholds, self.ignore_index)
+
+    plot = _roc_plot
 
 
 class ROC(_ClassificationTaskWrapper):

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.metric import Metric
 from metrics_tpu.retrieval.base import GroupedQueries, RetrievalMetric
 from metrics_tpu.utils.compute import _safe_divide
 
@@ -260,6 +261,19 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         recall_k = (recall_kg * valid[None, :]).sum(axis=1) / denom
         return precision_k, recall_k, jnp.arange(1, max_k + 1)
 
+    def plot(self, curve: Optional[Tuple[Array, Array, Array]] = None, ax: Any = None):
+        """Plot the retrieval precision-recall curve (reference ``retrieval/precision_recall_curve.py:257-293``).
+
+        Recall runs along x and precision along y — the standard PR presentation
+        (the reference passes ROC axis labels here, an upstream labeling slip we
+        do not reproduce).
+        """
+        from metrics_tpu.utils.plot import plot_curve
+
+        computed = curve if curve is not None else self.compute()
+        curve_xy = (computed[1], computed[0]) + tuple(computed[2:])
+        return plot_curve(curve_xy, ax=ax, label_names=("Recall", "Precision"), name=self.__class__.__name__)
+
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
     """Highest recall@k with precision@k ≥ min_precision (reference ``retrieval/recall_fixed_precision.py:40``)."""
@@ -282,3 +296,8 @@ class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
             return jnp.asarray(0.0), jnp.asarray(int(k[-1]))
         best = int(np.argmax(np.where(ok, r, -1.0)))
         return jnp.asarray(r[best], dtype=jnp.float32), jnp.asarray(int(k[best]))
+
+    def plot(self, val: Any = None, ax: Any = None):
+        """Generic value plot of the best recall (reference ``precision_recall_curve.py:297,390-393``)."""
+        val = val if val is not None else self.compute()[0]
+        return Metric.plot(self, val, ax)

@@ -35,6 +35,9 @@ def plot_single_or_multi_val(
     import matplotlib.pyplot as plt
 
     fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    if isinstance(val, (list, tuple)) and val and isinstance(val[0], dict):
+        # a time series of result dicts → one series per key (reference plot.py:117-121)
+        val = {k: np.stack([np.asarray(v[k]) for v in val]) for k in val[0]}
     if isinstance(val, dict):
         for key, item in val.items():
             arr = np.atleast_1d(np.asarray(item))
@@ -75,8 +78,14 @@ def plot_confusion_matrix(
     confmat = np.asarray(confmat)
     if confmat.ndim == 3:
         nb, fig_label = confmat.shape[0], labels or [str(i) for i in range(confmat.shape[0])]
-        fig, axs = plt.subplots(nrows=1, ncols=nb, figsize=(4 * nb, 4))
-        axs = np.atleast_1d(axs)
+        if ax is not None:
+            axs = np.atleast_1d(np.asarray(ax, dtype=object))
+            if len(axs) != nb:
+                raise ValueError(f"Expected {nb} axes for a ({nb}, 2, 2) confusion matrix, got {len(axs)}")
+            fig = axs[0].get_figure()
+        else:
+            fig, axs = plt.subplots(nrows=1, ncols=nb, figsize=(4 * nb, 4))
+            axs = np.atleast_1d(axs)
         for i in range(nb):
             ax_i = axs[i]
             ax_i.imshow(confmat[i], cmap=cmap)
@@ -84,7 +93,7 @@ def plot_confusion_matrix(
             if add_text:
                 for r in range(2):
                     for c in range(2):
-                        ax_i.text(c, r, f"{confmat[i, r, c]:.0f}", ha="center", va="center")
+                        ax_i.text(c, r, str(round(confmat[i, r, c].item(), 2)), ha="center", va="center")
         return fig, axs
     fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
     im = ax.imshow(confmat, cmap=cmap)
@@ -98,7 +107,8 @@ def plot_confusion_matrix(
     if add_text:
         for r in range(n):
             for c in range(n):
-                ax.text(c, r, f"{confmat[r, c]:.0f}", ha="center", va="center")
+                # reference plot.py:291 renders round(val, 2): ints stay ints, normalized floats keep 2 dp
+                ax.text(c, r, str(round(confmat[r, c].item(), 2)), ha="center", va="center")
     return fig, ax
 
 
@@ -114,23 +124,26 @@ def plot_curve(
     _error_on_missing_matplotlib()
     import matplotlib.pyplot as plt
 
-    x, y = np.asarray(curve[0]), np.asarray(curve[1])
     fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
     if isinstance(curve[0], (list, tuple)) and not hasattr(curve[0], "ndim"):
+        # exact-path multiclass/multilabel curves are ragged: one array per class,
+        # potentially different lengths — never stack, plot per class
         for i, (xi, yi) in enumerate(zip(curve[0], curve[1])):
             ax.plot(np.asarray(xi), np.asarray(yi), label=f"{legend_name or 'class'} {i}")
         ax.legend()
-    elif x.ndim == 2:
-        for i in range(x.shape[0]):
-            ax.plot(x[i], y[i], label=f"{legend_name or 'class'} {i}")
-        ax.legend()
     else:
-        lbl = None
-        if score is not None:
-            lbl = f"AUC={float(np.asarray(score)):.3f}"
-        ax.plot(x, y, label=lbl)
-        if lbl:
+        x, y = np.asarray(curve[0]), np.asarray(curve[1])
+        if x.ndim == 2:
+            for i in range(x.shape[0]):
+                ax.plot(x[i], y[i], label=f"{legend_name or 'class'} {i}")
             ax.legend()
+        else:
+            lbl = None
+            if score is not None:
+                lbl = f"AUC={float(np.asarray(score)):.3f}"
+            ax.plot(x, y, label=lbl)
+            if lbl:
+                ax.legend()
     if label_names:
         ax.set_xlabel(label_names[0])
         ax.set_ylabel(label_names[1])

@@ -116,6 +116,13 @@ class MetricTracker(WrapperMetric):
             return (None, None) if return_step else None
         return (best_val, best_idx) if return_step else best_val
 
+    def plot(self, val: Any = None, ax: Any = None):
+        """Plot the tracked value(s) over steps (reference ``tracker.py:300-343``)."""
+        from metrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute_all()
+        return plot_single_or_multi_val(val, ax=ax, name=self.__class__.__name__)
+
     def reset(self) -> None:
         """Reset the current step's metric."""
         if self._history:

@@ -1,0 +1,372 @@
+"""Parametrized ``.plot()`` sweep across metric families.
+
+Models the reference's plot test module
+(``/root/reference/tests/unittests/utilities/test_plot.py``): every family's
+``.plot()`` must produce a matplotlib figure with the semantics the reference
+assigns to it — heatmaps for confusion matrices (``confusion_matrix.py:148``),
+x/y curves for the ROC/PRC families (``roc.py:125``), generic value plots for
+everything scalar.
+"""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import jax.numpy as jnp
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+import metrics_tpu.classification as C
+import metrics_tpu.clustering as CL
+import metrics_tpu.segmentation as S
+
+_R = np.random.RandomState(7)
+
+
+def _rand(*shape):
+    return jnp.asarray(_R.rand(*shape).astype(np.float32))
+
+
+def _randint(hi, *shape):
+    return jnp.asarray(_R.randint(0, hi, shape))
+
+
+# (ctor, input-builder) — one representative per family, spanning every domain package.
+GENERIC_CASES = [
+    pytest.param(lambda: C.BinaryAccuracy(), lambda: (_rand(10), _randint(2, 10)), id="BinaryAccuracy"),
+    pytest.param(
+        lambda: C.MulticlassAccuracy(num_classes=3), lambda: (_rand(10, 3), _randint(3, 10)), id="MulticlassAccuracy"
+    ),
+    pytest.param(
+        lambda: C.MultilabelFBetaScore(beta=2.0, num_labels=3),
+        lambda: (_rand(10, 3), _randint(2, 10, 3)),
+        id="MultilabelFBetaScore",
+    ),
+    pytest.param(lambda: C.BinaryHammingDistance(), lambda: (_rand(10), _randint(2, 10)), id="BinaryHammingDistance"),
+    pytest.param(lambda: C.BinaryCohenKappa(), lambda: (_rand(10), _randint(2, 10)), id="BinaryCohenKappa"),
+    pytest.param(lambda: C.BinarySpecificity(), lambda: (_rand(10), _randint(2, 10)), id="BinarySpecificity"),
+    pytest.param(
+        lambda: C.MulticlassExactMatch(num_classes=3),
+        lambda: (_randint(3, 4, 5), _randint(3, 4, 5)),
+        id="MulticlassExactMatch",
+    ),
+    pytest.param(lambda: C.BinaryCalibrationError(), lambda: (_rand(10), _randint(2, 10)), id="BinaryCalibrationError"),
+    pytest.param(
+        lambda: C.MultilabelRankingLoss(num_labels=3),
+        lambda: (_rand(8, 3), _randint(2, 8, 3)),
+        id="MultilabelRankingLoss",
+    ),
+    pytest.param(lambda: C.BinaryAUROC(), lambda: (_rand(10), _randint(2, 10)), id="BinaryAUROC"),
+    pytest.param(
+        lambda: C.MulticlassAveragePrecision(num_classes=3),
+        lambda: (_rand(10, 3), _randint(3, 10)),
+        id="MulticlassAveragePrecision",
+    ),
+    pytest.param(lambda: M.MeanSquaredError(), lambda: (_rand(10), _rand(10)), id="MeanSquaredError"),
+    pytest.param(lambda: M.PearsonCorrCoef(), lambda: (_rand(10), _rand(10)), id="PearsonCorrCoef"),
+    pytest.param(lambda: M.R2Score(), lambda: (_rand(10), _rand(10)), id="R2Score"),
+    pytest.param(lambda: M.KendallRankCorrCoef(), lambda: (_rand(10), _rand(10)), id="KendallRankCorrCoef"),
+    pytest.param(lambda: M.SpearmanCorrCoef(), lambda: (_rand(10), _rand(10)), id="SpearmanCorrCoef"),
+    pytest.param(lambda: M.ConcordanceCorrCoef(), lambda: (_rand(10), _rand(10)), id="ConcordanceCorrCoef"),
+    pytest.param(lambda: M.MinkowskiDistance(p=3), lambda: (_rand(10), _rand(10)), id="MinkowskiDistance"),
+    pytest.param(lambda: M.LogCoshError(), lambda: (_rand(10), _rand(10)), id="LogCoshError"),
+    pytest.param(lambda: M.ExplainedVariance(), lambda: (_rand(10), _rand(10)), id="ExplainedVariance"),
+    pytest.param(lambda: M.MeanMetric(), lambda: (_rand(10),), id="MeanMetric"),
+    pytest.param(lambda: M.SumMetric(), lambda: (_rand(10),), id="SumMetric"),
+    pytest.param(lambda: M.MaxMetric(), lambda: (_rand(10),), id="MaxMetric"),
+    pytest.param(lambda: M.RunningMean(window=3), lambda: (_rand(10),), id="RunningMean"),
+    pytest.param(lambda: M.CharErrorRate(), lambda: (["hello"], ["hallo"]), id="CharErrorRate"),
+    pytest.param(lambda: M.WordErrorRate(), lambda: (["a quick fox"], ["a fast fox"]), id="WordErrorRate"),
+    pytest.param(
+        lambda: M.BLEUScore(), lambda: (["the cat sat"], [["the cat sat on the mat"]]), id="BLEUScore"
+    ),
+    pytest.param(
+        lambda: M.PeakSignalNoiseRatio(), lambda: (_rand(2, 3, 8, 8), _rand(2, 3, 8, 8)), id="PeakSignalNoiseRatio"
+    ),
+    pytest.param(
+        lambda: M.StructuralSimilarityIndexMeasure(),
+        lambda: (_rand(2, 3, 16, 16), _rand(2, 3, 16, 16)),
+        id="StructuralSimilarityIndexMeasure",
+    ),
+    pytest.param(
+        lambda: M.UniversalImageQualityIndex(),
+        lambda: (_rand(2, 3, 16, 16), _rand(2, 3, 16, 16)),
+        id="UniversalImageQualityIndex",
+    ),
+    pytest.param(lambda: M.TotalVariation(), lambda: (_rand(2, 3, 8, 8),), id="TotalVariation"),
+    pytest.param(lambda: M.SignalNoiseRatio(), lambda: (_rand(16), _rand(16)), id="SignalNoiseRatio"),
+    pytest.param(
+        lambda: M.ScaleInvariantSignalDistortionRatio(),
+        lambda: (_rand(2, 16), _rand(2, 16)),
+        id="ScaleInvariantSignalDistortionRatio",
+    ),
+    pytest.param(lambda: CL.AdjustedRandScore(), lambda: (_randint(3, 12), _randint(3, 12)), id="AdjustedRandScore"),
+    pytest.param(
+        lambda: CL.NormalizedMutualInfoScore(), lambda: (_randint(3, 12), _randint(3, 12)), id="NormalizedMutualInfoScore"
+    ),
+    pytest.param(lambda: M.CramersV(num_classes=3), lambda: (_randint(3, 20), _randint(3, 20)), id="CramersV"),
+    pytest.param(lambda: M.TschuprowsT(num_classes=3), lambda: (_randint(3, 20), _randint(3, 20)), id="TschuprowsT"),
+    pytest.param(
+        lambda: S.MeanIoU(num_classes=3, input_format="index"),
+        lambda: (_randint(3, 2, 8, 8), _randint(3, 2, 8, 8)),
+        id="MeanIoU",
+    ),
+    pytest.param(
+        lambda: S.GeneralizedDiceScore(num_classes=3, input_format="index"),
+        lambda: (_randint(3, 2, 8, 8), _randint(3, 2, 8, 8)),
+        id="GeneralizedDiceScore",
+    ),
+    pytest.param(
+        lambda: M.MinMaxMetric(C.BinaryAccuracy()), lambda: (_rand(10), _randint(2, 10)), id="MinMaxMetric"
+    ),
+    pytest.param(
+        lambda: M.BootStrapper(M.MeanSquaredError(), num_bootstraps=4),
+        lambda: (_rand(10), _rand(10)),
+        id="BootStrapper",
+    ),
+    pytest.param(
+        lambda: M.ClasswiseWrapper(C.MulticlassAccuracy(num_classes=3, average=None)),
+        lambda: (_rand(10, 3), _randint(3, 10)),
+        id="ClasswiseWrapper",
+    ),
+    pytest.param(
+        lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2),
+        lambda: (_rand(10, 2), _rand(10, 2)),
+        id="MultioutputWrapper",
+    ),
+]
+
+
+@pytest.mark.parametrize(("ctor", "builder"), GENERIC_CASES)
+@pytest.mark.parametrize("num_vals", [1, 2])
+def test_plot_methods(ctor, builder, num_vals):
+    """Every family's ``.plot()`` returns a (fig, ax) pair for single and multi-step values."""
+    metric = ctor()
+    vals = [metric(*builder()) for _ in range(num_vals)]
+    fig, ax = metric.plot() if num_vals == 1 else metric.plot(vals)
+    assert isinstance(fig, plt.Figure)
+    assert ax is not None
+    plt.close("all")
+
+
+@pytest.mark.parametrize(
+    ("ctor", "builder", "n_axes"),
+    [
+        pytest.param(lambda: C.BinaryConfusionMatrix(), lambda: (_rand(10), _randint(2, 10)), 1, id="binary"),
+        pytest.param(
+            lambda: C.MulticlassConfusionMatrix(num_classes=3), lambda: (_rand(10, 3), _randint(3, 10)), 1,
+            id="multiclass",
+        ),
+        pytest.param(
+            lambda: C.MultilabelConfusionMatrix(num_labels=3), lambda: (_rand(10, 3), _randint(2, 10, 3)), 3,
+            id="multilabel",
+        ),
+    ],
+)
+@pytest.mark.parametrize("use_labels", [False, True])
+def test_confusion_matrix_plotter(ctor, builder, n_axes, use_labels):
+    """ConfusionMatrix plots render heatmaps (reference ``test_plot.py:842-857``)."""
+    metric = ctor()
+    metric.update(*builder())
+    labels = [f"c{i}" for i in range(n_axes if n_axes > 1 else metric.compute().shape[0])] if use_labels else None
+    fig, axs = metric.plot(add_text=True, labels=labels)
+    assert isinstance(fig, plt.Figure)
+    axs = np.atleast_1d(axs)
+    assert len(axs) == n_axes
+    for ax in axs:
+        assert len(ax.images) == 1, "confusion matrix must render as a heatmap image"
+        assert len(ax.texts) >= 4, "add_text must annotate every cell"
+    plt.close("all")
+
+
+@pytest.mark.parametrize(
+    ("ctor", "builder", "xlabel", "ylabel"),
+    [
+        pytest.param(
+            lambda t: C.BinaryROC(thresholds=t), lambda: (_rand(20), _randint(2, 20)),
+            "False positive rate", "True positive rate", id="BinaryROC",
+        ),
+        pytest.param(
+            lambda t: C.MulticlassROC(num_classes=3, thresholds=t), lambda: (_rand(20, 3), _randint(3, 20)),
+            "False positive rate", "True positive rate", id="MulticlassROC",
+        ),
+        pytest.param(
+            lambda t: C.MultilabelROC(num_labels=3, thresholds=t), lambda: (_rand(20, 3), _randint(2, 20, 3)),
+            "False positive rate", "True positive rate", id="MultilabelROC",
+        ),
+        pytest.param(
+            lambda t: C.BinaryPrecisionRecallCurve(thresholds=t), lambda: (_rand(20), _randint(2, 20)),
+            "Recall", "Precision", id="BinaryPRC",
+        ),
+        pytest.param(
+            lambda t: C.MulticlassPrecisionRecallCurve(num_classes=3, thresholds=t),
+            lambda: (_rand(20, 3), _randint(3, 20)), "Recall", "Precision", id="MulticlassPRC",
+        ),
+        pytest.param(
+            lambda t: C.MultilabelPrecisionRecallCurve(num_labels=3, thresholds=t),
+            lambda: (_rand(20, 3), _randint(2, 20, 3)), "Recall", "Precision", id="MultilabelPRC",
+        ),
+    ],
+)
+@pytest.mark.parametrize("thresholds", [None, 10])
+def test_plot_method_curve_metrics(ctor, builder, xlabel, ylabel, thresholds):
+    """Curve metrics draw x/y lines with the right axis semantics (reference ``test_plot.py:944-951``)."""
+    metric = ctor(thresholds)
+    metric.update(*builder())
+    fig, ax = metric.plot()
+    assert isinstance(fig, plt.Figure)
+    assert len(ax.lines) >= 1, "curve plot must draw at least one line"
+    assert ax.get_xlabel() == xlabel
+    assert ax.get_ylabel() == ylabel
+    plt.close("all")
+
+
+def test_binary_curve_score_annotation():
+    """``score=True`` annotates the binary curves with the trapezoidal AUC."""
+    preds, target = _rand(20), _randint(2, 20)
+    for metric in (C.BinaryROC(thresholds=None), C.BinaryPrecisionRecallCurve(thresholds=None)):
+        metric.update(preds, target)
+        fig, ax = metric.plot(score=True)
+        legend_texts = [t.get_text() for t in ax.get_legend().get_texts()]
+        assert any(t.startswith("AUC=") for t in legend_texts)
+    plt.close("all")
+
+
+def test_scalar_curve_subclasses_plot_generic():
+    """AUROC/AP/Jaccard inherit curve/confmat states but must plot as plain values."""
+    cases = [
+        (C.BinaryAUROC(), (_rand(10), _randint(2, 10))),
+        (C.BinaryAveragePrecision(), (_rand(10), _randint(2, 10))),
+        (C.MulticlassAUROC(num_classes=3), (_rand(10, 3), _randint(3, 10))),
+        (C.BinaryJaccardIndex(), (_randint(2, 10), _randint(2, 10))),
+        (C.MulticlassCohenKappa(num_classes=3), (_randint(3, 10), _randint(3, 10))),
+        (C.BinaryMatthewsCorrCoef(), (_randint(2, 10), _randint(2, 10))),
+    ]
+    for metric, args in cases:
+        metric.update(*args)
+        fig, ax = metric.plot()
+        assert not ax.images, f"{type(metric).__name__}.plot must NOT render a heatmap"
+        plt.close("all")
+
+
+def test_plot_methods_retrieval():
+    """Retrieval curve plots a PR curve; fixed-precision variant plots its best recall."""
+    indexes, preds, target = _randint(3, 20), _rand(20), _randint(2, 20)
+    curve = M.RetrievalPrecisionRecallCurve(max_k=4)
+    curve.update(preds, target, indexes=indexes)
+    fig, ax = curve.plot()
+    assert len(ax.lines) == 1
+    assert ax.get_xlabel() == "Recall" and ax.get_ylabel() == "Precision"
+
+    fixed = M.RetrievalRecallAtFixedPrecision(min_precision=0.2, max_k=4)
+    fixed.update(preds, target, indexes=indexes)
+    fig, ax = fixed.plot()
+    assert not ax.lines or ax.get_xlabel() != "Recall"
+
+    mrr = M.RetrievalMRR()
+    mrr.update(preds, target, indexes=indexes)
+    fig, ax = mrr.plot()
+    assert isinstance(fig, plt.Figure)
+    plt.close("all")
+
+
+@pytest.mark.parametrize("together", [True, False])
+def test_plot_method_collection(together):
+    """MetricCollection.plot: one figure per metric, or all series on one axis."""
+    mc = M.MetricCollection([C.BinaryAccuracy(), C.BinaryPrecision(), C.BinaryRecall()])
+    mc.update(_rand(10), _randint(2, 10))
+    out = mc.plot(together=together)
+    if together:
+        fig, ax = out
+        assert isinstance(fig, plt.Figure)
+    else:
+        assert len(out) == 3
+        assert all(isinstance(f, plt.Figure) for f, _ in out)
+    # list-of-step-results form
+    vals = [mc.compute(), mc.compute()]
+    out = mc.plot(vals, together=together)
+    plt.close("all")
+
+
+def test_plot_method_collection_invalid_args():
+    mc = M.MetricCollection([C.BinaryAccuracy()])
+    mc.update(_rand(10), _randint(2, 10))
+    with pytest.raises(ValueError, match="together"):
+        mc.plot(together="yes")
+    with pytest.raises(ValueError, match="sequence of matplotlib axis"):
+        mc.plot(ax=3, together=False)
+    plt.close("all")
+
+
+def test_tracker_plotter():
+    """Tracker plots the tracked value sequence over steps (reference ``test_plot.py:954-963``)."""
+    tracker = M.MetricTracker(C.BinaryAccuracy())
+    for _ in range(3):
+        tracker.increment()
+        tracker.update(_rand(10), _randint(2, 10))
+    fig, ax = tracker.plot()
+    assert isinstance(fig, plt.Figure)
+    assert len(ax.lines) == 1
+    assert len(ax.lines[0].get_xdata()) == 3, "one point per tracked step"
+    plt.close("all")
+
+
+def test_multitask_plotter():
+    """MultitaskWrapper plots one (fig, ax) per task."""
+    mt = M.MultitaskWrapper({"cls": C.BinaryAccuracy(), "reg": M.MeanSquaredError()})
+    mt.update(
+        {"cls": _rand(10), "reg": _rand(10)},
+        {"cls": _randint(2, 10), "reg": _rand(10)},
+    )
+    out = mt.plot()
+    assert len(out) == 2
+    assert all(isinstance(f, plt.Figure) for f, _ in out)
+    with pytest.raises(TypeError, match="Sequence"):
+        mt.plot(axes=3)
+    plt.close("all")
+
+
+def test_ragged_exact_curve_plot():
+    """Exact-path multiclass curves with tied scores are ragged per class — must still plot."""
+    metric = C.MulticlassPrecisionRecallCurve(num_classes=3, thresholds=None)
+    preds = jnp.round(_rand(30, 3), 1)  # quantized scores force duplicate thresholds
+    metric.update(preds, _randint(3, 30))
+    fig, ax = metric.plot()
+    assert len(ax.lines) == 3
+    plt.close("all")
+
+
+def test_multilabel_confmat_plot_into_existing_axes():
+    """A sequence of axes passed to the multilabel confmat plot is drawn into, not ignored."""
+    metric = C.MultilabelConfusionMatrix(num_labels=2)
+    metric.update(_rand(10, 2), _randint(2, 10, 2))
+    fig, axes = plt.subplots(ncols=2)
+    out_fig, out_axs = metric.plot(ax=axes)
+    assert out_fig is fig
+    assert all(len(a.images) == 1 for a in out_axs)
+    with pytest.raises(ValueError, match="Expected 2 axes"):
+        metric.plot(ax=axes[:1])
+    plt.close("all")
+
+
+def test_collection_plot_together_ax_validation():
+    mc = M.MetricCollection([C.BinaryAccuracy()])
+    mc.update(_rand(10), _randint(2, 10))
+    with pytest.raises(ValueError, match="matplotlib axis object"):
+        mc.plot(ax=[1, 2], together=True)
+    plt.close("all")
+
+
+def test_plot_with_existing_axis():
+    """Passing ``ax`` draws into the provided axis instead of a new figure."""
+    fig, ax = plt.subplots()
+    m = M.MeanMetric()
+    m.update(_rand(10))
+    out_fig, out_ax = m.plot(ax=ax)
+    assert out_ax is ax
+    assert out_fig is fig
+    plt.close("all")
