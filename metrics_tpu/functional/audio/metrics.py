@@ -6,9 +6,9 @@ autocorrelation + linear solve, ``:28-199``), ``pit.py`` (permutation search,
 
 * SDR's Toeplitz system is built with one FFT autocorrelation and solved with a
   dense ``jnp.linalg.solve`` (512×512) — batched over (batch, channel) by vmap.
-* PIT enumerates permutations statically (itertools at trace time) and reduces with
-  one stacked max/min — no host loop, no scipy Hungarian on the hot path (valid for
-  the ≤8-source regime; SURVEY §2.8).
+* PIT builds the pairwise metric matrix on device; the assignment is exhaustive
+  (static itertools enumeration, one stacked max/min) for <3 sources and a
+  Hungarian ``pure_callback`` beyond — O(S³), no factorial blowup (SURVEY §2.8).
 """
 
 from __future__ import annotations
@@ -18,9 +18,45 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _find_best_perm_by_linear_sum_assignment(metric_mtx: Array, eval_func: str) -> Tuple[Array, Array]:
+    """Hungarian assignment over the pairwise metric matrix (reference ``pit.py:42-66``).
+
+    ``metric_mtx`` is (batch, pred_spk, target_spk). The O(S³) scipy solve runs on the
+    host through ``jax.pure_callback`` so the surrounding program stays jittable; only
+    the (batch, S, S) matrix crosses the device boundary.
+
+    Returns ``(best_metric, best_perm)`` where ``best_perm[b, j]`` is the prediction
+    index assigned to target ``j`` — the ``pit_permutate`` convention.
+    """
+    maximize = eval_func == "max"
+    # rows = target, cols = pred so the assignment's column index is a pred per target
+    mtx_tp = jnp.swapaxes(metric_mtx, -1, -2)
+    batch, spk = mtx_tp.shape[0], mtx_tp.shape[1]
+
+    def _host_lsa(m):
+        from scipy.optimize import linear_sum_assignment
+
+        m = np.asarray(m)
+        return np.stack([linear_sum_assignment(row, maximize=maximize)[1] for row in m]).astype(np.int32)
+
+    # the assignment indices are a non-differentiable argmax-like choice — solve on a
+    # gradient-stopped copy so jax.grad still flows through best_metric below (the
+    # reference detaches before its scipy solve, pit.py:61)
+    best_perm = jax.pure_callback(
+        _host_lsa,
+        jax.ShapeDtypeStruct((batch, spk), jnp.int32),
+        jax.lax.stop_gradient(mtx_tp),
+        vmap_method="sequential",
+    )
+    best_metric = jnp.take_along_axis(mtx_tp, best_perm[:, :, None], axis=2)[..., 0].mean(-1)
+    return best_metric, best_perm
 
 
 def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
@@ -192,8 +228,15 @@ def permutation_invariant_training(
 ) -> Tuple[Array, Array]:
     """PIT (reference ``pit.py:42-135``): best metric over source permutations.
 
-    ``preds``/``target`` are (batch, spk, time). The S! permutations are enumerated
-    statically and reduced with one stacked max/min (S ≤ 8 regime).
+    ``preds``/``target`` are (batch, spk, time). Speaker-wise mode builds the
+    (batch, spk, spk) pairwise metric matrix on device; the assignment is then
+    solved exhaustively for S < 3 (S! tiny — stays on device, reference
+    ``pit.py:203-207``) or by the Hungarian algorithm via a host callback
+    (``scipy.optimize.linear_sum_assignment``, reference ``pit.py:42-66``) —
+    O(S³) instead of O(S!), so S = 8+ sources cost the same matrix build plus a
+    negligible host solve. ``jax.pure_callback`` keeps the whole function
+    jittable. Permutation-wise mode is exhaustive by construction (the metric is
+    a black box over whole permutations).
 
     >>> import jax.numpy as jnp
     >>> import numpy as np
@@ -211,9 +254,8 @@ def permutation_invariant_training(
     if mode not in ("speaker-wise", "permutation-wise"):
         raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
     spk = preds.shape[1]
-    perms = list(permutations(range(spk)))
     if mode == "speaker-wise":
-        # metric matrix (batch, pred_spk, target_spk), then sum per permutation
+        # metric matrix (batch, pred_spk, target_spk)
         metric_mtx = jnp.stack(
             [
                 jnp.stack([metric_func(preds[:, i], target[:, j], **kwargs) for j in range(spk)], axis=-1)
@@ -221,10 +263,21 @@ def permutation_invariant_training(
             ],
             axis=-2,
         )  # (batch, pred, target)
+        from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
+
+        if spk >= 3 and _SCIPY_AVAILABLE:
+            return _find_best_perm_by_linear_sum_assignment(metric_mtx, eval_func)
+        if spk >= 3:
+            # reachable in scipy-less installs: falls through to S! enumeration below
+            rank_zero_warn(
+                "In pit metric for speaker-num >= 3, we recommend installing scipy for better performance"
+            )
+        perms = list(permutations(range(spk)))
         perm_scores = jnp.stack(
             [metric_mtx[:, jnp.arange(spk), jnp.asarray(p)].mean(-1) for p in perms], axis=-1
         )  # (batch, n_perms)
     else:
+        perms = list(permutations(range(spk)))
         def _per_batch(p):
             v = metric_func(preds[:, jnp.asarray(p)], target, **kwargs)
             return v.reshape(v.shape[0], -1).mean(-1)  # (batch,) regardless of metric output rank
