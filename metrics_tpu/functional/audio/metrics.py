@@ -44,6 +44,8 @@ def _find_best_perm_by_linear_sum_assignment(metric_mtx: Array, eval_func: str) 
         from scipy.optimize import linear_sum_assignment
 
         m = np.asarray(m)
+        if m.shape[0] == 0:  # empty batch (e.g. an empty per-host shard): np.stack([]) would raise
+            return np.zeros((0, m.shape[1]), np.int32)
         return np.stack([linear_sum_assignment(row, maximize=maximize)[1] for row in m]).astype(np.int32)
 
     # the assignment indices are a non-differentiable argmax-like choice — solve on a

@@ -138,6 +138,14 @@ def test_pit_hungarian_many_sources(n_spk, eval_func):
     assert_close(got_perm, ref_perm, atol=0, label="pit_perm")
 
 
+def test_pit_hungarian_empty_batch():
+    """A zero-length batch (empty per-host shard) returns empty results, not a crash."""
+    bm, bp = ours.permutation_invariant_training(
+        jnp.zeros((0, 4, 32)), jnp.zeros((0, 4, 32)), ours.scale_invariant_signal_distortion_ratio
+    )
+    assert bm.shape == (0,) and bp.shape == (0, 4)
+
+
 def test_pit_hungarian_differentiable():
     """PIT stays usable as a training loss for S ≥ 3: grads flow through best_metric."""
     import jax

@@ -101,6 +101,35 @@ def test_lpips_end_to_end_parity_vs_reference_scorer(net_type, normalize):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.skipif(not os.path.isdir(_REF_LPIPS_DIR), reason="vendored lin weights not on disk")
+@pytest.mark.parametrize("net_type", ["vgg", "alex", "squeeze"])
+def test_lpips_hub_loader_real_lin_heads(net_type, tmp_path, monkeypatch):
+    """The production loader chain with GENUINE trained lin heads end to end.
+
+    Deploy recipe under test: drop a torchvision-layout backbone ``.pth`` plus the
+    reference's vendored lin-head file into the weights dir, point
+    ``METRICS_TPU_WEIGHTS`` at it, and call the metric — no injected callables.
+    """
+    import shutil
+
+    ref = _ref_lpips_module(net_type)
+    backbone_name = {"vgg": "vgg16", "alex": "alexnet", "squeeze": "squeezenet1_1"}[net_type]
+    torch.save(_tower_state_dict(ref.net), tmp_path / f"{backbone_name}.pth")
+    shutil.copy(os.path.join(_REF_LPIPS_DIR, f"{net_type}.pth"), tmp_path / f"lpips_{net_type}.pth")
+    monkeypatch.setenv("METRICS_TPU_WEIGHTS", str(tmp_path))
+
+    from metrics_tpu.image.lpips import LearnedPerceptualImagePatchSimilarity
+
+    x = _rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1
+    y = _rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        want = float(ref(torch.from_numpy(x), torch.from_numpy(y)).mean())
+
+    metric = LearnedPerceptualImagePatchSimilarity(net_type=net_type)
+    metric.update(jnp.asarray(x), jnp.asarray(y))
+    assert float(metric.compute()) == pytest.approx(want, rel=1e-4, abs=1e-5)
+
+
 @pytest.fixture(scope="module")
 def inception_pair():
     from tests._torch_inception import TorchInceptionV3FID
