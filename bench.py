@@ -45,6 +45,51 @@ MAP_IMGS = 50
 MAP_CLASSES = 5
 
 
+# ----------------------------------------------------------------- roofline
+# Estimated work per config (bytes moved through memory at least once, and
+# model FLOPs), so any run — especially on-chip — reports achieved bandwidth /
+# throughput and, when the device's peaks are known, utilization. Estimates are
+# lower bounds on traffic (ideal fusion); utilization numbers are therefore
+# upper bounds.
+def _roofline_model():
+    acc_bytes = ACC_STEPS * 2 * ACC_BATCH * 4  # preds+target int32 once each
+    col_bytes = COL_STEPS * 2 * COL_BATCH * 4
+    col_flops = COL_STEPS * 2 * COL_BATCH * ACC_CLASSES  # one-hot matmul bincount
+    ret_n = RET_QUERIES * RET_DOCS
+    ret_bytes = ret_n * 4 * 12  # sort + ~10 segment/cum passes over the flat arrays
+    ssim_elems = SSIM_STEPS * int(np.prod(SSIM_SHAPE))
+    ssim_flops = ssim_elems * (11 * 11) * 2 * 5  # 5 windowed moments per SSIM
+    ssim_bytes = ssim_elems * 4 * 12
+    return {
+        "accuracy": {"bytes": acc_bytes, "flops": ACC_STEPS * ACC_BATCH * 4},
+        "collection": {"bytes": col_bytes, "flops": col_flops},
+        "retrieval": {"bytes": ret_bytes, "flops": ret_n * 150},
+        "ssim_psnr": {"bytes": ssim_bytes, "flops": ssim_flops},
+        "mean_ap": {"bytes": 2e7, "flops": 5e7},  # ragged small-tensor regime; IoU matmuls dominate
+    }
+
+
+# device_kind → (peak FLOP/s in the dtype the configs use, peak HBM bytes/s)
+_PEAKS = {
+    "TPU v5 lite": (197e12, 8.19e11),
+    "TPU v5e": (197e12, 8.19e11),
+    "TPU v4": (275e12, 1.23e12),
+    "TPU v5p": (459e12, 2.77e12),
+}
+
+
+def _device_peaks():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    # exact match only: substring heuristics misattribute peaks to related chips
+    # (e.g. 'TPU v4i' has half the FLOPs of 'TPU v4')
+    for known, peaks in _PEAKS.items():
+        if kind.lower() == known.lower():
+            return kind, peaks
+    return kind, None
+
+
 def _best_of(fn, repeats=5):
     best = float("inf")
     out = None
@@ -349,6 +394,9 @@ def main():
         return
     _import_reference()
 
+    roofline = _roofline_model()
+    device_kind, peaks = _device_peaks()
+
     configs = {}
     speedups = []
     for name, fn in (
@@ -367,6 +415,16 @@ def main():
                 "speedup": round(speedup, 3),
                 "workload": what,
             }
+            rf = roofline.get(name)
+            if rf:
+                rl = {
+                    "achieved_gbps": round(rf["bytes"] / t_ours / 1e9, 2),
+                    "achieved_gflops": round(rf["flops"] / t_ours / 1e9, 2),
+                }
+                if peaks:
+                    rl["mfu"] = round(rf["flops"] / t_ours / peaks[0], 4)
+                    rl["hbm_util"] = round(rf["bytes"] / t_ours / peaks[1], 4)
+                configs[name]["roofline"] = rl
             speedups.append(speedup)
         except Exception as err:  # noqa: BLE001 — a failed config must not kill the bench line
             configs[name] = {"error": f"{type(err).__name__}: {err}"}
@@ -376,6 +434,7 @@ def main():
         "value": round(geomean, 3),
         "unit": "x vs reference (torch-CPU), 5 configs",
         "vs_baseline": round(geomean, 3),
+        "device_kind": device_kind,
         "configs": configs,
     }))
 

@@ -63,14 +63,43 @@ def _retrieval_aggregate(values: Array, aggregation: str = "mean", mask: Optiona
     return aggregation(values[np.asarray(mask)])
 
 
+def _order_by_query_desc(indexes: Array, values: Array) -> Array:
+    """Stable argsort by (query asc, value desc) — the grouping sort.
+
+    XLA's CPU sort is the dominant cost of retrieval compute (~60× slower than
+    numpy's introsort for 400k keys on this class of host), so on the ``cpu``
+    backend the argsort runs host-side through ``pure_callback`` on a single
+    64-bit composite key (query id in the high 32 bits, descending-sortable IEEE
+    bits of the value in the low 32). On accelerators the on-device ``lexsort``
+    is kept: the device→host transfer would cost more than the sort, and the
+    composite trick needs 64-bit integers that jax disables by default.
+    """
+    n = indexes.shape[0]
+    if jax.default_backend() != "cpu" or n == 0:
+        return jnp.lexsort((-values.astype(jnp.float32), indexes))
+
+    def _host(idx, vals):
+        v = np.ascontiguousarray(np.asarray(vals, dtype=np.float32))
+        v = np.where(v == 0.0, np.float32(0.0), v)  # collapse -0.0 with +0.0 (comparison semantics)
+        bits = v.view(np.uint32)
+        asc = np.where(bits >> 31 == 0, bits | np.uint32(0x80000000), ~bits)  # ascending-sortable IEEE key
+        asc = np.where(np.isnan(v), np.uint32(0), asc)  # NaN ranks last in DESC order, like jnp.lexsort
+        key = (np.asarray(idx).astype(np.uint64) << np.uint64(32)) | (~asc).astype(np.uint64)
+        return np.argsort(key, kind="stable").astype(np.int32)
+
+    return jax.pure_callback(
+        _host, jax.ShapeDtypeStruct((n,), jnp.int32), indexes, values, vmap_method="sequential"
+    )
+
+
 class GroupedQueries:
     """Flat sorted view over all queries + the segment quantities every metric needs.
 
-    Fully on-device (SURVEY §2.7): ONE ``jnp.lexsort`` by (query, -pred), group
-    ids compacted by neighbor comparison on the sorted keys, and every per-query
-    quantity a ``segment_sum``-style reduction. ``num_groups`` is the static
-    upper bound ``n`` (padding groups have ``n_docs == 0`` and are masked out),
-    so the whole view — and every metric built on it — traces under ``jit``.
+    SURVEY §2.7: ONE argsort by (query, -pred) (see :func:`_order_by_query_desc`),
+    group ids compacted by neighbor comparison on the sorted keys, and every
+    per-query quantity a ``segment_sum``-style reduction. ``num_groups`` is the
+    static upper bound ``n`` (padding groups have ``n_docs == 0`` and are masked
+    out), so the whole view — and every metric built on it — traces under ``jit``.
 
     Fields: ``rel`` (binary), ``graded`` (raw target), ``group_id``, ``pos``
     (0-based rank within query), ``n_rel``/``n_docs`` per group, and the
@@ -82,7 +111,7 @@ class GroupedQueries:
         preds = jnp.asarray(preds)
         target = jnp.asarray(target)
         n = int(preds.shape[0])
-        order = jnp.lexsort((-preds.astype(jnp.float32), indexes))
+        order = _order_by_query_desc(indexes, preds)
         self.order = order
         idx_sorted = indexes[order]
         new_group = jnp.concatenate([jnp.ones(1, bool), idx_sorted[1:] != idx_sorted[:-1]]) if n else jnp.zeros(0, bool)
@@ -110,7 +139,7 @@ class GroupedQueries:
         offset = jnp.concatenate([jnp.zeros(1), self.n_rel.cumsum()[:-1]]) if n else jnp.zeros(0)
         self.rel_cum = cum - offset[g]
         # ideal ordering (target desc within group) for NDCG
-        ideal_order = jnp.lexsort((-target.astype(jnp.float32), indexes))
+        ideal_order = _order_by_query_desc(indexes, target.astype(jnp.float32))
         self.ideal_graded = target[ideal_order].astype(jnp.float32)
 
     def seg_sum(self, x: Array) -> Array:
@@ -121,6 +150,56 @@ class GroupedQueries:
 
     def seg_max(self, x: Array) -> Array:
         return jax.ops.segment_max(x, self.group_id, self.num_groups)
+
+    _TREE_FIELDS = (
+        "order", "group_id", "preds", "graded", "rel", "n_docs", "n_rel", "pos", "rel_cum", "ideal_graded"
+    )
+
+    def as_tree(self) -> Dict[str, Array]:
+        """The view as a flat dict of arrays — the jit-crossable form."""
+        return {k: getattr(self, k) for k in self._TREE_FIELDS}
+
+    @classmethod
+    def from_tree(cls, tree: Dict[str, Array]) -> "GroupedQueries":
+        """Rebuild a view from :meth:`as_tree` arrays without re-sorting."""
+        gq = cls.__new__(cls)
+        for k in cls._TREE_FIELDS:
+            setattr(gq, k, tree[k])
+        gq.num_groups = tree["n_docs"].shape[0]
+        return gq
+
+
+# Sorted views shared across group-mate metrics, keyed by the identity of the
+# stored state arrays (MetricCollection compute groups alias the SAME list
+# objects across e.g. RetrievalMAP and RetrievalMRR, so the second metric's
+# compute reuses the first's sort; the reference re-sorts per metric,
+# ``base.py:148-191``). Anchors are held by WEAK reference: once the owning
+# metric resets or is freed, the entry dies with its states instead of pinning
+# up to several datasets' worth of sorted copies. A live weakref also makes
+# id-reuse false hits impossible — ref() returning an object proves identity.
+_VIEW_CACHE: Dict[Any, Any] = {}
+
+
+def shared_grouped_view(indexes: Array, preds: Array, target: Array, anchors: Any) -> GroupedQueries:
+    import weakref
+
+    for k in [k for k, (refs, _) in _VIEW_CACHE.items() if any(r() is None for r in refs)]:
+        _VIEW_CACHE.pop(k)
+    key = tuple(map(id, anchors))
+    hit = _VIEW_CACHE.get(key)
+    if hit is not None:
+        live = [r() for r in hit[0]]
+        if len(live) == len(anchors) and all(a is b for a, b in zip(live, anchors)):
+            return hit[1]
+    gq = GroupedQueries(indexes, preds, target)
+    try:
+        refs = tuple(weakref.ref(a) for a in anchors)
+    except TypeError:  # un-weakref-able anchor: serve the view uncached
+        return gq
+    _VIEW_CACHE[key] = (refs, gq)
+    while len(_VIEW_CACHE) > 4:
+        _VIEW_CACHE.pop(next(iter(_VIEW_CACHE)))
+    return gq
 
 
 class RetrievalMetric(Metric):
@@ -177,6 +256,11 @@ class RetrievalMetric(Metric):
 
     _empty_error_msg = "`compute` method was provided with a query with no positive target."
 
+    def _state_anchors(self) -> tuple:
+        """The identity key for :func:`shared_grouped_view` — single-sourced so every
+        compute path shares one view per state tuple."""
+        return tuple(self.indexes) + tuple(self.preds) + tuple(self.target)
+
     def _empty_mask(self, gq: GroupedQueries) -> Array:
         """Which (valid) groups count as "empty" for ``empty_target_action``."""
         return gq.n_rel == 0
@@ -194,25 +278,29 @@ class RetrievalMetric(Metric):
             n_rel = np.bincount(compact, weights=np.asarray(target) > 0)
             if bool((self._empty_counts_host(n_rel, np.bincount(compact))).any()):
                 raise ValueError(self._empty_error_msg)
+        if preds.shape[0] == 0:
+            return jnp.asarray(0.0)
+        # The sort-and-group view is built EAGERLY once per unique state tuple
+        # (true group count → small segment arrays) and shared across group-mate
+        # metrics; only the cheap scoring+aggregation runs as a per-class jitted
+        # program. Keyed by static config with a pristine-clone representative
+        # (same economics as Metric._lookup_shared_jit) so live instances — and
+        # their accumulated list states — are never pinned by the cache.
+        gq = shared_grouped_view(indexes, preds, target, self._state_anchors())
         if callable(self.aggregation) and not isinstance(self.aggregation, str):
-            return self.compute_flat(preds, target, indexes)  # host-side aggregation
-        # ONE compiled program for grouping + scoring + aggregation: ~3× faster
-        # than the eager op-by-op path even with the static n-bound segments.
-        # Keyed by static config with a pristine-clone representative (same
-        # economics as Metric._lookup_shared_jit) so live instances — and their
-        # accumulated list states — are never pinned by the cache.
+            return self._score_groups(gq)  # host-side aggregation — eager
         key = self._jit_cache_key()
         if key is None:
-            return self.compute_flat(preds, target, indexes)
+            return self._score_groups(gq)
         jitted = _JITTED_COMPUTE.get(key)
         if jitted is None:
             rep = self.clone()
             rep.reset()
-            jitted = jax.jit(rep.compute_flat)
+            jitted = jax.jit(lambda tree: rep._score_groups(GroupedQueries.from_tree(tree)))
             _JITTED_COMPUTE[key] = jitted
             if len(_JITTED_COMPUTE) > 128:
                 _JITTED_COMPUTE.pop(next(iter(_JITTED_COMPUTE)))
-        return jitted(preds, target, indexes)
+        return jitted(gq.as_tree())
 
     @staticmethod
     def _empty_counts_host(n_rel: "np.ndarray", n_docs: "np.ndarray") -> "np.ndarray":
@@ -230,7 +318,10 @@ class RetrievalMetric(Metric):
         """
         if preds.shape[0] == 0:
             return jnp.asarray(0.0)
-        gq = GroupedQueries(indexes, preds, target)
+        return self._score_groups(GroupedQueries(indexes, preds, target))
+
+    def _score_groups(self, gq: GroupedQueries) -> Array:
+        """Score every group and aggregate — the post-sort tail of the evaluation."""
         scores = self._metric_vectorized(gq)  # (num_groups,) under the static bound
         valid = gq.n_docs > 0
         empty = self._empty_mask(gq) & valid

@@ -232,10 +232,12 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         """Average precision/recall over queries at each k."""
         from metrics_tpu.utils.data import dim_zero_cat
 
+        from metrics_tpu.retrieval.base import shared_grouped_view
+
         indexes = dim_zero_cat(self.indexes)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
-        gq = GroupedQueries(indexes, preds, target)
+        gq = shared_grouped_view(indexes, preds, target, self._state_anchors())
         max_k = self.max_k or int(jnp.max(gq.n_docs))
         ks = jnp.arange(1, max_k + 1, dtype=jnp.float32)
         # hits@k per group: (G, K) via segment sums of rank masks

@@ -228,3 +228,44 @@ def test_retrieval_skip_action_masked_aggregation():
     m.update(preds, target, indexes=indexes)
     # queries 0 and 2 both have AP=1; query 1 skipped
     assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_host_sort_matches_device_lexsort_edge_values():
+    """The cpu-backend host argsort agrees with jnp.lexsort on NaN and ±0.0 keys."""
+    from metrics_tpu.retrieval.base import _order_by_query_desc
+
+    preds = jnp.asarray([0.5, np.nan, -0.0, 0.0, np.inf, -np.inf, 0.5], dtype=jnp.float32)
+    indexes = jnp.asarray([0, 0, 0, 0, 1, 1, 1])
+    got = np.asarray(_order_by_query_desc(indexes, preds))
+    want = np.asarray(jnp.lexsort((-preds, indexes)))
+    assert np.array_equal(got, want), (got, want)
+
+
+def test_shared_view_reused_across_group_mates_and_released_on_reset():
+    from metrics_tpu.retrieval.base import _VIEW_CACHE, shared_grouped_view
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(50).astype(np.float32))
+    target = jnp.asarray((rng.rand(50) < 0.3).astype(np.int64))
+    indexes = jnp.asarray(np.repeat(np.arange(5), 10))
+
+    m1, m2 = RetrievalMAP(), RetrievalMRR()
+    for m in (m1, m2):
+        m.update(preds, target, indexes=indexes)
+        m.compute()
+    # group-mate sharing: both metrics store the identical array objects, so one view
+    anchors = m1._state_anchors()
+    gq1 = shared_grouped_view(None, None, None, anchors)  # cache hit: inputs unused
+    assert gq1 is shared_grouped_view(None, None, None, m2._state_anchors())
+
+    # releasing the states kills the weakref anchors: nothing stays pinned
+    m1.reset(), m2.reset()
+    del preds, target, indexes, anchors, gq1
+    import gc
+
+    gc.collect()
+    assert all(any(r() is None for r in refs) for refs, _ in _VIEW_CACHE.values())
+    # the next call purges dead entries
+    p2 = jnp.asarray([0.5, 0.2]); t2 = jnp.asarray([1, 0]); i2 = jnp.asarray([0, 0])
+    shared_grouped_view(i2, p2, t2, (i2, p2, t2))
+    assert len(_VIEW_CACHE) == 1
