@@ -69,7 +69,9 @@ def _roofline_model():
     }
 
 
-# device_kind → (peak FLOP/s in the dtype the configs use, peak HBM bytes/s)
+# device_kind → (peak bf16 FLOP/s, peak HBM bytes/s). The configs run f32, whose
+# matmul peak is ~half the bf16 figure — the reported "mfu" is therefore a
+# conservative LOWER bound on utilization in the executed dtype.
 _PEAKS = {
     "TPU v5 lite": (197e12, 8.19e11),
     "TPU v5e": (197e12, 8.19e11),

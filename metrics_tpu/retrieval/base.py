@@ -190,6 +190,7 @@ def shared_grouped_view(indexes: Array, preds: Array, target: Array, anchors: An
     if hit is not None:
         live = [r() for r in hit[0]]
         if len(live) == len(anchors) and all(a is b for a, b in zip(live, anchors)):
+            _VIEW_CACHE[key] = _VIEW_CACHE.pop(key)  # LRU: reinsert so rotation over >4 views still hits
             return hit[1]
     gq = GroupedQueries(indexes, preds, target)
     try:
