@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import operator
 from abc import ABC, abstractmethod
 from collections import OrderedDict
 from copy import deepcopy
@@ -692,6 +693,21 @@ class Metric(ABC):
             for k, v in self.__dict__["_state"].items()
         }
 
+    def load_merged_state(self, merged: Dict[str, Any], update_count: int = 1) -> "Metric":
+        """Install a reduced/merged state dict (e.g. from ``allreduce_over_mesh``).
+
+        The receiving end of the offline fan-in and mesh-sync paths: cat-reduced
+        states arrive as single arrays and are rewrapped as one-element lists when
+        the state is list-typed. Returns ``self`` for chaining.
+        """
+        for k, v in merged.items():
+            if k not in self._state:
+                raise KeyError(f"Unknown state {k!r} for {self.__class__.__name__}")
+            self._state[k] = [v] if isinstance(self._state[k], list) and not isinstance(v, list) else v
+        self._update_count = update_count
+        self._computed = None
+        return self
+
     # ------------------------------------------------------------------ persistence
     def persistent(self, mode: bool = False) -> None:
         """Change post-init if metric states should be saved to state_dict (reference ``metric.py:919``)."""
@@ -793,7 +809,10 @@ class Metric(ABC):
         )
 
     def __hash__(self) -> int:
-        hash_vals: List[Any] = [self.__class__.__name__]
+        """Unique per instance AND per state (reference ``metric.py:1013-1031``): the
+        instance id keeps two same-class metrics distinct even with identical (e.g.
+        empty-list) states, and the state ids make the hash change as states do."""
+        hash_vals: List[Any] = [self.__class__.__name__, id(self)]
         for key in self._defaults:
             val = self._state[key]
             hash_vals.append(tuple(id(v) for v in val) if isinstance(val, list) else id(val))
@@ -805,44 +824,60 @@ class Metric(ABC):
     def __str__(self) -> str:
         return self.__repr__()
 
-    # ------------------------------------------------------------------ composition operators (reference metric.py:1038-1181)
-    def __add__(self, other): return CompositionalMetric(jnp.add, self, other)
-    def __radd__(self, other): return CompositionalMetric(jnp.add, other, self)
-    def __sub__(self, other): return CompositionalMetric(jnp.subtract, self, other)
-    def __rsub__(self, other): return CompositionalMetric(jnp.subtract, other, self)
-    def __mul__(self, other): return CompositionalMetric(jnp.multiply, self, other)
-    def __rmul__(self, other): return CompositionalMetric(jnp.multiply, other, self)
-    def __truediv__(self, other): return CompositionalMetric(jnp.divide, self, other)
-    def __rtruediv__(self, other): return CompositionalMetric(jnp.divide, other, self)
-    def __floordiv__(self, other): return CompositionalMetric(jnp.floor_divide, self, other)
-    def __rfloordiv__(self, other): return CompositionalMetric(jnp.floor_divide, other, self)
-    def __mod__(self, other): return CompositionalMetric(jnp.mod, self, other)
-    def __rmod__(self, other): return CompositionalMetric(jnp.mod, other, self)
-    def __pow__(self, other): return CompositionalMetric(jnp.power, self, other)
-    def __rpow__(self, other): return CompositionalMetric(jnp.power, other, self)
-    def __matmul__(self, other): return CompositionalMetric(jnp.matmul, self, other)
-    def __rmatmul__(self, other): return CompositionalMetric(jnp.matmul, other, self)
-    def __and__(self, other): return CompositionalMetric(jnp.bitwise_and, self, other)
-    def __rand__(self, other): return CompositionalMetric(jnp.bitwise_and, other, self)
-    def __or__(self, other): return CompositionalMetric(jnp.bitwise_or, self, other)
-    def __ror__(self, other): return CompositionalMetric(jnp.bitwise_or, other, self)
-    def __xor__(self, other): return CompositionalMetric(jnp.bitwise_xor, self, other)
-    def __rxor__(self, other): return CompositionalMetric(jnp.bitwise_xor, other, self)
-    def __eq__(self, other): return CompositionalMetric(jnp.equal, self, other)
-    def __ne__(self, other): return CompositionalMetric(jnp.not_equal, self, other)
-    def __ge__(self, other): return CompositionalMetric(jnp.greater_equal, self, other)
-    def __gt__(self, other): return CompositionalMetric(jnp.greater, self, other)
-    def __le__(self, other): return CompositionalMetric(jnp.less_equal, self, other)
-    def __lt__(self, other): return CompositionalMetric(jnp.less, self, other)
-    def __abs__(self): return CompositionalMetric(jnp.abs, self, None)
+    # ------------------------------------------------------------------ composition operators (reference metric.py:1038-1181).
+    # operator.* / module-level callables keep CompositionalMetric picklable (jnp ufunc
+    # wrappers are not).
+    def __add__(self, other): return CompositionalMetric(operator.add, self, other)
+    def __radd__(self, other): return CompositionalMetric(operator.add, other, self)
+    def __sub__(self, other): return CompositionalMetric(operator.sub, self, other)
+    def __rsub__(self, other): return CompositionalMetric(operator.sub, other, self)
+    def __mul__(self, other): return CompositionalMetric(operator.mul, self, other)
+    def __rmul__(self, other): return CompositionalMetric(operator.mul, other, self)
+    def __truediv__(self, other): return CompositionalMetric(operator.truediv, self, other)
+    def __rtruediv__(self, other): return CompositionalMetric(operator.truediv, other, self)
+    def __floordiv__(self, other): return CompositionalMetric(operator.floordiv, self, other)
+    def __rfloordiv__(self, other): return CompositionalMetric(operator.floordiv, other, self)
+    def __mod__(self, other): return CompositionalMetric(operator.mod, self, other)
+    def __rmod__(self, other): return CompositionalMetric(operator.mod, other, self)
+    def __pow__(self, other): return CompositionalMetric(operator.pow, self, other)
+    def __rpow__(self, other): return CompositionalMetric(operator.pow, other, self)
+    def __matmul__(self, other): return CompositionalMetric(operator.matmul, self, other)
+    def __rmatmul__(self, other): return CompositionalMetric(operator.matmul, other, self)
+    def __and__(self, other): return CompositionalMetric(operator.and_, self, other)
+    def __rand__(self, other): return CompositionalMetric(operator.and_, other, self)
+    def __or__(self, other): return CompositionalMetric(operator.or_, self, other)
+    def __ror__(self, other): return CompositionalMetric(operator.or_, other, self)
+    def __xor__(self, other): return CompositionalMetric(operator.xor, self, other)
+    def __rxor__(self, other): return CompositionalMetric(operator.xor, other, self)
+    def __eq__(self, other): return CompositionalMetric(operator.eq, self, other)
+    def __ne__(self, other): return CompositionalMetric(operator.ne, self, other)
+    def __ge__(self, other): return CompositionalMetric(operator.ge, self, other)
+    def __gt__(self, other): return CompositionalMetric(operator.gt, self, other)
+    def __le__(self, other): return CompositionalMetric(operator.le, self, other)
+    def __lt__(self, other): return CompositionalMetric(operator.lt, self, other)
+    def __abs__(self): return CompositionalMetric(operator.abs, self, None)
     def __neg__(self): return CompositionalMetric(_neg, self, None)
-    def __pos__(self): return CompositionalMetric(jnp.abs, self, None)
-    def __invert__(self): return CompositionalMetric(jnp.logical_not, self, None)
-    def __getitem__(self, idx): return CompositionalMetric(lambda x: x[idx], self, None)
+    def __pos__(self): return CompositionalMetric(operator.abs, self, None)
+    def __invert__(self): return CompositionalMetric(_logical_not, self, None)
+    def __getitem__(self, idx): return CompositionalMetric(_Indexer(idx), self, None)
 
 
 def _neg(x: Array) -> Array:
     return -jnp.abs(x)
+
+
+def _logical_not(x: Array) -> Array:
+    return jnp.logical_not(x)
+
+
+class _Indexer:
+    """Picklable ``x[idx]`` callable for ``Metric.__getitem__`` compositions."""
+
+    def __init__(self, idx: Any) -> None:
+        self.idx = idx
+
+    def __call__(self, x: Array) -> Array:
+        return x[self.idx]
 
 
 def _squeeze_if_scalar(data: Any) -> Any:

@@ -1,0 +1,248 @@
+"""Saving/loading and hashing edge coverage.
+
+Models the reference's ``tests/unittests/bases/test_saving_loading.py`` and
+``test_hashing.py``: persistent-flag semantics through ``state_dict`` round
+trips (including list states, prefixes, and strict loading) and the identity
+hash contract.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import BootStrapper, CatMetric, MeanMetric, MetricCollection
+from metrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from metrics_tpu.regression import SpearmanCorrCoef
+
+_R = np.random.RandomState(11)
+
+
+@pytest.mark.parametrize("persistent", [True, False])
+def test_saving_loading_roundtrip(tmp_path, persistent):
+    """state_dict export → file → load restores persistent states (and only those)."""
+    metric1 = MulticlassAccuracy(num_classes=5)
+    metric1.persistent(persistent)
+    metric1.update(jnp.asarray(_R.randint(0, 5, 100)), jnp.asarray(_R.randint(0, 5, 100)))
+    path = tmp_path / "metric.pkl"
+    with open(path, "wb") as fh:
+        pickle.dump(metric1.state_dict(), fh)
+
+    metric2 = MulticlassAccuracy(num_classes=5)
+    with open(path, "rb") as fh:
+        metric2.load_state_dict(pickle.load(fh), strict=False)
+
+    for k, v in metric1.metric_state.items():
+        v2 = metric2.metric_state[k]
+        if persistent:
+            np.testing.assert_allclose(np.asarray(v), np.asarray(v2))
+        else:
+            # nothing was exported: the target keeps its defaults
+            assert not np.allclose(np.asarray(v), np.asarray(v2))
+    if persistent:
+        assert float(metric2.compute()) == pytest.approx(float(metric1.compute()))
+
+
+def test_saving_loading_list_state_roundtrip(tmp_path):
+    """List (cat) states survive the round trip element by element."""
+    metric1 = SpearmanCorrCoef()
+    metric1.persistent(True)
+    for _ in range(3):
+        metric1.update(jnp.asarray(_R.rand(7).astype(np.float32)), jnp.asarray(_R.rand(7).astype(np.float32)))
+    sd = metric1.state_dict()
+    assert isinstance(sd["preds"], list) and len(sd["preds"]) == 3
+
+    metric2 = SpearmanCorrCoef()
+    metric2.load_state_dict(sd)
+    assert float(metric2.compute()) == pytest.approx(float(metric1.compute()), rel=1e-6)
+
+
+def test_state_dict_prefix_and_strict():
+    metric = MeanMetric()
+    metric.persistent(True)
+    metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+    sd = metric.state_dict(prefix="logbook.acc.")
+    assert all(k.startswith("logbook.acc.") for k in sd)
+
+    target = MeanMetric()
+    target.persistent(True)
+    target.load_state_dict(sd, prefix="logbook.acc.")
+    assert float(target.compute()) == pytest.approx(2.0)
+
+    strict_metric = MeanMetric()
+    strict_metric.persistent(True)  # only persistent states are required on strict load
+    with pytest.raises(RuntimeError, match="Missing key"):
+        strict_metric.load_state_dict({}, strict=True)
+    # non-persistent states are never required, matching the reference's buffer semantics
+    MeanMetric().load_state_dict({}, strict=True)
+    MeanMetric().load_state_dict({}, strict=False)
+
+
+def test_state_dict_update_count_piggyback():
+    """_update_count rides the state_dict so warnings/merge semantics resume correctly."""
+    metric = MeanMetric()
+    metric.persistent(True)
+    metric.update(jnp.asarray([1.0]))
+    metric.update(jnp.asarray([2.0]))
+    fresh = MeanMetric()
+    fresh.load_state_dict(metric.state_dict())
+    assert fresh._update_count == 2
+
+
+def test_pickle_whole_metric_mid_lifecycle():
+    """A metric pickled after updates computes identically when restored."""
+    metric = CatMetric()
+    metric.update(jnp.asarray([1.0, 2.0]))
+    metric.update(jnp.asarray([3.0]))
+    clone = pickle.loads(pickle.dumps(metric))
+    np.testing.assert_allclose(np.asarray(clone.compute()), [1.0, 2.0, 3.0])
+    clone.update(jnp.asarray([4.0]))  # restored metric keeps accepting updates
+    assert np.asarray(clone.compute()).shape == (4,)
+
+
+def test_collection_state_dict_roundtrip():
+    """Per-metric state_dicts with prefixes reassemble a collection."""
+    col = MetricCollection({"acc": BinaryAccuracy(), "mean": MeanMetric()})
+    col["acc"].persistent(True)
+    col["mean"].persistent(True)
+    col.update(jnp.asarray([0.9, 0.2, 0.8]), jnp.asarray([1, 0, 0]))
+    col["mean"].update(jnp.asarray([5.0]))
+
+    sd = {}
+    for name, m in col.items():
+        m.state_dict(destination=sd, prefix=f"{name}.")
+
+    col2 = MetricCollection({"acc": BinaryAccuracy(), "mean": MeanMetric()})
+    for name, m in col2.items():
+        m.load_state_dict(sd, prefix=f"{name}.")
+    want, got = col.compute(), col2.compute()
+    assert set(want) == set(got)
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]))
+
+
+@pytest.mark.parametrize("ctor", [lambda: MeanMetric(), lambda: CatMetric(), lambda: SpearmanCorrCoef()])
+def test_metric_hashing_distinct_instances(ctor):
+    """Two instances never hash equal (hash follows state identity, reference test_hashing.py)."""
+    a, b = ctor(), ctor()
+    assert hash(a) != hash(b)
+    assert id(a) != id(b)
+
+
+def test_hash_changes_when_state_changes():
+    metric = CatMetric()
+    h0 = hash(metric)
+    metric.update(jnp.asarray([1.0]))
+    assert hash(metric) != h0
+
+
+def test_wrapper_hashing_distinct():
+    a = BootStrapper(MeanMetric(), num_bootstraps=2)
+    b = BootStrapper(MeanMetric(), num_bootstraps=2)
+    assert hash(a) != hash(b)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+def test_set_dtype_casts_all_states(dtype):
+    """half()/set_dtype casts scalar AND list states (reference metric.py:883-917)."""
+    m = SpearmanCorrCoef()
+    m.update(jnp.asarray([0.1, 0.5, 0.9]), jnp.asarray([0.2, 0.4, 0.8]))
+    m.set_dtype(dtype)
+    assert all(v.dtype == dtype for v in m._state["preds"])
+
+
+def test_half_float_double_roundtrip():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    assert m.half()._state["mean_value"].dtype == jnp.bfloat16
+    assert m.float()._state["mean_value"].dtype == jnp.float32
+
+
+def test_clone_is_state_independent():
+    a = MeanMetric()
+    a.update(jnp.asarray([1.0]))
+    b = a.clone()
+    b.update(jnp.asarray([3.0]))
+    assert float(a.compute()) == pytest.approx(1.0)
+    assert float(b.compute()) == pytest.approx(2.0)
+
+
+def test_load_state_dict_invalidates_compute_cache():
+    """A stale cached compute() must not survive a state load."""
+    m = MeanMetric()
+    m.persistent(True)
+    m.update(jnp.asarray([2.0]))
+    donor = MeanMetric()
+    donor.persistent(True)
+    donor.update(jnp.asarray([10.0]))
+    assert float(m.compute()) == pytest.approx(2.0)  # populates the cache
+    m.load_state_dict(donor.state_dict())
+    assert float(m.compute()) == pytest.approx(10.0)
+
+
+def test_add_state_persistent_kwarg_controls_export():
+    """Per-state persistent flags: only flagged states are exported."""
+    from metrics_tpu.metric import Metric
+
+    class Mixed(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("kept", jnp.asarray(0.0), dist_reduce_fx="sum", persistent=True)
+            self.add_state("dropped", jnp.asarray(0.0), dist_reduce_fx="sum", persistent=False)
+
+        def update(self, x):
+            self.kept = self.kept + x
+            self.dropped = self.dropped + x
+
+        def compute(self):
+            return self.kept + self.dropped
+
+    m = Mixed()
+    m.update(jnp.asarray(3.0))
+    sd = m.state_dict()
+    assert "kept" in sd and "dropped" not in sd
+
+
+def test_compositional_metric_pickles():
+    m1, m2 = MeanMetric(), MeanMetric()
+    comp = m1 + m2
+    m1.update(jnp.asarray([2.0]))
+    m2.update(jnp.asarray([3.0]))
+    restored = pickle.loads(pickle.dumps(comp))
+    assert float(restored.compute()) == pytest.approx(5.0)
+
+
+def test_state_dict_is_host_resident():
+    """Exports are numpy arrays, safe to serialize without a live jax backend."""
+    m = MeanMetric()
+    m.persistent(True)
+    m.update(jnp.asarray([4.0]))
+    sd = m.state_dict()
+    assert all(isinstance(v, (np.ndarray, int, float)) for v in sd.values())
+
+
+@pytest.mark.parametrize(
+    ("expr", "want"),
+    [
+        (lambda a, b: a + b, 5.0),
+        (lambda a, b: a - b, -1.0),
+        (lambda a, b: a * b, 6.0),
+        (lambda a, b: a / b, 2.0 / 3.0),
+        (lambda a, b: a**b, 8.0),
+        (lambda a, b: abs(a - b), 1.0),
+        (lambda a, b: a > b, 0.0),
+        (lambda a, b: a <= b, 1.0),
+        (lambda a, b: 1.0 + a, 3.0),
+    ],
+)
+def test_composition_operator_sweep(expr, want):
+    """Every overloaded operator composes metrics AND survives pickling."""
+    m1, m2 = MeanMetric(), MeanMetric()
+    comp = expr(m1, m2)
+    m1.update(jnp.asarray([2.0]))
+    m2.update(jnp.asarray([3.0]))
+    assert float(comp.compute()) == pytest.approx(want)
+    assert float(pickle.loads(pickle.dumps(comp)).compute()) == pytest.approx(want)

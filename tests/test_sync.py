@@ -167,3 +167,60 @@ def test_metric_state_through_mesh_equals_sequential():
         m.update(jnp.asarray(row))
     out = allreduce_over_mesh([m.metric_state for m in ms], ms[0]._reductions)
     np.testing.assert_allclose(float(out["x"]), data.sum(), rtol=1e-4)
+
+
+# ---------------------------------------------------------------- multihost eager gather
+def test_gather_all_states_ragged_pad_gather_trim(monkeypatch):
+    """The multihost eager path (gather_all_states) with UNEVEN per-host sizes.
+
+    ``process_allgather`` is mocked to emulate a 4-host world from host 0's seat:
+    the size exchange returns every host's leading dim, the padded gather returns
+    the stacked padded buffers — the function must trim each host back to its
+    true size (reference ``distributed.py:138-151``).
+    """
+    from metrics_tpu.parallel import sync as sync_mod
+
+    sizes = [2, 0, 5, 1]
+    host_states = [np.arange(k * 3, dtype=np.float32).reshape(k, 3) + 100 * r for r, k in enumerate(sizes)]
+
+    def fake_allgather(x):
+        x = np.asarray(x)
+        if x.ndim == 0:  # the size exchange
+            return jnp.asarray(sizes)
+        cap = x.shape[0]
+        np.testing.assert_allclose(x[: sizes[0]], host_states[0])  # host 0 sends its padded state
+        stacked = [np.pad(h, [(0, cap - h.shape[0]), (0, 0)]) for h in host_states]
+        return jnp.asarray(np.stack(stacked))
+
+    monkeypatch.setattr("jax.process_count", lambda: 4)
+    monkeypatch.setattr("jax.experimental.multihost_utils.process_allgather", fake_allgather)
+
+    out = sync_mod.gather_all_states([jnp.asarray(host_states[0])])
+    assert len(out) == 1 and len(out[0]) == 4
+    for r, k in enumerate(sizes):
+        assert out[0][r].shape == (k, 3)
+        np.testing.assert_allclose(np.asarray(out[0][r]), host_states[r])
+
+
+def test_gather_all_states_scalar_and_empty_list(monkeypatch):
+    """Scalar states gather without padding; an empty-list state becomes a (0,) buffer."""
+    from metrics_tpu.parallel import sync as sync_mod
+
+    scalar_vals = [3.0, 7.0, 1.0, 5.0]
+    calls = {"n": 0}
+
+    def fake_allgather(x):
+        x = np.asarray(x)
+        calls["n"] += 1
+        if x.ndim == 0 and calls["n"] % 2 == 1:  # odd calls: the size exchange (all hosts alike)
+            return jnp.asarray([int(x)] * 4)
+        if x.ndim == 0:  # scalar state gather
+            return jnp.asarray(scalar_vals)
+        return jnp.asarray(np.stack([np.asarray(x)] * 4))  # empty buffers: all hosts alike
+
+    monkeypatch.setattr("jax.process_count", lambda: 4)
+    monkeypatch.setattr("jax.experimental.multihost_utils.process_allgather", fake_allgather)
+
+    out = sync_mod.gather_all_states([jnp.asarray(3.0), []])
+    np.testing.assert_allclose([float(v) for v in out[0]], scalar_vals)
+    assert all(v.shape == (0,) for v in out[1])
