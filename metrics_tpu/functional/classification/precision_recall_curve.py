@@ -147,22 +147,64 @@ def _binary_precision_recall_curve_format(
     return preds, target, thresholds
 
 
+def _binned_confusion_tensor(preds: Array, target01: Array, valid: Array, thresholds: Array) -> Array:
+    """(N, C) scores → the (T, C, 2, 2) multi-threshold confusion tensor.
+
+    O(N·C) redesign of the reference's O(N·C·T) broadcast-compare scatter
+    (``precision_recall_curve.py:189-252``): ``p >= thr_t`` for every t at once is
+    a THRESHOLD-BUCKET index (``searchsorted``), so one histogram over (C, T+1)
+    buckets plus a suffix cumsum yields every tp/fp count — no (N, C, T)
+    intermediate ever exists. T-fold less memory traffic, and the bucket compare
+    runs once per sample instead of once per (sample, threshold).
+    """
+    len_t = thresholds.shape[0]
+    num_c = preds.shape[1]
+    from metrics_tpu.ops.binned_hist import binned_counts_pallas, pallas_binned_fits, use_pallas_binned
+
+    # both the bucket trick and the kernel need ascending thresholds; the reference
+    # contract keeps output rows in the USER'S threshold order, so sort and unpermute
+    order = jnp.argsort(thresholds, stable=True)
+    thr_sorted = thresholds[order]
+
+    if use_pallas_binned() and pallas_binned_fits(preds.shape[0], num_c, len_t):
+        # TPU: one fused HBM pass (VMEM-accumulated compares, no scatter)
+        tp, fp, pos_tot_c, neg_tot_c = binned_counts_pallas(preds, target01, valid, thr_sorted)
+        pos_tot, neg_tot = pos_tot_c[:, None], neg_tot_c[:, None]
+    else:
+        # bucket b = #thresholds <= p, so p >= thr_t ⟺ t < b; NaN scores satisfy no
+        # threshold (comparison semantics of the broadcast formulation)
+        bucket = jnp.searchsorted(thr_sorted, preds, side="right").astype(jnp.int32)
+        bucket = jnp.where(jnp.isnan(preds), 0, bucket)
+        flat = bucket + (len_t + 1) * jnp.arange(num_c, dtype=jnp.int32)[None, :]
+        dead = num_c * (len_t + 1)
+        is_pos = valid & (target01 == 1)
+        pos_hist = bincount(jnp.where(is_pos, flat, dead), dead + 1)[:dead].reshape(num_c, len_t + 1)
+        neg_hist = bincount(jnp.where(valid & ~is_pos, flat, dead), dead + 1)[:dead].reshape(num_c, len_t + 1)
+        pos_tot = pos_hist.sum(-1, keepdims=True)
+        neg_tot = neg_hist.sum(-1, keepdims=True)
+        tp = (pos_tot - jnp.cumsum(pos_hist, -1))[:, :len_t]  # (C, T): #(pos & b > t)
+        fp = (neg_tot - jnp.cumsum(neg_hist, -1))[:, :len_t]
+    fn = pos_tot - tp
+    tn = neg_tot - fp
+    # (C, T, 2, 2) with [y, p>=t] layout → (T, C, 2, 2), rows back in user order
+    bins = jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)
+    return jnp.swapaxes(bins, 0, 1).astype(jnp.int32)[jnp.argsort(order)]
+
+
 def _binary_precision_recall_curve_update(
     preds: Array,
     target: Array,
     thresholds: Optional[Array],
 ) -> Union[Array, Tuple[Array, Array]]:
     """State update (reference ``precision_recall_curve.py:189-252``): samples (exact) or one
-    scatter-add into the (T,2,2) multi-threshold confusion tensor (binned)."""
+    bucketed histogram into the (T,2,2) multi-threshold confusion tensor (binned)."""
     if thresholds is None:
         return preds, target
-    len_t = thresholds.shape[0]
     valid = target >= 0
-    preds_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.int32)  # (N, T)
-    unique_mapping = preds_t + 2 * jnp.clip(target, 0, 1)[:, None] + 4 * jnp.arange(len_t)
-    unique_mapping = jnp.where(valid[:, None], unique_mapping, 4 * len_t)
-    bins = bincount(unique_mapping, 4 * len_t + 1)[: 4 * len_t]
-    return bins.reshape(len_t, 2, 2)
+    bins = _binned_confusion_tensor(
+        preds[:, None], jnp.clip(target, 0, 1)[:, None], valid[:, None], thresholds
+    )
+    return bins[:, 0]
 
 
 def _binary_precision_recall_curve_compute(
@@ -308,16 +350,9 @@ def _multiclass_precision_recall_curve_update(
         return preds, target
     if average == "micro":
         return _binary_precision_recall_curve_update(preds, target, thresholds)
-    len_t = thresholds.shape[0]
-    valid = target >= 0
-    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)  # (N, C, T)
+    valid = jnp.broadcast_to((target >= 0)[:, None], preds.shape)
     target_t = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)  # (N, C)
-    unique_mapping = preds_t + 2 * target_t[:, :, None]
-    unique_mapping = unique_mapping + 4 * jnp.arange(num_classes)[None, :, None]
-    unique_mapping = unique_mapping + 4 * num_classes * jnp.arange(len_t)[None, None, :]
-    unique_mapping = jnp.where(valid[:, None, None], unique_mapping, 4 * num_classes * len_t)
-    bins = bincount(unique_mapping, 4 * num_classes * len_t + 1)[: 4 * num_classes * len_t]
-    return bins.reshape(len_t, num_classes, 2, 2)
+    return _binned_confusion_tensor(preds, target_t, valid, thresholds)
 
 
 def _multiclass_precision_recall_curve_compute(
@@ -454,15 +489,8 @@ def _multilabel_precision_recall_curve_update(
     """State update (reference ``precision_recall_curve.py:777-799``): one scatter-add into (T, L, 2, 2)."""
     if thresholds is None:
         return preds, target
-    len_t = thresholds.shape[0]
     valid = target >= 0
-    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)
-    unique_mapping = preds_t + 2 * jnp.clip(target, 0, 1)[:, :, None]
-    unique_mapping = unique_mapping + 4 * jnp.arange(num_labels)[None, :, None]
-    unique_mapping = unique_mapping + 4 * num_labels * jnp.arange(len_t)[None, None, :]
-    unique_mapping = jnp.where(valid[:, :, None], unique_mapping, 4 * num_labels * len_t)
-    bins = bincount(unique_mapping, 4 * num_labels * len_t + 1)[: 4 * num_labels * len_t]
-    return bins.reshape(len_t, num_labels, 2, 2)
+    return _binned_confusion_tensor(preds, jnp.clip(target, 0, 1), valid, thresholds)
 
 
 def _multilabel_precision_recall_curve_compute(

@@ -1,0 +1,145 @@
+"""Pallas TPU kernel for the binned curve-family update (VERDICT r3 #8).
+
+The binned PRC/ROC/calibration update reduces (N, C) scores against T
+thresholds into per-threshold tp/fp counts (reference
+``precision_recall_curve.py:211-227``). The XLA path here
+(:func:`metrics_tpu.functional.classification.precision_recall_curve._binned_confusion_tensor`)
+is the O(N·C) bucket-histogram redesign — searchsorted + bincount + suffix
+cumsum — which reads the scores twice (bucketize, then scatter) and pays TPU's
+serialized scatter on the histogram.
+
+This kernel fuses the whole reduction into ONE pass over the scores: each grid
+step loads a (tile, C) block into VMEM, compares it against a T-chunk of
+thresholds on the VPU, and accumulates ``tp[c, t] = Σ pos & (score >= thr_t)``
+/ ``fp[c, t]`` directly into VMEM output accumulators that persist across the
+sequential TPU grid. No (N, T) intermediate, no scatter, one HBM read of the
+scores.
+
+Selection is automatic (:func:`use_pallas_binned`): compiled Pallas on a real
+TPU backend, the XLA histogram path elsewhere; override with
+``METRICS_TPU_CURVE_KERNEL=pallas|xla`` (interpret mode is for tests).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+__all__ = ["binned_counts_pallas", "pallas_binned_fits", "use_pallas_binned"]
+
+_T_CHUNK = 128  # threshold-chunk width: one lane-aligned block of compares per step
+_VMEM_ELEMS = 1 << 20  # budget for the (tile, C, T_CHUNK) compare block
+_MAX_EXACT_N = 1 << 24  # f32 accumulators count exactly below 2^24 per cell
+_MAX_ACC_ELEMS = 1 << 19  # (C, t_pad) ×2 f32 accumulators must sit in VMEM
+
+
+def pallas_binned_fits(n: int, num_c: int, len_t: int) -> bool:
+    """Does the fused kernel's exactness/VMEM envelope cover this shape?
+
+    Counts accumulate in f32 — exact only below 2^24 per cell, so huge updates
+    fall back to the XLA histogram (whose scatter path is exact). The two
+    (C, t_pad) accumulators plus the (tile, C, T_CHUNK) compare block must also
+    fit VMEM with a non-degenerate tile.
+    """
+    t_pad = max(_T_CHUNK, ((len_t + _T_CHUNK - 1) // _T_CHUNK) * _T_CHUNK)
+    return n < _MAX_EXACT_N and num_c * t_pad <= _MAX_ACC_ELEMS and _VMEM_ELEMS // (num_c * _T_CHUNK) >= 8
+
+
+def use_pallas_binned() -> bool:
+    """Route the binned curve update through the Pallas kernel?"""
+    choice = os.environ.get("METRICS_TPU_CURVE_KERNEL", "auto").lower()
+    if choice == "pallas":
+        return True
+    if choice == "xla":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend probe failed — stay on the XLA path
+        return False
+
+
+def _kernel(p_ref, pos_ref, neg_ref, thr_ref, tp_ref, fp_ref, ptot_ref, ntot_ref, *, t_pad: int):
+    """One (tile, C) block: accumulate per-threshold tp/fp and the pos/neg totals."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        tp_ref[...] = jnp.zeros_like(tp_ref)
+        fp_ref[...] = jnp.zeros_like(fp_ref)
+        ptot_ref[...] = jnp.zeros_like(ptot_ref)
+        ntot_ref[...] = jnp.zeros_like(ntot_ref)
+
+    p = p_ref[...]  # (tile, C) scores
+    pos = pos_ref[...]  # (tile, C) f32 {0, 1}: valid positives
+    neg = neg_ref[...]  # (tile, C) f32 {0, 1}: valid negatives
+    ptot_ref[...] += pos.sum(axis=0, keepdims=True)
+    ntot_ref[...] += neg.sum(axis=0, keepdims=True)
+    for c0 in range(0, t_pad, _T_CHUNK):
+        thr = thr_ref[0, c0 : c0 + _T_CHUNK]  # (T_CHUNK,)
+        ge = (p[:, :, None] >= thr[None, None, :]).astype(jnp.float32)  # (tile, C, T_CHUNK)
+        tp_ref[:, c0 : c0 + _T_CHUNK] += jnp.einsum("nc,nct->ct", pos, ge)
+        fp_ref[:, c0 : c0 + _T_CHUNK] += jnp.einsum("nc,nct->ct", neg, ge)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def binned_counts_pallas(
+    preds: Array, target01: Array, valid: Array, thresholds: Array, interpret: bool = False
+) -> Tuple[Array, Array, Array, Array]:
+    """Fused per-threshold counts: ``(tp, fp, pos_tot, neg_tot)``.
+
+    ``preds``/``target01``/``valid`` are (N, C); ``thresholds`` (T,) ascending.
+    Returns tp/fp of shape (C, T) and totals of shape (C,), all int32 — the
+    exact quantities :func:`_binned_confusion_tensor` derives its (T, C, 2, 2)
+    tensor from.
+    """
+    n, num_c = preds.shape
+    len_t = thresholds.shape[0]
+    t_pad = max(_T_CHUNK, ((len_t + _T_CHUNK - 1) // _T_CHUNK) * _T_CHUNK)
+    tile = max(8, min(4096, _VMEM_ELEMS // (num_c * _T_CHUNK)))
+    n_pad = max(tile, ((n + tile - 1) // tile) * tile)
+
+    p = preds.astype(jnp.float32)
+    # NaN scores satisfy no threshold (comparison semantics); +inf thresholds pad
+    # the chunk tail and are never satisfied by finite scores
+    p = jnp.where(jnp.isnan(p), -jnp.inf, p)
+    p = jnp.pad(p, ((0, n_pad - n), (0, 0)), constant_values=-jnp.inf)
+    pos = jnp.pad((valid & (target01 == 1)).astype(jnp.float32), ((0, n_pad - n), (0, 0)))
+    neg = jnp.pad((valid & (target01 == 0)).astype(jnp.float32), ((0, n_pad - n), (0, 0)))
+    thr = jnp.pad(thresholds.astype(jnp.float32), (0, t_pad - len_t), constant_values=jnp.inf)[None, :]
+
+    grid = (n_pad // tile,)
+    tp, fp, ptot, ntot = pl.pallas_call(
+        functools.partial(_kernel, t_pad=t_pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, num_c), lambda i: (i, 0)),
+            pl.BlockSpec((tile, num_c), lambda i: (i, 0)),
+            pl.BlockSpec((tile, num_c), lambda i: (i, 0)),
+            pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((num_c, t_pad), lambda i: (0, 0)),
+            pl.BlockSpec((num_c, t_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, num_c), lambda i: (0, 0)),
+            pl.BlockSpec((1, num_c), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((num_c, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((num_c, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, num_c), jnp.float32),
+            jax.ShapeDtypeStruct((1, num_c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(p, pos, neg, thr)
+    return (
+        tp[:, :len_t].astype(jnp.int32),
+        fp[:, :len_t].astype(jnp.int32),
+        ptot[0].astype(jnp.int32),
+        ntot[0].astype(jnp.int32),
+    )

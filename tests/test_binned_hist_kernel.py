@@ -1,0 +1,111 @@
+"""Bit-exactness of the Pallas binned-curve kernel vs the XLA histogram path.
+
+Runs the kernel in interpret mode on the CPU rig (the compiled form needs a
+real TPU); the contract is the (tp, fp, totals) quadruple behind
+``_binned_confusion_tensor``'s (T, C, 2, 2) tensor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binned_confusion_tensor,
+)
+from metrics_tpu.ops.binned_hist import binned_counts_pallas
+
+_R = np.random.RandomState(31)
+
+
+def _xla_quad(preds, target01, valid, thresholds):
+    bins = _binned_confusion_tensor(preds, target01, valid, thresholds)  # (T, C, 2, 2)
+    tp = np.asarray(bins[:, :, 1, 1]).T
+    fp = np.asarray(bins[:, :, 0, 1]).T
+    pos_tot = tp + np.asarray(bins[:, :, 1, 0]).T
+    neg_tot = fp + np.asarray(bins[:, :, 0, 0]).T
+    return tp, fp, pos_tot[:, 0], neg_tot[:, 0]
+
+
+@pytest.mark.parametrize(
+    ("n", "c", "t"),
+    [(100, 1, 5), (257, 3, 17), (1000, 4, 100), (50, 2, 129), (8, 1, 1)],
+)
+def test_pallas_binned_counts_bit_exact(n, c, t):
+    preds = jnp.asarray(_R.rand(n, c).astype(np.float32))
+    target01 = jnp.asarray(_R.randint(0, 2, (n, c)))
+    valid = jnp.asarray(_R.rand(n, c) > 0.1)
+    thresholds = _adjust_threshold_arg(t)
+
+    got = binned_counts_pallas(preds, target01, valid, thresholds, interpret=True)
+    want = _xla_quad(preds, target01, valid, thresholds)
+    for g, w, name in zip(got, want, ("tp", "fp", "pos_tot", "neg_tot")):
+        np.testing.assert_array_equal(np.asarray(g), w, err_msg=name)
+
+
+def test_pallas_binned_counts_edge_values():
+    """Threshold ties, NaN scores, and all-invalid rows match the XLA semantics."""
+    preds = jnp.asarray([[0.0], [0.25], [0.5], [0.5], [1.0], [np.nan], [0.75]], dtype=jnp.float32)
+    target01 = jnp.asarray([[0], [1], [1], [0], [1], [1], [1]])
+    valid = jnp.asarray([[True]] * 6 + [[False]])
+    thresholds = _adjust_threshold_arg(5)
+
+    got = binned_counts_pallas(preds, target01, valid, thresholds, interpret=True)
+    want = _xla_quad(preds, target01, valid, thresholds)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), w)
+
+
+def test_pallas_gate_is_off_on_cpu(monkeypatch):
+    from metrics_tpu.ops.binned_hist import use_pallas_binned
+
+    monkeypatch.delenv("METRICS_TPU_CURVE_KERNEL", raising=False)
+    assert use_pallas_binned() is False  # CPU rig: XLA path
+    monkeypatch.setenv("METRICS_TPU_CURVE_KERNEL", "pallas")
+    assert use_pallas_binned() is True
+    monkeypatch.setenv("METRICS_TPU_CURVE_KERNEL", "xla")
+    assert use_pallas_binned() is False
+
+
+def test_binary_update_through_kernel_matches(monkeypatch):
+    """The full binary binned update with the kernel forced (interpret) == XLA path."""
+    import metrics_tpu.ops.binned_hist as bh
+    from metrics_tpu.functional.classification.precision_recall_curve import (
+        _binary_precision_recall_curve_update,
+    )
+
+    preds = jnp.asarray(_R.rand(300).astype(np.float32))
+    target = jnp.asarray(_R.randint(-1, 2, 300))  # includes ignore rows
+    thresholds = _adjust_threshold_arg(11)
+    want = np.asarray(_binary_precision_recall_curve_update(preds, target, thresholds))
+
+    real = bh.binned_counts_pallas
+    monkeypatch.setattr(bh, "use_pallas_binned", lambda: True)
+    monkeypatch.setattr(bh, "binned_counts_pallas", lambda p, y, v, t: real(p, y, v, t, interpret=True))
+    got = np.asarray(_binary_precision_recall_curve_update(preds, target, thresholds))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_unsorted_thresholds_preserve_user_order():
+    """User-supplied descending thresholds get correct rows in THEIR order."""
+    from metrics_tpu.functional.classification.precision_recall_curve import (
+        _binary_precision_recall_curve_update,
+    )
+
+    preds = jnp.asarray(_R.rand(50).astype(np.float32))
+    target = jnp.asarray(_R.randint(0, 2, 50))
+    up = jnp.asarray([0.1, 0.5, 0.9])
+    down = jnp.asarray([0.9, 0.5, 0.1])
+    bins_up = np.asarray(_binary_precision_recall_curve_update(preds, target, up))
+    bins_down = np.asarray(_binary_precision_recall_curve_update(preds, target, down))
+    np.testing.assert_array_equal(bins_down, bins_up[::-1])
+
+
+def test_pallas_fits_gate():
+    from metrics_tpu.ops.binned_hist import pallas_binned_fits
+
+    assert pallas_binned_fits(1000, 4, 100)
+    assert not pallas_binned_fits(1 << 25, 4, 100)  # f32 count exactness bound
+    assert not pallas_binned_fits(1000, 4096, 200)  # accumulators would not fit VMEM
