@@ -167,8 +167,14 @@ def _binned_confusion_tensor(preds: Array, target01: Array, valid: Array, thresh
     thr_sorted = thresholds[order]
 
     if use_pallas_binned() and pallas_binned_fits(preds.shape[0], num_c, len_t):
-        # TPU: one fused HBM pass (VMEM-accumulated compares, no scatter)
-        tp, fp, pos_tot_c, neg_tot_c = binned_counts_pallas(preds, target01, valid, thr_sorted)
+        # TPU: one fused HBM pass (VMEM-accumulated compares, no scatter).
+        # A forced `pallas` choice off-TPU runs in interpret mode (SSIM precedent).
+        import jax as _jax
+
+        interpret = _jax.default_backend() != "tpu"
+        tp, fp, pos_tot_c, neg_tot_c = binned_counts_pallas(
+            preds, target01, valid, thr_sorted, interpret=interpret
+        )
         pos_tot, neg_tot = pos_tot_c[:, None], neg_tot_c[:, None]
     else:
         # bucket b = #thresholds <= p, so p >= thr_t ⟺ t < b; NaN scores satisfy no

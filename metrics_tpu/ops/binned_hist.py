@@ -59,6 +59,11 @@ def use_pallas_binned() -> bool:
     if choice == "xla":
         return False
     try:
+        # a jax.default_device(cpu) context inside a TPU process pins execution off
+        # the accelerator — the compiled kernel must not be selected there
+        pinned = jax.config.jax_default_device
+        if pinned is not None and getattr(pinned, "platform", "tpu") != "tpu":
+            return False
         return jax.default_backend() == "tpu"
     except Exception:  # backend probe failed — stay on the XLA path
         return False

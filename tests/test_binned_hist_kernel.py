@@ -83,7 +83,7 @@ def test_binary_update_through_kernel_matches(monkeypatch):
 
     real = bh.binned_counts_pallas
     monkeypatch.setattr(bh, "use_pallas_binned", lambda: True)
-    monkeypatch.setattr(bh, "binned_counts_pallas", lambda p, y, v, t: real(p, y, v, t, interpret=True))
+    monkeypatch.setattr(bh, "binned_counts_pallas", lambda p, y, v, t, **kw: real(p, y, v, t, interpret=True))
     got = np.asarray(_binary_precision_recall_curve_update(preds, target, thresholds))
     np.testing.assert_array_equal(got, want)
 

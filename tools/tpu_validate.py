@@ -220,6 +220,66 @@ def build_cases():
 
     cases.append(("small_domains", small_domains))
 
+    # ---------------- round-4 additions: binned-curve Pallas kernel, Hungarian PIT,
+    # shared-view retrieval pair (the exact code paths changed this round)
+    bc_p = rng.rand(200_000, 4).astype(np.float32)
+    bc_t = rng.randint(0, 4, 200_000).astype(np.int32)
+
+    def binned_curves():
+        """On TPU the update routes through ops/binned_hist.py; compare vs the CPU
+        XLA histogram path AND the forced-XLA path on the accelerator itself."""
+        import os as _os
+
+        from metrics_tpu.functional.classification import (
+            multiclass_average_precision,
+            multiclass_roc,
+        )
+
+        pj, tj = jnp.asarray(bc_p), jnp.asarray(bc_t)
+        auto = multiclass_average_precision(pj, tj, num_classes=4, thresholds=200, average="macro")
+        roc = multiclass_roc(pj, tj, num_classes=4, thresholds=100)
+        prior = _os.environ.get("METRICS_TPU_CURVE_KERNEL")
+        _os.environ["METRICS_TPU_CURVE_KERNEL"] = "xla"
+        try:
+            forced_xla = multiclass_average_precision(pj, tj, num_classes=4, thresholds=200, average="macro")
+        finally:  # restore the operator's own override, if any
+            if prior is None:
+                _os.environ.pop("METRICS_TPU_CURVE_KERNEL", None)
+            else:
+                _os.environ["METRICS_TPU_CURVE_KERNEL"] = prior
+        return (auto, forced_xla, auto - forced_xla) + tuple(roc[:2])
+
+    cases.append(("binned_curves_pallas", binned_curves))
+
+    pit_p = rng.randn(4, 6, 400).astype(np.float32)
+    pit_t = rng.randn(4, 6, 400).astype(np.float32)
+
+    def pit_hungarian():
+        from metrics_tpu.functional.audio.metrics import (
+            permutation_invariant_training,
+            scale_invariant_signal_distortion_ratio,
+        )
+
+        best, perm = permutation_invariant_training(
+            jnp.asarray(pit_p), jnp.asarray(pit_t), scale_invariant_signal_distortion_ratio
+        )
+        return best, perm.astype(jnp.float32)
+
+    cases.append(("pit_hungarian", pit_hungarian))
+
+    def retrieval_shared_view():
+        """MAP+MRR through the shared sorted view (on-device lexsort on TPU)."""
+        from metrics_tpu.retrieval import RetrievalMAP, RetrievalMRR
+
+        vals = []
+        for cls in (RetrievalMAP, RetrievalMRR):
+            m = cls()
+            m.update(jnp.asarray(ret_p), jnp.asarray(ret_t), indexes=jnp.asarray(ret_idx))
+            vals.append(m.compute())
+        return tuple(vals)
+
+    cases.append(("retrieval_shared_view", retrieval_shared_view))
+
     return cases
 
 
@@ -240,8 +300,20 @@ def main():
             accel = fn()
             jax.block_until_ready(accel)
             t_accel = time.perf_counter() - t0
-            with jax.default_device(cpu_dev):
-                host = fn()
+            # the host reference leg must not pick the compiled TPU kernels even
+            # though the process backend is still "tpu" inside this context
+            priors = {k: os.environ.get(k) for k in ("METRICS_TPU_SSIM_KERNEL", "METRICS_TPU_CURVE_KERNEL")}
+            os.environ["METRICS_TPU_SSIM_KERNEL"] = "stencil"
+            os.environ["METRICS_TPU_CURVE_KERNEL"] = "xla"
+            try:
+                with jax.default_device(cpu_dev):
+                    host = fn()
+            finally:
+                for k, v in priors.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
             diff = _tree_max_diff(accel, host)
             records[name] = {"ok": bool(diff < 5e-3), "max_rel_diff": float(diff),
                              "accel_ms": round(1000 * t_accel, 2)}
