@@ -159,19 +159,17 @@ def _binned_confusion_tensor(preds: Array, target01: Array, valid: Array, thresh
     """
     len_t = thresholds.shape[0]
     num_c = preds.shape[1]
-    from metrics_tpu.ops.binned_hist import binned_counts_pallas, pallas_binned_fits, use_pallas_binned
+    from metrics_tpu.ops.binned_hist import binned_counts_pallas, binned_kernel_plan, pallas_binned_fits
 
     # both the bucket trick and the kernel need ascending thresholds; the reference
     # contract keeps output rows in the USER'S threshold order, so sort and unpermute
     order = jnp.argsort(thresholds, stable=True)
     thr_sorted = thresholds[order]
 
-    if use_pallas_binned() and pallas_binned_fits(preds.shape[0], num_c, len_t):
+    use_kernel, interpret = binned_kernel_plan()
+    if use_kernel and pallas_binned_fits(preds.shape[0], num_c, len_t):
         # TPU: one fused HBM pass (VMEM-accumulated compares, no scatter).
-        # A forced `pallas` choice off-TPU runs in interpret mode (SSIM precedent).
-        import jax as _jax
-
-        interpret = _jax.default_backend() != "tpu"
+        # A forced `pallas` choice where the compiled kernel can't run interprets.
         tp, fp, pos_tot_c, neg_tot_c = binned_counts_pallas(
             preds, target01, valid, thr_sorted, interpret=interpret
         )

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import Array
 from jax.experimental import pallas as pl
 
-__all__ = ["binned_counts_pallas", "pallas_binned_fits", "use_pallas_binned"]
+__all__ = ["binned_counts_pallas", "binned_kernel_plan", "pallas_binned_fits", "use_pallas_binned"]
 
 _T_CHUNK = 128  # threshold-chunk width: one lane-aligned block of compares per step
 _VMEM_ELEMS = 1 << 20  # budget for the (tile, C, T_CHUNK) compare block
@@ -51,22 +51,43 @@ def pallas_binned_fits(n: int, num_c: int, len_t: int) -> bool:
     return n < _MAX_EXACT_N and num_c * t_pad <= _MAX_ACC_ELEMS and _VMEM_ELEMS // (num_c * _T_CHUNK) >= 8
 
 
-def use_pallas_binned() -> bool:
-    """Route the binned curve update through the Pallas kernel?"""
-    choice = os.environ.get("METRICS_TPU_CURVE_KERNEL", "auto").lower()
-    if choice == "pallas":
-        return True
-    if choice == "xla":
-        return False
+def _compiled_kernel_ok() -> bool:
+    """Can the COMPILED TPU kernel legally run right now?
+
+    False when the process backend is not TPU, or when a ``jax.default_device``
+    pin (device object OR platform string — jax accepts both) routes execution
+    off the accelerator. Unknown pin types fail CLOSED.
+    """
     try:
-        # a jax.default_device(cpu) context inside a TPU process pins execution off
-        # the accelerator — the compiled kernel must not be selected there
-        pinned = jax.config.jax_default_device
-        if pinned is not None and getattr(pinned, "platform", "tpu") != "tpu":
+        if jax.default_backend() != "tpu":
             return False
-        return jax.default_backend() == "tpu"
+        pinned = jax.config.jax_default_device
+        if pinned is None:
+            return True
+        platform = getattr(pinned, "platform", None)
+        if platform is None:  # string pins like 'cpu'; anything unrecognized fails closed
+            platform = str(pinned).lower()
+        return platform == "tpu"
     except Exception:  # backend probe failed — stay on the XLA path
         return False
+
+
+def binned_kernel_plan() -> Tuple[bool, bool]:
+    """The single routing decision: ``(use_kernel, interpret)``.
+
+    ``interpret`` is only ever True for a FORCED ``pallas`` choice somewhere the
+    compiled kernel cannot run (tests, CPU rigs)."""
+    choice = os.environ.get("METRICS_TPU_CURVE_KERNEL", "auto").lower()
+    if choice == "pallas":
+        return True, not _compiled_kernel_ok()
+    if choice == "xla":
+        return False, False
+    return _compiled_kernel_ok(), False
+
+
+def use_pallas_binned() -> bool:
+    """Route the binned curve update through the Pallas kernel?"""
+    return binned_kernel_plan()[0]
 
 
 def _kernel(p_ref, pos_ref, neg_ref, thr_ref, tp_ref, fp_ref, ptot_ref, ntot_ref, *, t_pad: int):

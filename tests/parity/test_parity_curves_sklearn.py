@@ -1,0 +1,106 @@
+"""Curve-family parity against scikit-learn as an INDEPENDENT oracle.
+
+The reference package is the primary oracle (tests/parity/test_parity_classification.py);
+sklearn shares no code with either side, so agreement here pins the exact-path
+curve math itself — sort order, tie handling, AUC integration — rather than
+agreement-with-torch. Binned results are additionally checked to converge to the
+exact value as T grows (the binned path has no sklearn counterpart).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("sklearn")
+
+from sklearn.metrics import (  # noqa: E402
+    average_precision_score,
+    precision_recall_curve as sk_prc,
+    roc_auc_score,
+    roc_curve as sk_roc,
+)
+
+from metrics_tpu.functional.classification import (  # noqa: E402
+    binary_auroc,
+    binary_average_precision,
+    binary_precision_recall_curve,
+    binary_roc,
+    multiclass_auroc,
+    multilabel_auroc,
+)
+
+_R = np.random.RandomState(77)
+
+
+def _scores(n, tie_fraction=0.0):
+    s = _R.rand(n).astype(np.float32)
+    if tie_fraction:
+        s = np.round(s, 1)  # quantize → heavy score ties
+    return s
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_binary_roc_exact_vs_sklearn(ties):
+    preds = _scores(400, 0.5 if ties else 0.0)
+    target = _R.randint(0, 2, 400)
+    fpr, tpr, thr = binary_roc(jnp.asarray(preds), jnp.asarray(target), thresholds=None)
+    sk_fpr, sk_tpr, _ = sk_roc(target, preds)
+    # sklearn drops collinear points (drop_intermediate) — compare the full curves
+    # via interpolation-free containment: every sklearn vertex must be on ours
+    ours = np.stack([np.asarray(fpr, np.float64), np.asarray(tpr, np.float64)], 1)
+    for x, y in zip(sk_fpr, sk_tpr):
+        dist = np.abs(ours - np.asarray([x, y])).sum(1).min()
+        assert dist < 1e-5, (x, y, dist)
+    assert float(binary_auroc(jnp.asarray(preds), jnp.asarray(target), thresholds=None)) == pytest.approx(
+        roc_auc_score(target, preds), abs=1e-6
+    )
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_binary_prc_exact_vs_sklearn(ties):
+    preds = _scores(400, 0.5 if ties else 0.0)
+    target = _R.randint(0, 2, 400)
+    precision, recall, _ = binary_precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), thresholds=None)
+    sk_p, sk_r, _ = sk_prc(target, preds)
+    np.testing.assert_allclose(np.asarray(precision), sk_p, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), sk_r, rtol=1e-5, atol=1e-6)
+    assert float(
+        binary_average_precision(jnp.asarray(preds), jnp.asarray(target), thresholds=None)
+    ) == pytest.approx(average_precision_score(target, preds), abs=1e-5)
+
+
+def test_multiclass_auroc_vs_sklearn():
+    preds = _R.rand(300, 4).astype(np.float32)
+    preds /= preds.sum(1, keepdims=True)
+    target = _R.randint(0, 4, 300)
+    for average, sk_avg in (("macro", "macro"), ("weighted", "weighted")):
+        got = float(
+            multiclass_auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average=average, thresholds=None)
+        )
+        want = roc_auc_score(target, preds, multi_class="ovr", average=sk_avg)
+        assert got == pytest.approx(want, abs=1e-5), average
+
+
+def test_multilabel_auroc_vs_sklearn():
+    preds = _R.rand(300, 3).astype(np.float32)
+    target = _R.randint(0, 2, (300, 3))
+    got = float(
+        multilabel_auroc(jnp.asarray(preds), jnp.asarray(target), num_labels=3, average="macro", thresholds=None)
+    )
+    want = roc_auc_score(target, preds, average="macro")
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_binned_converges_to_exact():
+    """The histogram-binned curve approaches the exact sklearn value as T grows."""
+    preds = _scores(2000)
+    target = _R.randint(0, 2, 2000)
+    exact = roc_auc_score(target, preds)
+    errs = []
+    for t in (10, 100, 1000):
+        binned = float(binary_auroc(jnp.asarray(preds), jnp.asarray(target), thresholds=t))
+        errs.append(abs(binned - exact))
+    assert errs[-1] <= errs[0]
+    assert errs[-1] < 2e-3

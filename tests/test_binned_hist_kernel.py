@@ -59,12 +59,12 @@ def test_pallas_binned_counts_edge_values():
 
 
 def test_pallas_gate_is_off_on_cpu(monkeypatch):
-    from metrics_tpu.ops.binned_hist import use_pallas_binned
+    from metrics_tpu.ops.binned_hist import binned_kernel_plan, use_pallas_binned
 
     monkeypatch.delenv("METRICS_TPU_CURVE_KERNEL", raising=False)
     assert use_pallas_binned() is False  # CPU rig: XLA path
     monkeypatch.setenv("METRICS_TPU_CURVE_KERNEL", "pallas")
-    assert use_pallas_binned() is True
+    assert binned_kernel_plan() == (True, True)  # forced off-TPU → interpret
     monkeypatch.setenv("METRICS_TPU_CURVE_KERNEL", "xla")
     assert use_pallas_binned() is False
 
@@ -82,7 +82,7 @@ def test_binary_update_through_kernel_matches(monkeypatch):
     want = np.asarray(_binary_precision_recall_curve_update(preds, target, thresholds))
 
     real = bh.binned_counts_pallas
-    monkeypatch.setattr(bh, "use_pallas_binned", lambda: True)
+    monkeypatch.setattr(bh, "binned_kernel_plan", lambda: (True, True))
     monkeypatch.setattr(bh, "binned_counts_pallas", lambda p, y, v, t, **kw: real(p, y, v, t, interpret=True))
     got = np.asarray(_binary_precision_recall_curve_update(preds, target, thresholds))
     np.testing.assert_array_equal(got, want)
