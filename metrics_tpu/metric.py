@@ -79,6 +79,18 @@ def clear_jit_cache() -> None:
     _SHARED_JIT_CACHE.clear()
 
 
+def _named_for_profiler(fn: Callable, name: str) -> Callable:
+    """Tag a to-be-jitted callable so JAX profiler traces and HLO dumps carry the
+    metric's name (SURVEY §5: the reference's per-metric usage hook analog)."""
+
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        with jax.named_scope(name):
+            return fn(*args, **kwargs)
+
+    wrapper.__name__ = wrapper.__qualname__ = name
+    return wrapper
+
+
 # Instance fields that do not affect how `update` traces: runtime bookkeeping and
 # the sync-orchestration kwargs (those act outside the jitted region).
 _JIT_KEY_EXCLUDE = frozenset({
@@ -376,7 +388,7 @@ class Metric(ABC):
         """Return the compiled pure update for this config, compiling at most once per config."""
         key = self._jit_cache_key()
         if key is None:
-            return jax.jit(self._functional_update)
+            return jax.jit(_named_for_profiler(self._functional_update, f"{type(self).__name__}_update"))
         fn = _SHARED_JIT_CACHE.get(key)
         if fn is None:
             # A dedicated pristine clone becomes the representative whose bound
@@ -385,7 +397,7 @@ class Metric(ABC):
             # large states they later accumulate — out of the cache.
             rep = self.clone()
             rep.reset()
-            fn = jax.jit(rep._functional_update)
+            fn = jax.jit(_named_for_profiler(rep._functional_update, f"{type(self).__name__}_update"))
             _SHARED_JIT_CACHE[key] = fn
             if len(_SHARED_JIT_CACHE) > _SHARED_JIT_CACHE_MAX:
                 _SHARED_JIT_CACHE.popitem(last=False)

@@ -295,9 +295,14 @@ class RetrievalMetric(Metric):
             return self._score_groups(gq)
         jitted = _JITTED_COMPUTE.get(key)
         if jitted is None:
+            from metrics_tpu.metric import _named_for_profiler
+
             rep = self.clone()
             rep.reset()
-            jitted = jax.jit(lambda tree: rep._score_groups(GroupedQueries.from_tree(tree)))
+            jitted = jax.jit(_named_for_profiler(
+                lambda tree: rep._score_groups(GroupedQueries.from_tree(tree)),
+                f"{type(self).__name__}_compute",
+            ))
             _JITTED_COMPUTE[key] = jitted
             if len(_JITTED_COMPUTE) > 128:
                 _JITTED_COMPUTE.pop(next(iter(_JITTED_COMPUTE)))

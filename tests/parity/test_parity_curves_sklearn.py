@@ -31,11 +31,13 @@ from metrics_tpu.functional.classification import (  # noqa: E402
     multilabel_auroc,
 )
 
-_R = np.random.RandomState(77)
+def _rng():
+    # per-test stream: data must not depend on which tests ran before
+    return np.random.RandomState(77)
 
 
-def _scores(n, tie_fraction=0.0):
-    s = _R.rand(n).astype(np.float32)
+def _scores(rng, n, tie_fraction=0.0):
+    s = rng.rand(n).astype(np.float32)
     if tie_fraction:
         s = np.round(s, 1)  # quantize → heavy score ties
     return s
@@ -43,8 +45,9 @@ def _scores(n, tie_fraction=0.0):
 
 @pytest.mark.parametrize("ties", [False, True])
 def test_binary_roc_exact_vs_sklearn(ties):
-    preds = _scores(400, 0.5 if ties else 0.0)
-    target = _R.randint(0, 2, 400)
+    rng = _rng()
+    preds = _scores(rng, 400, 0.5 if ties else 0.0)
+    target = rng.randint(0, 2, 400)
     fpr, tpr, thr = binary_roc(jnp.asarray(preds), jnp.asarray(target), thresholds=None)
     sk_fpr, sk_tpr, _ = sk_roc(target, preds)
     # sklearn drops collinear points (drop_intermediate) — compare the full curves
@@ -60,8 +63,9 @@ def test_binary_roc_exact_vs_sklearn(ties):
 
 @pytest.mark.parametrize("ties", [False, True])
 def test_binary_prc_exact_vs_sklearn(ties):
-    preds = _scores(400, 0.5 if ties else 0.0)
-    target = _R.randint(0, 2, 400)
+    rng = _rng()
+    preds = _scores(rng, 400, 0.5 if ties else 0.0)
+    target = rng.randint(0, 2, 400)
     precision, recall, _ = binary_precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), thresholds=None)
     sk_p, sk_r, _ = sk_prc(target, preds)
     np.testing.assert_allclose(np.asarray(precision), sk_p, rtol=1e-5, atol=1e-6)
@@ -72,9 +76,10 @@ def test_binary_prc_exact_vs_sklearn(ties):
 
 
 def test_multiclass_auroc_vs_sklearn():
-    preds = _R.rand(300, 4).astype(np.float32)
+    rng = _rng()
+    preds = rng.rand(300, 4).astype(np.float32)
     preds /= preds.sum(1, keepdims=True)
-    target = _R.randint(0, 4, 300)
+    target = rng.randint(0, 4, 300)
     for average, sk_avg in (("macro", "macro"), ("weighted", "weighted")):
         got = float(
             multiclass_auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average=average, thresholds=None)
@@ -84,8 +89,9 @@ def test_multiclass_auroc_vs_sklearn():
 
 
 def test_multilabel_auroc_vs_sklearn():
-    preds = _R.rand(300, 3).astype(np.float32)
-    target = _R.randint(0, 2, (300, 3))
+    rng = _rng()
+    preds = rng.rand(300, 3).astype(np.float32)
+    target = rng.randint(0, 2, (300, 3))
     got = float(
         multilabel_auroc(jnp.asarray(preds), jnp.asarray(target), num_labels=3, average="macro", thresholds=None)
     )
@@ -95,8 +101,9 @@ def test_multilabel_auroc_vs_sklearn():
 
 def test_binned_converges_to_exact():
     """The histogram-binned curve approaches the exact sklearn value as T grows."""
-    preds = _scores(2000)
-    target = _R.randint(0, 2, 2000)
+    rng = _rng()
+    preds = _scores(rng, 2000)
+    target = rng.randint(0, 2, 2000)
     exact = roc_auc_score(target, preds)
     errs = []
     for t in (10, 100, 1000):

@@ -318,3 +318,15 @@ def test_shared_jit_cache_distinct_shapes_still_correct():
     assert float(b.compute()) == 6.0
     assert a._jitted_update is b._jitted_update
     clear_jit_cache()
+
+
+def test_jitted_update_carries_metric_name_for_profiler():
+    """SURVEY §5: jitted per-metric programs are tagged with the metric's name so
+    JAX profiler traces and HLO dumps attribute time to the right metric."""
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=3, average="micro")
+    m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+    fn = m._lookup_shared_jit()
+    hlo = fn.lower(m._state, jnp.asarray([0, 1]), jnp.asarray([0, 1])).as_text()
+    assert "MulticlassAccuracy_update" in hlo
