@@ -293,7 +293,13 @@ class MetricCollection:
             reps = [lm.clone() for lm in leaders] if shareable else leaders
             for r in (reps if shareable else []):
                 r.reset()
-            fns = [r._functional_update for r in reps]
+            # per-leader profiler names so the fused program's trace still
+            # attributes time to each metric (metric.py:_named_for_profiler)
+            from metrics_tpu.metric import _named_for_profiler
+
+            fns = [
+                _named_for_profiler(r._functional_update, f"{type(r).__name__}_update") for r in reps
+            ]
 
             def _fused(states, *a):
                 return tuple(fn(s, *a) for fn, s in zip(fns, states))
