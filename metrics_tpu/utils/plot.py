@@ -29,38 +29,85 @@ def plot_single_or_multi_val(
     legend_name: Optional[str] = None,
     name: Optional[str] = None,
 ):
-    """Plot a single scalar, a vector of per-class values, or a sequence over steps
-    (reference ``plot.py:65-218``)."""
+    """Plot a single scalar, a vector of per-class values, or a sequence over steps.
+
+    Reference semantics (``plot.py:65-218``): scalars and per-class vectors are
+    marker POINTS; lists are time series over a visible "Step" axis; known bounds
+    draw dashed lines with an "Optimal value" annotation on the better one; the
+    metric name labels the y-axis.
+    """
     _error_on_missing_matplotlib()
     import matplotlib.pyplot as plt
 
     fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    ax.get_xaxis().set_visible(False)
+
+    def _series_axis(n_steps: int) -> None:
+        ax.get_xaxis().set_visible(True)
+        ax.set_xlabel("Step")
+        ax.set_xticks(np.arange(n_steps))
+
     if isinstance(val, (list, tuple)) and val and isinstance(val[0], dict):
         # a time series of result dicts → one series per key (reference plot.py:117-121)
         val = {k: np.stack([np.asarray(v[k]) for v in val]) for k in val[0]}
     if isinstance(val, dict):
-        for key, item in val.items():
+        for i, (key, item) in enumerate(val.items()):
             arr = np.atleast_1d(np.asarray(item))
-            ax.plot(np.arange(len(arr)), arr, marker="o", label=key)
-        ax.legend()
-    elif isinstance(val, (list, tuple)) or (hasattr(val, "ndim") and np.asarray(val).ndim > 0 and np.asarray(val).size > 1):
-        arr = np.asarray([np.asarray(v) for v in val]) if isinstance(val, (list, tuple)) else np.asarray(val)
+            if arr.size == 1:
+                ax.plot(i, arr.item(), marker="o", markersize=10, label=key)
+            else:
+                ax.plot(np.arange(len(arr)), arr, marker="o", markersize=10, linestyle="-", label=key)
+                _series_axis(len(arr))
+    elif isinstance(val, (list, tuple)):
+        arr = np.asarray([np.asarray(v) for v in val])
         if arr.ndim == 1:
-            ax.plot(np.arange(len(arr)), arr, marker="o", label=legend_name)
-        else:
+            ax.plot(np.arange(len(arr)), arr, marker="o", markersize=10, linestyle="-", label=legend_name or "")
+        else:  # per-step multi-value results → one series per component
             for ci in range(arr.shape[-1]):
-                ax.plot(np.arange(arr.shape[0]), arr[:, ci], marker="o",
-                        label=f"{legend_name or 'series'} {ci}")
-        if legend_name:
-            ax.legend()
+                ax.plot(np.arange(arr.shape[0]), arr[:, ci], marker="o", markersize=10, linestyle="-",
+                        label=f"{legend_name} {ci}" if legend_name else f"{ci}")
+        _series_axis(arr.shape[0])
+    elif hasattr(val, "ndim") and np.asarray(val).ndim > 0 and np.asarray(val).size > 1:
+        # ONE multi-element result (per-class/per-output): separate marker points
+        arr = np.asarray(val).reshape(-1)
+        for i, v in enumerate(arr):
+            ax.plot(i, v, marker="o", markersize=10, linestyle="None",
+                    label=f"{legend_name} {i}" if legend_name else f"{i}")
     else:
-        ax.bar(0, float(np.asarray(val)), width=0.4)
-        ax.set_xticks([])
-    if lower_bound is not None or upper_bound is not None:
-        ax.set_ylim(bottom=lower_bound, top=upper_bound)
+        ax.plot([np.asarray(val).item()], marker="o", markersize=10)
+
+    ylim = ax.get_ylim()
+    if lower_bound is not None and upper_bound is not None:
+        factor = 0.1 * (upper_bound - lower_bound)
+    else:
+        factor = 0.1 * (ylim[1] - ylim[0])
+    ax.set_ylim(
+        bottom=lower_bound - factor if lower_bound is not None else ylim[0] - factor,
+        top=upper_bound + factor if upper_bound is not None else ylim[1] + factor,
+    )
+    ax.grid(True)
     if name:
-        ax.set_title(name)
-    ax.grid(True, alpha=0.3)
+        ax.set_ylabel(name)
+
+    xlim = ax.get_xlim()
+    xfactor = 0.1 * (xlim[1] - xlim[0])
+    y_lines = [b for b in (lower_bound, upper_bound) if b is not None]
+    if y_lines:
+        ax.hlines(y_lines, xlim[0], xlim[1], linestyles="dashed", colors="k")
+    if higher_is_better is not None:
+        if lower_bound is not None and not higher_is_better:
+            ax.set_xlim(xlim[0] - xfactor, xlim[1])
+            ax.text(xlim[0], lower_bound, s="Optimal \n value", horizontalalignment="center",
+                    verticalalignment="center")
+        if upper_bound is not None and higher_is_better:
+            ax.set_xlim(xlim[0] - xfactor, xlim[1])
+            ax.text(xlim[0], upper_bound, s="Optimal \n value", horizontalalignment="center",
+                    verticalalignment="center")
+
+    handles, labels = ax.get_legend_handles_labels()
+    if handles and any(labels):
+        ax.legend(handles, labels, loc="upper center", bbox_to_anchor=(0.5, 1.15), ncol=3,
+                  fancybox=True, shadow=True)
     return fig, ax
 
 

@@ -310,8 +310,9 @@ def test_tracker_plotter():
         tracker.update(_rand(10), _randint(2, 10))
     fig, ax = tracker.plot()
     assert isinstance(fig, plt.Figure)
-    assert len(ax.lines) == 1
-    assert len(ax.lines[0].get_xdata()) == 3, "one point per tracked step"
+    # reference semantics: a stacked per-step value array renders one marker per step
+    assert len(ax.lines) == 3
+    assert all(len(line.get_xdata()) == 1 for line in ax.lines)
     plt.close("all")
 
 

@@ -104,6 +104,14 @@ def main():
             if " = " not in line:
                 continue
             rhs = line.split(" = ", 1)[1]
+            if rhs.startswith("("):  # tuple-typed op: strip the parenthesized type first
+                depth, i = 0, 0
+                for i, ch in enumerate(rhs):
+                    depth += ch == "("
+                    depth -= ch == ")"
+                    if depth == 0:
+                        break
+                rhs = rhs[i + 1 :].lstrip()
             head = rhs.split("(", 1)[0].split()
             if head:
                 ops.append(head[-1])
