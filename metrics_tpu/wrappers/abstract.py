@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Dict, List, Optional, Tuple
 
 from metrics_tpu.metric import Metric
 
@@ -11,10 +11,86 @@ class WrapperMetric(Metric):
     """Abstract base class for wrapper metrics.
 
     Wrapper metrics hold inner metrics whose states they manage explicitly; the
-    wrapper itself registers no states of its own.
+    wrapper itself registers no states of its own. Persistence recurses into the
+    children (the reference inherits this from ``nn.Module`` registration: a
+    BootStrapper's state_dict carries ``metrics.0.tp`` etc.; here the children
+    are discovered generically from instance attributes).
     """
 
     __jit_ineligible__ = True  # wrappers delegate to child metrics with external state
 
     def _wrap_update_children(self) -> None:  # parity hook, unused
         pass
+
+    def _children(self) -> List[Tuple[str, Metric]]:
+        """(dotted-path, metric) pairs for every child metric this wrapper holds."""
+        from metrics_tpu.collections import MetricCollection
+
+        def expand(path: str, obj: Any, out: List[Tuple[str, Metric]]) -> None:
+            if isinstance(obj, Metric):
+                out.append((path, obj))
+            elif isinstance(obj, MetricCollection):
+                for name, member in obj.items(keep_base=True):
+                    out.append((f"{path}.{name}", member))
+            elif isinstance(obj, (list, tuple)):
+                for i, x in enumerate(obj):
+                    if isinstance(x, (Metric, MetricCollection)):
+                        expand(f"{path}.{i}", x, out)
+            elif isinstance(obj, dict):
+                for k, x in obj.items():
+                    if isinstance(x, (Metric, MetricCollection)):
+                        expand(f"{path}.{k}", x, out)
+
+        out: List[Tuple[str, Metric]] = []
+        for attr, value in vars(self).items():
+            if attr.startswith("__"):
+                continue
+            expand(attr, value, out)
+        return out
+
+    # non-metric state a subclass persists beside its children (e.g. Running's window)
+    _extra_state_keys: Tuple[str, ...] = ()
+
+    def _recognized_keys(self, prefix: str = "") -> set:
+        """Every key this wrapper (and its children, recursively) could export."""
+        keys = {prefix + k for k in self._defaults} | {prefix + "_update_count"}
+        keys |= {prefix + k for k in self._extra_state_keys}
+        for path, child in self._children():
+            child_prefix = f"{prefix}{path}."
+            if isinstance(child, WrapperMetric):
+                keys |= child._recognized_keys(child_prefix)
+            else:
+                keys |= {child_prefix + k for k in child._defaults} | {child_prefix + "_update_count"}
+        return keys
+
+    def persistent(self, mode: bool = False) -> None:
+        """Flag the wrapper's own and every child's states (reference nn.Module nesting)."""
+        super().persistent(mode)
+        for _, child in self._children():
+            child.persistent(mode)
+
+    def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
+        """Export own states plus every child metric's, under dotted child paths."""
+        destination = super().state_dict(destination, prefix)
+        for path, child in self._children():
+            child.state_dict(destination, prefix=f"{prefix}{path}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        """Restore own states plus every child metric's.
+
+        ``strict`` additionally rejects keys under this prefix that no current
+        child can consume — a structural mismatch (e.g. a tracker restored with a
+        different history length) must not silently no-op.
+        """
+        if strict:
+            recognized = self._recognized_keys(prefix)
+            unexpected = [k for k in state_dict if k.startswith(prefix) and k not in recognized]
+            if unexpected:
+                raise RuntimeError(
+                    f"Unexpected key(s) in state_dict for {self.__class__.__name__}: {sorted(unexpected)[:8]}"
+                    " — the wrapper's structure (children/steps) does not match the checkpoint."
+                )
+        super().load_state_dict(state_dict, prefix, strict)
+        for path, child in self._children():
+            child.load_state_dict(state_dict, prefix=f"{prefix}{path}.", strict=strict)

@@ -11,6 +11,10 @@ from __future__ import annotations
 from collections import deque
 from typing import Any
 
+import jax
+import jax.numpy as jnp
+import numpy as np
+
 from metrics_tpu.metric import Metric
 from metrics_tpu.wrappers.abstract import WrapperMetric
 
@@ -27,6 +31,8 @@ class Running(WrapperMetric):
     Array(7., dtype=float32)
     """
 
+    _extra_state_keys = ("_window_states",)
+
     def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(base_metric, Metric):
@@ -42,6 +48,7 @@ class Running(WrapperMetric):
                 f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
             )
         self._window_states: deque = deque(maxlen=window)
+        self._window_persistent = False
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update: push this batch's state onto the window."""
@@ -74,3 +81,38 @@ class Running(WrapperMetric):
         super().reset()
         self.base_metric.reset()
         self._window_states.clear()
+
+    def persistent(self, mode: bool = False) -> None:
+        """The window follows the same persistence flag as the states it derives."""
+        super().persistent(mode)
+        self._window_persistent = mode
+
+    @staticmethod
+    def _host(v):
+        return [np.asarray(jax.device_get(x)) for x in v] if isinstance(v, list) else np.asarray(jax.device_get(v))
+
+    @staticmethod
+    def _device(v):
+        return [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
+
+    def state_dict(self, destination=None, prefix: str = ""):
+        """Persist the WINDOW itself — the derived base-metric view alone would lose
+        per-batch boundaries on the first post-restore update. List-valued states
+        keep their list-ness, mirroring ``Metric.state_dict``."""
+        destination = super().state_dict(destination, prefix)
+        if self._window_persistent:
+            destination[prefix + "_window_states"] = [
+                {k: self._host(v) for k, v in st.items()} for st in self._window_states
+            ]
+        return destination
+
+    def load_state_dict(self, state_dict, prefix: str = "", strict: bool = True) -> None:
+        """Restore the window and re-derive the base metric's merged view."""
+        super().load_state_dict(state_dict, prefix, strict)
+        key = prefix + "_window_states"
+        if key in state_dict:
+            self._window_states = deque(
+                ({k: self._device(v) for k, v in st.items()} for st in state_dict[key]), maxlen=self.window
+            )
+            if self._window_states:
+                self._apply_window()

@@ -75,19 +75,31 @@ class MetricTracker(WrapperMetric):
         return self._history[-1].compute()
 
     def compute_all(self) -> Any:
-        """Compute all tracked steps (reference ``tracker.py:146``)."""
+        """Compute all tracked steps (reference ``tracker.py:182-206``).
+
+        Dict results (collections OR dict-returning metrics like BootStrapper)
+        stack per key; anything unstackable is returned as the raw list.
+        """
         self._check_for_increment("compute_all")
         res = [metric.compute() for metric in self._history]
-        if isinstance(self._base_metric, MetricCollection):
-            keys = res[0].keys()
-            return {k: jnp.stack([r[k] for r in res], axis=0) for k in keys}
-        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+        try:
+            if isinstance(res[0], dict):
+                keys = res[0].keys()
+                return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+            if isinstance(res[0], (list, tuple)):
+                return jnp.stack([jnp.stack([jnp.asarray(x) for x in r], axis=0) for r in res], axis=0)
+            return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+        except (TypeError, ValueError):  # unstackable (incl. ragged) results: raw list (reference fallback)
+            return res
 
     def best_metric(
         self, return_step: bool = False
     ) -> Union[Array, Tuple[Array, int], Dict, Tuple[Dict, Dict]]:
         """Return the best value seen (and optionally the step it occurred) (reference ``tracker.py:181``)."""
         res = self.compute_all()
+        if isinstance(res, list):  # unstackable fallback: no scalar ordering exists
+            rank_zero_warn("Encountered unstackable per-step results in best_metric; returning None.")
+            return (None, None) if return_step else None
 
         def _best_1d(v: np.ndarray, maximize: bool):
             if v.ndim != 1:

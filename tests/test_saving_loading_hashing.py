@@ -246,3 +246,163 @@ def test_composition_operator_sweep(expr, want):
     m2.update(jnp.asarray([3.0]))
     assert float(comp.compute()) == pytest.approx(want)
     assert float(pickle.loads(pickle.dumps(comp)).compute()) == pytest.approx(want)
+
+
+def test_wrapper_state_dict_includes_children():
+    """Wrapper state_dicts carry child metric states under dotted paths, like the
+    reference's nn.Module nesting (e.g. ``metrics.0.<state>``)."""
+    from metrics_tpu import MinMaxMetric
+
+    bs = BootStrapper(MeanMetric(), num_bootstraps=2)
+    bs.persistent(True)
+    bs.update(jnp.asarray([1.0, 2.0, 3.0]))
+    sd = bs.state_dict()
+    assert any(k.startswith("metrics.0.") for k in sd), sd.keys()
+
+    restored = BootStrapper(MeanMetric(), num_bootstraps=2)
+    restored.load_state_dict(sd, strict=False)
+    want, got = bs.compute(), restored.compute()
+    np.testing.assert_allclose(np.asarray(got["mean"]), np.asarray(want["mean"]))
+    np.testing.assert_allclose(np.asarray(got["std"]), np.asarray(want["std"]))
+
+    mm = MinMaxMetric(BinaryAccuracy())
+    mm.persistent(True)
+    mm.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    sd = mm.state_dict()
+    assert any(k.startswith("_base_metric.") for k in sd)
+    mm2 = MinMaxMetric(BinaryAccuracy())
+    mm2.load_state_dict(sd, strict=False)
+    assert float(mm2.compute()["raw"]) == pytest.approx(float(mm.compute()["raw"]))
+
+
+def test_tracker_state_dict_roundtrips_history():
+    from metrics_tpu import MetricTracker
+
+    tr = MetricTracker(BinaryAccuracy())
+    for vals in ([0.9, 0.2], [0.4, 0.8]):
+        tr.increment()
+        tr.update(jnp.asarray(vals), jnp.asarray([1, 0]))
+    tr.persistent(True)
+    sd = tr.state_dict()
+    assert any(k.startswith("_history.0.") for k in sd) and any(k.startswith("_history.1.") for k in sd)
+
+    tr2 = MetricTracker(BinaryAccuracy())
+    tr2.increment(), tr2.increment()  # same history shape, then restore states
+    tr2.persistent(True)
+    tr2.load_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(tr2.compute_all()), np.asarray(tr.compute_all()))
+
+
+def test_multitask_state_dict_roundtrips_tasks():
+    from metrics_tpu import MultitaskWrapper
+
+    mt = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanMetric()})
+    mt.persistent(True)
+    mt.update({"cls": jnp.asarray([0.9, 0.1]), "reg": jnp.asarray([5.0])},
+              {"cls": jnp.asarray([1, 0]), "reg": jnp.asarray([5.0])})
+    sd = mt.state_dict()
+    assert any(k.startswith("task_metrics.cls.") for k in sd)
+    mt2 = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanMetric()})
+    mt2.load_state_dict(sd, strict=False)
+    want, got = mt.compute(), mt2.compute()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+
+
+def test_tracker_compute_all_stacks_dict_results():
+    """compute_all over a dict-returning base metric stacks per key (reference tracker.py:198-206)."""
+    from metrics_tpu import MetricTracker
+
+    tr = MetricTracker(BootStrapper(BinaryAccuracy(), num_bootstraps=2))
+    for _ in range(3):
+        tr.increment()
+        tr.update(jnp.asarray(_R.rand(10).astype(np.float32)), jnp.asarray(_R.randint(0, 2, 10)))
+    out = tr.compute_all()
+    assert set(out) == {"mean", "std"}
+    assert all(np.asarray(v).shape == (3,) for v in out.values())
+
+
+def test_running_wrapper_persists_its_window():
+    """A restored Running keeps per-batch window boundaries, not just the merged view."""
+    from metrics_tpu import SumMetric
+    from metrics_tpu.wrappers import Running
+
+    r = Running(SumMetric(), window=2)
+    for v in (0.0, 1.0, 2.0):
+        r.update(jnp.asarray(v))
+    r.persistent(True)
+    sd = r.state_dict()
+    assert "_window_states" in sd
+
+    r2 = Running(SumMetric(), window=2)
+    r2.persistent(True)
+    r2.load_state_dict(sd)
+    assert float(r2.compute()) == pytest.approx(3.0)  # 1 + 2
+    r2.update(jnp.asarray(10.0))
+    assert float(r2.compute()) == pytest.approx(12.0)  # window slides: 2 + 10
+
+
+def test_wrapper_strict_load_rejects_structural_mismatch():
+    """strict=True must not silently ignore checkpoint keys the wrapper cannot consume."""
+    from metrics_tpu import MetricTracker
+
+    tr = MetricTracker(BinaryAccuracy())
+    for _ in range(3):
+        tr.increment()
+        tr.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    tr.persistent(True)
+    sd = tr.state_dict()
+
+    fresh = MetricTracker(BinaryAccuracy())  # zero increments: _history.N keys are unexpected
+    with pytest.raises(RuntimeError, match="Unexpected key"):
+        fresh.load_state_dict(sd, strict=True)
+    fresh.load_state_dict(sd, strict=False)  # permissive load stays available
+
+
+def test_tracker_compute_all_ragged_fallback():
+    """Unstackable (ragged) step results fall back to the raw list (reference tracker.py:205)."""
+    from metrics_tpu import CatMetric as _Cat, MetricTracker
+
+    tr = MetricTracker(_Cat())
+    for vals in ([1.0, 2.0], [3.0]):
+        tr.increment()
+        tr.update(jnp.asarray(vals))
+    out = tr.compute_all()
+    assert isinstance(out, list) and len(out) == 2
+
+
+def test_running_wrapper_list_state_window_roundtrip():
+    """List-state metrics (CatMetric) keep per-batch list-ness through the window."""
+    from metrics_tpu.wrappers import Running
+
+    r = Running(CatMetric(), window=2)
+    r.update(jnp.asarray([1.0, 2.0]))
+    r.update(jnp.asarray([3.0]))
+    r.persistent(True)
+    r2 = Running(CatMetric(), window=2)
+    r2.persistent(True)
+    r2.load_state_dict(r.state_dict())
+    np.testing.assert_allclose(np.asarray(r2.compute()), [1.0, 2.0, 3.0])
+    r2.update(jnp.asarray([4.0]))  # window slides past the restored batches
+    np.testing.assert_allclose(np.asarray(r2.compute()), [3.0, 4.0])
+
+
+def test_running_window_respects_persistent_flag():
+    from metrics_tpu import SumMetric
+    from metrics_tpu.wrappers import Running
+
+    r = Running(SumMetric(), window=2)
+    r.update(jnp.asarray(1.0))
+    assert "_window_states" not in r.state_dict()  # persistent defaults to False
+
+
+def test_tracker_best_metric_handles_unstackable_fallback():
+    from metrics_tpu import MetricTracker
+
+    tr = MetricTracker(CatMetric())
+    for vals in ([1.0, 2.0], [3.0]):
+        tr.increment()
+        tr.update(jnp.asarray(vals))
+    assert tr.best_metric() is None
+    val, step = tr.best_metric(return_step=True)
+    assert val is None and step is None
