@@ -3,8 +3,10 @@
 Parity with reference ``torchmetrics/utilities/`` (SURVEY §2.3).
 """
 
+from metrics_tpu.utils import enums, imports, plot  # noqa: F401  (submodule surface parity)
 from metrics_tpu.utils.checks import _check_same_shape, check_forward_full_state_property
 from metrics_tpu.utils.compute import _safe_divide, _safe_xlogy, auc, interp
+from metrics_tpu.utils.distributed import class_reduce, reduce
 from metrics_tpu.utils.data import (
     bincount,
     dim_zero_cat,
@@ -20,6 +22,8 @@ from metrics_tpu.utils.exceptions import TPUMetricsUserError, TPUMetricsUserWarn
 from metrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
 
 __all__ = [
+    "reduce",
+    "class_reduce",
     "TPUMetricsUserError",
     "TPUMetricsUserWarning",
     "_check_same_shape",

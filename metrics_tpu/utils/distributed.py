@@ -1,0 +1,65 @@
+"""Reduction helpers + the multihost gather surface (reference ``utilities/distributed.py``).
+
+The legacy public reducers ``reduce``/``class_reduce`` (``distributed.py:22-88``)
+re-expressed over jnp, and the eager cross-process gather re-exported from the
+mesh-native comm layer (:mod:`metrics_tpu.parallel.sync`) so user code porting
+from the reference finds the same import surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.parallel.sync import gather_all_states  # noqa: F401  (re-export)
+
+__all__ = ["class_reduce", "gather_all_states", "reduce"]
+
+
+def reduce(x: Array, reduction: Optional[str]) -> Array:
+    """Reduce a tensor by name: ``elementwise_mean`` | ``sum`` | ``none`` (reference ``distributed.py:22-42``).
+
+    >>> import jax.numpy as jnp
+    >>> reduce(jnp.asarray([1.0, 2.0, 3.0]), "sum")
+    Array(6., dtype=float32)
+    """
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "none" or reduction is None:
+        return x
+    if reduction == "sum":
+        return jnp.sum(x)
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: Optional[str] = "none") -> Array:
+    """Reduce per-class fractions ``num / denom`` (reference ``distributed.py:45-88``).
+
+    ``micro`` divides the totals, ``macro`` means the per-class fractions,
+    ``weighted`` weights them by ``weights``; 0/0 classes contribute 0.
+
+    >>> import jax.numpy as jnp
+    >>> tps = jnp.asarray([1.0, 2.0, 0.0])
+    >>> sup = jnp.asarray([2.0, 2.0, 0.0])
+    >>> class_reduce(tps, sup, sup, "macro")
+    Array(0.5, dtype=float32)
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    if class_reduction == "micro":
+        fraction = jnp.sum(num) / jnp.sum(denom)
+    else:
+        fraction = num / denom
+    fraction = jnp.where(jnp.isnan(fraction), 0.0, fraction)  # 0/0 → 0; x/0 keeps ±inf like the reference
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights.astype(fraction.dtype) / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(
+        f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}"
+    )

@@ -116,3 +116,8 @@ class Running(WrapperMetric):
             )
             if self._window_states:
                 self._apply_window()
+        else:
+            # a checkpoint without the window (e.g. saved pre-window or with only the
+            # base states flagged): stale local batches must not leak into the
+            # restored state on the next update
+            self._window_states.clear()

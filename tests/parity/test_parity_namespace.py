@@ -6,6 +6,8 @@ works for the same 106 root names (``/root/reference/src/torchmetrics/__init__.p
 
 import re
 
+import jax.numpy as jnp
+import numpy as np
 import pytest
 
 import metrics_tpu
@@ -46,3 +48,48 @@ def test_dir_covers_all_and_unknown_attribute_raises():
     assert set(metrics_tpu.__all__) <= set(dir(metrics_tpu))
     with pytest.raises(AttributeError, match="Bogus"):
         metrics_tpu.Bogus
+
+
+def test_utilities_namespace_surface_matches_reference():
+    """Every public name under the reference's ``torchmetrics.utilities`` exists in
+    ``metrics_tpu.utils`` (reduce/class_reduce reducers, submodules, rank-zero prints)."""
+    from tests._reference import reference
+
+    reference()
+    import torchmetrics.utilities as ref_utils
+
+    import metrics_tpu.utils as ours
+
+    ref_public = {n for n in dir(ref_utils) if not n.startswith("_")}
+    missing = {n for n in ref_public if not hasattr(ours, n)}
+    assert not missing, f"utilities surface missing: {sorted(missing)}"
+
+
+def test_reduce_and_class_reduce_match_reference():
+    import torch
+
+    from tests._reference import reference
+
+    reference()
+    from torchmetrics.utilities import class_reduce as ref_cr, reduce as ref_red
+
+    from metrics_tpu.utils import class_reduce, reduce
+
+    x = np.asarray([1.0, 2.0, 3.0], np.float32)
+    for r in ("elementwise_mean", "sum", "none", None):
+        np.testing.assert_allclose(np.asarray(reduce(jnp.asarray(x), r)), ref_red(torch.tensor(x), r).numpy())
+    with pytest.raises(ValueError):
+        reduce(jnp.asarray(x), "bogus")
+
+    num = np.asarray([1.0, 2.0, 0.0], np.float32)
+    den = np.asarray([2.0, 2.0, 0.0], np.float32)
+    w = np.asarray([2.0, 2.0, 0.0], np.float32)
+    for cr in ("micro", "macro", "weighted", "none", None):
+        np.testing.assert_allclose(
+            np.asarray(class_reduce(jnp.asarray(num), jnp.asarray(den), jnp.asarray(w), cr)),
+            ref_cr(torch.tensor(num), torch.tensor(den), torch.tensor(w), cr).numpy(),
+            rtol=1e-6,
+            err_msg=str(cr),
+        )
+    with pytest.raises(ValueError):
+        class_reduce(jnp.asarray(num), jnp.asarray(den), jnp.asarray(w), "bogus")
