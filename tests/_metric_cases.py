@@ -1,0 +1,133 @@
+"""Shared (ctor, builder) metric-case registry.
+
+One representative per family across every domain package; consumed by the
+plot sweep (tests/test_plot_sweep.py) and the lifecycle-contract sweep
+(tests/test_lifecycle_contracts.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+import metrics_tpu.classification as C
+import metrics_tpu.clustering as CL
+import metrics_tpu.segmentation as S
+
+_R = np.random.RandomState(7)
+
+
+def _rand(*shape):
+    return jnp.asarray(_R.rand(*shape).astype(np.float32))
+
+
+def _randint(hi, *shape):
+    return jnp.asarray(_R.randint(0, hi, shape))
+
+
+# (ctor, input-builder) — one representative per family, spanning every domain package.
+GENERIC_CASES = [
+    pytest.param(lambda: C.BinaryAccuracy(), lambda: (_rand(10), _randint(2, 10)), id="BinaryAccuracy"),
+    pytest.param(
+        lambda: C.MulticlassAccuracy(num_classes=3), lambda: (_rand(10, 3), _randint(3, 10)), id="MulticlassAccuracy"
+    ),
+    pytest.param(
+        lambda: C.MultilabelFBetaScore(beta=2.0, num_labels=3),
+        lambda: (_rand(10, 3), _randint(2, 10, 3)),
+        id="MultilabelFBetaScore",
+    ),
+    pytest.param(lambda: C.BinaryHammingDistance(), lambda: (_rand(10), _randint(2, 10)), id="BinaryHammingDistance"),
+    pytest.param(lambda: C.BinaryCohenKappa(), lambda: (_rand(10), _randint(2, 10)), id="BinaryCohenKappa"),
+    pytest.param(lambda: C.BinarySpecificity(), lambda: (_rand(10), _randint(2, 10)), id="BinarySpecificity"),
+    pytest.param(
+        lambda: C.MulticlassExactMatch(num_classes=3),
+        lambda: (_randint(3, 4, 5), _randint(3, 4, 5)),
+        id="MulticlassExactMatch",
+    ),
+    pytest.param(lambda: C.BinaryCalibrationError(), lambda: (_rand(10), _randint(2, 10)), id="BinaryCalibrationError"),
+    pytest.param(
+        lambda: C.MultilabelRankingLoss(num_labels=3),
+        lambda: (_rand(8, 3), _randint(2, 8, 3)),
+        id="MultilabelRankingLoss",
+    ),
+    pytest.param(lambda: C.BinaryAUROC(), lambda: (_rand(10), _randint(2, 10)), id="BinaryAUROC"),
+    pytest.param(
+        lambda: C.MulticlassAveragePrecision(num_classes=3),
+        lambda: (_rand(10, 3), _randint(3, 10)),
+        id="MulticlassAveragePrecision",
+    ),
+    pytest.param(lambda: M.MeanSquaredError(), lambda: (_rand(10), _rand(10)), id="MeanSquaredError"),
+    pytest.param(lambda: M.PearsonCorrCoef(), lambda: (_rand(10), _rand(10)), id="PearsonCorrCoef"),
+    pytest.param(lambda: M.R2Score(), lambda: (_rand(10), _rand(10)), id="R2Score"),
+    pytest.param(lambda: M.KendallRankCorrCoef(), lambda: (_rand(10), _rand(10)), id="KendallRankCorrCoef"),
+    pytest.param(lambda: M.SpearmanCorrCoef(), lambda: (_rand(10), _rand(10)), id="SpearmanCorrCoef"),
+    pytest.param(lambda: M.ConcordanceCorrCoef(), lambda: (_rand(10), _rand(10)), id="ConcordanceCorrCoef"),
+    pytest.param(lambda: M.MinkowskiDistance(p=3), lambda: (_rand(10), _rand(10)), id="MinkowskiDistance"),
+    pytest.param(lambda: M.LogCoshError(), lambda: (_rand(10), _rand(10)), id="LogCoshError"),
+    pytest.param(lambda: M.ExplainedVariance(), lambda: (_rand(10), _rand(10)), id="ExplainedVariance"),
+    pytest.param(lambda: M.MeanMetric(), lambda: (_rand(10),), id="MeanMetric"),
+    pytest.param(lambda: M.SumMetric(), lambda: (_rand(10),), id="SumMetric"),
+    pytest.param(lambda: M.MaxMetric(), lambda: (_rand(10),), id="MaxMetric"),
+    pytest.param(lambda: M.RunningMean(window=3), lambda: (_rand(10),), id="RunningMean"),
+    pytest.param(lambda: M.CharErrorRate(), lambda: (["hello"], ["hallo"]), id="CharErrorRate"),
+    pytest.param(lambda: M.WordErrorRate(), lambda: (["a quick fox"], ["a fast fox"]), id="WordErrorRate"),
+    pytest.param(
+        lambda: M.BLEUScore(), lambda: (["the cat sat"], [["the cat sat on the mat"]]), id="BLEUScore"
+    ),
+    pytest.param(
+        lambda: M.PeakSignalNoiseRatio(), lambda: (_rand(2, 3, 8, 8), _rand(2, 3, 8, 8)), id="PeakSignalNoiseRatio"
+    ),
+    pytest.param(
+        lambda: M.StructuralSimilarityIndexMeasure(),
+        lambda: (_rand(2, 3, 16, 16), _rand(2, 3, 16, 16)),
+        id="StructuralSimilarityIndexMeasure",
+    ),
+    pytest.param(
+        lambda: M.UniversalImageQualityIndex(),
+        lambda: (_rand(2, 3, 16, 16), _rand(2, 3, 16, 16)),
+        id="UniversalImageQualityIndex",
+    ),
+    pytest.param(lambda: M.TotalVariation(), lambda: (_rand(2, 3, 8, 8),), id="TotalVariation"),
+    pytest.param(lambda: M.SignalNoiseRatio(), lambda: (_rand(16), _rand(16)), id="SignalNoiseRatio"),
+    pytest.param(
+        lambda: M.ScaleInvariantSignalDistortionRatio(),
+        lambda: (_rand(2, 16), _rand(2, 16)),
+        id="ScaleInvariantSignalDistortionRatio",
+    ),
+    pytest.param(lambda: CL.AdjustedRandScore(), lambda: (_randint(3, 12), _randint(3, 12)), id="AdjustedRandScore"),
+    pytest.param(
+        lambda: CL.NormalizedMutualInfoScore(), lambda: (_randint(3, 12), _randint(3, 12)), id="NormalizedMutualInfoScore"
+    ),
+    pytest.param(lambda: M.CramersV(num_classes=3), lambda: (_randint(3, 20), _randint(3, 20)), id="CramersV"),
+    pytest.param(lambda: M.TschuprowsT(num_classes=3), lambda: (_randint(3, 20), _randint(3, 20)), id="TschuprowsT"),
+    pytest.param(
+        lambda: S.MeanIoU(num_classes=3, input_format="index"),
+        lambda: (_randint(3, 2, 8, 8), _randint(3, 2, 8, 8)),
+        id="MeanIoU",
+    ),
+    pytest.param(
+        lambda: S.GeneralizedDiceScore(num_classes=3, input_format="index"),
+        lambda: (_randint(3, 2, 8, 8), _randint(3, 2, 8, 8)),
+        id="GeneralizedDiceScore",
+    ),
+    pytest.param(
+        lambda: M.MinMaxMetric(C.BinaryAccuracy()), lambda: (_rand(10), _randint(2, 10)), id="MinMaxMetric"
+    ),
+    pytest.param(
+        lambda: M.BootStrapper(M.MeanSquaredError(), num_bootstraps=4),
+        lambda: (_rand(10), _rand(10)),
+        id="BootStrapper",
+    ),
+    pytest.param(
+        lambda: M.ClasswiseWrapper(C.MulticlassAccuracy(num_classes=3, average=None)),
+        lambda: (_rand(10, 3), _randint(3, 10)),
+        id="ClasswiseWrapper",
+    ),
+    pytest.param(
+        lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2),
+        lambda: (_rand(10, 2), _rand(10, 2)),
+        id="MultioutputWrapper",
+    ),
+]
