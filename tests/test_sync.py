@@ -224,3 +224,45 @@ def test_gather_all_states_scalar_and_empty_list(monkeypatch):
     out = sync_mod.gather_all_states([jnp.asarray(3.0), []])
     np.testing.assert_allclose([float(v) for v in out[0]], scalar_vals)
     assert all(v.shape == (0,) for v in out[1])
+
+
+# ---------------------------------------------------------------- 2-D mesh
+def test_sync_states_on_2d_mesh_both_axes():
+    """A (dp=4, tp=2) mesh: metric states reduce over BOTH axes with one psum."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    stacked = {"s": jnp.arange(8.0).reshape(4, 2)}
+
+    def body(st):
+        local = {k: v[0, 0] for k, v in st.items()}
+        return sync_states(local, {"s": "sum"}, ("data", "model"))
+
+    out = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=({"s": P("data", "model")},), out_specs={"s": P()}, check_vma=False,
+    )(stacked)
+    assert float(out["s"]) == 28.0
+
+
+def test_sync_states_on_2d_mesh_single_axis():
+    """Sync over the data axis only: each model column keeps its own reduction —
+    the layout of per-shard metrics under tensor parallelism."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    stacked = {"s": jnp.arange(8.0).reshape(4, 2)}
+
+    def body(st):
+        local = {k: v[0, 0] for k, v in st.items()}
+        synced = sync_states(local, {"s": "sum"}, "data")
+        return {"s": synced["s"].reshape(1, 1)}
+
+    out = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=({"s": P("data", "model")},), out_specs={"s": P(None, "model")}, check_vma=False,
+    )(stacked)
+    # column 0 holds devices 0,2,4,6 → 12; column 1 holds 1,3,5,7 → 16
+    np.testing.assert_allclose(np.asarray(out["s"]).reshape(-1), [12.0, 16.0])
