@@ -335,17 +335,15 @@ def test_jitted_update_carries_metric_name_for_profiler():
 def test_compute_on_cpu_offloads_list_states():
     """compute_on_cpu moves list states to host numpy after each update and still
     computes correctly (reference metric.py:566-571 list-offload semantics)."""
-    import numpy as _np
-
     from metrics_tpu.regression import SpearmanCorrCoef
 
     m = SpearmanCorrCoef(compute_on_cpu=True)
-    rng = _np.random.RandomState(0)
+    rng = np.random.RandomState(0)
     for _ in range(2):
-        m.update(jnp.asarray(rng.rand(8).astype(_np.float32)), jnp.asarray(rng.rand(8).astype(_np.float32)))
-    assert all(isinstance(x, _np.ndarray) for x in m._state["preds"]), "list states should live on host"
+        m.update(jnp.asarray(rng.rand(8).astype(np.float32)), jnp.asarray(rng.rand(8).astype(np.float32)))
+    assert all(isinstance(x, np.ndarray) for x in m._state["preds"]), "list states should live on host"
     seq = SpearmanCorrCoef()
-    rng = _np.random.RandomState(0)
+    rng = np.random.RandomState(0)
     for _ in range(2):
-        seq.update(jnp.asarray(rng.rand(8).astype(_np.float32)), jnp.asarray(rng.rand(8).astype(_np.float32)))
+        seq.update(jnp.asarray(rng.rand(8).astype(np.float32)), jnp.asarray(rng.rand(8).astype(np.float32)))
     assert float(m.compute()) == pytest.approx(float(seq.compute()), rel=1e-6)

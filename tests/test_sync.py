@@ -266,3 +266,34 @@ def test_sync_states_on_2d_mesh_single_axis():
     )(stacked)
     # column 0 holds devices 0,2,4,6 → 12; column 1 holds 1,3,5,7 → 16
     np.testing.assert_allclose(np.asarray(out["s"]).reshape(-1), [12.0, 16.0])
+
+
+def test_forward_dist_sync_on_step_through_injected_fn():
+    """``dist_sync_on_step=True``: every forward's batch value reflects the WORLD
+    state via the injected gather (reference metric.py:287-317 + _sync_dist)."""
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    calls = []
+
+    def fake_two_rank_gather(states, group):
+        calls.append(group)
+        # my state plus an identical peer — world accuracy equals local
+        return [[s, s] for s in states]
+
+    m = MulticlassAccuracy(
+        num_classes=3, average="micro",
+        dist_sync_on_step=True,
+        dist_sync_fn=fake_two_rank_gather,
+        distributed_available_fn=lambda: True,
+        process_group="data",
+    )
+    batch_val = m(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+    assert calls and calls[0] == "data", "forward must gather each step through the injected fn"
+    assert float(batch_val) == pytest.approx(0.75)
+    # after forward the metric is unsynced and keeps accumulating locally
+    assert not m._is_synced
+    m.update(jnp.asarray([0, 0]), jnp.asarray([0, 1]))
+    n_calls = len(calls)
+    local = float(m.compute())  # sync_on_compute also routes through the injected fn
+    assert len(calls) > n_calls
+    assert local == pytest.approx(4 / 6)
