@@ -678,14 +678,18 @@ class Metric(ABC):
         return new
 
     def __getstate__(self) -> Dict[str, Any]:
-        """Pickle support: drop bound/wrapped callables (reference ``metric.py:779-788``)."""
+        """Pickle support: drop bound/wrapped callables (reference ``metric.py:779-788``).
+
+        Device arrays move to host; HOST payload entries (numpy float64 COCO
+        states, RLE objects, ``None`` placeholders) pass through untouched.
+        """
         state = {
             k: v
             for k, v in self.__dict__.items()
             if k not in ("update", "compute", "_update_impl", "_compute_impl", "_jitted_update", "_update_signature")
         }
         state["_state"] = {
-            k: (list(np.asarray(x) for x in v) if isinstance(v, list) else np.asarray(v))
+            k: (list(_pickle_to_host(x) for x in v) if isinstance(v, list) else _pickle_to_host(v))
             for k, v in self._state.items()
         }
         return state
@@ -699,9 +703,17 @@ class Metric(ABC):
         object.__setattr__(self, "update", self._wrapped_update)
         object.__setattr__(self, "compute", self._wrapped_compute)
         object.__setattr__(self, "_jitted_update", None)
-        # re-hydrate numpy → jnp
+        # re-hydrate device-able numpy → jnp; host payloads stay host (a float64
+        # COCO state must NOT silently downcast to a device f32), and
+        # compute_on_cpu list states stay offloaded — restoring them into HBM
+        # would defeat the flag's purpose before the first post-restore update
+        keep_lists_on_host = getattr(self, "compute_on_cpu", False)
         self.__dict__["_state"] = {
-            k: (list(jnp.asarray(x) for x in v) if isinstance(v, list) else jnp.asarray(v))
+            k: (
+                (v if keep_lists_on_host else [_pickle_to_device(x) for x in v])
+                if isinstance(v, list)
+                else _pickle_to_device(v)
+            )
             for k, v in self.__dict__["_state"].items()
         }
 
@@ -872,6 +884,25 @@ class Metric(ABC):
     def __pos__(self): return CompositionalMetric(operator.abs, self, None)
     def __invert__(self): return CompositionalMetric(_logical_not, self, None)
     def __getitem__(self, idx): return CompositionalMetric(_Indexer(idx), self, None)
+
+
+# dtypes that only exist as HOST state under jax's default 32-bit mode — arrays
+# carrying them were never device arrays, so (un)pickling must not touch them
+_HOST_ONLY_DTYPES = tuple(
+    np.dtype(t) for t in ("float64", "int64", "uint64", "complex128", "object")
+)
+
+
+def _pickle_to_host(x: Any) -> Any:
+    """Device array → host numpy; host payloads (numpy f64/object, None, …) pass through."""
+    return np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
+
+
+def _pickle_to_device(x: Any) -> Any:
+    """Numpy with a device-native dtype → jnp; everything else stays as pickled."""
+    if isinstance(x, np.ndarray) and x.dtype not in _HOST_ONLY_DTYPES:
+        return jnp.asarray(x)
+    return x
 
 
 def _neg(x: Array) -> Array:

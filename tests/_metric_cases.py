@@ -27,6 +27,29 @@ def _randint(hi, *shape):
     return jnp.asarray(_R.randint(0, hi, shape))
 
 
+def _probs(*shape):
+    p = _R.rand(*shape).astype(np.float32) + 0.05
+    return jnp.asarray(p / p.sum(-1, keepdims=True))
+
+
+def _panoptic_map():
+    cats = _R.choice([0, 1, 6, 7], size=(1, 8, 8))
+    inst = _R.randint(0, 3, (1, 8, 8))
+    return jnp.asarray(np.stack([cats, inst], axis=-1))
+
+
+def _detection_batch():
+    nd, ng = _R.randint(1, 4), _R.randint(1, 4)
+    db = (_R.rand(nd, 4).astype(np.float32) * 50).round(1)
+    db[:, 2:] = db[:, :2] + 1 + (_R.rand(nd, 2).astype(np.float32) * 30).round(1)
+    gb = (_R.rand(ng, 4).astype(np.float32) * 50).round(1)
+    gb[:, 2:] = gb[:, :2] + 1 + (_R.rand(ng, 2).astype(np.float32) * 30).round(1)
+    preds = [{"boxes": jnp.asarray(db), "scores": jnp.asarray(_R.rand(nd).astype(np.float32)),
+              "labels": jnp.asarray(_R.randint(0, 2, nd))}]
+    target = [{"boxes": jnp.asarray(gb), "labels": jnp.asarray(_R.randint(0, 2, ng))}]
+    return preds, target
+
+
 # (ctor, input-builder) — one representative per family, spanning every domain package.
 GENERIC_CASES = [
     pytest.param(lambda: C.BinaryAccuracy(), lambda: (_rand(10), _randint(2, 10)), id="BinaryAccuracy"),
@@ -129,5 +152,29 @@ GENERIC_CASES = [
         lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2),
         lambda: (_rand(10, 2), _rand(10, 2)),
         id="MultioutputWrapper",
+    ),
+    pytest.param(lambda: M.KLDivergence(), lambda: (_probs(6, 4), _probs(6, 4)), id="KLDivergence"),
+    pytest.param(lambda: M.CosineSimilarity(), lambda: (_rand(6, 4), _rand(6, 4)), id="CosineSimilarity"),
+    pytest.param(
+        lambda: M.SymmetricMeanAbsolutePercentageError(),
+        lambda: (_rand(10) + 0.5, _rand(10) + 0.5),
+        id="SMAPE",
+    ),
+    pytest.param(lambda: M.TheilsU(num_classes=3), lambda: (_randint(3, 25), _randint(3, 25)), id="TheilsU"),
+    pytest.param(lambda: C.BinaryHingeLoss(), lambda: (_rand(12), _randint(2, 12)), id="BinaryHingeLoss"),
+    pytest.param(
+        lambda: __import__("metrics_tpu.text", fromlist=["ROUGEScore"]).ROUGEScore(),
+        lambda: ("the cat sat on the mat", "a cat sat on the mat"),
+        id="ROUGEScore",
+    ),
+    pytest.param(
+        lambda: M.PanopticQuality(things={0, 1}, stuffs={6, 7}),
+        lambda: (_panoptic_map(), _panoptic_map()),
+        id="PanopticQuality",
+    ),
+    pytest.param(
+        lambda: __import__("metrics_tpu.detection", fromlist=["MeanAveragePrecision"]).MeanAveragePrecision(),
+        _detection_batch,
+        id="MeanAveragePrecision",
     ),
 ]

@@ -406,3 +406,12 @@ def test_tracker_best_metric_handles_unstackable_fallback():
     assert tr.best_metric() is None
     val, step = tr.best_metric(return_step=True)
     assert val is None and step is None
+
+
+def test_compute_on_cpu_survives_pickle():
+    """Restored compute_on_cpu metrics keep their list states on host (no HBM restore)."""
+    m = SpearmanCorrCoef(compute_on_cpu=True)
+    m.update(jnp.asarray(_R.rand(6).astype(np.float32)), jnp.asarray(_R.rand(6).astype(np.float32)))
+    clone = pickle.loads(pickle.dumps(m))
+    assert all(isinstance(x, np.ndarray) for x in clone._state["preds"])
+    assert float(clone.compute()) == pytest.approx(float(m.compute()), rel=1e-6)
