@@ -1,0 +1,81 @@
+"""Data-utility helpers against the reference's utilities suite.
+
+Models ``/root/reference/tests/unittests/utilities/test_utilities.py``:
+onehot/categorical round trips, top-k golden masks, flatten helpers, and
+bincount/cumsum equivalence with numpy.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utils.data import (
+    _flatten,
+    _flatten_dict,
+    bincount,
+    dim_zero_cat,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+
+
+def test_onehot_matches_eye_and_roundtrips():
+    """(N,) labels → (N, C); extra dims keep the class dim at axis 1 (reference test_onehot)."""
+    labels = jnp.arange(10)
+    onehot = to_onehot(labels, num_classes=10)
+    np.testing.assert_array_equal(np.asarray(onehot), np.eye(10))
+    # round trip through argmax
+    np.testing.assert_array_equal(np.asarray(to_categorical(onehot)), np.asarray(labels))
+
+    # batched spatial labels: (N, H) → (N, C, H)
+    spatial = jnp.asarray([[0, 2], [1, 1]])
+    oh = to_onehot(spatial, num_classes=3)
+    assert oh.shape == (2, 3, 2)
+    np.testing.assert_array_equal(np.asarray(oh[0, :, 0]), [1, 0, 0])
+    np.testing.assert_array_equal(np.asarray(oh[0, :, 1]), [0, 0, 1])
+
+
+def test_to_categorical_matches_reference_example():
+    x = jnp.asarray([[0.2, 0.5], [0.9, 0.6]])  # per-axis argmaxes differ: axis1→[1,0], axis0→[1,1]
+    np.testing.assert_array_equal(np.asarray(to_categorical(x)), [1, 0])
+    np.testing.assert_array_equal(np.asarray(to_categorical(x, argmax_dim=0)), [1, 1])
+
+
+@pytest.mark.parametrize(
+    ("k", "dim", "want"),
+    [
+        (1, 1, [[0, 1, 0], [0, 0, 1]]),
+        (2, 1, [[1, 1, 0], [1, 0, 1]]),
+    ],
+)
+def test_select_topk_goldens(k, dim, want):
+    probs = jnp.asarray([[0.3, 0.6, 0.1], [0.4, 0.2, 0.5]])
+    got = select_topk(probs, topk=k, dim=dim)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert got.dtype == jnp.int32
+
+
+def test_flatten_list_and_dict():
+    assert _flatten([[1, 2], [3], [], [4]]) == [1, 2, 3, 4]
+    flat, dup = _flatten_dict({"a": {"x": 1}, "b": 2})
+    assert flat == {"x": 1, "b": 2} and not dup
+    _, dup = _flatten_dict({"a": {"x": 1}, "b": {"x": 3}})
+    assert dup  # key collision reported, reference data.py:63-76
+
+
+@pytest.mark.parametrize("n", [0, 1, 513])
+def test_bincount_matches_numpy(n):
+    rng = np.random.RandomState(4)
+    x = rng.randint(0, 7, n)
+    got = bincount(jnp.asarray(x, dtype=jnp.int32), minlength=9)
+    np.testing.assert_array_equal(np.asarray(got), np.bincount(x, minlength=9))
+
+
+def test_dim_zero_cat_handles_lists_scalars_and_arrays():
+    np.testing.assert_array_equal(
+        np.asarray(dim_zero_cat([jnp.asarray([1.0]), jnp.asarray([2.0, 3.0])])), [1.0, 2.0, 3.0]
+    )
+    np.testing.assert_array_equal(np.asarray(dim_zero_cat(jnp.asarray([4.0]))), [4.0])
