@@ -248,7 +248,12 @@ def bench_collection():
 
 
 # --------------------------------------------------------------------- config 3
-def bench_retrieval():
+def bench_retrieval(force_device_sort: bool = False, ref_time: float = None):
+    """Config 3; with ``force_device_sort`` the on-device single-pass fused sort
+    (the TPU deployment path, ``retrieval/base.py:_device_order``) is timed on
+    this rig instead of the cpu-backend host-callback sort. Pass ``ref_time`` to
+    reuse an already-measured reference timing (the torch side is identical for
+    both sort paths)."""
     import jax
     import jax.numpy as jnp
 
@@ -262,7 +267,14 @@ def bench_retrieval():
     target_np[:: RET_DOCS] = 1  # every query has at least one positive
     indexes, preds, target = jnp.asarray(indexes_np), jnp.asarray(preds_np), jnp.asarray(target_np)
 
+    from metrics_tpu.retrieval import base as retrieval_base
+
     def ours():
+        # Clear the shared-view cache so every timed repeat pays the REAL cost
+        # including the grouping sort — the cache would otherwise serve the view
+        # built during the compile call (same array identities) and the config
+        # would time only the post-sort scoring.
+        retrieval_base._VIEW_CACHE.clear()
         vals = []
         for cls in (RetrievalMAP, RetrievalMRR):
             m = cls()
@@ -270,8 +282,18 @@ def bench_retrieval():
             vals.append(m.compute())  # async dispatch — no per-metric sync
         return [float(v) for v in jax.device_get(vals)]  # one fetch
 
-    ours()  # compile
-    t_ours, v_ours = _best_of(ours)
+    prior_flag = os.environ.get("METRICS_TPU_FORCE_DEVICE_SORT")
+    if force_device_sort:
+        os.environ["METRICS_TPU_FORCE_DEVICE_SORT"] = "1"
+    try:
+        ours()  # compile
+        t_ours, v_ours = _best_of(ours)
+    finally:
+        if force_device_sort:  # restore, never clobber an externally-set value
+            if prior_flag is None:
+                os.environ.pop("METRICS_TPU_FORCE_DEVICE_SORT", None)
+            else:
+                os.environ["METRICS_TPU_FORCE_DEVICE_SORT"] = prior_flag
 
     import torch
     from torchmetrics.retrieval import RetrievalMAP as RefMAP, RetrievalMRR as RefMRR
@@ -286,7 +308,10 @@ def bench_retrieval():
             res.append(float(m.compute()))
         return res
 
-    t_ref, v_ref = _best_of(ref, repeats=3)
+    if ref_time is None:
+        t_ref, v_ref = _best_of(ref, repeats=3)
+    else:  # identical torch workload for both sort paths — correctness-check once
+        t_ref, v_ref = ref_time, ref()
     np.testing.assert_allclose(v_ours, v_ref, atol=1e-5)
     return t_ours, t_ref, f"{RET_QUERIES} queries x {RET_DOCS} docs, MAP+MRR"
 
@@ -430,6 +455,22 @@ def main():
             speedups.append(speedup)
         except Exception as err:  # noqa: BLE001 — a failed config must not kill the bench line
             configs[name] = {"error": f"{type(err).__name__}: {err}"}
+    # Extra (outside the 5-config geomean, for round-over-round comparability):
+    # config 3 through the on-device fused single-pass sort — the path that runs
+    # on TPU, where the host-callback argsort is disabled (round-4 VERDICT weak #3).
+    try:
+        ref_ms = configs.get("retrieval", {}).get("ref_ms")
+        t_dev, t_ref_dev, what = bench_retrieval(
+            force_device_sort=True, ref_time=None if ref_ms is None else ref_ms / 1000.0
+        )
+        configs["retrieval_device_sort"] = {
+            "ours_ms": round(1000 * t_dev, 3),
+            "ref_ms": round(1000 * t_ref_dev, 3),
+            "speedup": round(t_ref_dev / t_dev, 3),
+            "workload": what + " [on-device fused sort — TPU deployment path; not in geomean]",
+        }
+    except Exception as err:  # noqa: BLE001
+        configs["retrieval_device_sort"] = {"error": f"{type(err).__name__}: {err}"}
     geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups)) if speedups else -1.0
     print(json.dumps({
         "metric": "bench_suite_speedup_geomean",

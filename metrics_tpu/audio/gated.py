@@ -9,7 +9,7 @@ it host-side (these never belong on the TPU).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -19,7 +19,6 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utils.imports import (
     _ONNXRUNTIME_AVAILABLE,
     _PESQ_AVAILABLE,
-    _PYSTOI_AVAILABLE,
 )
 
 
@@ -68,27 +67,33 @@ class PerceptualEvaluationSpeechQuality(_HostAudioMetric):
 
 
 class ShortTimeObjectiveIntelligibility(_HostAudioMetric):
-    """STOI via ``pystoi`` (reference ``audio/stoi.py:30``)."""
+    """STOI via ``pystoi`` when installed, else the in-framework native
+    implementation (reference ``audio/stoi.py:30``; native path
+    :func:`metrics_tpu.functional.audio.stoi.stoi_native`). Unlike the
+    reference, this metric therefore never import-gates.
+
+    >>> import numpy as np, jax.numpy as jnp
+    >>> rng = np.random.RandomState(0)
+    >>> clean = jnp.asarray(rng.randn(16000))
+    >>> m = ShortTimeObjectiveIntelligibility(fs=16000)
+    >>> m.update(clean, clean)
+    >>> round(float(m.compute()), 3)
+    1.0
+    """
 
     def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
-        if not _PYSTOI_AVAILABLE:
-            raise ModuleNotFoundError(
-                "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
-                " Install as `pip install pystoi`."
-            )
         super().__init__(**kwargs)
         self.fs = fs
         self.extended = extended
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with degraded and reference speech."""
-        from pystoi import stoi as stoi_backend
+        from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 
-        p = np.asarray(preds, dtype=np.float32).reshape(-1, preds.shape[-1])
-        t = np.asarray(target, dtype=np.float32).reshape(-1, target.shape[-1])
-        for pi, ti in zip(p, t):
-            self.sum_value = self.sum_value + float(stoi_backend(ti, pi, self.fs, extended=self.extended))
-            self.total = self.total + 1
+        scores = short_time_objective_intelligibility(preds, target, self.fs, extended=self.extended)
+        scores = jnp.atleast_1d(scores)
+        self.sum_value = self.sum_value + scores.sum()
+        self.total = self.total + scores.size
 
 
 class SpeechReverberationModulationEnergyRatio(_HostAudioMetric):
@@ -242,7 +247,8 @@ def _resample(audio: np.ndarray, sr_in: int, sr_out: int) -> np.ndarray:
             " Install it, or provide audio at the native rate."
         ) from err
     g = gcd(sr_in, sr_out)
-    return resample_poly(audio, sr_out // g, sr_in // g).astype(np.float32)
+    # dtype-preserving: DNSMOS/NISQA feed float32, the native STOI feeds float64
+    return resample_poly(audio, sr_out // g, sr_in // g).astype(audio.dtype)
 
 
 class DeepNoiseSuppressionMeanOpinionScore(Metric):
@@ -264,7 +270,9 @@ class DeepNoiseSuppressionMeanOpinionScore(Metric):
     _INPUT_LEN_S = 9.01
     _FS = 16000
 
-    def __init__(self, fs: int, personalized: bool = False, **kwargs: Any) -> None:
+    def __init__(
+        self, fs: int, personalized: bool = False, num_threads: Optional[int] = None, **kwargs: Any
+    ) -> None:
         if not _ONNXRUNTIME_AVAILABLE:
             raise ModuleNotFoundError(
                 "DeepNoiseSuppressionMeanOpinionScore metric requires that `onnxruntime` is installed."
@@ -273,6 +281,7 @@ class DeepNoiseSuppressionMeanOpinionScore(Metric):
         super().__init__(**kwargs)
         self.fs = fs
         self.personalized = personalized
+        self.num_threads = num_threads
         self._sessions = None
         self.add_state("sum_dnsmos", jnp.zeros(4), dist_reduce_fx="sum")
         self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
@@ -293,10 +302,14 @@ class DeepNoiseSuppressionMeanOpinionScore(Metric):
         import onnxruntime as ort
 
         if self._sessions is None:
+            opts = ort.SessionOptions()
+            if self.num_threads is not None:
+                opts.inter_op_num_threads = self.num_threads
+                opts.intra_op_num_threads = self.num_threads
             name = ("p" if self.personalized else "") + "sig_bak_ovr.onnx"
             self._sessions = (
-                ort.InferenceSession(_local_model_path(name, "DNSMOS"), providers=["CPUExecutionProvider"]),
-                ort.InferenceSession(_local_model_path("model_v8.onnx", "DNSMOS (P.808)"), providers=["CPUExecutionProvider"]),
+                ort.InferenceSession(_local_model_path(name, "DNSMOS"), opts, providers=["CPUExecutionProvider"]),
+                ort.InferenceSession(_local_model_path("model_v8.onnx", "DNSMOS (P.808)"), opts, providers=["CPUExecutionProvider"]),
             )
         sess_835, sess_808 = self._sessions
         if audio.shape[-1] == 0:

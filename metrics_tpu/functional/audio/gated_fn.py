@@ -1,0 +1,142 @@
+"""Functional entry points for the host-side gated audio metrics.
+
+Parity with reference ``functional/audio/{pesq.py:26,dnsmos.py:182,nisqa.py:66}``.
+PESQ stays a wrapper over the third-party C library (an ITU P.862 fixed-point
+port is a poor effort/value trade — see STATUS); DNSMOS/NISQA run the
+in-framework featurization (``functional/audio/melspec``) through local onnx
+scorers. All are import-gated exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.imports import _ONNXRUNTIME_AVAILABLE, _PESQ_AVAILABLE
+
+__all__ = [
+    "perceptual_evaluation_speech_quality",
+    "deep_noise_suppression_mean_opinion_score",
+    "non_intrusive_speech_quality_assessment",
+]
+
+
+def _pesq_one(fs: int, ref: np.ndarray, deg: np.ndarray, mode: str) -> float:
+    """Module-level (picklable) single-pair PESQ call for the worker pool."""
+    import pesq as pesq_backend
+
+    return float(pesq_backend.pesq(fs, ref, deg, mode))
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> Array:
+    """PESQ via the ``pesq`` C library (reference ``functional/audio/pesq.py:26``).
+
+    Accepts ``(..., time)``; returns one MOS-LQO score per waveform.
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that `pesq` is installed. Install as `pip install pesq`."
+        )
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    p = np.asarray(preds, dtype=np.float32)
+    t = np.asarray(target, dtype=np.float32)
+    if p.shape != t.shape:
+        raise ValueError(
+            f"Expected `preds` and `target` to have the same shape, but got {p.shape} and {t.shape}"
+        )
+    batch_shape = p.shape[:-1]
+    flat = list(zip(p.reshape(-1, p.shape[-1]), t.reshape(-1, t.shape[-1])))
+    if n_processes > 1 and len(flat) > 1:
+        # fan the C-library calls over worker processes, as the reference does
+        # (functional/audio/pesq.py:26 via pesq_batch(n_processor=...))
+        import multiprocessing as mp
+
+        with mp.Pool(processes=min(n_processes, len(flat))) as pool:
+            vals = pool.starmap(_pesq_one, [(fs, ti, pi, mode) for pi, ti in flat])
+    else:
+        vals = [_pesq_one(fs, ti, pi, mode) for pi, ti in flat]
+    return jnp.asarray(np.asarray(vals, dtype=np.float32).reshape(batch_shape))
+
+
+# scorer instances (and the two onnx sessions inside them) reused across calls
+# when cache_session=True — the reference's session cache, keyed the same way
+_DNSMOS_SCORERS: dict = {}
+
+
+def deep_noise_suppression_mean_opinion_score(
+    preds: Array,
+    fs: int,
+    personalized: bool = False,
+    device: Optional[str] = None,
+    num_threads: Optional[int] = None,
+    cache_session: bool = True,
+) -> Array:
+    """DNSMOS ``[p808_mos, mos_sig, mos_bak, mos_ovr]`` per waveform
+    (reference ``functional/audio/dnsmos.py:182``). Accepts ``(..., time)``;
+    returns ``(..., 4)``. The onnx scorers always run on the host CPU here (they
+    never belong on the TPU); a ``device`` requesting anything else is rejected."""
+    if not _ONNXRUNTIME_AVAILABLE:
+        raise ModuleNotFoundError(
+            "DNSMOS metric requires that `onnxruntime` is installed."
+            " Install as `pip install onnxruntime`."
+        )
+    if device is not None and "cpu" not in str(device).lower():
+        raise ValueError(
+            f"DNSMOS onnx scorers run host-side on CPU in this build; got device={device!r}."
+        )
+    from metrics_tpu.audio.gated import DeepNoiseSuppressionMeanOpinionScore
+
+    key = (fs, personalized, num_threads)
+    scorer = _DNSMOS_SCORERS.get(key) if cache_session else None
+    if scorer is None:
+        scorer = DeepNoiseSuppressionMeanOpinionScore(
+            fs=fs, personalized=personalized, num_threads=num_threads
+        )
+        if cache_session:
+            _DNSMOS_SCORERS[key] = scorer
+    p = np.asarray(preds, dtype=np.float32)
+    batch_shape = p.shape[:-1]
+    rows = [scorer._scores_for(wav) for wav in p.reshape(-1, p.shape[-1])]
+    return jnp.asarray(np.asarray(rows, dtype=np.float32).reshape(*batch_shape, 4))
+
+
+# metric instances (holding the loaded onnx session) reused across calls — the
+# reference lru_caches its model the same way (functional/audio/nisqa.py:123)
+_NISQA_SCORERS: dict = {}
+
+
+def non_intrusive_speech_quality_assessment(preds: Array, fs: int) -> Array:
+    """NISQA ``[mos, noisiness, discontinuity, coloration, loudness]`` per
+    waveform (reference ``functional/audio/nisqa.py:66``). Accepts
+    ``(..., time)``; returns ``(..., 5)``."""
+    if not _ONNXRUNTIME_AVAILABLE:
+        raise ModuleNotFoundError(
+            "NISQA metric requires that `onnxruntime` is installed."
+            " Install as `pip install onnxruntime`."
+        )
+    from metrics_tpu.audio.gated import NonIntrusiveSpeechQualityAssessment
+
+    metric = _NISQA_SCORERS.get(fs)
+    if metric is None:
+        metric = _NISQA_SCORERS[fs] = NonIntrusiveSpeechQualityAssessment(fs=fs)
+    p = np.asarray(preds, dtype=np.float32)
+    batch_shape = p.shape[:-1]
+    rows = []
+    for wav in p.reshape(-1, p.shape[-1]):
+        metric.reset()
+        metric.update(jnp.asarray(wav))
+        rows.append(np.asarray(metric.compute()))
+    return jnp.asarray(np.asarray(rows, dtype=np.float32).reshape(*batch_shape, 5))

@@ -137,8 +137,14 @@ from metrics_tpu.functional.classification.stat_scores import (
     multilabel_stat_scores,
 )
 
+# The reference lists `generalized_dice_score` in this namespace's `__all__`
+# (functional/classification/__init__.py:185) without a backing import — an
+# upstream oversight. We keep the name resolvable here with a real alias.
+from metrics_tpu.functional.segmentation.metrics import generalized_dice_score
+
 __all__ = [
     "dice",
+    "generalized_dice_score",
     "binary_calibration_error", "calibration_error", "multiclass_calibration_error",
     "binary_fairness", "binary_groups_stat_rates", "demographic_parity", "equal_opportunity",
     "binary_hinge_loss", "hinge_loss", "multiclass_hinge_loss",

@@ -1,0 +1,6 @@
+"""Functional multimodal metrics (reference ``torchmetrics/functional/multimodal/__init__.py``)."""
+
+from metrics_tpu.functional.multimodal.clip_iqa import clip_image_quality_assessment
+from metrics_tpu.functional.multimodal.clip_score import clip_score
+
+__all__ = ["clip_image_quality_assessment", "clip_score"]

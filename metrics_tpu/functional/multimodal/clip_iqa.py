@@ -1,0 +1,108 @@
+"""Functional CLIP-IQA (reference ``functional/multimodal/clip_iqa.py:218``).
+
+Score = softmax over each image's similarity to a (positive, negative) prompt
+pair, reported as the probability mass on the positive prompt. Prompt table and
+semantics match the modular ``CLIPImageQualityAssessment``; encoders are
+injectable for offline use (default: local HF Flax CLIP via ``models.hub``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.multimodal.clip_score import _unit
+
+__all__ = ["clip_image_quality_assessment"]
+
+# canonical prompt table (reference ``multimodal/clip_iqa.py:55-71``); the
+# modular ``CLIPImageQualityAssessment`` consumes this same table and resolver
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _resolve_prompts(
+    prompts: Tuple[Union[str, Tuple[str, str]], ...],
+) -> Tuple[List[Tuple[str, str]], List[str]]:
+    resolved: List[Tuple[str, str]] = []
+    names: List[str] = []
+    n_custom = 0  # reference numbers custom tuples by their own count (clip_iqa.py:116,138)
+    for p in prompts:
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"Unknown prompt {p!r}; expected one of {sorted(_PROMPTS)} or a (pos, neg) tuple"
+                )
+            resolved.append(_PROMPTS[p])
+            names.append(p)
+        elif isinstance(p, tuple) and len(p) == 2:
+            resolved.append(p)
+            names.append(f"user_defined_{n_custom}")
+            n_custom += 1
+        else:
+            raise ValueError(
+                "Argument `prompts` must contain strings or (positive, negative) tuples"
+            )
+    return resolved, names
+
+
+def clip_image_quality_assessment(
+    images: Array,
+    model_name_or_path: str = "openai/clip-vit-large-patch14",
+    data_range: float = 1.0,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+    image_encoder: Optional[Callable] = None,
+    text_encoder: Optional[Callable] = None,
+) -> Union[Array, Dict[str, Array]]:
+    """Per-image CLIP-IQA scores in [0, 1].
+
+    Returns a ``(N,)`` array for a single prompt, else a dict keyed by prompt
+    name (reference ``functional/multimodal/clip_iqa.py:218-330``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(0)
+    >>> enc = lambda xs: jnp.asarray(rng.rand(len(xs), 16).astype(np.float32))
+    >>> out = clip_image_quality_assessment(jnp.zeros((2, 3, 8, 8)),
+    ...     image_encoder=enc, text_encoder=enc)
+    >>> out.shape
+    (2,)
+    """
+    if image_encoder is None or text_encoder is None:
+        from metrics_tpu.models.hub import load_clip
+
+        default_img, default_txt = load_clip(model_name_or_path)
+        image_encoder = image_encoder or default_img
+        text_encoder = text_encoder or default_txt
+    pairs, names = _resolve_prompts(prompts)
+
+    imgs = images[None] if getattr(images, "ndim", 0) == 3 else images
+    imgs = jnp.asarray(imgs, dtype=jnp.float32) / float(data_range)
+    img_emb = _unit(jnp.asarray(image_encoder(imgs)))
+    per_prompt = []
+    for pos, neg in pairs:
+        txt_emb = _unit(jnp.asarray(text_encoder([pos, neg])))
+        logits = 100.0 * img_emb @ txt_emb.T  # (N, 2)
+        per_prompt.append(jax.nn.softmax(logits, axis=-1)[:, 0])
+    if len(names) == 1:
+        return per_prompt[0]
+    return {name: vals for name, vals in zip(names, per_prompt)}

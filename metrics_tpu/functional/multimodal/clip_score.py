@@ -1,0 +1,83 @@
+"""Functional CLIPScore (reference ``functional/multimodal/clip_score.py:205``).
+
+Offline-first jax design: the score math (embed → normalize → cosine ×100 →
+mean → clamp ≥0, matching the reference's order) is pure jnp; encoders are
+injectable callables so the metric works without network weights. When omitted,
+both default to the local HF Flax CLIP checkpoint via
+``metrics_tpu.models.hub.load_clip`` — the same loader the modular ``CLIPScore``
+uses.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+__all__ = ["clip_score"]
+
+
+def _is_text(x: object) -> bool:
+    return isinstance(x, str) or (
+        isinstance(x, (list, tuple)) and len(x) > 0 and isinstance(x[0], str)
+    )
+
+
+def _as_batch(x: Union[Array, Sequence, str]) -> Union[List[str], Sequence]:
+    if isinstance(x, str):
+        return [x]
+    if hasattr(x, "ndim") and getattr(x, "ndim", 0) == 3:
+        return x[None]
+    return x
+
+
+def _unit(x: Array) -> Array:
+    return x / jnp.clip(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12, None)
+
+
+def clip_score(
+    source: Union[Array, Sequence, str],
+    target: Union[Array, Sequence, str],
+    model_name_or_path: str = "openai/clip-vit-large-patch14",
+    image_encoder: Optional[Callable] = None,
+    text_encoder: Optional[Callable] = None,
+) -> Array:
+    """CLIPScore(S, T) = max(mean over pairs of 100 · cos(E_S, E_T), 0) — the
+    clamp applies AFTER the batch mean, as in the reference.
+
+    Either slot can hold images (``[N, C, H, W]`` array or list of ``[C, H, W]``)
+    or text (caption or list of captions) — image-text, image-image, and
+    text-text comparisons all work, matching the reference
+    (``functional/multimodal/clip_score.py:205-270``).
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(0)
+    >>> enc = lambda xs: jnp.asarray(rng.rand(len(xs), 16).astype(np.float32))
+    >>> s = clip_score(jnp.zeros((2, 3, 8, 8)), ["a cat", "a dog"],
+    ...                image_encoder=enc, text_encoder=enc)
+    >>> bool((s >= 0) & (s <= 100))
+    True
+    """
+    if image_encoder is None or text_encoder is None:
+        from metrics_tpu.models.hub import load_clip
+
+        default_img, default_txt = load_clip(model_name_or_path)
+        image_encoder = image_encoder or default_img
+        text_encoder = text_encoder or default_txt
+
+    def _embed(x: Union[Array, Sequence, str]) -> Tuple[Array, int]:
+        batch = _as_batch(x)
+        enc = text_encoder if _is_text(batch) else image_encoder
+        emb = _unit(jnp.asarray(enc(batch)))
+        return emb, len(batch)
+
+    src_emb, n_src = _embed(source)
+    tgt_emb, n_tgt = _embed(target)
+    if n_src != n_tgt:
+        raise ValueError(
+            f"Expected the number of source and target examples to be the same but got {n_src} and {n_tgt}"
+        )
+    score = 100.0 * jnp.sum(src_emb * tgt_emb, axis=-1)
+    return jnp.maximum(jnp.mean(score), 0.0).astype(jnp.float32)

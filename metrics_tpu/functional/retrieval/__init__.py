@@ -1,6 +1,7 @@
 """Functional retrieval metrics (reference ``torchmetrics/functional/retrieval/__init__.py``)."""
 
 from metrics_tpu.functional.retrieval.metrics import (
+    retrieval_auroc,
     retrieval_average_precision,
     retrieval_fall_out,
     retrieval_hit_rate,
@@ -13,6 +14,7 @@ from metrics_tpu.functional.retrieval.metrics import (
 )
 
 __all__ = [
+    "retrieval_auroc",
     "retrieval_average_precision",
     "retrieval_fall_out",
     "retrieval_hit_rate",

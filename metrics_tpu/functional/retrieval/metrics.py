@@ -142,6 +142,33 @@ def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] =
     return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0).astype(jnp.float32)
 
 
+def retrieval_auroc(
+    preds: Array, target: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
+    """AUROC for a single query (reference ``functional/retrieval/auroc.py:22-66``).
+
+    Restricts to the top-k documents by prediction score, then computes binary
+    AUROC over them; 0.0 when the top-k slice is single-class.
+
+    >>> import jax.numpy as jnp
+    >>> retrieval_auroc(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]))
+    Array(0.5, dtype=float32)
+    """
+    from metrics_tpu.functional.classification.auroc import binary_auroc
+
+    k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    k = min(k, preds.shape[-1])
+    order = jnp.argsort(-preds, stable=True)[:k]
+    top_target = target[order].astype(jnp.int32)
+    # single-class slice (all relevant or none) has no ROC — defined as 0.0
+    n_pos = jnp.sum(top_target)
+    degenerate = (n_pos == 0) | (n_pos == k)
+    auroc_val = binary_auroc(preds[order], top_target, max_fpr=max_fpr)
+    return jnp.where(degenerate, 0.0, auroc_val).astype(jnp.float32)
+
+
 def retrieval_precision_recall_curve(
     preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Tuple[Array, Array, Array]:

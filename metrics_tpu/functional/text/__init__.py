@@ -11,11 +11,14 @@ from metrics_tpu.functional.text.error_rates import (
     word_information_preserved,
 )
 from metrics_tpu.functional.text.misc import extended_edit_distance, squad, translation_edit_rate
+from metrics_tpu.functional.text.model_based import bert_score, infolm
 from metrics_tpu.functional.text.perplexity import perplexity
 from metrics_tpu.functional.text.rouge import rouge_score
 
 __all__ = [
+    "bert_score",
     "bleu_score",
+    "infolm",
     "char_error_rate",
     "chrf_score",
     "edit_distance",

@@ -1,0 +1,90 @@
+"""Functional entry points for the model-based text metrics.
+
+Parity with reference ``functional/text/bert.py:260`` (``bert_score``) and
+``functional/text/infolm.py:546`` (``infolm``). Single-shot convenience
+wrappers over the modular metrics: construct, update once, compute. Encoders /
+distribution fns are injectable for offline use, mirroring the modular classes
+(``metrics_tpu/text/model_based.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+from jax import Array
+
+__all__ = ["bert_score", "infolm"]
+
+
+def bert_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: Optional[str] = None,
+    encoder: Optional[Callable] = None,
+    idf: bool = False,
+    rescale_with_baseline: bool = False,
+    **kwargs: Any,
+) -> Dict[str, Array]:
+    """Greedy-cosine-matching BERTScore P/R/F1 (reference ``functional/text/bert.py:260``).
+
+    >>> import numpy as np
+    >>> rng = np.random.RandomState(42)
+    >>> vocab = {w: rng.rand(8) for w in "the cat sat on mat".split()}
+    >>> enc = lambda texts: [np.stack([vocab[w] for w in t.split()]) for t in texts]
+    >>> out = bert_score(["the cat sat"], ["the cat sat"], encoder=enc)
+    >>> round(float(out["f1"]), 4)
+    1.0
+    """
+    from metrics_tpu.text.model_based import BERTScore
+
+    metric = BERTScore(
+        model_name_or_path=model_name_or_path,
+        encoder=encoder,
+        idf=idf,
+        rescale_with_baseline=rescale_with_baseline,
+        **kwargs,
+    )
+    metric.update(preds, target)
+    return metric.compute()
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: Optional[str] = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    distribution_fn: Optional[Callable] = None,
+    return_sentence_level_score: bool = False,
+    **kwargs: Any,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM divergence between masked-LM token distributions
+    (reference ``functional/text/infolm.py:546``).
+
+    Requires ``distribution_fn`` (list of strings → per-text ``(T_i, V)`` token
+    probability arrays) in this zero-egress build — same contract as the modular
+    :class:`~metrics_tpu.text.model_based.InfoLM`. ``temperature`` re-tempers the
+    injected distributions per token (``p^(1/T)`` renormalized — identical to the
+    reference applying T inside the MLM softmax); the default 0.25 matches the
+    reference's default.
+    """
+    from metrics_tpu.text.model_based import InfoLM
+
+    metric = InfoLM(
+        model_name_or_path=model_name_or_path,
+        distribution_fn=distribution_fn,
+        information_measure=information_measure,
+        idf=idf,
+        alpha=0.25 if alpha is None else alpha,
+        beta=0.25 if beta is None else beta,
+        temperature=temperature,
+        **kwargs,
+    )
+    metric.update(preds, target)
+    score = metric.compute()
+    if return_sentence_level_score:
+        return score, metric.compute_sentence_scores()
+    return score

@@ -76,11 +76,13 @@ class CLIPScore(Metric):
         img_emb = _unit(jnp.asarray(self.image_encoder(images)))
         txt_emb = _unit(jnp.asarray(self.text_encoder(text_)))
         score = 100 * jnp.sum(img_emb * txt_emb, axis=-1)
-        self.score = self.score + jnp.clip(score, 0, None).sum()
+        # raw sum; the clamp applies once to the MEAN in compute (reference
+        # clip_score.py accumulates unclamped and clamps the final average)
+        self.score = self.score + score.sum()
         self.n_samples = self.n_samples + score.shape[0]
 
     def compute(self) -> Array:
-        """Average CLIPScore."""
+        """Average CLIPScore, clamped at 0."""
         return jnp.maximum(self.score / self.n_samples, 0.0).astype(jnp.float32)
 
 
@@ -107,25 +109,6 @@ class CLIPImageQualityAssessment(Metric):
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
 
-    _PROMPTS: Dict[str, Tuple[str, str]] = {
-        "quality": ("Good photo.", "Bad photo."),
-        "brightness": ("Bright photo.", "Dark photo."),
-        "noisiness": ("Clean photo.", "Noisy photo."),
-        "colorfullness": ("Colorful photo.", "Dull photo."),
-        "sharpness": ("Sharp photo.", "Blurry photo."),
-        "contrast": ("High contrast photo.", "Low contrast photo."),
-        "complexity": ("Complex photo.", "Simple photo."),
-        "natural": ("Natural photo.", "Synthetic photo."),
-        "happy": ("Happy photo.", "Sad photo."),
-        "scary": ("Scary photo.", "Peaceful photo."),
-        "new": ("New photo.", "Old photo."),
-        "warm": ("Warm photo.", "Cold photo."),
-        "real": ("Real photo.", "Abstract photo."),
-        "beautiful": ("Beautiful photo.", "Ugly photo."),
-        "lonely": ("Lonely photo.", "Sociable photo."),
-        "relaxing": ("Relaxing photo.", "Stressful photo."),
-    }
-
     def __init__(
         self,
         model_name_or_path: Optional[str] = None,
@@ -143,21 +126,10 @@ class CLIPImageQualityAssessment(Metric):
             )
         self.image_encoder = image_encoder
         self.text_encoder = text_encoder
-        resolved = []
-        names = []
-        for p in prompts:
-            if isinstance(p, str):
-                if p not in self._PROMPTS:
-                    raise ValueError(f"Unknown prompt {p!r}; expected one of {sorted(self._PROMPTS)} or a (pos, neg) tuple")
-                resolved.append(self._PROMPTS[p])
-                names.append(p)
-            elif isinstance(p, tuple) and len(p) == 2:
-                resolved.append(p)
-                names.append(f"user_defined_{len(names)}")
-            else:
-                raise ValueError("Argument `prompts` must contain strings or (positive, negative) tuples")
-        self.prompt_pairs = resolved
-        self.prompt_names = names
+        # single-sourced prompt table + resolver (functional/multimodal/clip_iqa.py)
+        from metrics_tpu.functional.multimodal.clip_iqa import _resolve_prompts
+
+        self.prompt_pairs, self.prompt_names = _resolve_prompts(prompts)
         self.add_state("scores", [], dist_reduce_fx="cat")
 
     def update(self, images: Array) -> None:

@@ -63,6 +63,22 @@ def _retrieval_aggregate(values: Array, aggregation: str = "mean", mask: Optiona
     return aggregation(values[np.asarray(mask)])
 
 
+def _device_order(indexes: Array, values: Array) -> Array:
+    """On-device stable argsort by (query asc, value desc) in ONE sort pass.
+
+    ``jnp.lexsort`` would run one stable sort per key (two passes over HBM);
+    XLA's variadic sort compares all key operands in a single fused pass, so we
+    hand ``lax.sort`` the pair (query, -value) as keys and ride an iota operand
+    out as the permutation. NaN values rank last within their query (the float
+    total order puts NaN after +inf), matching the host path.
+    """
+    n = indexes.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    neg = -values.astype(jnp.float32)
+    _, _, perm = jax.lax.sort((indexes.astype(jnp.int32), neg, iota), num_keys=2, is_stable=True)
+    return perm
+
+
 def _order_by_query_desc(indexes: Array, values: Array) -> Array:
     """Stable argsort by (query asc, value desc) — the grouping sort.
 
@@ -70,13 +86,19 @@ def _order_by_query_desc(indexes: Array, values: Array) -> Array:
     numpy's introsort for 400k keys on this class of host), so on the ``cpu``
     backend the argsort runs host-side through ``pure_callback`` on a single
     64-bit composite key (query id in the high 32 bits, descending-sortable IEEE
-    bits of the value in the low 32). On accelerators the on-device ``lexsort``
-    is kept: the device→host transfer would cost more than the sort, and the
-    composite trick needs 64-bit integers that jax disables by default.
+    bits of the value in the low 32). On accelerators the single-pass fused
+    device sort (:func:`_device_order`) is used: the device→host transfer would
+    cost more than the sort, and the composite trick needs 64-bit integers that
+    jax disables by default. Set ``METRICS_TPU_FORCE_DEVICE_SORT=1`` to force
+    the device path on any backend — the bench uses this to time the
+    deployment (TPU) sort path explicitly on the CPU rig.
     """
+    import os
+
     n = indexes.shape[0]
-    if jax.default_backend() != "cpu" or n == 0:
-        return jnp.lexsort((-values.astype(jnp.float32), indexes))
+    force_device = os.environ.get("METRICS_TPU_FORCE_DEVICE_SORT", "") == "1"
+    if jax.default_backend() != "cpu" or n == 0 or force_device:
+        return _device_order(indexes, values)
 
     def _host(idx, vals):
         v = np.ascontiguousarray(np.asarray(vals, dtype=np.float32))

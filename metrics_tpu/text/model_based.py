@@ -118,6 +118,7 @@ class InfoLM(Metric):
         idf: bool = False,
         alpha: float = 0.25,
         beta: float = 0.25,
+        temperature: float = 0.25,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -128,11 +129,19 @@ class InfoLM(Metric):
             )
         if information_measure not in self._MEASURES:
             raise ValueError(f"Expected `information_measure` to be one of {self._MEASURES}")
+        if not (isinstance(temperature, (int, float)) and temperature > 0):
+            raise ValueError(f"Expected `temperature` to be a positive number but got {temperature}")
         self.distribution_fn = distribution_fn
         self.information_measure = information_measure
         self.idf = idf
         self.alpha = alpha
         self.beta = beta
+        # Re-tempering exponent: softmax(z/T) == softmax(z)^(1/T) renormalized, so
+        # applying p^(1/T) per token to the injected distributions reproduces the
+        # reference's temperature semantics (infolm.py:546 applies T inside the
+        # MLM softmax). Default 0.25 matches the reference; pass 1.0 to use
+        # distribution_fn's outputs untouched.
+        self.temperature = float(temperature)
         self._pairs: List = []
 
     def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
@@ -174,18 +183,34 @@ class InfoLM(Metric):
             - np.log(np.sum(p**a * q**b)) / (a * b)
         )
 
-    def compute(self) -> Array:
-        """Average information measure over pairs (mean-pooled token distributions)."""
+    def _temper(self, dist: np.ndarray) -> np.ndarray:
+        """Per-token ``p^(1/T)`` renormalized — identity at T=1."""
+        if self.temperature == 1.0:
+            return dist
+        t = np.clip(dist, 1e-12, None) ** (1.0 / self.temperature)
+        return t / t.sum(axis=-1, keepdims=True)
+
+    def _pair_scores(self) -> List[float]:
         pred_dists = self.distribution_fn([p for p, _ in self._pairs])
         tgt_dists = self.distribution_fn([t for _, t in self._pairs])
         vals = []
         for pd, td in zip(pred_dists, tgt_dists):
-            p = np.asarray(pd, dtype=np.float64).mean(0)
-            q = np.asarray(td, dtype=np.float64).mean(0)
+            p = self._temper(np.asarray(pd, dtype=np.float64)).mean(0)
+            q = self._temper(np.asarray(td, dtype=np.float64)).mean(0)
             p = p / p.sum()
             q = q / q.sum()
             vals.append(self._measure(p, q))
+        return vals
+
+    def compute(self) -> Array:
+        """Average information measure over pairs (mean-pooled token distributions)."""
+        vals = self._pair_scores()
         return jnp.asarray(np.mean(vals) if vals else 0.0, dtype=jnp.float32)
+
+    def compute_sentence_scores(self) -> Array:
+        """Per-pair scores (the reference's ``return_sentence_level_score`` payload,
+        ``functional/text/infolm.py:560``)."""
+        return jnp.asarray(np.asarray(self._pair_scores(), dtype=np.float32))
 
     def reset(self) -> None:
         """Reset stored pairs too."""

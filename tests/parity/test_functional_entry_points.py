@@ -1,0 +1,192 @@
+"""Round-5 functional entry points (VERDICT r4 missing #1-3 + recursive-walk finds).
+
+Covers ``functional.multimodal.{clip_score,clip_image_quality_assessment}``,
+``functional.retrieval.retrieval_auroc`` consistency with the modular engine,
+the ``generalized_dice_score`` classification alias, ``functional.text``'s
+``bert_score``/``infolm``, and the import gates on the functional gated-audio
+wrappers.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _fake_encoders(dim=16, seed=0):
+    rng = np.random.RandomState(seed)
+    cache = {}
+
+    def enc(xs):
+        out = []
+        for x in xs:
+            key = x if isinstance(x, str) else ("img", getattr(x, "shape", None), float(np.sum(np.asarray(x))))
+            if key not in cache:
+                cache[key] = rng.rand(dim).astype(np.float32)
+            out.append(cache[key])
+        return jnp.asarray(np.stack(out))
+
+    return enc, enc
+
+
+def test_functional_clip_score_matches_modular():
+    from metrics_tpu.functional.multimodal import clip_score
+    from metrics_tpu.multimodal import CLIPScore
+
+    img_enc, txt_enc = _fake_encoders()
+    imgs = jnp.asarray(np.random.RandomState(1).rand(3, 3, 8, 8).astype(np.float32))
+    caps = ["a cat", "a dog", "a bird"]
+    got = clip_score(imgs, caps, image_encoder=img_enc, text_encoder=txt_enc)
+    m = CLIPScore(image_encoder=img_enc, text_encoder=txt_enc)
+    m.update(imgs, caps)
+    assert float(got) == pytest.approx(float(m.compute()), abs=1e-5)
+
+
+def test_functional_clip_score_text_text_and_mismatch():
+    from metrics_tpu.functional.multimodal import clip_score
+
+    enc, _ = _fake_encoders()
+    s = clip_score("hello there", "hello there", image_encoder=enc, text_encoder=enc)
+    assert float(s) == pytest.approx(100.0, abs=1e-3)  # identical embedding
+    with pytest.raises(ValueError, match="same"):
+        clip_score(["a", "b"], ["c"], image_encoder=enc, text_encoder=enc)
+
+
+def test_functional_clip_iqa_matches_modular():
+    from metrics_tpu.functional.multimodal import clip_image_quality_assessment
+    from metrics_tpu.multimodal import CLIPImageQualityAssessment
+
+    img_enc, txt_enc = _fake_encoders(seed=2)
+    imgs = jnp.asarray(np.random.RandomState(3).rand(2, 3, 8, 8).astype(np.float32))
+    got = clip_image_quality_assessment(
+        imgs, prompts=("quality", "brightness"), image_encoder=img_enc, text_encoder=txt_enc
+    )
+    m = CLIPImageQualityAssessment(
+        prompts=("quality", "brightness"), image_encoder=img_enc, text_encoder=txt_enc
+    )
+    m.update(imgs)
+    want = m.compute()
+    assert set(got) == set(want) == {"quality", "brightness"}
+    for k in got:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), atol=1e-5)
+
+
+def test_functional_clip_iqa_single_prompt_shape_and_validation():
+    from metrics_tpu.functional.multimodal import clip_image_quality_assessment
+
+    img_enc, txt_enc = _fake_encoders(seed=4)
+    imgs = jnp.zeros((3, 3, 8, 8))
+    out = clip_image_quality_assessment(imgs, image_encoder=img_enc, text_encoder=txt_enc)
+    assert out.shape == (3,)
+    assert bool(((out >= 0) & (out <= 1)).all())
+    with pytest.raises(ValueError, match="Unknown prompt"):
+        clip_image_quality_assessment(imgs, prompts=("bogus",), image_encoder=img_enc, text_encoder=txt_enc)
+    # custom tuples are numbered by their own count, not the overall position
+    # (reference clip_iqa.py:116,138): built-in first, tuple second → user_defined_0
+    mixed = clip_image_quality_assessment(
+        imgs, prompts=("quality", ("Nice photo.", "Awful photo.")),
+        image_encoder=img_enc, text_encoder=txt_enc,
+    )
+    assert set(mixed) == {"quality", "user_defined_0"}
+
+
+def test_retrieval_auroc_functional_consistent_with_modular_engine():
+    from metrics_tpu.functional.retrieval import retrieval_auroc
+    from metrics_tpu.retrieval import RetrievalAUROC
+
+    rng = np.random.RandomState(5)
+    n, groups = 200, 8
+    indexes = rng.randint(0, groups, n)
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n)
+    m = RetrievalAUROC()
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    per_query = [
+        float(retrieval_auroc(jnp.asarray(preds[indexes == q]), jnp.asarray(target[indexes == q])))
+        for q in range(groups)
+    ]
+    assert float(m.compute()) == pytest.approx(np.mean(per_query), abs=1e-5)
+
+
+def test_generalized_dice_score_classification_alias():
+    import metrics_tpu.functional.classification as cls_ns
+    import metrics_tpu.functional.segmentation as seg_ns
+
+    assert cls_ns.generalized_dice_score is seg_ns.generalized_dice_score
+    assert "generalized_dice_score" in cls_ns.__all__
+
+
+def test_functional_bert_score_matches_modular():
+    from metrics_tpu.functional.text import bert_score
+    from metrics_tpu.text.model_based import BERTScore
+
+    rng = np.random.RandomState(6)
+    vocab = {w: rng.rand(8) for w in "the cat sat on mat a dog ran".split()}
+    enc = lambda texts: [np.stack([vocab[w] for w in t.split()]) for t in texts]
+    preds, target = ["the cat sat", "a dog ran"], ["the cat sat on mat", "a dog ran"]
+    got = bert_score(preds, target, encoder=enc)
+    m = BERTScore(encoder=enc)
+    m.update(preds, target)
+    want = m.compute()
+    for k in ("precision", "recall", "f1"):
+        assert float(got[k]) == pytest.approx(float(want[k]), abs=1e-6)
+
+
+def test_functional_infolm_sentence_level_scores():
+    from metrics_tpu.functional.text import infolm
+
+    rng = np.random.RandomState(7)
+    dists = {}
+
+    def distribution_fn(texts):
+        out = []
+        for t_ in texts:
+            if t_ not in dists:
+                raw = rng.rand(4, 10) + 1e-3
+                dists[t_] = raw / raw.sum(-1, keepdims=True)
+            out.append(dists[t_])
+        return out
+
+    preds, target = ["aa", "bb"], ["aa", "cc"]
+    corpus, sentences = infolm(
+        preds, target, distribution_fn=distribution_fn, return_sentence_level_score=True
+    )
+    assert sentences.shape == (2,)
+    assert float(sentences[0]) == pytest.approx(0.0, abs=1e-6)  # identical distributions
+    assert float(corpus) == pytest.approx(float(np.mean(np.asarray(sentences))), abs=1e-6)
+
+
+def test_infolm_temperature_is_applied():
+    from metrics_tpu.functional.text import infolm
+    from metrics_tpu.text.model_based import InfoLM
+
+    rng = np.random.RandomState(8)
+    raw = {t: (lambda r: r / r.sum(-1, keepdims=True))(rng.rand(3, 6) + 1e-3) for t in ("x", "y")}
+    fn = lambda texts: [raw[t] for t in texts]
+    hot = float(infolm(["x"], ["y"], distribution_fn=fn, temperature=1.0))
+    cold = float(infolm(["x"], ["y"], distribution_fn=fn, temperature=0.25))
+    assert hot != pytest.approx(cold)  # sweeping temperature must change the score
+    # T=0.25 == p^4 renormalized per token, then the identity pipeline
+    sharp = {t: (d**4) / (d**4).sum(-1, keepdims=True) for t, d in raw.items()}
+    want = float(infolm(["x"], ["y"], distribution_fn=lambda ts: [sharp[t] for t in ts], temperature=1.0))
+    assert cold == pytest.approx(want, abs=1e-9)
+    with pytest.raises(ValueError, match="temperature"):
+        InfoLM(distribution_fn=fn, temperature=0.0)
+
+
+def test_gated_audio_functionals_raise_cleanly_without_packages():
+    from metrics_tpu.functional.audio import (
+        deep_noise_suppression_mean_opinion_score,
+        non_intrusive_speech_quality_assessment,
+        perceptual_evaluation_speech_quality,
+    )
+    from metrics_tpu.utils.imports import _ONNXRUNTIME_AVAILABLE, _PESQ_AVAILABLE
+
+    wav = jnp.zeros((2, 8000))
+    if not _PESQ_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="pesq"):
+            perceptual_evaluation_speech_quality(wav, wav, 8000, "nb")
+    if not _ONNXRUNTIME_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
+            deep_noise_suppression_mean_opinion_score(wav, 8000)
+        with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
+            non_intrusive_speech_quality_assessment(wav, 8000)

@@ -227,6 +227,9 @@ RETRIEVAL_FNS = [
     ("retrieval_r_precision", {}),
     ("retrieval_normalized_dcg", {}),
     ("retrieval_normalized_dcg", {"top_k": 5}),
+    ("retrieval_auroc", {}),
+    ("retrieval_auroc", {"top_k": 5}),
+    ("retrieval_auroc", {"max_fpr": 0.5}),
 ]
 
 
@@ -242,7 +245,7 @@ def test_retrieval_functional_per_query(name, kwargs):
         mask = indexes == q
         if not target[mask].any():
             continue
-        ref = getattr(tm.functional, name)(t(preds[mask]), t(target[mask]), **kwargs)
+        ref = getattr(tm.functional.retrieval, name)(t(preds[mask]), t(target[mask]), **kwargs)
         got = getattr(ours, name)(jnp.asarray(preds[mask]), jnp.asarray(target[mask]), **kwargs)
         assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"{name}[q{q}]")
 

@@ -31,8 +31,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def synth_dataset(n_images: int, n_classes: int, seed: int = 0):
-    """COCO-val-like predictions/targets: mixed object sizes, crowd flags, score noise."""
+def synth_dataset(n_images: int, n_classes: int, seed: int = 0, crowd_prob: float = 0.03):
+    """COCO-val-like predictions/targets: mixed object sizes, crowd flags, score noise.
+
+    ``crowd_prob=0`` generates a crowd-free set — required when comparing against
+    the reference's legacy torch backend, which does not model crowd re-matching
+    (our matcher does, oracled separately in ``tests/_map_oracle.py``).
+    """
     rng = np.random.RandomState(seed)
     preds, target = [], []
     for _ in range(n_images):
@@ -42,7 +47,7 @@ def synth_dataset(n_images: int, n_classes: int, seed: int = 0):
         xy = rng.rand(ng, 2) * (640 - wh.clip(max=600))
         gb = np.concatenate([xy, xy + wh], axis=1)
         glab = rng.randint(0, n_classes, ng)
-        crowd = (rng.rand(ng) < 0.03).astype(np.int64)
+        crowd = (rng.rand(ng) < crowd_prob).astype(np.int64)
 
         # detections: jittered copies of most gts (localization noise ∝ size),
         # some dropped, plus false positives
@@ -63,7 +68,28 @@ def synth_dataset(n_images: int, n_classes: int, seed: int = 0):
     return preds, target
 
 
-def bench_ours(preds, target, repeats: int = 2):
+# the official 12-number COCO detection summary (reference ``detection/mean_ap.py:521-600``)
+COCO_SUMMARY_KEYS = (
+    "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+    "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+)
+
+
+def _summarize(result, n_classes: int):
+    """compute() dict → {12 summary numbers} + per-class AP/AR vectors."""
+    summary = {k: round(float(result[k]), 6) for k in COCO_SUMMARY_KEYS}
+    per_class_ap = np.full(n_classes, -1.0)
+    per_class_ar = np.full(n_classes, -1.0)
+    classes = np.asarray(result["classes"]).reshape(-1).astype(int)
+    ap = np.asarray(result["map_per_class"]).reshape(-1)
+    ar = np.asarray(result["mar_100_per_class"]).reshape(-1)
+    if ap.size == classes.size:  # class_metrics=True path
+        per_class_ap[classes] = ap
+        per_class_ar[classes] = ar
+    return summary, per_class_ap, per_class_ar
+
+
+def bench_ours(preds, target, n_classes: int, repeats: int = 2):
     import jax.numpy as jnp
 
     from metrics_tpu.detection import MeanAveragePrecision
@@ -72,21 +98,22 @@ def bench_ours(preds, target, repeats: int = 2):
     j_target = [{k: jnp.asarray(v) for k, v in d.items()} for d in target]
 
     def run():
-        m = MeanAveragePrecision()
+        m = MeanAveragePrecision(class_metrics=True)
         m.update(j_preds, j_target)
-        return float(m.compute()["map"])
+        return m.compute()
 
-    value = run()  # compile
+    result = run()  # compile
+    value = float(result["map"])
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
         got = run()
         best = min(best, time.perf_counter() - t0)
-        assert got == value
-    return best, value
+        assert float(got["map"]) == value
+    return best, _summarize(result, n_classes)
 
 
-def bench_reference(preds, target, repeats: int = 1):
+def bench_reference(preds, target, n_classes: int, repeats: int = 1):
     sys.path.insert(0, os.path.join(REPO, "tests", "_ref_shim"))
     sys.path.insert(0, "/root/reference/src")
     import torch
@@ -104,18 +131,56 @@ def bench_reference(preds, target, repeats: int = 1):
     ]
 
     def run():
-        m = RefMAP()
+        m = RefMAP(class_metrics=True)
         m.update(t_preds, t_target)
-        return float(m.compute()["map"])
+        return m.compute()
 
-    value = run()
+    result = run()
+    value = float(result["map"])
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
         got = run()
         best = min(best, time.perf_counter() - t0)
-        assert got == value
-    return best, value
+        assert float(got["map"]) == value
+    return best, _summarize({k: np.asarray(v) for k, v in result.items()}, n_classes)
+
+
+def summarize_oracle(preds, target, n_classes: int):
+    """12-number COCO summary + per-class AP from the sequential COCOeval
+    transcription (``tests/_map_oracle.py``) — the TRUE-protocol oracle standing
+    in for pycocotools (not installable here). Slow: pure-python loops."""
+    from tests._map_oracle import evaluate_full
+
+    precision, recall, classes = evaluate_full(preds, target)
+
+    def _mean_valid(x):
+        v = x[x > -1]
+        return float(v.mean()) if v.size else -1.0
+
+    # accumulate layout: precision (T, R, K, A, M), recall (T, K, A, M);
+    # A = [all, small, medium, large], M = [1, 10, 100]
+    summary = {
+        "map": _mean_valid(precision[:, :, :, 0, 2]),
+        "map_50": _mean_valid(precision[0, :, :, 0, 2]),
+        "map_75": _mean_valid(precision[5, :, :, 0, 2]),
+        "map_small": _mean_valid(precision[:, :, :, 1, 2]),
+        "map_medium": _mean_valid(precision[:, :, :, 2, 2]),
+        "map_large": _mean_valid(precision[:, :, :, 3, 2]),
+        "mar_1": _mean_valid(recall[:, :, 0, 0]),
+        "mar_10": _mean_valid(recall[:, :, 0, 1]),
+        "mar_100": _mean_valid(recall[:, :, 0, 2]),
+        "mar_small": _mean_valid(recall[:, :, 1, 2]),
+        "mar_medium": _mean_valid(recall[:, :, 2, 2]),
+        "mar_large": _mean_valid(recall[:, :, 3, 2]),
+    }
+    summary = {k: round(v, 6) for k, v in summary.items()}
+    per_class_ap = np.full(n_classes, -1.0)
+    per_class_ar = np.full(n_classes, -1.0)
+    for ki, cls in enumerate(classes):
+        per_class_ap[int(cls)] = _mean_valid(precision[:, :, ki, 0, 2])
+        per_class_ar[int(cls)] = _mean_valid(recall[:, ki, 0, 2])
+    return summary, per_class_ap, per_class_ar
 
 
 def main():
@@ -123,6 +188,8 @@ def main():
     ap.add_argument("--images", type=int, default=5000)
     ap.add_argument("--classes", type=int, default=80)
     ap.add_argument("--reference", action="store_true", help="also time the reference torch backend")
+    ap.add_argument("--oracle", action="store_true",
+                    help="also check the full summary + per-class AP against the COCOeval transcription")
     ap.add_argument("--repeats", type=int, default=2)
     args = ap.parse_args()
 
@@ -133,11 +200,12 @@ def main():
 
     backend = jax.default_backend()
 
-    preds, target = synth_dataset(args.images, args.classes)
+    # crowd-free when the reference oracle runs (its legacy backend has no crowd model)
+    preds, target = synth_dataset(args.images, args.classes, crowd_prob=0.0 if args.reference else 0.03)
     n_det = int(sum(len(p["scores"]) for p in preds))
     n_gt = int(sum(len(t["labels"]) for t in target))
 
-    t_ours, v_ours = bench_ours(preds, target, repeats=args.repeats)
+    t_ours, (summary_ours, ap_ours, ar_ours) = bench_ours(preds, target, args.classes, repeats=args.repeats)
     out = {
         "metric": "mean_ap_coco_val_scale",
         "images": args.images,
@@ -147,13 +215,44 @@ def main():
         "backend": backend,
         "platform_probe": platform,
         "ours_s": round(t_ours, 3),
-        "map": round(v_ours, 5),
+        "map": summary_ours["map"],
+        "coco_summary": summary_ours,
+        "map_per_class": [round(float(v), 6) for v in ap_ours],
     }
     if args.reference:
-        t_ref, v_ref = bench_reference(preds, target)
-        assert abs(v_ours - v_ref) < 5e-3, (v_ours, v_ref)
+        t_ref, (summary_ref, ap_ref, ar_ref) = bench_reference(preds, target, args.classes)
+        # The legacy torch backend deviates from the COCO protocol on AREA-RANGE
+        # ignores (documented in tests/test_detection_map_parity.py:118-121): its
+        # area-'all' keys are exact oracles, its small/medium/large keys are not —
+        # those are asserted against the true-protocol COCOeval transcription
+        # under --oracle instead. Report all diffs either way.
+        strict_keys = ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100")
+        diffs = {k: abs(summary_ours[k] - summary_ref[k]) for k in COCO_SUMMARY_KEYS}
         out["reference_s"] = round(t_ref, 3)
         out["speedup"] = round(t_ref / t_ours, 2)
+        out["coco_summary_reference"] = summary_ref
+        out["summary_max_abs_diff_area_all"] = round(max(diffs[k] for k in strict_keys), 6)
+        out["summary_max_abs_diff_area_ranges"] = round(
+            max(v for k, v in diffs.items() if k not in strict_keys), 6
+        )
+        assert max(diffs[k] for k in strict_keys) < 1e-4, {
+            k: (summary_ours[k], summary_ref[k]) for k in strict_keys
+        }
+    if args.oracle:
+        t0 = time.perf_counter()
+        summary_orc, ap_orc, ar_orc = summarize_oracle(preds, target, args.classes)
+        t_orc = time.perf_counter() - t0
+        diffs = {k: abs(summary_ours[k] - summary_orc[k]) for k in COCO_SUMMARY_KEYS}
+        per_class_diff = float(np.max(np.abs(ap_ours - ap_orc))) if len(ap_ours) else 0.0
+        per_class_ar_diff = float(np.max(np.abs(ar_ours - ar_orc))) if len(ar_ours) else 0.0
+        out["oracle_s"] = round(t_orc, 3)
+        out["coco_summary_cocoeval_oracle"] = summary_orc
+        out["oracle_summary_max_abs_diff"] = round(max(diffs.values()), 6)
+        out["oracle_per_class_ap_max_abs_diff"] = round(per_class_diff, 6)
+        out["oracle_per_class_ar_max_abs_diff"] = round(per_class_ar_diff, 6)
+        assert max(diffs.values()) < 1e-4, {k: (summary_ours[k], summary_orc[k]) for k in COCO_SUMMARY_KEYS}
+        assert per_class_diff < 1e-4, per_class_diff
+        assert per_class_ar_diff < 1e-4, per_class_ar_diff
 
     print(json.dumps(out))
     with open(os.path.join(REPO, "MAP_SCALE_BENCH.json"), "w") as f:
