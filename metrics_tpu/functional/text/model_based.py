@@ -84,7 +84,10 @@ def infolm(
         **kwargs,
     )
     metric.update(preds, target)
-    score = metric.compute()
     if return_sentence_level_score:
-        return score, metric.compute_sentence_scores()
-    return score
+        # one distribution_fn pass: the corpus score is the sentence-score mean
+        sentences = metric.compute_sentence_scores()
+        import jax.numpy as jnp
+
+        return jnp.mean(sentences).astype(jnp.float32), sentences
+    return metric.compute()

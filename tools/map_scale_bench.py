@@ -191,6 +191,10 @@ def main():
     ap.add_argument("--oracle", action="store_true",
                     help="also check the full summary + per-class AP against the COCOeval transcription")
     ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: MAP_SCALE_BENCH.json at full scale, "
+                         "MAP_SCALE_BENCH_SMALL.json below 1000 images — small/under-load runs "
+                         "must never clobber the full-scale evidence)")
     args = ap.parse_args()
 
     from metrics_tpu.utils.backend import ensure_backend
@@ -255,7 +259,8 @@ def main():
         assert per_class_ar_diff < 1e-4, per_class_ar_diff
 
     print(json.dumps(out))
-    with open(os.path.join(REPO, "MAP_SCALE_BENCH.json"), "w") as f:
+    default_name = "MAP_SCALE_BENCH.json" if args.images >= 1000 else "MAP_SCALE_BENCH_SMALL.json"
+    with open(args.out or os.path.join(REPO, default_name), "w") as f:
         json.dump(out, f, indent=1)
 
 

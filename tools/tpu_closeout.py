@@ -1,0 +1,203 @@
+"""Zero-prep on-chip closeout — ONE command for the moment the TPU tunnel revives.
+
+Round-5 VERDICT item 1. Runs, in order:
+
+  (a) backend probe via ``ensure_backend`` (wedge-safe: killable subprocess),
+  (b) ``bench.py`` (5 BASELINE configs + device-sort extra, roofline/MFU),
+      ``tools/tpu_validate.py`` (per-domain TPU-vs-CPU deviation sweep), and
+      ``tools/map_scale_bench.py --reference`` (COCO-val-scale MAP),
+  (c) COMPILED Pallas kernel timings vs their XLA paths — ``ops/binned_hist``
+      (multi-threshold curve histogram) and ``ops/ssim_window`` (separable
+      window stencil) — the two kernels that have never executed compiled,
+  (d) a refreshed ``BENCH_TPU_live.json`` bundling all of it.
+
+On a CPU fallback (tunnel still wedged) everything still runs — Pallas in
+interpreter mode, labeled as such — but the bundle is written to
+``TPU_CLOSEOUT_SMOKE.json`` instead, so the round-2 ``BENCH_TPU_live.json``
+(the last real hardware truth) is never overwritten by proxy numbers.
+
+Usage::
+
+    python tools/tpu_closeout.py            # full closeout
+    python tools/tpu_closeout.py --smoke    # small shapes, quick CPU dry run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _best_of(fn, repeats=5):
+    import jax
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _run_tool(cmd, timeout):
+    """Run a repo tool as a subprocess; return (ok, last JSON line or error)."""
+    proc = subprocess.run(
+        [sys.executable] + cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout
+    )
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return proc.returncode == 0, json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return False, {"error": f"rc={proc.returncode}", "stderr": proc.stderr[-2000:]}
+
+
+def kernel_timings(on_tpu: bool, smoke: bool) -> dict:
+    """Compiled-Pallas vs XLA timings + max deviation for both kernels."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.image._helpers import separable_depthwise_conv
+    from metrics_tpu.functional.image.ssim import _gaussian_taps_np
+    from metrics_tpu.ops.binned_hist import binned_counts_pallas, pallas_binned_fits
+    from metrics_tpu.ops.ssim_window import windowed_sum_nchw
+
+    out = {"pallas_mode": "compiled" if on_tpu else "interpret (no TPU — not a hardware number)"}
+    interpret = not on_tpu
+
+    # --- binned multi-threshold histogram (ops/binned_hist.py) ---
+    n, t_len = (1 << 14, 50) if smoke or interpret else (1 << 22, 200)
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(n, 1).astype(np.float32))
+    target01 = jnp.asarray(rng.randint(0, 2, (n, 1)).astype(np.int32))
+    valid = jnp.ones((n, 1), bool)
+    thr = jnp.asarray(np.linspace(0.0, 1.0, t_len).astype(np.float32))
+    assert pallas_binned_fits(n, 1, t_len)
+
+    def pallas_hist():
+        return binned_counts_pallas(preds, target01, valid, thr, interpret=interpret)
+
+    def xla_hist():
+        from metrics_tpu.utils.data import bincount
+
+        bucket = jnp.searchsorted(thr, preds, side="right").astype(jnp.int32)
+        flat = bucket[:, 0]
+        is_pos = valid[:, 0] & (target01[:, 0] == 1)
+        dead = t_len + 1
+        pos_hist = bincount(jnp.where(is_pos, flat, dead), dead + 1)[:dead]
+        neg_hist = bincount(jnp.where(~is_pos, flat, dead), dead + 1)[:dead]
+        tp = (pos_hist.sum() - jnp.cumsum(pos_hist))[:t_len]
+        fp = (neg_hist.sum() - jnp.cumsum(neg_hist))[:t_len]
+        return tp, fp, pos_hist.sum()[None], neg_hist.sum()[None]
+
+    xla_hist_j = jax.jit(xla_hist)
+    try:
+        got_p = jax.block_until_ready(pallas_hist())  # compile + correctness probe
+        got_x = jax.block_until_ready(xla_hist_j())
+        diff = max(
+            float(np.max(np.abs(np.asarray(a, np.float64) - np.asarray(b.reshape(np.asarray(a).shape), np.float64))))
+            for a, b in zip(got_p[:2], got_x[:2])
+        )
+        out["binned_hist"] = {
+            "n": n, "thresholds": t_len,
+            "pallas_ms": round(1000 * _best_of(pallas_hist), 3),
+            "xla_ms": round(1000 * _best_of(xla_hist_j), 3),
+            "max_abs_diff": diff,
+        }
+    except Exception as err:  # noqa: BLE001 — a kernel failure must not kill the closeout
+        out["binned_hist"] = {"error": f"{type(err).__name__}: {err}"}
+
+    # --- SSIM separable window (ops/ssim_window.py) ---
+    shape = (2, 1, 64, 64) if smoke or interpret else (20, 3, 256, 256)
+    x = jnp.asarray(np.random.RandomState(1).rand(*shape).astype(np.float32))
+    taps = [_gaussian_taps_np(11, 1.5), _gaussian_taps_np(11, 1.5)]
+    kernels = [jnp.asarray(t) for t in taps]
+
+    def pallas_win():
+        return windowed_sum_nchw(x, taps, interpret=interpret)
+
+    conv_j = jax.jit(lambda v: separable_depthwise_conv(v, kernels))
+    try:
+        got_p = jax.block_until_ready(pallas_win())
+        got_x = jax.block_until_ready(conv_j(x))
+        out["ssim_window"] = {
+            "shape": list(shape),
+            "pallas_ms": round(1000 * _best_of(pallas_win), 3),
+            "xla_ms": round(1000 * _best_of(lambda: conv_j(x)), 3),
+            "max_abs_diff": float(np.max(np.abs(np.asarray(got_p, np.float64) - np.asarray(got_x, np.float64)))),
+        }
+    except Exception as err:  # noqa: BLE001
+        out["ssim_window"] = {"error": f"{type(err).__name__}: {err}"}
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small shapes + small MAP sweep (CPU dry run)")
+    args = ap.parse_args()
+
+    from metrics_tpu.utils.backend import ensure_backend
+
+    platform = ensure_backend(min_devices=1)
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    bundle = {
+        "closeout": "round-5",
+        "platform_probe": platform,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "hardware_truth": bool(on_tpu),
+    }
+
+    print(f"[closeout] backend={bundle['backend']} device={bundle['device_kind']}", file=sys.stderr)
+    # Proxy runs (smoke or CPU fallback) route EVERY sub-tool artifact to a side
+    # file: canonical artifacts (TPU_VALIDATION.json, MAP_SCALE_BENCH.json) hold
+    # idle-machine / on-chip evidence and must never be clobbered by proxies.
+    proxy = args.smoke or not on_tpu
+    validate_out = ["--out", os.path.join(REPO, "TPU_VALIDATION_SMOKE.json")] if proxy else []
+    map_out = ["--out", os.path.join(REPO, "MAP_SCALE_BENCH_SMALL.json")] if proxy else []
+    steps = [
+        ("bench", ["bench.py"], 3600),
+        ("tpu_validate", ["tools/tpu_validate.py", *validate_out], 3600),
+        ("map_scale", ["tools/map_scale_bench.py", "--reference", *map_out]
+         + (["--images", "200", "--classes", "10"] if args.smoke else []), 3600),
+    ]
+    for name, cmd, timeout in steps:
+        print(f"[closeout] running {name}...", file=sys.stderr)
+        try:
+            ok, payload = _run_tool(cmd, timeout)
+        except subprocess.TimeoutExpired:
+            ok, payload = False, {"error": f"timeout after {timeout}s"}
+        bundle[name] = payload
+        bundle[f"{name}_ok"] = ok
+
+    print("[closeout] timing Pallas kernels vs XLA...", file=sys.stderr)
+    bundle["kernels"] = kernel_timings(on_tpu, args.smoke)
+
+    target = os.path.join(REPO, "BENCH_TPU_live.json" if on_tpu else "TPU_CLOSEOUT_SMOKE.json")
+    with open(target, "w") as fh:
+        json.dump(bundle, fh, indent=1)
+    print(json.dumps({
+        "metric": "tpu_closeout",
+        "value": 1 if on_tpu else 0,
+        "unit": "1 = on-chip artifact refreshed, 0 = cpu smoke only",
+        "vs_baseline": bundle.get("bench", {}).get("value", -1),
+        "artifact": os.path.basename(target),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -284,6 +284,14 @@ def build_cases():
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default TPU_VALIDATION.json; pass a side file for "
+                         "smoke/under-load runs so they never clobber the idle-machine record)")
+    args = ap.parse_args()
+
     from metrics_tpu.utils.backend import ensure_backend
 
     ensure_backend(min_devices=1)
@@ -325,7 +333,7 @@ def main():
         "all_ok": all(r.get("ok") for r in records.values()),
         "domains": records,
     }
-    with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as fh:
+    with open(args.out or os.path.join(REPO, "TPU_VALIDATION.json"), "w") as fh:
         json.dump(summary, fh, indent=2)
     print(json.dumps(summary))
 
