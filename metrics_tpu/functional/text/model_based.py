@@ -89,5 +89,6 @@ def infolm(
         sentences = metric.compute_sentence_scores()
         import jax.numpy as jnp
 
-        return jnp.mean(sentences).astype(jnp.float32), sentences
+        corpus = jnp.mean(sentences) if sentences.size else jnp.asarray(0.0)  # empty → 0.0, like compute()
+        return corpus.astype(jnp.float32), sentences
     return metric.compute()

@@ -14,6 +14,7 @@ over the flat sorted arrays; there is no per-query loop anywhere.
 
 from __future__ import annotations
 
+import functools
 from abc import abstractmethod
 from typing import Any, Dict, Optional
 
@@ -114,6 +115,29 @@ def _order_by_query_desc(indexes: Array, values: Array) -> Array:
     )
 
 
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def _view_tail(idx_sorted: Array, preds_sorted: Array, graded: Array, num_groups: int):
+    """Everything after the grouping sort, fused into one XLA program."""
+    n = idx_sorted.shape[0]
+    new_group = (
+        jnp.concatenate([jnp.ones(1, bool), idx_sorted[1:] != idx_sorted[:-1]])
+        if n
+        else jnp.zeros(0, bool)
+    )
+    g = jnp.cumsum(new_group) - 1
+    rel = (graded > 0).astype(jnp.float32)
+    ones = jnp.ones(n, dtype=jnp.float32)
+    n_docs = jax.ops.segment_sum(ones, g, num_groups)
+    n_rel = jax.ops.segment_sum(rel, g, num_groups)
+    starts = jnp.concatenate([jnp.zeros(1), jnp.cumsum(n_docs)[:-1]]) if n else jnp.zeros(0)
+    pos = jnp.arange(n, dtype=jnp.float32) - starts[g]
+    # cumulative relevant within group, inclusive of current position
+    cum = jnp.cumsum(rel)
+    offset = jnp.concatenate([jnp.zeros(1), n_rel.cumsum()[:-1]]) if n else jnp.zeros(0)
+    rel_cum = cum - offset[g]
+    return g, preds_sorted, rel, n_docs, n_rel, pos, rel_cum
+
+
 class GroupedQueries:
     """Flat sorted view over all queries + the segment quantities every metric needs.
 
@@ -136,33 +160,39 @@ class GroupedQueries:
         order = _order_by_query_desc(indexes, preds)
         self.order = order
         idx_sorted = indexes[order]
-        new_group = jnp.concatenate([jnp.ones(1, bool), idx_sorted[1:] != idx_sorted[:-1]]) if n else jnp.zeros(0, bool)
-        g = jnp.cumsum(new_group) - 1
-        if isinstance(new_group, jax.core.Tracer):
+        if isinstance(idx_sorted, jax.core.Tracer):
             # under jit the group count is dynamic → static upper bound n; padding
             # groups have n_docs == 0 and are masked out of every aggregation
             self.num_groups = n
         else:
             # eager: one cheap host sync buys segment arrays sized to the TRUE
             # group count instead of n (often 100× smaller)
-            self.num_groups = int(new_group.sum()) if n else 0
-        self.group_id = g
-        self.preds = preds[order]
+            idx_np = np.asarray(idx_sorted)
+            self.num_groups = (int((idx_np[1:] != idx_np[:-1]).sum()) + 1) if n else 0
         self.graded = target[order].astype(jnp.float32)
-        self.rel = (self.graded > 0).astype(jnp.float32)
+        # post-sort tail as ONE fused program: eagerly this collapses ~10
+        # dispatch round-trips (cumsums/gathers/segment sums) into one call,
+        # inside jit it inlines — same trace either way
+        (self.group_id, self.preds, self.rel, self.n_docs, self.n_rel, self.pos,
+         self.rel_cum) = _view_tail(idx_sorted, preds[order], self.graded, self.num_groups)
+        # ideal ordering (target desc within group) — ONLY NDCG consumes it, and
+        # it costs a second full sort, so it materializes lazily on first access
+        self._ideal_inputs = (indexes, target)
+        self._ideal_graded: Optional[Array] = None
 
-        ones = jnp.ones(n, dtype=jnp.float32)
-        self.n_docs = jax.ops.segment_sum(ones, g, self.num_groups)
-        self.n_rel = jax.ops.segment_sum(self.rel, g, self.num_groups)
-        starts = jnp.concatenate([jnp.zeros(1), jnp.cumsum(self.n_docs)[:-1]]) if n else jnp.zeros(0)
-        self.pos = jnp.arange(n, dtype=jnp.float32) - starts[g]
-        # cumulative relevant within group, inclusive of current position
-        cum = jnp.cumsum(self.rel)
-        offset = jnp.concatenate([jnp.zeros(1), self.n_rel.cumsum()[:-1]]) if n else jnp.zeros(0)
-        self.rel_cum = cum - offset[g]
-        # ideal ordering (target desc within group) for NDCG
-        ideal_order = _order_by_query_desc(indexes, target.astype(jnp.float32))
-        self.ideal_graded = target[ideal_order].astype(jnp.float32)
+    @property
+    def ideal_graded(self) -> Array:
+        """Graded targets in ideal (target-desc within group) order — lazy."""
+        if self._ideal_graded is None:
+            if self._ideal_inputs is None:
+                raise AttributeError(
+                    "ideal_graded was not materialized before as_tree(); the owning "
+                    "metric must declare `_uses_ideal_order = True`."
+                )
+            indexes, target = self._ideal_inputs
+            ideal_order = _order_by_query_desc(indexes, target.astype(jnp.float32))
+            self._ideal_graded = target[ideal_order].astype(jnp.float32)
+        return self._ideal_graded
 
     def seg_sum(self, x: Array) -> Array:
         return jax.ops.segment_sum(x, self.group_id, self.num_groups)
@@ -174,12 +204,21 @@ class GroupedQueries:
         return jax.ops.segment_max(x, self.group_id, self.num_groups)
 
     _TREE_FIELDS = (
-        "order", "group_id", "preds", "graded", "rel", "n_docs", "n_rel", "pos", "rel_cum", "ideal_graded"
+        "order", "group_id", "preds", "graded", "rel", "n_docs", "n_rel", "pos", "rel_cum"
     )
 
-    def as_tree(self) -> Dict[str, Array]:
-        """The view as a flat dict of arrays — the jit-crossable form."""
-        return {k: getattr(self, k) for k in self._TREE_FIELDS}
+    def as_tree(self, include_ideal: bool = False) -> Dict[str, Array]:
+        """The view as a flat dict of arrays — the jit-crossable form.
+
+        ``ideal_graded`` rides along only when the CALLER asks for it (NDCG via
+        ``_uses_ideal_order``) — keyed on caller intent, not on whether a
+        group-mate happened to materialize it, so a shared view never flips the
+        pytree structure (and the jit cache) of metrics that don't use it.
+        """
+        tree = {k: getattr(self, k) for k in self._TREE_FIELDS}
+        if include_ideal:
+            tree["ideal_graded"] = self.ideal_graded
+        return tree
 
     @classmethod
     def from_tree(cls, tree: Dict[str, Array]) -> "GroupedQueries":
@@ -188,6 +227,8 @@ class GroupedQueries:
         for k in cls._TREE_FIELDS:
             setattr(gq, k, tree[k])
         gq.num_groups = tree["n_docs"].shape[0]
+        gq._ideal_inputs = None
+        gq._ideal_graded = tree.get("ideal_graded")
         return gq
 
 
@@ -237,6 +278,9 @@ class RetrievalMetric(Metric):
     full_state_update = False
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
+    # metrics consuming gq.ideal_graded (NDCG) set this so the lazy second sort
+    # materializes BEFORE the view crosses into the jitted compute as a tree
+    _uses_ideal_order = False
 
     def __init__(
         self,
@@ -328,7 +372,7 @@ class RetrievalMetric(Metric):
             _JITTED_COMPUTE[key] = jitted
             if len(_JITTED_COMPUTE) > 128:
                 _JITTED_COMPUTE.pop(next(iter(_JITTED_COMPUTE)))
-        return jitted(gq.as_tree())
+        return jitted(gq.as_tree(include_ideal=self._uses_ideal_order))
 
     @staticmethod
     def _empty_counts_host(n_rel: "np.ndarray", n_docs: "np.ndarray") -> "np.ndarray":

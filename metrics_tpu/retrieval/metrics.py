@@ -165,6 +165,8 @@ class RetrievalRPrecision(RetrievalMetric):
 class RetrievalNormalizedDCG(_TopKRetrievalMetric):
     """NDCG@k for IR with graded relevance (reference ``retrieval/ndcg.py:34``)."""
 
+    _uses_ideal_order = True  # IDCG needs the lazy target-desc sort materialized
+
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, aggregation: Any = "mean", **kwargs: Any) -> None:
         super().__init__(empty_target_action, ignore_index, top_k, aggregation, **kwargs)

@@ -186,7 +186,10 @@ def main() -> int:
     print("[closeout] timing Pallas kernels vs XLA...", file=sys.stderr)
     bundle["kernels"] = kernel_timings(on_tpu, args.smoke)
 
-    target = os.path.join(REPO, "BENCH_TPU_live.json" if on_tpu else "TPU_CLOSEOUT_SMOKE.json")
+    # key on `not proxy`, not on_tpu: --smoke on a live chip must also land in
+    # the side file (smoke shapes are not hardware evidence either)
+    target = os.path.join(REPO, "TPU_CLOSEOUT_SMOKE.json" if proxy else "BENCH_TPU_live.json")
+    bundle["hardware_truth"] = not proxy
     with open(target, "w") as fh:
         json.dump(bundle, fh, indent=1)
     print(json.dumps({
