@@ -13,6 +13,11 @@ from metrics_tpu.utils.checks import _check_same_shape
 def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     """Pass through batches for concatenation (reference ``cosine_similarity.py:24-40``)."""
     _check_same_shape(preds, target)
+    if preds.ndim != 2:
+        raise ValueError(
+            "Expected input to cosine similarity to be 2D tensors of shape `[N,D]` where `N` is the number of "
+            f"samples and `D` is the number of dimensions, but got tensor of shape {preds.shape}"
+        )
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)
     return preds, target

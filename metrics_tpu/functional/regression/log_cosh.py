@@ -36,8 +36,9 @@ def _log_cosh_error_compute(sum_log_cosh_error: Array, total: int) -> Array:
     return jnp.squeeze(sum_log_cosh_error / total)
 
 
-def log_cosh_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
-    """Compute log-cosh error (reference ``log_cosh.py:52-84``).
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """Compute log-cosh error (reference ``log_cosh.py:63-93``): the output
+    count is inferred from the input — ``(B,)`` → scalar, ``(B, K)`` → ``(K,)``.
 
     >>> import jax.numpy as jnp
     >>> preds = jnp.array([3.0, 5.0, 2.5, 7.0])
@@ -45,5 +46,6 @@ def log_cosh_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
     >>> log_cosh_error(preds, target)
     Array(0.3523339, dtype=float32)
     """
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
     sum_log_cosh_error, total = _log_cosh_error_update(preds, target, num_outputs)
     return _log_cosh_error_compute(sum_log_cosh_error, total)

@@ -97,12 +97,14 @@ def _relative_squared_error_compute(
     num_obs: Union[int, Array],
     squared: bool = True,
 ) -> Array:
-    """RSE = Σ(t-p)² / Σ(t-t̄)² (reference ``rse.py:24-44``)."""
+    """RSE = Σ(t-p)² / Σ(t-t̄)², PER OUTPUT then averaged (reference ``rse.py:44-52``
+    — the sqrt for RRSE applies per output BEFORE the mean over outputs)."""
     epsilon = jnp.finfo(jnp.float32).eps
     mean_obs = sum_obs / num_obs
-    tss = jnp.maximum(sum_squared_obs - sum_obs * mean_obs, epsilon)
-    rse = jnp.sum(rss) / jnp.sum(tss)
-    return rse if squared else jnp.sqrt(rse)
+    rse = rss / jnp.maximum(sum_squared_obs - sum_obs * mean_obs, epsilon)
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return jnp.mean(rse)
 
 
 def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:

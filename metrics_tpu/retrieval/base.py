@@ -166,9 +166,14 @@ class GroupedQueries:
             self.num_groups = n
         else:
             # eager: one cheap host sync buys segment arrays sized to the TRUE
-            # group count instead of n (often 100× smaller)
+            # group count instead of n (often 100× smaller). Bucketed up to the
+            # next power of two so a stream of datasets with varying query
+            # counts reuses O(log n) compiled _view_tail programs, not one per
+            # distinct count — the extra groups have n_docs == 0 and every
+            # aggregation masks them out.
             idx_np = np.asarray(idx_sorted)
-            self.num_groups = (int((idx_np[1:] != idx_np[:-1]).sum()) + 1) if n else 0
+            true_groups = (int((idx_np[1:] != idx_np[:-1]).sum()) + 1) if n else 0
+            self.num_groups = 1 << (true_groups - 1).bit_length() if true_groups else 0
         self.graded = target[order].astype(jnp.float32)
         # post-sort tail as ONE fused program: eagerly this collapses ~10
         # dispatch round-trips (cumsums/gathers/segment sums) into one call,

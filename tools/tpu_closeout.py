@@ -158,7 +158,6 @@ def main() -> int:
         "platform_probe": platform,
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
-        "hardware_truth": bool(on_tpu),
     }
 
     print(f"[closeout] backend={bundle['backend']} device={bundle['device_kind']}", file=sys.stderr)
@@ -194,8 +193,8 @@ def main() -> int:
         json.dump(bundle, fh, indent=1)
     print(json.dumps({
         "metric": "tpu_closeout",
-        "value": 1 if on_tpu else 0,
-        "unit": "1 = on-chip artifact refreshed, 0 = cpu smoke only",
+        "value": 0 if proxy else 1,
+        "unit": "1 = on-chip artifact refreshed, 0 = proxy (cpu or smoke) only",
         "vs_baseline": bundle.get("bench", {}).get("value", -1),
         "artifact": os.path.basename(target),
     }))
