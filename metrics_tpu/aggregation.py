@@ -74,6 +74,7 @@ class BaseAggregator(Metric):
         """
         x = jnp.asarray(x, dtype=self._dtype)
         weight = jnp.asarray(1.0 if weight is None else weight, dtype=self._dtype)
+        weight_was_scalar = weight.ndim == 0 or weight.size == 1
         weight = jnp.broadcast_to(weight, x.shape)
         # drop/replace where EITHER the value or its weight is NaN
         # (reference ``aggregation.py:84-102``)
@@ -106,15 +107,22 @@ class BaseAggregator(Metric):
             return x, weight, ~nan_mask
         if self.nan_strategy == "disable":
             return x, weight, jnp.ones_like(nan_mask) | True
-        # float replacement: both the value AND its weight take the replacement
-        # (reference ``aggregation.py:101-102``), element-wise — we do not
-        # replicate the reference's broadcast-view write-through quirk
+        # float replacement (reference ``aggregation.py:101-102``): values are
+        # replaced; a per-element weight tensor gets the replacement at the same
+        # positions (matches the reference's contiguous-tensor path exactly). A
+        # SCALAR weight is replaced only if it is itself NaN (then the reference's
+        # stride-0 view write poisons every cell — same result). A finite scalar
+        # weight stays untouched — here we deliberately diverge from the
+        # reference, whose view-write quirk makes a NaN-containing batch's
+        # weights all equal the replacement: stream-dependent means for nonzero
+        # strategies and 0/0 = NaN for strategy 0.0. Divergence pinned in
+        # tests/parity/test_parity_wrappers.py::test_aggregation_nan_float_documented_divergence.
         repl = jnp.asarray(self.nan_strategy, dtype=x.dtype)
-        return (
-            jnp.where(nan_mask, repl, x),
-            jnp.where(nan_mask, repl, weight),
-            jnp.ones_like(nan_mask) | True,
-        )
+        if weight_was_scalar:
+            new_weight = jnp.where(jnp.isnan(weight), repl, weight)
+        else:
+            new_weight = jnp.where(nan_mask, repl, weight)
+        return jnp.where(nan_mask, repl, x), new_weight, jnp.ones_like(nan_mask) | True
 
     def update(self, value: Union[float, Array]) -> None:  # noqa: D102
         raise NotImplementedError

@@ -68,9 +68,15 @@ class Running(WrapperMetric):
         self.base_metric._computed = None
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
-        """Update the window and return the windowed value."""
-        self.update(*args, **kwargs)
-        return self.compute()
+        """Update the window and return the CURRENT BATCH's value.
+
+        The reference contract (``running.py:40-42``): forward keeps the wrapped
+        metric's batch-local semantics; the windowed value comes from
+        :meth:`compute`.
+        """
+        self.update(*args, **kwargs)  # the wrapped update maintains lifecycle counters
+        fns = self.base_metric.functional()
+        return fns.compute(self._window_states[-1])
 
     def compute(self) -> Any:
         """Compute over the current window."""

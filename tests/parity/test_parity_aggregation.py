@@ -1,0 +1,151 @@
+"""Aggregation-family parity vs the ACTUAL reference (round-5 densification).
+
+The existing ``tests/test_aggregation.py`` oracles against numpy; this module
+pins the same surface against the reference itself across the full
+``nan_strategy`` grid (error / warn / ignore / float replacement), weighted
+means, the ``Running`` wrapper windows, and the forward path.
+"""
+
+import warnings
+import zlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+
+def _seed(key) -> int:
+    return zlib.crc32(repr(key).encode()) % 2**31
+
+
+AGGREGATORS = ["MaxMetric", "MinMetric", "SumMetric", "MeanMetric", "CatMetric"]
+
+
+def _ours(name, **kwargs):
+    import metrics_tpu.aggregation as agg
+
+    return getattr(agg, name)(**kwargs)
+
+
+def _ref(name, **kwargs):
+    tm = reference()
+
+    return getattr(tm.aggregation, name)(**kwargs)
+
+
+@pytest.mark.parametrize("name", AGGREGATORS)
+@pytest.mark.parametrize("shape", ["scalar", "vector"])
+def test_aggregator_values_match_reference(name, shape):
+    tm = reference()
+    import torch
+
+    rng = np.random.RandomState(_seed((name, shape)))
+    batches = [rng.randn() if shape == "scalar" else rng.randn(7).astype(np.float32) for _ in range(4)]
+    ours = _ours(name, nan_strategy="error")
+    ref = _ref(name, nan_strategy="error")
+    for b in batches:
+        ours.update(jnp.asarray(b))
+        ref.update(torch.as_tensor(np.asarray(b)))
+    got, want = ours.compute(), ref.compute()
+    if name == "CatMetric":
+        assert_close(got, want, rtol=1e-6, atol=1e-7, label=name)
+    else:
+        assert_close(got, want, rtol=1e-6, atol=1e-7, label=name)
+
+
+@pytest.mark.parametrize("name", AGGREGATORS)
+@pytest.mark.parametrize("strategy", ["ignore", 42.0, "warn"])
+def test_nan_strategy_grid(name, strategy):
+    tm = reference()
+    import torch
+
+    rng = np.random.RandomState(_seed((name, str(strategy))))
+    batch = rng.randn(9).astype(np.float32)
+    batch[::3] = np.nan
+    ours = _ours(name, nan_strategy=strategy)
+    ref = _ref(name, nan_strategy=strategy)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # 'warn' strategy emits on both sides
+        ours.update(jnp.asarray(batch))
+        ref.update(torch.as_tensor(batch))
+    assert_close(ours.compute(), ref.compute(), rtol=1e-6, atol=1e-7, label=f"{name}[{strategy}]")
+
+
+@pytest.mark.parametrize("name", AGGREGATORS)
+def test_nan_error_strategy_raises_like_reference(name):
+    tm = reference()
+    import torch
+
+    bad = np.asarray([1.0, np.nan], np.float32)
+    ref = _ref(name, nan_strategy="error")
+    with pytest.raises(RuntimeError):
+        ref.update(torch.as_tensor(bad))
+    ours = _ours(name, nan_strategy="error")
+    with pytest.raises(RuntimeError):
+        ours.update(jnp.asarray(bad))
+
+
+@pytest.mark.parametrize("weights", ["none", "scalar", "vector"])
+def test_weighted_mean_matches_reference(weights):
+    tm = reference()
+    import torch
+
+    rng = np.random.RandomState(_seed(("wm", weights)))
+    ours = _ours("MeanMetric")
+    ref = _ref("MeanMetric")
+    for _ in range(3):
+        v = rng.randn(5).astype(np.float32)
+        if weights == "none":
+            ours.update(jnp.asarray(v))
+            ref.update(torch.as_tensor(v))
+        elif weights == "scalar":
+            w = float(rng.rand() + 0.1)
+            ours.update(jnp.asarray(v), w)
+            ref.update(torch.as_tensor(v), w)
+        else:
+            w = (rng.rand(5) + 0.1).astype(np.float32)
+            ours.update(jnp.asarray(v), jnp.asarray(w))
+            ref.update(torch.as_tensor(v), torch.as_tensor(w))
+    assert_close(ours.compute(), ref.compute(), rtol=1e-5, atol=1e-6, label=f"mean[{weights}]")
+
+
+@pytest.mark.parametrize("window", [1, 3, 5])
+@pytest.mark.parametrize("kind", ["RunningMean", "RunningSum"])
+def test_running_windows_match_reference(kind, window):
+    """Our RunningMean/RunningSum classes vs the reference's Running wrapper
+    over MeanMetric/SumMetric (reference ``wrappers/running.py:28``)."""
+    tm = reference()
+    import torch
+
+    import metrics_tpu.aggregation as agg
+
+    rng = np.random.RandomState(_seed((kind, window)))
+    stream = rng.randn(8).astype(np.float32)
+    ours = getattr(agg, kind)(window=window)
+    base = tm.aggregation.MeanMetric() if kind == "RunningMean" else tm.aggregation.SumMetric()
+    ref = tm.wrappers.Running(base, window=window)
+    for i, v in enumerate(stream):
+        got = ours.forward(jnp.asarray(v))
+        want = ref.forward(torch.as_tensor(v))
+        assert_close(got, want, rtol=1e-5, atol=1e-6, label=f"{kind}[w={window}] step {i} forward")
+    assert_close(ours.compute(), ref.compute(), rtol=1e-5, atol=1e-6, label=f"{kind}[w={window}] compute")
+
+
+@pytest.mark.parametrize("name", AGGREGATORS)
+def test_forward_returns_batch_value_like_reference(name):
+    tm = reference()
+    import torch
+
+    rng = np.random.RandomState(_seed(("fwd", name)))
+    a = rng.randn(4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    ours = _ours(name)
+    ref = _ref(name)
+    for batch in (a, b):
+        got = ours.forward(jnp.asarray(batch))
+        want = ref.forward(torch.as_tensor(batch))
+        assert_close(got, want, rtol=1e-6, atol=1e-7, label=f"{name}.forward")
+    assert_close(ours.compute(), ref.compute(), rtol=1e-6, atol=1e-7, label=f"{name}.compute")

@@ -50,15 +50,19 @@ def test_aggregation_nan_ignore():
 
 
 def test_aggregation_nan_float_documented_divergence():
-    """INTENTIONAL divergence from the reference (documented oracle bug).
+    """Float nan_strategy with the DEFAULT scalar weight: values replaced,
+    finite scalar weights stay uniform — an INTENTIONAL, pinned divergence.
 
-    With a float nan_strategy and the default scalar weight, the reference's
-    ``aggregation.py:71`` broadcasts the weight with ``torch.broadcast_to`` (a
-    single-memory-cell view) and then writes the replacement through the mask
-    (``:101-102``) — the write lands in the one shared cell, poisoning EVERY
-    weight and yielding NaN (0.0 strategy) or a globally-rescaled mean. We
-    implement the documented per-element semantics instead: nan values and
-    their weights are replaced element-wise.
+    The reference broadcasts the scalar weight into a stride-0 view
+    (``aggregation.py:71``) and writes the replacement through the mask
+    (``:101-102``) — the write poisons the one shared cell, so a NaN-containing
+    batch's weights ALL become the replacement while clean batches keep weight
+    1.0. Consequences we refuse to replicate, pinned below: single-batch
+    strategy 0.0 yields 0/0 = NaN, and mixed NaN/clean streams get
+    stream-dependent weighted means. Where the quirk happens to be benign
+    (single batch + nonzero strategy: the uniform poisoned weight cancels;
+    NaN scalar weight: every cell poisoned either way) we agree exactly, also
+    asserted below.
     """
     tm = reference()
     import torch
@@ -70,7 +74,30 @@ def test_aggregation_nan_float_documented_divergence():
     assert np.isnan(float(ref_m.compute()))  # the reference quirk, pinned
     our_m = ours.MeanMetric(nan_strategy=0.0)
     our_m.update(jnp.asarray(vals))
-    assert float(our_m.compute()) == pytest.approx(9.0 / 3.0)  # per-element semantics
+    assert float(our_m.compute()) == pytest.approx(9.0 / 5.0)  # replace-with-0.0 mean
+    # single batch + nonzero strategy: exact agreement (poisoned uniform weight cancels)
+    ref_nz = tm.MeanMetric(nan_strategy=42.0)
+    ref_nz.update(t(vals))
+    our_nz = ours.MeanMetric(nan_strategy=42.0)
+    our_nz.update(jnp.asarray(vals))
+    assert_close(our_nz.compute(), ref_nz.compute(), rtol=1e-6, atol=1e-7, label="mean_nan[42.0]")
+    # NaN scalar weight: the reference poisons every weight cell to the
+    # replacement; our scalar path replaces the NaN scalar — identical result
+    ref_nw = tm.MeanMetric(nan_strategy=1.0)
+    ref_nw.update(t(np.asarray([1.0, 2.0], np.float32)), float("nan"))
+    our_nw = ours.MeanMetric(nan_strategy=1.0)
+    our_nw.update(jnp.asarray([1.0, 2.0]), float("nan"))
+    assert_close(our_nw.compute(), ref_nw.compute(), rtol=1e-6, atol=1e-7, label="mean_nan[nan-weight]")
+    # mixed NaN/clean stream + nonzero strategy: the PINNED divergence — the
+    # reference weights the NaN batch 42× heavier; we weight all batches evenly
+    ref_mix = tm.MeanMetric(nan_strategy=42.0)
+    ref_mix.update(t(np.asarray([np.nan, 1.0], np.float32)))
+    ref_mix.update(t(np.asarray([3.0], np.float32)))
+    assert float(ref_mix.compute()) == pytest.approx((42 * 42 + 1 * 42 + 3) / 85, rel=1e-5)
+    our_mix = ours.MeanMetric(nan_strategy=42.0)
+    our_mix.update(jnp.asarray([np.nan, 1.0]))
+    our_mix.update(jnp.asarray([3.0]))
+    assert float(our_mix.compute()) == pytest.approx(46 / 3, rel=1e-6)
     # with an explicit per-element weight vector the reference takes the sane
     # path too, and both agree
     ref_m2 = tm.MeanMetric(nan_strategy=0.0)
