@@ -32,6 +32,10 @@ def procrustes_disparity(
             "Expected both datasets to be 3D tensors of shape (N, M, D), where N is the batch size, M is the number of"
             f" data points and D is the dimensionality of the data points, but got {point_cloud1.ndim} dimensions."
         )
+    # SVD kernels exist only for full precision — half inputs (bf16/fp16) are
+    # upcast here rather than crashing in lax.linalg.svd
+    point_cloud1 = point_cloud1.astype(jnp.promote_types(point_cloud1.dtype, jnp.float32))
+    point_cloud2 = point_cloud2.astype(jnp.promote_types(point_cloud2.dtype, jnp.float32))
     point_cloud1 = point_cloud1 - point_cloud1.mean(axis=1, keepdims=True)
     point_cloud2 = point_cloud2 - point_cloud2.mean(axis=1, keepdims=True)
     n1 = jnp.linalg.norm(point_cloud1, axis=(1, 2), keepdims=True)

@@ -167,10 +167,12 @@ class GroupedQueries:
         else:
             # eager: one cheap host sync buys segment arrays sized to the TRUE
             # group count instead of n (often 100× smaller). Bucketed up to the
-            # next power of two so a stream of datasets with varying query
-            # counts reuses O(log n) compiled _view_tail programs, not one per
-            # distinct count — the extra groups have n_docs == 0 and every
-            # aggregation masks them out.
+            # next power of two so datasets sharing a flat length n but varying
+            # in query count (fixed eval batch, variable #queries) reuse compiled
+            # _view_tail programs instead of one per distinct count. (When n
+            # itself varies, each n recompiles regardless — the bucketing then
+            # only costs ≤2× on the small segment arrays.) The extra groups have
+            # n_docs == 0 and every aggregation masks them out.
             idx_np = np.asarray(idx_sorted)
             true_groups = (int((idx_np[1:] != idx_np[:-1]).sum()) + 1) if n else 0
             self.num_groups = 1 << (true_groups - 1).bit_length() if true_groups else 0
