@@ -1,0 +1,123 @@
+"""Dense text-family matrix vs the reference (round-5 densification, text leg).
+
+Sweeps the parameter axes the base text parity module leaves thin: ROUGE over
+``rouge_keys`` × ``accumulate`` × multi-reference targets, BLEU weight grids,
+CHRF β, WER/CER on edge-case corpora (empty strings, punctuation-only,
+repeated tokens), and perplexity masking variants.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+PREDS = [
+    "the cat is on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "hello world",
+]
+MULTI_TARGETS = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["the quick brown fox jumped over the lazy dog", "quick brown foxes leap over lazy dogs"],
+    ["hello beautiful world", "hello world"],
+]
+
+
+def _rouge_both(preds, target, **kwargs):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    try:
+        ref = tm.functional.text.rouge_score(preds, target, **kwargs)
+    except (ModuleNotFoundError, ValueError, LookupError, OSError) as err:
+        pytest.skip(f"reference rouge unavailable: {err}")
+    got = ours.rouge_score(preds, target, **kwargs)
+    return got, ref
+
+
+@pytest.mark.parametrize("rouge_keys", ["rouge1", "rouge2", "rougeL", "rougeLsum", ("rouge1", "rougeL")])
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+def test_rouge_keys_accumulate_matrix(rouge_keys, accumulate):
+    got, ref = _rouge_both(PREDS, MULTI_TARGETS, rouge_keys=rouge_keys, accumulate=accumulate)
+    assert set(got) == set(ref)
+    assert_close(dict(got), dict(ref), rtol=1e-4, atol=1e-5, label=f"rouge[{rouge_keys},{accumulate}]")
+
+
+def test_rouge_single_string_pair():
+    got, ref = _rouge_both("My name is John", "Is your name John")
+    assert_close(dict(got), dict(ref), rtol=1e-4, atol=1e-5, label="rouge[str,str]")
+
+
+@pytest.mark.parametrize("weights", [None, [0.6, 0.4], [0.25, 0.25, 0.25, 0.25]])
+def test_bleu_weight_grid(weights):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    n_gram = len(weights) if weights else 4
+    ref = tm.functional.text.bleu_score(PREDS, MULTI_TARGETS, n_gram=n_gram, weights=weights)
+    got = ours.bleu_score(PREDS, MULTI_TARGETS, n_gram=n_gram, weights=weights)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"bleu[w={weights}]")
+
+
+@pytest.mark.parametrize("beta", [0.5, 1.0, 2.0, 3.0])
+def test_chrf_beta_grid(beta):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = tm.functional.text.chrf_score(PREDS, MULTI_TARGETS, beta=beta)
+    got = ours.chrf_score(PREDS, MULTI_TARGETS, beta=beta)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"chrf[beta={beta}]")
+
+
+EDGE_CORPORA = [
+    (["a a a a"], ["a a"]),                      # repeated tokens
+    (["hello"], ["completely different words"]),  # full substitution + deletions
+    ([""], ["non empty reference"]),              # empty hypothesis
+    (["!!! ???"], ["!!! ???"]),                   # punctuation-only, exact
+]
+
+
+@pytest.mark.parametrize("preds,target", EDGE_CORPORA, ids=["repeat", "subst", "empty-hyp", "punct"])
+@pytest.mark.parametrize("fn_name", ["word_error_rate", "char_error_rate", "match_error_rate",
+                                     "word_information_lost", "word_information_preserved"])
+def test_error_rate_edge_corpora(fn_name, preds, target):
+    tm = reference()
+    import metrics_tpu.functional.text as ours
+
+    ref = getattr(tm.functional.text, fn_name)(preds, target)
+    got = getattr(ours, fn_name)(preds, target)
+    assert_close(got, ref, rtol=1e-4, atol=1e-5, label=f"{fn_name}[{preds[0][:8]!r}]")
+
+
+def test_rougelsum_needs_no_nltk():
+    """Unlike the reference (which requires the nltk `punkt` download for
+    sentence splitting and is dead in this zero-egress image), our rougeLsum
+    splits sentences natively and always works."""
+    import metrics_tpu.functional.text as ours
+
+    out = ours.rouge_score(
+        ["First sentence. Second one here."],
+        ["First sentence. A second one."],
+        rouge_keys="rougeLsum",
+    )
+    assert set(out) == {"rougeLsum_fmeasure", "rougeLsum_precision", "rougeLsum_recall"}
+    assert float(out["rougeLsum_fmeasure"]) == pytest.approx(0.8, abs=1e-4)
+
+
+@pytest.mark.parametrize("ignore_index", [None, -100, 0])
+def test_perplexity_masking_matrix(ignore_index):
+    tm = reference()
+    import torch
+
+    import metrics_tpu.functional.text as ours
+
+    rng = np.random.RandomState(5)
+    logits = rng.randn(2, 10, 12).astype(np.float32)
+    target = rng.randint(1, 12, (2, 10))
+    if ignore_index is not None:
+        target[0, :4] = ignore_index
+    ref = tm.functional.text.perplexity(t(logits), t(target), ignore_index=ignore_index)
+    got = ours.perplexity(jnp.asarray(logits), jnp.asarray(target), ignore_index=ignore_index)
+    assert_close(got, ref, rtol=1e-4, atol=1e-4, label=f"perplexity[ii={ignore_index}]")
