@@ -1,0 +1,88 @@
+"""MetricCollection compute-group PARTITION parity vs the reference.
+
+The reference merges metrics whose update signatures and states coincide into
+compute groups after the first update (``collections.py`` `_merge_compute_groups`).
+These tests build identical collections on both sides and assert the same
+group partition emerges — plus equal outputs, with and without grouping.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import assert_close, reference, t
+
+
+def _partition(col):
+    """Canonical group partition: frozenset of frozensets of metric names."""
+    return frozenset(frozenset(names) for names in col.compute_groups.values())
+
+
+def _build(tm_side: bool, compute_groups: bool = True):
+    if tm_side:
+        tm = reference()
+        from torchmetrics import MetricCollection as C
+        from torchmetrics.classification import (
+            MulticlassAccuracy as Acc,
+            MulticlassAUROC as Auroc,
+            MulticlassCohenKappa as Kappa,
+            MulticlassF1Score as F1,
+            MulticlassPrecision as Prec,
+        )
+    else:
+        from metrics_tpu.classification import (
+            MulticlassAccuracy as Acc,
+            MulticlassAUROC as Auroc,
+            MulticlassCohenKappa as Kappa,
+            MulticlassF1Score as F1,
+            MulticlassPrecision as Prec,
+        )
+        from metrics_tpu.collections import MetricCollection as C
+    return C(
+        {
+            "acc": Acc(num_classes=4, average="micro", validate_args=False),
+            "prec": Prec(num_classes=4, average="micro", validate_args=False),
+            "f1": F1(num_classes=4, average="macro", validate_args=False),
+            "auroc": Auroc(num_classes=4, validate_args=False),
+            "kappa": Kappa(num_classes=4, validate_args=False),
+        },
+        compute_groups=compute_groups,
+    )
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(17)
+    logits = rng.randn(120, 4).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = rng.randint(0, 4, 120)
+    return probs.astype(np.float32), target
+
+
+def test_group_partition_matches_reference(data):
+    reference()
+    probs, target = data
+    ours = _build(tm_side=False)
+    ref = _build(tm_side=True)
+    ours.update(jnp.asarray(probs), jnp.asarray(target))
+    ref.update(t(probs), t(target))
+    # group merging finalizes on the first compute/second update in both designs
+    ours.compute()
+    ref.compute()
+    assert _partition(ours) == _partition(ref), (ours.compute_groups, ref.compute_groups)
+
+
+def test_grouped_equals_ungrouped_equals_reference(data):
+    reference()
+    probs, target = data
+    for grouped in (True, False):
+        ours = _build(tm_side=False, compute_groups=grouped)
+        ref = _build(tm_side=True, compute_groups=grouped)
+        for chunk in (slice(0, 60), slice(60, 120)):
+            ours.update(jnp.asarray(probs[chunk]), jnp.asarray(target[chunk]))
+            ref.update(t(probs[chunk]), t(target[chunk]))
+        got, want = ours.compute(), ref.compute()
+        assert set(got) == set(want)
+        for k in want:
+            assert_close(got[k], want[k], rtol=1e-4, atol=1e-5, label=f"{k}[grouped={grouped}]")
