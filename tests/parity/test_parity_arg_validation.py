@@ -1,0 +1,159 @@
+"""Argument/data-validation contract parity vs the reference.
+
+Every invalid constructor/argument combination the reference rejects with
+``ValueError`` must be rejected here too (``validate_args=True`` paths,
+reference ``functional/classification/stat_scores.py`` arg-validation
+helpers). Divergence in these contracts silently accepts bad configs.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.functional.classification as ours
+from tests._reference import reference, t
+
+N = 24
+
+
+def _mc_data():
+    rng = np.random.RandomState(3)
+    return rng.rand(N, 4).astype(np.float32), rng.randint(0, 4, N)
+
+
+def _bin_data():
+    rng = np.random.RandomState(4)
+    return rng.rand(N).astype(np.float32), rng.randint(0, 2, N)
+
+
+def _ml_data():
+    rng = np.random.RandomState(5)
+    return rng.rand(N, 3).astype(np.float32), rng.randint(0, 2, (N, 3))
+
+
+def _both_raise(fn_name, p, g, kwargs, exc=ValueError):
+    tm = reference()
+    with pytest.raises(exc):
+        getattr(tm.functional.classification, fn_name)(t(p), t(g), **kwargs)
+    with pytest.raises(exc):
+        getattr(ours, fn_name)(jnp.asarray(p), jnp.asarray(g), **kwargs)
+
+
+BAD_MULTICLASS = [
+    ("multiclass_accuracy", {"num_classes": 4, "average": "bogus"}),
+    ("multiclass_accuracy", {"num_classes": 0}),
+    ("multiclass_accuracy", {"num_classes": -3}),
+    ("multiclass_accuracy", {"num_classes": 4, "top_k": 5}),  # top_k > num_classes
+    ("multiclass_accuracy", {"num_classes": 4, "ignore_index": "x"}),
+    ("multiclass_accuracy", {"num_classes": 4, "multidim_average": "bogus"}),
+    ("multiclass_f1_score", {"num_classes": 4, "average": "bogus"}),
+    ("multiclass_stat_scores", {"num_classes": 4, "average": "bogus"}),
+]
+
+
+@pytest.mark.parametrize("fn_name,kwargs", BAD_MULTICLASS, ids=lambda v: str(v)[:45])
+def test_multiclass_bad_args_raise_both_sides(fn_name, kwargs):
+    p, g = _mc_data()
+    _both_raise(fn_name, p, g, kwargs)
+
+
+BAD_BINARY = [
+    ("binary_accuracy", {"threshold": 1.5}),
+    ("binary_accuracy", {"threshold": -0.1}),
+    ("binary_f1_score", {"threshold": "x"}),
+    ("binary_precision", {"ignore_index": 1.5}),
+    ("binary_accuracy", {"multidim_average": "bogus"}),
+]
+
+
+@pytest.mark.parametrize("fn_name,kwargs", BAD_BINARY, ids=lambda v: str(v)[:40])
+def test_binary_bad_args_raise_both_sides(fn_name, kwargs):
+    p, g = _bin_data()
+    _both_raise(fn_name, p, g, kwargs)
+
+
+BAD_MULTILABEL = [
+    ("multilabel_accuracy", {"num_labels": 0}),
+    ("multilabel_accuracy", {"num_labels": 3, "threshold": 2.0}),
+    ("multilabel_accuracy", {"num_labels": 3, "average": "bogus"}),
+    ("multilabel_f1_score", {"num_labels": 5}),  # mismatch with (N, 3) data
+]
+
+
+@pytest.mark.parametrize("fn_name,kwargs", BAD_MULTILABEL, ids=lambda v: str(v)[:40])
+def test_multilabel_bad_args_raise_both_sides(fn_name, kwargs):
+    p, g = _ml_data()
+    _both_raise(fn_name, p, g, kwargs)
+
+
+def test_multiclass_out_of_range_target_raises_both_sides():
+    """Data validation: target values >= num_classes rejected when validate_args."""
+    p, g = _mc_data()
+    g = g.copy()
+    g[0] = 7
+    _both_raise("multiclass_accuracy", p, g, {"num_classes": 4}, exc=(ValueError, RuntimeError))
+
+
+def test_binary_nonbinary_target_raises_both_sides():
+    p, g = _bin_data()
+    g = g.copy()
+    g[0] = 3
+    _both_raise("binary_accuracy", p, g, {}, exc=(ValueError, RuntimeError))
+
+
+BAD_CURVES = [
+    ("binary_auroc", {"thresholds": -5}),
+    ("binary_precision_recall_curve", {"thresholds": "x"}),
+    ("multiclass_auroc", {"num_classes": 4, "average": "bogus"}),
+]
+
+
+@pytest.mark.parametrize("fn_name,kwargs", BAD_CURVES, ids=lambda v: str(v)[:40])
+def test_curve_bad_args_raise_both_sides(fn_name, kwargs):
+    p, g = _mc_data() if "multiclass" in fn_name else _bin_data()
+    _both_raise(fn_name, p, g, kwargs)
+
+
+def test_stricter_than_reference_pinned_divergences():
+    """Cases where the reference's validation is buggy and ours enforces the
+    DOCUMENTED contract with a clear ValueError — intentional divergences:
+
+    - ``top_k <= 0``: the reference never checks it and dies later with an
+      unrelated shape RuntimeError; we raise up front.
+    - ``max_fpr=0.0``: the reference's falsy-check skips both validation and
+      the partial-AUC clip (silently behaves like None); ``max_fpr=2.0``
+      escapes its range check and crashes with an IndexError. We enforce the
+      documented (0, 1] range for both.
+    """
+    tm = reference()
+    p, g = _mc_data()
+    with pytest.raises(ValueError, match="top_k"):
+        ours.multiclass_accuracy(jnp.asarray(p), jnp.asarray(g), num_classes=4, top_k=0)
+    with pytest.raises(RuntimeError):  # the reference's incidental crash, pinned
+        tm.functional.classification.multiclass_accuracy(t(p), t(g), num_classes=4, top_k=0)
+
+    pb, gb = _bin_data()
+    for bad_fpr in (0.0, 2.0):
+        with pytest.raises(ValueError, match="max_fpr"):
+            ours.binary_auroc(jnp.asarray(pb), jnp.asarray(gb), max_fpr=bad_fpr)
+    # pin the reference behaviors so a future reference fix flags this test:
+    # max_fpr=0.0 silently returns garbage (NaN here) instead of raising
+    junk = float(tm.functional.classification.binary_auroc(t(pb), t(gb), max_fpr=0.0))
+    assert np.isnan(junk) or junk >= 0
+    with pytest.raises(IndexError):
+        tm.functional.classification.binary_auroc(t(pb), t(gb), max_fpr=2.0)
+
+
+def test_validate_args_false_skips_arg_checks_both_sides():
+    """With validate_args=False neither side pays (or performs) the checks —
+    out-of-range targets flow through undiagnosed on both sides."""
+    tm = reference()
+    p, g = _mc_data()
+    g = g.copy()
+    g[0] = 2  # keep in range: semantics, not crash, is what we compare
+    ref = tm.functional.classification.multiclass_accuracy(
+        t(p), t(g), num_classes=4, validate_args=False
+    )
+    got = ours.multiclass_accuracy(jnp.asarray(p), jnp.asarray(g), num_classes=4, validate_args=False)
+    assert float(got) == pytest.approx(float(ref), abs=1e-6)
