@@ -882,7 +882,8 @@ class Metric(ABC):
     def __abs__(self): return CompositionalMetric(operator.abs, self, None)
     def __neg__(self): return CompositionalMetric(_neg, self, None)
     def __pos__(self): return CompositionalMetric(operator.abs, self, None)
-    def __invert__(self): return CompositionalMetric(_logical_not, self, None)
+    def __inv__(self): return CompositionalMetric(_bitwise_not, self, None)
+    def __invert__(self): return self.__inv__()
     def __getitem__(self, idx): return CompositionalMetric(_Indexer(idx), self, None)
 
 
@@ -909,8 +910,10 @@ def _neg(x: Array) -> Array:
     return -jnp.abs(x)
 
 
-def _logical_not(x: Array) -> Array:
-    return jnp.logical_not(x)
+def _bitwise_not(x: Array) -> Array:
+    # the reference's `~metric` is torch.bitwise_not (metric.py:1155-1161) —
+    # integer/bool complement, NOT logical negation of floats
+    return jnp.bitwise_not(x)
 
 
 class _Indexer:
