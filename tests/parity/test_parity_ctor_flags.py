@@ -1,0 +1,100 @@
+"""Base-Metric constructor-flag behavior parity vs the reference.
+
+Covers the flags the rest of the suite exercises only implicitly:
+``compute_with_cache`` (cache served until the next update/reset),
+``sync_on_compute=False`` (no sync attempted even when distributed), and
+``dist_sync_fn`` injection — mirroring reference ``bases/test_metric.py``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests._reference import reference
+
+
+def _ours_counting(**kwargs):
+    from metrics_tpu.aggregation import SumMetric
+
+    return SumMetric(**kwargs)
+
+
+def _ref_counting(**kwargs):
+    tm = reference()
+
+    return tm.aggregation.SumMetric(**kwargs)
+
+
+@pytest.mark.parametrize("cached", [True, False])
+def test_compute_with_cache_semantics_match_reference(cached):
+    """With the cache on, repeat computes serve the stored value (state pokes
+    invisible); with it off every compute re-reads state. Same on both sides."""
+    import torch
+
+    ours = _ours_counting(compute_with_cache=cached)
+    ref = _ref_counting(compute_with_cache=cached)
+    ours.update(jnp.asarray(2.0))
+    ref.update(torch.as_tensor(2.0))
+    assert float(ours.compute()) == float(ref.compute()) == 2.0
+    # poke the state BEHIND the cache: a cached metric must not see it
+    ours.sum_value = ours.sum_value + 5.0
+    ref.sum_value = ref.sum_value + 5.0
+    expect = 2.0 if cached else 7.0
+    assert float(ours.compute()) == float(ref.compute()) == expect
+    # an update invalidates the cache on both sides
+    ours.update(jnp.asarray(1.0))
+    ref.update(torch.as_tensor(1.0))
+    assert float(ours.compute()) == float(ref.compute())
+
+
+def test_sync_on_compute_false_skips_sync_both_sides():
+    """compute() must not attempt a sync when sync_on_compute=False even if the
+    environment claims to be distributed."""
+    import torch
+
+    calls = {"ours": 0, "ref": 0}
+
+    def ours_gather(states, group):
+        calls["ours"] += 1
+        return [[s] for s in states]
+
+    def ref_gather(tensor, group=None):
+        calls["ref"] += 1
+        return [tensor]
+
+    ours = _ours_counting(
+        sync_on_compute=False,
+        dist_sync_fn=ours_gather,
+        distributed_available_fn=lambda: True,
+    )
+    ref = _ref_counting(
+        sync_on_compute=False,
+        dist_sync_fn=ref_gather,
+        distributed_available_fn=lambda: True,
+    )
+    ours.update(jnp.asarray(4.0))
+    ref.update(torch.as_tensor(4.0))
+    assert float(ours.compute()) == float(ref.compute()) == 4.0
+    assert calls == {"ours": 0, "ref": 0}
+
+
+def test_injected_dist_sync_fn_is_used_on_manual_sync():
+    """Manual sync() routes through the injected gather (ours only: the
+    reference's sync path additionally touches ``torch.distributed`` world-size
+    queries that demand a real initialized process group, unavailable here —
+    its real-process behavior is covered by tests/test_multihost_real.py's
+    analog on our side instead)."""
+    calls = {"ours": 0}
+
+    def ours_gather(states, group):
+        calls["ours"] += 1
+        return [[s, s] for s in states]  # fake 2-rank world
+
+    ours = _ours_counting(dist_sync_fn=ours_gather, distributed_available_fn=lambda: True)
+    ours.update(jnp.asarray(3.0))
+    ours.sync()
+    assert calls == {"ours": 1}
+    assert float(jnp.asarray(ours.value).sum()) == 6.0
+    ours.unsync()
+    assert float(jnp.asarray(ours.value).sum()) == 3.0
