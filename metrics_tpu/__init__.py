@@ -23,7 +23,7 @@ from metrics_tpu.aggregation import (
 from metrics_tpu.collections import MetricCollection
 from metrics_tpu.metric import CompositionalMetric, Metric
 
-__version__ = "0.1.0"
+__version__ = "1.0.0"
 
 # name -> defining module, for every reference root export not imported above
 _LAZY_EXPORTS = {
