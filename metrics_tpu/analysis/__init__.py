@@ -1,0 +1,40 @@
+"""jitlint — tracer-safety & recompilation static analysis for metrics_tpu.
+
+Two complementary passes guard the §7 invariant that every metric ``update`` is
+one trace-stable XLA executable:
+
+* the **AST pass** (:mod:`metrics_tpu.analysis.rules`, rules JL001–JL006) flags
+  tracer concretization, recompilation keys, state-contract breaches, dtype
+  promotion, side effects and namespace drift — heuristically, before any code
+  runs. CLI: ``python tools/lint_metrics.py`` / the ``jitlint`` console script.
+* the **abstract-interpretation pass**
+  (:mod:`metrics_tpu.analysis.abstract_contracts`) actually traces every
+  registered functional kernel with ``jax.eval_shape`` over canonical abstract
+  inputs — zero FLOPs, but a genuine trace, so it catches what the AST pass can
+  only guess at.
+"""
+
+from metrics_tpu.analysis.contexts import RULE_CODES, Suppressions, Violation
+from metrics_tpu.analysis.engine import (
+    LintResult,
+    diff_against_baseline,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
+
+__all__ = [
+    "ALL_RULES",
+    "LintResult",
+    "ModuleInfo",
+    "RULE_CODES",
+    "Suppressions",
+    "Violation",
+    "diff_against_baseline",
+    "lint_file",
+    "lint_paths",
+    "load_baseline",
+    "write_baseline",
+]

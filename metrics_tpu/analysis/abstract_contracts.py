@@ -1,0 +1,226 @@
+"""Dynamic tracer-safety contracts: eval_shape every registered functional kernel.
+
+The AST rules (JL001–JL006) are heuristic; this module is the ground truth.
+Each :class:`KernelContract` names a public functional kernel and a canonical
+abstract input signature. :func:`trace_contract` runs the kernel through
+``jax.eval_shape`` — zero FLOPs, zero host transfers, but a *real* trace — so
+any tracer concretization (`TracerBoolConversionError`, `.item()` on a tracer,
+data-dependent shapes) surfaces as a failure here even if the static pass
+missed it.
+
+The harness also enforces the dtype half of the §7 contract: under jax's
+default 32-bit mode no kernel may return a 64-bit (or complex-128) leaf, which
+would mark a silent host/float64 escape.
+
+Run via ``tests/test_jitlint_contracts.py`` or directly::
+
+    python -m metrics_tpu.analysis.abstract_contracts
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "CONTRACTS",
+    "ContractResult",
+    "KernelContract",
+    "trace_contract",
+    "verify_contracts",
+]
+
+
+def f32(*shape: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def i32(*shape: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelContract:
+    """One functional kernel plus a canonical abstract input signature."""
+
+    name: str  # dotted path under metrics_tpu.functional
+    args: Tuple[Any, ...]  # ShapeDtypeStructs trace abstractly; rest is static
+    kwargs: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractResult:
+    contract: KernelContract
+    ok: bool
+    outputs: Any = None  # pytree of ShapeDtypeStruct on success
+    error: str = ""
+
+
+# canonical problem sizes — small, TPU-lane-agnostic, even N for pairing
+_N, _C, _L = 12, 4, 3
+
+CONTRACTS: List[KernelContract] = [
+    # ---- classification (binary probabilistic) --------------------------------
+    KernelContract("accuracy", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("precision", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("recall", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("f1_score", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("fbeta_score", (f32(_N), i32(_N)), {"task": "binary", "beta": 0.5}),
+    KernelContract("specificity", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("stat_scores", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("confusion_matrix", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("hamming_distance", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("jaccard_index", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("matthews_corrcoef", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("cohen_kappa", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("negative_predictive_value", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract("critical_success_index", (f32(_N), f32(_N), 0.5)),
+    KernelContract("hinge_loss", (f32(_N), i32(_N)), {"task": "binary"}),
+    KernelContract(
+        "calibration_error", (f32(_N), i32(_N)), {"task": "binary", "n_bins": 5}
+    ),
+    # binned curve family: thresholds=int keeps every shape static (§7 path)
+    KernelContract("auroc", (f32(_N), i32(_N)), {"task": "binary", "thresholds": 16}),
+    KernelContract(
+        "average_precision", (f32(_N), i32(_N)), {"task": "binary", "thresholds": 16}
+    ),
+    KernelContract("roc", (f32(_N), i32(_N)), {"task": "binary", "thresholds": 16}),
+    KernelContract(
+        "precision_recall_curve", (f32(_N), i32(_N)), {"task": "binary", "thresholds": 16}
+    ),
+    # ---- classification (multiclass) ------------------------------------------
+    KernelContract(
+        "accuracy", (f32(_N, _C), i32(_N)), {"task": "multiclass", "num_classes": _C}
+    ),
+    KernelContract(
+        "confusion_matrix", (f32(_N, _C), i32(_N)), {"task": "multiclass", "num_classes": _C}
+    ),
+    KernelContract(
+        "auroc", (f32(_N, _C), i32(_N)),
+        {"task": "multiclass", "num_classes": _C, "thresholds": 16},
+    ),
+    KernelContract("dice", (i32(_N), i32(_N)), {"num_classes": _C}),
+    # ---- regression ------------------------------------------------------------
+    KernelContract("mean_squared_error", (f32(_N), f32(_N))),
+    KernelContract("mean_absolute_error", (f32(_N), f32(_N))),
+    KernelContract("mean_squared_log_error", (f32(_N), f32(_N))),
+    KernelContract("mean_absolute_percentage_error", (f32(_N), f32(_N))),
+    KernelContract("symmetric_mean_absolute_percentage_error", (f32(_N), f32(_N))),
+    KernelContract("weighted_mean_absolute_percentage_error", (f32(_N), f32(_N))),
+    KernelContract("normalized_root_mean_squared_error", (f32(_N), f32(_N))),
+    KernelContract("explained_variance", (f32(_N), f32(_N))),
+    KernelContract("r2_score", (f32(_N), f32(_N))),
+    KernelContract("r2_score", (f32(_N), f32(_N)), {"adjusted": 2}),
+    KernelContract("pearson_corrcoef", (f32(_N), f32(_N))),
+    KernelContract("spearman_corrcoef", (f32(_N), f32(_N))),
+    KernelContract("concordance_corrcoef", (f32(_N), f32(_N))),
+    KernelContract("cosine_similarity", (f32(_N, _C), f32(_N, _C))),
+    KernelContract("kl_divergence", (f32(_N, _C), f32(_N, _C))),
+    KernelContract("log_cosh_error", (f32(_N), f32(_N))),
+    KernelContract("minkowski_distance", (f32(_N), f32(_N), 3.0)),
+    KernelContract("tweedie_deviance_score", (f32(_N), f32(_N)), {"power": 1.5}),
+    KernelContract("relative_squared_error", (f32(_N), f32(_N))),
+    # ---- pairwise --------------------------------------------------------------
+    KernelContract("pairwise_cosine_similarity", (f32(_N, _C),)),
+    KernelContract("pairwise_euclidean_distance", (f32(_N, _C),)),
+    KernelContract("pairwise_manhattan_distance", (f32(_N, _C),)),
+    KernelContract("pairwise_linear_similarity", (f32(_N, _C),)),
+    KernelContract("pairwise_minkowski_distance", (f32(_N, _C),), {"exponent": 3.0}),
+    # ---- image -----------------------------------------------------------------
+    KernelContract("peak_signal_noise_ratio", (f32(2, 3, 16, 16), f32(2, 3, 16, 16)), {"data_range": 1.0}),
+    KernelContract("structural_similarity_index_measure", (f32(2, 3, 16, 16), f32(2, 3, 16, 16)), {"data_range": 1.0}),
+    KernelContract("total_variation", (f32(2, 3, 16, 16),)),
+    KernelContract("universal_image_quality_index", (f32(2, 3, 16, 16), f32(2, 3, 16, 16))),
+    KernelContract("image_gradients", (f32(2, 3, 16, 16),)),
+    KernelContract("spectral_angle_mapper", (f32(2, 3, 16, 16), f32(2, 3, 16, 16))),
+    KernelContract(
+        "error_relative_global_dimensionless_synthesis",
+        (f32(2, 3, 16, 16), f32(2, 3, 16, 16)),
+    ),
+    KernelContract("relative_average_spectral_error", (f32(2, 3, 16, 16), f32(2, 3, 16, 16))),
+    # ---- audio -----------------------------------------------------------------
+    KernelContract("signal_noise_ratio", (f32(_N, 256), f32(_N, 256))),
+    KernelContract("scale_invariant_signal_noise_ratio", (f32(_N, 256), f32(_N, 256))),
+    KernelContract("scale_invariant_signal_distortion_ratio", (f32(_N, 256), f32(_N, 256))),
+    # ---- retrieval (indexes are int group labels: shapes stay static) ----------
+    KernelContract("retrieval_precision", (f32(_N), i32(_N)), {"top_k": 4}),
+    KernelContract("retrieval_recall", (f32(_N), i32(_N)), {"top_k": 4}),
+    KernelContract("retrieval_fall_out", (f32(_N), i32(_N)), {"top_k": 4}),
+    KernelContract("retrieval_hit_rate", (f32(_N), i32(_N)), {"top_k": 4}),
+    KernelContract("retrieval_average_precision", (f32(_N), i32(_N))),
+    KernelContract("retrieval_reciprocal_rank", (f32(_N), i32(_N))),
+    KernelContract("retrieval_normalized_dcg", (f32(_N), i32(_N))),
+    # ---- text (tensor-shaped) --------------------------------------------------
+    KernelContract("perplexity", (f32(2, 8, 16), i32(2, 8))),
+    # ---- segmentation ----------------------------------------------------------
+    KernelContract(
+        "segmentation.mean_iou", (i32(2, _C, 16, 16), i32(2, _C, 16, 16)),
+        {"num_classes": _C, "input_format": "one-hot"},
+    ),
+    KernelContract(
+        "segmentation.generalized_dice_score", (i32(2, _C, 16, 16), i32(2, _C, 16, 16)),
+        {"num_classes": _C, "input_format": "one-hot"},
+    ),
+]
+
+
+def _resolve(name: str):
+    import metrics_tpu.functional as F
+
+    obj: Any = F
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+_DISALLOWED_DTYPES = ("float64", "complex128", "int64")
+
+
+def trace_contract(contract: KernelContract) -> ContractResult:
+    """eval_shape one kernel; failures carry the tracer error message."""
+    try:
+        fn = _resolve(contract.name)
+        abstract = [a for a in contract.args if isinstance(a, jax.ShapeDtypeStruct)]
+
+        def call(*arrays):
+            it = iter(arrays)
+            full = [next(it) if isinstance(a, jax.ShapeDtypeStruct) else a for a in contract.args]
+            return fn(*full, **(contract.kwargs or {}))
+
+        out = jax.eval_shape(call, *abstract)
+    except Exception as exc:  # noqa: BLE001 — the error text IS the result
+        return ContractResult(contract, ok=False, error=f"{type(exc).__name__}: {exc}")
+
+    bad = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(out)
+        if hasattr(leaf, "dtype") and str(leaf.dtype) in _DISALLOWED_DTYPES
+    ]
+    if bad and not jax.config.jax_enable_x64:
+        return ContractResult(
+            contract, ok=False, outputs=out,
+            error=f"64-bit output leaves under 32-bit mode: {[str(b.dtype) for b in bad]}",
+        )
+    return ContractResult(contract, ok=True, outputs=out)
+
+
+def verify_contracts(contracts: Optional[List[KernelContract]] = None) -> List[ContractResult]:
+    """Trace every contract; returns all results (callers filter failures)."""
+    return [trace_contract(c) for c in (contracts if contracts is not None else CONTRACTS)]
+
+
+def main() -> int:
+    results = verify_contracts()
+    failures = [r for r in results if not r.ok]
+    for r in failures:
+        kw = f", kwargs={r.contract.kwargs}" if r.contract.kwargs else ""
+        print(f"FAIL {r.contract.name}{kw}: {r.error}")
+    print(f"abstract contracts: {len(results) - len(failures)}/{len(results)} kernels trace cleanly")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

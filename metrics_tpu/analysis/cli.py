@@ -1,0 +1,100 @@
+"""jitlint command line: ``python tools/lint_metrics.py`` / the ``jitlint`` script.
+
+Exit codes: 0 clean (or fully baselined), 1 new violations, 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from metrics_tpu.analysis.engine import (
+    diff_against_baseline,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = ["main"]
+
+_DEFAULT_BASELINE = os.path.join("tools", "jitlint_baseline.json")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jitlint",
+        description="Tracer-safety & recompilation static analysis for metrics_tpu (rules JL001-JL006).",
+    )
+    p.add_argument("targets", nargs="*", default=["metrics_tpu"],
+                   help="files or directories to lint (default: metrics_tpu)")
+    p.add_argument("--root", default=None, help="repo root for relative paths (default: cwd)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule codes to run (default: all, e.g. JL001,JL004)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON path (default: <root>/{_DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true", help="ignore the baseline entirely")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write current violations as the new baseline and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text", dest="fmt")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    targets = [t if os.path.isabs(t) else os.path.join(root, t) for t in args.targets]
+    missing = [t for t in targets if not os.path.exists(t)]
+    if missing:
+        print(f"jitlint: no such file or directory: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    rules: Optional[List[str]] = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+
+    result = lint_paths(targets, root=root, rules=rules)
+    if result.parse_errors:
+        for err in result.parse_errors:
+            print(f"jitlint: parse error: {err}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, _DEFAULT_BASELINE)
+    if args.update_baseline:
+        entries = write_baseline(baseline_path, result.violations)
+        if not args.quiet:
+            print(f"jitlint: baseline written to {baseline_path} "
+                  f"({len(entries)} keys, {sum(entries.values())} violations)")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, baselined, stale = diff_against_baseline(result.violations, baseline)
+
+    if args.fmt == "json":
+        print(json.dumps({
+            "files_scanned": result.files_scanned,
+            "new": [v.__dict__ for v in new],
+            "baselined": baselined,
+            "inline_suppressed": result.suppressed,
+            "stale_baseline_keys": stale,
+        }, indent=2))
+    else:
+        for v in new:
+            print(v.render())
+        for key in stale:
+            print(f"jitlint: stale baseline entry (no longer matches): {key}")
+        if not args.quiet:
+            by_rule = {}
+            for v in new:
+                by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_rule.items())) or "none"
+            print(f"jitlint: {result.files_scanned} files, {len(new)} new violation(s) [{detail}], "
+                  f"{baselined} baselined, {result.suppressed} inline-suppressed")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

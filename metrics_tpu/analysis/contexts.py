@@ -1,0 +1,384 @@
+"""jitlint core: violations, suppressions, traced-context discovery, array taint.
+
+The static pass answers one question per source region: *will this code run
+under a JAX trace?* — and only there do the tracer-safety rules (JL001/JL004/
+JL005) apply. The runtime contract it mirrors lives in ``metrics_tpu/metric.py``:
+
+* ``Metric.update`` bodies are traced into one XLA executable **unless** the
+  class opts out (``__jit_ineligible__ = True``) or registers a list state
+  (``add_state(name, [])`` — ``_has_list_state`` latches eager mode).
+* ``Metric.compute`` bodies are traced when users jit the functional quadruple
+  (``Metric.functional().compute``), so they are held to the same rules.
+* every function in ``metrics_tpu/functional/`` is a kernel a user may embed in
+  ``jit``/``vmap``/``shard_map`` and is traced-context by default.
+
+Escape hatches the codebase already uses are recognized, not flagged:
+
+* a function that consults ``_is_traced(...)`` or ``isinstance(x, core.Tracer)``
+  is *concreteness-aware* — it branches on tracedness explicitly, so JL001 does
+  not second-guess it (the dynamic ``abstract_contracts`` harness covers those).
+* ``jax.pure_callback`` is the sanctioned host island (DESIGN §4) and is never
+  reported; ``io_callback``/``host_callback`` are (JL005).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Violation",
+    "Suppressions",
+    "TracedContext",
+    "find_traced_contexts",
+    "ArrayTaint",
+    "RULE_CODES",
+]
+
+RULE_CODES = ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006")
+
+_SUPPRESS_RE = re.compile(r"#\s*jitlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*jitlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One rule hit, addressable for both human output and the baseline."""
+
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    rule: str  # "JL001".."JL006"
+    message: str
+    context: str = "<module>"  # qualified name of enclosing def/class
+
+    def key(self) -> str:
+        """Line-number-free identity used by the baseline (stable across edits)."""
+        return f"{self.path}::{self.rule}::{self.context}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message} [{self.context}]"
+
+
+class Suppressions:
+    """Per-line ``# jitlint: disable=JL001[,JL004|all]`` comments.
+
+    A suppression on a ``def``/``class``/``if``/``while`` line covers only that
+    line (rules report at the offending statement), keeping suppressions local
+    and reviewable.
+    """
+
+    def __init__(self, source: str) -> None:
+        self._by_line: Dict[int, Set[str]] = {}
+        self._file_wide: Set[str] = set()
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self._file_wide |= {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                codes = {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+                self._by_line[lineno] = codes
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        rule = rule.upper()
+        if rule in self._file_wide or "ALL" in self._file_wide:
+            return True
+        codes = self._by_line.get(line)
+        return bool(codes) and (rule in codes or "ALL" in codes)
+
+
+@dataclass
+class TracedContext:
+    """A function body the linter treats as running under a JAX trace."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    qualname: str
+    kind: str  # "update" | "compute" | "kernel"
+    concreteness_aware: bool = False  # references _is_traced / core.Tracer
+    owner_class: Optional[ast.ClassDef] = None
+
+
+def _class_is_jit_ineligible(cls: ast.ClassDef) -> bool:
+    """True if the class opts its update out of tracing in its own body."""
+    for stmt in cls.body:
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "__jit_ineligible__":
+                if isinstance(value, ast.Constant) and bool(value.value):
+                    return True
+    return False
+
+
+def class_list_state_names(cls: ast.ClassDef) -> Set[str]:
+    """State names registered with a ``[]`` default anywhere in the class body."""
+    names: Set[str] = set()
+    for call in (n for n in ast.walk(cls) if isinstance(n, ast.Call)):
+        if not (isinstance(call.func, ast.Attribute) and call.func.attr == "add_state"):
+            continue
+        args = call.args
+        default = args[1] if len(args) > 1 else next(
+            (kw.value for kw in call.keywords if kw.arg == "default"), None
+        )
+        if isinstance(default, ast.List) and not default.elts:
+            if args and isinstance(args[0], ast.Constant) and isinstance(args[0].value, str):
+                names.add(args[0].value)
+    return names
+
+
+_NON_ARRAY_TYPE_NAMES = frozenset(
+    {"int", "float", "bool", "str", "bytes", "list", "tuple", "dict", "set", "type(None)"}
+)
+
+
+def _isinstance_narrowed_names(expr: ast.expr) -> Set[str]:
+    """Names proven non-array by an ``isinstance(name, int/str/...)`` check."""
+    if not (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and expr.func.id == "isinstance"
+        and len(expr.args) == 2
+        and isinstance(expr.args[0], ast.Name)
+    ):
+        return set()
+    types = expr.args[1]
+    candidates = types.elts if isinstance(types, ast.Tuple) else [types]
+    if all(isinstance(t, ast.Name) and t.id in _NON_ARRAY_TYPE_NAMES for t in candidates):
+        return {expr.args[0].id}
+    return set()
+
+
+def _references_tracer_guard(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == "_is_traced":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in ("Tracer", "_is_traced"):
+            return True
+    return False
+
+
+def find_traced_contexts(tree: ast.Module, is_functional_module: bool) -> List[TracedContext]:
+    """Enumerate function bodies the tracer-safety rules apply to."""
+    out: List[TracedContext] = []
+
+    def visit_class(cls: ast.ClassDef, prefix: str) -> None:
+        if _class_is_jit_ineligible(cls) or class_list_state_names(cls):
+            return  # update/compute run eagerly for this class
+        has_own_states = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) and n.func.attr == "add_state"
+            for n in ast.walk(cls)
+        )
+        if not has_own_states:
+            # states (and their array-vs-list nature) live in a base class in
+            # another module — unknowable statically, so stay conservative
+            return
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and stmt.name in ("update", "compute"):
+                out.append(
+                    TracedContext(
+                        node=stmt,
+                        qualname=f"{prefix}{cls.name}.{stmt.name}",
+                        kind=stmt.name,
+                        concreteness_aware=_references_tracer_guard(stmt),
+                        owner_class=cls,
+                    )
+                )
+
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            visit_class(stmt, "")
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and is_functional_module:
+            out.append(
+                TracedContext(
+                    node=stmt,
+                    qualname=stmt.name,
+                    kind="kernel",
+                    concreteness_aware=_references_tracer_guard(stmt),
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------- taint
+_ARRAY_MODULE_ROOTS = ("jnp", "lax", "jsp")
+# jax `Array` (and torch-style `Tensor`) annotations mark values that may be
+# tracers; `np.ndarray` annotations mark *host* arrays, which are always
+# concrete — deliberately not listed
+_ARRAY_ANNOTATIONS = ("Array", "Tensor")
+# attribute reads that yield *static* (trace-time-constant) values — never taint
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "weak_type", "sharding"})
+# jnp/lax functions whose result is a *static* Python value under trace
+# (dtype/shape predicates and introspection) — they never taint
+_STATIC_ARRAY_FNS = frozenset({
+    "issubdtype", "iscomplexobj", "isrealobj", "finfo", "iinfo", "dtype",
+    "result_type", "promote_types", "shape", "ndim", "size", "can_cast",
+})
+# array methods whose result is still an array
+_ARRAY_METHODS = frozenset({
+    "sum", "mean", "max", "min", "prod", "astype", "reshape", "flatten", "ravel",
+    "squeeze", "transpose", "clip", "cumsum", "cumprod", "any", "all", "argmax",
+    "argmin", "argsort", "sort", "round", "take", "repeat", "swapaxes", "conj",
+    "real", "imag", "T", "at", "dot", "std", "var", "item", "tolist", "get",
+})
+
+
+def _annotation_is_array(ann: Optional[ast.expr]) -> bool:
+    if ann is None:
+        return False
+    text = ast.unparse(ann) if hasattr(ast, "unparse") else ""
+    return any(token in text for token in _ARRAY_ANNOTATIONS)
+
+
+class ArrayTaint:
+    """Conservative intra-function inference of which names hold traced arrays.
+
+    Seeds: parameters with array annotations plus ``self.<state>`` attribute
+    reads inside Metric bodies (attribute-routed state is always an array in a
+    traced update). Propagation: assignments whose RHS is array-valued —
+    ``jnp.*``/``lax.*`` calls, arithmetic over tainted operands, subscripts and
+    array-methods of tainted values. ``.shape``/``.ndim``/``.dtype``/``.size``
+    reads are static under trace and break the chain.
+    """
+
+    def __init__(self, fn: ast.AST, extra_seeds: Sequence[str] = (), state_attrs: Sequence[str] = ()) -> None:
+        self.tainted: Set[str] = set(extra_seeds)
+        self.state_attrs: Set[str] = set(state_attrs)  # self.<name> reads that are arrays
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+                if _annotation_is_array(a.annotation):
+                    self.tainted.add(a.arg)
+            for va in (args.vararg, args.kwarg):
+                if va is not None and _annotation_is_array(va.annotation):
+                    self.tainted.add(va.arg)
+        # fixpoint over assignments (two passes are enough for straight-line reuse)
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if self.is_array_expr(node.value):
+                        for t in node.targets:
+                            self._taint_target(t)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    if _annotation_is_array(node.annotation) or self.is_array_expr(node.value):
+                        self._taint_target(node.target)
+                elif isinstance(node, ast.AugAssign):
+                    if self.is_array_expr(node.value) or self.is_array_expr(node.target):
+                        self._taint_target(node.target)
+
+    def _taint_target(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._taint_target(elt)
+
+    # -- expression classification ------------------------------------------------
+    def is_array_expr(self, e: ast.expr) -> bool:
+        """Does this expression plausibly evaluate to a traced array?"""
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return False
+            if isinstance(e.value, ast.Name) and e.value.id == "self":
+                # registered states are arrays under trace; jnp.pi / np.inf
+                # style module constants are untainted
+                return e.attr in self.state_attrs
+            return self.is_array_expr(e.value) and e.attr in _ARRAY_METHODS | {"real", "imag", "T"}
+        if isinstance(e, ast.Subscript):
+            return self.is_array_expr(e.value)
+        if isinstance(e, ast.BinOp):
+            return self.is_array_expr(e.left) or self.is_array_expr(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.is_array_expr(e.operand)
+        if isinstance(e, ast.IfExp):
+            return self.is_array_expr(e.body) or self.is_array_expr(e.orelse)
+        if isinstance(e, ast.Call):
+            return self._is_array_call(e)
+        if isinstance(e, ast.Compare):
+            # x == y over arrays is an array; `is (not) None` / `in` are static
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in e.ops):
+                return False
+            return self.is_array_expr(e.left) or any(self.is_array_expr(c) for c in e.comparators)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self.is_array_expr(x) for x in e.elts)
+        return False
+
+    def _is_array_call(self, call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _STATIC_ARRAY_FNS:
+                return False
+            chain: List[str] = [fn.attr]
+            root = fn.value
+            # jnp.foo(...) / lax.foo(...) / jax.numpy.foo(...) / jnp.linalg.foo(...)
+            while isinstance(root, ast.Attribute):
+                chain.append(root.attr)
+                root = root.value
+            if isinstance(root, ast.Name):
+                if root.id in _ARRAY_MODULE_ROOTS:
+                    return True
+                if root.id == "jax":
+                    # only the numerical sub-namespaces produce arrays;
+                    # jax.default_backend()/jax.devices()/... are host utilities
+                    sub = chain[-1] if len(chain) > 1 else ""
+                    return sub in ("numpy", "lax", "nn", "random", "scipy", "vmap")
+            # tainted.sum() etc.
+            if fn.attr in _ARRAY_METHODS and self.is_array_expr(fn.value):
+                return fn.attr not in ("item", "tolist")  # those concretize (rule-handled)
+        return False
+
+    def is_value_dependent_test(self, test: ast.expr, narrowed: Optional[Set[str]] = None) -> bool:
+        """Would branching on this expression concretize a tracer?
+
+        ``narrowed`` carries names proven non-array by an earlier
+        ``isinstance(name, int/list/str/...)`` conjunct in the same test.
+        """
+        narrowed = narrowed if narrowed is not None else set()
+        if isinstance(test, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in test.ops):
+                return False  # identity/membership checks are trace-static
+            operands = [test.left, *test.comparators]
+            return any(
+                self.is_array_expr(o) and not (isinstance(o, ast.Name) and o.id in narrowed)
+                for o in operands
+            )
+        if isinstance(test, ast.BoolOp):
+            local = set(narrowed)
+            for v in test.values:
+                if self.is_value_dependent_test(v, local):
+                    return True
+                if isinstance(test.op, ast.And):
+                    local |= _isinstance_narrowed_names(v)
+            return False
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self.is_value_dependent_test(test.operand, narrowed)
+        if isinstance(test, ast.Name) and test.id in narrowed:
+            return False
+        return self.is_array_expr(test)
+
+
+def self_state_seeds(ctx: TracedContext) -> Tuple[str, ...]:
+    """Registered state names for a metric context — passed to
+    :class:`ArrayTaint` as ``state_attrs`` so ``if self.total > 0`` inside an
+    ``update`` body is recognized as value-dependent branching.
+    """
+    if ctx.owner_class is None:
+        return ()
+    names: Set[str] = set()
+    for call in (n for n in ast.walk(ctx.owner_class) if isinstance(n, ast.Call)):
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "add_state":
+            if call.args and isinstance(call.args[0], ast.Constant) and isinstance(call.args[0].value, str):
+                names.add(call.args[0].value)
+    return tuple(sorted(names))

@@ -1,0 +1,146 @@
+"""jitlint engine: walk source trees, run rules, apply suppressions + baseline.
+
+The baseline (``tools/jitlint_baseline.json``) records *intentional* host-side
+exceptions keyed by ``path::rule::context`` with an occurrence count — line
+numbers are deliberately absent so unrelated edits in the same file don't
+invalidate it. A lint run fails only on violations that exceed the baselined
+count for their key; a baseline entry that no longer matches anything is
+reported as stale so the file ratchets down over time.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from metrics_tpu.analysis.contexts import RULE_CODES, Suppressions, Violation
+from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
+
+__all__ = ["LintResult", "lint_file", "lint_paths", "load_baseline", "write_baseline", "diff_against_baseline"]
+
+# directories whose members are traced-context-by-default kernels
+_FUNCTIONAL_ROOTS = ("metrics_tpu/functional", "metrics_tpu/ops")
+_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "build", "dist"}
+
+
+@dataclass
+class LintResult:
+    violations: List[Violation] = field(default_factory=list)
+    suppressed: int = 0  # inline `# jitlint: disable=` hits
+    files_scanned: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+    def by_rule(self) -> Dict[str, int]:
+        return dict(Counter(v.rule for v in self.violations))
+
+
+def _relpath(path: str, root: Optional[str]) -> str:
+    if root:
+        try:
+            return os.path.relpath(path, root).replace(os.sep, "/")
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def lint_file(path: str, root: Optional[str] = None, rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint one Python source file; ``root`` anchors the repo-relative path."""
+    result = LintResult(files_scanned=1)
+    rel = _relpath(path, root)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError) as exc:
+        result.parse_errors.append(f"{rel}: {exc}")
+        return result
+
+    mod = ModuleInfo(
+        path=rel,
+        tree=tree,
+        source=source,
+        is_functional=any(rel.startswith(r) or f"/{r.split('/')[-1]}/" in rel for r in _FUNCTIONAL_ROOTS),
+        is_package_init=os.path.basename(path) == "__init__.py",
+    )
+    suppress = Suppressions(source)
+    selected = rules or RULE_CODES
+    for code in selected:
+        rule = ALL_RULES.get(code.upper())
+        if rule is None:
+            continue
+        for violation in rule(mod):
+            if suppress.is_suppressed(violation.line, violation.rule):
+                result.suppressed += 1
+            else:
+                result.violations.append(violation)
+    return result
+
+
+def _iter_py_files(target: str) -> Iterable[str]:
+    if os.path.isfile(target):
+        yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def lint_paths(targets: Sequence[str], root: Optional[str] = None, rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint files/directories; results are merged in deterministic path order."""
+    merged = LintResult(files_scanned=0)
+    root = root or os.getcwd()
+    for target in targets:
+        for path in _iter_py_files(target):
+            one = lint_file(path, root=root, rules=rules)
+            merged.violations.extend(one.violations)
+            merged.suppressed += one.suppressed
+            merged.files_scanned += one.files_scanned
+            merged.parse_errors.extend(one.parse_errors)
+    merged.violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return merged
+
+
+# --------------------------------------------------------------------------- baseline
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+
+
+def write_baseline(path: str, violations: Sequence[Violation]) -> Dict[str, int]:
+    entries = dict(sorted(Counter(v.key() for v in violations).items()))
+    payload = {
+        "comment": "jitlint baseline — intentional host-side exceptions, keyed path::rule::context. "
+                   "Regenerate with `python tools/lint_metrics.py --update-baseline`.",
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return entries
+
+
+def diff_against_baseline(
+    violations: Sequence[Violation], baseline: Dict[str, int]
+) -> Tuple[List[Violation], int, List[str]]:
+    """Split into (new, baselined_count, stale_baseline_keys)."""
+    budget = dict(baseline)
+    new: List[Violation] = []
+    baselined = 0
+    for v in violations:
+        k = v.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            baselined += 1
+        else:
+            new.append(v)
+    stale = sorted(k for k, remaining in budget.items() if remaining == baseline.get(k, 0) and baseline.get(k, 0) > 0)
+    return new, baselined, stale

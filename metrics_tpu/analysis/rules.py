@@ -1,0 +1,447 @@
+"""jitlint rules JL001–JL006.
+
+Each rule is a callable ``rule(module: ModuleInfo) -> list[Violation]`` over a
+parsed module. Rules are registered in :data:`ALL_RULES` keyed by code; the
+engine applies suppressions and the baseline afterwards.
+
+=======  ======================================================================
+code     invariant
+=======  ======================================================================
+JL001    no tracer concretization in traced code: ``float()/int()/bool()``,
+         ``.item()``, ``if``/``while`` on array-valued expressions
+JL002    no recompilation hazards: ``jax.jit`` of functions with str/bool
+         config params must declare ``static_argnums``/``static_argnames``;
+         no f-string/``str()`` of traced values
+JL003    Metric state contract: every ``add_state`` name is used in ``update``,
+         ``dist_reduce_fx`` declared, host-side updates marked
+         ``__jit_ineligible__`` (or carried by a list state)
+JL004    no dtype-promotion hazards in traced code: bare ``np.`` calls,
+         explicit float64/complex128 dtypes
+JL005    no side effects in traced code: ``print``, ``block_until_ready``,
+         ``io_callback``/``host_callback`` (``pure_callback`` is sanctioned)
+JL006    namespace consistency: ``__all__`` present in package inits, every
+         listed name bound, every public import exported
+=======  ======================================================================
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from metrics_tpu.analysis.contexts import (
+    ArrayTaint,
+    TracedContext,
+    Violation,
+    class_list_state_names,
+    find_traced_contexts,
+    self_state_seeds,
+)
+
+__all__ = ["ModuleInfo", "ALL_RULES"]
+
+
+@dataclass
+class ModuleInfo:
+    """Everything a rule needs to know about one source file."""
+
+    path: str  # repo-relative posix path
+    tree: ast.Module
+    source: str
+    is_functional: bool  # under metrics_tpu/functional/ or metrics_tpu/ops/
+    is_package_init: bool
+
+    _contexts: Optional[List[TracedContext]] = field(default=None, repr=False)
+
+    @property
+    def traced_contexts(self) -> List[TracedContext]:
+        if self._contexts is None:
+            self._contexts = find_traced_contexts(self.tree, self.is_functional)
+        return self._contexts
+
+
+def _v(mod: ModuleInfo, node: ast.AST, rule: str, msg: str, context: str = "<module>") -> Violation:
+    return Violation(
+        path=mod.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        rule=rule,
+        message=msg,
+        context=context,
+    )
+
+
+def _dotted(e: ast.expr) -> str:
+    """Best-effort dotted-name rendering ('jax.jit', 'np.sum'); '' if not a name chain."""
+    parts: List[str] = []
+    while isinstance(e, ast.Attribute):
+        parts.append(e.attr)
+        e = e.value
+    if isinstance(e, ast.Name):
+        parts.append(e.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# =========================================================================== JL001
+def rule_jl001_tracer_concretization(mod: ModuleInfo) -> List[Violation]:
+    out: List[Violation] = []
+    for ctx in mod.traced_contexts:
+        if ctx.concreteness_aware:
+            continue  # function branches on tracedness explicitly
+        taint = ArrayTaint(ctx.node, state_attrs=self_state_seeds(ctx))
+        for node in ast.walk(ctx.node):
+            if isinstance(node, (ast.If, ast.While)):
+                if taint.is_value_dependent_test(node.test):
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    out.append(_v(mod, node, "JL001",
+                                  f"`{kw}` on an array-valued expression concretizes the tracer "
+                                  "(use jnp.where/lax.cond or hoist to eager validation)", ctx.qualname))
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool") and node.args:
+                    if taint.is_array_expr(node.args[0]):
+                        out.append(_v(mod, node, "JL001",
+                                      f"`{fn.id}()` of an array value forces concretization under trace",
+                                      ctx.qualname))
+                elif isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+                    if taint.is_array_expr(fn.value):
+                        out.append(_v(mod, node, "JL001",
+                                      "`.item()` forces a device sync and fails under trace", ctx.qualname))
+    return out
+
+
+# =========================================================================== JL002
+_CONFIG_ANNOTATIONS = ("str", "bool", "Literal")
+
+
+def _param_needs_static(arg: ast.arg, default: Optional[ast.expr]) -> bool:
+    """A parameter that must be marked static for jit to either work or not retrace."""
+    if isinstance(default, ast.Constant) and isinstance(default.value, (str, bool)):
+        return True
+    if arg.annotation is not None:
+        text = ast.unparse(arg.annotation)
+        if any(tok in text for tok in _CONFIG_ANNOTATIONS):
+            return True
+    return False
+
+
+def _collect_module_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+
+
+def _static_decl_names(call: ast.Call, target: ast.FunctionDef) -> Set[str]:
+    """Parameter names covered by static_argnums/static_argnames in a jit call."""
+    covered: Set[str] = set()
+    params = [a.arg for a in target.args.posonlyargs + target.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    covered.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(params):
+                        covered.add(params[n.value])
+    return covered
+
+
+def _function_params_with_defaults(fn: ast.FunctionDef):
+    """Yield (arg, default|None) over positional+kwonly params."""
+    pos = fn.args.posonlyargs + fn.args.args
+    defaults = [None] * (len(pos) - len(fn.args.defaults)) + list(fn.args.defaults)
+    yield from zip(pos, defaults)
+    yield from zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+
+
+def rule_jl002_recompilation(mod: ModuleInfo) -> List[Violation]:
+    out: List[Violation] = []
+    functions = _collect_module_functions(mod.tree)
+
+    def check_jit_application(call: ast.Call, target: Optional[ast.FunctionDef], where: str) -> None:
+        if target is None:
+            return
+        covered = _static_decl_names(call, target)
+        for arg, default in _function_params_with_defaults(target):
+            if arg.arg in covered or arg.arg == "self":
+                continue
+            if _param_needs_static(arg, default):
+                out.append(_v(mod, call, "JL002",
+                              f"jit of `{target.name}` leaves config param `{arg.arg}` non-static "
+                              "(declare static_argnums/static_argnames or it recompiles/fails per call)",
+                              where))
+
+    # decorator form: @jax.jit / @functools.partial(jax.jit, ...)
+    for fn in (n for n in ast.walk(mod.tree) if isinstance(n, ast.FunctionDef)):
+        for dec in fn.decorator_list:
+            if _dotted(dec) in ("jax.jit", "jit"):
+                check_jit_application(ast.Call(func=dec, args=[], keywords=[],
+                                               lineno=dec.lineno, col_offset=dec.col_offset), fn, fn.name)
+            elif isinstance(dec, ast.Call):
+                head = _dotted(dec.func)
+                if head in ("jax.jit", "jit"):
+                    check_jit_application(dec, fn, fn.name)
+                elif head in ("functools.partial", "partial") and dec.args and _dotted(dec.args[0]) in ("jax.jit", "jit"):
+                    check_jit_application(dec, fn, fn.name)
+
+    # call form: jax.jit(f, ...) where f is a module-level def
+    for call in (n for n in ast.walk(mod.tree) if isinstance(n, ast.Call)):
+        if _dotted(call.func) in ("jax.jit", "jit") and call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Name) and first.id in functions:
+                check_jit_application(call, functions[first.id], "<module>")
+
+    # f-string / str() of traced values inside traced contexts
+    for ctx in mod.traced_contexts:
+        if ctx.concreteness_aware:
+            continue  # branches on _is_traced — formatting happens eagerly
+        taint = ArrayTaint(ctx.node, state_attrs=self_state_seeds(ctx))
+        # f-strings inside `raise` messages format the tracer's repr, which is
+        # harmless (and the raise aborts the trace anyway) — exempt them
+        in_raise: set = set()
+        for stmt in ast.walk(ctx.node):
+            if isinstance(stmt, ast.Raise):
+                in_raise.update(id(n) for n in ast.walk(stmt))
+        for node in ast.walk(ctx.node):
+            if id(node) in in_raise:
+                continue
+            if isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.FormattedValue) and taint.is_array_expr(part.value):
+                        out.append(_v(mod, node, "JL002",
+                                      "f-string interpolation of a traced value concretizes it "
+                                      "(use jax.debug.print for traced diagnostics)", ctx.qualname))
+                        break
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and node.func.id == "str":
+                if node.args and taint.is_array_expr(node.args[0]):
+                    out.append(_v(mod, node, "JL002",
+                                  "`str()` of a traced value concretizes it", ctx.qualname))
+    return out
+
+
+# =========================================================================== JL003
+_HOST_CALL_ROOTS = ("np", "numpy")
+_HOST_METHODS = ("tolist", "item")
+
+
+def _update_host_ops(update: ast.FunctionDef) -> List[ast.AST]:
+    hits: List[ast.AST] = []
+    for node in ast.walk(update):
+        if isinstance(node, ast.Call):
+            head = _dotted(node.func)
+            if head.split(".")[0] in _HOST_CALL_ROOTS and head.count("."):
+                hits.append(node)
+            elif isinstance(node.func, ast.Attribute) and node.func.attr in _HOST_METHODS:
+                hits.append(node)
+            elif head in ("jax.device_get", "device_get"):
+                hits.append(node)
+    return hits
+
+
+def rule_jl003_state_contract(mod: ModuleInfo) -> List[Violation]:
+    out: List[Violation] = []
+    for cls in (n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)):
+        add_state_calls = [
+            c for c in ast.walk(cls)
+            if isinstance(c, ast.Call)
+            and isinstance(c.func, ast.Attribute) and c.func.attr == "add_state"
+            and isinstance(c.func.value, ast.Name) and c.func.value.id == "self"
+        ]
+        if not add_state_calls:
+            continue
+        qual = cls.name
+        update = next((s for s in cls.body if isinstance(s, ast.FunctionDef) and s.name == "update"), None)
+
+        state_names: Dict[str, ast.Call] = {}
+        for call in add_state_calls:
+            # dist_reduce_fx declared? (3rd positional or keyword)
+            has_reduce = len(call.args) >= 3 or any(kw.arg == "dist_reduce_fx" for kw in call.keywords)
+            name_node = call.args[0] if call.args else None
+            if isinstance(name_node, ast.Constant) and isinstance(name_node.value, str):
+                state_names[name_node.value] = call
+                if not has_reduce:
+                    out.append(_v(mod, call, "JL003",
+                                  f"state `{name_node.value}` registered without an explicit dist_reduce_fx "
+                                  "(distributed sync semantics must be declared)", qual))
+            elif not has_reduce:
+                out.append(_v(mod, call, "JL003",
+                              "add_state without an explicit dist_reduce_fx", qual))
+
+        if update is not None and state_names:
+            # usage anywhere in the class body counts: update may delegate to
+            # helpers, and dict-style access (`self._state["name"]` or an
+            # f-string suffix like f"{key}_features_sum") is idiomatic here
+            declaration_nodes = {id(c.args[0]) for c in add_state_calls if c.args}
+            used_attrs: set = set()
+            str_constants: set = set()
+            fstr_suffixes: set = set()
+            for n in ast.walk(cls):
+                if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) and n.value.id == "self":
+                    used_attrs.add(n.attr)
+                elif isinstance(n, ast.Constant) and isinstance(n.value, str) and id(n) not in declaration_nodes:
+                    str_constants.add(n.value)
+                elif isinstance(n, ast.JoinedStr):
+                    for part in n.values:
+                        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                            fstr_suffixes.add(part.value)
+            for sname, call in state_names.items():
+                used = (
+                    sname in used_attrs
+                    or sname in str_constants
+                    or any(suf and sname.endswith(suf) for suf in fstr_suffixes)
+                )
+                if not used:
+                    out.append(_v(mod, call, "JL003",
+                                  f"state `{sname}` is never read or written outside add_state", qual))
+
+        # host-side update bodies must be marked ineligible (or ride a list state)
+        if update is not None:
+            from metrics_tpu.analysis.contexts import _class_is_jit_ineligible  # noqa: PLC0415
+
+            if not _class_is_jit_ineligible(cls) and not class_list_state_names(cls):
+                for hit in _update_host_ops(update):
+                    out.append(_v(mod, hit, "JL003",
+                                  "host-side op in `update` of a jit-eligible metric — set "
+                                  "`__jit_ineligible__ = True` or register a list state", f"{qual}.update"))
+    return out
+
+
+# =========================================================================== JL004
+# np.<attr> reads that are plain constants/dtypes — fine inside traced code
+_NP_SAFE_ATTRS = frozenset({
+    "pi", "e", "inf", "nan", "newaxis", "euler_gamma",
+    "float32", "float64", "int32", "int64", "uint8", "uint32", "uint64",
+    "bool_", "int8", "int16", "uint16", "complex64", "complex128", "dtype",
+    "ndarray", "integer", "floating", "number",
+})
+_WIDE_DTYPES = ("float64", "complex128")
+
+
+def rule_jl004_dtype_promotion(mod: ModuleInfo) -> List[Violation]:
+    out: List[Violation] = []
+    for ctx in mod.traced_contexts:
+        if ctx.concreteness_aware:
+            continue
+        taint = ArrayTaint(ctx.node, state_attrs=self_state_seeds(ctx))
+        for node in ast.walk(ctx.node):
+            if isinstance(node, ast.Call):
+                head = _dotted(node.func)
+                root, _, attr = head.partition(".")
+                if root in _HOST_CALL_ROOTS and attr and attr.split(".")[0] not in _NP_SAFE_ATTRS:
+                    # np.* over *static* config (building constant tables at trace
+                    # time) is fine; np.* over traced arrays concretizes them
+                    feeds_traced = any(taint.is_array_expr(a) for a in node.args) or any(
+                        kw.arg != "dtype" and taint.is_array_expr(kw.value) for kw in node.keywords
+                    )
+                    if feeds_traced:
+                        out.append(_v(mod, node, "JL004",
+                                      f"`{head}(...)` applied to a traced array concretizes it and computes "
+                                      "on host in float64 (use jnp)", ctx.qualname))
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        text = _dotted(kw.value) or (
+                            kw.value.value if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str) else ""
+                        )
+                        if any(w in str(text) for w in _WIDE_DTYPES):
+                            out.append(_v(mod, node, "JL004",
+                                          f"explicit {text} dtype promotes to a 64-bit program "
+                                          "(host-only under jax default 32-bit mode)", ctx.qualname))
+    return out
+
+
+# =========================================================================== JL005
+_SIDE_EFFECT_CALLS = ("jax.experimental.io_callback", "io_callback",
+                      "jax.experimental.host_callback.call", "host_callback.call")
+
+
+def rule_jl005_side_effects(mod: ModuleInfo) -> List[Violation]:
+    out: List[Violation] = []
+    for ctx in mod.traced_contexts:
+        for node in ast.walk(ctx.node):
+            if not isinstance(node, ast.Call):
+                continue
+            head = _dotted(node.func)
+            if head == "print":
+                out.append(_v(mod, node, "JL005",
+                              "`print` in traced code runs once at trace time, not per step "
+                              "(use jax.debug.print)", ctx.qualname))
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "block_until_ready":
+                out.append(_v(mod, node, "JL005",
+                              "`block_until_ready()` is a host sync and fails under trace", ctx.qualname))
+            elif head in _SIDE_EFFECT_CALLS:
+                out.append(_v(mod, node, "JL005",
+                              f"`{head}` is an impure host callback in a traced region "
+                              "(pure_callback is the sanctioned escape hatch)", ctx.qualname))
+    return out
+
+
+# =========================================================================== JL006
+def _all_literal_names(tree: ast.Module) -> Optional[List[ast.Constant]]:
+    for stmt in tree.body:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else (
+            [stmt.target] if isinstance(stmt, ast.AnnAssign) else []
+        )
+        if any(isinstance(t, ast.Name) and t.id == "__all__" for t in targets):
+            value = stmt.value
+            if isinstance(value, (ast.List, ast.Tuple)):
+                return [e for e in value.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return None
+
+
+def _bound_names(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                if alias.name == "*":
+                    continue
+                names.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(stmt.name)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            names.add(stmt.target.id)
+    return names
+
+
+def rule_jl006_namespace(mod: ModuleInfo) -> List[Violation]:
+    if not mod.is_package_init:
+        return []
+    out: List[Violation] = []
+    all_names = _all_literal_names(mod.tree)
+    if all_names is None:
+        # only functional-layer packages are held to the export contract
+        if mod.is_functional:
+            out.append(_v(mod, mod.tree, "JL006", "package __init__ has no literal __all__"))
+        return out
+    bound = _bound_names(mod.tree)
+    listed = set()
+    for const in all_names:
+        listed.add(const.value)
+        if const.value not in bound:
+            out.append(_v(mod, const, "JL006", f"`{const.value}` listed in __all__ but never bound"))
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.ImportFrom) and stmt.module and "metrics_tpu" in (stmt.module or ""):
+            for alias in stmt.names:
+                name = alias.asname or alias.name
+                if name.startswith("_") or alias.name == "*":
+                    continue
+                if name not in listed:
+                    out.append(_v(mod, stmt, "JL006",
+                                  f"public import `{name}` missing from __all__ (silent namespace drift)"))
+    return out
+
+
+ALL_RULES: Dict[str, Callable[[ModuleInfo], List[Violation]]] = {
+    "JL001": rule_jl001_tracer_concretization,
+    "JL002": rule_jl002_recompilation,
+    "JL003": rule_jl003_state_contract,
+    "JL004": rule_jl004_dtype_promotion,
+    "JL005": rule_jl005_side_effects,
+    "JL006": rule_jl006_namespace,
+}

@@ -24,6 +24,9 @@ them bit-faithfully in numpy (librosa itself is not a dependency):
 Everything here is host-side numpy by design: the consumers are CPU onnx
 sessions (SURVEY §2.9), never TPU programs.
 """
+# Mel filterbank construction and STFT framing run on the host in float64 for
+# librosa bit-parity; results are cast to device float32 at the boundary.
+# jitlint: disable-file=JL004
 
 from __future__ import annotations
 

@@ -9,6 +9,9 @@ precision_fixed_recall,recall_fixed_precision}.py``).
 These run at the eager ``compute()`` boundary, so the constrained lex-argmax uses
 host numpy (mirroring the reference's ``_lexargmax``, ``recall_fixed_precision.py:38-55``).
 """
+# Fixed-point threshold selection breaks ties lexicographically in host
+# float64 to match the reference bit-for-bit; eager-only by design.
+# jitlint: disable-file=JL004
 
 from __future__ import annotations
 

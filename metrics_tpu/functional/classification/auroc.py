@@ -27,6 +27,8 @@ from metrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _auc_compute_without_check, _safe_divide
 from metrics_tpu.utils.data import bincount
 from metrics_tpu.utils.enums import ClassificationTask
@@ -54,7 +56,7 @@ def _reduce_auroc(
         res = jnp.stack([_auc_compute_without_check(x, y, direction=direction) for x, y in zip(fpr, tpr)])
     if average is None or average == "none":
         return res
-    if bool(jnp.isnan(res).any()):
+    if not _is_traced(res) and bool(jnp.isnan(res).any()):
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
             UserWarning,
@@ -88,7 +90,14 @@ def _binary_auroc_compute(
 ) -> Array:
     """AUROC with optional partial-AUC McClish correction (reference ``auroc.py:83-107``)."""
     fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
-    if max_fpr is None or max_fpr == 1 or bool((jnp.sum(fpr) == 0) | (jnp.sum(tpr) == 0)):
+    if max_fpr is None or max_fpr == 1:
+        return _auc_compute_without_check(fpr, tpr, 1.0)
+    if _is_traced(fpr, tpr):
+        raise TraceIneligibleError(
+            "binary_auroc with max_fpr < 1 slices the ROC curve at a data-dependent index"
+            " and cannot run under jax.jit; call it eagerly or use max_fpr=None."
+        )
+    if bool((jnp.sum(fpr) == 0) | (jnp.sum(tpr) == 0)):
         return _auc_compute_without_check(fpr, tpr, 1.0)
 
     max_area = jnp.asarray(max_fpr, dtype=fpr.dtype)
@@ -207,7 +216,9 @@ def _multilabel_auroc_compute(
 
         preds, target = state[0].reshape(-1), state[1].reshape(-1)
         if ignore_index is not None:
-            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)
+            # exact path rides a list state (eager by design): host boolean
+            # filtering here produces data-dependent shapes on purpose
+            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)  # jitlint: disable=JL004
             preds, target = preds[keep], target[keep]
         return _binary_auroc_compute((preds, target), thresholds, max_fpr=None)
 

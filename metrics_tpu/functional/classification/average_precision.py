@@ -25,6 +25,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _safe_divide
 from metrics_tpu.utils.data import bincount
 from metrics_tpu.utils.enums import ClassificationTask
@@ -55,7 +56,7 @@ def _reduce_average_precision(
     if average is None or average == "none":
         return res
     nan = jnp.isnan(res)
-    if bool(nan.any()):
+    if not _is_traced(nan) and bool(nan.any()):
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
             UserWarning,
@@ -185,7 +186,9 @@ def _multilabel_average_precision_compute(
 
         preds, target = state[0].reshape(-1), state[1].reshape(-1)
         if ignore_index is not None:
-            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)
+            # exact path rides a list state (eager by design): host boolean
+            # filtering here produces data-dependent shapes on purpose
+            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)  # jitlint: disable=JL004
             preds, target = preds[keep], target[keep]
         return _binary_average_precision_compute((preds, target), thresholds)
 

@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _safe_divide
 
 __all__ = ["dice"]
@@ -60,6 +62,11 @@ def _dice_format(
             return preds_hard.reshape(n, c, -1), target_b.reshape(n, c, -1), c
         return preds_hard.reshape(-1, 1, 1), target_b.reshape(-1, 1, 1), 1
     # hard labels: infer classes
+    if not num_classes and _is_traced(preds, target):
+        raise TraceIneligibleError(
+            "dice with hard labels infers the class count from the data, which"
+            " cannot run under jax.jit; pass num_classes explicitly."
+        )
     c = num_classes or int(max(int(preds.max()), int(target.max())) + 1)
     n = preds.shape[0] if preds.ndim else 1
     preds_oh = jnp.arange(c).reshape(1, c, *([1] * max(preds.ndim - 1, 0))) == preds[:, None]

@@ -17,9 +17,17 @@ from metrics_tpu.functional.classification.stat_scores import (
     _binary_stat_scores_format,
     _binary_stat_scores_tensor_validation,
 )
+from metrics_tpu.utils.exceptions import TraceIneligibleError
 from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _safe_divide
 from metrics_tpu.utils.data import bincount_weighted
+
+# group ids become dict keys of the result, so the group structure must be
+# concrete — these metrics are eager-only by construction (reference parity)
+_FAIRNESS_JIT_MSG = (
+    "binary group-fairness metrics key their outputs by data-dependent group ids"
+    " and cannot run under jax.jit; call them eagerly."
+)
 
 
 def _groups_validation(groups: Array, num_groups: int) -> None:
@@ -90,6 +98,8 @@ def binary_groups_stat_rates(
 
 def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
     """Demographic parity from group stats (reference ``group_fairness.py:164-174``)."""
+    if _is_traced(tp, fp, tn, fn):
+        raise TraceIneligibleError(_FAIRNESS_JIT_MSG)
     pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
     min_id = int(jnp.argmin(pos_rates))
     max_id = int(jnp.argmax(pos_rates))
@@ -98,6 +108,8 @@ def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Arra
 
 def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
     """Equal opportunity from group stats (reference ``group_fairness.py:243-255``)."""
+    if _is_traced(tp, fp, tn, fn):
+        raise TraceIneligibleError(_FAIRNESS_JIT_MSG)
     tpr = _safe_divide(tp, tp + fn)
     min_id = int(jnp.argmin(tpr))
     max_id = int(jnp.argmax(tpr))
@@ -119,6 +131,8 @@ def demographic_parity(
     >>> demographic_parity(preds, groups)
     {'DP_0_1': Array(0., dtype=float32)}
     """
+    if _is_traced(groups):
+        raise TraceIneligibleError(_FAIRNESS_JIT_MSG)
     num_groups = int(jnp.max(groups)) + 1
     target = jnp.zeros(preds.shape, dtype=jnp.int32)
     tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
@@ -136,6 +150,8 @@ def equal_opportunity(
     validate_args: bool = True,
 ) -> Dict[str, Array]:
     """Equal opportunity between all groups (reference ``group_fairness.py:258-324``)."""
+    if _is_traced(groups):
+        raise TraceIneligibleError(_FAIRNESS_JIT_MSG)
     num_groups = int(jnp.max(groups)) + 1
     tp, fp, tn, fn = _binary_groups_stat_scores_tensor(
         preds, target, groups, num_groups, threshold, ignore_index, validate_args
@@ -158,6 +174,8 @@ def binary_fairness(
             f"Expected argument `task` to either be ``demographic_parity``,"
             f"``equal_opportunity`` or ``all`` but got {task}."
         )
+    if _is_traced(groups):
+        raise TraceIneligibleError(_FAIRNESS_JIT_MSG)
     num_groups = int(jnp.max(groups)) + 1
     if task == "demographic_parity":
         target = jnp.zeros(preds.shape, dtype=jnp.int32)

@@ -26,6 +26,8 @@ from metrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _auc_compute_without_check, interp
 from metrics_tpu.utils.enums import ClassificationTask
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -50,6 +52,11 @@ def _binary_logauc_compute(
             "At least two values on for the fpr and tpr are required to compute the log AUC. Returns 0 score."
         )
         return jnp.asarray(0.0)
+    if _is_traced(fpr, tpr):
+        raise TraceIneligibleError(
+            "binary_logauc trims the ROC curve at data-dependent indices"
+            " and cannot run under jax.jit; call it eagerly."
+        )
     fpr_rng = jnp.asarray(fpr_range, dtype=fpr.dtype)
     tpr = jnp.sort(jnp.concatenate([tpr, interp(fpr_rng, fpr, tpr)]))
     fpr = jnp.sort(jnp.concatenate([fpr, fpr_rng]))
@@ -76,7 +83,7 @@ def _reduce_logauc(
     if average is None or average == "none":
         return scores
     nan = jnp.isnan(scores)
-    if bool(nan.any()):
+    if not _is_traced(nan) and bool(nan.any()):
         rank_zero_warn(f"Some classes had `nan` log AUC. Ignoring these classes in {average}-average", UserWarning)
     if average == "macro":
         return jnp.where(nan, 0.0, scores).sum() / jnp.maximum((~nan).sum(), 1)

@@ -230,12 +230,15 @@ def _binary_precision_recall_curve_compute(
     fps, tps, thres = _binary_clf_curve(state[0], state[1], pos_label=pos_label)
     precision = tps / (tps + fps)
     recall = tps / tps[-1]
-    if bool((state[1] == pos_label).sum() == 0):
+    no_positives = (state[1] == pos_label).sum() == 0
+    if not _is_traced(no_positives) and bool(no_positives):
         rank_zero_warn(
             "No positive samples found in target, recall is undefined. Setting recall to one for all thresholds.",
             UserWarning,
         )
-        recall = jnp.ones_like(recall)
+    # reference substitutes recall=1 at every threshold when the target has no
+    # positives; selecting via where keeps the same result trace-safely
+    recall = jnp.where(no_positives, jnp.ones_like(recall), recall)
     precision = jnp.concatenate([jnp.flip(precision, 0), jnp.ones(1, dtype=precision.dtype)])
     recall = jnp.concatenate([jnp.flip(recall, 0), jnp.zeros(1, dtype=recall.dtype)])
     thres = jnp.flip(thres, 0)
@@ -520,7 +523,9 @@ def _multilabel_precision_recall_curve_compute(
         preds_i = state[0][:, i]
         target_i = state[1][:, i]
         if ignore_index is not None:
-            keep = np.asarray(target_i != ignore_index) & np.asarray(target_i >= 0)
+            # exact path rides a list state (eager by design): host boolean
+            # filtering here produces data-dependent shapes on purpose
+            keep = np.asarray(target_i != ignore_index) & np.asarray(target_i >= 0)  # jitlint: disable=JL004
             preds_i, target_i = preds_i[keep], target_i[keep]
         res = _binary_precision_recall_curve_compute((preds_i, target_i), thresholds=None)
         precision_list.append(res[0])

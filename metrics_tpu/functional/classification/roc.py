@@ -23,6 +23,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _safe_divide, interp
 from metrics_tpu.utils.enums import ClassificationTask
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -48,24 +49,23 @@ def _binary_roc_compute(
     fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
     thres = jnp.concatenate([jnp.ones(1, dtype=thres.dtype), thres])
 
-    if bool(fps[-1] <= 0):
+    # cumulative counts are >= 0, so a zero final count is exactly the
+    # degenerate "no negatives/positives" case — _safe_divide returns the
+    # reference's zero tensor there, branch-free, so this also works under jit
+    if not _is_traced(fps) and bool(fps[-1] <= 0):
         rank_zero_warn(
             "No negative samples in targets, false positive value should be meaningless."
             " Returning zero tensor in false positive score",
             UserWarning,
         )
-        fpr = jnp.zeros_like(thres)
-    else:
-        fpr = fps / fps[-1]
-    if bool(tps[-1] <= 0):
+    fpr = _safe_divide(fps, fps[-1])
+    if not _is_traced(tps) and bool(tps[-1] <= 0):
         rank_zero_warn(
             "No positive samples in targets, true positive value should be meaningless."
             " Returning zero tensor in true positive score",
             UserWarning,
         )
-        tpr = jnp.zeros_like(thres)
-    else:
-        tpr = tps / tps[-1]
+    tpr = _safe_divide(tps, tps[-1])
     return fpr, tpr, thres
 
 
@@ -181,7 +181,9 @@ def _multilabel_roc_compute(
         preds = state[0][:, i]
         target = state[1][:, i]
         if ignore_index is not None:
-            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)
+            # exact path rides a list state (eager by design): host boolean
+            # filtering here produces data-dependent shapes on purpose
+            keep = np.asarray(target != ignore_index) & np.asarray(target >= 0)  # jitlint: disable=JL004
             preds, target = preds[keep], target[keep]
         res = _binary_roc_compute((preds, target), thresholds=None, pos_label=1)
         fpr_list.append(res[0])

@@ -19,12 +19,19 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.data import bincount
 
 
 def _compact_labels(preds: Array, target: Array) -> Tuple[Array, Array, int, int]:
     """Map labels to 0..K-1 (host-side; label vocabularies are data-dependent)."""
+    if _is_traced(preds, target):
+        raise TraceIneligibleError(
+            "extrinsic clustering metrics compact data-dependent label vocabularies"
+            " on the host and cannot run under jax.jit; call them eagerly."
+        )
     import numpy as np
 
     p = np.asarray(preds).reshape(-1)
@@ -183,6 +190,11 @@ def _generalized_average(u: Array, v: Array, method: str) -> Array:
 
 def _expected_mutual_info(c: Array) -> Array:
     """Expected MI under the permutation model (reference's scipy-based EMI, via log-gamma)."""
+    if _is_traced(c):
+        raise TraceIneligibleError(
+            "adjusted_mutual_info_score evaluates the expected MI with a host-side"
+            " loop over the contingency table and cannot run under jax.jit."
+        )
     import numpy as np
     from scipy.special import gammaln
 
@@ -224,6 +236,6 @@ def adjusted_mutual_info_score(preds: Array, target: Array, average_method: str 
     denom = norm - emi
     import numpy as np
 
-    if abs(float(denom)) < np.finfo(np.float32).eps:
+    if not _is_traced(denom) and abs(float(denom)) < np.finfo(np.float32).eps:
         denom = jnp.asarray(float(np.finfo(np.float32).eps))
     return (mi - emi) / denom

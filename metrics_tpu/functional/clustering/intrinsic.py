@@ -12,8 +12,16 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+from metrics_tpu.utils.checks import _is_traced
+
 
 def _cluster_stats(data: Array, labels: Array):
+    if _is_traced(labels):
+        raise TraceIneligibleError(
+            "intrinsic clustering metrics derive the cluster count from the data"
+            " on the host and cannot run under jax.jit; call them eagerly."
+        )
     import numpy as np
 
     lab_np = np.asarray(labels).reshape(-1)

@@ -14,10 +14,17 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.clustering.extrinsic import calculate_contingency_matrix
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def _handle_nan(preds: Array, target: Array, nan_strategy: str, nan_replace_value: Optional[float]):
+    if _is_traced(preds, target):
+        raise TraceIneligibleError(
+            "nominal metrics preprocess NaNs on the host (nan_strategy='drop' changes"
+            " the data shape) and cannot run under jax.jit; call them eagerly."
+        )
     import numpy as np
 
     p = np.asarray(preds, dtype=np.float64).reshape(-1)
@@ -65,7 +72,7 @@ def cramers_v(
         r = r - (r - 1) ** 2 / float(n - 1)
         k = k - (k - 1) ** 2 / float(n - 1)
         denom = jnp.minimum(jnp.asarray(r - 1), jnp.asarray(k - 1))
-        if float(denom) == 0:
+        if not _is_traced(denom) and float(denom) == 0:
             rank_zero_warn(
                 "Unable to compute Cramer's V using bias correction. Please consider to set `bias_correction=False`."
             )

@@ -52,7 +52,7 @@ def _kendall_tau_1d(preds: Array, target: Array, variant: str) -> Array:
     # variant "c": needs the number of distinct values per column (host-side)
     import numpy as np
 
-    m = min(len(np.unique(np.asarray(preds))), len(np.unique(np.asarray(target))))
+    m = min(len(np.unique(np.asarray(preds))), len(np.unique(np.asarray(target))))  # jitlint: disable=JL004
     m = max(m, 2)
     return 2 * con_min_dis / (n**2 * (m - 1) / m)
 
@@ -109,7 +109,7 @@ def kendall_rank_corrcoef(
     import numpy as np
 
     n = preds.shape[0]
-    z = 3 * np.asarray(tau, dtype=np.float64) * math.sqrt(n * (n - 1)) / math.sqrt(2 * (2 * n + 5))
+    z = 3 * np.asarray(tau, dtype=np.float64) * math.sqrt(n * (n - 1)) / math.sqrt(2 * (2 * n + 5))  # jitlint: disable=JL004
     sf = lambda v: 0.5 * np.vectorize(math.erfc)(v / math.sqrt(2.0))  # noqa: E731
     if alternative == "two-sided":
         p = 2 * sf(np.abs(z))

@@ -7,7 +7,7 @@ from typing import Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _check_same_shape, _is_traced
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -58,15 +58,23 @@ def _r2_score_compute(
     if adjusted < 0 or not isinstance(adjusted, int):
         raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
     if adjusted != 0:
-        if adjusted > num_obs - 1:
-            rank_zero_warn(
-                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
-                UserWarning,
-            )
-        elif adjusted == num_obs - 1:
-            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
-        else:
-            return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+        if not _is_traced(num_obs):
+            if int(num_obs) - 1 < adjusted:
+                rank_zero_warn(
+                    "More independent regressions than data points in adjusted r2 score."
+                    " Falls back to standard r2 score.",
+                    UserWarning,
+                )
+            elif int(num_obs) - 1 == adjusted:
+                rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+            else:
+                return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+            return r2
+        # under trace, select the adjusted score only where its denominator is
+        # positive (same fallback the warnings announce eagerly), branch-free
+        denom = num_obs - adjusted - 1
+        adj = 1 - (1 - r2) * (num_obs - 1) / jnp.maximum(denom, 1)
+        return jnp.where(denom > 0, adj, r2)
     return r2
 
 

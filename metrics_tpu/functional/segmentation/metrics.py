@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _safe_divide
 
 
@@ -203,6 +205,11 @@ def hausdorff_distance(
     Edge maps are computed on device; the point-set distance runs at the host
     compute boundary (dynamic edge counts are inherent to the metric).
     """
+    if _is_traced(preds, target):
+        raise TraceIneligibleError(
+            "hausdorff_distance gathers data-dependent edge point sets on the host"
+            " and cannot run under jax.jit; call it eagerly."
+        )
     import numpy as np
 
     if distance_metric not in ("euclidean", "chessboard", "taxicab"):

@@ -42,7 +42,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.utils.data import _flatten, dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
-from metrics_tpu.utils.exceptions import TPUMetricsUserError
+from metrics_tpu.utils.exceptions import TPUMetricsUserError, TraceIneligibleError
 from metrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = ["Metric", "CompositionalMetric", "clear_jit_cache", "jit_update_enabled"]
@@ -419,7 +419,7 @@ class Metric(ABC):
                 self.__dict__["_state"] = self._jitted_update(self._state, *args, **kwargs)
             except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
-                    jax.errors.TracerIntegerConversionError):
+                    jax.errors.TracerIntegerConversionError, TraceIneligibleError):
                 # update body is genuinely un-traceable → latch eager mode for this metric
                 self._jit_failed = True
                 self._jitted_update = None

@@ -36,6 +36,7 @@ from metrics_tpu.functional.regression.r2 import (
 )
 from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.data import dim_zero_cat
 
 __all__ = [
@@ -249,7 +250,7 @@ class R2Score(Metric):
 
     def compute(self) -> Array:
         """Compute metric."""
-        if int(self.total) < 2:
+        if not _is_traced(self.total) and int(self.total) < 2:
             raise ValueError("Needs at least two samples to calculate r2 score.")
         return _r2_score_compute(
             self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput

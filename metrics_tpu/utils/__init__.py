@@ -37,7 +37,10 @@ __all__ = [
     "dim_zero_mean",
     "dim_zero_min",
     "dim_zero_sum",
+    "enums",
+    "imports",
     "interp",
+    "plot",
     "rank_zero_debug",
     "rank_zero_info",
     "rank_zero_warn",

@@ -13,3 +13,14 @@ class TPUMetricsUserError(Exception):
 
 class TPUMetricsUserWarning(UserWarning):
     """Warning for recoverable user-facing issues (e.g. degraded precision paths)."""
+
+
+class TraceIneligibleError(RuntimeError):
+    """A kernel refused to run under tracing (data-dependent shapes or host math).
+
+    Raised by ``_is_traced`` guards in functional kernels whose reference
+    semantics cannot be expressed as a fixed-shape jaxpr (class-count
+    inference, data-dependent slicing, host-side group partitioning).
+    ``Metric._wrapped_update`` treats it like a tracer error: the metric
+    latches eager mode and re-runs the update outside ``jax.jit``.
+    """

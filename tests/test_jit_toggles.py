@@ -1,0 +1,154 @@
+"""Coverage for the runtime jit controls: ``clear_jit_cache()`` and
+``jit_update_enabled()`` (plus the per-instance ``jit_update=`` override they
+interact with). Companions to the shared-cache tests in ``test_core.py``."""
+
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.metric as metric_mod
+from metrics_tpu import Metric
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+
+
+class TracedSum(Metric):
+    full_state_update = False
+    traces = 0
+
+    def __init__(self, scale: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        type(self).traces += 1  # python-level side effect: counts real traces
+        self.total = self.total + self.scale * jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.total
+
+
+@pytest.fixture(autouse=True)
+def _pristine_jit_globals():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    TracedSum.traces = 0
+    yield
+    clear_jit_cache()
+    jit_update_enabled(True)
+
+
+def test_clear_jit_cache_empties_shared_cache_and_forces_retrace():
+    m = TracedSum()
+    m.update(1.0)
+    assert len(metric_mod._SHARED_JIT_CACHE) == 1
+    assert TracedSum.traces == 1
+
+    clear_jit_cache()
+    assert len(metric_mod._SHARED_JIT_CACHE) == 0
+
+    fresh = TracedSum()
+    fresh.update(2.0)
+    assert TracedSum.traces == 2  # cache was really dropped → traced again
+    assert float(fresh.compute()) == 2.0
+
+
+def test_clear_jit_cache_does_not_break_existing_instances():
+    m = TracedSum()
+    m.update(1.0)
+    clear_jit_cache()
+    m.update(2.0)  # instance still holds its compiled fn; must keep working
+    assert float(m.compute()) == 3.0
+
+
+def test_jit_update_enabled_false_runs_eagerly():
+    jit_update_enabled(False)
+    m = TracedSum()
+    m.update(1.0)
+    m.update(2.0)
+    # eager path: no shared-cache entry, no compiled update on the instance,
+    # and every call runs the python body
+    assert len(metric_mod._SHARED_JIT_CACHE) == 0
+    assert m._jitted_update is None
+    assert TracedSum.traces == 2
+    assert float(m.compute()) == 3.0
+
+
+def test_jit_update_enabled_roundtrip_restores_jit_path():
+    jit_update_enabled(False)
+    m = TracedSum()
+    m.update(1.0)
+    assert len(metric_mod._SHARED_JIT_CACHE) == 0
+
+    jit_update_enabled(True)
+    m.update(2.0)  # same instance picks the jit path back up
+    assert len(metric_mod._SHARED_JIT_CACHE) == 1
+    assert float(m.compute()) == 3.0
+
+
+def test_per_instance_override_beats_global_toggle():
+    jit_update_enabled(False)
+    opted_in = TracedSum(jit_update=True)
+    opted_in.update(1.0)
+    assert len(metric_mod._SHARED_JIT_CACHE) == 1  # explicit opt-in wins
+
+    jit_update_enabled(True)
+    opted_out = TracedSum(jit_update=False)
+    opted_out.update(1.0)
+    assert opted_out._jitted_update is None  # explicit opt-out wins
+    assert float(opted_in.compute()) == 1.0
+    assert float(opted_out.compute()) == 1.0
+
+
+def test_eager_and_jitted_results_agree():
+    jit_update_enabled(False)
+    eager = TracedSum(scale=2.0)
+    jit_update_enabled(True)
+    jitted = TracedSum(scale=2.0)
+    for v in (1.0, 2.5, 3.0):
+        eager_was = metric_mod._JIT_UPDATE_DEFAULT
+        jit_update_enabled(False)
+        eager.update(v)
+        jit_update_enabled(eager_was)
+        jitted.update(v)
+    assert float(eager.compute()) == pytest.approx(float(jitted.compute()))
+
+
+def test_trace_ineligible_update_latches_eager_mode():
+    """A TraceIneligibleError raised under trace must latch eager fallback,
+    exactly like a native jax tracer error (regression: Dice without
+    num_classes infers the class count from data)."""
+    from metrics_tpu.utils.checks import _is_traced
+    from metrics_tpu.utils.exceptions import TraceIneligibleError
+
+    class HostyMax(Metric):
+        full_state_update = False
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("peak", jnp.asarray(0.0), dist_reduce_fx="max")
+
+        def update(self, x):
+            if _is_traced(x):
+                raise TraceIneligibleError("needs concrete data")
+            self.peak = jnp.maximum(self.peak, jnp.asarray(float(x.max())))
+
+        def compute(self):
+            return self.peak
+
+    m = HostyMax()
+    m.update(jnp.asarray([1.0, 3.0, 2.0]))  # jit attempt -> latch -> eager rerun
+    assert m._jit_failed and m._jitted_update is None
+    m.update(jnp.asarray([5.0, 0.5]))
+    assert float(m.compute()) == 5.0
+
+
+def test_shared_cache_lru_bound_evicts_oldest(monkeypatch):
+    monkeypatch.setattr(metric_mod, "_SHARED_JIT_CACHE_MAX", 2)
+    for scale in (1.0, 2.0, 3.0):  # three distinct static configs
+        m = TracedSum(scale=scale)
+        m.update(1.0)
+    assert len(metric_mod._SHARED_JIT_CACHE) == 2  # oldest config evicted
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

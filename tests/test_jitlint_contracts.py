@@ -1,0 +1,78 @@
+"""Abstract-interpretation contracts: every canonical functional kernel must
+trace cleanly under ``jax.eval_shape`` with only 32-bit output leaves.
+
+This is the dynamic half of jitlint — the AST rules guess, ``eval_shape``
+*knows*: any concretization raises a tracer error here with zero FLOPs spent.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.functional as F
+from metrics_tpu.analysis.abstract_contracts import (
+    CONTRACTS,
+    KernelContract,
+    f32,
+    trace_contract,
+    verify_contracts,
+)
+
+
+def _contract_id(c: KernelContract) -> str:
+    suffix = "-".join(f"{k}={v}" for k, v in sorted((c.kwargs or {}).items()))
+    return f"{c.name}[{suffix}]" if suffix else c.name
+
+
+def test_contract_table_meets_coverage_floor():
+    assert len(CONTRACTS) >= 30, "the eval_shape harness must cover >=30 functional kernels"
+    assert len({c.name for c in CONTRACTS}) >= 30
+
+
+@pytest.mark.parametrize("contract", CONTRACTS, ids=_contract_id)
+def test_kernel_traces_cleanly(contract):
+    result = trace_contract(contract)
+    assert result.ok, f"{contract.name}: {result.error}"
+
+
+def test_verify_contracts_runs_full_table():
+    results = verify_contracts()
+    assert len(results) == len(CONTRACTS)
+    failures = [r for r in results if not r.ok]
+    assert not failures, "\n".join(f"{r.contract.name}: {r.error}" for r in failures)
+
+
+def test_harness_catches_tracer_concretization():
+    """Negative control: a kernel that branches on data must FAIL the harness."""
+
+    def bad_kernel(x):
+        if bool(jnp.sum(x) > 0):  # jitlint: disable=JL001  (deliberate fixture)
+            return x
+        return -x
+
+    F._bad_kernel_for_contract_test = bad_kernel
+    try:
+        result = trace_contract(KernelContract("_bad_kernel_for_contract_test", (f32(4),)))
+    finally:
+        del F._bad_kernel_for_contract_test
+    assert not result.ok
+    assert "Tracer" in result.error or "concret" in result.error.lower()
+
+
+def test_harness_reports_unknown_kernel_as_failure():
+    result = trace_contract(KernelContract("no_such_kernel_xyz", (f32(4),)))
+    assert not result.ok
+    assert "AttributeError" in result.error
+
+
+def test_outputs_are_abstract_not_concrete():
+    """eval_shape must not execute: outputs are ShapeDtypeStructs, not arrays."""
+    result = trace_contract(KernelContract("mean_squared_error", (f32(8), f32(8))))
+    assert result.ok
+    leaves = jax.tree_util.tree_leaves(result.outputs)
+    assert leaves and all(isinstance(leaf, jax.ShapeDtypeStruct) for leaf in leaves)
+    assert all(str(leaf.dtype) == "float32" for leaf in leaves)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

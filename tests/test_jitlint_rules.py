@@ -1,0 +1,395 @@
+"""Unit tests for the jitlint AST rules (JL001–JL006).
+
+Every rule gets at least one positive fixture (the violation is reported) and
+one negative fixture (idiomatic trace-safe code stays clean). Fixtures are
+written under a ``pkg/functional/`` directory so top-level functions count as
+kernel contexts, mirroring how the engine classifies ``metrics_tpu/functional``.
+"""
+
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis import Suppressions, diff_against_baseline, lint_file
+from metrics_tpu.analysis.contexts import Violation
+
+
+def run_lint(tmp_path, source, rel="pkg/functional/mod.py", rules=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_file(str(path), root=str(tmp_path), rules=rules)
+
+
+def codes(result):
+    return [v.rule for v in result.violations]
+
+
+# =========================================================================== JL001
+class TestJL001TracerConcretization:
+    def test_if_on_array_expression_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                if jnp.sum(x) > 0:
+                    return x
+                return -x
+        """, rules=["JL001"])
+        assert codes(res) == ["JL001"]
+        assert "`if` on an array-valued expression" in res.violations[0].message
+
+    def test_bool_and_item_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import Array
+
+            def kernel(x: Array) -> float:
+                flag = bool(x.sum())
+                return x.item() if flag else 0.0
+        """, rules=["JL001"])
+        assert codes(res).count("JL001") >= 2
+
+    def test_while_on_array_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                while x.sum() > 0:
+                    x = x - 1
+                return x
+        """, rules=["JL001"])
+        assert codes(res) == ["JL001"]
+
+    def test_is_traced_guard_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from jax import Array
+            from metrics_tpu.utils.checks import _is_traced
+
+            def kernel(x: Array) -> Array:
+                if not _is_traced(x) and bool(jnp.sum(x) > 0):
+                    pass  # eager-only warning path
+                return x
+        """, rules=["JL001"])
+        assert codes(res) == []
+
+    def test_static_tests_are_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from typing import Optional, Union
+            from jax import Array
+
+            def kernel(x: Array, thresholds: Optional[Union[int, Array]] = None) -> Array:
+                if thresholds is None:
+                    return x
+                if isinstance(thresholds, int) and thresholds < 2:
+                    raise ValueError("bad thresholds")
+                if x.ndim > 1:
+                    x = x.reshape(-1)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return x
+                return x.astype(jnp.float32)
+        """, rules=["JL001"])
+        assert codes(res) == []
+
+    def test_host_numpy_branching_is_clean(self, tmp_path):
+        # np arrays are concrete; branching on them never concretizes a tracer
+        res = run_lint(tmp_path, """
+            import numpy as np
+
+            def kernel(n: int) -> float:
+                table = np.zeros(n)
+                if table.sum() > 0:
+                    return 1.0
+                return 0.0
+        """, rules=["JL001"])
+        assert codes(res) == []
+
+
+# =========================================================================== JL002
+class TestJL002Recompilation:
+    def test_jit_with_str_param_without_static_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+            from jax import Array
+
+            @jax.jit
+            def kernel(x: Array, mode: str = "macro") -> Array:
+                return x
+        """, rules=["JL002"])
+        assert codes(res) == ["JL002"]
+
+    def test_jit_with_static_argnames_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import functools
+            import jax
+            from jax import Array
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def kernel(x: Array, mode: str = "macro") -> Array:
+                return x
+        """, rules=["JL002"])
+        assert codes(res) == []
+
+    def test_fstring_of_traced_value_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import Array
+
+            def kernel(x: Array) -> str:
+                return f"value is {x}"
+        """, rules=["JL002"])
+        assert codes(res) == ["JL002"]
+
+    def test_fstring_inside_raise_is_clean(self, tmp_path):
+        # error messages format the tracer's repr, which is harmless
+        res = run_lint(tmp_path, """
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                if x.ndim != 1:
+                    raise ValueError(f"expected 1d, got {x}")
+                return x
+        """, rules=["JL002"])
+        assert codes(res) == []
+
+
+# =========================================================================== JL003
+class TestJL003StateContract:
+    def test_missing_dist_reduce_fx_and_unused_state_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from metrics_tpu.metric import Metric
+
+            class Broken(Metric):
+                def __init__(self):
+                    super().__init__()
+                    self.add_state("total", jnp.zeros(()))
+                    self.add_state("orphan", jnp.zeros(()), "sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+
+                def compute(self):
+                    return self.total
+        """, rel="pkg/mod.py", rules=["JL003"])
+        messages = [v.message for v in res.violations]
+        assert any("without an explicit dist_reduce_fx" in m for m in messages)
+        assert any("`orphan` is never read or written" in m for m in messages)
+
+    def test_host_op_in_jit_eligible_update_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from metrics_tpu.metric import Metric
+
+            class Hosty(Metric):
+                def __init__(self):
+                    super().__init__()
+                    self.add_state("total", jnp.zeros(()), "sum")
+
+                def update(self, x):
+                    import numpy as np
+                    self.total = self.total + jnp.asarray(np.asarray(x).sum())
+
+                def compute(self):
+                    return self.total
+        """, rel="pkg/mod.py", rules=["JL003"])
+        assert any("host-side op in `update`" in v.message for v in res.violations)
+
+    def test_jit_ineligible_marker_permits_host_ops(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from metrics_tpu.metric import Metric
+
+            class HostyButHonest(Metric):
+                __jit_ineligible__ = True
+
+                def __init__(self):
+                    super().__init__()
+                    self.add_state("total", jnp.zeros(()), "sum")
+
+                def update(self, x):
+                    import numpy as np
+                    self.total = self.total + jnp.asarray(np.asarray(x).sum())
+
+                def compute(self):
+                    return self.total
+        """, rel="pkg/mod.py", rules=["JL003"])
+        assert codes(res) == []
+
+    def test_states_used_via_helper_and_fstring_are_clean(self, tmp_path):
+        # FrechetInceptionDistance-style dynamic state access
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from metrics_tpu.metric import Metric
+
+            class Dynamic(Metric):
+                def __init__(self):
+                    super().__init__()
+                    self.add_state("real_sum", jnp.zeros(()), "sum")
+                    self.add_state("fake_sum", jnp.zeros(()), "sum")
+
+                def update(self, x, real):
+                    self._accumulate(x, "real" if real else "fake")
+
+                def _accumulate(self, x, key):
+                    self._state[f"{key}_sum"] = self._state[f"{key}_sum"] + x.sum()
+
+                def compute(self):
+                    return self._state["real_sum"] - self._state["fake_sum"]
+        """, rel="pkg/mod.py", rules=["JL003"])
+        assert codes(res) == []
+
+
+# =========================================================================== JL004
+class TestJL004DtypePromotion:
+    def test_np_call_on_traced_array_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import numpy as np
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                return np.log(x)
+        """, rules=["JL004"])
+        assert codes(res) == ["JL004"]
+
+    def test_explicit_float64_dtype_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                return jnp.asarray(x, dtype=jnp.float64)
+        """, rules=["JL004"])
+        assert codes(res) == ["JL004"]
+
+    def test_np_on_static_config_is_clean(self, tmp_path):
+        # constant-table precompute at trace time is the sanctioned np use
+        res = run_lint(tmp_path, """
+            import numpy as np
+            import jax.numpy as jnp
+            from jax import Array
+
+            def kernel(x: Array, n_bins: int = 8) -> Array:
+                edges = jnp.asarray(np.linspace(0.0, 1.0, n_bins))
+                return x[None, :] >= edges[:, None]
+        """, rules=["JL004"])
+        assert codes(res) == []
+
+    def test_file_pragma_suppresses_whole_module(self, tmp_path):
+        res = run_lint(tmp_path, """
+            # host float64 module by design
+            # jitlint: disable-file=JL004
+            import numpy as np
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                return np.log(np.asarray(x, dtype=np.float64))
+        """, rules=["JL004"])
+        assert codes(res) == []
+        assert res.suppressed >= 1
+
+
+# =========================================================================== JL005
+class TestJL005SideEffects:
+    def test_print_and_block_until_ready_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                print(x)
+                x.block_until_ready()
+                return x
+        """, rules=["JL005"])
+        messages = [v.message for v in res.violations]
+        assert any("`print`" in m for m in messages)
+        assert any("block_until_ready" in m for m in messages)
+
+    def test_debug_print_and_pure_callback_are_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                jax.debug.print("x = {}", x)
+                return jax.pure_callback(lambda a: a, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        """, rules=["JL005"])
+        assert codes(res) == []
+
+
+# =========================================================================== JL006
+class TestJL006Namespace:
+    def test_unbound_all_entry_and_missing_export_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from metrics_tpu.utils.compute import auc, interp
+
+            __all__ = ["auc", "ghost"]
+        """, rel="pkg/functional/sub/__init__.py", rules=["JL006"])
+        messages = [v.message for v in res.violations]
+        assert any("`ghost` listed in __all__ but never bound" in m for m in messages)
+        assert any("public import `interp` missing from __all__" in m for m in messages)
+
+    def test_functional_init_without_all_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from metrics_tpu.utils.compute import auc
+        """, rel="pkg/functional/sub/__init__.py", rules=["JL006"])
+        assert any("no literal __all__" in v.message for v in res.violations)
+
+    def test_consistent_init_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from metrics_tpu.utils.compute import auc, interp
+
+            __all__ = ["auc", "interp"]
+        """, rel="pkg/functional/sub/__init__.py", rules=["JL006"])
+        assert codes(res) == []
+
+    def test_non_functional_init_not_held_to_all_contract(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from metrics_tpu.utils.compute import auc
+        """, rel="pkg/helpers/__init__.py", rules=["JL006"])
+        assert codes(res) == []
+
+
+# =========================================================================== suppression + baseline machinery
+class TestSuppressionsAndBaseline:
+    def test_inline_disable_suppresses_only_named_rule(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            from jax import Array
+
+            def kernel(x: Array) -> Array:
+                if jnp.sum(x) > 0:  # jitlint: disable=JL001
+                    return x
+                return -x
+        """, rules=["JL001"])
+        assert codes(res) == []
+        assert res.suppressed == 1
+
+    def test_suppressions_parse_multiple_rules(self):
+        sup = Suppressions("x = 1  # jitlint: disable=JL001, JL004\n")
+        assert sup.is_suppressed(1, "JL001")
+        assert sup.is_suppressed(1, "JL004")
+        assert not sup.is_suppressed(1, "JL002")
+
+    def test_baseline_diff_budget_and_staleness(self):
+        v = lambda ctx: Violation(  # noqa: E731
+            path="pkg/mod.py", line=1, col=0, rule="JL001", message="m", context=ctx
+        )
+        violations = [v("a"), v("a"), v("b")]
+        baseline = {"pkg/mod.py::JL001::a": 1, "pkg/mod.py::JL001::gone": 2}
+        new, baselined, stale = diff_against_baseline(violations, baseline)
+        assert baselined == 1
+        assert [x.context for x in new] == ["a", "b"]
+        assert stale == ["pkg/mod.py::JL001::gone"]
+
+
+def test_rules_registry_is_complete():
+    from metrics_tpu.analysis import ALL_RULES, RULE_CODES
+
+    assert set(ALL_RULES) == set(RULE_CODES)
+    assert len(ALL_RULES) >= 6
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

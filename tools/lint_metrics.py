@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""jitlint CLI — tracer-safety & recompilation static analysis over metrics_tpu.
+
+Usage:
+    python tools/lint_metrics.py [targets...] [--rules JL001,JL004] [--update-baseline]
+
+Thin wrapper over :mod:`metrics_tpu.analysis.cli` so the tool works from a
+checkout without installing the package (the ``jitlint`` console script is the
+installed-form equivalent).
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from metrics_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] if "--root" in sys.argv else ["--root", _REPO_ROOT, *sys.argv[1:]]))
