@@ -46,6 +46,11 @@ class BaseAggregator(Metric):
         state_name: str = "value",
         **kwargs: Any,
     ) -> None:
+        # builtin string reductions carry known algebra; a custom callable must
+        # declare its own merge_associative (DL001)
+        merge_associative = kwargs.pop("merge_associative", None)
+        if merge_associative is None and isinstance(fn, str):
+            merge_associative = fn in ("sum", "mean", "min", "max")
         super().__init__(**kwargs)
         allowed_nan_strategy = ("error", "warn", "ignore", "disable")
         if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
@@ -56,7 +61,7 @@ class BaseAggregator(Metric):
         if nan_strategy in ("error", "warn"):
             self._jit_update_opt = False  # value inspection needs the host
         self.state_name = state_name
-        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn, merge_associative=merge_associative)
 
     @property
     def value(self) -> Any:

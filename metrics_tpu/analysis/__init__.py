@@ -1,20 +1,31 @@
-"""jitlint — tracer-safety & recompilation static analysis for metrics_tpu.
+"""Static & dynamic analysis for metrics_tpu: jitlint + distlint.
 
-Two complementary passes guard the §7 invariant that every metric ``update`` is
-one trace-stable XLA executable:
+Four complementary passes guard the invariants the runtime cannot check:
 
-* the **AST pass** (:mod:`metrics_tpu.analysis.rules`, rules JL001–JL006) flags
-  tracer concretization, recompilation keys, state-contract breaches, dtype
-  promotion, side effects and namespace drift — heuristically, before any code
-  runs. CLI: ``python tools/lint_metrics.py`` / the ``jitlint`` console script.
+* **jitlint AST pass** (:mod:`metrics_tpu.analysis.rules`, rules JL001–JL006)
+  flags tracer concretization, recompilation keys, state-contract breaches,
+  dtype promotion, side effects and namespace drift — heuristically, before
+  any code runs.
+* **distlint AST pass** (:mod:`metrics_tpu.analysis.dist_rules`, rules
+  DL001–DL005) flags merge-soundness hazards in distributed state: undeclared
+  reduction algebra, non-additive read-modify-writes in ``update``,
+  merge-fragile ``compute`` bodies, raw collectives outside the sync layer,
+  and ``merge_state`` overrides that drop states (DESIGN §10).
 * the **abstract-interpretation pass**
-  (:mod:`metrics_tpu.analysis.abstract_contracts`) actually traces every
-  registered functional kernel with ``jax.eval_shape`` over canonical abstract
-  inputs — zero FLOPs, but a genuine trace, so it catches what the AST pass can
-  only guess at.
+  (:mod:`metrics_tpu.analysis.abstract_contracts`) traces every registered
+  functional kernel with ``jax.eval_shape`` over canonical abstract inputs.
+* the **merge-equivalence harness**
+  (:mod:`metrics_tpu.analysis.merge_contracts`) property-tests
+  split-update-merge vs single-pass compute and shard-permutation invariance
+  for every exported Metric class, classifying each as MERGE_SOUND /
+  MERGE_UNSOUND / CAT_ORDER_SENSITIVE against a checked-in baseline.
+
+CLI: ``python tools/lint_metrics.py [--pass jitlint|distlint | --all]`` or the
+``jitlint`` / ``distlint`` console scripts.
 """
 
-from metrics_tpu.analysis.contexts import RULE_CODES, Suppressions, Violation
+from metrics_tpu.analysis.contexts import DIST_RULE_CODES, RULE_CODES, Suppressions, Violation
+from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.engine import (
     LintResult,
     diff_against_baseline,
@@ -27,6 +38,8 @@ from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 
 __all__ = [
     "ALL_RULES",
+    "DIST_RULES",
+    "DIST_RULE_CODES",
     "LintResult",
     "ModuleInfo",
     "RULE_CODES",

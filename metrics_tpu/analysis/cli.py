@@ -1,6 +1,15 @@
-"""jitlint command line: ``python tools/lint_metrics.py`` / the ``jitlint`` script.
+"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint``.
 
-Exit codes: 0 clean (or fully baselined), 1 new violations, 2 usage/parse error.
+Two passes share one engine and one exit-code contract:
+
+* ``jitlint``  — tracer-safety & recompilation rules JL001–JL006, baselined in
+  ``tools/jitlint_baseline.json``
+* ``distlint`` — merge-soundness & collective-safety rules DL001–DL005,
+  baselined in ``tools/distlint_baseline.json``
+
+Select with ``--pass jitlint|distlint`` or run both with ``--all`` (the CI
+shape: one invocation, one verdict). Exit codes: 0 clean (or fully baselined),
+1 new violations in *any* selected pass, 2 usage/parse error.
 """
 
 from __future__ import annotations
@@ -9,8 +18,9 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
+from metrics_tpu.analysis.contexts import DIST_RULE_CODES, RULE_CODES
 from metrics_tpu.analysis.engine import (
     diff_against_baseline,
     lint_paths,
@@ -18,29 +28,64 @@ from metrics_tpu.analysis.engine import (
     write_baseline,
 )
 
-__all__ = ["main"]
+__all__ = ["main", "main_distlint"]
 
-_DEFAULT_BASELINE = os.path.join("tools", "jitlint_baseline.json")
+_PASSES: Dict[str, Dict[str, object]] = {
+    "jitlint": {
+        "rules": RULE_CODES,
+        "baseline": os.path.join("tools", "jitlint_baseline.json"),
+    },
+    "distlint": {
+        "rules": DIST_RULE_CODES,
+        "baseline": os.path.join("tools", "distlint_baseline.json"),
+    },
+}
 
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="jitlint",
-        description="Tracer-safety & recompilation static analysis for metrics_tpu (rules JL001-JL006).",
+        description="Static analysis for metrics_tpu: jitlint (JL001-JL006, tracer safety) "
+                    "and distlint (DL001-DL005, distributed merge soundness).",
     )
     p.add_argument("targets", nargs="*", default=["metrics_tpu"],
                    help="files or directories to lint (default: metrics_tpu)")
     p.add_argument("--root", default=None, help="repo root for relative paths (default: cwd)")
+    p.add_argument("--pass", dest="passes", action="append", choices=sorted(_PASSES),
+                   help="which pass to run (repeatable; default: jitlint)")
+    p.add_argument("--all", action="store_true", dest="run_all",
+                   help="run every pass (jitlint + distlint) in one invocation")
     p.add_argument("--rules", default=None,
-                   help="comma-separated rule codes to run (default: all, e.g. JL001,JL004)")
+                   help="comma-separated rule codes to run (overrides --pass selection, "
+                        "e.g. JL001,DL004; baseline follows each code's own pass)")
     p.add_argument("--baseline", default=None,
-                   help=f"baseline JSON path (default: <root>/{_DEFAULT_BASELINE})")
-    p.add_argument("--no-baseline", action="store_true", help="ignore the baseline entirely")
+                   help="baseline JSON path override (only with a single selected pass)")
+    p.add_argument("--no-baseline", action="store_true", help="ignore baselines entirely")
     p.add_argument("--update-baseline", action="store_true",
-                   help="write current violations as the new baseline and exit 0")
+                   help="write current violations as the new baseline(s) and exit 0")
     p.add_argument("--format", choices=("text", "json"), default="text", dest="fmt")
     p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
     return p
+
+
+def _selected_passes(args: argparse.Namespace) -> List[str]:
+    if args.run_all:
+        return sorted(_PASSES)  # deterministic: distlint, jitlint
+    if args.passes:
+        # de-dup, preserve order
+        seen: List[str] = []
+        for name in args.passes:
+            if name not in seen:
+                seen.append(name)
+        return seen
+    return ["jitlint"]
+
+
+def _pass_rules(name: str, explicit: Optional[List[str]]) -> List[str]:
+    codes = list(_PASSES[name]["rules"])  # type: ignore[arg-type]
+    if explicit is None:
+        return codes
+    return [c for c in explicit if c in codes]
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -49,51 +94,79 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     targets = [t if os.path.isabs(t) else os.path.join(root, t) for t in args.targets]
     missing = [t for t in targets if not os.path.exists(t)]
     if missing:
-        print(f"jitlint: no such file or directory: {', '.join(missing)}", file=sys.stderr)
+        print(f"lint: no such file or directory: {', '.join(missing)}", file=sys.stderr)
         return 2
 
-    rules: Optional[List[str]] = None
+    explicit_rules: Optional[List[str]] = None
     if args.rules:
-        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        explicit_rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
 
-    result = lint_paths(targets, root=root, rules=rules)
-    if result.parse_errors:
-        for err in result.parse_errors:
-            print(f"jitlint: parse error: {err}", file=sys.stderr)
+    passes = _selected_passes(args)
+    if explicit_rules is not None and not args.passes and not args.run_all:
+        # --rules alone: infer the passes the codes belong to
+        passes = [name for name in sorted(_PASSES) if _pass_rules(name, explicit_rules)]
+        if not passes:
+            print(f"lint: no known rule codes in --rules={args.rules}", file=sys.stderr)
+            return 2
+    if args.baseline and len(passes) > 1:
+        print("lint: --baseline requires a single selected pass", file=sys.stderr)
         return 2
 
-    baseline_path = args.baseline or os.path.join(root, _DEFAULT_BASELINE)
-    if args.update_baseline:
-        entries = write_baseline(baseline_path, result.violations)
-        if not args.quiet:
-            print(f"jitlint: baseline written to {baseline_path} "
-                  f"({len(entries)} keys, {sum(entries.values())} violations)")
-        return 0
+    exit_code = 0
+    report: Dict[str, object] = {}
+    for name in passes:
+        rules = _pass_rules(name, explicit_rules)
+        if not rules:
+            continue
+        result = lint_paths(targets, root=root, rules=rules)
+        if result.parse_errors:
+            for err in result.parse_errors:
+                print(f"{name}: parse error: {err}", file=sys.stderr)
+            return 2
 
-    baseline = {} if args.no_baseline else load_baseline(baseline_path)
-    new, baselined, stale = diff_against_baseline(result.violations, baseline)
+        baseline_path = args.baseline or os.path.join(root, str(_PASSES[name]["baseline"]))
+        if args.update_baseline:
+            entries = write_baseline(baseline_path, result.violations)
+            if not args.quiet:
+                print(f"{name}: baseline written to {baseline_path} "
+                      f"({len(entries)} keys, {sum(entries.values())} violations)")
+            continue
 
-    if args.fmt == "json":
-        print(json.dumps({
-            "files_scanned": result.files_scanned,
-            "new": [v.__dict__ for v in new],
-            "baselined": baselined,
-            "inline_suppressed": result.suppressed,
-            "stale_baseline_keys": stale,
-        }, indent=2))
-    else:
-        for v in new:
-            print(v.render())
-        for key in stale:
-            print(f"jitlint: stale baseline entry (no longer matches): {key}")
-        if not args.quiet:
-            by_rule = {}
+        baseline = {} if args.no_baseline else load_baseline(baseline_path)
+        new, baselined, stale = diff_against_baseline(result.violations, baseline)
+
+        if args.fmt == "json":
+            report[name] = {
+                "files_scanned": result.files_scanned,
+                "new": [v.__dict__ for v in new],
+                "baselined": baselined,
+                "inline_suppressed": result.suppressed,
+                "stale_baseline_keys": stale,
+            }
+        else:
             for v in new:
-                by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
-            detail = ", ".join(f"{k}={v}" for k, v in sorted(by_rule.items())) or "none"
-            print(f"jitlint: {result.files_scanned} files, {len(new)} new violation(s) [{detail}], "
-                  f"{baselined} baselined, {result.suppressed} inline-suppressed")
-    return 1 if new else 0
+                print(v.render())
+            for key in stale:
+                print(f"{name}: stale baseline entry (no longer matches): {key}")
+            if not args.quiet:
+                by_rule: Dict[str, int] = {}
+                for v in new:
+                    by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+                detail = ", ".join(f"{k}={v}" for k, v in sorted(by_rule.items())) or "none"
+                print(f"{name}: {result.files_scanned} files, {len(new)} new violation(s) [{detail}], "
+                      f"{baselined} baselined, {result.suppressed} inline-suppressed")
+        if new:
+            exit_code = 1
+
+    if args.fmt == "json" and not args.update_baseline:
+        print(json.dumps(report if len(report) != 1 else next(iter(report.values())), indent=2))
+    return exit_code
+
+
+def main_distlint(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for the ``distlint`` console script — DL rules only."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(["--pass", "distlint", *argv])
 
 
 if __name__ == "__main__":  # pragma: no cover

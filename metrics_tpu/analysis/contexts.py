@@ -35,12 +35,16 @@ __all__ = [
     "find_traced_contexts",
     "ArrayTaint",
     "RULE_CODES",
+    "DIST_RULE_CODES",
 ]
 
 RULE_CODES = ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006")
+DIST_RULE_CODES = ("DL001", "DL002", "DL003", "DL004", "DL005")
 
-_SUPPRESS_RE = re.compile(r"#\s*jitlint:\s*disable=([A-Za-z0-9_,\s]+)")
-_SUPPRESS_FILE_RE = re.compile(r"#\s*jitlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+# `# jitlint: disable=JL001` and `# distlint: disable=DL002` share one grammar;
+# either prefix may carry codes from either pass (codes are globally unique).
+_SUPPRESS_RE = re.compile(r"#\s*(?:jitlint|distlint):\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*(?:jitlint|distlint):\s*disable-file=([A-Za-z0-9_,\s]+)")
 
 
 @dataclass(frozen=True)

@@ -18,9 +18,14 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from metrics_tpu.analysis.contexts import RULE_CODES, Suppressions, Violation
+from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 
 __all__ = ["LintResult", "lint_file", "lint_paths", "load_baseline", "write_baseline", "diff_against_baseline"]
+
+# one registry across both passes; rule codes are globally unique so a
+# ``--rules JL001,DL004`` mix selects freely across them
+_REGISTRY = {**ALL_RULES, **DIST_RULES}
 
 # directories whose members are traced-context-by-default kernels
 _FUNCTIONAL_ROOTS = ("metrics_tpu/functional", "metrics_tpu/ops")
@@ -69,7 +74,7 @@ def lint_file(path: str, root: Optional[str] = None, rules: Optional[Sequence[st
     suppress = Suppressions(source)
     selected = rules or RULE_CODES
     for code in selected:
-        rule = ALL_RULES.get(code.upper())
+        rule = _REGISTRY.get(code.upper())
         if rule is None:
             continue
         for violation in rule(mod):
@@ -117,11 +122,22 @@ def load_baseline(path: str) -> Dict[str, int]:
 
 def write_baseline(path: str, violations: Sequence[Violation]) -> Dict[str, int]:
     entries = dict(sorted(Counter(v.key() for v in violations).items()))
-    payload = {
-        "comment": "jitlint baseline — intentional host-side exceptions, keyed path::rule::context. "
+    payload: Dict[str, object] = {
+        "comment": "lint baseline — intentional exceptions, keyed path::rule::context. "
                    "Regenerate with `python tools/lint_metrics.py --update-baseline`.",
         "entries": entries,
     }
+    # preserve sibling sections (e.g. distlint's "merge" classifications, owned
+    # by analysis/merge_contracts.py) when refreshing the static entries
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                existing = json.load(fh)
+            for k, v in existing.items():
+                if k not in ("comment", "entries"):
+                    payload[k] = v
+        except (OSError, ValueError):
+            pass
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=2, sort_keys=True)
         fh.write("\n")

@@ -1,0 +1,439 @@
+"""Dynamic merge-equivalence contracts: property-test split-update-merge per class.
+
+The static DL rules are heuristic; this module is the ground truth for the
+distributed story (DESIGN §10). For every exported :class:`~metrics_tpu.Metric`
+in :data:`MERGE_CASES` it runs the MapReduce algebra check that DrJAX (arxiv
+2403.07128) identifies as the correctness condition for sharded aggregation:
+
+1. **single-pass reference** — one metric consumes all batches in order;
+2. **split-update-merge** — the batches are split across 3 virtual shards with
+   *unequal* batch counts, each shard updates its own replica, and the partial
+   states fold back through ``merge_state`` (falling back to the functional
+   ``_merge_state_dicts`` fold for ``full_state_update`` classes that refuse
+   the OO path);
+3. **shard permutation** — the same fold in a permuted shard order.
+
+Each class is then classified:
+
+==================== =======================================================
+MERGE_SOUND          both folds reproduce the single-pass compute
+CAT_ORDER_SENSITIVE  the in-order fold matches but a permuted shard order
+                     does not — concat-ordered state leaks into the result
+MERGE_UNSOUND        even the in-order fold diverges (or merging errors)
+==================== =======================================================
+
+Classifications are ratcheted against the ``"merge"`` section of
+``tools/distlint_baseline.json``: a class may only *improve* (e.g. a baselined
+CAT_ORDER_SENSITIVE that becomes MERGE_SOUND is reported stale); any class
+observed worse than its baseline fails the run.
+
+Run via ``tests/test_merge_contracts.py`` or directly::
+
+    python -m metrics_tpu.analysis.merge_contracts [--update-baseline]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "CLASSIFICATIONS",
+    "MERGE_CASES",
+    "MergeCase",
+    "MergeResult",
+    "check_merge_case",
+    "run_merge_contracts",
+    "load_merge_baseline",
+    "write_merge_baseline",
+    "diff_merge_baseline",
+]
+
+CLASSIFICATIONS = ("MERGE_SOUND", "CAT_ORDER_SENSITIVE", "MERGE_UNSOUND")
+_SEVERITY = {name: i for i, name in enumerate(CLASSIFICATIONS)}
+
+# 4 batches over 3 shards with UNEQUAL counts, plus one non-trivial shard
+# permutation — the minimal layout that distinguishes all three classes
+_N_BATCHES = 4
+_SHARD_SPLITS: Tuple[Tuple[int, ...], ...] = ((0, 1), (2,), (3,))
+_PERMUTED_ORDER: Tuple[int, ...] = (1, 2, 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeCase:
+    """One exported Metric class plus a deterministic synthetic batch source."""
+
+    name: str  # exported class name — the baseline key
+    ctor: Callable[[], Any]
+    batch: Callable[[np.random.RandomState], Tuple[Any, ...]]
+    n_batches: int = _N_BATCHES
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeResult:
+    case: MergeCase
+    classification: str  # one of CLASSIFICATIONS
+    detail: str = ""
+
+
+def _batch_rng(case: MergeCase, i: int) -> np.random.RandomState:
+    # deterministic per (case, batch): same data every run, varied across batches
+    return np.random.RandomState(zlib.crc32(f"{case.name}:{i}".encode()) % (2**31))
+
+
+def _batches(case: MergeCase) -> List[Tuple[Any, ...]]:
+    return [case.batch(_batch_rng(case, i)) for i in range(case.n_batches)]
+
+
+def _trees_match(a: Any, b: Any, rtol: float = 2e-3, atol: float = 1e-5) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        try:
+            xa = np.asarray(jax.device_get(x), dtype=np.float64)
+            ya = np.asarray(jax.device_get(y), dtype=np.float64)
+        except (TypeError, ValueError):
+            if x != y:  # non-numeric leaves compare exactly
+                return False
+            continue
+        if xa.shape != ya.shape:
+            return False
+        if not np.allclose(xa, ya, rtol=rtol, atol=atol, equal_nan=True):
+            return False
+    return True
+
+
+def _fold_shards(case: MergeCase, shard_batches: Sequence[Sequence[Tuple[Any, ...]]]) -> Any:
+    """Update one replica per shard, fold the partials, return the fold's compute.
+
+    The OO fold starts from the LAST shard and merges earlier shards as
+    ``incoming`` — ``merge_state`` is incoming-first, so this reproduces the
+    shard order of ``shard_batches`` exactly. ``full_state_update`` classes
+    refuse the OO path; they fall back to the functional
+    ``_merge_state_dicts`` fold with explicit per-shard update counts.
+    """
+    replicas = []
+    for batches in shard_batches:
+        m = case.ctor()
+        for args in batches:
+            m.update(*args)
+        replicas.append(m)
+    try:
+        acc = replicas[-1]
+        for m in reversed(replicas[:-1]):
+            acc.merge_state(m)
+        return acc.compute()
+    except RuntimeError as exc:
+        if "merge_state" not in str(exc):
+            raise
+    # functional fallback: fold earlier-first so ordering matches the OO path
+    template = replicas[0]
+    state, count = template.metric_state, template._update_count
+    for m in replicas[1:]:
+        state = template._merge_state_dicts(state, m.metric_state, count, m._update_count)
+        count += m._update_count
+    holder = case.ctor()
+    holder.__dict__["_state"] = dict(state)
+    holder._update_count = count
+    return holder.compute()
+
+
+def check_merge_case(case: MergeCase) -> MergeResult:
+    """Classify one class by split-update-merge vs single-pass equivalence."""
+    try:
+        batches = _batches(case)
+        ref = case.ctor()
+        for args in batches:
+            ref.update(*args)
+        ref_out = ref.compute()
+    except Exception as exc:  # noqa: BLE001 — a broken reference is a harness bug
+        return MergeResult(case, "MERGE_UNSOUND", f"reference pass failed: {type(exc).__name__}: {exc}")
+
+    shards = [[batches[i] for i in split] for split in _SHARD_SPLITS]
+    try:
+        in_order = _fold_shards(case, shards)
+    except Exception as exc:  # noqa: BLE001 — the error text IS the classification detail
+        return MergeResult(case, "MERGE_UNSOUND", f"merge failed: {type(exc).__name__}: {exc}")
+    if not _trees_match(ref_out, in_order):
+        return MergeResult(
+            case, "MERGE_UNSOUND",
+            "in-order split-update-merge diverges from single-pass compute",
+        )
+
+    try:
+        permuted = _fold_shards(case, [shards[i] for i in _PERMUTED_ORDER])
+    except Exception as exc:  # noqa: BLE001
+        return MergeResult(case, "MERGE_UNSOUND", f"permuted merge failed: {type(exc).__name__}: {exc}")
+    if not _trees_match(ref_out, permuted):
+        return MergeResult(
+            case, "CAT_ORDER_SENSITIVE",
+            "merge matches in shard order but diverges under shard permutation",
+        )
+    return MergeResult(case, "MERGE_SOUND")
+
+
+# --------------------------------------------------------------------------- registry
+def _rand(rng: np.random.RandomState, *shape: int) -> jax.Array:
+    return jnp.asarray(rng.rand(*shape).astype(np.float32))
+
+
+def _randint(rng: np.random.RandomState, hi: int, *shape: int) -> jax.Array:
+    return jnp.asarray(rng.randint(0, hi, shape))
+
+
+def _probs(rng: np.random.RandomState, *shape: int) -> jax.Array:
+    p = rng.rand(*shape).astype(np.float32) + 0.05
+    return jnp.asarray(p / p.sum(-1, keepdims=True))
+
+
+def _panoptic(rng: np.random.RandomState) -> jax.Array:
+    cats = rng.choice([0, 1, 6, 7], size=(1, 8, 8))
+    inst = rng.randint(0, 3, (1, 8, 8))
+    return jnp.asarray(np.stack([cats, inst], axis=-1))
+
+
+_WORDS = ("the", "cat", "sat", "on", "a", "mat", "dog", "ran", "fast", "home")
+
+
+def _sentence(rng: np.random.RandomState, n: int = 5) -> str:
+    return " ".join(_WORDS[i] for i in rng.randint(0, len(_WORDS), n))
+
+
+def _make_cases() -> List[MergeCase]:
+    import metrics_tpu as M
+    import metrics_tpu.classification as C
+    import metrics_tpu.clustering as CL
+    import metrics_tpu.segmentation as S
+    import metrics_tpu.text as T
+
+    def case(name, ctor, batch, n_batches=_N_BATCHES):
+        return MergeCase(name=name, ctor=ctor, batch=batch, n_batches=n_batches)
+
+    bin_batch = lambda r: (_rand(r, 10), _randint(r, 2, 10))  # noqa: E731
+    reg_batch = lambda r: (_rand(r, 10), _rand(r, 10))  # noqa: E731
+    mc_batch = lambda r: (_rand(r, 10, 3), _randint(r, 3, 10))  # noqa: E731
+    ml_batch = lambda r: (_rand(r, 10, 3), _randint(r, 2, 10, 3))  # noqa: E731
+    img_batch = lambda r: (_rand(r, 2, 3, 16, 16), _rand(r, 2, 3, 16, 16))  # noqa: E731
+    lab_batch = lambda r: (_randint(r, 3, 12), _randint(r, 3, 12))  # noqa: E731
+    seg_batch = lambda r: (_randint(r, 3, 2, 8, 8), _randint(r, 3, 2, 8, 8))  # noqa: E731
+
+    return [
+        # ---- classification ----------------------------------------------------
+        case("BinaryAccuracy", C.BinaryAccuracy, bin_batch),
+        case("BinaryPrecision", C.BinaryPrecision, bin_batch),
+        case("BinaryRecall", C.BinaryRecall, bin_batch),
+        case("BinaryF1Score", C.BinaryF1Score, bin_batch),
+        case("BinarySpecificity", C.BinarySpecificity, bin_batch),
+        case("BinaryStatScores", C.BinaryStatScores, bin_batch),
+        case("BinaryHammingDistance", C.BinaryHammingDistance, bin_batch),
+        case("BinaryCohenKappa", C.BinaryCohenKappa, bin_batch),
+        case("BinaryMatthewsCorrCoef", C.BinaryMatthewsCorrCoef, bin_batch),
+        case("BinaryJaccardIndex", C.BinaryJaccardIndex, bin_batch),
+        case("BinaryHingeLoss", C.BinaryHingeLoss, bin_batch),
+        case("BinaryCalibrationError", C.BinaryCalibrationError, bin_batch),
+        case("BinaryAUROC", C.BinaryAUROC, bin_batch),
+        case("MulticlassAccuracy", lambda: C.MulticlassAccuracy(num_classes=3), mc_batch),
+        case("MulticlassConfusionMatrix", lambda: C.MulticlassConfusionMatrix(num_classes=3), mc_batch),
+        case("MulticlassAveragePrecision", lambda: C.MulticlassAveragePrecision(num_classes=3), mc_batch),
+        case("MulticlassExactMatch", lambda: C.MulticlassExactMatch(num_classes=3),
+             lambda r: (_randint(r, 3, 4, 5), _randint(r, 3, 4, 5))),
+        case("MultilabelFBetaScore", lambda: C.MultilabelFBetaScore(beta=2.0, num_labels=3), ml_batch),
+        case("MultilabelRankingLoss", lambda: C.MultilabelRankingLoss(num_labels=3),
+             lambda r: (_rand(r, 8, 3), _randint(r, 2, 8, 3))),
+        # ---- regression --------------------------------------------------------
+        case("MeanSquaredError", M.MeanSquaredError, reg_batch),
+        case("MeanAbsoluteError", M.MeanAbsoluteError, reg_batch),
+        case("MeanSquaredLogError", M.MeanSquaredLogError, reg_batch),
+        case("ExplainedVariance", M.ExplainedVariance, reg_batch),
+        case("R2Score", M.R2Score, reg_batch),
+        case("PearsonCorrCoef", M.PearsonCorrCoef, reg_batch),
+        case("SpearmanCorrCoef", M.SpearmanCorrCoef, reg_batch),
+        case("KendallRankCorrCoef", M.KendallRankCorrCoef, reg_batch),
+        case("ConcordanceCorrCoef", M.ConcordanceCorrCoef, reg_batch),
+        case("MinkowskiDistance", lambda: M.MinkowskiDistance(p=3), reg_batch),
+        case("LogCoshError", M.LogCoshError, reg_batch),
+        case("SymmetricMeanAbsolutePercentageError", M.SymmetricMeanAbsolutePercentageError,
+             lambda r: (_rand(r, 10) + 0.5, _rand(r, 10) + 0.5)),
+        case("CosineSimilarity", M.CosineSimilarity, lambda r: (_rand(r, 6, 4), _rand(r, 6, 4))),
+        case("KLDivergence", M.KLDivergence, lambda r: (_probs(r, 6, 4), _probs(r, 6, 4))),
+        # ---- aggregation -------------------------------------------------------
+        case("MeanMetric", M.MeanMetric, lambda r: (_rand(r, 10),)),
+        case("SumMetric", M.SumMetric, lambda r: (_rand(r, 10),)),
+        case("MaxMetric", M.MaxMetric, lambda r: (_rand(r, 10),)),
+        case("MinMetric", M.MinMetric, lambda r: (_rand(r, 10),)),
+        case("CatMetric", M.CatMetric, lambda r: (_rand(r, 10),)),
+        case("RunningMean", lambda: M.RunningMean(window=3), lambda r: (_rand(r, 10),)),
+        # ---- text --------------------------------------------------------------
+        case("CharErrorRate", M.CharErrorRate, lambda r: ([_sentence(r)], [_sentence(r)])),
+        case("WordErrorRate", M.WordErrorRate, lambda r: ([_sentence(r)], [_sentence(r)])),
+        case("BLEUScore", M.BLEUScore, lambda r: ([_sentence(r)], [[_sentence(r, 7)]])),
+        case("ROUGEScore", T.ROUGEScore, lambda r: (_sentence(r), _sentence(r))),
+        # ---- image -------------------------------------------------------------
+        case("PeakSignalNoiseRatio", M.PeakSignalNoiseRatio, img_batch),
+        case("StructuralSimilarityIndexMeasure", M.StructuralSimilarityIndexMeasure, img_batch),
+        case("UniversalImageQualityIndex", M.UniversalImageQualityIndex, img_batch),
+        case("TotalVariation", M.TotalVariation, lambda r: (_rand(r, 2, 3, 8, 8),)),
+        # ---- audio -------------------------------------------------------------
+        case("SignalNoiseRatio", M.SignalNoiseRatio, lambda r: (_rand(r, 16), _rand(r, 16))),
+        case("ScaleInvariantSignalDistortionRatio", M.ScaleInvariantSignalDistortionRatio,
+             lambda r: (_rand(r, 2, 16), _rand(r, 2, 16))),
+        # ---- clustering / nominal ---------------------------------------------
+        case("AdjustedRandScore", CL.AdjustedRandScore, lab_batch),
+        case("NormalizedMutualInfoScore", CL.NormalizedMutualInfoScore, lab_batch),
+        case("CramersV", lambda: M.CramersV(num_classes=3), lambda r: (_randint(r, 3, 20), _randint(r, 3, 20))),
+        case("TschuprowsT", lambda: M.TschuprowsT(num_classes=3), lambda r: (_randint(r, 3, 20), _randint(r, 3, 20))),
+        case("TheilsU", lambda: M.TheilsU(num_classes=3), lambda r: (_randint(r, 3, 25), _randint(r, 3, 25))),
+        # ---- segmentation / panoptic -------------------------------------------
+        case("MeanIoU", lambda: S.MeanIoU(num_classes=3, input_format="index"), seg_batch),
+        case("GeneralizedDiceScore", lambda: S.GeneralizedDiceScore(num_classes=3, input_format="index"), seg_batch),
+        case("PanopticQuality", lambda: M.PanopticQuality(things={0, 1}, stuffs={6, 7}),
+             lambda r: (_panoptic(r), _panoptic(r))),
+        # ---- wrappers ----------------------------------------------------------
+        case("MinMaxMetric", lambda: M.MinMaxMetric(C.BinaryAccuracy()), bin_batch),
+        case("BootStrapper", lambda: M.BootStrapper(M.MeanSquaredError(), num_bootstraps=4), reg_batch),
+        case("ClasswiseWrapper", lambda: M.ClasswiseWrapper(C.MulticlassAccuracy(num_classes=3, average=None)),
+             mc_batch),
+        case("MultioutputWrapper", lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2),
+             lambda r: (_rand(r, 10, 2), _rand(r, 10, 2))),
+    ]
+
+
+_CASES_CACHE: Optional[List[MergeCase]] = None
+
+
+def _cases() -> List[MergeCase]:
+    global _CASES_CACHE
+    if _CASES_CACHE is None:
+        _CASES_CACHE = _make_cases()
+    return _CASES_CACHE
+
+
+# module-level alias resolved lazily — importing this module stays cheap
+class _LazyCases:
+    def __iter__(self):
+        return iter(_cases())
+
+    def __len__(self):
+        return len(_cases())
+
+    def __getitem__(self, i):
+        return _cases()[i]
+
+
+MERGE_CASES = _LazyCases()
+
+
+def run_merge_contracts(cases: Optional[Sequence[MergeCase]] = None) -> List[MergeResult]:
+    """Classify every case; returns all results (callers apply the baseline)."""
+    return [check_merge_case(c) for c in (cases if cases is not None else _cases())]
+
+
+# --------------------------------------------------------------------------- baseline
+_DEFAULT_BASELINE = os.path.join("tools", "distlint_baseline.json")
+
+
+def load_merge_baseline(path: str) -> Dict[str, str]:
+    """The ``"merge"`` section of the distlint baseline: class name → classification."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): str(v) for k, v in data.get("merge", {}).items()}
+
+
+def write_merge_baseline(path: str, results: Sequence[MergeResult]) -> Dict[str, str]:
+    """Record every non-SOUND classification; preserves the static ``entries``."""
+    merge = {
+        r.case.name: r.classification
+        for r in sorted(results, key=lambda r: r.case.name)
+        if r.classification != "MERGE_SOUND"
+    }
+    payload: Dict[str, Any] = {
+        "comment": "distlint baseline — static entries keyed path::rule::context, merge-harness "
+                   "classifications keyed by exported class name. Regenerate with "
+                   "`python tools/lint_metrics.py --pass distlint --update-baseline` and "
+                   "`python -m metrics_tpu.analysis.merge_contracts --update-baseline`.",
+        "entries": {},
+        "merge": merge,
+    }
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                existing = json.load(fh)
+            for k, v in existing.items():
+                if k not in ("comment", "merge"):
+                    payload[k] = v
+        except (OSError, ValueError):
+            pass
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return merge
+
+
+def diff_merge_baseline(
+    results: Sequence[MergeResult], baseline: Dict[str, str]
+) -> Tuple[List[MergeResult], List[str]]:
+    """Split into (regressions worse than baseline, stale/improvable baseline keys)."""
+    regressions: List[MergeResult] = []
+    observed: Dict[str, str] = {}
+    for r in results:
+        observed[r.case.name] = r.classification
+        allowed = baseline.get(r.case.name, "MERGE_SOUND")
+        if _SEVERITY[r.classification] > _SEVERITY.get(allowed, 0):
+            regressions.append(r)
+    stale = sorted(
+        name for name, allowed in baseline.items()
+        if name not in observed or _SEVERITY[observed[name]] < _SEVERITY.get(allowed, 0)
+    )
+    return regressions, stale
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="merge-contracts",
+        description="Merge-equivalence harness: split-update-merge vs single-pass per Metric class.",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="distlint baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current classifications into the baseline's `merge` section")
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    baseline_path = args.baseline or os.path.join(root, _DEFAULT_BASELINE)
+
+    results = run_merge_contracts()
+    if args.update_baseline:
+        merge = write_merge_baseline(baseline_path, results)
+        if not args.quiet:
+            print(f"merge-contracts: baseline written to {baseline_path} ({len(merge)} non-sound classes)")
+        return 0
+
+    baseline = load_merge_baseline(baseline_path)
+    regressions, stale = diff_merge_baseline(results, baseline)
+    counts = {c: sum(1 for r in results if r.classification == c) for c in CLASSIFICATIONS}
+    for r in regressions:
+        print(f"REGRESSION {r.case.name}: {r.classification} "
+              f"(baseline {baseline.get(r.case.name, 'MERGE_SOUND')}) — {r.detail}")
+    for name in stale:
+        print(f"merge-contracts: stale baseline entry (class improved or removed): {name}")
+    if not args.quiet:
+        detail = ", ".join(f"{k}={v}" for k, v in counts.items())
+        print(f"merge-contracts: {len(results)} classes [{detail}], "
+              f"{len(regressions)} regression(s), {len(stale)} stale")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

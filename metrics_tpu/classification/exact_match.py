@@ -39,8 +39,11 @@ class _AbstractExactMatch(Metric):
         else:
             default = lambda: jnp.zeros((), dtype=jnp.int32)  # noqa: E731
             dist_reduce_fx = "sum"
-        self.add_state("correct", default(), dist_reduce_fx=dist_reduce_fx)
-        self.add_state("total", default(), dist_reduce_fx=dist_reduce_fx)
+        # "sum" merges associatively+commutatively; "cat" list states concat in
+        # shard order (merge-sound up to ordering — DESIGN §10)
+        assoc = dist_reduce_fx in ("sum", "mean", "min", "max")
+        self.add_state("correct", default(), dist_reduce_fx=dist_reduce_fx, merge_associative=assoc)
+        self.add_state("total", default(), dist_reduce_fx=dist_reduce_fx, merge_associative=assoc)
 
     def _update_state(self, correct: Array, total: Array) -> None:
         if self.multidim_average == "samplewise":

@@ -51,10 +51,13 @@ class _AbstractStatScores(Metric):
         else:
             default = lambda: jnp.zeros(size, dtype=jnp.int32)  # noqa: E731
             dist_reduce_fx = "sum"
-        self.add_state("tp", default(), dist_reduce_fx=dist_reduce_fx)
-        self.add_state("fp", default(), dist_reduce_fx=dist_reduce_fx)
-        self.add_state("tn", default(), dist_reduce_fx=dist_reduce_fx)
-        self.add_state("fn", default(), dist_reduce_fx=dist_reduce_fx)
+        # "sum" merges associatively+commutatively; "cat" list states concat in
+        # shard order (merge-sound up to ordering — DESIGN §10)
+        assoc = dist_reduce_fx in ("sum", "mean", "min", "max")
+        self.add_state("tp", default(), dist_reduce_fx=dist_reduce_fx, merge_associative=assoc)
+        self.add_state("fp", default(), dist_reduce_fx=dist_reduce_fx, merge_associative=assoc)
+        self.add_state("tn", default(), dist_reduce_fx=dist_reduce_fx, merge_associative=assoc)
+        self.add_state("fn", default(), dist_reduce_fx=dist_reduce_fx, merge_associative=assoc)
 
     def _update_state(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
         """Accumulate batch statistics into the states."""

@@ -94,7 +94,7 @@ def _named_for_profiler(fn: Callable, name: str) -> Callable:
 # Instance fields that do not affect how `update` traces: runtime bookkeeping and
 # the sync-orchestration kwargs (those act outside the jitted region).
 _JIT_KEY_EXCLUDE = frozenset({
-    "_defaults", "_state", "_persistent", "_reductions", "_computed", "_update_count",
+    "_defaults", "_state", "_persistent", "_reductions", "_merge_associative", "_computed", "_update_count",
     "_to_sync", "_should_unsync", "_is_synced", "_cache", "_update_signature",
     "_update_impl", "_compute_impl", "update", "compute", "_jitted_update",
     "_jit_failed", "_jit_update_opt", "compute_on_cpu", "dist_sync_on_step",
@@ -126,14 +126,28 @@ class MetricFunctions:
     ``init/update/compute/merge`` are closures over the metric's *static config* only;
     all state flows through arguments, so each is jit/vmap/shard_map-compatible
     (for metrics whose states are fixed-shape arrays).
+
+    ``merge(a, b, count_a=1, count_b=1)`` accepts the number of updates folded
+    into each side so mean-reduce states are weighted correctly when shards saw
+    unequal batch counts. ``associative`` carries each state's declared/inferred
+    ``merge_associative`` flag (see :meth:`Metric.add_state`) for the sync layer.
     """
 
-    def __init__(self, init: Callable, update: Callable, compute: Callable, merge: Callable, reductions: Dict):
+    def __init__(
+        self,
+        init: Callable,
+        update: Callable,
+        compute: Callable,
+        merge: Callable,
+        reductions: Dict,
+        associative: Optional[Dict] = None,
+    ):
         self.init = init
         self.update = update
         self.compute = compute
         self.merge = merge
         self.reductions = reductions
+        self.associative = dict(associative or {})
 
     def __iter__(self):
         return iter((self.init, self.update, self.compute, self.merge))
@@ -173,6 +187,7 @@ class Metric(ABC):
         object.__setattr__(self, "_state", {})
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Any] = {}
+        self._merge_associative: Dict[str, Optional[bool]] = {}
 
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
         self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
@@ -209,12 +224,22 @@ class Metric(ABC):
         default: Union[Array, list, float, int],
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        merge_associative: Optional[bool] = None,
     ) -> None:
         """Register a state variable (reference ``metric.py:201-284``).
 
         ``default`` is an array (fixed-shape accumulator) or an empty list ("cat"
         style sample store — host-side between jit calls, per SURVEY §7.1-2b).
         ``dist_reduce_fx`` ∈ {"sum","mean","cat","min","max", None, callable}.
+
+        ``merge_associative`` declares whether the reduction is associative AND
+        commutative, i.e. whether per-shard partial states merge to the same
+        answer as a single-pass compute regardless of shard order (DESIGN §10).
+        The builtin string reductions are inferred (sum/mean/min/max → True,
+        "cat" → False: concatenation order follows shard order); a *custom
+        callable* reduction must declare it explicitly (distlint DL001) so the
+        multi-chip sync layer can refuse folds with no well-defined cross-shard
+        answer.
         """
         if isinstance(default, list):
             if default:
@@ -237,9 +262,15 @@ class Metric(ABC):
         else:
             raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max']")
 
+        if merge_associative is not None and not isinstance(merge_associative, bool):
+            raise ValueError("`merge_associative` must be True, False or None (unknown)")
+        if merge_associative is None and isinstance(dist_reduce_fx, str):
+            merge_associative = dist_reduce_fx in ("sum", "mean", "min", "max")
+
         self._defaults[name] = deepcopy(default) if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = reduce_fx
+        self._merge_associative[name] = merge_associative
         self._state[name] = deepcopy(default) if isinstance(default, list) else default
 
     # attribute routing: registered state names resolve into the state pytree
@@ -328,9 +359,23 @@ class Metric(ABC):
             elif reduce_fn is None and isinstance(a, list):
                 out[attr] = _flatten([a, b])
             elif reduce_fn is None:
-                out[attr] = jnp.stack([a, b])
+                # replica-stack semantics: keep ONE leading replica axis however many
+                # shards have been folded in, so a pairwise fold over >2 shards works
+                # (a bare jnp.stack would nest axes and fail on the third shard)
+                base_ndim = jnp.ndim(self._defaults[attr])
+                a_st = jnp.asarray(a) if jnp.ndim(a) > base_ndim else jnp.asarray(a)[None]
+                b_st = jnp.asarray(b) if jnp.ndim(b) > base_ndim else jnp.asarray(b)[None]
+                out[attr] = jnp.concatenate([a_st, b_st], axis=0)
             elif callable(reduce_fn):
-                out[attr] = reduce_fn(jnp.stack([a, b]))
+                a_arr, b_arr = jnp.asarray(a), jnp.asarray(b)
+                if a_arr.shape != b_arr.shape:
+                    raise TPUMetricsUserError(
+                        f"Cannot merge state {attr!r}: custom dist_reduce_fx expects equal per-shard "
+                        f"state shapes but got {a_arr.shape} vs {b_arr.shape}. Pad shard states to a "
+                        "common capacity (metrics_tpu.parallel.pad_to_capacity) or register the state "
+                        "with dist_reduce_fx='cat'."
+                    )
+                out[attr] = reduce_fn(jnp.stack([a_arr, b_arr]))
             else:  # pragma: no cover
                 raise TypeError(f"Unsupported reduce_fn: {reduce_fn}")
         return out
@@ -346,8 +391,9 @@ class Metric(ABC):
             init=self._fresh_state,
             update=self._functional_update,
             compute=self._functional_compute,
-            merge=lambda a, b: self._merge_state_dicts(a, b, 1, 1),
+            merge=lambda a, b, count_a=1, count_b=1: self._merge_state_dicts(a, b, count_a, count_b),
             reductions=dict(self._reductions),
+            associative=dict(self._merge_associative),
         )
 
     # ------------------------------------------------------------------ eager API
@@ -538,12 +584,20 @@ class Metric(ABC):
                     f"Expected incoming state to be an instance of {self.__class__.__name__} "
                     f"but got {type(incoming_state)}"
                 )
+            incoming_count = incoming_state._update_count
             incoming_state = incoming_state.metric_state
-        self._update_count += 1
-        # note reference semantics: incoming plays the "global" role in the running-mean formula
+        else:
+            # a bare dict carries no lifecycle info: count it as one accumulation
+            incoming_count = 1
+        # each side's mean-reduce states are weighted by its OWN update count
+        # (deliberate fix over the reference's `(_update_count-1, 1)` weighting,
+        # which scales the incoming state by the receiver's history length —
+        # distlint merge-equivalence harness, DESIGN §10)
+        own_count = self._update_count
         self.__dict__["_state"] = self._merge_state_dicts(
-            incoming_state, self.metric_state, self._update_count - 1, 1
+            incoming_state, self.metric_state, incoming_count, own_count
         )
+        self._update_count = own_count + incoming_count
 
     def _copy_state(self) -> Dict[str, Any]:
         return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
@@ -697,6 +751,8 @@ class Metric(ABC):
     def __setstate__(self, state: Dict[str, Any]) -> None:
         for k, v in state.items():
             object.__setattr__(self, k, v)
+        # checkpoints from before merge-annotation support: all flags unknown
+        self.__dict__.setdefault("_merge_associative", dict.fromkeys(self.__dict__.get("_defaults", {})))
         object.__setattr__(self, "_update_signature", inspect.signature(type(self).update))
         object.__setattr__(self, "_update_impl", functools.partial(type(self).update, self))
         object.__setattr__(self, "_compute_impl", functools.partial(type(self).compute, self))

@@ -5,7 +5,8 @@ from metrics_tpu.parallel.sync import (
     build_mesh,
     gather_all_states,
     pad_to_capacity,
+    shard_map_compat,
     sync_states,
 )
 
-__all__ = ["allreduce_over_mesh", "build_mesh", "gather_all_states", "pad_to_capacity", "sync_states"]
+__all__ = ["allreduce_over_mesh", "build_mesh", "gather_all_states", "pad_to_capacity", "shard_map_compat", "sync_states"]

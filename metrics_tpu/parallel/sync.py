@@ -28,6 +28,9 @@ from jax import Array, lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+_BUILTIN_REDUCTIONS = (dim_zero_sum, dim_zero_mean, dim_zero_min, dim_zero_max, dim_zero_cat)
 
 __all__ = [
     "sync_states",
@@ -35,7 +38,25 @@ __all__ = [
     "allreduce_over_mesh",
     "pad_to_capacity",
     "build_mesh",
+    "shard_map_compat",
 ]
+
+
+def shard_map_compat(f: Callable, mesh: Mesh, in_specs: Any, out_specs: Any) -> Callable:
+    """``jax.shard_map`` with replication checking off, across jax versions.
+
+    Newer jax exposes top-level ``jax.shard_map(..., check_vma=)``; older releases
+    only have ``jax.experimental.shard_map.shard_map(..., check_rep=)``. Collective
+    code in this package (and the test rigs) must run on both.
+    """
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+        except TypeError:  # pre-rename signature
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as _shard_map  # noqa: PLC0415
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
 def build_mesh(axis_names: Sequence[str] = ("data",), shape: Optional[Sequence[int]] = None, devices=None) -> Mesh:
@@ -50,16 +71,35 @@ def build_mesh(axis_names: Sequence[str] = ("data",), shape: Optional[Sequence[i
     return Mesh(devices.reshape(shape), tuple(axis_names))
 
 
-def sync_states(state: Dict[str, Any], reductions: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+def sync_states(
+    state: Dict[str, Any],
+    reductions: Dict[str, Any],
+    axis_name: str,
+    associative: Optional[Dict[str, Optional[bool]]] = None,
+) -> Dict[str, Any]:
     """Reduce a metric state pytree across a mesh axis — call INSIDE ``shard_map``/``pjit``.
 
     This is the reference's ``Metric._sync_dist`` (``metric.py:501-540``) re-expressed
     as XLA collectives; used with :meth:`Metric.functional` to keep the entire
     train-step + metric-sync inside one compiled program.
+
+    ``associative`` optionally carries each state's ``merge_associative`` flag
+    (:attr:`MetricFunctions.associative`). A *custom callable* reduction declared
+    ``merge_associative=False`` is refused at trace time: its gather-then-fold has
+    no shard-order-independent answer, so syncing it would return numbers that
+    silently depend on device ordering (DESIGN §10).
     """
+    associative = associative or {}
     out: Dict[str, Any] = {}
     for name, value in state.items():
         fx = reductions.get(name)
+        if callable(fx) and fx not in _BUILTIN_REDUCTIONS and associative.get(name) is False:
+            raise TPUMetricsUserError(
+                f"State {name!r} has a custom dist_reduce_fx declared merge_associative=False: "
+                "its cross-shard fold depends on device order and cannot be synced. Reformulate "
+                "the reduction as associative+commutative, or gather with dist_reduce_fx=None/'cat' "
+                "and finish the order-sensitive fold on the host."
+            )
         if fx is dim_zero_sum or fx == "sum":
             out[name] = lax.psum(value, axis_name)
         elif fx is dim_zero_mean or fx == "mean":
@@ -153,12 +193,11 @@ def allreduce_over_mesh(
         local = {k: v[0] for k, v in state.items()}  # strip the per-rank leading dim
         return sync_states(local, reductions, axis_name)
 
-    synced = jax.shard_map(
+    synced = shard_map_compat(
         _body,
         mesh=mesh,
         in_specs=(specs,),
         out_specs={k: P() for k in stacked},
-        check_vma=False,
     )(stacked)
     for k, dims in ragged.items():
         cap = max(dims)

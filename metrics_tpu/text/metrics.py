@@ -426,6 +426,26 @@ class _StringStoreMetric(Metric):
         self._computed = None  # running compute must not reuse the batch value
         return batch_val
 
+    def merge_state(self, incoming_state) -> None:
+        """Merge the string stores too — they live outside ``_state``.
+
+        The generic ``merge_state`` only folds registered array states; with an
+        empty ``_defaults`` it would silently drop every stored string of the
+        incoming shard (distlint DL005 failure mode). Incoming strings go first,
+        matching the base merge's incoming-first "cat" convention.
+        """
+        if not isinstance(incoming_state, _StringStoreMetric):
+            raise ValueError(
+                f"Expected incoming state to be a {self.__class__.__name__} holding its string "
+                f"stores but got {type(incoming_state)}"
+            )
+        in_preds = list(incoming_state._preds_store)
+        in_target = list(incoming_state._target_store)
+        super().merge_state(incoming_state)
+        self._preds_store = in_preds + self._preds_store
+        self._target_store = in_target + self._target_store
+        self._computed = None
+
     def reset(self) -> None:
         """Reset stored strings too."""
         super().reset()

@@ -69,6 +69,51 @@ class WrapperMetric(Metric):
         for _, child in self._children():
             child.persistent(mode)
 
+    def merge_state(self, incoming_state: Any) -> None:
+        """Merge own registered states and recurse into children pairwise.
+
+        The generic ``Metric.merge_state`` only folds registered array states;
+        a wrapper's payload lives in its child metrics, so the base path would
+        silently drop every incoming child state (the dynamic DL005 failure
+        mode — see analysis/merge_contracts.py). Children are matched by their
+        structural path; a shape mismatch (different child count/layout) is an
+        error, not a silent partial merge.
+
+        ``full_state_update`` wrappers (MinMaxMetric, BootStrapper) keep the
+        base contract and refuse: their state is a trajectory/resampling
+        artifact that a pairwise child fold cannot reconstruct.
+        """
+        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+            raise RuntimeError(
+                "``merge_state`` is not supported for metrics with ``full_state_update=True`` or "
+                "``dist_sync_on_step=True``. Please overwrite the merge_state method in the metric class."
+            )
+        if not isinstance(incoming_state, self.__class__):
+            raise ValueError(
+                f"Expected incoming state to be an instance of {self.__class__.__name__} "
+                f"but got {type(incoming_state)}"
+            )
+        own_children = self._children()
+        in_children = dict(incoming_state._children())
+        if {p for p, _ in own_children} != set(in_children):
+            raise ValueError(
+                f"Cannot merge {self.__class__.__name__}: child structure differs "
+                f"({sorted(p for p, _ in own_children)} vs {sorted(in_children)})"
+            )
+        incoming_count = incoming_state._update_count
+        own_count = self._update_count
+        if self._defaults:
+            # the wrapper's own registered states fold by their declared
+            # reductions, bypassing the full_state_update guard — child state
+            # is merged explicitly right below
+            self.__dict__["_state"] = self._merge_state_dicts(
+                incoming_state.metric_state, self.metric_state, incoming_count, own_count
+            )
+        for path, child in own_children:
+            child.merge_state(in_children[path])
+        self._update_count = own_count + incoming_count
+        self._computed = None
+
     def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
         """Export own states plus every child metric's, under dotted child paths."""
         destination = super().state_dict(destination, prefix)

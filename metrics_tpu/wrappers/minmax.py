@@ -40,8 +40,9 @@ class MinMaxMetric(WrapperMetric):
         val = self._base_metric.compute()
         if not self._is_suitable_val(val):
             raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}")
-        self.max_val = jnp.where(self.max_val < val, jnp.asarray(val, dtype=jnp.float32), self.max_val)
-        self.min_val = jnp.where(self.min_val > val, jnp.asarray(val, dtype=jnp.float32), self.min_val)
+        val = jnp.asarray(val, dtype=jnp.float32)
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
         """Update (once) and return the current raw/min/max values.

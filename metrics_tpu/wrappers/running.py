@@ -61,11 +61,35 @@ class Running(WrapperMetric):
         fns = self.base_metric.functional()
         states = list(self._window_states)
         merged = states[0]
-        for st in states[1:]:
-            merged = fns.merge(merged, st)
+        for i, st in enumerate(states[1:], start=1):
+            # the accumulator holds i batches vs the incoming one — mean-reduce
+            # states must be weighted accordingly
+            merged = fns.merge(merged, st, i, 1)
         self.base_metric.__dict__["_state"].update(merged)
         self.base_metric._update_count = len(states)
         self.base_metric._computed = None
+
+    def merge_state(self, incoming_state: Any) -> None:
+        """Merge by splicing windows — the base metric's state is window-derived.
+
+        The generic child-merging wrapper path would fold the base metric
+        directly and then have ``_apply_window`` clobber it; instead the
+        incoming window is spliced in FIRST (matching the base merge's
+        incoming-first convention) and the deque's ``maxlen`` keeps the most
+        recent ``window`` batches. The result is inherently shard-order
+        dependent — a running view is a trajectory statistic — which is why
+        Running stays baselined CAT_ORDER_SENSITIVE (DESIGN §10).
+        """
+        if not isinstance(incoming_state, self.__class__):
+            raise ValueError(
+                f"Expected incoming state to be an instance of {self.__class__.__name__} "
+                f"but got {type(incoming_state)}"
+            )
+        incoming_count = incoming_state._update_count
+        combined = list(incoming_state._window_states) + list(self._window_states)
+        self._window_states = deque(combined, maxlen=self.window)
+        self._apply_window()
+        self._update_count += incoming_count
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Update the window and return the CURRENT BATCH's value.

@@ -208,7 +208,7 @@ def test_functional_inside_shard_map():
     """The metric update+sync embedded in a sharded step — the TPU deployment shape."""
     from jax.sharding import PartitionSpec as P
 
-    from metrics_tpu.parallel.sync import build_mesh, sync_states
+    from metrics_tpu.parallel.sync import build_mesh, shard_map_compat, sync_states
 
     m = DummySum()
     fns = m.functional()
@@ -220,7 +220,7 @@ def test_functional_inside_shard_map():
         synced = sync_states(state, fns.reductions, "data")
         return synced
 
-    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs={"x": P()}, check_vma=False)(data)
+    out = shard_map_compat(step, mesh=mesh, in_specs=P("data"), out_specs={"x": P()})(data)
     assert float(out["x"]) == float(data.sum())
 
 

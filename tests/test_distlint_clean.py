@@ -1,0 +1,68 @@
+"""The repo must stay distlint-clean: zero non-baselined DL violations.
+
+This is the enforcement point for the §10 merge-soundness invariant — any new
+undeclared custom reduction, non-additive read-modify-write fold, merge-fragile
+compute, raw collective outside ``parallel/sync.py``, or state-dropping
+``merge_state`` override introduced under ``metrics_tpu/`` fails this test.
+Intentional exceptions belong in ``tools/distlint_baseline.json`` (regenerate
+with ``python tools/lint_metrics.py --pass distlint --update-baseline``) or
+behind an inline ``# distlint: disable=RULE`` with a justification comment.
+"""
+
+import os
+
+import pytest
+
+from metrics_tpu.analysis import (
+    DIST_RULE_CODES,
+    diff_against_baseline,
+    lint_paths,
+    load_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "distlint_baseline.json")
+
+
+@pytest.fixture(scope="module")
+def lint_result():
+    return lint_paths(
+        [os.path.join(REPO_ROOT, "metrics_tpu")], root=REPO_ROOT, rules=list(DIST_RULE_CODES)
+    )
+
+
+def test_every_module_parses(lint_result):
+    assert not lint_result.parse_errors, "\n".join(lint_result.parse_errors)
+    assert lint_result.files_scanned > 100  # the walk really covered the package
+
+
+def test_zero_non_baselined_violations(lint_result):
+    baseline = load_baseline(BASELINE_PATH)
+    new, _, _ = diff_against_baseline(lint_result.violations, baseline)
+    assert not new, "new distlint violations (fix or baseline with a justification):\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_no_stale_baseline_entries(lint_result):
+    """The baseline only ratchets down: entries must still match something."""
+    baseline = load_baseline(BASELINE_PATH)
+    _, _, stale = diff_against_baseline(lint_result.violations, baseline)
+    assert not stale, f"stale baseline entries (remove them): {stale}"
+
+
+def test_cli_exits_zero_against_baseline():
+    from metrics_tpu.analysis.cli import main
+
+    assert main(["--root", REPO_ROOT, os.path.join(REPO_ROOT, "metrics_tpu"), "--pass", "distlint", "-q"]) == 0
+
+
+def test_combined_all_passes_exit_zero():
+    """The unified entry point — jitlint AND distlint — stays green."""
+    from metrics_tpu.analysis.cli import main
+
+    assert main(["--root", REPO_ROOT, os.path.join(REPO_ROOT, "metrics_tpu"), "--all", "-q"]) == 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,312 @@
+"""Unit tests for the distlint AST rules (DL001–DL005).
+
+Every rule gets at least one positive fixture (the violation is reported) and
+one negative fixture (merge-sound idiomatic code stays clean). Fixtures model
+Metric subclasses — distlint keys off ``self.add_state`` registrations.
+"""
+
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis import DIST_RULE_CODES, lint_file
+
+
+def run_lint(tmp_path, source, rel="pkg/mod.py", rules=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_file(str(path), root=str(tmp_path), rules=rules or list(DIST_RULE_CODES))
+
+
+def codes(result):
+    return [v.rule for v in result.violations]
+
+
+# =========================================================================== DL001
+class TestDL001UndeclaredReduceAlgebra:
+    def test_callable_reduce_fn_without_declaration_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self, fn):
+                    self.add_state("v", default=0.0, dist_reduce_fx=fn)
+        """, rules=["DL001"])
+        assert codes(res) == ["DL001"]
+        assert "merge_associative" in res.violations[0].message
+
+    def test_lambda_reduce_fn_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("v", 0.0, lambda x: x.prod(0))
+        """, rules=["DL001"])
+        assert codes(res) == ["DL001"]
+
+    def test_literal_string_reduce_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("b", default=[], dist_reduce_fx="cat")
+                    self.add_state("c", default=0.0, dist_reduce_fx=None)
+        """, rules=["DL001"])
+        assert codes(res) == []
+
+    def test_declared_callable_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self, fn):
+                    self.add_state("v", default=0.0, dist_reduce_fx=fn, merge_associative=True)
+        """, rules=["DL001"])
+        assert codes(res) == []
+
+    def test_inline_suppression_with_distlint_prefix(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self, fn):
+                    self.add_state("v", default=0.0, dist_reduce_fx=fn)  # distlint: disable=DL001
+        """, rules=["DL001"])
+        assert codes(res) == []
+        assert res.suppressed == 1
+
+
+# =========================================================================== DL002
+class TestDL002NonadditiveRMW:
+    def test_where_fold_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("mx", default=0.0, dist_reduce_fx="max")
+
+                def update(self, x):
+                    self.mx = jnp.where(self.mx < x, x, self.mx)
+        """, rules=["DL002"])
+        assert codes(res) == ["DL002"]
+        assert "jnp.where" in res.violations[0].message
+
+    def test_multiplicative_fold_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("p", default=1.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.p = self.p * x
+        """, rules=["DL002"])
+        assert codes(res) == ["DL002"]
+
+    def test_nonadditive_augassign_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("p", default=1.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.p *= x
+        """, rules=["DL002"])
+        assert codes(res) == ["DL002"]
+
+    def test_state_on_right_of_subtraction_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("v", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.v = x - self.v
+        """, rules=["DL002"])
+        assert codes(res) == ["DL002"]
+
+    def test_additive_folds_are_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("s", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("mx", default=0.0, dist_reduce_fx="max")
+                    self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+                def update(self, x):
+                    self.s += x.sum()
+                    self.mx = jnp.maximum(self.mx, x.max())
+                    self.vals.append(x)
+        """, rules=["DL002"])
+        assert codes(res) == []
+
+    def test_overwrite_from_batch_only_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("last", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.last = x.sum()
+        """, rules=["DL002"])
+        assert codes(res) == []
+
+
+# =========================================================================== DL003
+class TestDL003MergeFragileCompute:
+    def test_update_count_in_compute_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("s", default=0.0, dist_reduce_fx="sum")
+
+                def compute(self):
+                    return self.s / self._update_count
+        """, rules=["DL003"])
+        assert codes(res) == ["DL003"]
+        assert "_update_count" in res.violations[0].message
+
+    def test_positional_list_state_index_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+                def compute(self):
+                    return self.vals[0] - self.vals[-1]
+        """, rules=["DL003"])
+        assert codes(res).count("DL003") == 2
+
+    def test_reduced_list_state_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from metrics_tpu.utils.data import dim_zero_cat
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("vals", default=[], dist_reduce_fx="cat")
+                    self.add_state("n", default=0.0, dist_reduce_fx="sum")
+
+                def compute(self):
+                    return dim_zero_cat(self.vals).sum() / self.n
+        """, rules=["DL003"])
+        assert codes(res) == []
+
+
+# =========================================================================== DL004
+class TestDL004RawCollectives:
+    def test_lax_psum_outside_sync_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import lax
+
+            def my_reduce(x):
+                return lax.psum(x, "data")
+        """, rules=["DL004"])
+        assert codes(res) == ["DL004"]
+        assert "parallel/sync.py" in res.violations[0].message
+
+    def test_bare_import_form_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax.lax import pmean
+
+            def my_reduce(x):
+                return pmean(x, "data")
+        """, rules=["DL004"])
+        assert codes(res) == ["DL004"]
+
+    def test_sync_module_itself_is_exempt(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import lax
+
+            def sync(x):
+                return lax.psum(x, "data")
+        """, rel="metrics_tpu/parallel/sync.py", rules=["DL004"])
+        assert codes(res) == []
+
+    def test_unrelated_name_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def psum(values):
+                return sum(values)
+
+            def caller(values):
+                return psum(values)
+        """, rules=["DL004"])
+        assert codes(res) == []
+
+
+# =========================================================================== DL005
+class TestDL005MergeOverrideDropsState:
+    def test_dropped_state_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("b", default=0.0, dist_reduce_fx="sum")
+
+                def merge_state(self, incoming):
+                    self.a = self.a + incoming.a
+        """, rules=["DL005"])
+        assert codes(res) == ["DL005"]
+        assert "`b`" in res.violations[0].message
+
+    def test_all_states_touched_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("b", default=0.0, dist_reduce_fx="sum")
+
+                def merge_state(self, incoming):
+                    self.a = self.a + incoming.a
+                    self.b = self.b + incoming.b
+        """, rules=["DL005"])
+        assert codes(res) == []
+
+    def test_delegation_to_super_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("b", default=0.0, dist_reduce_fx="sum")
+
+                def merge_state(self, incoming):
+                    extra = incoming.extra
+                    super().merge_state(incoming)
+        """, rules=["DL005"])
+        assert codes(res) == []
+
+
+# =========================================================================== wiring
+class TestDistlintWiring:
+    def test_rules_registered(self):
+        from metrics_tpu.analysis import DIST_RULES
+
+        assert set(DIST_RULES) == set(DIST_RULE_CODES)
+
+    def test_mixed_rule_selection_runs_both_passes(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import lax
+
+            class M(Metric):
+                def __init__(self, fn):
+                    self.add_state("v", default=0.0)
+
+                def update(self, x):
+                    return lax.psum(x, "data")
+        """, rules=["JL003", "DL004"])
+        got = set(codes(res))
+        assert "JL003" in got  # no dist_reduce_fx declared
+        assert "DL004" in got  # raw collective
+
+    def test_cli_all_flag(self, tmp_path):
+        from metrics_tpu.analysis.cli import main
+
+        mod = tmp_path / "m.py"
+        mod.write_text("from jax import lax\n\ndef f(x):\n    return lax.psum(x, 'd')\n")
+        # --all runs jitlint (clean here) AND distlint (one DL004) → exit 1
+        assert main(["--root", str(tmp_path), str(mod), "--all", "--no-baseline", "-q"]) == 1
+        # jitlint pass alone does not know DL004 → exit 0
+        assert main(["--root", str(tmp_path), str(mod), "--pass", "jitlint", "--no-baseline", "-q"]) == 0
+        # distlint console-script entry sees it again
+        from metrics_tpu.analysis.cli import main_distlint
+
+        assert main_distlint(["--root", str(tmp_path), str(mod), "--no-baseline", "-q"]) == 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

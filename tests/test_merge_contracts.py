@@ -1,0 +1,75 @@
+"""The merge-equivalence harness must stay green against its baseline.
+
+Every exported Metric class in the registry is property-tested: splitting the
+update stream across unequal shards, merging the partials, and computing must
+match the single-pass result (MERGE_SOUND), and the match must survive shard
+permutation. Honest exceptions (ordered concat, trajectory statistics,
+stochastic resampling) live in the ``merge`` section of
+``tools/distlint_baseline.json`` — anything WORSE than its baselined
+classification is a regression and fails here.
+"""
+
+import os
+
+import pytest
+
+from metrics_tpu.analysis.merge_contracts import (
+    CLASSIFICATIONS,
+    MERGE_CASES,
+    diff_merge_baseline,
+    load_merge_baseline,
+    run_merge_contracts,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "distlint_baseline.json")
+
+
+@pytest.fixture(scope="module")
+def results():
+    return run_merge_contracts()
+
+
+def test_registry_covers_enough_classes():
+    # the acceptance floor: the harness must exercise a broad slice of the API
+    assert len(MERGE_CASES) >= 40
+    names = [c.name for c in MERGE_CASES]
+    assert len(names) == len(set(names)), "duplicate case names would collide in the baseline"
+
+
+def test_classifications_are_valid(results):
+    for r in results:
+        assert r.classification in CLASSIFICATIONS, r.case.name
+
+
+def test_no_unbaselined_merge_regressions(results):
+    baseline = load_merge_baseline(BASELINE_PATH)
+    regressions, _ = diff_merge_baseline(results, baseline)
+    assert not regressions, "merge-soundness regressions:\n" + "\n".join(
+        f"  {r.case.name}: {r.classification} — {r.detail}" for r in regressions
+    )
+
+
+def test_no_stale_merge_baseline_entries(results):
+    """Baselined classes that improved (or vanished) must be re-baselined down."""
+    baseline = load_merge_baseline(BASELINE_PATH)
+    _, stale = diff_merge_baseline(results, baseline)
+    assert not stale, f"stale merge-baseline entries (remove or downgrade them): {stale}"
+
+
+def test_majority_of_classes_merge_sound(results):
+    """The framework guarantee: non-sound classes are the rare, documented exception."""
+    sound = sum(1 for r in results if r.classification == "MERGE_SOUND")
+    assert sound >= 0.85 * len(results), (
+        f"only {sound}/{len(results)} classes MERGE_SOUND — the merge guarantee eroded"
+    )
+
+
+def test_cli_exits_zero_against_baseline():
+    from metrics_tpu.analysis.merge_contracts import main
+
+    assert main(["--root", REPO_ROOT, "-q"]) == 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,191 @@
+"""Merge-soundness of the aggregation metrics under real sharding patterns.
+
+Targeted complement to the generic harness in
+``metrics_tpu/analysis/merge_contracts.py``: unequal shard counts, permuted
+shard order, the count-weighted mean-merge path, and the shape-mismatch error
+contract for custom ``dist_reduce_fx`` states.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    SumMetric,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+# one stream, deliberately split into UNEQUAL shards: 4 + 2 + 1 updates
+VALUES = [3.0, -1.5, 7.25, 0.5, 2.0, -4.0, 9.5]
+SHARDS = (VALUES[:4], VALUES[4:6], VALUES[6:])
+
+
+def _filled(ctor, values):
+    m = ctor()
+    for v in values:
+        m.update(jnp.asarray(v))
+    return m
+
+
+def _merged(ctor, shard_values):
+    """Fold per-shard replicas, last shard as the accumulator (incoming-first).
+
+    ``full_state_update`` classes (MaxMetric, MinMetric) refuse the OO merge
+    path by contract; they fold through the functional ``_merge_state_dicts``
+    with explicit per-shard counts, exactly as the merge-contracts harness does.
+    """
+    replicas = [_filled(ctor, vals) for vals in shard_values]
+    try:
+        acc = replicas[-1]
+        for m in reversed(replicas[:-1]):
+            acc.merge_state(m)
+        return acc
+    except RuntimeError as exc:
+        if "merge_state" not in str(exc):
+            raise
+    template = replicas[0]
+    state, count = template.metric_state, template._update_count
+    for m in replicas[1:]:
+        state = template._merge_state_dicts(state, m.metric_state, count, m._update_count)
+        count += m._update_count
+    holder = ctor()
+    holder.__dict__["_state"] = dict(state)
+    holder._update_count = count
+    return holder
+
+
+@pytest.mark.parametrize("ctor", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+def test_unequal_shards_match_single_pass(ctor):
+    ref = _filled(ctor, VALUES).compute()
+    got = _merged(ctor, SHARDS).compute()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+
+@pytest.mark.parametrize("ctor", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+def test_shard_order_is_irrelevant(ctor):
+    in_order = _merged(ctor, SHARDS).compute()
+    for perm in [(1, 2, 0), (2, 0, 1), (2, 1, 0)]:
+        permuted = _merged(ctor, [SHARDS[i] for i in perm]).compute()
+        np.testing.assert_allclose(np.asarray(permuted), np.asarray(in_order), rtol=1e-6)
+
+
+def test_merged_update_count_sums():
+    m = _merged(SumMetric, SHARDS)
+    assert m._update_count == len(VALUES)
+
+
+def test_cat_metric_is_order_sensitive_but_content_complete():
+    """CatMetric keeps everything but the order tracks the merge order — the
+    documented CAT_ORDER_SENSITIVE contract (baselined, DESIGN §10)."""
+    ref = np.asarray(_filled(CatMetric, VALUES).compute())
+    in_order = np.asarray(_merged(CatMetric, SHARDS).compute())
+    np.testing.assert_allclose(in_order, ref)  # incoming-first fold preserves stream order
+    permuted = np.asarray(_merged(CatMetric, [SHARDS[i] for i in (1, 2, 0)]).compute())
+    assert not np.array_equal(permuted, ref)
+    np.testing.assert_allclose(np.sort(permuted), np.sort(ref))  # same multiset
+
+
+def test_weighted_mean_merge():
+    """MeanMetric carries its own weight state, so weighted streams merge exactly."""
+    ref = MeanMetric()
+    a, b = MeanMetric(), MeanMetric()
+    for value, weight, shard in [(2.0, 1.0, a), (4.0, 3.0, a), (10.0, 0.5, b)]:
+        ref.update(jnp.asarray(value), jnp.asarray(weight))
+        shard.update(jnp.asarray(value), jnp.asarray(weight))
+    b.merge_state(a)
+    np.testing.assert_allclose(np.asarray(b.compute()), np.asarray(ref.compute()), rtol=1e-6)
+
+
+class _MeanState(Metric):
+    """Minimal metric with a ``dist_reduce_fx="mean"`` state: the merge must
+    weight each side by its OWN update count, not the receiver's history."""
+
+    full_state_update = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+        self.add_state("n", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value):
+        # running mean over this replica's updates, tracked in jit-safe state
+        self.avg = (self.avg * self.n + value) / (self.n + 1.0)
+        self.n = self.n + 1.0
+
+    def compute(self):
+        return self.avg
+
+
+def test_mean_reduce_merge_weights_by_own_counts():
+    a = _filled(_MeanState, [1.0, 2.0, 3.0])  # avg 2.0 over 3 updates
+    b = _filled(_MeanState, [10.0])  # avg 10.0 over 1 update
+    b.merge_state(a)
+    # (3*2 + 1*10) / 4 = 4.0 — NOT (2+10)/2 = 6.0 or any receiver-history weighting
+    np.testing.assert_allclose(float(b.compute()), 4.0, rtol=1e-6)
+    a2 = _filled(_MeanState, [1.0, 2.0, 3.0])
+    b2 = _filled(_MeanState, [10.0])
+    a2.merge_state(b2)  # merge in the opposite direction — same weighted answer
+    np.testing.assert_allclose(float(a2.compute()), 4.0, rtol=1e-6)
+
+
+def test_mean_reduce_merge_from_bare_dict_counts_as_one():
+    a = _filled(_MeanState, [1.0, 2.0, 3.0])
+    a.merge_state({"avg": jnp.asarray(10.0), "n": jnp.asarray(1.0)})
+    np.testing.assert_allclose(float(a.compute()), 4.0, rtol=1e-6)
+
+
+class _TopKState(Metric):
+    """Custom reduce_fn whose state shape depends on how much data a shard saw."""
+
+    full_state_update = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_state(
+            "seen",
+            default=jnp.zeros(0),
+            dist_reduce_fx=lambda x: x.reshape(-1),
+            merge_associative=True,
+        )
+
+    def update(self, value):
+        self.seen = jnp.concatenate([self.seen, jnp.atleast_1d(value)])
+
+    def compute(self):
+        return self.seen
+
+
+def test_custom_reduce_shape_mismatch_is_a_clear_error():
+    a = _filled(_TopKState, [1.0, 2.0])  # state shape (2,)
+    b = _filled(_TopKState, [3.0])  # state shape (1,)
+    with pytest.raises(TPUMetricsUserError, match="equal per-shard"):
+        b.merge_state(a)
+
+
+def test_custom_reduce_equal_shapes_merge():
+    a = _filled(_TopKState, [1.0, 2.0])
+    b = _filled(_TopKState, [3.0, 4.0])
+    b.merge_state(a)
+    np.testing.assert_allclose(np.sort(np.asarray(b.compute())), [1.0, 2.0, 3.0, 4.0])
+
+
+def test_running_mean_merge_splices_windows():
+    """Running merge is a trajectory statistic (order-sensitive, baselined), but
+    the spliced window must still equal the last ``window`` combined batches."""
+    window = 3
+    ref = _filled(lambda: RunningMean(window=window), VALUES).compute()
+    shards = [_filled(lambda: RunningMean(window=window), vals) for vals in SHARDS]
+    acc = shards[-1]
+    for m in reversed(shards[:-1]):
+        acc.merge_state(m)
+    np.testing.assert_allclose(np.asarray(acc.compute()), np.asarray(ref), rtol=1e-6)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from metrics_tpu.parallel.sync import allreduce_over_mesh, build_mesh, pad_to_capacity, sync_states
+from metrics_tpu.parallel.sync import allreduce_over_mesh, build_mesh, pad_to_capacity, shard_map_compat, sync_states
 
 
 def _reductions(**kw):
@@ -136,12 +136,11 @@ def test_sync_states_inside_shard_map_mixed():
         local = {k: v[0] for k, v in st.items()}
         return sync_states(local, {"s": "sum", "mx": "max", "c": "cat"}, "data")
 
-    out = jax.shard_map(
+    out = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=({k: P("data", *([None] * (v.ndim - 1))) for k, v in stacked.items()},),
         out_specs={"s": P(), "mx": P(), "c": P()},
-        check_vma=False,
     )(stacked)
     assert float(out["s"]) == 28.0
     assert float(out["mx"]) == 7.0
@@ -239,9 +238,9 @@ def test_sync_states_on_2d_mesh_both_axes():
         local = {k: v[0, 0] for k, v in st.items()}
         return sync_states(local, {"s": "sum"}, ("data", "model"))
 
-    out = jax.shard_map(
+    out = shard_map_compat(
         body, mesh=mesh,
-        in_specs=({"s": P("data", "model")},), out_specs={"s": P()}, check_vma=False,
+        in_specs=({"s": P("data", "model")},), out_specs={"s": P()},
     )(stacked)
     assert float(out["s"]) == 28.0
 
@@ -260,9 +259,9 @@ def test_sync_states_on_2d_mesh_single_axis():
         synced = sync_states(local, {"s": "sum"}, "data")
         return {"s": synced["s"].reshape(1, 1)}
 
-    out = jax.shard_map(
+    out = shard_map_compat(
         body, mesh=mesh,
-        in_specs=({"s": P("data", "model")},), out_specs={"s": P(None, "model")}, check_vma=False,
+        in_specs=({"s": P("data", "model")},), out_specs={"s": P(None, "model")},
     )(stacked)
     # column 0 holds devices 0,2,4,6 → 12; column 1 holds 1,3,5,7 → 16
     np.testing.assert_allclose(np.asarray(out["s"]).reshape(-1), [12.0, 16.0])
