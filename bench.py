@@ -416,6 +416,11 @@ def main():
     from metrics_tpu.utils.backend import ensure_backend
 
     ensure_backend(min_devices=1)
+    # telemetry for the BENCH line: compile counts / jit-cache hit rates of the
+    # benchmarked metrics ride along in the output JSON (ISSUE PR3 satellite c)
+    from metrics_tpu import observe
+
+    observe.enable()
     if not _reference_available():
         print(json.dumps({"metric": "bench_suite", "value": -1, "unit": "reference checkout missing", "vs_baseline": -1}))
         return
@@ -472,6 +477,7 @@ def main():
     except Exception as err:  # noqa: BLE001
         configs["retrieval_device_sort"] = {"error": f"{type(err).__name__}: {err}"}
     geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups)) if speedups else -1.0
+    snap = observe.snapshot()
     print(json.dumps({
         "metric": "bench_suite_speedup_geomean",
         "value": round(geomean, 3),
@@ -479,6 +485,7 @@ def main():
         "vs_baseline": round(geomean, 3),
         "device_kind": device_kind,
         "configs": configs,
+        "observe": {"counters": snap["counters"], "derived": snap["derived"]},
     }))
 
 

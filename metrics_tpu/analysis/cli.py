@@ -7,9 +7,16 @@ Two passes share one engine and one exit-code contract:
 * ``distlint`` — merge-soundness & collective-safety rules DL001–DL005,
   baselined in ``tools/distlint_baseline.json``
 
-Select with ``--pass jitlint|distlint`` or run both with ``--all`` (the CI
-shape: one invocation, one verdict). Exit codes: 0 clean (or fully baselined),
-1 new violations in *any* selected pass, 2 usage/parse error.
+A third, dynamic pass rides the same selection/exit-code contract:
+
+* ``perf`` — XLA cost profiling of compiled metric updates
+  (:mod:`metrics_tpu.observe.profile`), ratcheted against
+  ``tools/perf_baseline.json``
+
+Select with ``--pass jitlint|distlint|perf`` or run everything with ``--all``
+(the CI shape: one invocation, one verdict). Exit codes: 0 clean (or fully
+baselined), 1 new violations/regressions in *any* selected pass, 2
+usage/parse error.
 """
 
 from __future__ import annotations
@@ -45,16 +52,18 @@ _PASSES: Dict[str, Dict[str, object]] = {
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="jitlint",
-        description="Static analysis for metrics_tpu: jitlint (JL001-JL006, tracer safety) "
-                    "and distlint (DL001-DL005, distributed merge soundness).",
+        description="Static analysis for metrics_tpu: jitlint (JL001-JL006, tracer safety), "
+                    "distlint (DL001-DL005, distributed merge soundness), and the perf "
+                    "cost-baseline check.",
     )
     p.add_argument("targets", nargs="*", default=["metrics_tpu"],
                    help="files or directories to lint (default: metrics_tpu)")
     p.add_argument("--root", default=None, help="repo root for relative paths (default: cwd)")
-    p.add_argument("--pass", dest="passes", action="append", choices=sorted(_PASSES),
+    p.add_argument("--pass", dest="passes", action="append",
+                   choices=sorted([*_PASSES, "perf"]),
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
-                   help="run every pass (jitlint + distlint) in one invocation")
+                   help="run every pass (jitlint + distlint + perf) in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004; baseline follows each code's own pass)")
@@ -70,7 +79,8 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _selected_passes(args: argparse.Namespace) -> List[str]:
     if args.run_all:
-        return sorted(_PASSES)  # deterministic: distlint, jitlint
+        # deterministic: cheap AST passes first, the dynamic perf pass last
+        return sorted(_PASSES) + ["perf"]
     if args.passes:
         # de-dup, preserve order
         seen: List[str] = []
@@ -115,6 +125,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     exit_code = 0
     report: Dict[str, object] = {}
     for name in passes:
+        if name == "perf":
+            if explicit_rules is not None:
+                continue  # perf has no rule codes; --rules selects AST rules only
+            from metrics_tpu.observe.profile import run_perf_check  # noqa: PLC0415 — lazy: imports jax
+
+            rc = run_perf_check(
+                root,
+                baseline_path=args.baseline if len(passes) == 1 else None,
+                update_baseline=args.update_baseline,
+                quiet=args.quiet,
+            )
+            if rc:
+                exit_code = 1
+            continue
         rules = _pass_rules(name, explicit_rules)
         if not rules:
             continue

@@ -28,6 +28,7 @@ import weakref
 from metrics_tpu.metric import Metric
 from jax import Array
 
+from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.utils.data import _flatten_dict
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -287,6 +288,8 @@ class MetricCollection:
             return False
         shared_key = tuple(lm._jit_cache_key() for lm in leaders)
         shareable = all(k is not None for k in shared_key)
+        rec = _observe.RECORDER if _observe.ENABLED else None
+        t0 = _observe.clock() if rec is not None else 0.0
         fused = _FUSED_SHARED_CACHE.get(shared_key) if shareable else _FUSED_UPDATE_CACHE.get(self)
         if fused is None:
             # representatives are pristine clones so no live collection is pinned
@@ -311,18 +314,25 @@ class MetricCollection:
                     _FUSED_SHARED_CACHE.pop(next(iter(_FUSED_SHARED_CACHE)))
             else:
                 _FUSED_UPDATE_CACHE[self] = fused
+            _observe.note_fused_compile(len(leaders), shareable)
+        elif rec is not None:
+            rec.add_count("fused_hit", str(len(leaders)))
         states = tuple({k: lm._state[k] for k in lm._defaults} for lm in leaders)
         try:
             new_states = fused(states, *args)
         except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
-                jax.errors.TracerIntegerConversionError):
+                jax.errors.TracerIntegerConversionError) as exc:
             _FUSED_UPDATE_CACHE.pop(self, None)
+            _observe.note_fused_fallback(len(leaders), exc)
             return False
         for lm, ns in zip(leaders, new_states):
             lm.__dict__["_state"].update(ns)
             lm._computed = None
             lm._update_count += 1
+        if rec is not None:
+            rec.add_time("fused_update", str(len(leaders)), _observe.clock() - t0)
+            rec.add_count("fused_dispatch", str(len(leaders)))
         return True
 
     def _merge_compute_groups(self) -> None:

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.utils.data import _flatten, dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 from metrics_tpu.utils.exceptions import TPUMetricsUserError, TraceIneligibleError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -75,8 +76,13 @@ _SHARED_JIT_CACHE_MAX = 256
 
 
 def clear_jit_cache() -> None:
-    """Drop all shared compiled updates (frees the representative instances too)."""
+    """Drop all shared compiled updates (frees the representative instances too).
+
+    The observe layer's jit-cache counters (compiles / hits / evictions) describe
+    this cache, so they reset with it — see ``metrics_tpu.observe`` (DESIGN §11).
+    """
     _SHARED_JIT_CACHE.clear()
+    _observe.note_jit_cache_cleared()
 
 
 def _named_for_profiler(fn: Callable, name: str) -> Callable:
@@ -434,6 +440,7 @@ class Metric(ABC):
         """Return the compiled pure update for this config, compiling at most once per config."""
         key = self._jit_cache_key()
         if key is None:
+            _observe.note_jit_compile(type(self).__name__, shared=False)
             return jax.jit(_named_for_profiler(self._functional_update, f"{type(self).__name__}_update"))
         fn = _SHARED_JIT_CACHE.get(key)
         if fn is None:
@@ -445,33 +452,55 @@ class Metric(ABC):
             rep.reset()
             fn = jax.jit(_named_for_profiler(rep._functional_update, f"{type(self).__name__}_update"))
             _SHARED_JIT_CACHE[key] = fn
+            _observe.note_jit_compile(type(self).__name__, shared=True)
             if len(_SHARED_JIT_CACHE) > _SHARED_JIT_CACHE_MAX:
-                _SHARED_JIT_CACHE.popitem(last=False)
+                evicted_key, _ = _SHARED_JIT_CACHE.popitem(last=False)
+                _observe.note_jit_eviction(evicted_key[0].__name__)
         else:
             _SHARED_JIT_CACHE.move_to_end(key)
+            _observe.note_jit_cache_hit(type(self).__name__)
         return fn
 
     def _wrapped_update(self, *args: Any, **kwargs: Any) -> None:
-        """``_wrap_update`` analog (reference ``metric.py:542-564``): cache invalidation + counting."""
+        """``_wrap_update`` analog (reference ``metric.py:542-564``): cache invalidation + counting.
+
+        Observability (DESIGN §11): with telemetry off — the default — the only
+        added work is the one ``_observe.ENABLED`` flag read; nothing is timed
+        or allocated. Enabled, each call records wall time plus which path ran
+        (``jit`` / ``eager`` / ``fallback``). The timer brackets the (async)
+        dispatch, so a first call carries its trace+compile cost — retraces
+        surface as ``max_s`` spikes.
+        """
         self._computed = None
         self._update_count += 1
         if self._is_synced:
             raise TPUMetricsUserError("The Metric has already been synced and cannot be updated.")
+        rec = _observe.RECORDER if _observe.ENABLED else None
+        t0 = _observe.clock() if rec is not None else 0.0
+        path = "eager"
         if self._jit_eligible(args, kwargs):
             if self._jitted_update is None:
                 # NOTE: no buffer donation — default arrays are shared across resets.
                 self._jitted_update = self._lookup_shared_jit()
             try:
                 self.__dict__["_state"] = self._jitted_update(self._state, *args, **kwargs)
+                path = "jit"
             except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
-                    jax.errors.TracerIntegerConversionError, TraceIneligibleError):
-                # update body is genuinely un-traceable → latch eager mode for this metric
+                    jax.errors.TracerIntegerConversionError, TraceIneligibleError) as exc:
+                # update body is genuinely un-traceable → latch eager mode for this
+                # metric; warn once per class and log the triggering exception
                 self._jit_failed = True
                 self._jitted_update = None
+                _observe.note_eager_fallback(type(self).__name__, exc)
                 self._update_impl(*args, **kwargs)
+                path = "fallback"
         else:
             self._update_impl(*args, **kwargs)
+        if rec is not None:
+            name = type(self).__name__
+            rec.add_time("update", name, _observe.clock() - t0)
+            rec.add_count("update_" + path, name)
         if self.compute_on_cpu:
             self._move_list_states_to_cpu()
 
@@ -488,8 +517,12 @@ class Metric(ABC):
                 f"The ``compute`` method of metric {type(self).__name__} was called before the ``update`` method.",
                 UserWarning,
             )
+        rec = _observe.RECORDER if _observe.ENABLED else None
         if self.compute_with_cache and self._computed is not None:
+            if rec is not None:
+                rec.add_count("compute_cached", type(self).__name__)
             return self._computed
+        t0 = _observe.clock() if rec is not None else 0.0
         with self.sync_context(
             dist_sync_fn=self.dist_sync_fn,
             process_group=self.process_group,
@@ -498,6 +531,8 @@ class Metric(ABC):
         ):
             value = self._compute_impl()
             value = _squeeze_if_scalar(value)
+        if rec is not None:
+            rec.add_time("compute", type(self).__name__, _observe.clock() - t0)
         if self.compute_with_cache:
             self._computed = value
         return value
@@ -594,9 +629,14 @@ class Metric(ABC):
         # which scales the incoming state by the receiver's history length —
         # distlint merge-equivalence harness, DESIGN §10)
         own_count = self._update_count
+        rec = _observe.RECORDER if _observe.ENABLED else None
+        t0 = _observe.clock() if rec is not None else 0.0
         self.__dict__["_state"] = self._merge_state_dicts(
             incoming_state, self.metric_state, incoming_count, own_count
         )
+        if rec is not None:
+            rec.add_time("merge", type(self).__name__, _observe.clock() - t0)
+            rec.add_count("merge", type(self).__name__)
         self._update_count = own_count + incoming_count
 
     def _copy_state(self) -> Dict[str, Any]:
@@ -657,7 +697,12 @@ class Metric(ABC):
         if not should_sync or not distributed_available:
             return
         self._cache = self._copy_state()
+        rec = _observe.RECORDER if _observe.ENABLED else None
+        t0 = _observe.clock() if rec is not None else 0.0
         self._sync_dist(dist_sync_fn or self.dist_sync_fn, process_group or self.process_group)
+        if rec is not None:
+            rec.add_time("sync", type(self).__name__, _observe.clock() - t0)
+            rec.add_count("sync", type(self).__name__)
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:

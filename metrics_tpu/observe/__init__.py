@@ -1,0 +1,73 @@
+"""metrics_tpu.observe — runtime telemetry and XLA cost profiling (DESIGN §11).
+
+The third subsystem of the tooling triad (correctness → jitlint, distribution
+→ distlint, performance → observe). Two halves:
+
+* **runtime half** (:mod:`metrics_tpu.observe.recorder`) — near-zero-overhead
+  counters/timers/structured events the core runtime reports into: per-metric
+  update/compute wall time, jit compile count vs. cache hits/evictions,
+  retrace causes, eager-fallback latches with the triggering exception, and
+  sync/merge timings. Off by default; one flag check per hot path when off.
+* **static half** (:mod:`metrics_tpu.observe.costs` +
+  :mod:`metrics_tpu.observe.profile`) — XLA cost profiling via
+  ``jax.jit(update).lower(...).cost_analysis()`` over the jit-eligible
+  exported metric classes (FLOPs, bytes accessed, peak memory per compiled
+  update), ratcheted against ``tools/perf_baseline.json`` by the
+  ``profile-metrics`` CLI exactly like the jitlint/distlint baselines.
+
+Quick start::
+
+    from metrics_tpu import observe
+    observe.enable()
+    ...  # run your eval loop
+    print(observe.snapshot()["derived"])   # compile counts, cache hit rate, ...
+    print(observe.prometheus())            # Prometheus text exposition
+
+``costs``/``profile`` load lazily (PEP 562) so the core runtime's unconditional
+``observe.recorder`` import stays free of jax-tracing machinery.
+"""
+
+from metrics_tpu.observe.recorder import (
+    RECORDER,
+    Recorder,
+    disable,
+    enable,
+    enabled,
+    prometheus,
+    record_event,
+    reset,
+    snapshot,
+    snapshot_json,
+)
+
+# submodules (costs/profile/recorder) resolve via __getattr__ below; they are
+# deliberately absent from __all__ — JL006 requires every listed name be bound
+# at module top level, and binding them eagerly would defeat the lazy import
+__all__ = [
+    "RECORDER",
+    "Recorder",
+    "disable",
+    "enable",
+    "enabled",
+    "prometheus",
+    "record_event",
+    "reset",
+    "snapshot",
+    "snapshot_json",
+]
+
+_LAZY_SUBMODULES = ("costs", "profile", "recorder")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        value = importlib.import_module(f"metrics_tpu.observe.{name}")
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module 'metrics_tpu.observe' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_SUBMODULES))

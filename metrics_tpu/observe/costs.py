@@ -1,0 +1,288 @@
+"""Static XLA cost profiling of compiled metric updates (DESIGN §11).
+
+For every jit-eligible exported metric class in :data:`PROFILE_CASES` the
+harness lowers the pure update — ``jax.jit(m._functional_update).lower(state,
+*abstract_args)`` — and reads XLA's own cost model
+(``Lowered.cost_analysis()``: FLOPs + bytes accessed) plus, optionally, the
+compiled executable's memory footprint (``Compiled.memory_analysis()``: peak
+temp/argument/output bytes). Zero data-dependent execution: the numbers are a
+pure function of the program XLA was handed, which is exactly what a perf
+ratchet wants to pin (the harness pattern follows
+``analysis/abstract_contracts.py``; the compiler-first cost accounting follows
+DrJAX's MapReduce-primitive cost model, PAPERS.md).
+
+Each case also reports the *sharing* story: whether the class produces a
+hashable static-config key (``Metric._jit_cache_key``) so N config-equal
+instances replay ONE executable, and — via a tiny real two-instance update
+under the observe runtime — how many compiles two instances actually cost.
+
+Run via ``tools/profile_metrics.py`` / the ``profile-metrics`` console script;
+baselined in ``tools/perf_baseline.json`` (see :mod:`metrics_tpu.observe.profile`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "PROFILE_CASES",
+    "CostReport",
+    "ProfileCase",
+    "collect_cost_report",
+    "profile_case",
+]
+
+# canonical problem sizes — small, TPU-lane-agnostic, matched to the
+# abstract-contracts harness so the two static passes describe the same regime
+_N, _C = 64, 4
+_IMG = (2, 3, 16, 16)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileCase:
+    """One exported Metric class plus a deterministic synthetic batch source."""
+
+    name: str  # exported class name — the baseline key
+    ctor: Callable[[], Any]
+    batch: Callable[[np.random.RandomState], Tuple[Any, ...]]
+
+
+@dataclasses.dataclass
+class CostReport:
+    case: ProfileCase
+    ok: bool
+    cost: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    error: str = ""
+
+
+def _rng(case: ProfileCase) -> np.random.RandomState:
+    return np.random.RandomState(zlib.crc32(case.name.encode()) % (2**31))
+
+
+def _abstract(args: Sequence[Any]) -> List[Any]:
+    return [
+        jax.ShapeDtypeStruct(a.shape, a.dtype) if isinstance(a, (jax.Array, np.ndarray)) else a
+        for a in args
+    ]
+
+
+def profile_case(case: ProfileCase, include_memory: bool = True, dynamic: bool = True) -> CostReport:
+    """Lower one class's update and read XLA's cost model.
+
+    ``dynamic=True`` additionally runs TWO config-equal instances through one
+    real (tiny) update each under the observe runtime and reports the compile
+    count — 1 proves shared-cache sharing works end to end, 2 means every
+    instance pays its own trace+compile (the regression the ratchet exists to
+    catch). ``include_memory=False`` skips backend compilation (lower-only is
+    several times faster; FLOPs/bytes are unaffected).
+    """
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, Metric, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+
+    try:
+        m = case.ctor()
+        if not isinstance(m, Metric):
+            return CostReport(case, ok=False, error=f"{case.name} did not construct a Metric")
+        if type(m).__jit_ineligible__ or m._has_list_state():
+            return CostReport(case, ok=False, error="not jit-eligible (list state or host-side update)")
+        args = case.batch(_rng(case))
+        state = m._fresh_state()
+        lowered = jax.jit(m._functional_update).lower(state, *_abstract(args))
+        analysis = lowered.cost_analysis() or {}
+        if isinstance(analysis, (list, tuple)):  # older jax: one entry per computation
+            analysis = analysis[0] if analysis else {}
+        cost: Dict[str, Any] = {
+            "flops": float(analysis.get("flops", 0.0)),
+            "bytes_accessed": float(analysis.get("bytes accessed", 0.0)),
+            "shareable": m._jit_cache_key() is not None,
+        }
+        if include_memory:
+            mem = lowered.compile().memory_analysis()
+            if mem is not None:
+                cost["peak_memory_bytes"] = int(
+                    getattr(mem, "argument_size_in_bytes", 0)
+                    + getattr(mem, "output_size_in_bytes", 0)
+                    + getattr(mem, "temp_size_in_bytes", 0)
+                )
+        if dynamic:
+            # two fresh instances, a pristine shared cache, real updates: the
+            # observed compile count IS the sharing behavior users get
+            saved_cache = dict(_SHARED_JIT_CACHE)
+            was_enabled = _observe.ENABLED
+            probe = _observe.Recorder()
+            real, _observe.RECORDER = _observe.RECORDER, probe
+            try:
+                clear_jit_cache()
+                _observe.ENABLED = True
+                for inst in (case.ctor(), case.ctor()):
+                    inst.update(*args)
+            finally:
+                _observe.ENABLED = was_enabled
+                _observe.RECORDER = real
+                _SHARED_JIT_CACHE.clear()
+                _SHARED_JIT_CACHE.update(saved_cache)
+            cls_label = type(m).__name__
+            compiles = probe.counters.get(("jit_compile", cls_label), 0) + probe.counters.get(
+                ("jit_compile_unshared", cls_label), 0
+            )
+            cost["compile_count"] = int(compiles)
+            cost["cache_hits"] = int(probe.counters.get(("jit_cache_hit", cls_label), 0))
+            if probe.counters.get(("eager_fallback", cls_label)):
+                return CostReport(case, ok=False, error="update latched eager fallback under jit")
+        return CostReport(case, ok=True, cost=cost)
+    except Exception as exc:  # noqa: BLE001 — the error text IS the result
+        return CostReport(case, ok=False, error=f"{type(exc).__name__}: {exc}")
+
+
+def collect_cost_report(
+    cases: Optional[Sequence[ProfileCase]] = None,
+    include_memory: bool = True,
+    dynamic: bool = True,
+) -> List[CostReport]:
+    """Profile every case; returns all results (callers apply the baseline)."""
+    return [
+        profile_case(c, include_memory=include_memory, dynamic=dynamic)
+        for c in (cases if cases is not None else _cases())
+    ]
+
+
+# --------------------------------------------------------------------------- registry
+def _rand(rng: np.random.RandomState, *shape: int) -> jax.Array:
+    return jnp.asarray(rng.rand(*shape).astype(np.float32))
+
+
+def _randint(rng: np.random.RandomState, hi: int, *shape: int) -> jax.Array:
+    return jnp.asarray(rng.randint(0, hi, shape).astype(np.int32))
+
+
+def _probs(rng: np.random.RandomState, *shape: int) -> jax.Array:
+    p = rng.rand(*shape).astype(np.float32) + 0.05
+    return jnp.asarray(p / p.sum(-1, keepdims=True))
+
+
+def _make_cases() -> List[ProfileCase]:
+    import metrics_tpu as M
+    import metrics_tpu.classification as C
+    import metrics_tpu.segmentation as S
+
+    case = ProfileCase
+    bin_batch = lambda r: (_rand(r, _N), _randint(r, 2, _N))  # noqa: E731
+    reg_batch = lambda r: (_rand(r, _N), _rand(r, _N))  # noqa: E731
+    mc_batch = lambda r: (_probs(r, _N, _C), _randint(r, _C, _N))  # noqa: E731
+    ml_batch = lambda r: (_rand(r, _N, _C), _randint(r, 2, _N, _C))  # noqa: E731
+    img_batch = lambda r: (_rand(r, *_IMG), _rand(r, *_IMG))  # noqa: E731
+    seg_batch = lambda r: (_randint(r, _C, 2, 8, 8), _randint(r, _C, 2, 8, 8))  # noqa: E731
+    nom_batch = lambda r: (_randint(r, _C, _N), _randint(r, _C, _N))  # noqa: E731
+
+    return [
+        # ---- classification (binary) ------------------------------------------
+        case("BinaryAccuracy", C.BinaryAccuracy, bin_batch),
+        case("BinaryPrecision", C.BinaryPrecision, bin_batch),
+        case("BinaryRecall", C.BinaryRecall, bin_batch),
+        case("BinaryF1Score", C.BinaryF1Score, bin_batch),
+        case("BinarySpecificity", C.BinarySpecificity, bin_batch),
+        case("BinaryStatScores", C.BinaryStatScores, bin_batch),
+        case("BinaryHammingDistance", C.BinaryHammingDistance, bin_batch),
+        case("BinaryCohenKappa", C.BinaryCohenKappa, bin_batch),
+        case("BinaryMatthewsCorrCoef", C.BinaryMatthewsCorrCoef, bin_batch),
+        case("BinaryJaccardIndex", C.BinaryJaccardIndex, bin_batch),
+        case("BinaryHingeLoss", C.BinaryHingeLoss, bin_batch),
+        case("BinaryCalibrationError", C.BinaryCalibrationError, bin_batch),
+        case("BinaryAUROC", lambda: C.BinaryAUROC(thresholds=16), bin_batch),
+        case("BinaryAveragePrecision", lambda: C.BinaryAveragePrecision(thresholds=16), bin_batch),
+        case("BinaryNegativePredictiveValue", C.BinaryNegativePredictiveValue, bin_batch),
+        # ---- classification (multiclass / multilabel) -------------------------
+        case("MulticlassAccuracy", lambda: C.MulticlassAccuracy(num_classes=_C), mc_batch),
+        case("MulticlassPrecision", lambda: C.MulticlassPrecision(num_classes=_C), mc_batch),
+        case("MulticlassRecall", lambda: C.MulticlassRecall(num_classes=_C), mc_batch),
+        case("MulticlassF1Score", lambda: C.MulticlassF1Score(num_classes=_C), mc_batch),
+        case("MulticlassConfusionMatrix", lambda: C.MulticlassConfusionMatrix(num_classes=_C), mc_batch),
+        case("MulticlassCohenKappa", lambda: C.MulticlassCohenKappa(num_classes=_C), mc_batch),
+        case("MulticlassAUROC", lambda: C.MulticlassAUROC(num_classes=_C, thresholds=16), mc_batch),
+        case("MulticlassExactMatch", lambda: C.MulticlassExactMatch(num_classes=_C),
+             lambda r: (_randint(r, _C, 8, 6), _randint(r, _C, 8, 6))),
+        case("MultilabelFBetaScore", lambda: C.MultilabelFBetaScore(beta=2.0, num_labels=_C), ml_batch),
+        case("MultilabelAccuracy", lambda: C.MultilabelAccuracy(num_labels=_C), ml_batch),
+        # ---- regression --------------------------------------------------------
+        case("MeanSquaredError", M.MeanSquaredError, reg_batch),
+        case("MeanAbsoluteError", M.MeanAbsoluteError, reg_batch),
+        case("MeanSquaredLogError", M.MeanSquaredLogError, reg_batch),
+        case("MeanAbsolutePercentageError", M.MeanAbsolutePercentageError, reg_batch),
+        case("SymmetricMeanAbsolutePercentageError", M.SymmetricMeanAbsolutePercentageError, reg_batch),
+        case("WeightedMeanAbsolutePercentageError", M.WeightedMeanAbsolutePercentageError, reg_batch),
+        case("ExplainedVariance", M.ExplainedVariance, reg_batch),
+        case("R2Score", M.R2Score, reg_batch),
+        case("PearsonCorrCoef", M.PearsonCorrCoef, reg_batch),
+        case("ConcordanceCorrCoef", M.ConcordanceCorrCoef, reg_batch),
+        case("MinkowskiDistance", lambda: M.MinkowskiDistance(p=3), reg_batch),
+        case("LogCoshError", M.LogCoshError, reg_batch),
+        case("TweedieDevianceScore", lambda: M.TweedieDevianceScore(power=1.5),
+             lambda r: (_rand(r, _N) + 0.1, _rand(r, _N) + 0.1)),
+        case("RelativeSquaredError", M.RelativeSquaredError, reg_batch),
+        case("NormalizedRootMeanSquaredError", M.NormalizedRootMeanSquaredError, reg_batch),
+        case("CosineSimilarity", M.CosineSimilarity, lambda r: (_rand(r, _N, _C), _rand(r, _N, _C))),
+        case("KLDivergence", M.KLDivergence, lambda r: (_probs(r, _N, _C), _probs(r, _N, _C))),
+        # ---- aggregation -------------------------------------------------------
+        case("MeanMetric", M.MeanMetric, lambda r: (_rand(r, _N),)),
+        case("SumMetric", M.SumMetric, lambda r: (_rand(r, _N),)),
+        case("MaxMetric", M.MaxMetric, lambda r: (_rand(r, _N),)),
+        case("MinMetric", M.MinMetric, lambda r: (_rand(r, _N),)),
+        case("RunningMean", lambda: M.RunningMean(window=3), lambda r: (_rand(r, _N),)),
+        # ---- image -------------------------------------------------------------
+        case("PeakSignalNoiseRatio", lambda: M.PeakSignalNoiseRatio(data_range=1.0), img_batch),
+        case("StructuralSimilarityIndexMeasure",
+             lambda: M.StructuralSimilarityIndexMeasure(data_range=1.0), img_batch),
+        case("UniversalImageQualityIndex", M.UniversalImageQualityIndex, img_batch),
+        case("TotalVariation", M.TotalVariation, lambda r: (_rand(r, *_IMG),)),
+        case("SpectralAngleMapper", M.SpectralAngleMapper, img_batch),
+        case("RelativeAverageSpectralError", M.RelativeAverageSpectralError, img_batch),
+        # ---- audio -------------------------------------------------------------
+        case("SignalNoiseRatio", M.SignalNoiseRatio, lambda r: (_rand(r, 2, 256), _rand(r, 2, 256))),
+        case("ScaleInvariantSignalNoiseRatio", M.ScaleInvariantSignalNoiseRatio,
+             lambda r: (_rand(r, 2, 256), _rand(r, 2, 256))),
+        case("ScaleInvariantSignalDistortionRatio", M.ScaleInvariantSignalDistortionRatio,
+             lambda r: (_rand(r, 2, 256), _rand(r, 2, 256))),
+        # ---- nominal -----------------------------------------------------------
+        case("CramersV", lambda: M.CramersV(num_classes=_C), nom_batch),
+        case("TschuprowsT", lambda: M.TschuprowsT(num_classes=_C), nom_batch),
+        case("TheilsU", lambda: M.TheilsU(num_classes=_C), nom_batch),
+        case("PearsonsContingencyCoefficient",
+             lambda: M.PearsonsContingencyCoefficient(num_classes=_C), nom_batch),
+        # ---- segmentation / text ----------------------------------------------
+        case("MeanIoU", lambda: S.MeanIoU(num_classes=_C, input_format="index"), seg_batch),
+        case("GeneralizedDiceScore",
+             lambda: S.GeneralizedDiceScore(num_classes=_C, input_format="index"), seg_batch),
+        case("Perplexity", M.Perplexity, lambda r: (_probs(r, 2, 8, 16), _randint(r, 16, 2, 8))),
+    ]
+
+
+_CASES_CACHE: Optional[List[ProfileCase]] = None
+
+
+def _cases() -> List[ProfileCase]:
+    global _CASES_CACHE
+    if _CASES_CACHE is None:
+        _CASES_CACHE = _make_cases()
+    return _CASES_CACHE
+
+
+class _LazyCases:
+    """Sequence façade over the lazily-built registry (import stays cheap)."""
+
+    def __iter__(self):
+        return iter(_cases())
+
+    def __len__(self):
+        return len(_cases())
+
+    def __getitem__(self, i):
+        return _cases()[i]
+
+
+PROFILE_CASES = _LazyCases()

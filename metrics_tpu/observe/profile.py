@@ -1,0 +1,249 @@
+"""Perf-baseline ratchet over the XLA cost report (DESIGN §11).
+
+``tools/perf_baseline.json`` pins, per jit-eligible exported metric class, the
+XLA cost model of its compiled update (FLOPs, bytes accessed, peak memory) and
+its jit-cache sharing behavior (``shareable`` + observed ``compile_count`` for
+two config-equal instances). The check ratchets exactly like the
+jitlint/distlint baselines:
+
+* a class whose FLOPs or bytes grow beyond ``tolerance``× its baseline — or
+  whose update stops sharing one compiled executable across instances — is a
+  **regression** (exit 1);
+* a class that *improved* beyond tolerance, or vanished from the registry, is
+  reported **stale** so the baseline ratchets down over time (exit 0);
+* classes with no baseline entry are reported as **new** (exit 0; record them
+  with ``--update-baseline``).
+
+FLOPs/bytes come from XLA's cost model over the lowered (pre-optimization)
+HLO, so they are deterministic per jax version — the default 1.5× tolerance
+absorbs cost-model drift across versions while still failing a genuinely
+doubled kernel. Peak memory is recorded for attribution but not ratcheted
+(it tracks backend packing decisions, not the program we authored).
+
+CLI: ``python tools/profile_metrics.py`` / the ``profile-metrics`` console
+script; also runs as the ``perf`` pass of ``tools/lint_metrics.py --all``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from metrics_tpu.observe.costs import CostReport, collect_cost_report
+
+__all__ = [
+    "DEFAULT_TOLERANCE",
+    "diff_cost_baseline",
+    "load_cost_baseline",
+    "main",
+    "run_perf_check",
+    "write_cost_baseline",
+]
+
+DEFAULT_TOLERANCE = 1.5
+_DEFAULT_BASELINE = os.path.join("tools", "perf_baseline.json")
+_RATCHETED = ("flops", "bytes_accessed")
+
+
+def report_to_dict(results: Sequence[CostReport]) -> Dict[str, Dict[str, Any]]:
+    """``{class name: cost dict}`` for the successful cases (the baseline shape)."""
+    return {r.case.name: dict(r.cost) for r in results if r.ok}
+
+
+def load_cost_baseline(path: str) -> Dict[str, Dict[str, Any]]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): dict(v) for k, v in data.get("cost", {}).items()}
+
+
+def write_cost_baseline(path: str, results: Sequence[CostReport]) -> Dict[str, Dict[str, Any]]:
+    cost = dict(sorted(report_to_dict(results).items()))
+    payload: Dict[str, Any] = {
+        "comment": "perf baseline — XLA cost model per compiled metric update, keyed by exported "
+                   "class name. Regenerate with `python tools/profile_metrics.py --update-baseline`.",
+        "tolerance": DEFAULT_TOLERANCE,
+        "cost": cost,
+    }
+    if os.path.exists(path):  # preserve sibling sections, mirroring engine.write_baseline
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                existing = json.load(fh)
+            for k, v in existing.items():
+                if k not in ("comment", "cost", "tolerance"):
+                    payload[k] = v
+        except (OSError, ValueError):
+            pass
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return cost
+
+
+def diff_cost_baseline(
+    results: Sequence[CostReport],
+    baseline: Dict[str, Dict[str, Any]],
+    tolerance: float = DEFAULT_TOLERANCE,
+) -> Tuple[List[str], List[str], List[str]]:
+    """Split into (regressions, stale_keys, new_keys); regressions fail the run."""
+    regressions: List[str] = []
+    new: List[str] = []
+    observed = report_to_dict(results)
+    for name, cost in sorted(observed.items()):
+        base = baseline.get(name)
+        if base is None:
+            new.append(name)
+            continue
+        for field in _RATCHETED:
+            cur, ref = float(cost.get(field, 0.0)), float(base.get(field, 0.0))
+            if ref > 0 and cur > ref * tolerance:
+                regressions.append(f"{name}: {field} {cur:.0f} > {tolerance}x baseline {ref:.0f}")
+            elif ref == 0 and cur > 0 and field == "flops":
+                regressions.append(f"{name}: {field} appeared ({cur:.0f}) where baseline had none")
+        if base.get("shareable") and not cost.get("shareable"):
+            regressions.append(f"{name}: update no longer shareable (jit-cache key became unhashable)")
+        # compile_count 0 means the class updates eagerly by design (e.g. the
+        # aggregation metrics' host-scalar path) — starting to compile is not a
+        # sharing regression, so only ratchet from a baseline of >= 1
+        base_compiles = base.get("compile_count")
+        cur_compiles = cost.get("compile_count")
+        if base_compiles and cur_compiles is not None and cur_compiles > base_compiles:
+            regressions.append(
+                f"{name}: {cur_compiles} compiles for two config-equal instances "
+                f"(baseline {base_compiles}) — jit-cache sharing broke"
+            )
+    stale: List[str] = []
+    for name, base in sorted(baseline.items()):
+        cost = observed.get(name)
+        if cost is None:
+            stale.append(f"{name}: in baseline but not profiled (class removed or now ineligible)")
+            continue
+        for field in _RATCHETED:
+            cur, ref = float(cost.get(field, 0.0)), float(base.get(field, 0.0))
+            if cur > 0 and ref > cur * tolerance:
+                stale.append(f"{name}: {field} improved {ref:.0f} -> {cur:.0f}; ratchet the baseline down")
+    return regressions, stale, new
+
+
+def run_perf_check(
+    root: str,
+    baseline_path: Optional[str] = None,
+    tolerance: float = DEFAULT_TOLERANCE,
+    include_memory: bool = False,
+    update_baseline: bool = False,
+    quiet: bool = False,
+) -> int:
+    """The ``perf`` pass of ``lint_metrics --all``: profile, ratchet, one verdict line."""
+    path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
+    results = collect_cost_report(include_memory=include_memory)
+    failures = [r for r in results if not r.ok]
+    if update_baseline:
+        cost = write_cost_baseline(path, results)
+        if not quiet:
+            print(f"perf: baseline written to {path} ({len(cost)} classes)")
+        return 0
+    regressions, stale, new = diff_cost_baseline(results, load_cost_baseline(path), tolerance)
+    for line in regressions:
+        print(f"perf: REGRESSION {line}")
+    if not quiet:
+        for line in stale:
+            print(f"perf: stale baseline entry: {line}")
+        for name in new:
+            print(f"perf: new class not in baseline: {name} (record with --update-baseline)")
+        for r in failures:
+            print(f"perf: skipped {r.case.name}: {r.error}")
+        ok = sum(1 for r in results if r.ok)
+        print(f"perf: {ok}/{len(results)} classes profiled, {len(regressions)} regression(s), "
+              f"{len(stale)} stale, {len(new)} new")
+    return 1 if regressions else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="profile-metrics",
+        description="XLA cost profiling of compiled metric updates (FLOPs / bytes accessed / "
+                    "peak memory / jit-cache sharing), ratcheted against tools/perf_baseline.json.",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="perf baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record the current cost report as the new baseline and exit 0")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help=f"allowed growth factor per ratcheted field (default {DEFAULT_TOLERANCE})")
+    p.add_argument("--classes", default=None,
+                   help="comma-separated class names to profile (default: the full registry)")
+    p.add_argument("--no-memory", action="store_true",
+                   help="skip backend compilation (no peak-memory column; several times faster)")
+    p.add_argument("--static-only", action="store_true",
+                   help="skip the dynamic two-instance sharing probe (no compile_count column)")
+    p.add_argument("--format", choices=("text", "json"), default="text", dest="fmt")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the report body and summary")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    baseline_path = args.baseline or os.path.join(root, _DEFAULT_BASELINE)
+
+    from metrics_tpu.observe.costs import PROFILE_CASES
+
+    cases = list(PROFILE_CASES)
+    if args.classes:
+        wanted = {c.strip() for c in args.classes.split(",") if c.strip()}
+        cases = [c for c in cases if c.name in wanted]
+        missing = wanted - {c.name for c in cases}
+        if missing:
+            print(f"profile-metrics: unknown class(es): {', '.join(sorted(missing))}")
+            return 2
+    results = collect_cost_report(
+        cases, include_memory=not args.no_memory, dynamic=not args.static_only
+    )
+
+    if args.update_baseline:
+        cost = write_cost_baseline(baseline_path, results)
+        if not args.quiet:
+            print(f"profile-metrics: baseline written to {baseline_path} ({len(cost)} classes)")
+        return 0
+
+    baseline = load_cost_baseline(baseline_path)
+    regressions, stale, new = diff_cost_baseline(results, baseline, args.tolerance)
+    failures = [r for r in results if not r.ok]
+
+    if args.fmt == "json":
+        print(json.dumps({
+            "cost": report_to_dict(results),
+            "errors": {r.case.name: r.error for r in failures},
+            "regressions": regressions,
+            "stale": stale,
+            "new": new,
+        }, indent=2, sort_keys=True))
+        return 1 if regressions else 0
+
+    if not args.quiet:
+        header = f"{'class':<40} {'flops':>12} {'bytes':>12} {'peak_mem':>10} {'compiles':>8} {'shared':>6}"
+        print(header)
+        print("-" * len(header))
+        for r in sorted(results, key=lambda r: r.case.name):
+            if not r.ok:
+                print(f"{r.case.name:<40} SKIP: {r.error}")
+                continue
+            c = r.cost
+            print(f"{r.case.name:<40} {c.get('flops', 0):>12.0f} {c.get('bytes_accessed', 0):>12.0f} "
+                  f"{c.get('peak_memory_bytes', '-'):>10} {c.get('compile_count', '-'):>8} "
+                  f"{str(c.get('shareable', '-')):>6}")
+    for line in regressions:
+        print(f"REGRESSION {line}")
+    if not args.quiet:
+        for line in stale:
+            print(f"stale: {line}")
+        for name in new:
+            print(f"new (not in baseline): {name}")
+        ok = sum(1 for r in results if r.ok)
+        print(f"profile-metrics: {ok}/{len(results)} classes profiled, {len(regressions)} regression(s), "
+              f"{len(stale)} stale, {len(new)} new")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,318 @@
+"""Near-zero-overhead runtime telemetry for the metrics_tpu runtime (DESIGN §11).
+
+The runtime makes invisible performance decisions — shared-jit cache
+hits/evictions (``metric.py:_lookup_shared_jit``), silent eager-fallback
+latching (``metric.py:_wrapped_update``), compute-group fusion
+(``collections.py:_fused_group_update``), cross-replica sync
+(``parallel/sync.py``) — that determine whether an update loop runs as one XLA
+dispatch or a Python interpreter crawl. This module makes them observable:
+
+* **counters** — monotonically increasing ``(name, label)`` integers:
+  compiles, cache hits, evictions, fallback latches, per-path update counts;
+* **timers** — ``(name, label)`` wall-time aggregates (count/total/min/max)
+  over host-side ``update``/``compute``/``sync``/``merge`` dispatch;
+* **events** — a bounded structured log (ring buffer) carrying the *causes*:
+  which exception latched an eager fallback, why a class recompiled
+  (new config vs. cache eviction), when the cache was cleared.
+
+Overhead contract: with observability **disabled (the default)** every
+instrumented hot path pays a single module-flag check (``ENABLED``) and
+allocates nothing — verified by ``tests/test_observe_disabled.py``. Timers
+measure *host-side* wall time around (async) dispatch: the first call of a
+compiled update includes its trace+compile cost, so a retrace storm shows up
+as a fat ``max_s`` even though steady-state dispatch is microseconds.
+
+Everything here is import-light (stdlib only; jax is only touched lazily via
+``rank_zero_warn``'s process probe) so the core runtime can import it
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+__all__ = [
+    "RECORDER",
+    "Recorder",
+    "disable",
+    "enable",
+    "enabled",
+    "note_eager_fallback",
+    "note_fused_compile",
+    "note_fused_fallback",
+    "note_jit_cache_cleared",
+    "note_jit_cache_hit",
+    "note_jit_compile",
+    "note_jit_eviction",
+    "prometheus",
+    "record_event",
+    "reset",
+    "snapshot",
+    "snapshot_json",
+]
+
+# Module-level fast flag: hot paths read this ONE attribute and skip all
+# instrumentation when False. Mutated only via enable()/disable().
+ENABLED = False
+
+clock: Callable[[], float] = time.perf_counter
+
+# counter names owned by the shared-jit cache — cleared together with it so
+# `clear_jit_cache()` leaves counters consistent with the (now empty) cache
+_JIT_CACHE_COUNTERS = ("jit_compile", "jit_compile_unshared", "jit_cache_hit", "jit_cache_eviction")
+
+# one warning per metric class across the process, independent of ENABLED —
+# losing compiled updates is user-facing even when telemetry is off
+_FALLBACK_WARNED: set = set()
+
+
+class Recorder:
+    """Holds all telemetry. Internal containers start empty and stay empty while
+    disabled (the zero-allocation half of the overhead contract)."""
+
+    __slots__ = ("counters", "timers", "events", "max_events", "_seq", "_compiled", "_evicted", "_lock")
+
+    def __init__(self, max_events: int = 1024) -> None:
+        self.counters: Dict[Tuple[str, str], int] = {}
+        self.timers: Dict[Tuple[str, str], List[float]] = {}  # [count, total, min, max]
+        self.events: Deque[Dict[str, Any]] = deque(maxlen=max_events)
+        self.max_events = max_events
+        self._seq = 0
+        self._compiled: Dict[str, int] = {}  # metric class -> distinct shared compiles
+        self._evicted: set = set()  # metric classes whose executables were evicted
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ primitives
+    def add_count(self, name: str, label: str, n: int = 1) -> None:
+        key = (name, label)
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def add_time(self, name: str, label: str, seconds: float) -> None:
+        key = (name, label)
+        with self._lock:
+            agg = self.timers.get(key)
+            if agg is None:
+                self.timers[key] = [1, seconds, seconds, seconds]
+            else:
+                agg[0] += 1
+                agg[1] += seconds
+                agg[2] = min(agg[2], seconds)
+                agg[3] = max(agg[3], seconds)
+
+    def add_event(self, kind: str, **fields: Any) -> None:
+        with self._lock:
+            self._seq += 1
+            self.events.append({"seq": self._seq, "kind": kind, **fields})
+
+    def clear(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.timers.clear()
+            self.events.clear()
+            self._seq = 0
+            self._compiled.clear()
+            self._evicted.clear()
+
+    def clear_jit_cache_stats(self) -> None:
+        """Reset the shared-jit-cache counters (the cache itself was just cleared)."""
+        with self._lock:
+            for key in [k for k in self.counters if k[0] in _JIT_CACHE_COUNTERS]:
+                del self.counters[key]
+            self._compiled.clear()
+            self._evicted.clear()
+
+
+RECORDER = Recorder()
+
+
+# ---------------------------------------------------------------------- lifecycle
+def enable(max_events: int = 1024) -> None:
+    """Turn telemetry collection on (counters/timers/events start accumulating)."""
+    global ENABLED
+    RECORDER.max_events = max_events
+    if RECORDER.events.maxlen != max_events:
+        RECORDER.events = deque(RECORDER.events, maxlen=max_events)
+    ENABLED = True
+
+
+def disable() -> None:
+    """Turn telemetry collection off (recorded data is kept until :func:`reset`)."""
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def reset(include_warnings: bool = False) -> None:
+    """Drop all recorded telemetry; optionally re-arm the one-time fallback warnings."""
+    RECORDER.clear()
+    if include_warnings:
+        _FALLBACK_WARNED.clear()
+
+
+def record_event(kind: str, **fields: Any) -> None:
+    """Append a structured event to the log (no-op while disabled)."""
+    if ENABLED:
+        RECORDER.add_event(kind, **fields)
+
+
+# ------------------------------------------------------------------- runtime hooks
+# Called by metric.py / collections.py / parallel/sync.py. All are no-ops while
+# disabled except note_eager_fallback's one-time user warning.
+def note_jit_compile(metric: str, shared: bool = True) -> None:
+    if not ENABLED:
+        return
+    if not shared:
+        RECORDER.add_count("jit_compile_unshared", metric)
+        RECORDER.add_event("jit_compile", metric=metric, shared=False)
+        return
+    RECORDER.add_count("jit_compile", metric)
+    prior = RECORDER._compiled.get(metric, 0)
+    RECORDER._compiled[metric] = prior + 1
+    if metric in RECORDER._evicted:
+        RECORDER.add_event("recompile", metric=metric, cause="after_eviction")
+    elif prior:
+        RECORDER.add_event("recompile", metric=metric, cause="new_config")
+    else:
+        RECORDER.add_event("jit_compile", metric=metric, shared=True)
+
+
+def note_jit_cache_hit(metric: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("jit_cache_hit", metric)
+
+
+def note_jit_eviction(metric: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("jit_cache_eviction", metric)
+        RECORDER._evicted.add(metric)
+        RECORDER.add_event("jit_cache_evict", metric=metric)
+
+
+def note_jit_cache_cleared() -> None:
+    """The shared cache was dropped: its counters reset with it so hit rates and
+    compile counts keep describing the cache that actually exists."""
+    RECORDER.clear_jit_cache_stats()
+    if ENABLED:
+        RECORDER.add_event("jit_cache_clear")
+
+
+def note_eager_fallback(metric: str, exc: BaseException) -> None:
+    """A tracer error latched ``_jit_failed``: warn ONCE per class (always), and
+    record the triggering exception class in the event log (when enabled)."""
+    if metric not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(metric)
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(
+            f"Metric {metric!r} could not be jit-compiled ({type(exc).__name__}) and has "
+            "latched eager-mode updates for this instance's lifetime. Its update loop now "
+            "runs per-op on the host instead of as one XLA executable. See "
+            "`metrics_tpu.observe.snapshot()` for details.",
+            UserWarning,
+        )
+    if ENABLED:
+        RECORDER.add_count("eager_fallback", metric)
+        RECORDER.add_event("eager_fallback", metric=metric, error=type(exc).__name__, detail=str(exc)[:200])
+
+
+def note_fused_compile(n_leaders: int, shared: bool) -> None:
+    if ENABLED:
+        RECORDER.add_count("fused_compile", str(n_leaders))
+        RECORDER.add_event("fused_compile", leaders=n_leaders, shared=shared)
+
+
+def note_fused_fallback(n_leaders: int, exc: BaseException) -> None:
+    if ENABLED:
+        RECORDER.add_count("fused_fallback", str(n_leaders))
+        RECORDER.add_event("fused_fallback", leaders=n_leaders, error=type(exc).__name__)
+
+
+# ------------------------------------------------------------------ export surfaces
+def snapshot() -> Dict[str, Any]:
+    """One JSON-able dict of everything recorded so far.
+
+    Schema (stable — tests/test_observe_runtime.py pins it)::
+
+        {"enabled": bool,
+         "counters": {name: {label: int}},
+         "timers":   {name: {label: {"count", "total_s", "mean_s", "min_s", "max_s"}}},
+         "events":   [{"seq", "kind", ...}, ...],
+         "derived":  {"jit_cache_hit_rate": float|None,
+                      "jit_compiles_total": int, "jit_cache_hits_total": int,
+                      "jit_cache_evictions_total": int, "eager_fallbacks_total": int}}
+    """
+    with RECORDER._lock:
+        counters: Dict[str, Dict[str, int]] = {}
+        for (name, label), v in RECORDER.counters.items():
+            counters.setdefault(name, {})[label] = v
+        timers: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for (name, label), (count, total, mn, mx) in RECORDER.timers.items():
+            timers.setdefault(name, {})[label] = {
+                "count": int(count),
+                "total_s": total,
+                "mean_s": total / count if count else 0.0,
+                "min_s": mn,
+                "max_s": mx,
+            }
+        events = list(RECORDER.events)
+    compiles = sum(counters.get("jit_compile", {}).values())
+    hits = sum(counters.get("jit_cache_hit", {}).values())
+    lookups = compiles + hits
+    return {
+        "enabled": ENABLED,
+        "counters": {k: dict(sorted(v.items())) for k, v in sorted(counters.items())},
+        "timers": {k: dict(sorted(v.items())) for k, v in sorted(timers.items())},
+        "events": events,
+        "derived": {
+            "jit_cache_hit_rate": (hits / lookups) if lookups else None,
+            "jit_compiles_total": compiles,
+            "jit_cache_hits_total": hits,
+            "jit_cache_evictions_total": sum(counters.get("jit_cache_eviction", {}).values()),
+            "eager_fallbacks_total": sum(counters.get("eager_fallback", {}).values()),
+        },
+    }
+
+
+def _prom_name(name: str) -> str:
+    return "metrics_tpu_" + "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_label(label: str) -> str:
+    return label.replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+
+def prometheus() -> str:
+    """Prometheus text-exposition dump of the counters and timers.
+
+    Counters render as ``*_total`` counter families; timers as summary-style
+    ``*_seconds_count`` / ``*_seconds_sum`` pairs — ready for a textfile
+    collector or a scrape handler.
+    """
+    snap = snapshot()
+    lines: List[str] = []
+    for name, by_label in snap["counters"].items():
+        prom = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {prom} counter")
+        for label, v in by_label.items():
+            lines.append(f'{prom}{{metric="{_prom_label(label)}"}} {v}')
+    for name, by_label in snap["timers"].items():
+        prom = _prom_name(name) + "_seconds"
+        lines.append(f"# TYPE {prom} summary")
+        for label, agg in by_label.items():
+            sel = f'{{metric="{_prom_label(label)}"}}'
+            lines.append(f"{prom}_count{sel} {agg['count']}")
+            lines.append(f"{prom}_sum{sel} {agg['total_s']:.9f}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def snapshot_json(**dump_kwargs: Any) -> str:
+    """:func:`snapshot` serialized to a JSON string (convenience for logging)."""
+    return json.dumps(snapshot(), **dump_kwargs)

@@ -27,6 +27,7 @@ import numpy as np
 from jax import Array, lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
 
@@ -100,24 +101,27 @@ def sync_states(
                 "the reduction as associative+commutative, or gather with dist_reduce_fx=None/'cat' "
                 "and finish the order-sensitive fold on the host."
             )
-        if fx is dim_zero_sum or fx == "sum":
-            out[name] = lax.psum(value, axis_name)
-        elif fx is dim_zero_mean or fx == "mean":
-            out[name] = lax.pmean(value, axis_name)
-        elif fx is dim_zero_max or fx == "max":
-            out[name] = lax.pmax(value, axis_name)
-        elif fx is dim_zero_min or fx == "min":
-            out[name] = lax.pmin(value, axis_name)
-        elif fx is dim_zero_cat or fx == "cat":
-            v = jnp.concatenate([jnp.atleast_1d(x) for x in value]) if isinstance(value, list) else value
-            gathered = lax.all_gather(v, axis_name)  # (world, ...) → concat along sample dim
-            out[name] = gathered.reshape((-1,) + gathered.shape[2:])
-        elif fx is None:
-            out[name] = lax.all_gather(value, axis_name)
-        elif callable(fx):
-            out[name] = fx(lax.all_gather(value, axis_name))
-        else:  # pragma: no cover
-            raise TypeError(f"Unsupported dist_reduce_fx for state {name!r}: {fx}")
+        # named scopes are trace-safe: profiler timelines and HLO dumps attribute
+        # each collective to the state it reduces (DESIGN §11)
+        with jax.named_scope(f"sync_states.{name}"):
+            if fx is dim_zero_sum or fx == "sum":
+                out[name] = lax.psum(value, axis_name)
+            elif fx is dim_zero_mean or fx == "mean":
+                out[name] = lax.pmean(value, axis_name)
+            elif fx is dim_zero_max or fx == "max":
+                out[name] = lax.pmax(value, axis_name)
+            elif fx is dim_zero_min or fx == "min":
+                out[name] = lax.pmin(value, axis_name)
+            elif fx is dim_zero_cat or fx == "cat":
+                v = jnp.concatenate([jnp.atleast_1d(x) for x in value]) if isinstance(value, list) else value
+                gathered = lax.all_gather(v, axis_name)  # (world, ...) → concat along sample dim
+                out[name] = gathered.reshape((-1,) + gathered.shape[2:])
+            elif fx is None:
+                out[name] = lax.all_gather(value, axis_name)
+            elif callable(fx):
+                out[name] = fx(lax.all_gather(value, axis_name))
+            else:  # pragma: no cover
+                raise TypeError(f"Unsupported dist_reduce_fx for state {name!r}: {fx}")
     return out
 
 
@@ -136,6 +140,8 @@ def allreduce_over_mesh(
     (``tests/unittests/conftest.py:47-84``).
     """
     n = len(per_rank_states)
+    rec = _observe.RECORDER if _observe.ENABLED else None
+    t0 = _observe.clock() if rec is not None else 0.0
     if mesh is None:
         mesh = build_mesh((axis_name,), devices=jax.devices()[:n])
     # list states: pre-concat per rank (reference metric.py:506-507), pad to common capacity
@@ -208,6 +214,9 @@ def allreduce_over_mesh(
         else:
             # cat: (world*cap, ...) rank-major concat: splice out the valid spans
             synced[k] = jnp.concatenate([v[r * cap : r * cap + dims[r]] for r in range(n)])
+    if rec is not None:
+        rec.add_time("allreduce", axis_name, _observe.clock() - t0)
+        rec.add_count("allreduce", axis_name)
     return synced
 
 
@@ -222,6 +231,8 @@ def gather_all_states(states: List[Any], group: Any = None) -> List[List[Any]]:
         return [[s] for s in states]
     from jax.experimental import multihost_utils
 
+    rec = _observe.RECORDER if _observe.ENABLED else None
+    t0 = _observe.clock() if rec is not None else 0.0
     world = jax.process_count()
     out: List[List[Any]] = []
     for s in states:
@@ -240,6 +251,9 @@ def gather_all_states(states: List[Any], group: Any = None) -> List[List[Any]]:
         padded = jnp.pad(s, pad)
         gathered = multihost_utils.process_allgather(padded)
         out.append([gathered[i, : int(sizes[i])] for i in range(world)])
+    if rec is not None:
+        rec.add_time("gather_all", str(world), _observe.clock() - t0)
+        rec.add_count("gather_all", str(world))
     return out
 
 

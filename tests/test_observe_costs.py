@@ -1,0 +1,227 @@
+"""Static XLA cost profiling + perf-baseline ratchet coverage
+(``metrics_tpu.observe.costs`` / ``.profile``, DESIGN §11).
+
+The full-registry run lives in ``tools/profile_metrics.py`` (CI); here we pin
+the harness semantics on a small subset plus the pure ratchet logic against
+synthetic baselines, and that the checked-in ``tools/perf_baseline.json``
+actually covers the acceptance floor of 40 exported classes.
+"""
+
+import json
+import os
+
+import pytest
+
+from metrics_tpu.observe import profile as profile_mod
+from metrics_tpu.observe.costs import (
+    PROFILE_CASES,
+    CostReport,
+    ProfileCase,
+    collect_cost_report,
+    profile_case,
+)
+from metrics_tpu.observe.profile import (
+    diff_cost_baseline,
+    load_cost_baseline,
+    report_to_dict,
+    write_cost_baseline,
+)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BASELINE = os.path.join(_REPO_ROOT, "tools", "perf_baseline.json")
+
+
+def _case(name):
+    matches = [c for c in PROFILE_CASES if c.name == name]
+    assert matches, f"{name} not in PROFILE_CASES"
+    return matches[0]
+
+
+def _fake_report(name, **cost):
+    case = ProfileCase(name=name, ctor=lambda: None, batch=lambda r: ())
+    return CostReport(case, ok=True, cost=cost)
+
+
+# --------------------------------------------------------------------- harness
+def test_registry_covers_acceptance_floor_with_unique_names():
+    names = [c.name for c in PROFILE_CASES]
+    assert len(names) == len(set(names))
+    assert len(names) >= 40
+
+
+def test_profile_case_static_costs():
+    r = profile_case(_case("MeanSquaredError"), include_memory=False, dynamic=False)
+    assert r.ok, r.error
+    assert r.cost["flops"] > 0
+    assert r.cost["bytes_accessed"] > 0
+    assert r.cost["shareable"] is True
+    assert "compile_count" not in r.cost  # dynamic probe skipped
+
+
+def test_profile_case_dynamic_probe_observes_sharing():
+    r = profile_case(_case("BinaryAccuracy"), include_memory=False, dynamic=True)
+    assert r.ok, r.error
+    # two config-equal instances -> ONE compile, second is a cache hit
+    assert r.cost["compile_count"] == 1
+    assert r.cost["cache_hits"] == 1
+
+
+def test_profile_case_memory_analysis():
+    r = profile_case(_case("MeanSquaredError"), include_memory=True, dynamic=False)
+    assert r.ok, r.error
+    assert r.cost["peak_memory_bytes"] > 0
+
+
+def test_profile_case_is_deterministic():
+    a = profile_case(_case("BinaryAccuracy"), include_memory=False, dynamic=False)
+    b = profile_case(_case("BinaryAccuracy"), include_memory=False, dynamic=False)
+    assert a.cost == b.cost
+
+
+def test_profile_case_rejects_list_state_metrics():
+    import metrics_tpu as M
+
+    case = ProfileCase(
+        name="CosineSimilarity", ctor=M.CosineSimilarity, batch=lambda r: ()
+    )
+    r = profile_case(case, include_memory=False, dynamic=False)
+    assert not r.ok
+    assert "not jit-eligible" in r.error
+
+
+def test_dynamic_probe_leaves_globals_untouched():
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as rec_mod
+
+    clear_jit_cache()
+    import metrics_tpu as M
+
+    m = M.MeanSquaredError()
+    import jax.numpy as jnp
+
+    m.update(jnp.asarray([0.1]), jnp.asarray([0.2]))  # seed one real cache entry
+    before_keys = set(_SHARED_JIT_CACHE)
+    was_enabled, real = rec_mod.ENABLED, rec_mod.RECORDER
+    profile_case(_case("BinaryAccuracy"), include_memory=False, dynamic=True)
+    assert set(_SHARED_JIT_CACHE) == before_keys
+    assert rec_mod.ENABLED is was_enabled
+    assert rec_mod.RECORDER is real
+    clear_jit_cache()
+
+
+# --------------------------------------------------------------------- ratchet
+def test_diff_classifies_regressions_stale_and_new():
+    results = [
+        _fake_report("Flat", flops=100.0, bytes_accessed=100.0, shareable=True),
+        _fake_report("Fatter", flops=200.0, bytes_accessed=100.0, shareable=True),
+        _fake_report("Slimmer", flops=10.0, bytes_accessed=100.0, shareable=True),
+        _fake_report("Fresh", flops=5.0, bytes_accessed=5.0, shareable=True),
+    ]
+    baseline = {
+        "Flat": {"flops": 100.0, "bytes_accessed": 100.0, "shareable": True},
+        "Fatter": {"flops": 100.0, "bytes_accessed": 100.0, "shareable": True},
+        "Slimmer": {"flops": 100.0, "bytes_accessed": 100.0, "shareable": True},
+        "Gone": {"flops": 1.0, "bytes_accessed": 1.0, "shareable": True},
+    }
+    regressions, stale, new = diff_cost_baseline(results, baseline, tolerance=1.5)
+    assert len(regressions) == 1 and regressions[0].startswith("Fatter: flops")
+    assert any(s.startswith("Slimmer: flops improved") for s in stale)
+    assert any(s.startswith("Gone:") for s in stale)
+    assert new == ["Fresh"]
+
+
+def test_diff_within_tolerance_is_clean():
+    results = [_fake_report("A", flops=140.0, bytes_accessed=70.0, shareable=True)]
+    baseline = {"A": {"flops": 100.0, "bytes_accessed": 100.0, "shareable": True}}
+    regressions, stale, new = diff_cost_baseline(results, baseline, tolerance=1.5)
+    assert regressions == [] and stale == [] and new == []
+
+
+def test_diff_flags_lost_shareability_and_extra_compiles():
+    results = [
+        _fake_report("A", flops=1.0, bytes_accessed=1.0, shareable=False),
+        _fake_report("B", flops=1.0, bytes_accessed=1.0, shareable=True, compile_count=2),
+        _fake_report("C", flops=1.0, bytes_accessed=1.0, shareable=True, compile_count=1),
+    ]
+    baseline = {
+        "A": {"flops": 1.0, "bytes_accessed": 1.0, "shareable": True},
+        "B": {"flops": 1.0, "bytes_accessed": 1.0, "shareable": True, "compile_count": 1},
+        # eager-by-design class starting to compile is NOT a sharing regression
+        "C": {"flops": 1.0, "bytes_accessed": 1.0, "shareable": True, "compile_count": 0},
+    }
+    regressions, _, _ = diff_cost_baseline(results, baseline, tolerance=1.5)
+    assert len(regressions) == 2
+    assert any("no longer shareable" in r for r in regressions)
+    assert any("jit-cache sharing broke" in r for r in regressions)
+
+
+def test_write_baseline_roundtrip_preserves_siblings(tmp_path):
+    path = str(tmp_path / "perf_baseline.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"cost": {}, "extra_section": {"keep": 1}}, fh)
+    results = [_fake_report("A", flops=2.0, bytes_accessed=4.0, shareable=True)]
+    write_cost_baseline(path, results)
+    assert load_cost_baseline(path) == report_to_dict(results)
+    with open(path, "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    assert payload["extra_section"] == {"keep": 1}
+    assert "tolerance" in payload and "comment" in payload
+
+
+def test_missing_baseline_loads_empty(tmp_path):
+    assert load_cost_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# ------------------------------------------------------- checked-in baseline/CLI
+def test_checked_in_baseline_covers_40_classes_with_required_fields():
+    baseline = load_cost_baseline(_BASELINE)
+    assert len(baseline) >= 40
+    registry = {c.name for c in PROFILE_CASES}
+    for name, cost in baseline.items():
+        assert name in registry, f"baseline entry {name} has no registry case"
+        assert cost["flops"] >= 0 and cost["bytes_accessed"] > 0
+        assert isinstance(cost["shareable"], bool)
+        assert "compile_count" in cost and "peak_memory_bytes" in cost
+
+
+def test_sample_classes_match_checked_in_baseline():
+    """The real ratchet, on a fast subset: current code must not regress the
+    checked-in numbers (the full sweep runs in tools/profile_metrics.py)."""
+    names = ("BinaryAccuracy", "MeanSquaredError", "MulticlassAccuracy", "SumMetric")
+    results = collect_cost_report(
+        [_case(n) for n in names], include_memory=False, dynamic=False
+    )
+    assert all(r.ok for r in results), [r.error for r in results]
+    regressions, _, new = diff_cost_baseline(results, load_cost_baseline(_BASELINE))
+    assert regressions == []
+    assert new == []  # all four are baselined
+
+
+def test_cli_subset_run_is_clean():
+    rc = profile_mod.main([
+        "--root", _REPO_ROOT, "--classes", "BinaryAccuracy,MeanSquaredError",
+        "--no-memory", "--static-only", "-q",
+    ])
+    assert rc == 0
+
+
+def test_cli_rejects_unknown_class():
+    rc = profile_mod.main(["--root", _REPO_ROOT, "--classes", "NoSuchMetric", "-q"])
+    assert rc == 2
+
+
+def test_cli_regression_exit_code(tmp_path):
+    # a baseline claiming tiny costs forces a regression verdict on real numbers
+    path = str(tmp_path / "baseline.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"cost": {"MeanSquaredError": {"flops": 1.0, "bytes_accessed": 1.0,
+                                                 "shareable": True}}}, fh)
+    rc = profile_mod.main([
+        "--root", _REPO_ROOT, "--baseline", path, "--classes", "MeanSquaredError",
+        "--no-memory", "--static-only", "-q",
+    ])
+    assert rc == 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,158 @@
+"""The overhead contract of the observe runtime while DISABLED (the default):
+one module-flag check per hot path, zero telemetry allocations, and numerically
+identical metric behavior with telemetry on or off (DESIGN §11; companion to
+``tests/test_jit_toggles.py`` for the jit controls)."""
+
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.metric as metric_mod
+from metrics_tpu import Metric, observe
+from metrics_tpu.metric import clear_jit_cache
+from metrics_tpu.observe import recorder as rec_mod
+
+
+class DisSum(Metric):
+    full_state_update = False
+    traces = 0
+
+    def __init__(self, scale: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        type(self).traces += 1
+        self.total = self.total + self.scale * jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.total
+
+
+@pytest.fixture(autouse=True)
+def _pristine_disabled():
+    clear_jit_cache()
+    observe.disable()
+    rec_mod.reset(include_warnings=True)
+    DisSum.traces = 0
+    yield
+    observe.disable()
+    rec_mod.reset(include_warnings=True)
+    clear_jit_cache()
+
+
+def test_disabled_is_the_default():
+    import importlib
+
+    spec = importlib.util.find_spec("metrics_tpu.observe.recorder")
+    fresh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fresh)  # a fresh copy of the module, untouched by tests
+    assert fresh.ENABLED is False
+    assert fresh.enabled() is False
+
+
+def test_disabled_path_allocates_no_telemetry():
+    m1 = DisSum()
+    m1.update(1.0)
+    DisSum().update(2.0)  # cache hit path
+    m1.merge_state(DisSum())
+    assert float(m1.compute()) == 1.0
+
+    from metrics_tpu.parallel.sync import allreduce_over_mesh
+
+    allreduce_over_mesh([{"total": jnp.asarray(1.0)}], {"total": "sum"})
+
+    rec = rec_mod.RECORDER
+    assert rec.counters == {}
+    assert rec.timers == {}
+    assert len(rec.events) == 0
+    assert rec._compiled == {} and rec._evicted == set()
+    snap = observe.snapshot()
+    assert snap["enabled"] is False
+    assert snap["counters"] == {} and snap["timers"] == {} and snap["events"] == []
+    assert snap["derived"]["jit_cache_hit_rate"] is None
+    assert observe.prometheus() == ""
+
+
+def test_record_event_is_a_noop_while_disabled():
+    observe.record_event("probe", x=1)
+    assert len(rec_mod.RECORDER.events) == 0
+    observe.enable()
+    observe.record_event("probe", x=1)
+    assert len(rec_mod.RECORDER.events) == 1
+
+
+def test_fused_collection_disabled_allocates_nothing():
+    from metrics_tpu import MeanAbsoluteError, MeanSquaredError, MetricCollection
+
+    col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    p, t = jnp.asarray([0.1, 0.9]), jnp.asarray([0.0, 1.0])
+    for _ in range(3):
+        col.update(p, t)
+    assert rec_mod.RECORDER.counters == {} and rec_mod.RECORDER.timers == {}
+
+
+def test_enabled_and_disabled_runs_are_numerically_identical():
+    values = (1.0, 2.5, 3.25)
+
+    observe.disable()
+    off = DisSum(scale=2.0)
+    for v in values:
+        off.update(v)
+    traces_off = DisSum.traces
+    clear_jit_cache()
+    DisSum.traces = 0
+
+    observe.enable()
+    on = DisSum(scale=2.0)
+    for v in values:
+        on.update(v)
+
+    # same result, same number of real traces: telemetry observes the compiled
+    # path, it does not change it
+    assert float(off.compute()) == float(on.compute())
+    assert DisSum.traces == traces_off == 1
+    assert rec_mod.RECORDER.counters != {}  # sanity: enabled run did record
+
+
+def test_eviction_and_eager_fallback_still_work_silently(monkeypatch):
+    monkeypatch.setattr(metric_mod, "_SHARED_JIT_CACHE_MAX", 2)
+    for scale in (1.0, 2.0, 3.0):
+        DisSum(scale=scale).update(1.0)
+    assert len(metric_mod._SHARED_JIT_CACHE) == 2  # eviction happened, uncounted
+    assert rec_mod.RECORDER.counters == {}
+
+
+def test_one_time_fallback_warning_fires_even_while_disabled():
+    """Losing the compiled update is user-facing: the warning must not depend on
+    telemetry being enabled — but no counters may be recorded for it."""
+    from metrics_tpu.utils.checks import _is_traced
+    from metrics_tpu.utils.exceptions import TraceIneligibleError
+
+    class HostyOff(Metric):
+        full_state_update = False
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("peak", jnp.asarray(0.0), dist_reduce_fx="max")
+
+        def update(self, x):
+            if _is_traced(x):
+                raise TraceIneligibleError("needs concrete data")
+            self.peak = jnp.maximum(self.peak, jnp.asarray(float(x.max())))
+
+        def compute(self):
+            return self.peak
+
+    with pytest.warns(UserWarning, match="HostyOff.*latched eager"):
+        HostyOff().update(jnp.asarray([1.0, 2.0]))
+    assert rec_mod.RECORDER.counters == {}
+    with warnings.catch_warnings():  # still one-time
+        warnings.simplefilter("error")
+        HostyOff().update(jnp.asarray([3.0]))
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,257 @@
+"""Runtime telemetry coverage: the observe counters/timers/events that
+``metric.py``/``collections.py``/``parallel/sync.py`` report into (DESIGN §11).
+
+Pins the full counter story — jit compiles vs cache hits vs evictions vs eager
+fallbacks — the ``snapshot()`` schema, the Prometheus dump, and the
+``clear_jit_cache()`` ↔ counter consistency contract.
+"""
+
+import json
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.metric as metric_mod
+from metrics_tpu import Metric, observe
+from metrics_tpu.metric import clear_jit_cache
+from metrics_tpu.observe import recorder as rec_mod
+
+
+class ObsSum(Metric):
+    full_state_update = False
+
+    def __init__(self, scale: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + self.scale * jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.total
+
+
+class HostyMax(Metric):
+    """Update that cannot trace — latches eager fallback on first jit attempt."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("peak", jnp.asarray(0.0), dist_reduce_fx="max")
+
+    def update(self, x):
+        from metrics_tpu.utils.checks import _is_traced
+        from metrics_tpu.utils.exceptions import TraceIneligibleError
+
+        if _is_traced(x):
+            raise TraceIneligibleError("needs concrete data")
+        self.peak = jnp.maximum(self.peak, jnp.asarray(float(x.max())))
+
+    def compute(self):
+        return self.peak
+
+
+@pytest.fixture(autouse=True)
+def _pristine_observe():
+    import metrics_tpu.collections as collections_mod
+
+    clear_jit_cache()
+    collections_mod._FUSED_SHARED_CACHE.clear()  # fused executables outlive collections
+    rec_mod.reset(include_warnings=True)
+    observe.enable()
+    yield
+    observe.disable()
+    rec_mod.reset(include_warnings=True)
+    clear_jit_cache()
+    collections_mod._FUSED_SHARED_CACHE.clear()
+
+
+def test_compile_then_hit_counters_and_hit_rate():
+    m1 = ObsSum()
+    m1.update(1.0)  # first instance: trace+compile into the shared cache
+    m2 = ObsSum()
+    m2.update(2.0)  # config-equal: shared-cache hit
+    m1.update(3.0)  # instance already holds its fn: no cache lookup at all
+
+    snap = observe.snapshot()
+    assert snap["counters"]["jit_compile"] == {"ObsSum": 1}
+    assert snap["counters"]["jit_cache_hit"] == {"ObsSum": 1}
+    assert snap["counters"]["update_jit"] == {"ObsSum": 3}
+    assert snap["derived"]["jit_compiles_total"] == 1
+    assert snap["derived"]["jit_cache_hits_total"] == 1
+    assert snap["derived"]["jit_cache_hit_rate"] == pytest.approx(0.5)
+
+
+def test_eviction_counter_and_recompile_cause(monkeypatch):
+    monkeypatch.setattr(metric_mod, "_SHARED_JIT_CACHE_MAX", 2)
+    for scale in (1.0, 2.0, 3.0):  # third distinct config evicts the first
+        ObsSum(scale=scale).update(1.0)
+    snap = observe.snapshot()
+    assert snap["counters"]["jit_cache_eviction"] == {"ObsSum": 1}
+    assert snap["derived"]["jit_cache_evictions_total"] == 1
+    assert any(e["kind"] == "jit_cache_evict" for e in snap["events"])
+
+    ObsSum(scale=1.0).update(1.0)  # evicted config returns: recompile, attributed
+    recompiles = [e for e in observe.snapshot()["events"] if e["kind"] == "recompile"]
+    assert recompiles and recompiles[-1]["cause"] == "after_eviction"
+
+
+def test_clear_jit_cache_resets_cache_counters_consistently():
+    m1 = ObsSum()
+    m1.update(1.0)
+    ObsSum().update(1.0)
+    assert observe.snapshot()["derived"]["jit_compiles_total"] == 1
+
+    clear_jit_cache()
+    snap = observe.snapshot()
+    # cache counters describe the (now empty) cache...
+    assert snap["derived"]["jit_compiles_total"] == 0
+    assert snap["derived"]["jit_cache_hits_total"] == 0
+    assert snap["derived"]["jit_cache_hit_rate"] is None
+    assert "jit_compile" not in snap["counters"]
+    # ...while non-cache telemetry survives, and the clear is on the record
+    assert snap["counters"]["update_jit"] == {"ObsSum": 2}
+    assert any(e["kind"] == "jit_cache_clear" for e in snap["events"])
+
+    ObsSum().update(1.0)  # counting restarts from the empty cache
+    assert observe.snapshot()["derived"]["jit_compiles_total"] == 1
+
+
+def test_eager_fallback_counter_event_and_one_time_warning():
+    with pytest.warns(UserWarning, match="HostyMax.*latched eager"):
+        m = HostyMax()
+        m.update(jnp.asarray([1.0, 3.0]))
+    assert m._jit_failed
+    snap = observe.snapshot()
+    assert snap["counters"]["eager_fallback"] == {"HostyMax": 1}
+    assert snap["derived"]["eager_fallbacks_total"] == 1
+    ev = [e for e in snap["events"] if e["kind"] == "eager_fallback"]
+    assert ev and ev[0]["error"] == "TraceIneligibleError" and ev[0]["detail"]
+    assert snap["counters"]["update_fallback"] == {"HostyMax": 1}
+
+    # a second instance latches (and counts) again but must NOT warn again
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        HostyMax().update(jnp.asarray([2.0]))
+    assert observe.snapshot()["counters"]["eager_fallback"] == {"HostyMax": 2}
+
+
+def test_update_and_compute_timers_aggregate():
+    m = ObsSum()
+    m.update(1.0)
+    m.update(2.0)
+    assert float(m.compute()) == 3.0
+    snap = observe.snapshot()
+    upd = snap["timers"]["update"]["ObsSum"]
+    assert upd["count"] == 2
+    assert upd["total_s"] >= upd["max_s"] >= upd["min_s"] >= 0.0
+    assert upd["mean_s"] == pytest.approx(upd["total_s"] / 2)
+    assert snap["timers"]["compute"]["ObsSum"]["count"] == 1
+    # cached compute short-circuits: counted separately, not timed again
+    m.compute()
+    snap = observe.snapshot()
+    assert snap["timers"]["compute"]["ObsSum"]["count"] == 1
+    assert snap["counters"]["compute_cached"] == {"ObsSum": 1}
+
+
+def test_merge_and_sync_allreduce_instrumented():
+    m1, m2 = ObsSum(), ObsSum()
+    m1.update(1.0)
+    m2.update(2.0)
+    m1.merge_state(m2)
+    assert float(m1.compute()) == 3.0
+    snap = observe.snapshot()
+    assert snap["counters"]["merge"] == {"ObsSum": 1}
+    assert snap["timers"]["merge"]["ObsSum"]["count"] == 1
+
+    from metrics_tpu.parallel.sync import allreduce_over_mesh
+
+    synced = allreduce_over_mesh([{"total": jnp.asarray(2.0)}], {"total": "sum"})
+    assert float(synced["total"]) == 2.0
+    snap = observe.snapshot()
+    assert snap["counters"]["allreduce"] == {"data": 1}
+    assert snap["timers"]["allreduce"]["data"]["count"] == 1
+
+
+def test_fused_collection_counters():
+    from metrics_tpu import MeanAbsoluteError, MeanSquaredError, MetricCollection
+
+    col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    p, t = jnp.asarray([0.1, 0.9]), jnp.asarray([0.0, 1.0])
+    col.update(p, t)  # groups not stabilized yet: per-metric loop
+    col.update(p, t)  # two leaders -> one fused compile + dispatch
+    col.update(p, t)  # fused executable replayed
+    snap = observe.snapshot()
+    assert snap["counters"]["fused_compile"] == {"2": 1}
+    assert snap["counters"]["fused_dispatch"] == {"2": 2}
+    assert snap["counters"]["fused_hit"] == {"2": 1}
+    assert snap["timers"]["fused_update"]["2"]["count"] == 2
+    # a second, config-equal collection shares the fused executable too
+    col2 = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    col2.update(p, t)
+    col2.update(p, t)
+    assert observe.snapshot()["counters"]["fused_compile"] == {"2": 1}
+
+
+def test_snapshot_schema_is_stable_and_json_able():
+    ObsSum().update(1.0)
+    snap = observe.snapshot()
+    assert set(snap) == {"enabled", "counters", "timers", "events", "derived"}
+    assert snap["enabled"] is True
+    assert set(snap["derived"]) == {
+        "jit_cache_hit_rate", "jit_compiles_total", "jit_cache_hits_total",
+        "jit_cache_evictions_total", "eager_fallbacks_total",
+    }
+    for by_label in snap["timers"].values():
+        for agg in by_label.values():
+            assert set(agg) == {"count", "total_s", "mean_s", "min_s", "max_s"}
+    roundtrip = json.loads(observe.snapshot_json())
+    assert roundtrip["counters"] == snap["counters"]
+    seqs = [e["seq"] for e in snap["events"]]
+    assert seqs == sorted(seqs)
+
+
+def test_event_log_is_bounded_ring_buffer():
+    observe.enable(max_events=4)
+    for i in range(10):
+        observe.record_event("probe", i=i)
+    events = observe.snapshot()["events"]
+    assert len(events) == 4
+    assert [e["i"] for e in events] == [6, 7, 8, 9]  # oldest dropped, order kept
+
+
+def test_prometheus_text_format():
+    m = ObsSum()
+    m.update(1.0)
+    ObsSum().update(1.0)
+    m.compute()
+    text = observe.prometheus()
+    assert "# TYPE metrics_tpu_jit_compile_total counter" in text
+    assert 'metrics_tpu_jit_compile_total{metric="ObsSum"} 1' in text
+    assert 'metrics_tpu_jit_cache_hit_total{metric="ObsSum"} 1' in text
+    assert 'metrics_tpu_update_seconds_count{metric="ObsSum"} 2' in text
+    assert 'metrics_tpu_update_seconds_sum{metric="ObsSum"} ' in text
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or " " in line
+
+
+def test_reset_drops_telemetry_and_rearms_warnings():
+    with pytest.warns(UserWarning):
+        HostyMax().update(jnp.asarray([1.0]))
+    rec_mod.reset()
+    assert observe.snapshot()["counters"] == {}
+    # warnings NOT re-armed by a plain reset...
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        HostyMax().update(jnp.asarray([1.0]))
+    # ...until include_warnings=True
+    rec_mod.reset(include_warnings=True)
+    with pytest.warns(UserWarning):
+        HostyMax().update(jnp.asarray([1.0]))
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Perf CLI — XLA cost profiling of compiled metric updates.
+
+Usage:
+    python tools/profile_metrics.py [--classes A,B] [--update-baseline]
+                                    [--tolerance 1.5] [--no-memory] [--format json]
+
+Thin wrapper over :mod:`metrics_tpu.observe.profile` so the tool works from a
+checkout without installing the package (the ``profile-metrics`` console
+script is the installed-form equivalent). Ratchets against
+``tools/perf_baseline.json`` exactly like the jitlint/distlint baselines.
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from metrics_tpu.observe.profile import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] if "--root" in sys.argv else ["--root", _REPO_ROOT, *sys.argv[1:]]))
