@@ -52,8 +52,8 @@ class SignalNoiseRatio(_AveragedAudioMetric):
     >>> import jax.numpy as jnp
     >>> metric = SignalNoiseRatio()
     >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
-    >>> metric.compute()
-    Array(16.180481, dtype=float32)
+    >>> round(float(metric.compute()), 4)  # last digits drift across XLA builds
+    16.1805
     """
 
     higher_is_better = True
@@ -72,8 +72,8 @@ class ScaleInvariantSignalDistortionRatio(_AveragedAudioMetric):
     >>> import jax.numpy as jnp
     >>> metric = ScaleInvariantSignalDistortionRatio()
     >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
-    >>> metric.compute()
-    Array(18.402992, dtype=float32)
+    >>> round(float(metric.compute()), 4)  # last digits drift across XLA builds
+    18.403
     """
 
     higher_is_better = True

@@ -261,6 +261,10 @@ class MetricCollection:
                     })
                     member._update_count = leader._update_count
                     member._computed = None
+                    # members alias the leader's arrays: the leader must copy before
+                    # its next donated dispatch, and so must the members themselves
+                    member.__dict__["_group_shared"] = True
+                    leader.__dict__["_group_shared"] = True
         else:
             for m in self._modules.values():
                 m.update(*args, **m._filter_kwargs(**kwargs))
@@ -290,16 +294,17 @@ class MetricCollection:
         shareable = all(k is not None for k in shared_key)
         rec = _observe.RECORDER if _observe.ENABLED else None
         t0 = _observe.clock() if rec is not None else 0.0
-        fused = _FUSED_SHARED_CACHE.get(shared_key) if shareable else _FUSED_UPDATE_CACHE.get(self)
-        if fused is None:
+        from metrics_tpu.metric import _CompiledUpdate, _named_for_profiler, _probation_dispatch
+
+        donate = all(lm._donation_eligible() for lm in leaders)
+        entry = _FUSED_SHARED_CACHE.get((shared_key, donate)) if shareable else _FUSED_UPDATE_CACHE.get(self)
+        if entry is None:
             # representatives are pristine clones so no live collection is pinned
             reps = [lm.clone() for lm in leaders] if shareable else leaders
             for r in (reps if shareable else []):
                 r.reset()
             # per-leader profiler names so the fused program's trace still
             # attributes time to each metric (metric.py:_named_for_profiler)
-            from metrics_tpu.metric import _named_for_profiler
-
             fns = [
                 _named_for_profiler(r._functional_update, f"{type(r).__name__}_update") for r in reps
             ]
@@ -307,19 +312,41 @@ class MetricCollection:
             def _fused(states, *a):
                 return tuple(fn(s, *a) for fn, s in zip(fns, states))
 
-            fused = jax.jit(_fused)
+            entry = _CompiledUpdate(_fused, donate)
             if shareable:
-                _FUSED_SHARED_CACHE[shared_key] = fused
+                _FUSED_SHARED_CACHE[(shared_key, donate)] = entry
                 if len(_FUSED_SHARED_CACHE) > 64:
                     _FUSED_SHARED_CACHE.pop(next(iter(_FUSED_SHARED_CACHE)))
             else:
-                _FUSED_UPDATE_CACHE[self] = fused
+                _FUSED_UPDATE_CACHE[self] = entry
             _observe.note_fused_compile(len(leaders), shareable)
         elif rec is not None:
             rec.add_count("fused_hit", str(len(leaders)))
-        states = tuple({k: lm._state[k] for k in lm._defaults} for lm in leaders)
+        if entry.donate:
+            # copy any leader state with live outside references, and dedup aliases
+            # across the WHOLE donated pytree — one buffer must not be donated twice
+            seen: set = set()
+
+            def _donatable(lm: Metric) -> Dict[str, Any]:
+                force = lm._state_escaped or lm._group_shared
+                out: Dict[str, Any] = {}
+                for k in lm._defaults:
+                    v = lm._state[k]
+                    if isinstance(v, jax.Array):
+                        if force or id(v) in seen:
+                            v = jnp.copy(v)
+                        seen.add(id(v))
+                    out[k] = v
+                return out
+
+            states = tuple(_donatable(lm) for lm in leaders)
+        else:
+            states = tuple({k: lm._state[k] for k in lm._defaults} for lm in leaders)
         try:
-            new_states = fused(states, *args)
+            if entry.probation:
+                new_states = _probation_dispatch(entry, f"fused[{len(leaders)}]", (states,) + args, {})
+            else:
+                new_states = entry(states, *args)
         except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
                 jax.errors.TracerIntegerConversionError) as exc:
@@ -330,9 +357,15 @@ class MetricCollection:
             lm.__dict__["_state"].update(ns)
             lm._computed = None
             lm._update_count += 1
+            # fresh executable-owned buffers; the sharing loop in update() re-marks
+            # the leader once members re-alias them
+            lm.__dict__["_state_escaped"] = False
+            lm.__dict__["_group_shared"] = False
         if rec is not None:
             rec.add_time("fused_update", str(len(leaders)), _observe.clock() - t0)
             rec.add_count("fused_dispatch", str(len(leaders)))
+            if entry.donate:
+                rec.add_count("fused_donated", str(len(leaders)))
         return True
 
     def _merge_compute_groups(self) -> None:

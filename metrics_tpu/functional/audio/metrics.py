@@ -67,8 +67,8 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
     >>> import jax.numpy as jnp
     >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
     >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
-    >>> signal_noise_ratio(preds, target)
-    Array(16.180481, dtype=float32)
+    >>> round(float(signal_noise_ratio(preds, target)), 4)  # last digits drift across XLA builds
+    16.1805
     """
     _check_same_shape(preds, target)
     eps = jnp.finfo(jnp.float32).eps
@@ -87,8 +87,8 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
     >>> import jax.numpy as jnp
     >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
     >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
-    >>> scale_invariant_signal_distortion_ratio(preds, target)
-    Array(18.402992, dtype=float32)
+    >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)  # last digits drift across XLA builds
+    18.403
     """
     _check_same_shape(preds, target)
     eps = jnp.finfo(jnp.float32).eps

@@ -31,6 +31,8 @@ from __future__ import annotations
 import functools
 import inspect
 import operator
+import sys
+import warnings
 from abc import ABC, abstractmethod
 from collections import OrderedDict
 from copy import deepcopy
@@ -46,7 +48,7 @@ from metrics_tpu.utils.data import _flatten, dim_zero_cat, dim_zero_max, dim_zer
 from metrics_tpu.utils.exceptions import TPUMetricsUserError, TraceIneligibleError
 from metrics_tpu.utils.prints import rank_zero_warn
 
-__all__ = ["Metric", "CompositionalMetric", "clear_jit_cache", "jit_update_enabled"]
+__all__ = ["Metric", "CompositionalMetric", "clear_jit_cache", "donate_updates_enabled", "jit_update_enabled"]
 
 _REDUCE_ALIASES: Dict[Any, Any] = {
     "sum": dim_zero_sum,
@@ -65,23 +67,45 @@ def jit_update_enabled(enable: bool) -> None:
     _JIT_UPDATE_DEFAULT = enable
 
 
-# Shared compiled-update cache: (cls, static-config key) -> jitted pure update.
+_DONATE_UPDATE_DEFAULT = True
+
+
+def donate_updates_enabled(enable: bool) -> None:
+    """Globally toggle buffer donation in jitted ``Metric.update`` calls (debugging aid).
+
+    The per-instance ``donate_states=`` ctor kwarg overrides this, mirroring the
+    ``jit_update=`` / :func:`jit_update_enabled` pair.
+    """
+    global _DONATE_UPDATE_DEFAULT
+    _DONATE_UPDATE_DEFAULT = enable
+
+
+# Shared compiled-update cache: ((cls, static-config key), donate) -> _CompiledUpdate.
 # N instances of one metric class with equal config share ONE compilation (the
 # reference has no analog — torch Modules re-dispatch per call; under XLA a
 # per-instance `jax.jit` would recompile per instance, which dominates
 # MetricCollection startup cost). LRU-bounded: sweeping configs (e.g. a fresh
 # per-epoch weight array) must not pin representatives forever.
-_SHARED_JIT_CACHE: "OrderedDict[Any, Callable]" = OrderedDict()
+_SHARED_JIT_CACHE: "OrderedDict[Any, _CompiledUpdate]" = OrderedDict()
 _SHARED_JIT_CACHE_MAX = 256
 
 
 def clear_jit_cache() -> None:
     """Drop all shared compiled updates (frees the representative instances too).
 
-    The observe layer's jit-cache counters (compiles / hits / evictions) describe
-    this cache, so they reset with it — see ``metrics_tpu.observe`` (DESIGN §11).
+    Covers every compiled-update cache in the runtime: the per-metric shared
+    cache here, the fused collection-update cache (``collections.py``) and the
+    replica-engine cache (``wrappers/replicated.py``). The observe layer's
+    cache-scoped counters (compiles / hits / evictions) describe these caches,
+    so they reset with them — see ``metrics_tpu.observe`` (DESIGN §11).
     """
     _SHARED_JIT_CACHE.clear()
+    collections_mod = sys.modules.get("metrics_tpu.collections")
+    if collections_mod is not None:
+        collections_mod._FUSED_SHARED_CACHE.clear()
+    replicated_mod = sys.modules.get("metrics_tpu.wrappers.replicated")
+    if replicated_mod is not None:
+        replicated_mod._REPLICA_JIT_CACHE.clear()
     _observe.note_jit_cache_cleared()
 
 
@@ -97,13 +121,90 @@ def _named_for_profiler(fn: Callable, name: str) -> Callable:
     return wrapper
 
 
+class _CompiledUpdate:
+    """A shared-cache entry: one jitted pure update plus its donation decision.
+
+    All config-equal instances hold the SAME entry object (the identity contract
+    behind ``a._jitted_update is b._jitted_update``), so when XLA reports the
+    donation unusable the fallback to a plain jit propagates to every holder.
+    """
+
+    __slots__ = ("raw", "fn", "donate", "probation")
+
+    def __init__(self, raw: Callable, donate: bool) -> None:
+        self.raw = raw
+        self.donate = donate
+        # first dispatch runs under a warning probe: XLA reports aliasing it
+        # could not use ("Some donated buffers were not usable") at compile time
+        self.probation = donate
+        self.fn = jax.jit(raw, donate_argnums=(0,) if donate else ())
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.fn(*args, **kwargs)
+
+    def lower(self, *args: Any, **kwargs: Any) -> Any:
+        return self.fn.lower(*args, **kwargs)
+
+
+_DONATION_UNUSABLE_MSG = "donated buffers were not usable"
+
+
+def _probation_dispatch(entry: _CompiledUpdate, label: str, args: tuple, kwargs: Dict[str, Any]) -> Any:
+    """First dispatch of a donating executable, under a warning probe.
+
+    When the update body changes a state aval (dtype promotion, shape growth)
+    XLA cannot alias input→output and warns instead of failing — the input
+    buffer stays alive, so results are correct either way. On that warning the
+    entry drops to a non-donating jit of the same traced callable; every other
+    warning is re-emitted unchanged.
+    """
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = entry.fn(*args, **kwargs)
+    entry.probation = False
+    unusable = False
+    for w in caught:
+        if _DONATION_UNUSABLE_MSG in str(w.message):
+            unusable = True
+            continue
+        warnings.warn_explicit(w.message, w.category, w.filename, w.lineno)
+    if unusable:
+        entry.fn = jax.jit(entry.raw)
+        entry.donate = False
+        _observe.record_event("donation_unusable", metric=label)
+    return out
+
+
+def _donation_copy(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Fresh buffers for every array state, so donating them cannot touch arrays
+    the caller may still hold (defaults after reset, ``metric_state`` reads,
+    compute-group members aliasing a leader's state)."""
+    return {k: (jnp.copy(v) if isinstance(v, jax.Array) else v) for k, v in state.items()}
+
+
+def _dedup_donation_aliases(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Two state names bound to one array (``self.a = self.b = x`` in an update
+    body) would donate the same buffer twice; copy the duplicates."""
+    seen: set = set()
+    out: Dict[str, Any] = {}
+    for k, v in state.items():
+        if isinstance(v, jax.Array):
+            if id(v) in seen:
+                v = jnp.copy(v)
+            else:
+                seen.add(id(v))
+        out[k] = v
+    return out
+
+
 # Instance fields that do not affect how `update` traces: runtime bookkeeping and
 # the sync-orchestration kwargs (those act outside the jitted region).
 _JIT_KEY_EXCLUDE = frozenset({
     "_defaults", "_state", "_persistent", "_reductions", "_merge_associative", "_computed", "_update_count",
     "_to_sync", "_should_unsync", "_is_synced", "_cache", "_update_signature",
     "_update_impl", "_compute_impl", "update", "compute", "_jitted_update",
-    "_jit_failed", "_jit_update_opt", "compute_on_cpu", "dist_sync_on_step",
+    "_jit_failed", "_jit_update_opt", "_donate_opt", "_state_escaped", "_group_shared",
+    "compute_on_cpu", "dist_sync_on_step",
     "process_group", "dist_sync_fn", "distributed_available_fn", "sync_on_compute",
     "compute_with_cache",
 })
@@ -177,6 +278,10 @@ class Metric(ABC):
         compute_with_cache: cache the ``compute`` result until next update/reset.
         jit_update: compile eager ``update`` into a single XLA executable
             (auto-disabled for metrics with list states or non-array args).
+        donate_states: donate the state buffers to the compiled update so XLA
+            aliases input→output state instead of reallocating O(state) per step
+            (auto-enabled for jit-eligible metrics without list states; the
+            runtime copies first whenever a live external reference may exist).
     """
 
     __jit_ineligible__ = False  # subclasses with host-side update set this
@@ -203,6 +308,7 @@ class Metric(ABC):
         self.sync_on_compute = kwargs.pop("sync_on_compute", True)
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         self._jit_update_opt = kwargs.pop("jit_update", None)
+        self._donate_opt = kwargs.pop("donate_states", None)
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -220,8 +326,14 @@ class Metric(ABC):
         self._compute_impl: Callable = self.compute
         self.update = self._wrapped_update  # type: ignore[method-assign]
         self.compute = self._wrapped_compute  # type: ignore[method-assign]
-        self._jitted_update: Optional[Callable] = None
+        self._jitted_update: Optional[_CompiledUpdate] = None
         self._jit_failed = False
+        # donation bookkeeping: `_state_escaped` means the current state arrays may
+        # be referenced outside this instance (initially they alias `_defaults`);
+        # `_group_shared` means compute-group members alias them (collections.py).
+        # Either forces copy-then-donate so donation can never free a live buffer.
+        self._state_escaped = True
+        self._group_shared = False
 
     # ------------------------------------------------------------------ state registry
     def add_state(
@@ -286,6 +398,8 @@ class Metric(ABC):
         except AttributeError:
             raise AttributeError(name) from None
         if name in state:
+            # the caller now holds (or may hold) this array: donation must copy first
+            object.__getattribute__(self, "__dict__")["_state_escaped"] = True
             return state[name]
         raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
@@ -293,6 +407,8 @@ class Metric(ABC):
         defaults = self.__dict__.get("_defaults")
         if defaults is not None and name in defaults:
             self.__dict__["_state"][name] = value
+            # the assigned array has a live binding at the caller: copy before donating
+            self.__dict__["_state_escaped"] = True
             return
         if name in ("higher_is_better", "is_differentiable", "full_state_update") and name in type(self).__dict__:
             # instance-level override of class constants is an error (reference metric.py:800-811)
@@ -302,6 +418,7 @@ class Metric(ABC):
     @property
     def metric_state(self) -> Dict[str, Any]:
         """Current state pytree of the metric (reference ``metric.py`` ``metric_state`` property)."""
+        self.__dict__["_state_escaped"] = True
         return {k: self._state[k] for k in self._defaults}
 
     @property
@@ -419,6 +536,17 @@ class Metric(ABC):
             for a in list(args) + list(kwargs.values())
         )
 
+    def _donation_eligible(self) -> bool:
+        """Whether this metric's compiled update may donate its input state buffers.
+
+        List states are ruled out (they live host-side between jit calls, outside
+        the donated pytree); the explicit ``donate_states=`` override wins over
+        the global default, mirroring ``jit_update=``.
+        """
+        if self._donate_opt is not None:
+            return bool(self._donate_opt)
+        return _DONATE_UPDATE_DEFAULT and not self._has_list_state()
+
     def _jit_cache_key(self) -> Optional[Any]:
         """Static-config key for the shared compiled-update cache; None = not shareable.
 
@@ -436,30 +564,33 @@ class Metric(ABC):
             return None
         return (type(self), items)
 
-    def _lookup_shared_jit(self) -> Callable:
+    def _lookup_shared_jit(self, donate: bool = False) -> _CompiledUpdate:
         """Return the compiled pure update for this config, compiling at most once per config."""
-        key = self._jit_cache_key()
-        if key is None:
+        cfg = self._jit_cache_key()
+        if cfg is None:
             _observe.note_jit_compile(type(self).__name__, shared=False)
-            return jax.jit(_named_for_profiler(self._functional_update, f"{type(self).__name__}_update"))
-        fn = _SHARED_JIT_CACHE.get(key)
-        if fn is None:
+            raw = _named_for_profiler(self._functional_update, f"{type(self).__name__}_update")
+            return _CompiledUpdate(raw, donate)
+        key = (cfg, donate)
+        entry = _SHARED_JIT_CACHE.get(key)
+        if entry is None:
             # A dedicated pristine clone becomes the representative whose bound
             # update body is traced; config-equal instances replay its executable.
             # Cloning (rather than caching `self`) keeps user instances — and any
             # large states they later accumulate — out of the cache.
             rep = self.clone()
             rep.reset()
-            fn = jax.jit(_named_for_profiler(rep._functional_update, f"{type(self).__name__}_update"))
-            _SHARED_JIT_CACHE[key] = fn
+            raw = _named_for_profiler(rep._functional_update, f"{type(self).__name__}_update")
+            entry = _CompiledUpdate(raw, donate)
+            _SHARED_JIT_CACHE[key] = entry
             _observe.note_jit_compile(type(self).__name__, shared=True)
             if len(_SHARED_JIT_CACHE) > _SHARED_JIT_CACHE_MAX:
                 evicted_key, _ = _SHARED_JIT_CACHE.popitem(last=False)
-                _observe.note_jit_eviction(evicted_key[0].__name__)
+                _observe.note_jit_eviction(evicted_key[0][0].__name__)
         else:
             _SHARED_JIT_CACHE.move_to_end(key)
             _observe.note_jit_cache_hit(type(self).__name__)
-        return fn
+        return entry
 
     def _wrapped_update(self, *args: Any, **kwargs: Any) -> None:
         """``_wrap_update`` analog (reference ``metric.py:542-564``): cache invalidation + counting.
@@ -478,18 +609,40 @@ class Metric(ABC):
         rec = _observe.RECORDER if _observe.ENABLED else None
         t0 = _observe.clock() if rec is not None else 0.0
         path = "eager"
+        donated = False
         if self._jit_eligible(args, kwargs):
-            if self._jitted_update is None:
-                # NOTE: no buffer donation — default arrays are shared across resets.
-                self._jitted_update = self._lookup_shared_jit()
+            entry = self._jitted_update
+            if entry is None:
+                entry = self._jitted_update = self._lookup_shared_jit(self._donation_eligible())
             try:
-                self.__dict__["_state"] = self._jitted_update(self._state, *args, **kwargs)
+                state = self.__dict__["_state"]
+                if entry.donate:
+                    if self._state_escaped or self._group_shared:
+                        # a live reference may exist (defaults after reset,
+                        # metric_state/attribute reads, compute-group members):
+                        # donate fresh copies, never the referenced buffers
+                        state = _donation_copy(state)
+                        if rec is not None:
+                            rec.add_count("donate_copy", type(self).__name__)
+                    else:
+                        state = _dedup_donation_aliases(state)
+                if entry.probation:
+                    new_state = _probation_dispatch(entry, type(self).__name__, (state,) + args, kwargs)
+                else:
+                    new_state = entry(state, *args, **kwargs)
+                self.__dict__["_state"] = new_state
+                # the dispatch output is fresh executable-owned buffers: the next
+                # donated step may consume them in place
+                self.__dict__["_state_escaped"] = False
+                self.__dict__["_group_shared"] = False
+                donated = entry.donate
                 path = "jit"
             except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
                     jax.errors.TracerIntegerConversionError, TraceIneligibleError) as exc:
                 # update body is genuinely un-traceable → latch eager mode for this
-                # metric; warn once per class and log the triggering exception
+                # metric (donation never applies, so its buffers all stay alive);
+                # warn once per class and log the triggering exception
                 self._jit_failed = True
                 self._jitted_update = None
                 _observe.note_eager_fallback(type(self).__name__, exc)
@@ -501,6 +654,8 @@ class Metric(ABC):
             name = type(self).__name__
             rec.add_time("update", name, _observe.clock() - t0)
             rec.add_count("update_" + path, name)
+            if donated:
+                rec.add_count("update_donated", name)
         if self.compute_on_cpu:
             self._move_list_states_to_cpu()
 
@@ -558,10 +713,12 @@ class Metric(ABC):
         self.update(*args, **kwargs)
         _update_count = self._update_count
         cache = self._copy_state()
+        _escaped = self._state_escaped  # cache aliases the arrays, but only internally
         for attr in self._defaults:
             self._state[attr] = (
                 list(self._defaults[attr]) if isinstance(self._defaults[attr], list) else self._defaults[attr]
             )
+        self.__dict__["_state_escaped"] = True  # batch state aliases the defaults
         self.update(*args, **kwargs)
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
@@ -569,6 +726,7 @@ class Metric(ABC):
         # restore global state
         self._update_count = _update_count
         self.__dict__["_state"] = cache
+        self.__dict__["_state_escaped"] = _escaped
         self._computed = None
         self._is_synced = False
         self._should_unsync = True
@@ -583,6 +741,7 @@ class Metric(ABC):
             self._state[attr] = (
                 list(self._defaults[attr]) if isinstance(self._defaults[attr], list) else self._defaults[attr]
             )
+        self.__dict__["_state_escaped"] = True  # batch state aliases the defaults
         self._update_count = 0
         self.update(*args, **kwargs)  # batch state
         self._to_sync = self.dist_sync_on_step
@@ -591,6 +750,9 @@ class Metric(ABC):
         self._computed = None
         self._update_count = _update_count + 1
         self.__dict__["_state"] = self._merge_state_dicts(global_state, self._state, _update_count, 1)
+        # merge outputs are fresh arrays for every array reduction; only list
+        # states keep aliases, and list states never donate
+        self.__dict__["_state_escaped"] = self._has_list_state()
         self._is_synced = False
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
@@ -634,6 +796,9 @@ class Metric(ABC):
         self.__dict__["_state"] = self._merge_state_dicts(
             incoming_state, self.metric_state, incoming_count, own_count
         )
+        # array reductions produce fresh buffers, so donated steps may resume;
+        # list-cat keeps aliases into the incoming state (list states never donate)
+        self.__dict__["_state_escaped"] = self._has_list_state()
         if rec is not None:
             rec.add_time("merge", type(self).__name__, _observe.clock() - t0)
             rec.add_count("merge", type(self).__name__)
@@ -697,6 +862,7 @@ class Metric(ABC):
         if not should_sync or not distributed_available:
             return
         self._cache = self._copy_state()
+        self._state_escaped = True  # the unsync cache aliases the state arrays
         rec = _observe.RECORDER if _observe.ENABLED else None
         t0 = _observe.clock() if rec is not None else 0.0
         self._sync_dist(dist_sync_fn or self.dist_sync_fn, process_group or self.process_group)
@@ -714,6 +880,7 @@ class Metric(ABC):
         if self._cache is None:
             raise TPUMetricsUserError("The internal cache should exist to unsync the Metric.")
         self.__dict__["_state"].update(self._cache)
+        self._state_escaped = True  # restored arrays predate the sync; refs may exist
         self._is_synced = False
         self._cache = None
 
@@ -752,6 +919,10 @@ class Metric(ABC):
         self._computed = None
         for attr, default in self._defaults.items():
             self._state[attr] = list(default) if isinstance(default, list) else default
+        # state now aliases the default arrays, which every future reset (and every
+        # sibling instance's defaults built from the same constants) must keep alive
+        self._state_escaped = True
+        self._group_shared = False
         self._cache = None
         self._is_synced = False
 
@@ -774,6 +945,8 @@ class Metric(ABC):
         object.__setattr__(new, "update", new._wrapped_update)
         object.__setattr__(new, "compute", new._wrapped_compute)
         object.__setattr__(new, "_jitted_update", None)
+        object.__setattr__(new, "_state_escaped", True)
+        object.__setattr__(new, "_group_shared", False)
         return new
 
     def __getstate__(self) -> Dict[str, Any]:
@@ -798,6 +971,10 @@ class Metric(ABC):
             object.__setattr__(self, k, v)
         # checkpoints from before merge-annotation support: all flags unknown
         self.__dict__.setdefault("_merge_associative", dict.fromkeys(self.__dict__.get("_defaults", {})))
+        # checkpoints from before state donation: conservative donation flags
+        self.__dict__.setdefault("_donate_opt", None)
+        self.__dict__["_state_escaped"] = True
+        self.__dict__["_group_shared"] = False
         object.__setattr__(self, "_update_signature", inspect.signature(type(self).update))
         object.__setattr__(self, "_update_impl", functools.partial(type(self).update, self))
         object.__setattr__(self, "_compute_impl", functools.partial(type(self).compute, self))
@@ -829,6 +1006,7 @@ class Metric(ABC):
             if k not in self._state:
                 raise KeyError(f"Unknown state {k!r} for {self.__class__.__name__}")
             self._state[k] = [v] if isinstance(self._state[k], list) and not isinstance(v, list) else v
+        self._state_escaped = True  # caller-provided arrays: never donate them directly
         self._update_count = update_count
         self._computed = None
         return self
@@ -865,6 +1043,7 @@ class Metric(ABC):
                 self._state[key] = [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
             elif strict and self._persistent[key]:
                 raise RuntimeError(f"Missing key {full} in state_dict")
+        self._state_escaped = True  # loaded arrays may still be referenced by the caller
         self._computed = None
 
     # ------------------------------------------------------------------ dtype / device
@@ -890,6 +1069,7 @@ class Metric(ABC):
                 self._state[k] = [jax.device_put(x, device) for x in v]
             else:
                 self._state[k] = jax.device_put(v, device)
+        self._state_escaped = True  # device_put may return views of the source buffers
         return self
 
     # ------------------------------------------------------------------ misc API

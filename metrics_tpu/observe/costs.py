@@ -101,6 +101,10 @@ def profile_case(case: ProfileCase, include_memory: bool = True, dynamic: bool =
             "flops": float(analysis.get("flops", 0.0)),
             "bytes_accessed": float(analysis.get("bytes accessed", 0.0)),
             "shareable": m._jit_cache_key() is not None,
+            # static per-class eligibility: stable unless the class grows a list
+            # state or opts out — a True→False flip is a perf regression (the
+            # update loop starts reallocating O(state) every step)
+            "donation_eligible": m._donation_eligible(),
         }
         if include_memory:
             mem = lowered.compile().memory_analysis()

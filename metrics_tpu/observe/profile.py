@@ -104,6 +104,11 @@ def diff_cost_baseline(
                 regressions.append(f"{name}: {field} appeared ({cur:.0f}) where baseline had none")
         if base.get("shareable") and not cost.get("shareable"):
             regressions.append(f"{name}: update no longer shareable (jit-cache key became unhashable)")
+        if base.get("donation_eligible") and cost.get("donation_eligible") is False:
+            regressions.append(
+                f"{name}: update no longer donation-eligible — every jitted step "
+                "reallocates the state pytree instead of aliasing it in place"
+            )
         # compile_count 0 means the class updates eagerly by design (e.g. the
         # aggregation metrics' host-scalar path) — starting to compile is not a
         # sharing regression, so only ratchet from a baseline of >= 1

@@ -48,6 +48,10 @@ __all__ = [
     "note_jit_cache_hit",
     "note_jit_compile",
     "note_jit_eviction",
+    "note_replica_compile",
+    "note_replica_dispatch",
+    "note_replica_fallback",
+    "note_replica_hit",
     "prometheus",
     "record_event",
     "reset",
@@ -61,9 +65,13 @@ ENABLED = False
 
 clock: Callable[[], float] = time.perf_counter
 
-# counter names owned by the shared-jit cache — cleared together with it so
-# `clear_jit_cache()` leaves counters consistent with the (now empty) cache
-_JIT_CACHE_COUNTERS = ("jit_compile", "jit_compile_unshared", "jit_cache_hit", "jit_cache_eviction")
+# counter names owned by the compiled-update caches (per-metric shared cache,
+# fused collection cache, replica-engine cache) — cleared together with them so
+# `clear_jit_cache()` leaves counters consistent with the (now empty) caches
+_JIT_CACHE_COUNTERS = (
+    "jit_compile", "jit_compile_unshared", "jit_cache_hit", "jit_cache_eviction",
+    "fused_compile", "fused_hit", "replica_compile", "replica_hit",
+)
 
 # one warning per metric class across the process, independent of ENABLED —
 # losing compiled updates is user-facing even when telemetry is off
@@ -233,6 +241,29 @@ def note_fused_fallback(n_leaders: int, exc: BaseException) -> None:
     if ENABLED:
         RECORDER.add_count("fused_fallback", str(n_leaders))
         RECORDER.add_event("fused_fallback", leaders=n_leaders, error=type(exc).__name__)
+
+
+# replica-engine hooks (wrappers/replicated.py): label is "<InnerClass>x<N>"
+def note_replica_compile(label: str, n_replicas: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("replica_compile", label)
+        RECORDER.add_event("replica_compile", engine=label, replicas=n_replicas)
+
+
+def note_replica_hit(label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("replica_hit", label)
+
+
+def note_replica_dispatch(label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("replica_dispatch", label)
+
+
+def note_replica_fallback(label: str, exc: BaseException) -> None:
+    if ENABLED:
+        RECORDER.add_count("replica_fallback", label)
+        RECORDER.add_event("replica_fallback", engine=label, error=type(exc).__name__, detail=str(exc)[:200])
 
 
 # ------------------------------------------------------------------ export surfaces

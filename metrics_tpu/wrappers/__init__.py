@@ -7,6 +7,7 @@ from metrics_tpu.wrappers.feature_share import FeatureShare, NetworkCache
 from metrics_tpu.wrappers.minmax import MinMaxMetric
 from metrics_tpu.wrappers.multioutput import MultioutputWrapper
 from metrics_tpu.wrappers.multitask import MultitaskWrapper
+from metrics_tpu.wrappers.replicated import ReplicatedWrapper
 from metrics_tpu.wrappers.running import Running
 from metrics_tpu.wrappers.tracker import MetricTracker
 from metrics_tpu.wrappers.transformations import (
@@ -27,6 +28,7 @@ __all__ = [
     "MultioutputWrapper",
     "MultitaskWrapper",
     "NetworkCache",
+    "ReplicatedWrapper",
     "Running",
     "WrapperMetric",
 ]

@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-from copy import deepcopy
 from typing import Any, Dict, Optional, Sequence, Union
 
 import jax.numpy as jnp
@@ -10,7 +9,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.wrappers.abstract import WrapperMetric
+from metrics_tpu.wrappers.replicated import ReplicatedWrapper, replica_compute
 
 
 def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None):
@@ -24,7 +23,7 @@ def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optio
     raise ValueError("Unknown sampling strategy")
 
 
-class BootStrapper(WrapperMetric):
+class BootStrapper(ReplicatedWrapper):
     """Bootstrap resampling of a base metric over ``num_bootstraps`` replicates (reference ``bootstrapping.py:55``).
 
     >>> import numpy as np, jax.numpy as jnp
@@ -59,7 +58,7 @@ class BootStrapper(WrapperMetric):
             raise ValueError(
                 f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
             )
-        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self._init_replicas(base_metric, num_bootstraps)
         self.num_bootstraps = num_bootstraps
         self.mean = mean
         self.std = std
@@ -74,12 +73,29 @@ class BootStrapper(WrapperMetric):
         self.sampling_strategy = sampling_strategy
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update each bootstrap replicate on a resampled batch (reference ``bootstrapping.py:150-167``)."""
+        """Update each bootstrap replicate on a resampled batch (reference ``bootstrapping.py:150-167``).
+
+        Multinomial resampling with a jit-eligible base metric runs on the
+        replica engine: the fixed-shape index rows are drawn host-side and ONE
+        vmapped dispatch updates all replicates (DESIGN §12). Poisson
+        resampling (variable-length index arrays) and jit-ineligible or
+        eager-latched base metrics keep the reference per-replicate loop.
+        """
         arrays = [a for a in args if hasattr(a, "shape")] + [v for v in kwargs.values() if hasattr(v, "shape")]
         if not arrays:
             raise ValueError("None of the input contained tensors, so no bootstrapping was possible")
         size = arrays[0].shape[0]
-        for metric in self.metrics:
+        if self.sampling_strategy == "multinomial" and self._engine_ok(args, kwargs):
+            # one index row per replicate, drawn in the same global-RNG call
+            # order as the loop below, so engine and loop results are
+            # bit-identical under a fixed seed
+            idx = jnp.asarray(
+                np.stack([_bootstrap_sampler(size, self.sampling_strategy) for _ in range(self.num_bootstraps)])
+            )
+            if self._engine_update(args, kwargs, gather_idx=idx):
+                return
+        self._materialize()
+        for metric in self._replicas:
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy)
             if sample_idx.size == 0:
                 continue
@@ -90,7 +106,17 @@ class BootStrapper(WrapperMetric):
 
     def compute(self) -> Dict[str, Array]:
         """Aggregate replicate computes into mean/std/quantile/raw (reference ``bootstrapping.py:169-188``)."""
-        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        computed_vals = None
+        if self.__dict__.get("_stacked") is not None:
+            vals = replica_compute(self._replicas[0], self.num_bootstraps, self.__dict__["_stacked"])
+            if isinstance(vals, jnp.ndarray):
+                computed_vals = vals
+            else:
+                # non-array inner compute (tuple/dict): hand back to the
+                # reference path, which stacks per-replicate scalars/arrays
+                self._materialize()
+        if computed_vals is None:
+            computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
         output_dict = {}
         if self.mean:
             output_dict["mean"] = computed_vals.mean(axis=0)
@@ -106,9 +132,3 @@ class BootStrapper(WrapperMetric):
         """Update and return the aggregate over replicates."""
         self.update(*args, **kwargs)
         return self.compute()
-
-    def reset(self) -> None:
-        """Reset all replicates."""
-        for m in self.metrics:
-            m.reset()
-        super().reset()

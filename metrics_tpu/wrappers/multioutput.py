@@ -2,17 +2,16 @@
 
 from __future__ import annotations
 
-from copy import deepcopy
-from typing import Any, List
+from typing import Any, Dict, Tuple
 
 import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.wrappers.abstract import WrapperMetric
+from metrics_tpu.wrappers.replicated import ReplicatedWrapper, replica_compute
 
 
-class MultioutputWrapper(WrapperMetric):
+class MultioutputWrapper(ReplicatedWrapper):
     """Evaluate a metric independently per output dimension (reference ``multioutput.py:44``).
 
     >>> import jax.numpy as jnp
@@ -37,7 +36,7 @@ class MultioutputWrapper(WrapperMetric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self._init_replicas(base_metric, num_outputs)
         self.output_dim = output_dim
         self.remove_nans = remove_nans
         self.squeeze_outputs = squeeze_outputs
@@ -45,7 +44,7 @@ class MultioutputWrapper(WrapperMetric):
     def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array):
         """Slice args/kwargs along the output dimension (reference ``multioutput.py:120-139``)."""
         args_kwargs_by_output = []
-        for i in range(len(self.metrics)):
+        for i in range(len(self._replicas)):
             selected_args = [
                 jnp.take(arg, jnp.asarray([i]), axis=self.output_dim) if hasattr(arg, "ndim") else arg
                 for arg in args
@@ -80,15 +79,54 @@ class MultioutputWrapper(WrapperMetric):
             args_kwargs_by_output.append((selected_args, selected_kwargs))
         return args_kwargs_by_output
 
+    def _engine_sliceable(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> bool:
+        """Every array input must expose the output axis with one slot per output."""
+        num = len(self._replicas)
+        d = self.output_dim
+        for a in list(args) + list(kwargs.values()):
+            if hasattr(a, "ndim"):
+                if a.ndim == 0 or not -a.ndim <= d < a.ndim or a.shape[d] != num:
+                    return False
+        return True
+
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update each output's metric."""
+        """Update each output's metric.
+
+        With ``remove_nans=False`` and ``squeeze_outputs=True`` (the
+        jit-friendly configuration: NaN filtering is a host-side
+        data-dependent-shape step) and a jit-eligible base metric, the output
+        axis is moved to the front and ONE vmapped dispatch updates every
+        output's replica (DESIGN §12); other configurations keep the
+        reference per-output loop.
+        """
+        if (
+            not self.remove_nans
+            and self.squeeze_outputs
+            and self._engine_ok(args, kwargs)
+            and self._engine_sliceable(args, kwargs)
+        ):
+            moved_args = tuple(
+                jnp.moveaxis(a, self.output_dim, 0) if hasattr(a, "ndim") else a for a in args
+            )
+            moved_kwargs = {
+                k: (jnp.moveaxis(v, self.output_dim, 0) if hasattr(v, "ndim") else v) for k, v in kwargs.items()
+            }
+            if self._engine_update(moved_args, moved_kwargs):
+                return
+        self._materialize()
         for (selected_args, selected_kwargs), metric in zip(
-            self._get_args_kwargs_by_output(*args, **kwargs), self.metrics
+            self._get_args_kwargs_by_output(*args, **kwargs), self._replicas
         ):
             metric.update(*selected_args, **selected_kwargs)
 
     def compute(self) -> Array:
         """Stack per-output computes."""
+        if self.__dict__.get("_stacked") is not None:
+            vals = replica_compute(self._replicas[0], len(self._replicas), self.__dict__["_stacked"])
+            if isinstance(vals, jnp.ndarray):
+                return vals
+            # non-array inner compute: fall back to the reference stacking
+            self._materialize()
         return jnp.stack([m.compute() for m in self.metrics], 0)
 
     def forward(self, *args: Any, **kwargs: Any) -> Array:
@@ -100,9 +138,3 @@ class MultioutputWrapper(WrapperMetric):
             )
         ]
         return jnp.stack(results, 0)
-
-    def reset(self) -> None:
-        """Reset all underlying metrics."""
-        for metric in self.metrics:
-            metric.reset()
-        super().reset()

@@ -1,0 +1,267 @@
+"""Donation safety for the single-dispatch hot path (DESIGN §12).
+
+The jitted update donates its input state buffers (``donate_argnums=(0,)``) so
+XLA aliases input→output instead of reallocating O(state) every step. These
+tests pin the two things that make that safe:
+
+* buffers a caller can still see (defaults after reset, ``metric_state`` reads,
+  attribute reads, compute-group members) are copied before donation — a
+  deleted-buffer ``RuntimeError`` must never escape to users;
+* the telemetry contract: a donation-eligible metric's 100-step loop is exactly
+  1 compile and >= 99 donated dispatches (the ISSUE 4 acceptance criterion).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.metric as metric_mod
+from metrics_tpu import Metric, observe
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import clear_jit_cache, donate_updates_enabled, jit_update_enabled
+
+
+class DonSum(Metric):
+    full_state_update = False
+
+    def __init__(self, scale: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        self.total = self.total + self.scale * x.sum()
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1)
+
+
+class DonMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("acc", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        self.acc = self.acc + x.sum()
+        self.n = self.n + x.size
+
+    def compute(self):
+        return self.acc / jnp.maximum(self.n, 1)
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    donate_updates_enabled(True)
+    observe.enable()
+    observe.reset()
+    yield
+    observe.disable()
+    clear_jit_cache()
+    jit_update_enabled(True)
+    donate_updates_enabled(True)
+
+
+def test_hundred_step_loop_one_compile_donated_dispatches():
+    m = DonSum()
+    for i in range(100):
+        m.update(jnp.ones(8) * i)
+    snap = observe.snapshot()
+    assert snap["counters"]["jit_compile"] == {"DonSum": 1}
+    assert snap["counters"]["update_jit"] == {"DonSum": 100}
+    assert snap["counters"]["update_donated"]["DonSum"] >= 99
+    assert float(m.compute()) == pytest.approx(sum(range(100)) / 100)
+
+
+def test_update_reset_update_reuses_default_buffers_safely():
+    m = DonSum()
+    for _ in range(5):
+        m.update(jnp.ones(4))
+    m.reset()
+    # the post-reset state IS the registered default buffers; donating them
+    # would delete the defaults and poison every later reset
+    for _ in range(5):
+        m.update(jnp.full(4, 2.0))
+    assert float(m.compute()) == pytest.approx(2.0)
+    m.reset()
+    m.update(jnp.full(4, 3.0))
+    assert float(m.compute()) == pytest.approx(3.0)
+
+
+def test_metric_state_reference_survives_donated_steps():
+    m = DonSum()
+    m.update(jnp.ones(4))
+    held = m.metric_state  # caller now holds live references
+    before = {k: np.asarray(v) for k, v in held.items()}
+    for _ in range(10):
+        m.update(jnp.ones(4))
+    # the held buffers must still be readable — donation copied first
+    for k, v in held.items():
+        np.testing.assert_array_equal(np.asarray(v), before[k])
+
+
+def test_attribute_read_reference_survives_donated_steps():
+    m = DonSum()
+    m.update(jnp.full(4, 2.0))
+    total_ref = m.total  # attribute read escapes the buffer
+    val = float(total_ref)
+    for _ in range(10):
+        m.update(jnp.full(4, 2.0))
+    assert float(total_ref) == val  # not deleted, not mutated
+
+
+def test_merge_state_after_donated_steps():
+    a, b = DonSum(), DonSum()
+    for _ in range(10):
+        a.update(jnp.ones(4))
+        b.update(jnp.full(4, 3.0))
+    a.merge_state({k: v for k, v in b.metric_state.items()})
+    assert float(a.compute()) == pytest.approx(2.0)
+    # and the merged-in state must itself survive further donated updates
+    for _ in range(5):
+        a.update(jnp.full(4, 2.0))
+    assert float(a.compute()) == pytest.approx((40 + 120 + 40) / 100)
+
+
+def test_compute_then_update_keeps_computed_value_alive():
+    m = DonSum()
+    m.update(jnp.ones(4))
+    first = m.compute()
+    v = float(first)
+    for _ in range(10):
+        m.update(jnp.ones(4))
+    assert float(first) == v
+
+
+def test_donate_states_false_opt_out():
+    m = DonSum(donate_states=False)
+    for _ in range(10):
+        m.update(jnp.ones(4))
+    snap = observe.snapshot()
+    assert snap["counters"]["update_jit"] == {"DonSum": 10}
+    assert "update_donated" not in snap["counters"]
+    assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_donate_updates_enabled_global_toggle():
+    donate_updates_enabled(False)
+    m = DonSum()
+    for _ in range(5):
+        m.update(jnp.ones(4))
+    assert "update_donated" not in observe.snapshot()["counters"]
+    assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_shared_cache_instances_stay_correct_under_donation():
+    a, b = DonSum(), DonSum()
+    a.update(jnp.ones(4))
+    assert a._jitted_update is not None
+    b.update(jnp.full(4, 2.0))
+    # config-equal instances share ONE donating executable
+    assert a._jitted_update is b._jitted_update
+    for _ in range(5):
+        a.update(jnp.ones(4))
+        b.update(jnp.full(4, 2.0))
+    assert float(a.compute()) == pytest.approx(1.0)
+    assert float(b.compute()) == pytest.approx(2.0)
+    assert observe.snapshot()["counters"]["jit_compile"] == {"DonSum": 1}
+
+
+def test_eager_latch_never_leaks_deleted_buffer_errors():
+    class HostBranch(Metric):
+        full_state_update = False
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            x = jnp.asarray(x, dtype=jnp.float32)
+            if float(x.sum()) > 0:  # concretization error under tracing
+                self.total = self.total + x.sum()
+
+        def compute(self):
+            return self.total
+
+    m = HostBranch()
+    with pytest.warns(UserWarning, match="eager"):
+        m.update(jnp.ones(4))  # trace fails -> eager latch; buffers stay alive
+    for _ in range(5):
+        m.update(jnp.ones(4))
+    assert float(m.compute()) == pytest.approx(24.0)
+    snap = observe.snapshot()
+    assert snap["counters"]["update_fallback"] == {"HostBranch": 1}
+    assert "update_donated" not in snap["counters"]
+
+
+def test_fused_collection_donated_dispatch_correct_and_counted():
+    col = MetricCollection({"s": DonSum(), "m": DonMean()})
+    for i in range(20):
+        col.update(jnp.full(4, float(i)))
+    out = {k: float(v) for k, v in col.compute().items()}
+    assert out["s"] == pytest.approx(np.mean(range(20)))
+    assert out["m"] == pytest.approx(np.mean(range(20)))
+    snap = observe.snapshot()["counters"]
+    # update #1 builds the compute groups; every later step is ONE fused dispatch
+    assert snap["fused_dispatch"]["2"] >= 19
+    assert snap["fused_donated"]["2"] >= 19
+
+
+def test_fused_collection_member_state_reads_survive_donation():
+    col = MetricCollection({"s": DonSum(), "m": DonMean()})
+    col.update(jnp.ones(4))
+    held = col["s"].metric_state
+    before = {k: np.asarray(v) for k, v in held.items()}
+    for _ in range(5):
+        col.update(jnp.ones(4))
+    for k, v in held.items():
+        np.testing.assert_array_equal(np.asarray(v), before[k])
+    assert float(col.compute()["s"]) == pytest.approx(1.0)
+
+
+def test_state_aliasing_within_one_metric_is_deduped():
+    class Aliased(Metric):
+        full_state_update = False
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            shared = jnp.asarray(0.0)
+            self.add_state("a", shared, dist_reduce_fx="sum")
+            self.add_state("b", shared, dist_reduce_fx="sum")
+
+        def update(self, x):
+            x = jnp.asarray(x, dtype=jnp.float32)
+            self.a = self.a + x.sum()
+            self.b = self.b + 2 * x.sum()
+
+        def compute(self):
+            return self.a + self.b
+
+    m = Aliased()
+    # both states may start as the SAME buffer: double-donating it would crash
+    for _ in range(10):
+        m.update(jnp.ones(2))
+    assert float(m.compute()) == pytest.approx(60.0)
+
+
+def test_deepcopy_after_donated_steps_is_independent():
+    m = DonSum()
+    for _ in range(5):
+        m.update(jnp.ones(4))
+    import copy
+
+    dup = copy.deepcopy(m)
+    for _ in range(5):
+        m.update(jnp.full(4, 3.0))
+    assert float(dup.compute()) == pytest.approx(1.0)
+    dup.update(jnp.ones(4))
+    assert float(m.compute()) == pytest.approx(2.0)

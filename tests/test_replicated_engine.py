@@ -1,0 +1,286 @@
+"""Replica engine (``wrappers/replicated.py``, DESIGN §12): BootStrapper and
+MultioutputWrapper run N config-equal inner metrics as ONE vmapped jitted
+dispatch over a stacked leading-axis state pytree.
+
+The contract pinned here: the engine is an invisible optimization — results are
+bit-identical to the reference per-replica loop (forced via the
+``_engine_failed`` latch) under a fixed seed, including unequal per-replicate
+resample draws; jit-ineligible configurations fall back to the loop; and every
+reference surface (``.metrics``, state_dict, pickle, sync) still sees ordinary
+per-replica states.
+"""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+from metrics_tpu.regression import MeanSquaredError, R2Score
+from metrics_tpu.wrappers import BootStrapper, MultioutputWrapper
+from metrics_tpu.wrappers import replicated as replicated_mod
+
+N_BOOT = 10
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    observe.enable()
+    observe.reset()
+    yield
+    observe.disable()
+    clear_jit_cache()
+    jit_update_enabled(True)
+
+
+def _acc_batches(steps=4, n=64, seed=9):
+    rng = np.random.RandomState(seed)
+    return [
+        (jnp.asarray(rng.randint(3, size=n)), jnp.asarray(rng.randint(3, size=n)))
+        for _ in range(steps)
+    ]
+
+
+def _boot(engine: bool, **kwargs):
+    bs = BootStrapper(MulticlassAccuracy(num_classes=3, average="micro"), num_bootstraps=N_BOOT, **kwargs)
+    if not engine:
+        bs._engine_failed = True  # the documented loop fallback, forced
+    return bs
+
+
+def _feed(bs, batches, seed=123):
+    # resample indices draw from the global RNG at UPDATE time, in the same
+    # call order on both paths — seeding here makes engine and loop comparable
+    np.random.seed(seed)
+    for p, t in batches:
+        bs.update(p, t)
+
+
+def test_bootstrap_engine_bit_exact_vs_loop():
+    batches = _acc_batches()
+    eng, loop = _boot(True, quantile=0.5, raw=True), _boot(False, quantile=0.5, raw=True)
+    _feed(eng, batches)
+    _feed(loop, batches)
+    out_eng, out_loop = eng.compute(), loop.compute()
+    assert set(out_eng) == {"mean", "std", "quantile", "raw"}
+    for k in out_loop:
+        np.testing.assert_array_equal(np.asarray(out_eng[k]), np.asarray(out_loop[k]))
+
+
+def test_bootstrap_single_update_is_one_dispatch_not_ten():
+    bs = _boot(True)
+    p, t = _acc_batches(steps=1)[0]
+    bs.update(p, t)
+    snap = observe.snapshot()["counters"]
+    assert snap["replica_dispatch"] == {f"MulticlassAccuracyx{N_BOOT}": 1}
+    # the inner class never dispatched its own per-instance update
+    assert "MulticlassAccuracy" not in snap.get("update_jit", {})
+    assert "MulticlassAccuracy" not in snap.get("update_eager", {})
+
+
+def test_bootstrap_unequal_resample_counts_match_loop():
+    # multinomial rows genuinely differ per replicate: each replicate must see
+    # ITS OWN resample, not a shared one — compare replica states pairwise
+    batches = _acc_batches(steps=3, seed=77)
+    eng, loop = _boot(True), _boot(False)
+    _feed(eng, batches, seed=7)
+    _feed(loop, batches, seed=7)
+    states_e = [m.metric_state for m in eng.metrics]
+    states_l = [m.metric_state for m in loop.metrics]
+    # replicates are not all identical (the resamples were unequal) ...
+    assert any(
+        not np.array_equal(np.asarray(states_e[0][k]), np.asarray(states_e[1][k])) for k in states_e[0]
+    )
+    # ... yet each engine replicate bit-matches its looped twin
+    for se, sl in zip(states_e, states_l):
+        for k in se:
+            np.testing.assert_array_equal(np.asarray(se[k]), np.asarray(sl[k]))
+    for me, ml in zip(eng.metrics, loop.metrics):
+        assert me._update_count == ml._update_count == 3
+
+
+def test_bootstrap_poisson_stays_on_loop():
+    np.random.seed(3)
+    bs = BootStrapper(
+        MulticlassAccuracy(num_classes=3, average="micro"), num_bootstraps=4, sampling_strategy="poisson"
+    )
+    p, t = _acc_batches(steps=1)[0]
+    bs.update(p, t)
+    snap = observe.snapshot()["counters"]
+    assert not snap.get("replica_dispatch")
+    assert sorted(bs.compute()) == ["mean", "std"]
+
+
+def test_bootstrap_jit_disabled_stays_on_loop():
+    jit_update_enabled(False)
+    bs = _boot(True)
+    p, t = _acc_batches(steps=1)[0]
+    bs.update(p, t)
+    assert not observe.snapshot()["counters"].get("replica_dispatch")
+    assert sorted(bs.compute()) == ["mean", "std"]
+
+
+def test_bootstrap_state_dict_and_pickle_after_engine_updates():
+    bs = _boot(True)
+    for p, t in _acc_batches(steps=2):
+        bs.update(p, t)
+    sd = bs.state_dict()
+    assert {k.split(".")[0] for k in sd if k.startswith("metrics")} == {"metrics"}
+    assert any(k.startswith(f"metrics.{N_BOOT - 1}.") for k in sd)
+    expected = bs.compute()
+    revived = pickle.loads(pickle.dumps(bs))
+    got = revived.compute()
+    for k in expected:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(expected[k]))
+    # and a restored wrapper keeps updating correctly (engine re-engages)
+    p, t = _acc_batches(steps=1, seed=5)[0]
+    np.random.seed(11)
+    revived.update(p, t)
+    assert revived.metrics[0]._update_count == 3
+
+
+def test_bootstrap_load_state_dict_roundtrip_after_engine_updates():
+    bs = _boot(True)
+    _feed(bs, _acc_batches(steps=2))
+    bs.persistent(True)  # states are non-persistent by default (reference semantics)
+    sd = bs.state_dict()
+    fresh = _boot(True)
+    fresh.load_state_dict(sd)
+    expected, got = bs.compute(), fresh.compute()
+    for k in expected:
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(expected[k]))
+
+
+def test_bootstrap_reset_then_reuse_bit_exact():
+    batches = _acc_batches(steps=2)
+    bs = _boot(True)
+    _feed(bs, batches)
+    first = bs.compute()
+    bs.reset()
+    assert bs.metrics[0]._update_count == 0
+    _feed(bs, batches)  # same resample stream after reset
+    second = bs.compute()
+    for k in first:
+        np.testing.assert_array_equal(np.asarray(second[k]), np.asarray(first[k]))
+
+
+def test_bootstrap_mixed_engine_and_loop_updates():
+    # poisson-free wrapper flips between engine and loop mid-stream: the
+    # materialize/stack round trips must compose without losing updates
+    batches = _acc_batches(steps=4, seed=21)
+    mixed, loop = _boot(True), _boot(False)
+    np.random.seed(42)
+    for i, (p, t) in enumerate(batches):
+        mixed._engine_failed = bool(i % 2)  # force loop on odd steps
+        mixed.update(p, t)
+    _feed(loop, batches, seed=42)
+    out_m, out_l = mixed.compute(), loop.compute()
+    for k in out_l:
+        np.testing.assert_array_equal(np.asarray(out_m[k]), np.asarray(out_l[k]))
+
+
+def test_bootstrap_forward_returns_aggregate():
+    bs = _boot(True)
+    p, t = _acc_batches(steps=1)[0]
+    out = bs.forward(p, t)
+    assert sorted(out) == ["mean", "std"]
+    assert bs.metrics[0]._update_count == 1
+
+
+def _reg_batch(seed=3, n=16, outs=2):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(n, outs).astype(np.float32)),
+        jnp.asarray(rng.randn(n, outs).astype(np.float32)),
+    )
+
+
+def test_multioutput_engine_bit_exact_vs_loop():
+    preds, target = _reg_batch()
+    eng = MultioutputWrapper(R2Score(), num_outputs=2, remove_nans=False)
+    loop = MultioutputWrapper(R2Score(), num_outputs=2, remove_nans=False)
+    loop._engine_failed = True
+    for m in (eng, loop):
+        m.update(preds, target)
+        m.update(target, preds)
+    np.testing.assert_array_equal(np.asarray(eng.compute()), np.asarray(loop.compute()))
+    snap = observe.snapshot()["counters"]
+    assert snap["replica_dispatch"]["R2Scorex2"] == 3  # 2 updates + 1 compute
+
+
+def test_multioutput_remove_nans_default_stays_on_loop():
+    preds, target = _reg_batch()
+    m = MultioutputWrapper(R2Score(), num_outputs=2)  # remove_nans=True default
+    m.update(preds, target)
+    assert not observe.snapshot()["counters"].get("replica_dispatch")
+    assert np.asarray(m.compute()).shape == (2,)
+
+
+def test_multioutput_wrong_output_axis_size_stays_on_loop():
+    # axis 0 has size 3 != 2 outputs: the engine's moveaxis would vmap over the
+    # wrong extent, so _engine_sliceable must route this to the reference loop
+    # (whose jnp.take just reads rows 0 and 1)
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False, output_dim=0)
+    preds, target = _reg_batch(n=3, outs=5)
+    m.update(preds, target)
+    assert not observe.snapshot()["counters"].get("replica_dispatch")
+    assert np.asarray(m.compute()).shape == (2,)
+
+
+def test_multioutput_engine_nonminus1_output_dim():
+    rng = np.random.RandomState(8)
+    preds = jnp.asarray(rng.randn(3, 16).astype(np.float32))
+    target = jnp.asarray(rng.randn(3, 16).astype(np.float32))
+    eng = MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False, output_dim=0)
+    loop = MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False, output_dim=0)
+    loop._engine_failed = True
+    eng.update(preds, target)
+    loop.update(preds, target)
+    np.testing.assert_array_equal(np.asarray(eng.compute()), np.asarray(loop.compute()))
+    assert observe.snapshot()["counters"]["replica_dispatch"]["MeanSquaredErrorx3"] >= 1
+
+
+def test_replica_cache_shared_across_config_equal_wrappers():
+    p, t = _acc_batches(steps=1)[0]
+    a, b = _boot(True), _boot(True)
+    np.random.seed(1)
+    a.update(p, t)
+    np.random.seed(2)
+    b.update(p, t)
+    snap = observe.snapshot()["counters"]
+    label = f"MulticlassAccuracyx{N_BOOT}"
+    assert snap["replica_compile"] == {label: 1}  # ONE compile for both wrappers
+    assert snap["replica_hit"] == {label: 1}
+    assert snap["replica_dispatch"] == {label: 2}
+
+
+def test_clear_jit_cache_drops_replica_cache():
+    bs = _boot(True)
+    p, t = _acc_batches(steps=1)[0]
+    bs.update(p, t)
+    assert len(replicated_mod._REPLICA_JIT_CACHE) >= 1
+    clear_jit_cache()
+    assert len(replicated_mod._REPLICA_JIT_CACHE) == 0
+    bs.update(p, t)  # recompiles transparently
+    assert len(replicated_mod._REPLICA_JIT_CACHE) >= 1
+
+
+def test_metrics_property_materializes_live_states():
+    bs = _boot(True)
+    for p, t in _acc_batches(steps=2):
+        bs.update(p, t)
+    # .metrics exposes ordinary per-replica Metric objects mid-stream
+    for m in bs.metrics:
+        assert m._update_count == 2
+        st = m.metric_state
+        assert all(hasattr(v, "shape") for v in st.values())
+    # and the wrapper keeps accepting updates afterwards
+    np.random.seed(31)
+    p, t = _acc_batches(steps=1, seed=13)[0]
+    bs.update(p, t)
+    assert bs.metrics[0]._update_count == 3
