@@ -1,6 +1,6 @@
-"""Static & dynamic analysis for metrics_tpu: jitlint + distlint.
+"""Static & dynamic analysis for metrics_tpu: jitlint + distlint + donlint.
 
-Four complementary passes guard the invariants the runtime cannot check:
+Six complementary passes guard the invariants the runtime cannot check:
 
 * **jitlint AST pass** (:mod:`metrics_tpu.analysis.rules`, rules JL001–JL006)
   flags tracer concretization, recompilation keys, state-contract breaches,
@@ -11,6 +11,11 @@ Four complementary passes guard the invariants the runtime cannot check:
   reduction algebra, non-additive read-modify-writes in ``update``,
   merge-fragile ``compute`` bodies, raw collectives outside the sync layer,
   and ``merge_state`` overrides that drop states (DESIGN §10).
+* **donlint AST pass** (:mod:`metrics_tpu.analysis.mem_rules`, rules
+  ML001–ML006) proves donated state buffers cannot escape, alias, or be
+  resurrected: update/compute escape routes, intra-metric aliasing,
+  shape-stackable list states, unjustified ``donate_states=False`` opt-outs,
+  and ``reset`` overrides that re-bind shared defaults (DESIGN §13).
 * the **abstract-interpretation pass**
   (:mod:`metrics_tpu.analysis.abstract_contracts`) traces every registered
   functional kernel with ``jax.eval_shape`` over canonical abstract inputs.
@@ -19,12 +24,25 @@ Four complementary passes guard the invariants the runtime cannot check:
   split-update-merge vs single-pass compute and shard-permutation invariance
   for every exported Metric class, classifying each as MERGE_SOUND /
   MERGE_UNSOUND / CAT_ORDER_SENSITIVE against a checked-in baseline.
+* the **donation-contract harness**
+  (:mod:`metrics_tpu.analysis.donation_contracts`) runs every jit-eligible
+  class through 3-step donate-enabled update loops and cross-checks three
+  sources of truth — the static donlint verdict, ``costs.py``'s
+  ``donation_eligible``, and the runtime probation/buffer-deletion outcome —
+  failing on any disagreement.
 
-CLI: ``python tools/lint_metrics.py [--pass jitlint|distlint | --all]`` or the
-``jitlint`` / ``distlint`` console scripts.
+CLI: ``python tools/lint_metrics.py [--pass <name> | --all] [--json]`` or the
+``jitlint`` / ``distlint`` / ``donlint`` console scripts.
 """
 
-from metrics_tpu.analysis.contexts import DIST_RULE_CODES, RULE_CODES, Suppressions, Violation
+from metrics_tpu.analysis.contexts import (
+    DIST_RULE_CODES,
+    LINT_PREFIXES,
+    MEM_RULE_CODES,
+    RULE_CODES,
+    Suppressions,
+    Violation,
+)
 from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.engine import (
     LintResult,
@@ -32,15 +50,21 @@ from metrics_tpu.analysis.engine import (
     lint_file,
     lint_paths,
     load_baseline,
+    load_baseline_section,
     write_baseline,
+    write_baseline_section,
 )
+from metrics_tpu.analysis.mem_rules import MEM_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 
 __all__ = [
     "ALL_RULES",
     "DIST_RULES",
     "DIST_RULE_CODES",
+    "LINT_PREFIXES",
     "LintResult",
+    "MEM_RULES",
+    "MEM_RULE_CODES",
     "ModuleInfo",
     "RULE_CODES",
     "Suppressions",
@@ -49,5 +73,7 @@ __all__ = [
     "lint_file",
     "lint_paths",
     "load_baseline",
+    "load_baseline_section",
     "write_baseline",
+    "write_baseline_section",
 ]

@@ -1,20 +1,28 @@
-"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint``.
+"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint``.
 
-Two passes share one engine and one exit-code contract:
+Three static passes share one engine and one exit-code contract:
 
 * ``jitlint``  — tracer-safety & recompilation rules JL001–JL006, baselined in
   ``tools/jitlint_baseline.json``
 * ``distlint`` — merge-soundness & collective-safety rules DL001–DL005,
   baselined in ``tools/distlint_baseline.json``
+* ``donlint``  — donated-buffer escape/alias rules ML001–ML006, baselined in
+  ``tools/donlint_baseline.json``
 
-A third, dynamic pass rides the same selection/exit-code contract:
+Two dynamic passes ride the same selection/exit-code contract:
 
+* ``donation`` — 3-step donate-enabled update loops cross-checking static
+  donlint verdicts, ``costs.py`` eligibility, and runtime buffer deletion
+  (:mod:`metrics_tpu.analysis.donation_contracts`), disagreements baselined in
+  the ``donation`` section of ``tools/donlint_baseline.json``
 * ``perf`` — XLA cost profiling of compiled metric updates
   (:mod:`metrics_tpu.observe.profile`), ratcheted against
   ``tools/perf_baseline.json``
 
-Select with ``--pass jitlint|distlint|perf`` or run everything with ``--all``
-(the CI shape: one invocation, one verdict). Exit codes: 0 clean (or fully
+Select with ``--pass <name>`` or run everything with ``--all`` (the CI shape:
+one invocation, one verdict — ``tools/ci_check.sh``). ``--json`` emits one
+machine-readable document: per-pass status, violation counts, and baseline
+deltas, plus the aggregated exit code. Exit codes: 0 clean (or fully
 baselined), 1 new violations/regressions in *any* selected pass, 2
 usage/parse error.
 """
@@ -27,7 +35,7 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence
 
-from metrics_tpu.analysis.contexts import DIST_RULE_CODES, RULE_CODES
+from metrics_tpu.analysis.contexts import DIST_RULE_CODES, MEM_RULE_CODES, RULE_CODES
 from metrics_tpu.analysis.engine import (
     diff_against_baseline,
     lint_paths,
@@ -35,7 +43,7 @@ from metrics_tpu.analysis.engine import (
     write_baseline,
 )
 
-__all__ = ["main", "main_distlint"]
+__all__ = ["main", "main_distlint", "main_donlint"]
 
 _PASSES: Dict[str, Dict[str, object]] = {
     "jitlint": {
@@ -46,41 +54,55 @@ _PASSES: Dict[str, Dict[str, object]] = {
         "rules": DIST_RULE_CODES,
         "baseline": os.path.join("tools", "distlint_baseline.json"),
     },
+    "donlint": {
+        "rules": MEM_RULE_CODES,
+        "baseline": os.path.join("tools", "donlint_baseline.json"),
+    },
 }
+
+# dynamic passes: no rule codes, run programs instead of parsing them.
+# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, perf lowers
+# the whole registry).
+_DYNAMIC = ("donation", "perf")
 
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="jitlint",
         description="Static analysis for metrics_tpu: jitlint (JL001-JL006, tracer safety), "
-                    "distlint (DL001-DL005, distributed merge soundness), and the perf "
-                    "cost-baseline check.",
+                    "distlint (DL001-DL005, distributed merge soundness), donlint "
+                    "(ML001-ML006, donated-buffer escape/alias safety), the donation "
+                    "cross-check, and the perf cost-baseline check.",
     )
     p.add_argument("targets", nargs="*", default=["metrics_tpu"],
                    help="files or directories to lint (default: metrics_tpu)")
     p.add_argument("--root", default=None, help="repo root for relative paths (default: cwd)")
     p.add_argument("--pass", dest="passes", action="append",
-                   choices=sorted([*_PASSES, "perf"]),
+                   choices=sorted([*_PASSES, *_DYNAMIC]),
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
-                   help="run every pass (jitlint + distlint + perf) in one invocation")
+                   help="run every pass (jitlint + distlint + donlint + donation + perf) "
+                        "in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
-                        "e.g. JL001,DL004; baseline follows each code's own pass)")
+                        "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")
     p.add_argument("--baseline", default=None,
                    help="baseline JSON path override (only with a single selected pass)")
     p.add_argument("--no-baseline", action="store_true", help="ignore baselines entirely")
     p.add_argument("--update-baseline", action="store_true",
                    help="write current violations as the new baseline(s) and exit 0")
     p.add_argument("--format", choices=("text", "json"), default="text", dest="fmt")
+    p.add_argument("--json", action="store_const", const="json", dest="fmt",
+                   help="shorthand for --format json (one machine-readable report, "
+                        "per-pass status + aggregated exit code)")
     p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
     return p
 
 
 def _selected_passes(args: argparse.Namespace) -> List[str]:
     if args.run_all:
-        # deterministic: cheap AST passes first, the dynamic perf pass last
-        return sorted(_PASSES) + ["perf"]
+        # deterministic: cheap AST passes first, then the dynamic passes
+        return sorted(_PASSES) + list(_DYNAMIC)
     if args.passes:
         # de-dup, preserve order
         seen: List[str] = []
@@ -125,17 +147,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     exit_code = 0
     report: Dict[str, object] = {}
     for name in passes:
-        if name == "perf":
+        if name in _DYNAMIC:
             if explicit_rules is not None:
-                continue  # perf has no rule codes; --rules selects AST rules only
-            from metrics_tpu.observe.profile import run_perf_check  # noqa: PLC0415 — lazy: imports jax
+                continue  # dynamic passes have no rule codes; --rules selects AST rules only
+            # lazy: both import jax and build the metric registry
+            if name == "perf":
+                from metrics_tpu.observe.profile import run_perf_check as run_dynamic  # noqa: PLC0415
+            else:
+                from metrics_tpu.analysis.donation_contracts import (  # noqa: PLC0415
+                    run_donation_check as run_dynamic,
+                )
 
-            rc = run_perf_check(
+            pass_report: Optional[Dict[str, object]] = {} if args.fmt == "json" else None
+            rc = run_dynamic(
                 root,
                 baseline_path=args.baseline if len(passes) == 1 else None,
                 update_baseline=args.update_baseline,
                 quiet=args.quiet,
+                report=pass_report,
             )
+            if pass_report is not None:
+                pass_report["status"] = "fail" if rc else "ok"
+                report[name] = pass_report
             if rc:
                 exit_code = 1
             continue
@@ -161,6 +194,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         if args.fmt == "json":
             report[name] = {
+                "status": "fail" if new else "ok",
                 "files_scanned": result.files_scanned,
                 "new": [v.__dict__ for v in new],
                 "baselined": baselined,
@@ -183,7 +217,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             exit_code = 1
 
     if args.fmt == "json" and not args.update_baseline:
-        print(json.dumps(report if len(report) != 1 else next(iter(report.values())), indent=2))
+        # one selected pass prints its report unwrapped; several get the
+        # aggregated {passes, exit_code} document (the ci_check.sh shape)
+        if len(report) == 1:
+            print(json.dumps(next(iter(report.values())), indent=2))
+        else:
+            print(json.dumps({"passes": report, "exit_code": exit_code}, indent=2))
     return exit_code
 
 
@@ -191,6 +230,12 @@ def main_distlint(argv: Optional[Sequence[str]] = None) -> int:
     """Entry point for the ``distlint`` console script — DL rules only."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(["--pass", "distlint", *argv])
+
+
+def main_donlint(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for the ``donlint`` console script — ML rules + donation cross-check."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(["--pass", "donlint", "--pass", "donation", *argv])
 
 
 if __name__ == "__main__":  # pragma: no cover

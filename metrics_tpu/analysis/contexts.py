@@ -34,17 +34,25 @@ __all__ = [
     "TracedContext",
     "find_traced_contexts",
     "ArrayTaint",
+    "LINT_PREFIXES",
     "RULE_CODES",
     "DIST_RULE_CODES",
+    "MEM_RULE_CODES",
 ]
 
 RULE_CODES = ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006")
 DIST_RULE_CODES = ("DL001", "DL002", "DL003", "DL004", "DL005")
+MEM_RULE_CODES = ("ML001", "ML002", "ML003", "ML004", "ML005", "ML006")
 
-# `# jitlint: disable=JL001` and `# distlint: disable=DL002` share one grammar;
-# either prefix may carry codes from either pass (codes are globally unique).
-_SUPPRESS_RE = re.compile(r"#\s*(?:jitlint|distlint):\s*disable=([A-Za-z0-9_,\s]+)")
-_SUPPRESS_FILE_RE = re.compile(r"#\s*(?:jitlint|distlint):\s*disable-file=([A-Za-z0-9_,\s]+)")
+# `# jitlint: disable=JL001`, `# distlint: disable=DL002` and `# donlint:
+# disable=ML003` share one grammar; any prefix may carry codes from any pass
+# (codes are globally unique). A new pass registers its prefix here ONCE and
+# both suppression forms — per-line and file-wide — work for it; nothing else
+# needs a parser.
+LINT_PREFIXES = ("jitlint", "distlint", "donlint")
+_PREFIX_ALT = "|".join(LINT_PREFIXES)
+_SUPPRESS_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable-file=([A-Za-z0-9_,\s]+)")
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,319 @@
+"""Dynamic donation-contract harness: three sources of truth, zero tolerance.
+
+For every jit-eligible class in the profile registry this runs a 3-step
+donate-enabled update loop and cross-checks three independent verdicts on the
+same question — *may this class's update consume its input state buffers?*
+
+1. **static** — :func:`metrics_tpu.analysis.mem_rules.classify_donation`, read
+   off the class hierarchy's source (unconditional list states,
+   ``donate_states=False`` opt-outs);
+2. **costs** — ``Metric._donation_eligible()``, the same predicate the cost
+   profiler exports as ``donation_eligible`` and the dispatch uses to pick the
+   donating executable;
+3. **runtime** — what actually happened: which dispatch path ran (recorder
+   counters), whether probation latched donation off (``donation_unusable``
+   events), and whether buffers held across the dispatch were really consumed
+   (``jax.Array.is_deleted`` on a pre-dispatch state snapshot taken through
+   ``__dict__['_state']``, deliberately bypassing the escape latch so the
+   probe itself doesn't force a copy).
+
+Any disagreement is a lint failure: a class the static pass clears but the
+runtime refuses to donate is a silent steady-state allocation; a class the
+runtime donates but the static pass rejects means the analyzer has a hole.
+Runtime ``EAGER`` is compatible with an eligible verdict — donation is an
+attribute of the *jitted* path, and a class may opt out of jit (the
+aggregation metrics' nan_strategy host check) while its state contract stays
+donation-clean.
+
+The loop also asserts the user-facing lifecycle survives donation: ``compute``
+after the loop must materialize, and a value read between updates (through the
+escape latch) must stay alive after the next donated step.
+
+Disagreements are baselined in the ``donation`` section of
+``tools/donlint_baseline.json`` (expected empty; every entry needs a
+justification string). Runs as the ``donation`` pass of ``tools/lint_metrics
+--all`` and standalone via ``python -m metrics_tpu.analysis.donation_contracts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DonationResult",
+    "check_donation_case",
+    "diff_donation_baseline",
+    "donation_cases",
+    "main",
+    "run_donation_check",
+]
+
+_DEFAULT_BASELINE = os.path.join("tools", "donlint_baseline.json")
+_STEPS = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationResult:
+    name: str
+    static_eligible: bool
+    static_detail: str  # blocker list when ineligible
+    costs_eligible: bool
+    runtime: str  # DONATED | NON_DONATING | UNUSABLE | EAGER | ERROR:<why>
+    agree: bool
+    detail: str = ""
+
+    def render(self) -> str:
+        mark = "ok " if self.agree else "DISAGREE"
+        return (
+            f"{mark} {self.name}: static={'eligible' if self.static_eligible else 'ineligible'} "
+            f"costs={'eligible' if self.costs_eligible else 'ineligible'} runtime={self.runtime}"
+            + (f" ({self.detail})" if self.detail else "")
+        )
+
+
+def donation_cases() -> List[Any]:
+    """The jit-eligible slice of the profile registry (same gate as costs.py)."""
+    from metrics_tpu.observe.costs import PROFILE_CASES
+
+    cases = []
+    for case in PROFILE_CASES:
+        try:
+            m = case.ctor()
+        except Exception:  # a broken ctor is the profiler's problem, not ours
+            continue
+        if type(m).__jit_ineligible__ or m._has_list_state():
+            continue
+        cases.append(case)
+    return cases
+
+
+def _runtime_verdict(
+    probe: Any, cls_name: str, entry: Optional[Any], deleted: List[str], held: Dict[str, Any]
+) -> Tuple[str, str]:
+    """Fold counters/events/buffer-deletion into one runtime verdict string."""
+    jit_steps = probe.counters.get(("update_jit", cls_name), 0)
+    fallback = probe.counters.get(("update_fallback", cls_name), 0)
+    unusable = any(
+        e.get("kind") == "donation_unusable" and e.get("metric") == cls_name for e in probe.events
+    )
+    if fallback:
+        return "ERROR:tracer-fallback", "update fell back to eager mid-loop"
+    if jit_steps == 0:
+        if deleted:
+            return "ERROR:eager-deleted", f"no jitted step, yet buffers deleted: {', '.join(deleted)}"
+        return "EAGER", ""
+    if unusable:
+        return "UNUSABLE", "probation latched donation off (XLA could not alias)"
+    donating = bool(entry is not None and getattr(entry, "donate", False))
+    if not donating:
+        if deleted:
+            return "ERROR:nondonating-deleted", f"non-donating path deleted: {', '.join(deleted)}"
+        return "NON_DONATING", ""
+    if not deleted:
+        return (
+            "ERROR:donate-noop",
+            "donating executable ran but every held pre-dispatch buffer survived",
+        )
+    partial = sorted(set(held) - set(deleted))
+    return "DONATED", f"surviving buffers: {', '.join(partial)}" if partial else ""
+
+
+def check_donation_case(case: Any) -> DonationResult:
+    """One class through the 3-step loop; never raises (errors become verdicts)."""
+    import jax
+
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.analysis.mem_rules import classify_donation
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+    from metrics_tpu.observe.costs import _rng
+
+    probe = _observe.Recorder()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled = _observe.ENABLED
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    saved_donate = metric_mod._DONATE_UPDATE_DEFAULT
+    real = _observe.RECORDER
+    _observe.RECORDER = probe
+    try:
+        _observe.ENABLED = True
+        metric_mod._JIT_UPDATE_DEFAULT = True
+        metric_mod._DONATE_UPDATE_DEFAULT = True
+        clear_jit_cache()
+        m = case.ctor()
+        cls_name = type(m).__name__
+        static_eligible, static_detail = classify_donation(type(m))
+        costs_eligible = bool(m._donation_eligible())
+        rng = _rng(case)
+
+        # step 1 traces + compiles (and runs probation when donating)
+        m.update(*case.batch(rng))
+        # snapshot the post-step-1 buffers through __dict__ — NOT the metric_state
+        # property, whose escape latch would make step 2 copy instead of donate
+        held = {
+            k: v for k, v in m.__dict__["_state"].items() if isinstance(v, jax.Array)
+        }
+        m.update(*case.batch(rng))  # steady-state donated dispatch
+        deleted = sorted(k for k, v in held.items() if v.is_deleted())
+
+        # lifecycle survives donation: a latched read between updates must stay
+        # alive across the following (copy-before-donate) dispatch ...
+        probe_read = next(iter(m.metric_state.values()), None)
+        m.update(*case.batch(rng))
+        if probe_read is not None and getattr(probe_read, "is_deleted", lambda: False)():
+            return DonationResult(
+                case.name, static_eligible, static_detail, costs_eligible,
+                "ERROR:latch-bypassed", False,
+                "a metric_state read was consumed by the next update — escape latch broken",
+            )
+        # ... and compute must materialize off the final (donated-into) buffers
+        jax.block_until_ready(jax.tree_util.tree_leaves(m.compute()))
+
+        runtime, detail = _runtime_verdict(probe, cls_name, m._jitted_update, deleted, held)
+    except Exception as exc:  # noqa: BLE001 — every failure is a reportable verdict
+        return DonationResult(
+            case.name, False, "", False, f"ERROR:{type(exc).__name__}", False, str(exc)[:200]
+        )
+    finally:
+        _observe.RECORDER = real
+        _observe.ENABLED = saved_enabled
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+        metric_mod._DONATE_UPDATE_DEFAULT = saved_donate
+        clear_jit_cache()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+
+    # three-way agreement --------------------------------------------------
+    if runtime.startswith("ERROR"):
+        agree = False
+    elif static_eligible != costs_eligible:
+        agree = False
+    elif static_eligible:
+        # EAGER is fine (jit opt-out, donation not exercised); a donation the
+        # runtime refused (UNUSABLE/NON_DONATING) is a broken promise
+        agree = runtime in ("DONATED", "EAGER")
+    else:
+        agree = runtime in ("EAGER", "NON_DONATING")
+    return DonationResult(
+        case.name, static_eligible, static_detail, costs_eligible, runtime, agree, detail
+    )
+
+
+def collect_donation_report(cases: Optional[Sequence[Any]] = None) -> List[DonationResult]:
+    return [check_donation_case(c) for c in (cases if cases is not None else donation_cases())]
+
+
+# ------------------------------------------------------------------- baseline
+def load_donation_baseline(path: str) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): str(v) for k, v in load_baseline_section(path, "donation").items()}
+
+
+def write_donation_baseline(path: str, results: Sequence[DonationResult]) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
+    donation = {
+        r.name: f"UNJUSTIFIED: static={r.static_eligible} costs={r.costs_eligible} runtime={r.runtime}"
+        for r in sorted(results, key=lambda r: r.name)
+        if not r.agree
+    }
+    write_baseline_section(
+        path,
+        "donation",
+        donation,  # type: ignore[arg-type]
+        "donlint baseline — static escape/alias exceptions under `entries` "
+        "(path::rule::context -> count), donation cross-check disagreements under "
+        "`donation` (class -> justification; expected empty). Regenerate with "
+        "`python tools/lint_metrics.py --pass donlint --pass donation --update-baseline`.",
+        seed={"entries": {}},
+    )
+    return donation
+
+
+def diff_donation_baseline(
+    results: Sequence[DonationResult], baseline: Dict[str, str]
+) -> Tuple[List[DonationResult], List[str]]:
+    """Split into (failures, stale_baseline_keys): unbaselined disagreements fail."""
+    failures = [r for r in results if not r.agree and r.name not in baseline]
+    observed = {r.name for r in results}
+    disagreeing = {r.name for r in results if not r.agree}
+    stale = sorted(
+        name for name in baseline if name not in disagreeing or name not in observed
+    )
+    return failures, stale
+
+
+def run_donation_check(
+    root: str,
+    baseline_path: Optional[str] = None,
+    update_baseline: bool = False,
+    quiet: bool = False,
+    report: Optional[Dict[str, Any]] = None,
+) -> int:
+    """The ``donation`` pass of ``lint_metrics --all``: loop, cross-check, verdict."""
+    path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
+    results = collect_donation_report()
+    if update_baseline:
+        donation = write_donation_baseline(path, results)
+        if not quiet:
+            print(f"donation: baseline written to {path} ({len(donation)} disagreement(s))")
+        return 0
+    failures, stale = diff_donation_baseline(results, load_donation_baseline(path))
+    if report is not None:
+        # the caller owns stdout (one JSON document) — collect, don't print
+        report.update(
+            {
+                "cases": len(results),
+                "failures": [r.render() for r in failures],
+                "baselined": sum(1 for r in results if not r.agree) - len(failures),
+                "stale_baseline_keys": stale,
+                "runtime_verdicts": {r.name: r.runtime for r in results},
+            }
+        )
+        return 1 if failures else 0
+    for r in failures:
+        print(f"donation: {r.render()}")
+    if not quiet:
+        for key in stale:
+            print(f"donation: stale baseline entry: {key}")
+        agreed = sum(1 for r in results if r.agree)
+        donated = sum(1 for r in results if r.runtime == "DONATED")
+        print(
+            f"donation: {agreed}/{len(results)} classes agree "
+            f"({donated} donated at runtime), {len(failures)} failure(s), {len(stale)} stale"
+        )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="donation-contracts",
+        description="3-step donate-enabled update loops cross-checking static donlint "
+        "verdicts, costs.py donation_eligible, and runtime buffer-deletion outcomes.",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="donlint baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current disagreements as the new baseline and exit 0")
+    p.add_argument("-v", "--verbose", action="store_true", help="print every class verdict")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.verbose:
+        for r in collect_donation_report():
+            print(r.render())
+    return run_donation_check(
+        root,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

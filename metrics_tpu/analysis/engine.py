@@ -19,13 +19,23 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from metrics_tpu.analysis.contexts import RULE_CODES, Suppressions, Violation
 from metrics_tpu.analysis.dist_rules import DIST_RULES
+from metrics_tpu.analysis.mem_rules import MEM_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 
-__all__ = ["LintResult", "lint_file", "lint_paths", "load_baseline", "write_baseline", "diff_against_baseline"]
+__all__ = [
+    "LintResult",
+    "lint_file",
+    "lint_paths",
+    "load_baseline",
+    "load_baseline_section",
+    "write_baseline",
+    "write_baseline_section",
+    "diff_against_baseline",
+]
 
-# one registry across both passes; rule codes are globally unique so a
-# ``--rules JL001,DL004`` mix selects freely across them
-_REGISTRY = {**ALL_RULES, **DIST_RULES}
+# one registry across all passes; rule codes are globally unique so a
+# ``--rules JL001,DL004,ML002`` mix selects freely across them
+_REGISTRY = {**ALL_RULES, **DIST_RULES, **MEM_RULES}
 
 # directories whose members are traced-context-by-default kernels
 _FUNCTIONAL_ROOTS = ("metrics_tpu/functional", "metrics_tpu/ops")
@@ -112,35 +122,65 @@ def lint_paths(targets: Sequence[str], root: Optional[str] = None, rules: Option
 
 
 # --------------------------------------------------------------------------- baseline
-def load_baseline(path: str) -> Dict[str, int]:
+# Every baseline file in tools/ is one JSON document holding a "comment" plus
+# one section per owner: the static passes own "entries", the merge harness
+# owns "merge", the donation harness owns "donation", the perf ratchet owns
+# "cost". The two helpers below are the ONLY read/write path — each owner
+# rewrites its own section and must leave every sibling untouched.
+def load_baseline_section(path: str, section: str) -> Dict[str, object]:
+    """One named section of a baseline JSON document ({} when absent)."""
     if not os.path.exists(path):
         return {}
     with open(path, "r", encoding="utf-8") as fh:
         data = json.load(fh)
-    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+    value = data.get(section, {})
+    return dict(value) if isinstance(value, dict) else {}
 
 
-def write_baseline(path: str, violations: Sequence[Violation]) -> Dict[str, int]:
-    entries = dict(sorted(Counter(v.key() for v in violations).items()))
-    payload: Dict[str, object] = {
-        "comment": "lint baseline — intentional exceptions, keyed path::rule::context. "
-                   "Regenerate with `python tools/lint_metrics.py --update-baseline`.",
-        "entries": entries,
-    }
-    # preserve sibling sections (e.g. distlint's "merge" classifications, owned
-    # by analysis/merge_contracts.py) when refreshing the static entries
+def write_baseline_section(
+    path: str,
+    section: str,
+    values: Dict[str, object],
+    comment: str,
+    seed: Optional[Dict[str, object]] = None,
+) -> Dict[str, object]:
+    """Rewrite one section (and the comment), preserving every sibling section.
+
+    ``seed`` supplies sections to create when the file does not have them yet
+    (e.g. the merge harness seeds an empty static ``entries``); an existing
+    sibling always wins over its seed.
+    """
+    payload: Dict[str, object] = {"comment": comment, section: values}
+    for k, v in (seed or {}).items():
+        payload.setdefault(k, v)
     if os.path.exists(path):
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 existing = json.load(fh)
             for k, v in existing.items():
-                if k not in ("comment", "entries"):
+                if k not in ("comment", section):
                     payload[k] = v
         except (OSError, ValueError):
             pass
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=2, sort_keys=True)
         fh.write("\n")
+    return values
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    return {str(k): int(v) for k, v in load_baseline_section(path, "entries").items()}  # type: ignore[arg-type]
+
+
+def write_baseline(path: str, violations: Sequence[Violation]) -> Dict[str, int]:
+    entries = dict(sorted(Counter(v.key() for v in violations).items()))
+    write_baseline_section(
+        path,
+        "entries",
+        entries,  # type: ignore[arg-type]
+        "lint baseline — intentional exceptions, keyed path::rule::context. "
+        "Regenerate with `python tools/lint_metrics.py --update-baseline`.",
+    )
     return entries
 
 

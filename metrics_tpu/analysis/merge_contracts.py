@@ -35,7 +35,6 @@ Run via ``tests/test_merge_contracts.py`` or directly::
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -142,6 +141,9 @@ def _fold_shards(case: MergeCase, shard_batches: Sequence[Sequence[Tuple[Any, ..
         count += m._update_count
     holder = case.ctor()
     holder.__dict__["_state"] = dict(state)
+    # the spliced fold may alias the replicas' buffers — latch so any donated
+    # dispatch of the holder copies rather than consuming shared arrays
+    holder._state_escaped = True
     holder._update_count = count
     return holder.compute()
 
@@ -342,40 +344,30 @@ _DEFAULT_BASELINE = os.path.join("tools", "distlint_baseline.json")
 
 def load_merge_baseline(path: str) -> Dict[str, str]:
     """The ``"merge"`` section of the distlint baseline: class name → classification."""
-    if not os.path.exists(path):
-        return {}
-    with open(path, "r", encoding="utf-8") as fh:
-        data = json.load(fh)
-    return {str(k): str(v) for k, v in data.get("merge", {}).items()}
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): str(v) for k, v in load_baseline_section(path, "merge").items()}
 
 
 def write_merge_baseline(path: str, results: Sequence[MergeResult]) -> Dict[str, str]:
     """Record every non-SOUND classification; preserves the static ``entries``."""
+    from metrics_tpu.analysis.engine import write_baseline_section
+
     merge = {
         r.case.name: r.classification
         for r in sorted(results, key=lambda r: r.case.name)
         if r.classification != "MERGE_SOUND"
     }
-    payload: Dict[str, Any] = {
-        "comment": "distlint baseline — static entries keyed path::rule::context, merge-harness "
-                   "classifications keyed by exported class name. Regenerate with "
-                   "`python tools/lint_metrics.py --pass distlint --update-baseline` and "
-                   "`python -m metrics_tpu.analysis.merge_contracts --update-baseline`.",
-        "entries": {},
-        "merge": merge,
-    }
-    if os.path.exists(path):
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                existing = json.load(fh)
-            for k, v in existing.items():
-                if k not in ("comment", "merge"):
-                    payload[k] = v
-        except (OSError, ValueError):
-            pass
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(payload, fh, indent=2, sort_keys=True)
-        fh.write("\n")
+    write_baseline_section(
+        path,
+        "merge",
+        merge,  # type: ignore[arg-type]
+        "distlint baseline — static entries keyed path::rule::context, merge-harness "
+        "classifications keyed by exported class name. Regenerate with "
+        "`python tools/lint_metrics.py --pass distlint --update-baseline` and "
+        "`python -m metrics_tpu.analysis.merge_contracts --update-baseline`.",
+        seed={"entries": {}},
+    )
     return merge
 
 

@@ -377,7 +377,9 @@ class MemorizationInformedFrechetInceptionDistance(FrechetInceptionDistance):
         try:
             return super().forward(*args, **kwargs)
         except Exception:
-            self.__dict__["_state"] = state_backup
+            # the backup is a private _copy_state() snapshot — restoring it
+            # creates no outside alias
+            self.__dict__["_state"] = state_backup  # donlint: disable=ML001
             self._update_count = count_backup
             self._computed = None
             self._to_sync = self.sync_on_compute

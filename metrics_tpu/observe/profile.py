@@ -52,33 +52,23 @@ def report_to_dict(results: Sequence[CostReport]) -> Dict[str, Dict[str, Any]]:
 
 
 def load_cost_baseline(path: str) -> Dict[str, Dict[str, Any]]:
-    if not os.path.exists(path):
-        return {}
-    with open(path, "r", encoding="utf-8") as fh:
-        data = json.load(fh)
-    return {str(k): dict(v) for k, v in data.get("cost", {}).items()}
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): dict(v) for k, v in load_baseline_section(path, "cost").items()}
 
 
 def write_cost_baseline(path: str, results: Sequence[CostReport]) -> Dict[str, Dict[str, Any]]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
     cost = dict(sorted(report_to_dict(results).items()))
-    payload: Dict[str, Any] = {
-        "comment": "perf baseline — XLA cost model per compiled metric update, keyed by exported "
-                   "class name. Regenerate with `python tools/profile_metrics.py --update-baseline`.",
-        "tolerance": DEFAULT_TOLERANCE,
-        "cost": cost,
-    }
-    if os.path.exists(path):  # preserve sibling sections, mirroring engine.write_baseline
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                existing = json.load(fh)
-            for k, v in existing.items():
-                if k not in ("comment", "cost", "tolerance"):
-                    payload[k] = v
-        except (OSError, ValueError):
-            pass
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(payload, fh, indent=2, sort_keys=True)
-        fh.write("\n")
+    write_baseline_section(
+        path,
+        "cost",
+        cost,
+        "perf baseline — XLA cost model per compiled metric update, keyed by exported "
+        "class name. Regenerate with `python tools/profile_metrics.py --update-baseline`.",
+        seed={"tolerance": DEFAULT_TOLERANCE},
+    )
     return cost
 
 
@@ -139,8 +129,14 @@ def run_perf_check(
     include_memory: bool = False,
     update_baseline: bool = False,
     quiet: bool = False,
+    report: Optional[Dict[str, Any]] = None,
 ) -> int:
-    """The ``perf`` pass of ``lint_metrics --all``: profile, ratchet, one verdict line."""
+    """The ``perf`` pass of ``lint_metrics --all``: profile, ratchet, one verdict line.
+
+    With ``report`` given (the CLI's ``--json`` path), findings are collected
+    into it instead of printed — the caller owns the one JSON document on
+    stdout.
+    """
     path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
     results = collect_cost_report(include_memory=include_memory)
     failures = [r for r in results if not r.ok]
@@ -150,6 +146,16 @@ def run_perf_check(
             print(f"perf: baseline written to {path} ({len(cost)} classes)")
         return 0
     regressions, stale, new = diff_cost_baseline(results, load_cost_baseline(path), tolerance)
+    if report is not None:
+        report.update({
+            "profiled": sum(1 for r in results if r.ok),
+            "cases": len(results),
+            "regressions": regressions,
+            "stale": stale,
+            "new": new,
+            "skipped": {r.case.name: r.error for r in failures},
+        })
+        return 1 if regressions else 0
     for line in regressions:
         print(f"perf: REGRESSION {line}")
     if not quiet:

@@ -139,9 +139,18 @@ RECORDER = Recorder()
 
 
 # ---------------------------------------------------------------------- lifecycle
-def enable(max_events: int = 1024) -> None:
-    """Turn telemetry collection on (counters/timers/events start accumulating)."""
+def enable(max_events: int = 1024, reset: bool = False) -> None:
+    """Turn telemetry collection on (counters/timers/events start accumulating).
+
+    ``enable()`` alone keeps whatever was already recorded — re-enabling
+    mid-run must not destroy data. Pass ``reset=True`` to start from zero
+    counters in one call (the shape every counter-asserting test fixture
+    wants; stale counters from a previous test otherwise satisfy or break
+    assertions at random).
+    """
     global ENABLED
+    if reset:
+        RECORDER.clear()
     RECORDER.max_events = max_events
     if RECORDER.events.maxlen != max_events:
         RECORDER.events = deque(RECORDER.events, maxlen=max_events)

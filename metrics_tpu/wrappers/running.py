@@ -66,6 +66,10 @@ class Running(WrapperMetric):
             # states must be weighted accordingly
             merged = fns.merge(merged, st, i, 1)
         self.base_metric.__dict__["_state"].update(merged)
+        # the spliced buffers are still held by the window deque — arm the
+        # escape latch so a donated dispatch of the base metric copies instead
+        # of consuming them out from under the next window fold
+        self.base_metric._state_escaped = True
         self.base_metric._update_count = len(states)
         self.base_metric._computed = None
 

@@ -1,0 +1,120 @@
+"""The shared lint-engine plumbing every pass rides on.
+
+Covers the sectioned-baseline helpers (``load_baseline_section`` /
+``write_baseline_section`` — one JSON document, one section per owner, siblings
+never clobbered), the baseline diff semantics, and the multi-prefix
+suppression grammar (``LINT_PREFIXES``): these used to be duplicated per
+harness and are now the single read/write path for every baseline file in
+``tools/``.
+"""
+
+import json
+
+import pytest
+
+from metrics_tpu.analysis import (
+    LINT_PREFIXES,
+    Violation,
+    diff_against_baseline,
+    load_baseline_section,
+    write_baseline_section,
+)
+from metrics_tpu.analysis.contexts import Suppressions
+
+
+# ------------------------------------------------------------- section helpers
+def test_load_section_missing_file_and_missing_section(tmp_path):
+    path = str(tmp_path / "b.json")
+    assert load_baseline_section(path, "entries") == {}
+    (tmp_path / "b.json").write_text(json.dumps({"comment": "x", "cost": {"A": 1}}))
+    assert load_baseline_section(path, "entries") == {}
+    assert load_baseline_section(path, "cost") == {"A": 1}
+
+
+def test_load_section_tolerates_non_dict_value(tmp_path):
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps({"entries": ["not", "a", "dict"]}))
+    assert load_baseline_section(str(path), "entries") == {}
+
+
+def test_write_section_preserves_siblings_and_updates_comment(tmp_path):
+    path = str(tmp_path / "b.json")
+    write_baseline_section(path, "entries", {"k": 2}, "first comment")
+    write_baseline_section(path, "donation", {"Cls": "why"}, "second comment")
+    doc = json.loads((tmp_path / "b.json").read_text())
+    assert doc["entries"] == {"k": 2}  # sibling untouched
+    assert doc["donation"] == {"Cls": "why"}
+    assert doc["comment"] == "second comment"  # last writer owns the comment
+    # rewriting one section replaces it wholesale, not merges
+    write_baseline_section(path, "donation", {}, "third")
+    doc = json.loads((tmp_path / "b.json").read_text())
+    assert doc["donation"] == {} and doc["entries"] == {"k": 2}
+
+
+def test_write_section_seed_yields_to_existing_sibling(tmp_path):
+    path = str(tmp_path / "b.json")
+    # seed creates the section when absent ...
+    write_baseline_section(path, "donation", {}, "c", seed={"entries": {}})
+    assert load_baseline_section(path, "entries") == {}
+    # ... but an existing sibling always wins over its seed
+    write_baseline_section(path, "entries", {"k": 1}, "c")
+    write_baseline_section(path, "donation", {}, "c", seed={"entries": {}})
+    assert load_baseline_section(path, "entries") == {"k": 1}
+
+
+def test_write_section_recovers_from_corrupt_file(tmp_path):
+    path = tmp_path / "b.json"
+    path.write_text("{not json")
+    write_baseline_section(str(path), "entries", {"k": 1}, "c")
+    assert load_baseline_section(str(path), "entries") == {"k": 1}
+
+
+# ------------------------------------------------------------------ diff
+def _v(path="m.py", rule="JL001", context="M.update"):
+    return Violation(path=path, line=1, col=0, rule=rule, message="x", context=context)
+
+
+def test_diff_counts_per_key_budget():
+    vs = [_v(), _v(), _v(rule="DL004")]
+    new, baselined, stale = diff_against_baseline(vs, {"m.py::JL001::M.update": 1})
+    assert baselined == 1
+    assert [(v.rule) for v in new] == ["JL001", "DL004"]  # budget of 1 spent
+    assert stale == []
+
+
+def test_diff_reports_unmatched_entries_as_stale():
+    new, baselined, stale = diff_against_baseline([], {"gone.py::ML001::f": 2})
+    assert new == [] and baselined == 0
+    assert stale == ["gone.py::ML001::f"]
+
+
+# ------------------------------------------------------------------ suppressions
+def test_every_registered_prefix_parses():
+    assert set(LINT_PREFIXES) == {"jitlint", "distlint", "donlint"}
+    for prefix in LINT_PREFIXES:
+        s = Suppressions(f"x = 1  # {prefix}: disable=ML001\n")
+        assert s.is_suppressed(1, "ML001")
+        assert not s.is_suppressed(1, "ML002")
+        assert not s.is_suppressed(2, "ML001")
+
+
+def test_multi_code_and_all_forms():
+    s = Suppressions("x = 1  # donlint: disable=ML001, DL004\ny = 2  # jitlint: disable=all\n")
+    assert s.is_suppressed(1, "ML001") and s.is_suppressed(1, "DL004")
+    assert not s.is_suppressed(1, "JL001")
+    assert s.is_suppressed(2, "JL006") and s.is_suppressed(2, "ML003")
+
+
+def test_file_wide_suppression_spans_prefixes():
+    s = Suppressions("# distlint: disable-file=ML004\nx = 1\ny = 2\n")
+    assert s.is_suppressed(1, "ML004") and s.is_suppressed(3, "ml004")
+    assert not s.is_suppressed(3, "ML001")
+
+
+def test_unregistered_prefix_is_inert():
+    s = Suppressions("x = 1  # otherlint: disable=ML001\n")
+    assert not s.is_suppressed(1, "ML001")
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -62,8 +62,7 @@ def _pristine():
     clear_jit_cache()
     jit_update_enabled(True)
     donate_updates_enabled(True)
-    observe.enable()
-    observe.reset()
+    observe.enable(reset=True)
     yield
     observe.disable()
     clear_jit_cache()

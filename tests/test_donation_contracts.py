@@ -1,0 +1,204 @@
+"""The dynamic donation-contract harness (``analysis/donation_contracts.py``).
+
+Synthetic Metric fixtures pin each runtime verdict (DONATED / NON_DONATING /
+EAGER / ERROR) and the three-way agreement logic; the registry-wide test is
+the tentpole acceptance criterion — every jit-eligible profile case agrees
+across static classifier, ``_donation_eligible()``, and observed buffer
+deletion, with an empty baseline.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.analysis.donation_contracts import (
+    DonationResult,
+    check_donation_case,
+    collect_donation_report,
+    diff_donation_baseline,
+    donation_cases,
+    load_donation_baseline,
+    run_donation_check,
+    write_donation_baseline,
+)
+from metrics_tpu.analysis.mem_rules import classify_donation
+from metrics_tpu.observe.costs import ProfileCase
+
+
+class HarnessSum(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        self.total = self.total + x.sum()
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1)
+
+
+class HarnessOptOut(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        # fixture: a class-declared opt-out the static classifier must see
+        super().__init__(donate_states=False, **kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.total
+
+
+class HarnessCat(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(jnp.asarray(x, dtype=jnp.float32))
+
+    def compute(self):
+        return jnp.concatenate([jnp.atleast_1d(v) for v in self.vals]).sum()
+
+
+def _case(ctor, name="HarnessCase"):
+    return ProfileCase(name=name, ctor=ctor, batch=lambda rng: (rng.randn(8).astype(np.float32),))
+
+
+# ------------------------------------------------------------------ verdicts
+def test_donatable_class_reaches_three_way_agreement():
+    r = check_donation_case(_case(HarnessSum))
+    assert r.agree, r.render()
+    assert r.runtime == "DONATED"
+    assert r.static_eligible and r.costs_eligible
+    assert r.render().startswith("ok ")
+
+
+def test_class_declared_optout_agrees_as_non_donating():
+    r = check_donation_case(_case(HarnessOptOut))
+    assert r.agree, r.render()
+    assert r.runtime == "NON_DONATING"
+    assert not r.static_eligible and not r.costs_eligible
+    assert "donate_states=False opt-out" in r.static_detail
+
+
+def test_list_state_class_agrees_as_eager():
+    r = check_donation_case(_case(HarnessCat))
+    assert r.agree, r.render()
+    assert r.runtime == "EAGER"  # list state blocks jit: donation never exercised
+    assert not r.static_eligible and not r.costs_eligible
+    assert "list state(s): vals" in r.static_detail
+
+
+def test_callsite_optout_is_a_disagreement():
+    # the class source is donation-clean, but the ctor opts out at the call
+    # site — static says eligible, _donation_eligible() says no: a lint failure
+    r = check_donation_case(_case(lambda: HarnessSum(donate_states=False)))
+    assert not r.agree
+    assert r.static_eligible and not r.costs_eligible
+    assert r.runtime == "NON_DONATING"
+    assert r.render().startswith("DISAGREE")
+
+
+def test_broken_ctor_becomes_error_verdict_not_exception():
+    def boom():
+        raise RuntimeError("fixture ctor failure")
+
+    r = check_donation_case(_case(boom))
+    assert not r.agree
+    assert r.runtime == "ERROR:RuntimeError"
+    assert "fixture ctor failure" in r.detail
+
+
+def test_static_classifier_matches_runtime_predicate_on_fixtures():
+    for cls, expected in ((HarnessSum, True), (HarnessOptOut, False), (HarnessCat, False)):
+        eligible, detail = classify_donation(cls)
+        assert eligible is expected, f"{cls.__name__}: {detail}"
+        assert eligible == cls()._donation_eligible()
+
+
+# ------------------------------------------------------------------ registry
+def test_registry_slice_is_the_jit_eligible_set():
+    cases = donation_cases()
+    assert len(cases) >= 50
+    for case in cases:
+        m = case.ctor()
+        assert not type(m).__jit_ineligible__ and not m._has_list_state()
+
+
+def test_full_registry_three_way_agreement():
+    """The tentpole acceptance criterion: zero disagreements over the registry."""
+    results = collect_donation_report()
+    disagreements = [r.render() for r in results if not r.agree]
+    assert not disagreements, "\n".join(disagreements)
+    donated = sum(1 for r in results if r.runtime == "DONATED")
+    assert donated >= 40  # donation is the overwhelmingly common steady state
+
+
+# ------------------------------------------------------------------ baseline
+def _disagreement(name="Ghost"):
+    return DonationResult(name, True, "", False, "NON_DONATING", False)
+
+
+def _agreement(name="Fine"):
+    return DonationResult(name, True, "", True, "DONATED", True)
+
+
+def test_baseline_round_trip_preserves_static_section(tmp_path):
+    path = str(tmp_path / "donlint_baseline.json")
+    written = write_donation_baseline(path, [_agreement(), _disagreement()])
+    assert set(written) == {"Ghost"}
+    assert load_donation_baseline(path) == written
+    # the writer seeds the static section so one file serves both owners
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    assert load_baseline_section(path, "entries") == {}
+
+
+def test_diff_baselined_disagreement_is_not_a_failure():
+    results = [_agreement(), _disagreement()]
+    failures, stale = diff_donation_baseline(results, {"Ghost": "known: external holder"})
+    assert failures == [] and stale == []
+    # without the baseline entry it fails
+    failures, _ = diff_donation_baseline(results, {})
+    assert [r.name for r in failures] == ["Ghost"]
+
+
+def test_diff_reports_stale_entries():
+    results = [_agreement("Fine")]
+    _, stale = diff_donation_baseline(results, {"Fine": "now agrees", "Gone": "not observed"})
+    assert stale == ["Fine", "Gone"]
+
+
+def test_run_donation_check_report_and_exit_codes(tmp_path, monkeypatch, capsys):
+    import metrics_tpu.analysis.donation_contracts as dc
+
+    monkeypatch.setattr(dc, "collect_donation_report", lambda: [_agreement(), _disagreement()])
+    report = {}
+    rc = dc.run_donation_check(str(tmp_path), report=report)
+    assert rc == 1
+    assert report["cases"] == 2 and report["baselined"] == 0
+    assert report["failures"] and "Ghost" in report["failures"][0]
+    assert report["runtime_verdicts"] == {"Fine": "DONATED", "Ghost": "NON_DONATING"}
+    assert capsys.readouterr().out == ""  # report mode: the caller owns stdout
+
+    # a justified baseline entry turns the same run green
+    path = str(tmp_path / "tools" / "donlint_baseline.json")
+    (tmp_path / "tools").mkdir()
+    write_donation_baseline(path, [_disagreement()])
+    assert dc.run_donation_check(str(tmp_path), quiet=True) == 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

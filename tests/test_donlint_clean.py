@@ -1,0 +1,73 @@
+"""The repo must stay donlint-clean: zero non-baselined ML violations.
+
+This is the enforcement point for the §12/§13 donation-safety invariant — any
+new state escape from ``update``, state aliasing, stackable list state,
+unjustified ``donate_states=False``, compute-held reference, or default-
+aliasing ``reset`` introduced under ``metrics_tpu/`` fails this test.
+Intentional exceptions belong in the ``entries`` section of
+``tools/donlint_baseline.json`` (regenerate with ``python tools/lint_metrics.py
+--pass donlint --update-baseline``) or behind an inline ``# donlint:
+disable=RULE`` with a justification comment.
+"""
+
+import json
+import os
+
+import pytest
+
+from metrics_tpu.analysis import (
+    MEM_RULE_CODES,
+    diff_against_baseline,
+    lint_paths,
+    load_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "donlint_baseline.json")
+
+
+@pytest.fixture(scope="module")
+def lint_result():
+    return lint_paths(
+        [os.path.join(REPO_ROOT, "metrics_tpu")], root=REPO_ROOT, rules=list(MEM_RULE_CODES)
+    )
+
+
+def test_every_module_parses(lint_result):
+    assert not lint_result.parse_errors, "\n".join(lint_result.parse_errors)
+    assert lint_result.files_scanned > 100  # the walk really covered the package
+
+
+def test_zero_non_baselined_violations(lint_result):
+    baseline = load_baseline(BASELINE_PATH)
+    new, _, _ = diff_against_baseline(lint_result.violations, baseline)
+    assert not new, "new donlint violations (fix or baseline with a justification):\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_no_stale_baseline_entries(lint_result):
+    """The baseline only ratchets down: entries must still match something."""
+    baseline = load_baseline(BASELINE_PATH)
+    _, _, stale = diff_against_baseline(lint_result.violations, baseline)
+    assert not stale, f"stale baseline entries (remove them): {stale}"
+
+
+def test_static_baseline_is_empty():
+    """The escape analysis holds over the whole package with no exceptions —
+    the runtime's own splice sites participate in the latch protocol, and the
+    one intentional bypass is inline-suppressed with its justification."""
+    with open(BASELINE_PATH, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc.get("entries") == {}
+    assert doc.get("donation") == {}
+
+
+def test_cli_exits_zero_against_baseline():
+    from metrics_tpu.analysis.cli import main
+
+    assert main(["--root", REPO_ROOT, os.path.join(REPO_ROOT, "metrics_tpu"), "--pass", "donlint", "-q"]) == 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

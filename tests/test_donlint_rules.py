@@ -1,0 +1,474 @@
+"""Unit tests for the donlint AST rules (ML001–ML006).
+
+Every rule gets at least one positive fixture (the escape/alias hazard is
+reported) and one negative fixture (donation-sound idiomatic code stays
+clean). Fixtures model Metric subclasses — donlint keys off ``self.add_state``
+registrations, exactly like distlint.
+"""
+
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis import MEM_RULE_CODES, lint_file
+
+
+def run_lint(tmp_path, source, rel="pkg/mod.py", rules=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_file(str(path), root=str(tmp_path), rules=rules or list(MEM_RULE_CODES))
+
+
+def codes(result):
+    return [v.rule for v in result.violations]
+
+
+# =========================================================================== ML001
+class TestML001UpdateEscape:
+    def test_return_of_state_read_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+                    return self.total
+        """, rules=["ML001"])
+        assert codes(res) == ["ML001"]
+        assert "donated dispatch owns" in res.violations[0].message
+
+    def test_closure_capture_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+                    self._probe = lambda: self.total
+        """, rules=["ML001"])
+        # the lambda captures the state AND the stash parks the closure
+        assert "ML001" in codes(res)
+        assert any("closure" in v.message for v in res.violations)
+
+    def test_stash_into_non_state_attribute_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+                    self._last = self.total
+        """, rules=["ML001"])
+        assert codes(res) == ["ML001"]
+        assert "`self._last`" in res.violations[0].message
+
+    def test_copied_return_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+                    return jnp.copy(self.total)
+        """, rules=["ML001"])
+        assert codes(res) == []
+
+    def test_list_state_class_not_donation_exposed(self, tmp_path):
+        # a list state blocks donation for the whole class — its update can
+        # never run donated, so in-class escapes are not ML001's business
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+                def update(self, x):
+                    self.vals.append(x)
+                    return self.vals
+        """, rules=["ML001"])
+        assert codes(res) == []
+
+    def test_jit_ineligible_class_not_donation_exposed(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                __jit_ineligible__ = True
+
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+                    return self.total
+        """, rules=["ML001"])
+        assert codes(res) == []
+
+    def test_cross_object_splice_without_latch_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def fold(metric, merged):
+                metric.__dict__["_state"] = merged
+        """, rules=["ML001"])
+        assert codes(res) == ["ML001"]
+        assert "_state_escaped" in res.violations[0].message
+
+    def test_splice_update_call_form_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def fold(metric, merged):
+                metric.__dict__["_state"].update(merged)
+        """, rules=["ML001"])
+        assert codes(res) == ["ML001"]
+
+    def test_splice_with_latch_in_same_function_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def fold(metric, merged):
+                metric.__dict__["_state"].update(merged)
+                metric._state_escaped = True
+        """, rules=["ML001"])
+        assert codes(res) == []
+
+    def test_splice_of_metric_state_read_is_clean(self, tmp_path):
+        # the metric_state property arms the latch on the SOURCE objects
+        res = run_lint(tmp_path, """
+            def adopt(dst, src):
+                dst.__dict__["_state"] = {k: v for k, v in src.metric_state.items()}
+        """, rules=["ML001"])
+        assert codes(res) == []
+
+    def test_splice_of_copied_value_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import copy
+
+            def fold(metric, merged):
+                metric.__dict__["_state"] = copy.deepcopy(merged)
+        """, rules=["ML001"])
+        assert codes(res) == []
+
+
+# =========================================================================== ML002
+class TestML002StateAliasing:
+    def test_shared_default_buffer_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    zero = jnp.asarray(0.0)
+                    self.add_state("a", zero, dist_reduce_fx="sum")
+                    self.add_state("b", zero, dist_reduce_fx="sum")
+        """, rules=["ML002"])
+        assert codes(res) == ["ML002"]
+        assert "`a`" in res.violations[0].message and "`b`" in res.violations[0].message
+
+    def test_chained_state_assignment_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("b", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.a = self.b = x.sum()
+        """, rules=["ML002"])
+        assert codes(res) == ["ML002"]
+        assert "chained" in res.violations[0].message
+
+    def test_state_to_state_assignment_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("b", default=0.0, dist_reduce_fx="sum")
+
+                def reset_peak(self):
+                    self.a = self.b
+        """, rules=["ML002"])
+        assert codes(res) == ["ML002"]
+
+    def test_distinct_defaults_and_self_assign_are_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", jnp.asarray(0.0), dist_reduce_fx="sum")
+                    self.add_state("b", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.a = self.a + x.sum()
+                    self.b = self.b + x.size
+        """, rules=["ML002"])
+        assert codes(res) == []
+
+
+# =========================================================================== ML003
+class TestML003StackableListState:
+    def test_fixed_shape_scalar_appends_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("losses", default=[], dist_reduce_fx="cat")
+
+                def update(self, x):
+                    self.losses.append(x.sum())
+        """, rules=["ML003"])
+        assert codes(res) == ["ML003"]
+        assert "blocks jit AND donation" in res.violations[0].message
+
+    def test_fixed_local_dataflow_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+                def update(self, x):
+                    loss = x.mean()
+                    scaled = loss * 2
+                    self.vals.append(scaled)
+        """, rules=["ML003"])
+        assert codes(res) == ["ML003"]
+
+    def test_batch_shaped_append_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("preds", default=[], dist_reduce_fx="cat")
+
+                def update(self, x):
+                    self.preds.append(x)
+        """, rules=["ML003"])
+        assert codes(res) == []
+
+    def test_axis_reduction_keeps_batch_shape_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("rows", default=[], dist_reduce_fx="cat")
+
+                def update(self, x):
+                    self.rows.append(x.sum(axis=1))
+        """, rules=["ML003"])
+        assert codes(res) == []
+
+    def test_reassigned_local_disqualified(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+                def update(self, x):
+                    v = x.sum()
+                    v = x[v > 0]
+                    self.vals.append(v)
+        """, rules=["ML003"])
+        assert codes(res) == []
+
+
+# =========================================================================== ML004
+class TestML004UnjustifiedOptout:
+    def test_bare_optout_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def build():
+                return Accuracy(donate_states=False)
+        """, rules=["ML004"])
+        assert codes(res) == ["ML004"]
+        assert "justifying comment" in res.violations[0].message
+
+    def test_same_line_comment_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def build():
+                return Accuracy(donate_states=False)  # state handed to the dashboard
+        """, rules=["ML004"])
+        assert codes(res) == []
+
+    def test_line_above_comment_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def build():
+                # caller snapshots raw buffers between steps
+                return Accuracy(donate_states=False)
+        """, rules=["ML004"])
+        assert codes(res) == []
+
+    def test_donate_true_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def build():
+                return Accuracy(donate_states=True)
+        """, rules=["ML004"])
+        assert codes(res) == []
+
+
+# =========================================================================== ML005
+class TestML005ComputeHoldsReferences:
+    def test_compute_stash_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def compute(self):
+                    self._cached = self.total
+                    return self._cached
+        """, rules=["ML005"])
+        assert codes(res) == ["ML005"]
+        assert "`self._cached`" in res.violations[0].message
+
+    def test_returning_state_derived_value_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("n", default=0.0, dist_reduce_fx="sum")
+
+                def compute(self):
+                    return self.total / self.n
+        """, rules=["ML005"])
+        assert codes(res) == []
+
+    def test_copied_stash_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def compute(self):
+                    self._snapshot = jnp.copy(self.total)
+                    return self._snapshot
+        """, rules=["ML005"])
+        assert codes(res) == []
+
+
+# =========================================================================== ML006
+class TestML006ResetAliasesDefaults:
+    def test_rebind_to_defaults_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def reset(self):
+                    self.total = self._defaults["total"]
+        """, rules=["ML006"])
+        assert codes(res) == ["ML006"]
+        assert "shared" in res.violations[0].message
+
+    def test_two_states_one_local_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("a", default=0.0, dist_reduce_fx="sum")
+                    self.add_state("b", default=0.0, dist_reduce_fx="sum")
+
+                def reset(self):
+                    zero = jnp.asarray(0.0)
+                    self.a = zero
+                    self.b = zero
+        """, rules=["ML006"])
+        assert codes(res) == ["ML006"]
+        assert "`zero`" in res.violations[0].message
+
+    def test_super_delegation_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def reset(self):
+                    super().reset()
+                    self._rounds = 0
+        """, rules=["ML006"])
+        assert codes(res) == []
+
+    def test_copied_defaults_rebind_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def reset(self):
+                    self.total = jnp.copy(self._defaults["total"])
+        """, rules=["ML006"])
+        assert codes(res) == []
+
+
+# =========================================================================== wiring
+class TestDonlintWiring:
+    def test_rules_registered(self):
+        from metrics_tpu.analysis import MEM_RULES
+
+        assert set(MEM_RULES) == set(MEM_RULE_CODES)
+
+    def test_donlint_prefix_suppression(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+                    return self.total  # donlint: disable=ML001
+        """, rules=["ML001"])
+        assert codes(res) == []
+        assert res.suppressed == 1
+
+    def test_sibling_prefix_carries_ml_codes(self, tmp_path):
+        # codes are globally unique, so any registered prefix may carry them
+        res = run_lint(tmp_path, """
+            class M(Metric):
+                def __init__(self):
+                    self.add_state("total", default=0.0, dist_reduce_fx="sum")
+
+                def update(self, x):
+                    self.total = self.total + x.sum()
+                    return self.total  # distlint: disable=ML001
+        """, rules=["ML001"])
+        assert codes(res) == []
+        assert res.suppressed == 1
+
+    def test_mixed_rule_selection_spans_three_passes(self, tmp_path):
+        res = run_lint(tmp_path, """
+            from jax import lax
+
+            class M(Metric):
+                def __init__(self, fn):
+                    self.add_state("v", default=0.0)
+
+                def update(self, x):
+                    self.v = self.v + lax.psum(x, "data")
+                    return self.v
+        """, rules=["JL003", "DL004", "ML001"])
+        got = set(codes(res))
+        assert {"JL003", "DL004", "ML001"} <= got
+
+    def test_cli_donlint_pass_and_console_script(self, tmp_path):
+        from metrics_tpu.analysis.cli import main, main_donlint
+
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "class M(Metric):\n"
+            "    def __init__(self):\n"
+            "        self.add_state('t', default=0.0, dist_reduce_fx='sum')\n"
+            "\n"
+            "    def update(self, x):\n"
+            "        self.t = self.t + x\n"
+            "        return self.t\n"
+        )
+        assert main(["--root", str(tmp_path), str(mod), "--pass", "donlint", "--no-baseline", "-q"]) == 1
+        # jitlint alone does not know ML001
+        assert main(["--root", str(tmp_path), str(mod), "--pass", "jitlint", "--no-baseline", "-q"]) == 0
+        # the donlint console script wires the static pass (plus the donation
+        # harness, skipped here via --rules: it gets its own dynamic tests)
+        assert main_donlint(["--root", str(tmp_path), str(mod), "--no-baseline", "-q", "--rules", "ML001"]) == 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

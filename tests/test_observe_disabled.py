@@ -79,7 +79,7 @@ def test_disabled_path_allocates_no_telemetry():
 def test_record_event_is_a_noop_while_disabled():
     observe.record_event("probe", x=1)
     assert len(rec_mod.RECORDER.events) == 0
-    observe.enable()
+    observe.enable(reset=True)
     observe.record_event("probe", x=1)
     assert len(rec_mod.RECORDER.events) == 1
 
@@ -105,7 +105,7 @@ def test_enabled_and_disabled_runs_are_numerically_identical():
     clear_jit_cache()
     DisSum.traces = 0
 
-    observe.enable()
+    observe.enable(reset=True)
     on = DisSum(scale=2.0)
     for v in values:
         on.update(v)

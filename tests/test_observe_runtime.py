@@ -60,8 +60,8 @@ def _pristine_observe():
 
     clear_jit_cache()
     collections_mod._FUSED_SHARED_CACHE.clear()  # fused executables outlive collections
-    rec_mod.reset(include_warnings=True)
-    observe.enable()
+    rec_mod.reset(include_warnings=True)  # re-arm the one-time fallback warnings
+    observe.enable(reset=True)
     yield
     observe.disable()
     rec_mod.reset(include_warnings=True)
@@ -215,7 +215,7 @@ def test_snapshot_schema_is_stable_and_json_able():
 
 
 def test_event_log_is_bounded_ring_buffer():
-    observe.enable(max_events=4)
+    observe.enable(max_events=4, reset=True)
     for i in range(10):
         observe.record_event("probe", i=i)
     events = observe.snapshot()["events"]

@@ -30,8 +30,7 @@ N_BOOT = 10
 def _pristine():
     clear_jit_cache()
     jit_update_enabled(True)
-    observe.enable()
-    observe.reset()
+    observe.enable(reset=True)
     yield
     observe.disable()
     clear_jit_cache()
@@ -268,6 +267,61 @@ def test_clear_jit_cache_drops_replica_cache():
     assert len(replicated_mod._REPLICA_JIT_CACHE) == 0
     bs.update(p, t)  # recompiles transparently
     assert len(replicated_mod._REPLICA_JIT_CACHE) >= 1
+
+
+def test_materialization_never_reads_donated_buffers_100_steps():
+    # donation × replication: the vmapped engine donates its stacked state
+    # buffers, and `.metrics` / `state_dict()` materialize per-replica views
+    # mid-stream. A materialized view must NEVER hand out a buffer a donated
+    # dispatch already consumed — np.asarray on such a buffer raises
+    # RuntimeError, and is_deleted() flags it before the read.
+    from metrics_tpu.metric import donate_updates_enabled
+
+    donate_updates_enabled(True)
+    try:
+        eng = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+        loop = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+        loop._engine_failed = True
+        eng.persistent(True)
+        rng = np.random.RandomState(0)
+        for step in range(1, 101):
+            preds = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+            target = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+            eng.update(preds, target)
+            loop.update(preds, target)
+            if step % 10 == 0:
+                for m in eng.metrics:
+                    for value in m.metric_state.values():
+                        assert not value.is_deleted(), f"consumed buffer exposed at step {step}"
+                        assert np.all(np.isfinite(np.asarray(value)))
+                for value in eng.state_dict().values():
+                    np.asarray(value)  # a consumed buffer raises here
+        # the interleaved materializations must not have perturbed the stream
+        np.testing.assert_allclose(
+            np.asarray(eng.compute()), np.asarray(loop.compute()), rtol=1e-5
+        )
+        assert eng.metrics[0]._update_count == 100
+    finally:
+        donate_updates_enabled(True)
+
+
+def test_bootstrap_materialization_survives_donated_stream_100_steps():
+    # same contract through BootStrapper's resampled stacked state
+    bs = _boot(True)
+    np.random.seed(17)
+    rng = np.random.RandomState(4)
+    for step in range(1, 101):
+        p = jnp.asarray(rng.randint(3, size=32))
+        t = jnp.asarray(rng.randint(3, size=32))
+        bs.update(p, t)
+        if step % 10 == 0:
+            for m in bs.metrics:
+                for value in m.metric_state.values():
+                    assert not value.is_deleted(), f"consumed buffer exposed at step {step}"
+                    np.asarray(value)
+    out = bs.compute()
+    assert np.isfinite(float(np.asarray(out["mean"])))
+    assert bs.metrics[0]._update_count == 100
 
 
 def test_metrics_property_materializes_live_states():

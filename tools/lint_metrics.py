@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Lint CLI — jitlint + distlint static analysis over metrics_tpu.
+"""Lint CLI — jitlint + distlint + donlint analysis over metrics_tpu.
 
 Usage:
-    python tools/lint_metrics.py [targets...] [--pass jitlint|distlint] [--all]
-                                 [--rules JL001,DL004] [--update-baseline]
+    python tools/lint_metrics.py [targets...] [--pass jitlint|distlint|donlint|donation|perf]
+                                 [--all] [--json] [--rules JL001,DL004,ML002]
+                                 [--update-baseline]
 
 Thin wrapper over :mod:`metrics_tpu.analysis.cli` so the tool works from a
 checkout without installing the package (the ``jitlint`` console script is the
