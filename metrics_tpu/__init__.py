@@ -129,7 +129,7 @@ _LAZY_EXPORTS = {
 _LAZY_SUBPACKAGES = (
     "audio", "classification", "clustering", "detection", "functional", "image",
     "integration", "models", "multimodal", "nominal", "observe", "ops", "parallel",
-    "regression", "retrieval", "segmentation", "shape", "text", "utils", "wrappers",
+    "regression", "resilience", "retrieval", "segmentation", "shape", "text", "utils", "wrappers",
 )
 
 

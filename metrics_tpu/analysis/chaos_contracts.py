@@ -1,0 +1,646 @@
+"""Chaos contract harness: inject faults, assert the runtime's recovery promises.
+
+For every jit-eligible class in the profile registry (the same slice
+:func:`metrics_tpu.analysis.donation_contracts.donation_cases` feeds the
+donation cross-check) this injects the DESIGN §14 fault taxonomy and checks
+the documented contract after each one:
+
+- **update faults** — exceptions raised before, mid-way through (after a state
+  mutation), and after the update body, on both the eager path and the first
+  jit trace: the update must be transactional (``_state``, ``_update_count``
+  and the compute cache all roll back bit-exactly) and the next clean update
+  must succeed;
+- **dispatch death** — the compiled executable dies on its probation (first)
+  dispatch and again at steady state after donation is live: the pre-dispatch
+  rescue reference must keep the live state intact, and a restored executable
+  must produce the same result as a never-faulted oracle instance;
+- **poisoned inputs** — a NaN batch under ``install_guard``: ``skip_batch``
+  must quarantine it (payload states equal an instance that never saw the
+  batch, counter == 1) and ``raise_on_host`` must raise
+  :class:`~metrics_tpu.resilience.guards.PoisonedInputError` then keep working;
+- **corrupt checkpoints** — truncated and bit-flipped snapshot files must be
+  rejected as :class:`~metrics_tpu.resilience.checkpoint.CorruptCheckpointError`
+  with the restore target untouched, while an intact snapshot round-trips
+  bit-exactly into a fresh instance;
+- **dropped sync peer** — a sync that loses a peer after a transient retry
+  must degrade to the count-weighted partial merge of the survivors (checked
+  against the ``_merge_state_dicts`` oracle), record ``sync_retry`` /
+  ``sync_degraded``, and still restore local state on unsync.
+
+Every broken promise is a violation keyed by class name, baselined in the
+``chaos`` section of ``tools/chaos_baseline.json`` (expected empty; every
+entry needs a justification string). Runs as the ``chaos`` pass of
+``tools/lint_metrics --all`` / the ``chaoslint`` console script and standalone
+via ``python -m metrics_tpu.analysis.chaos_contracts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "ChaosResult",
+    "chaos_cases",
+    "check_chaos_case",
+    "diff_chaos_baseline",
+    "main",
+    "run_chaos_check",
+]
+
+_DEFAULT_BASELINE = os.path.join("tools", "chaos_baseline.json")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosResult:
+    name: str
+    ran: Tuple[str, ...]  # fault names exercised
+    skipped: Tuple[str, ...]  # fault names not applicable (e.g. no float inputs)
+    violations: Tuple[str, ...]  # "fault: what broke" — empty means contract held
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def render(self) -> str:
+        mark = "ok " if self.ok else "VIOLATED"
+        head = f"{mark} {self.name}: {len(self.ran)} fault(s)"
+        if self.skipped:
+            head += f", skipped {','.join(self.skipped)}"
+        for v in self.violations:
+            head += f"\n    {v}"
+        return head
+
+
+def chaos_cases() -> List[Any]:
+    """Same jit-eligible registry slice as the donation cross-check."""
+    from metrics_tpu.analysis.donation_contracts import donation_cases
+
+    return donation_cases()
+
+
+# ------------------------------------------------------------------- helpers
+def _host_state(m: Any) -> Dict[str, Any]:
+    """Host copy of the live state, read through ``__dict__`` so the probe
+    itself never trips the escape latch into a donation copy."""
+    import jax
+    import numpy as np
+
+    return {k: np.asarray(jax.device_get(v)) for k, v in m.__dict__["_state"].items()}
+
+
+def _state_diff(before: Dict[str, Any], after: Dict[str, Any]) -> str:
+    """'' when bit-identical (NaN == NaN), else a description of the first drift."""
+    import numpy as np
+
+    if set(before) != set(after):
+        return f"state keys changed {sorted(before)} -> {sorted(after)}"
+    for k in sorted(before):
+        if not np.array_equal(before[k], after[k], equal_nan=True):
+            return f"state {k!r} changed"
+    return ""
+
+
+def _trees_close(a: Any, b: Any) -> bool:
+    import jax
+    import numpy as np
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x = np.asarray(jax.device_get(x))
+        y = np.asarray(jax.device_get(y))
+        if x.shape != y.shape or not np.allclose(x, y, rtol=1e-5, atol=1e-6, equal_nan=True):
+            return False
+    return True
+
+
+def _poison_batch(batch: Tuple[Any, ...]) -> Tuple[Optional[Tuple[Any, ...]], bool]:
+    """NaN-inject the first float array argument; (None, False) when there is none."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = list(batch)
+    for i, a in enumerate(out):
+        if isinstance(a, (jax.Array, np.ndarray)):
+            arr = jnp.asarray(a)
+            if jnp.issubdtype(arr.dtype, jnp.inexact) and arr.size:
+                host = np.asarray(jax.device_get(arr)).copy()
+                host.reshape(-1)[0] = np.nan
+                out[i] = jnp.asarray(host)
+                return tuple(out), True
+    return None, False
+
+
+class _InjectedFault(RuntimeError):
+    """The fault the harness injects — anything else escaping is a real bug."""
+
+
+def _check_rollback(m: Any, fault: str, batch: Tuple[Any, ...], before: Dict[str, Any], count: int) -> List[str]:
+    """Run one (pre-sabotaged) faulty update; assert propagation + bit-exact rollback."""
+    bad: List[str] = []
+    raised = False
+    try:
+        m.update(*batch)
+    except _InjectedFault:
+        raised = True
+    if not raised:
+        bad.append(f"{fault}: injected exception was swallowed")
+    drift = _state_diff(before, _host_state(m))
+    if drift:
+        bad.append(f"{fault}: rollback incomplete — {drift}")
+    if m._update_count != count:
+        bad.append(f"{fault}: _update_count {count} -> {m._update_count} after failed update")
+    return bad
+
+
+def _fault_update_exceptions(case: Any) -> Tuple[List[str], List[str]]:
+    """pre/mid/post exception injection into the eager update body."""
+    import jax.numpy as jnp
+
+    import metrics_tpu.metric as metric_mod
+
+    bad: List[str] = []
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    metric_mod._JIT_UPDATE_DEFAULT = False
+    try:
+        m = case.ctor()
+        rng = _rng_for(case)
+        batch = case.batch(rng)
+        m.update(*batch)  # populate real (non-default) state first
+        before, count = _host_state(m), m._update_count
+        real = m._update_impl
+
+        def pre(*a: Any, **k: Any) -> None:
+            raise _InjectedFault("pre-update fault")
+
+        def mid(*a: Any, **k: Any) -> None:
+            state = m.__dict__["_state"]
+            for key, v in state.items():  # corrupt one state, then die mid-update
+                if hasattr(v, "dtype"):
+                    state[key] = jnp.zeros_like(v)
+                    break
+            raise _InjectedFault("mid-update fault")
+
+        def post(*a: Any, **k: Any) -> None:
+            real(*a, **k)  # the body fully ran; the failure is after it
+            raise _InjectedFault("post-update fault")
+
+        for depth, impl in (("pre", pre), ("mid", mid), ("post", post)):
+            m._update_impl = impl
+            try:
+                bad.extend(_check_rollback(m, f"exc_eager[{depth}]", batch, before, count))
+            finally:
+                m._update_impl = real
+        # recovery: the next clean update must land
+        m.update(*batch)
+        if m._update_count != count + 1:
+            bad.append("exc_eager: clean update after faults did not advance the count")
+        ran = [f"exc_eager[{d}]" for d in ("pre", "mid", "post")]
+    finally:
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+    return bad, ran
+
+
+def _fault_trace_death(case: Any) -> Tuple[List[str], bool]:
+    """Trace/compile stage dies (the jit path traces a representative clone, so
+    the fault is injected at the cache-lookup seam the user instance does own)."""
+    bad: List[str] = []
+    m = case.ctor()
+    rng = _rng_for(case)
+    batch = case.batch(rng)
+    if not m._jit_eligible(batch, {}):
+        return [], False  # instance opted out of jit: there is no trace to kill
+    before, count = _host_state(m), m._update_count
+
+    def dead_lookup(donate: bool = False) -> Any:
+        raise _InjectedFault("trace/compile died")
+
+    m._lookup_shared_jit = dead_lookup
+    try:
+        bad.extend(_check_rollback(m, "exc_trace", batch, before, count))
+    finally:
+        del m.__dict__["_lookup_shared_jit"]
+    m.update(*batch)  # recovery: compiles and lands through the real lookup
+    if m._update_count != count + 1:
+        bad.append("exc_trace: clean update after the fault did not advance the count")
+    return bad, True
+
+
+def _fault_dispatch_death(case: Any) -> Tuple[List[str], bool]:
+    """Kill the compiled executable at probation and at steady state."""
+    import metrics_tpu.metric as metric_mod
+
+    bad: List[str] = []
+    rng = _rng_for(case)
+    batches = [case.batch(rng) for _ in range(3)]
+
+    # probation death: the very first dispatch dies after donation was handed off
+    metric_mod.clear_jit_cache()
+    m = case.ctor()
+    if not m._jit_eligible(batches[0], {}):
+        return [], False  # eager-only instance: there is no dispatch to kill
+    before = _host_state(m)
+    real_probation = metric_mod._probation_dispatch
+
+    def dead_probation(*a: Any, **k: Any) -> Any:
+        raise _InjectedFault("dispatch died during probation")
+
+    metric_mod._probation_dispatch = dead_probation
+    try:
+        try:
+            m.update(*batches[0])
+            bad.append("dispatch_death[probation]: injected death was swallowed")
+        except _InjectedFault:
+            pass
+        drift = _state_diff(before, _host_state(m))
+        if drift:
+            bad.append(f"dispatch_death[probation]: live state lost — {drift}")
+        if m._update_count != 0:
+            bad.append("dispatch_death[probation]: count advanced through a dead dispatch")
+    finally:
+        metric_mod._probation_dispatch = real_probation
+
+    # steady-state death: probation passed, donation (when eligible) is live
+    m.update(*batches[0])
+    m.update(*batches[1])
+    entry = m._jitted_update
+    if entry is not None:
+        before, count = _host_state(m), m._update_count
+        real_fn = entry.fn
+
+        def dead_fn(*a: Any, **k: Any) -> Any:
+            raise _InjectedFault("dispatch died at steady state")
+
+        entry.fn = dead_fn
+        try:
+            try:
+                m.update(*batches[2])
+                bad.append("dispatch_death[steady]: injected death was swallowed")
+            except _InjectedFault:
+                pass
+            drift = _state_diff(before, _host_state(m))
+            if drift:
+                bad.append(f"dispatch_death[steady]: live state lost — {drift}")
+            if m._update_count != count:
+                bad.append("dispatch_death[steady]: count advanced through a dead dispatch")
+        finally:
+            entry.fn = real_fn
+        m.update(*batches[2])  # recovery through the restored executable
+        oracle = case.ctor()
+        for b in batches:
+            oracle.update(*b)
+        if not _trees_close(m.compute(), oracle.compute()):
+            bad.append("dispatch_death[steady]: post-recovery compute drifted from the oracle")
+    return bad, True
+
+
+def _fault_nan_guard(case: Any) -> Tuple[List[str], bool]:
+    """skip_batch quarantine + raise_on_host, against an unguarded control."""
+    from metrics_tpu.resilience.guards import (
+        GUARD_STATE,
+        PoisonedInputError,
+        install_guard,
+        poisoned_count,
+    )
+    from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+    rng = _rng_for(case)
+    clean = [case.batch(rng) for _ in range(2)]
+    poisoned, ok = _poison_batch(case.batch(rng))
+    if not ok:
+        return [], False  # nothing float-typed to poison
+    bad: List[str] = []
+    try:
+        guarded = install_guard(case.ctor(), policy="skip_batch")
+    except TPUMetricsUserError:
+        return [], False  # growable states: guard legitimately refuses
+    control = case.ctor()
+    for b in clean:
+        control.update(*b)
+    guarded.update(*clean[0])
+    guarded.update(*poisoned)  # must be quarantined wholesale
+    guarded.update(*clean[1])
+    if poisoned_count(guarded) != 1:
+        bad.append(f"nan_guard[skip]: poisoned_count={poisoned_count(guarded)}, expected 1")
+    g_state = {k: v for k, v in _host_state(guarded).items() if k != GUARD_STATE}
+    drift = _state_diff(_host_state(control), g_state)
+    if drift:
+        bad.append(f"nan_guard[skip]: quarantine leaked into payload state — {drift}")
+    if not _trees_close(guarded.compute(), control.compute()):
+        bad.append("nan_guard[skip]: compute drifted from the never-poisoned control")
+
+    loud = install_guard(case.ctor(), policy="raise_on_host")
+    loud.update(*clean[0])
+    try:
+        loud.update(*poisoned)
+        bad.append("nan_guard[raise]: poisoned batch did not raise PoisonedInputError")
+    except PoisonedInputError:
+        pass
+    loud.update(*clean[1])  # documented contract: catching and continuing is safe
+    if poisoned_count(loud) != 1:
+        bad.append(f"nan_guard[raise]: poisoned_count={poisoned_count(loud)}, expected 1")
+    return bad, True
+
+
+def _fault_checkpoint(case: Any) -> List[str]:
+    """Round-trip, truncation and bit-flip against the atomic snapshot format."""
+    import tempfile
+
+    from metrics_tpu.resilience.checkpoint import (
+        CorruptCheckpointError,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    bad: List[str] = []
+    rng = _rng_for(case)
+    batches = [case.batch(rng) for _ in range(2)]
+    m = case.ctor()
+    for b in batches:
+        m.update(*b)
+    with tempfile.TemporaryDirectory(prefix="chaos_ckpt_") as tmp:
+        path = os.path.join(tmp, "m.ckpt")
+        save_checkpoint(m, path)
+        with open(path, "rb") as fh:
+            blob = fh.read()
+
+        fresh = case.ctor()
+        restore_checkpoint(fresh, path)
+        drift = _state_diff(_host_state(m), _host_state(fresh))
+        if drift:
+            bad.append(f"ckpt[roundtrip]: restored state not bit-exact — {drift}")
+        if fresh._update_count != m._update_count:
+            bad.append("ckpt[roundtrip]: update_count not restored")
+
+        for fault, mutated in (
+            ("truncate", blob[: len(blob) - 7]),
+            ("bitflip", blob[:-1] + bytes([blob[-1] ^ 0xFF])),
+        ):
+            broken = os.path.join(tmp, f"{fault}.ckpt")
+            with open(broken, "wb") as fh:
+                fh.write(mutated)
+            target = case.ctor()
+            target.update(*batches[0])
+            before = _host_state(target)
+            try:
+                restore_checkpoint(target, broken)
+                bad.append(f"ckpt[{fault}]: corrupt checkpoint was accepted")
+            except CorruptCheckpointError:
+                pass
+            drift = _state_diff(before, _host_state(target))
+            if drift:
+                bad.append(f"ckpt[{fault}]: rejected restore still touched the target — {drift}")
+    return bad
+
+
+def _fault_sync_degraded(case: Any, probe: Any) -> List[str]:
+    """Lose a peer after one transient failure; expect the survivor merge."""
+    import copy
+
+    from metrics_tpu.parallel.sync import SyncPeerLostError, SyncPolicy, sync_policy
+
+    bad: List[str] = []
+    rng = _rng_for(case)
+    m = case.ctor()
+    for _ in range(2):
+        m.update(*case.batch(rng))
+    local = copy.copy(m.__dict__["_state"])
+    count = m._update_count
+    peer = {k: v for k, v in _host_state(m).items()}  # a surviving remote twin
+    attempts = {"n": 0}
+
+    def lossy(states: Any, group: Any) -> Any:
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("transient collective timeout")
+        raise SyncPeerLostError("peer 1 lost", survivors=[peer], survivor_counts=[count])
+
+    before_events = len(probe.events)
+    with sync_policy(SyncPolicy(retries=1, backoff_s=0.0, partial_merge=True)):
+        m.sync(dist_sync_fn=lossy, distributed_available=True)
+    if attempts["n"] != 2:
+        bad.append(f"sync[degraded]: expected 1 retry (2 attempts), saw {attempts['n']}")
+    expected = m._merge_state_dicts(dict(local), dict(peer), count, count)
+    drift = _state_diff(
+        {k: _host_state_value(v) for k, v in expected.items()}, _host_state(m)
+    )
+    if drift:
+        bad.append(f"sync[degraded]: merged state disagrees with the _merge_state_dicts oracle — {drift}")
+    if not m._is_synced:
+        bad.append("sync[degraded]: metric not marked synced after the degraded merge")
+    kinds = [e.get("kind") for e in list(probe.events)[before_events:]]
+    if "sync_retry" not in kinds:
+        bad.append("sync[degraded]: no sync_retry event recorded for the transient failure")
+    if "sync_degraded" not in kinds:
+        bad.append("sync[degraded]: no sync_degraded event recorded")
+    m.unsync()
+    drift = _state_diff({k: _host_state_value(v) for k, v in local.items()}, _host_state(m))
+    if drift:
+        bad.append(f"sync[degraded]: unsync did not restore local state — {drift}")
+    return bad
+
+
+def _host_state_value(v: Any) -> Any:
+    import jax
+    import numpy as np
+
+    return np.asarray(jax.device_get(v))
+
+
+def _rng_for(case: Any) -> Any:
+    from metrics_tpu.observe.costs import _rng
+
+    return _rng(case)
+
+
+# ------------------------------------------------------------------ the case
+def check_chaos_case(case: Any) -> ChaosResult:
+    """One class through the whole fault suite; never raises."""
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+
+    probe = _observe.Recorder()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled = _observe.ENABLED
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    saved_donate = metric_mod._DONATE_UPDATE_DEFAULT
+    real = _observe.RECORDER
+    _observe.RECORDER = probe
+    violations: List[str] = []
+    ran: List[str] = []
+    skipped: List[str] = []
+    try:
+        _observe.ENABLED = True
+        metric_mod._JIT_UPDATE_DEFAULT = True
+        metric_mod._DONATE_UPDATE_DEFAULT = True
+        clear_jit_cache()
+
+        bad, names = _fault_update_exceptions(case)
+        violations += bad
+        ran += names
+        bad, applicable = _fault_trace_death(case)
+        if applicable:
+            violations += bad
+            ran += ["exc_trace"]
+        else:
+            skipped.append("exc_trace")
+
+        bad, applicable = _fault_dispatch_death(case)
+        if applicable:
+            violations += bad
+            ran += ["dispatch_death[probation]", "dispatch_death[steady]"]
+        else:
+            skipped.append("dispatch_death")
+
+        bad, applicable = _fault_nan_guard(case)
+        if applicable:
+            violations += bad
+            ran += ["nan_guard[skip]", "nan_guard[raise]"]
+        else:
+            skipped.append("nan_guard")
+
+        violations += _fault_checkpoint(case)
+        ran += ["ckpt[roundtrip]", "ckpt[truncate]", "ckpt[bitflip]"]
+
+        violations += _fault_sync_degraded(case, probe)
+        ran += ["sync[degraded]"]
+    except Exception as exc:  # noqa: BLE001 — a crash in the harness is itself a verdict
+        violations.append(f"harness: {type(exc).__name__}: {str(exc)[:200]}")
+    finally:
+        _observe.RECORDER = real
+        _observe.ENABLED = saved_enabled
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+        metric_mod._DONATE_UPDATE_DEFAULT = saved_donate
+        clear_jit_cache()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+    return ChaosResult(case.name, tuple(ran), tuple(skipped), tuple(violations))
+
+
+def collect_chaos_report(cases: Optional[Sequence[Any]] = None) -> List[ChaosResult]:
+    return [check_chaos_case(c) for c in (cases if cases is not None else chaos_cases())]
+
+
+# ------------------------------------------------------------------- baseline
+def load_chaos_baseline(path: str) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): str(v) for k, v in load_baseline_section(path, "chaos").items()}
+
+
+def write_chaos_baseline(path: str, results: Sequence[ChaosResult]) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
+    chaos = {
+        r.name: "UNJUSTIFIED: " + "; ".join(r.violations)
+        for r in sorted(results, key=lambda r: r.name)
+        if not r.ok
+    }
+    write_baseline_section(
+        path,
+        "chaos",
+        chaos,  # type: ignore[arg-type]
+        "chaoslint baseline — fault-injection contract violations under `chaos` "
+        "(class -> justification; expected empty). Regenerate with "
+        "`python tools/lint_metrics.py --pass chaos --update-baseline`.",
+    )
+    return chaos
+
+
+def diff_chaos_baseline(
+    results: Sequence[ChaosResult], baseline: Dict[str, str]
+) -> Tuple[List[ChaosResult], List[str]]:
+    """Split into (failures, stale_baseline_keys): unbaselined violations fail."""
+    failures = [r for r in results if not r.ok and r.name not in baseline]
+    observed = {r.name for r in results}
+    violated = {r.name for r in results if not r.ok}
+    stale = sorted(name for name in baseline if name not in violated or name not in observed)
+    return failures, stale
+
+
+def run_chaos_check(
+    root: str,
+    baseline_path: Optional[str] = None,
+    update_baseline: bool = False,
+    quiet: bool = False,
+    report: Optional[Dict[str, Any]] = None,
+) -> int:
+    """The ``chaos`` pass of ``lint_metrics --all``: inject, verify, verdict."""
+    path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
+    results = collect_chaos_report()
+    if update_baseline:
+        chaos = write_chaos_baseline(path, results)
+        if not quiet:
+            print(f"chaos: baseline written to {path} ({len(chaos)} violation(s))")
+        return 0
+    failures, stale = diff_chaos_baseline(results, load_chaos_baseline(path))
+    if report is not None:
+        report.update(
+            {
+                "cases": len(results),
+                "faults_injected": sum(len(r.ran) for r in results),
+                "failures": [r.render() for r in failures],
+                "baselined": sum(1 for r in results if not r.ok) - len(failures),
+                "stale_baseline_keys": stale,
+                "skipped": {r.name: list(r.skipped) for r in results if r.skipped},
+            }
+        )
+        return 1 if failures else 0
+    for r in failures:
+        print(f"chaos: {r.render()}")
+    if not quiet:
+        for key in stale:
+            print(f"chaos: stale baseline entry: {key}")
+        ok = sum(1 for r in results if r.ok)
+        faults = sum(len(r.ran) for r in results)
+        print(
+            f"chaos: {ok}/{len(results)} classes survived {faults} injected fault(s), "
+            f"{len(failures)} failure(s), {len(stale)} stale"
+        )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="chaos-contracts",
+        description="Fault-injection harness: transactional updates, dispatch death, "
+        "NaN quarantine, corrupt checkpoints and dropped sync peers across the "
+        "jit-eligible metric registry.",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="chaos baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current violations as the new baseline and exit 0")
+    p.add_argument("--only", default=None,
+                   help="case-name substring filter (debugging aid; baseline diff is skipped)")
+    p.add_argument("-v", "--verbose", action="store_true", help="print every class verdict")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.only:
+        results = collect_chaos_report(
+            [c for c in chaos_cases() if args.only.lower() in c.name.lower()]
+        )
+        for r in results:
+            print(r.render())
+        return 1 if any(not r.ok for r in results) else 0
+    if args.verbose:
+        for r in collect_chaos_report():
+            print(r.render())
+    return run_chaos_check(
+        root,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

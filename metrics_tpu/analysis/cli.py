@@ -1,4 +1,4 @@
-"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint``.
+"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint`` / ``chaoslint``.
 
 Three static passes share one engine and one exit-code contract:
 
@@ -9,12 +9,16 @@ Three static passes share one engine and one exit-code contract:
 * ``donlint``  — donated-buffer escape/alias rules ML001–ML006, baselined in
   ``tools/donlint_baseline.json``
 
-Two dynamic passes ride the same selection/exit-code contract:
+Three dynamic passes ride the same selection/exit-code contract:
 
 * ``donation`` — 3-step donate-enabled update loops cross-checking static
   donlint verdicts, ``costs.py`` eligibility, and runtime buffer deletion
   (:mod:`metrics_tpu.analysis.donation_contracts`), disagreements baselined in
   the ``donation`` section of ``tools/donlint_baseline.json``
+* ``chaos`` — fault-injection contract harness (transactional updates,
+  dispatch death, NaN quarantine, corrupt checkpoints, dropped sync peers;
+  :mod:`metrics_tpu.analysis.chaos_contracts`), violations baselined in
+  ``tools/chaos_baseline.json``
 * ``perf`` — XLA cost profiling of compiled metric updates
   (:mod:`metrics_tpu.observe.profile`), ratcheted against
   ``tools/perf_baseline.json``
@@ -43,7 +47,7 @@ from metrics_tpu.analysis.engine import (
     write_baseline,
 )
 
-__all__ = ["main", "main_distlint", "main_donlint"]
+__all__ = ["main", "main_chaoslint", "main_distlint", "main_donlint"]
 
 _PASSES: Dict[str, Dict[str, object]] = {
     "jitlint": {
@@ -61,9 +65,25 @@ _PASSES: Dict[str, Dict[str, object]] = {
 }
 
 # dynamic passes: no rule codes, run programs instead of parsing them.
-# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, perf lowers
-# the whole registry).
-_DYNAMIC = ("donation", "perf")
+# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, chaos injects
+# the full fault suite per class, perf lowers the whole registry).
+_DYNAMIC = ("donation", "chaos", "perf")
+
+
+def _dynamic_runner(name: str):
+    """Resolve a dynamic pass's ``run_*_check`` lazily (each imports jax and
+    builds the metric registry; keep plain lint invocations light)."""
+    if name == "perf":
+        from metrics_tpu.observe.profile import run_perf_check  # noqa: PLC0415
+
+        return run_perf_check
+    if name == "chaos":
+        from metrics_tpu.analysis.chaos_contracts import run_chaos_check  # noqa: PLC0415
+
+        return run_chaos_check
+    from metrics_tpu.analysis.donation_contracts import run_donation_check  # noqa: PLC0415
+
+    return run_donation_check
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -81,8 +101,8 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=sorted([*_PASSES, *_DYNAMIC]),
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
-                   help="run every pass (jitlint + distlint + donlint + donation + perf) "
-                        "in one invocation")
+                   help="run every pass (jitlint + distlint + donlint + donation + chaos "
+                        "+ perf) in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")
@@ -150,14 +170,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if name in _DYNAMIC:
             if explicit_rules is not None:
                 continue  # dynamic passes have no rule codes; --rules selects AST rules only
-            # lazy: both import jax and build the metric registry
-            if name == "perf":
-                from metrics_tpu.observe.profile import run_perf_check as run_dynamic  # noqa: PLC0415
-            else:
-                from metrics_tpu.analysis.donation_contracts import (  # noqa: PLC0415
-                    run_donation_check as run_dynamic,
-                )
-
+            run_dynamic = _dynamic_runner(name)
             pass_report: Optional[Dict[str, object]] = {} if args.fmt == "json" else None
             rc = run_dynamic(
                 root,
@@ -236,6 +249,12 @@ def main_donlint(argv: Optional[Sequence[str]] = None) -> int:
     """Entry point for the ``donlint`` console script — ML rules + donation cross-check."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(["--pass", "donlint", "--pass", "donation", *argv])
+
+
+def main_chaoslint(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for the ``chaoslint`` console script — the fault-injection pass."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(["--pass", "chaos", *argv])
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -21,6 +21,7 @@ from metrics_tpu.analysis.contexts import RULE_CODES, Suppressions, Violation
 from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.mem_rules import MEM_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
+from metrics_tpu.utils.io import atomic_write_text
 
 __all__ = [
     "LintResult",
@@ -162,9 +163,9 @@ def write_baseline_section(
                     payload[k] = v
         except (OSError, ValueError):
             pass
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(payload, fh, indent=2, sort_keys=True)
-        fh.write("\n")
+    # atomic replace (utils/io.py): a lint run killed mid-write can never leave a
+    # truncated baseline behind for the next CI run to diff against
+    atomic_write_text(path, json.dumps(payload, indent=2, sort_keys=True) + "\n")
     return values
 
 

@@ -324,11 +324,15 @@ class MetricCollection:
             rec.add_count("fused_hit", str(len(leaders)))
         if entry.donate:
             # copy any leader state with live outside references, and dedup aliases
-            # across the WHOLE donated pytree — one buffer must not be donated twice
+            # across the WHOLE donated pytree — one buffer must not be donated twice.
+            # While the entry is on probation the dispatch is not yet known-good, so
+            # every leader donates copies and keeps its live state as the rescue
+            # reference a mid-dispatch death cannot consume (DESIGN §14).
             seen: set = set()
+            probation = entry.probation
 
             def _donatable(lm: Metric) -> Dict[str, Any]:
-                force = lm._state_escaped or lm._group_shared
+                force = probation or lm._state_escaped or lm._group_shared
                 out: Dict[str, Any] = {}
                 for k in lm._defaults:
                     v = lm._state[k]
@@ -353,6 +357,11 @@ class MetricCollection:
             _FUSED_UPDATE_CACHE.pop(self, None)
             _observe.note_fused_fallback(len(leaders), exc)
             return False
+        except BaseException as exc:
+            # fused dispatch died: no leader state/count was assigned yet, so the
+            # whole group is untouched — the fused path is atomic as one unit
+            _observe.note_update_rollback(f"fused[{len(leaders)}]", exc)
+            raise
         for lm, ns in zip(leaders, new_states):
             lm.__dict__["_state"].update(ns)
             lm._computed = None
@@ -612,11 +621,26 @@ class MetricCollection:
         """Export all member state dicts keyed by metric name."""
         return {name: m.state_dict() for name, m in self._modules.items()}
 
-    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
-        """Load member state dicts."""
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        """Load member state dicts.
+
+        ``strict`` is forwarded to every member (so a partial per-metric dict is
+        loadable with ``strict=False``) and additionally checks the member names
+        themselves: unknown or missing metric names raise under ``strict=True``
+        and are skipped otherwise.
+        """
+        if strict:
+            unexpected = sorted(set(state_dict) - set(self._modules))
+            missing = sorted(set(self._modules) - set(state_dict))
+            if unexpected or missing:
+                raise RuntimeError(
+                    f"MetricCollection.load_state_dict: state_dict does not match collection members "
+                    f"(missing: {missing or 'none'}, unexpected: {unexpected or 'none'}). "
+                    "Pass strict=False to load the intersection."
+                )
         for name, sd in state_dict.items():
             if name in self._modules:
-                self._modules[name].load_state_dict(sd)
+                self._modules[name].load_state_dict(sd, strict=strict)
 
     def set_dtype(self, dst_type) -> "MetricCollection":
         """Transfer all metric states to ``dst_type``."""

@@ -36,7 +36,7 @@ import warnings
 from abc import ABC, abstractmethod
 from collections import OrderedDict
 from copy import deepcopy
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -204,6 +204,11 @@ _JIT_KEY_EXCLUDE = frozenset({
     "_to_sync", "_should_unsync", "_is_synced", "_cache", "_update_signature",
     "_update_impl", "_compute_impl", "update", "compute", "_jitted_update",
     "_jit_failed", "_jit_update_opt", "_donate_opt", "_state_escaped", "_group_shared",
+    # NOTE: "_guard_policy" (resilience/guards.py) is deliberately NOT excluded —
+    # it changes what the traced update body computes, so guarded and unguarded
+    # instances must compile (and share) separately. "_guard_seen" is the host-side
+    # quarantine watermark and never enters the trace.
+    "_guard_seen",
     "compute_on_cpu", "dist_sync_on_step",
     "process_group", "dist_sync_fn", "distributed_available_fn", "sync_on_compute",
     "compute_with_cache",
@@ -438,13 +443,25 @@ class Metric(ABC):
     def _fresh_state(self) -> Dict[str, Any]:
         return {k: (list(v) if isinstance(v, list) else v) for k, v in self._defaults.items()}
 
+    def _run_update_body(self, *args: Any, **kwargs: Any) -> None:
+        """Dispatch the raw update body, routed through the input guard when one is
+        installed (``resilience.guards.install_guard``). Shared by the eager,
+        fallback, and traced (``_functional_update``) paths so guard semantics are
+        identical under jit and ``jit_update_enabled(False)``."""
+        if self.__dict__.get("_guard_policy") is None:
+            self._update_impl(*args, **kwargs)
+        else:
+            from metrics_tpu.resilience.guards import run_guarded_update
+
+            run_guarded_update(self, args, kwargs)
+
     def _functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure form of subclass ``update``: runs the mutating body against a swapped-in state."""
         old = self.__dict__["_state"]
         work = {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}
         self.__dict__["_state"] = work
         try:
-            self._update_impl(*args, **kwargs)
+            self._run_update_body(*args, **kwargs)
             return self.__dict__["_state"]
         finally:
             self.__dict__["_state"] = old
@@ -601,63 +618,101 @@ class Metric(ABC):
         (``jit`` / ``eager`` / ``fallback``). The timer brackets the (async)
         dispatch, so a first call carries its trace+compile cost — retraces
         surface as ``max_s`` spikes.
+
+        Transactional contract (DESIGN §14): every path either fully applies or
+        leaves ``_state`` / ``_update_count`` / ``_computed`` untouched. The jit
+        path assigns state only after the dispatch returns; the eager and
+        fallback paths snapshot-and-restore; a donating dispatch that is not yet
+        known-good (``entry.probation``) donates fresh copies so the live state
+        is the rescue reference a mid-dispatch death cannot consume.
         """
-        self._computed = None
-        self._update_count += 1
         if self._is_synced:
             raise TPUMetricsUserError("The Metric has already been synced and cannot be updated.")
         rec = _observe.RECORDER if _observe.ENABLED else None
         t0 = _observe.clock() if rec is not None else 0.0
+        prev_computed = self._computed
+        prev_count = self._update_count
+        self._computed = None
+        self._update_count += 1
         path = "eager"
         donated = False
-        if self._jit_eligible(args, kwargs):
-            entry = self._jitted_update
-            if entry is None:
-                entry = self._jitted_update = self._lookup_shared_jit(self._donation_eligible())
-            try:
-                state = self.__dict__["_state"]
-                if entry.donate:
-                    if self._state_escaped or self._group_shared:
-                        # a live reference may exist (defaults after reset,
-                        # metric_state/attribute reads, compute-group members):
-                        # donate fresh copies, never the referenced buffers
-                        state = _donation_copy(state)
-                        if rec is not None:
-                            rec.add_count("donate_copy", type(self).__name__)
+        try:
+            if self._jit_eligible(args, kwargs):
+                entry = self._jitted_update
+                if entry is None:
+                    entry = self._jitted_update = self._lookup_shared_jit(self._donation_eligible())
+                try:
+                    state = self.__dict__["_state"]
+                    if entry.donate:
+                        if entry.probation or self._state_escaped or self._group_shared:
+                            # a live reference may exist (defaults after reset,
+                            # metric_state/attribute reads, compute-group members),
+                            # or the dispatch is not yet known-good (probation) and
+                            # `state` itself must survive as the rescue reference:
+                            # donate fresh copies, never the referenced buffers
+                            state = _donation_copy(state)
+                            if rec is not None:
+                                rec.add_count("donate_copy", type(self).__name__)
+                        else:
+                            state = _dedup_donation_aliases(state)
+                    if entry.probation:
+                        new_state = _probation_dispatch(entry, type(self).__name__, (state,) + args, kwargs)
                     else:
-                        state = _dedup_donation_aliases(state)
-                if entry.probation:
-                    new_state = _probation_dispatch(entry, type(self).__name__, (state,) + args, kwargs)
-                else:
-                    new_state = entry(state, *args, **kwargs)
-                self.__dict__["_state"] = new_state
-                # the dispatch output is fresh executable-owned buffers: the next
-                # donated step may consume them in place
-                self.__dict__["_state_escaped"] = False
-                self.__dict__["_group_shared"] = False
-                donated = entry.donate
-                path = "jit"
-            except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
-                    jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
-                    jax.errors.TracerIntegerConversionError, TraceIneligibleError) as exc:
-                # update body is genuinely un-traceable → latch eager mode for this
-                # metric (donation never applies, so its buffers all stay alive);
-                # warn once per class and log the triggering exception
-                self._jit_failed = True
-                self._jitted_update = None
-                _observe.note_eager_fallback(type(self).__name__, exc)
-                self._update_impl(*args, **kwargs)
-                path = "fallback"
-        else:
-            self._update_impl(*args, **kwargs)
+                        new_state = entry(state, *args, **kwargs)
+                    self.__dict__["_state"] = new_state
+                    # the dispatch output is fresh executable-owned buffers: the next
+                    # donated step may consume them in place
+                    self.__dict__["_state_escaped"] = False
+                    self.__dict__["_group_shared"] = False
+                    donated = entry.donate
+                    path = "jit"
+                except (jax.errors.TracerBoolConversionError, jax.errors.ConcretizationTypeError,
+                        jax.errors.TracerArrayConversionError, jax.errors.UnexpectedTracerError,
+                        jax.errors.TracerIntegerConversionError, TraceIneligibleError) as exc:
+                    # update body is genuinely un-traceable → latch eager mode for this
+                    # metric (donation never applies, so its buffers all stay alive);
+                    # warn once per class and log the triggering exception
+                    self._jit_failed = True
+                    self._jitted_update = None
+                    _observe.note_eager_fallback(type(self).__name__, exc)
+                    self._eager_update_transactional(*args, **kwargs)
+                    path = "fallback"
+            else:
+                self._eager_update_transactional(*args, **kwargs)
+        except BaseException as exc:
+            # failed update: roll the lifecycle back so the metric is bit-identical
+            # to its pre-update self (state was restored by the failing path itself)
+            self._computed = prev_computed
+            self._update_count = prev_count
+            _observe.note_update_rollback(type(self).__name__, exc)
+            raise
         if rec is not None:
             name = type(self).__name__
             rec.add_time("update", name, _observe.clock() - t0)
             rec.add_count("update_" + path, name)
             if donated:
                 rec.add_count("update_donated", name)
+        if self.__dict__.get("_guard_policy") == "raise_on_host":
+            from metrics_tpu.resilience.guards import raise_if_quarantined
+
+            raise_if_quarantined(self)
         if self.compute_on_cpu:
             self._move_list_states_to_cpu()
+
+    def _eager_update_transactional(self, *args: Any, **kwargs: Any) -> None:
+        """Run the mutating update body with a state snapshot restored on failure.
+
+        Array states are immutable (jnp ops replace, never mutate in place), so
+        holding references is enough; list states are shallow-copied so in-place
+        appends roll back too.
+        """
+        state = self.__dict__["_state"]
+        snapshot = {k: (list(v) if isinstance(v, list) else v) for k, v in state.items()}
+        try:
+            self._run_update_body(*args, **kwargs)
+        except BaseException:
+            self.__dict__["_state"] = snapshot
+            raise
 
     def _move_list_states_to_cpu(self) -> None:
         """Move list states to host memory (reference ``metric.py:566-571``)."""
@@ -838,14 +893,17 @@ class Metric(ABC):
         names = list(input_dict)
         gathered = sync_fn([input_dict[n] for n in names], process_group)
         output_dict = dict(zip(names, gathered))
+        new_states: Dict[str, Any] = {}
         for attr, reduction_fn in self._reductions.items():
             values = output_dict[attr]
             if isinstance(values[0], list):
                 values = _flatten(values)
             if isinstance(values, list) and values and not isinstance(values[0], list) and reduction_fn is not dim_zero_cat:
                 values = jnp.stack([jnp.asarray(v) for v in values])
-            reduced = reduction_fn(values) if reduction_fn is not None else values
-            self._state[attr] = reduced
+            new_states[attr] = reduction_fn(values) if reduction_fn is not None else values
+        # install only after every collective and reduction succeeded, so a
+        # mid-sync failure can never leave some states synced and others local
+        self._state.update(new_states)
 
     def sync(
         self,
@@ -861,11 +919,42 @@ class Metric(ABC):
             distributed_available = self._distributed_available()
         if not should_sync or not distributed_available:
             return
+        from metrics_tpu.parallel import sync as _sync_mod
+
         self._cache = self._copy_state()
         self._state_escaped = True  # the unsync cache aliases the state arrays
         rec = _observe.RECORDER if _observe.ENABLED else None
         t0 = _observe.clock() if rec is not None else 0.0
-        self._sync_dist(dist_sync_fn or self.dist_sync_fn, process_group or self.process_group)
+        policy = _sync_mod.get_sync_policy()
+        try:
+            _sync_mod.run_with_retries(
+                lambda: self._sync_dist(dist_sync_fn or self.dist_sync_fn, process_group or self.process_group),
+                label=type(self).__name__,
+                policy=policy,
+            )
+        except Exception as exc:
+            if not policy.partial_merge or isinstance(exc, TPUMetricsUserError):
+                self._cache = None
+                raise
+            # degraded mode (DESIGN §14): the collective failed after retries —
+            # fold whatever survivor shards the failure carried into the local
+            # state (count-weighted, same algebra as merge_state) and let compute
+            # run on that instead of raising. _sync_dist is transactional, so the
+            # local state is intact and is itself the first survivor.
+            merged = self._copy_state()
+            merged_count = self._update_count
+            survivors = getattr(exc, "survivors", None) or []
+            counts = getattr(exc, "survivor_counts", None) or [1] * len(survivors)
+            for peer_state, peer_count in zip(survivors, counts):
+                merged = self._merge_state_dicts(merged, peer_state, merged_count, peer_count)
+                merged_count += peer_count
+            self.__dict__["_state"].update(merged)
+            self._state_escaped = True
+            self._is_synced = True
+            _observe.note_sync_degraded(type(self).__name__, exc, len(survivors))
+            if rec is not None:
+                rec.add_time("sync", type(self).__name__, _observe.clock() - t0)
+            return
         if rec is not None:
             rec.add_time("sync", type(self).__name__, _observe.clock() - t0)
             rec.add_count("sync", type(self).__name__)
@@ -1031,8 +1120,55 @@ class Metric(ABC):
         destination[prefix + "_update_count"] = self._update_count
         return destination
 
+    def _expected_aval(self, key: str) -> Tuple[Tuple[int, ...], Any, bool]:
+        """(shape, dtype, growable) the registered default prescribes for a state.
+
+        ``growable`` states (cat-reduced or list-backed) legitimately change their
+        leading extent as updates accumulate, so only dtype is checked for them.
+        """
+        default = self._defaults[key]
+        if isinstance(default, list):
+            elt = np.asarray(default[0]) if default else np.asarray(0, dtype=self._dtype)
+            return tuple(elt.shape), elt.dtype, True
+        arr = np.asarray(jax.device_get(default))
+        growable = self._reductions[key] is dim_zero_cat
+        return tuple(arr.shape), arr.dtype, growable
+
+    def _validate_loaded_state(self, key: str, value: Any, where: str) -> None:
+        """Raise a clear error naming the metric class and expected aval when a
+        to-be-loaded value cannot belong to this state."""
+        shape, dtype, growable = self._expected_aval(key)
+        values = value if isinstance(value, list) else [value]
+        for v in values:
+            arr = np.asarray(jax.device_get(v)) if isinstance(v, jax.Array) else np.asarray(v)
+            if arr.dtype.kind != np.dtype(dtype).kind:
+                raise RuntimeError(
+                    f"{type(self).__name__}.load_state_dict: state {where!r} expects dtype "
+                    f"{np.dtype(dtype).name} (shape {shape}) but got {arr.dtype.name} "
+                    f"(shape {arr.shape}) — wrong checkpoint or mismatched metric config."
+                )
+            if not growable and arr.shape != shape:
+                raise RuntimeError(
+                    f"{type(self).__name__}.load_state_dict: state {where!r} expects shape "
+                    f"{shape} (dtype {np.dtype(dtype).name}) but got {arr.shape} "
+                    f"(dtype {arr.dtype.name}) — wrong checkpoint or mismatched metric config."
+                )
+
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
-        """Load states exported by :meth:`state_dict` (reference ``metric.py:973-990``)."""
+        """Load states exported by :meth:`state_dict` (reference ``metric.py:973-990``).
+
+        Every incoming value is validated against the registered state's aval
+        (clear error naming the metric class on mismatch) BEFORE anything is
+        installed, so a bad checkpoint can never leave the metric partially
+        loaded. With ``strict=False`` missing keys keep their current value.
+        Checkpoint restore (``resilience.checkpoint``) reuses this path.
+        """
+        for key in self._defaults:
+            full = prefix + key
+            if full in state_dict:
+                self._validate_loaded_state(key, state_dict[full], full)
+            elif strict and self._persistent[key]:
+                raise RuntimeError(f"Missing key {full} in state_dict")
         count_key = prefix + "_update_count"
         if count_key in state_dict:
             self._update_count = int(state_dict[count_key])
@@ -1041,8 +1177,6 @@ class Metric(ABC):
             if full in state_dict:
                 v = state_dict[full]
                 self._state[key] = [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
-            elif strict and self._persistent[key]:
-                raise RuntimeError(f"Missing key {full} in state_dict")
         self._state_escaped = True  # loaded arrays may still be referenced by the caller
         self._computed = None
 

@@ -275,6 +275,45 @@ def note_replica_fallback(label: str, exc: BaseException) -> None:
         RECORDER.add_event("replica_fallback", engine=label, error=type(exc).__name__, detail=str(exc)[:200])
 
 
+# resilience hooks (metric.py transactional updates, resilience/, parallel/sync.py)
+def note_update_rollback(metric: str, exc: BaseException) -> None:
+    if ENABLED:
+        RECORDER.add_count("update_rolled_back", metric)
+        RECORDER.add_event("update_rolled_back", metric=metric, error=type(exc).__name__, detail=str(exc)[:200])
+
+
+def note_checkpoint_save(label: str, path: str, nbytes: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("ckpt_save", label)
+        RECORDER.add_event("ckpt_save", target=label, path=path, bytes=nbytes)
+
+
+def note_checkpoint_restore(label: str, path: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("ckpt_restore", label)
+        RECORDER.add_event("ckpt_restore", target=label, path=path)
+
+
+def note_sync_retry(label: str, attempt: int, exc: BaseException) -> None:
+    if ENABLED:
+        RECORDER.add_count("sync_retry", label)
+        RECORDER.add_event("sync_retry", metric=label, attempt=attempt, error=type(exc).__name__)
+
+
+def note_sync_degraded(label: str, exc: BaseException, n_survivors: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("sync_degraded", label)
+        RECORDER.add_event(
+            "sync_degraded", metric=label, error=type(exc).__name__, survivors=n_survivors, detail=str(exc)[:200]
+        )
+
+
+def note_guard_quarantined(metric: str, n_batches: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("guard_quarantined", metric)
+        RECORDER.add_event("guard_quarantined", metric=metric, batches=n_batches)
+
+
 # ------------------------------------------------------------------ export surfaces
 def snapshot() -> Dict[str, Any]:
     """One JSON-able dict of everything recorded so far.
@@ -287,7 +326,10 @@ def snapshot() -> Dict[str, Any]:
          "events":   [{"seq", "kind", ...}, ...],
          "derived":  {"jit_cache_hit_rate": float|None,
                       "jit_compiles_total": int, "jit_cache_hits_total": int,
-                      "jit_cache_evictions_total": int, "eager_fallbacks_total": int}}
+                      "jit_cache_evictions_total": int, "eager_fallbacks_total": int,
+                      "updates_rolled_back_total": int, "ckpt_saves_total": int,
+                      "ckpt_restores_total": int, "sync_retries_total": int,
+                      "sync_degraded_total": int, "guard_quarantined_total": int}}
     """
     with RECORDER._lock:
         counters: Dict[str, Dict[str, int]] = {}
@@ -317,6 +359,12 @@ def snapshot() -> Dict[str, Any]:
             "jit_cache_hits_total": hits,
             "jit_cache_evictions_total": sum(counters.get("jit_cache_eviction", {}).values()),
             "eager_fallbacks_total": sum(counters.get("eager_fallback", {}).values()),
+            "updates_rolled_back_total": sum(counters.get("update_rolled_back", {}).values()),
+            "ckpt_saves_total": sum(counters.get("ckpt_save", {}).values()),
+            "ckpt_restores_total": sum(counters.get("ckpt_restore", {}).values()),
+            "sync_retries_total": sum(counters.get("sync_retry", {}).values()),
+            "sync_degraded_total": sum(counters.get("sync_degraded", {}).values()),
+            "guard_quarantined_total": sum(counters.get("guard_quarantined", {}).values()),
         },
     }
 

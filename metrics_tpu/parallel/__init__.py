@@ -1,12 +1,31 @@
 """Distributed/mesh layer: state sync over ICI/DCN via XLA collectives (SURVEY §2.2)."""
 
 from metrics_tpu.parallel.sync import (
+    SyncPeerLostError,
+    SyncPolicy,
     allreduce_over_mesh,
     build_mesh,
     gather_all_states,
+    get_sync_policy,
     pad_to_capacity,
+    run_with_retries,
+    set_sync_policy,
     shard_map_compat,
+    sync_policy,
     sync_states,
 )
 
-__all__ = ["allreduce_over_mesh", "build_mesh", "gather_all_states", "pad_to_capacity", "shard_map_compat", "sync_states"]
+__all__ = [
+    "SyncPeerLostError",
+    "SyncPolicy",
+    "allreduce_over_mesh",
+    "build_mesh",
+    "gather_all_states",
+    "get_sync_policy",
+    "pad_to_capacity",
+    "run_with_retries",
+    "set_sync_policy",
+    "shard_map_compat",
+    "sync_policy",
+    "sync_states",
+]

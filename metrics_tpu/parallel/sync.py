@@ -19,7 +19,9 @@ dynamic pad-gather-trim of ``distributed.py:138-151``, which XLA cannot express.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, TypeVar
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +42,129 @@ __all__ = [
     "pad_to_capacity",
     "build_mesh",
     "shard_map_compat",
+    "SyncPolicy",
+    "SyncPeerLostError",
+    "get_sync_policy",
+    "set_sync_policy",
+    "sync_policy",
+    "run_with_retries",
 ]
+
+_T = TypeVar("_T")
+
+
+# ------------------------------------------------------------------ degraded-sync policy
+@dataclasses.dataclass(frozen=True)
+class SyncPolicy:
+    """How the eager sync orchestration (``Metric.sync`` → ``gather_all_states``)
+    behaves when a collective fails (DESIGN §14).
+
+    - ``retries``: extra attempts after the first failure, each preceded by an
+      exponentially growing sleep starting at ``backoff_s``.
+    - ``timeout_s``: total retry budget in seconds — once exceeded, no further
+      attempt is made even if ``retries`` remain. ``None`` = unbounded.
+    - ``partial_merge``: when the final attempt still fails, degrade to a
+      count-weighted merge of the surviving shards (the local state plus any
+      survivors a :class:`SyncPeerLostError` carried) and record a
+      ``sync_degraded`` observe event instead of raising.
+
+    Retries apply only to the eager/multi-host path; the in-trace
+    :func:`sync_states` collectives compile into the caller's executable and
+    cannot be retried from Python.
+    """
+
+    retries: int = 0
+    backoff_s: float = 0.05
+    timeout_s: Optional[float] = None
+    partial_merge: bool = False
+
+
+_SYNC_POLICY = SyncPolicy()
+
+
+def get_sync_policy() -> SyncPolicy:
+    return _SYNC_POLICY
+
+
+def set_sync_policy(policy: SyncPolicy) -> SyncPolicy:
+    """Install a new process-wide :class:`SyncPolicy`; returns the previous one."""
+    global _SYNC_POLICY
+    if not isinstance(policy, SyncPolicy):
+        raise TPUMetricsUserError(f"set_sync_policy expects a SyncPolicy, got {type(policy).__name__}")
+    previous = _SYNC_POLICY
+    _SYNC_POLICY = policy
+    return previous
+
+
+class sync_policy:
+    """Context manager form: ``with sync_policy(SyncPolicy(retries=2)): ...``"""
+
+    def __init__(self, policy: SyncPolicy) -> None:
+        self._policy = policy
+        self._previous: Optional[SyncPolicy] = None
+
+    def __enter__(self) -> SyncPolicy:
+        self._previous = set_sync_policy(self._policy)
+        return self._policy
+
+    def __exit__(self, *exc_info: Any) -> None:
+        assert self._previous is not None
+        set_sync_policy(self._previous)
+
+
+class SyncPeerLostError(RuntimeError):
+    """A sync collective lost one or more peers.
+
+    Raise this from a custom ``dist_sync_fn`` (or any transport shim) to hand
+    the degraded-merge machinery whatever shards DID arrive: ``survivors`` is a
+    list of per-peer state dicts (``{state_name: value}``, local rank excluded —
+    it is always counted as a survivor) and ``survivor_counts`` the matching
+    update counts for count-weighted merging. Not retried: a lost peer will not
+    reappear within a backoff window, and the survivors are already in hand.
+    """
+
+    no_retry = True
+
+    def __init__(
+        self,
+        message: str,
+        survivors: Optional[List[Dict[str, Any]]] = None,
+        survivor_counts: Optional[List[int]] = None,
+    ) -> None:
+        super().__init__(message)
+        self.survivors = survivors or []
+        self.survivor_counts = survivor_counts if survivor_counts is not None else [1] * len(self.survivors)
+        if len(self.survivor_counts) != len(self.survivors):
+            raise ValueError("survivor_counts must match survivors in length")
+
+
+def run_with_retries(attempt: Callable[[], _T], label: str = "", policy: Optional[SyncPolicy] = None) -> _T:
+    """Run ``attempt`` under the policy's retry/backoff/timeout envelope.
+
+    Exceptions whose class sets ``no_retry = True`` (e.g. :class:`SyncPeerLostError`)
+    and user errors propagate immediately; anything else is retried with
+    exponential backoff until attempts or the time budget run out. Each retry
+    records a ``sync_retry`` observe event.
+    """
+    policy = policy if policy is not None else _SYNC_POLICY
+    deadline = (time.monotonic() + policy.timeout_s) if policy.timeout_s is not None else None
+    delay = policy.backoff_s
+    for attempt_no in range(policy.retries + 1):
+        try:
+            return attempt()
+        except Exception as exc:
+            out_of_budget = deadline is not None and time.monotonic() + delay > deadline
+            if (
+                attempt_no == policy.retries
+                or getattr(exc, "no_retry", False)
+                or isinstance(exc, TPUMetricsUserError)
+                or out_of_budget
+            ):
+                raise
+            _observe.note_sync_retry(label, attempt_no + 1, exc)
+            time.sleep(delay)
+            delay *= 2.0
+    raise AssertionError("unreachable")  # pragma: no cover
 
 
 def shard_map_compat(f: Callable, mesh: Mesh, in_specs: Any, out_specs: Any) -> Callable:

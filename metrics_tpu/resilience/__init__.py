@@ -1,0 +1,48 @@
+"""Fault-tolerant metric runtime (DESIGN §14).
+
+Three layers over the L2 metric core:
+
+- transactional updates live in ``metric.py`` itself (every update path fully
+  applies or leaves state untouched — this package only documents the contract);
+- :mod:`metrics_tpu.resilience.checkpoint` — crash-consistent atomic snapshots
+  of any ``Metric``, ``MetricCollection`` or ``ReplicatedWrapper``, with
+  versioned headers validated before a single byte of state is installed;
+- :mod:`metrics_tpu.resilience.guards` — opt-in, jit-compatible NaN/Inf input
+  policies (``propagate`` | ``skip_batch`` | ``raise_on_host``) that quarantine
+  poisoned batches branch-free (``jnp.where`` + a counter state, no recompile).
+
+Degraded sync (retry/backoff + count-weighted partial merge of survivors) lives
+in :mod:`metrics_tpu.parallel.sync` next to the collectives it wraps.
+"""
+
+from metrics_tpu.resilience.checkpoint import (
+    CheckpointError,
+    CorruptCheckpointError,
+    IncompatibleCheckpointError,
+    PeriodicCheckpointer,
+    SnapshotPolicy,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from metrics_tpu.resilience.guards import (
+    GUARD_POLICIES,
+    GUARD_STATE,
+    PoisonedInputError,
+    install_guard,
+    poisoned_count,
+)
+
+__all__ = [
+    "CheckpointError",
+    "CorruptCheckpointError",
+    "GUARD_POLICIES",
+    "GUARD_STATE",
+    "IncompatibleCheckpointError",
+    "PeriodicCheckpointer",
+    "PoisonedInputError",
+    "SnapshotPolicy",
+    "install_guard",
+    "poisoned_count",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

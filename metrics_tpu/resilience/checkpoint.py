@@ -1,0 +1,353 @@
+"""Durable checkpoint/restore for metric state (DESIGN §14).
+
+File format (all integers big-endian)::
+
+    MAGIC "MTCKPT01"  (8 bytes)
+    u32 header_len | u32 header_crc32
+    header JSON      {"format_version", "payload_len", "payload_crc32",
+                      "root_kind", "root_class"}
+    payload          pickled host tree of the node structure below
+
+The write is crash-consistent (``utils.io.atomic_write_bytes``: sibling temp
+file + fsync + ``os.replace`` + directory fsync), so a reader only ever sees a
+complete old or complete new checkpoint. ``restore_checkpoint`` verifies the
+magic, version, both CRCs and exact length, then validates class names, config
+fingerprints and state avals against the live target — all BEFORE installing
+anything, so a truncated, bit-flipped or mismatched checkpoint is rejected with
+a clean error and can never leave the target partially loaded. Installation
+goes through ``Metric.load_state_dict`` (aval-checked, sets the escape latch so
+the first post-restore donated dispatch copies instead of consuming restored
+buffers) and clears sync leftovers, re-entering the donation/shared-jit
+machinery with no stale probation state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import struct
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import numpy as np
+
+from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.utils.io import atomic_write_bytes
+
+__all__ = [
+    "CheckpointError",
+    "CorruptCheckpointError",
+    "IncompatibleCheckpointError",
+    "PeriodicCheckpointer",
+    "SnapshotPolicy",
+    "restore_checkpoint",
+    "save_checkpoint",
+]
+
+MAGIC = b"MTCKPT01"
+FORMAT_VERSION = 1
+_HEAD = struct.Struct(">II")  # header_len, header_crc32
+
+
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint failures; the target is guaranteed untouched."""
+
+
+class CorruptCheckpointError(CheckpointError):
+    """The file is not a complete, intact checkpoint (truncated, bit-flipped,
+    wrong magic/version, or trailing garbage)."""
+
+
+class IncompatibleCheckpointError(CheckpointError):
+    """The file is intact but describes a different object (class, config
+    fingerprint, structure, or state avals do not match the target)."""
+
+
+# ------------------------------------------------------------------ extraction
+def _fingerprint(metric: Any) -> Optional[str]:
+    """Config fingerprint from the shared-jit cache key; None when the config is
+    unshareable (child metrics, unhashable attrs) — aval checks still apply."""
+    key = metric._jit_cache_key()
+    if key is None:
+        return None
+    # the key's first element is the class object; repr() it stably by name
+    cls, items = key
+    return hashlib.sha256(repr((cls.__module__, cls.__qualname__, items)).encode()).hexdigest()
+
+
+def _host(v: Any) -> Any:
+    return np.asarray(jax.device_get(v))
+
+
+def _aval_of(v: Any) -> Any:
+    if isinstance(v, list):
+        return {"list": [{"shape": list(x.shape), "dtype": str(x.dtype)} for x in v]}
+    return {"shape": list(v.shape), "dtype": str(v.dtype)}
+
+
+def _metric_payload(m: Any) -> Dict[str, Any]:
+    state: Dict[str, Any] = {}
+    for key in m._defaults:
+        v = m.__dict__["_state"][key]
+        state[key] = [_host(x) for x in v] if isinstance(v, list) else _host(v)
+    return {
+        "kind": "metric",
+        "class": type(m).__name__,
+        "fingerprint": _fingerprint(m),
+        "update_count": int(m._update_count),
+        "state": state,
+        "avals": {k: _aval_of(v) for k, v in state.items()},
+    }
+
+
+def _extract(obj: Any) -> Dict[str, Any]:
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.wrappers.replicated import ReplicatedWrapper
+
+    if isinstance(obj, MetricCollection):
+        return {
+            "kind": "collection",
+            "class": type(obj).__name__,
+            "members": {name: _extract(m) for name, m in obj._modules.items()},
+        }
+    if isinstance(obj, ReplicatedWrapper):
+        obj._materialize()
+        node = _metric_payload(obj)
+        node["kind"] = "replicated"
+        node["replicas"] = [_metric_payload(r) for r in obj._replicas]
+        return node
+    return _metric_payload(obj)
+
+
+# ------------------------------------------------------------------ save
+def _label(obj: Any) -> str:
+    return type(obj).__name__
+
+
+def save_checkpoint(obj: Any, path: Union[str, os.PathLike]) -> str:
+    """Atomically snapshot ``obj`` (Metric / MetricCollection / ReplicatedWrapper).
+
+    Captures ALL registered states (persistence flags gate ``state_dict``, not
+    durability checkpoints) plus update counts, recursively for collections and
+    replica engines. Returns the path written.
+    """
+    path = os.fspath(path)
+    node = _extract(obj)
+    payload = pickle.dumps(node, protocol=pickle.HIGHEST_PROTOCOL)
+    header = json.dumps(
+        {
+            "format_version": FORMAT_VERSION,
+            "payload_len": len(payload),
+            "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+            "root_kind": node["kind"],
+            "root_class": node["class"],
+        },
+        sort_keys=True,
+    ).encode("utf-8")
+    blob = MAGIC + _HEAD.pack(len(header), zlib.crc32(header) & 0xFFFFFFFF) + header + payload
+    atomic_write_bytes(path, blob)
+    _observe.note_checkpoint_save(_label(obj), path, len(blob))
+    return path
+
+
+# ------------------------------------------------------------------ parse + verify
+def _parse(blob: bytes, path: str) -> Dict[str, Any]:
+    base = len(MAGIC) + _HEAD.size
+    if len(blob) < base or blob[: len(MAGIC)] != MAGIC:
+        raise CorruptCheckpointError(f"{path}: not a metrics_tpu checkpoint (bad magic or truncated preamble)")
+    header_len, header_crc = _HEAD.unpack_from(blob, len(MAGIC))
+    if len(blob) < base + header_len:
+        raise CorruptCheckpointError(f"{path}: truncated header")
+    header_bytes = blob[base : base + header_len]
+    if zlib.crc32(header_bytes) & 0xFFFFFFFF != header_crc:
+        raise CorruptCheckpointError(f"{path}: header CRC mismatch (bit-flipped or damaged)")
+    try:
+        header = json.loads(header_bytes.decode("utf-8"))
+    except ValueError as exc:
+        raise CorruptCheckpointError(f"{path}: header is not valid JSON ({exc})") from exc
+    if header.get("format_version") != FORMAT_VERSION:
+        raise CorruptCheckpointError(
+            f"{path}: unsupported checkpoint format version {header.get('format_version')!r} "
+            f"(this build reads version {FORMAT_VERSION})"
+        )
+    payload = blob[base + header_len :]
+    if len(payload) != header.get("payload_len"):
+        raise CorruptCheckpointError(
+            f"{path}: payload length {len(payload)} != declared {header.get('payload_len')} "
+            "(truncated or trailing garbage)"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != header.get("payload_crc32"):
+        raise CorruptCheckpointError(f"{path}: payload CRC mismatch (bit-flipped or damaged)")
+    try:
+        node = pickle.loads(payload)
+    except Exception as exc:  # pickle raises a zoo of exception types on damage
+        raise CorruptCheckpointError(f"{path}: payload does not unpickle ({type(exc).__name__}: {exc})") from exc
+    if not isinstance(node, dict) or "kind" not in node:
+        raise CorruptCheckpointError(f"{path}: payload is not a checkpoint node tree")
+    return node
+
+
+def _validate_metric(m: Any, node: Dict[str, Any], where: str) -> None:
+    if node.get("kind") not in ("metric", "replicated"):
+        raise IncompatibleCheckpointError(f"{where}: expected a metric node, checkpoint holds {node.get('kind')!r}")
+    if node["class"] != type(m).__name__:
+        raise IncompatibleCheckpointError(
+            f"{where}: checkpoint was saved from {node['class']} but the restore target is {type(m).__name__}"
+        )
+    fp_ckpt, fp_live = node.get("fingerprint"), _fingerprint(m)
+    if fp_ckpt is not None and fp_live is not None and fp_ckpt != fp_live:
+        raise IncompatibleCheckpointError(
+            f"{where}: config fingerprint mismatch for {type(m).__name__} — the checkpointed instance "
+            "was constructed with different arguments than the restore target"
+        )
+    for key, value in node["state"].items():
+        if key not in m._defaults:
+            raise IncompatibleCheckpointError(
+                f"{where}: checkpoint carries state {key!r} that {type(m).__name__} does not register"
+            )
+        try:
+            m._validate_loaded_state(key, value, key)
+        except RuntimeError as exc:
+            raise IncompatibleCheckpointError(f"{where}: {exc}") from exc
+
+
+def _validate(obj: Any, node: Dict[str, Any], where: str) -> None:
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.wrappers.replicated import ReplicatedWrapper
+
+    if isinstance(obj, MetricCollection):
+        if node.get("kind") != "collection":
+            raise IncompatibleCheckpointError(
+                f"{where}: restore target is a MetricCollection but the checkpoint holds {node.get('kind')!r}"
+            )
+        members = node.get("members", {})
+        missing = sorted(set(obj._modules) - set(members))
+        unexpected = sorted(set(members) - set(obj._modules))
+        if missing or unexpected:
+            raise IncompatibleCheckpointError(
+                f"{where}: collection members do not match the checkpoint "
+                f"(missing: {missing or 'none'}, unexpected: {unexpected or 'none'})"
+            )
+        for name, sub in members.items():
+            _validate(obj._modules[name], sub, f"{where}.{name}")
+        return
+    if isinstance(obj, ReplicatedWrapper):
+        if node.get("kind") != "replicated":
+            raise IncompatibleCheckpointError(
+                f"{where}: restore target is a ReplicatedWrapper but the checkpoint holds {node.get('kind')!r}"
+            )
+        obj._materialize()  # layout-only: logical state is unchanged
+        replicas = node.get("replicas", [])
+        if len(replicas) != len(obj._replicas):
+            raise IncompatibleCheckpointError(
+                f"{where}: checkpoint holds {len(replicas)} replicas, target has {len(obj._replicas)}"
+            )
+        _validate_metric(obj, node, where)
+        for i, (r, sub) in enumerate(zip(obj._replicas, replicas)):
+            _validate_metric(r, sub, f"{where}.replica[{i}]")
+        return
+    _validate_metric(obj, node, where)
+
+
+def _install_metric(m: Any, node: Dict[str, Any]) -> None:
+    flat: Dict[str, Any] = dict(node["state"])
+    flat["_update_count"] = node["update_count"]
+    # load_state_dict re-validates avals, installs, sets the escape latch (the
+    # first post-restore donated dispatch copies) and drops the compute cache
+    m.load_state_dict(flat, strict=False)
+    # no sync leftovers survive a restore
+    m._is_synced = False
+    m._cache = None
+
+
+def _install(obj: Any, node: Dict[str, Any]) -> None:
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.wrappers.replicated import ReplicatedWrapper
+
+    if isinstance(obj, MetricCollection):
+        for name, sub in node["members"].items():
+            _install(obj._modules[name], sub)
+        return
+    if isinstance(obj, ReplicatedWrapper):
+        _install_metric(obj, node)
+        for r, sub in zip(obj._replicas, node["replicas"]):
+            _install_metric(r, sub)
+        # stale stacked layout (if any) was materialized during validation;
+        # the next engine dispatch re-stacks from the restored replica states
+        obj.__dict__["_stacked"] = None
+        obj._engine_updates = 0
+        return
+    _install_metric(obj, node)
+
+
+def restore_checkpoint(obj: Any, path: Union[str, os.PathLike]) -> Any:
+    """Restore ``obj`` from a checkpoint written by :func:`save_checkpoint`.
+
+    Fully reads and verifies the file (magic, version, CRCs, exact length) and
+    validates every class name, config fingerprint and state aval against the
+    live target BEFORE installing anything — a failure raises
+    :class:`CorruptCheckpointError` / :class:`IncompatibleCheckpointError` and
+    leaves ``obj`` bit-identical to its pre-call state. Returns ``obj``.
+    """
+    path = os.fspath(path)
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError as exc:
+        raise CheckpointError(f"{path}: cannot read checkpoint ({exc})") from exc
+    node = _parse(blob, path)
+    _validate(obj, node, _label(obj))
+    _install(obj, node)
+    _observe.note_checkpoint_restore(_label(obj), path)
+    return obj
+
+
+# ------------------------------------------------------------------ periodic snapshots
+@dataclasses.dataclass(frozen=True)
+class SnapshotPolicy:
+    """When :class:`PeriodicCheckpointer.step` actually writes: after every
+    ``every_n_updates`` accumulated steps, and/or every ``every_s`` seconds of
+    wall clock — whichever fires first. Both ``None`` means manual-only."""
+
+    every_n_updates: Optional[int] = 1000
+    every_s: Optional[float] = None
+
+
+class PeriodicCheckpointer:
+    """Policy-driven snapshot loop for long-lived streams.
+
+    Call :meth:`step` from the ingest loop after each update (or batch of
+    updates); it saves according to the policy and is cheap when not due.
+    Every save is atomic, so a preemption mid-save costs at most the interval
+    since the previous snapshot.
+    """
+
+    def __init__(self, target: Any, path: Union[str, os.PathLike], policy: SnapshotPolicy = SnapshotPolicy()) -> None:
+        self.target = target
+        self.path = os.fspath(path)
+        self.policy = policy
+        self.saves = 0
+        self._updates_since = 0
+        self._last_save_t = time.monotonic()
+
+    def step(self, n_updates: int = 1) -> bool:
+        """Account ``n_updates`` more updates; snapshot if the policy says so."""
+        self._updates_since += n_updates
+        due_n = self.policy.every_n_updates is not None and self._updates_since >= self.policy.every_n_updates
+        due_t = self.policy.every_s is not None and (time.monotonic() - self._last_save_t) >= self.policy.every_s
+        if due_n or due_t:
+            self.save()
+            return True
+        return False
+
+    def save(self) -> str:
+        out = save_checkpoint(self.target, self.path)
+        self.saves += 1
+        self._updates_since = 0
+        self._last_save_t = time.monotonic()
+        return out

@@ -1,0 +1,161 @@
+"""Opt-in NaN/Inf input guard rails (DESIGN §14).
+
+``install_guard(metric, policy)`` arms one of three per-metric policies:
+
+- ``propagate`` — record poisoned batches in the ``guard_poisoned`` counter
+  state but let the values flow (the unguarded arithmetic, plus bookkeeping);
+- ``skip_batch`` — quarantine the whole batch: every state keeps its pre-update
+  value and only the counter advances;
+- ``raise_on_host`` — ``skip_batch`` semantics, plus a host-side check after
+  each update that raises :class:`PoisonedInputError`. This forces one device
+  sync per update (documented opt-in cost); the other policies stay async.
+
+The implementation is branch-free so it jit-compiles into the shared update
+executable with NO recompile per outcome: inputs are sanitized with
+``jnp.where(isfinite, x, 0)`` (also keeping ``jax_debug_nans`` quiet), the
+update body runs, and a traced scalar ``bad`` flag selects old-vs-new per
+state. The poisoned count is itself a metric state (int32, sum-merged), so it
+resets, syncs, checkpoints and merges like any other state.
+
+``_guard_policy`` deliberately participates in the shared-jit cache key
+(metric.py ``_JIT_KEY_EXCLUDE``): guarded and unguarded instances of one config
+compile separately, and a clone/deepcopy representative traces the guarded
+body.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["GUARD_POLICIES", "GUARD_STATE", "PoisonedInputError", "install_guard", "poisoned_count"]
+
+GUARD_POLICIES = ("propagate", "skip_batch", "raise_on_host")
+GUARD_STATE = "guard_poisoned"
+
+
+class PoisonedInputError(TPUMetricsUserError):
+    """A ``raise_on_host``-guarded metric received a non-finite input batch.
+
+    The batch was quarantined before the raise: payload states are untouched
+    and only the ``guard_poisoned`` counter advanced — catching this error and
+    continuing the stream is always safe.
+    """
+
+
+def install_guard(metric: Any, policy: str = "skip_batch") -> Any:
+    """Arm a NaN/Inf input policy on ``metric``; returns the metric.
+
+    Install right after construction (the policy enters the jit cache key, so a
+    previously-resolved compiled entry is dropped). ``skip_batch`` and
+    ``raise_on_host`` need fixed-shape states to select old-vs-new with
+    ``jnp.where`` — metrics with list or cat-growable states only support
+    ``propagate``.
+    """
+    if policy not in GUARD_POLICIES:
+        raise TPUMetricsUserError(f"Unknown guard policy {policy!r}; choose one of {GUARD_POLICIES}")
+    if policy != "propagate":
+        growable = [
+            k
+            for k, v in metric._defaults.items()
+            if isinstance(v, list) or metric._reductions[k] is dim_zero_cat
+        ]
+        if growable:
+            raise TPUMetricsUserError(
+                f"Guard policy {policy!r} needs fixed-shape states to quarantine batches branch-free, "
+                f"but {type(metric).__name__} state(s) {growable} grow per update. Use policy='propagate'."
+            )
+    if GUARD_STATE not in metric._defaults:
+        metric.add_state(GUARD_STATE, jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum", persistent=True)
+    metric._guard_policy = policy
+    metric.__dict__["_guard_seen"] = 0
+    metric._jitted_update = None  # the cache key changed; re-resolve on next update
+    return metric
+
+
+def _iter_guardable(args: Tuple[Any, ...], kwargs: Dict[str, Any]):
+    for a in list(args) + list(kwargs.values()):
+        yield a
+
+
+def _poisoned_flag(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Any:
+    """Traced scalar bool: any non-finite value in any float input."""
+    flags = []
+    for a in _iter_guardable(args, kwargs):
+        if isinstance(a, bool) or a is None:
+            continue
+        if isinstance(a, float):
+            if not math.isfinite(a):  # static python scalar: static flag
+                flags.append(jnp.asarray(True))
+            continue
+        if isinstance(a, (jax.Array, np.ndarray)) and jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact):
+            flags.append(jnp.any(~jnp.isfinite(jnp.asarray(a))))
+    if not flags:
+        return jnp.asarray(False)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def _sanitize(a: Any) -> Any:
+    """Replace non-finite float values with zeros so the (discarded) update
+    arithmetic stays finite — keeps ``jax_debug_nans`` runs clean too."""
+    if isinstance(a, bool) or a is None:
+        return a
+    if isinstance(a, float):
+        return a if math.isfinite(a) else 0.0
+    if isinstance(a, (jax.Array, np.ndarray)) and jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact):
+        arr = jnp.asarray(a)
+        return jnp.where(jnp.isfinite(arr), arr, jnp.zeros_like(arr))
+    return a
+
+
+def run_guarded_update(metric: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
+    """The guarded update body — called by ``Metric._run_update_body`` in BOTH
+    the traced (``_functional_update``) and eager paths, so semantics are
+    identical under jit and ``jit_update_enabled(False)``."""
+    state = metric.__dict__["_state"]
+    prev_counter = state[GUARD_STATE]
+    policy = metric.__dict__["_guard_policy"]
+    bad = _poisoned_flag(args, kwargs)
+    if policy == "propagate":
+        metric._update_impl(*args, **kwargs)
+    else:
+        snapshot = {k: v for k, v in state.items() if k != GUARD_STATE}
+        metric._update_impl(
+            *tuple(_sanitize(a) for a in args), **{k: _sanitize(v) for k, v in kwargs.items()}
+        )
+        after = metric.__dict__["_state"]
+        for key, old in snapshot.items():
+            after[key] = jnp.where(bad, old, after[key])
+    # the add allocates a fresh buffer — never aliases an input
+    metric.__dict__["_state"][GUARD_STATE] = prev_counter + bad.astype(prev_counter.dtype)  # donlint: disable=ML001
+
+
+def poisoned_count(metric: Any) -> int:
+    """Host-side read of the quarantine counter (forces a device sync)."""
+    return int(jax.device_get(metric.__dict__["_state"][GUARD_STATE]))
+
+
+def raise_if_quarantined(metric: Any) -> None:
+    """``raise_on_host`` post-update hook (metric.py): compare the counter to the
+    host watermark; new quarantines raise after recording telemetry."""
+    current = poisoned_count(metric)
+    seen = metric.__dict__.get("_guard_seen", 0)
+    if current > seen:
+        metric.__dict__["_guard_seen"] = current
+        _observe.note_guard_quarantined(type(metric).__name__, current - seen)
+        raise PoisonedInputError(
+            f"{type(metric).__name__}: non-finite input batch quarantined "
+            f"({current} poisoned batch(es) total). State is untouched apart from the "
+            "guard counter; catching this error and continuing is safe."
+        )

@@ -1,0 +1,53 @@
+"""Crash-consistent file writes shared by checkpointing and the lint baselines.
+
+The contract (DESIGN §14): a reader at any instant sees either the complete old
+file or the complete new file, never a truncated mix. Achieved the classic way —
+write a sibling temp file, flush+fsync it, then atomically ``os.replace`` over
+the destination, and fsync the directory so the rename itself is durable.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Union
+
+__all__ = ["atomic_write_bytes", "atomic_write_text"]
+
+
+def atomic_write_bytes(path: Union[str, os.PathLike], payload: bytes, fsync: bool = True) -> None:
+    """Atomically replace ``path`` with ``payload``.
+
+    The temp file lives in the destination directory (``os.replace`` must not
+    cross filesystems) and is unlinked on any failure, so a crashed writer never
+    leaves a partial file under the real name.
+    """
+    path = os.fspath(path)
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".", suffix=".tmp", dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(payload)
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        try:
+            dir_fd = os.open(directory, os.O_RDONLY)
+        except OSError:
+            return  # platform without directory fds: the data fsync already ran
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+
+
+def atomic_write_text(path: Union[str, os.PathLike], text: str, fsync: bool = True) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)

@@ -126,6 +126,11 @@ def replica_update(
         return _CompiledUpdate(jax.vmap(one, in_axes=in_axes), donate)
 
     entry = _lookup_replica_entry(key, build, label, n)
+    if entry.probation and entry.donate:
+        # the dispatch is not yet known-good: donate fresh copies so the engine's
+        # live stacked pytree survives as the rescue reference if the first
+        # dispatch dies mid-flight (transactional-update contract, DESIGN §14)
+        stacked = {k: jnp.copy(v) for k, v in stacked.items()}
     call_args = (stacked, gather_idx) + flat if mode == "gather" else (stacked,) + flat
     if entry.probation:
         new_stacked = _probation_dispatch(entry, label, call_args, {})

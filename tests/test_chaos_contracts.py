@@ -1,0 +1,75 @@
+"""Chaos contract harness (``analysis/chaos_contracts.py``): registry coverage,
+one end-to-end class run, baseline diff semantics, and CLI wiring. The full
+53-class sweep runs as the ``chaos`` pass of ``tools/ci_check.sh``, not here."""
+
+import json
+
+from metrics_tpu.analysis.chaos_contracts import (
+    ChaosResult,
+    chaos_cases,
+    check_chaos_case,
+    diff_chaos_baseline,
+    load_chaos_baseline,
+    write_chaos_baseline,
+)
+
+
+def test_registry_covers_the_jit_eligible_classes():
+    cases = chaos_cases()
+    assert len(cases) >= 50
+    names = {c.name for c in cases}
+    assert "BinaryAccuracy" in names
+
+
+def test_one_class_survives_the_full_fault_suite():
+    case = next(c for c in chaos_cases() if c.name == "BinaryAccuracy")
+    result = check_chaos_case(case)
+    assert result.ok, result.render()
+    ran = set(result.ran)
+    # every fault family fired for a float-input, jit-eligible classifier
+    assert {"exc_eager[pre]", "exc_eager[mid]", "exc_eager[post]", "exc_trace"} <= ran
+    assert {"dispatch_death[probation]", "dispatch_death[steady]"} <= ran
+    assert {"nan_guard[skip]", "nan_guard[raise]"} <= ran
+    assert {"ckpt[roundtrip]", "ckpt[truncate]", "ckpt[bitflip]", "sync[degraded]"} <= ran
+
+
+def test_diff_splits_failures_and_stale():
+    ok = ChaosResult("A", ("f",), (), ())
+    bad = ChaosResult("B", ("f",), (), ("f: broke",))
+    baselined = ChaosResult("C", ("f",), (), ("f: known",))
+    failures, stale = diff_chaos_baseline(
+        [ok, bad, baselined], {"C": "justified", "Gone": "stale entry"}
+    )
+    assert [r.name for r in failures] == ["B"]
+    assert stale == ["Gone"]
+
+
+def test_baseline_write_load_roundtrip(tmp_path):
+    path = str(tmp_path / "chaos_baseline.json")
+    results = [
+        ChaosResult("A", ("f",), (), ()),
+        ChaosResult("B", ("f",), (), ("f: broke",)),
+    ]
+    written = write_chaos_baseline(path, results)
+    assert set(written) == {"B"}
+    assert load_chaos_baseline(path) == written
+    payload = json.loads(open(path).read())
+    assert "chaos" in payload and "comment" in payload
+
+
+def test_cli_wires_the_chaos_pass():
+    from metrics_tpu.analysis import cli
+
+    assert "chaos" in cli._DYNAMIC
+    from metrics_tpu.analysis.chaos_contracts import run_chaos_check
+
+    assert cli._dynamic_runner("chaos") is run_chaos_check
+    assert callable(cli.main_chaoslint)
+
+
+def test_repo_baseline_is_empty():
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    baseline = load_chaos_baseline(os.path.join(root, "tools", "chaos_baseline.json"))
+    assert baseline == {}  # every class honors every fault contract

@@ -1,0 +1,171 @@
+"""Degraded sync (DESIGN §14): retry with backoff, and on final failure with
+``partial_merge`` fold the survivor shards count-weighted instead of raising."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.observe import recorder as rec_mod
+from metrics_tpu.parallel import (
+    SyncPeerLostError,
+    SyncPolicy,
+    get_sync_policy,
+    run_with_retries,
+    set_sync_policy,
+    sync_policy,
+)
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.rand(32)), jnp.asarray(rng.randint(0, 2, 32))
+
+
+def _host(d):
+    return {k: np.asarray(jax.device_get(v)) for k, v in d.items()}
+
+
+# ----------------------------------------------------------------- policy API
+def test_policy_get_set_roundtrip():
+    original = get_sync_policy()
+    p = SyncPolicy(retries=2, backoff_s=0.0, partial_merge=True)
+    prev = set_sync_policy(p)
+    try:
+        assert prev == original
+        assert get_sync_policy() == p
+    finally:
+        set_sync_policy(original)
+
+
+def test_policy_context_manager_restores():
+    original = get_sync_policy()
+    with sync_policy(SyncPolicy(retries=5)):
+        assert get_sync_policy().retries == 5
+    assert get_sync_policy() == original
+
+
+def test_set_policy_type_checked():
+    with pytest.raises(TPUMetricsUserError):
+        set_sync_policy("not a policy")
+
+
+# ------------------------------------------------------------ run_with_retries
+def test_retries_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert run_with_retries(flaky, policy=SyncPolicy(retries=3, backoff_s=0.0)) == "ok"
+    assert calls["n"] == 3
+
+
+def test_no_retry_errors_raise_immediately():
+    calls = {"n": 0}
+
+    def lost():
+        calls["n"] += 1
+        raise SyncPeerLostError("gone")
+
+    with pytest.raises(SyncPeerLostError):
+        run_with_retries(lost, policy=SyncPolicy(retries=5, backoff_s=0.0))
+    assert calls["n"] == 1  # no_retry short-circuits the retry loop
+
+
+def test_user_errors_never_retry():
+    calls = {"n": 0}
+
+    def misuse():
+        calls["n"] += 1
+        raise TPUMetricsUserError("already synced")
+
+    with pytest.raises(TPUMetricsUserError):
+        run_with_retries(misuse, policy=SyncPolicy(retries=5, backoff_s=0.0))
+    assert calls["n"] == 1
+
+
+def test_survivor_lengths_validated():
+    with pytest.raises(ValueError):
+        SyncPeerLostError("gone", survivors=[{}], survivor_counts=[1, 2])
+
+
+# --------------------------------------------------------------- degraded sync
+def _lossy_then_lost(peer, count):
+    attempts = {"n": 0}
+
+    def fn(states, group):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("transient collective timeout")
+        raise SyncPeerLostError("peer 1 lost", survivors=[peer], survivor_counts=[count])
+
+    return fn, attempts
+
+
+def test_degraded_merge_matches_merge_oracle():
+    m = BinaryAccuracy(distributed_available_fn=lambda: True)
+    m.update(*_batch(0))
+    m.update(*_batch(1))
+    local = dict(m.__dict__["_state"])
+    count = m._update_count
+    peer = _host(m.__dict__["_state"])  # a surviving remote twin
+    lossy, attempts = _lossy_then_lost(peer, count)
+
+    probe = rec_mod.Recorder()
+    saved, rec_mod.RECORDER = rec_mod.RECORDER, probe
+    saved_enabled, rec_mod.ENABLED = rec_mod.ENABLED, True
+    try:
+        with sync_policy(SyncPolicy(retries=1, backoff_s=0.0, partial_merge=True)):
+            m.sync(dist_sync_fn=lossy, distributed_available=True)
+    finally:
+        rec_mod.RECORDER = saved
+        rec_mod.ENABLED = saved_enabled
+    assert attempts["n"] == 2
+    assert m._is_synced
+    expected = m._merge_state_dicts(dict(local), dict(peer), count, count)
+    got = _host(m.__dict__["_state"])
+    for k, v in _host(expected).items():
+        np.testing.assert_allclose(got[k], v, rtol=1e-6)
+    kinds = [e["kind"] for e in probe.events]
+    assert "sync_retry" in kinds
+    assert "sync_degraded" in kinds
+    # unsync restores the pre-sync local state
+    m.unsync()
+    restored = _host(m.__dict__["_state"])
+    for k, v in _host(local).items():
+        np.testing.assert_array_equal(restored[k], v)
+
+
+def test_degraded_sync_through_compute():
+    m = BinaryAccuracy(distributed_available_fn=lambda: True)
+    m.update(*_batch(0))
+    peer = _host(m.__dict__["_state"])
+    lossy, _ = _lossy_then_lost(peer, m._update_count)
+    m.dist_sync_fn = lossy
+    with sync_policy(SyncPolicy(retries=1, backoff_s=0.0, partial_merge=True)):
+        value = m.compute()  # degrades inside the sync context instead of raising
+    assert np.isfinite(np.asarray(value))
+    # two identical shards merged: the accuracy is unchanged
+    solo = BinaryAccuracy()
+    solo.update(*_batch(0))
+    np.testing.assert_allclose(np.asarray(value), np.asarray(solo.compute()), rtol=1e-6)
+
+
+def test_strict_policy_reraises_and_clears_cache():
+    m = BinaryAccuracy(distributed_available_fn=lambda: True)
+    m.update(*_batch(0))
+
+    def always_lost(states, group):
+        raise SyncPeerLostError("gone", survivors=[], survivor_counts=[])
+
+    with pytest.raises(SyncPeerLostError):
+        m.sync(dist_sync_fn=always_lost, distributed_available=True)
+    assert m._cache is None
+    assert not m._is_synced
+    m.update(*_batch(1))  # still usable after the failed sync

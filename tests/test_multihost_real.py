@@ -27,6 +27,8 @@ def test_two_process_sync_end_to_end():
         timeout=280,
         env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
     )
+    if proc.returncode != 0 and "Multiprocess computations aren't implemented" in proc.stdout + proc.stderr:
+        pytest.skip("multihost collectives unimplemented on this backend")
     assert proc.returncode == 0, f"multihost smoke failed:\n{proc.stdout}\n{proc.stderr}"
     assert "MULTIHOST_OK" in proc.stdout
     payload = json.loads(proc.stdout[proc.stdout.index("{") : proc.stdout.rindex("}") + 1])

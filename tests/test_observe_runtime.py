@@ -204,6 +204,8 @@ def test_snapshot_schema_is_stable_and_json_able():
     assert set(snap["derived"]) == {
         "jit_cache_hit_rate", "jit_compiles_total", "jit_cache_hits_total",
         "jit_cache_evictions_total", "eager_fallbacks_total",
+        "updates_rolled_back_total", "ckpt_saves_total", "ckpt_restores_total",
+        "sync_retries_total", "sync_degraded_total", "guard_quarantined_total",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():

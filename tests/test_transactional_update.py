@@ -1,0 +1,169 @@
+"""Transactional update contract (DESIGN §14): every update path fully applies
+or leaves ``_state`` / ``_update_count`` / ``_computed`` untouched, and the
+donated jit path keeps a pre-dispatch rescue reference until the executable is
+known-good."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.metric as metric_mod
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+def _host_state(m):
+    return {k: np.asarray(jax.device_get(v)) for k, v in m.__dict__["_state"].items()}
+
+
+def _assert_states_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.rand(32)), jnp.asarray(rng.randint(0, 2, 32))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_jit_cache()
+    yield
+    clear_jit_cache()
+
+
+@pytest.mark.parametrize("depth", ["pre", "mid", "post"])
+def test_eager_update_rolls_back_bit_exactly(depth):
+    jit_update_enabled(False)
+    try:
+        m = BinaryAccuracy()
+        m.update(*_batch(0))
+        before, count = _host_state(m), m._update_count
+        real = m._update_impl
+
+        def faulty(*args, **kwargs):
+            if depth == "mid":
+                state = m.__dict__["_state"]
+                key = next(iter(state))
+                state[key] = jnp.zeros_like(state[key])
+            elif depth == "post":
+                real(*args, **kwargs)
+            raise _Boom(depth)
+
+        m._update_impl = faulty
+        with pytest.raises(_Boom):
+            m.update(*_batch(1))
+        m._update_impl = real
+        _assert_states_equal(before, _host_state(m))
+        assert m._update_count == count
+        # recovery: the next clean update lands
+        m.update(*_batch(1))
+        assert m._update_count == count + 1
+    finally:
+        jit_update_enabled(True)
+
+
+def test_failed_update_restores_compute_cache_and_count():
+    jit_update_enabled(False)
+    try:
+        m = BinaryAccuracy()
+        m.update(*_batch(0))
+        value = m.compute()
+        assert m._computed is not None
+        real = m._update_impl
+        m._update_impl = lambda *a, **k: (_ for _ in ()).throw(_Boom("pre"))
+        with pytest.raises(_Boom):
+            m.update(*_batch(1))
+        m._update_impl = real
+        # the cached compute result survives a failed update
+        assert m._computed is not None
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(value))
+    finally:
+        jit_update_enabled(True)
+
+
+def test_trace_stage_death_rolls_back():
+    m = BinaryAccuracy()
+    before = _host_state(m)
+
+    def dead_lookup(donate=False):
+        raise _Boom("compile died")
+
+    m._lookup_shared_jit = dead_lookup
+    with pytest.raises(_Boom):
+        m.update(*_batch(0))
+    del m.__dict__["_lookup_shared_jit"]
+    _assert_states_equal(before, _host_state(m))
+    assert m._update_count == 0
+    m.update(*_batch(0))  # recovers through the real lookup
+    assert m._update_count == 1
+
+
+def test_probation_dispatch_death_keeps_live_state():
+    m = BinaryAccuracy()
+    before = _host_state(m)
+    real = metric_mod._probation_dispatch
+    metric_mod._probation_dispatch = lambda *a, **k: (_ for _ in ()).throw(_Boom("died"))
+    try:
+        with pytest.raises(_Boom):
+            m.update(*_batch(0))
+    finally:
+        metric_mod._probation_dispatch = real
+    # the donated rescue copy died with the dispatch; the live state did not
+    _assert_states_equal(before, _host_state(m))
+    assert m._update_count == 0
+    m.update(*_batch(0))
+    assert m._update_count == 1
+
+
+def test_steady_state_dispatch_death_rolls_back_and_recovers():
+    m = BinaryAccuracy()
+    m.update(*_batch(0))
+    m.update(*_batch(1))
+    entry = m._jitted_update
+    assert entry is not None and not entry.probation
+    before, count = _host_state(m), m._update_count
+    real_fn = entry.fn
+    entry.fn = lambda *a, **k: (_ for _ in ()).throw(_Boom("dispatch died"))
+    try:
+        with pytest.raises(_Boom):
+            m.update(*_batch(2))
+    finally:
+        entry.fn = real_fn
+    _assert_states_equal(before, _host_state(m))
+    assert m._update_count == count
+    m.update(*_batch(2))
+    oracle = BinaryAccuracy()
+    for s in (0, 1, 2):
+        oracle.update(*_batch(s))
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(oracle.compute()), rtol=1e-6)
+
+
+def test_rollback_is_observable():
+    from metrics_tpu.observe import recorder as rec_mod
+
+    probe = rec_mod.Recorder()
+    saved, rec_mod.RECORDER = rec_mod.RECORDER, probe
+    saved_enabled, rec_mod.ENABLED = rec_mod.ENABLED, True
+    try:
+        jit_update_enabled(False)
+        m = BinaryAccuracy()
+        real = m._update_impl
+        m._update_impl = lambda *a, **k: (_ for _ in ()).throw(_Boom("x"))
+        with pytest.raises(_Boom):
+            m.update(*_batch(0))
+        m._update_impl = real
+    finally:
+        jit_update_enabled(True)
+        rec_mod.RECORDER = saved
+        rec_mod.ENABLED = saved_enabled
+    assert probe.counters.get(("update_rolled_back", "BinaryAccuracy"), 0) == 1
+    kinds = [e["kind"] for e in probe.events]
+    assert "update_rolled_back" in kinds

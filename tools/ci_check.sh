@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
-# + donlint), the donation three-way cross-check, and the perf cost ratchet —
-# all via `lint_metrics.py --all`, which aggregates their exit codes.
+# + donlint), the donation three-way cross-check, the chaos fault-injection
+# harness, and the perf cost ratchet — all via `lint_metrics.py --all`, which
+# aggregates their exit codes.
 #
 #   tools/ci_check.sh            # text report, exit 0 clean / 1 violations / 2 usage
 #   tools/ci_check.sh --json     # one machine-readable document on stdout
