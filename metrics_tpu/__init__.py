@@ -118,6 +118,7 @@ _LAZY_EXPORTS = {
     "WordErrorRate": "metrics_tpu.text",
     "WordInfoLost": "metrics_tpu.text",
     "WordInfoPreserved": "metrics_tpu.text",
+    "StreamEngine": "metrics_tpu.engine",
     "BootStrapper": "metrics_tpu.wrappers",
     "ClasswiseWrapper": "metrics_tpu.wrappers",
     "MetricTracker": "metrics_tpu.wrappers",
@@ -127,7 +128,7 @@ _LAZY_EXPORTS = {
 }
 
 _LAZY_SUBPACKAGES = (
-    "audio", "classification", "clustering", "detection", "functional", "image",
+    "audio", "classification", "clustering", "detection", "engine", "functional", "image",
     "integration", "models", "multimodal", "nominal", "observe", "ops", "parallel",
     "regression", "resilience", "retrieval", "segmentation", "shape", "text", "utils", "wrappers",
 )

@@ -9,18 +9,23 @@ Three static passes share one engine and one exit-code contract:
 * ``donlint``  — donated-buffer escape/alias rules ML001–ML006, baselined in
   ``tools/donlint_baseline.json``
 
-Three dynamic passes ride the same selection/exit-code contract:
+Four dynamic passes ride the same selection/exit-code contract:
 
 * ``donation`` — 3-step donate-enabled update loops cross-checking static
   donlint verdicts, ``costs.py`` eligibility, and runtime buffer deletion
   (:mod:`metrics_tpu.analysis.donation_contracts`), disagreements baselined in
   the ``donation`` section of ``tools/donlint_baseline.json``
+* ``fleet`` — StreamEngine lifecycle contracts per registry class: churning
+  4-slot buckets vs per-instance oracles (state bit-exactness, masked-row
+  isolation, donation consumption, merge;
+  :mod:`metrics_tpu.analysis.fleet_contracts`), disagreements baselined in
+  ``tools/fleet_baseline.json``
 * ``chaos`` — fault-injection contract harness (transactional updates,
   dispatch death, NaN quarantine, corrupt checkpoints, dropped sync peers;
   :mod:`metrics_tpu.analysis.chaos_contracts`), violations baselined in
   ``tools/chaos_baseline.json``
-* ``perf`` — XLA cost profiling of compiled metric updates
-  (:mod:`metrics_tpu.observe.profile`), ratcheted against
+* ``perf`` — XLA cost profiling of compiled metric updates + the 64-stream
+  fleet smoke (:mod:`metrics_tpu.observe.profile`), ratcheted against
   ``tools/perf_baseline.json``
 
 Select with ``--pass <name>`` or run everything with ``--all`` (the CI shape:
@@ -65,9 +70,10 @@ _PASSES: Dict[str, Dict[str, object]] = {
 }
 
 # dynamic passes: no rule codes, run programs instead of parsing them.
-# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, chaos injects
-# the full fault suite per class, perf lowers the whole registry).
-_DYNAMIC = ("donation", "chaos", "perf")
+# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, fleet churns a
+# 4-slot StreamEngine bucket per class, chaos injects the full fault suite per
+# class, perf lowers the whole registry + runs the fleet smoke).
+_DYNAMIC = ("donation", "fleet", "chaos", "perf")
 
 
 def _dynamic_runner(name: str):
@@ -81,6 +87,10 @@ def _dynamic_runner(name: str):
         from metrics_tpu.analysis.chaos_contracts import run_chaos_check  # noqa: PLC0415
 
         return run_chaos_check
+    if name == "fleet":
+        from metrics_tpu.analysis.fleet_contracts import run_fleet_check  # noqa: PLC0415
+
+        return run_fleet_check
     from metrics_tpu.analysis.donation_contracts import run_donation_check  # noqa: PLC0415
 
     return run_donation_check
@@ -101,8 +111,8 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=sorted([*_PASSES, *_DYNAMIC]),
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
-                   help="run every pass (jitlint + distlint + donlint + donation + chaos "
-                        "+ perf) in one invocation")
+                   help="run every pass (jitlint + distlint + donlint + donation + fleet "
+                        "+ chaos + perf) in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")

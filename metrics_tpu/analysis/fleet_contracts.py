@@ -1,0 +1,374 @@
+"""Dynamic fleet-contract harness: every registry class through a churning bucket.
+
+For every jit-eligible class in the profile registry this drives a 4-slot
+``StreamEngine`` bucket through the full multi-tenant lifecycle — concurrent
+sessions, an idle (masked-off) session, mid-run expiry into a recycled slot —
+and cross-checks the engine-resident rows against independent per-instance
+oracle metrics fed the identical batches:
+
+* **churn** — a session expired mid-stream computes bit-identically to its
+  oracle (expiry slices the row out of the stack; its compute runs eagerly);
+* **masked rows** — a tick where a session submits nothing must leave its row
+  (and the padded virgin row) bit-identical: masked rows contribute zero;
+* **donation** — in steady state the bucket's stacked buffers held across a
+  flush must actually be consumed (``jax.Array.is_deleted``) when the class is
+  donation-eligible: a donating program that consumes nothing is a silent
+  steady-state allocation;
+* **merge** — two expired engine-resident states merged via ``merge_state``
+  agree with the same merge of their oracles;
+* **values** — final live states are bit-identical and computes agree.
+
+Per-class verdicts:
+
+* ``EXACT`` — states bit-identical AND every compute bit-identical;
+* ``CLOSE`` — states bit-identical, computes within float tolerance (the
+  bucket-wide vmapped compute may reassociate float reductions);
+* ``LOOSE`` — the class never formed a bucket (no stable config fingerprint or
+  jit-ineligible call signature); the engine fell back to per-session eager
+  updates which still agree with the oracle;
+* ``DIVERGED`` — any state/value disagreement or masked-row contamination;
+* ``ERROR:<why>`` — harness failure or a broken donation promise.
+
+``DIVERGED``/``ERROR`` fail the pass unless baselined (with a justification
+string) in the ``fleet`` section of ``tools/fleet_baseline.json`` (expected
+empty). Runs as the ``fleet`` pass of ``tools/lint_metrics --all`` and
+standalone via ``python -m metrics_tpu.analysis.fleet_contracts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "FleetResult",
+    "check_fleet_case",
+    "diff_fleet_contract_baseline",
+    "fleet_cases",
+    "main",
+    "run_fleet_check",
+]
+
+_DEFAULT_BASELINE = os.path.join("tools", "fleet_baseline.json")
+_CAPACITY = 4  # 3 live sessions + 1 padded virgin row
+_RTOL, _ATOL = 1e-5, 1e-7
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetResult:
+    name: str
+    verdict: str  # EXACT | CLOSE | LOOSE | DIVERGED | ERROR:<why>
+    donation: str  # DONATED | NON_DONATING | EAGER | NOOP | n/a
+    detail: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.verdict in ("EXACT", "CLOSE", "LOOSE")
+
+    def render(self) -> str:
+        mark = "ok " if self.ok else "FAIL"
+        return (
+            f"{mark} {self.name}: {self.verdict} donation={self.donation}"
+            + (f" ({self.detail})" if self.detail else "")
+        )
+
+
+def fleet_cases() -> List[Any]:
+    """The jit-eligible slice of the profile registry (same gate as costs.py)."""
+    from metrics_tpu.observe.costs import PROFILE_CASES
+
+    cases = []
+    for case in PROFILE_CASES:
+        try:
+            m = case.ctor()
+        except Exception:  # a broken ctor is the profiler's problem, not ours
+            continue
+        if type(m).__jit_ineligible__ or m._has_list_state():
+            continue
+        cases.append(case)
+    return cases
+
+
+def _leaves(value: Any) -> List[Any]:
+    import jax
+
+    return jax.tree_util.tree_leaves(value)
+
+
+def _compare(a: Any, b: Any) -> str:
+    """'' if pytrees bit-identical, 'close' within tolerance, 'diverged' else."""
+    import numpy as np
+
+    la, lb = _leaves(a), _leaves(b)
+    if len(la) != len(lb):
+        return "diverged"
+    worst = ""
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        if xa.shape != ya.shape:
+            return "diverged"
+        if np.array_equal(xa, ya):
+            continue
+        if np.allclose(xa, ya, rtol=_RTOL, atol=_ATOL, equal_nan=True):
+            worst = "close"
+        else:
+            return "diverged"
+    return worst
+
+
+def _row(engine: Any, sid: Any) -> Dict[str, Any]:
+    """A session's engine-resident state, wherever it lives right now."""
+    sess = engine._sessions[sid]
+    if sess.bucket is None:
+        return dict(sess.metric._state)
+    return {k: v[sess.slot] for k, v in sess.bucket.stacked.items()}
+
+
+def check_fleet_case(case: Any) -> FleetResult:
+    """One class through the churning 4-slot bucket; never raises."""
+    import jax
+    import numpy as np
+
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.observe.costs import _rng
+
+    _FLEET_JIT_CACHE.clear()
+    try:
+        rng = _rng(case)
+        engine = StreamEngine(initial_capacity=_CAPACITY)
+        sids = [engine.add_session(case.ctor()) for _ in range(3)]
+        oracles = {sid: case.ctor() for sid in sids}
+
+        def feed(active: Sequence[Any]) -> None:
+            for sid in active:
+                args = case.batch(rng)
+                engine.submit(sid, *args)
+                oracles[sid].update(*args)
+            engine.tick()
+
+        feed(sids)  # tick 1: trace + compile (+ donation probation)
+        bucketed = engine._sessions[sids[0]].bucket is not None
+
+        # donation: steady-state flush must consume the stacked buffers iff the
+        # class promises donation (probation cleared by tick 1)
+        donation = "EAGER"
+        if bucketed:
+            bucket = engine._sessions[sids[0]].bucket
+            held = {k: v for k, v in bucket.stacked.items() if isinstance(v, jax.Array)}
+            feed(sids)  # tick 2
+            deleted = sorted(k for k, v in held.items() if v.is_deleted())
+            if engine._sessions[sids[0]].bucket is None:
+                bucketed, donation = False, "EAGER"  # demoted mid-flight
+            elif case.ctor()._donation_eligible():
+                donation = "DONATED" if deleted else "NOOP"
+            else:
+                donation = "NON_DONATING"
+                if deleted:
+                    return FleetResult(
+                        case.name, "ERROR:nondonating-deleted", donation,
+                        f"non-donating flush deleted: {', '.join(deleted)}",
+                    )
+            if donation == "NOOP":
+                return FleetResult(
+                    case.name, "ERROR:donate-noop", donation,
+                    "donating bucket flush ran but every held stacked buffer survived",
+                )
+        else:
+            feed(sids)  # tick 2, loose path
+
+        # masked rows: sid[1] sits a tick out; its row and the virgin padded row
+        # must come through the masked dispatch bit-identical
+        idle = sids[1]
+        before_idle = {k: np.asarray(v) for k, v in _row(engine, idle).items()}
+        before_virgin = None
+        if bucketed:
+            bucket = engine._sessions[sids[0]].bucket
+            free_slot = bucket.free[-1] if bucket.free else None
+            if free_slot is not None:
+                before_virgin = {k: np.asarray(v[free_slot]) for k, v in bucket.stacked.items()}
+        feed([sids[0], sids[2]])  # tick 3: masked flush
+        after_idle = {k: np.asarray(v) for k, v in _row(engine, idle).items()}
+        for k, ref in before_idle.items():
+            if not np.array_equal(after_idle[k], ref):
+                return FleetResult(
+                    case.name, "DIVERGED", donation, f"masked row mutated: state '{k}'"
+                )
+        if before_virgin is not None:
+            bucket = engine._sessions[sids[0]].bucket
+            for k, ref in before_virgin.items():
+                if not np.array_equal(np.asarray(bucket.stacked[k][free_slot]), ref):
+                    return FleetResult(
+                        case.name, "DIVERGED", donation, f"padded virgin row mutated: state '{k}'"
+                    )
+
+        # churn: expire mid-stream, verify the retiree, recycle its slot
+        retired = engine.expire(idle)
+        churn_cmp = _compare(retired.compute(), oracles[idle].compute())
+        if churn_cmp == "diverged":
+            return FleetResult(case.name, "DIVERGED", donation, "expired session diverged from oracle")
+        replacement = engine.add_session(case.ctor())
+        oracles[replacement] = case.ctor()
+        live = [sids[0], sids[2], replacement]
+        feed(live)  # tick 4: recycled slot in the masked dispatch
+
+        # values: engine-resident states bit-exact, computes agree
+        verdict = "EXACT" if not churn_cmp else "CLOSE"
+        for sid in live:
+            for k, ref in oracles[sid]._state.items():
+                if not np.array_equal(np.asarray(_row(engine, sid)[k]), np.asarray(ref)):
+                    return FleetResult(
+                        case.name, "DIVERGED", donation,
+                        f"live state '{k}' diverged from oracle (session {sid})",
+                    )
+            cmp = _compare(engine.compute(sid), oracles[sid].compute())
+            if cmp == "diverged":
+                return FleetResult(
+                    case.name, "DIVERGED", donation, f"live compute diverged (session {sid})"
+                )
+            if cmp == "close":
+                verdict = "CLOSE"
+
+        # merge: two expired engine-resident states vs the same merge of oracles
+        m_a, m_b = engine.expire(sids[0]), engine.expire(sids[2])
+        o_a, o_b = oracles[sids[0]], oracles[sids[2]]
+        try:
+            o_a.merge_state(o_b)
+        except Exception as exc:  # merge unsupported: merge_contracts' turf
+            merge_detail = f"merge skipped ({type(exc).__name__})"
+        else:
+            m_a.merge_state(m_b)
+            merge_cmp = _compare(m_a.compute(), o_a.compute())
+            if merge_cmp == "diverged":
+                return FleetResult(
+                    case.name, "DIVERGED", donation, "merged engine-resident states diverged"
+                )
+            if merge_cmp == "close":
+                verdict = "CLOSE"
+            merge_detail = ""
+
+        if not bucketed:
+            verdict = "LOOSE"
+        return FleetResult(case.name, verdict, donation, merge_detail)
+    except Exception as exc:  # noqa: BLE001 — every failure is a reportable verdict
+        return FleetResult(case.name, f"ERROR:{type(exc).__name__}", "n/a", str(exc)[:200])
+    finally:
+        _FLEET_JIT_CACHE.clear()
+
+
+def collect_fleet_report(cases: Optional[Sequence[Any]] = None) -> List[FleetResult]:
+    return [check_fleet_case(c) for c in (cases if cases is not None else fleet_cases())]
+
+
+# ------------------------------------------------------------------- baseline
+def load_fleet_contract_baseline(path: str) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): str(v) for k, v in load_baseline_section(path, "fleet").items()}
+
+
+def write_fleet_contract_baseline(path: str, results: Sequence[FleetResult]) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
+    fleet = {
+        r.name: f"UNJUSTIFIED: {r.verdict} donation={r.donation}"
+        for r in sorted(results, key=lambda r: r.name)
+        if not r.ok
+    }
+    write_baseline_section(
+        path,
+        "fleet",
+        fleet,  # type: ignore[arg-type]
+        "fleet-contract baseline — StreamEngine lifecycle disagreements "
+        "(class -> justification; expected empty). Regenerate with "
+        "`python tools/lint_metrics.py --pass fleet --update-baseline`.",
+    )
+    return fleet
+
+
+def diff_fleet_contract_baseline(
+    results: Sequence[FleetResult], baseline: Dict[str, str]
+) -> Tuple[List[FleetResult], List[str]]:
+    """Split into (failures, stale_baseline_keys): unbaselined disagreements fail."""
+    failures = [r for r in results if not r.ok and r.name not in baseline]
+    failing = {r.name for r in results if not r.ok}
+    observed = {r.name for r in results}
+    stale = sorted(name for name in baseline if name not in failing or name not in observed)
+    return failures, stale
+
+
+def run_fleet_check(
+    root: str,
+    baseline_path: Optional[str] = None,
+    update_baseline: bool = False,
+    quiet: bool = False,
+    report: Optional[Dict[str, Any]] = None,
+) -> int:
+    """The ``fleet`` pass of ``lint_metrics --all``: churn every class, one verdict."""
+    path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
+    results = collect_fleet_report()
+    if update_baseline:
+        fleet = write_fleet_contract_baseline(path, results)
+        if not quiet:
+            print(f"fleet: baseline written to {path} ({len(fleet)} disagreement(s))")
+        return 0
+    failures, stale = diff_fleet_contract_baseline(results, load_fleet_contract_baseline(path))
+    if report is not None:
+        # the caller owns stdout (one JSON document) — collect, don't print
+        report.update(
+            {
+                "cases": len(results),
+                "failures": [r.render() for r in failures],
+                "baselined": sum(1 for r in results if not r.ok) - len(failures),
+                "stale_baseline_keys": stale,
+                "verdicts": {r.name: r.verdict for r in results},
+            }
+        )
+        return 1 if failures else 0
+    for r in failures:
+        print(f"fleet: {r.render()}")
+    if not quiet:
+        for key in stale:
+            print(f"fleet: stale baseline entry: {key}")
+        exact = sum(1 for r in results if r.verdict == "EXACT")
+        loose = sum(1 for r in results if r.verdict == "LOOSE")
+        donated = sum(1 for r in results if r.donation == "DONATED")
+        print(
+            f"fleet: {sum(1 for r in results if r.ok)}/{len(results)} classes agree "
+            f"({exact} exact, {loose} loose, {donated} donated at runtime), "
+            f"{len(failures)} failure(s), {len(stale)} stale"
+        )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="fleet-contracts",
+        description="StreamEngine lifecycle contracts per registry class: churning "
+        "4-slot buckets cross-checked against per-instance oracles (state "
+        "bit-exactness, masked-row isolation, donation consumption, merge).",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="fleet baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current disagreements as the new baseline and exit 0")
+    p.add_argument("-v", "--verbose", action="store_true", help="print every class verdict")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.verbose:
+        for r in collect_fleet_report():
+            print(r.render())
+    return run_fleet_check(
+        root,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,27 @@
+"""metrics_tpu.engine — the multi-tenant fleet runtime (DESIGN §15).
+
+Two layers:
+
+* :mod:`metrics_tpu.engine.core` — the shared vmapped-dispatch machinery
+  (gather / stacked / masked modes, donating jit, :class:`ProgramCache` LRUs
+  with compile/hit/evict telemetry) that both the replica engine
+  (``wrappers/replicated.py``) and the fleet engine compile through.
+* :mod:`metrics_tpu.engine.stream` — :class:`StreamEngine`: arbitrary live
+  ``Metric`` instances bucketed by ``(class, config fingerprint, state
+  avals)``, stacked into padded leading-axis pytrees, and driven at one
+  donated dispatch per bucket per tick with mid-stream session churn and zero
+  recompiles within padded capacity.
+
+``metrics_tpu.engine.smoke`` holds the 64-stream CI smoke the perf ratchet
+runs (``tools/ci_check.sh`` → perf pass → ``run_fleet_smoke``).
+"""
+
+from metrics_tpu.engine.core import ProgramCache, engine_compute, engine_update
+from metrics_tpu.engine.stream import StreamEngine
+
+__all__ = [
+    "ProgramCache",
+    "StreamEngine",
+    "engine_compute",
+    "engine_update",
+]

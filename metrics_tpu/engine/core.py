@@ -1,0 +1,227 @@
+"""Shared vmapped-dispatch core for the replica and fleet engines (DESIGN §12, §15).
+
+Both engines reduce N logical metric instances to ONE XLA dispatch by stacking
+their states into a leading-axis pytree and running a jitted ``jax.vmap`` of
+the pure update/compute over it. What differs is only how rows relate to the
+incoming batch:
+
+- ``gather``: every row sees the SAME batch through its own integer index row
+  (bootstrap resampling) — state and index rows map, the batch broadcasts.
+- ``stacked``: every row sees its own slice of a batch that already carries a
+  leading row axis (multioutput).
+- ``masked``: every row sees its own batch slice AND a boolean ``keep`` flag;
+  rows with ``keep == False`` return their old state leaves bit-exactly
+  (``jnp.where`` on the scalar flag selects whole leaves), so padding rows in
+  a partially-occupied fleet bucket can never be contaminated by staging
+  garbage. This is the StreamEngine mode (DESIGN §15).
+
+Compiled programs live in :class:`ProgramCache` LRUs — one per engine kind —
+keyed on the template's static config plus everything that forces a retrace
+(row count, mode, argument structure, batch avals for the masked mode, the
+donation decision). Every lookup reports ``<kind>_compile`` / ``<kind>_hit`` /
+``<kind>_evict`` observe counters, and :func:`metrics_tpu.clear_jit_cache`
+drops both caches alongside the per-metric shared cache.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import (
+    Metric,
+    _CompiledUpdate,
+    _named_for_profiler,
+    _probation_dispatch,
+    _squeeze_if_scalar,
+)
+from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+
+__all__ = ["ProgramCache", "TRACER_ERRORS", "engine_compute", "engine_update"]
+
+# Trace-time failures only: they abort before execution, so donated stacked
+# buffers are still intact and the caller can safely fall back to a loop (or,
+# for the fleet engine, demote the bucket's sessions to loose eager metrics).
+TRACER_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.ConcretizationTypeError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.UnexpectedTracerError,
+    jax.errors.TracerIntegerConversionError,
+    TraceIneligibleError,
+)
+
+
+class ProgramCache(OrderedDict):
+    """LRU of compiled vmapped engine programs with observe-visible economics.
+
+    ``kind`` namespaces the counters: the replica cache reports
+    ``replica_compile/hit/evict``, the fleet cache ``fleet_compile/hit/evict``.
+    Eviction events carry the evicted program's engine label so a thrashing
+    cache is attributable, not silent.
+    """
+
+    def __init__(self, kind: str, max_entries: int) -> None:
+        super().__init__()
+        self.kind = kind
+        self.max_entries = max_entries
+        self._labels: Dict[Any, str] = {}
+
+    def lookup(self, key: Any, build: Callable[[], _CompiledUpdate], label: str, n: int) -> _CompiledUpdate:
+        entry = self.get(key)
+        if entry is None:
+            entry = build()
+            self[key] = entry
+            self._labels[key] = label
+            _observe.note_engine_compile(self.kind, label, n)
+            if len(self) > self.max_entries:
+                evicted_key, _ = self.popitem(last=False)
+                _observe.note_engine_evict(self.kind, self._labels.pop(evicted_key, "?"))
+        else:
+            self.move_to_end(key)
+            _observe.note_engine_hit(self.kind, label)
+        return entry
+
+    def clear(self) -> None:  # type: ignore[override]
+        super().clear()
+        self._labels.clear()
+
+
+# The replica cache object is re-exported by wrappers/replicated.py under its
+# historical name; the fleet cache is sized for many (class, capacity, batch
+# signature) buckets since each live signature is one executable.
+_REPLICA_JIT_CACHE = ProgramCache("replica", 64)
+_FLEET_JIT_CACHE = ProgramCache("fleet", 256)
+
+
+def _batch_leaf_sig(v: Any) -> Tuple[Any, ...]:
+    if hasattr(v, "shape"):
+        return ("arr", tuple(v.shape), str(getattr(v, "dtype", "")))
+    if v is None:
+        return ("none",)
+    # Python scalars trace as weak-typed operands under jit: the value never
+    # shapes the program, so key by type to avoid one cache entry per value.
+    return ("pyval", type(v).__name__)
+
+
+def engine_update(
+    template: Metric,
+    n: int,
+    stacked: Dict[str, Any],
+    args: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+    *,
+    gather_idx: Optional[jax.Array] = None,
+    mask: Optional[jax.Array] = None,
+    cache: ProgramCache = _REPLICA_JIT_CACHE,
+    label: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Run one vmapped update over ``n`` stacked row states; returns the new stack.
+
+    Exactly one of ``gather_idx`` / ``mask`` may be given. ``gather_idx``
+    (shape ``(n, batch)`` integer rows) selects each row's resample of the
+    shared batch inside the traced body. ``mask`` (shape ``(n,)`` bool) runs
+    the masked fleet mode: array arguments carry a leading row axis sized to
+    the padded capacity, and rows where ``mask`` is False keep their prior
+    state leaves bit-exactly. Without either, array arguments are expected to
+    already carry a leading row axis (stacked mode).
+    """
+    if gather_idx is not None and mask is not None:
+        raise ValueError("engine_update: gather_idx and mask are mutually exclusive")
+    mode = "gather" if gather_idx is not None else ("masked" if mask is not None else "stacked")
+    kw_names = tuple(sorted(kwargs))
+    flat = tuple(args) + tuple(kwargs[k] for k in kw_names)
+    arr_flags = tuple(hasattr(a, "shape") for a in flat)
+    nargs = len(args)
+    donate = template._donation_eligible()
+    if label is None:
+        label = f"{type(template).__name__}x{n}"
+    if mode == "masked":
+        # the masked cache key pins full batch avals (not just array-ness), so a
+        # `fleet_compile` count IS an XLA compile count: within one entry every
+        # dispatch replays the same traced executable — the recompile-pin tests
+        # and the perf ratchet's dispatches-per-tick column rely on this.
+        batch_sig = tuple(_batch_leaf_sig(a) for a in flat)
+        key = (template._jit_cache_key(), n, mode, nargs, kw_names, batch_sig, donate)
+    else:
+        key = (template._jit_cache_key(), n, mode, nargs, kw_names, arr_flags, donate)
+
+    def build() -> _CompiledUpdate:
+        # a pristine clone is the traced representative, keeping user instances
+        # (and their accumulated states) out of the module-global cache
+        rep = template.clone()
+        rep.reset()
+        upd = _named_for_profiler(rep._functional_update, f"{type(rep).__name__}_{cache.kind}_update")
+
+        if mode == "gather":
+
+            def one(st, idx, *leaves):
+                sel = [jnp.take(a, idx, axis=0) if f else a for a, f in zip(leaves, arr_flags)]
+                return upd(st, *sel[:nargs], **dict(zip(kw_names, sel[nargs:])))
+
+            in_axes = (0, 0) + (None,) * len(flat)
+        elif mode == "masked":
+
+            def one(st, keep, *leaves):
+                new = upd(st, *leaves[:nargs], **dict(zip(kw_names, leaves[nargs:])))
+                # scalar-predicate where selects whole old leaves for inactive
+                # rows, so a padding row's state passes through bit-exactly no
+                # matter what the staging buffers held at its index
+                return {k: jnp.where(keep, new[k], st[k]) for k in st}
+
+            in_axes = (0, 0) + tuple(0 if f else None for f in arr_flags)
+        else:
+
+            def one(st, *leaves):
+                return upd(st, *leaves[:nargs], **dict(zip(kw_names, leaves[nargs:])))
+
+            in_axes = (0,) + tuple(0 if f else None for f in arr_flags)
+        return _CompiledUpdate(jax.vmap(one, in_axes=in_axes), donate)
+
+    entry = cache.lookup(key, build, label, n)
+    if entry.probation and entry.donate:
+        # the dispatch is not yet known-good: donate fresh copies so the engine's
+        # live stacked pytree survives as the rescue reference if the first
+        # dispatch dies mid-flight (transactional-update contract, DESIGN §14)
+        stacked = {k: jnp.copy(v) for k, v in stacked.items()}
+    if mode == "gather":
+        call_args: Tuple[Any, ...] = (stacked, gather_idx) + flat
+    elif mode == "masked":
+        call_args = (stacked, mask) + flat
+    else:
+        call_args = (stacked,) + flat
+    if entry.probation:
+        return _probation_dispatch(entry, label, call_args, {})
+    return entry(*call_args)
+
+
+def engine_compute(
+    template: Metric,
+    n: int,
+    stacked: Dict[str, Any],
+    *,
+    cache: ProgramCache = _REPLICA_JIT_CACHE,
+    label: Optional[str] = None,
+) -> Any:
+    """Vmapped compute over the stacked states: per-row values with a leading axis.
+
+    Never donates — compute must leave the stacked state usable for further
+    updates. ``_squeeze_if_scalar`` runs inside the mapped body so each row's
+    value matches what its ``Metric.compute()`` would have returned.
+    """
+    if label is None:
+        label = f"{type(template).__name__}x{n}"
+    key = (template._jit_cache_key(), n, "compute")
+
+    def build() -> _CompiledUpdate:
+        rep = template.clone()
+        rep.reset()
+        comp = _named_for_profiler(rep._functional_compute, f"{type(rep).__name__}_{cache.kind}_compute")
+        return _CompiledUpdate(jax.vmap(lambda st: _squeeze_if_scalar(comp(st)), in_axes=(0,)), False)
+
+    entry = cache.lookup(key, build, label, n)
+    return entry(stacked)

@@ -1,0 +1,206 @@
+"""Small-fleet CI smoke: 64 churning streams, ratcheted dispatch economics.
+
+The StreamEngine's whole value is a pair of invariants that are easy to break
+silently — a bucketing-key regression splits one bucket into many dispatches;
+a cache-key regression recompiles on every arrival. This module runs a small
+heterogeneous fleet (MulticlassAccuracy + BinaryAUROC streams, mid-run churn)
+under a private telemetry probe and reduces it to three numbers the perf
+ratchet pins in the ``fleet`` section of ``tools/perf_baseline.json``:
+
+* ``dispatches_per_bucket_tick`` — update dispatches over bucket flushes;
+  1.0 means every touched bucket cost exactly one XLA dispatch per tick;
+* ``update_compiles_per_bucket`` — compiled update programs per bucket; 1
+  means arrival/expiry churn within padded capacity never recompiled;
+* ``bit_exact`` — every stream's accumulated *state* (live and expired) is
+  bit-identical to a per-instance oracle metric fed the identical batches,
+  expired streams' computed values are bit-identical too (they compute on
+  their own sliced rows), and live computed values agree to float ulp (the
+  bucket-wide vmapped compute may reassociate float reductions, so last-ulp
+  wobble vs the eager oracle is expected and tolerated).
+
+Runs as part of the ``perf`` pass of ``tools/lint_metrics.py --all``, i.e. on
+every ``tools/ci_check.sh`` invocation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.observe import recorder as rec_mod
+
+__all__ = [
+    "diff_fleet_baseline",
+    "load_fleet_baseline",
+    "run_fleet_smoke",
+    "write_fleet_baseline",
+]
+
+_RATCHETED_MAX = ("dispatches_per_bucket_tick", "update_compiles_per_bucket")
+
+
+def _stream_ctors() -> List[Tuple[str, Any, Any]]:
+    """(kind, metric ctor, batch fn) per heterogeneous stream family."""
+    from metrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
+
+    def acc_batch(rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+        return rng.integers(0, 8, size=32), rng.integers(0, 8, size=32)
+
+    def auroc_batch(rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+        return rng.random(32, dtype=np.float32), rng.integers(0, 2, size=32)
+
+    return [
+        ("acc", lambda: MulticlassAccuracy(num_classes=8), acc_batch),
+        ("auroc", lambda: BinaryAUROC(thresholds=16), auroc_batch),
+    ]
+
+
+def run_fleet_smoke(
+    n_streams: int = 64, ticks: int = 6, churn: int = 8, seed: int = 0
+) -> Dict[str, Any]:
+    """Drive the smoke fleet and return its observed dispatch economics.
+
+    Runs under a private Recorder (the process-wide telemetry state is saved
+    and restored), with the fleet program cache cleared so compile counts
+    start from zero.
+    """
+    families = _stream_ctors()
+    per_family = n_streams // len(families)
+    # capacity sized so churn stays within the padded stack: the smoke pins
+    # the zero-recompile claim, not the growth path (tests cover doubling)
+    capacity = 1 << (per_family - 1).bit_length()
+    rng = np.random.default_rng(seed)
+
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    probe = rec_mod.Recorder()
+    rec_mod.RECORDER, rec_mod.ENABLED = probe, True
+    _FLEET_JIT_CACHE.clear()
+    try:
+        engine = StreamEngine(initial_capacity=capacity)
+        oracles: Dict[Any, Any] = {}
+        batchers: Dict[Any, Any] = {}
+        kinds: Dict[Any, str] = {}
+        retired_exact = True
+        for kind, ctor, batch in families:
+            for _ in range(per_family):
+                sid = engine.add_session(ctor())
+                oracles[sid] = ctor()
+                batchers[sid] = batch
+                kinds[sid] = kind
+        next_family = 0
+        for t in range(ticks):
+            for sid in list(oracles):
+                args = batchers[sid](rng)
+                engine.submit(sid, *args)
+                oracles[sid].update(*args)
+            engine.tick()
+            if t == ticks // 2:
+                # mid-run churn: retire `churn` sessions round-robin across the
+                # families (so no bucket outgrows its padded capacity — the
+                # smoke pins zero-recompile churn), verify the retirees against
+                # their oracles, and arrive replacements into the holes
+                by_kind = {k: [s for s in oracles if kinds[s] == k] for k, _, _ in families}
+                doomed: List[Any] = []
+                while len(doomed) < churn:
+                    pool = by_kind[families[len(doomed) % len(families)][0]]
+                    doomed.append(pool.pop(0))
+                for sid in doomed:
+                    retired = engine.expire(sid)
+                    if not np.array_equal(np.asarray(retired.compute()), np.asarray(oracles[sid].compute())):
+                        retired_exact = False
+                    del oracles[sid], batchers[sid], kinds[sid]
+                for _ in range(churn):
+                    kind, ctor, batch = families[next_family % len(families)]
+                    next_family += 1
+                    sid = engine.add_session(ctor())
+                    oracles[sid] = ctor()
+                    batchers[sid] = batch
+                    kinds[sid] = kind
+        values = engine.compute_all()
+        live_exact = True
+        for sid, oracle in oracles.items():
+            sess = engine._sessions[sid]
+            row = (
+                sess.metric._state
+                if sess.bucket is None
+                else {k: v[sess.slot] for k, v in sess.bucket.stacked.items()}
+            )
+            for k, ref in oracle._state.items():
+                if not np.array_equal(np.asarray(row[k]), np.asarray(ref)):
+                    live_exact = False
+            if not np.allclose(np.asarray(values[sid]), np.asarray(oracle.compute()), rtol=1e-6, atol=0.0):
+                live_exact = False
+        counters: Dict[str, Dict[str, int]] = {}
+        for (name, label), v in probe.counters.items():
+            counters.setdefault(name, {})[label] = v
+    finally:
+        rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+        _FLEET_JIT_CACHE.clear()
+
+    update_compiles = {
+        label: v for label, v in counters.get("fleet_compile", {}).items() if not label.endswith(":compute")
+    }
+    n_buckets = len(counters.get("fleet_flush", {}))
+    dispatches = sum(counters.get("fleet_dispatch", {}).values())
+    flushes = sum(counters.get("fleet_flush", {}).values())
+    return {
+        "streams": n_streams,
+        "buckets": n_buckets,
+        "ticks": ticks,
+        "churn": churn,
+        "dispatches_per_bucket_tick": round(dispatches / flushes, 4) if flushes else None,
+        "update_compiles_per_bucket": max(update_compiles.values(), default=0),
+        "loose_updates": sum(counters.get("fleet_loose_update", {}).values()),
+        "bit_exact": bool(live_exact and retired_exact),
+    }
+
+
+# ------------------------------------------------------------------ baseline IO
+def load_fleet_baseline(path: str) -> Dict[str, Any]:
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return dict(load_baseline_section(path, "fleet"))
+
+
+def write_fleet_baseline(path: str, observed: Dict[str, Any]) -> Dict[str, Any]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
+    pinned = {k: observed[k] for k in ("streams", "buckets", *_RATCHETED_MAX)}
+    write_baseline_section(
+        path,
+        "fleet",
+        pinned,
+        "perf baseline — XLA cost model per compiled metric update ('cost') and the "
+        "fleet-engine dispatch economy ('fleet'). Regenerate with "
+        "`python tools/profile_metrics.py --update-baseline`.",
+    )
+    return pinned
+
+
+def diff_fleet_baseline(observed: Dict[str, Any], baseline: Dict[str, Any]) -> Tuple[List[str], List[str], List[str]]:
+    """(regressions, stale, new) for the fleet smoke, mirroring the cost ratchet."""
+    regressions: List[str] = []
+    stale: List[str] = []
+    new: List[str] = []
+    if not observed.get("bit_exact", False):
+        regressions.append("fleet: smoke fleet diverged from the per-instance oracle")
+    if observed.get("loose_updates", 0):
+        regressions.append(
+            f"fleet: {observed['loose_updates']} update(s) fell off the bucketed path "
+            "(sessions demoted to loose eager metrics)"
+        )
+    if not baseline:
+        new.append("fleet: no baseline section (record with --update-baseline)")
+        return regressions, stale, new
+    for field in _RATCHETED_MAX:
+        cur, ref = observed.get(field), baseline.get(field)
+        if cur is None:
+            regressions.append(f"fleet: {field} unobserved (no bucket was ever flushed)")
+        elif ref is not None and float(cur) > float(ref) + 1e-9:
+            regressions.append(f"fleet: {field} {cur} > baseline {ref}")
+        elif ref is not None and float(cur) < float(ref) - 1e-9:
+            stale.append(f"fleet: {field} improved {ref} -> {cur}; ratchet the baseline down")
+    return regressions, stale, new

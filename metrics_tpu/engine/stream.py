@@ -1,0 +1,555 @@
+"""Multi-tenant fleet runtime: thousands of live metric streams, one donated
+XLA dispatch per bucket per tick (DESIGN §15).
+
+The serving-fleet workload is a heterogeneous, churning population of live
+``Metric`` instances — millions of user sessions, each with its own accuracy /
+AUROC / error tracker, arriving and expiring mid-stream. Dispatching each
+instance's update separately is a Python interpreter crawl; recompiling when
+the population changes is worse. :class:`StreamEngine` makes fleet cost
+independent of fleet size and fleet churn:
+
+* **Bucketing.** Sessions whose metrics share ``(class, config fingerprint,
+  state avals)`` — the ``Metric._jit_cache_key()`` identity plus
+  ``Metric.state_avals()`` — land in one *bucket* and share one compiled
+  program, exactly like config-equal replicas in ``wrappers/replicated.py``.
+* **Padded stacked states.** Each bucket stacks its rows' states into one
+  leading-axis pytree padded to a power-of-two capacity. Rows are claimed
+  from a LIFO free-list (an expiring session's row is recycled by the next
+  arrival) and never moved, so arrival/expiry within capacity changes *data*,
+  not *shapes* — zero recompiles. Only a capacity doubling compiles one new
+  program per bucket.
+* **Masked dispatch.** A tick flushes each bucket's ingest queue as ONE
+  donated ``jit(vmap(...))`` dispatch (``engine/core.py`` masked mode): rows
+  without a submission carry ``keep=False`` and pass their state through
+  bit-exactly, so padding can never contaminate live rows and padding rows
+  contribute nothing. Compute vmaps over the whole bucket once and the host
+  slices out live rows (masked rows are skipped, never surfaced).
+* **Host-side ingest queue.** ``submit()`` only appends ``(slot, batch)`` to
+  the bucket's queue while the device is busy; ``tick()`` coalesces the queue
+  into numpy staging buffers and flushes. Submissions with distinct batch
+  signatures — or repeat submissions for one slot — split into ordered waves,
+  each wave one dispatch, so per-session ordering is preserved.
+
+Sessions whose metrics cannot take the vmapped path (list states, host-side
+updates, unhashable config, jit disabled, ineligible batch values) run as
+*loose* sessions: same API, per-instance eager updates, reported via the
+``fleet_loose_update`` counter. A trace failure inside a bucket demotes all
+of its sessions to loose and replays the pending queue eagerly — the same
+never-lose-an-update contract as the replica engine's loop fallback.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict
+from typing import Any, Dict, Hashable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.engine.core import _FLEET_JIT_CACHE, TRACER_ERRORS, engine_compute, engine_update
+from metrics_tpu.metric import Metric, _squeeze_if_scalar
+from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["StreamEngine"]
+
+
+def _bucket_label(metric: Metric) -> str:
+    fp = metric.config_fingerprint()
+    return f"{type(metric).__name__}@{fp[:8] if fp else 'unshared'}"
+
+
+def _submission_sig(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[Any, ...]:
+    """Groupability key for one submission: array leaves by aval, scalars by value.
+
+    Two submissions coalesce into one dispatch only when every array argument
+    agrees on (shape, dtype) — they share staging buffers — and every
+    non-array argument agrees on its exact value (it is broadcast into the
+    traced body once for the whole wave).
+    """
+
+    def leaf(v: Any) -> Tuple[Any, ...]:
+        if hasattr(v, "shape"):
+            return ("arr", tuple(v.shape), str(getattr(v, "dtype", "")))
+        return ("val", v)
+
+    kw_names = tuple(sorted(kwargs))
+    return (len(args), kw_names, tuple(leaf(a) for a in args), tuple(leaf(kwargs[k]) for k in kw_names))
+
+
+class _Session:
+    """One live stream: its metric instance plus where its state lives."""
+
+    __slots__ = ("sid", "metric", "bucket", "slot", "base_count", "engine_count", "queue")
+
+    def __init__(self, sid: Hashable, metric: Metric, bucket: Optional["_Bucket"], slot: int) -> None:
+        self.sid = sid
+        self.metric = metric
+        self.bucket = bucket
+        self.slot = slot
+        self.base_count = metric._update_count  # updates accumulated before adoption
+        self.engine_count = 0  # engine dispatches applied to this row since
+        self.queue: List[Tuple[Tuple[Any, ...], Dict[str, Any]]] = []  # loose sessions only
+
+
+class _Bucket:
+    """All sessions sharing one compiled program: a padded stacked state pytree."""
+
+    __slots__ = (
+        "key", "label", "template", "capacity", "stacked", "slot_sids", "free",
+        "high_water", "queue", "version", "computed", "computed_version",
+        "compute_eager", "row_bytes",
+    )
+
+    def __init__(self, template: Metric, label: str, key: Any, capacity: int) -> None:
+        self.key = key
+        self.label = label
+        self.template = template  # pristine clone; traced representative + default source
+        self.capacity = capacity
+        self.stacked = self._tiled_defaults(capacity)
+        self.slot_sids: List[Optional[Hashable]] = [None] * capacity
+        # LIFO free-list, initialized so pop() hands out slot 0 first; recycled
+        # slots are appended and therefore reused before untouched ones
+        self.free: List[int] = list(range(capacity - 1, -1, -1))
+        self.high_water = -1  # highest slot ever occupied (fragmentation horizon)
+        self.queue: List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]] = []
+        self.version = 0  # bumped on every state change; invalidates cached computes
+        self.computed: Any = None
+        self.computed_version = -1
+        self.compute_eager = False  # latched when the vmapped compute cannot trace
+        self.row_bytes = sum(
+            int(np.prod(np.asarray(d).shape, dtype=np.int64)) * np.dtype(np.asarray(d).dtype).itemsize
+            for d in template._defaults.values()
+        )
+
+    def _tiled_defaults(self, rows: int) -> Dict[str, Any]:
+        # padding rows hold the per-state defaults (not zeros): a virgin slot is
+        # indistinguishable from a freshly-reset metric, so a fresh arrival into
+        # one needs no scatter at all
+        return {k: jnp.repeat(jnp.asarray(d)[None], rows, axis=0) for k, d in self.template._defaults.items()}
+
+    def grow(self) -> None:
+        """Double the padded capacity (the only shape change a bucket ever makes)."""
+        old = self.capacity
+        self.capacity = old * 2
+        pad = self._tiled_defaults(old)
+        self.stacked = {k: jnp.concatenate([v, pad[k]], axis=0) for k, v in self.stacked.items()}
+        self.slot_sids.extend([None] * old)
+        self.free.extend(range(self.capacity - 1, old - 1, -1))
+        self.version += 1
+
+    def active(self) -> int:
+        return self.capacity - len(self.free)
+
+    def fragmented(self) -> int:
+        """Free slots below the high-water mark: holes a dispatch still pays for
+        even under an optimal (non-compacting) allocator."""
+        return sum(1 for s in self.free if s <= self.high_water)
+
+
+class StreamEngine:
+    """Drive an arbitrary, churning population of live metrics as a bucketed fleet.
+
+    ::
+
+        engine = StreamEngine()
+        sid = engine.add_session(MulticlassAccuracy(num_classes=10))
+        engine.submit(sid, preds, target)     # host-side enqueue, no dispatch
+        engine.tick()                         # ONE dispatch per touched bucket
+        value = engine.compute(sid)           # vmapped compute, host-sliced
+        metric = engine.expire(sid)           # state materialized back out
+
+    ``add_session`` adopts the instance (including any state it already
+    accumulated); until ``expire`` hands it back, route updates through
+    ``submit`` — the adopted instance's own ``update`` would diverge from the
+    engine-resident row.
+    """
+
+    def __init__(self, initial_capacity: int = 8) -> None:
+        if initial_capacity < 1:
+            raise TPUMetricsUserError("StreamEngine initial_capacity must be >= 1")
+        self._initial_capacity = 1 << (int(initial_capacity) - 1).bit_length()
+        self._buckets: "OrderedDict[Any, _Bucket]" = OrderedDict()
+        self._sessions: Dict[Hashable, _Session] = {}
+        self._auto_sid = itertools.count()
+        self._ticks = 0
+
+    # ------------------------------------------------------------------ sessions
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    def session_ids(self) -> List[Hashable]:
+        return list(self._sessions)
+
+    def add_session(self, metric: Metric, session_id: Optional[Hashable] = None) -> Hashable:
+        """Adopt a live metric instance into the fleet; returns its session id."""
+        if not isinstance(metric, Metric):
+            raise TPUMetricsUserError(
+                f"StreamEngine.add_session expects a Metric instance, got {type(metric).__name__}"
+            )
+        sid = next(self._auto_sid) if session_id is None else session_id
+        if sid in self._sessions:
+            raise TPUMetricsUserError(f"session {sid!r} is already live in this engine")
+        key = self._bucket_key(metric)
+        if key is None:
+            self._sessions[sid] = _Session(sid, metric, None, -1)
+            _observe.note_fleet_session("loose", "add")
+            return sid
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            template = metric.clone()
+            template.reset()
+            bucket = _Bucket(template, _bucket_label(metric), key, self._initial_capacity)
+            self._buckets[key] = bucket
+        if not bucket.free:
+            bucket.grow()
+        slot = bucket.free.pop()
+        virgin = slot > bucket.high_water
+        bucket.high_water = max(bucket.high_water, slot)
+        bucket.slot_sids[slot] = sid
+        state = metric.__dict__["_state"]
+        fresh = metric._update_count == 0 and all(
+            state[k] is metric._defaults[k] for k in metric._defaults
+        )
+        if not (virgin and fresh):
+            # recycled rows hold the previous tenant's leftovers, and adopted
+            # instances may carry accumulated state — scatter the real rows in
+            for k in metric._defaults:
+                bucket.stacked[k] = bucket.stacked[k].at[slot].set(jnp.asarray(state[k]))
+            bucket.version += 1
+        self._sessions[sid] = _Session(sid, metric, bucket, slot)
+        _observe.note_fleet_session(bucket.label, "add")
+        return sid
+
+    def _bucket_key(self, metric: Metric) -> Optional[Any]:
+        """(config key, state avals) when the metric can ride a bucket, else None."""
+        cfg = metric._jit_cache_key()
+        if cfg is None or not metric._jit_eligible((), {}):
+            return None
+        avals = metric.state_avals()
+        state = metric.__dict__["_state"]
+        for name, shape, dtype in avals:
+            live = state[name]
+            if not hasattr(live, "shape") or tuple(live.shape) != shape or str(live.dtype) != dtype:
+                return None  # live state drifted off the registered avals
+        return (cfg, avals)
+
+    # ------------------------------------------------------------------ ingest
+    def submit(self, session_id: Hashable, *args: Any, **kwargs: Any) -> None:
+        """Queue one update batch for a session (no device work until tick/compute)."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"unknown or expired session {session_id!r}")
+        bucket = sess.bucket
+        if bucket is not None and not bucket.template._jit_eligible(args, kwargs):
+            # this batch cannot enter a traced dispatch (host-only values, or jit
+            # globally disabled): hand the session its row back and go loose
+            self._demote_session(sess)
+            bucket = None
+        if bucket is None:
+            sess.queue.append((args, kwargs))
+        else:
+            bucket.queue.append((sess.slot, args, kwargs))
+
+    def tick(self) -> int:
+        """Flush every pending queue; returns the number of XLA update dispatches."""
+        dispatches = self._flush_pending()
+        self._ticks += 1
+        _observe.note_fleet_tick(dispatches)
+        self._publish_gauges()
+        return dispatches
+
+    def _flush_pending(self) -> int:
+        dispatches = 0
+        for bucket in list(self._buckets.values()):
+            if bucket.queue:
+                dispatches += self._flush_bucket(bucket)
+        for sess in list(self._sessions.values()):
+            if sess.bucket is None and sess.queue:
+                self._flush_loose(sess)
+        return dispatches
+
+    def _flush_loose(self, sess: _Session) -> None:
+        pending, sess.queue = sess.queue, []
+        for args, kwargs in pending:
+            sess.metric.update(*args, **kwargs)
+            _observe.note_fleet_loose_update(type(sess.metric).__name__)
+
+    def _flush_bucket(self, bucket: _Bucket) -> int:
+        """Coalesce the bucket's queue into waves and dispatch each wave once."""
+        queue, bucket.queue = bucket.queue, []
+        _observe.note_fleet_flush(bucket.label)
+        # wave = how many earlier submissions this slot already has in the queue;
+        # grouping on (wave, signature) keeps per-session ordering while letting
+        # every first-submission-per-slot coalesce into one dispatch
+        seen: Dict[int, int] = {}
+        groups: "OrderedDict[Tuple[int, Any], List[int]]" = OrderedDict()
+        for idx, (slot, args, kwargs) in enumerate(queue):
+            wave = seen.get(slot, 0)
+            seen[slot] = wave + 1
+            groups.setdefault((wave, _submission_sig(args, kwargs)), []).append(idx)
+        dispatches = 0
+        done: set = set()
+        for (wave, _sig), idxs in sorted(groups.items(), key=lambda kv: kv[0][0]):
+            subs = [queue[i] for i in idxs]
+            try:
+                stacked_args, stacked_kwargs, mask = self._stage(bucket, subs)
+                new_stacked = engine_update(
+                    bucket.template, bucket.capacity, bucket.stacked,
+                    stacked_args, stacked_kwargs, mask=mask,
+                    cache=_FLEET_JIT_CACHE, label=bucket.label,
+                )
+            except TRACER_ERRORS as exc:
+                # trace failure aborts before execution: the stacked buffers are
+                # intact, so dissolve the bucket into loose sessions and replay
+                # everything not yet applied — no submission is ever lost
+                remaining = [queue[i] for i in range(len(queue)) if i not in done]
+                self._demote_bucket(bucket, exc, remaining)
+                return dispatches
+            bucket.stacked = new_stacked
+            bucket.version += 1
+            for slot, _a, _k in subs:
+                self._sessions[bucket.slot_sids[slot]].engine_count += 1
+            done.update(idxs)
+            _observe.note_engine_dispatch("fleet", bucket.label)
+            dispatches += 1
+        return dispatches
+
+    def _stage(
+        self, bucket: _Bucket, subs: List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]]
+    ) -> Tuple[Tuple[Any, ...], Dict[str, Any], Any]:
+        """Scatter one wave's host batches into (capacity, ...) staging buffers."""
+        capacity = bucket.capacity
+        slots = [s for s, _a, _k in subs]
+        args0, kwargs0 = subs[0][1], subs[0][2]
+        kw_names = sorted(kwargs0)
+
+        def stage(pick) -> Any:
+            first = pick(subs[0])
+            if not hasattr(first, "shape"):
+                return first  # signature grouping guarantees value equality
+            rows = np.stack([np.asarray(pick(sub)) for sub in subs], axis=0)
+            buf = np.zeros((capacity,) + rows.shape[1:], dtype=rows.dtype)
+            buf[slots] = rows
+            return jnp.asarray(buf)
+
+        stacked_args = tuple(stage(lambda sub, i=i: sub[1][i]) for i in range(len(args0)))
+        stacked_kwargs = {k: stage(lambda sub, k=k: sub[2][k]) for k in kw_names}
+        mask = np.zeros(capacity, dtype=bool)
+        mask[slots] = True
+        return stacked_args, stacked_kwargs, jnp.asarray(mask)
+
+    # ------------------------------------------------------------------ fallback
+    def _materialize(self, sess: _Session) -> None:
+        """Slice a session's engine-resident row back into its metric instance."""
+        bucket, slot, m = sess.bucket, sess.slot, sess.metric
+        for k in m._defaults:
+            m.__dict__["_state"][k] = bucket.stacked[k][slot]
+        m._update_count = sess.base_count + sess.engine_count
+        m._computed = None
+        # sliced rows are caller-visible from here on: the metric's own jitted
+        # update must copy before donating
+        m.__dict__["_state_escaped"] = True
+
+    def _release_slot(self, sess: _Session) -> None:
+        bucket = sess.bucket
+        bucket.slot_sids[sess.slot] = None
+        bucket.free.append(sess.slot)
+        sess.bucket = None
+        sess.slot = -1
+
+    def _demote_session(self, sess: _Session) -> None:
+        """Convert one bucketed session to a loose one (row handed back)."""
+        bucket = sess.bucket
+        if bucket.queue:
+            self._flush_bucket(bucket)  # ordering: queued updates land first
+        if sess.bucket is None:
+            return  # the flush itself demoted the whole bucket
+        self._materialize(sess)
+        self._release_slot(sess)
+
+    def _demote_bucket(
+        self, bucket: _Bucket, exc: BaseException,
+        remaining: List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]],
+    ) -> None:
+        """Trace failure: dissolve the bucket, replay unapplied submissions eagerly."""
+        _observe.note_fleet_fallback(bucket.label, exc)
+        replay: List[Tuple[_Session, Tuple[Any, ...], Dict[str, Any]]] = []
+        for slot, args, kwargs in remaining:
+            replay.append((self._sessions[bucket.slot_sids[slot]], args, kwargs))
+        for sid in bucket.slot_sids:
+            if sid is None:
+                continue
+            sess = self._sessions[sid]
+            self._materialize(sess)
+            sess.bucket = None
+            sess.slot = -1
+        self._buckets.pop(bucket.key, None)
+        _observe.set_fleet_gauges(bucket.label, 0, 0, 0, 0, 0)
+        for sess, args, kwargs in replay:
+            sess.metric.update(*args, **kwargs)
+            _observe.note_fleet_loose_update(type(sess.metric).__name__)
+
+    # ------------------------------------------------------------------ readout
+    def compute(self, session_id: Hashable) -> Any:
+        """Flush pending work, then return this session's metric value."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"unknown or expired session {session_id!r}")
+        self._flush_pending()
+        if sess.bucket is None:
+            return sess.metric.compute()
+        values = self._bucket_values(sess.bucket)
+        if values is None:
+            return self._row_value(sess.bucket, sess.slot)
+        return jax.tree_util.tree_map(lambda a: a[sess.slot], values)
+
+    def compute_all(self) -> Dict[Hashable, Any]:
+        """Flush pending work, then compute every live session (one vmapped
+        dispatch per bucket, cached until the bucket's state changes)."""
+        self._flush_pending()
+        out: Dict[Hashable, Any] = {}
+        for sid, sess in self._sessions.items():
+            if sess.bucket is None:
+                out[sid] = sess.metric.compute()
+                continue
+            values = self._bucket_values(sess.bucket)
+            if values is None:
+                out[sid] = self._row_value(sess.bucket, sess.slot)
+            else:
+                out[sid] = jax.tree_util.tree_map(lambda a, s=sess.slot: a[s], values)
+        return out
+
+    def _bucket_values(self, bucket: _Bucket) -> Any:
+        """Whole-bucket vmapped compute, cached by state version; None → eager rows."""
+        if bucket.computed_version == bucket.version:
+            return bucket.computed
+        if not bucket.compute_eager:
+            try:
+                values = engine_compute(
+                    bucket.template, bucket.capacity, bucket.stacked,
+                    cache=_FLEET_JIT_CACHE, label=f"{bucket.label}:compute",
+                )
+            except TRACER_ERRORS as exc:
+                bucket.compute_eager = True
+                _observe.note_fleet_fallback(f"{bucket.label}:compute", exc)
+            else:
+                # separate counter family: fleet_dispatch stays a pure update-
+                # dispatch count so dispatches-per-flush pins the tick economy
+                _observe.note_engine_dispatch("fleet_compute", bucket.label)
+                bucket.computed = values
+                bucket.computed_version = bucket.version
+                return values
+        return None
+
+    def _row_value(self, bucket: _Bucket, slot: int) -> Any:
+        row = {k: v[slot] for k, v in bucket.stacked.items()}
+        return _squeeze_if_scalar(bucket.template._functional_compute(row))
+
+    # ------------------------------------------------------------------ lifecycle
+    def expire(self, session_id: Hashable) -> Metric:
+        """Retire a session: flush its pending updates, materialize its state back
+        into the metric instance, recycle its row, and hand the metric back."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"unknown or expired session {session_id!r}")
+        if sess.bucket is not None and sess.bucket.queue:
+            self._flush_bucket(sess.bucket)
+        if sess.bucket is not None:
+            label = sess.bucket.label
+            self._materialize(sess)
+            self._release_slot(sess)
+        else:
+            label = "loose"
+            self._flush_loose(sess)
+        del self._sessions[session_id]
+        _observe.note_fleet_session(label, "expire")
+        self._publish_gauges()
+        return sess.metric
+
+    def reset(self, session_id: Optional[Hashable] = None) -> None:
+        """Reset one session's row (or, with no id, the whole fleet) to defaults.
+
+        Pending queued submissions for the reset scope are discarded — a reset
+        row starts from zero, exactly like ``Metric.reset()``.
+        """
+        if session_id is None:
+            for bucket in self._buckets.values():
+                bucket.stacked = bucket._tiled_defaults(bucket.capacity)
+                bucket.queue = []
+                bucket.version += 1
+            for sess in self._sessions.values():
+                sess.metric.reset()
+                sess.base_count = 0
+                sess.engine_count = 0
+                sess.queue = []
+            self._publish_gauges()
+            return
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"unknown or expired session {session_id!r}")
+        sess.metric.reset()
+        sess.base_count = 0
+        sess.engine_count = 0
+        bucket = sess.bucket
+        if bucket is None:
+            sess.queue = []
+            return
+        bucket.queue = [(s, a, k) for s, a, k in bucket.queue if s != sess.slot]
+        for k, d in bucket.template._defaults.items():
+            bucket.stacked[k] = bucket.stacked[k].at[sess.slot].set(jnp.asarray(d))
+        bucket.version += 1
+
+    # ------------------------------------------------------------------ telemetry
+    def stats(self) -> Dict[str, Any]:
+        """Occupancy/fragmentation/pad-waste per bucket plus fleet totals (also
+        pushed as ``fleet_*`` observe gauges when telemetry is enabled)."""
+        buckets: Dict[str, Dict[str, Any]] = {}
+        tot_active = tot_capacity = tot_bytes = tot_bytes_active = 0
+        for bucket in self._buckets.values():
+            active = bucket.active()
+            bytes_stacked = bucket.capacity * bucket.row_bytes
+            bytes_active = active * bucket.row_bytes
+            buckets[bucket.label] = {
+                "capacity": bucket.capacity,
+                "active": active,
+                "fragmented": bucket.fragmented(),
+                "pending": len(bucket.queue),
+                "row_bytes": bucket.row_bytes,
+                "bytes_stacked": bytes_stacked,
+                "occupancy_pct": 100.0 * active / bucket.capacity,
+                "pad_waste_pct": 100.0 * (bytes_stacked - bytes_active) / bytes_stacked if bytes_stacked else 0.0,
+            }
+            tot_active += active
+            tot_capacity += bucket.capacity
+            tot_bytes += bytes_stacked
+            tot_bytes_active += bytes_active
+        loose = sum(1 for s in self._sessions.values() if s.bucket is None)
+        self._publish_gauges()
+        return {
+            "buckets": buckets,
+            "sessions": len(self._sessions),
+            "loose_sessions": loose,
+            "ticks": self._ticks,
+            "rows_active": tot_active,
+            "rows_capacity": tot_capacity,
+            "occupancy_pct": 100.0 * tot_active / tot_capacity if tot_capacity else None,
+            "pad_waste_pct": 100.0 * (tot_bytes - tot_bytes_active) / tot_bytes if tot_bytes else None,
+        }
+
+    def _publish_gauges(self) -> None:
+        if not _observe.ENABLED:
+            return
+        for bucket in self._buckets.values():
+            active = bucket.active()
+            _observe.set_fleet_gauges(
+                bucket.label,
+                active,
+                bucket.capacity,
+                bucket.fragmented(),
+                bucket.capacity * bucket.row_bytes,
+                active * bucket.row_bytes,
+            )
+

@@ -29,6 +29,7 @@ Capability parity with reference ``torchmetrics/metric.py`` (1311 LoC: ``Metric`
 from __future__ import annotations
 
 import functools
+import hashlib
 import inspect
 import operator
 import sys
@@ -95,17 +96,19 @@ def clear_jit_cache() -> None:
 
     Covers every compiled-update cache in the runtime: the per-metric shared
     cache here, the fused collection-update cache (``collections.py``) and the
-    replica-engine cache (``wrappers/replicated.py``). The observe layer's
-    cache-scoped counters (compiles / hits / evictions) describe these caches,
-    so they reset with them — see ``metrics_tpu.observe`` (DESIGN §11).
+    engine program caches (``engine/core.py``: the replica cache re-exported by
+    ``wrappers/replicated.py`` plus the fleet bucket cache). The observe
+    layer's cache-scoped counters (compiles / hits / evictions) describe these
+    caches, so they reset with them — see ``metrics_tpu.observe`` (DESIGN §11).
     """
     _SHARED_JIT_CACHE.clear()
     collections_mod = sys.modules.get("metrics_tpu.collections")
     if collections_mod is not None:
         collections_mod._FUSED_SHARED_CACHE.clear()
-    replicated_mod = sys.modules.get("metrics_tpu.wrappers.replicated")
-    if replicated_mod is not None:
-        replicated_mod._REPLICA_JIT_CACHE.clear()
+    engine_core = sys.modules.get("metrics_tpu.engine.core")
+    if engine_core is not None:
+        engine_core._REPLICA_JIT_CACHE.clear()
+        engine_core._FLEET_JIT_CACHE.clear()
     _observe.note_jit_cache_cleared()
 
 
@@ -581,6 +584,40 @@ class Metric(ABC):
             return None
         return (type(self), items)
 
+    def state_avals(self) -> Tuple[Tuple[str, Any, str], ...]:
+        """Static ``(name, shape, dtype)`` signature of the registered default states.
+
+        Two instances with equal config AND equal state avals can share one
+        compiled executable over stacked rows — this is half of the fleet
+        engine's bucketing key (DESIGN §15) and what checkpoint restore
+        validates before installing a payload. List states record the sentinel
+        shape ``"list"`` so they can never aval-match an array state.
+        """
+        out: List[Tuple[str, Any, str]] = []
+        for name, default in self._defaults.items():
+            if isinstance(default, list):
+                out.append((name, "list", ""))
+            else:
+                arr = jnp.asarray(default)
+                out.append((name, tuple(int(s) for s in arr.shape), str(arr.dtype)))
+        return tuple(out)
+
+    def config_fingerprint(self) -> Optional[str]:
+        """Stable hex digest of the static config, or None when not fingerprintable.
+
+        Renders ``_jit_cache_key()`` with the class spelled as an importable
+        path (so the digest survives pickling across processes) and hashes it —
+        the identity used by checkpoint compatibility validation
+        (``resilience/checkpoint.py``) and fleet bucket labels. None means the
+        config holds unhashable values and the instance cannot share compiled
+        executables either.
+        """
+        key = self._jit_cache_key()
+        if key is None:
+            return None
+        cls, items = key
+        return hashlib.sha256(repr((cls.__module__, cls.__qualname__, items)).encode()).hexdigest()
+
     def _lookup_shared_jit(self, donate: bool = False) -> _CompiledUpdate:
         """Return the compiled pure update for this config, compiling at most once per config."""
         cfg = self._jit_cache_key()
@@ -858,6 +895,7 @@ class Metric(ABC):
             rec.add_time("merge", type(self).__name__, _observe.clock() - t0)
             rec.add_count("merge", type(self).__name__)
         self._update_count = own_count + incoming_count
+        self._computed = None  # merged state invalidates any cached compute
 
     def _copy_state(self) -> Dict[str, Any]:
         return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}

@@ -137,19 +137,33 @@ def run_perf_check(
     into it instead of printed — the caller owns the one JSON document on
     stdout.
     """
+    from metrics_tpu.engine.smoke import (  # noqa: PLC0415 — pulls in jax + the registry
+        diff_fleet_baseline,
+        load_fleet_baseline,
+        run_fleet_smoke,
+        write_fleet_baseline,
+    )
+
     path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
     results = collect_cost_report(include_memory=include_memory)
     failures = [r for r in results if not r.ok]
+    fleet_obs = run_fleet_smoke()
     if update_baseline:
         cost = write_cost_baseline(path, results)
+        fleet = write_fleet_baseline(path, fleet_obs)
         if not quiet:
-            print(f"perf: baseline written to {path} ({len(cost)} classes)")
+            print(f"perf: baseline written to {path} ({len(cost)} classes + {len(fleet)} fleet keys)")
         return 0
     regressions, stale, new = diff_cost_baseline(results, load_cost_baseline(path), tolerance)
+    f_reg, f_stale, f_new = diff_fleet_baseline(fleet_obs, load_fleet_baseline(path))
+    regressions += f_reg
+    stale += f_stale
+    new += f_new
     if report is not None:
         report.update({
             "profiled": sum(1 for r in results if r.ok),
             "cases": len(results),
+            "fleet": fleet_obs,
             "regressions": regressions,
             "stale": stale,
             "new": new,
@@ -167,7 +181,10 @@ def run_perf_check(
             print(f"perf: skipped {r.case.name}: {r.error}")
         ok = sum(1 for r in results if r.ok)
         print(f"perf: {ok}/{len(results)} classes profiled, {len(regressions)} regression(s), "
-              f"{len(stale)} stale, {len(new)} new")
+              f"{len(stale)} stale, {len(new)} new; fleet smoke: "
+              f"{fleet_obs['streams']} streams / {fleet_obs['buckets']} buckets, "
+              f"{fleet_obs['dispatches_per_bucket_tick']} dispatches/bucket-tick, "
+              f"{fleet_obs['update_compiles_per_bucket']} compile(s)/bucket")
     return 1 if regressions else 0
 
 
@@ -211,19 +228,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         cases, include_memory=not args.no_memory, dynamic=not args.static_only
     )
 
+    from metrics_tpu.engine.smoke import (  # noqa: PLC0415
+        diff_fleet_baseline,
+        load_fleet_baseline,
+        run_fleet_smoke,
+        write_fleet_baseline,
+    )
+
+    # the fleet smoke rides along except under a --classes filter (whose point
+    # is profiling a handful of updates quickly)
+    fleet_obs = None if args.classes else run_fleet_smoke()
+
     if args.update_baseline:
         cost = write_cost_baseline(baseline_path, results)
+        if fleet_obs is not None:
+            write_fleet_baseline(baseline_path, fleet_obs)
         if not args.quiet:
             print(f"profile-metrics: baseline written to {baseline_path} ({len(cost)} classes)")
         return 0
 
     baseline = load_cost_baseline(baseline_path)
     regressions, stale, new = diff_cost_baseline(results, baseline, args.tolerance)
+    if fleet_obs is not None:
+        f_reg, f_stale, f_new = diff_fleet_baseline(fleet_obs, load_fleet_baseline(baseline_path))
+        regressions += f_reg
+        stale += f_stale
+        new += f_new
     failures = [r for r in results if not r.ok]
 
     if args.fmt == "json":
         print(json.dumps({
             "cost": report_to_dict(results),
+            "fleet": fleet_obs,
             "errors": {r.case.name: r.error for r in failures},
             "regressions": regressions,
             "stale": stale,

@@ -42,6 +42,15 @@ __all__ = [
     "enable",
     "enabled",
     "note_eager_fallback",
+    "note_engine_compile",
+    "note_engine_dispatch",
+    "note_engine_evict",
+    "note_engine_hit",
+    "note_fleet_fallback",
+    "note_fleet_flush",
+    "note_fleet_loose_update",
+    "note_fleet_session",
+    "note_fleet_tick",
     "note_fused_compile",
     "note_fused_fallback",
     "note_jit_cache_cleared",
@@ -55,6 +64,7 @@ __all__ = [
     "prometheus",
     "record_event",
     "reset",
+    "set_fleet_gauges",
     "snapshot",
     "snapshot_json",
 ]
@@ -66,11 +76,13 @@ ENABLED = False
 clock: Callable[[], float] = time.perf_counter
 
 # counter names owned by the compiled-update caches (per-metric shared cache,
-# fused collection cache, replica-engine cache) — cleared together with them so
-# `clear_jit_cache()` leaves counters consistent with the (now empty) caches
+# fused collection cache, replica/fleet engine program caches) — cleared
+# together with them so `clear_jit_cache()` leaves counters consistent with
+# the (now empty) caches
 _JIT_CACHE_COUNTERS = (
     "jit_compile", "jit_compile_unshared", "jit_cache_hit", "jit_cache_eviction",
-    "fused_compile", "fused_hit", "replica_compile", "replica_hit",
+    "fused_compile", "fused_hit", "replica_compile", "replica_hit", "replica_evict",
+    "fleet_compile", "fleet_hit", "fleet_evict",
 )
 
 # one warning per metric class across the process, independent of ENABLED —
@@ -82,12 +94,13 @@ class Recorder:
     """Holds all telemetry. Internal containers start empty and stay empty while
     disabled (the zero-allocation half of the overhead contract)."""
 
-    __slots__ = ("counters", "timers", "events", "max_events", "_seq", "_compiled", "_evicted", "_lock")
+    __slots__ = ("counters", "timers", "events", "gauges", "max_events", "_seq", "_compiled", "_evicted", "_lock")
 
     def __init__(self, max_events: int = 1024) -> None:
         self.counters: Dict[Tuple[str, str], int] = {}
         self.timers: Dict[Tuple[str, str], List[float]] = {}  # [count, total, min, max]
         self.events: Deque[Dict[str, Any]] = deque(maxlen=max_events)
+        self.gauges: Dict[Tuple[str, str], float] = {}  # last-write-wins levels
         self.max_events = max_events
         self._seq = 0
         self._compiled: Dict[str, int] = {}  # metric class -> distinct shared compiles
@@ -99,6 +112,10 @@ class Recorder:
         key = (name, label)
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
+
+    def set_gauge(self, name: str, label: str, value: float) -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
 
     def add_time(self, name: str, label: str, seconds: float) -> None:
         key = (name, label)
@@ -122,6 +139,7 @@ class Recorder:
             self.counters.clear()
             self.timers.clear()
             self.events.clear()
+            self.gauges.clear()
             self._seq = 0
             self._compiled.clear()
             self._evicted.clear()
@@ -252,27 +270,89 @@ def note_fused_fallback(n_leaders: int, exc: BaseException) -> None:
         RECORDER.add_event("fused_fallback", leaders=n_leaders, error=type(exc).__name__)
 
 
-# replica-engine hooks (wrappers/replicated.py): label is "<InnerClass>x<N>"
-def note_replica_compile(label: str, n_replicas: int) -> None:
+# engine hooks (engine/core.py ProgramCache + its two users): kind is
+# "replica" (label "<InnerClass>x<N>", wrappers/replicated.py) or "fleet"
+# (label "<Class>@<fingerprint8>", engine/stream.py buckets)
+def note_engine_compile(kind: str, label: str, n_rows: int) -> None:
     if ENABLED:
-        RECORDER.add_count("replica_compile", label)
-        RECORDER.add_event("replica_compile", engine=label, replicas=n_replicas)
+        RECORDER.add_count(f"{kind}_compile", label)
+        RECORDER.add_event(f"{kind}_compile", engine=label, rows=n_rows)
+
+
+def note_engine_hit(kind: str, label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count(f"{kind}_hit", label)
+
+
+def note_engine_evict(kind: str, label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count(f"{kind}_evict", label)
+        RECORDER.add_event(f"{kind}_evict", engine=label)
+
+
+def note_engine_dispatch(kind: str, label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count(f"{kind}_dispatch", label)
+
+
+# replica-shaped conveniences kept for the wrapper call sites
+def note_replica_compile(label: str, n_replicas: int) -> None:
+    note_engine_compile("replica", label, n_replicas)
 
 
 def note_replica_hit(label: str) -> None:
-    if ENABLED:
-        RECORDER.add_count("replica_hit", label)
+    note_engine_hit("replica", label)
 
 
 def note_replica_dispatch(label: str) -> None:
-    if ENABLED:
-        RECORDER.add_count("replica_dispatch", label)
+    note_engine_dispatch("replica", label)
 
 
 def note_replica_fallback(label: str, exc: BaseException) -> None:
     if ENABLED:
         RECORDER.add_count("replica_fallback", label)
         RECORDER.add_event("replica_fallback", engine=label, error=type(exc).__name__, detail=str(exc)[:200])
+
+
+# fleet StreamEngine hooks (engine/stream.py): bucket label is "<Class>@<fp8>"
+def note_fleet_tick(n_dispatches: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("fleet_tick", "engine")
+        RECORDER.add_count("fleet_tick_dispatches", "engine", n_dispatches)
+
+
+def note_fleet_flush(label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("fleet_flush", label)
+
+
+def note_fleet_session(label: str, change: str) -> None:
+    """``change`` is "add" or "expire"; counts arrivals/expiries per bucket."""
+    if ENABLED:
+        RECORDER.add_count(f"fleet_session_{change}", label)
+
+
+def note_fleet_loose_update(label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("fleet_loose_update", label)
+
+
+def note_fleet_fallback(label: str, exc: BaseException) -> None:
+    if ENABLED:
+        RECORDER.add_count("fleet_fallback", label)
+        RECORDER.add_event("fleet_fallback", engine=label, error=type(exc).__name__, detail=str(exc)[:200])
+
+
+def set_fleet_gauges(
+    label: str, active: int, capacity: int, fragmented: int, bytes_stacked: int, bytes_active: int
+) -> None:
+    """Publish one bucket's occupancy levels (refreshed on tick/expire/stats)."""
+    if ENABLED:
+        RECORDER.set_gauge("fleet_rows_active", label, active)
+        RECORDER.set_gauge("fleet_rows_capacity", label, capacity)
+        RECORDER.set_gauge("fleet_rows_fragmented", label, fragmented)
+        RECORDER.set_gauge("fleet_bytes_stacked", label, bytes_stacked)
+        RECORDER.set_gauge("fleet_bytes_active", label, bytes_active)
 
 
 # resilience hooks (metric.py transactional updates, resilience/, parallel/sync.py)
@@ -324,12 +404,24 @@ def snapshot() -> Dict[str, Any]:
          "counters": {name: {label: int}},
          "timers":   {name: {label: {"count", "total_s", "mean_s", "min_s", "max_s"}}},
          "events":   [{"seq", "kind", ...}, ...],
+         "gauges":   {name: {label: float}},
          "derived":  {"jit_cache_hit_rate": float|None,
                       "jit_compiles_total": int, "jit_cache_hits_total": int,
                       "jit_cache_evictions_total": int, "eager_fallbacks_total": int,
                       "updates_rolled_back_total": int, "ckpt_saves_total": int,
                       "ckpt_restores_total": int, "sync_retries_total": int,
-                      "sync_degraded_total": int, "guard_quarantined_total": int}}
+                      "sync_degraded_total": int, "guard_quarantined_total": int,
+                      "fleet_sessions_total": int, "fleet_capacity_total": int,
+                      "fleet_occupancy_pct": float|None,
+                      "fleet_pad_waste_pct": float|None,
+                      "fleet_dispatches_total": int,
+                      "fleet_dispatches_per_flush": float|None}}
+
+    The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
+    buckets: occupancy is live rows over padded capacity, pad waste is the
+    byte-weighted share of stacked state bytes held by padding rows, and
+    dispatches-per-flush is the engine's per-bucket-per-tick dispatch economy
+    (1.0 = every flushed bucket cost exactly one XLA dispatch).
     """
     with RECORDER._lock:
         counters: Dict[str, Dict[str, int]] = {}
@@ -345,14 +437,24 @@ def snapshot() -> Dict[str, Any]:
                 "max_s": mx,
             }
         events = list(RECORDER.events)
+        gauges: Dict[str, Dict[str, float]] = {}
+        for (name, label), g in RECORDER.gauges.items():
+            gauges.setdefault(name, {})[label] = g
     compiles = sum(counters.get("jit_compile", {}).values())
     hits = sum(counters.get("jit_cache_hit", {}).values())
     lookups = compiles + hits
+    fleet_active = sum(gauges.get("fleet_rows_active", {}).values())
+    fleet_capacity = sum(gauges.get("fleet_rows_capacity", {}).values())
+    fleet_bytes = sum(gauges.get("fleet_bytes_stacked", {}).values())
+    fleet_bytes_active = sum(gauges.get("fleet_bytes_active", {}).values())
+    fleet_dispatches = sum(counters.get("fleet_dispatch", {}).values())
+    fleet_flushes = sum(counters.get("fleet_flush", {}).values())
     return {
         "enabled": ENABLED,
         "counters": {k: dict(sorted(v.items())) for k, v in sorted(counters.items())},
         "timers": {k: dict(sorted(v.items())) for k, v in sorted(timers.items())},
         "events": events,
+        "gauges": {k: dict(sorted(v.items())) for k, v in sorted(gauges.items())},
         "derived": {
             "jit_cache_hit_rate": (hits / lookups) if lookups else None,
             "jit_compiles_total": compiles,
@@ -365,6 +467,12 @@ def snapshot() -> Dict[str, Any]:
             "sync_retries_total": sum(counters.get("sync_retry", {}).values()),
             "sync_degraded_total": sum(counters.get("sync_degraded", {}).values()),
             "guard_quarantined_total": sum(counters.get("guard_quarantined", {}).values()),
+            "fleet_sessions_total": int(fleet_active),
+            "fleet_capacity_total": int(fleet_capacity),
+            "fleet_occupancy_pct": (100.0 * fleet_active / fleet_capacity) if fleet_capacity else None,
+            "fleet_pad_waste_pct": (100.0 * (fleet_bytes - fleet_bytes_active) / fleet_bytes) if fleet_bytes else None,
+            "fleet_dispatches_total": fleet_dispatches,
+            "fleet_dispatches_per_flush": (fleet_dispatches / fleet_flushes) if fleet_flushes else None,
         },
     }
 
@@ -380,15 +488,20 @@ def _prom_label(label: str) -> str:
 def prometheus() -> str:
     """Prometheus text-exposition dump of the counters and timers.
 
-    Counters render as ``*_total`` counter families; timers as summary-style
-    ``*_seconds_count`` / ``*_seconds_sum`` pairs — ready for a textfile
-    collector or a scrape handler.
+    Counters render as ``*_total`` counter families; gauges as gauge families;
+    timers as summary-style ``*_seconds_count`` / ``*_seconds_sum`` pairs —
+    ready for a textfile collector or a scrape handler.
     """
     snap = snapshot()
     lines: List[str] = []
     for name, by_label in snap["counters"].items():
         prom = _prom_name(name) + "_total"
         lines.append(f"# TYPE {prom} counter")
+        for label, v in by_label.items():
+            lines.append(f'{prom}{{metric="{_prom_label(label)}"}} {v}')
+    for name, by_label in snap["gauges"].items():
+        prom = _prom_name(name)
+        lines.append(f"# TYPE {prom} gauge")
         for label, v in by_label.items():
             lines.append(f'{prom}{{metric="{_prom_label(label)}"}} {v}')
     for name, by_label in snap["timers"].items():

@@ -24,7 +24,6 @@ machinery with no stale probation state.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import os
 import pickle
@@ -70,14 +69,12 @@ class IncompatibleCheckpointError(CheckpointError):
 
 # ------------------------------------------------------------------ extraction
 def _fingerprint(metric: Any) -> Optional[str]:
-    """Config fingerprint from the shared-jit cache key; None when the config is
-    unshareable (child metrics, unhashable attrs) — aval checks still apply."""
-    key = metric._jit_cache_key()
-    if key is None:
-        return None
-    # the key's first element is the class object; repr() it stably by name
-    cls, items = key
-    return hashlib.sha256(repr((cls.__module__, cls.__qualname__, items)).encode()).hexdigest()
+    """Config fingerprint; None when the config is unshareable (child metrics,
+    unhashable attrs) — aval checks still apply. Delegates to
+    ``Metric.config_fingerprint`` so checkpoints and the fleet engine's bucket
+    labels agree on config identity."""
+    fp = getattr(metric, "config_fingerprint", None)
+    return fp() if callable(fp) else None
 
 
 def _host(v: Any) -> Any:

@@ -7,13 +7,11 @@ dispatches per ``update()``. Per DrJAX's broadcast/map-reduce decomposition
 the N replica states into one leading-axis pytree and run a single
 ``jax.vmap``-ed jitted update over it (DESIGN §12).
 
-Two vmap modes cover the shipped wrappers:
-
-- ``gather``: every replica sees the SAME batch through its own integer index
-  row (bootstrap resampling expressed as per-replica gathered index arrays) —
-  ``in_axes`` maps state and index rows, broadcasts the batch.
-- ``stacked``: every replica sees its own slice of the batch (multioutput:
-  the output axis is moved to the front and mapped).
+The dispatch machinery (gather/stacked vmap modes, the donating jit, the
+program LRU) lives in :mod:`metrics_tpu.engine.core`, shared with the fleet
+:class:`~metrics_tpu.engine.StreamEngine` which adds a masked mode on top
+(DESIGN §15). This module keeps the replica-shaped entry points — and the
+historical ``_REPLICA_JIT_CACHE`` name — for the wrappers built on them.
 
 The stacked state is engine-owned: no caller ever holds a reference to its
 buffers, so the compiled update donates them (``donate_argnums=(0,)``) and XLA
@@ -24,60 +22,27 @@ per-replica states back out lazily whenever user code touches ``.metrics``
 
 from __future__ import annotations
 
-from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.metric import (
-    Metric,
-    _CompiledUpdate,
-    _named_for_profiler,
-    _probation_dispatch,
-    _squeeze_if_scalar,
+from metrics_tpu.engine.core import (
+    _REPLICA_JIT_CACHE,
+    TRACER_ERRORS as _TRACER_ERRORS,
+    engine_compute,
+    engine_update,
 )
+from metrics_tpu.metric import Metric
 from metrics_tpu.observe import recorder as _observe
-from metrics_tpu.utils.exceptions import TraceIneligibleError
 from metrics_tpu.wrappers.abstract import WrapperMetric
 
 __all__ = ["ReplicatedWrapper", "replica_update", "replica_compute"]
 
-# Compiled vmapped replica programs, shared across wrapper instances whose
-# template metrics are config-equal (same economics as Metric._lookup_shared_jit).
-# Registered with metrics_tpu.clear_jit_cache().
-_REPLICA_JIT_CACHE: "OrderedDict[Any, _CompiledUpdate]" = OrderedDict()
-_REPLICA_JIT_CACHE_MAX = 64
-
-# Trace-time failures only: they abort before execution, so donated stacked
-# buffers are still intact and the caller can safely fall back to the loop.
-_TRACER_ERRORS = (
-    jax.errors.TracerBoolConversionError,
-    jax.errors.ConcretizationTypeError,
-    jax.errors.TracerArrayConversionError,
-    jax.errors.UnexpectedTracerError,
-    jax.errors.TracerIntegerConversionError,
-    TraceIneligibleError,
-)
-
 
 def _engine_label(template: Metric, n: int) -> str:
     return f"{type(template).__name__}x{n}"
-
-
-def _lookup_replica_entry(key: Any, build, label: str, n: int) -> _CompiledUpdate:
-    entry = _REPLICA_JIT_CACHE.get(key)
-    if entry is None:
-        entry = build()
-        _REPLICA_JIT_CACHE[key] = entry
-        _observe.note_replica_compile(label, n)
-        if len(_REPLICA_JIT_CACHE) > _REPLICA_JIT_CACHE_MAX:
-            _REPLICA_JIT_CACHE.popitem(last=False)
-    else:
-        _REPLICA_JIT_CACHE.move_to_end(key)
-        _observe.note_replica_hit(label)
-    return entry
 
 
 def replica_update(
@@ -94,48 +59,11 @@ def replica_update(
     resample of the shared batch inside the traced body; without it, array
     arguments are expected to already carry a leading replica axis.
     """
-    mode = "gather" if gather_idx is not None else "stacked"
-    kw_names = tuple(sorted(kwargs))
-    flat = tuple(args) + tuple(kwargs[k] for k in kw_names)
-    arr_flags = tuple(hasattr(a, "shape") for a in flat)
-    nargs = len(args)
-    donate = template._donation_eligible()
     label = _engine_label(template, n)
-    key = (template._jit_cache_key(), n, mode, nargs, kw_names, arr_flags, donate)
-
-    def build() -> _CompiledUpdate:
-        # a pristine clone is the traced representative, keeping user instances
-        # (and their accumulated states) out of the module-global cache
-        rep = template.clone()
-        rep.reset()
-        upd = _named_for_profiler(rep._functional_update, f"{type(rep).__name__}_replica_update")
-
-        if mode == "gather":
-
-            def one(st, idx, *leaves):
-                sel = [jnp.take(a, idx, axis=0) if f else a for a, f in zip(leaves, arr_flags)]
-                return upd(st, *sel[:nargs], **dict(zip(kw_names, sel[nargs:])))
-
-            in_axes = (0, 0) + (None,) * len(flat)
-        else:
-
-            def one(st, *leaves):
-                return upd(st, *leaves[:nargs], **dict(zip(kw_names, leaves[nargs:])))
-
-            in_axes = (0,) + tuple(0 if f else None for f in arr_flags)
-        return _CompiledUpdate(jax.vmap(one, in_axes=in_axes), donate)
-
-    entry = _lookup_replica_entry(key, build, label, n)
-    if entry.probation and entry.donate:
-        # the dispatch is not yet known-good: donate fresh copies so the engine's
-        # live stacked pytree survives as the rescue reference if the first
-        # dispatch dies mid-flight (transactional-update contract, DESIGN §14)
-        stacked = {k: jnp.copy(v) for k, v in stacked.items()}
-    call_args = (stacked, gather_idx) + flat if mode == "gather" else (stacked,) + flat
-    if entry.probation:
-        new_stacked = _probation_dispatch(entry, label, call_args, {})
-    else:
-        new_stacked = entry(*call_args)
+    new_stacked = engine_update(
+        template, n, stacked, args, kwargs,
+        gather_idx=gather_idx, cache=_REPLICA_JIT_CACHE, label=label,
+    )
     _observe.note_replica_dispatch(label)
     return new_stacked
 
@@ -148,16 +76,7 @@ def replica_compute(template: Metric, n: int, stacked: Dict[str, Any]) -> Any:
     replica's value matches what its ``Metric.compute()`` would have returned.
     """
     label = _engine_label(template, n)
-    key = (template._jit_cache_key(), n, "compute")
-
-    def build() -> _CompiledUpdate:
-        rep = template.clone()
-        rep.reset()
-        comp = _named_for_profiler(rep._functional_compute, f"{type(rep).__name__}_replica_compute")
-        return _CompiledUpdate(jax.vmap(lambda st: _squeeze_if_scalar(comp(st)), in_axes=(0,)), False)
-
-    entry = _lookup_replica_entry(key, build, label, n)
-    out = entry(stacked)
+    out = engine_compute(template, n, stacked, cache=_REPLICA_JIT_CACHE, label=label)
     _observe.note_replica_dispatch(label)
     return out
 

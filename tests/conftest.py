@@ -24,6 +24,12 @@ EXTRA_DIM = 3
 THRESHOLD = 0.5
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: acceptance-scale runs excluded from the tier-1 `-m 'not slow'` pass"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import numpy as np

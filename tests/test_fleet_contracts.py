@@ -1,0 +1,108 @@
+"""The ``fleet`` dynamic lint pass (``analysis/fleet_contracts.py``): per-class
+StreamEngine lifecycle contracts — churning 4-slot buckets cross-checked against
+per-instance oracles — plus its baseline diff/IO plumbing.
+
+The registry-wide sweep runs in CI (``tools/ci_check.sh`` via ``--all``); here we
+pin a few representative classes end to end and exercise the pass mechanics with
+synthetic results so failures localize.
+"""
+
+import json
+
+import pytest
+
+import metrics_tpu.analysis.fleet_contracts as fc
+from metrics_tpu import observe
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    observe.enable(reset=True)
+    yield
+    observe.disable()
+    clear_jit_cache()
+    jit_update_enabled(True)
+
+
+def _case(name):
+    for case in fc.fleet_cases():
+        if case.name == name:
+            return case
+    raise AssertionError(f"{name} not in fleet_cases()")
+
+
+def test_fleet_cases_is_the_jit_eligible_registry_slice():
+    names = {c.name for c in fc.fleet_cases()}
+    assert "MulticlassAccuracy" in names
+    assert "BinaryAUROC" in names
+    assert len(names) > 40  # the sweep covers the registry, not a hand-picked few
+
+
+@pytest.mark.parametrize("name", ["MulticlassAccuracy", "BinaryAUROC", "MeanSquaredError"])
+def test_representative_classes_agree(name):
+    result = fc.check_fleet_case(_case(name))
+    assert result.ok, result.render()
+    assert result.verdict in ("EXACT", "CLOSE")
+    assert result.donation in ("DONATED", "NON_DONATING")
+
+
+def test_mean_metric_runs_loose():
+    # MeanMetric's update signature is jit-ineligible per-call (weights kwarg
+    # variants), so the engine demotes it — the contract is LOOSE, not broken.
+    result = fc.check_fleet_case(_case("MeanMetric"))
+    assert result.ok, result.render()
+    assert result.verdict == "LOOSE"
+
+
+def test_diff_failures_and_stale_keys():
+    ok = fc.FleetResult("A", "EXACT", "DONATED")
+    bad = fc.FleetResult("B", "DIVERGED", "DONATED")
+    baselined = fc.FleetResult("C", "ERROR:donate-noop", "NOOP")
+    results = [ok, bad, baselined]
+    baseline = {"C": "known quirk", "Gone": "class was deleted"}
+    failures, stale = fc.diff_fleet_contract_baseline(results, baseline)
+    assert [r.name for r in failures] == ["B"]  # unbaselined disagreement fails
+    assert stale == ["Gone"]  # baselined entries must keep matching
+    # a baseline naming a now-healthy class is stale too
+    failures, stale = fc.diff_fleet_contract_baseline([ok], {"A": "was flaky"})
+    assert not failures and stale == ["A"]
+
+
+def test_baseline_roundtrip_and_run_fleet_check(tmp_path, monkeypatch):
+    results = [
+        fc.FleetResult("Good", "EXACT", "DONATED"),
+        fc.FleetResult("Bad", "DIVERGED", "DONATED", "states diverged at tick 2"),
+    ]
+    monkeypatch.setattr(fc, "collect_fleet_report", lambda cases=None: list(results))
+    path = str(tmp_path / "fleet_baseline.json")
+
+    report = {}
+    assert fc.run_fleet_check(str(tmp_path), baseline_path=path, quiet=True, report=report) == 1
+    assert report["cases"] == 2 and len(report["failures"]) == 1
+    assert report["verdicts"]["Bad"] == "DIVERGED"
+
+    assert fc.run_fleet_check(str(tmp_path), baseline_path=path, update_baseline=True, quiet=True) == 0
+    doc = json.loads(open(path).read())
+    assert list(doc["fleet"]) == ["Bad"]  # only disagreements are recorded
+    assert fc.load_fleet_contract_baseline(path) == doc["fleet"]
+
+    # baselined: same disagreement no longer fails the pass
+    report = {}
+    assert fc.run_fleet_check(str(tmp_path), baseline_path=path, quiet=True, report=report) == 0
+    assert report["baselined"] == 1 and not report["failures"]
+
+
+def test_repo_fleet_baseline_is_empty():
+    # the shipped contract: every registry class agrees with its oracle
+    import os
+
+    here = os.path.join(os.path.dirname(__file__), "..", "tools", "fleet_baseline.json")
+    doc = json.loads(open(here).read())
+    assert doc["fleet"] == {}
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -199,13 +199,15 @@ def test_fused_collection_counters():
 def test_snapshot_schema_is_stable_and_json_able():
     ObsSum().update(1.0)
     snap = observe.snapshot()
-    assert set(snap) == {"enabled", "counters", "timers", "events", "derived"}
+    assert set(snap) == {"enabled", "counters", "timers", "events", "gauges", "derived"}
     assert snap["enabled"] is True
     assert set(snap["derived"]) == {
         "jit_cache_hit_rate", "jit_compiles_total", "jit_cache_hits_total",
         "jit_cache_evictions_total", "eager_fallbacks_total",
         "updates_rolled_back_total", "ckpt_saves_total", "ckpt_restores_total",
         "sync_retries_total", "sync_degraded_total", "guard_quarantined_total",
+        "fleet_sessions_total", "fleet_capacity_total", "fleet_occupancy_pct",
+        "fleet_pad_waste_pct", "fleet_dispatches_total", "fleet_dispatches_per_flush",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():
@@ -238,6 +240,29 @@ def test_prometheus_text_format():
     assert 'metrics_tpu_update_seconds_sum{metric="ObsSum"} ' in text
     for line in text.strip().splitlines():
         assert line.startswith("#") or " " in line
+
+
+def test_fleet_derived_totals_aggregate_engine_gauges_and_counters():
+    from metrics_tpu import StreamEngine
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    engine = StreamEngine(initial_capacity=4)
+    sids = [engine.add_session(MulticlassAccuracy(num_classes=3)) for _ in range(3)]
+    for sid in sids:
+        engine.submit(sid, jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+    engine.tick()
+    derived = observe.snapshot()["derived"]
+    assert derived["fleet_sessions_total"] == 3
+    assert derived["fleet_capacity_total"] == 4
+    assert derived["fleet_occupancy_pct"] == pytest.approx(75.0)
+    assert derived["fleet_pad_waste_pct"] == pytest.approx(25.0)
+    assert derived["fleet_dispatches_total"] == 1
+    assert derived["fleet_dispatches_per_flush"] == pytest.approx(1.0)  # ≤1 dispatch/bucket/tick
+    # expiry refreshes the gauges the totals are summed from
+    engine.expire(sids[0])
+    derived = observe.snapshot()["derived"]
+    assert derived["fleet_sessions_total"] == 2
+    assert derived["fleet_occupancy_pct"] == pytest.approx(50.0)
 
 
 def test_reset_drops_telemetry_and_rearms_warnings():

@@ -269,6 +269,22 @@ def test_clear_jit_cache_drops_replica_cache():
     assert len(replicated_mod._REPLICA_JIT_CACHE) >= 1
 
 
+def test_replica_cache_eviction_counted():
+    old_max = replicated_mod._REPLICA_JIT_CACHE.max_entries
+    replicated_mod._REPLICA_JIT_CACHE.max_entries = 1
+    try:
+        p, t = _acc_batches(steps=1)[0]
+        _boot(True).update(p, t)
+        # a config-distinct wrapper needs its own program: LRU evicts the first
+        bs2 = BootStrapper(MulticlassAccuracy(num_classes=3, average="macro"), num_bootstraps=N_BOOT)
+        bs2.update(p, t)
+        snap = observe.snapshot()["counters"]
+        assert sum(snap["replica_evict"].values()) == 1
+        assert len(replicated_mod._REPLICA_JIT_CACHE) == 1
+    finally:
+        replicated_mod._REPLICA_JIT_CACHE.max_entries = old_max
+
+
 def test_materialization_never_reads_donated_buffers_100_steps():
     # donation × replication: the vmapped engine donates its stacked state
     # buffers, and `.metrics` / `state_dict()` materialize per-replica views

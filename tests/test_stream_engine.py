@@ -1,0 +1,588 @@
+"""Fleet engine (``engine/stream.py``, DESIGN §15): StreamEngine drives an
+arbitrary churning population of live Metric instances as bucketed, padded,
+masked vmapped dispatches — one donated XLA dispatch per bucket per tick.
+
+The contract pinned here: the engine is an invisible optimization — every
+session's state stays bit-identical to a per-instance loop oracle fed the
+identical batches, through arrival, expiry, slot recycling, idle (masked)
+ticks, multi-submission waves, and capacity growth; churn within padded
+capacity never recompiles (capacity doubling compiles exactly once per
+bucket); and sessions that cannot ride a bucket fall back to loose eager
+updates without ever losing a submission.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.engine.core as engine_core
+from metrics_tpu import Metric, StreamEngine, observe
+from metrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    observe.enable(reset=True)
+    yield
+    observe.disable()
+    clear_jit_cache()
+    jit_update_enabled(True)
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=4)
+
+
+def _acc_batch(rng, n=8):
+    return jnp.asarray(rng.randint(4, size=n)), jnp.asarray(rng.randint(4, size=n))
+
+
+def _auroc():
+    return BinaryAUROC(thresholds=8)
+
+
+def _auroc_batch(rng, n=8):
+    return jnp.asarray(rng.rand(n).astype(np.float32)), jnp.asarray(rng.randint(2, size=n))
+
+
+def _update_compiles():
+    counters = observe.snapshot()["counters"].get("fleet_compile", {})
+    return {k: v for k, v in counters.items() if not k.endswith(":compute")}
+
+
+def _state_rows(engine, sid):
+    sess = engine._sessions[sid]
+    if sess.bucket is None:
+        return dict(sess.metric._state)
+    return {k: v[sess.slot] for k, v in sess.bucket.stacked.items()}
+
+
+def _assert_state_equal(engine, sid, oracle):
+    row = _state_rows(engine, sid)
+    for k, ref in oracle._state.items():
+        np.testing.assert_array_equal(np.asarray(row[k]), np.asarray(ref), err_msg=f"state {k!r}")
+
+
+# --------------------------------------------------------------- bit-exactness
+def test_fleet_bit_exact_random_churn_vs_loop_oracle():
+    """Random arrival/expiry/interleaving over two heterogeneous bucket families:
+    every state bit-identical to a forced per-instance loop oracle."""
+    rng = np.random.RandomState(0)
+    engine = StreamEngine(initial_capacity=8)
+    families = [(_acc, _acc_batch), (_auroc, _auroc_batch)]
+    oracles, batchers = {}, {}
+
+    def arrive():
+        ctor, batch = families[rng.randint(2)]
+        sid = engine.add_session(ctor())
+        oracles[sid], batchers[sid] = ctor(), batch
+        return sid
+
+    for _ in range(24):
+        arrive()
+    for _tick in range(8):
+        for sid in list(oracles):
+            if rng.rand() < 0.3:
+                continue  # idle this tick: masked row must pass through untouched
+            args = batchers[sid](rng)
+            engine.submit(sid, *args)
+            oracles[sid].update(*args)
+        engine.tick()
+        # expiring sessions compute eagerly on their own sliced row: bit-exact
+        for sid in list(oracles):
+            if rng.rand() < 0.15:
+                retired = engine.expire(sid)
+                np.testing.assert_array_equal(
+                    np.asarray(retired.compute()), np.asarray(oracles.pop(sid).compute())
+                )
+                del batchers[sid]
+        while len(oracles) < 24:
+            arrive()
+
+    for sid, oracle in oracles.items():
+        _assert_state_equal(engine, sid, oracle)
+    values = engine.compute_all()
+    for sid, oracle in oracles.items():
+        np.testing.assert_allclose(
+            np.asarray(values[sid]), np.asarray(oracle.compute()), rtol=1e-6, atol=0
+        )
+
+
+@pytest.mark.slow
+def test_fleet_bit_exact_10k_sessions():
+    """The acceptance-scale fleet: 10k sessions, two classes, mid-run churn."""
+    rng = np.random.RandomState(1)
+    engine = StreamEngine(initial_capacity=8192)
+    families = [(_acc, _acc_batch), (_auroc, _auroc_batch)]
+    oracles, batchers = {}, {}
+    for ctor, batch in families:
+        for _ in range(5000):
+            sid = engine.add_session(ctor())
+            oracles[sid], batchers[sid] = ctor(), batch
+    for t in range(3):
+        for sid in list(oracles):
+            args = batchers[sid](rng)
+            engine.submit(sid, *args)
+            oracles[sid].update(*args)
+        engine.tick()
+        if t == 1:
+            for sid in list(oracles)[:100]:
+                retired = engine.expire(sid)
+                np.testing.assert_array_equal(
+                    np.asarray(retired.compute()), np.asarray(oracles.pop(sid).compute())
+                )
+                del batchers[sid]
+            for _ in range(100):
+                ctor, batch = families[rng.randint(2)]
+                sid = engine.add_session(ctor())
+                oracles[sid], batchers[sid] = ctor(), batch
+    assert max(_update_compiles().values()) == 1  # churn never recompiled
+    for sid in list(oracles)[::97]:  # every row lives in the same two stacks
+        _assert_state_equal(engine, sid, oracles[sid])
+
+
+def test_adopted_instance_keeps_accumulated_state():
+    rng = np.random.RandomState(2)
+    m, oracle = _acc(), _acc()
+    for _ in range(2):  # pre-adoption history rides into the bucket row
+        args = _acc_batch(rng)
+        m.update(*args)
+        oracle.update(*args)
+    engine = StreamEngine()
+    sid = engine.add_session(m)
+    args = _acc_batch(rng)
+    engine.submit(sid, *args)
+    oracle.update(*args)
+    engine.tick()
+    _assert_state_equal(engine, sid, oracle)
+    back = engine.expire(sid)
+    assert back is m
+    assert m._update_count == 3
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(oracle.compute()))
+
+
+# ------------------------------------------------------------- masking & slots
+def test_masked_rows_and_padding_never_contaminated():
+    rng = np.random.RandomState(3)
+    engine = StreamEngine(initial_capacity=4)
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    bucket = engine._sessions[sids[0]].bucket
+    idle_before = {k: np.asarray(v) for k, v in _state_rows(engine, sids[1]).items()}
+    virgin_slot = bucket.free[-1]
+    virgin_before = {k: np.asarray(v[virgin_slot]) for k, v in bucket.stacked.items()}
+    for sid in (sids[0], sids[2]):  # sids[1] idle: masked out of this dispatch
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    for k, ref in idle_before.items():
+        np.testing.assert_array_equal(np.asarray(_state_rows(engine, sids[1])[k]), ref)
+    for k, ref in virgin_before.items():
+        np.testing.assert_array_equal(np.asarray(bucket.stacked[k][virgin_slot]), ref)
+
+
+def test_compute_after_expiry():
+    rng = np.random.RandomState(4)
+    engine = StreamEngine()
+    sid = engine.add_session(_acc())
+    oracle = _acc()
+    args = _acc_batch(rng)
+    engine.submit(sid, *args)
+    oracle.update(*args)
+    engine.tick()
+    retired = engine.expire(sid)
+    # the handed-back instance is fully independent of the engine...
+    np.testing.assert_array_equal(np.asarray(retired.compute()), np.asarray(oracle.compute()))
+    args2 = _acc_batch(rng)
+    retired.update(*args2)
+    oracle.update(*args2)
+    np.testing.assert_array_equal(np.asarray(retired.compute()), np.asarray(oracle.compute()))
+    # ...and the engine no longer knows the session
+    with pytest.raises(KeyError):
+        engine.compute(sid)
+    with pytest.raises(KeyError):
+        engine.submit(sid, *args2)
+
+
+def test_expire_flushes_pending_submissions_first():
+    rng = np.random.RandomState(5)
+    engine = StreamEngine()
+    sid = engine.add_session(_acc())
+    oracle = _acc()
+    args = _acc_batch(rng)
+    engine.submit(sid, *args)  # still queued — no tick
+    oracle.update(*args)
+    retired = engine.expire(sid)
+    np.testing.assert_array_equal(np.asarray(retired.compute()), np.asarray(oracle.compute()))
+
+
+def test_slot_recycling_is_lifo_and_clean():
+    rng = np.random.RandomState(6)
+    engine = StreamEngine(initial_capacity=4)
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    freed_slot = engine._sessions[sids[1]].slot
+    engine.expire(sids[1])
+    newcomer = engine.add_session(_acc())
+    # the recycled hole is reused before untouched padding (LIFO free-list)
+    assert engine._sessions[newcomer].slot == freed_slot
+    # and the previous tenant's leftovers were scattered out
+    oracle = _acc()
+    _assert_state_equal(engine, newcomer, oracle)
+    args = _acc_batch(rng)
+    engine.submit(newcomer, *args)
+    oracle.update(*args)
+    engine.tick()
+    _assert_state_equal(engine, newcomer, oracle)
+
+
+# ------------------------------------------------------------------ ingest
+def test_ingest_waves_preserve_per_session_order():
+    rng = np.random.RandomState(7)
+    engine = StreamEngine()
+    a, b = engine.add_session(_acc()), engine.add_session(_acc())
+    oa, ob = _acc(), _acc()
+    a1, a2, b1 = _acc_batch(rng), _acc_batch(rng), _acc_batch(rng)
+    engine.submit(a, *a1)
+    engine.submit(a, *a2)  # second submission for `a` within one tick
+    engine.submit(b, *b1)
+    oa.update(*a1)
+    oa.update(*a2)
+    ob.update(*b1)
+    # wave 0 coalesces {a1, b1} into one dispatch; wave 1 carries a2 alone
+    assert engine.tick() == 2
+    _assert_state_equal(engine, a, oa)
+    _assert_state_equal(engine, b, ob)
+
+
+def test_distinct_batch_signatures_split_waves():
+    engine = StreamEngine()
+    a, b = engine.add_session(_acc()), engine.add_session(_acc())
+    oa, ob = _acc(), _acc()
+    wide = (jnp.asarray([0, 1, 2, 3]), jnp.asarray([0, 1, 2, 0]))
+    narrow = (jnp.asarray([1, 1]), jnp.asarray([1, 0]))
+    engine.submit(a, *wide)
+    engine.submit(b, *narrow)  # different aval: cannot share staging buffers
+    oa.update(*wide)
+    ob.update(*narrow)
+    assert engine.tick() == 2
+    _assert_state_equal(engine, a, oa)
+    _assert_state_equal(engine, b, ob)
+
+
+def test_submit_is_lazy_until_tick():
+    engine = StreamEngine()
+    sid = engine.add_session(_acc())
+    engine.submit(sid, jnp.asarray([1, 2]), jnp.asarray([1, 2]))
+    assert not observe.snapshot()["counters"].get("fleet_dispatch")
+    engine.tick()
+    assert sum(observe.snapshot()["counters"]["fleet_dispatch"].values()) == 1
+
+
+# ------------------------------------------------------------------ buckets
+def test_heterogeneous_classes_one_dispatch_per_bucket():
+    rng = np.random.RandomState(8)
+    engine = StreamEngine()
+    for _ in range(4):
+        sid = engine.add_session(_acc())
+        engine.submit(sid, *_acc_batch(rng))
+    for _ in range(4):
+        sid = engine.add_session(_auroc())
+        engine.submit(sid, *_auroc_batch(rng))
+    assert len(engine._buckets) == 2
+    assert engine.tick() == 2  # 8 streams, 2 buckets, 2 dispatches
+
+
+def test_config_fingerprint_splits_buckets():
+    engine = StreamEngine()
+    engine.add_session(MulticlassAccuracy(num_classes=4))
+    engine.add_session(MulticlassAccuracy(num_classes=7))  # different config
+    engine.add_session(MulticlassAccuracy(num_classes=4))  # shares the first
+    assert len(engine._buckets) == 2
+
+
+def test_no_recompile_for_churn_within_capacity():
+    rng = np.random.RandomState(9)
+    engine = StreamEngine(initial_capacity=8)
+    sids = [engine.add_session(_acc()) for _ in range(4)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    assert _update_compiles() == {engine._buckets[next(iter(engine._buckets))].label: 1}
+    for sid in sids[:2]:
+        engine.expire(sid)
+    sids = sids[2:] + [engine.add_session(_acc()) for _ in range(3)]  # 5 of 8 slots
+    for _ in range(2):
+        for sid in sids:
+            engine.submit(sid, *_acc_batch(rng))
+        engine.tick()
+    assert max(_update_compiles().values()) == 1  # arrival/expiry changed data, not shapes
+
+
+def test_capacity_doubling_compiles_exactly_once_per_bucket():
+    rng = np.random.RandomState(10)
+    engine = StreamEngine(initial_capacity=2)
+    sids = [engine.add_session(_acc()) for _ in range(2)]
+    oracles = {sid: _acc() for sid in sids}
+
+    def feed_all():
+        for sid in sids:
+            args = _acc_batch(rng)
+            engine.submit(sid, *args)
+            oracles[sid].update(*args)
+        engine.tick()
+
+    feed_all()
+    assert max(_update_compiles().values()) == 1
+    sids.append(engine.add_session(_acc()))  # third arrival: 2 -> 4 rows
+    oracles[sids[-1]] = _acc()
+    bucket = next(iter(engine._buckets.values()))
+    assert bucket.capacity == 4
+    feed_all()
+    assert max(_update_compiles().values()) == 2  # ONE new program for the new shape
+    feed_all()
+    assert max(_update_compiles().values()) == 2
+    for sid in sids:  # growth moved rows; nothing may have been lost or mixed
+        _assert_state_equal(engine, sid, oracles[sid])
+
+
+def test_compute_is_cached_until_state_changes():
+    rng = np.random.RandomState(11)
+    engine = StreamEngine()
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.compute_all()
+    engine.compute(sids[0])  # same bucket version: served from the cached stack
+    counters = observe.snapshot()["counters"]
+    assert sum(counters["fleet_compute_dispatch"].values()) == 1
+    engine.submit(sids[0], *_acc_batch(rng))
+    engine.compute(sids[0])  # flushes, version bumps, recomputes
+    counters = observe.snapshot()["counters"]
+    assert sum(counters["fleet_compute_dispatch"].values()) == 2
+
+
+# ------------------------------------------------------------------ loose path
+class _AnySum(Metric):
+    """Accepts any array-like — including Python lists, which are jit-ineligible."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(jnp.asarray(x, dtype=jnp.float32))
+
+    def compute(self):
+        return self.total
+
+
+def test_batch_ineligible_submission_demotes_to_loose():
+    engine = StreamEngine()
+    sid = engine.add_session(_AnySum())
+    oracle = _AnySum()
+    args = (jnp.asarray([1.0, 2.0]),)
+    engine.submit(sid, *args)
+    oracle.update(*args)
+    engine.tick()
+    # a Python-list batch cannot enter a traced dispatch
+    engine.submit(sid, [3.0, 4.0])
+    oracle.update([3.0, 4.0])
+    engine.tick()
+    sess = engine._sessions[sid]
+    assert sess.bucket is None  # demoted, row handed back
+    np.testing.assert_array_equal(np.asarray(engine.compute(sid)), np.asarray(oracle.compute()))
+    assert sum(observe.snapshot()["counters"]["fleet_loose_update"].values()) == 1
+
+
+class _HostOnlyUpdate(Metric):
+    """Traceable signature, untraceable body: demotes its bucket at first flush."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("peak", jnp.asarray(0.0), dist_reduce_fx="max")
+
+    def update(self, x):
+        from metrics_tpu.utils.checks import _is_traced
+        from metrics_tpu.utils.exceptions import TraceIneligibleError
+
+        if _is_traced(x):
+            raise TraceIneligibleError("needs concrete data")
+        self.peak = jnp.maximum(self.peak, jnp.asarray(float(np.max(np.asarray(x)))))
+
+    def compute(self):
+        return self.peak
+
+
+def test_tracer_failure_demotes_bucket_and_replays_every_submission():
+    engine = StreamEngine()
+    a = engine.add_session(_HostOnlyUpdate())
+    b = engine.add_session(_HostOnlyUpdate())
+    assert engine._sessions[a].bucket is not None  # eligible until proven otherwise
+    engine.submit(a, jnp.asarray([1.0, 5.0]))
+    engine.submit(b, jnp.asarray([3.0, 2.0]))
+    engine.submit(a, jnp.asarray([4.0, 0.5]))
+    engine.tick()  # trace fails -> bucket dissolves -> eager replay, nothing lost
+    assert engine._sessions[a].bucket is None and engine._sessions[b].bucket is None
+    assert float(engine.compute(a)) == 5.0
+    assert float(engine.compute(b)) == 3.0
+    snap = observe.snapshot()["counters"]
+    assert sum(snap["fleet_fallback"].values()) == 1
+    assert sum(snap["fleet_loose_update"].values()) == 3
+    # the loose sessions keep absorbing updates through the same API
+    engine.submit(b, jnp.asarray([9.0]))
+    engine.tick()
+    assert float(engine.compute(b)) == 9.0
+
+
+class _HostOnlyCompute(Metric):
+    """Traceable update, untraceable compute: buckets fine, computes per-row."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        from metrics_tpu.utils.checks import _is_traced
+        from metrics_tpu.utils.exceptions import TraceIneligibleError
+
+        if _is_traced(self.total):
+            raise TraceIneligibleError("host-side compute")
+        return self.total
+
+
+def test_compute_trace_failure_falls_back_to_per_row_compute():
+    engine = StreamEngine()
+    a = engine.add_session(_HostOnlyCompute())
+    b = engine.add_session(_HostOnlyCompute())
+    engine.submit(a, jnp.asarray([1.0, 2.0]))
+    engine.submit(b, jnp.asarray([10.0, 0.0]))
+    assert engine.tick() == 1  # updates still ride ONE vmapped dispatch
+    assert float(engine.compute(a)) == 3.0
+    assert float(engine.compute(b)) == 10.0
+    assert engine._sessions[a].bucket is not None  # compute fallback ≠ demotion
+    assert sum(observe.snapshot()["counters"]["fleet_fallback"].values()) == 1
+
+
+# ------------------------------------------------------------------ lifecycle
+def test_reset_single_session():
+    rng = np.random.RandomState(13)
+    engine = StreamEngine()
+    a, b = engine.add_session(_acc()), engine.add_session(_acc())
+    ob = _acc()
+    for sid in (a, b):
+        args = _acc_batch(rng)
+        engine.submit(sid, *args)
+        if sid == b:
+            ob.update(*args)
+    engine.tick()
+    engine.submit(a, *_acc_batch(rng))  # queued work dies with the reset
+    engine.reset(a)
+    engine.tick()
+    _assert_state_equal(engine, a, _acc())  # back to defaults
+    _assert_state_equal(engine, b, ob)  # neighbor row untouched
+    assert engine._sessions[a].metric._update_count == 0
+
+
+def test_reset_whole_fleet():
+    rng = np.random.RandomState(14)
+    engine = StreamEngine()
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.reset()
+    for sid in sids:
+        _assert_state_equal(engine, sid, _acc())
+
+
+def test_add_session_rejects_duplicates_and_non_metrics():
+    engine = StreamEngine()
+    engine.add_session(_acc(), session_id="s1")
+    with pytest.raises(TPUMetricsUserError, match="already live"):
+        engine.add_session(_acc(), session_id="s1")
+    with pytest.raises(TPUMetricsUserError, match="expects a Metric"):
+        engine.add_session("not a metric")
+    with pytest.raises(TPUMetricsUserError, match="initial_capacity"):
+        StreamEngine(initial_capacity=0)
+
+
+def test_clear_jit_cache_drops_fleet_cache():
+    rng = np.random.RandomState(15)
+    engine = StreamEngine()
+    sid = engine.add_session(_acc())
+    engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.compute(sid)
+    assert len(engine_core._FLEET_JIT_CACHE) >= 2  # update + compute programs
+    clear_jit_cache()
+    assert len(engine_core._FLEET_JIT_CACHE) == 0
+
+
+def test_fleet_cache_eviction_recorded():
+    rng = np.random.RandomState(16)
+    old_max = engine_core._FLEET_JIT_CACHE.max_entries
+    engine_core._FLEET_JIT_CACHE.max_entries = 1
+    try:
+        engine = StreamEngine()
+        for ctor, batch in ((_acc, _acc_batch), (_auroc, _auroc_batch)):
+            sid = engine.add_session(ctor())
+            engine.submit(sid, *batch(rng))
+            engine.tick()  # second bucket's compile evicts the first's program
+        counters = observe.snapshot()["counters"]
+        assert sum(counters["fleet_evict"].values()) == 1
+        assert any(e["kind"] == "fleet_evict" for e in observe.snapshot()["events"])
+    finally:
+        engine_core._FLEET_JIT_CACHE.max_entries = old_max
+
+
+# ------------------------------------------------------------------ telemetry
+def test_stats_occupancy_fragmentation_and_pad_waste():
+    rng = np.random.RandomState(17)
+    engine = StreamEngine(initial_capacity=8)
+    sids = [engine.add_session(_acc()) for _ in range(5)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.expire(sids[1])  # a hole below the high-water mark
+    stats = engine.stats()
+    (label,) = stats["buckets"]
+    b = stats["buckets"][label]
+    assert b["capacity"] == 8 and b["active"] == 4
+    assert b["fragmented"] == 1
+    assert b["occupancy_pct"] == pytest.approx(50.0)
+    assert b["pad_waste_pct"] == pytest.approx(50.0)
+    assert stats["sessions"] == 4 and stats["loose_sessions"] == 0
+    assert stats["rows_active"] == 4 and stats["rows_capacity"] == 8
+    # the same numbers land in observe gauges for the snapshot() fleet totals
+    gauges = observe.snapshot()["gauges"]
+    assert gauges["fleet_rows_active"][label] == 4
+    assert gauges["fleet_rows_capacity"][label] == 8
+    assert gauges["fleet_rows_fragmented"][label] == 1
+
+
+def test_stream_engine_root_export():
+    import metrics_tpu
+
+    assert metrics_tpu.StreamEngine is StreamEngine
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
 # + donlint), the donation three-way cross-check, the chaos fault-injection
-# harness, and the perf cost ratchet — all via `lint_metrics.py --all`, which
-# aggregates their exit codes.
+# harness, the fleet-engine contract pass, and the perf cost ratchet (which
+# also drives the 64-stream StreamEngine smoke and pins its dispatch economy
+# against the `fleet` section of tools/perf_baseline.json) — all via
+# `lint_metrics.py --all`, which aggregates their exit codes.
 #
 #   tools/ci_check.sh            # text report, exit 0 clean / 1 violations / 2 usage
 #   tools/ci_check.sh --json     # one machine-readable document on stdout
