@@ -119,6 +119,11 @@ _LAZY_EXPORTS = {
     "WordInfoLost": "metrics_tpu.text",
     "WordInfoPreserved": "metrics_tpu.text",
     "StreamEngine": "metrics_tpu.engine",
+    "DDSketch": "metrics_tpu.sketches",
+    "HyperLogLog": "metrics_tpu.sketches",
+    "ReservoirSample": "metrics_tpu.sketches",
+    "StreamingAUROC": "metrics_tpu.sketches",
+    "StreamingCalibrationError": "metrics_tpu.sketches",
     "BootStrapper": "metrics_tpu.wrappers",
     "ClasswiseWrapper": "metrics_tpu.wrappers",
     "MetricTracker": "metrics_tpu.wrappers",
@@ -130,7 +135,8 @@ _LAZY_EXPORTS = {
 _LAZY_SUBPACKAGES = (
     "audio", "classification", "clustering", "detection", "engine", "functional", "image",
     "integration", "models", "multimodal", "nominal", "observe", "ops", "parallel",
-    "regression", "resilience", "retrieval", "segmentation", "shape", "text", "utils", "wrappers",
+    "regression", "resilience", "retrieval", "segmentation", "shape", "sketches", "text",
+    "utils", "wrappers",
 )
 
 

@@ -164,6 +164,23 @@ CONTRACTS: List[KernelContract] = [
         "segmentation.generalized_dice_score", (i32(2, _C, 16, 16), i32(2, _C, 16, 16)),
         {"num_classes": _C, "input_format": "one-hot"},
     ),
+    # ---- sketches (fixed-shape mergeable stream state) -------------------------
+    KernelContract(
+        "sketches.ddsketch_delta", (f32(_N), i32(_N)),
+        {"alpha": 0.01, "key_offset": -64, "num_buckets": 128},
+    ),
+    KernelContract(
+        "sketches.ddsketch_quantiles", (i32(128), i32(128), i32()),
+        {"quantiles": (0.5, 0.99), "alpha": 0.01, "key_offset": -64},
+    ),
+    KernelContract("sketches.hll_delta", (f32(_N), i32(_N)), {"p": 8}),
+    KernelContract("sketches.hll_estimate", (i32(256),)),
+    KernelContract("sketches.reservoir_fold", (f32(3, 8), f32(_N), i32(_N)), {"seed": 7}),
+    KernelContract("sketches.reservoir_merge", (f32(2, 3, 8),)),
+    KernelContract("sketches.score_hist_delta", (f32(_N), i32(_N), i32(_N)), {"num_bins": 32}),
+    KernelContract("sketches.binned_auroc", (i32(32), i32(32))),
+    KernelContract("sketches.calibration_delta", (f32(_N), i32(_N), i32(_N)), {"num_bins": 10}),
+    KernelContract("sketches.binned_ece", (f32(10), i32(10), i32(10))),
 ]
 
 

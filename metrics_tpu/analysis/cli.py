@@ -42,6 +42,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import Dict, List, Optional, Sequence
 
 from metrics_tpu.analysis.contexts import DIST_RULE_CODES, MEM_RULE_CODES, RULE_CODES
@@ -177,6 +178,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     exit_code = 0
     report: Dict[str, object] = {}
     for name in passes:
+        # per-pass wall time rides the --json report so CI can spot slow passes
+        t_start = time.perf_counter()
         if name in _DYNAMIC:
             if explicit_rules is not None:
                 continue  # dynamic passes have no rule codes; --rules selects AST rules only
@@ -191,6 +194,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
             if pass_report is not None:
                 pass_report["status"] = "fail" if rc else "ok"
+                pass_report["wall_s"] = round(time.perf_counter() - t_start, 3)
                 report[name] = pass_report
             if rc:
                 exit_code = 1
@@ -223,6 +227,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "baselined": baselined,
                 "inline_suppressed": result.suppressed,
                 "stale_baseline_keys": stale,
+                "wall_s": round(time.perf_counter() - t_start, 3),
             }
         else:
             for v in new:

@@ -305,6 +305,13 @@ def _make_cases() -> List[MergeCase]:
              mc_batch),
         case("MultioutputWrapper", lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2),
              lambda r: (_rand(r, 10, 2), _rand(r, 10, 2))),
+        # ---- sketches (exactly mergeable by construction, DESIGN §16) ----------
+        case("DDSketch", lambda: M.DDSketch(num_buckets=512), lambda r: (_rand(r, 10) + 0.01,)),
+        case("HyperLogLog", lambda: M.HyperLogLog(p=8), lambda r: (_rand(r, 10),)),
+        case("ReservoirSample", lambda: M.ReservoirSample(k=8), lambda r: (_rand(r, 10),)),
+        case("StreamingAUROC", lambda: M.StreamingAUROC(num_bins=64), bin_batch),
+        case("StreamingCalibrationError", lambda: M.StreamingCalibrationError(num_bins=10),
+             bin_batch),
     ]
 
 

@@ -1,0 +1,50 @@
+"""Functional sketch kernels: fixed-shape mergeable summaries of unbounded streams.
+
+The L1 layer of the sketch family (DESIGN §16): pure, branch-free jnp kernels
+that bucketize/fold one batch into fixed-shape state deltas and evaluate the
+final estimate. The modular classes in :mod:`metrics_tpu.sketches` are thin
+state-plumbing over these.
+"""
+
+from metrics_tpu.functional.sketches.ddsketch import (
+    ddsketch_delta,
+    ddsketch_gamma,
+    ddsketch_quantiles,
+)
+from metrics_tpu.functional.sketches.ecdf import (
+    binned_auroc,
+    binned_auroc_bound,
+    binned_ece,
+    calibration_delta,
+    score_hist_delta,
+    uniform_edges,
+)
+from metrics_tpu.functional.sketches.hashing import fmix32, hash32
+from metrics_tpu.functional.sketches.hll import hll_delta, hll_estimate, hll_std_error
+from metrics_tpu.functional.sketches.reservoir import (
+    reservoir_empty,
+    reservoir_fold,
+    reservoir_merge,
+    reservoir_values,
+)
+
+__all__ = [
+    "binned_auroc",
+    "binned_auroc_bound",
+    "binned_ece",
+    "calibration_delta",
+    "ddsketch_delta",
+    "ddsketch_gamma",
+    "ddsketch_quantiles",
+    "fmix32",
+    "hash32",
+    "hll_delta",
+    "hll_estimate",
+    "hll_std_error",
+    "reservoir_empty",
+    "reservoir_fold",
+    "reservoir_merge",
+    "reservoir_values",
+    "score_hist_delta",
+    "uniform_edges",
+]

@@ -1,0 +1,136 @@
+"""Binned-ECDF streaming curve metrics: AUROC and calibration error.
+
+Exact AUROC needs every score (to rank positives against negatives) and exact
+top-label calibration needs every (confidence, correctness) pair. Both have a
+fixed-memory sketch: histogram the scores into B equal-width bins over [0, 1]
+and evaluate the curve on the binned ECDF. The states are plain per-bin
+counts/sums with ``sum`` algebra — exactly mergeable, donation-eligible,
+fleet-stackable.
+
+The AUROC estimator gives every (positive, negative) pair in *different* bins
+its exact Mann-Whitney contribution and pairs sharing a bin half credit, so
+the estimation error is bounded by the sketch itself:
+
+    |AUROC_binned − AUROC_exact| ≤ ½ · Σ_b (pos_b/P)·(neg_b/N)
+
+(:func:`binned_auroc_bound` — the mass of same-bin pairs, each off by at most
+½). The oracle tests assert this bound, not an eyeballed tolerance. The
+binned ECE with the *same* bin edges as the exact metric is not an
+approximation at all — binning is part of ECE's definition — so it matches
+the exact computation to float rounding.
+
+Bucketizing runs through :func:`metrics_tpu.ops.binned_hist.histogram_counts`
+so the compare dtype and the count accumulator stay pinned (f32/int32) even
+when ``jax_enable_x64`` makes freshly-built bin edges f64.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.binned_hist import histogram_counts
+from metrics_tpu.utils.data import bincount_weighted
+
+__all__ = [
+    "binned_auroc",
+    "binned_auroc_bound",
+    "binned_ece",
+    "calibration_delta",
+    "score_hist_delta",
+    "uniform_edges",
+]
+
+
+def uniform_edges(num_bins: int) -> Array:
+    """B+1 equal-width bin edges over [0, 1]."""
+    if num_bins < 2:
+        raise ValueError(f"`num_bins` must be >= 2, got {num_bins}")
+    return jnp.linspace(0.0, 1.0, num_bins + 1)
+
+
+def score_hist_delta(
+    preds: Array, target: Array, valid: Array, *, num_bins: int
+) -> Tuple[Array, Array]:
+    """One batch of scores split into ``(pos, neg)`` per-bin int32 count deltas.
+
+    ``preds`` are probability scores (clipped into [0, 1]); ``target`` is
+    {0, 1}. Non-finite scores are dropped branch-free.
+    """
+    p = preds.astype(jnp.float32).reshape(-1)
+    t = jnp.asarray(target).reshape(-1)
+    ok = jnp.asarray(valid, bool).reshape(-1) & jnp.isfinite(p)
+    p = jnp.clip(p, 0.0, 1.0)
+    edges = uniform_edges(num_bins)
+    pos = histogram_counts(p, ok & (t == 1), edges)
+    neg = histogram_counts(p, ok & (t != 1), edges)
+    return pos, neg
+
+
+def binned_auroc(pos: Array, neg: Array) -> Array:
+    """AUROC of the binned ECDF; () f32, 0.0 while either class is empty.
+
+    Σ_b [ neg_below_b · pos_b + ½ · pos_b · neg_b ] / (P·N): cross-bin pairs
+    counted exactly, same-bin pairs at half credit.
+    """
+    posf = pos.astype(jnp.float32)
+    negf = neg.astype(jnp.float32)
+    p_tot = jnp.sum(posf)
+    n_tot = jnp.sum(negf)
+    neg_below = jnp.cumsum(negf) - negf
+    num = jnp.sum(neg_below * posf + 0.5 * posf * negf)
+    denom = p_tot * n_tot
+    return jnp.where(denom > 0, num / jnp.maximum(denom, 1.0), 0.0)
+
+
+def binned_auroc_bound(pos: Array, neg: Array) -> Array:
+    """Worst-case |binned − exact| AUROC error, computed from the sketch: the
+    probability mass of (positive, negative) pairs sharing a bin, halved."""
+    posf = pos.astype(jnp.float32)
+    negf = neg.astype(jnp.float32)
+    denom = jnp.sum(posf) * jnp.sum(negf)
+    same_bin = jnp.sum(posf * negf)
+    return jnp.where(denom > 0, 0.5 * same_bin / jnp.maximum(denom, 1.0), 0.0)
+
+
+def calibration_delta(
+    preds: Array, target: Array, valid: Array, *, num_bins: int
+) -> Tuple[Array, Array, Array]:
+    """One binary-classification batch → ``(conf_sum, count, correct)`` deltas.
+
+    Top-label convention: predicted label is ``p >= 0.5``, confidence is the
+    probability of the predicted label (``max(p, 1−p)`` — lives in [0.5, 1]),
+    a prediction is correct when the label matches ``target``. ``conf_sum`` is
+    f32 per-bin summed confidence; ``count``/``correct`` are int32 per-bin
+    counts.
+    """
+    p = preds.astype(jnp.float32).reshape(-1)
+    t = jnp.asarray(target).reshape(-1)
+    ok = jnp.asarray(valid, bool).reshape(-1) & jnp.isfinite(p)
+    p = jnp.clip(p, 0.0, 1.0)
+    label = (p >= 0.5).astype(t.dtype)
+    conf = jnp.maximum(p, 1.0 - p)
+    hit = ok & (label == t)
+    edges = uniform_edges(num_bins)
+    count = histogram_counts(conf, ok, edges)
+    correct = histogram_counts(conf, hit, edges)
+    idx = jnp.clip(
+        jnp.searchsorted(edges.astype(jnp.float32), conf, side="right") - 1,
+        0,
+        num_bins - 1,
+    ).astype(jnp.int32)
+    conf_sum = bincount_weighted(
+        jnp.where(ok, idx, num_bins), jnp.where(ok, conf, 0.0), num_bins + 1
+    )[:num_bins].astype(jnp.float32)
+    return conf_sum, count, correct
+
+
+def binned_ece(conf_sum: Array, count: Array, correct: Array) -> Array:
+    """Expected calibration error (L1) from the per-bin states; () f32."""
+    cnt = count.astype(jnp.float32)
+    n = jnp.sum(cnt)
+    safe = jnp.maximum(cnt, 1.0)
+    gap = jnp.abs(correct.astype(jnp.float32) / safe - conf_sum / safe)
+    return jnp.where(n > 0, jnp.sum(cnt * gap) / jnp.maximum(n, 1.0), 0.0)

@@ -1,0 +1,49 @@
+"""Branch-free 32-bit hashing for sketch states.
+
+Every sketch in this package (HyperLogLog registers, bottom-k reservoir
+priorities) needs a deterministic, well-mixed hash of array *values* that is
+pure XLA: no host round-trips, no data-dependent shapes, vmap-batchable. JAX's
+32-bit default mode has no uint64, so the whole pipeline is uint32 — the
+murmur3 finalizer (``fmix32``) gives full avalanche on 32 bits, which is
+enough for the register/priority widths used here (p ≤ 16 index bits + rank,
+16+16-bit priorities).
+
+Seeding XORs the seed into the value bits *before* finalizing, so different
+seeds yield independent hash families (the reservoir's sampling seed, HLL's
+stream-salt) while ``seed=0`` stays the canonical reproducible default.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+__all__ = ["fmix32", "hash32"]
+
+
+def fmix32(h: Array) -> Array:
+    """murmur3's 32-bit finalizer: full avalanche, uint32 in/out."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash32(values: Array, seed: int = 0) -> Array:
+    """Elementwise uint32 hash of ``values`` (same shape out as in).
+
+    Floats hash their f32 bit pattern (−0.0 collapsed onto +0.0 so the two
+    representations of zero count as one distinct value); integers and bools
+    hash their value modulo 2^32. NaNs hash to the canonical-NaN pattern —
+    callers mask them out with their own validity mask.
+    """
+    v = jnp.asarray(values)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v32 = v.astype(jnp.float32)
+        v32 = jnp.where(v32 == 0.0, 0.0, v32)
+        bits = lax.bitcast_convert_type(v32, jnp.uint32)
+    else:
+        bits = v.astype(jnp.uint32)
+    return fmix32(bits ^ jnp.uint32(seed & 0xFFFFFFFF))

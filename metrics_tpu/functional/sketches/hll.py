@@ -1,0 +1,76 @@
+"""HyperLogLog distinct counting on 32-bit hashes.
+
+Flajolet et al.'s HyperLogLog splits each hash into a ``p``-bit register
+index and a 32−p bit suffix whose leading-zero *rank* the register tracks as
+a running max — m = 2^p int32 registers estimate cardinality with standard
+error ``1.04/√m`` regardless of stream length. The register array is the
+textbook mergeable sketch: elementwise ``max`` is associative, commutative,
+and idempotent, so cross-shard sync reuses the builtin ``"max"`` algebra and
+re-merging a shard twice is harmless.
+
+The update kernel returns a register *delta* (a batch folded into an all-zero
+register array) so the Metric layer folds with ``jnp.maximum`` — the extremal
+idiom distlint's DL002 recognizes statically. Everything is scatter-max +
+``jnp.where``: branch-free, jit/vmap-clean, fixed shape.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+from metrics_tpu.functional.sketches.hashing import hash32
+
+__all__ = ["hll_delta", "hll_estimate", "hll_std_error"]
+
+
+def hll_std_error(p: int) -> float:
+    """Theoretical standard error of the estimate: 1.04/√(2^p)."""
+    return 1.04 / math.sqrt(float(1 << p))
+
+
+def hll_delta(values: Array, valid: Array, *, p: int, seed: int = 0) -> Array:
+    """One batch folded into a fresh (2^p,) int32 register array.
+
+    Invalid rows contribute rank 0 — the register identity under max — so
+    masking needs no branches. ``p`` must be in [4, 16]: below 4 the bias
+    correction constants don't hold, above 16 the 32-bit hash leaves fewer
+    than 16 suffix bits of rank resolution.
+    """
+    if not 4 <= p <= 16:
+        raise ValueError(f"`p` must be in [4, 16], got {p}")
+    m = 1 << p
+    v = jnp.asarray(values).reshape(-1)
+    ok = jnp.asarray(valid, bool).reshape(-1)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        ok = ok & jnp.isfinite(v)
+    h = hash32(v, seed)
+    idx = (h >> jnp.uint32(32 - p)).astype(jnp.int32)
+    suffix = h << jnp.uint32(p)  # suffix bits left-aligned; low p bits zero
+    rank = jnp.minimum(lax.clz(suffix).astype(jnp.int32) + 1, 32 - p + 1)
+    rank = jnp.where(ok, rank, 0)
+    return jnp.zeros((m,), jnp.int32).at[idx].max(rank)
+
+
+def hll_estimate(registers: Array) -> Array:
+    """Cardinality estimate from a register array; () f32.
+
+    The raw harmonic-mean estimate with the standard two corrections, both
+    branch-free via ``jnp.where``: linear counting when the estimate is small
+    and empty registers remain, and the 32-bit hash-collision correction when
+    the estimate approaches 2^32.
+    """
+    m = registers.shape[0]
+    # bias constants from the HLL paper: exact for the small register counts,
+    # the asymptotic formula from m = 128 up
+    alpha_m = {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7213 / (1.0 + 1.079 / m))
+    regs = registers.astype(jnp.float32)
+    raw = alpha_m * m * m / jnp.sum(jnp.exp2(-regs))
+    zeros = jnp.sum(registers == 0).astype(jnp.float32)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+    two32 = 4294967296.0
+    large = -two32 * jnp.log(jnp.maximum(1.0 - est / two32, 1e-12))
+    return jnp.where(est > two32 / 30.0, large, est)

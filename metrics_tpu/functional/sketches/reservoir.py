@@ -1,0 +1,80 @@
+"""Seeded bottom-k reservoir sampling with exactly-mergeable fixed state.
+
+Classic reservoir sampling (Vitter's algorithm R) is *order-sensitive*: its
+acceptance probabilities depend on how many elements each shard has already
+seen, so merging two reservoirs is not associative. The bottom-k variant used
+here assigns every element a *priority* — a pure seeded hash of its value —
+and keeps the k elements with the smallest priorities. "k smallest of a
+multiset" is a rank filter: associative, commutative, idempotent under any
+split of the stream, so shard merges are bit-exact, not just statistically
+equivalent, and the merge harness can hold the sketch to EXACT agreement.
+
+The state packs into one (3, k) f32 array — rows ``[prio_hi, prio_lo,
+value]`` — because the runtime's merge layer reduces each named state
+independently: value and priority must travel in a single buffer so the merge
+can select whole (priority, value) pairs. The uint32 priority splits into two
+16-bit halves, each exactly representable in f32 (< 2^24). Empty slots carry
+``prio_hi = 65536`` — one above any real 16-bit half — so they sort after
+every live element and need no separate occupancy mask.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.sketches.hashing import hash32
+
+__all__ = [
+    "EMPTY_PRIORITY_HI",
+    "reservoir_empty",
+    "reservoir_fold",
+    "reservoir_merge",
+    "reservoir_values",
+]
+
+EMPTY_PRIORITY_HI = 65536.0  # real halves are <= 65535; empties sort last
+
+
+def reservoir_empty(k: int) -> Array:
+    """The (3, k) all-empty packed state."""
+    if k < 1:
+        raise ValueError(f"`k` must be >= 1, got {k}")
+    packed = jnp.zeros((3, k), jnp.float32)
+    return packed.at[0].set(EMPTY_PRIORITY_HI)
+
+
+def _bottom_k(packed: Array, k: int) -> Array:
+    """Rows with the k smallest (hi, lo, value) keys, packed back to (3, k)."""
+    hi, lo, val = packed[0], packed[1], packed[2]
+    order = jnp.lexsort((val, lo, hi))[:k]
+    return jnp.stack([hi[order], lo[order], val[order]])
+
+
+def reservoir_fold(packed: Array, values: Array, valid: Array, *, seed: int = 0) -> Array:
+    """Fold one batch into the packed state: bottom-k of (state ∪ batch)."""
+    k = packed.shape[1]
+    v = values.astype(jnp.float32).reshape(-1)
+    ok = jnp.asarray(valid, bool).reshape(-1) & jnp.isfinite(v)
+    h = hash32(v, seed)
+    hi = jnp.where(ok, (h >> jnp.uint32(16)).astype(jnp.float32), EMPTY_PRIORITY_HI)
+    lo = jnp.where(ok, (h & jnp.uint32(0xFFFF)).astype(jnp.float32), 0.0)
+    batch = jnp.stack([hi, lo, jnp.where(ok, v, 0.0)])
+    return _bottom_k(jnp.concatenate([packed, batch], axis=1), k)
+
+
+def reservoir_merge(stacked: Array) -> Array:
+    """Reduce (s, 3, k) stacked shard states to one (3, k) bottom-k state.
+
+    This is the custom ``dist_reduce_fx`` the ReservoirSample metric declares
+    ``merge_associative=True`` for: bottom-k of a union is invariant under
+    shard order and grouping.
+    """
+    k = stacked.shape[-1]
+    flat = jnp.moveaxis(stacked, 0, 1).reshape(3, -1)
+    return _bottom_k(flat, k)
+
+
+def reservoir_values(packed: Array) -> Array:
+    """Sampled values, (k,) f32; unfilled slots read 0.0."""
+    return jnp.where(packed[0] < EMPTY_PRIORITY_HI, packed[2], 0.0)

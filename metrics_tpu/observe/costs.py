@@ -263,6 +263,14 @@ def _make_cases() -> List[ProfileCase]:
         case("GeneralizedDiceScore",
              lambda: S.GeneralizedDiceScore(num_classes=_C, input_format="index"), seg_batch),
         case("Perplexity", M.Perplexity, lambda r: (_probs(r, 2, 8, 16), _randint(r, 16, 2, 8))),
+        # ---- sketches (fixed-shape mergeable stream state, DESIGN §16) ---------
+        case("DDSketch", lambda: M.DDSketch(num_buckets=512),
+             lambda r: (_rand(r, _N) + 0.01,)),
+        case("HyperLogLog", lambda: M.HyperLogLog(p=8), lambda r: (_rand(r, _N),)),
+        case("ReservoirSample", lambda: M.ReservoirSample(k=16), lambda r: (_rand(r, _N),)),
+        case("StreamingAUROC", lambda: M.StreamingAUROC(num_bins=128), bin_batch),
+        case("StreamingCalibrationError", lambda: M.StreamingCalibrationError(num_bins=10),
+             bin_batch),
     ]
 
 

@@ -482,7 +482,10 @@ def _prom_name(name: str) -> str:
 
 
 def _prom_label(label: str) -> str:
-    return label.replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+    # exposition-format label escaping: backslash first, then quote, and
+    # newline as the literal two characters ``\n`` — replacing it with a space
+    # (the old behavior) silently aliased distinct label values
+    return label.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
 def prometheus() -> str:

@@ -31,7 +31,13 @@ import jax.numpy as jnp
 from jax import Array
 from jax.experimental import pallas as pl
 
-__all__ = ["binned_counts_pallas", "binned_kernel_plan", "pallas_binned_fits", "use_pallas_binned"]
+__all__ = [
+    "binned_counts_pallas",
+    "binned_kernel_plan",
+    "histogram_counts",
+    "pallas_binned_fits",
+    "use_pallas_binned",
+]
 
 _T_CHUNK = 128  # threshold-chunk width: one lane-aligned block of compares per step
 _VMEM_ELEMS = 1 << 20  # budget for the (tile, C, T_CHUNK) compare block
@@ -88,6 +94,34 @@ def binned_kernel_plan() -> Tuple[bool, bool]:
 def use_pallas_binned() -> bool:
     """Route the binned curve update through the Pallas kernel?"""
     return binned_kernel_plan()[0]
+
+
+def histogram_counts(values: Array, valid: Array, edges: Array) -> Array:
+    """Masked bucket counts over explicit edges with PINNED dtypes.
+
+    Returns (len(edges)−1,) int32 counts of ``values`` falling in
+    ``[edges[i], edges[i+1])`` (under-/overflow clamped into the end bins,
+    NaNs and masked rows dropped). The compare runs in f32 and the
+    accumulator is int32 *by construction*: under ``jax_enable_x64`` a
+    freshly-built edge array (``jnp.linspace``) is f64, and letting it meet
+    f32 values would silently upcast the bucketize compare — and any
+    weighted accumulation keyed on it — to 64 bit, changing the histogram's
+    dtype (and therefore the state aval, breaking donation/jit-cache reuse)
+    between 32- and 64-bit runs. Every sketch-state histogram goes through
+    here for exactly that reason.
+    """
+    from metrics_tpu.utils.data import bincount
+
+    num_bins = edges.shape[0] - 1
+    v = values.astype(jnp.float32).reshape(-1)
+    ok = jnp.asarray(valid, bool).reshape(-1) & ~jnp.isnan(v)
+    idx = jnp.clip(
+        jnp.searchsorted(edges.astype(jnp.float32), v, side="right") - 1,
+        0,
+        num_bins - 1,
+    ).astype(jnp.int32)
+    # masked rows scatter into a discarded overflow bin — branch-free
+    return bincount(jnp.where(ok, idx, num_bins), num_bins + 1)[:num_bins]
 
 
 def _kernel(p_ref, pos_ref, neg_ref, thr_ref, tp_ref, fp_ref, ptot_ref, ntot_ref, *, t_pad: int):

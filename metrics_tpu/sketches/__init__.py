@@ -1,0 +1,23 @@
+"""Sketch-state metrics: bounded-memory summaries of unbounded streams (DESIGN §16).
+
+Every class here holds *fixed-shape* state with a declared associative merge
+algebra — the combination that makes the whole family donation-eligible on
+the single-dispatch hot path, stackable into ``StreamEngine`` fleet buckets,
+checkpointable, and exactly shard-mergeable under distlint's split-update-
+merge harness. Accuracy is traded for memory with a *theoretical* bound per
+sketch (DDSketch relative error α, HyperLogLog standard error 1.04/√m,
+binned-AUROC same-bin pair mass), each asserted by the oracle tests.
+"""
+
+from metrics_tpu.sketches.cardinality import HyperLogLog
+from metrics_tpu.sketches.curve import StreamingAUROC, StreamingCalibrationError
+from metrics_tpu.sketches.quantile import DDSketch
+from metrics_tpu.sketches.sample import ReservoirSample
+
+__all__ = [
+    "DDSketch",
+    "HyperLogLog",
+    "ReservoirSample",
+    "StreamingAUROC",
+    "StreamingCalibrationError",
+]

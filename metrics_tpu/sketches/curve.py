@@ -1,0 +1,110 @@
+"""Binned-ECDF streaming curve metrics: AUROC and calibration error (modular layer)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.sketches.ecdf import (
+    binned_auroc,
+    binned_auroc_bound,
+    binned_ece,
+    calibration_delta,
+    score_hist_delta,
+)
+from metrics_tpu.metric import Metric
+
+__all__ = ["StreamingAUROC", "StreamingCalibrationError"]
+
+
+class StreamingAUROC(Metric):
+    """Binary AUROC over an unbounded score stream in O(num_bins) memory.
+
+    Two per-bin int32 histograms (positive/negative scores over ``num_bins``
+    equal-width bins of [0, 1], ``sum`` algebra). Cross-bin pairs contribute
+    their exact Mann-Whitney term; same-bin pairs get half credit, so
+    ``|compute() − exact| ≤ error_bound()`` — a bound the sketch computes
+    from its own state, asserted (not eyeballed) by the oracle tests.
+
+    Args:
+        num_bins: score histogram resolution; the error bound shrinks with
+            the same-bin pair mass, i.e. roughly with 1/num_bins.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, num_bins: int = 2048, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if num_bins < 2:
+            raise ValueError(f"`num_bins` must be >= 2, got {num_bins}")
+        self.num_bins = int(num_bins)
+        self.add_state(
+            "pos_hist", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+        )
+        self.add_state(
+            "neg_hist", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds)
+        d_pos, d_neg = score_hist_delta(
+            preds, target, jnp.ones(preds.shape, bool), num_bins=self.num_bins
+        )
+        self.pos_hist = self.pos_hist + d_pos
+        self.neg_hist = self.neg_hist + d_neg
+
+    def compute(self) -> Array:
+        return binned_auroc(self.pos_hist, self.neg_hist)
+
+    def error_bound(self) -> Array:
+        """Worst-case |compute() − exact AUROC|, from the current state."""
+        return binned_auroc_bound(self.pos_hist, self.neg_hist)
+
+
+class StreamingCalibrationError(Metric):
+    """Top-label expected calibration error (L1) over an unbounded stream.
+
+    Per-bin confidence sums plus prediction/correct counts (``sum`` algebra)
+    over ``num_bins`` equal-width confidence bins. Binning is part of ECE's
+    definition, so against an exact ECE computed with the *same* bins this
+    sketch is not an approximation at all — it agrees to float rounding while
+    holding O(num_bins) state instead of the stream.
+
+    Args:
+        num_bins: confidence bins (the reference metric's ``n_bins``).
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_bins: int = 15, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if num_bins < 2:
+            raise ValueError(f"`num_bins` must be >= 2, got {num_bins}")
+        self.num_bins = int(num_bins)
+        self.add_state(
+            "conf_sum", default=jnp.zeros((self.num_bins,), jnp.float32), dist_reduce_fx="sum"
+        )
+        self.add_state(
+            "bin_count", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+        )
+        self.add_state(
+            "bin_correct", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds)
+        d_conf, d_count, d_correct = calibration_delta(
+            preds, target, jnp.ones(preds.shape, bool), num_bins=self.num_bins
+        )
+        self.conf_sum = self.conf_sum + d_conf
+        self.bin_count = self.bin_count + d_count
+        self.bin_correct = self.bin_correct + d_correct
+
+    def compute(self) -> Array:
+        return binned_ece(self.conf_sum, self.bin_count, self.bin_correct)

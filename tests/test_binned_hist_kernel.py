@@ -109,3 +109,58 @@ def test_pallas_fits_gate():
     assert pallas_binned_fits(1000, 4, 100)
     assert not pallas_binned_fits(1 << 25, 4, 100)  # f32 count exactness bound
     assert not pallas_binned_fits(1000, 4096, 200)  # accumulators would not fit VMEM
+
+
+# --------------------------------------------------------------------- x64 dtype pinning
+def test_histogram_counts_pins_dtypes_under_x64():
+    """With ``jax_enable_x64`` on, f64 edges (e.g. from ``jnp.linspace``) must
+    not upcast the compare or widen the accumulator: ``histogram_counts``
+    pins values/edges to f32 and returns int32 regardless of the x64 flag."""
+    import jax
+    from metrics_tpu.ops.binned_hist import histogram_counts
+
+    vals32 = np.array([0.05, 0.15, 0.15, 0.95, np.nan], np.float32)
+    valid = np.array([1, 1, 1, 1, 1], bool)
+    want = np.array([1, 2, 0, 0, 0, 0, 0, 0, 0, 1], np.int64)
+
+    with jax.experimental.enable_x64():
+        edges64 = jnp.linspace(0.0, 1.0, 11)  # f64 under x64 — the hazard
+        assert edges64.dtype == jnp.float64
+        out = histogram_counts(jnp.asarray(vals32), jnp.asarray(valid), edges64)
+        assert out.dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(out), want)
+
+    out32 = histogram_counts(jnp.asarray(vals32), jnp.asarray(valid), jnp.linspace(0.0, 1.0, 11))
+    np.testing.assert_array_equal(np.asarray(out32), want)
+    assert out32.dtype == jnp.int32
+
+
+def test_binned_confusion_tensor_stays_int32_under_x64():
+    import jax
+
+    preds = jnp.asarray(_R.rand(64, 1).astype(np.float32))
+    target = jnp.asarray(_R.randint(0, 2, (64, 1)))
+    valid = jnp.ones((64,), bool)
+    thresholds = _adjust_threshold_arg(10)
+    base = np.asarray(_binned_confusion_tensor(preds, target, valid, thresholds))
+    with jax.experimental.enable_x64():
+        bins = _binned_confusion_tensor(preds, target, valid, jnp.asarray(thresholds))
+        assert bins.dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(bins), base)
+
+
+def test_sketch_deltas_stay_pinned_under_x64():
+    """The sketch kernels ride ``histogram_counts``/``bincount`` — their count
+    states must stay int32 (f32 for conf sums) when callers enable x64."""
+    import jax
+    from metrics_tpu.functional.sketches import calibration_delta, score_hist_delta
+
+    preds = jnp.asarray(_R.rand(32).astype(np.float32))
+    target = jnp.asarray(_R.randint(0, 2, 32).astype(np.int32))
+    valid = jnp.ones((32,), bool)
+    with jax.experimental.enable_x64():
+        pos, neg = score_hist_delta(preds, target, valid, num_bins=16)
+        conf, cnt, hit = calibration_delta(preds, target, valid, num_bins=10)
+    assert pos.dtype == jnp.int32 and neg.dtype == jnp.int32
+    assert cnt.dtype == jnp.int32 and hit.dtype == jnp.int32
+    assert conf.dtype == jnp.float32

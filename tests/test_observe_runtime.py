@@ -242,6 +242,19 @@ def test_prometheus_text_format():
         assert line.startswith("#") or " " in line
 
 
+def test_prometheus_label_values_escaped_per_exposition_format():
+    """Backslash, double quote, and newline in label values must be escaped
+    (as ``\\\\``, ``\\"``, and the two characters ``\\n``), keeping every
+    sample on one line and distinct labels distinct."""
+    rec_mod.note_jit_compile(metric='A\\B"C\nD')
+    rec_mod.note_jit_compile(metric="A\\B\"C D")  # would collide if \n → space
+    text = observe.prometheus()
+    assert 'metric="A\\\\B\\"C\\nD"' in text
+    assert 'metric="A\\\\B\\"C D"' in text
+    series = [l for l in text.splitlines() if 'metric="A' in l]
+    assert len(series) == 2 and all(l.endswith(" 1") for l in series)
+
+
 def test_fleet_derived_totals_aggregate_engine_gauges_and_counters():
     from metrics_tpu import StreamEngine
     from metrics_tpu.classification import MulticlassAccuracy

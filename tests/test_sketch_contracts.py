@@ -1,0 +1,115 @@
+"""Merge/donation contract sweeps for the sketch family (satellite of DESIGN §16).
+
+Three layers of assurance on top of the generic registry sweeps:
+
+* the five sketch classes are registered in ``MERGE_CASES`` and classify
+  ``MERGE_SOUND`` under the harness's unequal-shard + permutation layout;
+* an exhaustive property check — *every* permutation of the shard merge order
+  and several distinct split shapes reproduce the single-pass result;
+* the 3-way donation contract (static donlint × costs.py eligibility ×
+  runtime buffer deletion) agrees for every sketch.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from metrics_tpu.analysis.merge_contracts import (
+    MERGE_CASES,
+    check_merge_case,
+)
+
+SKETCH_NAMES = (
+    "DDSketch",
+    "HyperLogLog",
+    "ReservoirSample",
+    "StreamingAUROC",
+    "StreamingCalibrationError",
+)
+
+
+def _sketch_cases():
+    cases = {c.name: c for c in MERGE_CASES if c.name in SKETCH_NAMES}
+    missing = sorted(set(SKETCH_NAMES) - set(cases))
+    assert not missing, f"sketch classes absent from MERGE_CASES: {missing}"
+    return [cases[n] for n in SKETCH_NAMES]
+
+
+def _deterministic_batches(case, n):
+    return [case.batch(np.random.RandomState(1000 + i)) for i in range(n)]
+
+
+def _single_pass(case, batches):
+    m = case.ctor()
+    for args in batches:
+        m.update(*args)
+    return m.compute()
+
+
+def _merged(case, shards, order):
+    """Fold shard replicas in the given order via the public merge_state API."""
+    replicas = []
+    for shard in shards:
+        m = case.ctor()
+        for args in shard:
+            m.update(*args)
+        replicas.append(m)
+    acc = replicas[order[0]]
+    for i in order[1:]:
+        acc.merge_state(replicas[i])
+    return acc.compute()
+
+
+@pytest.fixture(scope="module", params=SKETCH_NAMES)
+def sketch_case(request):
+    return {c.name: c for c in _sketch_cases()}[request.param]
+
+
+def test_all_sketches_registered_and_merge_sound():
+    for case in _sketch_cases():
+        result = check_merge_case(case)
+        assert result.classification == "MERGE_SOUND", (
+            f"{case.name}: {result.classification} — {result.detail}"
+        )
+
+
+def test_every_shard_permutation_reproduces_single_pass(sketch_case):
+    batches = _deterministic_batches(sketch_case, 6)
+    shards = [batches[0:2], batches[2:3], batches[3:6]]  # deliberately unequal
+    expect = np.asarray(_single_pass(sketch_case, batches))
+    for order in itertools.permutations(range(len(shards))):
+        got = np.asarray(_merged(sketch_case, shards, order))
+        assert np.allclose(got, expect, rtol=2e-3, atol=1e-5), (
+            f"{sketch_case.name}: shard order {order} diverged from single pass"
+        )
+
+
+def test_split_shape_does_not_matter(sketch_case):
+    batches = _deterministic_batches(sketch_case, 6)
+    expect = np.asarray(_single_pass(sketch_case, batches))
+    splits = (
+        [batches[:1], batches[1:]],
+        [batches[:3], batches[3:]],
+        [batches[:5], batches[5:]],
+        [[b] for b in batches],  # one replica per batch
+    )
+    for shards in splits:
+        got = np.asarray(_merged(sketch_case, shards, tuple(range(len(shards)))))
+        assert np.allclose(got, expect, rtol=2e-3, atol=1e-5), (
+            f"{sketch_case.name}: split into {len(shards)} shards diverged"
+        )
+
+
+def test_three_way_donation_contract_agrees_for_every_sketch():
+    from metrics_tpu.analysis.donation_contracts import check_donation_case, donation_cases
+
+    cases = [c for c in donation_cases() if c.name in SKETCH_NAMES]
+    assert sorted(c.name for c in cases) == sorted(SKETCH_NAMES), (
+        "every sketch must be in the jit-eligible donation slice"
+    )
+    for case in cases:
+        r = check_donation_case(case)
+        assert r.static_eligible, f"{r.name}: donlint says ineligible — {r.static_detail}"
+        assert r.costs_eligible, f"{r.name}: costs.py says ineligible"
+        assert r.agree, f"{r.name}: 3-way donation contract disagrees — {r.detail}"

@@ -1,0 +1,256 @@
+"""Unit tests for the sketch metric family (DESIGN §16).
+
+Small-stream correctness, merge/reset/checkpoint lifecycle, donation
+eligibility, and StreamEngine fleet integration. The ≥1e6-element error-bound
+oracles live in ``test_sketches_oracle.py``; the registry-driven
+merge/donation contract sweeps in ``test_sketch_contracts.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.sketches import (
+    DDSketch,
+    HyperLogLog,
+    ReservoirSample,
+    StreamingAUROC,
+    StreamingCalibrationError,
+)
+
+ALL_SKETCHES = [DDSketch, HyperLogLog, ReservoirSample, StreamingAUROC, StreamingCalibrationError]
+
+
+def _binary_batch(rng, n=64):
+    return (
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, n).astype(np.int32)),
+    )
+
+
+def _small(cls):
+    """A cheap instance + matching batch source for lifecycle tests."""
+    rng = np.random.RandomState(3)
+    if cls is DDSketch:
+        return DDSketch(num_buckets=256), lambda: (jnp.asarray(rng.rand(32).astype(np.float32) + 0.01),)
+    if cls is HyperLogLog:
+        return HyperLogLog(p=8), lambda: (jnp.asarray(rng.rand(32).astype(np.float32)),)
+    if cls is ReservoirSample:
+        return ReservoirSample(k=8), lambda: (jnp.asarray(rng.rand(32).astype(np.float32)),)
+    if cls is StreamingAUROC:
+        return StreamingAUROC(num_bins=64), lambda: _binary_batch(rng, 32)
+    return StreamingCalibrationError(num_bins=10), lambda: _binary_batch(rng, 32)
+
+
+# --------------------------------------------------------------------------- DDSketch
+def test_ddsketch_relative_error_within_alpha():
+    rng = np.random.RandomState(0)
+    vals = np.exp(rng.randn(50_000)).astype(np.float32)
+    m = DDSketch(alpha=0.02, quantiles=(0.1, 0.5, 0.9, 0.99))
+    for chunk in np.split(vals, 5):
+        m.update(jnp.asarray(chunk))
+    est = np.asarray(m.compute())
+    exact = np.quantile(vals, (0.1, 0.5, 0.9, 0.99))
+    assert np.all(np.abs(est - exact) / exact <= 0.02)
+
+
+def test_ddsketch_handles_negative_zero_and_nonfinite():
+    vals = np.array([-4.0, -1.0, 0.0, 0.0, 1.0, 4.0, np.nan, np.inf], np.float32)
+    m = DDSketch(alpha=0.01, quantiles=(0.0, 0.5, 1.0), num_buckets=256)
+    m.update(jnp.asarray(vals))
+    lo, med, hi = np.asarray(m.compute())
+    # NaN/inf dropped: 6 finite values, median rank lands on a zero
+    assert lo == pytest.approx(-4.0, rel=0.01)
+    assert med == 0.0
+    assert hi == pytest.approx(4.0, rel=0.01)
+    assert int(m.zero_count) == 2
+
+
+def test_ddsketch_empty_compute_is_zero_and_reset_restores():
+    m = DDSketch(num_buckets=256)
+    assert np.all(np.asarray(m.compute()) == 0.0)
+    m.update(jnp.asarray([1.0, 2.0], jnp.float32))
+    m.reset()
+    assert np.all(np.asarray(m.compute()) == 0.0)
+
+
+def test_ddsketch_key_offset_defaults_scale_with_num_buckets():
+    # a small sketch must still cover magnitudes around 1.0 by default
+    m = DDSketch(alpha=0.01, quantiles=(0.5,), num_buckets=128)
+    m.update(jnp.asarray(np.full(100, 3.0, np.float32)))
+    assert float(m.compute()) == pytest.approx(3.0, rel=0.01)
+
+
+# --------------------------------------------------------------------------- HyperLogLog
+def test_hll_estimate_within_five_sigma():
+    n = 40_000
+    vals = (np.arange(n, dtype=np.int64) * 2654435761 % (2**31)).astype(np.int32)
+    m = HyperLogLog(p=10)
+    for chunk in np.split(vals, 4):
+        m.update(jnp.asarray(chunk))
+    est = float(m.compute())
+    assert abs(est - n) / n <= 5 * m.std_error
+
+
+def test_hll_small_range_linear_counting():
+    m = HyperLogLog(p=12)
+    m.update(jnp.arange(100, dtype=jnp.int32))
+    assert float(m.compute()) == pytest.approx(100, abs=5)
+
+
+def test_hll_duplicates_do_not_inflate():
+    m = HyperLogLog(p=10)
+    for _ in range(5):
+        m.update(jnp.arange(1000, dtype=jnp.int32))  # same 1000 values, 5 times
+    assert float(m.compute()) == pytest.approx(1000, rel=5 * m.std_error)
+
+
+def test_hll_merge_is_idempotent():
+    rng = np.random.RandomState(2)
+    a, b = HyperLogLog(p=8), HyperLogLog(p=8)
+    a.update(jnp.asarray(rng.rand(500).astype(np.float32)))
+    b.update(jnp.asarray(rng.rand(500).astype(np.float32)))
+    a.merge_state(b)
+    once = float(a.compute())
+    a.merge_state(b)  # max algebra: re-merging the same shard changes nothing
+    assert float(a.compute()) == once
+
+
+# --------------------------------------------------------------------------- ReservoirSample
+def _bottom_k_oracle(vals: np.ndarray, k: int, seed: int) -> np.ndarray:
+    from metrics_tpu.functional.sketches.hashing import hash32
+
+    h = np.asarray(hash32(jnp.asarray(vals), seed)).astype(np.uint64)
+    order = np.lexsort((vals, h & 0xFFFF, h >> 16))
+    return np.sort(vals[order[:k]])
+
+
+def test_reservoir_matches_exact_bottom_k():
+    rng = np.random.RandomState(4)
+    vals = rng.rand(3000).astype(np.float32)
+    m = ReservoirSample(k=32, seed=11)
+    for chunk in np.split(vals, 6):
+        m.update(jnp.asarray(chunk))
+    got = np.sort(np.asarray(m.compute()))
+    assert np.array_equal(got, _bottom_k_oracle(vals, 32, 11))
+
+
+def test_reservoir_seed_selects_different_samples():
+    rng = np.random.RandomState(5)
+    vals = jnp.asarray(rng.rand(1000).astype(np.float32))
+    a, b = ReservoirSample(k=16, seed=0), ReservoirSample(k=16, seed=1)
+    a.update(vals)
+    b.update(vals)
+    assert not np.array_equal(np.asarray(a.compute()), np.asarray(b.compute()))
+
+
+def test_reservoir_underfilled_slots_read_zero():
+    m = ReservoirSample(k=8)
+    m.update(jnp.asarray([5.0, 7.0], jnp.float32))
+    out = np.sort(np.asarray(m.compute()))
+    assert np.allclose(out[-2:], [5.0, 7.0]) and np.all(out[:-2] == 0.0)
+
+
+# --------------------------------------------------------------------------- curves
+def test_streaming_auroc_within_own_bound():
+    rng = np.random.RandomState(6)
+    n = 4000
+    t = (rng.rand(n) < 0.4).astype(np.int32)
+    s = np.clip(0.35 * t + 0.5 * rng.rand(n), 0, 1).astype(np.float32)
+    m = StreamingAUROC(num_bins=256)
+    for ts, ss in zip(np.split(t, 4), np.split(s, 4)):
+        m.update(jnp.asarray(ss), jnp.asarray(ts))
+    est = float(m.compute())
+    bound = float(m.error_bound())
+    from metrics_tpu.functional import auroc as exact_auroc
+
+    exact = float(exact_auroc(jnp.asarray(s), jnp.asarray(t), task="binary"))
+    assert abs(est - exact) <= bound + 1e-5
+    assert bound < 0.05
+
+
+def test_streaming_auroc_empty_class_is_zero():
+    m = StreamingAUROC(num_bins=32)
+    m.update(jnp.asarray([0.2, 0.8], jnp.float32), jnp.asarray([1, 1]))
+    assert float(m.compute()) == 0.0  # no negatives yet — undefined, pinned to 0
+
+
+def test_streaming_ece_matches_same_binned_oracle():
+    rng = np.random.RandomState(7)
+    n = 5000
+    t = (rng.rand(n) < 0.5).astype(np.int32)
+    s = rng.rand(n).astype(np.float32)
+    num_bins = 15
+    m = StreamingCalibrationError(num_bins=num_bins)
+    for ts, ss in zip(np.split(t, 5), np.split(s, 5)):
+        m.update(jnp.asarray(ss), jnp.asarray(ts))
+    conf = np.maximum(s, 1 - s)
+    hit = ((s >= 0.5).astype(np.int32) == t)
+    edges = np.linspace(0, 1, num_bins + 1)
+    idx = np.clip(np.searchsorted(edges.astype(np.float32), conf, side="right") - 1, 0, num_bins - 1)
+    oracle = sum(
+        (idx == b).sum() / n * abs(hit[idx == b].mean() - conf[idx == b].mean())
+        for b in range(num_bins)
+        if (idx == b).any()
+    )
+    assert float(m.compute()) == pytest.approx(oracle, abs=1e-5)
+
+
+# --------------------------------------------------------------------------- family-wide lifecycle
+@pytest.mark.parametrize("cls", ALL_SKETCHES, ids=lambda c: c.__name__)
+def test_sketches_are_donation_eligible_with_fixed_avals(cls):
+    m, batch = _small(cls)
+    assert m._donation_eligible(), "fixed-shape sketch state must ride the donated hot path"
+    m.update(*batch())
+    avals_1 = m.state_avals()
+    m.update(*batch())
+    assert m.state_avals() == avals_1, "update must not change any state aval"
+
+
+@pytest.mark.parametrize("cls", ALL_SKETCHES, ids=lambda c: c.__name__)
+def test_sketches_checkpoint_roundtrip(cls, tmp_path):
+    from metrics_tpu.resilience.checkpoint import restore_checkpoint, save_checkpoint
+
+    m, batch = _small(cls)
+    m.update(*batch())
+    path = save_checkpoint(m, tmp_path / "sketch.ckpt")
+    fresh, _ = _small(cls)
+    restore_checkpoint(fresh, path)
+    assert np.array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+
+@pytest.mark.parametrize("cls", ALL_SKETCHES, ids=lambda c: c.__name__)
+def test_sketches_compile_once_across_same_shape_updates(cls):
+    observe.enable(reset=True)
+    try:
+        m, batch = _small(cls)
+        for _ in range(4):
+            m.update(*batch())
+        compiles = observe.snapshot()["counters"].get("jit_compile", {})
+        assert compiles.get(cls.__name__, 0) <= 1, compiles
+    finally:
+        observe.disable()
+
+
+def test_sketches_run_inside_stream_engine_bucket():
+    from metrics_tpu import StreamEngine
+
+    observe.enable(reset=True)
+    try:
+        rng = np.random.RandomState(9)
+        engine = StreamEngine(initial_capacity=4)
+        sids = [engine.add_session(DDSketch(num_buckets=256)) for _ in range(3)]
+        solo = DDSketch(num_buckets=256)
+        batches = [jnp.asarray(rng.rand(32).astype(np.float32) + 0.01) for _ in range(3)]
+        for sid, b in zip(sids, batches):
+            engine.submit(sid, b)
+        solo.update(batches[0])
+        engine.tick()
+        derived = observe.snapshot()["derived"]
+        # the 1-dispatch/bucket/tick economy must hold for sketch buckets too
+        assert derived["fleet_dispatches_per_flush"] == pytest.approx(1.0)
+        assert np.allclose(np.asarray(engine.compute(sids[0])), np.asarray(solo.compute()))
+    finally:
+        observe.disable()

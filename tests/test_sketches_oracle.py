@@ -1,0 +1,162 @@
+"""Error-bound oracle tests: every sketch vs the exact metric on ≥1e6-element streams.
+
+Each test streams at least one million elements through a sketch in chunks,
+computes the exact answer from the full stream, and asserts the *theoretical*
+error bound from DESIGN §16 — DDSketch's relative-error α, HyperLogLog's
+1.04/√m standard error (at 5σ), the binned-AUROC same-bin-pair bound computed
+from the sketch's own state, and bit-exactness for the bottom-k reservoir.
+Shard-split merge equivalence is asserted at the same scale.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.sketches import (
+    DDSketch,
+    HyperLogLog,
+    ReservoirSample,
+    StreamingAUROC,
+    StreamingCalibrationError,
+)
+
+N = 1_000_000
+CHUNKS = 8
+
+
+def _stream(vals, *arrs):
+    """Yield aligned chunk tuples of the full stream."""
+    pieces = [np.array_split(a, CHUNKS) for a in (vals, *arrs)]
+    for parts in zip(*pieces):
+        yield tuple(jnp.asarray(p) for p in parts)
+
+
+def _distinct_ints(n: int) -> np.ndarray:
+    # n guaranteed-distinct int32 values without materialising a 2^31 permutation
+    return (np.arange(n, dtype=np.int64) * 2654435761 % (2**31)).astype(np.int32)
+
+
+def test_ddsketch_quantiles_within_alpha_on_1e6_stream():
+    rng = np.random.RandomState(0)
+    vals = np.exp(rng.randn(N)).astype(np.float32)  # heavy-tailed, spans ~1e-5..1e5
+    qs = (0.01, 0.25, 0.5, 0.9, 0.99, 0.999)
+    m = DDSketch(alpha=0.01, quantiles=qs)
+    for (chunk,) in _stream(vals):
+        m.update(chunk)
+    est = np.asarray(m.compute())
+    exact = np.quantile(vals, qs)
+    rel = np.abs(est - exact) / np.abs(exact)
+    assert np.all(rel <= 0.01), f"relative errors {rel} exceed alpha"
+
+
+def test_ddsketch_shard_merge_equals_single_pass_at_1e6():
+    rng = np.random.RandomState(1)
+    vals = rng.lognormal(size=N).astype(np.float32)
+    single = DDSketch(alpha=0.02, num_buckets=1024)
+    shards = [DDSketch(alpha=0.02, num_buckets=1024) for _ in range(4)]
+    for i, (chunk,) in enumerate(_stream(vals)):
+        single.update(chunk)
+        shards[i % 4].update(chunk)
+    merged = shards[0]
+    for s in shards[1:]:
+        merged.merge_state(s)
+    # integer count states: shard merge is bit-exact, not merely close
+    assert np.array_equal(np.asarray(merged.compute()), np.asarray(single.compute()))
+
+
+def test_hll_within_five_sigma_on_1e6_distinct():
+    vals = _distinct_ints(N)
+    m = HyperLogLog(p=12)  # m=4096 registers, std error 1.04/64 ≈ 1.625%
+    for (chunk,) in _stream(vals):
+        m.update(chunk)
+    est = float(m.compute())
+    assert m.std_error == pytest.approx(1.04 / np.sqrt(4096))
+    assert abs(est - N) / N <= 5 * m.std_error
+
+
+def test_hll_shard_merge_equals_single_pass_at_1e6():
+    vals = _distinct_ints(N)
+    single = HyperLogLog(p=10)
+    shards = [HyperLogLog(p=10) for _ in range(4)]
+    for i, (chunk,) in enumerate(_stream(vals)):
+        single.update(chunk)
+        shards[i % 4].update(chunk)
+    merged = shards[0]
+    for s in shards[1:]:
+        merged.merge_state(s)
+    assert np.array_equal(np.asarray(merged.registers), np.asarray(single.registers))
+
+
+def test_reservoir_is_exact_bottom_k_at_1e6():
+    from metrics_tpu.functional.sketches.hashing import hash32
+
+    rng = np.random.RandomState(2)
+    vals = rng.rand(N).astype(np.float32)
+    k, seed = 64, 5
+    m = ReservoirSample(k=k, seed=seed)
+    shards = [ReservoirSample(k=k, seed=seed) for _ in range(4)]
+    for i, (chunk,) in enumerate(_stream(vals)):
+        m.update(chunk)
+        shards[i % 4].update(chunk)
+    h = np.asarray(hash32(jnp.asarray(vals), seed)).astype(np.uint64)
+    order = np.lexsort((vals, h & 0xFFFF, h >> 16))
+    oracle = np.sort(vals[order[:k]])
+    assert np.array_equal(np.sort(np.asarray(m.compute())), oracle)
+    merged = shards[0]
+    for s in shards[1:]:
+        merged.merge_state(s)
+    assert np.array_equal(np.sort(np.asarray(merged.compute())), oracle)
+
+
+def test_streaming_auroc_within_bound_on_1e6_stream():
+    rng = np.random.RandomState(3)
+    target = (rng.rand(N) < 0.3).astype(np.int32)
+    preds = np.clip(0.25 * target + 0.6 * rng.rand(N), 0.0, 1.0).astype(np.float32)
+    m = StreamingAUROC(num_bins=2048)
+    for p, t in _stream(preds, target):
+        m.update(p, t)
+    est = float(m.compute())
+    bound = float(m.error_bound())
+
+    # exact Mann-Whitney AUROC with average-rank tie handling, pure numpy
+    order = np.argsort(preds, kind="mergesort")
+    ranks = np.empty(N, np.float64)
+    ranks[order] = np.arange(1, N + 1, dtype=np.float64)
+    sorted_p = preds[order]
+    boundaries = np.flatnonzero(np.diff(sorted_p)) + 1
+    starts = np.concatenate(([0], boundaries))
+    ends = np.concatenate((boundaries, [N]))
+    for s, e in zip(starts, ends):
+        if e - s > 1:
+            ranks[order[s:e]] = 0.5 * (s + 1 + e)
+    n_pos = int(target.sum())
+    n_neg = N - n_pos
+    exact = (ranks[target == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+    assert bound <= 0.005, "2048 bins must give a sub-half-percent bound here"
+    assert abs(est - exact) <= bound + 1e-6
+
+
+def test_streaming_ece_matches_same_binned_exact_on_1e6_stream():
+    rng = np.random.RandomState(4)
+    target = (rng.rand(N) < 0.5).astype(np.int32)
+    preds = rng.rand(N).astype(np.float32)
+    num_bins = 15
+    m = StreamingCalibrationError(num_bins=num_bins)
+    for p, t in _stream(preds, target):
+        m.update(p, t)
+    conf = np.maximum(preds, 1.0 - preds).astype(np.float64)
+    hit = ((preds >= 0.5).astype(np.int32) == target)
+    edges = np.linspace(0.0, 1.0, num_bins + 1)
+    idx = np.clip(
+        np.searchsorted(edges.astype(np.float32), conf.astype(np.float32), side="right") - 1,
+        0,
+        num_bins - 1,
+    )
+    exact = sum(
+        (idx == b).sum() / N * abs(hit[idx == b].mean() - conf[idx == b].mean())
+        for b in range(num_bins)
+        if (idx == b).any()
+    )
+    # same bins ⇒ only f32 conf_sum accumulation separates sketch from exact
+    assert float(m.compute()) == pytest.approx(exact, abs=1e-3)

@@ -4,7 +4,10 @@
 # harness, the fleet-engine contract pass, and the perf cost ratchet (which
 # also drives the 64-stream StreamEngine smoke and pins its dispatch economy
 # against the `fleet` section of tools/perf_baseline.json) — all via
-# `lint_metrics.py --all`, which aggregates their exit codes.
+# `lint_metrics.py --all`, which aggregates their exit codes. The default
+# target sweeps all of metrics_tpu/ including the sketch family
+# (sketches/ + functional/sketches/, registered in every dynamic-pass
+# registry), and `--json` reports per-pass wall time for CI timing budgets.
 #
 #   tools/ci_check.sh            # text report, exit 0 clean / 1 violations / 2 usage
 #   tools/ci_check.sh --json     # one machine-readable document on stdout
