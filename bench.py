@@ -19,7 +19,9 @@ Configs (BASELINE.md "Targets"):
 Extras outside the geomean: retrieval_device_sort (TPU sort path), bootstrap
 (replica engine vs our loop fallback), and fleet (StreamEngine driving 10k
 concurrent heterogeneous metric streams at one donated dispatch per bucket per
-tick, dispatch economy asserted from the observe counters).
+tick, dispatch economy asserted from the observe counters), and recovery (a
+1k-stream fleet checkpointed, crashed with a pending wave in the ingest WAL,
+restored + replayed bit-exact, ckpt/restore counters asserted).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "configs": {...}}
 where value/vs_baseline is the geometric-mean speedup across configs and
@@ -55,6 +57,8 @@ FLEET_STREAMS = 10000
 FLEET_TICKS = 3
 FLEET_CHURN = 256
 FLEET_BATCH = 16
+RECOVERY_STREAMS = 1000
+RECOVERY_TICKS = 3
 
 
 # ----------------------------------------------------------------- roofline
@@ -589,6 +593,130 @@ def bench_fleet(with_ref: bool = True):
     }
 
 
+# ------------------------------------------------------------- extra: recovery
+def bench_recovery(with_ref: bool = True):
+    """Durability path (``engine/durability.py``, DESIGN §17): checkpoint a
+    1k-session fleet, "crash" it with a full submitted-but-unticked wave
+    sitting in the ingest WAL, then time restore + journal replay and require
+    the recovered fleet to be bit-exact against the never-crashed engine. The
+    torch reference has no fleet (let alone a durable one), so this config
+    reports recovery wall times + the ckpt/restore observe counters instead of
+    a speedup and stays out of the geomean."""
+    import shutil
+    import tempfile
+
+    import jax  # noqa: F401 — keeps jax import shape uniform with siblings
+
+    from metrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
+    from metrics_tpu.engine import StreamEngine
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.observe import recorder as rec_mod
+
+    rng = np.random.default_rng(13)
+    families = ("acc", "auroc")
+    ctors = {
+        "acc": lambda: MulticlassAccuracy(num_classes=8, validate_args=False),
+        "auroc": lambda: BinaryAUROC(thresholds=16),
+    }
+    pools = {
+        "acc": [
+            (rng.integers(0, 8, FLEET_BATCH), rng.integers(0, 8, FLEET_BATCH)) for _ in range(8)
+        ],
+        "auroc": [
+            (rng.random(FLEET_BATCH, dtype=np.float32), rng.integers(0, 2, FLEET_BATCH))
+            for _ in range(8)
+        ],
+    }
+    per_family = RECOVERY_STREAMS // len(families)
+
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    probe = rec_mod.Recorder()
+    rec_mod.RECORDER, rec_mod.ENABLED = probe, True
+    _FLEET_JIT_CACHE.clear()
+    tmp = tempfile.mkdtemp(prefix="bench_recovery_")
+    try:
+        wal = os.path.join(tmp, "ingest.wal")
+        ckpt = os.path.join(tmp, "fleet.mtckpt")
+        engine = StreamEngine(initial_capacity=per_family, wal_path=wal)
+        kinds = {}
+        for kind in families:
+            for _ in range(per_family):
+                kinds[engine.add_session(ctors[kind]())] = kind
+
+        def wave(t):
+            for i, (sid, kind) in enumerate(kinds.items()):
+                engine.submit(sid, *pools[kind][(i + t) % 8])
+
+        for t in range(RECOVERY_TICKS):
+            wave(t)
+            engine.tick()
+        start = time.perf_counter()
+        engine.checkpoint(ckpt)
+        ckpt_wall = time.perf_counter() - start
+        # the pending tail: one full wave journaled + fsynced but never ticked —
+        # this is the state an engine crashes in
+        wave(RECOVERY_TICKS)
+        engine._wal.sync()
+        start = time.perf_counter()
+        recovered = StreamEngine.restore(ckpt, wal_path=wal)
+        restore_wall = time.perf_counter() - start
+        # the oracle engine never crashed: it just applies the same tail
+        engine.tick()
+        recovered.tick()
+        equal = True
+        for key, b in engine._buckets.items():
+            rb = recovered._buckets[key]
+            equal = equal and rb.slot_sids == b.slot_sids
+            for k in b.stacked:
+                equal = equal and bool(
+                    np.array_equal(np.asarray(b.stacked[k]), np.asarray(rb.stacked[k]))
+                )
+        assert equal, "recovered fleet state diverged from the never-crashed oracle"
+        for sid in list(kinds)[:: per_family // 2][:4]:
+            got = float(np.asarray(recovered.compute(sid)))
+            want = float(np.asarray(engine.compute(sid)))
+            assert got == want, (sid, got, want)
+
+        counters = {}
+        for (name, label), v in probe.counters.items():
+            counters.setdefault(name, {})[label] = v
+    finally:
+        rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+        _FLEET_JIT_CACHE.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # the durability claims, checked from live telemetry: one snapshot written,
+    # one restore, the whole pending wave replayed from the journal, and the
+    # recovered tick still costs one donated dispatch per bucket
+    replayed = sum(counters.get("wal_replay", {}).values())
+    dispatches = sum(counters.get("fleet_dispatch", {}).values())
+    flushes = sum(counters.get("fleet_flush", {}).values())
+    assert counters.get("ckpt_save", {}).get("StreamEngine") == 1, counters
+    assert counters.get("ckpt_restore", {}).get("StreamEngine") == 1, counters
+    assert counters.get("fleet_restore", {}).get("engine") == 1, counters
+    assert replayed == RECOVERY_STREAMS, counters
+    assert dispatches / flushes <= 1.0 + 1e-9, counters
+    return {
+        "streams": RECOVERY_STREAMS,
+        "ticks_before_crash": RECOVERY_TICKS,
+        "pending_records_replayed": replayed,
+        "checkpoint_ms": round(1000 * ckpt_wall, 3),
+        "restore_ms": round(1000 * restore_wall, 3),
+        "recovered_bit_exact": equal,
+        "dispatches_per_bucket_tick": round(dispatches / flushes, 4),
+        "observe_counters": {
+            k: counters.get(k, {})
+            for k in ("ckpt_save", "ckpt_restore", "fleet_restore",
+                      "wal_append", "wal_replay", "wal_truncate")
+        },
+        "workload": (
+            f"{RECOVERY_STREAMS} streams (2 metric classes) x {RECOVERY_TICKS} ticks, "
+            "checkpoint, crash with 1 unticked wave in the WAL, restore + replay "
+            "[bit-exact vs never-crashed oracle; not in geomean]"
+        ),
+    }
+
+
 def bench_sketches(with_ref: bool = True):
     """Sketch metrics (``sketches/``, DESIGN §16): stream 2^20 elements through
     DDSketch / HyperLogLog / StreamingAUROC and compare against exact
@@ -756,6 +884,11 @@ def main():
         configs["fleet"] = bench_fleet(with_ref=with_ref)
     except Exception as err:  # noqa: BLE001
         configs["fleet"] = {"error": f"{type(err).__name__}: {err}"}
+    # durability: checkpoint + crash + restore + WAL replay at 1k streams
+    try:
+        configs["recovery"] = bench_recovery(with_ref=with_ref)
+    except Exception as err:  # noqa: BLE001
+        configs["recovery"] = {"error": f"{type(err).__name__}: {err}"}
     # sketch metrics: accuracy-vs-memory at 2^20 streamed elements
     try:
         configs["sketches"] = bench_sketches(with_ref=with_ref)

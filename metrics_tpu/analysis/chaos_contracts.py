@@ -27,11 +27,22 @@ the documented contract after each one:
   against the ``_merge_state_dicts`` oracle), record ``sync_retry`` /
   ``sync_degraded``, and still restore local state on unsync.
 
+A second suite covers the fleet runtime's DESIGN §17 durability contract
+(:func:`check_fleet_chaos_case`): for every bucketable class a
+``StreamEngine`` with an ingest WAL is killed mid-tick, mid-flush and
+mid-checkpoint, its journal is torn and bit-flipped, and one poisoned row is
+injected into a full bucket — each recovered engine must be *bit-exact*
+(``Metric.state_fingerprint``) versus a never-crashed oracle engine, corrupt
+snapshots must be rejected with the previous snapshot still recoverable, and
+a quarantined row must never cost its bucket the one-dispatch-per-tick
+economy.
+
 Every broken promise is a violation keyed by class name, baselined in the
-``chaos`` section of ``tools/chaos_baseline.json`` (expected empty; every
-entry needs a justification string). Runs as the ``chaos`` pass of
-``tools/lint_metrics --all`` / the ``chaoslint`` console script and standalone
-via ``python -m metrics_tpu.analysis.chaos_contracts``.
+``chaos`` (metric faults) and ``fleet`` (engine recovery) sections of
+``tools/chaos_baseline.json`` (expected empty; every entry needs a
+justification string). Runs as the ``chaos`` pass of ``tools/lint_metrics
+--all`` / the ``chaoslint`` console script and standalone via ``python -m
+metrics_tpu.analysis.chaos_contracts``.
 """
 
 from __future__ import annotations
@@ -44,6 +55,7 @@ __all__ = [
     "ChaosResult",
     "chaos_cases",
     "check_chaos_case",
+    "check_fleet_chaos_case",
     "diff_chaos_baseline",
     "main",
     "run_chaos_check",
@@ -526,30 +538,268 @@ def collect_chaos_report(cases: Optional[Sequence[Any]] = None) -> List[ChaosRes
     return [check_chaos_case(c) for c in (cases if cases is not None else chaos_cases())]
 
 
+# --------------------------------------------------------- fleet durability suite
+_FLEET_SESSIONS = 3  # sessions per scenario engine (one bucket, distinct rows)
+
+
+def _fleet_script(case: Any, n_batches: int) -> List[Tuple[int, Tuple[Any, ...]]]:
+    """Deterministic round-robin ingest script: (session index, batch)."""
+    rng = _rng_for(case)
+    return [(i % _FLEET_SESSIONS, case.batch(rng)) for i in range(n_batches)]
+
+
+def _fleet_oracle(case: Any, script: Sequence[Tuple[int, Tuple[Any, ...]]]) -> List[str]:
+    """Per-session state fingerprints from a never-crashed engine fed ``script``."""
+    from metrics_tpu.engine.stream import StreamEngine
+
+    eng = StreamEngine()
+    sids = [eng.add_session(case.ctor()) for _ in range(_FLEET_SESSIONS)]
+    for idx, batch in script:
+        eng.submit(sids[idx], *batch)
+    eng.tick()
+    return [eng.expire(sid).state_fingerprint() for sid in sids]
+
+
+def _fleet_recovered(engine: Any, sids: Sequence[Any]) -> List[str]:
+    engine.tick()
+    return [engine.expire(sid).state_fingerprint() for sid in sids]
+
+
+def _diff_fingerprints(fault: str, got: Sequence[str], want: Sequence[str]) -> List[str]:
+    return [
+        f"{fault}: session {i} not bit-exact vs the never-crashed oracle"
+        for i, (g, w) in enumerate(zip(got, want))
+        if g != w
+    ]
+
+
+def _scenario_kill(case: Any, tmp: str, stage: str) -> List[str]:
+    """Kill the process mid-tick (unapplied journal suffix) or mid-flush (the
+    post-checkpoint records were applied, then the process died): recovery is
+    checkpoint + journal replay, bit-exact either way."""
+    from metrics_tpu.engine.stream import StreamEngine
+
+    wal = os.path.join(tmp, f"{stage}.wal")
+    ckpt = os.path.join(tmp, f"{stage}.ckpt")
+    script = _fleet_script(case, 8)
+    cut = 5
+    eng = StreamEngine(wal_path=wal)
+    sids = [eng.add_session(case.ctor()) for _ in range(_FLEET_SESSIONS)]
+    for idx, batch in script[:cut]:
+        eng.submit(sids[idx], *batch)
+    eng.tick()
+    eng.checkpoint(ckpt)
+    for idx, batch in script[cut:]:
+        eng.submit(sids[idx], *batch)
+    if stage == "mid_flush":
+        eng.tick()  # effects applied in memory, then the process dies
+    else:
+        eng._wal.sync()  # tick's durability point ran; the dispatch never did
+    eng._wal.close()
+    del eng  # crash
+    rec = StreamEngine.restore(ckpt, wal_path=wal)
+    return _diff_fingerprints(f"kill[{stage}]", _fleet_recovered(rec, sids), _fleet_oracle(case, script))
+
+
+def _scenario_kill_mid_ckpt(case: Any, tmp: str) -> List[str]:
+    """Die while writing a newer snapshot: the torn/bit-flipped file must be
+    rejected, and the previous snapshot + the (untruncated) journal must still
+    recover the full history bit-exact."""
+    from metrics_tpu.engine.durability import save_fleet_checkpoint
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.resilience.checkpoint import CorruptCheckpointError
+
+    bad: List[str] = []
+    wal = os.path.join(tmp, "mid_ckpt.wal")
+    ckpt1 = os.path.join(tmp, "good.ckpt")
+    ckpt2 = os.path.join(tmp, "torn.ckpt")
+    script = _fleet_script(case, 8)
+    cut = 5
+    eng = StreamEngine(wal_path=wal)
+    sids = [eng.add_session(case.ctor()) for _ in range(_FLEET_SESSIONS)]
+    for idx, batch in script[:cut]:
+        eng.submit(sids[idx], *batch)
+    eng.tick()
+    eng.checkpoint(ckpt1)
+    for idx, batch in script[cut:]:
+        eng.submit(sids[idx], *batch)
+    eng.tick()
+    # the second snapshot must NOT truncate the journal: it never becomes valid,
+    # so recovery has to reach past it from ckpt1
+    save_fleet_checkpoint(eng, ckpt2, truncate_wal=False)
+    eng._wal.close()
+    del eng  # crash mid-write: simulate the torn result
+    with open(ckpt2, "rb") as fh:
+        blob = fh.read()
+    for fault, mutated in (
+        ("truncate", blob[: len(blob) - 7]),
+        ("bitflip", blob[:-1] + bytes([blob[-1] ^ 0xFF])),
+    ):
+        with open(ckpt2, "wb") as fh:
+            fh.write(mutated)
+        try:
+            StreamEngine.restore(ckpt2, wal_path=wal)
+            bad.append(f"kill[mid_ckpt]: {fault}d snapshot was accepted")
+        except CorruptCheckpointError:
+            pass
+    rec = StreamEngine.restore(ckpt1, wal_path=wal)
+    bad += _diff_fingerprints("kill[mid_ckpt]", _fleet_recovered(rec, sids), _fleet_oracle(case, script))
+    return bad
+
+
+def _scenario_journal_damage(case: Any, tmp: str, fault: str) -> List[str]:
+    """Torn or bit-flipped final journal frame: replay must stop cleanly at the
+    damage and recover exactly the intact prefix of the history."""
+    from metrics_tpu.engine.stream import StreamEngine
+
+    wal = os.path.join(tmp, f"{fault}.wal")
+    ckpt = os.path.join(tmp, f"{fault}.ckpt")
+    script = _fleet_script(case, 6)
+    eng = StreamEngine(wal_path=wal)
+    sids = [eng.add_session(case.ctor()) for _ in range(_FLEET_SESSIONS)]
+    eng.checkpoint(ckpt)  # snapshot of the empty fleet; every submit lives in the WAL
+    for idx, batch in script:
+        eng.submit(sids[idx], *batch)
+    eng._wal.sync()
+    eng._wal.close()
+    del eng  # crash
+    with open(wal, "rb") as fh:
+        blob = fh.read()
+    damaged = blob[:-5] if fault == "torn" else blob[:-1] + bytes([blob[-1] ^ 0xFF])
+    with open(wal, "wb") as fh:
+        fh.write(damaged)
+    rec = StreamEngine.restore(ckpt, wal_path=wal)
+    # the damage eats exactly the final record; the oracle saw the prefix
+    return _diff_fingerprints(
+        f"journal[{fault}]", _fleet_recovered(rec, sids), _fleet_oracle(case, script[:-1])
+    )
+
+
+def _scenario_poison_row(case: Any) -> Tuple[List[str], bool]:
+    """One poisoned row in a full bucket under ``nan_guard``: that session is
+    quarantined (its poisoned batch dropped), every other row is bit-exact, and
+    the flush still costs exactly one dispatch for the bucket."""
+    from metrics_tpu.engine.stream import StreamEngine
+
+    script = _fleet_script(case, _FLEET_SESSIONS * 2)
+    poisoned, ok = _poison_batch(script[1][1])
+    if not ok:
+        return [], False  # nothing float-typed to poison
+    bad: List[str] = []
+    eng = StreamEngine(nan_guard=True)
+    sids = [eng.add_session(case.ctor()) for _ in range(_FLEET_SESSIONS)]
+    for i, (idx, batch) in enumerate(script):
+        eng.submit(sids[idx], *(poisoned if i == 1 else batch))
+    dispatches = eng.tick()
+    # wave 1 (first submission per slot) carries the poison; wave 2 is clean:
+    # the surviving rows must still coalesce — 2 waves, 2 dispatches, never more
+    if dispatches > 2:
+        bad.append(f"poison[row]: quarantine broke wave coalescing ({dispatches} dispatches for 2 waves)")
+    if eng.session_health(sids[1]) != "quarantined":
+        bad.append(f"poison[row]: poisoned session health is {eng.session_health(sids[1])!r}, expected 'quarantined'")
+    for i in (0, 2):
+        if eng.session_health(sids[i]) != "healthy":
+            bad.append(f"poison[row]: clean session {i} health is {eng.session_health(sids[i])!r}")
+    # oracle never sees the poisoned batch at all (nan_guard drops it)
+    want = _fleet_oracle(case, [sb for i, sb in enumerate(script) if i != 1])
+    got = [eng.expire(sid).state_fingerprint() for sid in sids]
+    bad += _diff_fingerprints("poison[row]", got, want)
+    return bad, True
+
+
+def check_fleet_chaos_case(case: Any) -> ChaosResult:
+    """One class through the fleet durability scenarios; never raises."""
+    import tempfile
+
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+
+    probe = _observe.Recorder()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled = _observe.ENABLED
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    saved_donate = metric_mod._DONATE_UPDATE_DEFAULT
+    real = _observe.RECORDER
+    _observe.RECORDER = probe
+    violations: List[str] = []
+    ran: List[str] = []
+    skipped: List[str] = []
+    try:
+        _observe.ENABLED = True
+        metric_mod._JIT_UPDATE_DEFAULT = True
+        metric_mod._DONATE_UPDATE_DEFAULT = True
+        clear_jit_cache()
+        _FLEET_JIT_CACHE.clear()
+
+        probe_engine = StreamEngine()
+        sid = probe_engine.add_session(case.ctor())
+        bucketable = probe_engine._sessions[sid].bucket is not None
+        probe_engine.expire(sid)
+        if not bucketable:
+            return ChaosResult(case.name, (), ("fleet",), ())
+
+        with tempfile.TemporaryDirectory(prefix="chaos_fleet_") as tmp:
+            for stage in ("mid_tick", "mid_flush"):
+                violations += _scenario_kill(case, tmp, stage)
+                ran.append(f"kill[{stage}]")
+            violations += _scenario_kill_mid_ckpt(case, tmp)
+            ran.append("kill[mid_ckpt]")
+            for fault in ("torn", "bitflip"):
+                violations += _scenario_journal_damage(case, tmp, fault)
+                ran.append(f"journal[{fault}]")
+        bad, applicable = _scenario_poison_row(case)
+        if applicable:
+            violations += bad
+            ran.append("poison[row]")
+        else:
+            skipped.append("poison[row]")
+    except Exception as exc:  # noqa: BLE001 — a crash in the harness is itself a verdict
+        violations.append(f"harness: {type(exc).__name__}: {str(exc)[:200]}")
+    finally:
+        _observe.RECORDER = real
+        _observe.ENABLED = saved_enabled
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+        metric_mod._DONATE_UPDATE_DEFAULT = saved_donate
+        clear_jit_cache()
+        _FLEET_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+    return ChaosResult(case.name, tuple(ran), tuple(skipped), tuple(violations))
+
+
+def collect_fleet_chaos_report(cases: Optional[Sequence[Any]] = None) -> List[ChaosResult]:
+    return [check_fleet_chaos_case(c) for c in (cases if cases is not None else chaos_cases())]
+
+
 # ------------------------------------------------------------------- baseline
-def load_chaos_baseline(path: str) -> Dict[str, str]:
+def load_chaos_baseline(path: str, section: str = "chaos") -> Dict[str, str]:
     from metrics_tpu.analysis.engine import load_baseline_section
 
-    return {str(k): str(v) for k, v in load_baseline_section(path, "chaos").items()}
+    return {str(k): str(v) for k, v in load_baseline_section(path, section).items()}
 
 
-def write_chaos_baseline(path: str, results: Sequence[ChaosResult]) -> Dict[str, str]:
+def write_chaos_baseline(
+    path: str, results: Sequence[ChaosResult], section: str = "chaos"
+) -> Dict[str, str]:
     from metrics_tpu.analysis.engine import write_baseline_section
 
-    chaos = {
+    values = {
         r.name: "UNJUSTIFIED: " + "; ".join(r.violations)
         for r in sorted(results, key=lambda r: r.name)
         if not r.ok
     }
     write_baseline_section(
         path,
-        "chaos",
-        chaos,  # type: ignore[arg-type]
-        "chaoslint baseline — fault-injection contract violations under `chaos` "
+        section,
+        values,  # type: ignore[arg-type]
+        f"chaoslint baseline — contract violations in the `{section}` suite "
         "(class -> justification; expected empty). Regenerate with "
         "`python tools/lint_metrics.py --pass chaos --update-baseline`.",
     )
-    return chaos
+    return values
 
 
 def diff_chaos_baseline(
@@ -570,15 +820,27 @@ def run_chaos_check(
     quiet: bool = False,
     report: Optional[Dict[str, Any]] = None,
 ) -> int:
-    """The ``chaos`` pass of ``lint_metrics --all``: inject, verify, verdict."""
+    """The ``chaos`` pass of ``lint_metrics --all``: inject, verify, verdict.
+
+    Runs BOTH suites — the per-metric fault taxonomy (baselined under
+    ``chaos``) and the fleet durability scenarios (baselined under ``fleet``).
+    """
     path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
     results = collect_chaos_report()
+    fleet_results = collect_fleet_chaos_report()
     if update_baseline:
-        chaos = write_chaos_baseline(path, results)
+        chaos = write_chaos_baseline(path, results, section="chaos")
+        fleet = write_chaos_baseline(path, fleet_results, section="fleet")
         if not quiet:
-            print(f"chaos: baseline written to {path} ({len(chaos)} violation(s))")
+            print(
+                f"chaos: baseline written to {path} "
+                f"({len(chaos)} chaos / {len(fleet)} fleet violation(s))"
+            )
         return 0
-    failures, stale = diff_chaos_baseline(results, load_chaos_baseline(path))
+    failures, stale = diff_chaos_baseline(results, load_chaos_baseline(path, "chaos"))
+    fleet_failures, fleet_stale = diff_chaos_baseline(
+        fleet_results, load_chaos_baseline(path, "fleet")
+    )
     if report is not None:
         report.update(
             {
@@ -588,21 +850,34 @@ def run_chaos_check(
                 "baselined": sum(1 for r in results if not r.ok) - len(failures),
                 "stale_baseline_keys": stale,
                 "skipped": {r.name: list(r.skipped) for r in results if r.skipped},
+                "fleet_cases": len(fleet_results),
+                "fleet_scenarios": sum(len(r.ran) for r in fleet_results),
+                "fleet_failures": [r.render() for r in fleet_failures],
+                "fleet_baselined": sum(1 for r in fleet_results if not r.ok) - len(fleet_failures),
+                "fleet_stale_baseline_keys": fleet_stale,
             }
         )
-        return 1 if failures else 0
+        return 1 if failures or fleet_failures else 0
     for r in failures:
         print(f"chaos: {r.render()}")
+    for r in fleet_failures:
+        print(f"chaos[fleet]: {r.render()}")
     if not quiet:
         for key in stale:
             print(f"chaos: stale baseline entry: {key}")
+        for key in fleet_stale:
+            print(f"chaos[fleet]: stale baseline entry: {key}")
         ok = sum(1 for r in results if r.ok)
         faults = sum(len(r.ran) for r in results)
+        fleet_ok = sum(1 for r in fleet_results if r.ok)
+        fleet_n = sum(len(r.ran) for r in fleet_results)
         print(
             f"chaos: {ok}/{len(results)} classes survived {faults} injected fault(s), "
-            f"{len(failures)} failure(s), {len(stale)} stale"
+            f"{len(failures)} failure(s), {len(stale)} stale; "
+            f"fleet: {fleet_ok}/{len(fleet_results)} classes survived {fleet_n} "
+            f"recovery scenario(s), {len(fleet_failures)} failure(s), {len(fleet_stale)} stale"
         )
-    return 1 if failures else 0
+    return 1 if failures or fleet_failures else 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -625,14 +900,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = p.parse_args(argv)
     root = os.path.abspath(args.root or os.getcwd())
     if args.only:
-        results = collect_chaos_report(
-            [c for c in chaos_cases() if args.only.lower() in c.name.lower()]
-        )
+        picked = [c for c in chaos_cases() if args.only.lower() in c.name.lower()]
+        results = collect_chaos_report(picked) + collect_fleet_chaos_report(picked)
         for r in results:
             print(r.render())
         return 1 if any(not r.ok for r in results) else 0
     if args.verbose:
-        for r in collect_chaos_report():
+        for r in collect_chaos_report() + collect_fleet_chaos_report():
             print(r.render())
     return run_chaos_check(
         root,

@@ -21,9 +21,12 @@ Four dynamic passes ride the same selection/exit-code contract:
   :mod:`metrics_tpu.analysis.fleet_contracts`), disagreements baselined in
   ``tools/fleet_baseline.json``
 * ``chaos`` — fault-injection contract harness (transactional updates,
-  dispatch death, NaN quarantine, corrupt checkpoints, dropped sync peers;
-  :mod:`metrics_tpu.analysis.chaos_contracts`), violations baselined in
-  ``tools/chaos_baseline.json``
+  dispatch death, NaN quarantine, corrupt checkpoints, dropped sync peers)
+  plus the fleet durability scenarios (kill mid-tick/mid-flush/mid-checkpoint,
+  torn/bit-flipped ingest journals, one poisoned row in a full bucket — each
+  recovery bit-exact vs a never-crashed oracle;
+  :mod:`metrics_tpu.analysis.chaos_contracts`), violations baselined in the
+  ``chaos`` / ``fleet`` sections of ``tools/chaos_baseline.json``
 * ``perf`` — XLA cost profiling of compiled metric updates + the 64-stream
   fleet smoke (:mod:`metrics_tpu.observe.profile`), ratcheted against
   ``tools/perf_baseline.json``

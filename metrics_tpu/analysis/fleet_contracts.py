@@ -14,6 +14,8 @@ oracle metrics fed the identical batches:
   flush must actually be consumed (``jax.Array.is_deleted``) when the class is
   donation-eligible: a donating program that consumes nothing is a silent
   steady-state allocation;
+* **checkpoint** — a mid-lifecycle ``checkpoint()`` → ``StreamEngine.restore``
+  round-trip lands every live engine-resident row bit-exactly (DESIGN §17);
 * **merge** — two expired engine-resident states merged via ``merge_state``
   agree with the same merge of their oracles;
 * **values** — final live states are bit-identical and computes agree.
@@ -229,6 +231,22 @@ def check_fleet_case(case: Any) -> FleetResult:
                 )
             if cmp == "close":
                 verdict = "CLOSE"
+
+        # durability: a checkpoint -> restore round-trip (DESIGN §17) must land
+        # every live engine-resident row in the fresh engine bit-exactly
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="fleet_ckpt_") as tmp:
+            ckpt = os.path.join(tmp, "fleet.ckpt")
+            engine.checkpoint(ckpt)
+            restored = StreamEngine.restore(ckpt)
+            for sid in live:
+                for k, ref in _row(engine, sid).items():
+                    if not np.array_equal(np.asarray(_row(restored, sid)[k]), np.asarray(ref)):
+                        return FleetResult(
+                            case.name, "DIVERGED", donation,
+                            f"checkpoint round-trip drifted: state '{k}' (session {sid})",
+                        )
 
         # merge: two expired engine-resident states vs the same merge of oracles
         m_a, m_b = engine.expire(sids[0]), engine.expire(sids[2])

@@ -11,17 +11,26 @@ Two layers:
   avals)``, stacked into padded leading-axis pytrees, and driven at one
   donated dispatch per bucket per tick with mid-stream session churn and zero
   recompiles within padded capacity.
+* :mod:`metrics_tpu.engine.durability` — fleet crash recovery (DESIGN §17):
+  incremental MTCKPT fleet checkpoints, the CRC-framed ingest WAL
+  (:class:`IngestWAL`), and the checkpoint+journal replay behind
+  ``StreamEngine.restore`` — recovered fleets are bit-exact versus a
+  never-crashed engine.
 
 ``metrics_tpu.engine.smoke`` holds the 64-stream CI smoke the perf ratchet
 runs (``tools/ci_check.sh`` → perf pass → ``run_fleet_smoke``).
 """
 
 from metrics_tpu.engine.core import ProgramCache, engine_compute, engine_update
+from metrics_tpu.engine.durability import IngestWAL, restore_fleet_checkpoint, save_fleet_checkpoint
 from metrics_tpu.engine.stream import StreamEngine
 
 __all__ = [
+    "IngestWAL",
     "ProgramCache",
     "StreamEngine",
     "engine_compute",
     "engine_update",
+    "restore_fleet_checkpoint",
+    "save_fleet_checkpoint",
 ]

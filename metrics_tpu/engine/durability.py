@@ -1,0 +1,479 @@
+"""Fleet durability: incremental StreamEngine checkpoints + the ingest WAL (DESIGN §17).
+
+Two complementary persistence layers make a fleet crash-recoverable bit-exact:
+
+**Fleet checkpoints** extend the MTCKPT container (``resilience/checkpoint.py``)
+with a ``root_kind == "fleet"`` payload: the engine's sequencing watermarks plus
+per-bucket snapshots (template metric, stacked state pytree as host arrays, slot
+map, free-list) and the session registry (sid → class, config fingerprint, row,
+health; loose sessions carry their full pickled metric). Writes are
+*incremental*: each bucket's node is pre-pickled to bytes and cached on the
+engine keyed by ``(bucket key) -> (version, bytes)``, so a bucket whose state
+version has not moved since the last checkpoint is re-emitted as a cached
+memcpy — no device_get, no re-pickle. The container write itself streams
+through ``utils.io.atomic_write_chunks`` (crash-consistent: complete old or
+complete new file, never a torn one).
+
+**The ingest WAL** is a redo journal: every ``add_session`` / ``submit`` /
+``expire`` / ``reset`` appends one CRC-framed record *before* the engine
+applies its effect, and the buffer is fsynced at each flush boundary
+(``StreamEngine._flush_pending``) — so submitted-but-unticked waves survive a
+crash. Frames are ``u32 len | u32 crc32`` + a pickled ``(kind, seq, sid,
+payload)`` tuple; a crash can only tear a *suffix*, and replay stops cleanly at
+the first torn or bit-flipped frame. Each successful checkpoint truncates the
+journal down to the records the snapshot does not cover (unapplied seqs), so
+the journal stays bounded by one checkpoint interval.
+
+**Recovery** (:func:`restore_fleet_checkpoint`, surfaced as
+``StreamEngine.restore``) validates the whole checkpoint tree — container CRCs,
+bucket template classes and config fingerprints, stacked avals with *exact*
+dtypes, slot-map/free-list consistency, session references, and the writer's
+``jax_enable_x64`` regime — before installing anything, then replays journal
+records in sequence order with their ORIGINAL sequence numbers (regenerating
+them would desynchronize the applied-watermark bookkeeping when records were
+applied out of order). Replayed submissions re-enter the normal ingest queues,
+so the next tick groups them into the same waves a never-crashed engine would
+have dispatched — recovered states are bit-exact versus the no-crash oracle
+(pinned per metric class by ``analysis/chaos_contracts.py`` fleet scenarios).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.resilience.checkpoint import (
+    CheckpointError,
+    CorruptCheckpointError,
+    IncompatibleCheckpointError,
+    _dtype_matches,
+    _parse,
+    _write_container,
+)
+from metrics_tpu.utils.io import atomic_write_chunks, fsync_directory
+
+__all__ = ["IngestWAL", "restore_fleet_checkpoint", "save_fleet_checkpoint"]
+
+WAL_MAGIC = b"MTWAL001"
+_FRAME = struct.Struct(">II")  # record_len, record_crc32
+_PICKLE = pickle.HIGHEST_PROTOCOL
+
+
+# ------------------------------------------------------------------ ingest WAL
+class IngestWAL:
+    """Append-only, CRC-framed redo journal for StreamEngine ingest records.
+
+    ``append`` is buffered (one tick's records cost one syscall burst at the
+    next ``sync``); ``sync`` is the durability point and is called by the
+    engine before any buffered record's effect lands. ``truncate`` atomically
+    rewrites the journal keeping only frames whose seq satisfies a predicate —
+    the checkpoint writer uses it to drop everything a fresh snapshot already
+    covers. ``read_records`` is the recovery-side reader: it returns every
+    intact record up to the first torn/corrupt frame (the expected shape of a
+    crash mid-append) plus a flag saying whether it stopped early.
+    """
+
+    def __init__(self, path: Union[str, os.PathLike]) -> None:
+        self.path = os.fspath(path)
+        fresh = not os.path.exists(self.path) or os.path.getsize(self.path) == 0
+        self._fh = open(self.path, "ab")
+        if fresh:
+            self._fh.write(WAL_MAGIC)
+            self.sync()
+            fsync_directory(os.path.dirname(os.path.abspath(self.path)))
+
+    def append(self, kind: str, seq: int, sid: Any, payload: Any = None) -> None:
+        """Buffer one record; durable only after the next :meth:`sync`."""
+        if isinstance(payload, Metric):
+            # Metric.__getstate__ moves device arrays to host, so journal files
+            # are process-portable; tag it so replay knows to unpickle
+            payload = ("__metric__", pickle.dumps(payload, protocol=_PICKLE))
+        rec = pickle.dumps((kind, seq, sid, payload), protocol=_PICKLE)
+        self._fh.write(_FRAME.pack(len(rec), zlib.crc32(rec) & 0xFFFFFFFF))
+        self._fh.write(rec)
+
+    def sync(self) -> None:
+        """Flush buffered frames and fsync: everything appended so far is durable."""
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def truncate(self, keep: Callable[[int], bool]) -> int:
+        """Atomically rewrite the journal with only the frames whose seq passes
+        ``keep``; returns how many records were kept. Torn trailing frames (if
+        any) are dropped — they were never durable records."""
+        self.sync()
+        records, _torn = self.read_records(self.path)
+        kept = [r for r in records if keep(r[1])]
+        chunks: List[bytes] = [WAL_MAGIC]
+        for rec_tuple in kept:
+            rec = pickle.dumps(rec_tuple, protocol=_PICKLE)
+            chunks.append(_FRAME.pack(len(rec), zlib.crc32(rec) & 0xFFFFFFFF))
+            chunks.append(rec)
+        self._fh.close()
+        try:
+            atomic_write_chunks(self.path, chunks)
+        finally:
+            self._fh = open(self.path, "ab")
+        return len(kept)
+
+    def close(self) -> None:
+        if self._fh is not None and not self._fh.closed:
+            self.sync()
+            self._fh.close()
+
+    @staticmethod
+    def read_records(path: Union[str, os.PathLike]) -> Tuple[List[Tuple[Any, ...]], bool]:
+        """Read every intact record; ``(records, torn)`` where ``torn`` means the
+        scan stopped at a damaged frame (truncated length, short body, CRC
+        mismatch, or unpicklable record). A missing/empty/magic-torn file is an
+        empty journal — a crash during journal creation loses nothing, because
+        the engine had not applied anything it could not re-log."""
+        path = os.fspath(path)
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            return [], False
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        if len(blob) < len(WAL_MAGIC) or blob[: len(WAL_MAGIC)] != WAL_MAGIC:
+            return [], True
+        records: List[Tuple[Any, ...]] = []
+        off = len(WAL_MAGIC)
+        while off < len(blob):
+            if off + _FRAME.size > len(blob):
+                return records, True
+            length, crc = _FRAME.unpack_from(blob, off)
+            body = blob[off + _FRAME.size : off + _FRAME.size + length]
+            if len(body) < length or zlib.crc32(body) & 0xFFFFFFFF != crc:
+                return records, True
+            try:
+                rec = pickle.loads(body)
+            except Exception:  # noqa: BLE001 — CRC passed but the record is garbage
+                return records, True
+            if not (isinstance(rec, tuple) and len(rec) == 4):
+                return records, True
+            records.append(rec)
+            off += _FRAME.size + length
+        return records, False
+
+
+# ------------------------------------------------------------------ save
+def _host(v: Any) -> np.ndarray:
+    return np.asarray(jax.device_get(v))
+
+
+def _bucket_node(bucket: Any) -> Dict[str, Any]:
+    return {
+        "label": bucket.label,
+        "class": type(bucket.template).__name__,
+        "fingerprint": bucket.template.config_fingerprint(),
+        "template": pickle.dumps(bucket.template, protocol=_PICKLE),
+        "capacity": int(bucket.capacity),
+        "high_water": int(bucket.high_water),
+        "version": int(bucket.version),
+        "faults": int(bucket.faults),
+        "compute_eager": bool(bucket.compute_eager),
+        "slot_sids": list(bucket.slot_sids),
+        "free": [int(s) for s in bucket.free],
+        "stacked": {k: _host(v) for k, v in bucket.stacked.items()},
+    }
+
+
+def save_fleet_checkpoint(
+    engine: Any, path: Union[str, os.PathLike], truncate_wal: bool = True
+) -> str:
+    """Write an incremental fleet snapshot; optionally truncate the ingest WAL.
+
+    Only *dirty* buckets (state version moved since their last snapshot) pay
+    device_get + pickle; clean buckets re-emit their cached bytes. Pending
+    (unapplied) ingest queue entries are deliberately NOT part of the snapshot
+    — they live in the WAL, which after truncation holds exactly the records
+    the snapshot does not cover. ``truncate_wal=False`` preserves the full
+    journal (used when writing a speculative/secondary snapshot that older
+    checkpoints may still need to recover past).
+    """
+    path = os.fspath(path)
+    if engine._wal is not None:
+        engine._wal.sync()  # the snapshot must never be ahead of the journal
+    bucket_blobs: List[bytes] = []
+    bucket_pos: Dict[Any, int] = {}
+    for key, bucket in engine._buckets.items():
+        cached = engine._ckpt_cache.get(key)
+        if cached is not None and cached[0] == bucket.version:
+            blob = cached[1]
+        else:
+            blob = pickle.dumps(_bucket_node(bucket), protocol=_PICKLE)
+            engine._ckpt_cache[key] = (bucket.version, blob)
+        bucket_pos[key] = len(bucket_blobs)
+        bucket_blobs.append(blob)
+    for key in [k for k in engine._ckpt_cache if k not in engine._buckets]:
+        del engine._ckpt_cache[key]  # dropped buckets must not pin their bytes
+    sessions: Dict[Hashable, Dict[str, Any]] = {}
+    for sid, sess in engine._sessions.items():
+        node: Dict[str, Any] = {
+            "class": type(sess.metric).__name__,
+            "fingerprint": sess.metric.config_fingerprint(),
+            "slot": int(sess.slot),
+            "base_count": int(sess.base_count),
+            "engine_count": int(sess.engine_count),
+            "health": sess.health,
+        }
+        if sess.bucket is not None:
+            node["mode"] = "bucketed"
+            node["bucket"] = bucket_pos[sess.bucket.key]
+        else:
+            node["mode"] = "loose"
+            node["metric"] = pickle.dumps(sess.metric, protocol=_PICKLE)
+        sessions[sid] = node
+    outer = {
+        "kind": "fleet",
+        "class": "StreamEngine",
+        "x64": bool(jax.config.jax_enable_x64),
+        "ticks": int(engine._ticks),
+        "seq": int(engine._seq),
+        "applied_seq": int(engine._applied_seq),
+        "applied_above": sorted(engine._applied_above),
+        "initial_capacity": int(engine._initial_capacity),
+        "next_auto": int(engine._next_auto),
+        "nan_guard": bool(engine._nan_guard),
+        "buckets": bucket_blobs,
+        "sessions": sessions,
+    }
+    payload = pickle.dumps(outer, protocol=_PICKLE)
+    nbytes = _write_container(path, "fleet", "StreamEngine", [payload])
+    _observe.note_checkpoint_save("StreamEngine", path, nbytes)
+    if truncate_wal and engine._wal is not None:
+        kept = engine._wal.truncate(lambda seq: not engine._is_applied(seq))
+        _observe.note_wal_truncate("engine", kept)
+    return path
+
+
+# ------------------------------------------------------------------ restore
+def _unpickle(blob: bytes, what: str, path: str) -> Any:
+    try:
+        return pickle.loads(blob)
+    except Exception as exc:  # noqa: BLE001 — damage shows up as a pickle zoo
+        raise CorruptCheckpointError(
+            f"{path}: {what} does not unpickle ({type(exc).__name__}: {exc})"
+        ) from exc
+
+
+def _validate_bucket(bnode: Any, i: int, path: str) -> Metric:
+    where = f"{path}: fleet bucket[{i}]"
+    if not isinstance(bnode, dict) or "template" not in bnode or "stacked" not in bnode:
+        raise CorruptCheckpointError(f"{where} is not a bucket node")
+    template = _unpickle(bnode["template"], f"fleet bucket[{i}] template", path)
+    if not isinstance(template, Metric) or type(template).__name__ != bnode.get("class"):
+        raise IncompatibleCheckpointError(
+            f"{where}: template is {type(template).__name__}, node declares {bnode.get('class')!r}"
+        )
+    fp = template.config_fingerprint()
+    if bnode.get("fingerprint") is not None and fp is not None and fp != bnode["fingerprint"]:
+        raise IncompatibleCheckpointError(
+            f"{where}: template config fingerprint drifted — the checkpointed bucket was "
+            "built from a different configuration of " + bnode["class"]
+        )
+    capacity = bnode["capacity"]
+    avals = template.state_avals()
+    stacked = bnode["stacked"]
+    if set(stacked) != {name for name, _s, _d in avals}:
+        raise IncompatibleCheckpointError(
+            f"{where}: stacked states {sorted(stacked)} do not match the template's "
+            f"registered states {sorted(name for name, _s, _d in avals)}"
+        )
+    for name, shape, dtype in avals:
+        arr = stacked[name]
+        if shape == "list":
+            raise IncompatibleCheckpointError(f"{where}: list state {name!r} cannot be bucketed")
+        if tuple(arr.shape) != (capacity,) + tuple(shape):
+            raise IncompatibleCheckpointError(
+                f"{where}: state {name!r} has stacked shape {tuple(arr.shape)}, "
+                f"expected {(capacity,) + tuple(shape)}"
+            )
+        if not _dtype_matches(str(arr.dtype), dtype):
+            raise IncompatibleCheckpointError(
+                f"{where}: state {name!r} was checkpointed as dtype {arr.dtype} but this "
+                f"process expects {dtype} — precision regime mismatch (was `jax_enable_x64` "
+                "toggled between the writing and the restoring process?). Refusing to "
+                "silently cast restored accumulator state."
+            )
+    slot_sids = bnode["slot_sids"]
+    free = bnode["free"]
+    occupied = [s for s, sid in enumerate(slot_sids) if sid is not None]
+    if (
+        len(slot_sids) != capacity
+        or len(set(free)) != len(free)
+        or set(free) & set(occupied)
+        or set(free) | set(occupied) != set(range(capacity))
+    ):
+        raise CorruptCheckpointError(f"{where}: slot map and free-list are inconsistent")
+    return template
+
+
+def restore_fleet_checkpoint(
+    engine: Any, path: Union[str, os.PathLike], wal_path: Optional[Union[str, os.PathLike]] = None
+) -> Any:
+    """Rebuild ``engine`` in place from a fleet checkpoint, then replay the WAL.
+
+    The whole tree is validated before anything is installed (a corrupt or
+    incompatible file leaves the engine untouched). Journal records at or below
+    the snapshot's applied watermark are skipped; the rest are re-applied in
+    sequence order with their original seqs — replayed submissions land in the
+    normal ingest queues for the next tick. Returns ``engine``.
+    """
+    from metrics_tpu.engine.stream import _Bucket, _Session
+
+    path = os.fspath(path)
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError as exc:
+        raise CheckpointError(f"{path}: cannot read checkpoint ({exc})") from exc
+    node = _parse(blob, path)
+    if node.get("kind") != "fleet" or node.get("class") != "StreamEngine":
+        raise IncompatibleCheckpointError(
+            f"{path}: restore target is a StreamEngine but the checkpoint holds "
+            f"kind={node.get('kind')!r} class={node.get('class')!r}"
+        )
+    stored_x64 = node.get("x64")
+    if stored_x64 is not None and bool(stored_x64) != bool(jax.config.jax_enable_x64):
+        raise IncompatibleCheckpointError(
+            f"{path}: checkpoint was written with jax_enable_x64={bool(stored_x64)} but this "
+            f"process runs with jax_enable_x64={bool(jax.config.jax_enable_x64)} — precision "
+            "regime mismatch. Refusing to silently cast restored accumulator state."
+        )
+    # ---- validate the whole tree before touching the engine ----
+    bucket_blobs: List[bytes] = list(node.get("buckets", []))
+    validated: List[Tuple[Dict[str, Any], Metric]] = []
+    for i, bblob in enumerate(bucket_blobs):
+        bnode = _unpickle(bblob, f"fleet bucket[{i}]", path)
+        validated.append((bnode, _validate_bucket(bnode, i, path)))
+    sessions_node: Dict[Hashable, Dict[str, Any]] = node.get("sessions", {})
+    loose_metrics: Dict[Hashable, Metric] = {}
+    for sid, snode in sessions_node.items():
+        where = f"{path}: fleet session {sid!r}"
+        if snode.get("mode") == "bucketed":
+            bi = snode.get("bucket")
+            if not isinstance(bi, int) or not 0 <= bi < len(validated):
+                raise CorruptCheckpointError(f"{where} references unknown bucket {bi!r}")
+            bnode = validated[bi][0]
+            slot = snode.get("slot")
+            if not isinstance(slot, int) or not 0 <= slot < bnode["capacity"] or bnode["slot_sids"][slot] != sid:
+                raise CorruptCheckpointError(f"{where} does not own its claimed slot {slot!r}")
+        elif snode.get("mode") == "loose":
+            m = _unpickle(snode["metric"], f"fleet session {sid!r} metric", path)
+            if not isinstance(m, Metric) or type(m).__name__ != snode.get("class"):
+                raise IncompatibleCheckpointError(
+                    f"{where}: metric is {type(m).__name__}, node declares {snode.get('class')!r}"
+                )
+            loose_metrics[sid] = m
+        else:
+            raise CorruptCheckpointError(f"{where} has unknown mode {snode.get('mode')!r}")
+    for i, (bnode, _t) in enumerate(validated):
+        for slot, sid in enumerate(bnode["slot_sids"]):
+            if sid is None:
+                continue
+            snode = sessions_node.get(sid)
+            if snode is None or snode.get("mode") != "bucketed" or snode.get("bucket") != i or snode.get("slot") != slot:
+                raise CorruptCheckpointError(
+                    f"{path}: fleet bucket[{i}] slot {slot} claims session {sid!r} "
+                    "but the session registry disagrees"
+                )
+    # ---- install ----
+    engine._buckets.clear()
+    engine._sessions.clear()
+    engine._ckpt_cache.clear()
+    engine._ticks = int(node.get("ticks", 0))
+    engine._seq = int(node.get("seq", 0))
+    engine._applied_seq = int(node.get("applied_seq", 0))
+    engine._applied_above = set(node.get("applied_above", ()))
+    engine._initial_capacity = int(node.get("initial_capacity", engine._initial_capacity))
+    engine._next_auto = int(node.get("next_auto", 0))
+    engine._nan_guard = engine._nan_guard or bool(node.get("nan_guard", False))
+    buckets: List[Any] = []
+    for (bnode, template), bblob in zip(validated, bucket_blobs):
+        key = engine._bucket_key(template)
+        if key is None:
+            raise IncompatibleCheckpointError(
+                f"{path}: bucket template {bnode['class']} is no longer bucket-eligible "
+                "in this process (jit disabled or state drifted)"
+            )
+        bucket = _Bucket(template, bnode["label"], key, bnode["capacity"])
+        bucket.stacked = {k: jnp.asarray(v) for k, v in bnode["stacked"].items()}
+        bucket.slot_sids = list(bnode["slot_sids"])
+        bucket.free = list(bnode["free"])
+        bucket.high_water = int(bnode["high_water"])
+        bucket.version = int(bnode["version"])
+        bucket.faults = int(bnode["faults"])
+        bucket.compute_eager = bool(bnode["compute_eager"])
+        engine._buckets[key] = bucket
+        engine._ckpt_cache[key] = (bucket.version, bblob)  # clean until state moves again
+        buckets.append(bucket)
+    for sid, snode in sessions_node.items():
+        if snode["mode"] == "bucketed":
+            bucket = buckets[snode["bucket"]]
+            # the adopted original died with the crashed process; expire() will
+            # materialize the recovered row into this fresh clone
+            sess = _Session(sid, bucket.template.clone(), bucket, snode["slot"])
+        else:
+            sess = _Session(sid, loose_metrics[sid], None, -1)
+        sess.base_count = int(snode["base_count"])
+        sess.engine_count = int(snode["engine_count"])
+        sess.health = snode["health"]
+        engine._sessions[sid] = sess
+    # ---- replay the journal, original seqs ----
+    n_replayed = 0
+    if wal_path is not None and os.path.exists(os.fspath(wal_path)):
+        records, _torn = IngestWAL.read_records(wal_path)
+        engine._replaying = True
+        try:
+            for kind, seq, sid, payload in records:
+                engine._seq = max(engine._seq, seq)
+                if engine._is_applied(seq):
+                    continue
+                if kind == "submit":
+                    sess = engine._sessions.get(sid)
+                    if sess is None:
+                        raise CorruptCheckpointError(
+                            f"{os.fspath(wal_path)}: journal submit seq={seq} targets unknown "
+                            f"session {sid!r} (journal/checkpoint mismatch)"
+                        )
+                    args, kwargs = payload
+                    engine._route(sess, seq, tuple(args), dict(kwargs))
+                elif kind == "add":
+                    if isinstance(payload, tuple) and len(payload) == 2 and payload[0] == "__metric__":
+                        payload = _unpickle(payload[1], f"journal add seq={seq} metric", os.fspath(wal_path))
+                    engine._apply_add(sid, payload)
+                    if isinstance(sid, int) and sid >= engine._next_auto:
+                        engine._next_auto = sid + 1  # auto-assigned ids must not recycle
+                    engine._mark_applied(seq)
+                elif kind == "expire":
+                    engine._apply_expire(sid)
+                    engine._mark_applied(seq)
+                elif kind == "reset":
+                    engine._apply_reset(sid)
+                    engine._mark_applied(seq)
+                else:
+                    raise CorruptCheckpointError(
+                        f"{os.fspath(wal_path)}: journal record seq={seq} has unknown kind {kind!r}"
+                    )
+                n_replayed += 1
+        finally:
+            engine._replaying = False
+        _observe.note_wal_replay("engine", n_replayed)
+    if wal_path is not None:
+        engine._wal = IngestWAL(wal_path)
+        engine._wal_path = os.fspath(wal_path)
+        # repair: drop applied records and any torn tail the crash left behind,
+        # so future appends land on an intact journal
+        engine._wal.truncate(lambda seq: not engine._is_applied(seq))
+    _observe.note_checkpoint_restore("StreamEngine", path)
+    _observe.note_fleet_restore("engine", len(engine._sessions), n_replayed)
+    return engine

@@ -1,5 +1,6 @@
 """Multi-tenant fleet runtime: thousands of live metric streams, one donated
-XLA dispatch per bucket per tick (DESIGN §15).
+XLA dispatch per bucket per tick (DESIGN §15), durable and self-healing
+(DESIGN §17).
 
 The serving-fleet workload is a heterogeneous, churning population of live
 ``Metric`` instances — millions of user sessions, each with its own accuracy /
@@ -29,20 +30,38 @@ independent of fleet size and fleet churn:
   into numpy staging buffers and flushes. Submissions with distinct batch
   signatures — or repeat submissions for one slot — split into ordered waves,
   each wave one dispatch, so per-session ordering is preserved.
+* **Blast-radius isolation.** Failures are contained to the sessions they
+  touch. A wave that fails to *trace* demotes only the sessions in that wave
+  to loose mode (their rows materialize back, their pending submissions
+  replay eagerly); the rest of the bucket keeps its rows and its compiled
+  program. A wave whose dispatch dies at *runtime* (buffers intact) replays
+  each row eagerly: surviving rows scatter back in, a row whose update raises
+  is individually **quarantined** — rolled back, ejected to loose mode,
+  ``health == "quarantined"`` — without costing the bucket anything. The
+  opt-in ``nan_guard`` quarantines sessions submitting non-finite batches at
+  staging time, before they can contaminate a dispatch. In every case the
+  surviving rows still cost one dispatch per bucket per tick.
+* **Durability.** With ``wal_path=`` set, every ``add_session`` / ``submit``
+  / ``expire`` / ``reset`` appends a CRC-framed record to an ingest
+  write-ahead journal (``engine/durability.py``) before it takes effect, and
+  the journal is fsynced at each flush boundary. ``checkpoint()`` writes an
+  incremental fleet snapshot (dirty buckets only) through the MTCKPT
+  container and truncates the journal; :meth:`StreamEngine.restore` rebuilds
+  the fleet from checkpoint + journal replay, bit-exact versus a
+  never-crashed engine. ``resilience.checkpoint.save_checkpoint`` /
+  ``PeriodicCheckpointer`` route StreamEngine targets here automatically.
 
 Sessions whose metrics cannot take the vmapped path (list states, host-side
 updates, unhashable config, jit disabled, ineligible batch values) run as
 *loose* sessions: same API, per-instance eager updates, reported via the
-``fleet_loose_update`` counter. A trace failure inside a bucket demotes all
-of its sessions to loose and replays the pending queue eagerly — the same
-never-lose-an-update contract as the replica engine's loop fallback.
+``fleet_loose_update`` counter — the same never-lose-an-update contract as
+the replica engine's loop fallback.
 """
 
 from __future__ import annotations
 
-import itertools
 from collections import OrderedDict
-from typing import Any, Dict, Hashable, List, Optional, Tuple
+from typing import Any, Dict, Hashable, List, Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -79,10 +98,17 @@ def _submission_sig(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[Any,
     return (len(args), kw_names, tuple(leaf(a) for a in args), tuple(leaf(kwargs[k]) for k in kw_names))
 
 
+def _host_value(v: Any) -> Any:
+    """Journal-able host form of one submission argument."""
+    if isinstance(v, jax.Array):
+        return np.asarray(jax.device_get(v))
+    return v
+
+
 class _Session:
     """One live stream: its metric instance plus where its state lives."""
 
-    __slots__ = ("sid", "metric", "bucket", "slot", "base_count", "engine_count", "queue")
+    __slots__ = ("sid", "metric", "bucket", "slot", "base_count", "engine_count", "queue", "health")
 
     def __init__(self, sid: Hashable, metric: Metric, bucket: Optional["_Bucket"], slot: int) -> None:
         self.sid = sid
@@ -91,7 +117,9 @@ class _Session:
         self.slot = slot
         self.base_count = metric._update_count  # updates accumulated before adoption
         self.engine_count = 0  # engine dispatches applied to this row since
-        self.queue: List[Tuple[Tuple[Any, ...], Dict[str, Any]]] = []  # loose sessions only
+        # loose sessions queue (seq, args, kwargs); bucketed queues live on the bucket
+        self.queue: List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]] = []
+        self.health = "healthy" if bucket is not None else "loose"
 
 
 class _Bucket:
@@ -100,7 +128,7 @@ class _Bucket:
     __slots__ = (
         "key", "label", "template", "capacity", "stacked", "slot_sids", "free",
         "high_water", "queue", "version", "computed", "computed_version",
-        "compute_eager", "row_bytes",
+        "compute_eager", "row_bytes", "faults",
     )
 
     def __init__(self, template: Metric, label: str, key: Any, capacity: int) -> None:
@@ -114,11 +142,12 @@ class _Bucket:
         # slots are appended and therefore reused before untouched ones
         self.free: List[int] = list(range(capacity - 1, -1, -1))
         self.high_water = -1  # highest slot ever occupied (fragmentation horizon)
-        self.queue: List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]] = []
+        self.queue: List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]] = []  # (slot, seq, args, kwargs)
         self.version = 0  # bumped on every state change; invalidates cached computes
         self.computed: Any = None
         self.computed_version = -1
         self.compute_eager = False  # latched when the vmapped compute cannot trace
+        self.faults = 0  # wave fallbacks + quarantines this bucket has absorbed
         self.row_bytes = sum(
             int(np.prod(np.asarray(d).shape, dtype=np.int64)) * np.dtype(np.asarray(d).dtype).itemsize
             for d in template._defaults.values()
@@ -148,33 +177,90 @@ class _Bucket:
         even under an optimal (non-compacting) allocator."""
         return sum(1 for s in self.free if s <= self.high_water)
 
+    def health(self) -> str:
+        """"healthy" while every dispatch path is intact; "degraded" once the
+        bucket has latched eager compute or absorbed a fault (a demoted wave or
+        quarantined row) — its surviving rows still dispatch normally."""
+        return "degraded" if (self.compute_eager or self.faults) else "healthy"
+
 
 class StreamEngine:
     """Drive an arbitrary, churning population of live metrics as a bucketed fleet.
 
     ::
 
-        engine = StreamEngine()
+        engine = StreamEngine(wal_path="fleet.wal")
         sid = engine.add_session(MulticlassAccuracy(num_classes=10))
         engine.submit(sid, preds, target)     # host-side enqueue, no dispatch
         engine.tick()                         # ONE dispatch per touched bucket
         value = engine.compute(sid)           # vmapped compute, host-sliced
+        engine.checkpoint("fleet.ckpt")       # incremental snapshot + WAL truncate
         metric = engine.expire(sid)           # state materialized back out
+
+        # after a crash: checkpoint + journal replay, bit-exact
+        engine = StreamEngine.restore("fleet.ckpt", wal_path="fleet.wal")
 
     ``add_session`` adopts the instance (including any state it already
     accumulated); until ``expire`` hands it back, route updates through
     ``submit`` — the adopted instance's own ``update`` would diverge from the
-    engine-resident row.
+    engine-resident row. After :meth:`restore`, bucketed sessions hold fresh
+    instances cloned from the bucket template (the adopted originals died with
+    the crashed process); ``expire`` materializes the recovered state into them.
     """
 
-    def __init__(self, initial_capacity: int = 8) -> None:
+    def __init__(
+        self,
+        initial_capacity: int = 8,
+        wal_path: Optional[str] = None,
+        nan_guard: bool = False,
+    ) -> None:
         if initial_capacity < 1:
             raise TPUMetricsUserError("StreamEngine initial_capacity must be >= 1")
         self._initial_capacity = 1 << (int(initial_capacity) - 1).bit_length()
         self._buckets: "OrderedDict[Any, _Bucket]" = OrderedDict()
         self._sessions: Dict[Hashable, _Session] = {}
-        self._auto_sid = itertools.count()
+        self._next_auto = 0  # plain int (not itertools.count) so restore can resume it
         self._ticks = 0
+        self._nan_guard = bool(nan_guard)
+        # --- durability bookkeeping (engine/durability.py) ---
+        self._seq = 0  # last ingest sequence number handed out
+        self._applied_seq = 0  # contiguous applied watermark: every seq <= this landed
+        self._applied_above: Set[int] = set()  # applied out of order, above the watermark
+        self._replaying = False  # WAL replay in flight: do not re-journal
+        self._ckpt_cache: Dict[Any, Tuple[int, bytes]] = {}  # bucket key -> (version, node bytes)
+        self._wal = None
+        self._wal_path = wal_path
+        if wal_path is not None:
+            from metrics_tpu.engine.durability import IngestWAL
+
+            self._wal = IngestWAL(wal_path)
+
+    # ------------------------------------------------------------------ sequencing
+    def _log(self, kind: str, sid: Optional[Hashable], payload: Any = None) -> int:
+        """Assign the next ingest sequence number; journal the record first.
+
+        The WAL is strictly write-ahead: the record hits the journal's buffer
+        before the engine applies any effect, and the buffer is fsynced at each
+        flush boundary — so a crash can lose at most a suffix of not-yet-synced
+        records, never reorder or tear the middle of the history.
+        """
+        self._seq += 1
+        if self._wal is not None and not self._replaying:
+            self._wal.append(kind, self._seq, sid, payload)
+            _observe.note_wal_append("engine")
+        return self._seq
+
+    def _mark_applied(self, seq: int) -> None:
+        if seq == self._applied_seq + 1:
+            self._applied_seq = seq
+            while self._applied_seq + 1 in self._applied_above:
+                self._applied_seq += 1
+                self._applied_above.discard(self._applied_seq)
+        elif seq > self._applied_seq:
+            self._applied_above.add(seq)
+
+    def _is_applied(self, seq: int) -> bool:
+        return seq <= self._applied_seq or seq in self._applied_above
 
     # ------------------------------------------------------------------ sessions
     def __len__(self) -> int:
@@ -183,20 +269,37 @@ class StreamEngine:
     def session_ids(self) -> List[Hashable]:
         return list(self._sessions)
 
+    def session_health(self, session_id: Hashable) -> str:
+        """"healthy" (bucketed), "loose" (eager fallback) or "quarantined"."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"unknown or expired session {session_id!r}")
+        return sess.health
+
     def add_session(self, metric: Metric, session_id: Optional[Hashable] = None) -> Hashable:
         """Adopt a live metric instance into the fleet; returns its session id."""
         if not isinstance(metric, Metric):
             raise TPUMetricsUserError(
                 f"StreamEngine.add_session expects a Metric instance, got {type(metric).__name__}"
             )
-        sid = next(self._auto_sid) if session_id is None else session_id
+        if session_id is None:
+            sid = self._next_auto
+            self._next_auto += 1
+        else:
+            sid = session_id
         if sid in self._sessions:
             raise TPUMetricsUserError(f"session {sid!r} is already live in this engine")
+        seq = self._log("add", sid, metric)
+        self._apply_add(sid, metric)
+        self._mark_applied(seq)
+        return sid
+
+    def _apply_add(self, sid: Hashable, metric: Metric) -> None:
         key = self._bucket_key(metric)
         if key is None:
             self._sessions[sid] = _Session(sid, metric, None, -1)
             _observe.note_fleet_session("loose", "add")
-            return sid
+            return
         bucket = self._buckets.get(key)
         if bucket is None:
             template = metric.clone()
@@ -221,7 +324,6 @@ class StreamEngine:
             bucket.version += 1
         self._sessions[sid] = _Session(sid, metric, bucket, slot)
         _observe.note_fleet_session(bucket.label, "add")
-        return sid
 
     def _bucket_key(self, metric: Metric) -> Optional[Any]:
         """(config key, state avals) when the metric can ride a bucket, else None."""
@@ -242,6 +344,19 @@ class StreamEngine:
         sess = self._sessions.get(session_id)
         if sess is None:
             raise KeyError(f"unknown or expired session {session_id!r}")
+        seq = self._log(
+            "submit",
+            session_id,
+            (
+                tuple(_host_value(a) for a in args),
+                {k: _host_value(v) for k, v in kwargs.items()},
+            )
+            if self._wal is not None and not self._replaying
+            else None,
+        )
+        self._route(sess, seq, args, kwargs)
+
+    def _route(self, sess: _Session, seq: int, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
         bucket = sess.bucket
         if bucket is not None and not bucket.template._jit_eligible(args, kwargs):
             # this batch cannot enter a traced dispatch (host-only values, or jit
@@ -249,9 +364,9 @@ class StreamEngine:
             self._demote_session(sess)
             bucket = None
         if bucket is None:
-            sess.queue.append((args, kwargs))
+            sess.queue.append((seq, args, kwargs))
         else:
-            bucket.queue.append((sess.slot, args, kwargs))
+            bucket.queue.append((sess.slot, seq, args, kwargs))
 
     def tick(self) -> int:
         """Flush every pending queue; returns the number of XLA update dispatches."""
@@ -262,6 +377,10 @@ class StreamEngine:
         return dispatches
 
     def _flush_pending(self) -> int:
+        if self._wal is not None and not self._replaying:
+            # durability point: every record whose effect is about to land must
+            # be on disk first, so recovery can always redo this flush
+            self._wal.sync()
         dispatches = 0
         for bucket in list(self._buckets.values()):
             if bucket.queue:
@@ -273,12 +392,36 @@ class StreamEngine:
 
     def _flush_loose(self, sess: _Session) -> None:
         pending, sess.queue = sess.queue, []
-        for args, kwargs in pending:
-            sess.metric.update(*args, **kwargs)
+        for i, (seq, args, kwargs) in enumerate(pending):
+            try:
+                sess.metric.update(*args, **kwargs)
+            except BaseException:
+                # the metric rolled itself back (transactional update); the failed
+                # submission is consumed, the rest stay queued for the next flush
+                self._mark_applied(seq)
+                sess.queue = pending[i + 1 :] + sess.queue
+                raise
+            self._mark_applied(seq)
             _observe.note_fleet_loose_update(type(sess.metric).__name__)
 
+    def _poisoned(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> bool:
+        """Host-side finiteness sweep over the float array leaves of one batch."""
+        for v in list(args) + list(kwargs.values()):
+            if isinstance(v, (jax.Array, np.ndarray)):
+                arr = np.asarray(jax.device_get(v)) if isinstance(v, jax.Array) else v
+                if arr.dtype.kind in "fc" and arr.size and not np.isfinite(arr).all():
+                    return True
+        return False
+
     def _flush_bucket(self, bucket: _Bucket) -> int:
-        """Coalesce the bucket's queue into waves and dispatch each wave once."""
+        """Coalesce the bucket's queue into waves; dispatch each surviving wave once.
+
+        Failure containment per wave (DESIGN §17): a NaN-guarded poisoned
+        submission or a trace failure ejects exactly the sessions involved,
+        a runtime dispatch death falls back to per-row replay with per-row
+        quarantine — in every case the rest of the bucket keeps its rows, its
+        compiled program, and its one-dispatch-per-tick economy.
+        """
         queue, bucket.queue = bucket.queue, []
         _observe.note_fleet_flush(bucket.label)
         # wave = how many earlier submissions this slot already has in the queue;
@@ -286,14 +429,32 @@ class StreamEngine:
         # every first-submission-per-slot coalesce into one dispatch
         seen: Dict[int, int] = {}
         groups: "OrderedDict[Tuple[int, Any], List[int]]" = OrderedDict()
-        for idx, (slot, args, kwargs) in enumerate(queue):
+        for idx, (slot, _seq, args, kwargs) in enumerate(queue):
             wave = seen.get(slot, 0)
             seen[slot] = wave + 1
             groups.setdefault((wave, _submission_sig(args, kwargs)), []).append(idx)
         dispatches = 0
-        done: set = set()
-        for (wave, _sig), idxs in sorted(groups.items(), key=lambda kv: kv[0][0]):
-            subs = [queue[i] for i in idxs]
+        done: Set[int] = set()
+        dead_slots: Set[int] = set()  # slots whose sessions left the bucket mid-flush
+        for (_wave, _sig), idxs in sorted(groups.items(), key=lambda kv: kv[0][0]):
+            live = [i for i in idxs if i not in done and queue[i][0] not in dead_slots]
+            if self._nan_guard:
+                clean: List[int] = []
+                for i in live:
+                    slot, seq, args, kwargs = queue[i]
+                    if self._poisoned(args, kwargs):
+                        sess = self._sessions[bucket.slot_sids[slot]]
+                        self._quarantine(sess, "nan_guard")
+                        self._mark_applied(seq)  # the poisoned batch is consumed (dropped)
+                        done.add(i)
+                        dead_slots.add(slot)
+                        self._replay_tail(queue, done, slot, sess)
+                    else:
+                        clean.append(i)
+                live = clean
+            if not live:
+                continue
+            subs = [queue[i] for i in live]
             try:
                 stacked_args, stacked_kwargs, mask = self._stage(bucket, subs)
                 new_stacked = engine_update(
@@ -302,28 +463,104 @@ class StreamEngine:
                     cache=_FLEET_JIT_CACHE, label=bucket.label,
                 )
             except TRACER_ERRORS as exc:
-                # trace failure aborts before execution: the stacked buffers are
-                # intact, so dissolve the bucket into loose sessions and replay
-                # everything not yet applied — no submission is ever lost
-                remaining = [queue[i] for i in range(len(queue)) if i not in done]
-                self._demote_bucket(bucket, exc, remaining)
-                return dispatches
+                # trace failure aborts before execution (stacked buffers intact):
+                # demote ONLY this wave's sessions to loose and replay their
+                # submissions eagerly — the rest of the bucket keeps its rows
+                _observe.note_fleet_fallback(bucket.label, exc)
+                bucket.faults += 1
+                for i in live:
+                    slot, seq, args, kwargs = queue[i]
+                    sess = self._sessions[bucket.slot_sids[slot]]
+                    self._materialize(sess)
+                    self._release_slot(sess)
+                    sess.health = "loose"
+                    done.add(i)
+                    dead_slots.add(slot)
+                    sess.metric.update(*args, **kwargs)
+                    self._mark_applied(seq)
+                    _observe.note_fleet_loose_update(type(sess.metric).__name__)
+                    self._replay_tail(queue, done, slot, sess)
+                if bucket.active() == 0:
+                    self._drop_bucket(bucket)
+                continue
+            except Exception as exc:  # noqa: BLE001 — runtime dispatch death
+                if any(
+                    getattr(v, "is_deleted", lambda: False)() for v in bucket.stacked.values()
+                ):
+                    # the dead dispatch consumed its donated inputs: in-memory
+                    # state is unrecoverable — this is exactly what checkpoints
+                    # + the ingest WAL exist for
+                    raise RuntimeError(
+                        f"fleet bucket {bucket.label!r}: dispatch died after consuming its "
+                        "donated state buffers; in-memory recovery is impossible. Recover "
+                        "via StreamEngine.restore(checkpoint, wal_path=...)."
+                    ) from exc
+                self._replay_wave_rows(bucket, queue, live, done, dead_slots)
+                continue
             bucket.stacked = new_stacked
             bucket.version += 1
-            for slot, _a, _k in subs:
+            for slot, seq, _a, _k in subs:
                 self._sessions[bucket.slot_sids[slot]].engine_count += 1
-            done.update(idxs)
+                self._mark_applied(seq)
+            done.update(live)
             _observe.note_engine_dispatch("fleet", bucket.label)
             dispatches += 1
         return dispatches
 
+    def _replay_wave_rows(
+        self, bucket: _Bucket, queue: List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]],
+        live: List[int], done: Set[int], dead_slots: Set[int],
+    ) -> None:
+        """A wave's dispatch died at runtime with the stacked buffers intact:
+        re-run each row's update eagerly through the pure per-row kernel.
+        Surviving rows scatter back in; a row whose update raises is
+        individually quarantined with its state rolled back (untouched)."""
+        for i in live:
+            slot, seq, args, kwargs = queue[i]
+            sess = self._sessions[bucket.slot_sids[slot]]
+            row = {k: v[slot] for k, v in bucket.stacked.items()}
+            try:
+                new_row = bucket.template._functional_update(
+                    row,
+                    *(jnp.asarray(a) if isinstance(a, (jax.Array, np.ndarray)) else a for a in args),
+                    **{k: jnp.asarray(v) if isinstance(v, (jax.Array, np.ndarray)) else v for k, v in kwargs.items()},
+                )
+            except Exception as row_exc:  # noqa: BLE001 — this row is the poison
+                self._quarantine(sess, "update_error", row_exc)
+                dead_slots.add(slot)
+                done.add(i)
+                self._mark_applied(seq)  # the failed submission is consumed (dropped)
+                self._replay_tail(queue, done, slot, sess)
+                continue
+            for k in bucket.stacked:
+                bucket.stacked[k] = bucket.stacked[k].at[slot].set(new_row[k])
+            bucket.version += 1
+            sess.engine_count += 1
+            done.add(i)
+            self._mark_applied(seq)
+            _observe.note_fleet_row_replay(bucket.label)
+
+    def _replay_tail(
+        self, queue: List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]],
+        done: Set[int], slot: int, sess: _Session,
+    ) -> None:
+        """Eagerly apply every not-yet-flushed queued submission of a session
+        that just left the bucket, preserving its per-session order."""
+        for j, (qslot, seq, args, kwargs) in enumerate(queue):
+            if j in done or qslot != slot:
+                continue
+            done.add(j)
+            sess.metric.update(*args, **kwargs)
+            self._mark_applied(seq)
+            _observe.note_fleet_loose_update(type(sess.metric).__name__)
+
     def _stage(
-        self, bucket: _Bucket, subs: List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]]
+        self, bucket: _Bucket, subs: List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]]
     ) -> Tuple[Tuple[Any, ...], Dict[str, Any], Any]:
         """Scatter one wave's host batches into (capacity, ...) staging buffers."""
         capacity = bucket.capacity
-        slots = [s for s, _a, _k in subs]
-        args0, kwargs0 = subs[0][1], subs[0][2]
+        slots = [s for s, _q, _a, _k in subs]
+        args0, kwargs0 = subs[0][2], subs[0][3]
         kw_names = sorted(kwargs0)
 
         def stage(pick) -> Any:
@@ -335,8 +572,8 @@ class StreamEngine:
             buf[slots] = rows
             return jnp.asarray(buf)
 
-        stacked_args = tuple(stage(lambda sub, i=i: sub[1][i]) for i in range(len(args0)))
-        stacked_kwargs = {k: stage(lambda sub, k=k: sub[2][k]) for k in kw_names}
+        stacked_args = tuple(stage(lambda sub, i=i: sub[2][i]) for i in range(len(args0)))
+        stacked_kwargs = {k: stage(lambda sub, k=k: sub[3][k]) for k in kw_names}
         mask = np.zeros(capacity, dtype=bool)
         mask[slots] = True
         return stacked_args, stacked_kwargs, jnp.asarray(mask)
@@ -360,37 +597,34 @@ class StreamEngine:
         sess.bucket = None
         sess.slot = -1
 
+    def _quarantine(self, sess: _Session, reason: str, exc: Optional[BaseException] = None) -> None:
+        """Individually eject one session (blast-radius isolation): its row is
+        materialized back (rolled back for a failed update — the stacked row was
+        never touched), its slot recycles, and it runs loose from here on with
+        ``health == "quarantined"``. The bucket keeps every other row."""
+        bucket = sess.bucket
+        self._materialize(sess)
+        self._release_slot(sess)
+        sess.health = "quarantined"
+        bucket.faults += 1
+        _observe.note_fleet_quarantine(bucket.label, reason, exc)
+
     def _demote_session(self, sess: _Session) -> None:
         """Convert one bucketed session to a loose one (row handed back)."""
         bucket = sess.bucket
         if bucket.queue:
             self._flush_bucket(bucket)  # ordering: queued updates land first
         if sess.bucket is None:
-            return  # the flush itself demoted the whole bucket
+            return  # the flush itself demoted this session
         self._materialize(sess)
         self._release_slot(sess)
+        sess.health = "loose"
 
-    def _demote_bucket(
-        self, bucket: _Bucket, exc: BaseException,
-        remaining: List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]],
-    ) -> None:
-        """Trace failure: dissolve the bucket, replay unapplied submissions eagerly."""
-        _observe.note_fleet_fallback(bucket.label, exc)
-        replay: List[Tuple[_Session, Tuple[Any, ...], Dict[str, Any]]] = []
-        for slot, args, kwargs in remaining:
-            replay.append((self._sessions[bucket.slot_sids[slot]], args, kwargs))
-        for sid in bucket.slot_sids:
-            if sid is None:
-                continue
-            sess = self._sessions[sid]
-            self._materialize(sess)
-            sess.bucket = None
-            sess.slot = -1
+    def _drop_bucket(self, bucket: _Bucket) -> None:
+        """Remove an emptied bucket (every session demoted/quarantined away)."""
         self._buckets.pop(bucket.key, None)
+        self._ckpt_cache.pop(bucket.key, None)
         _observe.set_fleet_gauges(bucket.label, 0, 0, 0, 0, 0)
-        for sess, args, kwargs in replay:
-            sess.metric.update(*args, **kwargs)
-            _observe.note_fleet_loose_update(type(sess.metric).__name__)
 
     # ------------------------------------------------------------------ readout
     def compute(self, session_id: Hashable) -> Any:
@@ -452,6 +686,14 @@ class StreamEngine:
     def expire(self, session_id: Hashable) -> Metric:
         """Retire a session: flush its pending updates, materialize its state back
         into the metric instance, recycle its row, and hand the metric back."""
+        if session_id not in self._sessions:
+            raise KeyError(f"unknown or expired session {session_id!r}")
+        seq = self._log("expire", session_id)
+        metric = self._apply_expire(session_id)
+        self._mark_applied(seq)
+        return metric
+
+    def _apply_expire(self, session_id: Hashable) -> Metric:
         sess = self._sessions.get(session_id)
         if sess is None:
             raise KeyError(f"unknown or expired session {session_id!r}")
@@ -475,15 +717,26 @@ class StreamEngine:
         Pending queued submissions for the reset scope are discarded — a reset
         row starts from zero, exactly like ``Metric.reset()``.
         """
+        if session_id is not None and session_id not in self._sessions:
+            raise KeyError(f"unknown or expired session {session_id!r}")
+        seq = self._log("reset", session_id)
+        self._apply_reset(session_id)
+        self._mark_applied(seq)
+
+    def _apply_reset(self, session_id: Optional[Hashable]) -> None:
         if session_id is None:
             for bucket in self._buckets.values():
                 bucket.stacked = bucket._tiled_defaults(bucket.capacity)
+                for _slot, qseq, _a, _k in bucket.queue:
+                    self._mark_applied(qseq)  # discarded, never to be replayed
                 bucket.queue = []
                 bucket.version += 1
             for sess in self._sessions.values():
                 sess.metric.reset()
                 sess.base_count = 0
                 sess.engine_count = 0
+                for qseq, _a, _k in sess.queue:
+                    self._mark_applied(qseq)
                 sess.queue = []
             self._publish_gauges()
             return
@@ -495,17 +748,57 @@ class StreamEngine:
         sess.engine_count = 0
         bucket = sess.bucket
         if bucket is None:
+            for qseq, _a, _k in sess.queue:
+                self._mark_applied(qseq)
             sess.queue = []
             return
-        bucket.queue = [(s, a, k) for s, a, k in bucket.queue if s != sess.slot]
+        kept = []
+        for entry in bucket.queue:
+            if entry[0] == sess.slot:
+                self._mark_applied(entry[1])
+            else:
+                kept.append(entry)
+        bucket.queue = kept
         for k, d in bucket.template._defaults.items():
             bucket.stacked[k] = bucket.stacked[k].at[sess.slot].set(jnp.asarray(d))
         bucket.version += 1
 
+    # ------------------------------------------------------------------ durability
+    def checkpoint(self, path: str) -> str:
+        """Write an incremental fleet snapshot (dirty buckets only) and truncate
+        the ingest journal down to the records the snapshot does not yet cover.
+        ``resilience.checkpoint.save_checkpoint(engine, path)`` is equivalent."""
+        from metrics_tpu.engine.durability import save_fleet_checkpoint
+
+        return save_fleet_checkpoint(self, path)
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        wal_path: Optional[str] = None,
+        initial_capacity: int = 8,
+        nan_guard: bool = False,
+    ) -> "StreamEngine":
+        """Rebuild a fleet from a checkpoint, then replay the ingest journal.
+
+        The checkpoint is fully validated before anything is installed; journal
+        records already covered by the snapshot's applied watermark are skipped,
+        the rest re-enter through the normal ingest path in sequence order (so
+        wave grouping — and therefore the recovered states — are bit-exact
+        versus an engine that never crashed). Replayed submissions sit in the
+        ingest queues; the next ``tick()``/``compute()`` applies them.
+        """
+        from metrics_tpu.engine.durability import restore_fleet_checkpoint
+
+        engine = cls(initial_capacity=initial_capacity, nan_guard=nan_guard)
+        restore_fleet_checkpoint(engine, path, wal_path=wal_path)
+        return engine
+
     # ------------------------------------------------------------------ telemetry
     def stats(self) -> Dict[str, Any]:
-        """Occupancy/fragmentation/pad-waste per bucket plus fleet totals (also
-        pushed as ``fleet_*`` observe gauges when telemetry is enabled)."""
+        """Occupancy/fragmentation/pad-waste/health per bucket plus fleet totals
+        (also pushed as ``fleet_*`` observe gauges when telemetry is enabled)."""
         buckets: Dict[str, Dict[str, Any]] = {}
         tot_active = tot_capacity = tot_bytes = tot_bytes_active = 0
         for bucket in self._buckets.values():
@@ -521,18 +814,24 @@ class StreamEngine:
                 "bytes_stacked": bytes_stacked,
                 "occupancy_pct": 100.0 * active / bucket.capacity,
                 "pad_waste_pct": 100.0 * (bytes_stacked - bytes_active) / bytes_stacked if bytes_stacked else 0.0,
+                "health": bucket.health(),
+                "faults": bucket.faults,
             }
             tot_active += active
             tot_capacity += bucket.capacity
             tot_bytes += bytes_stacked
             tot_bytes_active += bytes_active
         loose = sum(1 for s in self._sessions.values() if s.bucket is None)
+        quarantined = sum(1 for s in self._sessions.values() if s.health == "quarantined")
         self._publish_gauges()
         return {
             "buckets": buckets,
             "sessions": len(self._sessions),
             "loose_sessions": loose,
+            "quarantined_sessions": quarantined,
             "ticks": self._ticks,
+            "seq": self._seq,
+            "applied_seq": self._applied_seq,
             "rows_active": tot_active,
             "rows_capacity": tot_capacity,
             "occupancy_pct": 100.0 * tot_active / tot_capacity if tot_capacity else None,
@@ -552,4 +851,3 @@ class StreamEngine:
                 bucket.capacity * bucket.row_bytes,
                 active * bucket.row_bytes,
             )
-

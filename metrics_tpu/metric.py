@@ -618,6 +618,29 @@ class Metric(ABC):
         cls, items = key
         return hashlib.sha256(repr((cls.__module__, cls.__qualname__, items)).encode()).hexdigest()
 
+    def state_fingerprint(self) -> str:
+        """Content digest of the live state: class, update count, and every
+        registered state's name, aval and exact bytes (host order).
+
+        Two instances agree on this digest iff their observable accumulator
+        contents are bit-identical — the cheap equality the durability layer
+        (``engine/durability.py``) and the chaos recovery oracles use to assert
+        that checkpoint + WAL replay reproduced a never-crashed twin without
+        shipping full state trees around. NaNs hash by their bit pattern, so
+        NaN-poisoned states compare equal when truly bit-equal.
+        """
+        digest = hashlib.sha256(f"{type(self).__name__}:{int(self._update_count)}".encode())
+        state = self.__dict__["_state"]  # dict read: never trips the escape latch
+        for name in sorted(self._defaults):
+            v = state[name]
+            parts = v if isinstance(v, list) else [v]
+            digest.update(f"|{name}[{len(parts)}]".encode())
+            for part in parts:
+                arr = np.ascontiguousarray(np.asarray(jax.device_get(part)))
+                digest.update(f":{arr.dtype.str}{arr.shape}".encode())
+                digest.update(arr.tobytes())
+        return digest.hexdigest()
+
     def _lookup_shared_jit(self, donate: bool = False) -> _CompiledUpdate:
         """Return the compiled pure update for this config, compiling at most once per config."""
         cfg = self._jit_cache_key()

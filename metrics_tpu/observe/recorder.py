@@ -49,6 +49,9 @@ __all__ = [
     "note_fleet_fallback",
     "note_fleet_flush",
     "note_fleet_loose_update",
+    "note_fleet_quarantine",
+    "note_fleet_restore",
+    "note_fleet_row_replay",
     "note_fleet_session",
     "note_fleet_tick",
     "note_fused_compile",
@@ -61,6 +64,9 @@ __all__ = [
     "note_replica_dispatch",
     "note_replica_fallback",
     "note_replica_hit",
+    "note_wal_append",
+    "note_wal_replay",
+    "note_wal_truncate",
     "prometheus",
     "record_event",
     "reset",
@@ -343,6 +349,49 @@ def note_fleet_fallback(label: str, exc: BaseException) -> None:
         RECORDER.add_event("fleet_fallback", engine=label, error=type(exc).__name__, detail=str(exc)[:200])
 
 
+def note_fleet_quarantine(label: str, reason: str, exc: Optional[BaseException] = None) -> None:
+    """One session was individually quarantined out of its bucket (blast-radius
+    isolation): ``reason`` is "update_error", "nan_guard" or "probation"."""
+    if ENABLED:
+        RECORDER.add_count("fleet_quarantine", label)
+        RECORDER.add_event(
+            "fleet_quarantine", engine=label, reason=reason,
+            error=type(exc).__name__ if exc is not None else None,
+            detail=str(exc)[:200] if exc is not None else None,
+        )
+
+
+def note_fleet_row_replay(label: str, n: int = 1) -> None:
+    """Rows replayed eagerly inside a surviving bucket after a dispatch death."""
+    if ENABLED:
+        RECORDER.add_count("fleet_row_replay", label, n)
+
+
+def note_fleet_restore(label: str, n_sessions: int, n_replayed: int) -> None:
+    """A StreamEngine was rebuilt from a fleet checkpoint (+ WAL replay)."""
+    if ENABLED:
+        RECORDER.add_count("fleet_restore", label)
+        RECORDER.add_event("fleet_restore", engine=label, sessions=n_sessions, replayed=n_replayed)
+
+
+# ingest write-ahead-log hooks (engine/durability.py IngestWAL)
+def note_wal_append(label: str, n: int = 1) -> None:
+    if ENABLED:
+        RECORDER.add_count("wal_append", label, n)
+
+
+def note_wal_replay(label: str, n: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("wal_replay", label, n)
+        RECORDER.add_event("wal_replay", engine=label, records=n)
+
+
+def note_wal_truncate(label: str, kept: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("wal_truncate", label)
+        RECORDER.add_event("wal_truncate", engine=label, kept=kept)
+
+
 def set_fleet_gauges(
     label: str, active: int, capacity: int, fragmented: int, bytes_stacked: int, bytes_active: int
 ) -> None:
@@ -415,7 +464,11 @@ def snapshot() -> Dict[str, Any]:
                       "fleet_occupancy_pct": float|None,
                       "fleet_pad_waste_pct": float|None,
                       "fleet_dispatches_total": int,
-                      "fleet_dispatches_per_flush": float|None}}
+                      "fleet_dispatches_per_flush": float|None,
+                      "fleet_quarantined_total": int,
+                      "fleet_restores_total": int,
+                      "wal_appends_total": int,
+                      "wal_records_replayed_total": int}}
 
     The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
     buckets: occupancy is live rows over padded capacity, pad waste is the
@@ -473,6 +526,10 @@ def snapshot() -> Dict[str, Any]:
             "fleet_pad_waste_pct": (100.0 * (fleet_bytes - fleet_bytes_active) / fleet_bytes) if fleet_bytes else None,
             "fleet_dispatches_total": fleet_dispatches,
             "fleet_dispatches_per_flush": (fleet_dispatches / fleet_flushes) if fleet_flushes else None,
+            "fleet_quarantined_total": sum(counters.get("fleet_quarantine", {}).values()),
+            "fleet_restores_total": sum(counters.get("fleet_restore", {}).values()),
+            "wal_appends_total": sum(counters.get("wal_append", {}).values()),
+            "wal_records_replayed_total": sum(counters.get("wal_replay", {}).values()),
         },
     }
 

@@ -36,7 +36,7 @@ import jax
 import numpy as np
 
 from metrics_tpu.observe import recorder as _observe
-from metrics_tpu.utils.io import atomic_write_bytes
+from metrics_tpu.utils.io import atomic_write_chunks
 
 __all__ = [
     "CheckpointError",
@@ -51,6 +51,49 @@ __all__ = [
 MAGIC = b"MTCKPT01"
 FORMAT_VERSION = 1
 _HEAD = struct.Struct(">II")  # header_len, header_crc32
+
+# CRC32 is computed over fixed-size windows so a multi-GB payload (fleet bucket
+# snapshots) never needs a second contiguous copy just to be checksummed
+_CRC_CHUNK = 1 << 20
+
+
+def _crc32_chunked(*parts: bytes, chunk_size: int = _CRC_CHUNK) -> int:
+    """``zlib.crc32`` over the concatenation of ``parts`` without concatenating.
+
+    Bit-identical to ``zlib.crc32(b"".join(parts))`` (pinned by a regression
+    test): the CRC state is threaded through ``chunk_size`` memoryview windows,
+    so peak extra memory is O(chunk) instead of O(payload).
+    """
+    crc = 0
+    for part in parts:
+        view = memoryview(part)
+        for off in range(0, len(view), chunk_size):
+            crc = zlib.crc32(view[off : off + chunk_size], crc)
+    return crc & 0xFFFFFFFF
+
+
+def _write_container(
+    path: str, root_kind: str, root_class: str, payload_parts: List[bytes]
+) -> int:
+    """Frame ``payload_parts`` into one MTCKPT file, streamed (never joined).
+
+    Shared by the metric snapshot path below and the fleet checkpoint writer
+    (``engine/durability.py``): the header CRC/length describe the logical
+    payload (the parts concatenated), but neither the CRC pass nor the atomic
+    write ever materializes that concatenation. Returns total bytes written.
+    """
+    header = json.dumps(
+        {
+            "format_version": FORMAT_VERSION,
+            "payload_len": sum(len(p) for p in payload_parts),
+            "payload_crc32": _crc32_chunked(*payload_parts),
+            "root_kind": root_kind,
+            "root_class": root_class,
+        },
+        sort_keys=True,
+    ).encode("utf-8")
+    head = _HEAD.pack(len(header), zlib.crc32(header) & 0xFFFFFFFF)
+    return atomic_write_chunks(path, [MAGIC, head, header, *payload_parts])
 
 
 class CheckpointError(RuntimeError):
@@ -127,29 +170,35 @@ def _label(obj: Any) -> str:
 
 
 def save_checkpoint(obj: Any, path: Union[str, os.PathLike]) -> str:
-    """Atomically snapshot ``obj`` (Metric / MetricCollection / ReplicatedWrapper).
+    """Atomically snapshot ``obj`` (Metric / MetricCollection / ReplicatedWrapper
+    / StreamEngine — fleet targets route to ``engine/durability.py``).
 
     Captures ALL registered states (persistence flags gate ``state_dict``, not
     durability checkpoints) plus update counts, recursively for collections and
     replica engines. Returns the path written.
     """
+    fleet = _as_fleet(obj)
+    if fleet is not None:
+        from metrics_tpu.engine.durability import save_fleet_checkpoint
+
+        return save_fleet_checkpoint(fleet, path)
     path = os.fspath(path)
     node = _extract(obj)
     payload = pickle.dumps(node, protocol=pickle.HIGHEST_PROTOCOL)
-    header = json.dumps(
-        {
-            "format_version": FORMAT_VERSION,
-            "payload_len": len(payload),
-            "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
-            "root_kind": node["kind"],
-            "root_class": node["class"],
-        },
-        sort_keys=True,
-    ).encode("utf-8")
-    blob = MAGIC + _HEAD.pack(len(header), zlib.crc32(header) & 0xFFFFFFFF) + header + payload
-    atomic_write_bytes(path, blob)
-    _observe.note_checkpoint_save(_label(obj), path, len(blob))
+    nbytes = _write_container(path, node["kind"], node["class"], [payload])
+    _observe.note_checkpoint_save(_label(obj), path, nbytes)
     return path
+
+
+def _as_fleet(obj: Any) -> Optional[Any]:
+    """``obj`` when it is a StreamEngine, else None — without importing the
+    engine package for the common metric-only case (sys.modules probe)."""
+    import sys
+
+    stream_mod = sys.modules.get("metrics_tpu.engine.stream")
+    if stream_mod is not None and isinstance(obj, stream_mod.StreamEngine):
+        return obj
+    return None
 
 
 # ------------------------------------------------------------------ parse + verify
@@ -211,6 +260,50 @@ def _validate_metric(m: Any, node: Dict[str, Any], where: str) -> None:
             m._validate_loaded_state(key, value, key)
         except RuntimeError as exc:
             raise IncompatibleCheckpointError(f"{where}: {exc}") from exc
+        _validate_exact_dtype(m, key, node.get("avals", {}).get(key), where)
+
+
+# Under jax_enable_x64, metric updates may legitimately promote a registered
+# 32-bit state to its 64-bit twin (weak-typed increments stop canonicalizing
+# down), so a checkpoint written AND read in the x64 regime carries the widened
+# dtype on both sides. Any other divergence is a writer/reader regime mismatch.
+_X64_WIDENS = {
+    "int32": "int64",
+    "uint32": "uint64",
+    "float32": "float64",
+    "complex64": "complex128",
+}
+
+
+def _dtype_matches(got: str, expected: str) -> bool:
+    if got == expected:
+        return True
+    return bool(jax.config.jax_enable_x64) and _X64_WIDENS.get(expected) == got
+
+
+def _validate_exact_dtype(m: Any, key: str, aval: Optional[Dict[str, Any]], where: str) -> None:
+    """Exact-dtype aval check, stricter than ``_validate_loaded_state``.
+
+    The in-memory loader accepts any same-kind dtype (an f64 host array loads
+    into an f32 state by design), but a durability checkpoint crossing that
+    boundary is almost always a ``jax_enable_x64`` mismatch between writer and
+    reader — silently narrowing (or widening) restored accumulators corrupts
+    long-run aggregates, so reject it with a diagnosis instead.
+    """
+    if not aval or "list" in aval:
+        return  # list payloads carry their own per-element dtypes (validated by kind)
+    _, expected, growable = m._expected_aval(key)
+    if growable:
+        return  # cat-reduced defaults don't pin the accumulated element dtype
+    expected_name = np.dtype(expected).name
+    got = aval.get("dtype")
+    if got and not _dtype_matches(got, expected_name):
+        raise IncompatibleCheckpointError(
+            f"{where}: state {key!r} was checkpointed as dtype {got} but this process "
+            f"expects {expected_name} — precision regime mismatch (was `jax_enable_x64` "
+            "toggled between the writing and the restoring process?). Refusing to "
+            "silently cast restored accumulator state."
+        )
 
 
 def _validate(obj: Any, node: Dict[str, Any], where: str) -> None:
@@ -291,6 +384,11 @@ def restore_checkpoint(obj: Any, path: Union[str, os.PathLike]) -> Any:
     :class:`CorruptCheckpointError` / :class:`IncompatibleCheckpointError` and
     leaves ``obj`` bit-identical to its pre-call state. Returns ``obj``.
     """
+    fleet = _as_fleet(obj)
+    if fleet is not None:
+        from metrics_tpu.engine.durability import restore_fleet_checkpoint
+
+        return restore_fleet_checkpoint(fleet, path)
     path = os.fspath(path)
     try:
         with open(path, "rb") as fh:

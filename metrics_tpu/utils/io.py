@@ -10,24 +10,42 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Union
+from typing import Iterable, Union
 
-__all__ = ["atomic_write_bytes", "atomic_write_text"]
+__all__ = ["atomic_write_bytes", "atomic_write_chunks", "atomic_write_text", "fsync_directory"]
 
 
-def atomic_write_bytes(path: Union[str, os.PathLike], payload: bytes, fsync: bool = True) -> None:
-    """Atomically replace ``path`` with ``payload``.
+def fsync_directory(directory: str) -> None:
+    """fsync a directory so a just-completed rename inside it is durable."""
+    try:
+        dir_fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: the data fsync already ran
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
 
-    The temp file lives in the destination directory (``os.replace`` must not
-    cross filesystems) and is unlinked on any failure, so a crashed writer never
-    leaves a partial file under the real name.
+
+def atomic_write_chunks(path: Union[str, os.PathLike], chunks: Iterable[bytes], fsync: bool = True) -> int:
+    """Atomically replace ``path`` with the concatenation of ``chunks``.
+
+    The streaming sibling of :func:`atomic_write_bytes`: chunks are written one
+    by one, so a multi-part payload (checkpoint framing + per-bucket pickles)
+    never has to be concatenated into one giant host buffer first. The temp
+    file lives in the destination directory (``os.replace`` must not cross
+    filesystems) and is unlinked on any failure, so a crashed writer never
+    leaves a partial file under the real name. Returns the bytes written.
     """
     path = os.fspath(path)
     directory = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".", suffix=".tmp", dir=directory)
+    written = 0
     try:
         with os.fdopen(fd, "wb") as fh:
-            fh.write(payload)
+            for chunk in chunks:
+                fh.write(chunk)
+                written += len(chunk)
             fh.flush()
             if fsync:
                 os.fsync(fh.fileno())
@@ -39,14 +57,13 @@ def atomic_write_bytes(path: Union[str, os.PathLike], payload: bytes, fsync: boo
             pass
         raise
     if fsync:
-        try:
-            dir_fd = os.open(directory, os.O_RDONLY)
-        except OSError:
-            return  # platform without directory fds: the data fsync already ran
-        try:
-            os.fsync(dir_fd)
-        finally:
-            os.close(dir_fd)
+        fsync_directory(directory)
+    return written
+
+
+def atomic_write_bytes(path: Union[str, os.PathLike], payload: bytes, fsync: bool = True) -> None:
+    """Atomically replace ``path`` with ``payload`` (one-chunk convenience)."""
+    atomic_write_chunks(path, (payload,), fsync=fsync)
 
 
 def atomic_write_text(path: Union[str, os.PathLike], text: str, fsync: bool = True) -> None:

@@ -1,6 +1,7 @@
 """Chaos contract harness (``analysis/chaos_contracts.py``): registry coverage,
-one end-to-end class run, baseline diff semantics, and CLI wiring. The full
-53-class sweep runs as the ``chaos`` pass of ``tools/ci_check.sh``, not here."""
+one end-to-end class run through each suite (metric fault-injection + fleet
+durability scenarios), baseline diff semantics, and CLI wiring. The full
+per-class sweeps run as the ``chaos`` pass of ``tools/ci_check.sh``, not here."""
 
 import json
 
@@ -8,6 +9,7 @@ from metrics_tpu.analysis.chaos_contracts import (
     ChaosResult,
     chaos_cases,
     check_chaos_case,
+    check_fleet_chaos_case,
     diff_chaos_baseline,
     load_chaos_baseline,
     write_chaos_baseline,
@@ -31,6 +33,26 @@ def test_one_class_survives_the_full_fault_suite():
     assert {"dispatch_death[probation]", "dispatch_death[steady]"} <= ran
     assert {"nan_guard[skip]", "nan_guard[raise]"} <= ran
     assert {"ckpt[roundtrip]", "ckpt[truncate]", "ckpt[bitflip]", "sync[degraded]"} <= ran
+
+
+def test_one_class_survives_the_fleet_recovery_scenarios():
+    case = next(c for c in chaos_cases() if c.name == "BinaryAccuracy")
+    result = check_fleet_chaos_case(case)
+    assert result.ok, result.render()
+    # every recovery scenario fired for a float-input, bucketable classifier
+    assert set(result.ran) == {
+        "kill[mid_tick]", "kill[mid_flush]", "kill[mid_ckpt]",
+        "journal[torn]", "journal[bitflip]", "poison[row]",
+    }
+    assert result.skipped == ()
+
+
+def test_unbucketable_class_skips_the_fleet_suite():
+    # aggregates ride the engine loose (scalar states aval-collide), so the
+    # bucketed durability scenarios don't apply — skipped, never a violation
+    case = next(c for c in chaos_cases() if c.name == "MeanMetric")
+    result = check_fleet_chaos_case(case)
+    assert result.ok and result.ran == () and result.skipped == ("fleet",)
 
 
 def test_diff_splits_failures_and_stale():
@@ -70,6 +92,6 @@ def test_cli_wires_the_chaos_pass():
 def test_repo_baseline_is_empty():
     import os
 
-    root = os.path.join(os.path.dirname(__file__), "..")
-    baseline = load_chaos_baseline(os.path.join(root, "tools", "chaos_baseline.json"))
-    assert baseline == {}  # every class honors every fault contract
+    path = os.path.join(os.path.dirname(__file__), "..", "tools", "chaos_baseline.json")
+    assert load_chaos_baseline(path) == {}  # every class honors every fault contract
+    assert load_chaos_baseline(path, section="fleet") == {}  # and recovers bit-exact

@@ -57,6 +57,8 @@ def test_cli_exits_zero_against_baseline():
     assert main(["--root", REPO_ROOT, os.path.join(REPO_ROOT, "metrics_tpu"), "--pass", "distlint", "-q"]) == 0
 
 
+@pytest.mark.slow  # --all sweeps every dynamic pass over the registry (~2 min);
+# tools/ci_check.sh runs the same verdict, so tier-1 keeps only the fast passes
 def test_combined_all_passes_exit_zero():
     """The unified entry point — jitlint AND distlint — stays green."""
     from metrics_tpu.analysis.cli import main

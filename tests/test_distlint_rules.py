@@ -293,6 +293,8 @@ class TestDistlintWiring:
         assert "JL003" in got  # no dist_reduce_fx declared
         assert "DL004" in got  # raw collective
 
+    @pytest.mark.slow  # --all's dynamic passes sweep the whole registry even
+    # for a one-file target (~1.5 min); ci_check.sh covers the same wiring
     def test_cli_all_flag(self, tmp_path):
         from metrics_tpu.analysis.cli import main
 

@@ -1,0 +1,457 @@
+"""Fleet durability (``engine/durability.py``, DESIGN §17): the CRC-framed
+ingest WAL, incremental fleet checkpoints, validated restore + journal replay,
+and the blast-radius contracts — recovery is bit-exact versus a never-crashed
+oracle, and a quarantined session never demotes its bucket (the full per-class
+sweep runs as the ``chaos`` pass's fleet scenarios, not here)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import StreamEngine, observe
+from metrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
+from metrics_tpu.engine import durability as dur_mod
+from metrics_tpu.engine.durability import IngestWAL
+from metrics_tpu.metric import Metric, clear_jit_cache, jit_update_enabled
+from metrics_tpu.resilience import CorruptCheckpointError, IncompatibleCheckpointError
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    observe.enable(reset=True)
+    yield
+    observe.disable()
+    clear_jit_cache()
+    jit_update_enabled(True)
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=4)
+
+
+def _acc_batch(rng, n=8):
+    return jnp.asarray(rng.randint(4, size=n)), jnp.asarray(rng.randint(4, size=n))
+
+
+def _auroc():
+    return BinaryAUROC(thresholds=8)
+
+
+def _auroc_batch(rng, n=8):
+    return jnp.asarray(rng.rand(n).astype(np.float32)), jnp.asarray(rng.randint(2, size=n))
+
+
+def _state_rows(engine, sid):
+    sess = engine._sessions[sid]
+    if sess.bucket is None:
+        return dict(sess.metric._state)
+    return {k: v[sess.slot] for k, v in sess.bucket.stacked.items()}
+
+
+def _assert_engines_equal(got, want, sids):
+    assert set(got.session_ids()) == set(want.session_ids())
+    for sid in sids:
+        a, b = _state_rows(got, sid), _state_rows(want, sid)
+        for k in b:
+            np.testing.assert_array_equal(
+                np.asarray(a[k]), np.asarray(b[k]), err_msg=f"session {sid!r} state {k!r}"
+            )
+
+
+def _counters(name):
+    return sum(observe.snapshot()["counters"].get(name, {}).values())
+
+
+# ------------------------------------------------------------------ WAL frames
+def test_wal_append_sync_read_roundtrip(tmp_path):
+    path = str(tmp_path / "ingest.wal")
+    wal = IngestWAL(path)
+    wal.append("submit", 1, "a", ((1, 2), {}))
+    wal.append("expire", 2, "a")
+    wal.append("add", 3, "b", _acc())  # Metric payloads ride as tagged pickles
+    wal.sync()
+    records, torn = IngestWAL.read_records(path)
+    assert not torn
+    assert [(r[0], r[1], r[2]) for r in records] == [("submit", 1, "a"), ("expire", 2, "a"), ("add", 3, "b")]
+    assert records[0][3] == ((1, 2), {})
+    tag, blob = records[2][3]
+    assert tag == "__metric__" and isinstance(blob, bytes)
+    wal.close()
+
+
+def test_wal_append_is_buffered_until_sync(tmp_path):
+    path = str(tmp_path / "ingest.wal")
+    wal = IngestWAL(path)
+    wal.append("submit", 1, "a", ((), {}))
+    # not yet durable: the reader sees an empty journal until sync()
+    assert IngestWAL.read_records(path) == ([], False) or os.path.getsize(path) == len(dur_mod.WAL_MAGIC)
+    wal.sync()
+    records, torn = IngestWAL.read_records(path)
+    assert len(records) == 1 and not torn
+    wal.close()
+
+
+def test_wal_truncate_keeps_predicate_and_stays_appendable(tmp_path):
+    path = str(tmp_path / "ingest.wal")
+    wal = IngestWAL(path)
+    for seq in range(1, 6):
+        wal.append("submit", seq, "a", ((seq,), {}))
+    wal.sync()
+    assert wal.truncate(lambda seq: seq > 3) == 2
+    records, torn = IngestWAL.read_records(path)
+    assert [r[1] for r in records] == [4, 5] and not torn
+    wal.append("submit", 6, "a", ((6,), {}))  # the reopened handle keeps working
+    wal.sync()
+    assert [r[1] for r in IngestWAL.read_records(path)[0]] == [4, 5, 6]
+    wal.close()
+
+
+def test_wal_torn_and_bitflipped_tail_stop_replay_cleanly(tmp_path):
+    path = str(tmp_path / "ingest.wal")
+    wal = IngestWAL(path)
+    for seq in range(1, 4):
+        wal.append("submit", seq, "a", ((seq,), {}))
+    wal.close()
+    blob = open(path, "rb").read()
+    torn_path = str(tmp_path / "torn.wal")
+    with open(torn_path, "wb") as fh:
+        fh.write(blob[:-5])  # a crash mid-append tears a suffix
+    records, torn = IngestWAL.read_records(torn_path)
+    assert [r[1] for r in records] == [1, 2] and torn
+    flip_path = str(tmp_path / "flip.wal")
+    flipped = bytearray(blob)
+    flipped[-1] ^= 0xFF
+    with open(flip_path, "wb") as fh:
+        fh.write(bytes(flipped))
+    records, torn = IngestWAL.read_records(flip_path)
+    assert [r[1] for r in records] == [1, 2] and torn
+    assert IngestWAL.read_records(str(tmp_path / "missing.wal")) == ([], False)
+
+
+# --------------------------------------------------------- checkpoint + replay
+def test_crash_recovery_is_bit_exact_vs_never_crashed_oracle(tmp_path):
+    rng = np.random.RandomState(0)
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(initial_capacity=4, wal_path=wal)
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    sids += [engine.add_session(_auroc()) for _ in range(3)]
+    batches = {sid: [] for sid in sids}
+    for _ in range(2):
+        for sid in sids:
+            args = _acc_batch(rng) if sid < 3 else _auroc_batch(rng)
+            batches[sid].append(args)
+            engine.submit(sid, *args)
+        engine.tick()
+    engine.checkpoint(ckpt)
+    # the pending tail: journaled + fsynced, never ticked — the crash state
+    for sid in sids:
+        args = _acc_batch(rng) if sid < 3 else _auroc_batch(rng)
+        batches[sid].append(args)
+        engine.submit(sid, *args)
+    engine._wal.sync()
+    recovered = StreamEngine.restore(ckpt, wal_path=wal)
+    engine.tick()  # the oracle never crashed: it just applies the same tail
+    recovered.tick()
+    _assert_engines_equal(recovered, engine, sids)
+    for sid in (sids[0], sids[-1]):
+        np.testing.assert_array_equal(
+            np.asarray(recovered.compute(sid)), np.asarray(engine.compute(sid))
+        )
+    assert _counters("wal_replay") == len(sids)  # exactly the unticked wave
+    assert _counters("ckpt_restore") == 1
+    assert _counters("fleet_restore") == 1
+
+
+def test_restored_engine_keeps_one_dispatch_per_bucket_tick(tmp_path):
+    rng = np.random.RandomState(1)
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(wal_path=wal)
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.checkpoint(ckpt)
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine._wal.sync()
+    recovered = StreamEngine.restore(ckpt, wal_path=wal)
+    # the replayed wave coalesces exactly like a never-crashed tick would
+    assert recovered.tick() == 1
+    # lifecycle keeps journaling on the repaired WAL: another crashless cycle
+    recovered.submit(sids[0], *_acc_batch(rng))
+    assert recovered.tick() == 1
+
+
+def test_expire_and_reset_replay_from_journal(tmp_path):
+    rng = np.random.RandomState(2)
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(wal_path=wal)
+    a, b = engine.add_session(_acc()), engine.add_session(_acc())
+    for sid in (a, b):
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.checkpoint(ckpt)
+    engine.submit(a, *_acc_batch(rng))
+    engine.expire(b)
+    engine.reset(a)  # discards a's queued submission too
+    engine._wal.sync()
+    recovered = StreamEngine.restore(ckpt, wal_path=wal)
+    recovered.tick()
+    engine.tick()
+    assert set(recovered.session_ids()) == {a}
+    _assert_engines_equal(recovered, engine, [a])
+    oracle = _acc()  # reset wound a back to defaults in both engines
+    for k, v in _state_rows(recovered, a).items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(oracle._state[k]))
+
+
+def test_restore_resumes_auto_session_ids_past_journal(tmp_path):
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(wal_path=wal)
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    engine.checkpoint(ckpt)
+    post = engine.add_session(_acc())  # journaled, not checkpointed
+    engine._wal.sync()
+    recovered = StreamEngine.restore(ckpt, wal_path=wal)
+    assert set(recovered.session_ids()) == {*sids, post}
+    fresh = recovered.add_session(_acc())
+    assert fresh not in {*sids, post}  # recovered ids never recycle
+
+
+def test_checkpoint_truncates_journal_to_uncovered_records(tmp_path):
+    rng = np.random.RandomState(3)
+    wal = str(tmp_path / "ingest.wal")
+    engine = StreamEngine(wal_path=wal)
+    sid = engine.add_session(_acc())
+    engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.checkpoint(str(tmp_path / "a.mtckpt"))
+    assert IngestWAL.read_records(wal)[0] == []  # snapshot covers everything
+    engine.submit(sid, *_acc_batch(rng))  # pending again
+    engine._wal.sync()
+    engine.checkpoint(str(tmp_path / "b.mtckpt"))
+    kinds = [r[0] for r in IngestWAL.read_records(wal)[0]]
+    assert kinds == ["submit"]  # pending records survive truncation
+    assert _counters("wal_truncate") == 2
+
+
+def test_clean_buckets_reuse_cached_checkpoint_bytes(tmp_path, monkeypatch):
+    rng = np.random.RandomState(4)
+    engine = StreamEngine()
+    acc_sid = engine.add_session(_acc())
+    auroc_sid = engine.add_session(_auroc())
+    engine.submit(acc_sid, *_acc_batch(rng))
+    engine.submit(auroc_sid, *_auroc_batch(rng))
+    engine.tick()
+    calls = []
+    real = dur_mod._bucket_node
+    monkeypatch.setattr(dur_mod, "_bucket_node", lambda b: calls.append(b.label) or real(b))
+    engine.checkpoint(str(tmp_path / "one.mtckpt"))
+    assert len(calls) == 2  # both buckets dirty on first snapshot
+    del calls[:]
+    engine.checkpoint(str(tmp_path / "two.mtckpt"))
+    assert calls == []  # nothing moved: both re-emitted from cache
+    engine.submit(acc_sid, *_acc_batch(rng))
+    engine.tick()
+    engine.checkpoint(str(tmp_path / "three.mtckpt"))
+    assert len(calls) == 1  # only the bucket whose version moved re-pickles
+
+
+def test_corrupt_fleet_checkpoint_rejected(tmp_path):
+    rng = np.random.RandomState(5)
+    engine = StreamEngine()
+    sid = engine.add_session(_acc())
+    engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine.checkpoint(ckpt)
+    blob = open(ckpt, "rb").read()
+    torn = str(tmp_path / "torn.mtckpt")
+    with open(torn, "wb") as fh:
+        fh.write(blob[:-9])
+    with pytest.raises(CorruptCheckpointError):
+        StreamEngine.restore(torn)
+    flipped = bytearray(blob)
+    flipped[len(flipped) // 2] ^= 0xFF
+    flip = str(tmp_path / "flip.mtckpt")
+    with open(flip, "wb") as fh:
+        fh.write(bytes(flipped))
+    with pytest.raises(CorruptCheckpointError):
+        StreamEngine.restore(flip)
+    # the intact original still restores after both rejections
+    assert set(StreamEngine.restore(ckpt).session_ids()) == {sid}
+
+
+def test_journal_targeting_unknown_session_rejected(tmp_path):
+    rng = np.random.RandomState(6)
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(wal_path=wal)
+    sid = engine.add_session(_acc())
+    engine.checkpoint(ckpt)
+    engine.submit(sid, *_acc_batch(rng))
+    engine._wal.sync()
+    # a journal from a DIFFERENT engine history must not replay onto this snapshot
+    records, _ = IngestWAL.read_records(wal)
+    alien = IngestWAL(str(tmp_path / "alien.wal"))
+    for kind, seq, _sid, payload in records:
+        alien.append(kind, seq, "never-added", payload)
+    alien.close()
+    with pytest.raises(CorruptCheckpointError, match="unknown"):
+        StreamEngine.restore(ckpt, wal_path=str(tmp_path / "alien.wal"))
+
+
+# ------------------------------------------------------------ precision regime
+def test_roundtrip_under_x64_and_regime_mismatch_rejected(tmp_path):
+    rng = np.random.RandomState(7)
+    ckpt32 = str(tmp_path / "f32.mtckpt")
+    engine = StreamEngine()
+    sid = engine.add_session(_acc())
+    engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.checkpoint(ckpt32)
+    assert jax.config.jax_enable_x64 is False
+    jax.config.update("jax_enable_x64", True)
+    try:
+        clear_jit_cache()
+        # f32-written / f64-read: refused loudly, never silently cast
+        with pytest.raises(IncompatibleCheckpointError, match="precision regime"):
+            StreamEngine.restore(ckpt32)
+        # a full round trip natively under x64 stays bit-exact
+        ckpt64 = str(tmp_path / "f64.mtckpt")
+        wide = StreamEngine()
+        wsid = wide.add_session(_acc())
+        wide.submit(wsid, *_acc_batch(rng))
+        wide.tick()
+        wide.checkpoint(ckpt64)
+        recovered = StreamEngine.restore(ckpt64)
+        _assert_engines_equal(recovered, wide, [wsid])
+    finally:
+        jax.config.update("jax_enable_x64", False)
+        clear_jit_cache()
+    # f64-written / f32-read: the same refusal, other direction
+    with pytest.raises(IncompatibleCheckpointError, match="precision regime"):
+        StreamEngine.restore(ckpt64)
+
+
+# --------------------------------------------------------- blast-radius limits
+def test_nan_guard_quarantines_one_session_never_the_bucket(tmp_path):
+    rng = np.random.RandomState(8)
+    engine = StreamEngine(nan_guard=True)
+    sids = [engine.add_session(_auroc()) for _ in range(4)]
+    oracles = {sid: _auroc() for sid in sids[1:]}
+    for sid in sids[1:]:
+        args = _auroc_batch(rng)
+        engine.submit(sid, *args)
+        oracles[sid].update(*args)
+    preds, target = _auroc_batch(rng)
+    engine.submit(sids[0], preds.at[3].set(jnp.nan), target)
+    # the poisoned batch is dropped pre-dispatch; the survivors still coalesce
+    # into ONE dispatch — a quarantined session never demotes its bucket
+    assert engine.tick() == 1
+    assert engine.session_health(sids[0]) == "quarantined"
+    assert all(engine.session_health(sid) == "healthy" for sid in sids[1:])
+    for sid in sids[1:]:
+        assert engine._sessions[sid].bucket is not None
+        for k, ref in oracles[sid]._state.items():
+            np.testing.assert_array_equal(
+                np.asarray(_state_rows(engine, sid)[k]), np.asarray(ref)
+            )
+    snap = observe.snapshot()["counters"]
+    assert sum(snap["fleet_quarantine"].values()) == 1
+    assert sum(snap["fleet_dispatch"].values()) == 1
+    stats = engine.stats()
+    assert stats["quarantined_sessions"] == 1
+    (label,) = stats["buckets"]
+    assert stats["buckets"][label]["health"] == "degraded"  # faulted, not dissolved
+    # the quarantined session lives on loose: clean submissions still land
+    clean = _auroc_batch(rng)
+    engine.submit(sids[0], *clean)
+    engine.tick()
+    oracle = _auroc()
+    oracle.update(*clean)
+    np.testing.assert_array_equal(
+        np.asarray(engine.compute(sids[0])), np.asarray(oracle.compute())
+    )
+
+
+def test_runtime_dispatch_death_replays_rows_and_quarantines_the_poison():
+    import metrics_tpu.engine.stream as stream_mod
+
+    rng = np.random.RandomState(9)
+    engine = StreamEngine()
+    sids = [engine.add_session(_auroc()) for _ in range(3)]
+    oracles = {sid: _auroc() for sid in sids}
+    marked = {}
+    for j, sid in enumerate(sids):
+        preds, target = _auroc_batch(rng)
+        if j == 1:
+            preds = preds.at[0].set(7.0)  # the marker the row replay will reject
+        marked[sid] = (preds, target)
+        engine.submit(sid, *marked[sid])
+        oracles[sid].update(*marked[sid])
+    bucket = engine._sessions[sids[0]].bucket
+    real_update = stream_mod.engine_update
+    real_row = bucket.template._functional_update
+
+    def dead_dispatch(*args, **kwargs):
+        raise RuntimeError("injected runtime dispatch death")
+
+    def picky_row(row, preds, target):
+        if float(np.asarray(preds).max()) > 1.0:
+            raise ValueError("poisoned row")
+        return real_row(row, preds, target)
+
+    stream_mod.engine_update = dead_dispatch
+    bucket.template._functional_update = picky_row
+    try:
+        engine.tick()  # dispatch dies -> per-row eager replay with intact buffers
+    finally:
+        stream_mod.engine_update = real_update
+        del bucket.template.__dict__["_functional_update"]
+    assert engine.session_health(sids[1]) == "quarantined"
+    assert engine.session_health(sids[0]) == "healthy"
+    assert engine.session_health(sids[2]) == "healthy"
+    for sid in (sids[0], sids[2]):  # survivors landed their updates bit-exact
+        assert engine._sessions[sid].bucket is not None
+        for k, ref in oracles[sid]._state.items():
+            np.testing.assert_array_equal(
+                np.asarray(_state_rows(engine, sid)[k]), np.asarray(ref)
+            )
+    # the poisoned session rolled back: its failed batch was consumed, not applied
+    for k, ref in _auroc()._state.items():
+        np.testing.assert_array_equal(np.asarray(_state_rows(engine, sids[1])[k]), np.asarray(ref))
+    snap = observe.snapshot()["counters"]
+    assert sum(snap["fleet_quarantine"].values()) == 1
+    assert sum(snap["fleet_row_replay"].values()) == 2
+
+
+def test_quarantined_sessions_checkpoint_and_restore_loose(tmp_path):
+    rng = np.random.RandomState(10)
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(wal_path=wal, nan_guard=True)
+    sids = [engine.add_session(_auroc()) for _ in range(2)]
+    preds, target = _auroc_batch(rng)
+    engine.submit(sids[0], preds.at[0].set(jnp.inf), target)
+    engine.submit(sids[1], *_auroc_batch(rng))
+    engine.tick()
+    assert engine.session_health(sids[0]) == "quarantined"
+    engine.checkpoint(ckpt)
+    recovered = StreamEngine.restore(ckpt, wal_path=wal)
+    assert recovered.session_health(sids[0]) == "quarantined"
+    assert recovered.session_health(sids[1]) == "healthy"
+    _assert_engines_equal(recovered, engine, sids)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -208,6 +208,8 @@ def test_snapshot_schema_is_stable_and_json_able():
         "sync_retries_total", "sync_degraded_total", "guard_quarantined_total",
         "fleet_sessions_total", "fleet_capacity_total", "fleet_occupancy_pct",
         "fleet_pad_waste_pct", "fleet_dispatches_total", "fleet_dispatches_per_flush",
+        "fleet_quarantined_total", "fleet_restores_total",
+        "wal_appends_total", "wal_records_replayed_total",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():

@@ -168,6 +168,41 @@ def test_save_is_atomic_no_partial_files(tmp_path):
     assert leftovers == []
 
 
+def test_chunked_crc_is_bit_identical_to_monolithic():
+    """The streaming CRC (O(chunk) memory) must pin the exact zlib value — a
+    drift here would reject every checkpoint written by the other code path."""
+    import zlib
+
+    from metrics_tpu.resilience.checkpoint import _crc32_chunked
+
+    rng = np.random.RandomState(42)
+    parts = [rng.bytes(n) for n in (0, 1, 7, 1 << 10, (1 << 16) + 13)]
+    joined = b"".join(parts)
+    assert _crc32_chunked(*parts) == zlib.crc32(joined) & 0xFFFFFFFF
+    # chunk boundaries must not matter, including chunks smaller than a part
+    for chunk in (1, 3, 1 << 8, 1 << 22):
+        assert _crc32_chunked(*parts, chunk_size=chunk) == zlib.crc32(joined) & 0xFFFFFFFF
+    assert _crc32_chunked() == 0  # empty payload: zlib's identity CRC
+
+
+def test_save_checkpoint_dispatches_stream_engine_to_fleet_path(tmp_path):
+    """``save_checkpoint(engine)`` and ``engine.checkpoint()`` are the same
+    fleet container — either save restores through either entry point."""
+    from metrics_tpu import StreamEngine
+
+    engine = StreamEngine()
+    sid = engine.add_session(BinaryAccuracy())
+    engine.submit(sid, *_batch(0))
+    engine.tick()
+    path = str(tmp_path / "fleet.mtckpt")
+    save_checkpoint(engine, path)
+    target = StreamEngine()
+    restore_checkpoint(target, path)
+    np.testing.assert_array_equal(
+        np.asarray(target.compute(sid)), np.asarray(engine.compute(sid))
+    )
+
+
 # ------------------------------------------------- load_state_dict satellites
 class _PersistentSum(Metric):
     full_state_update = False
