@@ -133,7 +133,7 @@ _LAZY_EXPORTS = {
 }
 
 _LAZY_SUBPACKAGES = (
-    "audio", "classification", "clustering", "detection", "engine", "functional", "image",
+    "aot", "audio", "classification", "clustering", "detection", "engine", "functional", "image",
     "integration", "models", "multimodal", "nominal", "observe", "ops", "parallel",
     "regression", "resilience", "retrieval", "segmentation", "shape", "sketches", "text",
     "utils", "wrappers",

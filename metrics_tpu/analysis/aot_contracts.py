@@ -1,0 +1,292 @@
+"""Dynamic AOT round-trip harness: every registry class through the disk cache.
+
+For every jit-eligible, fingerprintable class in the profile registry this
+proves the DESIGN §18 contract end to end, in-process:
+
+1. **warm** — a fresh instance updates twice with the cache pointed at an
+   empty temp directory: its programs compile AOT and serialize to disk;
+2. **reload** — the in-memory shared cache is dropped and a second instance
+   replays the same batches: every program must come back from disk
+   (``aot_hit`` ≥ 1 for the class, ZERO ``jit_compile``) — anything else is
+   ``NO_REUSE``, the cold-start tax the subsystem exists to kill;
+3. **oracle** — the disk cache is turned off, the in-memory cache dropped
+   again, and a third instance freshly traces the identical batches: the
+   reloaded instance's states must match bit-exactly and its computes must
+   agree (``DIVERGED`` otherwise — a deserialized executable that computes
+   differently is the one failure mode worse than a cold start).
+
+Per-class verdicts:
+
+* ``ROUNDTRIP`` — reused from disk with zero compiles, bit-exact vs oracle;
+* ``CLOSE`` — reused, states bit-exact, compute within float tolerance;
+* ``INELIGIBLE`` — never jit-compiles (list state / host-side update), so
+  there is nothing to persist;
+* ``UNFINGERPRINTED`` — config has no process-stable identity
+  (``config_fingerprint()`` is None), so no disk key exists;
+* ``NO_REUSE`` — the reload leg compiled or missed;
+* ``DIVERGED`` — reloaded state/compute disagrees with the fresh trace;
+* ``ERROR:<why>`` — harness failure.
+
+``NO_REUSE``/``DIVERGED``/``ERROR`` fail the pass unless baselined (with a
+justification string) in the ``aot`` section of ``tools/aot_baseline.json``
+(expected empty). Runs as the ``aot`` pass of ``tools/lint_metrics --all`` and
+standalone via ``python -m metrics_tpu.analysis.aot_contracts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "AotResult",
+    "check_aot_case",
+    "collect_aot_report",
+    "diff_aot_contract_baseline",
+    "main",
+    "run_aot_check",
+]
+
+_DEFAULT_BASELINE = os.path.join("tools", "aot_baseline.json")
+_RTOL, _ATOL = 1e-5, 1e-7
+
+
+@dataclasses.dataclass(frozen=True)
+class AotResult:
+    name: str
+    verdict: str  # ROUNDTRIP | CLOSE | INELIGIBLE | UNFINGERPRINTED | NO_REUSE | DIVERGED | ERROR:<why>
+    detail: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.verdict in ("ROUNDTRIP", "CLOSE", "INELIGIBLE", "UNFINGERPRINTED")
+
+    def render(self) -> str:
+        mark = "ok " if self.ok else "FAIL"
+        return f"{mark} {self.name}: {self.verdict}" + (f" ({self.detail})" if self.detail else "")
+
+
+def _compare(a: Any, b: Any) -> str:
+    """'' if pytrees bit-identical, 'close' within tolerance, 'diverged' else."""
+    import jax
+    import numpy as np
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return "diverged"
+    worst = ""
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        if xa.shape != ya.shape:
+            return "diverged"
+        if np.array_equal(xa, ya):
+            continue
+        if np.allclose(xa, ya, rtol=_RTOL, atol=_ATOL, equal_nan=True):
+            worst = "close"
+        else:
+            return "diverged"
+    return worst
+
+
+def check_aot_case(case: Any) -> AotResult:
+    """One class through serialize → fresh-cache-dir load → oracle; never raises."""
+    import tempfile
+
+    import numpy as np
+
+    from metrics_tpu.aot import cache as _cache
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, Metric, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+    from metrics_tpu.observe.costs import _rng
+
+    try:
+        probe_inst = case.ctor()
+        if not isinstance(probe_inst, Metric):
+            return AotResult(case.name, "ERROR:ctor", f"{case.name} did not construct a Metric")
+        rng = _rng(case)
+        batches = [case.batch(rng), case.batch(rng)]
+        # _jit_eligible is the real dispatch gate (class opt-outs, list state,
+        # per-instance jit_update=False): an update that never compiles has
+        # nothing to round-trip
+        if not probe_inst._jit_eligible(batches[0], {}):
+            return AotResult(case.name, "INELIGIBLE")
+        if probe_inst._jit_cache_key() is None:
+            return AotResult(case.name, "UNFINGERPRINTED")
+        label = type(probe_inst).__name__
+
+        prev_dir = _cache.cache_dir()
+        saved_cache = dict(_SHARED_JIT_CACHE)
+        was_enabled = _observe.ENABLED
+        probe = _observe.Recorder()
+        real, _observe.RECORDER = _observe.RECORDER, probe
+        try:
+            with tempfile.TemporaryDirectory(prefix="aot_roundtrip_") as tmp:
+                _cache.set_cache_dir(tmp)
+                _observe.ENABLED = True
+
+                # leg 1: warm an empty directory (compile AOT + serialize)
+                clear_jit_cache()
+                warm = case.ctor()
+                for args in batches:
+                    warm.update(*args)
+                if probe.counters.get(("eager_fallback", label)):
+                    return AotResult(case.name, "ERROR:eager", "latched eager fallback under jit")
+                if not probe.counters.get(("aot_store", label)):
+                    return AotResult(case.name, "NO_REUSE", "warm leg stored nothing")
+
+                # leg 2: drop the in-memory cache, reload purely from disk
+                clear_jit_cache()
+                before = dict(probe.counters)
+                loaded = case.ctor()
+                for args in batches:
+                    loaded.update(*args)
+                compiles = probe.counters.get(("jit_compile", label), 0) - before.get(("jit_compile", label), 0)
+                hits = probe.counters.get(("aot_hit", label), 0) - before.get(("aot_hit", label), 0)
+                if compiles or not hits:
+                    return AotResult(
+                        case.name, "NO_REUSE",
+                        f"reload leg: {compiles} compile(s), {hits} disk hit(s)",
+                    )
+
+                # leg 3: fresh-trace oracle with the disk cache off
+                _cache.set_cache_dir(None)
+                clear_jit_cache()
+                oracle = case.ctor()
+                for args in batches:
+                    oracle.update(*args)
+
+                for k, ref in oracle.__dict__["_state"].items():
+                    got = loaded.__dict__["_state"][k]
+                    if not np.array_equal(np.asarray(got), np.asarray(ref)):
+                        return AotResult(case.name, "DIVERGED", f"state '{k}' != freshly traced oracle")
+                cmp = _compare(loaded.compute(), oracle.compute())
+                if cmp == "diverged":
+                    return AotResult(case.name, "DIVERGED", "compute != freshly traced oracle")
+                return AotResult(case.name, "CLOSE" if cmp else "ROUNDTRIP")
+        finally:
+            _observe.ENABLED = was_enabled
+            _observe.RECORDER = real
+            _SHARED_JIT_CACHE.clear()
+            _SHARED_JIT_CACHE.update(saved_cache)
+            _cache.set_cache_dir(prev_dir)
+    except Exception as exc:  # noqa: BLE001 — every failure is a reportable verdict
+        return AotResult(case.name, f"ERROR:{type(exc).__name__}", str(exc)[:200])
+
+
+def collect_aot_report(cases: Optional[Sequence[Any]] = None) -> List[AotResult]:
+    from metrics_tpu.observe.costs import PROFILE_CASES
+
+    return [check_aot_case(c) for c in (cases if cases is not None else PROFILE_CASES)]
+
+
+# ------------------------------------------------------------------- baseline
+def load_aot_contract_baseline(path: str) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): str(v) for k, v in load_baseline_section(path, "aot").items()}
+
+
+def write_aot_contract_baseline(path: str, results: Sequence[AotResult]) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
+    aot = {
+        r.name: f"UNJUSTIFIED: {r.verdict}"
+        for r in sorted(results, key=lambda r: r.name)
+        if not r.ok
+    }
+    write_baseline_section(
+        path,
+        "aot",
+        aot,  # type: ignore[arg-type]
+        "aot-contract baseline — executable serialize/reload disagreements "
+        "(class -> justification; expected empty). Regenerate with "
+        "`python tools/lint_metrics.py --pass aot --update-baseline`.",
+    )
+    return aot
+
+
+def diff_aot_contract_baseline(
+    results: Sequence[AotResult], baseline: Dict[str, str]
+) -> Tuple[List[AotResult], List[str]]:
+    """Split into (failures, stale_baseline_keys): unbaselined disagreements fail."""
+    failures = [r for r in results if not r.ok and r.name not in baseline]
+    failing = {r.name for r in results if not r.ok}
+    observed = {r.name for r in results}
+    stale = sorted(name for name in baseline if name not in failing or name not in observed)
+    return failures, stale
+
+
+def run_aot_check(
+    root: str,
+    baseline_path: Optional[str] = None,
+    update_baseline: bool = False,
+    quiet: bool = False,
+    report: Optional[Dict[str, Any]] = None,
+) -> int:
+    """The ``aot`` pass of ``lint_metrics --all``: round-trip every class, one verdict."""
+    path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
+    results = collect_aot_report()
+    if update_baseline:
+        aot = write_aot_contract_baseline(path, results)
+        if not quiet:
+            print(f"aot: baseline written to {path} ({len(aot)} disagreement(s))")
+        return 0
+    failures, stale = diff_aot_contract_baseline(results, load_aot_contract_baseline(path))
+    if report is not None:
+        # the caller owns stdout (one JSON document) — collect, don't print
+        report.update(
+            {
+                "cases": len(results),
+                "failures": [r.render() for r in failures],
+                "baselined": sum(1 for r in results if not r.ok) - len(failures),
+                "stale_baseline_keys": stale,
+                "verdicts": {r.name: r.verdict for r in results},
+            }
+        )
+        return 1 if failures else 0
+    for r in failures:
+        print(f"aot: {r.render()}")
+    if not quiet:
+        for key in stale:
+            print(f"aot: stale baseline entry: {key}")
+        roundtrip = sum(1 for r in results if r.verdict in ("ROUNDTRIP", "CLOSE"))
+        skipped = sum(1 for r in results if r.verdict in ("INELIGIBLE", "UNFINGERPRINTED"))
+        print(
+            f"aot: {sum(1 for r in results if r.ok)}/{len(results)} classes agree "
+            f"({roundtrip} reused from disk bit-exactly, {skipped} with nothing to cache), "
+            f"{len(failures)} failure(s), {len(stale)} stale"
+        )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="aot-contracts",
+        description="AOT executable-cache contracts per registry class: serialize → "
+        "fresh-cache-dir reload with zero compiles → bit-exact update/compute vs a "
+        "freshly traced oracle.",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="aot baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current disagreements as the new baseline and exit 0")
+    p.add_argument("-v", "--verbose", action="store_true", help="print every class verdict")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.verbose:
+        for r in collect_aot_report():
+            print(r.render())
+    return run_aot_check(
+        root,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

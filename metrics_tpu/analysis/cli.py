@@ -9,12 +9,16 @@ Three static passes share one engine and one exit-code contract:
 * ``donlint``  — donated-buffer escape/alias rules ML001–ML006, baselined in
   ``tools/donlint_baseline.json``
 
-Four dynamic passes ride the same selection/exit-code contract:
+Five dynamic passes ride the same selection/exit-code contract:
 
 * ``donation`` — 3-step donate-enabled update loops cross-checking static
   donlint verdicts, ``costs.py`` eligibility, and runtime buffer deletion
   (:mod:`metrics_tpu.analysis.donation_contracts`), disagreements baselined in
   the ``donation`` section of ``tools/donlint_baseline.json``
+* ``aot`` — AOT executable-cache round trips per registry class: serialize →
+  fresh-cache-dir reload with zero compiles → bit-exact update/compute vs a
+  freshly traced oracle (:mod:`metrics_tpu.analysis.aot_contracts`),
+  disagreements baselined in ``tools/aot_baseline.json`` (expected empty)
 * ``fleet`` — StreamEngine lifecycle contracts per registry class: churning
   4-slot buckets vs per-instance oracles (state bit-exactness, masked-row
   isolation, donation consumption, merge;
@@ -74,10 +78,11 @@ _PASSES: Dict[str, Dict[str, object]] = {
 }
 
 # dynamic passes: no rule codes, run programs instead of parsing them.
-# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, fleet churns a
-# 4-slot StreamEngine bucket per class, chaos injects the full fault suite per
-# class, perf lowers the whole registry + runs the fleet smoke).
-_DYNAMIC = ("donation", "fleet", "chaos", "perf")
+# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, aot compiles
+# each cacheable class twice — once AOT to disk, once as the fresh oracle —
+# fleet churns a 4-slot StreamEngine bucket per class, chaos injects the full
+# fault suite per class, perf lowers the whole registry + runs the fleet smoke).
+_DYNAMIC = ("donation", "aot", "fleet", "chaos", "perf")
 
 
 def _dynamic_runner(name: str):
@@ -95,6 +100,10 @@ def _dynamic_runner(name: str):
         from metrics_tpu.analysis.fleet_contracts import run_fleet_check  # noqa: PLC0415
 
         return run_fleet_check
+    if name == "aot":
+        from metrics_tpu.analysis.aot_contracts import run_aot_check  # noqa: PLC0415
+
+        return run_aot_check
     from metrics_tpu.analysis.donation_contracts import run_donation_check  # noqa: PLC0415
 
     return run_donation_check
@@ -115,8 +124,8 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=sorted([*_PASSES, *_DYNAMIC]),
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
-                   help="run every pass (jitlint + distlint + donlint + donation + fleet "
-                        "+ chaos + perf) in one invocation")
+                   help="run every pass (jitlint + distlint + donlint + donation + aot "
+                        "+ fleet + chaos + perf) in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")

@@ -1,0 +1,56 @@
+"""AOT executable cache: persist compiled metric programs across processes (DESIGN §18).
+
+Every new process normally re-traces and re-compiles every metric it touches —
+the shared jit cache, the replica cache and the fleet ``ProgramCache`` are all
+process-local, so at fleet scale a restart costs minutes of warmup per worker.
+This subsystem persists the compiled artifact itself: serialized XLA
+executables keyed by (class, config fingerprint, state avals, call signature,
+donation, engine shape statics) in CRC-framed files under a cache directory,
+consulted before tracing and validated before install.
+
+Off by default. Enable by pointing ``METRICS_TPU_AOT_CACHE`` at a directory
+before the process starts, or calling :func:`set_cache_dir` at runtime;
+``python tools/warm_cache.py --cache-dir <dir>`` pre-populates it for the
+whole registry. Unset, nothing here is even imported by the hot path.
+"""
+
+from metrics_tpu.aot.cache import (
+    AOTCacheError,
+    CorruptEntryError,
+    ENV_VAR,
+    StaleEntryError,
+    cache_dir,
+    cache_stats,
+    entry_digest,
+    entry_path,
+    environment_fingerprint,
+    purge_cache,
+    set_cache_dir,
+)
+from metrics_tpu.aot.runtime import AotBinding, active, call_signature
+
+__all__ = [
+    "AOTCacheError",
+    "AotBinding",
+    "CorruptEntryError",
+    "ENV_VAR",
+    "StaleEntryError",
+    "active",
+    "cache_dir",
+    "cache_stats",
+    "call_signature",
+    "entry_digest",
+    "entry_path",
+    "environment_fingerprint",
+    "purge_cache",
+    "set_cache_dir",
+    "warm_registry",
+]
+
+
+def warm_registry(*args, **kwargs):
+    """Lazy alias for :func:`metrics_tpu.aot.warm.warm_registry` (imports the
+    full metric registry, so it must not ride the package import)."""
+    from metrics_tpu.aot.warm import warm_registry as _warm  # noqa: PLC0415
+
+    return _warm(*args, **kwargs)

@@ -1,0 +1,327 @@
+"""On-disk storage for AOT-compiled metric executables (DESIGN §18).
+
+This is the persistence half of the AOT subsystem: stable content-addressed
+keys, CRC-framed entry files, and validate-before-install reads. The dispatch
+half — deciding when to consult the disk and how a loaded program replaces a
+fresh trace — lives in :mod:`metrics_tpu.aot.runtime`.
+
+Entry file layout (``<sha256>.aotx``, one executable per file)::
+
+    MAGIC "MTAOT001"                       8 bytes
+    header_len u32 | header_crc32 u32      big-endian frame
+    header JSON                            format_version, key_digest, label,
+                                           donate, payload_len, payload_crc32,
+                                           env {jax, jaxlib, backend,
+                                                backend_version, x64}
+    payload                                pickle of (blob, in_tree, out_tree)
+                                           from jax.experimental.
+                                           serialize_executable.serialize
+
+Files are written with the same tmp + fsync + ``os.replace`` discipline as the
+§14 checkpoint container (``utils/io.py``), so a crashed writer never leaves a
+torn file under the real name and concurrent warmers converge on last-writer-
+wins without readers ever seeing a mix.
+
+Staleness vs corruption: the environment fingerprint lives in the HEADER, not
+the key, so an entry built by an older jax/XLA or another backend is found,
+recognized as stale (``aot_stale``), latched in ``_STALE_DIGESTS`` so the file
+is not re-read and re-validated on every subsequent lookup, and overwritten in
+place by the next store — which lifts the latch. A corrupt file (bad magic,
+CRC mismatch, unpicklable payload) takes the same path: fall back to a normal
+trace, never crash or miscompute.
+
+The cache is OFF unless ``METRICS_TPU_AOT_CACHE`` names a directory (or
+:func:`set_cache_dir` is called); unset, no module in the hot path even
+imports this one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.utils.io import atomic_write_chunks, fsync_directory
+
+__all__ = [
+    "AOTCacheError",
+    "CorruptEntryError",
+    "ENV_VAR",
+    "FORMAT_VERSION",
+    "MAGIC",
+    "StaleEntryError",
+    "cache_dir",
+    "cache_stats",
+    "entry_digest",
+    "entry_path",
+    "environment_fingerprint",
+    "lookup",
+    "purge_cache",
+    "read_entry",
+    "set_cache_dir",
+    "store",
+    "write_entry",
+]
+
+ENV_VAR = "METRICS_TPU_AOT_CACHE"
+MAGIC = b"MTAOT001"
+FORMAT_VERSION = 1
+_FRAME = struct.Struct(">II")  # header_len, header_crc32
+_SUFFIX = ".aotx"
+
+# header fields that must match the running process for an entry to be usable —
+# serialized XLA executables are only portable on the same compiler + runtime
+_ENV_FIELDS = ("jax", "jaxlib", "backend", "backend_version", "x64")
+
+
+class AOTCacheError(Exception):
+    """Base for AOT cache entry problems (never escapes to metric callers)."""
+
+
+class CorruptEntryError(AOTCacheError):
+    """The entry file is damaged: bad magic, CRC mismatch, undecodable parts."""
+
+
+class StaleEntryError(AOTCacheError):
+    """The entry is intact but built by a different jax/XLA/backend/x64 regime."""
+
+
+_CACHE_DIR: Optional[str] = os.environ.get(ENV_VAR) or None
+
+# digests known unusable in this process (stale or corrupt): lookups miss
+# immediately instead of re-reading and re-validating the file every time;
+# the next store overwrites the file and lifts the latch (refresh-once).
+_STALE_DIGESTS: set = set()
+
+
+def cache_dir() -> Optional[str]:
+    """The configured cache directory, or None when the disk cache is off."""
+    return _CACHE_DIR
+
+
+def set_cache_dir(path: Optional[os.PathLike]) -> None:
+    """Point the AOT cache at ``path`` (None turns the disk cache off).
+
+    Overrides the ``METRICS_TPU_AOT_CACHE`` environment default for the rest
+    of the process. Already-attached bindings keep their in-memory loaded
+    programs; only new disk traffic moves. The stale latch resets — it
+    described the old directory.
+    """
+    global _CACHE_DIR
+    _CACHE_DIR = os.fspath(path) if path else None
+    _STALE_DIGESTS.clear()
+
+
+_BACKEND_FP: Optional[Dict[str, str]] = None
+
+
+def environment_fingerprint() -> Dict[str, Any]:
+    """The compatibility header fields: serialized executables are only valid
+    on the exact jax + jaxlib + backend (and its runtime version) that built
+    them, and under the same x64 regime (which changes every weak-typed aval).
+    The backend part is cached; ``x64`` is re-read per call because tests flip
+    it mid-process."""
+    global _BACKEND_FP
+    if _BACKEND_FP is None:
+        import jaxlib  # noqa: PLC0415
+        import jax.extend.backend as jeb  # noqa: PLC0415  (bare `jax.` lacks .extend)
+
+        backend = jeb.get_backend()
+        _BACKEND_FP = {
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "backend": str(backend.platform),
+            "backend_version": str(backend.platform_version),
+        }
+    return {**_BACKEND_FP, "x64": bool(jax.config.jax_enable_x64)}
+
+
+def entry_digest(key: Any) -> str:
+    """Content address of a cache key: sha256 over its repr.
+
+    Keys are built exclusively from primitives with deterministic reprs
+    (strings, ints, bools, tuples) — the class path, config fingerprint,
+    state avals, engine shape statics and the dispatch-time aval signature —
+    so the digest is stable across processes.
+    """
+    return hashlib.sha256(repr(key).encode()).hexdigest()
+
+
+def entry_path(digest: str, directory: Optional[str] = None) -> str:
+    d = directory if directory is not None else _CACHE_DIR
+    if d is None:
+        raise AOTCacheError("AOT cache directory is not configured")
+    return os.path.join(d, digest + _SUFFIX)
+
+
+# ---------------------------------------------------------------- entry framing
+def write_entry(path: str, key_digest: str, label: str, donate: bool, payload: bytes) -> int:
+    """Atomically write one framed entry file; returns bytes written."""
+    header = {
+        "format_version": FORMAT_VERSION,
+        "key_digest": key_digest,
+        "label": label,
+        "donate": bool(donate),
+        "payload_len": len(payload),
+        "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        "env": environment_fingerprint(),
+    }
+    header_bytes = json.dumps(header, sort_keys=True).encode()
+    frame = _FRAME.pack(len(header_bytes), zlib.crc32(header_bytes) & 0xFFFFFFFF)
+    return atomic_write_chunks(path, (MAGIC, frame, header_bytes, payload))
+
+
+def read_entry(path: str, key_digest: str) -> Tuple[Dict[str, Any], bytes]:
+    """Parse and fully validate one entry file BEFORE anything is installed.
+
+    Raises :class:`CorruptEntryError` for damage and :class:`StaleEntryError`
+    for an intact entry from an incompatible environment; returns
+    ``(header, payload)`` only when every frame, CRC, key and compatibility
+    check passed.
+    """
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError as exc:
+        raise CorruptEntryError(f"unreadable entry: {exc}") from exc
+    base = len(MAGIC) + _FRAME.size
+    if len(data) < base or data[: len(MAGIC)] != MAGIC:
+        raise CorruptEntryError("bad magic")
+    header_len, header_crc = _FRAME.unpack_from(data, len(MAGIC))
+    header_bytes = data[base : base + header_len]
+    if len(header_bytes) != header_len or zlib.crc32(header_bytes) & 0xFFFFFFFF != header_crc:
+        raise CorruptEntryError("header CRC mismatch")
+    try:
+        header = json.loads(header_bytes.decode())
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise CorruptEntryError(f"undecodable header: {exc}") from exc
+    version = header.get("format_version")
+    if version != FORMAT_VERSION:
+        raise StaleEntryError(f"format_version {version!r} != {FORMAT_VERSION}")
+    if header.get("key_digest") != key_digest:
+        raise CorruptEntryError("key digest mismatch (file renamed or hash collision)")
+    payload = data[base + header_len :]
+    if len(payload) != header.get("payload_len") or zlib.crc32(payload) & 0xFFFFFFFF != header.get("payload_crc32"):
+        raise CorruptEntryError("payload CRC mismatch")
+    env = header.get("env") or {}
+    mine = environment_fingerprint()
+    for field in _ENV_FIELDS:
+        if env.get(field) != mine[field]:
+            raise StaleEntryError(f"{field}: entry {env.get(field)!r} != process {mine[field]!r}")
+    return header, payload
+
+
+def serialize_executable(compiled: Any) -> bytes:
+    from jax.experimental.serialize_executable import serialize  # noqa: PLC0415
+
+    blob, in_tree, out_tree = serialize(compiled)
+    return pickle.dumps((blob, in_tree, out_tree), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_executable(payload: bytes) -> Any:
+    from jax.experimental.serialize_executable import deserialize_and_load  # noqa: PLC0415
+
+    blob, in_tree, out_tree = pickle.loads(payload)
+    return deserialize_and_load(blob, in_tree, out_tree)
+
+
+# ----------------------------------------------------------------- cache traffic
+def lookup(key: Any, label: str) -> Optional[Tuple[Any, bool]]:
+    """Consult the disk for ``key``: ``(loaded_executable, donate)`` or None.
+
+    Counts exactly one of ``aot_hit`` / ``aot_miss`` / ``aot_stale`` per call.
+    A stale or corrupt entry is latched so later lookups of the same key miss
+    without touching the file again; every failure mode returns None — the
+    caller traces normally.
+    """
+    if _CACHE_DIR is None:
+        return None
+    digest = entry_digest(key)
+    if digest in _STALE_DIGESTS:
+        _observe.note_aot_miss(label)
+        return None
+    path = os.path.join(_CACHE_DIR, digest + _SUFFIX)
+    if not os.path.exists(path):
+        _observe.note_aot_miss(label)
+        return None
+    try:
+        header, payload = read_entry(path, digest)
+        loaded = deserialize_executable(payload)
+    except StaleEntryError as exc:
+        _STALE_DIGESTS.add(digest)
+        _observe.note_aot_stale(label, str(exc))
+        return None
+    except Exception as exc:  # CorruptEntryError + anything unpickling can raise
+        _STALE_DIGESTS.add(digest)
+        _observe.note_aot_stale(label, f"corrupt: {exc}")
+        return None
+    _observe.note_aot_hit(label)
+    return loaded, bool(header.get("donate", False))
+
+
+def store(key: Any, compiled: Any, donate: bool, label: str) -> bool:
+    """Serialize ``compiled`` under ``key``; True on success.
+
+    Overwrites whatever was there (the refresh path for stale entries — the
+    latch lifts here, exactly once). Serialization failures (a backend without
+    executable serialization, disk errors) are recorded as events and absorbed:
+    the in-memory program the caller just compiled keeps working either way.
+    """
+    if _CACHE_DIR is None:
+        return False
+    digest = entry_digest(key)
+    try:
+        payload = serialize_executable(compiled)
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        nbytes = write_entry(os.path.join(_CACHE_DIR, digest + _SUFFIX), digest, label, donate, payload)
+    except Exception as exc:
+        _observe.record_event("aot_store_failed", metric=label, error=type(exc).__name__, detail=str(exc)[:200])
+        return False
+    _STALE_DIGESTS.discard(digest)
+    _observe.note_aot_store(label, nbytes)
+    return True
+
+
+def purge_cache(directory: Optional[str] = None) -> int:
+    """Delete every entry file in ``directory`` (default: the configured dir).
+
+    Returns the number of files removed; 0 when no directory is configured.
+    Only ``*.aotx`` files are touched — the cache never owns the directory.
+    """
+    d = directory if directory is not None else _CACHE_DIR
+    _STALE_DIGESTS.clear()
+    if d is None or not os.path.isdir(d):
+        return 0
+    removed = 0
+    for name in os.listdir(d):
+        if name.endswith(_SUFFIX):
+            try:
+                os.unlink(os.path.join(d, name))
+                removed += 1
+            except OSError:
+                pass
+    fsync_directory(d)
+    _observe.record_event("aot_purge", directory=d, removed=removed)
+    return removed
+
+
+def cache_stats(directory: Optional[str] = None) -> Dict[str, Any]:
+    """Entry count and total bytes on disk (for tools and triage output)."""
+    d = directory if directory is not None else _CACHE_DIR
+    out: Dict[str, Any] = {"directory": d, "entries": 0, "bytes": 0}
+    if d and os.path.isdir(d):
+        for name in os.listdir(d):
+            if name.endswith(_SUFFIX):
+                out["entries"] += 1
+                try:
+                    out["bytes"] += os.path.getsize(os.path.join(d, name))
+                except OSError:
+                    pass
+    return out

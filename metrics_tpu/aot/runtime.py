@@ -1,0 +1,159 @@
+"""Dispatch-side AOT integration (DESIGN §18).
+
+An :class:`AotBinding` is attached to a ``_CompiledUpdate`` cache entry (the
+shared per-metric cache in ``metric.py`` and the replica/fleet ``ProgramCache``
+in ``engine/core.py``) when the disk cache is configured. The entry's
+``__call__`` then routes through :meth:`AotBinding.dispatch`, which resolves
+each distinct argument signature to ONE executable:
+
+1. in-memory: a program already loaded/compiled for this signature replays;
+2. disk hit: the serialized executable loads (``aot_hit``) — no trace, no
+   XLA compile, the whole point;
+3. miss/stale: the entry's own ``jax.jit`` wrapper is lowered and compiled
+   AOT (``entry.fn.lower(...).compile()`` — same trace, same donation), then
+   serialized back to disk (``aot_store``) so the NEXT process hits.
+
+Donation interplay with the probation latch (``metric._probation_dispatch``):
+compiling here captures the compile-time "donated buffers were not usable"
+warning itself. On that warning the entry is latched to a plain non-donating
+jit exactly as probation would, the program is recompiled without donation,
+and the stored header records ``donate=False`` — so a later process loading
+the entry learns the donation verdict without ever seeing the warning, and
+its probation probe scans clean. First dispatches still run under probation
+(copies donated), so a loaded program that DOES donate can never consume
+buffers the caller still holds.
+
+A loaded program's first call is guarded: a ``TypeError`` (argument/aval
+rejection, raised before anything executes, buffers intact) demotes the entry
+to stale and falls back to a fresh compile — corrupt or mismatched entries
+degrade to exactly the behavior with the cache off.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from metrics_tpu.aot import cache as _cache
+from metrics_tpu.observe import recorder as _observe
+
+__all__ = ["AotBinding", "active", "call_signature"]
+
+# must match metric.py's probe string — both scan the same XLA warning
+_DONATION_UNUSABLE_MSG = "donated buffers were not usable"
+
+
+def active() -> bool:
+    """Whether dispatches should consult the disk (a cache dir is configured)."""
+    return _cache.cache_dir() is not None
+
+
+def call_signature(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[Any, ...]:
+    """Stable signature of one concrete call: per-leaf avals plus the treedef.
+
+    Mirrors what makes ``jax.jit`` retrace — shape, dtype and weak-typedness
+    per array leaf, the Python type for scalar operands (their values never
+    shape the program), and the argument tree structure. Rendered from
+    primitives only, so its repr is process-stable and safe to hash into the
+    disk key.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for v in leaves:
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            sig.append(("arr", tuple(int(s) for s in v.shape), str(v.dtype), bool(getattr(v, "weak_type", False))))
+        else:
+            sig.append(("py", type(v).__name__))
+    return (tuple(sig), str(treedef))
+
+
+class _Program:
+    """One resolved executable for one call signature."""
+
+    __slots__ = ("exe", "from_disk", "verified")
+
+    def __init__(self, exe: Any, from_disk: bool) -> None:
+        self.exe = exe
+        self.from_disk = from_disk
+        self.verified = not from_disk
+
+
+class AotBinding:
+    """Per-entry AOT dispatcher: maps call signatures to loaded executables.
+
+    ``base_key`` identifies everything signature-independent about the entry
+    (class path, config fingerprint, state avals, engine shape statics, the
+    requested donation); the full disk key is ``(base_key, call_signature)``.
+    ``on_compile`` defers the owner cache's compile counter to the moment an
+    XLA compile actually happens — a disk hit counts ``aot_hit`` instead, so
+    a warmed process reports zero compiles.
+    """
+
+    __slots__ = ("base_key", "label", "on_compile", "programs")
+
+    def __init__(self, base_key: Any, label: str, on_compile: Optional[Callable[[], None]] = None) -> None:
+        self.base_key = base_key
+        self.label = label
+        self.on_compile = on_compile
+        self.programs: Dict[Any, _Program] = {}
+
+    def dispatch(self, entry: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Any:
+        sig = call_signature(args, kwargs)
+        prog = self.programs.get(sig)
+        if prog is None:
+            prog = self._resolve(entry, sig, args, kwargs)
+            self.programs[sig] = prog
+        if not prog.verified:
+            try:
+                out = prog.exe(*args, **kwargs)
+            except TypeError as exc:
+                # argument rejection happens before execution, so every buffer
+                # (donated or not) is intact: demote to stale, trace fresh,
+                # overwrite the bad entry
+                _observe.note_aot_stale(self.label, f"load rejected: {exc}")
+                prog = self._compile(entry, sig, args, kwargs)
+                self.programs[sig] = prog
+                return prog.exe(*args, **kwargs)
+            prog.verified = True
+            return out
+        return prog.exe(*args, **kwargs)
+
+    def _resolve(self, entry: Any, sig: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> _Program:
+        rec = _cache.lookup((self.base_key, sig), self.label)
+        if rec is not None:
+            exe, donate = rec
+            if entry.donate and not donate:
+                # the stored program was built without donation (XLA reported
+                # the aliasing unusable when it was compiled): latch the
+                # in-memory entry the way the probation probe would, so the
+                # recorded verdict and the live dispatch path agree
+                entry.fn = jax.jit(entry.raw)
+                entry.donate = False
+                _observe.record_event("donation_unusable", metric=self.label, source="aot")
+            return _Program(exe, from_disk=True)
+        return self._compile(entry, sig, args, kwargs)
+
+    def _compile(self, entry: Any, sig: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> _Program:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            compiled = entry.fn.lower(*args, **kwargs).compile()
+        unusable = False
+        for w in caught:
+            if _DONATION_UNUSABLE_MSG in str(w.message):
+                unusable = True
+                continue
+            warnings.warn_explicit(w.message, w.category, w.filename, w.lineno)
+        if unusable and entry.donate:
+            # same latch as the probation probe, learned at compile time:
+            # rebuild without donation so the stored program and the recorded
+            # donate verdict agree (and later processes skip the probe)
+            entry.fn = jax.jit(entry.raw)
+            entry.donate = False
+            _observe.record_event("donation_unusable", metric=self.label, source="aot")
+            compiled = entry.fn.lower(*args, **kwargs).compile()
+        if self.on_compile is not None:
+            self.on_compile()
+        _cache.store((self.base_key, sig), compiled, entry.donate, self.label)
+        return _Program(compiled, from_disk=False)

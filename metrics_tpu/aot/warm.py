@@ -1,0 +1,166 @@
+"""Pre-populate the AOT executable cache for the whole metric registry.
+
+``python tools/warm_cache.py --cache-dir /var/cache/metrics_tpu`` (or the
+``warm-cache`` console script) runs ONE real update per profiled registry
+class (:data:`metrics_tpu.observe.costs.PROFILE_CASES`, the same cases and
+deterministic batches the perf ratchet lowers) with the disk cache pointed at
+the target directory. Every compile that run pays is serialized, so the next
+process — every fleet worker that mounts the directory — starts with zero
+cold-start compiles for those programs.
+
+Idempotent: a second run over a warm directory reports hits, stores nothing,
+and rewrites only entries gone stale (jax upgrade, backend change). Safe to
+call in-process (tests, notebooks): observe state, the shared jit cache and
+the configured cache dir are all restored on exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from metrics_tpu.aot import cache as _cache
+
+__all__ = ["main", "warm_registry"]
+
+
+def warm_registry(
+    cache_directory: Optional[str] = None,
+    classes: Optional[Sequence[str]] = None,
+    verbose: bool = False,
+) -> Dict[str, Any]:
+    """Warm the cache for every (matching) registry case; returns a summary.
+
+    ``cache_directory`` defaults to the already-configured dir (env var or
+    :func:`metrics_tpu.aot.set_cache_dir`). ``classes`` filters case names by
+    case-insensitive substring. The summary maps each case name to its status:
+    ``stored`` (entries written), ``hit`` (already warm), ``ineligible``
+    (never jit-compiles, nothing to cache), ``unfingerprintable`` (config has
+    no process-stable identity, so no disk key) or ``error``.
+    """
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+    from metrics_tpu.observe.costs import PROFILE_CASES, _rng
+
+    directory = cache_directory if cache_directory is not None else _cache.cache_dir()
+    if directory is None:
+        raise ValueError(
+            "no cache directory: pass --cache-dir, set METRICS_TPU_AOT_CACHE, "
+            "or call metrics_tpu.aot.set_cache_dir first"
+        )
+
+    selected = [
+        c for c in PROFILE_CASES
+        if not classes or any(s.lower() in c.name.lower() for s in classes)
+    ]
+    summary: Dict[str, Any] = {"directory": str(directory), "cases": {}}
+    tally = {"stored": 0, "hit": 0, "ineligible": 0, "unfingerprintable": 0, "error": 0}
+
+    prev_dir = _cache.cache_dir()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    was_enabled = _observe.ENABLED
+    probe = _observe.Recorder()
+    real, _observe.RECORDER = _observe.RECORDER, probe
+    try:
+        _cache.set_cache_dir(directory)
+        clear_jit_cache()  # in-memory only: force every case through the disk path
+        _observe.ENABLED = True
+        for case in selected:
+            status, detail = "stored", ""
+            before = dict(probe.counters)
+            try:
+                inst = case.ctor()
+                batch = case.batch(_rng(case))
+                # _jit_eligible is the real dispatch gate: class-level opt-outs,
+                # list state, per-instance jit_update=False (e.g. aggregation
+                # metrics whose nan_strategy needs the host) all mean the update
+                # never compiles, so there is nothing to persist
+                if not inst._jit_eligible(batch, {}):
+                    status = "ineligible"
+                elif inst._jit_cache_key() is None:
+                    status = "unfingerprintable"
+                else:
+                    inst.update(*batch)
+                    label = type(inst).__name__
+                    delta = lambda name: (  # noqa: E731
+                        probe.counters.get((name, label), 0) - before.get((name, label), 0)
+                    )
+                    if probe.counters.get(("eager_fallback", label), 0) - before.get(("eager_fallback", label), 0):
+                        status, detail = "error", "latched eager fallback under jit"
+                    elif delta("aot_store"):
+                        status = "stored"
+                    elif delta("aot_hit"):
+                        status = "hit"
+                    else:
+                        status, detail = "error", "update ran but neither stored nor hit"
+            except Exception as exc:  # noqa: BLE001 — the error text IS the result
+                status, detail = "error", f"{type(exc).__name__}: {exc}"
+            tally[status] += 1
+            summary["cases"][case.name] = {"status": status, **({"detail": detail} if detail else {})}
+            if verbose:
+                print(f"  {case.name:45s} {status}{(' — ' + detail) if detail else ''}")
+    finally:
+        _observe.ENABLED = was_enabled
+        _observe.RECORDER = real
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+        _cache.set_cache_dir(prev_dir)
+    summary.update(tally)
+    summary["stats"] = _cache.cache_stats(str(directory))
+    return summary
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="warm-cache",
+        description="Pre-populate the AOT executable cache (DESIGN §18) for the "
+                    "whole profiled metric registry in one run.",
+    )
+    p.add_argument("--cache-dir", default=None,
+                   help="target directory (default: $METRICS_TPU_AOT_CACHE)")
+    p.add_argument("--classes", default=None,
+                   help="comma-separated case-name substrings to warm (default: all)")
+    p.add_argument("--purge", action="store_true",
+                   help="delete existing entries first (force a full rebuild)")
+    p.add_argument("-v", "--verbose", action="store_true", help="per-case lines")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = p.parse_args(argv)
+
+    # probe the backend in a killable subprocess first (same as every other
+    # CLI tool): a wedged accelerator tunnel must not hang the warm run, and
+    # the entries must be fingerprinted against the backend that answers
+    from metrics_tpu.utils.backend import ensure_backend
+
+    ensure_backend(min_devices=1, quiet=args.quiet)
+
+    classes = [s.strip() for s in args.classes.split(",") if s.strip()] if args.classes else None
+    directory = args.cache_dir if args.cache_dir is not None else _cache.cache_dir()
+    if directory is None:
+        print("warm-cache: no cache directory (pass --cache-dir or set "
+              f"{_cache.ENV_VAR})", file=sys.stderr)
+        return 2
+    if args.purge:
+        removed = _cache.purge_cache(str(directory))
+        if not args.quiet:
+            print(f"warm-cache: purged {removed} entries from {directory}")
+    try:
+        summary = warm_registry(str(directory), classes=classes, verbose=args.verbose)
+    except ValueError as exc:
+        print(f"warm-cache: {exc}", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        stats = summary["stats"]
+        print(
+            f"warm-cache: {summary['stored']} stored, {summary['hit']} already warm, "
+            f"{summary['ineligible']} ineligible, {summary['unfingerprintable']} unfingerprintable, "
+            f"{summary['error']} errors — {stats['entries']} entries / {stats['bytes']} bytes in {stats['directory']}"
+        )
+        for name, info in summary["cases"].items():
+            if info["status"] == "error":
+                print(f"  ERROR {name}: {info.get('detail', '')}", file=sys.stderr)
+    return 1 if summary["error"] else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

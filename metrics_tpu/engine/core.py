@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from metrics_tpu.metric import (
     Metric,
     _CompiledUpdate,
+    _aot_runtime,
     _named_for_profiler,
     _probation_dispatch,
     _squeeze_if_scalar,
@@ -77,7 +78,10 @@ class ProgramCache(OrderedDict):
             entry = build()
             self[key] = entry
             self._labels[key] = label
-            _observe.note_engine_compile(self.kind, label, n)
+            if entry.aot is None:
+                # an attached AOT binding (DESIGN §18) owns the compile counter
+                # instead: it fires on a true XLA compile, not on a disk hit
+                _observe.note_engine_compile(self.kind, label, n)
             if len(self) > self.max_entries:
                 evicted_key, _ = self.popitem(last=False)
                 _observe.note_engine_evict(self.kind, self._labels.pop(evicted_key, "?"))
@@ -96,6 +100,40 @@ class ProgramCache(OrderedDict):
 # signature) buckets since each live signature is one executable.
 _REPLICA_JIT_CACHE = ProgramCache("replica", 64)
 _FLEET_JIT_CACHE = ProgramCache("fleet", 256)
+
+
+def _attach_engine_aot(
+    entry: _CompiledUpdate, template: Metric, cache: ProgramCache, label: str, n: int, statics: Tuple[Any, ...]
+) -> _CompiledUpdate:
+    """Bind a freshly built engine program to the disk executable cache.
+
+    Only when the AOT cache is configured AND the template is fingerprintable —
+    the disk key needs a process-stable identity, which the in-memory
+    ``_jit_cache_key`` (it holds the class object itself) cannot provide.
+    ``statics`` carries everything shape-relevant the ProgramCache key pins
+    (mode, arg structure, batch signature, donation), rendered from primitives
+    so its repr hashes identically across processes.
+    """
+    aot = _aot_runtime()
+    if aot is None:
+        return entry
+    fp = template.config_fingerprint()
+    if fp is None:
+        return entry
+    entry.aot = aot.AotBinding(
+        base_key=(
+            "engine",
+            cache.kind,
+            f"{type(template).__module__}.{type(template).__qualname__}",
+            fp,
+            template.state_avals(),
+            n,
+        )
+        + statics,
+        label=label,
+        on_compile=lambda: _observe.note_engine_compile(cache.kind, label, n),
+    )
+    return entry
 
 
 def _batch_leaf_sig(v: Any) -> Tuple[Any, ...]:
@@ -146,8 +184,10 @@ def engine_update(
         # dispatch replays the same traced executable — the recompile-pin tests
         # and the perf ratchet's dispatches-per-tick column rely on this.
         batch_sig = tuple(_batch_leaf_sig(a) for a in flat)
+        sig_static: Tuple[Any, ...] = batch_sig
         key = (template._jit_cache_key(), n, mode, nargs, kw_names, batch_sig, donate)
     else:
+        sig_static = arr_flags
         key = (template._jit_cache_key(), n, mode, nargs, kw_names, arr_flags, donate)
 
     def build() -> _CompiledUpdate:
@@ -180,7 +220,8 @@ def engine_update(
                 return upd(st, *leaves[:nargs], **dict(zip(kw_names, leaves[nargs:])))
 
             in_axes = (0,) + tuple(0 if f else None for f in arr_flags)
-        return _CompiledUpdate(jax.vmap(one, in_axes=in_axes), donate)
+        entry = _CompiledUpdate(jax.vmap(one, in_axes=in_axes), donate)
+        return _attach_engine_aot(entry, template, cache, label, n, (mode, nargs, kw_names, sig_static, donate))
 
     entry = cache.lookup(key, build, label, n)
     if entry.probation and entry.donate:
@@ -221,7 +262,8 @@ def engine_compute(
         rep = template.clone()
         rep.reset()
         comp = _named_for_profiler(rep._functional_compute, f"{type(rep).__name__}_{cache.kind}_compute")
-        return _CompiledUpdate(jax.vmap(lambda st: _squeeze_if_scalar(comp(st)), in_axes=(0,)), False)
+        entry = _CompiledUpdate(jax.vmap(lambda st: _squeeze_if_scalar(comp(st)), in_axes=(0,)), False)
+        return _attach_engine_aot(entry, template, cache, label, n, ("compute",))
 
     entry = cache.lookup(key, build, label, n)
     return entry(stacked)

@@ -32,6 +32,7 @@ import functools
 import hashlib
 import inspect
 import operator
+import os
 import sys
 import warnings
 from abc import ABC, abstractmethod
@@ -91,7 +92,7 @@ _SHARED_JIT_CACHE: "OrderedDict[Any, _CompiledUpdate]" = OrderedDict()
 _SHARED_JIT_CACHE_MAX = 256
 
 
-def clear_jit_cache() -> None:
+def clear_jit_cache(include_disk: bool = False) -> None:
     """Drop all shared compiled updates (frees the representative instances too).
 
     Covers every compiled-update cache in the runtime: the per-metric shared
@@ -100,6 +101,12 @@ def clear_jit_cache() -> None:
     ``wrappers/replicated.py`` plus the fleet bucket cache). The observe
     layer's cache-scoped counters (compiles / hits / evictions) describe these
     caches, so they reset with them — see ``metrics_tpu.observe`` (DESIGN §11).
+
+    The on-disk AOT executable cache (DESIGN §18) deliberately survives a
+    default clear — it exists to outlive in-memory caches and whole processes.
+    Pass ``include_disk=True`` to also purge the configured cache directory
+    (equivalent to :func:`metrics_tpu.aot.purge_cache`); a no-op when no
+    directory is configured.
     """
     _SHARED_JIT_CACHE.clear()
     collections_mod = sys.modules.get("metrics_tpu.collections")
@@ -109,7 +116,28 @@ def clear_jit_cache() -> None:
     if engine_core is not None:
         engine_core._REPLICA_JIT_CACHE.clear()
         engine_core._FLEET_JIT_CACHE.clear()
+    if include_disk:
+        from metrics_tpu.aot import purge_cache  # noqa: PLC0415
+
+        purge_cache()
     _observe.note_jit_cache_cleared()
+
+
+def _aot_runtime():
+    """The AOT runtime package when the disk executable cache is configured,
+    else None — the one gate the compile paths check (DESIGN §18).
+
+    Import cost discipline: with ``METRICS_TPU_AOT_CACHE`` unset and
+    :func:`metrics_tpu.aot.set_cache_dir` never called, this is a
+    ``sys.modules`` probe plus one environment read — the aot package is not
+    imported and behavior is bit-identical to a build without it.
+    """
+    pkg = sys.modules.get("metrics_tpu.aot")
+    if pkg is None:
+        if not os.environ.get("METRICS_TPU_AOT_CACHE"):
+            return None
+        import metrics_tpu.aot as pkg  # noqa: PLC0415
+    return pkg if pkg.active() else None
 
 
 def _named_for_profiler(fn: Callable, name: str) -> Callable:
@@ -132,7 +160,7 @@ class _CompiledUpdate:
     donation unusable the fallback to a plain jit propagates to every holder.
     """
 
-    __slots__ = ("raw", "fn", "donate", "probation")
+    __slots__ = ("raw", "fn", "donate", "probation", "aot")
 
     def __init__(self, raw: Callable, donate: bool) -> None:
         self.raw = raw
@@ -141,8 +169,14 @@ class _CompiledUpdate:
         # could not use ("Some donated buffers were not usable") at compile time
         self.probation = donate
         self.fn = jax.jit(raw, donate_argnums=(0,) if donate else ())
+        # disk executable cache binding (aot/runtime.py AotBinding), attached
+        # at entry creation when METRICS_TPU_AOT_CACHE is configured; None —
+        # the default — keeps dispatch on the plain jit wrapper
+        self.aot = None
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if self.aot is not None:
+            return self.aot.dispatch(self, args, kwargs)
         return self.fn(*args, **kwargs)
 
     def lower(self, *args: Any, **kwargs: Any) -> Any:
@@ -163,7 +197,10 @@ def _probation_dispatch(entry: _CompiledUpdate, label: str, args: tuple, kwargs:
     """
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
-        out = entry.fn(*args, **kwargs)
+        # through entry.__call__ so an attached AOT binding (DESIGN §18) serves
+        # the first dispatch too; when it compiles, it consumes the unusable-
+        # donation warning itself and latches the entry, leaving this probe inert
+        out = entry(*args, **kwargs)
     entry.probation = False
     unusable = False
     for w in caught:
@@ -657,10 +694,30 @@ class Metric(ABC):
             # large states they later accumulate — out of the cache.
             rep = self.clone()
             rep.reset()
-            raw = _named_for_profiler(rep._functional_update, f"{type(self).__name__}_update")
+            name = type(self).__name__
+            raw = _named_for_profiler(rep._functional_update, f"{name}_update")
             entry = _CompiledUpdate(raw, donate)
+            aot = _aot_runtime()
+            if aot is not None:
+                # the disk key's signature-independent half; config_fingerprint
+                # is non-None here (cfg hashed above) and already folds in the
+                # guard policy, which changes what the traced body computes
+                entry.aot = aot.AotBinding(
+                    base_key=(
+                        "shared",
+                        f"{type(self).__module__}.{type(self).__qualname__}",
+                        self.config_fingerprint(),
+                        self.state_avals(),
+                        donate,
+                    ),
+                    label=name,
+                    # defer the compile counter to an actual XLA compile: a disk
+                    # hit counts aot_hit instead, so warmed processes report 0
+                    on_compile=functools.partial(_observe.note_jit_compile, name, shared=True),
+                )
+            else:
+                _observe.note_jit_compile(name, shared=True)
             _SHARED_JIT_CACHE[key] = entry
-            _observe.note_jit_compile(type(self).__name__, shared=True)
             if len(_SHARED_JIT_CACHE) > _SHARED_JIT_CACHE_MAX:
                 evicted_key, _ = _SHARED_JIT_CACHE.popitem(last=False)
                 _observe.note_jit_eviction(evicted_key[0][0].__name__)

@@ -15,6 +15,11 @@ Each case also reports the *sharing* story: whether the class produces a
 hashable static-config key (``Metric._jit_cache_key``) so N config-equal
 instances replay ONE executable, and — via a tiny real two-instance update
 under the observe runtime — how many compiles two instances actually cost.
+A third dynamic probe measures the *cold-start* story (DESIGN §18): whether
+the class's executable persists through the AOT disk cache
+(``aot_cacheable``) and how many XLA compiles a fresh process still pays for
+its first update with a warmed cache mounted (``cold_start_compile_count``,
+0 when disk reuse works).
 
 Run via ``tools/profile_metrics.py`` / the ``profile-metrics`` console script;
 baselined in ``tools/perf_baseline.json`` (see :mod:`metrics_tpu.observe.profile`).
@@ -139,9 +144,65 @@ def profile_case(case: ProfileCase, include_memory: bool = True, dynamic: bool =
             cost["cache_hits"] = int(probe.counters.get(("jit_cache_hit", cls_label), 0))
             if probe.counters.get(("eager_fallback", cls_label)):
                 return CostReport(case, ok=False, error="update latched eager fallback under jit")
+            cost.update(_cold_start_probe(case, args, cls_label, bool(compiles)))
         return CostReport(case, ok=True, cost=cost)
     except Exception as exc:  # noqa: BLE001 — the error text IS the result
         return CostReport(case, ok=False, error=f"{type(exc).__name__}: {exc}")
+
+
+def _cold_start_probe(
+    case: ProfileCase, args: Sequence[Any], cls_label: str, compiled: bool
+) -> Dict[str, Any]:
+    """Measure what a FRESH process pays for this class's first update when a
+    warmed AOT executable cache (DESIGN §18) is mounted.
+
+    Warm a throwaway disk cache with one real update, drop the in-memory shared
+    cache (the stand-in for a process boundary), then update again:
+
+    * ``aot_cacheable`` — the warm leg persisted at least one executable;
+    * ``cold_start_compile_count`` — XLA compiles the second leg still paid
+      (0 when disk reuse works; for an uncacheable class, the compile every
+      process re-pays).
+
+    A class that never compiled in the sharing probe skips the disk legs: its
+    update is eager by design, so a new process pays zero compiles anyway.
+    """
+    if not compiled:
+        return {"aot_cacheable": False, "cold_start_compile_count": 0}
+    import tempfile
+
+    from metrics_tpu.aot import cache as _aot_cache
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+
+    prev_dir = _aot_cache.cache_dir()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    was_enabled = _observe.ENABLED
+    probe = _observe.Recorder()
+    real, _observe.RECORDER = _observe.RECORDER, probe
+    try:
+        with tempfile.TemporaryDirectory(prefix="aot_profile_") as tmp:
+            _aot_cache.set_cache_dir(tmp)
+            _observe.ENABLED = True
+            clear_jit_cache()
+            case.ctor().update(*args)  # warm leg: compile AOT, serialize to disk
+            stored = probe.counters.get(("aot_store", cls_label), 0)
+            clear_jit_cache()  # the process boundary: only the disk survives
+            before = dict(probe.counters)
+            case.ctor().update(*args)  # cold-start leg: should reload, not compile
+            cold = (
+                probe.counters.get(("jit_compile", cls_label), 0)
+                - before.get(("jit_compile", cls_label), 0)
+                + probe.counters.get(("jit_compile_unshared", cls_label), 0)
+                - before.get(("jit_compile_unshared", cls_label), 0)
+            )
+    finally:
+        _observe.ENABLED = was_enabled
+        _observe.RECORDER = real
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+        _aot_cache.set_cache_dir(prev_dir)
+    return {"aot_cacheable": bool(stored), "cold_start_compile_count": int(cold)}
 
 
 def collect_cost_report(

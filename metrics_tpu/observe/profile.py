@@ -7,8 +7,11 @@ two config-equal instances). The check ratchets exactly like the
 jitlint/distlint baselines:
 
 * a class whose FLOPs or bytes grow beyond ``tolerance``× its baseline — or
-  whose update stops sharing one compiled executable across instances — is a
-  **regression** (exit 1);
+  whose update stops sharing one compiled executable across instances, stops
+  persisting through the AOT disk cache (``aot_cacheable`` True→False), or
+  starts paying cold-start compiles a warmed cache used to absorb
+  (``cold_start_compile_count`` 0→N, DESIGN §18) — is a **regression**
+  (exit 1);
 * a class that *improved* beyond tolerance, or vanished from the registry, is
   reported **stale** so the baseline ratchets down over time (exit 0);
 * classes with no baseline entry are reported as **new** (exit 0; record them
@@ -108,6 +111,21 @@ def diff_cost_baseline(
             regressions.append(
                 f"{name}: {cur_compiles} compiles for two config-equal instances "
                 f"(baseline {base_compiles}) — jit-cache sharing broke"
+            )
+        # cold-start ratchet (DESIGN §18): a baseline of 0 means a warmed AOT
+        # cache fully absorbs this class's first-update compile in a fresh
+        # process; any compile reappearing there is disk reuse breaking. The
+        # == 0 comparison (not falsy) keeps pre-AOT baselines exempt.
+        cur_cold = cost.get("cold_start_compile_count")
+        if base.get("cold_start_compile_count") == 0 and cur_cold:
+            regressions.append(
+                f"{name}: {cur_cold} cold-start compile(s) where the baseline had 0 "
+                "— AOT disk executable reuse broke"
+            )
+        if base.get("aot_cacheable") and cost.get("aot_cacheable") is False:
+            regressions.append(
+                f"{name}: no longer AOT-cacheable — every new process pays this "
+                "class's cold-start compile again"
             )
     stale: List[str] = []
     for name, base in sorted(baseline.items()):

@@ -41,6 +41,10 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "note_aot_hit",
+    "note_aot_miss",
+    "note_aot_stale",
+    "note_aot_store",
     "note_eager_fallback",
     "note_engine_compile",
     "note_engine_dispatch",
@@ -264,6 +268,34 @@ def note_eager_fallback(metric: str, exc: BaseException) -> None:
         RECORDER.add_event("eager_fallback", metric=metric, error=type(exc).__name__, detail=str(exc)[:200])
 
 
+# AOT disk-cache hooks (aot/cache.py + aot/runtime.py). Deliberately NOT in
+# _JIT_CACHE_COUNTERS: clear_jit_cache() drops the in-memory caches, but the
+# disk cache (and the counters describing its traffic) outlives them.
+def note_aot_hit(label: str) -> None:
+    """A serialized executable was loaded from disk instead of compiling."""
+    if ENABLED:
+        RECORDER.add_count("aot_hit", label)
+
+
+def note_aot_miss(label: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("aot_miss", label)
+
+
+def note_aot_stale(label: str, reason: str) -> None:
+    """An entry was found but unusable (version/backend drift or corruption);
+    it is latched and rewritten by the next store, not re-tried every lookup."""
+    if ENABLED:
+        RECORDER.add_count("aot_stale", label)
+        RECORDER.add_event("aot_stale", metric=label, reason=reason[:200])
+
+
+def note_aot_store(label: str, nbytes: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("aot_store", label)
+        RECORDER.add_event("aot_store", metric=label, bytes=nbytes)
+
+
 def note_fused_compile(n_leaders: int, shared: bool) -> None:
     if ENABLED:
         RECORDER.add_count("fused_compile", str(n_leaders))
@@ -468,7 +500,10 @@ def snapshot() -> Dict[str, Any]:
                       "fleet_quarantined_total": int,
                       "fleet_restores_total": int,
                       "wal_appends_total": int,
-                      "wal_records_replayed_total": int}}
+                      "wal_records_replayed_total": int,
+                      "aot_hits_total": int, "aot_misses_total": int,
+                      "aot_stale_total": int, "aot_stores_total": int,
+                      "aot_hit_rate": float|None}}
 
     The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
     buckets: occupancy is live rows over padded capacity, pad waste is the
@@ -502,6 +537,9 @@ def snapshot() -> Dict[str, Any]:
     fleet_bytes_active = sum(gauges.get("fleet_bytes_active", {}).values())
     fleet_dispatches = sum(counters.get("fleet_dispatch", {}).values())
     fleet_flushes = sum(counters.get("fleet_flush", {}).values())
+    aot_hits = sum(counters.get("aot_hit", {}).values())
+    aot_misses = sum(counters.get("aot_miss", {}).values())
+    aot_lookups = aot_hits + aot_misses
     return {
         "enabled": ENABLED,
         "counters": {k: dict(sorted(v.items())) for k, v in sorted(counters.items())},
@@ -530,6 +568,11 @@ def snapshot() -> Dict[str, Any]:
             "fleet_restores_total": sum(counters.get("fleet_restore", {}).values()),
             "wal_appends_total": sum(counters.get("wal_append", {}).values()),
             "wal_records_replayed_total": sum(counters.get("wal_replay", {}).values()),
+            "aot_hits_total": aot_hits,
+            "aot_misses_total": aot_misses,
+            "aot_stale_total": sum(counters.get("aot_stale", {}).values()),
+            "aot_stores_total": sum(counters.get("aot_store", {}).values()),
+            "aot_hit_rate": (aot_hits / aot_lookups) if aot_lookups else None,
         },
     }
 

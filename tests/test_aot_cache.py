@@ -1,0 +1,287 @@
+"""AOT executable cache (DESIGN §18): storage discipline, staleness, counters.
+
+The disk cache must be invisible when off, bit-exact when on, and degrade to a
+normal trace on every failure mode (corrupt file, version drift) — never crash
+or miscompute. Cross-process reuse is proven in
+``tests/test_aot_cross_process.py``; the registry-wide round-trip oracle runs
+as the ``aot`` pass of ``tools/lint_metrics.py --all``.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from metrics_tpu.aot import cache as aot_cache
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+from metrics_tpu.observe import recorder as rec_mod
+from metrics_tpu.regression import MeanSquaredError
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(32).astype(np.float32), rng.randint(0, 2, 32).astype(np.int32)
+
+
+def _counters(probe):
+    out = {}
+    for (name, label), v in probe.counters.items():
+        out.setdefault(name, {})[label] = v
+    return out
+
+
+@pytest.fixture
+def aot_env(tmp_path):
+    """Probe recorder + cache dir pointed at tmp; every global restored."""
+    prev_dir = aot_cache.cache_dir()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    probe = rec_mod.Recorder()
+    rec_mod.RECORDER, rec_mod.ENABLED = probe, True
+    aot_cache.set_cache_dir(tmp_path)
+    clear_jit_cache()
+    yield str(tmp_path), probe
+    rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+    _SHARED_JIT_CACHE.clear()
+    _SHARED_JIT_CACHE.update(saved_cache)
+    aot_cache.set_cache_dir(prev_dir)
+
+
+def _entry_files(d):
+    return sorted(f for f in os.listdir(d) if f.endswith(".aotx"))
+
+
+# ---------------------------------------------------------------- default off
+def test_cache_unset_is_invisible(tmp_path):
+    prev_dir = aot_cache.cache_dir()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    probe = rec_mod.Recorder()
+    rec_mod.RECORDER, rec_mod.ENABLED = probe, True
+    aot_cache.set_cache_dir(None)
+    clear_jit_cache()
+    try:
+        m = BinaryAccuracy()
+        m.update(*_batch())
+        value = float(np.asarray(m.compute()))
+        counters = _counters(probe)
+        assert not any(k.startswith("aot_") for k in counters), counters
+        assert m._jitted_update.aot is None  # no binding even attached
+        assert value == pytest.approx(value)  # computed fine, eagerly checked
+        assert _entry_files(tmp_path) == []
+    finally:
+        rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+        aot_cache.set_cache_dir(prev_dir)
+
+
+# ------------------------------------------------------------------ roundtrip
+def test_roundtrip_zero_compiles_bit_exact(aot_env):
+    d, probe = aot_env
+    args = _batch()
+
+    cold = BinaryAccuracy()
+    cold.update(*args)
+    c = _counters(probe)
+    assert c["aot_miss"]["BinaryAccuracy"] == 1
+    assert c["aot_store"]["BinaryAccuracy"] == 1
+    assert c["jit_compile"]["BinaryAccuracy"] == 1
+    assert len(_entry_files(d)) == 1
+
+    clear_jit_cache()  # the in-process stand-in for a process boundary
+    warm = BinaryAccuracy()
+    warm.update(*args)
+    c = _counters(probe)
+    assert c["aot_hit"]["BinaryAccuracy"] == 1
+    assert c.get("jit_compile", {}).get("BinaryAccuracy", 0) == 0  # reset by clear, none since
+    for k, v in cold.metric_state.items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(warm.metric_state[k]))
+    assert float(np.asarray(cold.compute())) == float(np.asarray(warm.compute()))
+
+
+def test_distinct_signatures_get_distinct_entries(aot_env):
+    d, probe = aot_env
+    m = BinaryAccuracy()
+    m.update(*_batch())
+    rng = np.random.RandomState(1)
+    m.update(rng.rand(64).astype(np.float32), rng.randint(0, 2, 64).astype(np.int32))
+    assert len(_entry_files(d)) == 2  # one executable per batch signature
+    assert _counters(probe)["aot_store"]["BinaryAccuracy"] == 2
+
+
+# ----------------------------------------------------- corruption & staleness
+def test_corrupt_entry_falls_back_and_is_rewritten(aot_env):
+    d, probe = aot_env
+    args = _batch()
+    BinaryAccuracy().update(*args)
+    (name,) = _entry_files(d)
+    path = os.path.join(d, name)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF  # flip a payload bit
+    open(path, "wb").write(bytes(data))
+
+    clear_jit_cache()
+    aot_cache.set_cache_dir(d)  # drop the stale latch, as a fresh process would
+    m = BinaryAccuracy()
+    m.update(*args)  # must trace normally, never crash
+    c = _counters(probe)
+    assert c["aot_stale"]["BinaryAccuracy"] == 1
+    assert c["jit_compile"]["BinaryAccuracy"] == 1
+    assert c["aot_store"]["BinaryAccuracy"] == 2  # the overwrite repaired the file
+
+    clear_jit_cache()
+    m2 = BinaryAccuracy()
+    m2.update(*args)
+    assert _counters(probe)["aot_hit"]["BinaryAccuracy"] == 1
+    for k, v in m.metric_state.items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(m2.metric_state[k]))
+
+
+def _version_bump_entry(d):
+    """Rewrite the single entry on disk as if an older jax had built it."""
+    (name,) = _entry_files(d)
+    path = os.path.join(d, name)
+    digest = name[: -len(".aotx")]
+    header, payload = aot_cache.read_entry(path, digest)
+    aot_cache.environment_fingerprint()  # populate the cached backend part
+    real_fp = aot_cache._BACKEND_FP
+    aot_cache._BACKEND_FP = dict(real_fp, jax="0.0.0-previous")
+    try:
+        aot_cache.write_entry(path, digest, header["label"], header["donate"], payload)
+    finally:
+        aot_cache._BACKEND_FP = real_fp
+
+
+def test_version_bumped_entry_refreshed_exactly_once(aot_env):
+    d, probe = aot_env
+    args = _batch()
+    BinaryAccuracy().update(*args)
+    _version_bump_entry(d)
+
+    clear_jit_cache()
+    aot_cache.set_cache_dir(d)  # fresh latch, like the upgraded process starting
+    m = BinaryAccuracy()
+    m.update(*args)
+    c = _counters(probe)
+    assert c["aot_stale"]["BinaryAccuracy"] == 1  # recognized once
+    assert c["jit_compile"]["BinaryAccuracy"] == 1  # recompiled once
+    assert c["aot_store"]["BinaryAccuracy"] == 2  # rewritten in place
+
+    # the refreshed entry now serves hits — no second stale, no second rewrite
+    clear_jit_cache()
+    BinaryAccuracy().update(*args)
+    c = _counters(probe)
+    assert c["aot_stale"]["BinaryAccuracy"] == 1
+    assert c["aot_store"]["BinaryAccuracy"] == 2
+    assert c["aot_hit"]["BinaryAccuracy"] == 1
+
+
+def test_stale_latch_skips_reread_until_next_store(aot_env):
+    d, probe = aot_env
+    key = ("unit", "latch")
+    path = aot_cache.entry_path(aot_cache.entry_digest(key))
+    open(path, "wb").write(b"garbage that is not an entry")
+    assert aot_cache.lookup(key, "Unit") is None
+    assert aot_cache.lookup(key, "Unit") is None
+    c = _counters(probe)
+    assert c["aot_stale"]["Unit"] == 1  # first lookup validates and latches
+    assert c["aot_miss"]["Unit"] == 1  # second misses without touching the file
+
+
+def test_read_entry_rejects_bad_magic_and_old_format(tmp_path):
+    p = str(tmp_path / "x.aotx")
+    open(p, "wb").write(b"NOTMAGIC" + b"\0" * 32)
+    with pytest.raises(aot_cache.CorruptEntryError):
+        aot_cache.read_entry(p, "x")
+    digest = aot_cache.entry_digest(("unit", "fmt"))
+    p2 = str(tmp_path / (digest + ".aotx"))
+    aot_cache.write_entry(p2, digest, "Unit", False, b"payload")
+    real = aot_cache.FORMAT_VERSION
+    try:
+        aot_cache.FORMAT_VERSION = real + 1
+        with pytest.raises(aot_cache.StaleEntryError):
+            aot_cache.read_entry(p2, digest)
+    finally:
+        aot_cache.FORMAT_VERSION = real
+
+
+# ------------------------------------------------------------------- purging
+def test_purge_and_clear_include_disk(aot_env):
+    d, probe = aot_env
+    BinaryAccuracy().update(*_batch())
+    MeanSquaredError().update(np.arange(8.0, dtype=np.float32), np.arange(8.0, dtype=np.float32))
+    keep = os.path.join(d, "not_ours.txt")
+    open(keep, "w").write("sibling file")
+    assert len(_entry_files(d)) == 2
+
+    clear_jit_cache()  # default: in-memory only, the disk survives
+    assert len(_entry_files(d)) == 2
+
+    clear_jit_cache(include_disk=True)
+    assert _entry_files(d) == []
+    assert os.path.exists(keep)  # only *.aotx files are the cache's to delete
+
+    BinaryAccuracy().update(*_batch())  # repopulates cleanly
+    assert len(_entry_files(d)) == 1
+    assert aot_cache.purge_cache() == 1
+    assert aot_cache.cache_stats(d) == {"directory": d, "entries": 0, "bytes": 0}
+
+
+# ------------------------------------------------------------------ observe
+def test_snapshot_derives_aot_totals(aot_env):
+    d, probe = aot_env
+    args = _batch()
+    BinaryAccuracy().update(*args)
+    clear_jit_cache()
+    BinaryAccuracy().update(*args)
+    snap = rec_mod.snapshot()
+    derived = snap["derived"]
+    assert derived["aot_hits_total"] == 1
+    assert derived["aot_misses_total"] == 1
+    assert derived["aot_stores_total"] == 1
+    assert derived["aot_stale_total"] == 0
+    assert derived["aot_hit_rate"] == pytest.approx(0.5)
+
+
+def test_snapshot_hit_rate_none_without_lookups():
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    rec_mod.RECORDER, rec_mod.ENABLED = rec_mod.Recorder(), True
+    try:
+        assert rec_mod.snapshot()["derived"]["aot_hit_rate"] is None
+    finally:
+        rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+
+
+# ------------------------------------------------------------------- engine
+def test_fleet_engine_programs_reload_from_disk(aot_env):
+    from metrics_tpu.engine import StreamEngine
+
+    d, probe = aot_env
+    rng = np.random.RandomState(3)
+    batches = [
+        (rng.rand(16).astype(np.float32), rng.rand(16).astype(np.float32)) for _ in range(4)
+    ]
+
+    def drive():
+        eng = StreamEngine(initial_capacity=4)
+        sids = [eng.add_session(MeanSquaredError()) for _ in range(4)]
+        for sid, args in zip(sids, batches):
+            eng.submit(sid, *args)
+        eng.tick()
+        return [float(np.asarray(eng.compute(sid))) for sid in sids]
+
+    first = drive()  # compiles the vmapped update + compute, stores both
+    c = _counters(probe)
+    stores = sum(v for k, v in c["aot_store"].items() if k.startswith("MeanSquaredError@"))
+    assert stores == 2  # the update program and the compute program
+
+    clear_jit_cache()
+    second = drive()
+    c = _counters(probe)
+    hits = sum(v for k, v in c["aot_hit"].items() if k.startswith("MeanSquaredError@"))
+    assert hits == 2
+    assert sum(c.get("fleet_compile", {}).values()) == 0
+    assert first == second

@@ -1,0 +1,75 @@
+"""The ``aot`` lint pass machinery: per-case verdicts, baseline diff, CLI runner.
+
+The full-registry sweep runs in CI via ``tools/lint_metrics.py --all``; here a
+small case subset exercises the same code paths quickly.
+"""
+
+import json
+
+import pytest
+
+from metrics_tpu.analysis.aot_contracts import (
+    AotResult,
+    check_aot_case,
+    diff_aot_contract_baseline,
+    load_aot_contract_baseline,
+    run_aot_check,
+    write_aot_contract_baseline,
+)
+from metrics_tpu.observe import costs as costs_mod
+
+_BY_NAME = {c.name: c for c in costs_mod.PROFILE_CASES}
+
+
+def test_check_aot_case_roundtrips_a_cacheable_class():
+    r = check_aot_case(_BY_NAME["BinaryAccuracy"])
+    assert r.verdict == "ROUNDTRIP", r.render()
+    assert r.ok
+
+
+def test_check_aot_case_classifies_host_side_metric_ineligible():
+    # MeanMetric's default nan_strategy="warn" pins its update to the host
+    # (_jit_update_opt False) — nothing ever compiles, so nothing is cached
+    r = check_aot_case(_BY_NAME["MeanMetric"])
+    assert r.verdict == "INELIGIBLE", r.render()
+    assert r.ok
+
+
+def test_diff_splits_failures_and_stale_keys():
+    results = [
+        AotResult("Good", "ROUNDTRIP"),
+        AotResult("Bad", "DIVERGED", "state[total]"),
+        AotResult("Known", "NO_REUSE"),
+    ]
+    baseline = {"Known": "justified: host callback", "Gone": "was flaky"}
+    failures, stale = diff_aot_contract_baseline(results, baseline)
+    assert [r.name for r in failures] == ["Bad"]  # unbaselined disagreement
+    assert stale == ["Gone"]  # baselined class no longer failing/observed
+
+
+def test_run_aot_check_report_and_baseline_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        costs_mod, "PROFILE_CASES", [_BY_NAME["BinaryAccuracy"], _BY_NAME["MeanMetric"]]
+    )
+    baseline = tmp_path / "aot_baseline.json"
+    write_aot_contract_baseline(str(baseline), [])
+    assert load_aot_contract_baseline(str(baseline)) == {}
+    assert json.loads(baseline.read_text())["aot"] == {}
+
+    report = {}
+    rc = run_aot_check(str(tmp_path), baseline_path=str(baseline), report=report)
+    assert rc == 0
+    assert report["cases"] == 2
+    assert report["failures"] == []
+    assert report["stale_baseline_keys"] == []
+    assert report["verdicts"] == {"BinaryAccuracy": "ROUNDTRIP", "MeanMetric": "INELIGIBLE"}
+
+
+def test_run_aot_check_flags_stale_baseline_entry(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(costs_mod, "PROFILE_CASES", [_BY_NAME["BinaryAccuracy"]])
+    baseline = tmp_path / "aot_baseline.json"
+    baseline.write_text(json.dumps({"aot": {"RetiredClass": "was failing once"}}))
+    report = {}
+    rc = run_aot_check(str(tmp_path), baseline_path=str(baseline), report=report)
+    assert rc == 0  # stale entries warn, they do not fail the pass
+    assert report["stale_baseline_keys"] == ["RetiredClass"]

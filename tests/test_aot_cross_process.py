@@ -1,0 +1,112 @@
+"""Cross-process AOT reuse: the whole point of the disk cache.
+
+A first interpreter warms the cache; a second, brand-new interpreter must run
+the same updates with ZERO XLA compiles and bit-identical results. In-process
+tests can only simulate the boundary (``clear_jit_cache``); these prove it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Two representative classes, deterministic batches: both processes draw the
+# same arrays, so any value difference is the deserialized executable's fault.
+_DRIVER = """
+import json
+import numpy as np
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.observe import recorder as rec
+probe = rec.Recorder()
+rec.RECORDER, rec.ENABLED = probe, True
+rng = np.random.RandomState(0)
+values = {}
+for cls in (BinaryAccuracy, MeanSquaredError):
+    preds = rng.rand(32).astype(np.float32)
+    target = rng.rand(32).astype(np.float32)
+    if cls is BinaryAccuracy:
+        target = (target > 0.5).astype(np.int32)
+    m = cls()
+    m.update(preds, target)
+    values[cls.__name__] = float(np.asarray(m.compute()))
+counters = {}
+for (name, label), v in probe.counters.items():
+    counters.setdefault(name, {})[label] = v
+print(json.dumps({"values": values, "counters": counters}))
+"""
+
+
+def _run(code, cache_dir, timeout=240):
+    env = dict(os.environ)
+    env["METRICS_TPU_AOT_CACHE"] = str(cache_dir)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return proc
+
+
+def _parse(proc):
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_second_process_reuses_without_compiling(tmp_path):
+    first = _parse(_run(_DRIVER, tmp_path))
+    assert first["counters"]["aot_store"] == {"BinaryAccuracy": 1, "MeanSquaredError": 1}
+    assert first["counters"]["jit_compile"] == {"BinaryAccuracy": 1, "MeanSquaredError": 1}
+
+    second = _parse(_run(_DRIVER, tmp_path))
+    c = second["counters"]
+    assert "jit_compile" not in c, c  # zero XLA compiles in the warm process
+    assert "jit_compile_unshared" not in c, c
+    assert c["aot_hit"] == {"BinaryAccuracy": 1, "MeanSquaredError": 1}
+    assert "aot_stale" not in c, c
+    assert second["values"] == first["values"]  # float-repr equality: bit-exact
+
+
+_SWEEP = """
+import json
+import numpy as np
+from metrics_tpu.observe import recorder as rec
+from metrics_tpu.observe.costs import PROFILE_CASES, _rng
+probe = rec.Recorder()
+rec.RECORDER, rec.ENABLED = probe, True
+ran = 0
+for case in PROFILE_CASES:
+    inst = case.ctor()
+    batch = case.batch(_rng(case))
+    if not inst._jit_eligible(batch, {}) or inst._jit_cache_key() is None:
+        continue
+    inst.update(*batch)
+    np.asarray(inst.compute())
+    ran += 1
+counters = {}
+for (name, label), v in probe.counters.items():
+    counters.setdefault(name, {})[label] = v
+print(json.dumps({"ran": ran, "counters": counters}))
+"""
+
+
+@pytest.mark.slow
+def test_registry_sweep_zero_cold_start_compiles(tmp_path):
+    warm = _run(
+        "import sys; from metrics_tpu.aot.warm import main; sys.exit(main(['-q']))",
+        tmp_path, timeout=600,
+    )
+    assert warm.returncode == 0
+
+    out = _parse(_run(_SWEEP, tmp_path, timeout=600))
+    c = out["counters"]
+    compiles = sum(c.get("jit_compile", {}).values()) + sum(c.get("jit_compile_unshared", {}).values())
+    assert compiles == 0, c  # a warmed cache means no registry class compiles
+    assert sum(c.get("aot_stale", {}).values()) == 0, c
+    assert out["ran"] > 0
+    assert sum(c.get("aot_hit", {}).values()) == out["ran"]

@@ -210,6 +210,8 @@ def test_snapshot_schema_is_stable_and_json_able():
         "fleet_pad_waste_pct", "fleet_dispatches_total", "fleet_dispatches_per_flush",
         "fleet_quarantined_total", "fleet_restores_total",
         "wal_appends_total", "wal_records_replayed_total",
+        "aot_hits_total", "aot_misses_total", "aot_stale_total",
+        "aot_stores_total", "aot_hit_rate",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():

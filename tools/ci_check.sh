@@ -1,7 +1,10 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
-# + donlint), the donation three-way cross-check, the chaos fault-injection
-# harness, the fleet-engine contract pass, and the perf cost ratchet (which
+# + donlint), the donation three-way cross-check, the AOT executable-cache
+# round-trip pass (serialize → fresh-dir reload with zero compiles → bit-exact
+# vs a fresh trace, baselined in tools/aot_baseline.json), the chaos
+# fault-injection harness, the fleet-engine contract pass, and the perf cost
+# ratchet (which
 # also drives the 64-stream StreamEngine smoke and pins its dispatch economy
 # against the `fleet` section of tools/perf_baseline.json) — all via
 # `lint_metrics.py --all`, which aggregates their exit codes. The default

@@ -2,7 +2,8 @@
 """Lint CLI — jitlint + distlint + donlint analysis over metrics_tpu.
 
 Usage:
-    python tools/lint_metrics.py [targets...] [--pass jitlint|distlint|donlint|donation|perf]
+    python tools/lint_metrics.py [targets...]
+                                 [--pass jitlint|distlint|donlint|donation|aot|fleet|chaos|perf]
                                  [--all] [--json] [--rules JL001,DL004,ML002]
                                  [--update-baseline]
 

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Pre-populate the AOT executable cache for the whole metric registry.
+
+Usage:
+    python tools/warm_cache.py --cache-dir DIR [--classes Binary,MeanSquared]
+                               [--purge] [-v]
+
+One real update per profiled registry class (~58, the perf-ratchet cases) with
+the disk cache enabled: every compile is serialized so the NEXT process — every
+fleet worker mounting DIR — starts with zero cold-start compiles. Idempotent;
+re-runs report hits and refresh only stale entries.
+
+Thin wrapper over :mod:`metrics_tpu.aot.warm` so the tool works from a
+checkout without installing the package (the ``warm-cache`` console script is
+the installed-form equivalent).
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from metrics_tpu.aot.warm import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
