@@ -896,6 +896,49 @@ def bench_sketches(with_ref: bool = True):
     }
 
 
+def _drain_flight(cap: int = 24):
+    """Per-config flight-recorder digest: drain the span ring accumulated by
+    the config that just ran and fold it into {span count, per-phase wall +
+    p50/p99, a capped Chrome-trace event list}. Draining between configs is
+    what makes the digest *per config* — the ring is process-wide. The full
+    timeline for interactive digging comes from ``observe.timeline()`` in your
+    own process; the embedded one is capped at ``cap`` events to keep the
+    BENCH line one line."""
+    import numpy as np
+
+    from metrics_tpu.observe import tracing
+
+    spans = tracing.drain_spans()
+    if not spans:
+        return None
+    by_phase = {}
+    for s in spans:
+        by_phase.setdefault(s["phase"], []).append(s["t1"] - s["t0"])
+    phases = {}
+    for phase, durs in sorted(by_phase.items()):
+        arr = np.asarray(durs)
+        phases[phase] = {
+            "count": int(arr.size),
+            "total_ms": round(float(arr.sum()) * 1e3, 3),
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 4),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 4),
+        }
+    return {
+        "spans": len(spans),
+        "phases": phases,
+        "timeline": tracing.chrome_events(spans)[:cap],
+    }
+
+
+def _attach_flight(configs, name):
+    """Drain the ring into ``configs[name]["flight"]`` (skip errored configs,
+    but still drain so their spans don't bleed into the next config)."""
+    flight = _drain_flight()
+    entry = configs.get(name)
+    if flight is not None and isinstance(entry, dict) and "error" not in entry:
+        entry["flight"] = flight
+
+
 def main():
     # probe the backend first: the accelerator tunnel can wedge in a way that blocks
     # backend init forever, and a benchmark that never prints is worse than a CPU number
@@ -946,8 +989,12 @@ def main():
                     rl["hbm_util"] = round(rf["bytes"] / t_ours / peaks[1], 4)
                 configs[name]["roofline"] = rl
             ours_times.append(t_ours)
+            flight = _drain_flight()
+            if flight is not None:
+                configs[name]["flight"] = flight
         except Exception as err:  # noqa: BLE001 — a failed config must not kill the bench line
             configs[name] = {"error": f"{type(err).__name__}: {err}"}
+            _drain_flight()  # don't bleed this config's spans into the next
     # Extras (outside the 5-config geomean, for round-over-round comparability):
     # config 3 through the on-device fused single-pass sort — the path that runs
     # on TPU, where the host-callback argsort is disabled (round-4 VERDICT weak #3).
@@ -965,6 +1012,7 @@ def main():
             configs["retrieval_device_sort"]["speedup"] = round(t_ref_dev / t_dev, 3)
     except Exception as err:  # noqa: BLE001
         configs["retrieval_device_sort"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "retrieval_device_sort")
     # the replica engine vs our own loop fallback: meaningful with or without torch
     try:
         t_eng, t_loop, what = bench_bootstrap(with_ref=with_ref)
@@ -976,26 +1024,31 @@ def main():
         }
     except Exception as err:  # noqa: BLE001
         configs["bootstrap"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "bootstrap")
     # the fleet engine: multi-tenant dispatch economy at 10k concurrent streams
     try:
         configs["fleet"] = bench_fleet(with_ref=with_ref)
     except Exception as err:  # noqa: BLE001
         configs["fleet"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "fleet")
     # durability: checkpoint + crash + restore + WAL replay at 1k streams
     try:
         configs["recovery"] = bench_recovery(with_ref=with_ref)
     except Exception as err:  # noqa: BLE001
         configs["recovery"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "recovery")
     # sketch metrics: accuracy-vs-memory at 2^20 streamed elements
     try:
         configs["sketches"] = bench_sketches(with_ref=with_ref)
     except Exception as err:  # noqa: BLE001
         configs["sketches"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "sketches")
     # AOT executable cache: first-update wall, cold compile+serialize vs warm reload
     try:
         configs["cold_start"] = bench_cold_start(with_ref=with_ref)
     except Exception as err:  # noqa: BLE001
         configs["cold_start"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "cold_start")
     snap = observe.snapshot()
     if with_ref:
         geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups)) if speedups else -1.0

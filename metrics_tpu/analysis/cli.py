@@ -78,16 +78,21 @@ _PASSES: Dict[str, Dict[str, object]] = {
 }
 
 # dynamic passes: no rule codes, run programs instead of parsing them.
-# Ordered cheap-first for --all (donation ~10s of tiny CPU jits, aot compiles
-# each cacheable class twice — once AOT to disk, once as the fresh oracle —
-# fleet churns a 4-slot StreamEngine bucket per class, chaos injects the full
-# fault suite per class, perf lowers the whole registry + runs the fleet smoke).
-_DYNAMIC = ("donation", "aot", "fleet", "chaos", "perf")
+# Ordered cheap-first for --all (telemetry is one compile + ~1k tiny steps,
+# donation ~10s of tiny CPU jits, aot compiles each cacheable class twice —
+# once AOT to disk, once as the fresh oracle — fleet churns a 4-slot
+# StreamEngine bucket per class, chaos injects the full fault suite per
+# class, perf lowers the whole registry + runs the fleet smoke).
+_DYNAMIC = ("telemetry", "donation", "aot", "fleet", "chaos", "perf")
 
 
 def _dynamic_runner(name: str):
     """Resolve a dynamic pass's ``run_*_check`` lazily (each imports jax and
     builds the metric registry; keep plain lint invocations light)."""
+    if name == "telemetry":
+        from metrics_tpu.observe.overhead import run_telemetry_check  # noqa: PLC0415
+
+        return run_telemetry_check
     if name == "perf":
         from metrics_tpu.observe.profile import run_perf_check  # noqa: PLC0415
 
@@ -124,8 +129,8 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=sorted([*_PASSES, *_DYNAMIC]),
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
-                   help="run every pass (jitlint + distlint + donlint + donation + aot "
-                        "+ fleet + chaos + perf) in one invocation")
+                   help="run every pass (jitlint + distlint + donlint + telemetry "
+                        "+ donation + aot + fleet + chaos + perf) in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")

@@ -48,6 +48,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 
 from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _trace
 from metrics_tpu.utils.io import atomic_write_chunks, fsync_directory
 
 __all__ = [
@@ -252,8 +253,9 @@ def lookup(key: Any, label: str) -> Optional[Tuple[Any, bool]]:
         _observe.note_aot_miss(label)
         return None
     try:
-        header, payload = read_entry(path, digest)
-        loaded = deserialize_executable(payload)
+        with _trace.span("aot", f"load:{label}"):
+            header, payload = read_entry(path, digest)
+            loaded = deserialize_executable(payload)
     except StaleEntryError as exc:
         _STALE_DIGESTS.add(digest)
         _observe.note_aot_stale(label, str(exc))
@@ -278,9 +280,10 @@ def store(key: Any, compiled: Any, donate: bool, label: str) -> bool:
         return False
     digest = entry_digest(key)
     try:
-        payload = serialize_executable(compiled)
-        os.makedirs(_CACHE_DIR, exist_ok=True)
-        nbytes = write_entry(os.path.join(_CACHE_DIR, digest + _SUFFIX), digest, label, donate, payload)
+        with _trace.span("aot", f"store:{label}"):
+            payload = serialize_executable(compiled)
+            os.makedirs(_CACHE_DIR, exist_ok=True)
+            nbytes = write_entry(os.path.join(_CACHE_DIR, digest + _SUFFIX), digest, label, donate, payload)
     except Exception as exc:
         _observe.record_event("aot_store_failed", metric=label, error=type(exc).__name__, detail=str(exc)[:200])
         return False

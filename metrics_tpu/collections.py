@@ -29,6 +29,7 @@ from metrics_tpu.metric import Metric
 from jax import Array
 
 from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _tracing
 from metrics_tpu.utils.data import _flatten_dict
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -371,7 +372,9 @@ class MetricCollection:
             lm.__dict__["_state_escaped"] = False
             lm.__dict__["_group_shared"] = False
         if rec is not None:
-            rec.add_time("fused_update", str(len(leaders)), _observe.clock() - t0)
+            t1 = _observe.clock()
+            rec.add_time("fused_update", str(len(leaders)), t1 - t0)
+            _tracing.record_complete("fused_update", str(len(leaders)), t0, t1)
             rec.add_count("fused_dispatch", str(len(leaders)))
             if entry.donate:
                 rec.add_count("fused_donated", str(len(leaders)))

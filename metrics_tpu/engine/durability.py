@@ -51,6 +51,7 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _trace
 from metrics_tpu.resilience.checkpoint import (
     CheckpointError,
     CorruptCheckpointError,
@@ -85,9 +86,14 @@ class IngestWAL:
     def __init__(self, path: Union[str, os.PathLike]) -> None:
         self.path = os.fspath(path)
         fresh = not os.path.exists(self.path) or os.path.getsize(self.path) == 0
+        # byte ledger kept explicitly: tell() on a buffered append handle lies
+        # about unsynced writes, and size_bytes() must include them (they are
+        # real replay lag the moment the next sync lands)
+        self._nbytes = 0 if fresh else os.path.getsize(self.path)
         self._fh = open(self.path, "ab")
         if fresh:
             self._fh.write(WAL_MAGIC)
+            self._nbytes = len(WAL_MAGIC)
             self.sync()
             fsync_directory(os.path.dirname(os.path.abspath(self.path)))
 
@@ -100,6 +106,12 @@ class IngestWAL:
         rec = pickle.dumps((kind, seq, sid, payload), protocol=_PICKLE)
         self._fh.write(_FRAME.pack(len(rec), zlib.crc32(rec) & 0xFFFFFFFF))
         self._fh.write(rec)
+        self._nbytes += _FRAME.size + len(rec)
+
+    def size_bytes(self) -> int:
+        """Journal record bytes (magic header excluded), counting buffered
+        not-yet-synced appends — the byte volume a restore would replay."""
+        return max(0, self._nbytes - len(WAL_MAGIC))
 
     def sync(self) -> None:
         """Flush buffered frames and fsync: everything appended so far is durable."""
@@ -110,20 +122,22 @@ class IngestWAL:
         """Atomically rewrite the journal with only the frames whose seq passes
         ``keep``; returns how many records were kept. Torn trailing frames (if
         any) are dropped — they were never durable records."""
-        self.sync()
-        records, _torn = self.read_records(self.path)
-        kept = [r for r in records if keep(r[1])]
-        chunks: List[bytes] = [WAL_MAGIC]
-        for rec_tuple in kept:
-            rec = pickle.dumps(rec_tuple, protocol=_PICKLE)
-            chunks.append(_FRAME.pack(len(rec), zlib.crc32(rec) & 0xFFFFFFFF))
-            chunks.append(rec)
-        self._fh.close()
-        try:
-            atomic_write_chunks(self.path, chunks)
-        finally:
-            self._fh = open(self.path, "ab")
-        return len(kept)
+        with _trace.span("wal", "truncate"):
+            self.sync()
+            records, _torn = self.read_records(self.path)
+            kept = [r for r in records if keep(r[1])]
+            chunks: List[bytes] = [WAL_MAGIC]
+            for rec_tuple in kept:
+                rec = pickle.dumps(rec_tuple, protocol=_PICKLE)
+                chunks.append(_FRAME.pack(len(rec), zlib.crc32(rec) & 0xFFFFFFFF))
+                chunks.append(rec)
+            self._fh.close()
+            try:
+                atomic_write_chunks(self.path, chunks)
+            finally:
+                self._fh = open(self.path, "ab")
+            self._nbytes = sum(len(c) for c in chunks)
+            return len(kept)
 
     def close(self) -> None:
         if self._fh is not None and not self._fh.closed:
@@ -199,6 +213,13 @@ def save_fleet_checkpoint(
     journal (used when writing a speculative/secondary snapshot that older
     checkpoints may still need to recover past).
     """
+    with _trace.span("ckpt", "save"):
+        return _save_fleet_checkpoint(engine, path, truncate_wal)
+
+
+def _save_fleet_checkpoint(
+    engine: Any, path: Union[str, os.PathLike], truncate_wal: bool
+) -> str:
     path = os.fspath(path)
     if engine._wal is not None:
         engine._wal.sync()  # the snapshot must never be ahead of the journal
@@ -252,6 +273,10 @@ def save_fleet_checkpoint(
     if truncate_wal and engine._wal is not None:
         kept = engine._wal.truncate(lambda seq: not engine._is_applied(seq))
         _observe.note_wal_truncate("engine", kept)
+    # durability-lag watermark (stats()/observe wal_lag_*): the snapshot covers
+    # exactly the applied records, so lag counts what only the journal holds
+    engine._ckpt_applied_seq = engine._applied_seq + len(engine._applied_above)
+    engine._last_ckpt_time = _observe.clock()
     return path
 
 
@@ -328,6 +353,13 @@ def restore_fleet_checkpoint(
     sequence order with their original seqs — replayed submissions land in the
     normal ingest queues for the next tick. Returns ``engine``.
     """
+    with _trace.span("ckpt", "restore"):
+        return _restore_fleet_checkpoint(engine, path, wal_path)
+
+
+def _restore_fleet_checkpoint(
+    engine: Any, path: Union[str, os.PathLike], wal_path: Optional[Union[str, os.PathLike]]
+) -> Any:
     from metrics_tpu.engine.stream import _Bucket, _Session
 
     path = os.fspath(path)
@@ -431,6 +463,7 @@ def restore_fleet_checkpoint(
     # ---- replay the journal, original seqs ----
     n_replayed = 0
     if wal_path is not None and os.path.exists(os.fspath(wal_path)):
+        t0_replay = _observe.clock()
         records, _torn = IngestWAL.read_records(wal_path)
         engine._replaying = True
         try:
@@ -467,6 +500,7 @@ def restore_fleet_checkpoint(
                 n_replayed += 1
         finally:
             engine._replaying = False
+        _trace.record_complete("wal", "replay", t0_replay, _observe.clock())
         _observe.note_wal_replay("engine", n_replayed)
     if wal_path is not None:
         engine._wal = IngestWAL(wal_path)
@@ -474,6 +508,10 @@ def restore_fleet_checkpoint(
         # repair: drop applied records and any torn tail the crash left behind,
         # so future appends land on an intact journal
         engine._wal.truncate(lambda seq: not engine._is_applied(seq))
+    # the freshly installed snapshot covers every applied record; replayed
+    # submissions still queued count as lag until the next checkpoint
+    engine._ckpt_applied_seq = engine._applied_seq + len(engine._applied_above)
+    engine._last_ckpt_time = _observe.clock()
     _observe.note_checkpoint_restore("StreamEngine", path)
     _observe.note_fleet_restore("engine", len(engine._sessions), n_replayed)
     return engine

@@ -70,6 +70,7 @@ import numpy as np
 from metrics_tpu.engine.core import _FLEET_JIT_CACHE, TRACER_ERRORS, engine_compute, engine_update
 from metrics_tpu.metric import Metric, _squeeze_if_scalar
 from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _trace
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
 
 __all__ = ["StreamEngine"]
@@ -228,6 +229,8 @@ class StreamEngine:
         self._applied_above: Set[int] = set()  # applied out of order, above the watermark
         self._replaying = False  # WAL replay in flight: do not re-journal
         self._ckpt_cache: Dict[Any, Tuple[int, bytes]] = {}  # bucket key -> (version, node bytes)
+        self._ckpt_applied_seq = 0  # applied watermark covered by the last checkpoint
+        self._last_ckpt_time: Optional[float] = None  # observe.clock() at last save/restore
         self._wal = None
         self._wal_path = wal_path
         if wal_path is not None:
@@ -370,17 +373,38 @@ class StreamEngine:
 
     def tick(self) -> int:
         """Flush every pending queue; returns the number of XLA update dispatches."""
-        dispatches = self._flush_pending()
+        with _trace.span("tick", "engine"):
+            dispatches = self._flush_pending()
         self._ticks += 1
         _observe.note_fleet_tick(dispatches)
         self._publish_gauges()
+        if _observe.ENABLED:
+            self._record_sample(dispatches)
         return dispatches
+
+    def _record_sample(self, dispatches: int) -> None:
+        """One rolling time-series sample of fleet health (telemetry on only)."""
+        active = sum(b.active() for b in self._buckets.values())
+        capacity = sum(b.capacity for b in self._buckets.values())
+        lag_records, lag_bytes = self._wal_lag()
+        _observe.note_fleet_sample(
+            tick=self._ticks,
+            sessions=len(self._sessions),
+            rows_active=active,
+            rows_capacity=capacity,
+            occupancy_pct=100.0 * active / capacity if capacity else None,
+            dispatches=dispatches,
+            wal_lag_records=lag_records,
+            wal_lag_bytes=lag_bytes,
+            quarantined=sum(1 for s in self._sessions.values() if s.health == "quarantined"),
+        )
 
     def _flush_pending(self) -> int:
         if self._wal is not None and not self._replaying:
             # durability point: every record whose effect is about to land must
             # be on disk first, so recovery can always redo this flush
-            self._wal.sync()
+            with _trace.span("wal", "sync"):
+                self._wal.sync()
         dispatches = 0
         for bucket in list(self._buckets.values()):
             if bucket.queue:
@@ -422,17 +446,22 @@ class StreamEngine:
         quarantine — in every case the rest of the bucket keeps its rows, its
         compiled program, and its one-dispatch-per-tick economy.
         """
+        with _trace.span("flush", bucket.label):
+            return self._flush_bucket_traced(bucket)
+
+    def _flush_bucket_traced(self, bucket: _Bucket) -> int:
         queue, bucket.queue = bucket.queue, []
         _observe.note_fleet_flush(bucket.label)
         # wave = how many earlier submissions this slot already has in the queue;
         # grouping on (wave, signature) keeps per-session ordering while letting
         # every first-submission-per-slot coalesce into one dispatch
-        seen: Dict[int, int] = {}
-        groups: "OrderedDict[Tuple[int, Any], List[int]]" = OrderedDict()
-        for idx, (slot, _seq, args, kwargs) in enumerate(queue):
-            wave = seen.get(slot, 0)
-            seen[slot] = wave + 1
-            groups.setdefault((wave, _submission_sig(args, kwargs)), []).append(idx)
+        with _trace.span("ingest", bucket.label):
+            seen: Dict[int, int] = {}
+            groups: "OrderedDict[Tuple[int, Any], List[int]]" = OrderedDict()
+            for idx, (slot, _seq, args, kwargs) in enumerate(queue):
+                wave = seen.get(slot, 0)
+                seen[slot] = wave + 1
+                groups.setdefault((wave, _submission_sig(args, kwargs)), []).append(idx)
         dispatches = 0
         done: Set[int] = set()
         dead_slots: Set[int] = set()  # slots whose sessions left the bucket mid-flush
@@ -456,12 +485,14 @@ class StreamEngine:
                 continue
             subs = [queue[i] for i in live]
             try:
-                stacked_args, stacked_kwargs, mask = self._stage(bucket, subs)
-                new_stacked = engine_update(
-                    bucket.template, bucket.capacity, bucket.stacked,
-                    stacked_args, stacked_kwargs, mask=mask,
-                    cache=_FLEET_JIT_CACHE, label=bucket.label,
-                )
+                with _trace.span("wave_assembly", bucket.label):
+                    stacked_args, stacked_kwargs, mask = self._stage(bucket, subs)
+                with _trace.span("dispatch", bucket.label):
+                    new_stacked = engine_update(
+                        bucket.template, bucket.capacity, bucket.stacked,
+                        stacked_args, stacked_kwargs, mask=mask,
+                        cache=_FLEET_JIT_CACHE, label=bucket.label,
+                    )
             except TRACER_ERRORS as exc:
                 # trace failure aborts before execution (stacked buffers intact):
                 # demote ONLY this wave's sessions to loose and replay their
@@ -662,10 +693,11 @@ class StreamEngine:
             return bucket.computed
         if not bucket.compute_eager:
             try:
-                values = engine_compute(
-                    bucket.template, bucket.capacity, bucket.stacked,
-                    cache=_FLEET_JIT_CACHE, label=f"{bucket.label}:compute",
-                )
+                with _trace.span("fleet_compute", bucket.label):
+                    values = engine_compute(
+                        bucket.template, bucket.capacity, bucket.stacked,
+                        cache=_FLEET_JIT_CACHE, label=f"{bucket.label}:compute",
+                    )
             except TRACER_ERRORS as exc:
                 bucket.compute_eager = True
                 _observe.note_fleet_fallback(f"{bucket.label}:compute", exc)
@@ -689,7 +721,8 @@ class StreamEngine:
         if session_id not in self._sessions:
             raise KeyError(f"unknown or expired session {session_id!r}")
         seq = self._log("expire", session_id)
-        metric = self._apply_expire(session_id)
+        with _trace.span("expire", "engine"):
+            metric = self._apply_expire(session_id)
         self._mark_applied(seq)
         return metric
 
@@ -796,9 +829,28 @@ class StreamEngine:
         return engine
 
     # ------------------------------------------------------------------ telemetry
+    def _wal_lag(self) -> Tuple[int, int]:
+        """(records, bytes) of durability lag: ingest records sequenced beyond
+        the last checkpoint's applied watermark, and the journal bytes that a
+        restore would have to replay. An engine running without a WAL has no
+        journal to lag — (0, 0) — so dashboards don't alarm on a configuration
+        choice; without any checkpoint everything in the journal lags."""
+        if self._wal is None:
+            return 0, 0
+        records = max(0, self._seq - self._ckpt_applied_seq)
+        return records, self._wal.size_bytes()
+
+    def _last_ckpt_age_s(self) -> Optional[float]:
+        """Seconds since the last checkpoint save/restore; None if never."""
+        if self._last_ckpt_time is None:
+            return None
+        return max(0.0, _observe.clock() - self._last_ckpt_time)
+
     def stats(self) -> Dict[str, Any]:
         """Occupancy/fragmentation/pad-waste/health per bucket plus fleet totals
-        (also pushed as ``fleet_*`` observe gauges when telemetry is enabled)."""
+        and durability lag (``wal_lag_records``/``wal_lag_bytes``/
+        ``last_ckpt_age_s``) — also pushed as ``fleet_*``/``wal_*`` observe
+        gauges when telemetry is enabled."""
         buckets: Dict[str, Dict[str, Any]] = {}
         tot_active = tot_capacity = tot_bytes = tot_bytes_active = 0
         for bucket in self._buckets.values():
@@ -823,6 +875,7 @@ class StreamEngine:
             tot_bytes_active += bytes_active
         loose = sum(1 for s in self._sessions.values() if s.bucket is None)
         quarantined = sum(1 for s in self._sessions.values() if s.health == "quarantined")
+        lag_records, lag_bytes = self._wal_lag()
         self._publish_gauges()
         return {
             "buckets": buckets,
@@ -836,6 +889,9 @@ class StreamEngine:
             "rows_capacity": tot_capacity,
             "occupancy_pct": 100.0 * tot_active / tot_capacity if tot_capacity else None,
             "pad_waste_pct": 100.0 * (tot_bytes - tot_bytes_active) / tot_bytes if tot_bytes else None,
+            "wal_lag_records": lag_records,
+            "wal_lag_bytes": lag_bytes,
+            "last_ckpt_age_s": self._last_ckpt_age_s(),
         }
 
     def _publish_gauges(self) -> None:
@@ -851,3 +907,5 @@ class StreamEngine:
                 bucket.capacity * bucket.row_bytes,
                 active * bucket.row_bytes,
             )
+        lag_records, lag_bytes = self._wal_lag()
+        _observe.note_wal_gauges("engine", lag_records, lag_bytes, self._last_ckpt_age_s())

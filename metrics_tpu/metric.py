@@ -46,6 +46,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _tracing
 from metrics_tpu.utils.data import _flatten, dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 from metrics_tpu.utils.exceptions import TPUMetricsUserError, TraceIneligibleError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -805,7 +806,9 @@ class Metric(ABC):
             raise
         if rec is not None:
             name = type(self).__name__
-            rec.add_time("update", name, _observe.clock() - t0)
+            t1 = _observe.clock()
+            rec.add_time("update", name, t1 - t0)
+            _tracing.record_complete("update", name, t0, t1)
             rec.add_count("update_" + path, name)
             if donated:
                 rec.add_count("update_donated", name)
@@ -859,7 +862,9 @@ class Metric(ABC):
             value = self._compute_impl()
             value = _squeeze_if_scalar(value)
         if rec is not None:
-            rec.add_time("compute", type(self).__name__, _observe.clock() - t0)
+            t1 = _observe.clock()
+            rec.add_time("compute", type(self).__name__, t1 - t0)
+            _tracing.record_complete("compute", type(self).__name__, t0, t1)
         if self.compute_with_cache:
             self._computed = value
         return value
@@ -972,7 +977,9 @@ class Metric(ABC):
         # list-cat keeps aliases into the incoming state (list states never donate)
         self.__dict__["_state_escaped"] = self._has_list_state()
         if rec is not None:
-            rec.add_time("merge", type(self).__name__, _observe.clock() - t0)
+            t1 = _observe.clock()
+            rec.add_time("merge", type(self).__name__, t1 - t0)
+            _tracing.record_complete("merge", type(self).__name__, t0, t1)
             rec.add_count("merge", type(self).__name__)
         self._update_count = own_count + incoming_count
         self._computed = None  # merged state invalidates any cached compute
@@ -1071,10 +1078,14 @@ class Metric(ABC):
             self._is_synced = True
             _observe.note_sync_degraded(type(self).__name__, exc, len(survivors))
             if rec is not None:
-                rec.add_time("sync", type(self).__name__, _observe.clock() - t0)
+                t1 = _observe.clock()
+                rec.add_time("sync", type(self).__name__, t1 - t0)
+                _tracing.record_complete("sync", type(self).__name__, t0, t1)
             return
         if rec is not None:
-            rec.add_time("sync", type(self).__name__, _observe.clock() - t0)
+            t1 = _observe.clock()
+            rec.add_time("sync", type(self).__name__, t1 - t0)
+            _tracing.record_complete("sync", type(self).__name__, t0, t1)
             rec.add_count("sync", type(self).__name__)
         self._is_synced = True
 

@@ -1,13 +1,21 @@
-"""metrics_tpu.observe — runtime telemetry and XLA cost profiling (DESIGN §11).
+"""metrics_tpu.observe — runtime telemetry and XLA cost profiling (DESIGN §11, §19).
 
 The third subsystem of the tooling triad (correctness → jitlint, distribution
-→ distlint, performance → observe). Two halves:
+→ distlint, performance → observe). Three parts:
 
 * **runtime half** (:mod:`metrics_tpu.observe.recorder`) — near-zero-overhead
   counters/timers/structured events the core runtime reports into: per-metric
   update/compute wall time, jit compile count vs. cache hits/evictions,
   retrace causes, eager-fallback latches with the triggering exception, and
   sync/merge timings. Off by default; one flag check per hot path when off.
+* **flight recorder** (:mod:`metrics_tpu.observe.tracing` +
+  :mod:`metrics_tpu.observe.latency`) — nested host-side spans over the whole
+  hot path (engine tick phases, update/compute/merge/sync, checkpoint/WAL,
+  AOT load/store) in a bounded ring, each span folded into per-(phase, label)
+  DDSketch latency histograms. Export as Chrome-trace JSON
+  (:func:`timeline`), Prometheus quantile families (:func:`prometheus`), or
+  fleet-merged quantiles (:func:`sync_telemetry`); ``tools/fleet_top.py``
+  renders the live health report.
 * **static half** (:mod:`metrics_tpu.observe.costs` +
   :mod:`metrics_tpu.observe.profile`) — XLA cost profiling via
   ``jax.jit(update).lower(...).cost_analysis()`` over the jit-eligible
@@ -18,15 +26,17 @@ The third subsystem of the tooling triad (correctness → jitlint, distribution
 Quick start::
 
     from metrics_tpu import observe
-    observe.enable()
-    ...  # run your eval loop
-    print(observe.snapshot()["derived"])   # compile counts, cache hit rate, ...
-    print(observe.prometheus())            # Prometheus text exposition
+    with observe.scope():                  # or observe.enable()
+        ...  # run your eval loop
+        print(observe.snapshot()["latency"])   # DDSketch p50/p99 per phase
+        json.dump(observe.timeline(), open("trace.json", "w"))  # chrome://tracing
 
-``costs``/``profile`` load lazily (PEP 562) so the core runtime's unconditional
-``observe.recorder`` import stays free of jax-tracing machinery.
+``costs``/``profile`` load lazily (PEP 562) so the import of this package
+stays free of jax-tracing machinery; ``overhead`` hosts the disabled-mode
+overhead smoke behind ``tools/lint_metrics.py --all``.
 """
 
+from metrics_tpu.observe.latency import sync_telemetry
 from metrics_tpu.observe.recorder import (
     RECORDER,
     Recorder,
@@ -36,27 +46,36 @@ from metrics_tpu.observe.recorder import (
     prometheus,
     record_event,
     reset,
+    scope,
     snapshot,
     snapshot_json,
 )
+from metrics_tpu.observe.tracing import drain_spans, record_complete, span, timeline
 
-# submodules (costs/profile/recorder) resolve via __getattr__ below; they are
-# deliberately absent from __all__ — JL006 requires every listed name be bound
-# at module top level, and binding them eagerly would defeat the lazy import
+# submodules (costs/profile/recorder/...) resolve via __getattr__ below; they
+# are deliberately absent from __all__ — JL006 requires every listed name be
+# bound at module top level, and binding them eagerly would defeat the lazy
+# import
 __all__ = [
     "RECORDER",
     "Recorder",
     "disable",
+    "drain_spans",
     "enable",
     "enabled",
     "prometheus",
+    "record_complete",
     "record_event",
     "reset",
+    "scope",
     "snapshot",
     "snapshot_json",
+    "span",
+    "sync_telemetry",
+    "timeline",
 ]
 
-_LAZY_SUBMODULES = ("costs", "profile", "recorder")
+_LAZY_SUBMODULES = ("costs", "latency", "overhead", "profile", "recorder", "tracing")
 
 
 def __getattr__(name):

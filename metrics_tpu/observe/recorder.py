@@ -56,6 +56,7 @@ __all__ = [
     "note_fleet_quarantine",
     "note_fleet_restore",
     "note_fleet_row_replay",
+    "note_fleet_sample",
     "note_fleet_session",
     "note_fleet_tick",
     "note_fused_compile",
@@ -69,11 +70,13 @@ __all__ = [
     "note_replica_fallback",
     "note_replica_hit",
     "note_wal_append",
+    "note_wal_gauges",
     "note_wal_replay",
     "note_wal_truncate",
     "prometheus",
     "record_event",
     "reset",
+    "scope",
     "set_fleet_gauges",
     "snapshot",
     "snapshot_json",
@@ -104,15 +107,26 @@ class Recorder:
     """Holds all telemetry. Internal containers start empty and stay empty while
     disabled (the zero-allocation half of the overhead contract)."""
 
-    __slots__ = ("counters", "timers", "events", "gauges", "max_events", "_seq", "_compiled", "_evicted", "_lock")
+    __slots__ = (
+        "counters", "timers", "events", "gauges", "spans", "series", "latency",
+        "max_events", "max_spans", "_seq", "_span_total", "_compiled", "_evicted", "_lock",
+    )
 
-    def __init__(self, max_events: int = 1024) -> None:
+    def __init__(self, max_events: int = 1024, max_spans: int = 4096) -> None:
         self.counters: Dict[Tuple[str, str], int] = {}
         self.timers: Dict[Tuple[str, str], List[float]] = {}  # [count, total, min, max]
         self.events: Deque[Dict[str, Any]] = deque(maxlen=max_events)
         self.gauges: Dict[Tuple[str, str], float] = {}  # last-write-wins levels
+        # flight recorder (DESIGN §19): bounded span ring (observe/tracing.py),
+        # rolling fleet time-series samples, and per-(phase, label) DDSketch
+        # latency histograms (observe/latency.py HostDDSketch instances)
+        self.spans: Deque[Dict[str, Any]] = deque(maxlen=max_spans)
+        self.series: Deque[Dict[str, Any]] = deque(maxlen=512)
+        self.latency: Dict[Tuple[str, str], Any] = {}
         self.max_events = max_events
+        self.max_spans = max_spans
         self._seq = 0
+        self._span_total = 0
         self._compiled: Dict[str, int] = {}  # metric class -> distinct shared compiles
         self._evicted: set = set()  # metric classes whose executables were evicted
         self._lock = threading.Lock()
@@ -150,7 +164,11 @@ class Recorder:
             self.timers.clear()
             self.events.clear()
             self.gauges.clear()
+            self.spans.clear()
+            self.series.clear()
+            self.latency.clear()
             self._seq = 0
+            self._span_total = 0
             self._compiled.clear()
             self._evicted.clear()
 
@@ -167,14 +185,15 @@ RECORDER = Recorder()
 
 
 # ---------------------------------------------------------------------- lifecycle
-def enable(max_events: int = 1024, reset: bool = False) -> None:
+def enable(max_events: int = 1024, reset: bool = False, max_spans: int = 4096) -> None:
     """Turn telemetry collection on (counters/timers/events start accumulating).
 
     ``enable()`` alone keeps whatever was already recorded — re-enabling
     mid-run must not destroy data. Pass ``reset=True`` to start from zero
     counters in one call (the shape every counter-asserting test fixture
     wants; stale counters from a previous test otherwise satisfy or break
-    assertions at random).
+    assertions at random). ``max_spans`` bounds the flight-recorder span ring
+    (observe/tracing.py) the same way ``max_events`` bounds the event log.
     """
     global ENABLED
     if reset:
@@ -182,6 +201,9 @@ def enable(max_events: int = 1024, reset: bool = False) -> None:
     RECORDER.max_events = max_events
     if RECORDER.events.maxlen != max_events:
         RECORDER.events = deque(RECORDER.events, maxlen=max_events)
+    RECORDER.max_spans = max_spans
+    if RECORDER.spans.maxlen != max_spans:
+        RECORDER.spans = deque(RECORDER.spans, maxlen=max_spans)
     ENABLED = True
 
 
@@ -189,6 +211,43 @@ def disable() -> None:
     """Turn telemetry collection off (recorded data is kept until :func:`reset`)."""
     global ENABLED
     ENABLED = False
+
+
+class scope:
+    """``with observe.scope(reset=True): ...`` — telemetry on for one block.
+
+    The context-manager form of the ``enable(reset=True)`` / ``disable()`` /
+    ``reset(include_warnings=True)`` dance every test fixture used to spell by
+    hand. Enter clears recorded data (when ``reset``, re-arming the one-time
+    fallback warnings too) and enables collection; exit restores the prior
+    enabled state and clears again so nothing recorded inside leaks into the
+    next test. Pass ``reset=False`` to accumulate into existing data and keep
+    it on exit (the mid-run inspection shape).
+    """
+
+    __slots__ = ("_reset", "_max_events", "_max_spans", "_prior")
+
+    def __init__(self, reset: bool = True, max_events: int = 1024, max_spans: int = 4096) -> None:
+        self._reset = reset
+        self._max_events = max_events
+        self._max_spans = max_spans
+        self._prior: Optional[bool] = None
+
+    def __enter__(self) -> "Recorder":
+        self._prior = ENABLED
+        if self._reset:
+            RECORDER.clear()
+            _FALLBACK_WARNED.clear()
+        enable(max_events=self._max_events, max_spans=self._max_spans)
+        return RECORDER
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        global ENABLED
+        ENABLED = bool(self._prior)
+        if self._reset:
+            RECORDER.clear()
+            _FALLBACK_WARNED.clear()
+        return False
 
 
 def enabled() -> bool:
@@ -436,6 +495,29 @@ def set_fleet_gauges(
         RECORDER.set_gauge("fleet_bytes_active", label, bytes_active)
 
 
+def note_wal_gauges(label: str, lag_records: int, lag_bytes: int, ckpt_age_s: Optional[float]) -> None:
+    """Publish one engine's durability lag: WAL records/bytes accumulated since
+    the last fleet checkpoint, and that checkpoint's age (omitted when the
+    engine has never checkpointed)."""
+    if ENABLED:
+        RECORDER.set_gauge("wal_lag_records", label, lag_records)
+        RECORDER.set_gauge("wal_lag_bytes", label, lag_bytes)
+        if ckpt_age_s is not None:
+            RECORDER.set_gauge("last_ckpt_age_s", label, ckpt_age_s)
+
+
+def note_fleet_sample(**fields: Any) -> None:
+    """Append one tick sample to the rolling fleet time-series ring.
+
+    The StreamEngine calls this once per tick (telemetry on) with its health
+    levels — sessions, occupancy, dispatches, WAL lag, quarantine count — so
+    ``tools/fleet_top.py`` can render rates from consecutive samples without
+    an external scrape loop. The ring is bounded (512 samples)."""
+    if ENABLED:
+        with RECORDER._lock:
+            RECORDER.series.append({"t": clock(), **fields})
+
+
 # resilience hooks (metric.py transactional updates, resilience/, parallel/sync.py)
 def note_update_rollback(metric: str, exc: BaseException) -> None:
     if ENABLED:
@@ -486,6 +568,9 @@ def snapshot() -> Dict[str, Any]:
          "timers":   {name: {label: {"count", "total_s", "mean_s", "min_s", "max_s"}}},
          "events":   [{"seq", "kind", ...}, ...],
          "gauges":   {name: {label: float}},
+         "latency":  {phase: {label: {"count", "total_s", "mean_s", "min_s",
+                      "max_s", "p50_s", "p90_s", "p99_s", "p999_s"}}},
+         "series":   [{"t", ...fleet sample fields...}, ...],
          "derived":  {"jit_cache_hit_rate": float|None,
                       "jit_compiles_total": int, "jit_cache_hits_total": int,
                       "jit_cache_evictions_total": int, "eager_fallbacks_total": int,
@@ -503,14 +588,28 @@ def snapshot() -> Dict[str, Any]:
                       "wal_records_replayed_total": int,
                       "aot_hits_total": int, "aot_misses_total": int,
                       "aot_stale_total": int, "aot_stores_total": int,
-                      "aot_hit_rate": float|None}}
+                      "aot_hit_rate": float|None,
+                      "spans_total": int,
+                      "wal_lag_records": int, "wal_lag_bytes": int}}
 
     The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
     buckets: occupancy is live rows over padded capacity, pad waste is the
     byte-weighted share of stacked state bytes held by padding rows, and
     dispatches-per-flush is the engine's per-bucket-per-tick dispatch economy
-    (1.0 = every flushed bucket cost exactly one XLA dispatch).
+    (1.0 = every flushed bucket cost exactly one XLA dispatch). ``latency`` is
+    the flight recorder's DDSketch-backed per-(phase, label) summaries
+    (observe/latency.py) and ``series`` the rolling fleet sample ring;
+    ``spans_total`` counts every span ever recorded (the span ring itself is
+    bounded and exported by ``observe.timeline()``, not here). The
+    ``wal_lag_*`` deriveds sum the durability-lag gauges across engines.
     """
+    if RECORDER.latency:
+        # lazy: latency.py pulls in numpy, which this stdlib-only module must not
+        from metrics_tpu.observe.latency import snapshot_latency
+
+        latency = snapshot_latency()
+    else:
+        latency = {}
     with RECORDER._lock:
         counters: Dict[str, Dict[str, int]] = {}
         for (name, label), v in RECORDER.counters.items():
@@ -528,6 +627,8 @@ def snapshot() -> Dict[str, Any]:
         gauges: Dict[str, Dict[str, float]] = {}
         for (name, label), g in RECORDER.gauges.items():
             gauges.setdefault(name, {})[label] = g
+        series = list(RECORDER.series)
+        span_total = RECORDER._span_total
     compiles = sum(counters.get("jit_compile", {}).values())
     hits = sum(counters.get("jit_cache_hit", {}).values())
     lookups = compiles + hits
@@ -546,6 +647,8 @@ def snapshot() -> Dict[str, Any]:
         "timers": {k: dict(sorted(v.items())) for k, v in sorted(timers.items())},
         "events": events,
         "gauges": {k: dict(sorted(v.items())) for k, v in sorted(gauges.items())},
+        "latency": latency,
+        "series": series,
         "derived": {
             "jit_cache_hit_rate": (hits / lookups) if lookups else None,
             "jit_compiles_total": compiles,
@@ -573,6 +676,9 @@ def snapshot() -> Dict[str, Any]:
             "aot_stale_total": sum(counters.get("aot_stale", {}).values()),
             "aot_stores_total": sum(counters.get("aot_store", {}).values()),
             "aot_hit_rate": (aot_hits / aot_lookups) if aot_lookups else None,
+            "spans_total": span_total,
+            "wal_lag_records": int(sum(gauges.get("wal_lag_records", {}).values())),
+            "wal_lag_bytes": int(sum(gauges.get("wal_lag_bytes", {}).values())),
         },
     }
 
@@ -589,31 +695,53 @@ def _prom_label(label: str) -> str:
 
 
 def prometheus() -> str:
-    """Prometheus text-exposition dump of the counters and timers.
+    """Prometheus text-exposition dump of counters, gauges, timers and latency.
 
     Counters render as ``*_total`` counter families; gauges as gauge families;
-    timers as summary-style ``*_seconds_count`` / ``*_seconds_sum`` pairs —
-    ready for a textfile collector or a scrape handler.
+    timers as summary-style ``*_seconds_count`` / ``*_seconds_sum`` pairs; and
+    the flight recorder's DDSketch phase latencies as full summary families
+    with ``quantile`` labels (p50/p90/p99/p999). Every family carries
+    ``# HELP``/``# TYPE`` headers — ready for a textfile collector or a
+    scrape handler.
     """
     snap = snapshot()
     lines: List[str] = []
+
+    def _family(prom: str, kind: str, help_text: str) -> None:
+        lines.append(f"# HELP {prom} {help_text}")
+        lines.append(f"# TYPE {prom} {kind}")
+
     for name, by_label in snap["counters"].items():
         prom = _prom_name(name) + "_total"
-        lines.append(f"# TYPE {prom} counter")
+        _family(prom, "counter", f"metrics_tpu runtime counter: {name} occurrences per label.")
         for label, v in by_label.items():
             lines.append(f'{prom}{{metric="{_prom_label(label)}"}} {v}')
     for name, by_label in snap["gauges"].items():
         prom = _prom_name(name)
-        lines.append(f"# TYPE {prom} gauge")
+        _family(prom, "gauge", f"metrics_tpu runtime gauge: last observed {name} level per label.")
         for label, v in by_label.items():
             lines.append(f'{prom}{{metric="{_prom_label(label)}"}} {v}')
     for name, by_label in snap["timers"].items():
         prom = _prom_name(name) + "_seconds"
-        lines.append(f"# TYPE {prom} summary")
+        _family(prom, "summary", f"metrics_tpu host wall time over {name} dispatch.")
         for label, agg in by_label.items():
             sel = f'{{metric="{_prom_label(label)}"}}'
             lines.append(f"{prom}_count{sel} {agg['count']}")
             lines.append(f"{prom}_sum{sel} {agg['total_s']:.9f}")
+    for phase, by_label in snap["latency"].items():
+        prom = _prom_name(f"phase_{phase}") + "_seconds"
+        _family(
+            prom, "summary",
+            f"metrics_tpu flight-recorder span latency for phase {phase} (DDSketch, rel. error <= 2%).",
+        )
+        for label, agg in by_label.items():
+            esc = _prom_label(label)
+            for key, value in agg.items():
+                if key.startswith("p") and key.endswith("_s"):
+                    q = "0." + key[1:-2]
+                    lines.append(f'{prom}{{label="{esc}",quantile="{q}"}} {value:.9f}')
+            lines.append(f'{prom}_count{{label="{esc}"}} {agg["count"]}')
+            lines.append(f'{prom}_sum{{label="{esc}"}} {agg["total_s"]:.9f}')
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -30,6 +30,7 @@ from jax import Array, lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _tracing
 from metrics_tpu.utils.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
 
@@ -339,7 +340,9 @@ def allreduce_over_mesh(
             # cat: (world*cap, ...) rank-major concat: splice out the valid spans
             synced[k] = jnp.concatenate([v[r * cap : r * cap + dims[r]] for r in range(n)])
     if rec is not None:
-        rec.add_time("allreduce", axis_name, _observe.clock() - t0)
+        t1 = _observe.clock()
+        rec.add_time("allreduce", axis_name, t1 - t0)
+        _tracing.record_complete("allreduce", axis_name, t0, t1)
         rec.add_count("allreduce", axis_name)
     return synced
 
@@ -376,7 +379,9 @@ def gather_all_states(states: List[Any], group: Any = None) -> List[List[Any]]:
         gathered = multihost_utils.process_allgather(padded)
         out.append([gathered[i, : int(sizes[i])] for i in range(world)])
     if rec is not None:
-        rec.add_time("gather_all", str(world), _observe.clock() - t0)
+        t1 = _observe.clock()
+        rec.add_time("gather_all", str(world), t1 - t0)
+        _tracing.record_complete("gather_all", str(world), t0, t1)
         rec.add_count("gather_all", str(world))
     return out
 

@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _trace
 from metrics_tpu.utils.io import atomic_write_chunks
 
 __all__ = [
@@ -183,9 +184,10 @@ def save_checkpoint(obj: Any, path: Union[str, os.PathLike]) -> str:
 
         return save_fleet_checkpoint(fleet, path)
     path = os.fspath(path)
-    node = _extract(obj)
-    payload = pickle.dumps(node, protocol=pickle.HIGHEST_PROTOCOL)
-    nbytes = _write_container(path, node["kind"], node["class"], [payload])
+    with _trace.span("ckpt", "save"):
+        node = _extract(obj)
+        payload = pickle.dumps(node, protocol=pickle.HIGHEST_PROTOCOL)
+        nbytes = _write_container(path, node["kind"], node["class"], [payload])
     _observe.note_checkpoint_save(_label(obj), path, nbytes)
     return path
 
@@ -390,14 +392,15 @@ def restore_checkpoint(obj: Any, path: Union[str, os.PathLike]) -> Any:
 
         return restore_fleet_checkpoint(fleet, path)
     path = os.fspath(path)
-    try:
-        with open(path, "rb") as fh:
-            blob = fh.read()
-    except OSError as exc:
-        raise CheckpointError(f"{path}: cannot read checkpoint ({exc})") from exc
-    node = _parse(blob, path)
-    _validate(obj, node, _label(obj))
-    _install(obj, node)
+    with _trace.span("ckpt", "restore"):
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError as exc:
+            raise CheckpointError(f"{path}: cannot read checkpoint ({exc})") from exc
+        node = _parse(blob, path)
+        _validate(obj, node, _label(obj))
+        _install(obj, node)
     _observe.note_checkpoint_restore(_label(obj), path)
     return obj
 

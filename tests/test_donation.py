@@ -62,9 +62,8 @@ def _pristine():
     clear_jit_cache()
     jit_update_enabled(True)
     donate_updates_enabled(True)
-    observe.enable(reset=True)
-    yield
-    observe.disable()
+    with observe.scope(reset=True):
+        yield
     clear_jit_cache()
     jit_update_enabled(True)
     donate_updates_enabled(True)

@@ -23,9 +23,8 @@ from metrics_tpu.resilience import CorruptCheckpointError, IncompatibleCheckpoin
 def _pristine():
     clear_jit_cache()
     jit_update_enabled(True)
-    observe.enable(reset=True)
-    yield
-    observe.disable()
+    with observe.scope(reset=True):
+        yield
     clear_jit_cache()
     jit_update_enabled(True)
 

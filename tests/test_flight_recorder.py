@@ -1,0 +1,410 @@
+"""Flight-recorder coverage (DESIGN §19): span tracing through the engine hot
+path, Chrome-trace/Perfetto export, DDSketch-backed latency quantiles and
+their fleet-wide merge, the WAL durability-lag surface, and the
+``fleet_top`` report.
+
+The disabled-mode overhead contract lives in ``tests/test_observe_disabled.py``;
+the snapshot schema pin lives in ``tests/test_observe_runtime.py``.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.metric import clear_jit_cache
+from metrics_tpu.observe import latency as latency_mod
+from metrics_tpu.observe import recorder as rec_mod
+from metrics_tpu.observe import tracing
+from metrics_tpu.observe.latency import HostDDSketch
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    with observe.scope(reset=True):
+        yield
+    clear_jit_cache()
+
+
+# ------------------------------------------------------------------ scope
+
+def test_scope_restores_prior_state_and_clears():
+    observe.disable()
+    with observe.scope(reset=True) as rec:
+        assert rec_mod.ENABLED is True and rec is rec_mod.RECORDER
+        observe.record_event("probe")
+        assert len(rec.events) == 1
+    assert rec_mod.ENABLED is False
+    assert len(rec_mod.RECORDER.events) == 0  # reset=True clears on exit too
+
+    observe.enable(reset=True)
+    with observe.scope(reset=True):
+        pass
+    assert rec_mod.ENABLED is True  # prior state, not unconditionally off
+
+
+def test_scope_without_reset_keeps_recordings():
+    observe.disable()
+    with observe.scope(reset=False):
+        observe.record_event("probe")
+    assert len(rec_mod.RECORDER.events) == 1
+
+
+# ------------------------------------------------------------------ spans
+
+def test_nested_spans_record_depth_and_order():
+    with tracing.span("tick", "engine"):
+        with tracing.span("flush", "b0"):
+            with tracing.span("dispatch", "b0"):
+                pass
+        with tracing.span("flush", "b1"):
+            pass
+    spans = list(rec_mod.RECORDER.spans)
+    assert [s["phase"] for s in spans] == ["dispatch", "flush", "flush", "tick"]
+    by_phase = {s["phase"]: s for s in spans}
+    assert by_phase["tick"]["depth"] == 0
+    assert by_phase["dispatch"]["depth"] == 2
+    # children are contained in the parent interval
+    tick = by_phase["tick"]
+    for s in spans:
+        assert tick["t0"] <= s["t0"] and s["t1"] <= tick["t1"]
+    assert rec_mod.RECORDER._span_total == 4
+
+
+def test_span_ring_is_bounded_and_total_keeps_counting():
+    observe.enable(reset=True, max_spans=8)
+    for i in range(20):
+        with tracing.span("tick", str(i)):
+            pass
+    rec = rec_mod.RECORDER
+    assert len(rec.spans) == 8
+    assert rec._span_total == 20
+    assert [s["label"] for s in rec.spans] == [str(i) for i in range(12, 20)]
+    assert observe.snapshot()["derived"]["spans_total"] == 20
+    # ...and the sketches saw every span, not just the retained ones
+    assert observe.snapshot()["latency"]["tick"]["0"]["count"] == 1
+
+
+def test_drain_spans_pops_ring_but_keeps_latency():
+    with tracing.span("tick", "engine"):
+        pass
+    drained = tracing.drain_spans()
+    assert len(drained) == 1 and drained[0]["phase"] == "tick"
+    assert len(rec_mod.RECORDER.spans) == 0
+    assert tracing.drain_spans() == []
+    snap = observe.snapshot()
+    assert snap["derived"]["spans_total"] == 1
+    assert snap["latency"]["tick"]["engine"]["count"] == 1
+
+
+def test_span_records_even_when_body_raises():
+    with pytest.raises(RuntimeError):
+        with tracing.span("tick", "boom"):
+            raise RuntimeError("x")
+    spans = list(rec_mod.RECORDER.spans)
+    assert len(spans) == 1 and spans[0]["t1"] >= spans[0]["t0"]
+
+
+# ------------------------------------------------------------------ engine timeline
+
+def _chrome_nesting_ok(events):
+    """Per track, every event must be fully contained in its open ancestors."""
+    by_tid = {}
+    for e in events:
+        by_tid.setdefault(e["tid"], []).append(e)
+    eps = 1e-3  # µs; perf_counter deltas are well above this
+    for track in by_tid.values():
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for e in track:
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"] - eps:
+                stack.pop()
+            if stack:
+                parent = stack[-1]
+                assert e["ts"] + e["dur"] <= parent["ts"] + parent["dur"] + eps, (
+                    e["name"], parent["name"])
+            stack.append(e)
+    return True
+
+
+def test_timeline_from_hundred_session_engine_run(tmp_path):
+    engine = StreamEngine(initial_capacity=128, wal_path=str(tmp_path / "wal.bin"))
+    sids = [engine.add_session(MulticlassAccuracy(num_classes=4)) for _ in range(100)]
+    rng = np.random.RandomState(7)
+    for _ in range(2):
+        for sid in sids:
+            n = int(rng.randint(8, 32))
+            engine.submit(sid, jnp.asarray(rng.randint(0, 4, n)), jnp.asarray(rng.randint(0, 4, n)))
+        engine.tick()
+    engine.checkpoint(str(tmp_path / "fleet.ckpt"))
+    engine.expire(sids[0])
+
+    tl = observe.timeline()
+    # valid Chrome-trace JSON: loads back, and the viewer-required fields are
+    # present and well-typed on every event
+    loaded = json.loads(json.dumps(tl))
+    assert set(loaded) == {"traceEvents", "displayTimeUnit", "otherData"}
+    assert loaded["displayTimeUnit"] == "ms"
+    events = loaded["traceEvents"]
+    assert events, "a fleet run must record spans"
+    for e in events:
+        assert set(e) == {"name", "cat", "ph", "ts", "dur", "pid", "tid", "args"}
+        assert e["ph"] == "X"
+        assert isinstance(e["name"], str) and e["name"]
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    cats = {e["cat"] for e in events}
+    assert {"tick", "ingest", "wave_assembly", "dispatch", "flush",
+            "wal", "ckpt", "expire"} <= cats
+    assert min(e["ts"] for e in events) == 0  # rebased to the earliest span
+    assert _chrome_nesting_ok(events)
+    assert loaded["otherData"]["spans_total"] >= len(events)
+
+
+def test_snapshot_reports_ddsketch_quantiles_per_phase(tmp_path):
+    engine = StreamEngine(initial_capacity=8, wal_path=str(tmp_path / "wal.bin"))
+    sids = [engine.add_session(MulticlassAccuracy(num_classes=3)) for _ in range(4)]
+    for _ in range(3):
+        for sid in sids:
+            engine.submit(sid, jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        engine.tick()
+    latency = observe.snapshot()["latency"]
+    assert {"tick", "dispatch", "flush", "wal"} <= set(latency)
+    for phase in ("tick", "dispatch"):
+        for summary in latency[phase].values():
+            assert summary["count"] >= 1
+            assert 0 <= summary["p50_s"] <= summary["p99_s"] <= summary["max_s"] * (1 + 0.05)
+            assert summary["min_s"] <= summary["mean_s"] <= summary["max_s"]
+
+
+def test_engine_stats_expose_wal_lag_and_ckpt_age(tmp_path):
+    engine = StreamEngine(initial_capacity=8, wal_path=str(tmp_path / "wal.bin"))
+    a = engine.add_session(MulticlassAccuracy(num_classes=3))
+    b = engine.add_session(MulticlassAccuracy(num_classes=3))
+    st = engine.stats()
+    # session adds are journaled too: everything lags until a checkpoint
+    lag0 = st["wal_lag_records"]
+    assert lag0 == 2 and st["wal_lag_bytes"] > 0
+    assert st["last_ckpt_age_s"] is None
+
+    engine.submit(a, jnp.asarray([0, 1]), jnp.asarray([0, 1]))
+    engine.tick()
+    st = engine.stats()
+    assert st["wal_lag_records"] == lag0 + 1 and st["wal_lag_bytes"] > 0
+
+    engine.checkpoint(str(tmp_path / "fleet.ckpt"))  # truncates the WAL
+    st = engine.stats()
+    assert st["wal_lag_records"] == 0 and st["wal_lag_bytes"] == 0
+    assert st["last_ckpt_age_s"] is not None and st["last_ckpt_age_s"] >= 0.0
+
+    engine.submit(a, jnp.asarray([1]), jnp.asarray([1]))
+    engine.submit(b, jnp.asarray([2]), jnp.asarray([2]))
+    st = engine.stats()
+    assert st["wal_lag_records"] == 2 and st["wal_lag_bytes"] > 0
+    # the lag also rides the gauges into the snapshot deriveds
+    derived = observe.snapshot()["derived"]
+    assert derived["wal_lag_records"] == 2
+    assert derived["wal_lag_bytes"] == st["wal_lag_bytes"]
+
+
+def test_engine_without_wal_reports_zero_lag():
+    engine = StreamEngine(initial_capacity=4)
+    sid = engine.add_session(MulticlassAccuracy(num_classes=3))
+    engine.submit(sid, jnp.asarray([0]), jnp.asarray([0]))
+    engine.tick()
+    st = engine.stats()
+    assert st["wal_lag_records"] == 0 and st["wal_lag_bytes"] == 0
+
+
+def test_fleet_series_samples_per_tick():
+    engine = StreamEngine(initial_capacity=8)
+    sids = [engine.add_session(MulticlassAccuracy(num_classes=3)) for _ in range(3)]
+    for _ in range(4):
+        for sid in sids:
+            engine.submit(sid, jnp.asarray([0, 1]), jnp.asarray([0, 1]))
+        engine.tick()
+    series = observe.snapshot()["series"]
+    assert len(series) == 4
+    assert [s["tick"] for s in series] == [1, 2, 3, 4]
+    for s in series:
+        assert {"t", "tick", "sessions", "rows_active", "rows_capacity",
+                "occupancy_pct", "dispatches", "wal_lag_records",
+                "wal_lag_bytes", "quarantined"} <= set(s)
+        assert s["sessions"] == 3 and s["quarantined"] == 0
+
+
+# ------------------------------------------------------------------ sketches
+
+def _true_quantile(values, q):
+    return float(np.quantile(np.asarray(values, dtype=np.float64), q, method="lower"))
+
+
+def test_host_sketch_merge_matches_single_host_oracle():
+    """Hierarchical merge must be lossless: N per-host sketches merged
+    together answer exactly like one sketch that saw the whole stream, and
+    both stay within the DDSketch relative-error bound of the true quantile."""
+    rng = np.random.RandomState(3)
+    shards = [np.abs(rng.lognormal(mean=-7, sigma=2.0, size=4000)) + 1e-9
+              for _ in range(3)]
+    per_host = []
+    for shard in shards:
+        sk = HostDDSketch()
+        for v in shard:
+            sk.observe(float(v))
+        per_host.append(sk)
+    merged = per_host[0].copy()
+    for sk in per_host[1:]:
+        merged.merge(sk)
+
+    single = HostDDSketch()
+    allv = np.concatenate(shards)
+    for v in allv:
+        single.observe(float(v))
+
+    # bucket-exact: merge is elementwise count addition
+    assert np.array_equal(merged.pos, single.pos)
+    assert np.array_equal(merged.neg, single.neg)
+    assert merged.zero == single.zero and merged.count == single.count
+    qs = (0.5, 0.9, 0.99)
+    assert merged.quantiles(qs) == pytest.approx(single.quantiles(qs))
+    for q in qs:
+        est = merged.quantile(q)
+        true = _true_quantile(allv, q)
+        assert abs(est - true) <= latency_mod.DEFAULT_ALPHA * abs(true) * 1.05, (q, est, true)
+
+
+def test_host_sketch_matches_jax_kernel_buckets():
+    """The host mirror and the jitted kernel bucket the same stream the same
+    way (modulo f32-vs-f64 boundary rounding) — quantiles agree within α."""
+    from metrics_tpu.functional.sketches.ddsketch import ddsketch_delta, ddsketch_quantiles
+
+    alpha, key_offset, num_buckets = 0.02, -128, 256
+    rng = np.random.RandomState(11)
+    values = np.abs(rng.lognormal(mean=0.0, sigma=1.0, size=2048)).astype(np.float32) + 1e-3
+
+    host = HostDDSketch(alpha=alpha, key_offset=key_offset, num_buckets=num_buckets)
+    for v in values:
+        host.observe(float(v))
+    pos, neg, zero = ddsketch_delta(
+        jnp.asarray(values), jnp.ones(len(values), bool),
+        alpha=alpha, key_offset=key_offset, num_buckets=num_buckets,
+    )
+    qs = (0.5, 0.9, 0.99)
+    kernel_q = np.asarray(ddsketch_quantiles(
+        pos, neg, zero, jnp.asarray(qs), alpha=alpha, key_offset=key_offset))
+    host_q = np.asarray(host.quantiles(qs))
+    np.testing.assert_allclose(host_q, kernel_q, rtol=2.5 * alpha)
+
+
+def test_host_sketch_state_roundtrip_and_compat_guard():
+    sk = HostDDSketch()
+    for v in (0.001, 0.5, 3.0, 0.0, 7.5):
+        sk.observe(v)
+    restored = HostDDSketch.from_state(json.loads(json.dumps(sk.state())))
+    assert restored.count == sk.count
+    assert restored.quantile(0.5) == pytest.approx(sk.quantile(0.5))
+    with pytest.raises(ValueError):
+        sk.merge(HostDDSketch(alpha=0.05))
+
+
+def test_sync_telemetry_merges_peer_states():
+    with tracing.span("tick", "engine"):
+        pass
+    peer = HostDDSketch()
+    for v in (0.01, 0.02, 0.03):
+        peer.observe(v)
+    peer_payload = {"tick": {"engine": peer.state()}}
+    fleet = observe.sync_telemetry(peer_states=[peer_payload, peer_payload])
+    summary = fleet["tick"]["engine"]
+    assert summary["count"] == 1 + 2 * 3  # local span + both peers
+    assert summary["p50_s"] > 0
+
+
+# ------------------------------------------------------------------ export
+
+def test_prometheus_has_help_type_and_latency_quantiles():
+    with tracing.span("tick", "engine"):
+        pass
+    MulticlassAccuracy(num_classes=3).update(jnp.asarray([0]), jnp.asarray([0]))
+    text = observe.prometheus()
+    lines = text.splitlines()
+    # every family is announced: each # TYPE is preceded by its # HELP
+    type_lines = [i for i, l in enumerate(lines) if l.startswith("# TYPE")]
+    assert type_lines
+    for i in type_lines:
+        family = lines[i].split()[2]
+        assert lines[i - 1].startswith(f"# HELP {family} ")
+    assert "# TYPE metrics_tpu_phase_tick_seconds summary" in text
+    assert 'metrics_tpu_phase_tick_seconds{label="engine",quantile="0.50"} ' in text
+    assert 'metrics_tpu_phase_tick_seconds_count{label="engine"} 1' in text
+    assert 'metrics_tpu_phase_tick_seconds_sum{label="engine"} ' in text
+    for line in lines:
+        assert line.startswith("#") or " " in line
+
+
+def _load_fleet_top():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "tools", "fleet_top.py")
+    spec = importlib.util.spec_from_file_location("fleet_top", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_top_renders_and_diffs_snapshots(tmp_path, capsys):
+    fleet_top = _load_fleet_top()
+
+    engine = StreamEngine(initial_capacity=8)
+    sids = [engine.add_session(MulticlassAccuracy(num_classes=3)) for _ in range(3)]
+    for sid in sids:
+        engine.submit(sid, jnp.asarray([0, 1]), jnp.asarray([0, 1]))
+    engine.tick()
+    snap0 = observe.snapshot()
+    for sid in sids:
+        engine.submit(sid, jnp.asarray([1, 2]), jnp.asarray([1, 2]))
+    engine.tick()
+    snap1 = observe.snapshot()
+
+    report = fleet_top.render_report(snap1, snap0)
+    assert "occupancy" in report and "wal lag" in report
+    assert "tick" in report and "p99" in report
+
+    p0, p1 = tmp_path / "a.json", tmp_path / "b.json"
+    p0.write_text(json.dumps(snap0))
+    p1.write_text(json.dumps(snap1))
+    assert fleet_top.main([str(p0), str(p1)]) == 0
+    out = capsys.readouterr().out
+    assert "== fleet ==" in out and "== phases (DDSketch quantiles) ==" in out
+    assert fleet_top.main(["/nonexistent.json"]) == 2
+
+
+def test_quantile_key_naming():
+    assert latency_mod._quantile_key(0.5) == "p50_s"
+    assert latency_mod._quantile_key(0.9) == "p90_s"
+    assert latency_mod._quantile_key(0.99) == "p99_s"
+    assert latency_mod._quantile_key(0.999) == "p999_s"
+
+
+def test_telemetry_overhead_primitives_measurable():
+    """The overhead pass's microbenchmarks run and return sane numbers (the
+    <2% verdict itself is CI's job via lint_metrics --pass telemetry)."""
+    from metrics_tpu.observe import overhead
+
+    observe.disable()
+    costs = overhead.measure_disabled_costs(iters=2000, repeats=2)
+    assert costs["span_s"] >= 0.0 and costs["check_s"] >= 0.0
+    assert costs["span_s"] < 1e-4  # a null span is sub-100µs by orders of magnitude
+    with pytest.raises(RuntimeError):
+        observe.enable()
+        overhead.measure_disabled_costs(iters=10, repeats=1)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

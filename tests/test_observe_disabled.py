@@ -69,11 +69,32 @@ def test_disabled_path_allocates_no_telemetry():
     assert rec.timers == {}
     assert len(rec.events) == 0
     assert rec._compiled == {} and rec._evicted == set()
+    # the flight recorder obeys the same contract: no spans, no sketches,
+    # no fleet samples while disabled
+    assert len(rec.spans) == 0 and rec.latency == {} and len(rec.series) == 0
+    assert rec._span_total == 0
     snap = observe.snapshot()
     assert snap["enabled"] is False
     assert snap["counters"] == {} and snap["timers"] == {} and snap["events"] == []
+    assert snap["latency"] == {} and snap["series"] == []
     assert snap["derived"]["jit_cache_hit_rate"] is None
     assert observe.prometheus() == ""
+
+
+def test_disabled_span_is_the_preallocated_singleton():
+    """``span()`` while disabled is one flag check returning a shared no-op —
+    zero allocations per call (the PR 3 contract, extended to spans)."""
+    from metrics_tpu.observe import tracing
+
+    s1 = observe.span("tick", "engine")
+    s2 = observe.span("flush", "other")
+    assert s1 is s2 is tracing._NULL_SPAN
+    with s1:
+        pass  # enter/exit are no-ops
+    observe.record_complete("tick", "engine", 0.0, 1.0)  # early return, no record
+    rec = rec_mod.RECORDER
+    assert len(rec.spans) == 0 and rec.latency == {} and rec._span_total == 0
+    assert observe.timeline()["traceEvents"] == []
 
 
 def test_record_event_is_a_noop_while_disabled():

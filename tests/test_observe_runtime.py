@@ -60,11 +60,10 @@ def _pristine_observe():
 
     clear_jit_cache()
     collections_mod._FUSED_SHARED_CACHE.clear()  # fused executables outlive collections
-    rec_mod.reset(include_warnings=True)  # re-arm the one-time fallback warnings
-    observe.enable(reset=True)
-    yield
-    observe.disable()
-    rec_mod.reset(include_warnings=True)
+    # scope(reset=True) = enable fresh on enter, restore + clear on exit
+    # (including the one-time fallback warnings)
+    with observe.scope(reset=True):
+        yield
     clear_jit_cache()
     collections_mod._FUSED_SHARED_CACHE.clear()
 
@@ -199,7 +198,10 @@ def test_fused_collection_counters():
 def test_snapshot_schema_is_stable_and_json_able():
     ObsSum().update(1.0)
     snap = observe.snapshot()
-    assert set(snap) == {"enabled", "counters", "timers", "events", "gauges", "derived"}
+    assert set(snap) == {
+        "enabled", "counters", "timers", "events", "gauges",
+        "latency", "series", "derived",
+    }
     assert snap["enabled"] is True
     assert set(snap["derived"]) == {
         "jit_cache_hit_rate", "jit_compiles_total", "jit_cache_hits_total",
@@ -212,10 +214,16 @@ def test_snapshot_schema_is_stable_and_json_able():
         "wal_appends_total", "wal_records_replayed_total",
         "aot_hits_total", "aot_misses_total", "aot_stale_total",
         "aot_stores_total", "aot_hit_rate",
+        "spans_total", "wal_lag_records", "wal_lag_bytes",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():
             assert set(agg) == {"count", "total_s", "mean_s", "min_s", "max_s"}
+    assert snap["latency"]  # the update above recorded a leaf span
+    for by_label in snap["latency"].values():
+        for agg in by_label.values():
+            assert set(agg) == {"count", "total_s", "mean_s", "min_s", "max_s",
+                                "p50_s", "p90_s", "p99_s", "p999_s"}
     roundtrip = json.loads(observe.snapshot_json())
     assert roundtrip["counters"] == snap["counters"]
     seqs = [e["seq"] for e in snap["events"]]

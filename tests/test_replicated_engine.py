@@ -30,9 +30,8 @@ N_BOOT = 10
 def _pristine():
     clear_jit_cache()
     jit_update_enabled(True)
-    observe.enable(reset=True)
-    yield
-    observe.disable()
+    with observe.scope(reset=True):
+        yield
     clear_jit_cache()
     jit_update_enabled(True)
 

@@ -223,22 +223,18 @@ def test_sketches_checkpoint_roundtrip(cls, tmp_path):
 
 @pytest.mark.parametrize("cls", ALL_SKETCHES, ids=lambda c: c.__name__)
 def test_sketches_compile_once_across_same_shape_updates(cls):
-    observe.enable(reset=True)
-    try:
+    with observe.scope(reset=True):
         m, batch = _small(cls)
         for _ in range(4):
             m.update(*batch())
         compiles = observe.snapshot()["counters"].get("jit_compile", {})
         assert compiles.get(cls.__name__, 0) <= 1, compiles
-    finally:
-        observe.disable()
 
 
 def test_sketches_run_inside_stream_engine_bucket():
     from metrics_tpu import StreamEngine
 
-    observe.enable(reset=True)
-    try:
+    with observe.scope(reset=True):
         rng = np.random.RandomState(9)
         engine = StreamEngine(initial_capacity=4)
         sids = [engine.add_session(DDSketch(num_buckets=256)) for _ in range(3)]
@@ -252,5 +248,3 @@ def test_sketches_run_inside_stream_engine_bucket():
         # the 1-dispatch/bucket/tick economy must hold for sketch buckets too
         assert derived["fleet_dispatches_per_flush"] == pytest.approx(1.0)
         assert np.allclose(np.asarray(engine.compute(sids[0])), np.asarray(solo.compute()))
-    finally:
-        observe.disable()

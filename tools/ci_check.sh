@@ -1,10 +1,10 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
-# + donlint), the donation three-way cross-check, the AOT executable-cache
-# round-trip pass (serialize → fresh-dir reload with zero compiles → bit-exact
-# vs a fresh trace, baselined in tools/aot_baseline.json), the chaos
-# fault-injection harness, the fleet-engine contract pass, and the perf cost
-# ratchet (which
+# + donlint), the disabled-mode telemetry overhead smoke, the donation
+# three-way cross-check, the AOT executable-cache round-trip pass (serialize
+# → fresh-dir reload with zero compiles → bit-exact vs a fresh trace,
+# baselined in tools/aot_baseline.json), the chaos fault-injection harness,
+# the fleet-engine contract pass, and the perf cost ratchet (which
 # also drives the 64-stream StreamEngine smoke and pins its dispatch economy
 # against the `fleet` section of tools/perf_baseline.json) — all via
 # `lint_metrics.py --all`, which aggregates their exit codes. The default
@@ -14,6 +14,17 @@
 #
 #   tools/ci_check.sh            # text report, exit 0 clean / 1 violations / 2 usage
 #   tools/ci_check.sh --json     # one machine-readable document on stdout
+#   tools/ci_check.sh --tier1    # the tier-1 test suite (CPU, not-slow) with
+#                                # --durations=20 so CI logs name the slowest
+#                                # tests when the timing budget drifts
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--tier1" ]]; then
+  shift
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors --durations=20 \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+fi
+
 exec python tools/lint_metrics.py --all "$@"

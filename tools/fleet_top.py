@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""``top`` for a metric fleet: one-command health report over observe snapshots.
+
+Offline, it reads one or two ``observe.snapshot()`` JSON files (the dicts the
+runtime half of :mod:`metrics_tpu.observe` emits — DESIGN §19) and renders a
+fleet health report: occupancy, dispatch economy, WAL durability lag,
+quarantine count, and per-phase DDSketch latency quantiles. With two
+snapshots it diffs them — counter families become rates over the snapshots'
+series-time window and gauge moves are signed — which is how a CI job or an
+operator compares "before the incident" to "after".
+
+Live, ``--live`` drives a self-contained demo fleet (a ``StreamEngine`` with
+``--sessions`` ragged-length streams, the same workload shape as the fleet
+contract smoke) inside this process and re-renders the report every
+``--interval`` ticks, diffing each frame against the previous one. The
+recorder is process-wide, so watching *your* fleet is the same one-liner in
+your process::
+
+    json.dump(observe.snapshot(), open("snap.json", "w"))   # twice, then
+    python tools/fleet_top.py snap0.json snap1.json
+
+Exit codes: 0 rendered, 2 usage/unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# ------------------------------------------------------------------ rendering
+
+_PHASE_ORDER = (
+    "tick", "ingest", "wave_assembly", "dispatch", "flush", "fleet_compute",
+    "wal", "ckpt", "expire", "update", "compute", "merge", "sync",
+    "allreduce", "gather_all", "fused_update", "aot",
+)
+
+
+def _fmt_s(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "-"
+    if seconds >= 1.0:
+        return f"{seconds:.2f}s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.2f}ms"
+    return f"{seconds * 1e6:.0f}us"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _series_window_s(snap: Dict[str, Any]) -> Optional[float]:
+    series = snap.get("series") or []
+    if len(series) >= 2:
+        dt = float(series[-1]["t"]) - float(series[0]["t"])
+        if dt > 0:
+            return dt
+    return None
+
+
+def _gauge_total(snap: Dict[str, Any], name: str) -> float:
+    return float(sum((snap.get("gauges", {}).get(name) or {}).values()))
+
+
+def _counter_total(snap: Dict[str, Any], name: str) -> int:
+    return int(sum((snap.get("counters", {}).get(name) or {}).values()))
+
+
+def _delta(cur: float, prev: Optional[float]) -> str:
+    if prev is None:
+        return ""
+    d = cur - prev
+    if d == 0:
+        return "  (=)"
+    return f"  ({'+' if d > 0 else ''}{d:g})"
+
+
+def render_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) -> str:
+    """Render one snapshot (optionally diffed against ``prev``) as text."""
+    lines: List[str] = []
+    derived = snap.get("derived", {})
+    pderived = (prev or {}).get("derived", {})
+    series = snap.get("series") or []
+    latest = series[-1] if series else {}
+
+    lines.append("== fleet ==")
+    occ = latest.get("occupancy_pct")
+    rows = (latest.get("rows_active"), latest.get("rows_capacity"))
+    if occ is not None:
+        lines.append(f"occupancy        {occ:.1f}%  ({rows[0]}/{rows[1]} rows)")
+    sessions = latest.get("sessions", derived.get("fleet_sessions"))
+    if sessions is not None:
+        lines.append(f"sessions         {sessions}{_delta(sessions, (prev or {}).get('series', [{}])[-1].get('sessions') if (prev or {}).get('series') else None)}")
+    if series:
+        dispatches = [s.get("dispatches", 0) for s in series]
+        lines.append(
+            f"dispatches/tick  {sum(dispatches) / len(dispatches):.2f}  "
+            f"(last {dispatches[-1]}, {len(series)} samples)"
+        )
+    quarantined = latest.get("quarantined")
+    if quarantined is not None:
+        lines.append(f"quarantined      {quarantined}")
+
+    lines.append("")
+    lines.append("== durability ==")
+    lag_r = derived.get("wal_lag_records", _gauge_total(snap, "wal_lag_records"))
+    lag_b = derived.get("wal_lag_bytes", _gauge_total(snap, "wal_lag_bytes"))
+    lines.append(f"wal lag          {int(lag_r)} records / {_fmt_bytes(float(lag_b))}"
+                 f"{_delta(lag_r, pderived.get('wal_lag_records') if prev else None)}")
+    age = (snap.get("gauges", {}).get("last_ckpt_age_s") or {})
+    if age:
+        lines.append(f"last checkpoint  {_fmt_s(max(age.values()))} ago")
+    else:
+        lines.append("last checkpoint  never")
+
+    lines.append("")
+    lines.append("== phases (DDSketch quantiles) ==")
+    latency = snap.get("latency") or {}
+    header = f"{'phase':<14}{'label':<18}{'count':>8}{'p50':>10}{'p99':>10}{'max':>10}"
+    lines.append(header)
+    ordered = [p for p in _PHASE_ORDER if p in latency]
+    ordered += sorted(p for p in latency if p not in _PHASE_ORDER)
+    window = _series_window_s(snap)
+    for phase in ordered:
+        for label, s in sorted(latency[phase].items()):
+            count = s.get("count", 0)
+            prev_count = ((prev or {}).get("latency", {}).get(phase, {}).get(label, {}) or {}).get("count")
+            rate = ""
+            if prev_count is not None and window:
+                rate = f"  ({(count - prev_count) / window:+.1f}/s)"
+            lines.append(
+                f"{phase:<14}{(label or '-'):<18}{count:>8}"
+                f"{_fmt_s(s.get('p50_s')):>10}{_fmt_s(s.get('p99_s')):>10}"
+                f"{_fmt_s(s.get('max_s')):>10}{rate}"
+            )
+    if not latency:
+        lines.append("(no spans recorded — is telemetry enabled?)")
+
+    spans_total = derived.get("spans_total")
+    if spans_total is not None:
+        lines.append("")
+        lines.append(
+            f"spans: {spans_total} recorded"
+            f"{_delta(spans_total, pderived.get('spans_total') if prev else None)}"
+            f"; jit compiles: {derived.get('jit_compiles_total', 0)}"
+            f"; eager fallbacks: {_counter_total(snap, 'eager_fallback')}"
+        )
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ live mode
+
+def _demo_fleet(sessions: int, interval: int, frames: int, out) -> int:
+    """Drive a demo StreamEngine and re-render every ``interval`` ticks."""
+    import numpy as np
+
+    from metrics_tpu import observe
+    from metrics_tpu.classification.accuracy import MulticlassAccuracy
+    from metrics_tpu.engine.stream import StreamEngine
+
+    rng = np.random.default_rng(0)
+    with observe.scope():
+        engine = StreamEngine(initial_capacity=max(8, sessions))
+        sids = [engine.add_session(MulticlassAccuracy(num_classes=8)) for _ in range(sessions)]
+        prev: Optional[Dict[str, Any]] = None
+        for frame in range(frames):
+            for _ in range(interval):
+                for sid in sids:
+                    if rng.random() < 0.8:  # ragged: not every stream every tick
+                        n = int(rng.integers(4, 64))
+                        engine.submit(sid, rng.integers(0, 8, n), rng.integers(0, 8, n))
+                engine.tick()
+            snap = observe.snapshot()
+            print(f"--- frame {frame + 1}/{frames} "
+                  f"(tick {engine.stats()['ticks']}) ---", file=out)
+            print(render_report(snap, prev), file=out)
+            print("", file=out)
+            prev = snap
+    return 0
+
+
+# ------------------------------------------------------------------ CLI
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fleet_top",
+        description="Fleet health report from observe.snapshot() JSON — offline "
+                    "(one or two snapshot files, second is diffed against the "
+                    "first) or --live (in-process demo fleet).",
+    )
+    p.add_argument("snapshots", nargs="*",
+                   help="snapshot JSON file(s): one to render, two to diff (old new)")
+    p.add_argument("--live", action="store_true",
+                   help="drive a demo StreamEngine and re-render per frame")
+    p.add_argument("--sessions", type=int, default=32, help="live: fleet size (default 32)")
+    p.add_argument("--interval", type=int, default=5, help="live: ticks per frame (default 5)")
+    p.add_argument("--frames", type=int, default=3, help="live: frames to render (default 3)")
+    args = p.parse_args(argv)
+
+    if args.live:
+        if args.snapshots:
+            print("fleet_top: --live takes no snapshot files", file=sys.stderr)
+            return 2
+        return _demo_fleet(args.sessions, args.interval, args.frames, sys.stdout)
+
+    if not 1 <= len(args.snapshots) <= 2:
+        print("fleet_top: expected 1 or 2 snapshot files (or --live)", file=sys.stderr)
+        return 2
+    snaps: List[Dict[str, Any]] = []
+    for path in args.snapshots:
+        try:
+            with open(path) as f:
+                snaps.append(json.load(f))
+        except (OSError, ValueError) as exc:
+            print(f"fleet_top: cannot read {path}: {exc}", file=sys.stderr)
+            return 2
+    prev, cur = (None, snaps[0]) if len(snaps) == 1 else (snaps[0], snaps[1])
+    print(render_report(cur, prev))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
