@@ -62,6 +62,10 @@ FLEET_CHURN = 256
 FLEET_BATCH = 16
 RECOVERY_STREAMS = 1000
 RECOVERY_TICKS = 3
+DRIFT_STREAMS = 1000
+DRIFT_TICKS = 4
+DRIFT_CHURN = 64
+DRIFT_BATCH = 16
 
 
 # ----------------------------------------------------------------- roofline
@@ -596,6 +600,129 @@ def bench_fleet(with_ref: bool = True):
     }
 
 
+# ---------------------------------------------------------------- extra: drift
+def bench_drift(with_ref: bool = True):
+    """Windowed + drift metrics on the fleet (``windows/``, ``drift/``, DESIGN §20):
+    1k logical streams, each carrying a time-decayed mean, a decayed DDSketch and a
+    CUSUM alarm (3k engine sessions, one bucket per class). Timestamps ride as 0-d
+    synced scalars, so every session in a bucket shares one donated dispatch per
+    tick and mid-run churn must not recompile — both asserted from the observe
+    counters. No torch analog; reports dispatch economy + host throughput and
+    stays out of the geomean."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.aggregation import MeanMetric
+    from metrics_tpu.drift import CUSUM
+    from metrics_tpu.engine import StreamEngine
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.observe import recorder as rec_mod
+    from metrics_tpu.windows import DecayedDDSketch, TimeDecayed
+
+    rng = np.random.default_rng(23)
+    ctors = {
+        "decayed_mean": lambda: TimeDecayed(MeanMetric(nan_strategy="disable"), half_life_s=60.0),
+        "decayed_sketch": lambda: DecayedDDSketch(half_life_s=60.0, num_buckets=512),
+        "cusum": lambda: CUSUM(target=0.5, k=0.1, h=5.0),
+    }
+    timed = {"decayed_mean", "decayed_sketch"}  # these lead with a timestamp arg
+    pools = {
+        "decayed_mean": [(rng.random(DRIFT_BATCH, dtype=np.float32),) for _ in range(16)],
+        "decayed_sketch": [
+            (rng.random(DRIFT_BATCH, dtype=np.float32) * 9.0 + 1.0,) for _ in range(16)
+        ],
+        "cusum": [(rng.random(DRIFT_BATCH, dtype=np.float32),) for _ in range(16)],
+    }
+    capacity = 1 << (DRIFT_STREAMS - 1).bit_length()
+    # one timestamp per tick, as a 0-d device scalar: waves group by aval, so the
+    # changing VALUE never splits a bucket or triggers a retrace
+    ticks_t = [jnp.asarray(5.0 * t, jnp.float32) for t in range(DRIFT_TICKS)]
+
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    probe = rec_mod.Recorder()
+    rec_mod.RECORDER, rec_mod.ENABLED = probe, True
+    _FLEET_JIT_CACHE.clear()
+    try:
+        engine = StreamEngine(initial_capacity=capacity)
+        kinds = {}
+        for _ in range(DRIFT_STREAMS):
+            for kind in ctors:  # each logical stream carries all three
+                kinds[engine.add_session(ctors[kind]())] = kind
+        sampled = list(kinds)[:: len(kinds) // 3][:3]
+        oracles = {sid: ctors[kinds[sid]]() for sid in sampled}
+
+        start = time.perf_counter()
+        compiles_pre_churn = None
+        for t in range(DRIFT_TICKS):
+            for i, (sid, kind) in enumerate(kinds.items()):
+                args = pools[kind][(i + t) % 16]
+                full_args = (ticks_t[t],) + args if kind in timed else args
+                engine.submit(sid, *full_args)
+                if sid in oracles:
+                    oracles[sid].update(*full_args)
+            engine.tick()
+            if t == 0:
+                compiles_pre_churn = dict(probe.counters)
+            if t == DRIFT_TICKS // 2:
+                doomed = [s for s in kinds if s not in oracles][:DRIFT_CHURN]
+                names = list(ctors)
+                for sid in doomed:
+                    engine.expire(sid)
+                    del kinds[sid]
+                for j in range(DRIFT_CHURN):
+                    kind = names[j % len(names)]
+                    kinds[engine.add_session(ctors[kind]())] = kind
+        wall = time.perf_counter() - start
+
+        for sid in sampled:
+            got = np.asarray(engine.compute(sid))
+            want = np.asarray(oracles[sid].compute())
+            assert np.allclose(got, want, rtol=1e-5, atol=1e-6), (sid, got, want)
+
+        counters = {}
+        for (name, label), v in probe.counters.items():
+            counters.setdefault(name, {})[label] = v
+    finally:
+        rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+        _FLEET_JIT_CACHE.clear()
+
+    update_compiles = {
+        k: v for k, v in counters.get("fleet_compile", {}).items() if not k.endswith(":compute")
+    }
+    pre_churn_compiles = sum(
+        v for (n, label), v in compiles_pre_churn.items()
+        if n == "fleet_compile" and not label.endswith(":compute")
+    )
+    dispatches = sum(counters.get("fleet_dispatch", {}).values())
+    flushes = sum(counters.get("fleet_flush", {}).values())
+    per_bucket_tick = dispatches / flushes
+    recompiles_after_churn = sum(update_compiles.values()) - pre_churn_compiles
+    n_sessions = DRIFT_STREAMS * len(ctors)
+    # the acceptance criteria for the windows/drift fleet path, from live telemetry:
+    assert per_bucket_tick <= 1.0 + 1e-9, counters
+    assert recompiles_after_churn == 0, counters
+    assert len(update_compiles) == len(ctors), counters
+    return {
+        "streams": DRIFT_STREAMS,
+        "sessions": n_sessions,
+        "buckets": len(update_compiles),
+        "ticks": DRIFT_TICKS,
+        "churn": DRIFT_CHURN,
+        "dispatches_per_bucket_tick": round(per_bucket_tick, 4),
+        "recompiles_after_churn": recompiles_after_churn,
+        "ms_per_tick": round(1000 * wall / DRIFT_TICKS, 3),
+        "stream_updates_per_sec": round(n_sessions * DRIFT_TICKS / wall),
+        "observe_counters": {
+            k: counters.get(k, {})
+            for k in ("fleet_dispatch", "fleet_flush", "fleet_compile", "fleet_session_add", "fleet_session_expire")
+        },
+        "workload": (
+            f"{DRIFT_STREAMS} streams x (TimeDecayed mean + DecayedDDSketch + CUSUM) "
+            f"= {n_sessions} sessions x {DRIFT_TICKS} ticks, churn {DRIFT_CHURN} "
+            "[1 donated dispatch/bucket/tick, zero churn recompiles; not in geomean]"
+        ),
+    }
+
+
 # ------------------------------------------------------------- extra: recovery
 def bench_recovery(with_ref: bool = True):
     """Durability path (``engine/durability.py``, DESIGN §17): checkpoint a
@@ -1031,6 +1158,12 @@ def main():
     except Exception as err:  # noqa: BLE001
         configs["fleet"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "fleet")
+    # windowed + drift metrics on the fleet: 1k streams x 3 classes, timestamped waves
+    try:
+        configs["drift"] = bench_drift(with_ref=with_ref)
+    except Exception as err:  # noqa: BLE001
+        configs["drift"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "drift")
     # durability: checkpoint + crash + restore + WAL replay at 1k streams
     try:
         configs["recovery"] = bench_recovery(with_ref=with_ref)

@@ -119,6 +119,13 @@ _LAZY_EXPORTS = {
     "WordInfoLost": "metrics_tpu.text",
     "WordInfoPreserved": "metrics_tpu.text",
     "StreamEngine": "metrics_tpu.engine",
+    "DecayedDDSketch": "metrics_tpu.windows",
+    "DecayedHLL": "metrics_tpu.windows",
+    "TimeDecayed": "metrics_tpu.windows",
+    "TumblingWindow": "metrics_tpu.windows",
+    "CUSUM": "metrics_tpu.drift",
+    "KSDistance": "metrics_tpu.drift",
+    "PSI": "metrics_tpu.drift",
     "DDSketch": "metrics_tpu.sketches",
     "HyperLogLog": "metrics_tpu.sketches",
     "ReservoirSample": "metrics_tpu.sketches",
@@ -133,10 +140,10 @@ _LAZY_EXPORTS = {
 }
 
 _LAZY_SUBPACKAGES = (
-    "aot", "audio", "classification", "clustering", "detection", "engine", "functional", "image",
-    "integration", "models", "multimodal", "nominal", "observe", "ops", "parallel",
+    "aot", "audio", "classification", "clustering", "detection", "drift", "engine", "functional",
+    "image", "integration", "models", "multimodal", "nominal", "observe", "ops", "parallel",
     "regression", "resilience", "retrieval", "segmentation", "shape", "sketches", "text",
-    "utils", "wrappers",
+    "utils", "windows", "wrappers",
 )
 
 

@@ -17,7 +17,8 @@ DL001    a custom (non-literal) ``dist_reduce_fx`` passed to ``add_state`` must
 DL002    ``update`` must fold new batches into state through a known
          merge-sound operation (additive/extremal/concat/logical); any other
          read-modify-write makes per-shard partials diverge from the
-         single-pass answer
+         single-pass answer (classes overriding ``_merge_state_dicts`` carry
+         their own verified merge algebra and are checked dynamically instead)
 DL003    ``compute`` must not depend on ``_update_count`` or on positional
          indexing of list states — both change meaning under merge (counts
          add, shard segments permute)
@@ -166,7 +167,13 @@ def rule_dl002_nonadditive_rmw(mod: ModuleInfo) -> List[Violation]:
 
     ``self.x = f(self.x, batch)`` for arbitrary ``f`` (``jnp.where`` selection,
     multiplication, subtraction with the state on the right, a helper call)
-    produces per-shard partials whose merge is not the single-pass answer.
+    produces per-shard partials whose merge is not the single-pass answer —
+    *when the class merges by its declared per-state reductions*. A class that
+    overrides ``_merge_state_dicts`` supplies its own merge algebra (e.g. the
+    decay-to-common-reference-time folds in ``windows/``, DESIGN §20); the
+    additive-idiom heuristic no longer applies and the obligation moves to the
+    dynamic merge harness (``merge_contracts`` + the time-shifted check),
+    which exercises exactly that override per exported class.
     """
     out: List[Violation] = []
     for cls, calls in _metric_classes(mod):
@@ -174,6 +181,8 @@ def rule_dl002_nonadditive_rmw(mod: ModuleInfo) -> List[Violation]:
         update = _method(cls, "update")
         if update is None or not states:
             continue
+        if _method(cls, "_merge_state_dicts") is not None:
+            continue  # custom merge algebra — verified dynamically, not by idiom
         qual = f"{cls.name}.update"
         for node in ast.walk(update):
             if isinstance(node, ast.AugAssign):

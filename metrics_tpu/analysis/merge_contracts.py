@@ -46,10 +46,15 @@ import numpy as np
 __all__ = [
     "CLASSIFICATIONS",
     "MERGE_CASES",
+    "TIME_SHIFTED_CASES",
     "MergeCase",
     "MergeResult",
+    "TimeShiftCase",
+    "TimeShiftResult",
     "check_merge_case",
+    "check_time_shifted_case",
     "run_merge_contracts",
+    "run_time_shifted_contracts",
     "load_merge_baseline",
     "write_merge_baseline",
     "diff_merge_baseline",
@@ -312,6 +317,26 @@ def _make_cases() -> List[MergeCase]:
         case("StreamingAUROC", lambda: M.StreamingAUROC(num_bins=64), bin_batch),
         case("StreamingCalibrationError", lambda: M.StreamingCalibrationError(num_bins=10),
              bin_batch),
+        # ---- windows & drift (time-decayed / windowed semantics, DESIGN §20) --
+        # timestamps are drawn from the per-batch rng, so shards see scrambled
+        # times — the decayed algebras are order-invariant and the pane merge is
+        # newest-pane-wins, so the fold must still match the single pass
+        case("TimeDecayed",
+             lambda: M.TimeDecayed(M.MeanMetric(nan_strategy="disable"), half_life_s=20.0),
+             lambda r: (jnp.asarray(r.rand() * 50.0, jnp.float32), _rand(r, 10))),
+        case("TumblingWindow",
+             lambda: M.TumblingWindow(M.SumMetric(nan_strategy="disable"), pane_s=10.0, n_panes=4),
+             lambda r: (jnp.asarray(r.rand() * 50.0, jnp.float32), _rand(r, 10))),
+        case("DecayedDDSketch", lambda: M.DecayedDDSketch(half_life_s=20.0, num_buckets=512),
+             lambda r: (jnp.asarray(r.rand() * 50.0, jnp.float32), _rand(r, 10) + 0.01)),
+        case("DecayedHLL", lambda: M.DecayedHLL(half_life_s=20.0, p=8),
+             lambda r: (jnp.asarray(r.rand() * 50.0, jnp.float32), _rand(r, 10))),
+        case("PSI", lambda: M.PSI(lo=0.0, hi=1.0, num_bins=16),
+             lambda r: (_rand(r, 10), _rand(r, 10))),
+        case("KSDistance", lambda: M.KSDistance(lo=0.0, hi=1.0, num_bins=16),
+             lambda r: (_rand(r, 10), _rand(r, 10))),
+        case("CUSUM", lambda: M.CUSUM(target=0.5, k=0.05, h=2.0),
+             lambda r: (_rand(r, 10),)),
     ]
 
 
@@ -343,6 +368,154 @@ MERGE_CASES = _LazyCases()
 def run_merge_contracts(cases: Optional[Sequence[MergeCase]] = None) -> List[MergeResult]:
     """Classify every case; returns all results (callers apply the baseline)."""
     return [check_merge_case(c) for c in (cases if cases is not None else _cases())]
+
+
+# --------------------------------------------------------------------- time-shifted merges
+@dataclasses.dataclass(frozen=True)
+class TimeShiftCase:
+    """One windowed/drift class plus a timestamped deterministic stream.
+
+    ``batch(rng, i)`` returns the update args for stream position ``i`` —
+    timestamps must be monotonically increasing in ``i`` so the random split
+    boundary is a genuine *time* boundary. ``rtol``/``atol`` is the case's
+    declared merge tolerance: 0.0 means bit-level agreement is required.
+    """
+
+    name: str  # exported class name
+    ctor: Callable[[], Any]
+    batch: Callable[[np.random.RandomState, int], Tuple[Any, ...]]
+    rtol: float = 0.0
+    atol: float = 0.0
+    n_batches: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeShiftResult:
+    case: TimeShiftCase
+    ok: bool
+    boundary: int = 0
+    detail: str = ""
+
+
+def check_time_shifted_case(case: TimeShiftCase) -> TimeShiftResult:
+    """The ROADMAP time-shifted-merge soundness check (DESIGN §20), one class.
+
+    Split a timestamped stream at a seeded-random time boundary, update an
+    "early" and a "late" replica, fold them through ``merge_state`` (early as
+    incoming — stream order), and require the merged compute to agree with the
+    single-pass fold to the case's declared tolerance (bit-level when 0.0).
+    This is exactly the property the decay-to-common-reference-time and
+    pane-aligned merge overrides exist to provide; no baseline — the expected
+    failure set is empty.
+    """
+    rng0 = np.random.RandomState(zlib.crc32(f"tshift:{case.name}".encode()) % (2**31))
+    boundary = int(rng0.randint(1, case.n_batches))
+    try:
+        batches = [
+            case.batch(
+                np.random.RandomState(zlib.crc32(f"tshift:{case.name}:{i}".encode()) % (2**31)), i
+            )
+            for i in range(case.n_batches)
+        ]
+        ref = case.ctor()
+        for args in batches:
+            ref.update(*args)
+        ref_out = ref.compute()
+
+        early, late = case.ctor(), case.ctor()
+        for args in batches[:boundary]:
+            early.update(*args)
+        for args in batches[boundary:]:
+            late.update(*args)
+        late.merge_state(early)  # incoming-first: early IS stream-earlier
+        merged_out = late.compute()
+    except Exception as exc:  # noqa: BLE001 — the error text IS the result detail
+        return TimeShiftResult(case, ok=False, boundary=boundary,
+                               detail=f"{type(exc).__name__}: {exc}")
+
+    ra = np.asarray(jax.device_get(ref_out), dtype=np.float64)
+    ma = np.asarray(jax.device_get(merged_out), dtype=np.float64)
+    if case.rtol == 0.0 and case.atol == 0.0:
+        ok = ra.shape == ma.shape and bool(np.array_equal(ra, ma, equal_nan=True))
+        how = "bit-level"
+    else:
+        ok = ra.shape == ma.shape and bool(
+            np.allclose(ra, ma, rtol=case.rtol, atol=case.atol, equal_nan=True)
+        )
+        how = f"rtol={case.rtol}, atol={case.atol}"
+    if not ok:
+        return TimeShiftResult(
+            case, ok=False, boundary=boundary,
+            detail=f"time-shifted merge diverges from single-pass fold ({how}): "
+                   f"single-pass={ra!r} merged={ma!r}",
+        )
+    return TimeShiftResult(case, ok=True, boundary=boundary)
+
+
+def _make_time_shifted_cases() -> List[TimeShiftCase]:
+    import metrics_tpu as M
+
+    def t(r: np.random.RandomState, i: int) -> jax.Array:
+        # strictly increasing, irregular spacing — a genuine time axis
+        return jnp.asarray(7.0 * i + r.rand() * 5.0, jnp.float32)
+
+    case = TimeShiftCase
+    return [
+        # decayed folds hit exp2 in a different association order on the merge
+        # path, so they declare a (tight) fp tolerance rather than bit equality
+        case("TimeDecayed",
+             lambda: M.TimeDecayed(M.MeanMetric(nan_strategy="disable"), half_life_s=15.0),
+             lambda r, i: (t(r, i), _rand(r, 10)), rtol=1e-5, atol=1e-6),
+        case("DecayedDDSketch", lambda: M.DecayedDDSketch(half_life_s=15.0, num_buckets=512),
+             lambda r, i: (t(r, i), _rand(r, 10) + 0.01), rtol=1e-5, atol=1e-6),
+        case("DecayedHLL", lambda: M.DecayedHLL(half_life_s=15.0, p=8),
+             lambda r, i: (t(r, i), _rand(r, 10)), rtol=1e-5, atol=1e-6),
+        # pane-aligned and count-sum merges reuse the single-pass arithmetic
+        # exactly; drift classes are timeless, so the boundary is an index
+        # boundary — still the same split-decay/merge-vs-single-pass property
+        case("TumblingWindow",
+             lambda: M.TumblingWindow(M.SumMetric(nan_strategy="disable"), pane_s=10.0, n_panes=4),
+             lambda r, i: (t(r, i), _rand(r, 10)), rtol=1e-6, atol=1e-7),
+        case("PSI", lambda: M.PSI(lo=0.0, hi=1.0, num_bins=16),
+             lambda r, i: (_rand(r, 10), _rand(r, 10))),
+        case("KSDistance", lambda: M.KSDistance(lo=0.0, hi=1.0, num_bins=16),
+             lambda r, i: (_rand(r, 10), _rand(r, 10))),
+        case("CUSUM", lambda: M.CUSUM(target=0.5, k=0.05, h=2.0),
+             lambda r, i: (_rand(r, 10),), rtol=1e-6, atol=1e-7),
+    ]
+
+
+_TSHIFT_CACHE: Optional[List[TimeShiftCase]] = None
+
+
+def _time_shifted_cases() -> List[TimeShiftCase]:
+    global _TSHIFT_CACHE
+    if _TSHIFT_CACHE is None:
+        _TSHIFT_CACHE = _make_time_shifted_cases()
+    return _TSHIFT_CACHE
+
+
+class _LazyTimeShiftCases:
+    def __iter__(self):
+        return iter(_time_shifted_cases())
+
+    def __len__(self):
+        return len(_time_shifted_cases())
+
+    def __getitem__(self, i):
+        return _time_shifted_cases()[i]
+
+
+TIME_SHIFTED_CASES = _LazyTimeShiftCases()
+
+
+def run_time_shifted_contracts(
+    cases: Optional[Sequence[TimeShiftCase]] = None,
+) -> List[TimeShiftResult]:
+    """Run the time-shifted-merge check for every windows/drift case."""
+    return [
+        check_time_shifted_case(c) for c in (cases if cases is not None else _time_shifted_cases())
+    ]
 
 
 # --------------------------------------------------------------------------- baseline
@@ -427,11 +600,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"(baseline {baseline.get(r.case.name, 'MERGE_SOUND')}) — {r.detail}")
     for name in stale:
         print(f"merge-contracts: stale baseline entry (class improved or removed): {name}")
+    # the time-shifted-merge check is expected-empty: every windows/drift class
+    # must agree with its single-pass fold, there is nothing to baseline
+    tshift = run_time_shifted_contracts()
+    tshift_failures = [r for r in tshift if not r.ok]
+    for r in tshift_failures:
+        print(f"TIME-SHIFT FAILURE {r.case.name} (boundary={r.boundary}): {r.detail}")
     if not args.quiet:
         detail = ", ".join(f"{k}={v}" for k, v in counts.items())
         print(f"merge-contracts: {len(results)} classes [{detail}], "
-              f"{len(regressions)} regression(s), {len(stale)} stale")
-    return 1 if regressions else 0
+              f"{len(regressions)} regression(s), {len(stale)} stale; "
+              f"time-shifted: {len(tshift)} classes, {len(tshift_failures)} failure(s)")
+    return 1 if (regressions or tshift_failures) else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,20 @@
+"""Drift detection & canary analysis as ordinary fleet metrics (DESIGN §20).
+
+The scenario layer the windowed machinery unlocks: "is live traffic still
+distributed like the reference?" and "did the monitored statistic shift?" as
+registered :class:`~metrics_tpu.Metric` subclasses with fixed-shape states —
+fleet-bucketable, donation-eligible, checkpointable via MTCKPT and
+WAL-replayable with zero new engine code.
+
+* :class:`PSI` — Population Stability Index from paired binned-histogram
+  states (reference vs. live), the canonical feature-drift score.
+* :class:`KSDistance` — Kolmogorov–Smirnov distance ``max |CDF_ref − CDF_live|``
+  from the same paired-histogram state.
+* :class:`CUSUM` — two-sided cumulative-sum change detector with a fixed
+  (4,)-per-side segment state that composes associatively across shards.
+"""
+
+from metrics_tpu.drift.cusum import CUSUM
+from metrics_tpu.drift.histogram import KSDistance, PSI
+
+__all__ = ["CUSUM", "KSDistance", "PSI"]

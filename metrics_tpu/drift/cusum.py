@@ -1,0 +1,95 @@
+"""Two-sided CUSUM change detection with a fixed-shape composable state."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.decay import cusum_compose, cusum_segment
+
+__all__ = ["CUSUM"]
+
+
+class CUSUM(Metric):
+    """Page's two-sided cumulative-sum change detector as a fleet metric.
+
+    Tracks the classic recursions over the monitored statistic ``x``::
+
+        S⁺ ← max(0, S⁺ + (x − target − k))      # upward shift
+        S⁻ ← max(0, S⁻ + (target − x − k))      # downward shift
+
+    and alarms when either side's *watermark* (the highest the statistic got
+    anywhere in the stream, not just its current value — so an excursion
+    inside a batch cannot be missed) exceeds the threshold ``h``.
+
+    The state per side is a fixed (4,) float32 segment summary ``(total,
+    statistic, max-prefix, watermark)`` that composes exactly across stream
+    segments (:func:`metrics_tpu.ops.decay.cusum_compose`): a whole batch
+    folds in one prefix-sum pass, and per-shard partials merge to the
+    single-pass trajectory bit-for-bit. The composition is associative but
+    NOT commutative — a CUSUM trajectory is an order statistic — so the merge
+    harness classifies it CAT_ORDER_SENSITIVE: shard-order-respecting folds
+    (checkpoint restore + WAL replay, ``merge_state`` chains) are exact, while
+    order-oblivious collectives are refused by the declared
+    ``merge_associative=False``.
+
+    ``compute()`` returns (3,) float32: ``[S⁺, S⁻, alarm]`` with alarm 1.0
+    when ``max(watermark⁺, watermark⁻) > h``.
+
+    Args:
+        target: in-control mean of the monitored statistic.
+        k: slack (allowance) per observation, typically half the shift to
+            detect, in the statistic's units (≥ 0).
+        h: decision threshold on the CUSUM statistic (> 0).
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, target: float, k: float = 0.5, h: float = 5.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not float(k) >= 0.0:
+            raise ValueError(f"`k` must be >= 0, got {k}")
+        if not float(h) > 0.0:
+            raise ValueError(f"`h` must be > 0, got {h}")
+        self.target = float(target)
+        self.k = float(k)
+        self.h = float(h)
+        # dist_reduce_fx=None: no order-oblivious reduction exists for an order
+        # statistic; merges must route through the override below, and the
+        # explicit merge_associative=False lets the sync layer refuse folds
+        # with no well-defined cross-shard answer.
+        self.add_state(
+            "pos", default=jnp.zeros((4,), jnp.float32), dist_reduce_fx=None, merge_associative=False
+        )
+        self.add_state(
+            "neg", default=jnp.zeros((4,), jnp.float32), dist_reduce_fx=None, merge_associative=False
+        )
+
+    def update(self, value: Array) -> None:
+        v = jnp.asarray(value, jnp.float32).reshape(-1)
+        ok = jnp.isfinite(v)
+        self.pos = cusum_compose(self.pos, cusum_segment(v - (self.target + self.k), ok))
+        self.neg = cusum_compose(self.neg, cusum_segment((self.target - self.k) - v, ok))
+
+    def compute(self) -> Array:
+        state = self.__dict__["_state"]
+        pos, neg = state["pos"], state["neg"]
+        alarm = jnp.maximum(pos[3], neg[3]) > self.h
+        return jnp.stack([pos[1], neg[1], alarm.astype(jnp.float32)])
+
+    def _merge_state_dicts(
+        self, state_a: Dict[str, Any], state_b: Dict[str, Any], count_a: int, count_b: int
+    ) -> Dict[str, Any]:
+        # `state_a` is the incoming (stream-earlier) side everywhere this is
+        # called: merge_state folds incoming-first, forward-reduce passes the
+        # running global state first, and the merge harness folds shards in
+        # stream order — exactly the order cusum_compose requires.
+        return {
+            "pos": cusum_compose(state_a["pos"], state_b["pos"]),
+            "neg": cusum_compose(state_a["neg"], state_b["neg"]),
+        }

@@ -1,0 +1,116 @@
+"""Distribution-drift scores from paired binned-histogram states."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import bincount
+
+__all__ = ["KSDistance", "PSI"]
+
+_EPS = 1e-6
+
+
+def _drift_histogram_delta(values: Array, *, lo: float, hi: float, num_bins: int) -> Array:
+    """One batch binned into (num_bins + 2,) float32 counts.
+
+    Bin 0 is underflow (v < lo), bin num_bins + 1 overflow (v ≥ hi), interior
+    bins split [lo, hi) evenly. Non-finite values are dropped into a discarded
+    dead bin — branch-free, so the kernel jits and vmaps cleanly.
+    """
+    v = jnp.asarray(values, jnp.float32).reshape(-1)
+    ok = jnp.isfinite(v)
+    scaled = (v - jnp.float32(lo)) / jnp.float32(hi - lo) * num_bins
+    idx = jnp.clip(jnp.floor(scaled).astype(jnp.int32) + 1, 0, num_bins + 1)
+    dead = num_bins + 2
+    return bincount(jnp.where(ok, idx, dead), dead + 1)[:dead].astype(jnp.float32)
+
+
+class _PairedHistogram(Metric):
+    """Shared state layout for histogram-based drift scores.
+
+    Two fixed-shape ``(num_bins + 2,)`` float32 count states over identical
+    bin edges — ``ref_counts`` for the reference distribution, ``live_counts``
+    for production traffic — both plain ``sum`` algebra, so shard merges are
+    exact elementwise adds and the metric keeps the full fleet contract with
+    no merge override. The +2 are explicit under/overflow bins, so mass
+    outside ``[lo, hi)`` still counts toward the score instead of vanishing.
+
+    ``update(live, reference)`` feeds both sides; either may be an empty
+    ``(0,)`` array when only one stream has data this batch (e.g. the
+    reference was loaded once up front).
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, lo: float, hi: float, num_bins: int = 64, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not float(hi) > float(lo):
+            raise ValueError(f"need `hi` > `lo`, got lo={lo}, hi={hi}")
+        if int(num_bins) < 1:
+            raise ValueError(f"`num_bins` must be >= 1, got {num_bins}")
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.num_bins = int(num_bins)
+        shape = (self.num_bins + 2,)
+        self.add_state("ref_counts", default=jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("live_counts", default=jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, live: Array, reference: Array) -> None:
+        self.live_counts = self.live_counts + _drift_histogram_delta(
+            live, lo=self.lo, hi=self.hi, num_bins=self.num_bins
+        )
+        self.ref_counts = self.ref_counts + _drift_histogram_delta(
+            reference, lo=self.lo, hi=self.hi, num_bins=self.num_bins
+        )
+
+    def _proportions(self) -> tuple:
+        state = self.__dict__["_state"]
+        ref, live = state["ref_counts"], state["live_counts"]
+        p_ref = ref / jnp.maximum(jnp.sum(ref), 1.0)
+        p_live = live / jnp.maximum(jnp.sum(live), 1.0)
+        return p_ref, p_live
+
+
+class PSI(_PairedHistogram):
+    """Population Stability Index between reference and live distributions.
+
+    ``PSI = Σ_b (p_live[b] − p_ref[b]) · ln(p_live[b] / p_ref[b])`` over the
+    shared bins (proportions clipped to 1e-6 before the log, the standard
+    zero-bin smoothing). PSI ≥ 0 always; the usual reading is < 0.1 stable,
+    0.1–0.25 moderate shift, > 0.25 action. An empty side contributes uniform
+    epsilon proportions, so a never-updated metric scores 0.0, not NaN.
+
+    Args:
+        lo / hi: value range split into equal-width bins (plus explicit
+            under/overflow bins, so out-of-range mass still drives the score).
+        num_bins: interior bin count over ``[lo, hi)``.
+    """
+
+    def compute(self) -> Array:
+        p_ref, p_live = self._proportions()
+        p_ref = jnp.clip(p_ref, _EPS, 1.0)
+        p_live = jnp.clip(p_live, _EPS, 1.0)
+        return jnp.sum((p_live - p_ref) * jnp.log(p_live / p_ref))
+
+
+class KSDistance(_PairedHistogram):
+    """Kolmogorov–Smirnov distance between reference and live distributions.
+
+    ``D = max_b |CDF_ref[b] − CDF_live[b]|`` evaluated at the shared bin
+    edges — the exact two-sample KS statistic of the binned distributions
+    (a lower bound on the unbinned statistic, tightening as ``num_bins``
+    grows). D ∈ [0, 1]; an empty metric scores 0.0.
+
+    Args: as :class:`PSI`.
+    """
+
+    def compute(self) -> Array:
+        p_ref, p_live = self._proportions()
+        return jnp.max(jnp.abs(jnp.cumsum(p_ref) - jnp.cumsum(p_live)))

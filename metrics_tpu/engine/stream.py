@@ -285,6 +285,14 @@ class StreamEngine:
             raise TPUMetricsUserError(
                 f"StreamEngine.add_session expects a Metric instance, got {type(metric).__name__}"
             )
+        refusal = type(metric).__fleet_refusal__
+        if refusal is not None:
+            # classes that can never ride a bucket say so up front — a clear
+            # error here beats a confusing trace failure (or a silent loose
+            # session redispatching host-side work) on the first tick
+            raise TPUMetricsUserError(
+                f"{type(metric).__name__} cannot join a StreamEngine fleet: {refusal}"
+            )
         if session_id is None:
             sid = self._next_auto
             self._next_auto += 1

@@ -331,6 +331,18 @@ class Metric(ABC):
     """
 
     __jit_ineligible__ = False  # subclasses with host-side update set this
+    # Instance attributes a subclass deliberately keeps out of the shared-compile
+    # key (on top of _JIT_KEY_EXCLUDE). Only for attributes whose trace-relevant
+    # content is FULLY covered by a hashable surrogate attribute that does enter
+    # the key — e.g. windows/ wrappers hold their base Metric under an excluded
+    # attr (a Metric value would make the config unhashable, metric.py:270) and
+    # expose (class path, config fingerprint, state avals) as plain config.
+    __jit_key_exclude__: frozenset = frozenset()
+    # When set to a string, StreamEngine.add_session refuses this class up front
+    # with the message (instead of silently degrading to a loose per-session
+    # dispatch or failing later inside a trace) — e.g. wrappers/running.py's
+    # O(window) host-side splice can never ride a fleet bucket.
+    __fleet_refusal__: Optional[str] = None
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = False
@@ -613,10 +625,11 @@ class Metric(ABC):
         instance attributes, all of which enter this key.
         """
         try:
+            excluded = type(self).__jit_key_exclude__
             items = tuple(
                 (k, _hashable_config_value(v))
                 for k, v in sorted(self.__dict__.items())
-                if k not in _JIT_KEY_EXCLUDE
+                if k not in _JIT_KEY_EXCLUDE and k not in excluded
             )
         except TypeError:
             return None

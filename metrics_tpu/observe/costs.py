@@ -332,6 +332,24 @@ def _make_cases() -> List[ProfileCase]:
         case("StreamingAUROC", lambda: M.StreamingAUROC(num_bins=128), bin_batch),
         case("StreamingCalibrationError", lambda: M.StreamingCalibrationError(num_bins=10),
              bin_batch),
+        # ---- windows & drift (time-decayed / windowed semantics, DESIGN §20) --
+        # timestamps are 0-d f32 *arrays* so submission waves group by aval
+        case("TimeDecayed", lambda: M.TimeDecayed(M.MeanMetric(nan_strategy="disable"),
+                                                  half_life_s=60.0),
+             lambda r: (jnp.asarray(5.0, jnp.float32), _rand(r, _N))),
+        case("TumblingWindow", lambda: M.TumblingWindow(M.SumMetric(nan_strategy="disable"),
+                                                        pane_s=1.0, n_panes=8),
+             lambda r: (jnp.asarray(5.0, jnp.float32), _rand(r, _N))),
+        case("DecayedDDSketch", lambda: M.DecayedDDSketch(half_life_s=60.0, num_buckets=512),
+             lambda r: (jnp.asarray(5.0, jnp.float32), _rand(r, _N) + 0.01)),
+        case("DecayedHLL", lambda: M.DecayedHLL(half_life_s=60.0, p=8),
+             lambda r: (jnp.asarray(5.0, jnp.float32), _rand(r, _N))),
+        case("PSI", lambda: M.PSI(lo=0.0, hi=1.0, num_bins=32),
+             lambda r: (_rand(r, _N), _rand(r, _N))),
+        case("KSDistance", lambda: M.KSDistance(lo=0.0, hi=1.0, num_bins=32),
+             lambda r: (_rand(r, _N), _rand(r, _N))),
+        case("CUSUM", lambda: M.CUSUM(target=0.5, k=0.1, h=5.0),
+             lambda r: (_rand(r, _N),)),
     ]
 
 

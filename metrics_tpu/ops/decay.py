@@ -1,0 +1,144 @@
+"""Time-semantics kernels: exponential decay, pane rotation, CUSUM segment folds.
+
+The L1 layer under ``metrics_tpu/windows/`` and ``metrics_tpu/drift/``
+(DESIGN §20). Everything here is branch-free fixed-shape jnp — jit, vmap and
+donation clean — and everything is expressed so the L2 metric states stay
+*mergeable by declared algebra*:
+
+* **decay** — exponential time-decay as a scalar rescale. A sum-algebra state
+  observed at time ``last_t`` re-weighted to a later reference time ``ref``
+  is ``state * 2^(-(ref - last_t)/half_life)``; the rescale distributes over
+  ``+`` (and over ``max`` for positive registers), so two decayed states
+  brought to a *common* reference time merge with their original algebra.
+  This is the state-space-dual recurrence view of windowed aggregation
+  (PAPERS: 2603.09555): O(1) per update, no buffer splice.
+* **panes** — tumbling-pane bookkeeping for exact sliding windows: each pane
+  is addressed by its absolute pane number ``floor(t / pane_s)``, stored in
+  slot ``pane_id % n_panes``. Writes rotate; nothing is ever spliced.
+* **cusum** — the associative (but order-sensitive) segment summary for
+  CUSUM change detection: per side, ``(total, stat, prefix, watermark)``
+  composes across stream segments exactly (Lin's max-plus segment algebra),
+  so per-shard partials fold to the single-pass trajectory statistic.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+__all__ = [
+    "cusum_compose",
+    "cusum_segment",
+    "decay_weights",
+    "decayed_hll_estimate",
+    "pane_id",
+    "pane_slot_onehot",
+]
+
+
+def decay_weights(last_t: Array, t: Array, half_life_s: float) -> Tuple[Array, Array, Array]:
+    """Common reference time and the two decay factors that bring a state pair to it.
+
+    Returns ``(ref, w_old, w_new)`` with ``ref = max(last_t, t)``,
+    ``w_old = 2^(-(ref - last_t)/half_life)`` applied to the accumulated state
+    and ``w_new = 2^(-(ref - t)/half_life)`` applied to the incoming batch
+    state. Branch-free: an in-order batch (``t >= last_t``) gets
+    ``w_new = 1`` and decays the accumulator; an out-of-order batch decays
+    *itself* by its own age instead, so the fold is order-invariant —
+    the state is always ``Σ_i batch_i · 2^(-(ref - t_i)/half_life)``.
+
+    Both exponents are ≥ 0 by construction, so the weights live in (0, 1] and
+    underflow monotonically to 0.0 for ancient states — no NaN, no Inf.
+    """
+    t = jnp.asarray(t, jnp.float32)
+    last_t = jnp.asarray(last_t, jnp.float32)
+    ref = jnp.maximum(last_t, t)
+    inv_hl = jnp.float32(1.0 / float(half_life_s))
+    w_old = jnp.exp2(-(ref - last_t) * inv_hl)
+    w_new = jnp.exp2(-(ref - t) * inv_hl)
+    return ref, w_old, w_new
+
+
+def pane_id(t: Array, pane_s: float) -> Array:
+    """Absolute pane number of timestamp ``t``: ``floor(t / pane_s)``, () int32."""
+    return jnp.floor(jnp.asarray(t, jnp.float32) / jnp.float32(pane_s)).astype(jnp.int32)
+
+
+def pane_slot_onehot(cur_id: Array, n_panes: int) -> Array:
+    """(n_panes,) bool mask selecting the rotating slot ``cur_id % n_panes``."""
+    return jnp.arange(n_panes, dtype=jnp.int32) == jnp.mod(cur_id, n_panes)
+
+
+def cusum_segment(y: Array, valid: Array) -> Array:
+    """Fold one batch of deviations into a (4,) f32 CUSUM segment summary.
+
+    For a segment with deviations ``y_1..y_n`` (invalid rows contribute 0,
+    the identity of every component) the summary is
+
+    * ``T`` — total ``Σ y_i``;
+    * ``S`` — max suffix sum including the empty suffix: the CUSUM statistic
+      ``s_i = max(0, s_{i-1} + y_i)`` after the segment, started from 0;
+    * ``P`` — max prefix sum including the empty prefix;
+    * ``M`` — the watermark ``max_i s_i``: the highest the statistic got
+      anywhere inside the segment.
+
+    All four come from one prefix-sum pass: with ``c_i = Σ_{j<=i} y_j`` and a
+    virtual ``c_0 = 0``, ``S = c_n − min_i c_i``, ``P = max_i c_i`` and
+    ``M = max_i (c_i − min_{j<=i} c_j)`` (running drawup via ``cummin``).
+    """
+    y = jnp.where(jnp.asarray(valid, bool).reshape(-1), jnp.asarray(y, jnp.float32).reshape(-1), 0.0)
+    c = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(y)])
+    total = c[-1]
+    stat = total - jnp.min(c)
+    prefix = jnp.max(c)
+    watermark = jnp.max(c - lax.cummin(c))
+    return jnp.stack([total, stat, prefix, watermark])
+
+
+def cusum_compose(a: Array, b: Array) -> Array:
+    """Compose two (…, 4) segment summaries, ``a`` strictly *before* ``b`` in stream order.
+
+    The fold is associative but NOT commutative — a CUSUM trajectory is an
+    order statistic — which is exactly the CAT_ORDER_SENSITIVE classification
+    the merge harness records for :class:`metrics_tpu.drift.CUSUM`:
+
+    * ``T = T_a + T_b``
+    * ``S = max(S_b, S_a + T_b)``  (suffix inside b, or spanning a's suffix)
+    * ``P = max(P_a, T_a + P_b)``
+    * ``M = max(M_a, M_b, S_a + P_b)``  (peak in a, peak in b from 0, or
+      a's carried statistic riding b's best prefix)
+    """
+    ta, sa, pa, ma = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+    tb, sb, pb, mb = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack(
+        [
+            ta + tb,
+            jnp.maximum(sb, sa + tb),
+            jnp.maximum(pa, ta + pb),
+            jnp.maximum(jnp.maximum(ma, mb), sa + pb),
+        ],
+        axis=-1,
+    )
+
+
+def decayed_hll_estimate(registers: Array, zero_rank: float = 0.5) -> Array:
+    """HyperLogLog estimate over *fractional* (time-decayed) ranks; () f32.
+
+    Identical to :func:`metrics_tpu.functional.sketches.hll.hll_estimate`
+    except the linear-counting correction treats a register whose decayed rank
+    fell below ``zero_rank`` as empty — a register that has lost more than
+    half its original (≥ 1) rank is "mostly forgotten", and without this the
+    estimate would floor at ``α·m`` instead of decaying toward 0.
+    """
+    m = registers.shape[0]
+    alpha_m = {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7213 / (1.0 + 1.079 / m))
+    regs = registers.astype(jnp.float32)
+    raw = alpha_m * m * m / jnp.sum(jnp.exp2(-regs))
+    zeros = jnp.sum(regs < zero_rank).astype(jnp.float32)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+    two32 = 4294967296.0
+    large = -two32 * jnp.log(jnp.maximum(1.0 - est / two32, 1e-12))
+    return jnp.where(est > two32 / 30.0, large, est)

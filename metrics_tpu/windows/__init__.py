@@ -1,0 +1,23 @@
+"""Windowed & time-decayed streaming semantics (DESIGN §20).
+
+Production metrics are rarely since-process-start. This package recasts
+windowed aggregation as *fixed-shape O(1) recurrences* — no O(window) buffer
+splice, nothing host-side — so every class here keeps the full fleet
+contract: donation-eligible single-dispatch updates, StreamEngine
+bucketability, MTCKPT checkpoints and WAL replay, with merges that stay
+sound under the declared-algebra MapReduce discipline by folding both sides
+to a **common reference time** before applying the original algebra.
+
+* :class:`TimeDecayed` — exponential time-decay as a scalar-rescale fold,
+  for any sum-algebra base metric (``state·2^(−Δt/half_life) + batch``).
+* :class:`TumblingWindow` — exact sliding windows from a rotating stack of
+  tumbling panes addressed by absolute pane number.
+* :class:`DecayedDDSketch` / :class:`DecayedHLL` — time-decayed variants of
+  the ``sketches/`` family via bucket-count / register rescale.
+"""
+
+from metrics_tpu.windows.decay import TimeDecayed
+from metrics_tpu.windows.panes import TumblingWindow
+from metrics_tpu.windows.sketch_decay import DecayedDDSketch, DecayedHLL
+
+__all__ = ["DecayedDDSketch", "DecayedHLL", "TimeDecayed", "TumblingWindow"]

@@ -1,0 +1,168 @@
+"""Exponential time-decay as a scalar-rescale fold over sum-algebra metrics."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.decay import decay_weights
+from metrics_tpu.utils.data import dim_zero_sum
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["TimeDecayed"]
+
+
+def _base_spec(metric: Metric) -> Any:
+    """Hashable stand-in for the held base metric in the jit-cache key.
+
+    The base instance itself is excluded from the key (``__jit_key_exclude__``)
+    because Metric-valued attrs are defined to be unhashable; what the traced
+    update *actually* closes over is the base's static config and state avals,
+    so that pair (plus the class path) is the honest key component. A base
+    whose own config is not fingerprintable poisons the key the usual way: the
+    Metric value itself is returned, ``_hashable_config_value`` raises, and the
+    wrapper is correctly not shareable.
+    """
+    fp = metric.config_fingerprint()
+    if fp is None:
+        return metric
+    cls = type(metric)
+    return (f"{cls.__module__}.{cls.__qualname__}", fp, metric.state_avals())
+
+
+def _validate_decay_base(metric: Metric, wrapper: str) -> None:
+    """Reject base metrics whose update/merge semantics break the decay fold."""
+    if not isinstance(metric, Metric):
+        raise TPUMetricsUserError(f"{wrapper} expects a Metric instance, got {type(metric).__name__}")
+    if type(metric).__jit_ineligible__:
+        raise TPUMetricsUserError(
+            f"{wrapper} cannot wrap {type(metric).__name__}: its update body is "
+            "declared jit-ineligible, so it cannot be traced into the wrapper's "
+            "single-dispatch update."
+        )
+    if metric._has_list_state():
+        raise TPUMetricsUserError(
+            f"{wrapper} cannot wrap {type(metric).__name__}: list ('cat') states "
+            "are variable-shape and have no scalar-rescale decay."
+        )
+    if metric._jit_update_opt is False:
+        raise TPUMetricsUserError(
+            f"{wrapper} cannot wrap this {type(metric).__name__}: its update runs "
+            "host-side (e.g. nan_strategy='warn'/'error'); construct the base "
+            "with a traceable configuration such as nan_strategy='disable'."
+        )
+    if metric.full_state_update is not False:
+        raise TPUMetricsUserError(
+            f"{wrapper} cannot wrap {type(metric).__name__}: the decay fold "
+            "requires batch-local updates (full_state_update=False)."
+        )
+
+
+class TimeDecayed(Metric):
+    """Exponential time-decay for any sum-algebra metric, as an O(1) rescale fold.
+
+    Wraps a base metric *all* of whose states carry the ``sum`` reduce algebra
+    (counts, totals, histograms — e.g. ``SumMetric``, ``MeanMetric``,
+    ``BinnedHistogram``-style states) and reweights every observation by
+    ``2^(-(now - t)/half_life_s)``: an observation ``half_life_s`` old counts
+    half as much, one two half-lives old a quarter, and so on. The state is
+    exactly ``Σ_i batch_i · 2^(-(ref - t_i)/half_life)`` where ``ref`` is the
+    newest timestamp seen — an order-invariant weighted sum, so per-shard
+    partials merge soundly by decaying both sides to a common reference time
+    (carried as the extra synced scalar state ``last_t``) and adding.
+
+    The update is branch-free and fixed-shape: ``state*w_old + batch*w_new``
+    with weights from :func:`metrics_tpu.ops.decay.decay_weights`. It is
+    donation-eligible, fleet-bucketable (the base metric enters the bucket key
+    via its config fingerprint, not its identity), and checkpoint/WAL-eligible
+    with zero engine changes.
+
+    ``update(t, *args, **kwargs)`` prepends a timestamp to the base metric's
+    update signature: ``t`` is a () float32 of *nonnegative stream-relative
+    seconds* (f32 holds ~7 significant digits — epoch nanoseconds will alias).
+    Pass ``t`` as a 0-d array when driving a :class:`~metrics_tpu.StreamEngine`
+    fleet so submission waves group by aval instead of splitting per value.
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> from metrics_tpu.windows import TimeDecayed
+        >>> m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=10.0)
+        >>> m.update(jnp.float32(0.0), jnp.asarray(1.0))
+        >>> m.update(jnp.float32(10.0), jnp.asarray(1.0))  # first obs is 1 half-life old
+        >>> float(m.compute())
+        1.5
+
+    Args:
+        metric: base metric; every registered state must use ``sum`` algebra.
+            A pristine clone is taken, so the passed instance stays untouched.
+        half_life_s: decay half-life in the same unit as ``t`` (> 0).
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    # the held base metric never enters the jit-cache key directly (Metric
+    # values are defined unhashable there); `base_spec` carries its honest
+    # hashable identity instead
+    __jit_key_exclude__ = frozenset({"_base"})
+
+    def __init__(self, metric: Metric, half_life_s: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_decay_base(metric, type(self).__name__)
+        if not float(half_life_s) > 0.0:
+            raise ValueError(f"`half_life_s` must be > 0, got {half_life_s}")
+        bad = [n for n, fn in metric._reductions.items() if fn is not dim_zero_sum]
+        if bad:
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} requires every base state to use the 'sum' "
+                f"reduce algebra (decay distributes over +); {type(metric).__name__} "
+                f"states {bad} do not. Mean-style metrics qualify when their "
+                "numerator and denominator are both registered as sums."
+            )
+        if "last_t" in metric._defaults:
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} reserves the state name 'last_t'; "
+                f"{type(metric).__name__} already registers it."
+            )
+        self.half_life_s = float(half_life_s)
+        base = metric.clone()
+        base.reset()
+        self._base = base
+        self.base_spec = _base_spec(base)
+        for name, default in base._defaults.items():
+            d = jnp.asarray(default)
+            if not jnp.issubdtype(d.dtype, jnp.floating):
+                # integer counts become fractional the moment they decay
+                d = d.astype(jnp.float32)
+            self.add_state(name, default=d, dist_reduce_fx="sum")
+        self.add_state("last_t", default=jnp.zeros((), jnp.float32), dist_reduce_fx="max")
+
+    def update(self, t: Array, *args: Any, **kwargs: Any) -> None:
+        batch = self._base._functional_update(self._base._fresh_state(), *args, **kwargs)
+        ref, w_old, w_new = decay_weights(self.last_t, t, self.half_life_s)
+        for name in self._base._defaults:
+            cur = getattr(self, name)
+            setattr(self, name, cur * w_old + jnp.asarray(batch[name], cur.dtype) * w_new)
+        self.last_t = ref
+
+    def compute(self) -> Any:
+        state = self.__dict__["_state"]
+        return self._base._functional_compute({name: state[name] for name in self._base._defaults})
+
+    def _merge_state_dicts(
+        self, state_a: Dict[str, Any], state_b: Dict[str, Any], count_a: int, count_b: int
+    ) -> Dict[str, Any]:
+        # decay both sides to the common (newer) reference time, then the base
+        # sum algebra applies unchanged — the declared per-state reductions
+        # alone would add states anchored at *different* times, which is why
+        # this override (not `_sync_dist`'s per-state path) is the merge
+        # contract for decayed metrics (DESIGN §20)
+        ref, w_a, w_b = decay_weights(state_a["last_t"], state_b["last_t"], self.half_life_s)
+        out = {name: state_a[name] * w_a + state_b[name] * w_b for name in self._base._defaults}
+        out["last_t"] = ref
+        return out

@@ -1,0 +1,144 @@
+"""Exact sliding windows from a rotating stack of tumbling panes."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.decay import pane_id, pane_slot_onehot
+from metrics_tpu.utils.data import dim_zero_sum
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+from metrics_tpu.windows.decay import _base_spec, _validate_decay_base
+
+__all__ = ["TumblingWindow"]
+
+
+class TumblingWindow(Metric):
+    """Exact sliding-window metrics over the last ``n_panes × pane_s`` seconds.
+
+    Keeps the base metric's sum-algebra states *per tumbling pane* in a fixed
+    ``(n_panes, …)`` stacked axis addressed by the absolute pane number
+    ``floor(t / pane_s)`` stored at rotating slot ``pane_id % n_panes`` — O(1)
+    per update, never a buffer splice, unlike the O(window) deque fold in
+    :class:`metrics_tpu.wrappers.Running`. ``compute()`` folds the panes whose
+    ids fall inside the window ending at the newest pane seen and runs the
+    base compute, so the answer is *exact* over that window (the oldest pane
+    expires wholesale — tumbling, not smoothly sliding, at pane granularity).
+
+    Every state is fixed-shape, the update is branch-free (an out-of-order
+    batch older than the window is dropped via a ``where`` mask rather than
+    clobbering a newer pane), so the wrapper is donation-eligible,
+    fleet-bucketable, and checkpoint/WAL-eligible with zero engine changes.
+    Merging two replicas is slot-wise newest-pane-id-wins (ties: both replicas
+    observed the *same* pane, so their sub-states add) — associative and
+    commutative, hence MERGE_SOUND under the merge harness.
+
+    ``update(t, *args, **kwargs)`` prepends a () float32 timestamp of
+    nonnegative stream-relative seconds to the base update signature; pass it
+    as a 0-d array when driving a fleet so submission waves group by aval.
+
+    Args:
+        metric: base metric; every registered state must use ``sum`` algebra.
+            A pristine clone is taken, so the passed instance stays untouched.
+        pane_s: tumbling pane width in seconds (> 0).
+        n_panes: number of live panes; the window covers ``n_panes * pane_s``
+            seconds ending at the newest pane boundary (≥ 1).
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    # the held base metric never enters the jit-cache key; `base_spec` does
+    __jit_key_exclude__ = frozenset({"_base"})
+
+    def __init__(self, metric: Metric, pane_s: float, n_panes: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_decay_base(metric, type(self).__name__)
+        if not float(pane_s) > 0.0:
+            raise ValueError(f"`pane_s` must be > 0, got {pane_s}")
+        if int(n_panes) < 1:
+            raise ValueError(f"`n_panes` must be >= 1, got {n_panes}")
+        bad = [n for n, fn in metric._reductions.items() if fn is not dim_zero_sum]
+        if bad:
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} requires every base state to use the 'sum' "
+                f"reduce algebra (panes fold by +); {type(metric).__name__} "
+                f"states {bad} do not."
+            )
+        if "pane_ids" in metric._defaults:
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} reserves the state name 'pane_ids'; "
+                f"{type(metric).__name__} already registers it."
+            )
+        self.pane_s = float(pane_s)
+        self.n_panes = int(n_panes)
+        base = metric.clone()
+        base.reset()
+        self._base = base
+        self.base_spec = _base_spec(base)
+        for name, default in base._defaults.items():
+            d = jnp.asarray(default)
+            stacked = jnp.zeros((self.n_panes,) + d.shape, d.dtype) + d
+            self.add_state(name, default=stacked, dist_reduce_fx="sum")
+        # absolute pane number held in each slot; -1 = never written. "max" is
+        # the declared algebra, but real merges run through the slot-aligned
+        # override below.
+        self.add_state(
+            "pane_ids", default=jnp.full((self.n_panes,), -1, jnp.int32), dist_reduce_fx="max"
+        )
+
+    def _pane_mask(self, mask: Array, name: str) -> Array:
+        """Reshape a (n_panes,) mask to broadcast against the stacked state."""
+        extra = jnp.ndim(self._base._defaults[name])
+        return jnp.reshape(mask, (self.n_panes,) + (1,) * extra)
+
+    def update(self, t: Array, *args: Any, **kwargs: Any) -> None:
+        batch = self._base._functional_update(self._base._fresh_state(), *args, **kwargs)
+        cur = pane_id(t, self.pane_s)
+        onehot = pane_slot_onehot(cur, self.n_panes)
+        slot_prev = jnp.sum(jnp.where(onehot, self.pane_ids, 0))
+        # a batch older than what its slot holds has already rotated out of the
+        # window: drop it branch-free instead of clobbering the newer pane
+        accept = cur >= slot_prev
+        write = onehot & accept
+        stale = write & (self.pane_ids != cur)
+        for name in self._base._defaults:
+            stacked = getattr(self, name)
+            kept = jnp.where(self._pane_mask(stale, name), jnp.zeros_like(stacked), stacked)
+            add = self._pane_mask(write, name).astype(stacked.dtype) * jnp.asarray(batch[name], stacked.dtype)
+            setattr(self, name, kept + add)
+        self.pane_ids = jnp.where(write, cur, self.pane_ids)
+
+    def compute(self) -> Any:
+        state = self.__dict__["_state"]
+        ids = state["pane_ids"]
+        live = (ids > jnp.max(ids) - self.n_panes) & (ids >= 0)
+        folded = {
+            name: jnp.sum(
+                state[name] * self._pane_mask(live, name).astype(state[name].dtype), axis=0
+            )
+            for name in self._base._defaults
+        }
+        return self._base._functional_compute(folded)
+
+    def _merge_state_dicts(
+        self, state_a: Dict[str, Any], state_b: Dict[str, Any], count_a: int, count_b: int
+    ) -> Dict[str, Any]:
+        # slot-wise newest-pane-wins; equal ids mean both replicas saw the SAME
+        # pane, so their partial states add. A losing slot's pane id differs by
+        # a multiple of n_panes, putting it outside the merged window — summing
+        # it in would be wrong, which is why the declared per-state algebras
+        # alone do not merge this metric (DESIGN §20).
+        ids_a, ids_b = state_a["pane_ids"], state_b["pane_ids"]
+        out_ids = jnp.maximum(ids_a, ids_b)
+        keep_a, keep_b = ids_a == out_ids, ids_b == out_ids
+        out = {
+            name: state_a[name] * self._pane_mask(keep_a, name).astype(state_a[name].dtype)
+            + state_b[name] * self._pane_mask(keep_b, name).astype(state_b[name].dtype)
+            for name in self._base._defaults
+        }
+        out["pane_ids"] = out_ids
+        return out

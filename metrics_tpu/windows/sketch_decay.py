@@ -1,0 +1,174 @@
+"""Time-decayed variants of the ``sketches/`` family via bucket-count rescale."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.sketches.ddsketch import (
+    ddsketch_delta,
+    ddsketch_gamma,
+    ddsketch_quantiles,
+)
+from metrics_tpu.functional.sketches.hll import hll_delta
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.decay import decay_weights, decayed_hll_estimate
+
+__all__ = ["DecayedDDSketch", "DecayedHLL"]
+
+
+def _require_positive_half_life(half_life_s: float) -> float:
+    if not float(half_life_s) > 0.0:
+        raise ValueError(f"`half_life_s` must be > 0, got {half_life_s}")
+    return float(half_life_s)
+
+
+class DecayedDDSketch(Metric):
+    """Time-decayed streaming quantiles: a DDSketch whose counts forget.
+
+    Identical bucket geometry to :class:`metrics_tpu.sketches.DDSketch`, but
+    the three count states are float32 and every update first rescales them by
+    ``2^(-Δt/half_life_s)`` — an observation one half-life old carries half a
+    count. ``compute()`` therefore estimates the quantiles of the
+    *recency-weighted* value distribution, which is what a latency dashboard
+    or canary wants from an unbounded stream. The state is exactly the
+    per-bucket decayed sum ``Σ_i 1[v_i ∈ bucket]·2^(-(ref-t_i)/half_life)``,
+    order-invariant, so replicas merge by decaying both sides to a common
+    reference time and adding (DESIGN §20).
+
+    ``update(t, value)`` prepends a () float32 timestamp of nonnegative
+    stream-relative seconds to the plain sketch's signature.
+
+    Args: as :class:`~metrics_tpu.sketches.DDSketch`, plus ``half_life_s``.
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        half_life_s: float,
+        alpha: float = 0.01,
+        quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+        num_buckets: int = 2048,
+        key_offset: int | None = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        ddsketch_gamma(alpha)  # validates alpha
+        if num_buckets < 2:
+            raise ValueError(f"`num_buckets` must be >= 2, got {num_buckets}")
+        qs = tuple(float(q) for q in quantiles)
+        if not qs or any(not 0.0 <= q <= 1.0 for q in qs):
+            raise ValueError(f"`quantiles` must be non-empty values in [0, 1], got {quantiles}")
+        self.half_life_s = _require_positive_half_life(half_life_s)
+        self.alpha = float(alpha)
+        self.quantiles = qs
+        self.num_buckets = int(num_buckets)
+        self.key_offset = int(-num_buckets // 2 if key_offset is None else key_offset)
+        self.add_state(
+            "pos_buckets", default=jnp.zeros((self.num_buckets,), jnp.float32), dist_reduce_fx="sum"
+        )
+        self.add_state(
+            "neg_buckets", default=jnp.zeros((self.num_buckets,), jnp.float32), dist_reduce_fx="sum"
+        )
+        self.add_state("zero_count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("last_t", default=jnp.zeros((), jnp.float32), dist_reduce_fx="max")
+
+    def update(self, t: Array, value: Array) -> None:
+        value = jnp.asarray(value)
+        d_pos, d_neg, d_zero = ddsketch_delta(
+            value,
+            jnp.ones(value.shape, bool),
+            alpha=self.alpha,
+            key_offset=self.key_offset,
+            num_buckets=self.num_buckets,
+        )
+        ref, w_old, w_new = decay_weights(self.last_t, t, self.half_life_s)
+        self.pos_buckets = self.pos_buckets * w_old + d_pos.astype(jnp.float32) * w_new
+        self.neg_buckets = self.neg_buckets * w_old + d_neg.astype(jnp.float32) * w_new
+        self.zero_count = self.zero_count * w_old + d_zero.astype(jnp.float32) * w_new
+        self.last_t = ref
+
+    def compute(self) -> Array:
+        return ddsketch_quantiles(
+            self.pos_buckets,
+            self.neg_buckets,
+            self.zero_count,
+            self.quantiles,
+            alpha=self.alpha,
+            key_offset=self.key_offset,
+        )
+
+    def _merge_state_dicts(
+        self, state_a: Dict[str, Any], state_b: Dict[str, Any], count_a: int, count_b: int
+    ) -> Dict[str, Any]:
+        ref, w_a, w_b = decay_weights(state_a["last_t"], state_b["last_t"], self.half_life_s)
+        out = {
+            name: state_a[name] * w_a + state_b[name] * w_b
+            for name in ("pos_buckets", "neg_buckets", "zero_count")
+        }
+        out["last_t"] = ref
+        return out
+
+
+class DecayedHLL(Metric):
+    """Time-decayed distinct-count sketch: HyperLogLog registers that forget.
+
+    Registers are float32 *decaying-max ranks*: ``regs = max(regs·w_old,
+    delta·w_new)``. Because the decay rescale is a positive monotone map it
+    distributes over ``max``, so the state is exactly
+    ``max_i rank_i·2^(-(ref-t_i)/half_life)`` — order-invariant, and two
+    replicas merge by decaying both to a common reference time and taking the
+    elementwise max (DESIGN §20). At ``half_life_s → ∞`` this is bit-for-bit
+    ordinary HyperLogLog; at finite half-life the estimate tracks the
+    *recently seen* cardinality, decaying toward 0 when a key stops appearing.
+    ``compute()`` uses :func:`metrics_tpu.ops.decay.decayed_hll_estimate`,
+    whose linear-counting correction treats a register decayed below rank ½ as
+    empty (a plain ``== 0`` test would floor the estimate at α·m forever).
+
+    ``update(t, values)`` prepends a () float32 timestamp of nonnegative
+    stream-relative seconds to the plain sketch's signature.
+
+    Args: as :class:`~metrics_tpu.sketches.HyperLogLog`, plus ``half_life_s``.
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, half_life_s: float, p: int = 12, seed: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not 4 <= int(p) <= 18:
+            raise ValueError(f"`p` must be in [4, 18], got {p}")
+        self.half_life_s = _require_positive_half_life(half_life_s)
+        self.p = int(p)
+        self.seed = int(seed)
+        self.add_state(
+            "registers", default=jnp.zeros((1 << self.p,), jnp.float32), dist_reduce_fx="max"
+        )
+        self.add_state("last_t", default=jnp.zeros((), jnp.float32), dist_reduce_fx="max")
+
+    def update(self, t: Array, values: Array) -> None:
+        values = jnp.asarray(values)
+        delta = hll_delta(values, jnp.ones(values.shape, bool), p=self.p, seed=self.seed)
+        ref, w_old, w_new = decay_weights(self.last_t, t, self.half_life_s)
+        self.registers = jnp.maximum(
+            self.registers * w_old, delta.astype(jnp.float32) * w_new
+        )
+        self.last_t = ref
+
+    def compute(self) -> Array:
+        return decayed_hll_estimate(self.registers)
+
+    def _merge_state_dicts(
+        self, state_a: Dict[str, Any], state_b: Dict[str, Any], count_a: int, count_b: int
+    ) -> Dict[str, Any]:
+        ref, w_a, w_b = decay_weights(state_a["last_t"], state_b["last_t"], self.half_life_s)
+        return {
+            "registers": jnp.maximum(state_a["registers"] * w_a, state_b["registers"] * w_b),
+            "last_t": ref,
+        }

@@ -4,6 +4,16 @@ The reference keeps ``window`` duplicated state copies ``_states_i`` inside the 
 metric. Here the window is a deque of per-batch state pytrees (immutable arrays, so
 the deque is cheap); the global view is a pure merge-fold of the window — no state
 duplication machinery.
+
+**Legacy windowing primitive.** Every update re-folds the whole deque — O(window)
+host-side merges per step over variable-shape host state, so ``Running`` is not
+jit-traceable, not donation-eligible, and can never ride a
+:class:`~metrics_tpu.StreamEngine` bucket (it refuses fleet registration
+explicitly). For production windowing use the fixed-shape O(1) recurrences in
+:mod:`metrics_tpu.windows` instead: :class:`~metrics_tpu.windows.TumblingWindow`
+for exact count/time panes, :class:`~metrics_tpu.windows.TimeDecayed` for
+exponentially-forgotten aggregates (DESIGN §20). ``Running`` remains for
+update-count windows of small host-side metrics and for reference parity.
 """
 
 from __future__ import annotations
@@ -29,9 +39,21 @@ class Running(WrapperMetric):
     ...     _ = metric.update(jnp.asarray(float(i)))
     >>> metric.compute()  # 3 + 4
     Array(7., dtype=float32)
+
+    .. note::
+        Legacy primitive — the O(window) deque splice keeps every update on the
+        host. Prefer :class:`metrics_tpu.windows.TumblingWindow` (exact sliding
+        windows, O(1), fleet-eligible) or :class:`metrics_tpu.windows.TimeDecayed`
+        (exponential forgetting) for streaming/fleet deployments.
     """
 
     _extra_state_keys = ("_window_states",)
+    __fleet_refusal__ = (
+        "its O(window) deque splice re-folds host-side state every update, so it "
+        "can never share a bucketed dispatch. Use metrics_tpu.windows.TumblingWindow "
+        "(exact sliding windows, O(1) fixed-shape state) or "
+        "metrics_tpu.windows.TimeDecayed (exponential forgetting) instead (DESIGN §20)."
+    )
 
     def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -1,0 +1,247 @@
+"""Behavioral tests for ``metrics_tpu.drift`` (DESIGN §20).
+
+PSI and KS distance against exact numpy oracles over the shared binned
+histogram, CUSUM against a step-by-step Page's-recursion oracle (current
+statistic, watermark-based alarm, and exact segment-composition merges),
+plus registry presence and fleet integration.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.drift import CUSUM, KSDistance, PSI
+
+DRIFT_NAMES = ("PSI", "KSDistance", "CUSUM")
+_EPS = 1e-6
+
+
+def _hist(vals, lo, hi, num_bins):
+    """The oracle twin of ``_drift_histogram_delta``: under/overflow bins 0 and -1."""
+    v = np.asarray(vals, np.float64).reshape(-1)
+    v = v[np.isfinite(v)]
+    idx = np.clip(np.floor((v - lo) / (hi - lo) * num_bins).astype(int) + 1, 0, num_bins + 1)
+    return np.bincount(idx, minlength=num_bins + 2).astype(np.float64)
+
+
+def _proportions(counts):
+    return counts / max(counts.sum(), 1.0)
+
+
+def _psi_oracle(live, ref, lo, hi, num_bins):
+    p_live = np.clip(_proportions(_hist(live, lo, hi, num_bins)), _EPS, 1.0)
+    p_ref = np.clip(_proportions(_hist(ref, lo, hi, num_bins)), _EPS, 1.0)
+    return float(np.sum((p_live - p_ref) * np.log(p_live / p_ref)))
+
+
+def _ks_oracle(live, ref, lo, hi, num_bins):
+    p_live = _proportions(_hist(live, lo, hi, num_bins))
+    p_ref = _proportions(_hist(ref, lo, hi, num_bins))
+    return float(np.max(np.abs(np.cumsum(p_ref) - np.cumsum(p_live))))
+
+
+# ----------------------------------------------------------------- PSI / KS
+def test_psi_matches_oracle_and_reads_right():
+    rng = np.random.RandomState(0)
+    ref = rng.normal(0.0, 1.0, 4096).astype(np.float32)
+    same = rng.normal(0.0, 1.0, 4096).astype(np.float32)
+    shifted = rng.normal(1.5, 1.0, 4096).astype(np.float32)
+
+    stable = PSI(lo=-4.0, hi=4.0, num_bins=32)
+    stable.update(jnp.asarray(same), jnp.asarray(ref))
+    drifted = PSI(lo=-4.0, hi=4.0, num_bins=32)
+    drifted.update(jnp.asarray(shifted), jnp.asarray(ref))
+
+    assert float(stable.compute()) == pytest.approx(
+        _psi_oracle(same, ref, -4.0, 4.0, 32), rel=1e-4, abs=1e-6
+    )
+    assert float(drifted.compute()) == pytest.approx(
+        _psi_oracle(shifted, ref, -4.0, 4.0, 32), rel=1e-4, abs=1e-6
+    )
+    # the standard reading: same distribution < 0.1, a 1.5σ shift is action-level
+    assert float(stable.compute()) < 0.1
+    assert float(drifted.compute()) > 0.25
+
+
+def test_ks_matches_oracle_and_unit_shift_value():
+    rng = np.random.RandomState(1)
+    ref = rng.normal(0.0, 1.0, 8192).astype(np.float32)
+    live = rng.normal(1.0, 1.0, 8192).astype(np.float32)
+    m = KSDistance(lo=-5.0, hi=5.0, num_bins=64)
+    m.update(jnp.asarray(live), jnp.asarray(ref))
+    got = float(m.compute())
+    assert got == pytest.approx(_ks_oracle(live, ref, -5.0, 5.0, 64), rel=1e-4, abs=1e-6)
+    # analytic D for two unit normals one σ apart: 2Φ(1/2) − 1 ≈ 0.3829
+    assert got == pytest.approx(0.3829, abs=0.03)
+
+
+def test_paired_histogram_empty_sides_and_nonfinite():
+    m = PSI(lo=0.0, hi=1.0, num_bins=8)
+    assert float(m.compute()) == pytest.approx(0.0, abs=1e-9)  # never updated: 0, not NaN
+    # reference loaded once up front, live streamed with an empty reference side
+    m.update(jnp.zeros((0,), jnp.float32), jnp.asarray([0.1, 0.2, 0.9], jnp.float32))
+    m.update(jnp.asarray([0.1, np.nan, np.inf, 5.0, -3.0], jnp.float32), jnp.zeros((0,), jnp.float32))
+    counts = np.asarray(jax.device_get(m.live_counts))
+    assert counts.sum() == 3.0  # NaN/Inf dropped; finite out-of-range kept
+    assert counts[0] == 1.0 and counts[-1] == 1.0  # under/overflow bins
+    assert np.isfinite(float(m.compute()))
+
+
+def test_psi_ks_merge_is_bit_level():
+    rng = np.random.RandomState(2)
+    batches = [
+        (rng.rand(64).astype(np.float32), rng.rand(64).astype(np.float32)) for _ in range(6)
+    ]
+    for cls in (PSI, KSDistance):
+        single = cls(lo=0.0, hi=1.0, num_bins=16)
+        early, late = cls(lo=0.0, hi=1.0, num_bins=16), cls(lo=0.0, hi=1.0, num_bins=16)
+        for i, (live, ref) in enumerate(batches):
+            single.update(jnp.asarray(live), jnp.asarray(ref))
+            (early if i < 3 else late).update(jnp.asarray(live), jnp.asarray(ref))
+        late.merge_state(early)
+        assert np.array_equal(
+            np.asarray(jax.device_get(single.compute())),
+            np.asarray(jax.device_get(late.compute())),
+        )
+
+
+# --------------------------------------------------------------------- CUSUM
+def _cusum_oracle(values, target, k):
+    """Page's recursions, one element at a time: final statistics + watermarks."""
+    sp = sn = wp = wn = 0.0
+    for x in np.asarray(values, np.float64).reshape(-1):
+        if not np.isfinite(x):
+            continue
+        sp = max(0.0, sp + (x - target - k))
+        sn = max(0.0, sn + (target - k - x))
+        wp, wn = max(wp, sp), max(wn, sn)
+    return sp, sn, wp, wn
+
+
+def test_cusum_matches_sequential_oracle():
+    rng = np.random.RandomState(3)
+    stream = rng.normal(0.5, 0.2, 400).astype(np.float32)
+    stream[250:] += 0.8  # injected upward shift
+    m = CUSUM(target=0.5, k=0.1, h=5.0)
+    for lo in range(0, 400, 50):  # irregular batching must not matter
+        m.update(jnp.asarray(stream[lo : lo + 50]))
+    sp, sn, wp, wn = _cusum_oracle(stream, 0.5, 0.1)
+    got = np.asarray(jax.device_get(m.compute()))
+    assert got[0] == pytest.approx(sp, rel=1e-4, abs=1e-4)
+    assert got[1] == pytest.approx(sn, rel=1e-4, abs=1e-4)
+    assert got[2] == 1.0  # the shift crossed h = 5
+    assert max(wp, wn) > 5.0
+
+
+def test_cusum_in_control_stays_silent():
+    rng = np.random.RandomState(4)
+    m = CUSUM(target=0.0, k=1.0, h=10.0)
+    m.update(jnp.asarray(rng.normal(0.0, 1.0, 500).astype(np.float32)))
+    out = np.asarray(jax.device_get(m.compute()))
+    assert out[2] == 0.0, out
+
+
+def test_cusum_watermark_catches_excursion_inside_batch():
+    """The alarm keys on the watermark: a spike that decays back below ``h``
+    before the batch ends must still trip it."""
+    calm = np.full(50, 0.5, np.float32)
+    spike = np.concatenate([calm, np.full(10, 3.0, np.float32), np.full(50, -2.0, np.float32)])
+    m = CUSUM(target=0.5, k=0.1, h=5.0)
+    m.update(jnp.asarray(spike))
+    out = np.asarray(jax.device_get(m.compute()))
+    assert out[0] == pytest.approx(0.0, abs=1e-5)  # current S⁺ was dragged back to 0
+    assert out[2] == 1.0  # ...but the excursion is on record
+
+
+def test_cusum_merge_composes_segments_exactly():
+    rng = np.random.RandomState(5)
+    stream = rng.normal(0.5, 0.3, 300).astype(np.float32)
+    single = CUSUM(target=0.5, k=0.05, h=2.0)
+    single.update(jnp.asarray(stream))
+    early, late = CUSUM(target=0.5, k=0.05, h=2.0), CUSUM(target=0.5, k=0.05, h=2.0)
+    early.update(jnp.asarray(stream[:120]))
+    late.update(jnp.asarray(stream[120:]))
+    late.merge_state(early)  # incoming-first: early IS stream-earlier
+    a = np.asarray(jax.device_get(single.compute()))
+    b = np.asarray(jax.device_get(late.compute()))
+    assert np.allclose(a, b, rtol=1e-6, atol=1e-6), (a, b)
+
+
+def test_cusum_rejects_bad_hyperparams():
+    with pytest.raises(ValueError, match="`k`"):
+        CUSUM(target=0.0, k=-0.1)
+    with pytest.raises(ValueError, match="`h`"):
+        CUSUM(target=0.0, h=0.0)
+
+
+# ------------------------------------------------------- registry + fleet
+def test_drift_classes_registered_everywhere():
+    from metrics_tpu.analysis.merge_contracts import MERGE_CASES, TIME_SHIFTED_CASES
+    from metrics_tpu.observe.costs import PROFILE_CASES
+
+    merge_names = {c.name for c in MERGE_CASES}
+    tshift_names = {c.name for c in TIME_SHIFTED_CASES}
+    profile_names = {c.name for c in PROFILE_CASES}
+    for name in DRIFT_NAMES:
+        assert name in merge_names, name
+        assert name in tshift_names, name
+        assert name in profile_names, name
+
+
+def test_cusum_baselined_order_sensitive():
+    """An order statistic has no order-oblivious merge: the harness must
+    classify CUSUM CAT_ORDER_SENSITIVE and the baseline must say so."""
+    import os
+
+    from metrics_tpu.analysis.merge_contracts import load_merge_baseline
+
+    baseline = load_merge_baseline(
+        os.path.join(os.path.dirname(__file__), "..", "tools", "distlint_baseline.json")
+    )
+    assert baseline.get("CUSUM") == "CAT_ORDER_SENSITIVE"
+
+
+def test_time_shifted_merge_quick_subset_drift():
+    from metrics_tpu.analysis.merge_contracts import TIME_SHIFTED_CASES, check_time_shifted_case
+
+    cases = {c.name: c for c in TIME_SHIFTED_CASES}
+    for name in ("PSI", "CUSUM"):
+        res = check_time_shifted_case(cases[name])
+        assert res.ok, f"{name}: {res.detail}"
+
+
+def test_drift_metrics_on_stream_engine():
+    from metrics_tpu.engine import StreamEngine
+
+    engine = StreamEngine(initial_capacity=8)
+    rng = np.random.RandomState(6)
+    psi_ids = [engine.add_session(PSI(lo=0.0, hi=1.0, num_bins=16)) for _ in range(2)]
+    cus_ids = [engine.add_session(CUSUM(target=0.5, k=0.1, h=5.0)) for _ in range(2)]
+    oracles = {sid: PSI(lo=0.0, hi=1.0, num_bins=16) for sid in psi_ids}
+    oracles.update({sid: CUSUM(target=0.5, k=0.1, h=5.0) for sid in cus_ids})
+    for _ in range(3):
+        for sid in psi_ids:
+            args = (rng.rand(16).astype(np.float32), rng.rand(16).astype(np.float32))
+            engine.submit(sid, *args)
+            oracles[sid].update(*args)
+        for sid in cus_ids:
+            args = (rng.rand(16).astype(np.float32),)
+            engine.submit(sid, *args)
+            oracles[sid].update(*args)
+        engine.tick()
+    for sid, oracle in oracles.items():
+        got = np.asarray(jax.device_get(engine.compute(sid)))
+        want = np.asarray(jax.device_get(oracle.compute()))
+        assert np.allclose(got, want, rtol=1e-5, atol=1e-6), (sid, got, want)
+
+
+@pytest.mark.slow  # acceptance-scale harness sweep over the drift classes
+def test_drift_merge_harness_classifications():
+    from metrics_tpu.analysis.merge_contracts import MERGE_CASES, check_merge_case
+
+    expected = {"PSI": "MERGE_SOUND", "KSDistance": "MERGE_SOUND", "CUSUM": "CAT_ORDER_SENSITIVE"}
+    cases = {c.name: c for c in MERGE_CASES if c.name in expected}
+    for name, want in expected.items():
+        res = check_merge_case(cases[name])
+        assert res.classification == want, (name, res.classification, res.detail)

@@ -1,0 +1,347 @@
+"""Behavioral and numeric tests for ``metrics_tpu.windows`` (DESIGN §20).
+
+Covers the decay arithmetic pins the windows subsystem promises:
+
+* ``TimeDecayed``: exact half-life weighting, Δt = 0 and out-of-order
+  timestamps pinned, order invariance, long-horizon (1e6-step) stability
+  through decay-weight underflow, x64-regime parity;
+* ``TumblingWindow``: pane expiry, out-of-order drop, replica merges;
+* ``DecayedDDSketch`` / ``DecayedHLL``: forgetting + parity with the
+  undecayed sketches in the ``half_life → ∞`` limit;
+* base-metric validation, the ``Running`` fleet refusal, and fleet
+  (StreamEngine) integration with timestamped waves.
+
+The registry-wide time-shifted-merge sweep is exercised here too; the full
+sweep is ``slow`` (acceptance scale), with a two-class quick subset kept in
+tier-1.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, SumMetric
+from metrics_tpu.sketches import HyperLogLog
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+from metrics_tpu.windows import DecayedDDSketch, DecayedHLL, TimeDecayed, TumblingWindow
+from metrics_tpu.wrappers import Running
+
+WINDOW_NAMES = ("TimeDecayed", "TumblingWindow", "DecayedDDSketch", "DecayedHLL")
+
+
+def _t(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+# --------------------------------------------------------------- TimeDecayed
+def test_time_decayed_half_life_exact():
+    m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=10.0)
+    m.update(_t(0.0), jnp.asarray(1.0))
+    m.update(_t(10.0), jnp.asarray(1.0))  # first obs now exactly 1 half-life old
+    assert float(m.compute()) == pytest.approx(1.5, abs=1e-6)
+    m.update(_t(20.0), jnp.asarray(1.0))
+    assert float(m.compute()) == pytest.approx(1.75, abs=1e-6)
+
+
+def test_time_decayed_mean_is_recency_weighted():
+    m = TimeDecayed(MeanMetric(nan_strategy="disable"), half_life_s=10.0)
+    m.update(_t(0.0), jnp.asarray([2.0]))
+    m.update(_t(10.0), jnp.asarray([4.0]))
+    # numerator 2*0.5 + 4, denominator 0.5 + 1 — both states decay together
+    assert float(m.compute()) == pytest.approx(5.0 / 1.5, rel=1e-6)
+
+
+def test_time_decayed_dt_zero_pinned():
+    """Two updates at the same timestamp weigh equally: no decay at Δt = 0."""
+    m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=3.0)
+    m.update(_t(5.0), jnp.asarray(2.0))
+    m.update(_t(5.0), jnp.asarray(3.0))
+    assert float(m.compute()) == pytest.approx(5.0, abs=1e-6)
+    assert float(m.last_t) == 5.0
+
+
+def test_time_decayed_out_of_order_pinned():
+    """A late-arriving batch is decayed by its age; the reference never rewinds."""
+    m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=10.0)
+    m.update(_t(10.0), jnp.asarray(1.0))
+    m.update(_t(0.0), jnp.asarray(1.0))  # 1 half-life older than the reference
+    assert float(m.compute()) == pytest.approx(1.5, abs=1e-6)
+    assert float(m.last_t) == 10.0  # max(last_t, t), not last-seen
+
+
+def test_time_decayed_order_invariance():
+    rng = np.random.RandomState(3)
+    stamps = rng.rand(12) * 40.0
+    vals = rng.randn(12).astype(np.float32)
+    perm = rng.permutation(12)
+
+    def run(order):
+        m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=8.0)
+        for i in order:
+            m.update(_t(stamps[i]), jnp.asarray(vals[i]))
+        return float(m.compute())
+
+    assert run(range(12)) == pytest.approx(run(perm), rel=1e-4, abs=1e-5)
+
+
+def test_time_decayed_long_horizon_stability():
+    """1e6 jitted steps: the decayed sum converges to the geometric fixed point
+    and never goes non-finite, even though ``w_old`` underflows partway in."""
+    hl, dt, n = 5.0, 1.0, 1_000_000
+    m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=hl)
+    fns = m.functional()
+    val = jnp.asarray(1.0, jnp.float32)
+
+    @jax.jit
+    def run(state):
+        def body(i, s):
+            return fns.update(s, i.astype(jnp.float32) * dt, val)
+
+        return jax.lax.fori_loop(0, n, body, state)
+
+    final = jax.device_get(run(fns.init()))
+    total = float(np.asarray(fns.compute(final)))
+    expected = 1.0 / (1.0 - 2.0 ** (-dt / hl))  # Σ r^k
+    assert np.isfinite(total)
+    assert total == pytest.approx(expected, rel=1e-3)
+    assert all(np.all(np.isfinite(v)) for v in final.values())
+
+
+def test_time_decayed_underflow_forgets_exactly():
+    """A gap of thousands of half-lives underflows ``w_old`` to exactly 0.0:
+    the state IS the newest batch, with no NaN/Inf from the dead past."""
+    m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=1.0)
+    m.update(_t(0.0), jnp.asarray(123.0))
+    m.update(_t(10_000.0), jnp.asarray(7.0))
+    assert float(m.compute()) == 7.0
+
+
+def test_time_decayed_x64_parity():
+    """The decay fold agrees across dtype regimes: states follow the ambient
+    default float (f64 under ``jax_enable_x64``), the answer does not move."""
+    def run():
+        m = TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=10.0)
+        m.update(_t(0.0), jnp.asarray(1.0, jnp.float32))
+        m.update(_t(10.0), jnp.asarray(1.0, jnp.float32))
+        return float(m.compute())
+
+    base = run()
+    with jax.experimental.enable_x64():
+        wide = run()
+    assert wide == pytest.approx(base, rel=1e-6)
+    assert base == pytest.approx(1.5, abs=1e-6)
+
+
+def test_time_decayed_merge_to_common_reference():
+    stream = [(0.0, 1.0), (4.0, 2.0), (9.0, 3.0), (15.0, 4.0)]
+
+    def fold(pairs, m=None):
+        m = m or TimeDecayed(SumMetric(nan_strategy="disable"), half_life_s=6.0)
+        for ts, v in pairs:
+            m.update(_t(ts), jnp.asarray(v))
+        return m
+
+    single = float(fold(stream).compute())
+    early, late = fold(stream[:2]), fold(stream[2:])
+    late.merge_state(early)  # incoming-first: early IS stream-earlier
+    assert float(late.compute()) == pytest.approx(single, rel=1e-5)
+
+
+# ------------------------------------------------------------ TumblingWindow
+def test_tumbling_window_expiry():
+    m = TumblingWindow(SumMetric(nan_strategy="disable"), pane_s=1.0, n_panes=2)
+    m.update(_t(0.5), jnp.asarray(1.0))
+    m.update(_t(1.5), jnp.asarray(2.0))
+    m.update(_t(2.5), jnp.asarray(4.0))  # pane 2 rotates pane 0 wholesale out
+    assert float(m.compute()) == 6.0
+
+
+def test_tumbling_window_out_of_order_drop_pinned():
+    """A batch older than what its slot holds has left the window: dropped,
+    never clobbering the newer pane."""
+    m = TumblingWindow(SumMetric(nan_strategy="disable"), pane_s=1.0, n_panes=2)
+    m.update(_t(2.5), jnp.asarray(4.0))  # pane 2 → slot 0
+    m.update(_t(0.5), jnp.asarray(1.0))  # pane 0 → slot 0, stale: dropped
+    assert float(m.compute()) == 4.0
+    assert [int(x) for x in m.pane_ids] == [2, -1]
+    # same-pane re-update still accumulates
+    m.update(_t(2.9), jnp.asarray(5.0))
+    assert float(m.compute()) == 9.0
+
+
+def test_tumbling_window_merge_matches_single_pass():
+    stream = [(0.5, 1.0), (1.5, 2.0), (1.8, 3.0), (2.5, 4.0), (3.1, 5.0)]
+
+    def fold(pairs):
+        m = TumblingWindow(SumMetric(nan_strategy="disable"), pane_s=1.0, n_panes=3)
+        for ts, v in pairs:
+            m.update(_t(ts), jnp.asarray(v))
+        return m
+
+    single = float(fold(stream).compute())
+    early, late = fold(stream[:2]), fold(stream[2:])
+    late.merge_state(early)
+    assert float(late.compute()) == pytest.approx(single, rel=1e-6)
+
+
+def test_tumbling_window_mean_base():
+    m = TumblingWindow(MeanMetric(nan_strategy="disable"), pane_s=10.0, n_panes=4)
+    m.update(_t(5.0), jnp.asarray([2.0, 4.0]))
+    m.update(_t(15.0), jnp.asarray([6.0]))
+    assert float(m.compute()) == pytest.approx(4.0, rel=1e-6)  # (2+4+6)/3
+
+
+# ------------------------------------------------------------ decayed sketches
+def test_decayed_ddsketch_forgets_old_regime():
+    m = DecayedDDSketch(half_life_s=1.0, quantiles=(0.5,), num_buckets=512)
+    rng = np.random.RandomState(0)
+    m.update(_t(0.0), jnp.asarray(rng.uniform(9.0, 11.0, 256).astype(np.float32)))
+    # 30 half-lives later the old regime carries ~1e-9 of a count
+    m.update(_t(30.0), jnp.asarray(rng.uniform(99.0, 101.0, 256).astype(np.float32)))
+    med = float(np.ravel(m.compute())[0])
+    assert 95.0 < med < 105.0
+
+
+def test_decayed_hll_matches_plain_hll_at_infinite_half_life():
+    rng = np.random.RandomState(1)
+    vals = rng.randint(0, 500, 800).astype(np.float32)
+    dec = DecayedHLL(half_life_s=1e30, p=8)
+    ref = HyperLogLog(p=8)
+    dec.update(_t(0.0), jnp.asarray(vals))
+    ref.update(jnp.asarray(vals))
+    assert float(dec.compute()) == pytest.approx(float(ref.compute()), rel=1e-4)
+
+
+def test_decayed_hll_forgets():
+    m = DecayedHLL(half_life_s=1.0, p=8)
+    rng = np.random.RandomState(2)
+    m.update(_t(0.0), jnp.asarray(rng.randint(0, 1000, 512).astype(np.float32)))
+    crowd = float(m.compute())
+    # long silence, then a lone straggler: the crowd has decayed away
+    m.update(_t(200.0), jnp.asarray(np.asarray([1234.0], np.float32)))
+    lone = float(m.compute())
+    assert crowd > 100.0
+    assert lone < 10.0
+
+
+# ------------------------------------------------------- validation + refusal
+def test_wrappers_reject_untraceable_base():
+    with pytest.raises(TPUMetricsUserError, match="host-side"):
+        TimeDecayed(SumMetric(nan_strategy="warn"), half_life_s=1.0)
+    with pytest.raises(TPUMetricsUserError, match="host-side"):
+        TumblingWindow(SumMetric(nan_strategy="error"), pane_s=1.0, n_panes=2)
+
+
+def test_wrappers_reject_non_sum_and_list_bases():
+    with pytest.raises(TPUMetricsUserError, match="cannot wrap"):
+        TimeDecayed(MaxMetric(nan_strategy="disable"), half_life_s=1.0)
+    with pytest.raises(TPUMetricsUserError, match="cannot wrap"):
+        TumblingWindow(CatMetric(nan_strategy="disable"), pane_s=1.0, n_panes=2)
+
+
+def test_wrappers_reject_bad_hyperparams():
+    base = SumMetric(nan_strategy="disable")
+    with pytest.raises(ValueError, match="half_life_s"):
+        TimeDecayed(base, half_life_s=0.0)
+    with pytest.raises(ValueError, match="pane_s"):
+        TumblingWindow(base, pane_s=0.0, n_panes=2)
+    with pytest.raises(ValueError, match="n_panes"):
+        TumblingWindow(base, pane_s=1.0, n_panes=0)
+    with pytest.raises(ValueError, match="half_life_s"):
+        DecayedHLL(half_life_s=-1.0)
+
+
+def test_running_refuses_fleet_registration():
+    """The legacy O(window) splice can never share a bucketed dispatch — the
+    engine must say so explicitly instead of failing downstream."""
+    from metrics_tpu.aggregation import RunningMean
+    from metrics_tpu.engine import StreamEngine
+
+    engine = StreamEngine(initial_capacity=4)
+    with pytest.raises(TPUMetricsUserError, match="cannot join a StreamEngine fleet"):
+        engine.add_session(Running(SumMetric(), window=2))
+    with pytest.raises(TPUMetricsUserError, match="TumblingWindow"):
+        engine.add_session(RunningMean(window=3))
+    # ...while the replacement primitives are welcome
+    sid = engine.add_session(TimeDecayed(MeanMetric(nan_strategy="disable"), half_life_s=5.0))
+    assert sid is not None
+
+
+# ----------------------------------------------------------- fleet integration
+def test_windows_metrics_on_stream_engine():
+    """Timestamped waves through the fleet: one donated dispatch per bucket,
+    computes bit-identical to per-instance oracles."""
+    from metrics_tpu.engine import StreamEngine
+
+    ctors = {
+        "td": lambda: TimeDecayed(MeanMetric(nan_strategy="disable"), half_life_s=20.0),
+        "tw": lambda: TumblingWindow(SumMetric(nan_strategy="disable"), pane_s=5.0, n_panes=3),
+        "hll": lambda: DecayedHLL(half_life_s=50.0, p=6),
+    }
+    engine = StreamEngine(initial_capacity=8)
+    rng = np.random.RandomState(11)
+    sessions, oracles = {}, {}
+    for kind, ctor in ctors.items():
+        for _ in range(2):
+            sid = engine.add_session(ctor())
+            sessions[sid] = kind
+            oracles[sid] = ctor()
+    for tick in range(3):
+        ts = _t(4.0 * tick)
+        for sid, kind in sessions.items():
+            vals = jnp.asarray(rng.rand(8).astype(np.float32) * 100.0)
+            engine.submit(sid, ts, vals)
+            oracles[sid].update(ts, vals)
+        engine.tick()
+    for sid in sessions:
+        got = np.asarray(jax.device_get(engine.compute(sid)))
+        want = np.asarray(jax.device_get(oracles[sid].compute()))
+        assert np.allclose(got, want, rtol=1e-5, atol=1e-6), (sessions[sid], got, want)
+
+
+# ------------------------------------------------------------ registry sweeps
+def test_windows_classes_registered_everywhere():
+    """Every windows class rides the shared registries: merge harness,
+    time-shifted harness, and the profile (costs) registry."""
+    from metrics_tpu.analysis.merge_contracts import MERGE_CASES, TIME_SHIFTED_CASES
+    from metrics_tpu.observe.costs import PROFILE_CASES
+
+    merge_names = {c.name for c in MERGE_CASES}
+    tshift_names = {c.name for c in TIME_SHIFTED_CASES}
+    profile_names = {c.name for c in PROFILE_CASES}
+    for name in WINDOW_NAMES:
+        assert name in merge_names, name
+        assert name in tshift_names, name
+        assert name in profile_names, name
+
+
+def test_time_shifted_merge_quick_subset():
+    """One decayed + one pane-aligned class stay in tier-1; the full sweep is
+    the slow test below."""
+    from metrics_tpu.analysis.merge_contracts import TIME_SHIFTED_CASES, check_time_shifted_case
+
+    cases = {c.name: c for c in TIME_SHIFTED_CASES}
+    for name in ("TimeDecayed", "TumblingWindow"):
+        res = check_time_shifted_case(cases[name])
+        assert res.ok, f"{name}: {res.detail}"
+
+
+@pytest.mark.slow  # acceptance-scale sweep: every windows/drift class, each
+# building full update/merge programs — minutes, not tier-1 material
+def test_time_shifted_merge_full_sweep():
+    from metrics_tpu.analysis.merge_contracts import run_time_shifted_contracts
+
+    results = run_time_shifted_contracts()
+    bad = [r for r in results if not r.ok]
+    assert not bad, [(r.case.name, r.detail) for r in bad]
+
+
+@pytest.mark.slow  # same scale: the generic merge harness over the new classes
+def test_windows_merge_harness_classifications():
+    from metrics_tpu.analysis.merge_contracts import MERGE_CASES, check_merge_case
+
+    cases = {c.name: c for c in MERGE_CASES if c.name in WINDOW_NAMES}
+    for name in WINDOW_NAMES:
+        res = check_merge_case(cases[name])
+        assert res.classification == "MERGE_SOUND", (name, res.classification, res.detail)
