@@ -19,7 +19,10 @@ Configs (BASELINE.md "Targets"):
 Extras outside the geomean: retrieval_device_sort (TPU sort path), bootstrap
 (replica engine vs our loop fallback), and fleet (StreamEngine driving 10k
 concurrent heterogeneous metric streams at one donated dispatch per bucket per
-tick, dispatch economy asserted from the observe counters), recovery (a
+tick, dispatch economy asserted from the observe counters), fleet_sharded
+(100k sessions hash-partitioned across 8 shards in a forced-8-device
+subprocess: one compiled program shared by every shard, zero churn recompiles,
+per-shard restore time flat in fleet size), recovery (a
 1k-stream fleet checkpointed, crashed with a pending wave in the ingest WAL,
 restored + replayed bit-exact, ckpt/restore counters asserted), and cold_start
 (first-update wall time with a cold AOT executable cache — trace + compile +
@@ -66,6 +69,15 @@ DRIFT_STREAMS = 1000
 DRIFT_TICKS = 4
 DRIFT_CHURN = 64
 DRIFT_BATCH = 16
+SHARDED_SESSIONS = 100_000
+SHARDED_SHARDS = 8
+SHARDED_TICKS = 4
+SHARDED_ACTIVE = 2048
+SHARDED_CHURN = 512
+SHARDED_BATCH = 16
+SHARDED_CAPACITY = 1 << 14
+SHARDED_RECOVERY_PER_SHARD = 900
+SHARDED_RECOVERY_RATIO_MAX = 3.0
 
 
 # ----------------------------------------------------------------- roofline
@@ -598,6 +610,227 @@ def bench_fleet(with_ref: bool = True):
             "[1 donated dispatch/bucket/tick, zero churn recompiles; not in geomean]"
         ),
     }
+
+
+# ------------------------------------------------- extra: sharded fleet engine
+def _stream_mean_cls():
+    """Build (once) the bench-local two-scalar metric and register it as a
+    module global, so the durable fleets' ingest WAL can pickle it. Deferred
+    because bench.py keeps jax/metrics_tpu imports out of module import."""
+    cls = globals().get("StreamMean")
+    if cls is not None:
+        return cls
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Metric
+
+    class StreamMean(Metric):
+        # the 100k population should time the engine's routing/bucketing, not
+        # a heavyweight metric constructor
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("count", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+            self.count = self.count + x.shape[0]
+
+        def compute(self):
+            return self.total / jnp.maximum(self.count, 1.0)
+
+    StreamMean.__qualname__ = "StreamMean"
+    globals()["StreamMean"] = StreamMean
+    return StreamMean
+
+
+def _bench_fleet_sharded_child():
+    """Subprocess body for :func:`bench_fleet_sharded`.
+
+    Runs with ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (set by
+    the parent BEFORE jax initializes) so the shard→device pinning in
+    ``engine/sharded.py`` is exercised against a real 8-device topology without
+    perturbing the parent bench process's backend. Prints ONE JSON line.
+    """
+    import glob
+    import tempfile
+
+    import jax
+
+    from metrics_tpu.engine import ShardedStreamEngine
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.engine.durability import restore_fleet_checkpoint
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.observe import recorder as rec_mod
+
+    assert len(jax.devices()) == SHARDED_SHARDS, jax.devices()
+    StreamMean = _stream_mean_cls()
+
+    rng = np.random.default_rng(11)
+    pool = [rng.random(SHARDED_BATCH, dtype=np.float32) for _ in range(16)]
+
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    probe = rec_mod.Recorder()
+    rec_mod.RECORDER, rec_mod.ENABLED = probe, True
+    _FLEET_JIT_CACHE.clear()
+    try:
+        fleet = ShardedStreamEngine(
+            n_shards=SHARDED_SHARDS, initial_capacity=SHARDED_CAPACITY, name="bench"
+        )
+        t0 = time.perf_counter()
+        sids = [fleet.add_session(StreamMean()) for _ in range(SHARDED_SESSIONS)]
+        populate_s = time.perf_counter() - t0
+
+        # bit-exactness spot check: a few sampled streams carry a per-instance
+        # oracle metric fed identical batches (full oracles live in tests/)
+        oracles = {sid: StreamMean() for sid in sids[:: SHARDED_SESSIONS // 4][:4]}
+
+        compiles_pre_churn = None
+        tick_dispatches = []
+        cursor = 0
+        t0 = time.perf_counter()
+        for t in range(SHARDED_TICKS):
+            window = [sids[(cursor + i) % len(sids)] for i in range(SHARDED_ACTIVE)]
+            cursor += SHARDED_ACTIVE
+            active = [sid for sid in window if sid not in oracles]
+            for i, sid in enumerate(active):
+                fleet.submit(sid, pool[(i + t) % len(pool)])
+            for sid, oracle in oracles.items():
+                fleet.submit(sid, pool[t % len(pool)])
+                oracle.update(pool[t % len(pool)])
+            tick_dispatches.append(fleet.tick())
+            if t == 0:
+                compiles_pre_churn = dict(probe.counters)
+            if t == SHARDED_TICKS // 2:
+                # churn within padded capacity: expired slots recycle, arrivals
+                # re-hash through the normal path — must not recompile
+                doomed = set(active[:SHARDED_CHURN])
+                for sid in doomed:
+                    fleet.expire(sid)
+                fresh = [fleet.add_session(StreamMean()) for _ in range(SHARDED_CHURN)]
+                sids = [s for s in sids if s not in doomed] + fresh
+        wall = time.perf_counter() - t0
+
+        for sid, oracle in oracles.items():
+            got = float(np.asarray(fleet.compute(sid)))
+            want = float(np.asarray(oracle.compute()))
+            assert abs(got - want) < 1e-6, (sid, got, want)
+
+        t0 = time.perf_counter()
+        merged = fleet.aggregate(StreamMean())
+        aggregate_s = time.perf_counter() - t0
+        assert merged._update_count >= SHARDED_TICKS * SHARDED_ACTIVE - SHARDED_CHURN
+
+        counters = {}
+        for (name, label), v in probe.counters.items():
+            counters.setdefault(name, {})[label] = v
+        stats = fleet.stats()
+    finally:
+        rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+        _FLEET_JIT_CACHE.clear()
+
+    update_compiles = {
+        k: v for k, v in counters.get("fleet_compile", {}).items() if not k.endswith(":compute")
+    }
+    pre_churn_compiles = sum(
+        v for (n, label), v in compiles_pre_churn.items()
+        if n == "fleet_compile" and not label.endswith(":compute")
+    )
+    dispatches = sum(counters.get("fleet_dispatch", {}).values())
+    flushes = sum(counters.get("fleet_flush", {}).values())
+    # the three claims the sharded fleet exists for, checked from telemetry:
+    # (1) all 8 shards share ONE compiled update program (cache key excludes
+    #     the engine label), (2) zero recompiles across churn, (3) at most one
+    #     donated dispatch per touched shard-bucket per tick
+    assert sum(update_compiles.values()) == 1, counters
+    assert sum(update_compiles.values()) - pre_churn_compiles == 0, counters
+    assert dispatches / max(flushes, 1) <= 1.0 + 1e-9, counters
+    assert all(d <= SHARDED_SHARDS for d in tick_dispatches), tick_dispatches
+
+    # recovery scaling: single-shard restore time must not grow with fleet
+    # size — durable fleets at 2 and 8 shards, equal per-shard population,
+    # time a fresh-engine restore of shard 0 from its own manifest entry
+    def _shard0_restore_s(n_shards: int, root: str) -> float:
+        wal_dir = os.path.join(root, f"wal{n_shards}")
+        os.makedirs(wal_dir, exist_ok=True)
+        durable = ShardedStreamEngine(
+            n_shards=n_shards, initial_capacity=1 << 10,
+            wal_dir=wal_dir, name=f"rec{n_shards}",
+        )
+        for _ in range(n_shards * SHARDED_RECOVERY_PER_SHARD):
+            durable.add_session(StreamMean())
+        for sid in durable.session_ids()[:256]:
+            durable.submit(sid, pool[0])
+        durable.tick()
+        ckpt_dir = os.path.join(root, f"ckpt{n_shards}")
+        durable.checkpoint(ckpt_dir)
+        ckpt = sorted(glob.glob(os.path.join(ckpt_dir, "*-shard000.mtckpt")))[-1]
+        best = float("inf")
+        for _ in range(3):
+            fresh = StreamEngine(initial_capacity=1 << 10)
+            t0 = time.perf_counter()
+            restore_fleet_checkpoint(fresh, ckpt)
+            best = min(best, time.perf_counter() - t0)
+        # crc32 routing is uniform-ish, not exact: shard 0 holds ~per_shard
+        assert abs(len(fresh) - SHARDED_RECOVERY_PER_SHARD) < SHARDED_RECOVERY_PER_SHARD // 4
+        return best
+
+    with tempfile.TemporaryDirectory() as root:
+        small_s = _shard0_restore_s(2, root)
+        large_s = _shard0_restore_s(SHARDED_SHARDS, root)
+    ratio = large_s / small_s
+    assert ratio < SHARDED_RECOVERY_RATIO_MAX, (small_s, large_s)
+
+    print(json.dumps({
+        "sessions": SHARDED_SESSIONS,
+        "shards": SHARDED_SHARDS,
+        "ticks": SHARDED_TICKS,
+        "active_per_tick": SHARDED_ACTIVE,
+        "churn": SHARDED_CHURN,
+        "populate_s": round(populate_s, 3),
+        "ms_per_tick": round(1000 * wall / SHARDED_TICKS, 3),
+        "dispatches_per_tick": tick_dispatches,
+        "update_compiles_total": sum(update_compiles.values()),
+        "recompiles_after_churn": sum(update_compiles.values()) - pre_churn_compiles,
+        "aggregate_ms": round(1000 * aggregate_s, 3),
+        "occupancy_pct": stats["occupancy_pct"],
+        "shard0_restore_s": {
+            "fleet_2shard": round(small_s, 4),
+            f"fleet_{SHARDED_SHARDS}shard": round(large_s, 4),
+            "ratio": round(ratio, 3),
+        },
+        "workload": (
+            f"{SHARDED_SESSIONS} sessions / {SHARDED_SHARDS} shards x {SHARDED_TICKS} ticks "
+            f"({SHARDED_ACTIVE} active/tick, churn {SHARDED_CHURN}) [1 shared program, "
+            "zero churn recompiles, per-shard restore flat in fleet size; not in geomean]"
+        ),
+    }))
+
+
+def bench_fleet_sharded(with_ref: bool = True):
+    """Sharded fleet (``engine/sharded.py``): 100k sessions hash-partitioned
+    across 8 shards, run in a SUBPROCESS so ``XLA_FLAGS`` can force an 8-device
+    host topology before jax initializes there — the parent's backend (and every
+    other config's timing) is untouched. The child asserts dispatch economy and
+    recovery scaling from live observe counters (see ``_bench_fleet_sharded_child``);
+    no torch analog, stays out of the geomean."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharded-child"],
+        env=env, capture_output=True, text=True, timeout=900, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "<no output>").strip().splitlines()[-12:]
+        raise RuntimeError("sharded-fleet child failed: " + " | ".join(tail))
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    return json.loads(lines[-1])
 
 
 # ---------------------------------------------------------------- extra: drift
@@ -1158,6 +1391,12 @@ def main():
     except Exception as err:  # noqa: BLE001
         configs["fleet"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "fleet")
+    # sharded fleet: 100k sessions over 8 shards, subprocess with forced devices
+    try:
+        configs["fleet_sharded"] = bench_fleet_sharded(with_ref=with_ref)
+    except Exception as err:  # noqa: BLE001
+        configs["fleet_sharded"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "fleet_sharded")
     # windowed + drift metrics on the fleet: 1k streams x 3 classes, timestamped waves
     try:
         configs["drift"] = bench_drift(with_ref=with_ref)
@@ -1210,4 +1449,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--sharded-child" in sys.argv[1:]:
+        _bench_fleet_sharded_child()
+    else:
+        main()

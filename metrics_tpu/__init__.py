@@ -118,6 +118,7 @@ _LAZY_EXPORTS = {
     "WordErrorRate": "metrics_tpu.text",
     "WordInfoLost": "metrics_tpu.text",
     "WordInfoPreserved": "metrics_tpu.text",
+    "ShardedStreamEngine": "metrics_tpu.engine",
     "StreamEngine": "metrics_tpu.engine",
     "DecayedDDSketch": "metrics_tpu.windows",
     "DecayedHLL": "metrics_tpu.windows",

@@ -37,12 +37,22 @@ snapshots must be rejected with the previous snapshot still recoverable, and
 a quarantined row must never cost its bucket the one-dispatch-per-tick
 economy.
 
+A third suite covers the sharded fleet's DESIGN §21 contract
+(:func:`check_shard_chaos_case`): a :class:`ShardedStreamEngine` whose host is
+killed must restore bit-exact with every shard replaying ONLY its own journal;
+a lost per-shard checkpoint file must rebuild from journal alone when the
+snapshot covered nothing, raise by default otherwise, and under
+``on_lost_shard="demote"`` come back demoted while every surviving shard is
+bit-exact AND keeps its one-dispatch-per-bucket-per-tick economy; a torn
+manifest must be rejected outright; and an elastic resize (grow and shrink)
+must re-route every session bit-exactly versus the never-crashed oracle.
+
 Every broken promise is a violation keyed by class name, baselined in the
-``chaos`` (metric faults) and ``fleet`` (engine recovery) sections of
-``tools/chaos_baseline.json`` (expected empty; every entry needs a
-justification string). Runs as the ``chaos`` pass of ``tools/lint_metrics
---all`` / the ``chaoslint`` console script and standalone via ``python -m
-metrics_tpu.analysis.chaos_contracts``.
+``chaos`` (metric faults), ``fleet`` (engine recovery) and ``shard`` (sharded
+fleet) sections of ``tools/chaos_baseline.json`` (expected empty; every entry
+needs a justification string). Runs as the ``chaos`` pass of
+``tools/lint_metrics --all`` / the ``chaoslint`` console script and standalone
+via ``python -m metrics_tpu.analysis.chaos_contracts``.
 """
 
 from __future__ import annotations
@@ -56,6 +66,7 @@ __all__ = [
     "chaos_cases",
     "check_chaos_case",
     "check_fleet_chaos_case",
+    "check_shard_chaos_case",
     "diff_chaos_baseline",
     "main",
     "run_chaos_check",
@@ -774,6 +785,301 @@ def collect_fleet_chaos_report(cases: Optional[Sequence[Any]] = None) -> List[Ch
     return [check_fleet_chaos_case(c) for c in (cases if cases is not None else chaos_cases())]
 
 
+# --------------------------------------------------------- sharded fleet suite
+_SHARD_N = 2  # shards per scenario fleet (small, but every cross-shard seam)
+
+
+def _shard_sids(n_shards: int, per_shard: int = 2) -> List[str]:
+    """Deterministic session ids covering every shard ``per_shard`` times."""
+    from metrics_tpu.engine.sharded import shard_of
+
+    got = {k: 0 for k in range(n_shards)}
+    out: List[str] = []
+    i = 0
+    while any(v < per_shard for v in got.values()):
+        sid = f"s{i}"
+        i += 1
+        k = shard_of(sid, n_shards)
+        if got[k] < per_shard:
+            got[k] += 1
+            out.append(sid)
+    return out
+
+
+def _shard_script(case: Any, sids: Sequence[str], n_batches: int) -> List[Tuple[str, Tuple[Any, ...]]]:
+    rng = _rng_for(case)
+    return [(sids[i % len(sids)], case.batch(rng)) for i in range(n_batches)]
+
+
+def _shard_oracle(case: Any, sids: Sequence[str], script: Sequence[Tuple[str, Tuple[Any, ...]]]) -> Dict[str, str]:
+    """Per-session fingerprints from a never-crashed (unsharded) engine: the
+    sharding layer must never change any session's numbers."""
+    from metrics_tpu.engine.stream import StreamEngine
+
+    eng = StreamEngine()
+    for sid in sids:
+        eng.add_session(case.ctor(), sid)
+    for sid, batch in script:
+        eng.submit(sid, *batch)
+    eng.tick()
+    return {sid: eng.expire(sid).state_fingerprint() for sid in sids}
+
+
+def _shard_crash(fleet: Any) -> None:
+    """Simulate a host kill: journals are on disk, nothing else survives."""
+    for shard in fleet._shards:
+        if shard._wal is not None:
+            shard._wal.sync()
+            shard._wal.close()
+
+
+def _diff_shard_fingerprints(fault: str, got: Dict[str, str], want: Dict[str, str]) -> List[str]:
+    return [
+        f"{fault}: session {sid} not bit-exact vs the never-crashed oracle"
+        for sid in want
+        if got.get(sid) != want[sid]
+    ]
+
+
+def _shard_ckpt_file(d: str, gen: int, k: int) -> str:
+    return os.path.join(d, f"g{gen:08d}-shard{k:03d}.mtckpt")
+
+
+def _shard_scenario_host_kill(case: Any, tmp: str) -> List[str]:
+    """Kill the host with a journal tail past the last checkpoint: restore must
+    be bit-exact, with each shard replaying only its own journal."""
+    from metrics_tpu.engine.sharded import ShardedStreamEngine
+
+    d = os.path.join(tmp, "host_kill")
+    sids = _shard_sids(_SHARD_N)
+    script = _shard_script(case, sids, 8)
+    cut = 5
+    fleet = ShardedStreamEngine(n_shards=_SHARD_N, wal_dir=d)
+    for sid in sids:
+        fleet.add_session(case.ctor(), sid)
+    for sid, batch in script[:cut]:
+        fleet.submit(sid, *batch)
+    fleet.tick()
+    fleet.checkpoint(d)
+    for sid, batch in script[cut:]:
+        fleet.submit(sid, *batch)
+    _shard_crash(fleet)  # the post-checkpoint tail lives only in the journals
+    rec = ShardedStreamEngine.restore(d)
+    rec.tick()
+    got = {sid: rec.expire(sid).state_fingerprint() for sid in sids}
+    return _diff_shard_fingerprints("shard_kill[host]", got, _shard_oracle(case, sids, script))
+
+
+def _shard_scenario_lost_recoverable(case: Any, tmp: str) -> List[str]:
+    """Delete one shard's checkpoint file whose snapshot covered nothing: that
+    shard must rebuild from its journal alone, bit-exact, no flags needed."""
+    from metrics_tpu.engine.sharded import ShardedStreamEngine
+
+    d = os.path.join(tmp, "lost_recoverable")
+    sids = _shard_sids(_SHARD_N)
+    script = _shard_script(case, sids, 6)
+    fleet = ShardedStreamEngine(n_shards=_SHARD_N, wal_dir=d)
+    fleet.checkpoint(d)  # snapshot of the empty fleet: the journal IS the history
+    for sid in sids:
+        fleet.add_session(case.ctor(), sid)
+    for sid, batch in script:
+        fleet.submit(sid, *batch)
+    _shard_crash(fleet)
+    os.remove(_shard_ckpt_file(d, 1, 0))
+    rec = ShardedStreamEngine.restore(d)
+    rec.tick()
+    got = {sid: rec.expire(sid).state_fingerprint() for sid in sids}
+    return _diff_shard_fingerprints("shard_lost[recoverable]", got, _shard_oracle(case, sids, script))
+
+
+def _shard_scenario_lost_unrecoverable(case: Any, tmp: str) -> List[str]:
+    """Bit-flip one shard's checkpoint file that DID cover state: the default
+    restore must refuse; ``on_lost_shard="demote"`` must bring the fleet back
+    with the lost shard empty + demoted, every surviving session bit-exact, and
+    the surviving shards still at one dispatch per bucket per tick."""
+    from metrics_tpu.engine.sharded import ShardedStreamEngine, shard_of
+    from metrics_tpu.resilience.checkpoint import CheckpointError
+
+    bad: List[str] = []
+    d = os.path.join(tmp, "lost_unrecoverable")
+    sids = _shard_sids(_SHARD_N)
+    survivors = [sid for sid in sids if shard_of(sid, _SHARD_N) != 0]
+    rng = _rng_for(case)
+    pre = [(sids[i % len(sids)], case.batch(rng)) for i in range(6)]
+    extra = [(sid, case.batch(rng)) for sid in survivors]  # lands post-restore
+    fleet = ShardedStreamEngine(n_shards=_SHARD_N, wal_dir=d)
+    for sid in sids:
+        fleet.add_session(case.ctor(), sid)
+    for sid, batch in pre:
+        fleet.submit(sid, *batch)
+    fleet.tick()
+    fleet.checkpoint(d)
+    _shard_crash(fleet)
+    fpath = _shard_ckpt_file(d, 1, 0)
+    with open(fpath, "rb") as fh:
+        blob = fh.read()
+    with open(fpath, "wb") as fh:
+        fh.write(blob[:-1] + bytes([blob[-1] ^ 0xFF]))
+    try:
+        ShardedStreamEngine.restore(d)
+        bad.append("shard_lost[strict]: corrupt shard checkpoint was accepted")
+    except CheckpointError:
+        pass
+    rec = ShardedStreamEngine.restore(d, on_lost_shard="demote")
+    if sorted(rec._demoted) != [0]:
+        bad.append(f"shard_lost[demote]: demoted set is {sorted(rec._demoted)}, expected [0]")
+    if set(rec.session_ids()) != set(survivors):
+        bad.append("shard_lost[demote]: surviving session population is wrong")
+    # the surviving shard keeps its dispatch economy while shard 0 sits demoted
+    for sid, batch in extra:
+        rec.submit(sid, *batch)
+    dispatches = rec.tick()
+    if dispatches != 1:
+        bad.append(
+            f"shard_lost[demote]: surviving shard cost {dispatches} dispatches for one bucket"
+        )
+    # a new arrival routed to the demoted shard runs loose, never a dispatch
+    i = 0
+    while shard_of(f"n{i}", _SHARD_N) != 0:
+        i += 1
+    new_sid = f"n{i}"
+    rec.add_session(case.ctor(), new_sid)
+    if rec.session_health(new_sid) != "loose":
+        bad.append(
+            f"shard_lost[demote]: arrival on the demoted shard is "
+            f"{rec.session_health(new_sid)!r}, expected 'loose'"
+        )
+    rec.submit(new_sid, *case.batch(_rng_for(case)))
+    if rec.tick() != 0:
+        bad.append("shard_lost[demote]: demoted shard's loose work cost a dispatch")
+    got = {sid: rec.expire(sid).state_fingerprint() for sid in survivors}
+    want = _shard_oracle(
+        case, survivors, [e for e in pre if e[0] in survivors] + extra
+    )
+    bad += _diff_shard_fingerprints("shard_lost[demote]", got, want)
+    return bad
+
+
+def _shard_scenario_torn_manifest(case: Any, tmp: str) -> List[str]:
+    """Truncate the manifest mid-write: the restore must be rejected outright
+    (the per-shard files are unreachable without an intact manifest)."""
+    from metrics_tpu.engine.sharded import MANIFEST_NAME, ShardedStreamEngine
+    from metrics_tpu.resilience.checkpoint import CorruptCheckpointError
+
+    d = os.path.join(tmp, "torn_manifest")
+    sids = _shard_sids(_SHARD_N)
+    fleet = ShardedStreamEngine(n_shards=_SHARD_N, wal_dir=d)
+    for sid in sids:
+        fleet.add_session(case.ctor(), sid)
+    for sid, batch in _shard_script(case, sids, 4):
+        fleet.submit(sid, *batch)
+    fleet.tick()
+    fleet.checkpoint(d)
+    _shard_crash(fleet)
+    man = os.path.join(d, MANIFEST_NAME)
+    with open(man, "rb") as fh:
+        blob = fh.read()
+    with open(man, "wb") as fh:
+        fh.write(blob[: len(blob) - 7])
+    try:
+        ShardedStreamEngine.restore(d)
+        return ["shard_manifest[torn]: torn manifest was accepted"]
+    except CorruptCheckpointError:
+        return []
+
+
+def _shard_scenario_resize(case: Any, tmp: str) -> List[str]:
+    """Elastic resize through restore: grow 2→3 then shrink 3→1, each hop
+    re-hashing every session through the normal arrival path, bit-exact."""
+    from metrics_tpu.engine.sharded import ShardedStreamEngine
+
+    bad: List[str] = []
+    d = os.path.join(tmp, "resize")
+    sids = _shard_sids(_SHARD_N)
+    script = _shard_script(case, sids, 6)
+    fleet = ShardedStreamEngine(n_shards=_SHARD_N, wal_dir=d)
+    for sid in sids:
+        fleet.add_session(case.ctor(), sid)
+    for sid, batch in script:
+        fleet.submit(sid, *batch)
+    fleet.tick()
+    fleet.checkpoint(d)
+    _shard_crash(fleet)
+    want = _shard_oracle(case, sids, script)
+    grown = ShardedStreamEngine.restore(d, n_shards=_SHARD_N + 1)  # also re-checkpoints
+    if grown.n_shards != _SHARD_N + 1:
+        bad.append(f"shard_resize[grow]: n_shards is {grown.n_shards}")
+    _shard_crash(grown)
+    shrunk = ShardedStreamEngine.restore(d, n_shards=1)
+    shrunk.tick()
+    got = {sid: shrunk.expire(sid).state_fingerprint() for sid in sids}
+    bad += _diff_shard_fingerprints("shard_resize[grow+shrink]", got, want)
+    return bad
+
+
+def check_shard_chaos_case(case: Any) -> ChaosResult:
+    """One class through the sharded-fleet scenarios; never raises."""
+    import tempfile
+
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+
+    probe = _observe.Recorder()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled = _observe.ENABLED
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    saved_donate = metric_mod._DONATE_UPDATE_DEFAULT
+    real = _observe.RECORDER
+    _observe.RECORDER = probe
+    violations: List[str] = []
+    ran: List[str] = []
+    skipped: List[str] = []
+    try:
+        _observe.ENABLED = True
+        metric_mod._JIT_UPDATE_DEFAULT = True
+        metric_mod._DONATE_UPDATE_DEFAULT = True
+        clear_jit_cache()
+        _FLEET_JIT_CACHE.clear()
+
+        probe_engine = StreamEngine()
+        sid = probe_engine.add_session(case.ctor())
+        bucketable = probe_engine._sessions[sid].bucket is not None
+        probe_engine.expire(sid)
+        if not bucketable:
+            return ChaosResult(case.name, (), ("shard",), ())
+
+        with tempfile.TemporaryDirectory(prefix="chaos_shard_") as tmp:
+            violations += _shard_scenario_host_kill(case, tmp)
+            ran.append("shard_kill[host]")
+            violations += _shard_scenario_lost_recoverable(case, tmp)
+            ran.append("shard_lost[recoverable]")
+            violations += _shard_scenario_lost_unrecoverable(case, tmp)
+            ran += ["shard_lost[strict]", "shard_lost[demote]"]
+            violations += _shard_scenario_torn_manifest(case, tmp)
+            ran.append("shard_manifest[torn]")
+            violations += _shard_scenario_resize(case, tmp)
+            ran.append("shard_resize[grow+shrink]")
+    except Exception as exc:  # noqa: BLE001 — a crash in the harness is itself a verdict
+        violations.append(f"harness: {type(exc).__name__}: {str(exc)[:200]}")
+    finally:
+        _observe.RECORDER = real
+        _observe.ENABLED = saved_enabled
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+        metric_mod._DONATE_UPDATE_DEFAULT = saved_donate
+        clear_jit_cache()
+        _FLEET_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+    return ChaosResult(case.name, tuple(ran), tuple(skipped), tuple(violations))
+
+
+def collect_shard_chaos_report(cases: Optional[Sequence[Any]] = None) -> List[ChaosResult]:
+    return [check_shard_chaos_case(c) for c in (cases if cases is not None else chaos_cases())]
+
+
 # ------------------------------------------------------------------- baseline
 def load_chaos_baseline(path: str, section: str = "chaos") -> Dict[str, str]:
     from metrics_tpu.analysis.engine import load_baseline_section
@@ -822,24 +1128,30 @@ def run_chaos_check(
 ) -> int:
     """The ``chaos`` pass of ``lint_metrics --all``: inject, verify, verdict.
 
-    Runs BOTH suites — the per-metric fault taxonomy (baselined under
-    ``chaos``) and the fleet durability scenarios (baselined under ``fleet``).
+    Runs all THREE suites — the per-metric fault taxonomy (baselined under
+    ``chaos``), the fleet durability scenarios (baselined under ``fleet``) and
+    the sharded-fleet scenarios (baselined under ``shard``).
     """
     path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
     results = collect_chaos_report()
     fleet_results = collect_fleet_chaos_report()
+    shard_results = collect_shard_chaos_report()
     if update_baseline:
         chaos = write_chaos_baseline(path, results, section="chaos")
         fleet = write_chaos_baseline(path, fleet_results, section="fleet")
+        shard = write_chaos_baseline(path, shard_results, section="shard")
         if not quiet:
             print(
                 f"chaos: baseline written to {path} "
-                f"({len(chaos)} chaos / {len(fleet)} fleet violation(s))"
+                f"({len(chaos)} chaos / {len(fleet)} fleet / {len(shard)} shard violation(s))"
             )
         return 0
     failures, stale = diff_chaos_baseline(results, load_chaos_baseline(path, "chaos"))
     fleet_failures, fleet_stale = diff_chaos_baseline(
         fleet_results, load_chaos_baseline(path, "fleet")
+    )
+    shard_failures, shard_stale = diff_chaos_baseline(
+        shard_results, load_chaos_baseline(path, "shard")
     )
     if report is not None:
         report.update(
@@ -855,29 +1167,42 @@ def run_chaos_check(
                 "fleet_failures": [r.render() for r in fleet_failures],
                 "fleet_baselined": sum(1 for r in fleet_results if not r.ok) - len(fleet_failures),
                 "fleet_stale_baseline_keys": fleet_stale,
+                "shard_cases": len(shard_results),
+                "shard_scenarios": sum(len(r.ran) for r in shard_results),
+                "shard_failures": [r.render() for r in shard_failures],
+                "shard_baselined": sum(1 for r in shard_results if not r.ok) - len(shard_failures),
+                "shard_stale_baseline_keys": shard_stale,
             }
         )
-        return 1 if failures or fleet_failures else 0
+        return 1 if failures or fleet_failures or shard_failures else 0
     for r in failures:
         print(f"chaos: {r.render()}")
     for r in fleet_failures:
         print(f"chaos[fleet]: {r.render()}")
+    for r in shard_failures:
+        print(f"chaos[shard]: {r.render()}")
     if not quiet:
         for key in stale:
             print(f"chaos: stale baseline entry: {key}")
         for key in fleet_stale:
             print(f"chaos[fleet]: stale baseline entry: {key}")
+        for key in shard_stale:
+            print(f"chaos[shard]: stale baseline entry: {key}")
         ok = sum(1 for r in results if r.ok)
         faults = sum(len(r.ran) for r in results)
         fleet_ok = sum(1 for r in fleet_results if r.ok)
         fleet_n = sum(len(r.ran) for r in fleet_results)
+        shard_ok = sum(1 for r in shard_results if r.ok)
+        shard_n = sum(len(r.ran) for r in shard_results)
         print(
             f"chaos: {ok}/{len(results)} classes survived {faults} injected fault(s), "
             f"{len(failures)} failure(s), {len(stale)} stale; "
             f"fleet: {fleet_ok}/{len(fleet_results)} classes survived {fleet_n} "
-            f"recovery scenario(s), {len(fleet_failures)} failure(s), {len(fleet_stale)} stale"
+            f"recovery scenario(s), {len(fleet_failures)} failure(s), {len(fleet_stale)} stale; "
+            f"shard: {shard_ok}/{len(shard_results)} classes survived {shard_n} "
+            f"sharded scenario(s), {len(shard_failures)} failure(s), {len(shard_stale)} stale"
         )
-    return 1 if failures or fleet_failures else 0
+    return 1 if failures or fleet_failures or shard_failures else 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -901,12 +1226,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     root = os.path.abspath(args.root or os.getcwd())
     if args.only:
         picked = [c for c in chaos_cases() if args.only.lower() in c.name.lower()]
-        results = collect_chaos_report(picked) + collect_fleet_chaos_report(picked)
+        results = (
+            collect_chaos_report(picked)
+            + collect_fleet_chaos_report(picked)
+            + collect_shard_chaos_report(picked)
+        )
         for r in results:
             print(r.render())
         return 1 if any(not r.ok for r in results) else 0
     if args.verbose:
-        for r in collect_chaos_report() + collect_fleet_chaos_report():
+        for r in (
+            collect_chaos_report() + collect_fleet_chaos_report() + collect_shard_chaos_report()
+        ):
             print(r.render())
     return run_chaos_check(
         root,

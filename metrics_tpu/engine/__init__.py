@@ -1,6 +1,6 @@
 """metrics_tpu.engine — the multi-tenant fleet runtime (DESIGN §15).
 
-Two layers:
+Layers:
 
 * :mod:`metrics_tpu.engine.core` — the shared vmapped-dispatch machinery
   (gather / stacked / masked modes, donating jit, :class:`ProgramCache` LRUs
@@ -16,21 +16,31 @@ Two layers:
   (:class:`IngestWAL`), and the checkpoint+journal replay behind
   ``StreamEngine.restore`` — recovered fleets are bit-exact versus a
   never-crashed engine.
+* :mod:`metrics_tpu.engine.sharded` — :class:`ShardedStreamEngine` (DESIGN
+  §21): the fleet partitioned across a device mesh by stable session-id hash,
+  one StreamEngine per shard with shard-local WAL + checkpoint files under a
+  CRC-validated manifest, hierarchical cross-shard merge through the declared
+  algebras, and the blast-radius ladder extended one rung (self-heal or
+  demote a single shard while the rest keep dispatching).
 
 ``metrics_tpu.engine.smoke`` holds the 64-stream CI smoke the perf ratchet
 runs (``tools/ci_check.sh`` → perf pass → ``run_fleet_smoke``).
 """
 
-from metrics_tpu.engine.core import ProgramCache, engine_compute, engine_update
-from metrics_tpu.engine.durability import IngestWAL, restore_fleet_checkpoint, save_fleet_checkpoint
+from metrics_tpu.engine.core import DispatchConsumedError, ProgramCache, engine_compute, engine_update
+from metrics_tpu.engine.durability import IngestWAL, replay_wal, restore_fleet_checkpoint, save_fleet_checkpoint
+from metrics_tpu.engine.sharded import ShardedStreamEngine
 from metrics_tpu.engine.stream import StreamEngine
 
 __all__ = [
+    "DispatchConsumedError",
     "IngestWAL",
     "ProgramCache",
+    "ShardedStreamEngine",
     "StreamEngine",
     "engine_compute",
     "engine_update",
+    "replay_wal",
     "restore_fleet_checkpoint",
     "save_fleet_checkpoint",
 ]

@@ -42,7 +42,7 @@ from metrics_tpu.metric import (
 from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.utils.exceptions import TraceIneligibleError
 
-__all__ = ["ProgramCache", "TRACER_ERRORS", "engine_compute", "engine_update"]
+__all__ = ["DispatchConsumedError", "ProgramCache", "TRACER_ERRORS", "engine_compute", "engine_update"]
 
 # Trace-time failures only: they abort before execution, so donated stacked
 # buffers are still intact and the caller can safely fall back to a loop (or,
@@ -55,6 +55,17 @@ TRACER_ERRORS = (
     jax.errors.TracerIntegerConversionError,
     TraceIneligibleError,
 )
+
+
+class DispatchConsumedError(RuntimeError):
+    """A donated engine dispatch died at runtime AFTER consuming its input
+    buffers: the stacked state it was handed no longer exists, so in-memory
+    recovery of those rows is impossible — only durability (checkpoint + WAL
+    replay) can bring them back. ``StreamEngine`` raises this instead of a bare
+    ``RuntimeError`` so a sharded fleet can catch it per shard and walk the
+    blast-radius ladder one rung further (self-heal the shard from its own
+    journal, or demote just that shard to eager loose sessions) while every
+    other shard keeps dispatching."""
 
 
 class ProgramCache(OrderedDict):

@@ -62,7 +62,7 @@ from metrics_tpu.resilience.checkpoint import (
 )
 from metrics_tpu.utils.io import atomic_write_chunks, fsync_directory
 
-__all__ = ["IngestWAL", "restore_fleet_checkpoint", "save_fleet_checkpoint"]
+__all__ = ["IngestWAL", "replay_wal", "restore_fleet_checkpoint", "save_fleet_checkpoint"]
 
 WAL_MAGIC = b"MTWAL001"
 _FRAME = struct.Struct(">II")  # record_len, record_crc32
@@ -151,31 +151,48 @@ class IngestWAL:
         mismatch, or unpicklable record). A missing/empty/magic-torn file is an
         empty journal — a crash during journal creation loses nothing, because
         the engine had not applied anything it could not re-log."""
+        records, torn = IngestWAL.read_records_detailed(path)
+        return records, torn is not None
+
+    @staticmethod
+    def read_records_detailed(
+        path: Union[str, os.PathLike],
+    ) -> Tuple[List[Tuple[Any, ...]], Optional[Dict[str, int]]]:
+        """:meth:`read_records` with the torn flag expanded into *where*.
+
+        Returns ``(records, torn)`` where ``torn`` is ``None`` for a clean scan
+        or ``{"frame_index": i, "byte_offset": off}`` locating the first damaged
+        frame — ``frame_index`` counts intact frames read before the damage (0
+        means even the magic header was torn) and ``byte_offset`` is where in
+        the file the scan stopped. Replay surfaces this as the ``wal_torn_tail``
+        observe event so operators can tell "clean recovery" from "the crash
+        tore the journal's tail and N bytes of suffix were dropped"."""
         path = os.fspath(path)
         if not os.path.exists(path) or os.path.getsize(path) == 0:
-            return [], False
+            return [], None
         with open(path, "rb") as fh:
             blob = fh.read()
         if len(blob) < len(WAL_MAGIC) or blob[: len(WAL_MAGIC)] != WAL_MAGIC:
-            return [], True
+            return [], {"frame_index": 0, "byte_offset": 0}
         records: List[Tuple[Any, ...]] = []
         off = len(WAL_MAGIC)
         while off < len(blob):
+            torn_here = {"frame_index": len(records), "byte_offset": off}
             if off + _FRAME.size > len(blob):
-                return records, True
+                return records, torn_here
             length, crc = _FRAME.unpack_from(blob, off)
             body = blob[off + _FRAME.size : off + _FRAME.size + length]
             if len(body) < length or zlib.crc32(body) & 0xFFFFFFFF != crc:
-                return records, True
+                return records, torn_here
             try:
                 rec = pickle.loads(body)
             except Exception:  # noqa: BLE001 — CRC passed but the record is garbage
-                return records, True
+                return records, torn_here
             if not (isinstance(rec, tuple) and len(rec) == 4):
-                return records, True
+                return records, torn_here
             records.append(rec)
             off += _FRAME.size + length
-        return records, False
+        return records, None
 
 
 # ------------------------------------------------------------------ save
@@ -272,7 +289,7 @@ def _save_fleet_checkpoint(
     _observe.note_checkpoint_save("StreamEngine", path, nbytes)
     if truncate_wal and engine._wal is not None:
         kept = engine._wal.truncate(lambda seq: not engine._is_applied(seq))
-        _observe.note_wal_truncate("engine", kept)
+        _observe.note_wal_truncate(getattr(engine, "_name", "engine"), kept)
     # durability-lag watermark (stats()/observe wal_lag_*): the snapshot covers
     # exactly the applied records, so lag counts what only the journal holds
     engine._ckpt_applied_seq = engine._applied_seq + len(engine._applied_above)
@@ -461,47 +478,7 @@ def _restore_fleet_checkpoint(
         sess.health = snode["health"]
         engine._sessions[sid] = sess
     # ---- replay the journal, original seqs ----
-    n_replayed = 0
-    if wal_path is not None and os.path.exists(os.fspath(wal_path)):
-        t0_replay = _observe.clock()
-        records, _torn = IngestWAL.read_records(wal_path)
-        engine._replaying = True
-        try:
-            for kind, seq, sid, payload in records:
-                engine._seq = max(engine._seq, seq)
-                if engine._is_applied(seq):
-                    continue
-                if kind == "submit":
-                    sess = engine._sessions.get(sid)
-                    if sess is None:
-                        raise CorruptCheckpointError(
-                            f"{os.fspath(wal_path)}: journal submit seq={seq} targets unknown "
-                            f"session {sid!r} (journal/checkpoint mismatch)"
-                        )
-                    args, kwargs = payload
-                    engine._route(sess, seq, tuple(args), dict(kwargs))
-                elif kind == "add":
-                    if isinstance(payload, tuple) and len(payload) == 2 and payload[0] == "__metric__":
-                        payload = _unpickle(payload[1], f"journal add seq={seq} metric", os.fspath(wal_path))
-                    engine._apply_add(sid, payload)
-                    if isinstance(sid, int) and sid >= engine._next_auto:
-                        engine._next_auto = sid + 1  # auto-assigned ids must not recycle
-                    engine._mark_applied(seq)
-                elif kind == "expire":
-                    engine._apply_expire(sid)
-                    engine._mark_applied(seq)
-                elif kind == "reset":
-                    engine._apply_reset(sid)
-                    engine._mark_applied(seq)
-                else:
-                    raise CorruptCheckpointError(
-                        f"{os.fspath(wal_path)}: journal record seq={seq} has unknown kind {kind!r}"
-                    )
-                n_replayed += 1
-        finally:
-            engine._replaying = False
-        _trace.record_complete("wal", "replay", t0_replay, _observe.clock())
-        _observe.note_wal_replay("engine", n_replayed)
+    n_replayed = replay_wal(engine, wal_path) if wal_path is not None else 0
     if wal_path is not None:
         engine._wal = IngestWAL(wal_path)
         engine._wal_path = os.fspath(wal_path)
@@ -513,5 +490,66 @@ def _restore_fleet_checkpoint(
     engine._ckpt_applied_seq = engine._applied_seq + len(engine._applied_above)
     engine._last_ckpt_time = _observe.clock()
     _observe.note_checkpoint_restore("StreamEngine", path)
-    _observe.note_fleet_restore("engine", len(engine._sessions), n_replayed)
+    _observe.note_fleet_restore(getattr(engine, "_name", "engine"), len(engine._sessions), n_replayed)
     return engine
+
+
+def replay_wal(engine: Any, wal_path: Union[str, os.PathLike]) -> int:
+    """Replay every surviving, not-yet-applied journal record into ``engine``.
+
+    Records keep their ORIGINAL sequence numbers (regenerating them would
+    desynchronize the applied-watermark bookkeeping for out-of-order applies);
+    replayed submissions land in the normal ingest queues for the next tick.
+    A torn tail stops the scan at the last intact frame — its location is
+    recorded on ``engine._wal_torn`` (surfaced by ``stats()``) and emitted as a
+    ``wal_torn_tail`` observe event, so a crash that tore the journal is
+    diagnosable instead of silent. Returns the number of records replayed.
+    """
+    name = getattr(engine, "_name", "engine")
+    wal_path = os.fspath(wal_path)
+    n_replayed = 0
+    if not os.path.exists(wal_path):
+        return 0
+    t0_replay = _observe.clock()
+    records, torn = IngestWAL.read_records_detailed(wal_path)
+    if torn is not None:
+        engine._wal_torn = (torn["frame_index"], torn["byte_offset"])
+        _observe.note_wal_torn_tail(name, torn["frame_index"], torn["byte_offset"])
+    engine._replaying = True
+    try:
+        for kind, seq, sid, payload in records:
+            engine._seq = max(engine._seq, seq)
+            if engine._is_applied(seq):
+                continue
+            if kind == "submit":
+                sess = engine._sessions.get(sid)
+                if sess is None:
+                    raise CorruptCheckpointError(
+                        f"{wal_path}: journal submit seq={seq} targets unknown "
+                        f"session {sid!r} (journal/checkpoint mismatch)"
+                    )
+                args, kwargs = payload
+                engine._route(sess, seq, tuple(args), dict(kwargs))
+            elif kind == "add":
+                if isinstance(payload, tuple) and len(payload) == 2 and payload[0] == "__metric__":
+                    payload = _unpickle(payload[1], f"journal add seq={seq} metric", wal_path)
+                engine._apply_add(sid, payload)
+                if isinstance(sid, int) and sid >= engine._next_auto:
+                    engine._next_auto = sid + 1  # auto-assigned ids must not recycle
+                engine._mark_applied(seq)
+            elif kind == "expire":
+                engine._apply_expire(sid)
+                engine._mark_applied(seq)
+            elif kind == "reset":
+                engine._apply_reset(sid)
+                engine._mark_applied(seq)
+            else:
+                raise CorruptCheckpointError(
+                    f"{wal_path}: journal record seq={seq} has unknown kind {kind!r}"
+                )
+            n_replayed += 1
+    finally:
+        engine._replaying = False
+    _trace.record_complete("wal", "replay", t0_replay, _observe.clock())
+    _observe.note_wal_replay(name, n_replayed)
+    return n_replayed

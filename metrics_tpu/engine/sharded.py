@@ -1,0 +1,738 @@
+"""Horizontally sharded fleet: StreamEngine across a device mesh (DESIGN §21).
+
+A single :class:`~metrics_tpu.engine.stream.StreamEngine` caps the fleet at one
+device's HBM and one dispatch queue, and its durability is one monolithic
+WAL + checkpoint — a lost host takes the whole fleet down and recovery replays
+everything. :class:`ShardedStreamEngine` removes both ceilings by partitioning
+the session population across ``n_shards`` inner engines:
+
+* **Routing.** ``shard_of(session_id) = crc32(repr(session_id)) % n_shards``
+  — process-stable (never Python's salted ``hash``), so the same session lands
+  on the same shard across restarts, and a *resized* fleet re-routes every
+  session deterministically through the normal arrival path.
+* **Per-shard dispatch on a mesh.** Each shard is a full StreamEngine whose
+  buckets pad/stack/mask exactly as before; shard ``k``'s work is pinned to
+  mesh device ``k % ndevices`` (``jax.default_device``), so the per-bucket
+  masked ``jit(vmap(...))`` dispatches of different shards land on different
+  devices. Shards sharing a metric class/config share ONE compiled program
+  (the program cache keys on template identity + capacity, not on the shard),
+  so sharding adds zero compiles.
+* **Hierarchical merge.** :meth:`aggregate` folds matching sessions through
+  the metric's *declared* merge algebra (``Metric._merge_state_dicts``):
+  rows → shard partial → intra-group fold ("intra-host") → cross-group fold
+  ("cross-host"). With ``mesh=`` given and every state's algebra a safe
+  builtin (sum/min/max), the cross-group stage runs as real XLA collectives
+  under ``parallel/sync.py``'s ``shard_map_compat`` via
+  :func:`~metrics_tpu.parallel.sync.allreduce_over_mesh`.
+* **Shard-local durability.** Each shard journals to its own WAL file
+  (``shard-NNN.wal``) and checkpoints to its own generation-named MTCKPT file;
+  a tiny CRC-validated **manifest** (``MANIFEST.mtman``,
+  ``resilience/checkpoint.py``) written atomically LAST is the durability
+  point. A lost host therefore restores and replays *only its own shard's*
+  journal — recovery cost scales with shard size, not fleet size — and
+  ``n_shards`` may grow or shrink between restores (:meth:`restore` re-hashes
+  every session through the normal arrival path; one compile per resized
+  bucket capacity, never a full-fleet replay).
+* **Blast-radius ladder, one rung further.** poisoned session → row → bucket
+  → **shard**: a dispatch that dies after consuming its donated buffers
+  (:class:`~metrics_tpu.engine.core.DispatchConsumedError`) triggers a
+  *shard-local self-heal* (restore just that shard from its own checkpoint
+  file + journal, the other shards never stop ticking); a shard that dies
+  again before its next clean tick — or whose files are unrecoverable under
+  ``on_lost_shard="demote"`` — is **demoted**: its sessions run as eager
+  loose sessions while every other shard keeps the one-dispatch-per-bucket-
+  per-tick economy.
+
+::
+
+    fleet = ShardedStreamEngine(n_shards=8, wal_dir="fleet.d")
+    sid = fleet.add_session(MulticlassAccuracy(num_classes=10))
+    fleet.submit(sid, preds, target)
+    fleet.tick()                          # one dispatch per touched bucket per shard
+    fleet.checkpoint("fleet.d")           # per-shard files + atomic manifest
+    fleet = ShardedStreamEngine.restore("fleet.d")            # same topology
+    fleet = ShardedStreamEngine.restore("fleet.d", n_shards=12)  # elastic resize
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import zlib
+from typing import Any, Dict, Hashable, List, Optional, Tuple
+
+import jax
+
+from metrics_tpu.engine.core import DispatchConsumedError
+from metrics_tpu.engine.durability import IngestWAL, replay_wal, restore_fleet_checkpoint, save_fleet_checkpoint
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.metric import Metric
+from metrics_tpu.observe import recorder as _observe
+from metrics_tpu.observe import tracing as _trace
+from metrics_tpu.parallel.sync import allreduce_over_mesh, build_mesh
+from metrics_tpu.resilience.checkpoint import (
+    CheckpointError,
+    CorruptCheckpointError,
+    IncompatibleCheckpointError,
+    file_crc32,
+    load_manifest,
+    save_manifest,
+)
+from metrics_tpu.utils.data import dim_zero_max, dim_zero_min, dim_zero_sum
+from metrics_tpu.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["MANIFEST_NAME", "ShardedStreamEngine", "shard_of"]
+
+MANIFEST_NAME = "MANIFEST.mtman"
+_CKPT_RE = re.compile(r"^g(\d{8})-shard(\d{3})\.mtckpt$")
+
+# cross-shard reductions that are count-independent, associative and
+# commutative — the only algebras the collective (mesh) fold accepts; mean and
+# custom folds take the count-weighted host path instead
+_MESH_SAFE = {dim_zero_sum, dim_zero_max, dim_zero_min, "sum", "max", "min"}
+
+
+def shard_of(session_id: Hashable, n_shards: int) -> int:
+    """Stable shard routing: ``crc32(repr(sid)) % n_shards``.
+
+    ``repr`` + CRC32 is process-stable and restart-stable, unlike Python's
+    salted ``hash()`` — the whole durability story (a shard's WAL must keep
+    describing the same session population across restores) depends on it.
+    """
+    return zlib.crc32(repr(session_id).encode("utf-8")) % n_shards
+
+
+class ShardedStreamEngine:
+    """Drive a churning metric-stream population as ``n_shards`` StreamEngines
+    partitioned over the local device mesh, with shard-local durability."""
+
+    def __init__(
+        self,
+        n_shards: Optional[int] = None,
+        initial_capacity: int = 8,
+        wal_dir: Optional[str] = None,
+        nan_guard: bool = False,
+        name: str = "fleet",
+        devices: Optional[List[Any]] = None,
+    ) -> None:
+        self._devices = list(devices) if devices is not None else list(jax.devices())
+        if n_shards is None:
+            n_shards = max(1, len(self._devices))
+        if int(n_shards) < 1:
+            raise TPUMetricsUserError("ShardedStreamEngine needs n_shards >= 1")
+        self.n_shards = int(n_shards)
+        self._name = str(name)
+        self._initial_capacity = int(initial_capacity)
+        self._nan_guard = bool(nan_guard)
+        self._wal_dir = os.fspath(wal_dir) if wal_dir is not None else None
+        if self._wal_dir is not None:
+            os.makedirs(self._wal_dir, exist_ok=True)
+        self._shards: List[StreamEngine] = [
+            StreamEngine(
+                initial_capacity=initial_capacity,
+                wal_path=self._shard_wal_path(k),
+                nan_guard=nan_guard,
+                name=f"{self._name}/shard{k}",
+            )
+            for k in range(self.n_shards)
+        ]
+        self._next_auto = 0  # fleet-level so auto session ids are unique across shards
+        self._ticks = 0
+        self._generation = 0  # checkpoint generation (monotonic across restores)
+        self._ckpt_dir: Optional[str] = None  # last manifest dir (enables self-heal)
+        self._demoted: Dict[int, str] = {}  # shard index -> demotion reason
+        self._heal_suspect: set = set()  # shards healed but not yet cleanly ticked
+
+    def _shard_wal_path(self, k: int) -> Optional[str]:
+        if self._wal_dir is None:
+            return None
+        return os.path.join(self._wal_dir, f"shard-{k:03d}.wal")
+
+    def _on_shard(self, k: int):
+        """Device-pinning context: shard ``k``'s arrays and dispatches commit to
+        mesh device ``k % ndevices``."""
+        return jax.default_device(self._devices[k % len(self._devices)])
+
+    def shard_of(self, session_id: Hashable) -> int:
+        return shard_of(session_id, self.n_shards)
+
+    # ------------------------------------------------------------------ sessions
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    def session_ids(self) -> List[Hashable]:
+        out: List[Hashable] = []
+        for shard in self._shards:
+            out.extend(shard.session_ids())
+        return out
+
+    def session_health(self, session_id: Hashable) -> str:
+        return self._shards[self.shard_of(session_id)].session_health(session_id)
+
+    def add_session(self, metric: Metric, session_id: Optional[Hashable] = None) -> Hashable:
+        """Adopt a live metric into the fleet; hashes its id onto a shard."""
+        if session_id is None:
+            sid = self._next_auto
+            self._next_auto += 1
+        else:
+            sid = session_id
+            if isinstance(sid, int) and sid >= self._next_auto:
+                self._next_auto = sid + 1  # auto ids must never collide with explicit ints
+        k = self.shard_of(sid)
+        shard = self._shards[k]
+        with self._on_shard(k):
+            shard.add_session(metric, sid)
+            if k in self._demoted:
+                # a demoted shard's vmapped path is distrusted: new arrivals run
+                # loose immediately so they never enter a bucket dispatch
+                sess = shard._sessions[sid]
+                if sess.bucket is not None:
+                    shard._demote_session(sess)
+        return sid
+
+    def submit(self, session_id: Hashable, *args: Any, **kwargs: Any) -> None:
+        self._shards[self.shard_of(session_id)].submit(session_id, *args, **kwargs)
+
+    def expire(self, session_id: Hashable) -> Metric:
+        k = self.shard_of(session_id)
+        with self._on_shard(k):
+            return self._shards[k].expire(session_id)
+
+    def reset(self, session_id: Optional[Hashable] = None) -> None:
+        if session_id is None:
+            for k, shard in enumerate(self._shards):
+                with self._on_shard(k):
+                    shard.reset()
+            return
+        k = self.shard_of(session_id)
+        with self._on_shard(k):
+            self._shards[k].reset(session_id)
+
+    # ------------------------------------------------------------------ dispatch
+    def tick(self) -> int:
+        """Flush every shard (one dispatch per touched bucket per shard).
+
+        A shard whose dispatch dies after consuming its donated buffers
+        (:class:`DispatchConsumedError`) is *self-healed* from its own
+        checkpoint file + journal when a manifest is known — the other shards
+        are never touched. A shard that dies again before its next clean tick
+        is demoted to eager loose sessions instead (last ladder rung).
+        """
+        total = 0
+        for k, shard in enumerate(self._shards):
+            with _trace.span("shard_tick", shard._name):
+                try:
+                    with self._on_shard(k):
+                        total += shard.tick()
+                except DispatchConsumedError as exc:
+                    self._on_dead_dispatch(k, exc)
+                    continue
+            self._heal_suspect.discard(k)  # a clean tick clears heal probation
+        self._ticks += 1
+        if _observe.ENABLED:
+            self._publish_shard_gauges()
+        return total
+
+    def _on_dead_dispatch(self, k: int, exc: DispatchConsumedError) -> None:
+        shard = self._shards[k]
+        if self._ckpt_dir is None or k in self._heal_suspect:
+            # no durability to heal from, or the heal itself did not survive a
+            # tick: walk the last rung — if the buffers are consumed nothing is
+            # left to demote, so without a heal the error must surface
+            if self._ckpt_dir is None:
+                raise exc
+            self._heal_shard(k, exc)  # fresh buffers so demotion can materialize rows
+            self.demote_shard(k, f"dispatch death loop: {exc}")
+            return
+        self._heal_shard(k, exc)
+        self._heal_suspect.add(k)
+
+    def _heal_shard(self, k: int, exc: BaseException) -> None:
+        """Rebuild shard ``k`` alone from the last manifest's per-shard files."""
+        manifest = load_manifest(os.path.join(self._ckpt_dir, MANIFEST_NAME))
+        if int(manifest.get("n_shards", -1)) != self.n_shards:
+            raise DispatchConsumedError(
+                f"shard {k} died ({exc}) and the last manifest describes a different "
+                f"topology ({manifest.get('n_shards')} shards vs {self.n_shards}); "
+                "checkpoint the resized fleet before relying on shard self-healing"
+            ) from exc
+        entry = manifest["shards"][k]
+        old = self._shards[k]
+        if old._wal is not None:
+            old._wal.close()  # the replacement engine takes over the journal file
+        fresh = StreamEngine(
+            initial_capacity=self._initial_capacity,
+            nan_guard=self._nan_guard,
+            name=old._name,
+        )
+        wal = self._resolve_wal(self._ckpt_dir, entry, self._wal_dir)
+        restore_fleet_checkpoint(fresh, os.path.join(self._ckpt_dir, entry["ckpt"]), wal_path=wal)
+        self._shards[k] = fresh
+        _observe.note_shard_restore(fresh._name, len(fresh._sessions), 0, True)
+
+    def demote_shard(self, k: int, reason: str = "manual") -> None:
+        """Last rung of the blast-radius ladder: every bucketed session of shard
+        ``k`` is converted to an eager loose session (rows materialized back,
+        queued submissions preserved in per-session order) and the shard is
+        marked demoted — its sessions keep accepting updates, they just no
+        longer ride a vmapped dispatch. The other shards are untouched."""
+        shard = self._shards[k]
+        # queued bucket submissions move to their sessions so nothing dispatches
+        # through the distrusted vmapped path and nothing is lost
+        for bucket in list(shard._buckets.values()):
+            for slot, seq, args, kwargs in bucket.queue:
+                shard._sessions[bucket.slot_sids[slot]].queue.append((seq, args, kwargs))
+            bucket.queue = []
+        for sess in list(shard._sessions.values()):
+            if sess.bucket is not None:
+                shard._materialize(sess)
+                shard._release_slot(sess)
+                if sess.health == "healthy":
+                    sess.health = "loose"
+        for bucket in list(shard._buckets.values()):
+            shard._drop_bucket(bucket)
+        self._demoted[k] = str(reason)
+        self._heal_suspect.discard(k)
+        _observe.note_shard_demoted(shard._name, str(reason))
+
+    # ------------------------------------------------------------------ readout
+    def compute(self, session_id: Hashable) -> Any:
+        k = self.shard_of(session_id)
+        with self._on_shard(k):
+            return self._shards[k].compute(session_id)
+
+    def compute_all(self) -> Dict[Hashable, Any]:
+        out: Dict[Hashable, Any] = {}
+        for k, shard in enumerate(self._shards):
+            with self._on_shard(k):
+                out.update(shard.compute_all())
+        return out
+
+    def aggregate(
+        self,
+        template: Metric,
+        group_size: Optional[int] = None,
+        mesh: Optional[Any] = None,
+    ) -> Optional[Metric]:
+        """Fleet-wide hierarchical merge of every session matching ``template``.
+
+        Sessions whose metric shares ``template``'s class and config
+        fingerprint contribute their state through the *declared* merge algebra
+        (``Metric._merge_state_dicts`` — the same count-weighted fold
+        ``Metric.merge_state`` and the distributed sync use): rows fold into a
+        per-shard partial, shard partials fold within groups of ``group_size``
+        consecutive shards (the intra-host stage; default one group), and the
+        group partials fold across groups (the cross-host stage). With
+        ``mesh=True`` (build one over the local devices) or an explicit
+        ``jax.sharding.Mesh``, the cross-group stage instead rides
+        :func:`allreduce_over_mesh` — real XLA collectives under
+        ``shard_map_compat`` — when every state's algebra is a count-independent
+        builtin (sum/min/max); other algebras keep the host fold, which is
+        always correct. Returns a fresh metric carrying the merged state, or
+        ``None`` when no session matches.
+        """
+        fp = template.config_fingerprint()
+        partials: List[Tuple[Dict[str, Any], int]] = []
+        for k, shard in enumerate(self._shards):
+            with self._on_shard(k):
+                shard._flush_pending()
+                p = self._shard_partial(shard, template, fp)
+            if p is not None:
+                partials.append(p)
+        if not partials:
+            return None
+        group = len(partials) if not group_size else max(1, int(group_size))
+        grouped = [
+            self._fold(template, partials[i : i + group])
+            for i in range(0, len(partials), group)
+        ]
+        if (
+            len(grouped) > 1
+            and mesh is not None
+            and len(grouped) <= len(self._devices)
+            and self._mesh_safe(template)
+        ):
+            the_mesh = mesh if mesh is not True else build_mesh(
+                ("shards",), devices=self._devices[: len(grouped)]
+            )
+            reductions = dict(template._reductions)
+            state = allreduce_over_mesh(
+                [g[0] for g in grouped], reductions, mesh=the_mesh, axis_name=the_mesh.axis_names[0]
+            )
+            merged = (state, sum(g[1] for g in grouped))
+        else:
+            merged = self._fold(template, grouped)
+        out = template.clone()
+        out.reset()
+        out.__dict__["_state"].update(merged[0])
+        out._update_count = merged[1]
+        out.__dict__["_state_escaped"] = True  # merged leaves are caller-visible
+        out._computed = None
+        return out
+
+    def _mesh_safe(self, template: Metric) -> bool:
+        return all(fx in _MESH_SAFE for fx in template._reductions.values())
+
+    @staticmethod
+    def _fold(template: Metric, parts: List[Tuple[Dict[str, Any], int]]) -> Tuple[Dict[str, Any], int]:
+        state, count = parts[0]
+        for other, n in parts[1:]:
+            state = template._merge_state_dicts(state, other, count, n)
+            count += n
+        return state, count
+
+    @staticmethod
+    def _shard_partial(
+        shard: StreamEngine, template: Metric, fp: Optional[str]
+    ) -> Optional[Tuple[Dict[str, Any], int]]:
+        cls = type(template)
+        parts: List[Tuple[Dict[str, Any], int]] = []
+        for sess in shard._sessions.values():
+            # bucketed rows live in the stacked pytree (the session's own metric
+            # instance is stale there); loose sessions carry their own state
+            rep = sess.bucket.template if sess.bucket is not None else sess.metric
+            if type(rep) is not cls:
+                continue
+            if fp is not None and rep.config_fingerprint() != fp:
+                continue
+            if sess.bucket is not None:
+                row = {k: v[sess.slot] for k, v in sess.bucket.stacked.items()}
+                parts.append((row, sess.base_count + sess.engine_count))
+            else:
+                parts.append((dict(sess.metric.__dict__["_state"]), sess.metric._update_count))
+        if not parts:
+            return None
+        return ShardedStreamEngine._fold(template, parts)
+
+    # ------------------------------------------------------------------ durability
+    def checkpoint(self, path: str) -> str:
+        """Per-shard checkpoint files under one atomically-written manifest.
+
+        Ordering is the durability contract: every shard's MTCKPT file is
+        written (atomic + fsync) FIRST, the CRC-validated manifest naming them
+        is written LAST, and only then is each shard's journal truncated — a
+        crash at any point leaves either the old manifest (whose files and
+        journals are all still intact) or the new one. Older generations are
+        garbage-collected after the new manifest lands. Returns the manifest
+        path.
+        """
+        path = os.fspath(path)
+        os.makedirs(path, exist_ok=True)
+        gen = self._generation + 1
+        with _trace.span("ckpt", "fleet_save"):
+            entries: List[Dict[str, Any]] = []
+            for k, shard in enumerate(self._shards):
+                fname = f"g{gen:08d}-shard{k:03d}.mtckpt"
+                fpath = os.path.join(path, fname)
+                with self._on_shard(k):
+                    save_fleet_checkpoint(shard, fpath, truncate_wal=False)
+                entries.append(
+                    {
+                        "shard": k,
+                        "ckpt": fname,
+                        "bytes": os.path.getsize(fpath),
+                        "crc32": file_crc32(fpath),
+                        "wal": os.path.basename(shard._wal_path) if shard._wal_path else None,
+                        "applied_seq": int(shard._applied_seq) + len(shard._applied_above),
+                        "sessions": len(shard._sessions),
+                        "demoted": self._demoted.get(k),
+                    }
+                )
+            save_manifest(
+                os.path.join(path, MANIFEST_NAME),
+                {
+                    "kind": "fleet_sharded",
+                    "format": 1,
+                    "name": self._name,
+                    "n_shards": self.n_shards,
+                    "generation": gen,
+                    "x64": bool(jax.config.jax_enable_x64),
+                    "next_auto": int(self._next_auto),
+                    "shards": entries,
+                },
+            )
+            # the manifest is durable: journals may now drop what the snapshot covers
+            for shard in self._shards:
+                if shard._wal is not None:
+                    kept = shard._wal.truncate(lambda seq, s=shard: not s._is_applied(seq))
+                    _observe.note_wal_truncate(shard._name, kept)
+        self._generation = gen
+        self._ckpt_dir = path
+        self._gc_generations(path, gen)
+        return os.path.join(path, MANIFEST_NAME)
+
+    @staticmethod
+    def _gc_generations(path: str, current: int) -> None:
+        for fname in os.listdir(path):
+            m = _CKPT_RE.match(fname)
+            if m and int(m.group(1)) < current:
+                try:
+                    os.remove(os.path.join(path, fname))
+                except OSError:
+                    pass  # GC is best-effort; a leaked old generation is harmless
+
+    @staticmethod
+    def _resolve_wal(path: str, entry: Dict[str, Any], wal_dir: Optional[str] = None) -> Optional[str]:
+        if entry.get("wal") is None:
+            return None
+        return os.path.join(wal_dir if wal_dir is not None else path, entry["wal"])
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        wal_dir: Optional[str] = None,
+        n_shards: Optional[int] = None,
+        on_lost_shard: str = "raise",
+        initial_capacity: int = 8,
+        nan_guard: bool = False,
+        devices: Optional[List[Any]] = None,
+    ) -> "ShardedStreamEngine":
+        """Rebuild a sharded fleet from its manifest directory.
+
+        Every shard restores from its OWN checkpoint file (CRC-verified against
+        the manifest) and replays its OWN journal — a shard's recovery never
+        reads another shard's files, so recovery time scales with shard size,
+        not fleet size. A shard whose checkpoint file is missing or damaged:
+
+        * rebuilds from journal alone (bit-exact) when the manifest shows the
+          snapshot covered nothing (``applied_seq == 0``) and the journal is
+          intact;
+        * otherwise raises (``on_lost_shard="raise"``, default) or — under
+          ``on_lost_shard="demote"`` — comes back empty and demoted while
+          every other shard restores normally.
+
+        Passing ``n_shards`` different from the manifest's performs an elastic
+        resize: the old topology is restored in full, then every session
+        re-enters through the normal arrival path of a fresh fleet (its pending
+        submissions preserved in order). Fresh journals are self-sufficient
+        from that moment; the cost is one compile per resized bucket capacity,
+        never a full-fleet replay.
+        """
+        if on_lost_shard not in ("raise", "demote"):
+            raise TPUMetricsUserError(
+                f"on_lost_shard must be 'raise' or 'demote', got {on_lost_shard!r}"
+            )
+        path = os.fspath(path)
+        manifest = load_manifest(os.path.join(path, MANIFEST_NAME))
+        if manifest.get("kind") != "fleet_sharded":
+            raise IncompatibleCheckpointError(
+                f"{path}: manifest holds kind={manifest.get('kind')!r}, expected 'fleet_sharded'"
+            )
+        stored_x64 = manifest.get("x64")
+        if stored_x64 is not None and bool(stored_x64) != bool(jax.config.jax_enable_x64):
+            raise IncompatibleCheckpointError(
+                f"{path}: manifest was written with jax_enable_x64={bool(stored_x64)} but this "
+                f"process runs with jax_enable_x64={bool(jax.config.jax_enable_x64)}"
+            )
+        old_n = int(manifest.get("n_shards", 0))
+        entries = manifest.get("shards", [])
+        if old_n < 1 or len(entries) != old_n:
+            raise CorruptCheckpointError(
+                f"{path}: manifest names {len(entries)} shard entries for n_shards={old_n}"
+            )
+        with _trace.span("ckpt", "fleet_restore"):
+            fleet = cls(
+                n_shards=old_n,
+                initial_capacity=initial_capacity,
+                wal_dir=None,  # per-shard journals attach below, straight from the manifest
+                nan_guard=nan_guard,
+                name=manifest.get("name", "fleet"),
+                devices=devices,
+            )
+            fleet._wal_dir = wal_dir if wal_dir is not None else path
+            for k, entry in enumerate(entries):
+                if int(entry.get("shard", -1)) != k:
+                    raise CorruptCheckpointError(f"{path}: manifest shard entry {k} is out of order")
+                shard = fleet._shards[k]
+                fpath = os.path.join(path, entry["ckpt"])
+                wal = cls._resolve_wal(path, entry, wal_dir)
+                try:
+                    if not os.path.exists(fpath):
+                        raise CheckpointError(f"{fpath}: shard checkpoint file is missing")
+                    if file_crc32(fpath) != int(entry["crc32"]):
+                        raise CorruptCheckpointError(
+                            f"{fpath}: shard checkpoint CRC does not match its manifest entry "
+                            "(bit-flipped or torn shard file)"
+                        )
+                    with fleet._on_shard(k):
+                        restore_fleet_checkpoint(shard, fpath, wal_path=wal)
+                except CheckpointError as exc:
+                    recoverable = (
+                        int(entry.get("applied_seq", 0)) == 0
+                        and wal is not None
+                        and os.path.exists(wal)
+                    )
+                    if recoverable:
+                        # the snapshot covered nothing: the journal IS the full
+                        # history, so an empty engine + replay is bit-exact
+                        with fleet._on_shard(k):
+                            n = replay_wal(shard, wal)
+                            shard._wal = IngestWAL(wal)
+                            shard._wal_path = wal
+                            shard._wal.truncate(lambda seq, s=shard: not s._is_applied(seq))
+                        _observe.note_shard_restore(shard._name, len(shard._sessions), n, True)
+                    elif on_lost_shard == "demote":
+                        # shard state is gone; come back empty + demoted so the
+                        # rest of the fleet restores and keeps ticking
+                        if wal is not None:
+                            if os.path.exists(wal):
+                                os.remove(wal)  # its records reference lost sessions
+                            shard._wal = IngestWAL(wal)
+                            shard._wal_path = wal
+                        fleet._demoted[k] = f"unrecoverable shard files: {exc}"
+                        _observe.note_shard_restore(shard._name, 0, 0, False)
+                        _observe.note_shard_demoted(shard._name, fleet._demoted[k])
+                    else:
+                        raise
+                else:
+                    if entry.get("demoted"):
+                        fleet._demoted[k] = str(entry["demoted"])
+            fleet._next_auto = int(manifest.get("next_auto", 0))
+            fleet._generation = int(manifest.get("generation", 0))
+            fleet._ckpt_dir = path
+            target_n = old_n if n_shards is None else int(n_shards)
+            if target_n != old_n:
+                fleet = cls._rehash(
+                    fleet, target_n, wal_dir if wal_dir is not None else path,
+                    initial_capacity, nan_guard, devices,
+                )
+                # the old manifest describes a topology that no longer exists
+                # (and _rehash replaced the journal files it referenced): write
+                # a fresh generation immediately so the manifest on disk always
+                # matches the live fleet and shard self-healing stays armed
+                fleet.checkpoint(path)
+        _observe.record_event(
+            "fleet_sharded_restore", name=fleet._name, shards=fleet.n_shards,
+            sessions=len(fleet), demoted=len(fleet._demoted),
+        )
+        return fleet
+
+    @classmethod
+    def _rehash(
+        cls,
+        old: "ShardedStreamEngine",
+        new_n: int,
+        wal_dir: str,
+        initial_capacity: int,
+        nan_guard: bool,
+        devices: Optional[List[Any]],
+    ) -> "ShardedStreamEngine":
+        """Elastic resize: every session re-enters a fresh ``new_n``-shard fleet
+        through the normal arrival path, pending submissions preserved in
+        per-session order. The old journals are consumed (deleted) — the new
+        fleet's journals are self-sufficient from the first re-add."""
+        # collect pending submissions per session BEFORE expiring, then clear
+        # the queues so expire materializes state without flushing them
+        pending: Dict[Hashable, List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]]] = {}
+        health: Dict[Hashable, str] = {}
+        order: List[Tuple[Hashable, Metric]] = []
+        for shard in old._shards:
+            for bucket in shard._buckets.values():
+                for slot, seq, args, kwargs in bucket.queue:
+                    pending.setdefault(bucket.slot_sids[slot], []).append((seq, args, kwargs))
+                bucket.queue = []
+            for sess in shard._sessions.values():
+                for seq, args, kwargs in sess.queue:
+                    pending.setdefault(sess.sid, []).append((seq, args, kwargs))
+                sess.queue = []
+                health[sess.sid] = sess.health
+            if shard._wal is not None:
+                shard._wal.close()
+                shard._wal = None  # expiries below must not journal to doomed files
+            for sid in list(shard._sessions):
+                order.append((sid, shard.expire(sid)))
+        for k in range(old.n_shards):
+            p = old._shard_wal_path(k) or os.path.join(wal_dir, f"shard-{k:03d}.wal")
+            if os.path.exists(p):
+                os.remove(p)
+        fleet = cls(
+            n_shards=new_n,
+            initial_capacity=initial_capacity,
+            wal_dir=wal_dir,
+            nan_guard=nan_guard,
+            name=old._name,
+            devices=devices,
+        )
+        fleet._next_auto = old._next_auto
+        fleet._generation = old._generation
+        # the last manifest describes the OLD topology: self-healing needs a
+        # fresh checkpoint of the resized fleet before it can trust the dir
+        fleet._ckpt_dir = None
+        for sid, metric in order:
+            fleet.add_session(metric, sid)
+            if health.get(sid, "healthy") != "healthy":
+                k = fleet.shard_of(sid)
+                sess = fleet._shards[k]._sessions[sid]
+                if sess.bucket is not None:
+                    fleet._shards[k]._demote_session(sess)
+                sess.health = health[sid]
+        for sid, subs in pending.items():
+            for _seq, args, kwargs in sorted(subs, key=lambda t: t[0]):
+                fleet.submit(sid, *args, **kwargs)
+        return fleet
+
+    # ------------------------------------------------------------------ telemetry
+    def shard_stats(self) -> List[Dict[str, Any]]:
+        """Per-shard occupancy / WAL lag / health, the ladder's shard rung view."""
+        out: List[Dict[str, Any]] = []
+        for k, shard in enumerate(self._shards):
+            lag_records, lag_bytes = shard._wal_lag()
+            active = sum(b.active() for b in shard._buckets.values())
+            capacity = sum(b.capacity for b in shard._buckets.values())
+            out.append(
+                {
+                    "shard": k,
+                    "name": shard._name,
+                    "sessions": len(shard._sessions),
+                    "loose_sessions": sum(1 for s in shard._sessions.values() if s.bucket is None),
+                    "rows_active": active,
+                    "rows_capacity": capacity,
+                    "occupancy_pct": 100.0 * active / capacity if capacity else None,
+                    "wal_lag_records": lag_records,
+                    "wal_lag_bytes": lag_bytes,
+                    "wal_torn_tail": shard._wal_torn,
+                    "health": "demoted" if k in self._demoted else "healthy",
+                    "demoted_reason": self._demoted.get(k),
+                }
+            )
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        """Fleet totals plus the per-shard breakdown (also pushed as ``shard_*``
+        observe gauges when telemetry is enabled)."""
+        shards = self.shard_stats()
+        active = sum(s["rows_active"] for s in shards)
+        capacity = sum(s["rows_capacity"] for s in shards)
+        self._publish_shard_gauges()
+        return {
+            "name": self._name,
+            "n_shards": self.n_shards,
+            "generation": self._generation,
+            "ticks": self._ticks,
+            "sessions": len(self),
+            "rows_active": active,
+            "rows_capacity": capacity,
+            "occupancy_pct": 100.0 * active / capacity if capacity else None,
+            "wal_lag_records": sum(s["wal_lag_records"] for s in shards),
+            "wal_lag_bytes": sum(s["wal_lag_bytes"] for s in shards),
+            "demoted_shards": sorted(self._demoted),
+            "shards": shards,
+        }
+
+    def _publish_shard_gauges(self) -> None:
+        if not _observe.ENABLED:
+            return
+        for k, shard in enumerate(self._shards):
+            lag_records, lag_bytes = shard._wal_lag()
+            active = sum(b.active() for b in shard._buckets.values())
+            capacity = sum(b.capacity for b in shard._buckets.values())
+            _observe.set_shard_gauges(
+                shard._name,
+                len(shard._sessions),
+                active,
+                capacity,
+                lag_records,
+                lag_bytes,
+                k not in self._demoted,
+            )

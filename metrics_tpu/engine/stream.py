@@ -67,7 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.engine.core import _FLEET_JIT_CACHE, TRACER_ERRORS, engine_compute, engine_update
+from metrics_tpu.engine.core import _FLEET_JIT_CACHE, TRACER_ERRORS, DispatchConsumedError, engine_compute, engine_update
 from metrics_tpu.metric import Metric, _squeeze_if_scalar
 from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.observe import tracing as _trace
@@ -214,9 +214,15 @@ class StreamEngine:
         initial_capacity: int = 8,
         wal_path: Optional[str] = None,
         nan_guard: bool = False,
+        name: str = "engine",
     ) -> None:
         if initial_capacity < 1:
             raise TPUMetricsUserError("StreamEngine initial_capacity must be >= 1")
+        # ``name`` labels this engine's observe events/gauges/spans. The default
+        # keeps standalone engines on the historical "engine" label; a sharded
+        # fleet names each inner engine "<fleet>/shardN" so per-shard telemetry
+        # never collides in the last-write-wins gauge space.
+        self._name = str(name)
         self._initial_capacity = 1 << (int(initial_capacity) - 1).bit_length()
         self._buckets: "OrderedDict[Any, _Bucket]" = OrderedDict()
         self._sessions: Dict[Hashable, _Session] = {}
@@ -233,6 +239,9 @@ class StreamEngine:
         self._last_ckpt_time: Optional[float] = None  # observe.clock() at last save/restore
         self._wal = None
         self._wal_path = wal_path
+        # (frame_index, byte_offset) of the torn tail the last WAL replay hit,
+        # or None — surfaced by stats() and the wal_torn_tail observe event
+        self._wal_torn: Optional[Tuple[int, int]] = None
         if wal_path is not None:
             from metrics_tpu.engine.durability import IngestWAL
 
@@ -250,7 +259,7 @@ class StreamEngine:
         self._seq += 1
         if self._wal is not None and not self._replaying:
             self._wal.append(kind, self._seq, sid, payload)
-            _observe.note_wal_append("engine")
+            _observe.note_wal_append(self._name)
         return self._seq
 
     def _mark_applied(self, seq: int) -> None:
@@ -315,7 +324,12 @@ class StreamEngine:
         if bucket is None:
             template = metric.clone()
             template.reset()
-            bucket = _Bucket(template, _bucket_label(metric), key, self._initial_capacity)
+            label = _bucket_label(metric)
+            if self._name != "engine":
+                # per-engine label namespace: two shards holding the same class
+                # must not fight over one last-write-wins gauge label
+                label = f"{self._name}/{label}"
+            bucket = _Bucket(template, label, key, self._initial_capacity)
             self._buckets[key] = bucket
         if not bucket.free:
             bucket.grow()
@@ -381,7 +395,7 @@ class StreamEngine:
 
     def tick(self) -> int:
         """Flush every pending queue; returns the number of XLA update dispatches."""
-        with _trace.span("tick", "engine"):
+        with _trace.span("tick", self._name):
             dispatches = self._flush_pending()
         self._ticks += 1
         _observe.note_fleet_tick(dispatches)
@@ -528,8 +542,9 @@ class StreamEngine:
                 ):
                     # the dead dispatch consumed its donated inputs: in-memory
                     # state is unrecoverable — this is exactly what checkpoints
-                    # + the ingest WAL exist for
-                    raise RuntimeError(
+                    # + the ingest WAL exist for. A sharded fleet catches this
+                    # typed error to self-heal or demote just this shard.
+                    raise DispatchConsumedError(
                         f"fleet bucket {bucket.label!r}: dispatch died after consuming its "
                         "donated state buffers; in-memory recovery is impossible. Recover "
                         "via StreamEngine.restore(checkpoint, wal_path=...)."
@@ -729,7 +744,7 @@ class StreamEngine:
         if session_id not in self._sessions:
             raise KeyError(f"unknown or expired session {session_id!r}")
         seq = self._log("expire", session_id)
-        with _trace.span("expire", "engine"):
+        with _trace.span("expire", self._name):
             metric = self._apply_expire(session_id)
         self._mark_applied(seq)
         return metric
@@ -820,6 +835,7 @@ class StreamEngine:
         wal_path: Optional[str] = None,
         initial_capacity: int = 8,
         nan_guard: bool = False,
+        name: str = "engine",
     ) -> "StreamEngine":
         """Rebuild a fleet from a checkpoint, then replay the ingest journal.
 
@@ -832,7 +848,7 @@ class StreamEngine:
         """
         from metrics_tpu.engine.durability import restore_fleet_checkpoint
 
-        engine = cls(initial_capacity=initial_capacity, nan_guard=nan_guard)
+        engine = cls(initial_capacity=initial_capacity, nan_guard=nan_guard, name=name)
         restore_fleet_checkpoint(engine, path, wal_path=wal_path)
         return engine
 
@@ -886,6 +902,7 @@ class StreamEngine:
         lag_records, lag_bytes = self._wal_lag()
         self._publish_gauges()
         return {
+            "name": self._name,
             "buckets": buckets,
             "sessions": len(self._sessions),
             "loose_sessions": loose,
@@ -899,6 +916,7 @@ class StreamEngine:
             "pad_waste_pct": 100.0 * (tot_bytes - tot_bytes_active) / tot_bytes if tot_bytes else None,
             "wal_lag_records": lag_records,
             "wal_lag_bytes": lag_bytes,
+            "wal_torn_tail": self._wal_torn,
             "last_ckpt_age_s": self._last_ckpt_age_s(),
         }
 
@@ -916,4 +934,4 @@ class StreamEngine:
                 active * bucket.row_bytes,
             )
         lag_records, lag_bytes = self._wal_lag()
-        _observe.note_wal_gauges("engine", lag_records, lag_bytes, self._last_ckpt_age_s())
+        _observe.note_wal_gauges(self._name, lag_records, lag_bytes, self._last_ckpt_age_s())

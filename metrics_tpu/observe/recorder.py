@@ -483,6 +483,58 @@ def note_wal_truncate(label: str, kept: int) -> None:
         RECORDER.add_event("wal_truncate", engine=label, kept=kept)
 
 
+def note_wal_torn_tail(label: str, frame_index: int, byte_offset: int) -> None:
+    """WAL replay stopped at a damaged frame: the crash tore the journal's
+    tail. ``frame_index`` is how many intact frames were recovered before the
+    damage; ``byte_offset`` where in the file the scan stopped. Everything
+    before the tear replayed normally — this event is the difference between
+    "clean recovery" and "a synced-but-torn suffix was dropped"."""
+    if ENABLED:
+        RECORDER.add_count("wal_torn_tail", label)
+        RECORDER.add_event("wal_torn_tail", engine=label, frame=frame_index, offset=byte_offset)
+
+
+# sharded fleet hooks (engine/sharded.py ShardedStreamEngine): label is the
+# inner engine name "<fleet>/shardN"
+def set_shard_gauges(
+    label: str,
+    sessions: int,
+    rows_active: int,
+    rows_capacity: int,
+    wal_lag_records: int,
+    wal_lag_bytes: int,
+    healthy: bool,
+) -> None:
+    """Publish one shard's occupancy/lag/health levels (refreshed per tick)."""
+    if ENABLED:
+        RECORDER.set_gauge("shard_sessions", label, sessions)
+        RECORDER.set_gauge("shard_rows_active", label, rows_active)
+        RECORDER.set_gauge("shard_rows_capacity", label, rows_capacity)
+        RECORDER.set_gauge("shard_wal_lag_records", label, wal_lag_records)
+        RECORDER.set_gauge("shard_wal_lag_bytes", label, wal_lag_bytes)
+        RECORDER.set_gauge("shard_healthy", label, 1.0 if healthy else 0.0)
+
+
+def note_shard_demoted(label: str, reason: str) -> None:
+    """One shard walked the last rung of the blast-radius ladder: its bucketed
+    sessions now run as eager loose sessions while every other shard keeps its
+    one-dispatch-per-bucket-per-tick economy."""
+    if ENABLED:
+        RECORDER.add_count("shard_demoted", label)
+        RECORDER.add_event("shard_demoted", engine=label, reason=reason[:200])
+
+
+def note_shard_restore(label: str, n_sessions: int, n_replayed: int, recovered: bool) -> None:
+    """One shard was rebuilt from its own checkpoint file + journal — the other
+    shards were not touched. ``recovered=False`` means the shard's files were
+    unrecoverable and it came back empty/demoted."""
+    if ENABLED:
+        RECORDER.add_count("shard_restore", label)
+        RECORDER.add_event(
+            "shard_restore", engine=label, sessions=n_sessions, replayed=n_replayed, recovered=recovered
+        )
+
+
 def set_fleet_gauges(
     label: str, active: int, capacity: int, fragmented: int, bytes_stacked: int, bytes_active: int
 ) -> None:
@@ -590,7 +642,12 @@ def snapshot() -> Dict[str, Any]:
                       "aot_stale_total": int, "aot_stores_total": int,
                       "aot_hit_rate": float|None,
                       "spans_total": int,
-                      "wal_lag_records": int, "wal_lag_bytes": int}}
+                      "wal_lag_records": int, "wal_lag_bytes": int,
+                      "wal_torn_tails_total": int,
+                      "fleet_shards_total": int, "fleet_shards_demoted": int,
+                      "shard_occupancy_pct": float|None,
+                      "shard_wal_lag_records": int,
+                      "shard_wal_lag_bytes": int}}
 
     The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
     buckets: occupancy is live rows over padded capacity, pad waste is the
@@ -601,7 +658,11 @@ def snapshot() -> Dict[str, Any]:
     (observe/latency.py) and ``series`` the rolling fleet sample ring;
     ``spans_total`` counts every span ever recorded (the span ring itself is
     bounded and exported by ``observe.timeline()``, not here). The
-    ``wal_lag_*`` deriveds sum the durability-lag gauges across engines.
+    ``wal_lag_*`` deriveds sum the durability-lag gauges across engines. The
+    ``shard_*`` / ``fleet_shards_*`` deriveds aggregate the per-shard gauges a
+    :class:`ShardedStreamEngine` publishes: shard count and how many shards are
+    currently demoted to eager loose sessions, fleet-wide shard occupancy, and
+    the summed per-shard journal replay debt.
     """
     if RECORDER.latency:
         # lazy: latency.py pulls in numpy, which this stdlib-only module must not
@@ -641,6 +702,8 @@ def snapshot() -> Dict[str, Any]:
     aot_hits = sum(counters.get("aot_hit", {}).values())
     aot_misses = sum(counters.get("aot_miss", {}).values())
     aot_lookups = aot_hits + aot_misses
+    shard_active = sum(gauges.get("shard_rows_active", {}).values())
+    shard_capacity = sum(gauges.get("shard_rows_capacity", {}).values())
     return {
         "enabled": ENABLED,
         "counters": {k: dict(sorted(v.items())) for k, v in sorted(counters.items())},
@@ -679,6 +742,12 @@ def snapshot() -> Dict[str, Any]:
             "spans_total": span_total,
             "wal_lag_records": int(sum(gauges.get("wal_lag_records", {}).values())),
             "wal_lag_bytes": int(sum(gauges.get("wal_lag_bytes", {}).values())),
+            "wal_torn_tails_total": sum(counters.get("wal_torn_tail", {}).values()),
+            "fleet_shards_total": len(gauges.get("shard_healthy", {})),
+            "fleet_shards_demoted": sum(1 for v in gauges.get("shard_healthy", {}).values() if not v),
+            "shard_occupancy_pct": (100.0 * shard_active / shard_capacity) if shard_capacity else None,
+            "shard_wal_lag_records": int(sum(gauges.get("shard_wal_lag_records", {}).values())),
+            "shard_wal_lag_bytes": int(sum(gauges.get("shard_wal_lag_bytes", {}).values())),
         },
     }
 

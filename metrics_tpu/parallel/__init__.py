@@ -9,6 +9,7 @@ from metrics_tpu.parallel.sync import (
     get_sync_policy,
     pad_to_capacity,
     run_with_retries,
+    seed_retry_jitter,
     set_sync_policy,
     shard_map_compat,
     sync_policy,
@@ -24,6 +25,7 @@ __all__ = [
     "get_sync_policy",
     "pad_to_capacity",
     "run_with_retries",
+    "seed_retry_jitter",
     "set_sync_policy",
     "shard_map_compat",
     "sync_policy",

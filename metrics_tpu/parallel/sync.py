@@ -20,6 +20,7 @@ dynamic pad-gather-trim of ``distributed.py:138-151``, which XLA cannot express.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, TypeVar
 
@@ -49,6 +50,7 @@ __all__ = [
     "set_sync_policy",
     "sync_policy",
     "run_with_retries",
+    "seed_retry_jitter",
 ]
 
 _T = TypeVar("_T")
@@ -68,6 +70,13 @@ class SyncPolicy:
       count-weighted merge of the surviving shards (the local state plus any
       survivors a :class:`SyncPeerLostError` carried) and record a
       ``sync_degraded`` observe event instead of raising.
+    - ``jitter``: bounded randomization of each backoff sleep. The actual sleep
+      is drawn uniformly from ``[delay * (1 - jitter), delay * (1 + jitter)]``
+      so peers that failed a collective at the same instant do not retry at the
+      same instant too (thundering herd). The exponential *base* delay stays
+      deterministic; only the sleep is perturbed. Must lie in ``[0, 1]``;
+      ``0`` disables jitter. Seed :func:`seed_retry_jitter` for deterministic
+      backoff sequences in tests.
 
     Retries apply only to the eager/multi-host path; the in-trace
     :func:`sync_states` collectives compile into the caller's executable and
@@ -78,6 +87,7 @@ class SyncPolicy:
     backoff_s: float = 0.05
     timeout_s: Optional[float] = None
     partial_merge: bool = False
+    jitter: float = 0.25
 
 
 _SYNC_POLICY = SyncPolicy()
@@ -139,13 +149,35 @@ class SyncPeerLostError(RuntimeError):
             raise ValueError("survivor_counts must match survivors in length")
 
 
+# Process-wide RNG for backoff jitter, deliberately separate from the global
+# ``random`` state so seeding it (tests) or seeding ``random`` (user code)
+# never perturbs the other.
+_RETRY_RNG = random.Random()
+
+
+def seed_retry_jitter(seed: Optional[int] = None) -> None:
+    """Re-seed the backoff-jitter RNG; with a fixed seed the exact sleep
+    sequence of :func:`run_with_retries` becomes reproducible."""
+    _RETRY_RNG.seed(seed)
+
+
+def _jittered(delay: float, jitter: float) -> float:
+    """One bounded-jitter sleep draw: uniform in ``delay * [1-jitter, 1+jitter]``."""
+    if not 0.0 <= jitter <= 1.0:
+        raise TPUMetricsUserError(f"SyncPolicy.jitter must lie in [0, 1], got {jitter!r}")
+    if not jitter or delay <= 0.0:
+        return max(0.0, delay)
+    return delay * (1.0 + jitter * (2.0 * _RETRY_RNG.random() - 1.0))
+
+
 def run_with_retries(attempt: Callable[[], _T], label: str = "", policy: Optional[SyncPolicy] = None) -> _T:
     """Run ``attempt`` under the policy's retry/backoff/timeout envelope.
 
     Exceptions whose class sets ``no_retry = True`` (e.g. :class:`SyncPeerLostError`)
     and user errors propagate immediately; anything else is retried with
-    exponential backoff until attempts or the time budget run out. Each retry
-    records a ``sync_retry`` observe event.
+    exponential backoff — each sleep perturbed by the policy's bounded jitter so
+    simultaneous peer failures do not re-collide — until attempts or the time
+    budget run out. Each retry records a ``sync_retry`` observe event.
     """
     policy = policy if policy is not None else _SYNC_POLICY
     deadline = (time.monotonic() + policy.timeout_s) if policy.timeout_s is not None else None
@@ -154,7 +186,11 @@ def run_with_retries(attempt: Callable[[], _T], label: str = "", policy: Optiona
         try:
             return attempt()
         except Exception as exc:
-            out_of_budget = deadline is not None and time.monotonic() + delay > deadline
+            sleep_s = _jittered(delay, policy.jitter)
+            # budget check uses the worst-case jittered sleep, not the draw, so
+            # whether a retry fits the deadline never depends on RNG state
+            worst = delay * (1.0 + policy.jitter) if delay > 0 else 0.0
+            out_of_budget = deadline is not None and time.monotonic() + worst > deadline
             if (
                 attempt_no == policy.retries
                 or getattr(exc, "no_retry", False)
@@ -163,7 +199,7 @@ def run_with_retries(attempt: Callable[[], _T], label: str = "", policy: Optiona
             ):
                 raise
             _observe.note_sync_retry(label, attempt_no + 1, exc)
-            time.sleep(delay)
+            time.sleep(sleep_s)
             delay *= 2.0
     raise AssertionError("unreachable")  # pragma: no cover
 

@@ -45,11 +45,14 @@ __all__ = [
     "IncompatibleCheckpointError",
     "PeriodicCheckpointer",
     "SnapshotPolicy",
+    "load_manifest",
     "restore_checkpoint",
     "save_checkpoint",
+    "save_manifest",
 ]
 
 MAGIC = b"MTCKPT01"
+MANIFEST_MAGIC = b"MTMAN001"
 FORMAT_VERSION = 1
 _HEAD = struct.Struct(">II")  # header_len, header_crc32
 
@@ -95,6 +98,66 @@ def _write_container(
     ).encode("utf-8")
     head = _HEAD.pack(len(header), zlib.crc32(header) & 0xFFFFFFFF)
     return atomic_write_chunks(path, [MAGIC, head, header, *payload_parts])
+
+
+# ------------------------------------------------------------------ manifests
+# A manifest is the durability root of a multi-file checkpoint (the sharded
+# fleet's per-shard MTCKPT files + WALs): a small CRC-framed JSON document
+# written ATOMICALLY and LAST, so its existence certifies that every file it
+# names was already fsynced. Format: MANIFEST_MAGIC | u32 len | u32 crc32 |
+# JSON body. Readers reject torn, bit-flipped or trailing-garbage files the
+# same way _parse rejects damaged MTCKPT containers.
+def save_manifest(path: Union[str, os.PathLike], node: Dict[str, Any]) -> str:
+    """Atomically write ``node`` (a JSON-able dict) as a CRC-validated manifest."""
+    path = os.fspath(path)
+    body = json.dumps(node, sort_keys=True).encode("utf-8")
+    head = _HEAD.pack(len(body), zlib.crc32(body) & 0xFFFFFFFF)
+    atomic_write_chunks(path, [MANIFEST_MAGIC, head, body])
+    return path
+
+
+def load_manifest(path: Union[str, os.PathLike]) -> Dict[str, Any]:
+    """Read and verify a manifest written by :func:`save_manifest`.
+
+    Verifies magic, declared length and CRC before parsing; a damaged file
+    raises :class:`CorruptCheckpointError` (never a partial dict)."""
+    path = os.fspath(path)
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError as exc:
+        raise CheckpointError(f"{path}: cannot read manifest ({exc})") from exc
+    base = len(MANIFEST_MAGIC) + _HEAD.size
+    if len(blob) < base or blob[: len(MANIFEST_MAGIC)] != MANIFEST_MAGIC:
+        raise CorruptCheckpointError(f"{path}: not a metrics_tpu manifest (bad magic or truncated preamble)")
+    body_len, body_crc = _HEAD.unpack_from(blob, len(MANIFEST_MAGIC))
+    body = blob[base:]
+    if len(body) != body_len:
+        raise CorruptCheckpointError(
+            f"{path}: manifest body length {len(body)} != declared {body_len} (truncated or trailing garbage)"
+        )
+    if zlib.crc32(body) & 0xFFFFFFFF != body_crc:
+        raise CorruptCheckpointError(f"{path}: manifest CRC mismatch (bit-flipped or damaged)")
+    try:
+        node = json.loads(body.decode("utf-8"))
+    except ValueError as exc:
+        raise CorruptCheckpointError(f"{path}: manifest body is not valid JSON ({exc})") from exc
+    if not isinstance(node, dict):
+        raise CorruptCheckpointError(f"{path}: manifest body is not a JSON object")
+    return node
+
+
+def file_crc32(path: Union[str, os.PathLike], chunk_size: int = _CRC_CHUNK) -> int:
+    """Streaming CRC32 of a file's bytes (manifest-side integrity for the
+    per-shard checkpoint files it names)."""
+    crc = 0
+    with open(os.fspath(path), "rb") as fh:
+        while True:
+            chunk = fh.read(chunk_size)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
 
 
 class CheckpointError(RuntimeError):

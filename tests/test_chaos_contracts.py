@@ -1,7 +1,8 @@
 """Chaos contract harness (``analysis/chaos_contracts.py``): registry coverage,
 one end-to-end class run through each suite (metric fault-injection + fleet
-durability scenarios), baseline diff semantics, and CLI wiring. The full
-per-class sweeps run as the ``chaos`` pass of ``tools/ci_check.sh``, not here."""
+durability scenarios + sharded-fleet recovery), baseline diff semantics, and
+CLI wiring. The full per-class sweeps run as the ``chaos`` pass of
+``tools/ci_check.sh``, not here."""
 
 import json
 
@@ -10,6 +11,7 @@ from metrics_tpu.analysis.chaos_contracts import (
     chaos_cases,
     check_chaos_case,
     check_fleet_chaos_case,
+    check_shard_chaos_case,
     diff_chaos_baseline,
     load_chaos_baseline,
     write_chaos_baseline,
@@ -55,6 +57,25 @@ def test_unbucketable_class_skips_the_fleet_suite():
     assert result.ok and result.ran == () and result.skipped == ("fleet",)
 
 
+def test_one_class_survives_the_sharded_fleet_scenarios():
+    case = next(c for c in chaos_cases() if c.name == "BinaryAccuracy")
+    result = check_shard_chaos_case(case)
+    assert result.ok, result.render()
+    # every sharded-recovery scenario fired for a bucketable classifier
+    assert set(result.ran) == {
+        "shard_kill[host]", "shard_lost[recoverable]",
+        "shard_lost[strict]", "shard_lost[demote]",
+        "shard_manifest[torn]", "shard_resize[grow+shrink]",
+    }
+    assert result.skipped == ()
+
+
+def test_unbucketable_class_skips_the_shard_suite():
+    case = next(c for c in chaos_cases() if c.name == "MeanMetric")
+    result = check_shard_chaos_case(case)
+    assert result.ok and result.ran == () and result.skipped == ("shard",)
+
+
 def test_diff_splits_failures_and_stale():
     ok = ChaosResult("A", ("f",), (), ())
     bad = ChaosResult("B", ("f",), (), ("f: broke",))
@@ -95,3 +116,4 @@ def test_repo_baseline_is_empty():
     path = os.path.join(os.path.dirname(__file__), "..", "tools", "chaos_baseline.json")
     assert load_chaos_baseline(path) == {}  # every class honors every fault contract
     assert load_chaos_baseline(path, section="fleet") == {}  # and recovers bit-exact
+    assert load_chaos_baseline(path, section="shard") == {}  # sharded included

@@ -95,6 +95,55 @@ def test_survivor_lengths_validated():
         SyncPeerLostError("gone", survivors=[{}], survivor_counts=[1, 2])
 
 
+# ------------------------------------------------------------- backoff jitter
+def _sleeps_for(policy, monkeypatch):
+    """Run a 4-attempt flaky fn under ``policy`` and capture every backoff sleep."""
+    import metrics_tpu.parallel.sync as sync_mod
+
+    sleeps = []
+    monkeypatch.setattr(sync_mod.time, "sleep", sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert run_with_retries(flaky, policy=policy) == "ok"
+    return sleeps
+
+
+def test_backoff_jitter_is_bounded_and_seed_deterministic(monkeypatch):
+    from metrics_tpu.parallel import seed_retry_jitter
+
+    policy = SyncPolicy(retries=3, backoff_s=0.01, jitter=0.5)
+    try:
+        seed_retry_jitter(123)
+        first = _sleeps_for(policy, monkeypatch)
+        assert len(first) == 3
+        for i, s in enumerate(first):
+            base = 0.01 * 2**i  # the exponential BASE delay stays deterministic
+            assert base * 0.5 <= s <= base * 1.5  # only the sleep is perturbed
+        seed_retry_jitter(123)
+        assert _sleeps_for(policy, monkeypatch) == first  # same seed, same sleeps
+        seed_retry_jitter(124)
+        assert _sleeps_for(policy, monkeypatch) != first
+    finally:
+        seed_retry_jitter()
+
+
+def test_jitter_zero_sleeps_the_exact_exponential_schedule(monkeypatch):
+    policy = SyncPolicy(retries=3, backoff_s=0.01, jitter=0.0)
+    assert _sleeps_for(policy, monkeypatch) == [0.01, 0.02, 0.04]
+
+
+def test_jitter_outside_unit_interval_rejected(monkeypatch):
+    for bad in (-0.1, 1.5):
+        with pytest.raises(TPUMetricsUserError, match="jitter"):
+            _sleeps_for(SyncPolicy(retries=1, backoff_s=0.01, jitter=bad), monkeypatch)
+
+
 # --------------------------------------------------------------- degraded sync
 def _lossy_then_lost(peer, count):
     attempts = {"n": 0}

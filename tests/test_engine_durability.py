@@ -452,5 +452,131 @@ def test_quarantined_sessions_checkpoint_and_restore_loose(tmp_path):
     _assert_engines_equal(recovered, engine, sids)
 
 
+# ------------------------------------------------- crashpoints + torn tails
+def test_wal_truncation_waits_for_a_durable_checkpoint(tmp_path, monkeypatch):
+    """Crashpoint between snapshot write and journal truncation: the ordering
+    contract is that not one journal byte drops until the checkpoint file is
+    durable, so a crash exactly there recovers bit-exact from new-ckpt+full-WAL."""
+    rng = np.random.RandomState(37)
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(wal_path=wal)
+    sid = engine.add_session(_acc())
+    oracle = _acc()
+    args = _acc_batch(rng)
+    engine.submit(sid, *args)
+    oracle.update(*args)
+    engine.tick()
+    seen = {}
+
+    def crashing_truncate(self, keep):
+        seen["ckpt_durable"] = os.path.exists(ckpt) and os.path.getsize(ckpt) > 0
+        seen["wal_bytes"] = os.path.getsize(wal)
+        raise RuntimeError("injected crash before truncate")
+
+    monkeypatch.setattr(IngestWAL, "truncate", crashing_truncate)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        engine.checkpoint(ckpt)
+    monkeypatch.undo()
+    # the snapshot was already durable when the crash hit, the journal untouched
+    assert seen == {"ckpt_durable": True, "wal_bytes": os.path.getsize(wal)}
+    engine._wal.close()
+    recovered = StreamEngine.restore(ckpt, wal_path=wal)
+    np.testing.assert_array_equal(
+        np.asarray(recovered.compute(sid)), np.asarray(oracle.compute())
+    )
+
+
+def test_sharded_truncation_waits_for_a_durable_manifest(tmp_path, monkeypatch):
+    """Same ordering contract one level up (engine/sharded.py): every shard's
+    journal truncates only AFTER the fleet manifest is on disk, and a crash
+    between per-shard truncations still restores bit-exact (the survivors'
+    journals carry applied records that replay filters out)."""
+    from metrics_tpu.engine import ShardedStreamEngine
+    from metrics_tpu.engine.sharded import MANIFEST_NAME, shard_of
+    from metrics_tpu.resilience.checkpoint import load_manifest
+
+    rng = np.random.RandomState(39)
+    wal_dir, ckpt_dir = str(tmp_path / "w"), str(tmp_path / "c")
+    fleet = ShardedStreamEngine(n_shards=2, wal_dir=wal_dir)
+    sids, i = [], 0
+    while len(sids) < 4:  # two sessions per shard
+        sid = f"s{i}"
+        i += 1
+        if sum(1 for s in sids if shard_of(s, 2) == shard_of(sid, 2)) < 2:
+            sids.append(sid)
+    oracles = {sid: _acc() for sid in sids}
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracles[sid].update(*args)
+    fleet.tick()
+    manifest_path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    durable_at_truncate = []
+    real_truncate = IngestWAL.truncate
+
+    def observing_truncate(self, keep):
+        durable_at_truncate.append(
+            os.path.exists(manifest_path) and load_manifest(manifest_path)["generation"] == 1
+        )
+        if len(durable_at_truncate) == 2:
+            raise RuntimeError("injected crash between shard truncations")
+        return real_truncate(self, keep)
+
+    monkeypatch.setattr(IngestWAL, "truncate", observing_truncate)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        fleet.checkpoint(ckpt_dir)
+    monkeypatch.undo()
+    assert durable_at_truncate == [True, True]  # manifest preceded EVERY truncate
+    for shard in fleet._shards:
+        if shard._wal is not None:
+            shard._wal.close()
+    rec = ShardedStreamEngine.restore(ckpt_dir, wal_dir=wal_dir)
+    assert set(rec.session_ids()) == set(sids)
+    for sid in sids:
+        np.testing.assert_array_equal(
+            np.asarray(rec.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+
+
+def test_torn_tail_location_is_surfaced_in_stats_and_events(tmp_path):
+    rng = np.random.RandomState(41)
+    wal = str(tmp_path / "ingest.wal")
+    ckpt = str(tmp_path / "fleet.mtckpt")
+    engine = StreamEngine(wal_path=wal)
+    sid = engine.add_session(_acc())
+    oracle = _acc()
+    args = _acc_batch(rng)
+    engine.submit(sid, *args)
+    oracle.update(*args)
+    engine.tick()
+    engine.checkpoint(ckpt)
+    args = _acc_batch(rng)  # journaled after the snapshot: survives the tear
+    engine.submit(sid, *args)
+    oracle.update(*args)
+    engine.submit(sid, *_acc_batch(rng))  # the frame the crash tears off
+    engine._wal.sync()
+    engine._wal.close()
+    blob = open(wal, "rb").read()
+    open(wal, "wb").write(blob[:-5])
+    records, torn = IngestWAL.read_records_detailed(wal)
+    assert torn is not None
+    assert torn["frame_index"] == len(records) == 1
+    assert 0 < torn["byte_offset"] < len(blob)
+    recovered = StreamEngine.restore(ckpt, wal_path=wal)
+    # the damage location rides the stats surface and the observe event stream
+    assert recovered.stats()["wal_torn_tail"] == (torn["frame_index"], torn["byte_offset"])
+    assert _counters("wal_torn_tail") == 1
+    events = [e for e in observe.snapshot()["events"] if e["kind"] == "wal_torn_tail"]
+    assert events[-1]["frame"] == torn["frame_index"]
+    assert events[-1]["offset"] == torn["byte_offset"]
+    assert observe.snapshot()["derived"]["wal_torn_tails_total"] == 1
+    recovered.tick()  # everything before the tear still replays
+    np.testing.assert_array_equal(
+        np.asarray(recovered.compute(sid)), np.asarray(oracle.compute())
+    )
+
+
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))

@@ -215,6 +215,8 @@ def test_snapshot_schema_is_stable_and_json_able():
         "aot_hits_total", "aot_misses_total", "aot_stale_total",
         "aot_stores_total", "aot_hit_rate",
         "spans_total", "wal_lag_records", "wal_lag_bytes",
+        "wal_torn_tails_total", "fleet_shards_total", "fleet_shards_demoted",
+        "shard_occupancy_pct", "shard_wal_lag_records", "shard_wal_lag_bytes",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():

@@ -1,0 +1,425 @@
+"""Sharded fleet (``engine/sharded.py``, DESIGN §21): hash-partitioned
+StreamEngines over the device mesh with shard-local durability.
+
+The contracts pinned here: crc32 routing is process-stable and covers every
+shard; the partitioned fleet stays bit-identical to per-instance oracles while
+shards sharing a metric class share ONE compiled program (sharding adds zero
+compiles); ``aggregate`` folds through the declared merge algebra;
+checkpoint/restore is per-shard-file + manifest and bit-exact through journal
+tails, elastic resize and lost shards; and the blast-radius ladder's last rung
+(dispatch death → shard self-heal → demote-to-loose) never loses a submission.
+The full per-class scenario sweep runs as the ``shard`` section of the chaos
+pass (``tools/ci_check.sh``); a registry-wide sweep also rides here as a
+``slow`` test.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric, observe
+from metrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
+from metrics_tpu.engine import DispatchConsumedError, ShardedStreamEngine
+from metrics_tpu.engine.sharded import MANIFEST_NAME, shard_of
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+from metrics_tpu.resilience import CorruptCheckpointError
+from metrics_tpu.resilience.checkpoint import CheckpointError, load_manifest
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    with observe.scope(reset=True):
+        yield
+    clear_jit_cache()
+    jit_update_enabled(True)
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=4)
+
+
+def _acc_batch(rng, n=8):
+    return jnp.asarray(rng.randint(4, size=n)), jnp.asarray(rng.randint(4, size=n))
+
+
+def _auroc():
+    return BinaryAUROC(thresholds=8)
+
+
+def _auroc_batch(rng, n=8):
+    return jnp.asarray(rng.rand(n).astype(np.float32)), jnp.asarray(rng.randint(2, size=n))
+
+
+def _sids_covering(n_shards, per_shard=2):
+    """Deterministic string sids that land ``per_shard`` sessions on EVERY shard."""
+    found = {k: 0 for k in range(n_shards)}
+    out, i = [], 0
+    while any(v < per_shard for v in found.values()):
+        sid = f"s{i}"
+        i += 1
+        k = shard_of(sid, n_shards)
+        if found[k] < per_shard:
+            found[k] += 1
+            out.append(sid)
+    return out
+
+
+def _crash(fleet):
+    """Simulate the host dying: journals stop mid-air, nothing else flushes."""
+    for shard in fleet._shards:
+        if shard._wal is not None:
+            shard._wal.close()
+
+
+def _update_compiles():
+    counters = observe.snapshot()["counters"].get("fleet_compile", {})
+    return {k: v for k, v in counters.items() if not k.endswith(":compute")}
+
+
+# ------------------------------------------------------------------- routing
+def test_shard_routing_is_crc_stable_and_covers_every_shard():
+    import zlib
+
+    # pinned to crc32-of-repr: restart-stable, never Python's salted hash()
+    assert shard_of("stream-7", 8) == zlib.crc32(b"'stream-7'") % 8
+    assert shard_of(1234, 8) == zlib.crc32(b"1234") % 8
+    hit = {shard_of(f"s{i}", 8) for i in range(256)}
+    assert hit == set(range(8))
+    fleet = ShardedStreamEngine(n_shards=4)
+    sid = fleet.add_session(_acc(), "stream-7")
+    assert fleet.shard_of(sid) == shard_of("stream-7", 4)
+    assert fleet._shards[fleet.shard_of(sid)].session_ids() == ["stream-7"]
+
+
+def test_partitioned_fleet_is_bit_exact_vs_per_instance_oracles():
+    rng = np.random.RandomState(3)
+    fleet = ShardedStreamEngine(n_shards=3)
+    ctors = {"acc": (_acc, _acc_batch), "auroc": (_auroc, _auroc_batch)}
+    sids = _sids_covering(3, per_shard=2)
+    kinds = {sid: ("acc" if i % 2 else "auroc") for i, sid in enumerate(sids)}
+    oracles = {}
+    for sid in sids:
+        fleet.add_session(ctors[kinds[sid]][0](), sid)
+        oracles[sid] = ctors[kinds[sid]][0]()
+    for _ in range(3):
+        for sid in sids:
+            if rng.rand() < 0.8:  # ragged: not every stream every tick
+                args = ctors[kinds[sid]][1](rng)
+                fleet.submit(sid, *args)
+                oracles[sid].update(*args)
+        fleet.tick()
+    assert len(fleet) == len(sids)
+    assert set(fleet.session_ids()) == set(sids)
+    for sid in sids:
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+    # expiry hands back a live metric carrying the full stream history
+    out = fleet.expire(sids[0])
+    np.testing.assert_array_equal(
+        np.asarray(out.compute()), np.asarray(oracles[sids[0]].compute())
+    )
+    assert len(fleet) == len(sids) - 1
+
+
+def test_shards_share_one_compiled_program_and_one_dispatch_each():
+    rng = np.random.RandomState(5)
+    fleet = ShardedStreamEngine(n_shards=4)
+    sids = _sids_covering(4, per_shard=2)
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+    for sid in sids:
+        fleet.submit(sid, *_acc_batch(rng))
+    # one dispatch per touched shard-bucket — and the program cache keys on
+    # template identity + capacity, not the shard, so 4 shards = ONE compile
+    assert fleet.tick() == 4
+    assert sum(_update_compiles().values()) == 1
+    for sid in sids:
+        fleet.submit(sid, *_acc_batch(rng))
+    assert fleet.tick() == 4
+    assert sum(_update_compiles().values()) == 1  # steady state: zero recompiles
+
+
+def test_auto_ids_are_fleet_unique_and_dodge_explicit_ints():
+    fleet = ShardedStreamEngine(n_shards=3)
+    a = fleet.add_session(_acc())
+    b = fleet.add_session(_acc())
+    assert a != b
+    fleet.add_session(_acc(), 17)  # explicit int bumps the auto counter past it
+    c = fleet.add_session(_acc())
+    assert c not in {a, b, 17}
+    assert len(set(fleet.session_ids())) == 4
+
+
+# ----------------------------------------------------------------- aggregate
+def test_aggregate_folds_matching_sessions_through_declared_algebra():
+    rng = np.random.RandomState(11)
+    fleet = ShardedStreamEngine(n_shards=3)
+    sids = _sids_covering(3, per_shard=2)
+    oracle = _acc()  # sum-reduction states: pooling all batches == merging
+    updates = 0
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+    fleet.add_session(_auroc(), "other")  # non-matching class must not leak in
+    fleet.submit("other", *_auroc_batch(rng))
+    for sid in sids:
+        for _ in range(2):
+            args = _acc_batch(rng)
+            fleet.submit(sid, *args)
+            oracle.update(*args)
+            updates += 1
+    merged = fleet.aggregate(MulticlassAccuracy(num_classes=4))
+    assert merged._update_count == updates
+    np.testing.assert_array_equal(np.asarray(merged.compute()), np.asarray(oracle.compute()))
+    # intra-group fold size and the mesh path change staging, never the result
+    for kwargs in ({"group_size": 2}, {"mesh": True}):
+        again = fleet.aggregate(MulticlassAccuracy(num_classes=4), **kwargs)
+        np.testing.assert_array_equal(
+            np.asarray(again.compute()), np.asarray(oracle.compute())
+        )
+    # a template no session matches aggregates to None
+    assert fleet.aggregate(MulticlassAccuracy(num_classes=7)) is None
+
+
+# ---------------------------------------------------------------- durability
+def test_checkpoint_restore_is_bit_exact_through_journal_tails(tmp_path):
+    rng = np.random.RandomState(7)
+    wal_dir, ckpt_dir = str(tmp_path / "w"), str(tmp_path / "c")
+    fleet = ShardedStreamEngine(n_shards=2, wal_dir=wal_dir)
+    sids = _sids_covering(2, per_shard=2)
+    oracles = {sid: _acc() for sid in sids}
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+    for sid in sids:
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracles[sid].update(*args)
+    fleet.tick()
+    manifest_path = fleet.checkpoint(ckpt_dir)
+    assert os.path.basename(manifest_path) == MANIFEST_NAME
+    # post-checkpoint ingest lives only in the per-shard journals
+    for sid in sids[:2]:
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracles[sid].update(*args)
+    fleet.tick()
+    _crash(fleet)
+    rec = ShardedStreamEngine.restore(ckpt_dir, wal_dir=wal_dir)
+    assert rec.n_shards == 2 and set(rec.session_ids()) == set(sids)
+    for sid in sids:
+        np.testing.assert_array_equal(
+            np.asarray(rec.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+
+
+def test_elastic_resize_rehashes_and_rewrites_the_manifest(tmp_path):
+    rng = np.random.RandomState(13)
+    wal_dir, ckpt_dir = str(tmp_path / "w"), str(tmp_path / "c")
+    fleet = ShardedStreamEngine(n_shards=2, wal_dir=wal_dir)
+    sids = _sids_covering(2, per_shard=2)
+    oracles = {sid: _acc() for sid in sids}
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracles[sid].update(*args)
+    fleet.tick()
+    fleet.checkpoint(ckpt_dir)
+    _crash(fleet)
+    grown = ShardedStreamEngine.restore(ckpt_dir, wal_dir=wal_dir, n_shards=3)
+    assert grown.n_shards == 3 and set(grown.session_ids()) == set(sids)
+    # the resize re-checkpointed immediately: the manifest on disk describes
+    # the LIVE topology (a stale one would reference rewritten journals)
+    manifest = load_manifest(os.path.join(ckpt_dir, MANIFEST_NAME))
+    assert manifest["n_shards"] == 3 and manifest["generation"] == grown._generation
+    for sid in sids:
+        assert grown.shard_of(sid) == shard_of(sid, 3)
+        np.testing.assert_array_equal(
+            np.asarray(grown.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+    # the rewritten manifest + journals are self-sufficient: crash + restore again
+    _crash(grown)
+    rec = ShardedStreamEngine.restore(ckpt_dir, wal_dir=wal_dir)
+    assert rec.n_shards == 3
+    for sid in sids:
+        np.testing.assert_array_equal(
+            np.asarray(rec.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+
+
+def test_lost_shard_raises_by_default_and_demotes_on_request(tmp_path):
+    rng = np.random.RandomState(17)
+    wal_dir, ckpt_dir = str(tmp_path / "w"), str(tmp_path / "c")
+    fleet = ShardedStreamEngine(n_shards=2, wal_dir=wal_dir)
+    sids = _sids_covering(2, per_shard=2)
+    oracles = {sid: _acc() for sid in sids}
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracles[sid].update(*args)
+    fleet.tick()
+    fleet.checkpoint(ckpt_dir)
+    _crash(fleet)
+    # bit-flip shard 0's checkpoint file: its CRC no longer matches the manifest
+    victim = os.path.join(ckpt_dir, f"g{fleet._generation:08d}-shard000.mtckpt")
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointError):
+        ShardedStreamEngine.restore(ckpt_dir, wal_dir=wal_dir)
+    rec = ShardedStreamEngine.restore(ckpt_dir, wal_dir=wal_dir, on_lost_shard="demote")
+    survivors = [sid for sid in sids if shard_of(sid, 2) == 1]
+    assert rec.stats()["demoted_shards"] == [0]
+    assert set(rec.session_ids()) == set(survivors)
+    for sid in survivors:
+        np.testing.assert_array_equal(
+            np.asarray(rec.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+    # the demoted shard keeps accepting arrivals — loose, never a vmapped dispatch
+    i = 0
+    while shard_of(f"n{i}", 2) != 0:
+        i += 1
+    rec.add_session(_acc(), f"n{i}")
+    assert rec.session_health(f"n{i}") == "loose"
+    rec.submit(f"n{i}", *_acc_batch(rng))
+    for sid in survivors:
+        rec.submit(sid, *_acc_batch(rng))
+    assert rec.tick() == 1  # one dispatch for shard 1's bucket, zero for shard 0
+
+
+def test_torn_manifest_is_rejected(tmp_path):
+    fleet = ShardedStreamEngine(n_shards=2, wal_dir=str(tmp_path / "w"))
+    fleet.add_session(_acc(), "s0")
+    fleet.checkpoint(str(tmp_path / "c"))
+    path = os.path.join(str(tmp_path / "c"), MANIFEST_NAME)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-7])
+    with pytest.raises(CorruptCheckpointError):
+        ShardedStreamEngine.restore(str(tmp_path / "c"))
+
+
+# -------------------------------------------------------- blast-radius ladder
+def _poison_tick(shard):
+    def dead_tick():
+        raise DispatchConsumedError("injected: buffers donated to a dead dispatch")
+
+    shard.tick = dead_tick
+
+
+def _durable_two_shard_fleet(tmp_path, rng):
+    wal_dir, ckpt_dir = str(tmp_path / "w"), str(tmp_path / "c")
+    fleet = ShardedStreamEngine(n_shards=2, wal_dir=wal_dir)
+    sids = _sids_covering(2, per_shard=2)
+    oracles = {sid: _acc() for sid in sids}
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracles[sid].update(*args)
+    fleet.tick()
+    fleet.checkpoint(ckpt_dir)
+    return fleet, sids, oracles
+
+
+def test_dispatch_death_self_heals_the_one_shard_from_its_own_files(tmp_path):
+    rng = np.random.RandomState(23)
+    fleet, sids, oracles = _durable_two_shard_fleet(tmp_path, rng)
+    # journal a post-checkpoint submission on shard 0, then kill its dispatch
+    wounded = [sid for sid in sids if shard_of(sid, 2) == 0]
+    args = _acc_batch(rng)
+    fleet.submit(wounded[0], *args)
+    oracles[wounded[0]].update(*args)
+    old_shard = fleet._shards[0]
+    _poison_tick(old_shard)
+    fleet.tick()  # heals shard 0 in place; shard 1 never stopped ticking
+    assert fleet._shards[0] is not old_shard
+    assert 0 in fleet._heal_suspect and not fleet._demoted
+    for sid in sids:  # checkpoint + journal replay — including the in-flight wave
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+    fleet.tick()  # a clean tick ends heal probation
+    assert 0 not in fleet._heal_suspect
+    snap = observe.snapshot()
+    assert sum(snap["counters"].get("shard_restore", {}).values()) == 1
+
+
+def test_dispatch_death_loop_demotes_the_shard_not_the_fleet(tmp_path):
+    rng = np.random.RandomState(29)
+    fleet, sids, oracles = _durable_two_shard_fleet(tmp_path, rng)
+    _poison_tick(fleet._shards[0])
+    fleet.tick()  # first death: heal, enter probation
+    _poison_tick(fleet._shards[0])
+    fleet.tick()  # second death before a clean tick: last rung — demote
+    assert fleet.stats()["demoted_shards"] == [0]
+    healthy = [sid for sid in sids if shard_of(sid, 2) == 1]
+    wounded = [sid for sid in sids if shard_of(sid, 2) == 0]
+    for sid in wounded:
+        assert fleet.session_health(sid) == "loose"
+    for sid in sids:
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracles[sid].update(*args)
+    assert fleet.tick() == 1  # shard 1's bucket only; demoted sessions run eager
+    for sid in sids:
+        np.testing.assert_array_equal(
+            np.asarray(fleet.compute(sid)), np.asarray(oracles[sid].compute())
+        )
+    assert fleet.session_health(healthy[0]) == "healthy"
+
+
+def test_dispatch_death_without_durability_must_surface():
+    fleet = ShardedStreamEngine(n_shards=2)
+    fleet.add_session(_acc(), "s0")
+    _poison_tick(fleet._shards[shard_of("s0", 2)])
+    with pytest.raises(DispatchConsumedError):
+        fleet.tick()
+
+
+# ----------------------------------------------------------------- telemetry
+def test_stats_shard_stats_and_observe_gauges():
+    rng = np.random.RandomState(31)
+    fleet = ShardedStreamEngine(n_shards=2, name="obs")
+    sids = _sids_covering(2, per_shard=2)
+    for sid in sids:
+        fleet.add_session(_acc(), sid)
+        fleet.submit(sid, *_acc_batch(rng))
+    fleet.tick()
+    stats = fleet.stats()
+    assert stats["name"] == "obs" and stats["n_shards"] == 2 and stats["ticks"] == 1
+    assert stats["sessions"] == len(sids) and stats["demoted_shards"] == []
+    assert stats["rows_active"] == len(sids) and stats["occupancy_pct"] is not None
+    per = {s["shard"]: s for s in stats["shards"]}
+    assert set(per) == {0, 1}
+    assert per[0]["name"] == "obs/shard0" and per[0]["health"] == "healthy"
+    assert sum(s["sessions"] for s in per.values()) == len(sids)
+    snap = observe.snapshot()
+    assert set(snap["gauges"]["shard_healthy"]) == {"obs/shard0", "obs/shard1"}
+    assert snap["derived"]["fleet_shards_total"] == 2
+    assert snap["derived"]["fleet_shards_demoted"] == 0
+    assert snap["derived"]["shard_occupancy_pct"] == pytest.approx(stats["occupancy_pct"])
+
+
+# -------------------------------------------------------------- registry sweep
+def _shard_sweep_cases():
+    from metrics_tpu.analysis.chaos_contracts import chaos_cases
+
+    return chaos_cases()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", _shard_sweep_cases(), ids=lambda c: c.name)
+def test_registry_wide_shard_chaos_sweep(case):
+    """Every registry class through the sharded-fleet recovery scenarios —
+    host-kill, lost-shard (recoverable + strict/demote), torn manifest and
+    elastic resize — bit-exact vs a never-crashed oracle (or cleanly skipped
+    when the class cannot ride a bucket)."""
+    from metrics_tpu.analysis.chaos_contracts import check_shard_chaos_case
+
+    result = check_shard_chaos_case(case)
+    assert result.ok, result.render()

@@ -3,8 +3,10 @@
 # + donlint), the disabled-mode telemetry overhead smoke, the donation
 # three-way cross-check, the AOT executable-cache round-trip pass (serialize
 # → fresh-dir reload with zero compiles → bit-exact vs a fresh trace,
-# baselined in tools/aot_baseline.json), the chaos fault-injection harness,
-# the fleet-engine contract pass, and the perf cost ratchet (which
+# baselined in tools/aot_baseline.json), the chaos fault-injection harness
+# (metric faults + fleet recovery + sharded-fleet recovery, baselined in the
+# `chaos`/`fleet`/`shard` sections of tools/chaos_baseline.json), and the
+# perf cost ratchet (which
 # also drives the 64-stream StreamEngine smoke and pins its dispatch economy
 # against the `fleet` section of tools/perf_baseline.json) — all via
 # `lint_metrics.py --all`, which aggregates their exit codes. The default

@@ -37,9 +37,9 @@ if _REPO_ROOT not in sys.path:
 # ------------------------------------------------------------------ rendering
 
 _PHASE_ORDER = (
-    "tick", "ingest", "wave_assembly", "dispatch", "flush", "fleet_compute",
-    "wal", "ckpt", "expire", "update", "compute", "merge", "sync",
-    "allreduce", "gather_all", "fused_update", "aot",
+    "tick", "shard_tick", "ingest", "wave_assembly", "dispatch", "flush",
+    "fleet_compute", "wal", "ckpt", "expire", "update", "compute", "merge",
+    "sync", "allreduce", "gather_all", "fused_update", "aot",
 )
 
 
@@ -124,6 +124,38 @@ def render_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) -
         lines.append(f"last checkpoint  {_fmt_s(max(age.values()))} ago")
     else:
         lines.append("last checkpoint  never")
+    torn = derived.get("wal_torn_tails_total", _counter_total(snap, "wal_torn_tail"))
+    if torn:
+        lines.append(f"torn wal tails   {int(torn)}  (journal damage detected at restore)")
+
+    # sharded fleet rung: one row per shard from the shard_* gauge families
+    healthy = snap.get("gauges", {}).get("shard_healthy") or {}
+    if healthy:
+        lines.append("")
+        lines.append("== shards ==")
+        demoted = derived.get(
+            "fleet_shards_demoted", sum(1 for v in healthy.values() if not v)
+        )
+        lines.append(
+            f"{len(healthy)} shard(s), {int(demoted)} demoted"
+            f"{_delta(demoted, pderived.get('fleet_shards_demoted') if prev else None)}"
+        )
+        lines.append(
+            f"{'shard':<22}{'sess':>6}{'rows':>12}{'occ%':>7}{'wal lag':>16}{'health':>10}"
+        )
+        g = snap.get("gauges", {})
+        for label in sorted(healthy):
+            sess = int((g.get("shard_sessions") or {}).get(label, 0))
+            r_act = int((g.get("shard_rows_active") or {}).get(label, 0))
+            r_cap = int((g.get("shard_rows_capacity") or {}).get(label, 0))
+            occ = f"{100.0 * r_act / r_cap:.0f}" if r_cap else "-"
+            lag_rec = int((g.get("shard_wal_lag_records") or {}).get(label, 0))
+            lag_by = float((g.get("shard_wal_lag_bytes") or {}).get(label, 0))
+            state = "ok" if healthy[label] else "DEMOTED"
+            lines.append(
+                f"{label:<22}{sess:>6}{f'{r_act}/{r_cap}':>12}{occ:>7}"
+                f"{f'{lag_rec}r/{_fmt_bytes(lag_by)}':>16}{state:>10}"
+            )
 
     lines.append("")
     lines.append("== phases (DDSketch quantiles) ==")
