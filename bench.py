@@ -1299,6 +1299,77 @@ def _attach_flight(configs, name):
         entry["flight"] = flight
 
 
+_WD_BASE = {}
+
+
+def _watchdog_digest():
+    """Watchdog/attribution delta since the last call (counters are process-
+    wide and cumulative, so per-config numbers are diffs against the running
+    base — same reason the flight ring drains between configs)."""
+    from metrics_tpu import observe
+
+    snap = observe.snapshot()
+    derived = snap.get("derived", {})
+    counters = snap.get("counters", {})
+    cur = {
+        "fired": derived.get("slo_alerts_fired_total", 0),
+        "resolved": derived.get("slo_alerts_resolved_total", 0),
+        "explains": dict(counters.get("compile_explain") or {}),
+        "causes": dict(counters.get("compile_cause") or {}),
+    }
+    base = _WD_BASE or {"fired": 0, "resolved": 0, "explains": {}, "causes": {}}
+    digest = {
+        "alerts_fired": int(cur["fired"] - base["fired"]),
+        "alerts_resolved": int(cur["resolved"] - base["resolved"]),
+        "firing": sorted(
+            k for k, v in (snap.get("gauges", {}).get("slo_firing") or {}).items() if v
+        ),
+        "compiles_by_cache": {
+            k: int(v - base["explains"].get(k, 0))
+            for k, v in sorted(cur["explains"].items())
+            if v != base["explains"].get(k, 0)
+        },
+        "causes": {
+            k: int(v - base["causes"].get(k, 0))
+            for k, v in sorted(cur["causes"].items())
+            if v != base["causes"].get(k, 0)
+        },
+    }
+    _WD_BASE.clear()
+    _WD_BASE.update(cur)
+    return digest
+
+
+def _attach_watchdog(configs, name, require_clean=False):
+    """Fold the per-config watchdog delta into ``configs[name]["watchdog"]``.
+
+    Always advances the delta base (even for errored configs, so their
+    compiles don't bleed into the next digest). With ``require_clean`` a
+    steady-state config that fired any SLO alert raises — callers put that
+    inside the config's try/except so the regression lands in its error slot
+    instead of killing the BENCH line.
+    """
+    from metrics_tpu import observe
+
+    digest = _watchdog_digest()
+    # the watchdog's rule state is process-local, so its health verdict sees
+    # alerts even for configs that ran under a swapped-in probe recorder
+    # (bench_fleet / bench_drift assert dispatch economy that way)
+    wd = observe.installed_watchdog()
+    health = wd.health() if wd is not None else None
+    if health is not None:
+        digest["verdict"] = health["verdict"]
+        digest["firing"] = sorted(set(digest["firing"]) | set(health["firing"]))
+    entry = configs.get(name)
+    if isinstance(entry, dict) and "error" not in entry:
+        entry["watchdog"] = digest
+        if require_clean and (digest["alerts_fired"] or digest["firing"]):
+            raise RuntimeError(
+                f"watchdog fired on clean '{name}' config: "
+                f"{digest['alerts_fired']} alert(s), firing={digest['firing']}"
+            )
+
+
 def main():
     # probe the backend first: the accelerator tunnel can wedge in a way that blocks
     # backend init forever, and a benchmark that never prints is worse than a CPU number
@@ -1310,6 +1381,10 @@ def main():
     from metrics_tpu import observe
 
     observe.enable()
+    # SLO evaluation rides along (DESIGN §22): the engine configs poke the
+    # watchdog every tick; per-config alert/attribution deltas land in each
+    # config's "watchdog" digest, and the fleet/drift configs assert clean.
+    observe.install_watchdog()
     # Without the TorchMetrics checkout the suite still times OUR side of every
     # config (value ≥ 0, unit "s/step (no-ref)") so the BENCH trajectory stays
     # populated in containers that lack the reference.
@@ -1355,6 +1430,7 @@ def main():
         except Exception as err:  # noqa: BLE001 — a failed config must not kill the bench line
             configs[name] = {"error": f"{type(err).__name__}: {err}"}
             _drain_flight()  # don't bleed this config's spans into the next
+        _attach_watchdog(configs, name)
     # Extras (outside the 5-config geomean, for round-over-round comparability):
     # config 3 through the on-device fused single-pass sort — the path that runs
     # on TPU, where the host-callback argsort is disabled (round-4 VERDICT weak #3).
@@ -1373,6 +1449,7 @@ def main():
     except Exception as err:  # noqa: BLE001
         configs["retrieval_device_sort"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "retrieval_device_sort")
+    _attach_watchdog(configs, "retrieval_device_sort")
     # the replica engine vs our own loop fallback: meaningful with or without torch
     try:
         t_eng, t_loop, what = bench_bootstrap(with_ref=with_ref)
@@ -1385,11 +1462,14 @@ def main():
     except Exception as err:  # noqa: BLE001
         configs["bootstrap"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "bootstrap")
+    _attach_watchdog(configs, "bootstrap")
     # the fleet engine: multi-tenant dispatch economy at 10k concurrent streams
     try:
         configs["fleet"] = bench_fleet(with_ref=with_ref)
+        _attach_watchdog(configs, "fleet", require_clean=True)
     except Exception as err:  # noqa: BLE001
         configs["fleet"] = {"error": f"{type(err).__name__}: {err}"}
+        _watchdog_digest()  # advance the delta base past the failed config
     _attach_flight(configs, "fleet")
     # sharded fleet: 100k sessions over 8 shards, subprocess with forced devices
     try:
@@ -1397,11 +1477,14 @@ def main():
     except Exception as err:  # noqa: BLE001
         configs["fleet_sharded"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "fleet_sharded")
+    _attach_watchdog(configs, "fleet_sharded")
     # windowed + drift metrics on the fleet: 1k streams x 3 classes, timestamped waves
     try:
         configs["drift"] = bench_drift(with_ref=with_ref)
+        _attach_watchdog(configs, "drift", require_clean=True)
     except Exception as err:  # noqa: BLE001
         configs["drift"] = {"error": f"{type(err).__name__}: {err}"}
+        _watchdog_digest()
     _attach_flight(configs, "drift")
     # durability: checkpoint + crash + restore + WAL replay at 1k streams
     try:
@@ -1409,18 +1492,21 @@ def main():
     except Exception as err:  # noqa: BLE001
         configs["recovery"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "recovery")
+    _attach_watchdog(configs, "recovery")
     # sketch metrics: accuracy-vs-memory at 2^20 streamed elements
     try:
         configs["sketches"] = bench_sketches(with_ref=with_ref)
     except Exception as err:  # noqa: BLE001
         configs["sketches"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "sketches")
+    _attach_watchdog(configs, "sketches")
     # AOT executable cache: first-update wall, cold compile+serialize vs warm reload
     try:
         configs["cold_start"] = bench_cold_start(with_ref=with_ref)
     except Exception as err:  # noqa: BLE001
         configs["cold_start"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "cold_start")
+    _attach_watchdog(configs, "cold_start")
     snap = observe.snapshot()
     if with_ref:
         geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups)) if speedups else -1.0

@@ -69,6 +69,37 @@ def call_signature(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[Any, 
     return (tuple(sig), str(treedef))
 
 
+def _miss_components(base_key: Any, sig: Any) -> Tuple[Tuple[str, Any], ...]:
+    """Decompose an AOT disk key for cause attribution (DESIGN §22).
+
+    Both base-key layouts built by the runtime (``("shared", classpath, fp,
+    state_avals, donate)`` from metric.py and ``("engine", kind, classpath,
+    fp, state_avals, n) + statics`` from engine/core.py) split into named
+    components; anything else reports as one opaque ``base_key`` component.
+    The call signature is always its own component, so a new batch shape on a
+    warmed entry attributes as exactly ``call_signature``.
+    """
+    comps: Tuple[Tuple[str, Any], ...]
+    if isinstance(base_key, tuple) and len(base_key) == 5 and base_key[0] == "shared":
+        _, classpath, fp, avals, donate = base_key
+        comps = (
+            ("class", classpath), ("config_fingerprint", fp),
+            ("state_avals", avals), ("donation", donate),
+        )
+    elif isinstance(base_key, tuple) and len(base_key) >= 6 and base_key[0] == "engine":
+        comps = (
+            ("engine", base_key[1]), ("class", base_key[2]),
+            ("config_fingerprint", base_key[3]), ("state_avals", base_key[4]),
+            ("capacity", base_key[5]), ("statics", base_key[6:]),
+        )
+    else:
+        comps = (("base_key", base_key),)
+    return comps + (
+        ("call_signature", sig),
+        ("x64", bool(jax.config.jax_enable_x64)),
+    )
+
+
 class _Program:
     """One resolved executable for one call signature."""
 
@@ -133,6 +164,8 @@ class AotBinding:
                 entry.donate = False
                 _observe.record_event("donation_unusable", metric=self.label, source="aot")
             return _Program(exe, from_disk=True)
+        if _observe.ENABLED:
+            _observe.note_compile_miss("aot", self.label, _miss_components(self.base_key, sig))
         return self._compile(entry, sig, args, kwargs)
 
     def _compile(self, entry: Any, sig: Any, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> _Program:

@@ -300,6 +300,18 @@ class MetricCollection:
         donate = all(lm._donation_eligible() for lm in leaders)
         entry = _FUSED_SHARED_CACHE.get((shared_key, donate)) if shareable else _FUSED_UPDATE_CACHE.get(self)
         if entry is None:
+            if rec is not None and shareable:
+                # cause attribution (DESIGN §22): per-leader config components
+                # are index-namespaced so "leader 0's num_classes changed" and
+                # "the leader set itself changed" stay distinguishable
+                comps = [("leaders", tuple(type(lm).__name__ for lm in leaders))]
+                for i, leader_key in enumerate(shared_key):
+                    comps.extend(
+                        (f"config[{i}]:{ck.lstrip('_')}", cv) for ck, cv in leader_key[1]
+                    )
+                comps.append(("donation", bool(donate)))
+                comps.append(("x64", bool(jax.config.jax_enable_x64)))
+                _observe.note_compile_miss("fused", f"fused[{len(leaders)}]", tuple(comps))
             # representatives are pristine clones so no live collection is pinned
             reps = [lm.clone() for lm in leaders] if shareable else leaders
             for r in (reps if shareable else []):

@@ -83,9 +83,20 @@ class ProgramCache(OrderedDict):
         self.max_entries = max_entries
         self._labels: Dict[Any, str] = {}
 
-    def lookup(self, key: Any, build: Callable[[], _CompiledUpdate], label: str, n: int) -> _CompiledUpdate:
+    def lookup(
+        self,
+        key: Any,
+        build: Callable[[], _CompiledUpdate],
+        label: str,
+        n: int,
+        components: Optional[Tuple[Tuple[str, Any], ...]] = None,
+    ) -> _CompiledUpdate:
         entry = self.get(key)
         if entry is None:
+            if components is not None and _observe.ENABLED:
+                # cause attribution (DESIGN §22): the call site decomposed the
+                # key into named components only because telemetry was on
+                _observe.note_compile_miss(self.kind, label, components)
             entry = build()
             self[key] = entry
             self._labels[key] = label
@@ -111,6 +122,27 @@ class ProgramCache(OrderedDict):
 # signature) buckets since each live signature is one executable.
 _REPLICA_JIT_CACHE = ProgramCache("replica", 64)
 _FLEET_JIT_CACHE = ProgramCache("fleet", 256)
+
+
+def _key_components(
+    template: Metric, n: int, mode: str, *extra: Tuple[str, Any]
+) -> Tuple[Tuple[str, Any], ...]:
+    """Decompose an engine cache key into named components for attribution.
+
+    The per-row ``capacity`` is its own component, so masked batch avals are
+    reported with their leading (capacity-sized) row axis stripped — growing
+    a bucket then attributes as exactly ``capacity``, not capacity AND every
+    stacked argument's shape.
+    """
+    cfg = template._jit_cache_key()
+    return (
+        ("class", type(template).__name__),
+        *(("config:" + k.lstrip("_"), v) for k, v in (cfg[1] if cfg is not None else ())),
+        ("capacity", n),
+        ("mode", mode),
+        *extra,
+        ("x64", bool(jax.config.jax_enable_x64)),
+    )
 
 
 def _attach_engine_aot(
@@ -200,6 +232,22 @@ def engine_update(
     else:
         sig_static = arr_flags
         key = (template._jit_cache_key(), n, mode, nargs, kw_names, arr_flags, donate)
+    components = None
+    if _observe.ENABLED:
+        if mode == "masked":
+            # stacked array args carry the capacity-sized row axis; capacity is
+            # its own component, so strip it from the reported avals
+            batch_comp: Tuple[Any, ...] = tuple(
+                (s[0], s[1][1:], s[2]) if s[0] == "arr" and len(s[1]) else s for s in batch_sig
+            )
+        else:
+            batch_comp = arr_flags
+        components = _key_components(
+            template, n, mode,
+            ("arg_structure", (nargs, kw_names)),
+            ("batch_avals", batch_comp),
+            ("donation", bool(donate)),
+        )
 
     def build() -> _CompiledUpdate:
         # a pristine clone is the traced representative, keeping user instances
@@ -234,7 +282,7 @@ def engine_update(
         entry = _CompiledUpdate(jax.vmap(one, in_axes=in_axes), donate)
         return _attach_engine_aot(entry, template, cache, label, n, (mode, nargs, kw_names, sig_static, donate))
 
-    entry = cache.lookup(key, build, label, n)
+    entry = cache.lookup(key, build, label, n, components)
     if entry.probation and entry.donate:
         # the dispatch is not yet known-good: donate fresh copies so the engine's
         # live stacked pytree survives as the rescue reference if the first
@@ -268,6 +316,7 @@ def engine_compute(
     if label is None:
         label = f"{type(template).__name__}x{n}"
     key = (template._jit_cache_key(), n, "compute")
+    components = _key_components(template, n, "compute") if _observe.ENABLED else None
 
     def build() -> _CompiledUpdate:
         rep = template.clone()
@@ -276,5 +325,5 @@ def engine_compute(
         entry = _CompiledUpdate(jax.vmap(lambda st: _squeeze_if_scalar(comp(st)), in_axes=(0,)), False)
         return _attach_engine_aot(entry, template, cache, label, n, ("compute",))
 
-    entry = cache.lookup(key, build, label, n)
+    entry = cache.lookup(key, build, label, n, components)
     return entry(stacked)

@@ -231,6 +231,9 @@ class ShardedStreamEngine:
         self._ticks += 1
         if _observe.ENABLED:
             self._publish_shard_gauges()
+            # demoted shards skip their inner StreamEngine.tick poke, so the
+            # sharded rung pokes once more per fleet tick (rate-limited inside)
+            _observe.poke_watchdog()
         return total
 
     def _on_dead_dispatch(self, k: int, exc: DispatchConsumedError) -> None:

@@ -402,6 +402,9 @@ class StreamEngine:
         self._publish_gauges()
         if _observe.ENABLED:
             self._record_sample(dispatches)
+            # the installed watchdog (observe/watchdog.py) samples off engine
+            # ticks — rate-limited inside, one attribute read when none is set
+            _observe.poke_watchdog()
         return dispatches
 
     def _record_sample(self, dispatches: int) -> None:

@@ -702,6 +702,16 @@ class Metric(ABC):
         key = (cfg, donate)
         entry = _SHARED_JIT_CACHE.get(key)
         if entry is None:
+            if _observe.ENABLED:
+                # decompose the miss's key for cause attribution (DESIGN §22):
+                # which component differs from the nearest prior key names the
+                # recompile's cause in the compile_explain event
+                _observe.note_compile_miss(
+                    "shared_jit", type(self).__name__,
+                    (("class", type(self).__name__),)
+                    + tuple(("config:" + k.lstrip("_"), v) for k, v in cfg[1])
+                    + (("donation", bool(donate)), ("x64", bool(jax.config.jax_enable_x64))),
+                )
             # A dedicated pristine clone becomes the representative whose bound
             # update body is traced; config-equal instances replay its executable.
             # Cloning (rather than caching `self`) keeps user instances — and any

@@ -16,6 +16,12 @@ The third subsystem of the tooling triad (correctness → jitlint, distribution
   (:func:`timeline`), Prometheus quantile families (:func:`prometheus`), or
   fleet-merged quantiles (:func:`sync_telemetry`); ``tools/fleet_top.py``
   renders the live health report.
+* **watchdog rung** (:mod:`metrics_tpu.observe.watchdog` +
+  :mod:`metrics_tpu.observe.explain`, DESIGN §22) — host-side twins of our own
+  metric designs (TimeDecayed rates, DDSketch quantiles, CUSUM, PSI) sampled
+  over the recorder's own counters, declarative :class:`SloRule` alerting
+  with firing/resolved events, and per-cache recompile-cause attribution
+  (``compile_explain`` events; ``tools/why_recompile.py`` renders them).
 * **static half** (:mod:`metrics_tpu.observe.costs` +
   :mod:`metrics_tpu.observe.profile`) — XLA cost profiling via
   ``jax.jit(update).lower(...).cost_analysis()`` over the jit-eligible
@@ -39,10 +45,12 @@ overhead smoke behind ``tools/lint_metrics.py --all``.
 from metrics_tpu.observe.latency import sync_telemetry
 from metrics_tpu.observe.recorder import (
     RECORDER,
+    SCHEMA_VERSION,
     Recorder,
     disable,
     enable,
     enabled,
+    poke_watchdog,
     prometheus,
     record_event,
     reset,
@@ -51,18 +59,33 @@ from metrics_tpu.observe.recorder import (
     snapshot_json,
 )
 from metrics_tpu.observe.tracing import drain_spans, record_complete, span, timeline
+from metrics_tpu.observe.watchdog import (
+    DEFAULT_SLOS,
+    SloRule,
+    Watchdog,
+    install_watchdog,
+    installed_watchdog,
+    uninstall_watchdog,
+)
 
 # submodules (costs/profile/recorder/...) resolve via __getattr__ below; they
 # are deliberately absent from __all__ — JL006 requires every listed name be
 # bound at module top level, and binding them eagerly would defeat the lazy
 # import
 __all__ = [
+    "DEFAULT_SLOS",
     "RECORDER",
     "Recorder",
+    "SCHEMA_VERSION",
+    "SloRule",
+    "Watchdog",
     "disable",
     "drain_spans",
     "enable",
     "enabled",
+    "install_watchdog",
+    "installed_watchdog",
+    "poke_watchdog",
     "prometheus",
     "record_complete",
     "record_event",
@@ -73,9 +96,10 @@ __all__ = [
     "span",
     "sync_telemetry",
     "timeline",
+    "uninstall_watchdog",
 ]
 
-_LAZY_SUBMODULES = ("costs", "latency", "overhead", "profile", "recorder", "tracing")
+_LAZY_SUBMODULES = ("costs", "explain", "latency", "overhead", "profile", "recorder", "tracing", "watchdog")
 
 
 def __getattr__(name):

@@ -27,6 +27,15 @@ a sub-1% effect in jit/OS noise, so the check is built bottom-up instead:
   charge of :data:`SPANS_PER_STEP` full null spans plus
   :data:`CHECKS_PER_STEP` checks strictly overcounts it.
 
+The *enabled*-watchdog budget (DESIGN §22) is checked the same bottom-up way:
+with telemetry on and a default-interval :class:`~metrics_tpu.observe.watchdog.
+Watchdog` installed, one ``poke_watchdog()`` per step is the entire hot-path
+charge — the rate limiter turns almost every poke into a monotonic-clock read,
+and a full ``sample()`` runs at most once per ``min_interval_s``. The check
+charges one poke per step *plus* the amortized share of a real sample
+(``sample_s * step_s / min_interval_s``) and requires the total under
+:data:`MAX_OVERHEAD_PCT` of the same 1k-step loop.
+
 The verdict is an absolute threshold, not a baseline ratchet — the contract
 is "disabled telemetry is free", not "no slower than last week".
 ``--update-baseline`` still records the measured numbers under a
@@ -49,6 +58,7 @@ __all__ = [
     "main",
     "measure_disabled_costs",
     "measure_step_cost",
+    "measure_watchdog_costs",
     "run_telemetry_check",
 ]
 
@@ -106,6 +116,50 @@ def measure_disabled_costs(iters: int = _MICRO_ITERS, repeats: int = _MICRO_REPE
     }
 
 
+def measure_watchdog_costs(iters: int = 4000, repeats: int = _MICRO_REPEATS) -> Dict[str, float]:
+    """Enabled-watchdog hot-path costs (seconds): the per-step poke, one sample.
+
+    Runs inside its own enabled ``observe.scope()`` with a default-interval
+    watchdog installed. ``poke_s`` is the min-over-repeats per-call cost of
+    ``poke_watchdog()`` (rate-limit fast path — the charge every instrumented
+    tick pays); ``sample_s`` is the mean cost of a full ``Watchdog.sample()``
+    (host-twin folds + SLO evaluation), which the rate limiter amortizes over
+    ``min_interval_s`` of steps.
+    """
+    from metrics_tpu import observe
+    from metrics_tpu.observe import recorder
+
+    with observe.scope(reset=True):
+        wd = observe.Watchdog()  # default min_interval_s
+        observe.install_watchdog(wd)
+        try:
+            poke = recorder.poke_watchdog
+            poke()  # first poke eats the initial sample outside the window
+            best_poke = float("inf")
+            best_empty = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    pass
+                best_empty = min(best_empty, (time.perf_counter() - t0) / iters)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    poke()
+                best_poke = min(best_poke, (time.perf_counter() - t0) / iters)
+            n_samples = 5
+            t0 = time.perf_counter()
+            for _ in range(n_samples):
+                wd.sample()
+            sample_s = (time.perf_counter() - t0) / n_samples
+        finally:
+            observe.uninstall_watchdog()
+    return {
+        "poke_s": max(0.0, best_poke - best_empty),
+        "sample_s": sample_s,
+        "min_interval_s": wd.min_interval_s,
+    }
+
+
 def measure_step_cost(steps: int = _LOOP_STEPS, repeats: int = _LOOP_REPEATS) -> float:
     """Steady-state per-step seconds of a jitted 1k-step update loop.
 
@@ -147,6 +201,22 @@ def _measure() -> Dict[str, Any]:
     }
 
 
+def _measure_watchdog(step_s: float) -> Dict[str, Any]:
+    wd = measure_watchdog_costs()
+    # per-step charge: one poke (rate-limit fast path) + the amortized share
+    # of one full sample per min_interval_s window of steps
+    amortized_s = wd["sample_s"] * step_s / wd["min_interval_s"] if wd["min_interval_s"] > 0 else wd["sample_s"]
+    budget_s = wd["poke_s"] + amortized_s
+    overhead_pct = 100.0 * budget_s / step_s if step_s > 0 else float("inf")
+    return {
+        "poke_ns": round(wd["poke_s"] * 1e9, 1),
+        "sample_us": round(wd["sample_s"] * 1e6, 2),
+        "min_interval_s": wd["min_interval_s"],
+        "overhead_pct": round(overhead_pct, 4),
+        "max_overhead_pct": MAX_OVERHEAD_PCT,
+    }
+
+
 def run_telemetry_check(
     root: str,
     baseline_path: Optional[str] = None,
@@ -154,7 +224,7 @@ def run_telemetry_check(
     quiet: bool = False,
     report: Optional[Dict[str, Any]] = None,
 ) -> int:
-    """Dynamic ``telemetry`` pass: disabled-mode overhead budget (exit 0/1)."""
+    """Dynamic ``telemetry`` pass: disabled-mode + enabled-watchdog budgets (exit 0/1)."""
     from metrics_tpu.observe import recorder
 
     was_enabled = recorder.ENABLED
@@ -167,8 +237,18 @@ def run_telemetry_check(
             attempts += 1
     finally:
         recorder.ENABLED = was_enabled
+    step_s = measured["step_us"] * 1e-6
+    wd_measured = _measure_watchdog(step_s)
+    wd_attempts = 1
+    while wd_measured["overhead_pct"] >= MAX_OVERHEAD_PCT and wd_attempts < _VERDICT_ATTEMPTS:
+        wd_measured = _measure_watchdog(step_s)
+        wd_attempts += 1
+    wd_measured["attempts"] = wd_attempts
     measured["attempts"] = attempts
-    ok = measured["overhead_pct"] < MAX_OVERHEAD_PCT
+    ok = (
+        measured["overhead_pct"] < MAX_OVERHEAD_PCT
+        and wd_measured["overhead_pct"] < MAX_OVERHEAD_PCT
+    )
 
     if update_baseline:
         from metrics_tpu.analysis.engine import write_baseline_section
@@ -177,7 +257,7 @@ def run_telemetry_check(
         write_baseline_section(
             path,
             "telemetry",
-            {"disabled_mode": measured},
+            {"disabled_mode": measured, "enabled_watchdog": wd_measured},
             "telemetry overhead record — disabled-mode instrumentation cost vs a "
             "1k-step update loop. Informational (the pass verdict is the absolute "
             f"{MAX_OVERHEAD_PCT}% threshold); regenerate with "
@@ -188,6 +268,7 @@ def run_telemetry_check(
 
     if report is not None:
         report["disabled_mode"] = measured
+        report["enabled_watchdog"] = wd_measured
     if not quiet:
         verdict = "ok" if ok else "FAIL"
         print(
@@ -195,7 +276,11 @@ def run_telemetry_check(
             f"of a {measured['step_us']:.0f}us step "
             f"(null span {measured['span_ns']:.0f}ns x{SPANS_PER_STEP}, "
             f"flag check {measured['check_ns']:.0f}ns x{CHECKS_PER_STEP}; "
-            f"budget {MAX_OVERHEAD_PCT}%) — {verdict}"
+            f"budget {MAX_OVERHEAD_PCT}%); "
+            f"watchdog overhead {wd_measured['overhead_pct']:.3f}% "
+            f"(poke {wd_measured['poke_ns']:.0f}ns, sample "
+            f"{wd_measured['sample_us']:.0f}us per {wd_measured['min_interval_s']:g}s) "
+            f"— {verdict}"
         )
     return 0 if ok else 1
 

@@ -30,6 +30,7 @@ unconditionally.
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from collections import deque
@@ -38,6 +39,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 __all__ = [
     "RECORDER",
     "Recorder",
+    "SCHEMA_VERSION",
     "disable",
     "enable",
     "enabled",
@@ -45,6 +47,7 @@ __all__ = [
     "note_aot_miss",
     "note_aot_stale",
     "note_aot_store",
+    "note_compile_miss",
     "note_eager_fallback",
     "note_engine_compile",
     "note_engine_dispatch",
@@ -73,6 +76,7 @@ __all__ = [
     "note_wal_gauges",
     "note_wal_replay",
     "note_wal_truncate",
+    "poke_watchdog",
     "prometheus",
     "record_event",
     "reset",
@@ -85,6 +89,17 @@ __all__ = [
 # Module-level fast flag: hot paths read this ONE attribute and skip all
 # instrumentation when False. Mutated only via enable()/disable().
 ENABLED = False
+
+# snapshot() schema generation: bumped whenever the top-level or derived key
+# set changes, so downstream consumers (fleet_top, why_recompile, external
+# scrapers) can detect which contract a serialized snapshot file carries.
+# 2 = PR 14 (schema_version itself + watchdog/SLO/compile-explain deriveds).
+SCHEMA_VERSION = 2
+
+# process-wide watchdog (observe/watchdog.py) registered via _set_watchdog;
+# held here — not in the watchdog module — so engine hot paths can poke it
+# through this already-imported module with one attribute read
+_WATCHDOG: Optional[Any] = None
 
 clock: Callable[[], float] = time.perf_counter
 
@@ -171,6 +186,12 @@ class Recorder:
             self._span_total = 0
             self._compiled.clear()
             self._evicted.clear()
+        # the recompile-attribution key history resets with the telemetry it
+        # explains (scope/test isolation) — but NOT on clear_jit_cache(), so a
+        # post-clear miss still attributes as "rebuild" rather than "first"
+        explain = sys.modules.get("metrics_tpu.observe.explain")
+        if explain is not None:
+            explain.clear_history()
 
     def clear_jit_cache_stats(self) -> None:
         """Reset the shared-jit-cache counters (the cache itself was just cleared)."""
@@ -306,6 +327,50 @@ def note_jit_cache_cleared() -> None:
     RECORDER.clear_jit_cache_stats()
     if ENABLED:
         RECORDER.add_event("jit_cache_clear")
+
+
+def note_compile_miss(kind: str, label: str, components: Any) -> None:
+    """Attribute one compiled-cache miss to the key component that changed.
+
+    ``kind`` names the cache ("shared_jit" / "fleet" / "replica" / "fused" /
+    "aot"); ``components`` is the decomposed cache key as ``(name, value)``
+    pairs. The diff against the nearest prior key of the same kind
+    (observe/explain.py) lands in the event log as a ``compile_explain``
+    event plus ``compile_explain`` (per cache) and ``compile_cause`` (per
+    cause) counters — the raw material for ``tools/why_recompile.py`` and
+    ``fleet_top``'s "== compiles ==" section. Call sites only build the
+    component tuple when ``ENABLED`` is already true.
+    """
+    if not ENABLED:
+        return
+    # lazy: explain.py is stdlib-only, but this module must stay importable
+    # without it for the disabled fast path's sake
+    from metrics_tpu.observe import explain as _explain
+
+    cause, changed, detail = _explain.attribute(kind, components)
+    RECORDER.add_count("compile_explain", kind)
+    RECORDER.add_count("compile_cause", cause)
+    RECORDER.add_event(
+        "compile_explain", cache=kind, label=label, cause=cause,
+        changed=list(changed), detail=detail,
+    )
+
+
+def _set_watchdog(watchdog: Optional[Any]) -> None:
+    """Register (or clear) the process-wide watchdog; observe/watchdog.py owns this."""
+    global _WATCHDOG
+    _WATCHDOG = watchdog
+
+
+def poke_watchdog() -> None:
+    """Give the installed watchdog a sampling opportunity (rate-limited).
+
+    Engine ticks call this from their already-ENABLED-guarded telemetry
+    branch; with no watchdog installed it is one module-attribute read.
+    """
+    wd = _WATCHDOG
+    if wd is not None and ENABLED:
+        wd.maybe_sample()
 
 
 def note_eager_fallback(metric: str, exc: BaseException) -> None:
@@ -616,6 +681,7 @@ def snapshot() -> Dict[str, Any]:
     Schema (stable — tests/test_observe_runtime.py pins it)::
 
         {"enabled": bool,
+         "schema_version": int,   # SCHEMA_VERSION, bumped with any key change
          "counters": {name: {label: int}},
          "timers":   {name: {label: {"count", "total_s", "mean_s", "min_s", "max_s"}}},
          "events":   [{"seq", "kind", ...}, ...],
@@ -647,7 +713,12 @@ def snapshot() -> Dict[str, Any]:
                       "fleet_shards_total": int, "fleet_shards_demoted": int,
                       "shard_occupancy_pct": float|None,
                       "shard_wal_lag_records": int,
-                      "shard_wal_lag_bytes": int}}
+                      "shard_wal_lag_bytes": int,
+                      "compile_explains_total": int,
+                      "watchdog_samples_total": int,
+                      "slo_alerts_fired_total": int,
+                      "slo_alerts_resolved_total": int,
+                      "slo_alerts_firing": int}}
 
     The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
     buckets: occupancy is live rows over padded capacity, pad waste is the
@@ -662,7 +733,10 @@ def snapshot() -> Dict[str, Any]:
     ``shard_*`` / ``fleet_shards_*`` deriveds aggregate the per-shard gauges a
     :class:`ShardedStreamEngine` publishes: shard count and how many shards are
     currently demoted to eager loose sessions, fleet-wide shard occupancy, and
-    the summed per-shard journal replay debt.
+    the summed per-shard journal replay debt. The watchdog rung (DESIGN §22)
+    adds attributed compile-miss counts (``compile_explains_total``), watchdog
+    sample counts and the SLO alert totals, with ``slo_alerts_firing`` the
+    number of rules currently in the firing state (the ``slo_firing`` gauges).
     """
     if RECORDER.latency:
         # lazy: latency.py pulls in numpy, which this stdlib-only module must not
@@ -706,6 +780,7 @@ def snapshot() -> Dict[str, Any]:
     shard_capacity = sum(gauges.get("shard_rows_capacity", {}).values())
     return {
         "enabled": ENABLED,
+        "schema_version": SCHEMA_VERSION,
         "counters": {k: dict(sorted(v.items())) for k, v in sorted(counters.items())},
         "timers": {k: dict(sorted(v.items())) for k, v in sorted(timers.items())},
         "events": events,
@@ -748,6 +823,11 @@ def snapshot() -> Dict[str, Any]:
             "shard_occupancy_pct": (100.0 * shard_active / shard_capacity) if shard_capacity else None,
             "shard_wal_lag_records": int(sum(gauges.get("shard_wal_lag_records", {}).values())),
             "shard_wal_lag_bytes": int(sum(gauges.get("shard_wal_lag_bytes", {}).values())),
+            "compile_explains_total": sum(counters.get("compile_explain", {}).values()),
+            "watchdog_samples_total": sum(counters.get("watchdog_sample", {}).values()),
+            "slo_alerts_fired_total": sum(counters.get("slo_fired", {}).values()),
+            "slo_alerts_resolved_total": sum(counters.get("slo_resolved", {}).values()),
+            "slo_alerts_firing": sum(1 for v in gauges.get("slo_firing", {}).values() if v),
         },
     }
 

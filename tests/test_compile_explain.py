@@ -1,0 +1,221 @@
+"""Recompile-cause attribution (``observe/explain.py``, DESIGN §22).
+
+Every compiled-program cache decomposes its key into named components and
+reports misses through ``note_compile_miss``; attribution diffs against the
+nearest prior key of the same cache kind. For each cache — shared-jit,
+fleet/replica ``ProgramCache``, fused collection, AOT disk — these tests force
+a miss by changing exactly ONE key component and assert the ``compile_explain``
+event names that component and no other.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.collections as collections_mod
+from metrics_tpu import observe
+from metrics_tpu.classification.accuracy import MulticlassAccuracy
+from metrics_tpu.metric import clear_jit_cache
+from metrics_tpu.observe import explain
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    collections_mod._FUSED_SHARED_CACHE.clear()
+    with observe.scope(reset=True):  # scope reset also clears explain history
+        yield
+    clear_jit_cache()
+    collections_mod._FUSED_SHARED_CACHE.clear()
+
+
+def _explains(cache=None):
+    events = [e for e in observe.snapshot()["events"] if e["kind"] == "compile_explain"]
+    if cache is not None:
+        events = [e for e in events if e["cache"] == cache]
+    return events
+
+
+# ------------------------------------------------------------------ unit level
+
+def test_attribute_classifies_first_single_multiple_rebuild():
+    assert explain.attribute("t", (("a", 1), ("b", 2))) == ("first", (), {})
+    cause, changed, detail = explain.attribute("t", (("a", 1), ("b", 3)))
+    assert cause == "b" and changed == ("b",)
+    assert detail == {"b": {"prior": "2", "now": "3"}}
+    cause, changed, _ = explain.attribute("t", (("a", 9), ("b", 9)))
+    assert cause == "multiple" and changed == ("a", "b")
+    # an exact prior key missing again is capacity churn, not key churn
+    assert explain.attribute("t", (("a", 1), ("b", 2)))[0] == "rebuild"
+    assert explain.history_depth("t") == 4
+    explain.clear_history()
+    assert explain.history_depth("t") == 0
+
+
+def test_attribute_x64_flip_collapses_implied_aval_changes():
+    explain.attribute("x", (("batch_avals", "f32"), ("x64", False)))
+    cause, changed, _ = explain.attribute("x", (("batch_avals", "f64"), ("x64", True)))
+    assert cause == "x64" and changed == ("x64",)
+    # without the x64 flip, the aval change attributes as itself
+    cause, changed, _ = explain.attribute("x", (("batch_avals", "f16"), ("x64", True)))
+    assert cause == "batch_avals"
+
+
+def test_attribute_component_added_or_removed_counts_as_changed():
+    explain.attribute("y", (("a", 1),))
+    cause, changed, detail = explain.attribute("y", (("a", 1), ("guard", "skip")))
+    assert cause == "guard" and detail["guard"] == {"prior": None, "now": "'skip'"}
+
+
+# ------------------------------------------------------------- shared-jit cache
+
+def test_shared_jit_config_change_attributes_single_component():
+    MulticlassAccuracy(num_classes=4).update(np.arange(4) % 4, np.arange(4) % 4)
+    MulticlassAccuracy(num_classes=5).update(np.arange(4) % 4, np.arange(4) % 4)
+    first, second = _explains("shared_jit")
+    assert first["cause"] == "first"
+    assert second["cause"] == "config:num_classes"
+    assert second["changed"] == ["config:num_classes"]
+
+
+def test_shared_jit_donation_flip_attributes_donation_only():
+    p, t = np.arange(4) % 4, np.arange(4) % 4
+    MulticlassAccuracy(num_classes=4, donate_states=True).update(p, t)
+    MulticlassAccuracy(num_classes=4, donate_states=False).update(p, t)
+    events = _explains("shared_jit")
+    assert [e["cause"] for e in events] == ["first", "donation"]
+    assert events[-1]["changed"] == ["donation"]
+
+
+def test_shared_jit_guard_install_attributes_guard_policy():
+    from metrics_tpu.resilience.guards import install_guard
+
+    p, t = np.arange(4) % 4, np.arange(4) % 4
+    MulticlassAccuracy(num_classes=4).update(p, t)
+    guarded = install_guard(MulticlassAccuracy(num_classes=4), "skip_batch")
+    guarded.update(p, t)
+    event = _explains("shared_jit")[-1]
+    assert event["cause"] == "config:guard_policy"
+    assert event["changed"] == ["config:guard_policy"]
+
+
+def test_shared_jit_recompile_after_cache_clear_is_rebuild():
+    m = MulticlassAccuracy(num_classes=4)
+    p, t = np.arange(4) % 4, np.arange(4) % 4
+    m.update(p, t)
+    clear_jit_cache()  # explain history survives — that is the point
+    MulticlassAccuracy(num_classes=4).update(p, t)
+    assert [e["cause"] for e in _explains("shared_jit")] == ["first", "rebuild"]
+
+
+# ------------------------------------------------------------------ fleet cache
+
+def test_fleet_capacity_growth_and_batch_aval_change_attribute_singly():
+    from metrics_tpu.engine.stream import StreamEngine
+
+    engine = StreamEngine(initial_capacity=4)
+    sids = [engine.add_session(MulticlassAccuracy(num_classes=4)) for _ in range(3)]
+    batch = (np.arange(8) % 4, np.arange(8) % 4)
+    for sid in sids:
+        engine.submit(sid, *batch)
+    engine.tick()
+    assert [e["cause"] for e in _explains("fleet")] == ["first"]
+    # growth: 5 sessions > capacity 4 -> rows double; same batch avals
+    sids += [engine.add_session(MulticlassAccuracy(num_classes=4)) for _ in range(2)]
+    for sid in sids:
+        engine.submit(sid, *batch)
+    engine.tick()
+    grown = _explains("fleet")[-1]
+    assert grown["cause"] == "capacity" and grown["changed"] == ["capacity"]
+    # new padded batch length at fixed capacity -> batch_avals alone
+    wide = (np.arange(16) % 4, np.arange(16) % 4)
+    for sid in sids:
+        engine.submit(sid, *wide)
+    engine.tick()
+    aval = _explains("fleet")[-1]
+    assert aval["cause"] == "batch_avals" and aval["changed"] == ["batch_avals"]
+
+
+# ---------------------------------------------------------------- replica cache
+
+def test_replica_inner_config_change_attributes_single_component():
+    from metrics_tpu.wrappers import BootStrapper
+
+    rng = np.random.default_rng(0)
+    p, t = rng.integers(0, 3, 16), rng.integers(0, 3, 16)
+    BootStrapper(MulticlassAccuracy(num_classes=3), num_bootstraps=4).update(p, t)
+    BootStrapper(MulticlassAccuracy(num_classes=4), num_bootstraps=4).update(p, t)
+    events = _explains("replica")
+    assert events and events[0]["cause"] == "first"
+    assert events[-1]["cause"] == "config:num_classes"
+    assert events[-1]["changed"] == ["config:num_classes"]
+
+
+# ------------------------------------------------------------------ fused cache
+
+def test_fused_leader_config_change_attributes_single_component():
+    from metrics_tpu import MeanAbsoluteError, MeanSquaredError, MetricCollection
+
+    p, t = jnp.asarray([0.1, 0.9]), jnp.asarray([0.0, 1.0])
+    col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    col.update(p, t)
+    col.update(p, t)  # groups stabilized: fused compile happens here
+    fused = _explains("fused")
+    assert fused and fused[-1]["cause"] == "first"
+    col2 = MetricCollection([MeanSquaredError(squared=False), MeanAbsoluteError()])
+    col2.update(p, t)
+    col2.update(p, t)
+    event = _explains("fused")[-1]
+    assert event["cause"] == "config[0]:squared"
+    assert event["changed"] == ["config[0]:squared"]
+
+
+# -------------------------------------------------------------------- AOT cache
+
+def test_aot_new_call_signature_attributes_call_signature(tmp_path):
+    from metrics_tpu.aot import cache as aot_cache
+
+    aot_cache.set_cache_dir(tmp_path)
+    try:
+        m = MulticlassAccuracy(num_classes=4)
+        m.update(np.arange(4) % 4, np.arange(4) % 4)
+        assert [e["cause"] for e in _explains("aot")] == ["first"]
+        m.update(np.arange(8) % 4, np.arange(8) % 4)  # new batch shape, warm entry
+        event = _explains("aot")[-1]
+        assert event["cause"] == "call_signature"
+        assert event["changed"] == ["call_signature"]
+    finally:
+        aot_cache.set_cache_dir(None)
+
+
+# ------------------------------------------------------------ snapshot/CLI surface
+
+def test_compile_explain_counters_and_derived_totals():
+    MulticlassAccuracy(num_classes=4).update(np.arange(4) % 4, np.arange(4) % 4)
+    MulticlassAccuracy(num_classes=5).update(np.arange(4) % 4, np.arange(4) % 4)
+    snap = observe.snapshot()
+    assert snap["counters"]["compile_explain"]["shared_jit"] == 2
+    assert snap["counters"]["compile_cause"]["first"] == 1
+    assert snap["counters"]["compile_cause"]["config:num_classes"] == 1
+    assert snap["derived"]["compile_explains_total"] == 2
+    json.dumps(snap)  # events carry only rendered strings
+
+
+def test_why_recompile_cli_renders_report(tmp_path, capsys):
+    MulticlassAccuracy(num_classes=4).update(np.arange(4) % 4, np.arange(4) % 4)
+    MulticlassAccuracy(num_classes=5).update(np.arange(4) % 4, np.arange(4) % 4)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(observe.snapshot()))
+    assert explain.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "== why recompile ==" in out
+    assert "config:num_classes" in out and "shared_jit" in out
+    assert explain.main([str(tmp_path / "missing.json")]) == 2
+    # an empty snapshot still renders (the "was telemetry enabled?" hint)
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert explain.main([str(empty)]) == 0

@@ -199,10 +199,11 @@ def test_snapshot_schema_is_stable_and_json_able():
     ObsSum().update(1.0)
     snap = observe.snapshot()
     assert set(snap) == {
-        "enabled", "counters", "timers", "events", "gauges",
+        "enabled", "schema_version", "counters", "timers", "events", "gauges",
         "latency", "series", "derived",
     }
     assert snap["enabled"] is True
+    assert snap["schema_version"] == observe.SCHEMA_VERSION == 2
     assert set(snap["derived"]) == {
         "jit_cache_hit_rate", "jit_compiles_total", "jit_cache_hits_total",
         "jit_cache_evictions_total", "eager_fallbacks_total",
@@ -217,6 +218,9 @@ def test_snapshot_schema_is_stable_and_json_able():
         "spans_total", "wal_lag_records", "wal_lag_bytes",
         "wal_torn_tails_total", "fleet_shards_total", "fleet_shards_demoted",
         "shard_occupancy_pct", "shard_wal_lag_records", "shard_wal_lag_bytes",
+        "compile_explains_total", "watchdog_samples_total",
+        "slo_alerts_fired_total", "slo_alerts_resolved_total",
+        "slo_alerts_firing",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():

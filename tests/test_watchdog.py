@@ -1,0 +1,322 @@
+"""Watchdog self-monitoring pipeline (``observe/watchdog.py``, DESIGN §22).
+
+The watchdog runs host twins of our own metrics over the recorder's counter
+deltas — TimeDecayed rates, two-sided CUSUMs, PSI on the occupancy histogram —
+and evaluates declarative SLO rules each sample. These tests pin:
+
+* the host twins against their sequential-recursion oracles (the same
+  semantics ``drift.CUSUM`` / ``windows.TimeDecayed`` declare on device);
+* SLO fire/resolve mechanics including the None-signal carry;
+* an injected recompile storm firing the CUSUM SLO within ``for_ticks``
+  samples and resolving after the storm stops;
+* an injected tick-latency regression firing ``tick_latency_p99``;
+* shard mergeability (``export_state``/``sync_telemetry``);
+* zero alerts over a clean steady-state fleet driven through
+  ``StreamEngine.tick`` (which pokes the installed watchdog);
+* the Prometheus export of the new alert/signal families (round-trip parse).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.observe import recorder as rec_mod
+from metrics_tpu.observe.watchdog import (
+    DEFAULT_SLOS,
+    HostCUSUM,
+    HostTimeDecayedRate,
+    SloRule,
+    Watchdog,
+    host_psi,
+)
+
+
+@pytest.fixture(autouse=True)
+def _scoped():
+    with observe.scope(reset=True):
+        yield
+    observe.uninstall_watchdog()
+
+
+# ------------------------------------------------------------------ host twins
+
+def test_host_cusum_matches_sequential_recursion_oracle():
+    rng = np.random.default_rng(3)
+    xs = rng.normal(loc=0.3, scale=1.0, size=200)
+    target, k = 0.0, 0.5
+    c = HostCUSUM(target, k=k)
+    s_pos = s_neg = 0.0
+    hi_pos = hi_neg = 0.0
+    for x in xs:
+        c.observe(float(x))
+        s_pos = max(0.0, s_pos + (float(x) - target - k))
+        s_neg = max(0.0, s_neg + (target - float(x) - k))
+        hi_pos = max(hi_pos, s_pos)
+        hi_neg = max(hi_neg, s_neg)
+        assert c.statistic() == pytest.approx(max(s_pos, s_neg), abs=1e-9)
+    assert c.watermark() == pytest.approx(max(hi_pos, hi_neg), abs=1e-9)
+
+
+def test_host_cusum_merge_is_the_order_sensitive_segment_fold():
+    rng = np.random.default_rng(4)
+    xs = rng.normal(size=64)
+    whole = HostCUSUM(0.0)
+    for x in xs:
+        whole.observe(float(x))
+    left, right = HostCUSUM(0.0), HostCUSUM(0.0)
+    for x in xs[:40]:
+        left.observe(float(x))
+    for x in xs[40:]:
+        right.observe(float(x))
+    left.merge_state(right.state())  # local first, peer appended — stream order
+    assert left.statistic() == pytest.approx(whole.statistic(), abs=1e-9)
+    assert left.watermark() == pytest.approx(whole.watermark(), abs=1e-9)
+    # non-finite observations are skipped, not folded as garbage
+    skipper = HostCUSUM(0.0)
+    skipper.observe(float("nan"))
+    skipper.observe(float("inf"))
+    assert skipper.statistic() == 0.0
+
+
+def test_host_time_decayed_rate_oracle_and_merge():
+    r = HostTimeDecayedRate(half_life_s=10.0)
+    assert r.rate() is None
+    r.observe(5.0, now=100.0)
+    assert r.rate() is None  # no elapsed window yet
+    r.observe(5.0, now=110.0)
+    # one half-life elapsed: sum = 5*0.5 + 5, norm = 10
+    assert r.rate() == pytest.approx((5.0 * 0.5 + 5.0) / 10.0)
+    # merge: two shards over the same wall clock sum their rates
+    a = HostTimeDecayedRate(half_life_s=10.0)
+    b = HostTimeDecayedRate(half_life_s=10.0)
+    for wd_rate in (a, b):
+        wd_rate.observe(3.0, now=100.0)
+        wd_rate.observe(3.0, now=110.0)
+    solo = a.rate()
+    a.merge_state(b.state())
+    assert a.rate() == pytest.approx(2.0 * solo)
+
+
+def test_host_psi_zero_on_identical_positive_on_shift_none_on_empty():
+    ref = [10.0, 20.0, 30.0, 40.0]
+    assert host_psi(ref, list(ref)) == pytest.approx(0.0, abs=1e-12)
+    shifted = [40.0, 30.0, 20.0, 10.0]
+    psi = host_psi(ref, shifted)
+    assert psi is not None and psi > 0.1
+    assert host_psi([], ref) is None
+    assert host_psi(ref, [0.0] * 4) is None
+    assert host_psi(ref, ref[:3]) is None  # bin-count mismatch
+
+
+# ------------------------------------------------------------------- SLO rules
+
+def test_slo_rule_validates_and_compares():
+    rule = SloRule("lag", "wal_lag_records", "<=", 100.0, for_ticks=2)
+    assert rule.healthy(100.0) and not rule.healthy(100.5)
+    with pytest.raises(ValueError):
+        SloRule("bad", "x", "==", 1.0)
+    with pytest.raises(ValueError):
+        SloRule("bad", "x", "<=", 1.0, for_ticks=0)
+    names = [r.name for r in DEFAULT_SLOS]
+    assert "recompile_storm" in names and "dispatch_economy" in names
+
+
+def test_slo_fires_after_for_ticks_and_none_signal_carries_state():
+    wd = Watchdog(
+        rules=(SloRule("lag", "wal_lag_records", "<=", 10.0, for_ticks=2),),
+        min_interval_s=0.0,
+    )
+    rec_mod.RECORDER.set_gauge("wal_lag_records", "w", 50.0)
+    wd.sample()
+    assert wd.health()["ok"]  # one breach < for_ticks
+    wd.sample()
+    health = wd.health()
+    assert not health["ok"] and health["firing"] == ["lag"]
+    snap = observe.snapshot()
+    assert snap["derived"]["slo_alerts_fired_total"] == 1
+    assert snap["derived"]["slo_alerts_firing"] == 1
+    [fired] = [e for e in snap["events"] if e["kind"] == "slo_fired"]
+    assert fired["rule"] == "lag" and fired["value"] == 50.0 and fired["op"] == "<="
+    # more breaching samples do not re-fire
+    wd.sample()
+    assert observe.snapshot()["derived"]["slo_alerts_fired_total"] == 1
+    # recovery resolves on the first healthy sample
+    rec_mod.RECORDER.set_gauge("wal_lag_records", "w", 0.0)
+    wd.sample()
+    snap = observe.snapshot()
+    assert wd.health()["ok"]
+    assert snap["derived"]["slo_alerts_resolved_total"] == 1
+    assert snap["derived"]["slo_alerts_firing"] == 0
+
+
+def test_recompile_storm_fires_within_for_ticks_and_resolves_after():
+    storm_rule = next(r for r in DEFAULT_SLOS if r.name == "recompile_storm")
+    wd = Watchdog(rules=(storm_rule,), min_interval_s=0.0)
+    wd.sample()  # baseline: zero deltas
+    fired_at = None
+    for i in range(4):  # storm: 4 fresh compiles per sample window
+        for j in range(4):
+            rec_mod.note_jit_compile(f"storm_{i}_{j}")
+        wd.sample()
+        if not wd.health()["ok"]:
+            fired_at = i + 1
+            break
+    # stat climbs 3/sample (delta 4 − k 1), breaches >3.0 at sample 2,
+    # fires at for_ticks=2 consecutive breaches
+    assert fired_at is not None and fired_at <= storm_rule.for_ticks + 1
+    [ev] = [e for e in observe.snapshot()["events"] if e["kind"] == "slo_fired"]
+    assert ev["rule"] == "recompile_storm" and ev["signal"] == "recompile_cusum_stat"
+    # storm stops: the statistic decays by k per clean sample and resolves
+    for _ in range(16):
+        wd.sample()
+        if wd.health()["ok"]:
+            break
+    health = wd.health()
+    assert health["ok"] and health["verdict"] == "healthy"
+    snap = observe.snapshot()
+    assert snap["derived"]["slo_alerts_resolved_total"] == 1
+    assert snap["derived"]["slo_alerts_firing"] == 0
+
+
+def test_latency_regression_fires_tick_p99_slo():
+    rule = next(r for r in DEFAULT_SLOS if r.name == "tick_latency_p99")
+    wd = Watchdog(rules=(rule,), min_interval_s=0.0)
+    for i in range(8):  # sustained 0.5s ticks — double the 0.25s ceiling
+        observe.record_complete("tick", "engine", 0.0, 0.5)
+        wd.sample()
+    health = wd.health()
+    assert not health["ok"] and health["firing"] == ["tick_latency_p99"]
+    assert health["signals"]["tick_p99_s"] >= 0.25
+    [ev] = [e for e in observe.snapshot()["events"] if e["kind"] == "slo_fired"]
+    assert ev["rule"] == "tick_latency_p99"
+
+
+# ------------------------------------------------------------- shard mergeability
+
+def test_export_state_is_json_able_and_sync_merges_peer_shards():
+    import json
+
+    a = Watchdog(min_interval_s=0.0)
+    b = Watchdog(min_interval_s=0.0)
+    for i in range(3):
+        rec_mod.note_jit_compile(f"a{i}")
+        a.sample()
+    state = b.export_state()
+    json.dumps(a.export_state())  # wire format must serialize
+    samples_before = a.health()["samples"]
+    a.sync_telemetry([state])
+    assert a.health()["samples"] == samples_before + b.health()["samples"]
+    # merging an idle peer leaves the local statistic unchanged
+    stat = next(iter(a._cusums.values())).statistic()
+    assert math.isfinite(stat)
+
+
+# --------------------------------------------------------------- fleet integration
+
+def test_clean_fleet_ticks_sample_watchdog_and_stay_alert_free():
+    from metrics_tpu.classification.accuracy import MulticlassAccuracy
+    from metrics_tpu.engine.stream import StreamEngine
+
+    rng = np.random.default_rng(0)
+    engine = StreamEngine(initial_capacity=8)
+    sids = [engine.add_session(MulticlassAccuracy(num_classes=4)) for _ in range(6)]
+
+    def run_ticks(n_ticks):
+        # uniform batch shape: every flush coalesces to ONE dispatch, the
+        # steady-state economy the dispatch_economy SLO pins
+        for _ in range(n_ticks):
+            for sid in sids:
+                engine.submit(sid, rng.integers(0, 4, 16), rng.integers(0, 4, 16))
+            engine.tick()
+
+    run_ticks(6)  # warmup: compile every bucket size before the watchdog watches
+    wd = Watchdog(min_interval_s=0.0)
+    observe.install_watchdog(wd)
+    assert observe.installed_watchdog() is wd
+    run_ticks(8)  # steady state: every tick is one watchdog sample
+    snap = observe.snapshot()
+    assert snap["derived"]["watchdog_samples_total"] >= 8  # tick() poked it
+    assert snap["derived"]["slo_alerts_fired_total"] == 0
+    assert snap["derived"]["slo_alerts_firing"] == 0
+    health = wd.health()
+    assert health["ok"] and health["verdict"] == "healthy"
+    # signals surfaced as gauges for fleet_top / prometheus
+    assert "recompile_cusum_stat" in (snap["gauges"].get("watchdog_signal") or {})
+
+
+def test_fleet_top_renders_alerts_and_compiles_sections():
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import fleet_top
+    finally:
+        sys.path.pop(0)
+
+    wd = Watchdog(
+        rules=(SloRule("lag", "wal_lag_records", "<=", 1.0, for_ticks=1),),
+        min_interval_s=0.0,
+    )
+    rec_mod.RECORDER.set_gauge("wal_lag_records", "w", 9.0)
+    rec_mod.note_compile_miss("shared_jit", "Acc", (("class", "Acc"), ("x64", False)))
+    rec_mod.note_compile_miss("shared_jit", "Acc", (("class", "Acc"), ("x64", True)))
+    wd.sample()
+    report = fleet_top.render_report(observe.snapshot())
+    assert "== alerts ==" in report and "FIRING" in report
+    assert "== compiles ==" in report and "shared_jit" in report
+
+
+# ------------------------------------------------------------------- prometheus
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})? (?P<value>[0-9eE+.\-]+|NaN)$'
+)
+
+
+def test_prometheus_round_trips_watchdog_and_alert_families():
+    wd = Watchdog(
+        rules=(SloRule("lag", "wal_lag_records", "<=", 1.0, for_ticks=1),),
+        min_interval_s=0.0,
+    )
+    rec_mod.RECORDER.set_gauge("wal_lag_records", "w", 9.0)
+    # a label with every escape-worthy character, exported through a counter
+    nasty = 'he said "hi"\\\nbye'
+    rec_mod.RECORDER.add_count("compile_explain", nasty)
+    wd.sample()
+    wd.sample()  # resolve path exercises slo_resolved too once healthy
+    text = observe.prometheus()
+
+    helped, typed = set(), set()
+    seen = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name = m.group("name")
+        assert name.startswith("metrics_tpu_"), name
+        base = name
+        for suffix in ("_total", "_count", "_sum"):
+            if base.endswith(suffix):
+                base = base[: -len(suffix)]
+        assert base in helped or name in helped, name
+        assert base in typed or name in typed, name
+        seen.add(name)
+    assert "metrics_tpu_watchdog_signal" in seen
+    assert "metrics_tpu_slo_firing" in seen
+    assert "metrics_tpu_slo_fired_total" in seen
+    assert "metrics_tpu_watchdog_sample_total" in seen
+    # escaping round-trip: unescape the exported label, recover the original
+    [lab] = re.findall(r'metrics_tpu_compile_explain_total\{metric="(.*)"\} 1', text)
+    unescaped = lab.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    assert unescaped == nasty

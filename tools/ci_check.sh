@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
-# + donlint), the disabled-mode telemetry overhead smoke, the donation
+# + donlint), the telemetry overhead smoke (disabled-mode cost pin plus the
+# enabled-watchdog sampling budget), the donation
 # three-way cross-check, the AOT executable-cache round-trip pass (serialize
 # → fresh-dir reload with zero compiles → bit-exact vs a fresh trace,
 # baselined in tools/aot_baseline.json), the chaos fault-injection harness

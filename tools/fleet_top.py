@@ -157,6 +157,47 @@ def render_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) -
                 f"{f'{lag_rec}r/{_fmt_bytes(lag_by)}':>16}{state:>10}"
             )
 
+    # watchdog rung: SLO alert state + recompile-cause attribution (DESIGN §22)
+    firing = snap.get("gauges", {}).get("slo_firing") or {}
+    samples = derived.get("watchdog_samples_total", 0)
+    if firing or samples:
+        lines.append("")
+        lines.append("== alerts ==")
+        n_firing = sum(1 for v in firing.values() if v)
+        fired = derived.get("slo_alerts_fired_total", _counter_total(snap, "slo_fired"))
+        resolved = derived.get(
+            "slo_alerts_resolved_total", _counter_total(snap, "slo_resolved")
+        )
+        lines.append(
+            f"watchdog         {int(samples)} samples; {n_firing} firing, "
+            f"{int(fired)} fired / {int(resolved)} resolved lifetime"
+            f"{_delta(fired, pderived.get('slo_alerts_fired_total') if prev else None)}"
+        )
+        for rule in sorted(firing):
+            state = "FIRING" if firing[rule] else "ok"
+            lines.append(f"{rule:<32}{state:>8}")
+        signals = snap.get("gauges", {}).get("watchdog_signal") or {}
+        for name in sorted(signals):
+            lines.append(f"  {name:<30}{signals[name]:>12.4g}")
+
+    explains = snap.get("counters", {}).get("compile_explain") or {}
+    if explains:
+        lines.append("")
+        lines.append("== compiles ==")
+        causes = snap.get("counters", {}).get("compile_cause") or {}
+        cause_str = ", ".join(f"{c}={n}" for c, n in sorted(causes.items()))
+        lines.append(
+            f"attributed misses  {sum(explains.values())}  ({cause_str})"
+        )
+        for cache in sorted(explains):
+            lines.append(f"  {cache:<20}{explains[cache]:>6}")
+        recent = [e for e in snap.get("events") or [] if e.get("kind") == "compile_explain"]
+        for e in recent[-4:]:
+            lines.append(
+                f"  {e.get('cache', '?')}:{e.get('label', '?')}  "
+                f"cause={e.get('cause', '?')}"
+            )
+
     lines.append("")
     lines.append("== phases (DDSketch quantiles) ==")
     latency = snap.get("latency") or {}
@@ -204,6 +245,7 @@ def _demo_fleet(sessions: int, interval: int, frames: int, out) -> int:
 
     rng = np.random.default_rng(0)
     with observe.scope():
+        observe.install_watchdog(min_interval_s=0.0)
         engine = StreamEngine(initial_capacity=max(8, sessions))
         sids = [engine.add_session(MulticlassAccuracy(num_classes=8)) for _ in range(sessions)]
         prev: Optional[Dict[str, Any]] = None
@@ -220,6 +262,7 @@ def _demo_fleet(sessions: int, interval: int, frames: int, out) -> int:
             print(render_report(snap, prev), file=out)
             print("", file=out)
             prev = snap
+        observe.uninstall_watchdog()
     return 0
 
 

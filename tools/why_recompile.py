@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Explain recompiles from an ``observe.snapshot()`` JSON dump.
+
+Usage:
+    python tools/why_recompile.py snap.json [--tail N]
+    python tools/why_recompile.py - < snap.json
+
+Renders the "why recompile" report: attributed cache misses per cache and per
+cause (first / single component / multiple / rebuild), plus the last N misses
+with the exact key component that changed and its prior->now values — the
+answer to "why did my fleet recompile at step 4000?" without reading XLA logs
+(DESIGN §22).
+
+Thin wrapper over :mod:`metrics_tpu.observe.explain` so the tool works from a
+checkout without installing the package (the ``why-recompile`` console script
+is the installed-form equivalent).
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from metrics_tpu.observe.explain import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
