@@ -501,6 +501,7 @@ def bench_fleet(with_ref: bool = True):
     import jax
     import jax.numpy as jnp  # noqa: F401 — keeps jax import shape uniform with siblings
 
+    from metrics_tpu import observe
     from metrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
     from metrics_tpu.engine import StreamEngine
     from metrics_tpu.engine.core import _FLEET_JIT_CACHE
@@ -530,6 +531,9 @@ def bench_fleet(with_ref: bool = True):
     probe = rec_mod.Recorder()
     rec_mod.RECORDER, rec_mod.ENABLED = probe, True
     _FLEET_JIT_CACHE.clear()
+    # fresh per-config meter: 10k streams vs top_k=64 exercises the exact
+    # ledger -> SpaceSaving spill, and the digest asserts attribution >= 99%
+    meter = observe.install_meter(top_k=64)
     try:
         engine = StreamEngine(initial_capacity=capacity)
         kinds = {}
@@ -572,7 +576,9 @@ def bench_fleet(with_ref: bool = True):
         counters = {}
         for (name, label), v in probe.counters.items():
             counters.setdefault(name, {})[label] = v
+        metering = _metering_digest(meter)
     finally:
+        observe.uninstall_meter()
         rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
         _FLEET_JIT_CACHE.clear()
 
@@ -605,6 +611,7 @@ def bench_fleet(with_ref: bool = True):
             k: counters.get(k, {})
             for k in ("fleet_dispatch", "fleet_flush", "fleet_compile", "fleet_session_add", "fleet_session_expire")
         },
+        "metering": metering,
         "workload": (
             f"{FLEET_STREAMS} streams (2 metric classes) x {FLEET_TICKS} ticks, churn {FLEET_CHURN} "
             "[1 donated dispatch/bucket/tick, zero churn recompiles; not in geomean]"
@@ -660,6 +667,7 @@ def _bench_fleet_sharded_child():
 
     import jax
 
+    from metrics_tpu import observe
     from metrics_tpu.engine import ShardedStreamEngine
     from metrics_tpu.engine.core import _FLEET_JIT_CACHE
     from metrics_tpu.engine.durability import restore_fleet_checkpoint
@@ -676,6 +684,9 @@ def _bench_fleet_sharded_child():
     probe = rec_mod.Recorder()
     rec_mod.RECORDER, rec_mod.ENABLED = probe, True
     _FLEET_JIT_CACHE.clear()
+    # fresh per-config meter; the per-shard inner engines all feed the one
+    # process-wide meter, so the digest is the cross-shard fold for free
+    meter = observe.install_meter(top_k=64)
     try:
         fleet = ShardedStreamEngine(
             n_shards=SHARDED_SHARDS, initial_capacity=SHARDED_CAPACITY, name="bench"
@@ -728,7 +739,11 @@ def _bench_fleet_sharded_child():
         for (name, label), v in probe.counters.items():
             counters.setdefault(name, {})[label] = v
         stats = fleet.stats()
+        metering = _metering_digest(meter)
     finally:
+        # uninstall BEFORE the recovery-scaling fleets below: their dispatch
+        # wall belongs to the restore timing, not this config's attribution
+        observe.uninstall_meter()
         rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
         _FLEET_JIT_CACHE.clear()
 
@@ -797,6 +812,7 @@ def _bench_fleet_sharded_child():
         "recompiles_after_churn": sum(update_compiles.values()) - pre_churn_compiles,
         "aggregate_ms": round(1000 * aggregate_s, 3),
         "occupancy_pct": stats["occupancy_pct"],
+        "metering": metering,
         "shard0_restore_s": {
             "fleet_2shard": round(small_s, 4),
             f"fleet_{SHARDED_SHARDS}shard": round(large_s, 4),
@@ -1297,6 +1313,37 @@ def _attach_flight(configs, name):
     entry = configs.get(name)
     if flight is not None and isinstance(entry, dict) and "error" not in entry:
         entry["flight"] = flight
+
+
+def _metering_digest(mt):
+    """Fold a bench-scoped :class:`FleetMeter` into a per-config digest, with
+    the claim the meter exists for checked from live telemetry: attributed
+    wall covers >=99% of measured dispatch wall (only failed dispatches may
+    leak, and a clean bench run has none). The meter is installed fresh per
+    config, so no delta-vs-base bookkeeping is needed (unlike ``_WD_BASE``)."""
+    tot = mt.totals()
+    measured = tot["measured_dispatch_s"]
+    pct = tot["attribution_pct"]
+    assert measured > 0.0, tot
+    assert pct is not None and pct >= 99.0, tot
+    mem = mt.memory_ledger()
+    return {
+        "measured_dispatch_s": round(measured, 4),
+        "attribution_pct": round(pct, 2),
+        "sessions_exact": tot["sessions_exact"],
+        "sessions_sketched": tot["sessions_sketched"],
+        "sketch_error_bound_s": round(tot["sketch_error_bound_s"], 6),
+        "top_sessions": [
+            {
+                "session": r["session"],
+                "source": r["source"],
+                "dispatch_ms": round(1000 * r["dispatch_s"], 4),
+            }
+            for r in mt.top_sessions(3)
+        ],
+        "live_mb": round(mem["totals"]["live_bytes"] / 2**20, 3),
+        "pad_waste_mb": round(mem["totals"]["pad_waste_bytes"] / 2**20, 3),
+    }
 
 
 _WD_BASE = {}

@@ -97,8 +97,12 @@ class IngestWAL:
             self.sync()
             fsync_directory(os.path.dirname(os.path.abspath(self.path)))
 
-    def append(self, kind: str, seq: int, sid: Any, payload: Any = None) -> None:
-        """Buffer one record; durable only after the next :meth:`sync`."""
+    def append(self, kind: str, seq: int, sid: Any, payload: Any = None) -> int:
+        """Buffer one record; durable only after the next :meth:`sync`.
+
+        Returns the framed size in bytes — the per-record journal cost the
+        fleet meter attributes back to the submitting session (DESIGN §23).
+        """
         if isinstance(payload, Metric):
             # Metric.__getstate__ moves device arrays to host, so journal files
             # are process-portable; tag it so replay knows to unpickle
@@ -106,7 +110,9 @@ class IngestWAL:
         rec = pickle.dumps((kind, seq, sid, payload), protocol=_PICKLE)
         self._fh.write(_FRAME.pack(len(rec), zlib.crc32(rec) & 0xFFFFFFFF))
         self._fh.write(rec)
-        self._nbytes += _FRAME.size + len(rec)
+        nframe = _FRAME.size + len(rec)
+        self._nbytes += nframe
+        return nframe
 
     def size_bytes(self) -> int:
         """Journal record bytes (magic header excluded), counting buffered
@@ -242,6 +248,7 @@ def _save_fleet_checkpoint(
         engine._wal.sync()  # the snapshot must never be ahead of the journal
     bucket_blobs: List[bytes] = []
     bucket_pos: Dict[Any, int] = {}
+    mt = _observe._METER if _observe.ENABLED else None
     for key, bucket in engine._buckets.items():
         cached = engine._ckpt_cache.get(key)
         if cached is not None and cached[0] == bucket.version:
@@ -251,6 +258,10 @@ def _save_fleet_checkpoint(
             engine._ckpt_cache[key] = (bucket.version, blob)
         bucket_pos[key] = len(bucket_blobs)
         bucket_blobs.append(blob)
+        if mt is not None:
+            # checkpoint-byte attribution: each bucket blob amortizes over its
+            # resident sessions (DESIGN §23)
+            mt.note_ckpt_bytes([str(s) for s in bucket.slot_sids if s is not None], len(blob))
     for key in [k for k in engine._ckpt_cache if k not in engine._buckets]:
         del engine._ckpt_cache[key]  # dropped buckets must not pin their bytes
     sessions: Dict[Hashable, Dict[str, Any]] = {}
@@ -269,6 +280,8 @@ def _save_fleet_checkpoint(
         else:
             node["mode"] = "loose"
             node["metric"] = pickle.dumps(sess.metric, protocol=_PICKLE)
+            if mt is not None:
+                mt.note_ckpt_bytes([str(sid)], len(node["metric"]))
         sessions[sid] = node
     outer = {
         "kind": "fleet",

@@ -263,6 +263,13 @@ class ShardedStreamEngine:
         old = self._shards[k]
         if old._wal is not None:
             old._wal.close()  # the replacement engine takes over the journal file
+        if _observe.ENABLED:
+            # the dead engine's buckets never see _drop_bucket: retire their
+            # meter memory rows here or the ledger reports phantom live bytes
+            mt = _observe._METER
+            if mt is not None:
+                for bucket in old._buckets.values():
+                    mt.drop_bucket_memory(old._name, bucket.label)
         fresh = StreamEngine(
             initial_capacity=self._initial_capacity,
             nan_guard=self._nan_guard,

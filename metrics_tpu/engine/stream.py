@@ -81,6 +81,13 @@ def _bucket_label(metric: Metric) -> str:
     return f"{type(metric).__name__}@{fp[:8] if fp else 'unshared'}"
 
 
+def _metering_cost(template: Metric, capacity: int, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[float, float]:
+    """Static (FLOPs, bytes) of a bucket's program for the fleet meter (lazy)."""
+    from metrics_tpu.observe.metering import program_cost
+
+    return program_cost(template, capacity, args, kwargs)
+
+
 def _submission_sig(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[Any, ...]:
     """Groupability key for one submission: array leaves by aval, scalars by value.
 
@@ -127,7 +134,8 @@ class _Bucket:
     """All sessions sharing one compiled program: a padded stacked state pytree."""
 
     __slots__ = (
-        "key", "label", "template", "capacity", "stacked", "slot_sids", "free",
+        "key", "label", "template", "capacity", "stacked", "slot_sids",
+        "slot_skeys", "free",
         "high_water", "queue", "version", "computed", "computed_version",
         "compute_eager", "row_bytes", "faults",
     )
@@ -139,6 +147,9 @@ class _Bucket:
         self.capacity = capacity
         self.stacked = self._tiled_defaults(capacity)
         self.slot_sids: List[Optional[Hashable]] = [None] * capacity
+        # meter keys (str(sid)) cached per slot so the dispatch hot path never
+        # re-stringifies a wave's worth of session ids
+        self.slot_skeys: List[Optional[str]] = [None] * capacity
         # LIFO free-list, initialized so pop() hands out slot 0 first; recycled
         # slots are appended and therefore reused before untouched ones
         self.free: List[int] = list(range(capacity - 1, -1, -1))
@@ -167,6 +178,7 @@ class _Bucket:
         pad = self._tiled_defaults(old)
         self.stacked = {k: jnp.concatenate([v, pad[k]], axis=0) for k, v in self.stacked.items()}
         self.slot_sids.extend([None] * old)
+        self.slot_skeys.extend([None] * old)
         self.free.extend(range(self.capacity - 1, old - 1, -1))
         self.version += 1
 
@@ -258,8 +270,12 @@ class StreamEngine:
         """
         self._seq += 1
         if self._wal is not None and not self._replaying:
-            self._wal.append(kind, self._seq, sid, payload)
+            nbytes = self._wal.append(kind, self._seq, sid, payload)
             _observe.note_wal_append(self._name)
+            if sid is not None and _observe.ENABLED:
+                mt = _observe._METER
+                if mt is not None:
+                    mt.note_wal_bytes(str(sid), nbytes)
         return self._seq
 
     def _mark_applied(self, seq: int) -> None:
@@ -337,6 +353,7 @@ class StreamEngine:
         virgin = slot > bucket.high_water
         bucket.high_water = max(bucket.high_water, slot)
         bucket.slot_sids[slot] = sid
+        bucket.slot_skeys[slot] = str(sid)
         state = metric.__dict__["_state"]
         fresh = metric._update_count == 0 and all(
             state[k] is metric._defaults[k] for k in metric._defaults
@@ -405,7 +422,25 @@ class StreamEngine:
             # the installed watchdog (observe/watchdog.py) samples off engine
             # ticks — rate-limited inside, one attribute read when none is set
             _observe.poke_watchdog()
+            mt = _observe._METER
+            if mt is not None and mt.policy is not None:
+                # soft quota (DESIGN §23): breaches fire events/gauges inside;
+                # "demote" policies queue sessions this engine walks down the
+                # gentlest blast-radius rung — loose, never failed
+                mt.poll_quota()
+                for skey in mt.pending_demotions():
+                    self._demote_by_meter(mt, skey)
         return dispatches
+
+    def _demote_by_meter(self, mt: Any, skey: str) -> None:
+        """Demote the session whose ``str(sid)`` matches a quota breach."""
+        for sid, sess in self._sessions.items():
+            if str(sid) == skey:
+                if sess.bucket is not None:
+                    self._demote_session(sess)
+                    _observe.record_event("quota_demoted", session=skey, engine=self._name)
+                mt.confirm_demotion(skey)
+                return
 
     def _record_sample(self, dispatches: int) -> None:
         """One rolling time-series sample of fleet health (telemetry on only)."""
@@ -439,6 +474,12 @@ class StreamEngine:
                 self._flush_loose(sess)
         return dispatches
 
+    def _meter_loose(self, sess: _Session) -> None:
+        """Charge one eagerly-applied update to the session's meter ledger."""
+        mt = _observe._METER if _observe.ENABLED else None
+        if mt is not None:
+            mt.note_loose_update(str(sess.sid))
+
     def _flush_loose(self, sess: _Session) -> None:
         pending, sess.queue = sess.queue, []
         for i, (seq, args, kwargs) in enumerate(pending):
@@ -452,6 +493,7 @@ class StreamEngine:
                 raise
             self._mark_applied(seq)
             _observe.note_fleet_loose_update(type(sess.metric).__name__)
+            self._meter_loose(sess)
 
     def _poisoned(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> bool:
         """Host-side finiteness sweep over the float array leaves of one batch."""
@@ -477,6 +519,10 @@ class StreamEngine:
     def _flush_bucket_traced(self, bucket: _Bucket) -> int:
         queue, bucket.queue = bucket.queue, []
         _observe.note_fleet_flush(bucket.label)
+        # fleet meter (observe/metering.py): one attribute read when disabled
+        # or uninstalled; when live, every dispatch's wall time is measured
+        # here and amortized over its wave's sessions
+        mt = _observe._METER if _observe.ENABLED else None
         # wave = how many earlier submissions this slot already has in the queue;
         # grouping on (wave, signature) keeps per-session ordering while letting
         # every first-submission-per-slot coalesce into one dispatch
@@ -509,16 +555,33 @@ class StreamEngine:
             if not live:
                 continue
             subs = [queue[i] for i in live]
+            m_t0: Optional[float] = None
             try:
                 with _trace.span("wave_assembly", bucket.label):
                     stacked_args, stacked_kwargs, mask = self._stage(bucket, subs)
+                if mt is not None:
+                    m_t0 = _observe.clock()
                 with _trace.span("dispatch", bucket.label):
                     new_stacked = engine_update(
                         bucket.template, bucket.capacity, bucket.stacked,
                         stacked_args, stacked_kwargs, mask=mask,
                         cache=_FLEET_JIT_CACHE, label=bucket.label,
                     )
+                if mt is not None:
+                    # amortization rule (DESIGN §23): measured wall + the
+                    # program's static cost, split equally over the wave
+                    mt.note_dispatch(
+                        bucket.label,
+                        [bucket.slot_skeys[s] for s, _q, _a, _k in subs],
+                        _observe.clock() - m_t0,
+                        cost_key=(bucket.label, bucket.capacity, _sig),
+                        cost_fn=lambda b=bucket, a=stacked_args, k=stacked_kwargs: _metering_cost(
+                            b.template, b.capacity, a, k
+                        ),
+                    )
             except TRACER_ERRORS as exc:
+                if mt is not None and m_t0 is not None:
+                    mt.note_failed_dispatch(bucket.label, _observe.clock() - m_t0)
                 # trace failure aborts before execution (stacked buffers intact):
                 # demote ONLY this wave's sessions to loose and replay their
                 # submissions eagerly — the rest of the bucket keeps its rows
@@ -535,11 +598,14 @@ class StreamEngine:
                     sess.metric.update(*args, **kwargs)
                     self._mark_applied(seq)
                     _observe.note_fleet_loose_update(type(sess.metric).__name__)
+                    self._meter_loose(sess)
                     self._replay_tail(queue, done, slot, sess)
                 if bucket.active() == 0:
                     self._drop_bucket(bucket)
                 continue
             except Exception as exc:  # noqa: BLE001 — runtime dispatch death
+                if mt is not None and m_t0 is not None:
+                    mt.note_failed_dispatch(bucket.label, _observe.clock() - m_t0)
                 if any(
                     getattr(v, "is_deleted", lambda: False)() for v in bucket.stacked.values()
                 ):
@@ -596,6 +662,7 @@ class StreamEngine:
             done.add(i)
             self._mark_applied(seq)
             _observe.note_fleet_row_replay(bucket.label)
+            self._meter_loose(sess)  # eager per-row replay: host work, not a shared dispatch
 
     def _replay_tail(
         self, queue: List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]],
@@ -610,6 +677,7 @@ class StreamEngine:
             sess.metric.update(*args, **kwargs)
             self._mark_applied(seq)
             _observe.note_fleet_loose_update(type(sess.metric).__name__)
+            self._meter_loose(sess)
 
     def _stage(
         self, bucket: _Bucket, subs: List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]]
@@ -650,6 +718,7 @@ class StreamEngine:
     def _release_slot(self, sess: _Session) -> None:
         bucket = sess.bucket
         bucket.slot_sids[sess.slot] = None
+        bucket.slot_skeys[sess.slot] = None
         bucket.free.append(sess.slot)
         sess.bucket = None
         sess.slot = -1
@@ -665,6 +734,9 @@ class StreamEngine:
         sess.health = "quarantined"
         bucket.faults += 1
         _observe.note_fleet_quarantine(bucket.label, reason, exc)
+        mt = _observe._METER if _observe.ENABLED else None
+        if mt is not None:
+            mt.note_quarantine(str(sess.sid))
 
     def _demote_session(self, sess: _Session) -> None:
         """Convert one bucketed session to a loose one (row handed back)."""
@@ -682,6 +754,9 @@ class StreamEngine:
         self._buckets.pop(bucket.key, None)
         self._ckpt_cache.pop(bucket.key, None)
         _observe.set_fleet_gauges(bucket.label, 0, 0, 0, 0, 0)
+        mt = _observe._METER if _observe.ENABLED else None
+        if mt is not None:
+            mt.drop_bucket_memory(self._name, bucket.label)
 
     # ------------------------------------------------------------------ readout
     def compute(self, session_id: Hashable) -> Any:
@@ -926,6 +1001,7 @@ class StreamEngine:
     def _publish_gauges(self) -> None:
         if not _observe.ENABLED:
             return
+        mt = _observe._METER
         for bucket in self._buckets.values():
             active = bucket.active()
             _observe.set_fleet_gauges(
@@ -936,5 +1012,9 @@ class StreamEngine:
                 bucket.capacity * bucket.row_bytes,
                 active * bucket.row_bytes,
             )
+            if mt is not None:
+                # memory ledger (DESIGN §23): per-bucket rows keyed by engine
+                # name, so sharded fleets ("<fleet>/shardN") never collide
+                mt.note_bucket_memory(self._name, bucket.label, bucket.capacity, active, bucket.row_bytes)
         lag_records, lag_bytes = self._wal_lag()
         _observe.note_wal_gauges(self._name, lag_records, lag_bytes, self._last_ckpt_age_s())

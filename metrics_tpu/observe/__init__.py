@@ -22,6 +22,12 @@ The third subsystem of the tooling triad (correctness → jitlint, distribution
   over the recorder's own counters, declarative :class:`SloRule` alerting
   with firing/resolved events, and per-cache recompile-cause attribution
   (``compile_explain`` events; ``tools/why_recompile.py`` renders them).
+* **fleet meter** (:mod:`metrics_tpu.observe.metering`, DESIGN §23) — host-side
+  cost & memory attribution: per-dispatch wall time and static XLA program
+  cost amortized over the wave's active sessions, exact ledgers for the top-K
+  tenants plus a mergeable SpaceSaving heavy-hitter sketch beyond, per-bucket
+  memory ledgers from state avals, and an opt-in soft-quota
+  :class:`MeterPolicy` that can demote a runaway session to loose.
 * **static half** (:mod:`metrics_tpu.observe.costs` +
   :mod:`metrics_tpu.observe.profile`) — XLA cost profiling via
   ``jax.jit(update).lower(...).cost_analysis()`` over the jit-eligible
@@ -43,6 +49,14 @@ overhead smoke behind ``tools/lint_metrics.py --all``.
 """
 
 from metrics_tpu.observe.latency import sync_telemetry
+from metrics_tpu.observe.metering import (
+    FleetMeter,
+    MeterPolicy,
+    SpaceSaving,
+    install_meter,
+    installed_meter,
+    uninstall_meter,
+)
 from metrics_tpu.observe.recorder import (
     RECORDER,
     SCHEMA_VERSION,
@@ -74,16 +88,21 @@ from metrics_tpu.observe.watchdog import (
 # import
 __all__ = [
     "DEFAULT_SLOS",
+    "FleetMeter",
+    "MeterPolicy",
     "RECORDER",
     "Recorder",
     "SCHEMA_VERSION",
     "SloRule",
+    "SpaceSaving",
     "Watchdog",
     "disable",
     "drain_spans",
     "enable",
     "enabled",
+    "install_meter",
     "install_watchdog",
+    "installed_meter",
     "installed_watchdog",
     "poke_watchdog",
     "prometheus",
@@ -96,10 +115,11 @@ __all__ = [
     "span",
     "sync_telemetry",
     "timeline",
+    "uninstall_meter",
     "uninstall_watchdog",
 ]
 
-_LAZY_SUBMODULES = ("costs", "explain", "latency", "overhead", "profile", "recorder", "tracing", "watchdog")
+_LAZY_SUBMODULES = ("costs", "explain", "latency", "metering", "overhead", "profile", "recorder", "tracing", "watchdog")
 
 
 def __getattr__(name):

@@ -36,6 +36,16 @@ charges one poke per step *plus* the amortized share of a real sample
 (``sample_s * step_s / min_interval_s``) and requires the total under
 :data:`MAX_OVERHEAD_PCT` of the same 1k-step loop.
 
+The *enabled*-meter budget (DESIGN §23) follows too: with a
+:class:`~metrics_tpu.observe.metering.FleetMeter` installed, a step rides
+either the bucketed path (its amortized share of one ``note_dispatch`` over
+the wave, key-list build included) or the eager path (one
+``note_loose_update``) — the check charges the costlier of the two, plus the
+rate-limited ``poll_quota`` fast path (one clock read per tick, amortized
+over the wave that tick serves) and the amortized share of one full quota
+scan per ``poll_interval_s`` (the watchdog-sample discipline), and requires
+the total under the same :data:`MAX_OVERHEAD_PCT`.
+
 The verdict is an absolute threshold, not a baseline ratchet — the contract
 is "disabled telemetry is free", not "no slower than last week".
 ``--update-baseline`` still records the measured numbers under a
@@ -57,6 +67,7 @@ __all__ = [
     "SPANS_PER_STEP",
     "main",
     "measure_disabled_costs",
+    "measure_metering_costs",
     "measure_step_cost",
     "measure_watchdog_costs",
     "run_telemetry_check",
@@ -160,6 +171,72 @@ def measure_watchdog_costs(iters: int = 4000, repeats: int = _MICRO_REPEATS) -> 
     }
 
 
+def measure_metering_costs(iters: int = 4000, repeats: int = _MICRO_REPEATS, wave: int = 32) -> Dict[str, float]:
+    """Enabled-meter hot-path costs (seconds) per primitive.
+
+    Runs inside its own enabled ``observe.scope()`` with a
+    :class:`~metrics_tpu.observe.metering.FleetMeter` installed.
+    ``dispatch_s`` is the min-over-repeats cost of one ``note_dispatch`` for a
+    ``wave``-session wave *including* the key-list build the engine pays
+    (indexing the bucket's cached ``slot_skeys`` — ``per_session_s`` is the
+    amortized per-session share); ``loose_s`` the per-call cost of
+    ``note_loose_update``; ``poll_fast_s`` the per-call cost of the
+    rate-limited ``poll_quota`` fast path (the charge every tick pays — one
+    clock read) and ``poll_scan_s`` the mean cost of a full ledger scan,
+    which the rate limiter amortizes over ``poll_interval_s``.
+    """
+    from metrics_tpu import observe
+
+    with observe.scope(reset=True):
+        mt = observe.install_meter(
+            top_k=64,
+            policy=observe.MeterPolicy(max_updates=1 << 60, cooldown_s=3600.0),
+        )
+        try:
+            # the engine indexes the bucket's cached slot_skeys per wave; the
+            # list build below mirrors that (slots in a wave are a subset)
+            skeys = [str(i) for i in range(wave)]
+            best_dispatch = float("inf")
+            best_loose = float("inf")
+            best_empty = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    pass
+                best_empty = min(best_empty, (time.perf_counter() - t0) / iters)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    mt.note_dispatch("bench", [skeys[i] for i in range(wave)], 1e-9)
+                best_dispatch = min(best_dispatch, (time.perf_counter() - t0) / iters)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    mt.note_loose_update("0")
+                best_loose = min(best_loose, (time.perf_counter() - t0) / iters)
+            best_poll = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    mt.poll_quota()  # rate-limited: the per-tick fast path
+                best_poll = min(best_poll, (time.perf_counter() - t0) / iters)
+            n_scans = 20
+            t0 = time.perf_counter()
+            for i in range(n_scans):
+                mt.poll_quota(now=1e9 + i)  # distinct clocks force full scans
+            poll_scan_s = (time.perf_counter() - t0) / n_scans
+        finally:
+            observe.uninstall_meter()
+    dispatch_s = max(0.0, best_dispatch - best_empty)
+    return {
+        "dispatch_s": dispatch_s,
+        "per_session_s": dispatch_s / wave,
+        "loose_s": max(0.0, best_loose - best_empty),
+        "poll_fast_s": max(0.0, best_poll - best_empty),
+        "poll_scan_s": poll_scan_s,
+        "poll_interval_s": mt.poll_interval_s,
+        "wave": float(wave),
+    }
+
+
 def measure_step_cost(steps: int = _LOOP_STEPS, repeats: int = _LOOP_REPEATS) -> float:
     """Steady-state per-step seconds of a jitted 1k-step update loop.
 
@@ -217,6 +294,38 @@ def _measure_watchdog(step_s: float) -> Dict[str, Any]:
     }
 
 
+def _measure_metering(step_s: float) -> Dict[str, Any]:
+    m = measure_metering_costs()
+    # per-step charge: a step rides EITHER the bucketed path (its wave share
+    # of one note_dispatch) OR the eager path (one note_loose_update) —
+    # charge the costlier — plus the per-tick poll, itself split into the
+    # rate-limited fast path (one clock read per tick, amortized over the
+    # wave the tick serves) and the amortized share of one full quota scan
+    # per poll_interval_s of steps
+    amortized_scan_s = (
+        m["poll_scan_s"] * step_s / m["poll_interval_s"]
+        if m["poll_interval_s"] > 0
+        else m["poll_scan_s"]
+    )
+    budget_s = (
+        max(m["per_session_s"], m["loose_s"])
+        + m["poll_fast_s"] / m["wave"]
+        + amortized_scan_s
+    )
+    overhead_pct = 100.0 * budget_s / step_s if step_s > 0 else float("inf")
+    return {
+        "dispatch_us": round(m["dispatch_s"] * 1e6, 3),
+        "per_session_ns": round(m["per_session_s"] * 1e9, 1),
+        "loose_ns": round(m["loose_s"] * 1e9, 1),
+        "poll_fast_ns": round(m["poll_fast_s"] * 1e9, 1),
+        "poll_scan_us": round(m["poll_scan_s"] * 1e6, 2),
+        "poll_interval_s": m["poll_interval_s"],
+        "wave": int(m["wave"]),
+        "overhead_pct": round(overhead_pct, 4),
+        "max_overhead_pct": MAX_OVERHEAD_PCT,
+    }
+
+
 def run_telemetry_check(
     root: str,
     baseline_path: Optional[str] = None,
@@ -224,7 +333,8 @@ def run_telemetry_check(
     quiet: bool = False,
     report: Optional[Dict[str, Any]] = None,
 ) -> int:
-    """Dynamic ``telemetry`` pass: disabled-mode + enabled-watchdog budgets (exit 0/1)."""
+    """Dynamic ``telemetry`` pass: disabled-mode + enabled-watchdog +
+    enabled-meter budgets (exit 0/1)."""
     from metrics_tpu.observe import recorder
 
     was_enabled = recorder.ENABLED
@@ -245,9 +355,16 @@ def run_telemetry_check(
         wd_attempts += 1
     wd_measured["attempts"] = wd_attempts
     measured["attempts"] = attempts
+    mt_measured = _measure_metering(step_s)
+    mt_attempts = 1
+    while mt_measured["overhead_pct"] >= MAX_OVERHEAD_PCT and mt_attempts < _VERDICT_ATTEMPTS:
+        mt_measured = _measure_metering(step_s)
+        mt_attempts += 1
+    mt_measured["attempts"] = mt_attempts
     ok = (
         measured["overhead_pct"] < MAX_OVERHEAD_PCT
         and wd_measured["overhead_pct"] < MAX_OVERHEAD_PCT
+        and mt_measured["overhead_pct"] < MAX_OVERHEAD_PCT
     )
 
     if update_baseline:
@@ -257,7 +374,11 @@ def run_telemetry_check(
         write_baseline_section(
             path,
             "telemetry",
-            {"disabled_mode": measured, "enabled_watchdog": wd_measured},
+            {
+                "disabled_mode": measured,
+                "enabled_watchdog": wd_measured,
+                "enabled_metering": mt_measured,
+            },
             "telemetry overhead record — disabled-mode instrumentation cost vs a "
             "1k-step update loop. Informational (the pass verdict is the absolute "
             f"{MAX_OVERHEAD_PCT}% threshold); regenerate with "
@@ -269,6 +390,7 @@ def run_telemetry_check(
     if report is not None:
         report["disabled_mode"] = measured
         report["enabled_watchdog"] = wd_measured
+        report["enabled_metering"] = mt_measured
     if not quiet:
         verdict = "ok" if ok else "FAIL"
         print(
@@ -279,7 +401,11 @@ def run_telemetry_check(
             f"budget {MAX_OVERHEAD_PCT}%); "
             f"watchdog overhead {wd_measured['overhead_pct']:.3f}% "
             f"(poke {wd_measured['poke_ns']:.0f}ns, sample "
-            f"{wd_measured['sample_us']:.0f}us per {wd_measured['min_interval_s']:g}s) "
+            f"{wd_measured['sample_us']:.0f}us per {wd_measured['min_interval_s']:g}s); "
+            f"metering overhead {mt_measured['overhead_pct']:.3f}% "
+            f"(dispatch {mt_measured['dispatch_us']:.1f}us/{mt_measured['wave']}-wave, "
+            f"loose {mt_measured['loose_ns']:.0f}ns, poll "
+            f"{mt_measured['poll_scan_us']:.0f}us per {mt_measured['poll_interval_s']:g}s) "
             f"— {verdict}"
         )
     return 0 if ok else 1

@@ -94,12 +94,18 @@ ENABLED = False
 # set changes, so downstream consumers (fleet_top, why_recompile, external
 # scrapers) can detect which contract a serialized snapshot file carries.
 # 2 = PR 14 (schema_version itself + watchdog/SLO/compile-explain deriveds).
-SCHEMA_VERSION = 2
+# 3 = PR 15 (top-level "metering" section + meter/sync-bytes deriveds).
+SCHEMA_VERSION = 3
 
 # process-wide watchdog (observe/watchdog.py) registered via _set_watchdog;
 # held here — not in the watchdog module — so engine hot paths can poke it
 # through this already-imported module with one attribute read
 _WATCHDOG: Optional[Any] = None
+
+# process-wide fleet meter (observe/metering.py) registered via _set_meter —
+# same pattern as the watchdog: engine hot paths reach it with one attribute
+# read, and it survives a swapped-in probe Recorder (bench configs)
+_METER: Optional[Any] = None
 
 clock: Callable[[], float] = time.perf_counter
 
@@ -360,6 +366,12 @@ def _set_watchdog(watchdog: Optional[Any]) -> None:
     """Register (or clear) the process-wide watchdog; observe/watchdog.py owns this."""
     global _WATCHDOG
     _WATCHDOG = watchdog
+
+
+def _set_meter(meter: Optional[Any]) -> None:
+    """Register (or clear) the process-wide fleet meter; observe/metering.py owns this."""
+    global _METER
+    _METER = meter
 
 
 def poke_watchdog() -> None:
@@ -689,6 +701,7 @@ def snapshot() -> Dict[str, Any]:
          "latency":  {phase: {label: {"count", "total_s", "mean_s", "min_s",
                       "max_s", "p50_s", "p90_s", "p99_s", "p999_s"}}},
          "series":   [{"t", ...fleet sample fields...}, ...],
+         "metering": {"installed": bool, ...FleetMeter.snapshot_payload()...},
          "derived":  {"jit_cache_hit_rate": float|None,
                       "jit_compiles_total": int, "jit_cache_hits_total": int,
                       "jit_cache_evictions_total": int, "eager_fallbacks_total": int,
@@ -718,7 +731,14 @@ def snapshot() -> Dict[str, Any]:
                       "watchdog_samples_total": int,
                       "slo_alerts_fired_total": int,
                       "slo_alerts_resolved_total": int,
-                      "slo_alerts_firing": int}}
+                      "slo_alerts_firing": int,
+                      "meter_sessions_tracked": int,
+                      "meter_attributed_dispatch_s": float,
+                      "meter_attribution_pct": float|None,
+                      "meter_live_bytes": int,
+                      "meter_pad_waste_bytes": int,
+                      "meter_quota_exceeded_total": int,
+                      "sync_bytes_total": int}}
 
     The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
     buckets: occupancy is live rows over padded capacity, pad waste is the
@@ -737,6 +757,11 @@ def snapshot() -> Dict[str, Any]:
     adds attributed compile-miss counts (``compile_explains_total``), watchdog
     sample counts and the SLO alert totals, with ``slo_alerts_firing`` the
     number of rules currently in the firing state (the ``slo_firing`` gauges).
+    The metering rung (DESIGN §23) adds the installed :class:`FleetMeter`'s
+    full payload under ``metering`` (``{"installed": False}`` when none is
+    installed), per-tenant attribution deriveds (``meter_*``), and the
+    summed per-state collective traffic from ``parallel/sync.py``
+    (``sync_bytes_total``).
     """
     if RECORDER.latency:
         # lazy: latency.py pulls in numpy, which this stdlib-only module must not
@@ -778,6 +803,15 @@ def snapshot() -> Dict[str, Any]:
     aot_lookups = aot_hits + aot_misses
     shard_active = sum(gauges.get("shard_rows_active", {}).values())
     shard_capacity = sum(gauges.get("shard_rows_capacity", {}).values())
+    mt = _METER
+    if mt is not None:
+        metering = mt.snapshot_payload()
+        meter_totals = metering["totals"]
+        meter_memory = metering["memory"]["totals"]
+    else:
+        metering = {"installed": False}
+        meter_totals = {}
+        meter_memory = {}
     return {
         "enabled": ENABLED,
         "schema_version": SCHEMA_VERSION,
@@ -787,6 +821,7 @@ def snapshot() -> Dict[str, Any]:
         "gauges": {k: dict(sorted(v.items())) for k, v in sorted(gauges.items())},
         "latency": latency,
         "series": series,
+        "metering": metering,
         "derived": {
             "jit_cache_hit_rate": (hits / lookups) if lookups else None,
             "jit_compiles_total": compiles,
@@ -828,6 +863,14 @@ def snapshot() -> Dict[str, Any]:
             "slo_alerts_fired_total": sum(counters.get("slo_fired", {}).values()),
             "slo_alerts_resolved_total": sum(counters.get("slo_resolved", {}).values()),
             "slo_alerts_firing": sum(1 for v in gauges.get("slo_firing", {}).values() if v),
+            "meter_sessions_tracked": int(meter_totals.get("sessions_exact", 0))
+            + int(meter_totals.get("sessions_sketched", 0)),
+            "meter_attributed_dispatch_s": float(meter_totals.get("attributed_s", 0.0)),
+            "meter_attribution_pct": meter_totals.get("attribution_pct"),
+            "meter_live_bytes": int(meter_memory.get("live_bytes", 0)),
+            "meter_pad_waste_bytes": int(meter_memory.get("pad_waste_bytes", 0)),
+            "meter_quota_exceeded_total": sum(counters.get("quota_exceeded", {}).values()),
+            "sync_bytes_total": sum(counters.get("sync_bytes", {}).values()),
         },
     }
 
@@ -851,7 +894,10 @@ def prometheus() -> str:
     the flight recorder's DDSketch phase latencies as full summary families
     with ``quantile`` labels (p50/p90/p99/p999). Every family carries
     ``# HELP``/``# TYPE`` headers — ready for a textfile collector or a
-    scrape handler.
+    scrape handler. With a fleet meter installed (observe/metering.py) the
+    ``metrics_tpu_meter_*`` families ride along, cardinality-bounded by
+    construction: at most ``top_k`` session label values regardless of how
+    many sessions the fleet has served.
     """
     snap = snapshot()
     lines: List[str] = []
@@ -891,6 +937,9 @@ def prometheus() -> str:
                     lines.append(f'{prom}{{label="{esc}",quantile="{q}"}} {value:.9f}')
             lines.append(f'{prom}_count{{label="{esc}"}} {agg["count"]}')
             lines.append(f'{prom}_sum{{label="{esc}"}} {agg["total_s"]:.9f}')
+    mt = _METER
+    if mt is not None:
+        lines.extend(mt.prometheus_lines(_prom_name, _prom_label))
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -354,6 +354,12 @@ def allreduce_over_mesh(
                 p[k], _ = pad_to_capacity(p[k], cap)
             ragged[k] = dims
     stacked = {k: jnp.stack([p[k] for p in prepped]) for k in prepped[0]}
+    if rec is not None:
+        # per-state collective traffic (DESIGN §23): the bytes this state pushes
+        # through the mesh — the interconnect-pressure signal ROADMAP item 2
+        # (quantized collectives) sizes its wins against
+        for k, v in stacked.items():
+            rec.add_count("sync_bytes", k, int(v.size) * np.dtype(v.dtype).itemsize)
     specs = {k: P(axis_name, *([None] * (stacked[k].ndim - 1))) for k in stacked}
 
     def _body(state):
@@ -408,11 +414,16 @@ def gather_all_states(states: List[Any], group: Any = None) -> List[List[Any]]:
         max_size = int(np.max(np.asarray(sizes)))
         if s.ndim == 0:
             gathered = multihost_utils.process_allgather(s)
+            if rec is not None:
+                rec.add_count("sync_bytes", f"state{len(out)}", int(np.dtype(s.dtype).itemsize) * world)
             out.append([gathered[i] for i in range(world)])
             continue
         pad = [(0, max_size - s.shape[0])] + [(0, 0)] * (s.ndim - 1)
         padded = jnp.pad(s, pad)
         gathered = multihost_utils.process_allgather(padded)
+        if rec is not None:
+            # allgather moves every rank's padded copy: padded bytes × world
+            rec.add_count("sync_bytes", f"state{len(out)}", int(padded.size) * np.dtype(padded.dtype).itemsize * world)
         out.append([gathered[i, : int(sizes[i])] for i in range(world)])
     if rec is not None:
         t1 = _observe.clock()

@@ -1,0 +1,359 @@
+"""Fleet metering (``observe/metering.py``, DESIGN §23).
+
+Cost & memory attribution for multi-tenant fleets. These tests pin:
+
+* the SpaceSaving heavy-hitter sketch against an exact-count oracle on a
+  skewed 1e5-element stream, including the mergeable-summaries bound for a
+  merge of per-shard sketches;
+* the amortization rule (dispatch wall split over the wave's active rows)
+  and the conservation identity ``attributed_s <= measured_dispatch_s``;
+* the exact-ledger/sketch split at ``top_k`` and the ``sync_telemetry``
+  fold of shard meters against a single-ledger oracle;
+* Prometheus exposition: metering families parse, per-session label
+  cardinality stays bounded by ``top_k`` no matter the fleet size, and
+  escape-worthy session keys round-trip;
+* the engine hot-path wiring end to end: dispatch/WAL/checkpoint/memory
+  attribution through a real ``StreamEngine`` and the soft-quota
+  ``MeterPolicy`` demoting a runaway session to loose.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import re
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import observe
+from metrics_tpu.classification.accuracy import MulticlassAccuracy
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.observe import recorder as rec_mod
+from metrics_tpu.observe.metering import FleetMeter, MeterPolicy, SpaceSaving
+
+
+@pytest.fixture(autouse=True)
+def _scoped():
+    with observe.scope(reset=True):
+        yield
+    observe.uninstall_meter()
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=4)
+
+
+def _batch(rng, n=8):
+    return jnp.asarray(rng.randint(4, size=n)), jnp.asarray(rng.randint(4, size=n))
+
+
+# ------------------------------------------------------------------ SpaceSaving
+
+def test_spacesaving_matches_exact_oracle_on_skewed_stream():
+    rng = np.random.default_rng(7)
+    stream = rng.zipf(1.6, size=100_000)
+    stream = stream[stream < 10_000]  # keep the key space bounded but skewed
+    exact = collections.Counter(int(x) for x in stream)
+    sk = SpaceSaving(capacity=64)
+    for x in stream:
+        sk.offer(str(int(x)))
+    total = float(len(stream))
+    assert sk.total == pytest.approx(total)
+    bound = sk.error_bound()
+    assert bound == pytest.approx(total / 64)
+    # every tracked estimate over-counts by at most its recorded error, and
+    # the recorded error never exceeds the structural total/capacity bound
+    for key, est, err in sk.items():
+        true = exact[int(key)]
+        assert err <= bound + 1e-9
+        assert true <= est + 1e-9          # SpaceSaving never under-counts
+        assert est - true <= err + 1e-9    # over-count is bounded by the error bar
+    # the guarantee: any key heavier than the bound is tracked
+    tracked = {key for key, _est, _err in sk.items()}
+    for key, true in exact.items():
+        if true > bound:
+            assert str(key) in tracked, f"heavy key {key} ({true} > {bound}) evicted"
+
+
+def test_spacesaving_shard_merge_stays_within_mergeable_summaries_bound():
+    rng = np.random.default_rng(11)
+    stream = [str(int(x)) for x in rng.zipf(1.5, size=100_000) if x < 5_000]
+    exact = collections.Counter(stream)
+    cap = 64
+    single = SpaceSaving(capacity=cap)
+    shards = [SpaceSaving(capacity=cap) for _ in range(4)]
+    for i, key in enumerate(stream):
+        single.offer(key)
+        shards[i % 4].offer(key)
+    merged = SpaceSaving(capacity=cap)
+    for sh in shards:
+        merged.merge(sh)
+    assert merged.total == pytest.approx(single.total)
+    # merged error bound is the sum of the inputs' weights over capacity
+    assert merged.error_bound() <= sum(sh.total for sh in shards) / cap + 1e-9
+    # mergeable-summaries guarantee (Agarwal et al.): the merge keeps every
+    # surviving estimate within the COMBINED additive bound — a key evicted
+    # from one shard's sketch may now under-count, unlike the single-sketch
+    # case, but never by more than the summed per-shard bounds
+    blur = sum(sh.error_bound() for sh in shards) + 1e-9
+    for key, est, _err in merged.items():
+        assert abs(est - exact[key]) <= blur
+
+
+def test_spacesaving_state_roundtrip_is_lossless():
+    sk = SpaceSaving(capacity=8)
+    for i, key in enumerate("aabbbcccc"):
+        sk.offer(key, weight=1.0 + i * 0.25)
+    back = SpaceSaving.from_state(json.loads(json.dumps(sk.state())))
+    assert back.capacity == sk.capacity
+    assert back.total == pytest.approx(sk.total)
+    assert back.items() == sk.items()
+
+
+# ------------------------------------------------------------------ amortization
+
+def test_dispatch_wall_amortizes_evenly_over_wave():
+    mt = FleetMeter(top_k=8)
+    mt.note_dispatch("b0", ["s1", "s2", "s3", "s4"], 0.4)
+    t = mt.totals()
+    assert t["measured_dispatch_s"] == pytest.approx(0.4)
+    assert t["attributed_s"] == pytest.approx(0.4)
+    assert t["attribution_pct"] == pytest.approx(100.0)
+    for row in mt.top_sessions():
+        assert row["dispatch_s"] == pytest.approx(0.1)
+        assert row["updates"] == 1
+
+
+def test_failed_dispatch_measures_but_attributes_nothing():
+    mt = FleetMeter(top_k=8)
+    mt.note_dispatch("b0", ["s1"], 0.1)
+    mt.note_failed_dispatch("b0", 0.1)
+    t = mt.totals()
+    assert t["measured_dispatch_s"] == pytest.approx(0.2)
+    assert t["attributed_s"] == pytest.approx(0.1)
+    assert t["attribution_pct"] == pytest.approx(50.0)
+
+
+def test_sessions_beyond_top_k_fold_into_sketch():
+    mt = FleetMeter(top_k=2, sketch_capacity=8)
+    for i in range(5):
+        mt.note_dispatch("b0", [f"s{i}"], 0.1)
+    t = mt.totals()
+    assert t["sessions_exact"] == 2
+    assert t["sessions_sketched"] == 3
+    assert t["attributed_s"] == pytest.approx(0.5)
+    assert t["sketch_total_s"] == pytest.approx(0.3)
+    assert mt.explain_session("s0")["tracked"] == "exact"
+    assert mt.explain_session("s4")["tracked"] == "sketch"
+    assert mt.explain_session("nope")["tracked"] is None
+
+
+def test_sharded_fold_agrees_with_single_ledger_oracle():
+    rng = np.random.default_rng(3)
+    n_sessions, cap = 400, 32
+    weights = rng.zipf(1.4, size=n_sessions).astype(float)
+    oracle = FleetMeter(top_k=16, sketch_capacity=cap)
+    shards = [FleetMeter(top_k=16, sketch_capacity=cap) for _ in range(4)]
+    for i, w in enumerate(weights):
+        skey = f"s{i}"
+        wall = 1e-3 * w
+        oracle.note_dispatch("b", [skey], wall)
+        shards[i % 4].note_dispatch("b", [skey], wall)
+    folded = FleetMeter(top_k=16, sketch_capacity=cap).sync_telemetry(
+        [sh.export_state() for sh in shards]
+    )
+    to = oracle.totals()
+    tf = folded.totals()
+    assert tf["measured_dispatch_s"] == pytest.approx(to["measured_dispatch_s"])
+    assert tf["attributed_s"] == pytest.approx(to["attributed_s"])
+    # per-session: the fold may only blur a session by the folded sketch's
+    # error bound (exact rows in the oracle are exact by construction)
+    blur = tf["sketch_error_bound_s"] + 1e-9
+    oracle_disp = {f"s{i}": 1e-3 * w for i, w in enumerate(weights)}
+    for row in folded.top_sessions(n=10):
+        true = oracle_disp[row["session"]]
+        assert row["dispatch_s"] >= true - 1e-9      # never under-counts
+        assert row["dispatch_s"] - true <= blur
+
+
+def test_export_state_is_json_able_and_fold_of_one_is_identity():
+    mt = FleetMeter(top_k=2, sketch_capacity=4)
+    for i in range(5):
+        mt.note_dispatch("b0", [f"s{i}"], 0.125)
+    mt.note_wal_bytes("s0", 64)
+    mt.note_bucket_memory("e", "b0", capacity=8, active=5, row_bytes=16)
+    state = json.loads(json.dumps(mt.export_state()))
+    back = FleetMeter(top_k=2, sketch_capacity=4).sync_telemetry([state])
+    assert back.totals()["measured_dispatch_s"] == pytest.approx(
+        mt.totals()["measured_dispatch_s"]
+    )
+    assert back.memory_ledger()["totals"] == mt.memory_ledger()["totals"]
+    assert back.explain_session("s0")["wal_bytes"] == 64
+
+
+# ------------------------------------------------------------------ prometheus
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})? (?P<value>[0-9eE+.\-]+|NaN)$'
+)
+
+
+def test_prometheus_metering_families_parse_with_bounded_cardinality():
+    top_k = 4
+    mt = observe.install_meter(top_k=top_k, sketch_capacity=8)
+    nasty = 'job "a"\\\nb'
+    mt.note_dispatch("b0", [nasty], 0.01)
+    for i in range(50):  # far more sessions than top_k
+        mt.note_dispatch("b0", [f"s{i}"], 0.01)
+    mt.note_bucket_memory("eng", "b0", capacity=16, active=10, row_bytes=8)
+    text = observe.prometheus()
+
+    helped, typed = set(), set()
+    session_labels = collections.defaultdict(set)
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name = m.group("name")
+        if name.startswith("metrics_tpu_meter_session_"):
+            [lab] = re.findall(r'session="((?:[^"\\]|\\.)*)"', m.group("labels"))
+            session_labels[name].add(lab)
+    for fam in (
+        "metrics_tpu_meter_session_dispatch_s_total",
+        "metrics_tpu_meter_session_updates_total",
+        "metrics_tpu_meter_session_est_flops_total",
+        "metrics_tpu_meter_session_est_bytes_total",
+        "metrics_tpu_meter_session_wal_bytes_total",
+    ):
+        assert fam in helped and fam in typed, fam
+        # cardinality bounded by construction: only the exact ledgers label
+        assert 0 < len(session_labels[fam]) <= top_k, fam
+    for fam in (
+        "metrics_tpu_meter_bucket_live_bytes",
+        "metrics_tpu_meter_bucket_pad_waste_bytes",
+        "metrics_tpu_meter_bucket_peak_capacity_bytes",
+        "metrics_tpu_meter_bucket_projected_2x_bytes",
+        "metrics_tpu_meter_measured_dispatch_seconds",
+        "metrics_tpu_meter_attributed_dispatch_seconds",
+        "metrics_tpu_meter_sketch_weight_seconds",
+        "metrics_tpu_meter_sketch_error_bound_seconds",
+    ):
+        assert fam in helped and fam in typed, fam
+    # escaping round-trip: the nasty session key is an exact ledger (it came
+    # first), so it must appear, escaped per the exposition format
+    labels = session_labels["metrics_tpu_meter_session_dispatch_s_total"]
+    unescaped = {
+        lab.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+        for lab in labels
+    }
+    assert nasty in unescaped
+
+
+# ------------------------------------------------------------------ engine wiring
+
+def test_engine_dispatch_wal_ckpt_and_memory_attribution(tmp_path):
+    rng = np.random.RandomState(0)
+    mt = observe.install_meter(top_k=8)
+    engine = StreamEngine(
+        initial_capacity=4, wal_path=str(tmp_path / "fleet.wal"), name="metered"
+    )
+    sids = [engine.add_session(_acc()) for _ in range(6)]
+    for _ in range(2):
+        for sid in sids:
+            engine.submit(sid, *_batch(rng))
+        engine.tick()
+    engine.checkpoint(str(tmp_path / "fleet.mtckpt"))
+    t = mt.totals()
+    assert t["measured_dispatch_s"] > 0
+    assert t["attribution_pct"] == pytest.approx(100.0)
+    assert t["sessions_exact"] == 6
+    ex = mt.explain_session(sids[0])
+    assert ex["tracked"] == "exact"
+    assert ex["updates"] == 2
+    assert ex["wal_bytes"] > 0       # add + submit frames journaled
+    assert ex["ckpt_bytes"] > 0      # bucket blob amortized over residents
+    assert ex["est_flops"] > 0       # static XLA cost model attributed
+    mem = mt.memory_ledger()
+    assert mem["totals"]["live_bytes"] > 0
+    [(key, row)] = list(mem["buckets"].items())
+    assert key.startswith("metered::")
+    assert row["active"] == 6
+    assert row["live_bytes"] == 6 * row["row_bytes"]
+    assert row["projected_2x_bytes"] == 2 * row["capacity"] * row["row_bytes"]
+    # snapshot surface: the metering section and its derived keys
+    snap = observe.snapshot()
+    assert snap["metering"]["installed"] is True
+    d = snap["derived"]
+    assert d["meter_sessions_tracked"] == 6
+    assert d["meter_attribution_pct"] == pytest.approx(100.0)
+    assert d["meter_live_bytes"] == mem["totals"]["live_bytes"]
+    json.dumps(snap["metering"])  # exports stay JSON-able
+
+
+def test_meter_policy_demotes_runaway_session_to_loose():
+    rng = np.random.RandomState(1)
+    policy = MeterPolicy(max_updates=1, action="demote", cooldown_s=0.0)
+    mt = observe.install_meter(top_k=8, policy=policy, poll_interval_s=0.0)
+    engine = StreamEngine(initial_capacity=4, name="quota")
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    hog = sids[0]
+    for step in range(3):
+        engine.submit(hog, *_batch(rng))  # only the hog keeps updating
+        engine.tick()
+    assert engine.session_health(hog) == "loose"
+    assert all(engine.session_health(s) == "healthy" for s in sids[1:])
+    t = mt.totals()
+    assert t["quota_exceeded_total"] >= 1
+    snap = observe.snapshot()
+    assert snap["derived"]["meter_quota_exceeded_total"] >= 1
+    assert (snap["gauges"].get("quota_sessions_over") or {}).get("meter", 0) >= 0
+    kinds = [e["kind"] for e in snap["events"]]
+    assert "quota_exceeded" in kinds
+    # the hog keeps updating loose — never lose an update, just de-escalate
+    engine.submit(hog, *_batch(rng))
+    engine.tick()
+    assert mt.explain_session(hog)["loose_updates"] >= 1
+
+
+def test_meter_observe_policy_fires_without_demoting():
+    policy = MeterPolicy(max_updates=1, action="observe", cooldown_s=0.0)
+    mt = FleetMeter(top_k=4, policy=policy)
+    mt.note_dispatch("b", ["s0"], 0.01)
+    mt.note_dispatch("b", ["s0"], 0.01)
+    mt.poll_quota()
+    assert mt.totals()["quota_exceeded_total"] >= 1
+    assert mt.pending_demotions() == []
+
+
+def test_sync_bytes_counter_feeds_derived_total():
+    from metrics_tpu.parallel.sync import allreduce_over_mesh
+
+    synced = allreduce_over_mesh([{"total": jnp.asarray(2.0)}], {"total": "sum"})
+    assert float(synced["total"]) == 2.0
+    snap = observe.snapshot()
+    assert snap["derived"]["sync_bytes_total"] > 0
+    assert snap["counters"]["sync_bytes"]["total"] > 0
+
+
+def test_disabled_meter_costs_nothing_and_meter_survives_reenable():
+    mt = observe.install_meter(top_k=4)
+    observe.disable()
+    rng = np.random.RandomState(2)
+    engine = StreamEngine(initial_capacity=4)
+    sid = engine.add_session(_acc())
+    engine.submit(sid, *_batch(rng))
+    engine.tick()
+    assert mt.totals()["measured_dispatch_s"] == 0.0  # hot path never touched it
+    observe.enable()
+    engine.submit(sid, *_batch(rng))
+    engine.tick()
+    assert mt.totals()["measured_dispatch_s"] > 0.0

@@ -200,10 +200,11 @@ def test_snapshot_schema_is_stable_and_json_able():
     snap = observe.snapshot()
     assert set(snap) == {
         "enabled", "schema_version", "counters", "timers", "events", "gauges",
-        "latency", "series", "derived",
+        "latency", "series", "derived", "metering",
     }
     assert snap["enabled"] is True
-    assert snap["schema_version"] == observe.SCHEMA_VERSION == 2
+    assert snap["schema_version"] == observe.SCHEMA_VERSION == 3
+    assert snap["metering"] == {"installed": False}  # no FleetMeter installed here
     assert set(snap["derived"]) == {
         "jit_cache_hit_rate", "jit_compiles_total", "jit_cache_hits_total",
         "jit_cache_evictions_total", "eager_fallbacks_total",
@@ -221,6 +222,9 @@ def test_snapshot_schema_is_stable_and_json_able():
         "compile_explains_total", "watchdog_samples_total",
         "slo_alerts_fired_total", "slo_alerts_resolved_total",
         "slo_alerts_firing",
+        "meter_sessions_tracked", "meter_attributed_dispatch_s",
+        "meter_attribution_pct", "meter_live_bytes", "meter_pad_waste_bytes",
+        "meter_quota_exceeded_total", "sync_bytes_total",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
 # + donlint), the telemetry overhead smoke (disabled-mode cost pin plus the
-# enabled-watchdog sampling budget), the donation
+# enabled-watchdog sampling budget and the enabled-meter attribution budget:
+# per-session dispatch share, loose path, rate-limited quota poll), the donation
 # three-way cross-check, the AOT executable-cache round-trip pass (serialize
 # → fresh-dir reload with zero compiles → bit-exact vs a fresh trace,
 # baselined in tools/aot_baseline.json), the chaos fault-injection harness

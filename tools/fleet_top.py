@@ -4,10 +4,16 @@
 Offline, it reads one or two ``observe.snapshot()`` JSON files (the dicts the
 runtime half of :mod:`metrics_tpu.observe` emits — DESIGN §19) and renders a
 fleet health report: occupancy, dispatch economy, WAL durability lag,
-quarantine count, and per-phase DDSketch latency quantiles. With two
-snapshots it diffs them — counter families become rates over the snapshots'
-series-time window and gauge moves are signed — which is how a CI job or an
-operator compares "before the incident" to "after".
+quarantine count, tenant cost attribution (DESIGN §23), per-bucket memory
+ledgers, and per-phase DDSketch latency quantiles. With two snapshots it
+diffs them — counter families become rates over the snapshots' series-time
+window and gauge moves are signed — which is how a CI job or an operator
+compares "before the incident" to "after".
+
+All diffing lives in ONE code path: :func:`build_report` computes the
+section data (numbers, deltas, rates) and both the text renderer and
+``--json`` consume that same structure, so the machine-readable report can
+never drift from the human one.
 
 Live, ``--live`` drives a self-contained demo fleet (a ``StreamEngine`` with
 ``--sessions`` ragged-length streams, the same workload shape as the fleet
@@ -34,31 +40,13 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-# ------------------------------------------------------------------ rendering
+# ------------------------------------------------------------------ report data
 
 _PHASE_ORDER = (
     "tick", "shard_tick", "ingest", "wave_assembly", "dispatch", "flush",
     "fleet_compute", "wal", "ckpt", "expire", "update", "compute", "merge",
     "sync", "allreduce", "gather_all", "fused_update", "aot",
 )
-
-
-def _fmt_s(seconds: Optional[float]) -> str:
-    if seconds is None:
-        return "-"
-    if seconds >= 1.0:
-        return f"{seconds:.2f}s"
-    if seconds >= 1e-3:
-        return f"{seconds * 1e3:.2f}ms"
-    return f"{seconds * 1e6:.0f}us"
-
-
-def _fmt_bytes(n: float) -> str:
-    for unit in ("B", "KiB", "MiB", "GiB"):
-        if abs(n) < 1024 or unit == "GiB":
-            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
-        n /= 1024
-    return f"{n:.1f}GiB"
 
 
 def _series_window_s(snap: Dict[str, Any]) -> Optional[float]:
@@ -78,10 +66,233 @@ def _counter_total(snap: Dict[str, Any], name: str) -> int:
     return int(sum((snap.get("counters", {}).get(name) or {}).values()))
 
 
-def _delta(cur: float, prev: Optional[float]) -> str:
-    if prev is None:
+def _diff(cur: Optional[float], prev: Optional[float]) -> Optional[float]:
+    if cur is None or prev is None:
+        return None
+    return cur - prev
+
+
+def build_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The one snapshot-diff code path: section data for text AND ``--json``.
+
+    Every value is plain JSON (numbers, strings, lists, dicts, None); deltas
+    are ``None`` when there is no previous snapshot to diff against. Sections
+    that do not apply to the snapshot (no shards, no watchdog, meter not
+    installed, ...) are ``None``.
+    """
+    derived = snap.get("derived", {})
+    pderived = (prev or {}).get("derived", {})
+    g = snap.get("gauges", {})
+    series = snap.get("series") or []
+    latest = series[-1] if series else {}
+    pseries = (prev or {}).get("series") or []
+    platest = pseries[-1] if pseries else {}
+    window = _series_window_s(snap)
+
+    sessions = latest.get("sessions", derived.get("fleet_sessions"))
+    dispatches = [s.get("dispatches", 0) for s in series]
+    fleet = {
+        "occupancy_pct": latest.get("occupancy_pct"),
+        "rows_active": latest.get("rows_active"),
+        "rows_capacity": latest.get("rows_capacity"),
+        "sessions": sessions,
+        "sessions_delta": _diff(sessions, platest.get("sessions")),
+        "dispatches_per_tick": (sum(dispatches) / len(dispatches)) if dispatches else None,
+        "dispatches_last": dispatches[-1] if dispatches else None,
+        "samples": len(series),
+        "quarantined": latest.get("quarantined"),
+    }
+
+    lag_r = derived.get("wal_lag_records", _gauge_total(snap, "wal_lag_records"))
+    age = g.get("last_ckpt_age_s") or {}
+    durability = {
+        "wal_lag_records": lag_r,
+        "wal_lag_records_delta": _diff(lag_r, pderived.get("wal_lag_records")) if prev else None,
+        "wal_lag_bytes": derived.get("wal_lag_bytes", _gauge_total(snap, "wal_lag_bytes")),
+        "last_ckpt_age_s": max(age.values()) if age else None,
+        "torn_tails": int(derived.get("wal_torn_tails_total", _counter_total(snap, "wal_torn_tail"))),
+    }
+
+    shards = None
+    healthy = g.get("shard_healthy") or {}
+    if healthy:
+        demoted = derived.get("fleet_shards_demoted", sum(1 for v in healthy.values() if not v))
+        rows = []
+        for label in sorted(healthy):
+            r_cap = int((g.get("shard_rows_capacity") or {}).get(label, 0))
+            r_act = int((g.get("shard_rows_active") or {}).get(label, 0))
+            rows.append({
+                "shard": label,
+                "sessions": int((g.get("shard_sessions") or {}).get(label, 0)),
+                "rows_active": r_act,
+                "rows_capacity": r_cap,
+                "occupancy_pct": (100.0 * r_act / r_cap) if r_cap else None,
+                "wal_lag_records": int((g.get("shard_wal_lag_records") or {}).get(label, 0)),
+                "wal_lag_bytes": float((g.get("shard_wal_lag_bytes") or {}).get(label, 0)),
+                "healthy": bool(healthy[label]),
+            })
+        shards = {
+            "count": len(healthy),
+            "demoted": int(demoted),
+            "demoted_delta": _diff(demoted, pderived.get("fleet_shards_demoted")) if prev else None,
+            "rows": rows,
+        }
+
+    alerts = None
+    firing = g.get("slo_firing") or {}
+    samples = derived.get("watchdog_samples_total", 0)
+    if firing or samples:
+        fired = derived.get("slo_alerts_fired_total", _counter_total(snap, "slo_fired"))
+        alerts = {
+            "samples": int(samples),
+            "firing": {rule: bool(firing[rule]) for rule in sorted(firing)},
+            "fired": int(fired),
+            "fired_delta": _diff(fired, pderived.get("slo_alerts_fired_total")) if prev else None,
+            "resolved": int(derived.get("slo_alerts_resolved_total", _counter_total(snap, "slo_resolved"))),
+            "signals": {k: (g.get("watchdog_signal") or {})[k] for k in sorted(g.get("watchdog_signal") or {})},
+        }
+
+    compiles = None
+    explains = snap.get("counters", {}).get("compile_explain") or {}
+    if explains:
+        compiles = {
+            "attributed": sum(explains.values()),
+            "causes": dict(sorted((snap.get("counters", {}).get("compile_cause") or {}).items())),
+            "caches": {cache: explains[cache] for cache in sorted(explains)},
+            "recent": [
+                {"cache": e.get("cache"), "label": e.get("label"), "cause": e.get("cause")}
+                for e in (snap.get("events") or [])
+                if e.get("kind") == "compile_explain"
+            ][-4:],
+        }
+
+    # tenant cost attribution + memory ledgers (DESIGN §23): the metering
+    # section the installed FleetMeter contributes to snapshot()
+    tenants = None
+    memory = None
+    metering = snap.get("metering") or {}
+    if metering.get("installed"):
+        totals = metering.get("totals", {})
+        ptop = {
+            r.get("session"): r
+            for r in ((prev or {}).get("metering") or {}).get("top_sessions", [])
+        }
+        attributed = float(totals.get("attributed_s") or 0.0)
+        srows = []
+        for r in metering.get("top_sessions", []):
+            disp = float(r.get("dispatch_s", 0.0))
+            pdisp = ptop.get(r.get("session"), {}).get("dispatch_s")
+            srows.append({
+                "session": r.get("session"),
+                "source": r.get("source"),
+                "dispatch_s": disp,
+                "dispatch_s_delta": _diff(disp, float(pdisp) if pdisp is not None else None),
+                "share_pct": (100.0 * disp / attributed) if attributed > 0 else None,
+                "error_s": r.get("error_s", 0.0),
+                "updates": r.get("updates"),
+                "est_flops": r.get("est_flops"),
+                "est_bytes": r.get("est_bytes"),
+                "loose_updates": r.get("loose_updates"),
+                "quarantines": r.get("quarantines"),
+                "wal_bytes": r.get("wal_bytes"),
+                "ckpt_bytes": r.get("ckpt_bytes"),
+            })
+        quota = int(totals.get("quota_exceeded_total") or 0)
+        tenants = {
+            "tracked_exact": int(totals.get("sessions_exact") or 0),
+            "tracked_sketched": int(totals.get("sessions_sketched") or 0),
+            "top_k": metering.get("top_k"),
+            "measured_dispatch_s": float(totals.get("measured_dispatch_s") or 0.0),
+            "attributed_s": attributed,
+            "attribution_pct": totals.get("attribution_pct"),
+            "sketch_total_s": float(totals.get("sketch_total_s") or 0.0),
+            "sketch_error_bound_s": float(totals.get("sketch_error_bound_s") or 0.0),
+            "quota_exceeded": quota,
+            "quota_exceeded_delta": (
+                _diff(quota, ((prev or {}).get("metering") or {}).get("totals", {}).get("quota_exceeded_total"))
+                if prev else None
+            ),
+            "policy": metering.get("policy"),
+            "sessions": srows,
+        }
+        mem = metering.get("memory", {})
+        mtot = mem.get("totals", {})
+        pmtot = (((prev or {}).get("metering") or {}).get("memory") or {}).get("totals", {})
+        memory = {
+            "totals": dict(mtot),
+            "live_bytes_delta": _diff(mtot.get("live_bytes"), pmtot.get("live_bytes")) if prev else None,
+            "engines": dict(mem.get("engines", {})),
+            "buckets": [
+                {"bucket": key, **row} for key, row in sorted(mem.get("buckets", {}).items())
+            ],
+        }
+
+    latency = snap.get("latency") or {}
+    ordered = [p for p in _PHASE_ORDER if p in latency]
+    ordered += sorted(p for p in latency if p not in _PHASE_ORDER)
+    phase_rows = []
+    for phase in ordered:
+        for label, s in sorted(latency[phase].items()):
+            count = s.get("count", 0)
+            prev_count = ((prev or {}).get("latency", {}).get(phase, {}).get(label, {}) or {}).get("count")
+            phase_rows.append({
+                "phase": phase,
+                "label": label,
+                "count": count,
+                "p50_s": s.get("p50_s"),
+                "p99_s": s.get("p99_s"),
+                "max_s": s.get("max_s"),
+                "rate_per_s": ((count - prev_count) / window) if (prev_count is not None and window) else None,
+            })
+
+    spans_total = derived.get("spans_total")
+    footer = None
+    if spans_total is not None:
+        footer = {
+            "spans_total": spans_total,
+            "spans_delta": _diff(spans_total, pderived.get("spans_total")) if prev else None,
+            "jit_compiles": derived.get("jit_compiles_total", 0),
+            "eager_fallbacks": _counter_total(snap, "eager_fallback"),
+        }
+
+    return {
+        "schema_version": snap.get("schema_version"),
+        "window_s": window,
+        "fleet": fleet,
+        "durability": durability,
+        "shards": shards,
+        "alerts": alerts,
+        "compiles": compiles,
+        "tenants": tenants,
+        "memory": memory,
+        "phases": phase_rows,
+        "footer": footer,
+    }
+
+
+# ------------------------------------------------------------------ rendering
+
+def _fmt_s(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "-"
+    if seconds >= 1.0:
+        return f"{seconds:.2f}s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.2f}ms"
+    return f"{seconds * 1e6:.0f}us"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _fmt_delta(d: Optional[float]) -> str:
+    if d is None:
         return ""
-    d = cur - prev
     if d == 0:
         return "  (=)"
     return f"  ({'+' if d > 0 else ''}{d:g})"
@@ -89,146 +300,179 @@ def _delta(cur: float, prev: Optional[float]) -> str:
 
 def render_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) -> str:
     """Render one snapshot (optionally diffed against ``prev``) as text."""
+    r = build_report(snap, prev)
     lines: List[str] = []
-    derived = snap.get("derived", {})
-    pderived = (prev or {}).get("derived", {})
-    series = snap.get("series") or []
-    latest = series[-1] if series else {}
 
+    fleet = r["fleet"]
     lines.append("== fleet ==")
-    occ = latest.get("occupancy_pct")
-    rows = (latest.get("rows_active"), latest.get("rows_capacity"))
-    if occ is not None:
-        lines.append(f"occupancy        {occ:.1f}%  ({rows[0]}/{rows[1]} rows)")
-    sessions = latest.get("sessions", derived.get("fleet_sessions"))
-    if sessions is not None:
-        lines.append(f"sessions         {sessions}{_delta(sessions, (prev or {}).get('series', [{}])[-1].get('sessions') if (prev or {}).get('series') else None)}")
-    if series:
-        dispatches = [s.get("dispatches", 0) for s in series]
+    if fleet["occupancy_pct"] is not None:
         lines.append(
-            f"dispatches/tick  {sum(dispatches) / len(dispatches):.2f}  "
-            f"(last {dispatches[-1]}, {len(series)} samples)"
+            f"occupancy        {fleet['occupancy_pct']:.1f}%  "
+            f"({fleet['rows_active']}/{fleet['rows_capacity']} rows)"
         )
-    quarantined = latest.get("quarantined")
-    if quarantined is not None:
-        lines.append(f"quarantined      {quarantined}")
+    if fleet["sessions"] is not None:
+        lines.append(f"sessions         {fleet['sessions']}{_fmt_delta(fleet['sessions_delta'])}")
+    if fleet["dispatches_per_tick"] is not None:
+        lines.append(
+            f"dispatches/tick  {fleet['dispatches_per_tick']:.2f}  "
+            f"(last {fleet['dispatches_last']}, {fleet['samples']} samples)"
+        )
+    if fleet["quarantined"] is not None:
+        lines.append(f"quarantined      {fleet['quarantined']}")
 
+    dur = r["durability"]
     lines.append("")
     lines.append("== durability ==")
-    lag_r = derived.get("wal_lag_records", _gauge_total(snap, "wal_lag_records"))
-    lag_b = derived.get("wal_lag_bytes", _gauge_total(snap, "wal_lag_bytes"))
-    lines.append(f"wal lag          {int(lag_r)} records / {_fmt_bytes(float(lag_b))}"
-                 f"{_delta(lag_r, pderived.get('wal_lag_records') if prev else None)}")
-    age = (snap.get("gauges", {}).get("last_ckpt_age_s") or {})
-    if age:
-        lines.append(f"last checkpoint  {_fmt_s(max(age.values()))} ago")
+    lines.append(
+        f"wal lag          {int(dur['wal_lag_records'])} records / "
+        f"{_fmt_bytes(float(dur['wal_lag_bytes']))}{_fmt_delta(dur['wal_lag_records_delta'])}"
+    )
+    if dur["last_ckpt_age_s"] is not None:
+        lines.append(f"last checkpoint  {_fmt_s(dur['last_ckpt_age_s'])} ago")
     else:
         lines.append("last checkpoint  never")
-    torn = derived.get("wal_torn_tails_total", _counter_total(snap, "wal_torn_tail"))
-    if torn:
-        lines.append(f"torn wal tails   {int(torn)}  (journal damage detected at restore)")
+    if dur["torn_tails"]:
+        lines.append(f"torn wal tails   {dur['torn_tails']}  (journal damage detected at restore)")
 
-    # sharded fleet rung: one row per shard from the shard_* gauge families
-    healthy = snap.get("gauges", {}).get("shard_healthy") or {}
-    if healthy:
+    if r["shards"]:
+        sh = r["shards"]
         lines.append("")
         lines.append("== shards ==")
-        demoted = derived.get(
-            "fleet_shards_demoted", sum(1 for v in healthy.values() if not v)
-        )
         lines.append(
-            f"{len(healthy)} shard(s), {int(demoted)} demoted"
-            f"{_delta(demoted, pderived.get('fleet_shards_demoted') if prev else None)}"
+            f"{sh['count']} shard(s), {sh['demoted']} demoted{_fmt_delta(sh['demoted_delta'])}"
         )
         lines.append(
             f"{'shard':<22}{'sess':>6}{'rows':>12}{'occ%':>7}{'wal lag':>16}{'health':>10}"
         )
-        g = snap.get("gauges", {})
-        for label in sorted(healthy):
-            sess = int((g.get("shard_sessions") or {}).get(label, 0))
-            r_act = int((g.get("shard_rows_active") or {}).get(label, 0))
-            r_cap = int((g.get("shard_rows_capacity") or {}).get(label, 0))
-            occ = f"{100.0 * r_act / r_cap:.0f}" if r_cap else "-"
-            lag_rec = int((g.get("shard_wal_lag_records") or {}).get(label, 0))
-            lag_by = float((g.get("shard_wal_lag_bytes") or {}).get(label, 0))
-            state = "ok" if healthy[label] else "DEMOTED"
+        for row in sh["rows"]:
+            occ = f"{row['occupancy_pct']:.0f}" if row["occupancy_pct"] is not None else "-"
+            state = "ok" if row["healthy"] else "DEMOTED"
+            rows_str = f"{row['rows_active']}/{row['rows_capacity']}"
+            lag_str = f"{row['wal_lag_records']}r/{_fmt_bytes(row['wal_lag_bytes'])}"
             lines.append(
-                f"{label:<22}{sess:>6}{f'{r_act}/{r_cap}':>12}{occ:>7}"
-                f"{f'{lag_rec}r/{_fmt_bytes(lag_by)}':>16}{state:>10}"
+                f"{row['shard']:<22}{row['sessions']:>6}"
+                f"{rows_str:>12}{occ:>7}{lag_str:>16}{state:>10}"
             )
 
-    # watchdog rung: SLO alert state + recompile-cause attribution (DESIGN §22)
-    firing = snap.get("gauges", {}).get("slo_firing") or {}
-    samples = derived.get("watchdog_samples_total", 0)
-    if firing or samples:
+    if r["alerts"]:
+        al = r["alerts"]
         lines.append("")
         lines.append("== alerts ==")
-        n_firing = sum(1 for v in firing.values() if v)
-        fired = derived.get("slo_alerts_fired_total", _counter_total(snap, "slo_fired"))
-        resolved = derived.get(
-            "slo_alerts_resolved_total", _counter_total(snap, "slo_resolved")
-        )
+        n_firing = sum(1 for v in al["firing"].values() if v)
         lines.append(
-            f"watchdog         {int(samples)} samples; {n_firing} firing, "
-            f"{int(fired)} fired / {int(resolved)} resolved lifetime"
-            f"{_delta(fired, pderived.get('slo_alerts_fired_total') if prev else None)}"
+            f"watchdog         {al['samples']} samples; {n_firing} firing, "
+            f"{al['fired']} fired / {al['resolved']} resolved lifetime"
+            f"{_fmt_delta(al['fired_delta'])}"
         )
-        for rule in sorted(firing):
-            state = "FIRING" if firing[rule] else "ok"
-            lines.append(f"{rule:<32}{state:>8}")
-        signals = snap.get("gauges", {}).get("watchdog_signal") or {}
-        for name in sorted(signals):
-            lines.append(f"  {name:<30}{signals[name]:>12.4g}")
+        for rule, is_firing in al["firing"].items():
+            lines.append(f"{rule:<32}{'FIRING' if is_firing else 'ok':>8}")
+        for name, value in al["signals"].items():
+            lines.append(f"  {name:<30}{value:>12.4g}")
 
-    explains = snap.get("counters", {}).get("compile_explain") or {}
-    if explains:
+    if r["compiles"]:
+        co = r["compiles"]
         lines.append("")
         lines.append("== compiles ==")
-        causes = snap.get("counters", {}).get("compile_cause") or {}
-        cause_str = ", ".join(f"{c}={n}" for c, n in sorted(causes.items()))
-        lines.append(
-            f"attributed misses  {sum(explains.values())}  ({cause_str})"
-        )
-        for cache in sorted(explains):
-            lines.append(f"  {cache:<20}{explains[cache]:>6}")
-        recent = [e for e in snap.get("events") or [] if e.get("kind") == "compile_explain"]
-        for e in recent[-4:]:
+        cause_str = ", ".join(f"{c}={n}" for c, n in co["causes"].items())
+        lines.append(f"attributed misses  {co['attributed']}  ({cause_str})")
+        for cache, n in co["caches"].items():
+            lines.append(f"  {cache:<20}{n:>6}")
+        for e in co["recent"]:
             lines.append(
-                f"  {e.get('cache', '?')}:{e.get('label', '?')}  "
-                f"cause={e.get('cause', '?')}"
+                f"  {e.get('cache') or '?'}:{e.get('label') or '?'}  cause={e.get('cause') or '?'}"
+            )
+
+    if r["tenants"]:
+        tn = r["tenants"]
+        lines.append("")
+        lines.append("== tenants ==")
+        attr = (
+            f"{tn['attribution_pct']:.1f}%" if tn["attribution_pct"] is not None else "-"
+        )
+        lines.append(
+            f"metering         {tn['tracked_exact'] + tn['tracked_sketched']} tracked "
+            f"({tn['tracked_exact']} exact + {tn['tracked_sketched']} sketched, "
+            f"top_k={tn['top_k']}); attribution {attr} of "
+            f"{_fmt_s(tn['measured_dispatch_s'])} measured"
+        )
+        lines.append(
+            f"sketch           {_fmt_s(tn['sketch_total_s'])} folded, "
+            f"error <= {_fmt_s(tn['sketch_error_bound_s'])} per estimate"
+        )
+        pol = tn["policy"]
+        pol_str = "none" if pol is None else (
+            f"action={pol.get('action')}"
+            + (f", share<={pol.get('max_dispatch_share'):g}" if pol.get("max_dispatch_share") is not None else "")
+            + (f", updates<={pol.get('max_updates')}" if pol.get("max_updates") is not None else "")
+            + (f", wal<={_fmt_bytes(pol.get('max_wal_bytes'))}" if pol.get("max_wal_bytes") is not None else "")
+        )
+        lines.append(
+            f"quota            {tn['quota_exceeded']} exceeded lifetime"
+            f"{_fmt_delta(tn['quota_exceeded_delta'])}  (policy: {pol_str})"
+        )
+        lines.append(
+            f"{'session':<18}{'src':<8}{'disp':>10}{'share%':>8}{'upd':>8}"
+            f"{'flops':>12}{'wal':>10}"
+        )
+        for row in tn["sessions"]:
+            share = f"{row['share_pct']:.1f}" if row["share_pct"] is not None else "-"
+            disp = _fmt_s(row["dispatch_s"])
+            if row["source"] == "sketch" and row["error_s"]:
+                disp += "±"  # sketch estimate carries error; exact rows do not
+            upd = row["updates"] if row["updates"] is not None else "-"
+            flops = f"{row['est_flops']:.3g}" if row.get("est_flops") is not None else "-"
+            wal = _fmt_bytes(row["wal_bytes"]) if row.get("wal_bytes") is not None else "-"
+            lines.append(
+                f"{str(row['session']):<18}{row['source']:<8}{disp:>10}{share:>8}"
+                f"{upd:>8}{flops:>12}{wal:>10}{_fmt_delta(row['dispatch_s_delta'])}"
+            )
+
+    if r["memory"]:
+        me = r["memory"]
+        t = me["totals"]
+        lines.append("")
+        lines.append("== memory ==")
+        lines.append(
+            f"stacked state    {_fmt_bytes(t.get('live_bytes', 0))} live + "
+            f"{_fmt_bytes(t.get('pad_waste_bytes', 0))} pad waste; "
+            f"peak {_fmt_bytes(t.get('peak_capacity_bytes', 0))}, "
+            f"next doubling {_fmt_bytes(t.get('projected_2x_bytes', 0))}"
+            f"{_fmt_delta(me['live_bytes_delta'])}"
+        )
+        lines.append(
+            f"{'bucket':<44}{'rows':>10}{'live':>10}{'waste':>10}{'proj@2x':>10}"
+        )
+        for row in me["buckets"]:
+            name = row["bucket"]
+            if len(name) > 43:
+                name = name[:40] + "..."
+            rows_str = f"{row['active']}/{row['capacity']}"
+            lines.append(
+                f"{name:<44}{rows_str:>10}"
+                f"{_fmt_bytes(row['live_bytes']):>10}{_fmt_bytes(row['pad_waste_bytes']):>10}"
+                f"{_fmt_bytes(row['projected_2x_bytes']):>10}"
             )
 
     lines.append("")
     lines.append("== phases (DDSketch quantiles) ==")
-    latency = snap.get("latency") or {}
-    header = f"{'phase':<14}{'label':<18}{'count':>8}{'p50':>10}{'p99':>10}{'max':>10}"
-    lines.append(header)
-    ordered = [p for p in _PHASE_ORDER if p in latency]
-    ordered += sorted(p for p in latency if p not in _PHASE_ORDER)
-    window = _series_window_s(snap)
-    for phase in ordered:
-        for label, s in sorted(latency[phase].items()):
-            count = s.get("count", 0)
-            prev_count = ((prev or {}).get("latency", {}).get(phase, {}).get(label, {}) or {}).get("count")
-            rate = ""
-            if prev_count is not None and window:
-                rate = f"  ({(count - prev_count) / window:+.1f}/s)"
-            lines.append(
-                f"{phase:<14}{(label or '-'):<18}{count:>8}"
-                f"{_fmt_s(s.get('p50_s')):>10}{_fmt_s(s.get('p99_s')):>10}"
-                f"{_fmt_s(s.get('max_s')):>10}{rate}"
-            )
-    if not latency:
+    lines.append(f"{'phase':<14}{'label':<18}{'count':>8}{'p50':>10}{'p99':>10}{'max':>10}")
+    for row in r["phases"]:
+        rate = f"  ({row['rate_per_s']:+.1f}/s)" if row["rate_per_s"] is not None else ""
+        lines.append(
+            f"{row['phase']:<14}{(row['label'] or '-'):<18}{row['count']:>8}"
+            f"{_fmt_s(row['p50_s']):>10}{_fmt_s(row['p99_s']):>10}"
+            f"{_fmt_s(row['max_s']):>10}{rate}"
+        )
+    if not r["phases"]:
         lines.append("(no spans recorded — is telemetry enabled?)")
 
-    spans_total = derived.get("spans_total")
-    if spans_total is not None:
+    if r["footer"]:
+        f = r["footer"]
         lines.append("")
         lines.append(
-            f"spans: {spans_total} recorded"
-            f"{_delta(spans_total, pderived.get('spans_total') if prev else None)}"
-            f"; jit compiles: {derived.get('jit_compiles_total', 0)}"
-            f"; eager fallbacks: {_counter_total(snap, 'eager_fallback')}"
+            f"spans: {f['spans_total']} recorded{_fmt_delta(f['spans_delta'])}"
+            f"; jit compiles: {f['jit_compiles']}"
+            f"; eager fallbacks: {f['eager_fallbacks']}"
         )
     return "\n".join(lines)
 
@@ -246,6 +490,7 @@ def _demo_fleet(sessions: int, interval: int, frames: int, out) -> int:
     rng = np.random.default_rng(0)
     with observe.scope():
         observe.install_watchdog(min_interval_s=0.0)
+        observe.install_meter()
         engine = StreamEngine(initial_capacity=max(8, sessions))
         sids = [engine.add_session(MulticlassAccuracy(num_classes=8)) for _ in range(sessions)]
         prev: Optional[Dict[str, Any]] = None
@@ -262,6 +507,7 @@ def _demo_fleet(sessions: int, interval: int, frames: int, out) -> int:
             print(render_report(snap, prev), file=out)
             print("", file=out)
             prev = snap
+        observe.uninstall_meter()
         observe.uninstall_watchdog()
     return 0
 
@@ -277,6 +523,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     p.add_argument("snapshots", nargs="*",
                    help="snapshot JSON file(s): one to render, two to diff (old new)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON (same data the text view renders)")
     p.add_argument("--live", action="store_true",
                    help="drive a demo StreamEngine and re-render per frame")
     p.add_argument("--sessions", type=int, default=32, help="live: fleet size (default 32)")
@@ -302,7 +550,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"fleet_top: cannot read {path}: {exc}", file=sys.stderr)
             return 2
     prev, cur = (None, snaps[0]) if len(snaps) == 1 else (snaps[0], snaps[1])
-    print(render_report(cur, prev))
+    if args.json:
+        print(json.dumps(build_report(cur, prev), indent=2, sort_keys=True))
+    else:
+        print(render_report(cur, prev))
     return 0
 
 
