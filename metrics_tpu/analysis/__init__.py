@@ -1,6 +1,6 @@
-"""Static & dynamic analysis for metrics_tpu: jitlint + distlint + donlint.
+"""Static & dynamic analysis for metrics_tpu: jitlint + distlint + donlint + hotlint.
 
-Six complementary passes guard the invariants the runtime cannot check:
+Eight complementary passes guard the invariants the runtime cannot check:
 
 * **jitlint AST pass** (:mod:`metrics_tpu.analysis.rules`, rules JL001–JL006)
   flags tracer concretization, recompilation keys, state-contract breaches,
@@ -30,9 +30,21 @@ Six complementary passes guard the invariants the runtime cannot check:
   sources of truth — the static donlint verdict, ``costs.py``'s
   ``donation_eligible``, and the runtime probation/buffer-deletion outcome —
   failing on any disagreement.
+* **hotlint AST pass** (:mod:`metrics_tpu.analysis.sync_rules`, rules
+  HL001–HL006) polices host-device transfer discipline on the hot path:
+  implicit host syncs (``float()``/``.item()``/``np.asarray`` on device
+  values), device truthiness, per-element device loops, per-call ``jax.jit``
+  churn, un-annotated blocking calls, and host allocation from device buffers
+  inside per-tick engine paths (DESIGN §24).
+* the **transfer-contract harness**
+  (:mod:`metrics_tpu.analysis.transfer_contracts`) proves hotlint's verdicts
+  at runtime: every jit-eligible class's steady-state update loop — and a
+  ``StreamEngine``/``ShardedStreamEngine`` churn tick — runs under
+  ``jax.transfer_guard("disallow")``; static rule, declared annotation and
+  guard outcome must agree.
 
 CLI: ``python tools/lint_metrics.py [--pass <name> | --all] [--json]`` or the
-``jitlint`` / ``distlint`` / ``donlint`` console scripts.
+``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` console scripts.
 """
 
 from metrics_tpu.analysis.contexts import (
@@ -40,12 +52,14 @@ from metrics_tpu.analysis.contexts import (
     LINT_PREFIXES,
     MEM_RULE_CODES,
     RULE_CODES,
+    SYNC_RULE_CODES,
     Suppressions,
     Violation,
 )
 from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.engine import (
     LintResult,
+    SourceMarkers,
     diff_against_baseline,
     lint_file,
     lint_paths,
@@ -56,6 +70,7 @@ from metrics_tpu.analysis.engine import (
 )
 from metrics_tpu.analysis.mem_rules import MEM_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
+from metrics_tpu.analysis.sync_rules import SYNC_RULES
 
 __all__ = [
     "ALL_RULES",
@@ -67,6 +82,9 @@ __all__ = [
     "MEM_RULE_CODES",
     "ModuleInfo",
     "RULE_CODES",
+    "SYNC_RULES",
+    "SYNC_RULE_CODES",
+    "SourceMarkers",
     "Suppressions",
     "Violation",
     "diff_against_baseline",

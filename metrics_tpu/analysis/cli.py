@@ -1,6 +1,6 @@
-"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint`` / ``chaoslint``.
+"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` / ``chaoslint``.
 
-Three static passes share one engine and one exit-code contract:
+Four static passes share one engine and one exit-code contract:
 
 * ``jitlint``  — tracer-safety & recompilation rules JL001–JL006, baselined in
   ``tools/jitlint_baseline.json``
@@ -8,13 +8,20 @@ Three static passes share one engine and one exit-code contract:
   baselined in ``tools/distlint_baseline.json``
 * ``donlint``  — donated-buffer escape/alias rules ML001–ML006, baselined in
   ``tools/donlint_baseline.json``
+* ``hotlint``  — host-sync & dispatch-economy rules HL001–HL006 over the
+  hot-path modules, baselined in ``tools/hotlint_baseline.json``
 
-Five dynamic passes ride the same selection/exit-code contract:
+Six dynamic passes ride the same selection/exit-code contract:
 
 * ``donation`` — 3-step donate-enabled update loops cross-checking static
   donlint verdicts, ``costs.py`` eligibility, and runtime buffer deletion
   (:mod:`metrics_tpu.analysis.donation_contracts`), disagreements baselined in
   the ``donation`` section of ``tools/donlint_baseline.json``
+* ``transfer`` — steady-state update loops and 100-session fleet ticks under
+  ``jax.transfer_guard("disallow")``, cross-checking static hotlint verdicts,
+  declared jit eligibility, and the runtime guard outcome
+  (:mod:`metrics_tpu.analysis.transfer_contracts`), disagreements baselined in
+  the ``transfer`` section of ``tools/hotlint_baseline.json`` (expected empty)
 * ``aot`` — AOT executable-cache round trips per registry class: serialize →
   fresh-cache-dir reload with zero compiles → bit-exact update/compute vs a
   freshly traced oracle (:mod:`metrics_tpu.analysis.aot_contracts`),
@@ -52,7 +59,12 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
-from metrics_tpu.analysis.contexts import DIST_RULE_CODES, MEM_RULE_CODES, RULE_CODES
+from metrics_tpu.analysis.contexts import (
+    DIST_RULE_CODES,
+    MEM_RULE_CODES,
+    RULE_CODES,
+    SYNC_RULE_CODES,
+)
 from metrics_tpu.analysis.engine import (
     diff_against_baseline,
     lint_paths,
@@ -60,7 +72,7 @@ from metrics_tpu.analysis.engine import (
     write_baseline,
 )
 
-__all__ = ["main", "main_chaoslint", "main_distlint", "main_donlint"]
+__all__ = ["main", "main_chaoslint", "main_distlint", "main_donlint", "main_hotlint"]
 
 _PASSES: Dict[str, Dict[str, object]] = {
     "jitlint": {
@@ -75,15 +87,21 @@ _PASSES: Dict[str, Dict[str, object]] = {
         "rules": MEM_RULE_CODES,
         "baseline": os.path.join("tools", "donlint_baseline.json"),
     },
+    "hotlint": {
+        "rules": SYNC_RULE_CODES,
+        "baseline": os.path.join("tools", "hotlint_baseline.json"),
+    },
 }
 
 # dynamic passes: no rule codes, run programs instead of parsing them.
 # Ordered cheap-first for --all (telemetry is one compile + ~1k tiny steps,
-# donation ~10s of tiny CPU jits, aot compiles each cacheable class twice —
-# once AOT to disk, once as the fresh oracle — fleet churns a 4-slot
-# StreamEngine bucket per class, chaos injects the full fault suite per
-# class, perf lowers the whole registry + runs the fleet smoke).
-_DYNAMIC = ("telemetry", "donation", "aot", "fleet", "chaos", "perf")
+# donation ~10s of tiny CPU jits, transfer re-runs the registry's update
+# loops plus two fleet ticks under transfer_guard, aot compiles each
+# cacheable class twice — once AOT to disk, once as the fresh oracle —
+# fleet churns a 4-slot StreamEngine bucket per class, chaos injects the
+# full fault suite per class, perf lowers the whole registry + runs the
+# fleet smoke).
+_DYNAMIC = ("telemetry", "donation", "transfer", "aot", "fleet", "chaos", "perf")
 
 
 def _dynamic_runner(name: str):
@@ -109,6 +127,10 @@ def _dynamic_runner(name: str):
         from metrics_tpu.analysis.aot_contracts import run_aot_check  # noqa: PLC0415
 
         return run_aot_check
+    if name == "transfer":
+        from metrics_tpu.analysis.transfer_contracts import run_transfer_check  # noqa: PLC0415
+
+        return run_transfer_check
     from metrics_tpu.analysis.donation_contracts import run_donation_check  # noqa: PLC0415
 
     return run_donation_check
@@ -119,8 +141,9 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="jitlint",
         description="Static analysis for metrics_tpu: jitlint (JL001-JL006, tracer safety), "
                     "distlint (DL001-DL005, distributed merge soundness), donlint "
-                    "(ML001-ML006, donated-buffer escape/alias safety), the donation "
-                    "cross-check, and the perf cost-baseline check.",
+                    "(ML001-ML006, donated-buffer escape/alias safety), hotlint "
+                    "(HL001-HL006, host-sync & dispatch economy), the donation and "
+                    "transfer-guard cross-checks, and the perf cost-baseline check.",
     )
     p.add_argument("targets", nargs="*", default=["metrics_tpu"],
                    help="files or directories to lint (default: metrics_tpu)")
@@ -129,8 +152,9 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=sorted([*_PASSES, *_DYNAMIC]),
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
-                   help="run every pass (jitlint + distlint + donlint + telemetry "
-                        "+ donation + aot + fleet + chaos + perf) in one invocation")
+                   help="run every pass (jitlint + distlint + donlint + hotlint "
+                        "+ telemetry + donation + transfer + aot + fleet + chaos "
+                        "+ perf) in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")
@@ -237,9 +261,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         new, baselined, stale = diff_against_baseline(result.violations, baseline)
 
         if args.fmt == "json":
+            hits: Dict[str, int] = {}
+            for v in result.violations:
+                hits[v.rule] = hits.get(v.rule, 0) + 1
             report[name] = {
                 "status": "fail" if new else "ok",
                 "files_scanned": result.files_scanned,
+                "by_rule": hits,
                 "new": [v.__dict__ for v in new],
                 "baselined": baselined,
                 "inline_suppressed": result.suppressed,
@@ -281,6 +309,12 @@ def main_donlint(argv: Optional[Sequence[str]] = None) -> int:
     """Entry point for the ``donlint`` console script — ML rules + donation cross-check."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(["--pass", "donlint", "--pass", "donation", *argv])
+
+
+def main_hotlint(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for the ``hotlint`` console script — HL rules + transfer-guard cross-check."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(["--pass", "hotlint", "--pass", "transfer", *argv])
 
 
 def main_chaoslint(argv: Optional[Sequence[str]] = None) -> int:

@@ -38,18 +38,20 @@ __all__ = [
     "RULE_CODES",
     "DIST_RULE_CODES",
     "MEM_RULE_CODES",
+    "SYNC_RULE_CODES",
 ]
 
 RULE_CODES = ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006")
 DIST_RULE_CODES = ("DL001", "DL002", "DL003", "DL004", "DL005")
 MEM_RULE_CODES = ("ML001", "ML002", "ML003", "ML004", "ML005", "ML006")
+SYNC_RULE_CODES = ("HL001", "HL002", "HL003", "HL004", "HL005", "HL006")
 
-# `# jitlint: disable=JL001`, `# distlint: disable=DL002` and `# donlint:
-# disable=ML003` share one grammar; any prefix may carry codes from any pass
-# (codes are globally unique). A new pass registers its prefix here ONCE and
-# both suppression forms — per-line and file-wide — work for it; nothing else
-# needs a parser.
-LINT_PREFIXES = ("jitlint", "distlint", "donlint")
+# `# jitlint: disable=JL001`, `# distlint: disable=DL002`, `# donlint:
+# disable=ML003` and `# hotlint: disable=HL001` share one grammar; any prefix
+# may carry codes from any pass (codes are globally unique). A new pass
+# registers its prefix here ONCE and both suppression forms — per-line and
+# file-wide — work for it; nothing else needs a parser.
+LINT_PREFIXES = ("jitlint", "distlint", "donlint", "hotlint")
 _PREFIX_ALT = "|".join(LINT_PREFIXES)
 _SUPPRESS_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable=([A-Za-z0-9_,\s]+)")
 _SUPPRESS_FILE_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable-file=([A-Za-z0-9_,\s]+)")
@@ -80,27 +82,21 @@ class Suppressions:
     A suppression on a ``def``/``class``/``if``/``while`` line covers only that
     line (rules report at the offending statement), keeping suppressions local
     and reviewable.
+
+    Thin compatibility shim: the actual comment scan lives in
+    :class:`metrics_tpu.analysis.engine.SourceMarkers` — ONE tokenize pass per
+    module serving every comment-derived query the four static passes make
+    (suppressions, justifying-comment lines, annotation markers). Kept here so
+    existing imports and the historical name keep working.
     """
 
     def __init__(self, source: str) -> None:
-        self._by_line: Dict[int, Set[str]] = {}
-        self._file_wide: Set[str] = set()
-        for lineno, text in enumerate(source.splitlines(), start=1):
-            m = _SUPPRESS_FILE_RE.search(text)
-            if m:
-                self._file_wide |= {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
-                continue
-            m = _SUPPRESS_RE.search(text)
-            if m:
-                codes = {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
-                self._by_line[lineno] = codes
+        from metrics_tpu.analysis.engine import SourceMarkers  # local: avoid import cycle
+
+        self._markers = SourceMarkers(source)
 
     def is_suppressed(self, line: int, rule: str) -> bool:
-        rule = rule.upper()
-        if rule in self._file_wide or "ALL" in self._file_wide:
-            return True
-        codes = self._by_line.get(line)
-        return bool(codes) and (rule in codes or "ALL" in codes)
+        return self._markers.is_suppressed(line, rule)
 
 
 @dataclass

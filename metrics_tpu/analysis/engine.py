@@ -11,20 +11,29 @@ reported as stale so the file ratchets down over time.
 from __future__ import annotations
 
 import ast
+import io
 import json
 import os
+import tokenize
 from collections import Counter
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from metrics_tpu.analysis.contexts import RULE_CODES, Suppressions, Violation
+from metrics_tpu.analysis.contexts import (
+    _SUPPRESS_FILE_RE,
+    _SUPPRESS_RE,
+    RULE_CODES,
+    Violation,
+)
 from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.mem_rules import MEM_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
+from metrics_tpu.analysis.sync_rules import SYNC_RULES
 from metrics_tpu.utils.io import atomic_write_text
 
 __all__ = [
     "LintResult",
+    "SourceMarkers",
     "lint_file",
     "lint_paths",
     "load_baseline",
@@ -36,7 +45,68 @@ __all__ = [
 
 # one registry across all passes; rule codes are globally unique so a
 # ``--rules JL001,DL004,ML002`` mix selects freely across them
-_REGISTRY = {**ALL_RULES, **DIST_RULES, **MEM_RULES}
+_REGISTRY = {**ALL_RULES, **DIST_RULES, **MEM_RULES, **SYNC_RULES}
+
+
+class SourceMarkers:
+    """Every comment-derived fact the four static passes need, in ONE scan.
+
+    Historically jitlint/distlint/donlint each carried a near-copy of a
+    comment parser (regex-per-line suppressions in ``contexts.Suppressions``,
+    a tokenize-based comment-line set in ``mem_rules._comment_lines``). This
+    class is the single shared implementation: one ``tokenize`` pass yields
+
+    * per-line and file-wide suppressions for every registered prefix in
+      :data:`~metrics_tpu.analysis.contexts.LINT_PREFIXES`
+      (``# hotlint: disable=HL001[,JL004|all]`` / ``disable-file=``),
+    * the set of commented lines (donlint ML004's justifying-comment check),
+    * named annotation markers such as ``# hotlint: intentional-transfer``
+      (HL005's sanctioned-blocking-call grammar), queryable on a line or the
+      line above — the same adjacency ML004 uses.
+
+    Tokenize (not a substring scan) means a ``#`` inside a string literal can
+    never masquerade as a suppression; on syntactically broken source it falls
+    back to the permissive per-line scan so partially-edited files still honor
+    their suppressions.
+    """
+
+    def __init__(self, source: str) -> None:
+        self.comment_text: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comment_text[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            for i, text in enumerate(source.splitlines(), start=1):
+                if "#" in text:
+                    self.comment_text[i] = text[text.index("#"):]
+        self._by_line: Dict[int, Set[str]] = {}
+        self._file_wide: Set[str] = set()
+        for lineno in sorted(self.comment_text):
+            text = self.comment_text[lineno]
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self._file_wide |= {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self._by_line[lineno] = {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        rule = rule.upper()
+        if rule in self._file_wide or "ALL" in self._file_wide:
+            return True
+        codes = self._by_line.get(line)
+        return bool(codes) and (rule in codes or "ALL" in codes)
+
+    def comment_lines(self) -> Set[int]:
+        """Lines carrying any comment (ML004's justifying-comment adjacency)."""
+        return set(self.comment_text)
+
+    def has_marker(self, line: int, marker: str, prefix: str = "hotlint") -> bool:
+        """Is ``# <prefix>: <marker>`` present on ``line`` or the line above?"""
+        needle = f"{prefix}: {marker}"
+        return any(needle in self.comment_text.get(ln, "") for ln in (line, line - 1))
 
 # directories whose members are traced-context-by-default kernels
 _FUNCTIONAL_ROOTS = ("metrics_tpu/functional", "metrics_tpu/ops")
@@ -82,7 +152,7 @@ def lint_file(path: str, root: Optional[str] = None, rules: Optional[Sequence[st
         is_functional=any(rel.startswith(r) or f"/{r.split('/')[-1]}/" in rel for r in _FUNCTIONAL_ROOTS),
         is_package_init=os.path.basename(path) == "__init__.py",
     )
-    suppress = Suppressions(source)
+    suppress = SourceMarkers(source)
     selected = rules or RULE_CODES
     for code in selected:
         rule = _REGISTRY.get(code.upper())

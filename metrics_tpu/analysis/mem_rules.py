@@ -47,8 +47,6 @@ and the runtime probation outcome — is
 from __future__ import annotations
 
 import ast
-import io
-import tokenize
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from metrics_tpu.analysis.contexts import Violation, _class_is_jit_ineligible, class_list_state_names
@@ -102,16 +100,11 @@ def _donation_exposed(cls: ast.ClassDef) -> bool:
 
 
 def _comment_lines(source: str) -> Set[int]:
-    lines: Set[int] = set()
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT:
-                lines.add(tok.start[0])
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        for i, text in enumerate(source.splitlines(), start=1):
-            if "#" in text:
-                lines.add(i)
-    return lines
+    """Commented line numbers — delegates to the shared one-pass comment scan
+    (``engine.SourceMarkers``), which unified the per-pass parser copies."""
+    from metrics_tpu.analysis.engine import SourceMarkers  # local: avoid import cycle
+
+    return SourceMarkers(source).comment_lines()
 
 
 def _owner_map(tree: ast.Module) -> Dict[int, str]:

@@ -1,0 +1,556 @@
+"""hotlint rules HL001–HL006: host-sync & dispatch-economy discipline.
+
+ROADMAP items 2 (async double-buffered ingest) and 5 (one-program tick) only
+pay off if the host loop never forces an implicit device→host sync — a single
+``float(x)`` in wave assembly serializes JAX's async dispatch and erases every
+kernel win. jitlint polices *traced* bodies (tracer errors); hotlint polices
+the *eager host code* on the hot path — ``metric.py``, ``collections.py``,
+``engine/``, ``wrappers/replicated.py``, ``parallel/sync.py`` and the
+``observe/`` instrumentation sites — where a blocking transfer is legal Python
+but a silent performance cliff.
+
+The sanctioned escape hatch is an *annotated* explicit transfer::
+
+    # hotlint: intentional-transfer — one batched d2h per wave
+    rows = jax.device_get(wave_columns)
+
+The marker (same line or the line above, donlint ML004's adjacency) satisfies
+HL005, exempts the fetched value from HL001/HL006, and by convention the site
+also runs under a scoped ``jax.transfer_guard("allow")`` and bumps the
+``explicit_transfer`` observe counter — which is how the dynamic cross-check
+(:mod:`metrics_tpu.analysis.transfer_contracts`) proves the static verdict at
+runtime: everything NOT so annotated must survive ``transfer_guard("disallow")``.
+
+Each rule is a callable ``rule(module: ModuleInfo) -> list[Violation]``
+registered in :data:`SYNC_RULES`.
+
+=======  ======================================================================
+code     invariant
+=======  ======================================================================
+HL001    no implicit host sync on device values in hot-path host code:
+         ``float()/int()/bool()``, ``.item()``/``.tolist()``,
+         ``np.asarray/np.array/np.ascontiguousarray`` applied to an
+         expression that is (or contains) a device array — unless the value
+         is routed through ``jax.device_get`` (HL005's domain) or the line
+         carries the intentional-transfer marker
+HL002    no Python truthiness/branching on device arrays outside traced
+         bodies: ``if``/``while``/``assert`` tests that would block on a
+         device value
+HL003    no per-element Python loops over device arrays (``for x in arr``
+         issues one device dispatch — or one transfer — per element)
+HL004    no per-call ``jax.jit`` construction inside function bodies:
+         ``jax.jit(f)(x)`` / ``jax.jit(f).lower(...)`` builds and drops a
+         fresh program every invocation; cache the jitted callable
+HL005    every blocking call (``jax.device_get``, ``.block_until_ready``)
+         in hot-path code carries a ``# hotlint: intentional-transfer``
+         annotation on the same line or the line above
+HL006    no host allocation from device buffers inside per-tick engine
+         paths (methods reachable from tick/submit/compute/aggregate/
+         _flush_pending): ``np.stack/np.asarray/...`` over values not
+         proven host-resident — fetch once via an annotated
+         ``jax.device_get``, then allocate from host buffers
+=======  ======================================================================
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+from metrics_tpu.analysis.contexts import ArrayTaint, Violation, _isinstance_narrowed_names
+from metrics_tpu.analysis.rules import ModuleInfo, _dotted, _v
+
+__all__ = ["SYNC_RULES", "classify_transfers", "INTENTIONAL_TRANSFER_MARKER"]
+
+# the HL005 annotation grammar: `# hotlint: intentional-transfer[ — why]`
+INTENTIONAL_TRANSFER_MARKER = "intentional-transfer"
+
+# ------------------------------------------------------------------- hot scope
+_HOT_FILES = {
+    "metrics_tpu/metric.py",
+    "metrics_tpu/collections.py",
+    "metrics_tpu/wrappers/replicated.py",
+    "metrics_tpu/parallel/sync.py",
+}
+_HOT_DIRS = ("metrics_tpu/engine/", "metrics_tpu/observe/")
+# bench / profiling / closeout harnesses: blocking on the device is their job
+_EXEMPT_FILES = {
+    "metrics_tpu/engine/smoke.py",      # dispatch-economy bench (measures syncs)
+    "metrics_tpu/observe/costs.py",     # HLO cost profiler (lowers per case)
+    "metrics_tpu/observe/overhead.py",  # overhead bench harness
+    "metrics_tpu/observe/profile.py",   # profiling entry points
+    "metrics_tpu/observe/explain.py",   # post-hoc report generator
+}
+
+
+def _is_hot(path: str) -> bool:
+    if path in _EXEMPT_FILES:
+        return False
+    return path in _HOT_FILES or any(path.startswith(d) for d in _HOT_DIRS)
+
+
+def _markers(mod: ModuleInfo):
+    from metrics_tpu.analysis.engine import SourceMarkers  # local: avoid import cycle
+
+    return SourceMarkers(mod.source)
+
+
+def _functions(mod: ModuleInfo) -> Iterator[Tuple[ast.AST, str]]:
+    """Every (top-level or method) function with its qualified name.
+
+    Nested ``def``s are *not* yielded separately — they are part of their
+    enclosing function's subtree, so rules that ``ast.walk`` a function see
+    them attributed to the outer qualname (the reviewable unit).
+    """
+
+    def walk(node: ast.AST, prefix: str) -> Iterator[Tuple[ast.AST, str]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, f"{prefix}{child.name}"
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+
+    yield from walk(mod.tree, "")
+
+
+def _traced_fn_ids(mod: ModuleInfo) -> Set[int]:
+    """Function nodes that never run eagerly — jitlint's turf, not hotlint's.
+
+    Union of jitlint's traced contexts (update/compute of jit-eligible metric
+    classes, functional-module kernels) and anything carrying a ``jax.jit`` /
+    ``functools.partial(jax.jit, ...)`` decorator: a host sync inside a traced
+    body is a *tracer error* (JL001), not a silent performance cliff.
+    """
+    ids = {id(ctx.node) for ctx in mod.traced_contexts}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            d = _dotted(dec.func if isinstance(dec, ast.Call) else dec)
+            if d in ("jax.jit", "jit") or (
+                isinstance(dec, ast.Call)
+                and d in ("functools.partial", "partial")
+                and dec.args
+                and _dotted(dec.args[0]) in ("jax.jit", "jit")
+            ):
+                ids.add(id(node))
+    return ids
+
+
+# ---------------------------------------------------------- device-source test
+_ARRAY_CALL_ROOTS = ("jnp", "lax", "jsp")
+# attribute names that are, by engine convention, device-resident buffers
+_DEVICE_ATTRS = frozenset({"stacked"})
+
+
+def _contains_device_get(e: ast.AST) -> bool:
+    for node in ast.walk(e):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d.endswith("device_get") or d.endswith("_host_fetch") or d.endswith("_host_value"):
+                return True
+    return False
+
+
+def _is_device_producing_call(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    root = d.split(".", 1)[0]
+    if root in _ARRAY_CALL_ROOTS:
+        return True
+    return d.startswith("jax.numpy.") or d.startswith("jax.lax.")
+
+
+def _device_expr(e: ast.expr, taint: ArrayTaint) -> bool:
+    """Does this expression plausibly hold (or contain) a device array?
+
+    Positive signals: a ``jnp.*``/``lax.*`` producing call anywhere in the
+    subtree, a *subscript* of an engine device-buffer attribute
+    (``bucket.stacked[k]`` — the dict itself is a host container, so iterating
+    its keys is fine), or the intra-function :class:`ArrayTaint` saying so.
+    ``jax.device_get`` anywhere in the subtree neutralizes the verdict — the
+    value was explicitly fetched (HL005 owns whether that fetch is annotated).
+    """
+    if _contains_device_get(e):
+        return False
+    for node in ast.walk(e):
+        if isinstance(node, ast.Call) and _is_device_producing_call(node):
+            return True
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr in _DEVICE_ATTRS
+        ):
+            return True
+    return taint.is_array_expr(e)
+
+
+_CONCRETIZING_BUILTINS = frozenset({"float", "int", "bool"})
+_CONCRETIZING_METHODS = frozenset({"item", "tolist"})
+_NP_CASTS = frozenset({"np.asarray", "np.array", "np.ascontiguousarray"})
+
+
+# =========================================================================== HL001
+def rule_hl001_implicit_host_sync(mod: ModuleInfo) -> List[Violation]:
+    if not _is_hot(mod.path):
+        return []
+    out: List[Violation] = []
+    marks = _markers(mod)
+    traced = _traced_fn_ids(mod)
+
+    def annotated(node: ast.AST) -> bool:
+        line = getattr(node, "lineno", 0)
+        return marks.has_marker(line, INTENTIONAL_TRANSFER_MARKER)
+
+    for fn, qual in _functions(mod):
+        if id(fn) in traced:
+            continue  # jitlint JL001 owns traced bodies
+        taint = ArrayTaint(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or annotated(node):
+                continue
+            d = _dotted(node.func)
+            if d in _CONCRETIZING_BUILTINS and len(node.args) == 1:
+                if _device_expr(node.args[0], taint):
+                    out.append(_v(mod, node, "HL001",
+                                  f"`{d}()` on a device value blocks host dispatch — "
+                                  "batch behind an annotated jax.device_get", qual))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CONCRETIZING_METHODS
+                and _device_expr(node.func.value, taint)
+            ):
+                out.append(_v(mod, node, "HL001",
+                              f"`.{node.func.attr}()` on a device value forces an implicit "
+                              "device→host sync", qual))
+            elif d in _NP_CASTS and node.args and _device_expr(node.args[0], taint):
+                out.append(_v(mod, node, "HL001",
+                              f"`{d}(...)` of a device value is an implicit blocking "
+                              "transfer — route through an annotated jax.device_get", qual))
+    return out
+
+
+# =========================================================================== HL002
+def rule_hl002_device_truthiness(mod: ModuleInfo) -> List[Violation]:
+    if not _is_hot(mod.path):
+        return []
+    out: List[Violation] = []
+    traced = _traced_fn_ids(mod)
+
+    for fn, qual in _functions(mod):
+        if id(fn) in traced:
+            continue  # JL001 reports value-dependent branches under trace
+        taint = ArrayTaint(fn)
+
+        def check(test: ast.expr, node: ast.AST, kind: str, narrowed: Set[str]) -> None:
+            if _contains_device_get(test):
+                return
+            if taint.is_value_dependent_test(test, set(narrowed)):
+                out.append(_v(mod, node, "HL002",
+                              f"`{kind}` on a device-array value blocks until the device "
+                              "catches up — compute the predicate on host state or fetch "
+                              "explicitly", qual))
+
+        # structured walk so `isinstance(x, list/int/...)` guards narrow names
+        # inside their branch (`if isinstance(d, list): if d:` is host truthiness)
+        def visit(stmts: List[ast.stmt], narrowed: Set[str]) -> None:
+            for node in stmts:
+                if isinstance(node, ast.If):
+                    check(node.test, node, "if", narrowed)
+                    visit(node.body, narrowed | _isinstance_narrowed_names(node.test))
+                    visit(node.orelse, narrowed)
+                elif isinstance(node, ast.While):
+                    check(node.test, node, "while", narrowed)
+                    visit(node.body, narrowed)
+                    visit(node.orelse, narrowed)
+                elif isinstance(node, ast.Assert):
+                    check(node.test, node, "assert", narrowed)
+                else:
+                    for field_body in ("body", "orelse", "finalbody"):
+                        sub = getattr(node, field_body, None)
+                        if isinstance(sub, list):
+                            visit(sub, narrowed)
+                    for handler in getattr(node, "handlers", []) or []:
+                        visit(handler.body, narrowed)
+
+        visit(list(getattr(fn, "body", [])), set())
+    return out
+
+
+# =========================================================================== HL003
+def rule_hl003_per_element_loops(mod: ModuleInfo) -> List[Violation]:
+    if not _is_hot(mod.path):
+        return []
+    out: List[Violation] = []
+    traced = _traced_fn_ids(mod)
+    for fn, qual in _functions(mod):
+        if id(fn) in traced:
+            continue
+        taint = ArrayTaint(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            if _contains_device_get(node.iter):
+                continue
+            if _device_expr(node.iter, taint):
+                out.append(_v(mod, node, "HL003",
+                              "Python loop over a device array issues one dispatch (or "
+                              "transfer) per element — vectorize, or fetch the whole "
+                              "array once via an annotated jax.device_get", qual))
+    return out
+
+
+# =========================================================================== HL004
+def rule_hl004_per_call_jit(mod: ModuleInfo) -> List[Violation]:
+    if not _is_hot(mod.path):
+        return []
+    out: List[Violation] = []
+
+    def is_jit_call(e: ast.AST) -> bool:
+        return isinstance(e, ast.Call) and _dotted(e.func) in ("jax.jit", "jit")
+
+    for fn, qual in _functions(mod):
+        for node in ast.walk(fn):
+            # jax.jit(f)(args): fresh program built and dropped per invocation
+            if isinstance(node, ast.Call) and is_jit_call(node.func):
+                out.append(_v(mod, node, "HL004",
+                              "per-call `jax.jit(f)(...)` constructs a fresh program "
+                              "every invocation — cache the jitted callable", qual))
+            # jax.jit(f).lower(...) / .trace(...): same churn through an attribute
+            elif (
+                isinstance(node, ast.Attribute)
+                and is_jit_call(node.value)
+            ):
+                out.append(_v(mod, node, "HL004",
+                              f"`jax.jit(...).{node.attr}` builds an uncached program "
+                              "inside a function body — hoist or cache the jit object", qual))
+    return out
+
+
+# =========================================================================== HL005
+_BLOCKING_LEAVES = ("device_get",)
+_BLOCKING_METHODS = frozenset({"block_until_ready"})
+
+
+def rule_hl005_unannotated_blocking(mod: ModuleInfo) -> List[Violation]:
+    if not _is_hot(mod.path):
+        return []
+    out: List[Violation] = []
+    marks = _markers(mod)
+    for fn, qual in _functions(mod):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            blocking = any(d.endswith(leaf) and "device_get" in d for leaf in _BLOCKING_LEAVES) or (
+                isinstance(node.func, ast.Attribute) and node.func.attr in _BLOCKING_METHODS
+            )
+            if not blocking:
+                continue
+            if not marks.has_marker(node.lineno, INTENTIONAL_TRANSFER_MARKER):
+                out.append(_v(mod, node, "HL005",
+                              f"blocking call `{d or node.func.attr}` without a "
+                              f"`# hotlint: {INTENTIONAL_TRANSFER_MARKER}` annotation — "
+                              "say why this sync is intentional (and scope it)", qual))
+    return out
+
+
+# =========================================================================== HL006
+# the per-tick entry points: anything these reach via self-calls is hot-loop code
+_TICK_ROOTS = frozenset({"tick", "submit", "compute", "compute_all", "aggregate", "_flush_pending"})
+_NP_ALLOCATORS = frozenset({
+    "np.stack", "np.asarray", "np.array", "np.ascontiguousarray",
+    "np.concatenate", "np.copy", "np.vstack", "np.hstack",
+})
+
+
+def _self_call_graph(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        callees: Set[str] = set()
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                callees.add(node.func.attr)
+        graph[stmt.name] = callees
+    return graph
+
+
+def _tick_reachable(cls: ast.ClassDef) -> Set[str]:
+    graph = _self_call_graph(cls)
+    seen: Set[str] = set()
+    frontier = [r for r in _TICK_ROOTS if r in graph]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        frontier.extend(c for c in graph.get(name, ()) if c not in seen and c in graph)
+    return seen
+
+
+def _host_proven_names(fn: ast.AST) -> Set[str]:
+    """Names assigned from provably host-resident values (fixpoint over assigns)."""
+    names: Set[str] = set()
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _host_proven(node.value, names):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(node, ast.For) and _host_proven(node.iter, names):
+                if isinstance(node.target, ast.Name):
+                    names.add(node.target.id)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp, ast.DictComp)):
+                for comp in node.generators:
+                    if _host_proven(comp.iter, names) and isinstance(comp.target, ast.Name):
+                        names.add(comp.target.id)
+    return names
+
+
+def _host_proven(e: ast.expr, names: Set[str]) -> bool:
+    """Conservatively: does this expression provably hold host (numpy) data?"""
+    if isinstance(e, ast.Constant):
+        return True
+    if isinstance(e, ast.Name):
+        return e.id in names
+    if isinstance(e, ast.Starred):
+        return _host_proven(e.value, names)
+    if isinstance(e, (ast.List, ast.Tuple, ast.Set)):
+        return all(_host_proven(x, names) for x in e.elts)
+    if isinstance(e, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        local = set(names)
+        for comp in e.generators:
+            if _host_proven(comp.iter, local) and isinstance(comp.target, ast.Name):
+                local.add(comp.target.id)
+        return _host_proven(e.elt, local)
+    if isinstance(e, ast.IfExp):
+        return _host_proven(e.body, names) and _host_proven(e.orelse, names)
+    if isinstance(e, ast.Dict):
+        return all(_host_proven(v, names) for v in e.values)
+    if isinstance(e, (ast.Subscript, ast.Attribute)):
+        return _host_proven(e.value, names)
+    if isinstance(e, ast.BinOp):
+        return _host_proven(e.left, names) and _host_proven(e.right, names)
+    if isinstance(e, ast.Call):
+        d = _dotted(e.func)
+        # numpy results and explicit fetches are host by construction; engine
+        # helpers named `*_host_fetch`/`_host_value` are the annotated choke
+        # points device_get routes through
+        return (
+            d.startswith("np.")
+            or d.endswith("device_get")
+            or d.endswith("_host_fetch")
+            or d.endswith("_host_value")
+        )
+    return False
+
+
+def rule_hl006_host_alloc_in_tick(mod: ModuleInfo) -> List[Violation]:
+    if not mod.path.startswith("metrics_tpu/engine/") or not _is_hot(mod.path):
+        return []
+    out: List[Violation] = []
+    marks = _markers(mod)
+    for cls in (n for n in mod.tree.body if isinstance(n, ast.ClassDef)):
+        reachable = _tick_reachable(cls)
+        if not reachable:
+            continue
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) or stmt.name not in reachable:
+                continue
+            host_names = _host_proven_names(stmt)
+            qual = f"{cls.name}.{stmt.name}"
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call) or _dotted(node.func) not in _NP_ALLOCATORS:
+                    continue
+                if not node.args or _host_proven(node.args[0], host_names):
+                    continue
+                if _contains_device_get(node.args[0]):
+                    continue
+                if marks.has_marker(node.lineno, INTENTIONAL_TRANSFER_MARKER):
+                    continue
+                out.append(_v(mod, node, "HL006",
+                              f"`{_dotted(node.func)}(...)` inside a per-tick engine path "
+                              "allocates host memory from values not proven host-resident "
+                              "— fetch once via an annotated jax.device_get, then build "
+                              "from host buffers", qual))
+    return out
+
+
+SYNC_RULES: Dict[str, Callable[[ModuleInfo], List[Violation]]] = {
+    "HL001": rule_hl001_implicit_host_sync,
+    "HL002": rule_hl002_device_truthiness,
+    "HL003": rule_hl003_per_element_loops,
+    "HL004": rule_hl004_per_call_jit,
+    "HL005": rule_hl005_unannotated_blocking,
+    "HL006": rule_hl006_host_alloc_in_tick,
+}
+
+
+# ----------------------------------------------------------------- classifier
+def class_sync_hazards(cls: ast.ClassDef) -> List[str]:
+    """Statically visible host-sync hazards inside a metric class's hot bodies.
+
+    The transfer-contract harness's *static leg*: concretizing calls or
+    device-truthiness inside ``update``/``_update_impl`` mean the steady-state
+    loop cannot be transfer-free. Mirrors :func:`rule_hl001_implicit_host_sync`
+    restricted to one class body.
+    """
+    hazards: List[str] = []
+    state_attrs: Set[str] = set()
+    for call in (n for n in ast.walk(cls) if isinstance(n, ast.Call)):
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "add_state":
+            if call.args and isinstance(call.args[0], ast.Constant) and isinstance(call.args[0].value, str):
+                state_attrs.add(call.args[0].value)
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) or stmt.name not in ("update", "_update_impl"):
+            continue
+        taint = ArrayTaint(stmt, state_attrs=tuple(sorted(state_attrs)))
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d in _CONCRETIZING_BUILTINS and len(node.args) == 1 and _device_expr(node.args[0], taint):
+                    hazards.append(f"{stmt.name}: {d}() on device value")
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CONCRETIZING_METHODS
+                    and _device_expr(node.func.value, taint)
+                ):
+                    hazards.append(f"{stmt.name}: .{node.func.attr}() on device value")
+                elif d in _NP_CASTS and node.args and _device_expr(node.args[0], taint):
+                    hazards.append(f"{stmt.name}: {d}() on device value")
+            elif isinstance(node, (ast.If, ast.While)) and taint.is_value_dependent_test(node.test):
+                hazards.append(f"{stmt.name}: branch on device value")
+    return hazards
+
+
+def classify_transfers(cls: type) -> Tuple[bool, str]:
+    """Static transfer verdict for a runtime class: (clean, hazards).
+
+    Walks the MRO below :class:`metrics_tpu.metric.Metric` exactly like
+    ``classify_donation`` and collects :func:`class_sync_hazards` from every
+    class body. Clean means *no statically visible host sync anywhere in the
+    hierarchy's update path* — the claim the runtime transfer-guard leg of
+    :mod:`metrics_tpu.analysis.transfer_contracts` re-proves dynamically.
+    """
+    import inspect
+    import textwrap
+
+    hazards: List[str] = []
+    for klass in cls.__mro__:
+        if klass.__module__ in ("builtins", "abc"):
+            continue
+        if klass.__name__ == "Metric" and klass.__module__.endswith("metric"):
+            break  # the runtime base owns the protocol; its body is not a subject
+        try:
+            node = ast.parse(textwrap.dedent(inspect.getsource(klass))).body[0]
+        except (OSError, TypeError, SyntaxError, IndexError):
+            continue
+        if isinstance(node, ast.ClassDef):
+            hazards.extend(f"{klass.__name__}: {h}" for h in class_sync_hazards(node))
+    return (not hazards, "; ".join(hazards))

@@ -1,0 +1,406 @@
+"""Dynamic transfer-contract harness: hotlint's verdicts, proven under the guard.
+
+For every jit-eligible class in the profile registry this runs a steady-state
+update loop under ``jax.transfer_guard("disallow")`` and cross-checks three
+independent verdicts on the same question — *is this class's steady-state
+update loop free of implicit host↔device transfers?*
+
+1. **static** — :func:`metrics_tpu.analysis.sync_rules.classify_transfers`,
+   read off the class hierarchy's source (concretizing calls / device
+   truthiness inside ``update``);
+2. **declared** — ``Metric._jit_eligible``, the predicate the class exports to
+   the dispatch layer and the fleet engine: "my update is one traced program"
+   implies the host loop around it moves no data;
+3. **runtime** — what actually happened: warm one compile first (tracing
+   legitimately uploads closure constants), then run steady-state updates with
+   pre-materialized device batches under ``transfer_guard("disallow")`` — any
+   implicit transfer raises, any annotated intentional one runs inside its
+   scoped ``transfer_guard("allow")`` (``engine/stream.py::_transfer_scope``).
+
+The same guard is then put around the fleet: a 100-session ``StreamEngine``
+steady-state tick and a ``ShardedStreamEngine`` churn tick (arrivals +
+expiries + submissions mid-guard) must complete with zero implicit-transfer
+errors — the expiry slice, state adoption and wave assembly are exactly the
+annotated sites, so the tick proves the engine's transfer discipline end to
+end. Their static leg is the hotlint pass itself over ``engine/``.
+
+Disagreements are baselined in the ``transfer`` section of
+``tools/hotlint_baseline.json`` (expected empty; every entry needs a
+justification string). Runs as the ``transfer`` pass of ``tools/lint_metrics
+--all`` and standalone via ``python -m metrics_tpu.analysis.transfer_contracts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "TransferResult",
+    "check_transfer_case",
+    "check_engine_contract",
+    "diff_transfer_baseline",
+    "transfer_cases",
+    "main",
+    "run_transfer_check",
+]
+
+_DEFAULT_BASELINE = os.path.join("tools", "hotlint_baseline.json")
+_STEPS = 3  # steady-state guarded updates after the warm-up compile
+_ENGINE_SESSIONS = 100  # the acceptance-criterion fleet size
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferResult:
+    name: str
+    static_clean: bool
+    static_detail: str  # hazard list when dirty
+    declared: bool  # _jit_eligible: "my steady-state loop is one program"
+    runtime: str  # CLEAN | TRANSFER:<why> | EAGER | ERROR:<why>
+    agree: bool
+    detail: str = ""
+
+    def render(self) -> str:
+        mark = "ok " if self.agree else "DISAGREE"
+        return (
+            f"{mark} {self.name}: static={'clean' if self.static_clean else 'hazard'} "
+            f"declared={'eligible' if self.declared else 'ineligible'} runtime={self.runtime}"
+            + (f" ({self.detail})" if self.detail else "")
+        )
+
+
+def transfer_cases() -> List[Any]:
+    """The jit-eligible slice of the profile registry (donation's gate, reused)."""
+    from metrics_tpu.analysis.donation_contracts import donation_cases
+
+    return donation_cases()
+
+
+def _materialized_batches(case: Any, n: int) -> List[Tuple[Any, ...]]:
+    """Device-resident, fully materialized batches, built OUTSIDE the guard —
+    the h2d upload of synthetic data is the test fixture's transfer, not the
+    subject's."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.observe.costs import _rng
+
+    rng = _rng(case)
+    batches = []
+    for _ in range(n):
+        batch = tuple(
+            jnp.asarray(a) if hasattr(a, "shape") or isinstance(a, (int, float, bool)) else a
+            for a in case.batch(rng)
+        )
+        jax.block_until_ready([a for a in batch if hasattr(a, "shape")])
+        batches.append(batch)
+    return batches
+
+
+def check_transfer_case(case: Any) -> TransferResult:
+    """One class through warm-up + guarded steady state; never raises."""
+    import jax
+
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.analysis.sync_rules import classify_transfers
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+
+    probe = _observe.Recorder()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled = _observe.ENABLED
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    real = _observe.RECORDER
+    _observe.RECORDER = probe
+    try:
+        _observe.ENABLED = True
+        metric_mod._JIT_UPDATE_DEFAULT = True
+        clear_jit_cache()
+        m = case.ctor()
+        cls_name = type(m).__name__
+        static_clean, static_detail = classify_transfers(type(m))
+        batches = _materialized_batches(case, _STEPS + 1)
+        declared = bool(m._jit_eligible(batches[0], {}))
+
+        # warm-up: the first dispatch traces + compiles, and tracing uploads
+        # closure constants — legitimate one-time transfers
+        m.update(*batches[0])
+        jax.block_until_ready(
+            [v for v in m.__dict__["_state"].values() if isinstance(v, jax.Array)]
+        )
+
+        runtime, detail = "CLEAN", ""
+        try:
+            with jax.transfer_guard("disallow"):
+                for batch in batches[1:]:
+                    m.update(*batch)
+        except Exception as exc:  # noqa: BLE001 — the guard's raise IS the verdict
+            runtime, detail = f"TRANSFER:{type(exc).__name__}", str(exc)[:200]
+        if runtime == "CLEAN" and probe.counters.get(("update_jit", cls_name), 0) == 0:
+            runtime = "EAGER"  # no jitted step ran; the guard proved nothing jitted
+    except Exception as exc:  # noqa: BLE001 — every failure is a reportable verdict
+        return TransferResult(
+            case.name, False, "", False, f"ERROR:{type(exc).__name__}", False, str(exc)[:200]
+        )
+    finally:
+        _observe.RECORDER = real
+        _observe.ENABLED = saved_enabled
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+        clear_jit_cache()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+
+    # three-way agreement --------------------------------------------------
+    if runtime.startswith("ERROR"):
+        agree = False
+    elif not declared:
+        # the class opted out of the one-traced-program contract for this
+        # batch shape; its eager loop may legitimately move scalars, so any
+        # guard outcome short of a hard error is consistent with the declaration
+        agree = True
+    elif static_clean:
+        agree = runtime == "CLEAN"
+    else:
+        # static hazard + declared eligible: the guard must confirm the hazard
+        # (or the body never dispatched at all)
+        agree = runtime.startswith("TRANSFER") or runtime == "EAGER"
+    return TransferResult(
+        case.name, static_clean, static_detail, declared, runtime, agree, detail
+    )
+
+
+# ----------------------------------------------------------------- engines
+def _engine_case() -> Any:
+    """First registry case whose metric rides a fleet bucket (the engine's gate)."""
+    for case in transfer_cases():
+        try:
+            m = case.ctor()
+            if m._jit_cache_key() is not None and m._jit_eligible((), {}):
+                return case
+        except Exception:  # noqa: BLE001
+            continue
+    raise RuntimeError("no bucket-eligible profile case found for the engine contract")
+
+
+def _engine_static_leg(root: str) -> Tuple[bool, str]:
+    """The engines' static verdict is the hotlint pass over ``engine/`` itself."""
+    from metrics_tpu.analysis.contexts import SYNC_RULE_CODES
+    from metrics_tpu.analysis.engine import lint_paths
+
+    target = os.path.join(root, "metrics_tpu", "engine")
+    if not os.path.isdir(target):
+        return True, "engine/ sources not present (installed package?)"
+    res = lint_paths([target], root=root, rules=SYNC_RULE_CODES)
+    if res.violations:
+        return False, "; ".join(v.render() for v in res.violations[:5])
+    return True, ""
+
+
+def check_engine_contract(kind: str, root: str) -> TransferResult:
+    """A fleet tick under ``transfer_guard("disallow")``; never raises.
+
+    ``kind`` is ``"StreamEngine"`` (100-session steady-state tick — the
+    acceptance criterion) or ``"ShardedStreamEngine"`` (churn tick: arrivals,
+    expiries and submissions all happen INSIDE the guard, so adoption scatter,
+    expiry slice and wave assembly must all run in their annotated scopes).
+    """
+    import jax
+
+    from metrics_tpu.observe import recorder as _observe
+
+    name = f"engine:{kind}"
+    try:
+        static_clean, static_detail = _engine_static_leg(root)
+        case = _engine_case()
+        saved_enabled = _observe.ENABLED
+        probe = _observe.Recorder()
+        real = _observe.RECORDER
+        _observe.RECORDER = probe
+        try:
+            _observe.ENABLED = True
+            if kind == "StreamEngine":
+                from metrics_tpu.engine.stream import StreamEngine
+
+                engine: Any = StreamEngine(name="xfer_contract")
+                n = _ENGINE_SESSIONS
+            else:
+                from metrics_tpu.engine.sharded import ShardedStreamEngine
+
+                engine = ShardedStreamEngine(n_shards=2, name="xfer_contract")
+                n = 16
+            sids = [engine.add_session(case.ctor(), session_id=f"s{i}") for i in range(n)]
+            # constructing a metric allocates device state (h2d) — that is the
+            # fixture's transfer, not the engine's, so churn arrivals are built
+            # out here and only *adopted* inside the guard
+            churn_metrics = [case.ctor() for _ in range(4)]
+            import jax as _jax
+
+            _jax.block_until_ready(
+                [v for m in churn_metrics for v in m.__dict__["_state"].values()
+                 if isinstance(v, _jax.Array)]
+            )
+            batches = _materialized_batches(case, 2 * n + 4)
+            bi = 0
+            for sid in sids:
+                engine.submit(sid, *batches[bi % len(batches)])
+                bi += 1
+            engine.tick()  # warm: traces + compiles the wave programs
+
+            runtime, detail = "CLEAN", ""
+            try:
+                with jax.transfer_guard("disallow"):
+                    if kind == "ShardedStreamEngine":
+                        # churn inside the guard: expiries slice rows out,
+                        # arrivals scatter adopted state in — both annotated
+                        for sid in sids[:4]:
+                            engine.expire(sid)
+                        sids = sids[4:]
+                        for i, m2 in enumerate(churn_metrics):
+                            sids.append(engine.add_session(m2, session_id=f"churn{i}"))
+                    for sid in sids:
+                        engine.submit(sid, *batches[bi % len(batches)])
+                        bi += 1
+                    engine.tick()  # steady state: zero implicit transfers
+            except Exception as exc:  # noqa: BLE001 — the guard's raise IS the verdict
+                runtime, detail = f"TRANSFER:{type(exc).__name__}", str(exc)[:200]
+            explicit = sum(
+                v for (fam, _), v in probe.counters.items() if fam == "explicit_transfer"
+            )
+            if runtime == "CLEAN" and not detail:
+                detail = f"{len(sids)} sessions, {explicit} annotated explicit transfer(s)"
+        finally:
+            _observe.RECORDER = real
+            _observe.ENABLED = saved_enabled
+    except Exception as exc:  # noqa: BLE001
+        return TransferResult(
+            name, False, "", False, f"ERROR:{type(exc).__name__}", False, str(exc)[:200]
+        )
+    agree = (static_clean and runtime == "CLEAN") or (
+        not static_clean and runtime.startswith("TRANSFER")
+    )
+    return TransferResult(name, static_clean, static_detail, True, runtime, agree, detail)
+
+
+def collect_transfer_report(
+    root: str, cases: Optional[Sequence[Any]] = None
+) -> List[TransferResult]:
+    results = [check_transfer_case(c) for c in (cases if cases is not None else transfer_cases())]
+    results.append(check_engine_contract("StreamEngine", root))
+    results.append(check_engine_contract("ShardedStreamEngine", root))
+    return results
+
+
+# ------------------------------------------------------------------- baseline
+def load_transfer_baseline(path: str) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): str(v) for k, v in load_baseline_section(path, "transfer").items()}
+
+
+def write_transfer_baseline(path: str, results: Sequence[TransferResult]) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
+    transfer = {
+        r.name: f"UNJUSTIFIED: static={r.static_clean} declared={r.declared} runtime={r.runtime}"
+        for r in sorted(results, key=lambda r: r.name)
+        if not r.agree
+    }
+    write_baseline_section(
+        path,
+        "transfer",
+        transfer,  # type: ignore[arg-type]
+        "hotlint baseline — static host-sync exceptions under `entries` "
+        "(path::rule::context -> count), transfer-guard cross-check disagreements "
+        "under `transfer` (class -> justification; expected empty). Regenerate with "
+        "`python tools/lint_metrics.py --pass hotlint --pass transfer --update-baseline`.",
+        seed={"entries": {}},
+    )
+    return transfer
+
+
+def diff_transfer_baseline(
+    results: Sequence[TransferResult], baseline: Dict[str, str]
+) -> Tuple[List[TransferResult], List[str]]:
+    """Split into (failures, stale_baseline_keys): unbaselined disagreements fail."""
+    failures = [r for r in results if not r.agree and r.name not in baseline]
+    observed = {r.name for r in results}
+    disagreeing = {r.name for r in results if not r.agree}
+    stale = sorted(
+        name for name in baseline if name not in disagreeing or name not in observed
+    )
+    return failures, stale
+
+
+def run_transfer_check(
+    root: str,
+    baseline_path: Optional[str] = None,
+    update_baseline: bool = False,
+    quiet: bool = False,
+    report: Optional[Dict[str, Any]] = None,
+) -> int:
+    """The ``transfer`` pass of ``lint_metrics --all``: guard, cross-check, verdict."""
+    path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
+    results = collect_transfer_report(root)
+    if update_baseline:
+        transfer = write_transfer_baseline(path, results)
+        if not quiet:
+            print(f"transfer: baseline written to {path} ({len(transfer)} disagreement(s))")
+        return 0
+    failures, stale = diff_transfer_baseline(results, load_transfer_baseline(path))
+    if report is not None:
+        # the caller owns stdout (one JSON document) — collect, don't print
+        report.update(
+            {
+                "cases": len(results),
+                "failures": [r.render() for r in failures],
+                "baselined": sum(1 for r in results if not r.agree) - len(failures),
+                "stale_baseline_keys": stale,
+                "runtime_verdicts": {r.name: r.runtime for r in results},
+            }
+        )
+        return 1 if failures else 0
+    for r in failures:
+        print(f"transfer: {r.render()}")
+    if not quiet:
+        for key in stale:
+            print(f"transfer: stale baseline entry: {key}")
+        agreed = sum(1 for r in results if r.agree)
+        clean = sum(1 for r in results if r.runtime == "CLEAN")
+        print(
+            f"transfer: {agreed}/{len(results)} cases agree "
+            f"({clean} guard-clean at runtime), {len(failures)} failure(s), {len(stale)} stale"
+        )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="transfer-contracts",
+        description="Steady-state update loops and fleet ticks under "
+        "jax.transfer_guard('disallow'), cross-checking static hotlint verdicts, "
+        "declared jit eligibility, and the runtime guard outcome.",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="hotlint baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current disagreements as the new baseline and exit 0")
+    p.add_argument("-v", "--verbose", action="store_true", help="print every case verdict")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.verbose:
+        for r in collect_transfer_report(root):
+            print(r.render())
+    return run_transfer_check(
+        root,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -436,7 +436,9 @@ class MetricCollection:
                     continue
                 equal[(k1, k2)] = equal[(k2, k1)] = verdict
         if pending:
-            flat = np.asarray(jnp.stack([arr for _, arr in pending]))  # one fetch
+            # hotlint: intentional-transfer — ONE batched d2h resolves every pair
+            flat = np.asarray(jax.device_get(jnp.stack([arr for _, arr in pending])))
+            _observe.note_explicit_transfer("collection_state_equal")
             for ((k1, k2), _), ok in zip(pending, flat):
                 equal[(k1, k2)] = equal[(k2, k1)] = bool(ok)
         return equal

@@ -203,6 +203,7 @@ class IngestWAL:
 
 # ------------------------------------------------------------------ save
 def _host(v: Any) -> np.ndarray:
+    # hotlint: intentional-transfer — checkpointing serializes state to host
     return np.asarray(jax.device_get(v))
 
 

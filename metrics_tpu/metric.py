@@ -687,6 +687,7 @@ class Metric(ABC):
             parts = v if isinstance(v, list) else [v]
             digest.update(f"|{name}[{len(parts)}]".encode())
             for part in parts:
+                # hotlint: intentional-transfer — the digest hashes exact state bytes
                 arr = np.ascontiguousarray(np.asarray(jax.device_get(part)))
                 digest.update(f":{arr.dtype.str}{arr.shape}".encode())
                 digest.update(arr.tobytes())
@@ -861,6 +862,7 @@ class Metric(ABC):
         """Move list states to host memory (reference ``metric.py:566-571``)."""
         for key, value in self._state.items():
             if isinstance(value, list):
+                # hotlint: intentional-transfer — this API's contract IS the host move
                 self._state[key] = [np.asarray(jax.device_get(v)) for v in value]
 
     def _wrapped_compute(self) -> Any:
@@ -1266,8 +1268,10 @@ class Metric(ABC):
                 continue
             current = self._state[key]
             if isinstance(current, list):
+                # hotlint: intentional-transfer — checkpoint export reads state to host
                 destination[prefix + key] = [np.asarray(jax.device_get(v)) for v in current]
             else:
+                # hotlint: intentional-transfer — checkpoint export reads state to host
                 destination[prefix + key] = np.asarray(jax.device_get(current))
         destination[prefix + "_update_count"] = self._update_count
         return destination
@@ -1282,6 +1286,7 @@ class Metric(ABC):
         if isinstance(default, list):
             elt = np.asarray(default[0]) if default else np.asarray(0, dtype=self._dtype)
             return tuple(elt.shape), elt.dtype, True
+        # hotlint: intentional-transfer — one-time aval read of a registered default
         arr = np.asarray(jax.device_get(default))
         growable = self._reductions[key] is dim_zero_cat
         return tuple(arr.shape), arr.dtype, growable
@@ -1292,6 +1297,7 @@ class Metric(ABC):
         shape, dtype, growable = self._expected_aval(key)
         values = value if isinstance(value, list) else [value]
         for v in values:
+            # hotlint: intentional-transfer — load-time validation reads the candidate
             arr = np.asarray(jax.device_get(v)) if isinstance(v, jax.Array) else np.asarray(v)
             if arr.dtype.kind != np.dtype(dtype).kind:
                 raise RuntimeError(
@@ -1463,6 +1469,7 @@ _HOST_ONLY_DTYPES = tuple(
 
 def _pickle_to_host(x: Any) -> Any:
     """Device array → host numpy; host payloads (numpy f64/object, None, …) pass through."""
+    # hotlint: intentional-transfer — pickling serializes device arrays to host
     return np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x
 
 

@@ -279,7 +279,9 @@ def program_cost(template: Any, capacity: int, args: Tuple[Any, ...], kwargs: Di
         state = template._fresh_state()
         row_args = tuple(_row_aval(a) for a in args)
         row_kwargs = {k: _row_aval(v) for k, v in kwargs.items()}
-        lowered = jax.jit(template._functional_update).lower(state, *row_args, **row_kwargs)
+        # lowering-only (never compiled/dispatched), and callers cache the result
+        # per (bucket, capacity) — no per-tick program churn
+        lowered = jax.jit(template._functional_update).lower(state, *row_args, **row_kwargs)  # hotlint: disable=HL004
         analysis = lowered.cost_analysis() or {}
         if isinstance(analysis, (list, tuple)):  # older jax: one entry per computation
             analysis = analysis[0] if analysis else {}

@@ -320,6 +320,20 @@ def note_jit_cache_hit(metric: str) -> None:
         RECORDER.add_count("jit_cache_hit", metric)
 
 
+def note_explicit_transfer(site: str) -> None:
+    """One annotated, intentional host↔device transfer executed.
+
+    Every ``# hotlint: intentional-transfer`` site (engine wave assembly, WAL
+    journaling, expiry slicing, collection merge fetch, …) bumps this counter
+    when it actually moves data, so ``fleet_top``'s "== compiles ==" section
+    can show the fleet's explicit-transfer budget next to its compile budget —
+    any transfer NOT counted here is implicit and hotlint/transfer-contract
+    material.
+    """
+    if ENABLED:
+        RECORDER.add_count("explicit_transfer", site)
+
+
 def note_jit_eviction(metric: str) -> None:
     if ENABLED:
         RECORDER.add_count("jit_cache_eviction", metric)

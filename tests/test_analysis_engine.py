@@ -20,6 +20,7 @@ from metrics_tpu.analysis import (
     write_baseline_section,
 )
 from metrics_tpu.analysis.contexts import Suppressions
+from metrics_tpu.analysis.engine import SourceMarkers
 
 
 # ------------------------------------------------------------- section helpers
@@ -90,7 +91,7 @@ def test_diff_reports_unmatched_entries_as_stale():
 
 # ------------------------------------------------------------------ suppressions
 def test_every_registered_prefix_parses():
-    assert set(LINT_PREFIXES) == {"jitlint", "distlint", "donlint"}
+    assert set(LINT_PREFIXES) == {"jitlint", "distlint", "donlint", "hotlint"}
     for prefix in LINT_PREFIXES:
         s = Suppressions(f"x = 1  # {prefix}: disable=ML001\n")
         assert s.is_suppressed(1, "ML001")
@@ -114,6 +115,52 @@ def test_file_wide_suppression_spans_prefixes():
 def test_unregistered_prefix_is_inert():
     s = Suppressions("x = 1  # otherlint: disable=ML001\n")
     assert not s.is_suppressed(1, "ML001")
+
+
+# ------------------------------------------------------------------ SourceMarkers
+# One tokenize pass now serves every consumer of comment text: suppression
+# parsing (all four prefixes), donlint's ML004 comment-adjacency check, and
+# hotlint's intentional-transfer annotations. These pin the unified behaviour.
+def test_markers_comment_lines_matches_real_comments():
+    src = 'x = 1  # trailing\n# full line\ny = "# not a comment"\n'
+    m = SourceMarkers(src)
+    assert m.comment_lines() == {1, 2}  # the string literal on line 3 is not a comment
+
+
+def test_markers_has_marker_same_line_and_line_above():
+    src = (
+        "# hotlint: intentional-transfer — checkpoint export\n"
+        "a = host(x)\n"
+        "b = host(y)  # hotlint: intentional-transfer — wal journal\n"
+        "c = host(z)\n"
+        "d = host(w)\n"
+    )
+    m = SourceMarkers(src)
+    assert m.has_marker(2, "intentional-transfer")  # line above
+    assert m.has_marker(3, "intentional-transfer")  # same line
+    assert not m.has_marker(5, "intentional-transfer")  # two lines below the marker
+
+
+def test_markers_prefix_is_part_of_the_grammar():
+    m = SourceMarkers("x = host(y)  # donlint: intentional-transfer\n")
+    assert not m.has_marker(1, "intentional-transfer")  # wrong prefix
+    assert m.has_marker(1, "intentional-transfer", prefix="donlint")
+
+
+def test_markers_survive_unparseable_source():
+    # tokenize raises on this input; the fallback line scan still finds both
+    # the suppression and the marker
+    src = "def broken(:\n    x = 1  # jitlint: disable=JL001\n    # hotlint: intentional-transfer\n    y = 2\n"
+    m = SourceMarkers(src)
+    assert m.is_suppressed(2, "JL001")
+    assert m.has_marker(4, "intentional-transfer")
+
+
+def test_suppressions_shim_delegates_to_markers():
+    # Suppressions is now a thin veneer over SourceMarkers — same verdicts
+    src = "x = 1  # hotlint: disable=HL001\n"
+    assert Suppressions(src).is_suppressed(1, "HL001")
+    assert SourceMarkers(src).is_suppressed(1, "HL001")
 
 
 if __name__ == "__main__":

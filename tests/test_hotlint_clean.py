@@ -1,0 +1,76 @@
+"""The repo must stay hotlint-clean: zero non-baselined HL violations.
+
+This is the enforcement point for the host-sync discipline the one-program
+tick depends on — any new implicit device→host sync (``float()`` / ``.item()``
+/ ``np.asarray`` of a device value), device-array truthiness, per-element
+device loop, per-call ``jax.jit`` construction, un-annotated blocking call, or
+host allocation from device buffers in a per-tick engine path introduced under
+the hot-path modules fails this test. Intentional transfers carry a
+``# hotlint: intentional-transfer`` annotation (and, by convention, a scoped
+``transfer_guard("allow")`` plus the ``explicit_transfer`` counter);
+exceptions belong in the ``entries`` section of ``tools/hotlint_baseline.json``
+(regenerate with ``python tools/lint_metrics.py --pass hotlint
+--update-baseline``) or behind an inline ``# hotlint: disable=RULE`` with a
+justification comment.
+"""
+
+import json
+import os
+
+import pytest
+
+from metrics_tpu.analysis import (
+    SYNC_RULE_CODES,
+    diff_against_baseline,
+    lint_paths,
+    load_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "hotlint_baseline.json")
+
+
+@pytest.fixture(scope="module")
+def lint_result():
+    return lint_paths(
+        [os.path.join(REPO_ROOT, "metrics_tpu")], root=REPO_ROOT, rules=list(SYNC_RULE_CODES)
+    )
+
+
+def test_every_module_parses(lint_result):
+    assert not lint_result.parse_errors, "\n".join(lint_result.parse_errors)
+    assert lint_result.files_scanned > 100  # the walk really covered the package
+
+
+def test_zero_non_baselined_violations(lint_result):
+    baseline = load_baseline(BASELINE_PATH)
+    new, _, _ = diff_against_baseline(lint_result.violations, baseline)
+    assert not new, "new hotlint violations (fix, annotate, or baseline):\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_no_stale_baseline_entries(lint_result):
+    baseline = load_baseline(BASELINE_PATH)
+    _, _, stale = diff_against_baseline(lint_result.violations, baseline)
+    assert not stale, f"stale baseline entries (remove them): {stale}"
+
+
+def test_static_baseline_is_empty():
+    """The hot path carries zero host-sync exceptions: every transfer is either
+    annotated intentional at its site or doesn't happen. The transfer section
+    is equally empty — the guard agrees with the static verdicts everywhere."""
+    with open(BASELINE_PATH, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc.get("entries") == {}
+    assert doc.get("transfer") == {}
+
+
+def test_cli_exits_zero_against_baseline():
+    from metrics_tpu.analysis.cli import main
+
+    assert main(["--root", REPO_ROOT, os.path.join(REPO_ROOT, "metrics_tpu"), "--pass", "hotlint", "-q"]) == 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

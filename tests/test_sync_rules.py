@@ -1,0 +1,364 @@
+"""Unit tests for the hotlint AST rules (HL001–HL006).
+
+Every rule gets at least one positive fixture (the host-sync / dispatch-economy
+hazard is reported) and one negative fixture (disciplined hot-path code stays
+clean). hotlint only fires inside the hot scope — ``metric.py``,
+``collections.py``, ``engine/``, ``wrappers/replicated.py``,
+``parallel/sync.py``, ``observe/`` — so fixtures are written at hot relative
+paths, and the scope gate itself is pinned by tests.
+"""
+
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis import SYNC_RULE_CODES, lint_file
+
+HOT = "metrics_tpu/engine/mod.py"
+
+
+def run_lint(tmp_path, source, rel=HOT, rules=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_file(str(path), root=str(tmp_path), rules=rules or list(SYNC_RULE_CODES))
+
+
+def codes(result):
+    return [v.rule for v in result.violations]
+
+
+# =========================================================================== scope
+class TestHotScope:
+    SRC = """
+        import jax.numpy as jnp
+
+        def f(x):
+            return float(jnp.sum(x))
+    """
+
+    def test_hot_file_is_linted(self, tmp_path):
+        assert codes(run_lint(tmp_path, self.SRC, rel="metrics_tpu/metric.py")) == ["HL001"]
+        assert codes(run_lint(tmp_path, self.SRC, rel="metrics_tpu/engine/stream.py")) == ["HL001"]
+
+    def test_cold_file_is_out_of_scope(self, tmp_path):
+        # functional/ code runs under trace or in user space — jitlint's turf
+        assert codes(run_lint(tmp_path, self.SRC, rel="metrics_tpu/functional/foo.py")) == []
+
+    def test_bench_harness_is_exempt(self, tmp_path):
+        # blocking on the device is the profiler's job, not a hazard
+        assert codes(run_lint(tmp_path, self.SRC, rel="metrics_tpu/observe/costs.py")) == []
+
+
+# =========================================================================== HL001
+class TestHL001ImplicitHostSync:
+    def test_float_of_device_value_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                return float(jnp.sum(x))
+        """, rules=["HL001"])
+        assert codes(res) == ["HL001"]
+        assert "blocks host dispatch" in res.violations[0].message
+
+    def test_item_and_np_asarray_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                a = jnp.sum(x).item()
+                b = np.asarray(jnp.cumsum(x))
+                return a, b
+        """, rules=["HL001"])
+        assert codes(res) == ["HL001", "HL001"]
+
+    def test_device_get_routing_is_clean(self, tmp_path):
+        # the fetch is explicit — HL005 owns whether it is annotated
+        res = run_lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                # hotlint: intentional-transfer — test fixture
+                return float(jax.device_get(jnp.sum(x)))
+        """, rules=["HL001"])
+        assert codes(res) == []
+
+    def test_annotated_line_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                # hotlint: intentional-transfer — closeout reads the scalar once
+                return float(jnp.sum(x))
+        """, rules=["HL001"])
+        assert codes(res) == []
+
+    def test_host_value_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import numpy as np
+
+            def f(rows):
+                return np.asarray(rows, dtype=np.float32)
+        """, rules=["HL001"])
+        assert codes(res) == []
+
+
+# =========================================================================== HL002
+class TestHL002DeviceTruthiness:
+    def test_branch_on_device_value_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                s = jnp.sum(x)
+                if s > 0:
+                    return 1
+                return 0
+        """, rules=["HL002"])
+        assert codes(res) == ["HL002"]
+        assert "blocks until the device" in res.violations[0].message
+
+    def test_isinstance_narrowing_is_clean(self, tmp_path):
+        # `if d:` inside an `isinstance(d, list)` branch is host truthiness
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(d):
+                d = jnp.asarray(d) if d is None else d
+                if isinstance(d, list):
+                    if d:
+                        return len(d)
+                return 0
+        """, rules=["HL002"])
+        assert codes(res) == []
+
+    def test_fetched_predicate_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def f(x):
+                # hotlint: intentional-transfer — test fixture
+                if jax.device_get(jnp.any(x)):
+                    return 1
+                return 0
+        """, rules=["HL002"])
+        assert codes(res) == []
+
+
+# =========================================================================== HL003
+class TestHL003PerElementLoops:
+    def test_loop_over_device_array_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                total = 0.0
+                for v in jnp.cumsum(x):
+                    total += v
+                return total
+        """, rules=["HL003"])
+        assert codes(res) == ["HL003"]
+        assert "one dispatch" in res.violations[0].message
+
+    def test_loop_over_stacked_column_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def f(bucket, k):
+                out = []
+                for v in bucket.stacked[k]:
+                    out.append(v)
+                return out
+        """, rules=["HL003"])
+        assert codes(res) == ["HL003"]
+
+    def test_loop_over_stacked_keys_is_clean(self, tmp_path):
+        # the .stacked dict is a host container; its KEYS are host strings
+        res = run_lint(tmp_path, """
+            def f(bucket):
+                out = []
+                for k in bucket.stacked:
+                    out.append(k)
+                return out
+        """, rules=["HL003"])
+        assert codes(res) == []
+
+    def test_loop_over_fetched_rows_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def f(x):
+                # hotlint: intentional-transfer — test fixture
+                for v in jax.device_get(jnp.cumsum(x)):
+                    yield v
+        """, rules=["HL003"])
+        assert codes(res) == []
+
+
+# =========================================================================== HL004
+class TestHL004PerCallJit:
+    def test_jit_immediately_invoked_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+
+            def f(g, x):
+                return jax.jit(g)(x)
+        """, rules=["HL004"])
+        assert codes(res) == ["HL004"]
+        assert "fresh program" in res.violations[0].message
+
+    def test_jit_lower_per_call_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+
+            def cost(g, x):
+                return jax.jit(g).lower(x).compile()
+        """, rules=["HL004"])
+        assert "HL004" in codes(res)
+
+    def test_cached_jit_object_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+
+            class Dispatcher:
+                def __init__(self, g):
+                    self._fn = jax.jit(g)
+
+                def __call__(self, x):
+                    return self._fn(x)
+        """, rules=["HL004"])
+        assert codes(res) == []
+
+
+# =========================================================================== HL005
+class TestHL005UnannotatedBlocking:
+    def test_bare_device_get_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+
+            def f(x):
+                return jax.device_get(x)
+        """, rules=["HL005"])
+        assert codes(res) == ["HL005"]
+        assert "intentional-transfer" in res.violations[0].message
+
+    def test_bare_block_until_ready_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            def f(x):
+                return x.block_until_ready()
+        """, rules=["HL005"])
+        assert codes(res) == ["HL005"]
+
+    def test_marker_on_line_above_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+
+            def f(cols):
+                # hotlint: intentional-transfer — one batched d2h per wave
+                return jax.device_get(cols)
+        """, rules=["HL005"])
+        assert codes(res) == []
+
+    def test_marker_on_same_line_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import jax
+
+            def f(x):
+                return jax.device_get(x)  # hotlint: intentional-transfer — closeout
+        """, rules=["HL005"])
+        assert codes(res) == []
+
+
+# =========================================================================== HL006
+class TestHL006HostAllocInTick:
+    def test_np_stack_of_device_rows_in_tick_flagged(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import numpy as np
+
+            class Engine:
+                def tick(self):
+                    return self._assemble()
+
+                def _assemble(self):
+                    return np.stack([self.bucket.stacked[k] for k in self.keys])
+        """, rules=["HL006"])
+        assert codes(res) == ["HL006"]
+        assert "per-tick engine path" in res.violations[0].message
+        assert res.violations[0].context == "Engine._assemble"  # reachability, not just tick
+
+    def test_alloc_from_fetched_rows_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import numpy as np
+
+            class Engine:
+                def tick(self):
+                    rows = _host_fetch(self.cols, "wave_assembly")
+                    return np.stack([np.asarray(r) for r in rows])
+        """, rules=["HL006"])
+        assert codes(res) == []
+
+    def test_alloc_outside_tick_paths_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            import numpy as np
+
+            class Engine:
+                def tick(self):
+                    return None
+
+                def checkpoint(self):
+                    return np.stack([self.bucket.stacked[k] for k in self.keys])
+        """, rules=["HL006"])
+        assert codes(res) == []
+
+    def test_rule_is_engine_only(self, tmp_path):
+        src = """
+            import numpy as np
+
+            class Engine:
+                def tick(self):
+                    return np.stack([self.bucket.stacked[k] for k in self.keys])
+        """
+        assert codes(run_lint(tmp_path, src, rel="metrics_tpu/metric.py", rules=["HL006"])) == []
+        assert codes(run_lint(tmp_path, src, rules=["HL006"])) == ["HL006"]
+
+
+# =========================================================================== misc
+def test_inline_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import jax
+
+        def f(x):
+            return jax.device_get(x)  # hotlint: disable=HL005
+    """, rules=["HL005"])
+    assert codes(res) == []
+    assert res.suppressed == 1
+
+
+def test_traced_bodies_are_jitlints_turf(tmp_path):
+    # a @jax.jit body never runs eagerly — float() there is a tracer error
+    # (JL001), not a host sync; hotlint must not double-report it
+    res = run_lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return float(jnp.sum(x))
+    """, rules=["HL001"])
+    assert codes(res) == []
+
+
+def test_classify_transfers_on_runtime_classes():
+    from metrics_tpu.analysis.sync_rules import classify_transfers
+    from metrics_tpu.regression import MeanSquaredError
+
+    clean, detail = classify_transfers(MeanSquaredError)
+    assert clean, detail
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,268 @@
+"""The dynamic transfer-contract harness (``analysis/transfer_contracts.py``).
+
+Synthetic Metric fixtures pin the runtime verdicts (CLEAN / EAGER / ERROR) and
+the three-way agreement logic (static hotlint classifier, declared
+``_jit_eligible``, transfer-guard outcome); the engine contracts are the
+tentpole acceptance criterion — a 100-session ``StreamEngine`` steady-state
+tick and a ``ShardedStreamEngine`` churn tick (arrivals + expiries inside the
+guard) complete under ``jax.transfer_guard("disallow")`` with zero
+implicit-transfer errors, the annotated explicit sites being the only
+transfers that run.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.analysis.sync_rules import classify_transfers
+from metrics_tpu.analysis.transfer_contracts import (
+    TransferResult,
+    check_engine_contract,
+    check_transfer_case,
+    diff_transfer_baseline,
+    load_transfer_baseline,
+    transfer_cases,
+    write_transfer_baseline,
+)
+from metrics_tpu.observe.costs import ProfileCase
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class HarnessClean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1)
+
+
+class HarnessEagerOptOut(Metric):
+    __jit_ineligible__ = True
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return self.total
+
+
+class HarnessHostBranch(Metric):
+    # fixture: update branches on a device value — the static classifier must
+    # call this a hazard even though the class never runs in this test
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        s = jnp.sum(x)
+        if s > 0:  # hotlint: disable=HL002 — deliberate fixture hazard
+            self.total = self.total + s
+
+    def compute(self):
+        return self.total
+
+
+def _case(ctor, name="HarnessCase"):
+    return ProfileCase(name=name, ctor=ctor, batch=lambda rng: (rng.randn(8).astype(np.float32),))
+
+
+# ------------------------------------------------------------------ verdicts
+def test_clean_class_reaches_three_way_agreement():
+    r = check_transfer_case(_case(HarnessClean))
+    assert r.agree, r.render()
+    assert r.runtime == "CLEAN"
+    assert r.static_clean and r.declared
+    assert r.render().startswith("ok ")
+
+
+def test_opted_out_class_waives_the_contract():
+    r = check_transfer_case(_case(HarnessEagerOptOut))
+    assert r.agree, r.render()
+    assert not r.declared  # __jit_ineligible__: the one-program claim is withdrawn
+    assert r.runtime in ("CLEAN", "EAGER") or r.runtime.startswith("TRANSFER")
+
+
+def test_broken_ctor_becomes_error_verdict_not_exception():
+    def boom():
+        raise RuntimeError("fixture ctor failure")
+
+    r = check_transfer_case(_case(boom))
+    assert not r.agree
+    assert r.runtime == "ERROR:RuntimeError"
+    assert "fixture ctor failure" in r.detail
+
+
+def test_static_classifier_flags_device_branch_hazard():
+    clean, detail = classify_transfers(HarnessHostBranch)
+    assert not clean
+    assert "branch on device value" in detail
+    clean, detail = classify_transfers(HarnessClean)
+    assert clean, detail
+
+
+# ------------------------------------------------------------------ registry
+def test_transfer_cases_are_the_jit_eligible_slice():
+    cases = transfer_cases()
+    assert len(cases) >= 50
+    names = {c.name for c in cases}
+    assert "MeanSquaredError" in names
+
+
+@pytest.mark.slow
+def test_full_registry_three_way_agreement():
+    """The tentpole acceptance criterion over the whole registry."""
+    results = [check_transfer_case(c) for c in transfer_cases()]
+    disagreements = [r.render() for r in results if not r.agree]
+    assert not disagreements, "\n".join(disagreements)
+    clean = sum(1 for r in results if r.runtime == "CLEAN")
+    assert clean >= 40  # guard-clean steady state is the overwhelming norm
+
+
+# ------------------------------------------------------------------ engines
+def test_stream_engine_100_sessions_tick_under_disallow():
+    """Acceptance criterion: a 100-session steady-state tick completes under
+    ``jax.transfer_guard("disallow")`` with zero implicit-transfer errors."""
+    r = check_engine_contract("StreamEngine", REPO_ROOT)
+    assert r.agree, r.render()
+    assert r.runtime == "CLEAN", r.render()
+    assert "100 sessions" in r.detail
+
+
+def test_sharded_engine_churn_tick_under_disallow():
+    """Satellite: churn (arrivals + expiries) inside the guard — the expiry
+    slice, adoption scatter and wave assembly run only in annotated scopes."""
+    r = check_engine_contract("ShardedStreamEngine", REPO_ROOT)
+    assert r.agree, r.render()
+    assert r.runtime == "CLEAN", r.render()
+
+
+def test_sharded_churn_transfers_are_exactly_the_annotated_sites():
+    """Zero implicit transfers, and every explicit one is a known annotated
+    site — expiry's host slice among them, as the only sanctioned way a row
+    leaves the device."""
+    from metrics_tpu.engine.sharded import ShardedStreamEngine
+    from metrics_tpu.observe import recorder as _observe
+
+    probe = _observe.Recorder()
+    saved_enabled, real = _observe.ENABLED, _observe.RECORDER
+    _observe.RECORDER = probe
+    try:
+        _observe.ENABLED = True
+        engine = ShardedStreamEngine(n_shards=2, name="churn_guard")
+        sids = [engine.add_session(HarnessClean(), session_id=f"s{i}") for i in range(8)]
+        batches = [jnp.asarray(np.random.RandomState(i).randn(8).astype(np.float32))
+                   for i in range(24)]
+        jax.block_until_ready(batches)
+        arrivals = [HarnessClean() for _ in range(2)]  # device state allocated out here
+        bi = 0
+        for sid in sids:
+            engine.submit(sid, batches[bi]); bi += 1
+        engine.tick()  # warm: compile outside the guard
+
+        before = dict(probe.counters)
+        with jax.transfer_guard("disallow"):
+            for sid in sids[:2]:
+                engine.expire(sid)
+            sids = sids[2:]
+            for i, m in enumerate(arrivals):
+                sids.append(engine.add_session(m, session_id=f"a{i}"))
+            for sid in sids:
+                engine.submit(sid, batches[bi]); bi += 1
+            engine.tick()
+        # no exception: zero implicit transfers. Now: the explicit ones are
+        # exactly the annotated engine sites, expiry's slice included.
+        sites = {
+            label for (fam, label), n in probe.counters.items()
+            if fam == "explicit_transfer" and n > before.get((fam, label), 0)
+        }
+        assert "expire_slice" in sites
+        assert sites <= {"expire_slice", "wave_assembly", "adopt_state", "reset_row",
+                         "row_replay", "nan_guard", "wal_journal"}
+    finally:
+        _observe.RECORDER = real
+        _observe.ENABLED = saved_enabled
+
+
+# ------------------------------------------------------------------ baseline
+def _disagreement(name="Ghost"):
+    return TransferResult(name, True, "", True, "TRANSFER:XlaRuntimeError", False)
+
+
+def _agreement(name="Fine"):
+    return TransferResult(name, True, "", True, "CLEAN", True)
+
+
+def test_baseline_round_trip_preserves_static_section(tmp_path):
+    path = str(tmp_path / "hotlint_baseline.json")
+    written = write_transfer_baseline(path, [_agreement(), _disagreement()])
+    assert set(written) == {"Ghost"}
+    assert load_transfer_baseline(path) == written
+    # the writer seeds the static section so one file serves both owners
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    assert load_baseline_section(path, "entries") == {}
+
+
+def test_diff_baselined_disagreement_is_not_a_failure():
+    results = [_agreement(), _disagreement()]
+    failures, stale = diff_transfer_baseline(results, {"Ghost": "known: fixture"})
+    assert failures == [] and stale == []
+    failures, _ = diff_transfer_baseline(results, {})
+    assert [r.name for r in failures] == ["Ghost"]
+
+
+def test_diff_reports_stale_entries():
+    _, stale = diff_transfer_baseline([_agreement("Fine")], {"Fine": "now agrees", "Gone": "?"})
+    assert stale == ["Fine", "Gone"]
+
+
+def test_run_transfer_check_report_and_exit_codes(tmp_path, monkeypatch, capsys):
+    import metrics_tpu.analysis.transfer_contracts as tc
+
+    monkeypatch.setattr(tc, "collect_transfer_report", lambda root: [_agreement(), _disagreement()])
+    report = {}
+    rc = tc.run_transfer_check(str(tmp_path), report=report)
+    assert rc == 1
+    assert report["cases"] == 2 and report["baselined"] == 0
+    assert report["failures"] and "Ghost" in report["failures"][0]
+    assert report["runtime_verdicts"] == {"Fine": "CLEAN", "Ghost": "TRANSFER:XlaRuntimeError"}
+    assert capsys.readouterr().out == ""  # report mode: the caller owns stdout
+
+    # a justified baseline entry turns the same run green
+    path = str(tmp_path / "tools" / "hotlint_baseline.json")
+    (tmp_path / "tools").mkdir()
+    write_transfer_baseline(path, [_disagreement()])
+    assert tc.run_transfer_check(str(tmp_path), quiet=True) == 0
+
+
+def test_checked_in_baseline_is_empty():
+    with open(os.path.join(REPO_ROOT, "tools", "hotlint_baseline.json"), encoding="utf-8") as fh:
+        import json
+
+        doc = json.load(fh)
+    assert doc.get("entries") == {}
+    assert doc.get("transfer") == {}
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,9 +1,15 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
-# + donlint), the telemetry overhead smoke (disabled-mode cost pin plus the
-# enabled-watchdog sampling budget and the enabled-meter attribution budget:
-# per-session dispatch share, loose path, rate-limited quota poll), the donation
-# three-way cross-check, the AOT executable-cache round-trip pass (serialize
+# + donlint + hotlint, the last covering host-sync & dispatch-economy rules
+# HL001–HL006 over the hot-path modules, baselined expected-empty in
+# tools/hotlint_baseline.json), the telemetry overhead smoke (disabled-mode
+# cost pin plus the enabled-watchdog sampling budget and the enabled-meter
+# attribution budget: per-session dispatch share, loose path, rate-limited
+# quota poll), the donation
+# three-way cross-check, the transfer-guard cross-check (steady-state update
+# loops and 100-session fleet ticks under jax.transfer_guard("disallow"),
+# agreeing with hotlint's static verdicts and each class's declared jit
+# eligibility), the AOT executable-cache round-trip pass (serialize
 # → fresh-dir reload with zero compiles → bit-exact vs a fresh trace,
 # baselined in tools/aot_baseline.json), the chaos fault-injection harness
 # (metric faults + fleet recovery + sharded-fleet recovery, baselined in the

@@ -3,7 +3,9 @@
 
 Offline, it reads one or two ``observe.snapshot()`` JSON files (the dicts the
 runtime half of :mod:`metrics_tpu.observe` emits — DESIGN §19) and renders a
-fleet health report: occupancy, dispatch economy, WAL durability lag,
+fleet health report: occupancy, dispatch economy (compile attribution plus
+the annotated explicit host↔device transfer counters hotlint's
+intentional-transfer sites emit — DESIGN §24), WAL durability lag,
 quarantine count, tenant cost attribution (DESIGN §23), per-bucket memory
 ledgers, and per-phase DDSketch latency quantiles. With two snapshots it
 diffs them — counter families become rates over the snapshots' series-time
@@ -154,11 +156,16 @@ def build_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) ->
 
     compiles = None
     explains = snap.get("counters", {}).get("compile_explain") or {}
-    if explains:
+    # annotated explicit host↔device transfers (hotlint intentional-transfer
+    # sites: wave assembly, expiry slice, WAL journal, ...) ride the compiles
+    # section — both are dispatch-economy signals
+    transfers = snap.get("counters", {}).get("explicit_transfer") or {}
+    if explains or transfers:
         compiles = {
             "attributed": sum(explains.values()),
             "causes": dict(sorted((snap.get("counters", {}).get("compile_cause") or {}).items())),
             "caches": {cache: explains[cache] for cache in sorted(explains)},
+            "transfers": {site: transfers[site] for site in sorted(transfers)},
             "recent": [
                 {"cache": e.get("cache"), "label": e.get("label"), "cause": e.get("cause")}
                 for e in (snap.get("events") or [])
@@ -381,6 +388,9 @@ def render_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) -
             lines.append(
                 f"  {e.get('cache') or '?'}:{e.get('label') or '?'}  cause={e.get('cause') or '?'}"
             )
+        if co.get("transfers"):
+            site_str = ", ".join(f"{s}={n}" for s, n in co["transfers"].items())
+            lines.append(f"transfers          {sum(co['transfers'].values())}  ({site_str})")
 
     if r["tenants"]:
         tn = r["tenants"]

@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""Lint CLI — jitlint + distlint + donlint analysis over metrics_tpu.
+"""Lint CLI — jitlint + distlint + donlint + hotlint analysis over metrics_tpu.
 
 Usage:
     python tools/lint_metrics.py [targets...]
-                                 [--pass jitlint|distlint|donlint|donation|aot|fleet|chaos|perf]
-                                 [--all] [--json] [--rules JL001,DL004,ML002]
+                                 [--pass jitlint|distlint|donlint|hotlint|donation|transfer|aot|fleet|chaos|perf]
+                                 [--all] [--json] [--rules JL001,DL004,ML002,HL005]
                                  [--update-baseline]
 
 Thin wrapper over :mod:`metrics_tpu.analysis.cli` so the tool works from a
