@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import neumaier_add, neumaier_value
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -183,14 +184,34 @@ class SumMetric(BaseAggregator):
     >>> metric.update(2.0)
     >>> float(metric.compute())
     3.0
+
+    ``compensated=True`` opts into Neumaier (improved-Kahan) accumulation: the
+    running sum carries a ``sum_value_comp`` residual state so the x32 error
+    stays O(eps) instead of O(n*eps) on long adversarial streams (numlint
+    NL004 / DESIGN §25). Both states merge by "sum", so cross-shard folds and
+    fleet contracts are unchanged — the residuals add just like the totals.
     """
 
-    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+    def __init__(self, nan_strategy: Union[str, float] = "warn", compensated: bool = False, **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="sum_value", **kwargs)
+        self.compensated = bool(compensated)
+        if self.compensated:
+            self._precision["sum_value"] = "compensated"
+            self.add_state("sum_value_comp", default=jnp.asarray(0.0), dist_reduce_fx="sum", precision="compensated")
 
     def update(self, value: Union[float, Array]) -> None:
         value, _, keep = self._cast_and_nan_check_input(value)
-        self.sum_value = self.sum_value + jnp.sum(jnp.where(keep, value, 0.0))
+        batch = jnp.sum(jnp.where(keep, value, 0.0))
+        if self.compensated:
+            self.sum_value, self.sum_value_comp = neumaier_add(self.sum_value, self.sum_value_comp, batch)
+        else:
+            self.sum_value = self.sum_value + batch
+
+    def compute(self) -> Array:
+        """Aggregated value; folds the Neumaier residual back in when compensated."""
+        if self.compensated:
+            return neumaier_value(self.sum_value, self.sum_value_comp)
+        return super().compute()
 
 
 class CatMetric(BaseAggregator):
@@ -222,22 +243,36 @@ class MeanMetric(BaseAggregator):
     >>> metric.update(3.0)
     >>> float(metric.compute())
     2.0
+
+    ``compensated=True`` opts into Neumaier accumulation of the weighted-value
+    sum (``mean_value_comp`` residual state; see :class:`SumMetric`). The
+    weight sum stays plain — it grows by O(1) per update and is not the term
+    that cancels.
     """
 
-    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+    def __init__(self, nan_strategy: Union[str, float] = "warn", compensated: bool = False, **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="mean_value", **kwargs)
         self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.compensated = bool(compensated)
+        if self.compensated:
+            self._precision["mean_value"] = "compensated"
+            self.add_state("mean_value_comp", default=jnp.asarray(0.0), dist_reduce_fx="sum", precision="compensated")
 
     def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
         """Update state with data; ``weight`` is broadcast to ``value``'s shape."""
         value, weight, keep = self._cast_and_nan_check_input(value, weight)
-        self.mean_value = self.mean_value + jnp.sum(jnp.where(keep, value * weight, 0.0))
+        batch = jnp.sum(jnp.where(keep, value * weight, 0.0))
+        if self.compensated:
+            self.mean_value, self.mean_value_comp = neumaier_add(self.mean_value, self.mean_value_comp, batch)
+        else:
+            self.mean_value = self.mean_value + batch
         self.weight = self.weight + jnp.sum(jnp.where(keep, weight, 0.0))
 
     def compute(self) -> Array:
         from metrics_tpu.utils.compute import _safe_divide
 
-        return _safe_divide(self.mean_value, self.weight)
+        value = neumaier_value(self.mean_value, self.mean_value_comp) if self.compensated else self.mean_value
+        return _safe_divide(value, self.weight)
 
 
 from metrics_tpu.wrappers.running import Running  # noqa: E402  (bottom import avoids a cycle at package init)

@@ -1,6 +1,6 @@
-"""Static & dynamic analysis for metrics_tpu: jitlint + distlint + donlint + hotlint.
+"""Static & dynamic analysis for metrics_tpu: jitlint + distlint + donlint + hotlint + numlint.
 
-Eight complementary passes guard the invariants the runtime cannot check:
+Ten complementary passes guard the invariants the runtime cannot check:
 
 * **jitlint AST pass** (:mod:`metrics_tpu.analysis.rules`, rules JL001–JL006)
   flags tracer concretization, recompilation keys, state-contract breaches,
@@ -42,15 +42,30 @@ Eight complementary passes guard the invariants the runtime cannot check:
   ``StreamEngine``/``ShardedStreamEngine`` churn tick — runs under
   ``jax.transfer_guard("disallow")``; static rule, declared annotation and
   guard outcome must agree.
+* **numlint AST pass** (:mod:`metrics_tpu.analysis.num_rules`, rules
+  NL001–NL006) flags numerical-soundness hazards: unguarded traced division,
+  catastrophic E[x²]−E[x]² cancellation, unclamped log/exp/sqrt/power domain
+  edges, narrow pinned accumulators on unbounded streams, dtype demotion in
+  state folds, and float reassociation claims without a declared tolerance
+  (DESIGN §25).
+* the **precision-contract harness**
+  (:mod:`metrics_tpu.analysis.precision_contracts`) proves numlint's verdicts
+  at runtime: every jit-eligible class replays the same stream through the
+  x32 jitted path and a float64 eager oracle — plus adversarial large-offset,
+  long-horizon, cancellation, 2^31-overflow and decay regimes — and the
+  static rule, the declared per-state ``precision=`` contract and the
+  observed drift must agree.
 
 CLI: ``python tools/lint_metrics.py [--pass <name> | --all] [--json]`` or the
-``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` console scripts.
+``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` / ``numlint`` console
+scripts.
 """
 
 from metrics_tpu.analysis.contexts import (
     DIST_RULE_CODES,
     LINT_PREFIXES,
     MEM_RULE_CODES,
+    NUM_RULE_CODES,
     RULE_CODES,
     SYNC_RULE_CODES,
     Suppressions,
@@ -69,6 +84,7 @@ from metrics_tpu.analysis.engine import (
     write_baseline_section,
 )
 from metrics_tpu.analysis.mem_rules import MEM_RULES
+from metrics_tpu.analysis.num_rules import NUM_RULES, classify_precision
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 from metrics_tpu.analysis.sync_rules import SYNC_RULES
 
@@ -81,12 +97,15 @@ __all__ = [
     "MEM_RULES",
     "MEM_RULE_CODES",
     "ModuleInfo",
+    "NUM_RULES",
+    "NUM_RULE_CODES",
     "RULE_CODES",
     "SYNC_RULES",
     "SYNC_RULE_CODES",
     "SourceMarkers",
     "Suppressions",
     "Violation",
+    "classify_precision",
     "diff_against_baseline",
     "lint_file",
     "lint_paths",

@@ -1,6 +1,6 @@
-"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` / ``chaoslint``.
+"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` / ``numlint`` / ``chaoslint``.
 
-Four static passes share one engine and one exit-code contract:
+Five static passes share one engine and one exit-code contract:
 
 * ``jitlint``  — tracer-safety & recompilation rules JL001–JL006, baselined in
   ``tools/jitlint_baseline.json``
@@ -10,8 +10,12 @@ Four static passes share one engine and one exit-code contract:
   ``tools/donlint_baseline.json``
 * ``hotlint``  — host-sync & dispatch-economy rules HL001–HL006 over the
   hot-path modules, baselined in ``tools/hotlint_baseline.json``
+* ``numlint``  — numerical-soundness rules NL001–NL006 (unguarded division,
+  catastrophic cancellation, domain-edge math, narrow accumulators, fold
+  demotion, undeclared reassociation tolerance), baselined in the ``rules``
+  section of ``tools/numlint_baseline.json`` (expected empty)
 
-Six dynamic passes ride the same selection/exit-code contract:
+Seven dynamic passes ride the same selection/exit-code contract:
 
 * ``donation`` — 3-step donate-enabled update loops cross-checking static
   donlint verdicts, ``costs.py`` eligibility, and runtime buffer deletion
@@ -22,6 +26,13 @@ Six dynamic passes ride the same selection/exit-code contract:
   declared jit eligibility, and the runtime guard outcome
   (:mod:`metrics_tpu.analysis.transfer_contracts`), disagreements baselined in
   the ``transfer`` section of ``tools/hotlint_baseline.json`` (expected empty)
+* ``precision`` — adversarial numerical regimes per jit-eligible registry
+  class: x32 streams vs an x64 oracle, large-offset data, near-2^31 counter
+  injection and long-horizon decay folds, cross-checking static numlint
+  verdicts, declared ``precision=`` tolerances, and the measured runtime
+  error (:mod:`metrics_tpu.analysis.precision_contracts`), disagreements
+  baselined in the ``precision`` section of ``tools/numlint_baseline.json``
+  (expected empty)
 * ``aot`` — AOT executable-cache round trips per registry class: serialize →
   fresh-cache-dir reload with zero compiles → bit-exact update/compute vs a
   freshly traced oracle (:mod:`metrics_tpu.analysis.aot_contracts`),
@@ -62,6 +73,7 @@ from typing import Dict, List, Optional, Sequence
 from metrics_tpu.analysis.contexts import (
     DIST_RULE_CODES,
     MEM_RULE_CODES,
+    NUM_RULE_CODES,
     RULE_CODES,
     SYNC_RULE_CODES,
 )
@@ -72,8 +84,11 @@ from metrics_tpu.analysis.engine import (
     write_baseline,
 )
 
-__all__ = ["main", "main_chaoslint", "main_distlint", "main_donlint", "main_hotlint"]
+__all__ = ["main", "main_chaoslint", "main_distlint", "main_donlint", "main_hotlint", "main_numlint"]
 
+# "section" names the baseline-JSON section the static pass owns; the default
+# is the historical "entries" (numlint shares its document with the precision
+# harness, so its static section is the more specific "rules").
 _PASSES: Dict[str, Dict[str, object]] = {
     "jitlint": {
         "rules": RULE_CODES,
@@ -91,17 +106,23 @@ _PASSES: Dict[str, Dict[str, object]] = {
         "rules": SYNC_RULE_CODES,
         "baseline": os.path.join("tools", "hotlint_baseline.json"),
     },
+    "numlint": {
+        "rules": NUM_RULE_CODES,
+        "baseline": os.path.join("tools", "numlint_baseline.json"),
+        "section": "rules",
+    },
 }
 
 # dynamic passes: no rule codes, run programs instead of parsing them.
 # Ordered cheap-first for --all (telemetry is one compile + ~1k tiny steps,
 # donation ~10s of tiny CPU jits, transfer re-runs the registry's update
-# loops plus two fleet ticks under transfer_guard, aot compiles each
-# cacheable class twice — once AOT to disk, once as the fresh oracle —
-# fleet churns a 4-slot StreamEngine bucket per class, chaos injects the
-# full fault suite per class, perf lowers the whole registry + runs the
-# fleet smoke).
-_DYNAMIC = ("telemetry", "donation", "transfer", "aot", "fleet", "chaos", "perf")
+# loops plus two fleet ticks under transfer_guard, precision runs each
+# jit-eligible class twice — an x32 stream and an x64 oracle — plus the
+# named adversarial regimes, aot compiles each cacheable class twice —
+# once AOT to disk, once as the fresh oracle — fleet churns a 4-slot
+# StreamEngine bucket per class, chaos injects the full fault suite per
+# class, perf lowers the whole registry + runs the fleet smoke).
+_DYNAMIC = ("telemetry", "donation", "transfer", "precision", "aot", "fleet", "chaos", "perf")
 
 
 def _dynamic_runner(name: str):
@@ -131,6 +152,10 @@ def _dynamic_runner(name: str):
         from metrics_tpu.analysis.transfer_contracts import run_transfer_check  # noqa: PLC0415
 
         return run_transfer_check
+    if name == "precision":
+        from metrics_tpu.analysis.precision_contracts import run_precision_check  # noqa: PLC0415
+
+        return run_precision_check
     from metrics_tpu.analysis.donation_contracts import run_donation_check  # noqa: PLC0415
 
     return run_donation_check
@@ -142,8 +167,9 @@ def _build_parser() -> argparse.ArgumentParser:
         description="Static analysis for metrics_tpu: jitlint (JL001-JL006, tracer safety), "
                     "distlint (DL001-DL005, distributed merge soundness), donlint "
                     "(ML001-ML006, donated-buffer escape/alias safety), hotlint "
-                    "(HL001-HL006, host-sync & dispatch economy), the donation and "
-                    "transfer-guard cross-checks, and the perf cost-baseline check.",
+                    "(HL001-HL006, host-sync & dispatch economy), numlint "
+                    "(NL001-NL006, numerical soundness), the donation, transfer-guard "
+                    "and precision cross-checks, and the perf cost-baseline check.",
     )
     p.add_argument("targets", nargs="*", default=["metrics_tpu"],
                    help="files or directories to lint (default: metrics_tpu)")
@@ -153,8 +179,8 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
                    help="run every pass (jitlint + distlint + donlint + hotlint "
-                        "+ telemetry + donation + transfer + aot + fleet + chaos "
-                        "+ perf) in one invocation")
+                        "+ numlint + telemetry + donation + transfer + precision "
+                        "+ aot + fleet + chaos + perf) in one invocation")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")
@@ -250,14 +276,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
 
         baseline_path = args.baseline or os.path.join(root, str(_PASSES[name]["baseline"]))
+        section = str(_PASSES[name].get("section", "entries"))
         if args.update_baseline:
-            entries = write_baseline(baseline_path, result.violations)
+            entries = write_baseline(baseline_path, result.violations, section=section)
             if not args.quiet:
                 print(f"{name}: baseline written to {baseline_path} "
                       f"({len(entries)} keys, {sum(entries.values())} violations)")
             continue
 
-        baseline = {} if args.no_baseline else load_baseline(baseline_path)
+        baseline = {} if args.no_baseline else load_baseline(baseline_path, section=section)
         new, baselined, stale = diff_against_baseline(result.violations, baseline)
 
         if args.fmt == "json":
@@ -315,6 +342,12 @@ def main_hotlint(argv: Optional[Sequence[str]] = None) -> int:
     """Entry point for the ``hotlint`` console script — HL rules + transfer-guard cross-check."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(["--pass", "hotlint", "--pass", "transfer", *argv])
+
+
+def main_numlint(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for the ``numlint`` console script — NL rules + precision cross-check."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(["--pass", "numlint", "--pass", "precision", *argv])
 
 
 def main_chaoslint(argv: Optional[Sequence[str]] = None) -> int:

@@ -39,19 +39,22 @@ __all__ = [
     "DIST_RULE_CODES",
     "MEM_RULE_CODES",
     "SYNC_RULE_CODES",
+    "NUM_RULE_CODES",
 ]
 
 RULE_CODES = ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006")
 DIST_RULE_CODES = ("DL001", "DL002", "DL003", "DL004", "DL005")
 MEM_RULE_CODES = ("ML001", "ML002", "ML003", "ML004", "ML005", "ML006")
 SYNC_RULE_CODES = ("HL001", "HL002", "HL003", "HL004", "HL005", "HL006")
+NUM_RULE_CODES = ("NL001", "NL002", "NL003", "NL004", "NL005", "NL006")
 
 # `# jitlint: disable=JL001`, `# distlint: disable=DL002`, `# donlint:
-# disable=ML003` and `# hotlint: disable=HL001` share one grammar; any prefix
-# may carry codes from any pass (codes are globally unique). A new pass
-# registers its prefix here ONCE and both suppression forms — per-line and
-# file-wide — work for it; nothing else needs a parser.
-LINT_PREFIXES = ("jitlint", "distlint", "donlint", "hotlint")
+# disable=ML003`, `# hotlint: disable=HL001` and `# numlint: disable=NL004`
+# share one grammar; any prefix may carry codes from any pass (codes are
+# globally unique). A new pass registers its prefix here ONCE and both
+# suppression forms — per-line and file-wide — work for it; nothing else
+# needs a parser.
+LINT_PREFIXES = ("jitlint", "distlint", "donlint", "hotlint", "numlint")
 _PREFIX_ALT = "|".join(LINT_PREFIXES)
 _SUPPRESS_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable=([A-Za-z0-9_,\s]+)")
 _SUPPRESS_FILE_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable-file=([A-Za-z0-9_,\s]+)")

@@ -27,6 +27,7 @@ from metrics_tpu.analysis.contexts import (
 )
 from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.mem_rules import MEM_RULES
+from metrics_tpu.analysis.num_rules import NUM_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 from metrics_tpu.analysis.sync_rules import SYNC_RULES
 from metrics_tpu.utils.io import atomic_write_text
@@ -45,7 +46,7 @@ __all__ = [
 
 # one registry across all passes; rule codes are globally unique so a
 # ``--rules JL001,DL004,ML002`` mix selects freely across them
-_REGISTRY = {**ALL_RULES, **DIST_RULES, **MEM_RULES, **SYNC_RULES}
+_REGISTRY = {**ALL_RULES, **DIST_RULES, **MEM_RULES, **SYNC_RULES, **NUM_RULES}
 
 
 class SourceMarkers:
@@ -239,15 +240,15 @@ def write_baseline_section(
     return values
 
 
-def load_baseline(path: str) -> Dict[str, int]:
-    return {str(k): int(v) for k, v in load_baseline_section(path, "entries").items()}  # type: ignore[arg-type]
+def load_baseline(path: str, section: str = "entries") -> Dict[str, int]:
+    return {str(k): int(v) for k, v in load_baseline_section(path, section).items()}  # type: ignore[arg-type]
 
 
-def write_baseline(path: str, violations: Sequence[Violation]) -> Dict[str, int]:
+def write_baseline(path: str, violations: Sequence[Violation], section: str = "entries") -> Dict[str, int]:
     entries = dict(sorted(Counter(v.key() for v in violations).items()))
     write_baseline_section(
         path,
-        "entries",
+        section,
         entries,  # type: ignore[arg-type]
         "lint baseline — intentional exceptions, keyed path::rule::context. "
         "Regenerate with `python tools/lint_metrics.py --update-baseline`.",

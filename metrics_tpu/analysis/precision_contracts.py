@@ -1,0 +1,562 @@
+"""Dynamic precision-contract harness: numlint's verdicts, proven against an x64 oracle.
+
+For every jit-eligible class in the profile registry this replays the same
+stream twice — once through the production path (x32, jitted update) and once
+through a float64 *eager* oracle (``jax.experimental.enable_x64`` with the jit
+dispatch forced off) — and cross-checks three independent verdicts on the same
+question: *does this class's accumulation stay numerically sound over the
+stream, or does it silently drift?*
+
+1. **static** — :func:`metrics_tpu.analysis.num_rules.classify_precision`,
+   read off the class hierarchy's source (cancellation patterns, narrow pinned
+   accumulators, fold demotion, undeclared reassociation);
+2. **declared** — the per-state ``precision=`` contracts registered through
+   :meth:`Metric.add_state` (``"compensated"``, a ``{"horizon": ...}`` bound,
+   an ``rtol``): the class's own claim about where its arithmetic is allowed
+   to lose;
+3. **runtime** — what actually happened: the relative error of the x32
+   production result against the x64 oracle on bit-identical input data.
+
+A clean class must be stable (``DRIFT`` needs a declared contract that bounds
+it; a static hazard needs a declaration that acknowledges it). On top of the
+registry sweep, five *adversarial regimes* drive the exact failure modes the
+static rules exist for — large-offset means, long-horizon sums above the f32
+ulp, catastrophic variance cancellation, counter overflow at the 2^31
+boundary, and long-horizon decay folds — including the acceptance criterion
+that the compensated (Neumaier) path tightens the large-offset error by at
+least 10^3x over the plain f32 fold.
+
+Disagreements are baselined in the ``precision`` section of
+``tools/numlint_baseline.json`` (expected empty; every entry needs a
+justification string). Runs as the ``precision`` pass of ``tools/lint_metrics
+--all`` and standalone via ``python -m metrics_tpu.analysis.precision_contracts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "PrecisionResult",
+    "check_precision_case",
+    "check_regime",
+    "collect_precision_report",
+    "diff_precision_baseline",
+    "precision_cases",
+    "main",
+    "run_precision_check",
+]
+
+_DEFAULT_BASELINE = os.path.join("tools", "numlint_baseline.json")
+_STEPS = 4  # stream length of the registry sweep (per leg)
+# x32-vs-x64 stability tolerance for the registry sweep: far above honest f32
+# roundoff on a 4-batch stream, far below the O(1) relative error of a
+# catastrophic cancellation or a wrapped counter
+_TOL = 5e-2
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionResult:
+    name: str
+    static_clean: bool
+    static_detail: str  # hazard list when dirty
+    declared: str  # comma-joined states with a precision= contract ("" = none)
+    runtime: str  # STABLE | DRIFT:<relerr> | ERROR:<why>
+    agree: bool
+    detail: str = ""
+
+    def render(self) -> str:
+        mark = "ok " if self.agree else "DISAGREE"
+        return (
+            f"{mark} {self.name}: static={'clean' if self.static_clean else 'hazard'} "
+            f"declared={self.declared or '-'} runtime={self.runtime}"
+            + (f" ({self.detail})" if self.detail else "")
+        )
+
+
+def precision_cases() -> List[Any]:
+    """The jit-eligible slice of the profile registry (donation's gate, reused)."""
+    from metrics_tpu.analysis.donation_contracts import donation_cases
+
+    return donation_cases()
+
+
+# ------------------------------------------------------------------ streams
+def _host_batches(case: Any, n: int) -> List[Tuple[Any, ...]]:
+    """``n`` batches as host numpy — the single source both regimes replay."""
+    import numpy as np
+
+    from metrics_tpu.observe.costs import _rng
+
+    rng = _rng(case)
+    out = []
+    for _ in range(n):
+        out.append(
+            tuple(np.asarray(a) if hasattr(a, "shape") else a for a in case.batch(rng))
+        )
+    return out
+
+
+def _widen_batch(batch: Tuple[Any, ...]) -> Tuple[Any, ...]:
+    """Upcast float args to f64 for the oracle leg (exact: f32 ⊂ f64)."""
+    import numpy as np
+
+    out = []
+    for a in batch:
+        if hasattr(a, "shape") and np.issubdtype(np.asarray(a).dtype, np.floating):
+            out.append(np.asarray(a, dtype=np.float64))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def _leaves(value: Any) -> List[Any]:
+    import jax
+    import numpy as np
+
+    return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(value)]
+
+
+def _max_rel_err(oracle: Sequence[Any], probe: Sequence[Any]) -> float:
+    """Max elementwise relative error of ``probe`` against ``oracle`` leaves."""
+    import numpy as np
+
+    if len(oracle) != len(probe):
+        raise ValueError(f"compute pytrees differ: {len(oracle)} vs {len(probe)} leaves")
+    worst = 0.0
+    for a, b in zip(oracle, probe):
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
+        if a.shape != b.shape:
+            raise ValueError(f"compute leaf shapes differ: {a.shape} vs {b.shape}")
+        both_nan = np.isnan(a) & np.isnan(b)
+        one_nan = np.isnan(a) ^ np.isnan(b)
+        if one_nan.any():
+            return math.inf
+        mask = ~both_nan
+        if not mask.any():
+            continue
+        err = np.abs(a[mask] - b[mask]) / np.maximum(np.abs(a[mask]), 1e-6)
+        worst = max(worst, float(err.max()) if err.size else 0.0)
+    return worst
+
+
+def _run_stream(ctor: Any, batches: Sequence[Tuple[Any, ...]], x64: bool) -> List[Any]:
+    """Replay ``batches`` through a fresh metric; returns compute() leaves.
+
+    ``x64=False`` is the production leg: jitted update under the default x32
+    regime. ``x64=True`` is the oracle: ``enable_x64`` with the jit dispatch
+    forced off, so every intermediate is eager f64.
+    """
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.metric import clear_jit_cache
+
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    try:
+        if x64:
+            metric_mod._JIT_UPDATE_DEFAULT = False
+            with enable_x64():
+                m = ctor()
+                for batch in batches:
+                    m.update(*(jnp.asarray(a) if hasattr(a, "shape") else a
+                               for a in _widen_batch(batch)))
+                return _leaves(m.compute())
+        metric_mod._JIT_UPDATE_DEFAULT = True
+        clear_jit_cache()
+        m = ctor()
+        for batch in batches:
+            m.update(*(jnp.asarray(a) if hasattr(a, "shape") else a for a in batch))
+        return _leaves(m.compute())
+    finally:
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+
+
+def _declared_contracts(m: Any) -> str:
+    return ",".join(sorted(n for n, v in getattr(m, "_precision", {}).items() if v))
+
+
+def _agreement(static_clean: bool, declared: str, runtime: str) -> bool:
+    """The three-way contract: hazards and drift both need a declaration."""
+    if runtime.startswith("ERROR"):
+        return False
+    if not static_clean and not declared:
+        return False  # statically visible hazard nobody owns
+    if runtime == "STABLE":
+        return True
+    return bool(declared)  # observed drift must be covered by a contract
+
+
+def check_precision_case(case: Any) -> PrecisionResult:
+    """One class: x32-jitted stream vs x64-eager oracle; never raises."""
+    from metrics_tpu.analysis.num_rules import classify_precision
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    try:
+        m = case.ctor()
+        static_clean, static_detail = classify_precision(type(m))
+        declared = _declared_contracts(m)
+        batches = _host_batches(case, _STEPS)
+        oracle = _run_stream(case.ctor, batches, x64=True)
+        probe = _run_stream(case.ctor, batches, x64=False)
+        err = _max_rel_err(oracle, probe)
+        runtime = "STABLE" if err <= _TOL else f"DRIFT:{err:.1e}"
+        detail = f"relerr={err:.1e}" if err > 0 else ""
+    except Exception as exc:  # noqa: BLE001 — every failure is a reportable verdict
+        return PrecisionResult(
+            case.name, False, "", "", f"ERROR:{type(exc).__name__}", False, str(exc)[:200]
+        )
+    finally:
+        clear_jit_cache()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+    return PrecisionResult(
+        case.name, static_clean, static_detail, declared, runtime,
+        _agreement(static_clean, declared, runtime), detail,
+    )
+
+
+# ----------------------------------------------------------------- regimes
+def _regime_mean_large_offset() -> Tuple[str, str]:
+    """Mean at offset 1e8, variance 1e-2: compensated must beat plain by >= 1e3x.
+
+    This is the acceptance criterion: on the adversarial large-offset stream
+    the Neumaier path's error against the f64 oracle is at least three orders
+    of magnitude below the plain f32 fold's.
+    """
+    import numpy as np
+
+    from metrics_tpu.aggregation import MeanMetric
+
+    rng = np.random.RandomState(0x5EED)
+    batches = [
+        (np.float32(1e8 + rng.standard_normal(32) * 1e-1),) for _ in range(512)
+    ]
+    oracle = float(np.mean(np.concatenate([np.float64(b[0]) for b in batches])))
+    plain = _run_stream(lambda: MeanMetric(nan_strategy="disable"), batches, x64=False)
+    comp = _run_stream(
+        lambda: MeanMetric(nan_strategy="disable", compensated=True), batches, x64=False
+    )
+    err_plain = abs(float(plain[0]) - oracle) / abs(oracle)
+    err_comp = abs(float(comp[0]) - oracle) / abs(oracle)
+    ratio = err_plain / max(err_comp, 1e-18)
+    detail = f"plain={err_plain:.1e} compensated={err_comp:.1e} ratio={ratio:.1e}"
+    if err_comp < 1e-7 or ratio >= 1e3:
+        return "STABLE", detail
+    return f"DRIFT:{err_comp:.1e}", detail + " (ratio < 1e3)"
+
+
+def _regime_sum_long_horizon() -> Tuple[str, str]:
+    """Sum far above the f32 ulp: plain drops every small add, Neumaier keeps them."""
+    import numpy as np
+
+    from metrics_tpu.aggregation import SumMetric
+
+    n = 2048  # 2048 adds of 1.0 on a 1e8 total: each one is below ulp(1e8)=8
+    batches = [(np.float32(1e8),)] + [(np.float32(1.0),) for _ in range(n)]
+    oracle = 1e8 + float(n)
+    plain = _run_stream(lambda: SumMetric(nan_strategy="disable"), batches, x64=False)
+    comp = _run_stream(
+        lambda: SumMetric(nan_strategy="disable", compensated=True), batches, x64=False
+    )
+    err_plain = abs(float(plain[0]) - oracle) / oracle
+    err_comp = abs(float(comp[0]) - oracle) / oracle
+    detail = f"plain={err_plain:.1e} compensated={err_comp:.1e}"
+    if err_comp < 1e-7 and err_comp < err_plain:
+        return "STABLE", detail
+    return f"DRIFT:{err_comp:.1e}", detail
+
+
+def _regime_variance_cancellation() -> Tuple[str, str]:
+    """ExplainedVariance at offset 1e8: Welford must track the x64 oracle.
+
+    The single-pass E[x^2]-E[x]^2 form this class used to carry loses every
+    significant digit here (NL002); the shifted/Welford states keep the
+    score finite and close to the oracle.
+    """
+    import numpy as np
+
+    from metrics_tpu.regression import ExplainedVariance
+
+    rng = np.random.RandomState(0xCA11)
+    batches = []
+    for _ in range(64):
+        target = 1e8 + rng.standard_normal(64) * 1e-1
+        preds = target + rng.standard_normal(64) * 1e-2
+        batches.append((np.float32(preds), np.float32(target)))
+    oracle = _run_stream(ExplainedVariance, batches, x64=True)
+    probe = _run_stream(ExplainedVariance, batches, x64=False)
+    err = _max_rel_err(oracle, probe)
+    finite = bool(np.isfinite(np.asarray(probe[0])).all())
+    detail = f"relerr={err:.1e} score={float(np.asarray(probe[0])):.4f}"
+    if finite and err <= 1e-2:
+        return "STABLE", detail
+    return f"DRIFT:{err:.1e}", detail
+
+
+def _regime_counter_overflow() -> Tuple[str, str]:
+    """Counters injected at 2^31 - 3 must cross the boundary without wrapping.
+
+    Under the x64 regime every ``count_dtype()`` state is int64, so one more
+    update past 2^31 increments exactly; a still-int32 counter would wrap
+    negative — the satellite-1 regression this regime pins.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import enable_x64
+
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix
+
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    try:
+        metric_mod._JIT_UPDATE_DEFAULT = False
+        with enable_x64():
+            m = BinaryConfusionMatrix(normalize=None, validate_args=False)
+            if m.confmat.dtype != jnp.int64:
+                return (
+                    f"DRIFT:dtype={m.confmat.dtype}",
+                    "confmat not int64 under x64 — counter still pinned narrow",
+                )
+            seed = 2**31 - 3
+            m.__dict__["_state"]["confmat"] = jnp.full((2, 2), seed, dtype=jnp.int64)  # donlint: disable=ML001 — jit is forced off for this probe; the spliced buffer is never donated
+            preds = jnp.asarray(np.array([0, 1, 1, 0, 1, 0, 1, 1]))
+            target = jnp.asarray(np.array([0, 1, 0, 0, 1, 1, 1, 0]))
+            m.update(preds, target)
+            out = np.asarray(m.confmat, dtype=np.int64)
+            total = int(out.sum())
+            expected = 4 * seed + int(preds.shape[0])
+            detail = f"max_cell={int(out.max())} total-4*seed={total - 4 * seed}"
+            if (out >= seed).all() and total == expected and int(out.max()) >= 2**31:
+                return "STABLE", detail
+            return "DRIFT:wrapped", detail
+    finally:
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+
+
+def _regime_decay_long_horizon() -> Tuple[str, str]:
+    """Long-horizon decay fold on a large total: compensated tracks the oracle.
+
+    A coarse stream clock (the timestamp advances every 256 observations, as a
+    second-resolution clock does under load) makes the dominant error the adds
+    the plain f32 fold drops below ulp(total) — exactly what the Neumaier
+    residual recovers; the handful of actual decay rescales contribute only
+    O(ulp) multiply rounding to both paths.
+    """
+    import numpy as np
+
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.windows import TimeDecayed
+
+    half_life = 1e4
+    n = 2048
+    batches = [(np.float32(0.0), np.float32(1e8))] + [
+        (np.float32(float(i // 256)), np.float32(1.0)) for i in range(1, n + 1)
+    ]
+
+    def _ctor(compensated: bool) -> Any:
+        return lambda: TimeDecayed(
+            SumMetric(nan_strategy="disable"), half_life_s=half_life, compensated=compensated
+        )
+
+    oracle = _run_stream(_ctor(False), batches, x64=True)
+    plain = _run_stream(_ctor(False), batches, x64=False)
+    comp = _run_stream(_ctor(True), batches, x64=False)
+    ref = float(oracle[0])
+    err_plain = abs(float(plain[0]) - ref) / abs(ref)
+    err_comp = abs(float(comp[0]) - ref) / abs(ref)
+    detail = f"plain={err_plain:.1e} compensated={err_comp:.1e}"
+    if err_comp <= 1e-5 and err_comp <= err_plain:
+        return "STABLE", detail
+    return f"DRIFT:{err_comp:.1e}", detail
+
+
+_REGIMES = {
+    "regime:mean_large_offset": _regime_mean_large_offset,
+    "regime:sum_long_horizon": _regime_sum_long_horizon,
+    "regime:variance_cancellation": _regime_variance_cancellation,
+    "regime:counter_overflow": _regime_counter_overflow,
+    "regime:decay_long_horizon": _regime_decay_long_horizon,
+}
+
+# the classes each regime exercises, for the static + declared legs
+_REGIME_SUBJECTS = {
+    "regime:mean_large_offset": lambda: __import__(
+        "metrics_tpu.aggregation", fromlist=["MeanMetric"]
+    ).MeanMetric(nan_strategy="disable", compensated=True),
+    "regime:sum_long_horizon": lambda: __import__(
+        "metrics_tpu.aggregation", fromlist=["SumMetric"]
+    ).SumMetric(nan_strategy="disable", compensated=True),
+    "regime:variance_cancellation": lambda: __import__(
+        "metrics_tpu.regression", fromlist=["ExplainedVariance"]
+    ).ExplainedVariance(),
+    "regime:counter_overflow": lambda: __import__(
+        "metrics_tpu.classification.confusion_matrix", fromlist=["BinaryConfusionMatrix"]
+    ).BinaryConfusionMatrix(validate_args=False),
+    "regime:decay_long_horizon": lambda: __import__(
+        "metrics_tpu.windows", fromlist=["TimeDecayed"]
+    ).TimeDecayed(
+        __import__("metrics_tpu.aggregation", fromlist=["SumMetric"]).SumMetric(
+            nan_strategy="disable"
+        ),
+        half_life_s=1e5,
+        compensated=True,
+    ),
+}
+
+
+def check_regime(name: str) -> PrecisionResult:
+    """One adversarial regime through all three legs; never raises."""
+    from metrics_tpu.analysis.num_rules import classify_precision
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    try:
+        subject = _REGIME_SUBJECTS[name]()
+        static_clean, static_detail = classify_precision(type(subject))
+        declared = _declared_contracts(subject)
+        runtime, detail = _REGIMES[name]()
+    except Exception as exc:  # noqa: BLE001
+        return PrecisionResult(
+            name, False, "", "", f"ERROR:{type(exc).__name__}", False, str(exc)[:200]
+        )
+    finally:
+        clear_jit_cache()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+    return PrecisionResult(
+        name, static_clean, static_detail, declared, runtime,
+        _agreement(static_clean, declared, runtime), detail,
+    )
+
+
+def collect_precision_report(
+    root: str, cases: Optional[Sequence[Any]] = None
+) -> List[PrecisionResult]:
+    results = [
+        check_precision_case(c) for c in (cases if cases is not None else precision_cases())
+    ]
+    results.extend(check_regime(name) for name in _REGIMES)
+    return results
+
+
+# ------------------------------------------------------------------- baseline
+def load_precision_baseline(path: str) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    return {str(k): str(v) for k, v in load_baseline_section(path, "precision").items()}
+
+
+def write_precision_baseline(path: str, results: Sequence[PrecisionResult]) -> Dict[str, str]:
+    from metrics_tpu.analysis.engine import write_baseline_section
+
+    precision = {
+        r.name: f"UNJUSTIFIED: static={r.static_clean} declared={r.declared or '-'} runtime={r.runtime}"
+        for r in sorted(results, key=lambda r: r.name)
+        if not r.agree
+    }
+    write_baseline_section(
+        path,
+        "precision",
+        precision,  # type: ignore[arg-type]
+        "numlint baseline — static numerical-soundness exceptions under `rules` "
+        "(path::rule::context -> count), x64-oracle cross-check disagreements "
+        "under `precision` (case -> justification; expected empty). Regenerate with "
+        "`python tools/lint_metrics.py --pass numlint --pass precision --update-baseline`.",
+        seed={"rules": {}},
+    )
+    return precision
+
+
+def diff_precision_baseline(
+    results: Sequence[PrecisionResult], baseline: Dict[str, str]
+) -> Tuple[List[PrecisionResult], List[str]]:
+    """Split into (failures, stale_baseline_keys): unbaselined disagreements fail."""
+    failures = [r for r in results if not r.agree and r.name not in baseline]
+    observed = {r.name for r in results}
+    disagreeing = {r.name for r in results if not r.agree}
+    stale = sorted(
+        name for name in baseline if name not in disagreeing or name not in observed
+    )
+    return failures, stale
+
+
+def run_precision_check(
+    root: str,
+    baseline_path: Optional[str] = None,
+    update_baseline: bool = False,
+    quiet: bool = False,
+    report: Optional[Dict[str, Any]] = None,
+) -> int:
+    """The ``precision`` pass of ``lint_metrics --all``: oracle, cross-check, verdict."""
+    path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
+    results = collect_precision_report(root)
+    if update_baseline:
+        precision = write_precision_baseline(path, results)
+        if not quiet:
+            print(f"precision: baseline written to {path} ({len(precision)} disagreement(s))")
+        return 0
+    failures, stale = diff_precision_baseline(results, load_precision_baseline(path))
+    if report is not None:
+        # the caller owns stdout (one JSON document) — collect, don't print
+        report.update(
+            {
+                "cases": len(results),
+                "failures": [r.render() for r in failures],
+                "baselined": sum(1 for r in results if not r.agree) - len(failures),
+                "stale_baseline_keys": stale,
+                "runtime_verdicts": {r.name: r.runtime for r in results},
+            }
+        )
+        return 1 if failures else 0
+    for r in failures:
+        print(f"precision: {r.render()}")
+    if not quiet:
+        for key in stale:
+            print(f"precision: stale baseline entry: {key}")
+        agreed = sum(1 for r in results if r.agree)
+        stable = sum(1 for r in results if r.runtime == "STABLE")
+        print(
+            f"precision: {agreed}/{len(results)} cases agree "
+            f"({stable} oracle-stable at runtime), {len(failures)} failure(s), {len(stale)} stale"
+        )
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="precision-contracts",
+        description="Replay streams through the x32 jitted path and a float64 eager "
+        "oracle, cross-checking static numlint verdicts, declared precision= "
+        "contracts, and the observed drift — plus adversarial large-offset, "
+        "long-horizon, cancellation, overflow and decay regimes.",
+    )
+    p.add_argument("--root", default=None, help="repo root (default: cwd)")
+    p.add_argument("--baseline", default=None, help="numlint baseline JSON path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current disagreements as the new baseline and exit 0")
+    p.add_argument("-v", "--verbose", action="store_true", help="print every case verdict")
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the summary line")
+    args = p.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    if args.verbose:
+        for r in collect_precision_report(root):
+            print(r.render())
+    return run_precision_check(
+        root,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        quiet=args.quiet,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -20,6 +20,7 @@ from metrics_tpu.utils.imports import (
     _ONNXRUNTIME_AVAILABLE,
     _PESQ_AVAILABLE,
 )
+from metrics_tpu.utils.compute import count_dtype
 
 
 class _HostAudioMetric(Metric):
@@ -31,7 +32,7 @@ class _HostAudioMetric(Metric):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.add_state("sum_value", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def compute(self) -> Array:
         """Compute metric."""
@@ -284,7 +285,7 @@ class DeepNoiseSuppressionMeanOpinionScore(Metric):
         self.num_threads = num_threads
         self._sessions = None
         self.add_state("sum_dnsmos", jnp.zeros(4), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     # published DNSMOS P.835/P.808 calibration polynomials (highest degree first)
     _POLY_PERSONALIZED = {
@@ -369,7 +370,7 @@ class NonIntrusiveSpeechQualityAssessment(Metric):
         self.fs = fs
         self._session = None
         self.add_state("sum_nisqa", jnp.zeros(5), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     _FS = 48000  # the published model's native rate; 20 ms / 10 ms framing below
 

@@ -17,6 +17,7 @@ from metrics_tpu.functional.audio.metrics import (
     source_aggregated_signal_distortion_ratio,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 
 class _AveragedAudioMetric(Metric):
@@ -30,7 +31,7 @@ class _AveragedAudioMetric(Metric):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.add_state("sum_value", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def _metric(self, preds: Array, target: Array) -> Array:  # pragma: no cover - abstract
         raise NotImplementedError

@@ -27,6 +27,7 @@ from metrics_tpu.functional.classification.confusion_matrix import (
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.compute import count_dtype
 
 
 def _confusion_matrix_plot(self, val=None, ax=None, add_text: bool = True, labels=None, cmap=None):
@@ -82,7 +83,7 @@ class BinaryConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((2, 2), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -133,7 +134,7 @@ class MulticlassConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -186,7 +187,7 @@ class MultilabelConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""

@@ -15,6 +15,7 @@ from metrics_tpu.functional.classification.dice import (
     _dice_stats,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 __all__ = ["Dice"]
 
@@ -71,7 +72,7 @@ class Dice(Metric):
             # per-class axis survives samplewise averaging for average='none'/None
             score_shape = (num_classes,) if average in ("none", None) else ()
             self.add_state("score_sum", jnp.zeros(score_shape), dist_reduce_fx="sum")
-            self.add_state("n_samples", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("n_samples", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
         elif average == "micro":
             self.add_state("tp", jnp.zeros(()), dist_reduce_fx="sum")
             self.add_state("fp", jnp.zeros(()), dist_reduce_fx="sum")

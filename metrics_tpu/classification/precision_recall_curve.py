@@ -33,6 +33,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
@@ -107,7 +108,7 @@ class BinaryPrecisionRecallCurve(Metric):
         else:
             self.thresholds = thresholds
             self.add_state(
-                "confmat", default=jnp.zeros((len(thresholds), 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+                "confmat", default=jnp.zeros((len(thresholds), 2, 2), dtype=count_dtype()), dist_reduce_fx="sum"
             )
 
     def update(self, preds: Array, target: Array) -> None:
@@ -165,7 +166,7 @@ class MulticlassPrecisionRecallCurve(Metric):
         else:
             self.thresholds = thresholds
             shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
-            self.add_state("confmat", default=jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("confmat", default=jnp.zeros(shape, dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -224,7 +225,7 @@ class MultilabelPrecisionRecallCurve(Metric):
         else:
             self.thresholds = thresholds
             self.add_state(
-                "confmat", default=jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=jnp.int32),
+                "confmat", default=jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=count_dtype()),
                 dist_reduce_fx="sum",
             )
 

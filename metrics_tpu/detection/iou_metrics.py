@@ -15,6 +15,7 @@ from metrics_tpu.functional.detection.iou import (
     intersection_over_union,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 
 class IntersectionOverUnion(Metric):
@@ -59,7 +60,7 @@ class IntersectionOverUnion(Metric):
         self.class_metrics = class_metrics
         self.respect_labels = respect_labels
         self.add_state("iou_sum", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
         self._class_sums: Dict[int, List[float]] = {}
 
     def _to_xyxy(self, boxes: Array) -> Array:

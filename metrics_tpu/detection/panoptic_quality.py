@@ -15,6 +15,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 
 def _panoptic_stats(
@@ -123,9 +124,9 @@ class PanopticQuality(Metric):
         self._cat_index = {c: i for i, c in enumerate(cats)}
         n = len(cats)
         self.add_state("iou_sum", jnp.zeros(n), dist_reduce_fx="sum")
-        self.add_state("true_positives", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
-        self.add_state("false_positives", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
-        self.add_state("false_negatives", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("true_positives", jnp.zeros(n, dtype=count_dtype()), dist_reduce_fx="sum")
+        self.add_state("false_positives", jnp.zeros(n, dtype=count_dtype()), dist_reduce_fx="sum")
+        self.add_state("false_negatives", jnp.zeros(n, dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with panoptic label maps."""

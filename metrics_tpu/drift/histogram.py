@@ -9,6 +9,7 @@ from jax import Array
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import bincount
+from metrics_tpu.utils.compute import acc_dtype
 
 __all__ = ["KSDistance", "PSI"]
 
@@ -59,8 +60,8 @@ class _PairedHistogram(Metric):
         self.hi = float(hi)
         self.num_bins = int(num_bins)
         shape = (self.num_bins + 2,)
-        self.add_state("ref_counts", default=jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("live_counts", default=jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("ref_counts", default=jnp.zeros(shape, acc_dtype()), dist_reduce_fx="sum")
+        self.add_state("live_counts", default=jnp.zeros(shape, acc_dtype()), dist_reduce_fx="sum")
 
     def update(self, live: Array, reference: Array) -> None:
         self.live_counts = self.live_counts + _drift_histogram_delta(

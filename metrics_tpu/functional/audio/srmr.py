@@ -93,7 +93,7 @@ def _srmr_one(
     freqs = jnp.fft.rfftfreq(n, 1.0 / fs)  # (F,)
     q = 2.0
     f_safe = jnp.maximum(freqs[None, :], 1e-6)
-    resp = 1.0 / jnp.sqrt(1.0 + q**2 * (f_safe / mod_cfs[:, None] - mod_cfs[:, None] / f_safe) ** 2)  # (M, F)
+    resp = 1.0 / jnp.sqrt(1.0 + q**2 * (f_safe / mod_cfs[:, None] - mod_cfs[:, None] / f_safe) ** 2)  # (M, F)  # numlint: disable=NL001 — mod_cfs = min_cf*ratio**k > 0 by construction
     env_spec = jnp.fft.rfft(env, axis=-1)  # (B, F)
     mod_sig = jnp.fft.irfft(env_spec[:, None, :] * resp[None, :, :], n, axis=-1)  # (B, M, T)
 

@@ -102,13 +102,13 @@ def _binary_auroc_compute(
 
     max_area = jnp.asarray(max_fpr, dtype=fpr.dtype)
     stop = int(jnp.searchsorted(fpr, max_area, side="right"))
-    weight = (max_area - fpr[stop - 1]) / (fpr[stop] - fpr[stop - 1])
+    weight = (max_area - fpr[stop - 1]) / (fpr[stop] - fpr[stop - 1])  # numlint: disable=NL001 — searchsorted: fpr[stop] > max_fpr >= fpr[stop-1]
     interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
     tpr = jnp.concatenate([tpr[:stop], interp_tpr.reshape(1)])
     fpr = jnp.concatenate([fpr[:stop], max_area.reshape(1)])
     partial_auc = _auc_compute_without_check(fpr, tpr, 1.0)
     min_area = 0.5 * max_area**2
-    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))  # numlint: disable=NL001 — max_area - min_area = max_fpr*(1 - max_fpr/2) > 0 for 0 < max_fpr <= 1
 
 
 def binary_auroc(

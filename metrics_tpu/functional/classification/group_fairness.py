@@ -17,6 +17,7 @@ from metrics_tpu.functional.classification.stat_scores import (
     _binary_stat_scores_format,
     _binary_stat_scores_tensor_validation,
 )
+from metrics_tpu.utils.compute import _safe_divide
 from metrics_tpu.utils.exceptions import TraceIneligibleError
 from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.compute import _safe_divide
@@ -92,7 +93,8 @@ def binary_groups_stat_rates(
         preds, target, groups, num_groups, threshold, ignore_index, validate_args
     )
     stacked = jnp.stack([tp, fp, tn, fn]).astype(jnp.float32)  # (4, G)
-    rates = stacked / stacked.sum(axis=0, keepdims=True)
+    # a group with no samples has an all-zero column: 0/0 -> 0, not nan
+    rates = _safe_divide(stacked, stacked.sum(axis=0, keepdims=True))
     return {f"group_{g}": rates[:, g] for g in range(num_groups)}
 
 

@@ -68,7 +68,7 @@ def _binary_logauc_compute(
     upper_bound_idx = int(jnp.nonzero(log_fpr == bounds[1])[0][-1])
     trimmed_log_fpr = log_fpr[lower_bound_idx : upper_bound_idx + 1]
     trimmed_tpr = tpr[lower_bound_idx : upper_bound_idx + 1]
-    return _auc_compute_without_check(trimmed_log_fpr, trimmed_tpr, 1.0) / (bounds[1] - bounds[0])
+    return _auc_compute_without_check(trimmed_log_fpr, trimmed_tpr, 1.0) / (bounds[1] - bounds[0])  # numlint: disable=NL001 — fpr_range validated strictly increasing; log-width > 0
 
 
 def _reduce_logauc(

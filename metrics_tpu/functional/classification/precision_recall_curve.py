@@ -228,8 +228,8 @@ def _binary_precision_recall_curve_compute(
         return precision, recall, thresholds
 
     fps, tps, thres = _binary_clf_curve(state[0], state[1], pos_label=pos_label)
-    precision = tps / (tps + fps)
-    recall = tps / tps[-1]
+    precision = _safe_divide(tps, tps + fps)
+    recall = _safe_divide(tps, tps[-1])
     no_positives = (state[1] == pos_label).sum() == 0
     if not _is_traced(no_positives) and bool(no_positives):
         rank_zero_warn(

@@ -81,7 +81,7 @@ def _multilabel_ranking_average_precision_update(preds: Array, target: Array) ->
     le = neg[:, None, :] <= neg[:, :, None]
     rank_all = le.sum(-1).astype(jnp.float32)  # (N, L)
     rank_rel = (le & relevant[:, None, :]).sum(-1).astype(jnp.float32)
-    ratio = jnp.where(relevant, rank_rel / rank_all, 0.0)
+    ratio = jnp.where(relevant, rank_rel / rank_all, 0.0)  # numlint: disable=NL001 — rank_all >= 1: the le diagonal (self-comparison) is always True
     n_rel = relevant.sum(axis=1)
     score_i = jnp.where(
         (n_rel > 0) & (n_rel < num_labels),

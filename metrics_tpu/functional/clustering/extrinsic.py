@@ -208,7 +208,7 @@ def _expected_mutual_info(c: Array) -> Array:
             lo = int(max(1, a[i] + b[j] - n))
             hi = int(min(a[i], b[j]))
             for nij in range(lo, hi + 1):
-                term1 = nij / n * np.log(n * nij / (a[i] * b[j]))
+                term1 = nij / n * np.log(n * nij / (a[i] * b[j]))  # numlint: disable=NL001 — host float64 EMI loop; hi >= lo >= 1 implies a[i], b[j] >= 1
                 lg = (
                     gammaln(a[i] + 1) + gammaln(b[j] + 1) + gammaln(n - a[i] + 1) + gammaln(n - b[j] + 1)
                     - gammaln(n + 1) - gammaln(nij + 1) - gammaln(a[i] - nij + 1)

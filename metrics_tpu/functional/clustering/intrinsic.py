@@ -49,7 +49,8 @@ def calinski_harabasz_score(data: Array, labels: Array) -> Array:
     mean = data.mean(axis=0)
     between = jnp.sum(counts * jnp.sum((centroids - mean) ** 2, axis=1))
     within = jnp.sum((data - centroids[g]) ** 2)
-    return jnp.where(within > 0, (between / within) * ((n - k) / max(k - 1, 1)), 1.0)
+    safe_within = jnp.where(within > 0, within, 1.0)  # keep the untaken branch finite under jit
+    return jnp.where(within > 0, (between / safe_within) * ((n - k) / max(k - 1, 1)), 1.0)
 
 
 def davies_bouldin_score(data: Array, labels: Array) -> Array:
@@ -87,4 +88,4 @@ def dunn_index(data: Array, labels: Array, p: float = 2.0) -> Array:
     inter = jnp.min(jnp.where(jnp.eye(k, dtype=bool), jnp.inf, cent_dist))
     to_centroid = jnp.linalg.norm(data - centroids[g], ord=p, axis=-1)
     intra = jnp.max(to_centroid)
-    return inter / intra
+    return inter / intra  # numlint: disable=NL001 — intra = 0 only when every point sits on its centroid; reference returns inf

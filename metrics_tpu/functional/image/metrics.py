@@ -120,7 +120,7 @@ def error_relative_global_dimensionless_synthesis(
     diff = (preds - target).reshape(b, c, -1)
     rmse_per_band = jnp.sqrt(jnp.mean(diff**2, axis=2))
     mean_target = jnp.mean(target.reshape(b, c, -1), axis=2)
-    ergas_score = 100 / ratio * jnp.sqrt(jnp.mean((rmse_per_band / mean_target) ** 2, axis=1))
+    ergas_score = 100 / ratio * jnp.sqrt(jnp.mean((rmse_per_band / mean_target) ** 2, axis=1))  # numlint: disable=NL001 — reference semantics: zero-mean band -> inf ERGAS
     return reduce(ergas_score, reduction)
 
 
@@ -299,7 +299,7 @@ def peak_signal_noise_ratio_with_blocked_effect(preds: Array, target: Array, blo
     data_range = target.max() - target.min()
     bef = _blocking_effect_factor(preds, block_size)
     mse_b = ((preds - target) ** 2).mean() + bef
-    return jnp.where(data_range > 2, 10 * jnp.log10(data_range**2 / mse_b), 10 * jnp.log10(1.0 / mse_b))
+    return jnp.where(data_range > 2, 10 * jnp.log10(data_range**2 / mse_b), 10 * jnp.log10(1.0 / mse_b))  # numlint: disable=NL001 — mse_b = 0 only for identical images; PSNR-B = inf intended
 
 
 # --------------------------------------------------------------------------- VIF

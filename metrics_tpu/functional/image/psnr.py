@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple, Union
 
 import jax.numpy as jnp
@@ -20,7 +21,7 @@ def _psnr_compute(
 ) -> Array:
     """PSNR from accumulated squared error (reference ``psnr.py:26-57``)."""
     psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
-    psnr_vals = psnr_base_e * (10 / jnp.log(base))
+    psnr_vals = psnr_base_e * (10 / math.log(base))  # host constant: base is a Python float > 1
     return reduce(psnr_vals, reduction)
 
 

@@ -179,10 +179,10 @@ def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
     n_subjects, _ = ratings.shape
     n_raters = ratings[0].sum()
     p_cat = ratings.sum(axis=0) / (n_subjects * n_raters)
-    p_subject = (jnp.sum(ratings * ratings, axis=1) - n_raters) / (n_raters * (n_raters - 1))
+    p_subject = (jnp.sum(ratings * ratings, axis=1) - n_raters) / (n_raters * (n_raters - 1))  # numlint: disable=NL001 — n_raters >= 2 caller contract (kappa undefined for one rater)
     p_bar = p_subject.mean()
     pe_bar = jnp.sum(p_cat**2)
-    return (p_bar - pe_bar) / (1 - pe_bar)
+    return (p_bar - pe_bar) / (1 - pe_bar)  # numlint: disable=NL001 — pe_bar = 1 only for single-category data; reference yields nan
 
 
 def _matrix_over_columns(matrix: Array, fn) -> Array:

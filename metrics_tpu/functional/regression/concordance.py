@@ -15,7 +15,9 @@ def _concordance_corrcoef_compute(
     var_x = var_x / nb
     var_y = var_y / nb
     corr_xy = corr_xy / nb
-    return jnp.squeeze(2.0 * corr_xy / (var_x + var_y + (mean_x - mean_y) ** 2))
+    # tiny floor: both-constant equal-mean inputs give CCC 0 instead of nan
+    denom = var_x + var_y + (mean_x - mean_y) ** 2
+    return jnp.squeeze(2.0 * corr_xy / jnp.maximum(denom, jnp.finfo(jnp.float32).tiny))
 
 
 def concordance_corrcoef(preds: Array, target: Array) -> Array:

@@ -28,7 +28,8 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
     dot_product = jnp.sum(preds * target, axis=-1)
     preds_norm = jnp.linalg.norm(preds, axis=-1)
     target_norm = jnp.linalg.norm(target, axis=-1)
-    similarity = dot_product / (preds_norm * target_norm)
+    # eps floor: a zero vector yields similarity 0 instead of nan
+    similarity = dot_product / jnp.maximum(preds_norm * target_norm, jnp.finfo(preds.dtype).eps)
     reduction_mapping = {
         "sum": jnp.sum,
         "mean": jnp.mean,

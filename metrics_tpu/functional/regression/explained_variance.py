@@ -1,8 +1,16 @@
-"""Explained variance kernels (reference ``functional/regression/explained_variance.py``)."""
+"""Explained variance kernels (reference ``functional/regression/explained_variance.py``).
+
+The reference accumulates raw sums and computes ``E[x**2] - E[x]**2`` — a
+single-pass form that cancels catastrophically once ``|mean| >> std`` (NL002).
+This port carries *centered* Welford moments ``(n, mean, m2)`` per stream
+instead: batches fold in via the Chan pairwise merge, which is exact for the
+same inputs and keeps full precision at arbitrary offsets. ``m2 / n`` is
+algebraically identical to the reference's biased variance.
+"""
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple, Union
+from typing import Tuple, Union
 
 import jax.numpy as jnp
 from jax import Array
@@ -12,38 +20,65 @@ from metrics_tpu.utils.checks import _check_same_shape
 ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
 
 
+def _batch_moments(x: Array) -> Tuple[Array, Array]:
+    """Per-feature ``(mean, m2)`` of one batch along axis 0 (shifted two-pass)."""
+    mean = jnp.mean(x, axis=0)
+    m2 = jnp.sum((x - mean) ** 2, axis=0)
+    return mean, m2
+
+
+def _merge_moments(
+    n_a: Union[int, Array], mean_a: Array, m2_a: Array, n_b: Union[int, Array], mean_b: Array, m2_b: Array
+) -> Tuple[Array, Array, Array]:
+    """Chan pairwise merge of two Welford moment sets (trace-safe, empty-safe)."""
+    n = n_a + n_b
+    n_safe = jnp.maximum(n, 1)
+    delta = mean_b - mean_a
+    mean = mean_a + delta * n_b / n_safe
+    m2 = m2_a + m2_b + delta**2 * n_a * n_b / n_safe
+    return jnp.asarray(n, jnp.float32), mean, m2
+
+
 def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
-    """Accumulate moment sums (reference ``explained_variance.py:26-48``)."""
+    """One batch's Welford moments of ``target - preds`` and ``target``."""
     _check_same_shape(preds, target)
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)
     num_obs = preds.shape[0]
-    sum_error = jnp.sum(target - preds, axis=0)
-    diff = target - preds
-    sum_squared_error = jnp.sum(diff * diff, axis=0)
-    sum_target = jnp.sum(target, axis=0)
-    sum_squared_target = jnp.sum(target * target, axis=0)
-    return num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+    mean_diff, m2_diff = _batch_moments(target - preds)
+    mean_target, m2_target = _batch_moments(target)
+    return num_obs, mean_diff, m2_diff, mean_target, m2_target
+
+
+def _explained_variance_fold(
+    num_obs: Array, mean_diff: Array, m2_diff: Array, mean_target: Array, m2_target: Array
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Fold stacked per-replica moment states (axis 0) into one set."""
+    n, md, m2d, mt, m2t = num_obs[0], mean_diff[0], m2_diff[0], mean_target[0], m2_target[0]
+    for i in range(1, num_obs.shape[0]):
+        n_new, md, m2d = _merge_moments(n, md, m2d, num_obs[i], mean_diff[i], m2_diff[i])
+        _, mt, m2t = _merge_moments(n, mt, m2t, num_obs[i], mean_target[i], m2_target[i])
+        n = n_new
+    return n, md, m2d, mt, m2t
 
 
 def _explained_variance_compute(
     num_obs: Union[int, Array],
-    sum_error: Array,
-    sum_squared_error: Array,
-    sum_target: Array,
-    sum_squared_target: Array,
+    mean_diff: Array,
+    m2_diff: Array,
+    mean_target: Array,
+    m2_target: Array,
     multioutput: str = "uniform_average",
 ) -> Array:
-    """Explained variance (reference ``explained_variance.py:51-96``)."""
-    diff_avg = sum_error / num_obs
-    numerator = sum_squared_error / num_obs - diff_avg**2
-    target_avg = sum_target / num_obs
-    denominator = sum_squared_target / num_obs - target_avg**2
+    """Explained variance from Welford moments (reference ``explained_variance.py:51-96``)."""
+    del mean_diff, mean_target  # carried for merging; the score only needs the m2s
+    numerator = m2_diff / num_obs
+    denominator = m2_target / num_obs
 
     nonzero_numerator = numerator != 0
     nonzero_denominator = denominator != 0
     valid_score = nonzero_numerator & nonzero_denominator
-    output_scores = jnp.ones_like(diff_avg)
+    output_scores = jnp.ones_like(numerator)
     output_scores = jnp.where(
         valid_score, 1.0 - (numerator / jnp.where(valid_score, denominator, 1.0)), output_scores
     )
@@ -68,5 +103,5 @@ def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_
     """
     if multioutput not in ALLOWED_MULTIOUTPUT:
         raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}")
-    num_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(preds, target)
-    return _explained_variance_compute(num_obs, sum_error, ss_error, sum_target, ss_target, multioutput)
+    num_obs, mean_diff, m2_diff, mean_target, m2_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(num_obs, mean_diff, m2_diff, mean_target, m2_target, multioutput)

@@ -45,9 +45,9 @@ def _kendall_tau_1d(preds: Array, target: Array, variant: str) -> Array:
     n0 = n * (n - 1) / 2.0
     if variant == "a":
         # tied pairs are excluded from the denominator (reference ``kendall.py:164-165``)
-        return con_min_dis / con_plus_dis
+        return con_min_dis / con_plus_dis  # numlint: disable=NL001 — tau-a: 0/0 only when every pair is tied; reference yields nan
     if variant == "b":
-        denom = jnp.sqrt((n0 - tx) * (n0 - ty))
+        denom = jnp.sqrt((n0 - tx) * (n0 - ty))  # numlint: disable=NL003 — n0 >= tx, ty by construction (tie counts over the same pairs)
         return con_min_dis / denom
     # variant "c": needs the number of distinct values per column (host-side)
     import numpy as np

@@ -18,9 +18,9 @@ def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
         raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
     total = p.shape[0]
     if log_prob:
-        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)  # numlint: disable=NL003 — log_prob contract: p are log-probabilities <= 0, so exp(p) <= 1
     else:
-        p = p / p.sum(axis=-1, keepdims=True)
+        p = p / p.sum(axis=-1, keepdims=True)  # numlint: disable=NL001 — probability rows: p.sum() > 0 unless input is all-zero (invalid)
         q = q / q.sum(axis=-1, keepdims=True)
         q = jnp.clip(q, jnp.finfo(q.dtype).eps, None)
         measures = jnp.sum(_safe_xlogy(p, p / q), axis=-1)

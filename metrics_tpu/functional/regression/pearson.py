@@ -60,9 +60,10 @@ def _pearson_corrcoef_update(
 
 def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
     """Final correlation from accumulated statistics (reference ``pearson.py:79-110``)."""
-    var_x = var_x / (nb - 1)
-    var_y = var_y / (nb - 1)
-    corr_xy = corr_xy / (nb - 1)
+    nb_1 = jnp.maximum(nb - 1.0, 1.0)  # Bessel; floor keeps the nb <= 1 degenerate case finite
+    var_x = var_x / nb_1
+    var_y = var_y / nb_1
+    corr_xy = corr_xy / nb_1
     bound = math.sqrt(jnp.finfo(jnp.float32).eps)
     import jax
 
@@ -73,7 +74,9 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
             " coefficient, leading to wrong results.",
             UserWarning,
         )
-    corrcoef = jnp.clip(corr_xy / jnp.sqrt(var_x * var_y), -1.0, 1.0)
+    # tiny floor: zero-variance inputs give corrcoef 0 (the eager warning above
+    # already flags them) instead of nan under jit
+    corrcoef = jnp.clip(corr_xy / jnp.maximum(jnp.sqrt(var_x * var_y), jnp.finfo(jnp.float32).tiny), -1.0, 1.0)
     return jnp.squeeze(corrcoef)
 
 

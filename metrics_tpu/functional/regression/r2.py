@@ -49,7 +49,7 @@ def _r2_score_compute(
         r2 = jnp.mean(raw_scores)
     elif multioutput == "variance_weighted":
         tss_sum = jnp.sum(tss)
-        r2 = jnp.sum(tss / tss_sum * raw_scores)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)  # numlint: disable=NL001 — tss_sum = 0 only for all-constant targets; reference yields nan
     else:
         raise ValueError(
             "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
@@ -68,7 +68,7 @@ def _r2_score_compute(
             elif int(num_obs) - 1 == adjusted:
                 rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
             else:
-                return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+                return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)  # numlint: disable=NL001 — eager branch: elif chain above returns early unless num_obs - 1 > adjusted
             return r2
         # under trace, select the adjusted score only where its denominator is
         # positive (same fallback the warnings announce eagerly), branch-free

@@ -32,9 +32,9 @@ def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 
     elif power == 0:
         deviance_score = jnp.power(targets - preds, 2)
     elif power == 1:
-        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)  # numlint: disable=NL001 — Poisson deviance domain: preds > 0 (reference contract)
     elif power == 2:
-        deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)
+        deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)  # numlint: disable=NL001 — gamma deviance domain: preds, targets > 0 (reference contract)
     elif 1 < power < 2:
         deviance_score = 2 * (
             jnp.power(targets, 2 - power) / ((1 - power) * (2 - power))

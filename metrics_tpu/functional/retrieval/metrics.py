@@ -104,7 +104,7 @@ def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] 
     pos = jnp.arange(sorted_target.shape[0], dtype=jnp.float32)
     within_k = pos < k
     first_rel = jnp.min(jnp.where((sorted_target > 0) & within_k, pos + 1, jnp.inf))
-    return jnp.where(jnp.isfinite(first_rel), 1.0 / first_rel, 0.0).astype(jnp.float32)
+    return jnp.where(jnp.isfinite(first_rel), 1.0 / first_rel, 0.0).astype(jnp.float32)  # numlint: disable=NL001 — first_rel in [1, inf]; 1/inf = 0 and the isfinite-where selects
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
@@ -118,7 +118,7 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
 
 def _dcg(target_sorted: Array, k_mask: Array) -> Array:
     pos = jnp.arange(target_sorted.shape[0], dtype=jnp.float32)
-    discount = 1.0 / jnp.log2(pos + 2.0)
+    discount = 1.0 / jnp.log2(pos + 2.0)  # numlint: disable=NL001 — log2(pos + 2) >= 1 for pos >= 0
     return jnp.sum(target_sorted * discount * k_mask)
 
 

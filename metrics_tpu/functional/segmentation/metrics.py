@@ -127,9 +127,9 @@ def generalized_dice_score(
     target_sum = jnp.sum(target, axis=reduce_axes)
     pred_sum = jnp.sum(preds, axis=reduce_axes)
     if weight_type == "square":
-        weights = 1.0 / target_sum**2
+        weights = 1.0 / target_sum**2  # numlint: disable=NL001 — inf weights from empty classes are zeroed below (reference quirk)
     elif weight_type == "simple":
-        weights = 1.0 / target_sum
+        weights = 1.0 / target_sum  # numlint: disable=NL001 — inf weights from empty classes are zeroed below (reference quirk)
     else:
         weights = jnp.ones_like(target_sum)
     # infinite weights (empty classes) replaced via the reference's

@@ -19,6 +19,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 
 def _sqrtm_trace_product(cov1: np.ndarray, cov2: np.ndarray) -> float:
@@ -104,10 +105,10 @@ class FrechetInceptionDistance(Metric):
     def _init_states(self, d: int) -> None:
         self.add_state("real_features_sum", jnp.zeros(d), "sum")
         self.add_state("real_features_cov_sum", jnp.zeros((d, d)), "sum")
-        self.add_state("real_features_num_samples", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("real_features_num_samples", jnp.zeros((), dtype=count_dtype()), "sum")
         self.add_state("fake_features_sum", jnp.zeros(d), "sum")
         self.add_state("fake_features_cov_sum", jnp.zeros((d, d)), "sum")
-        self.add_state("fake_features_num_samples", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("fake_features_num_samples", jnp.zeros((), dtype=count_dtype()), "sum")
         self._initialized = True
 
     def _extract(self, imgs: Array) -> Array:

@@ -16,6 +16,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 
 def _lpips_distance(feats_a: Sequence[Array], feats_b: Sequence[Array],
@@ -87,7 +88,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         self.reduction = reduction
         self.normalize = normalize
         self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, img1: Array, img2: Array) -> None:
         """Update with a pair of image batches."""

@@ -35,6 +35,7 @@ from metrics_tpu.functional.image.ssim import (
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.compute import count_dtype
 
 
 class PeakSignalNoiseRatio(Metric):
@@ -74,7 +75,7 @@ class PeakSignalNoiseRatio(Metric):
         if dim is None:
             self.data_range_val = None
             self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
             self.add_state("total", [], dist_reduce_fx="cat")
@@ -157,7 +158,7 @@ class StructuralSimilarityIndexMeasure(Metric):
             self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("similarity", [], dist_reduce_fx="cat")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
         if return_full_image or return_contrast_sensitivity:
             self.add_state("image_return", [], dist_reduce_fx="cat")
         self.gaussian_kernel = gaussian_kernel
@@ -242,7 +243,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
             self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("similarity", [], dist_reduce_fx="cat")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
         if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
             raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
         if normalize not in ("relu", "simple", None):
@@ -412,7 +413,7 @@ class TotalVariation(Metric):
         self.reduction = reduction
         if reduction in ("sum", "mean"):
             self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("num_elements", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("num_elements", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
         else:
             self.add_state("score_list", [], dist_reduce_fx="cat")
 

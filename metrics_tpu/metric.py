@@ -241,7 +241,8 @@ def _dedup_donation_aliases(state: Dict[str, Any]) -> Dict[str, Any]:
 # Instance fields that do not affect how `update` traces: runtime bookkeeping and
 # the sync-orchestration kwargs (those act outside the jitted region).
 _JIT_KEY_EXCLUDE = frozenset({
-    "_defaults", "_state", "_persistent", "_reductions", "_merge_associative", "_computed", "_update_count",
+    "_defaults", "_state", "_persistent", "_reductions", "_merge_associative", "_precision", "_computed",
+    "_update_count",
     "_to_sync", "_should_unsync", "_is_synced", "_cache", "_update_signature",
     "_update_impl", "_compute_impl", "update", "compute", "_jitted_update",
     "_jit_failed", "_jit_update_opt", "_donate_opt", "_state_escaped", "_group_shared",
@@ -357,6 +358,7 @@ class Metric(ABC):
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Any] = {}
         self._merge_associative: Dict[str, Optional[bool]] = {}
+        self._precision: Dict[str, Any] = {}
 
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
         self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
@@ -401,6 +403,7 @@ class Metric(ABC):
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
         merge_associative: Optional[bool] = None,
+        precision: Optional[Union[str, Dict[str, Any]]] = None,
     ) -> None:
         """Register a state variable (reference ``metric.py:201-284``).
 
@@ -416,6 +419,14 @@ class Metric(ABC):
         callable* reduction must declare it explicitly (distlint DL001) so the
         multi-chip sync layer can refuse folds with no well-defined cross-shard
         answer.
+
+        ``precision`` is this state's declared numerical contract (numlint
+        NL004/NL006, DESIGN §25): ``"compensated"`` means the state is paired
+        with a ``<name>_comp`` Neumaier companion; a dict may declare
+        ``{"horizon": <updates>, "rtol": <reassociation tolerance>, ...}`` to
+        bound the stream length the accumulator is rated for. Purely
+        declarative — stored in ``self._precision`` and cross-checked by the
+        precision-contract harness (``analysis/precision_contracts.py``).
         """
         if isinstance(default, list):
             if default:
@@ -443,10 +454,14 @@ class Metric(ABC):
         if merge_associative is None and isinstance(dist_reduce_fx, str):
             merge_associative = dist_reduce_fx in ("sum", "mean", "min", "max")
 
+        if precision is not None and not isinstance(precision, (str, dict)):
+            raise ValueError("`precision` must be None, a string tag, or a dict of contract fields")
+
         self._defaults[name] = deepcopy(default) if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = reduce_fx
         self._merge_associative[name] = merge_associative
+        self._precision[name] = precision
         self._state[name] = deepcopy(default) if isinstance(default, list) else default
 
     # attribute routing: registered state names resolve into the state pytree
@@ -1214,6 +1229,8 @@ class Metric(ABC):
             object.__setattr__(self, k, v)
         # checkpoints from before merge-annotation support: all flags unknown
         self.__dict__.setdefault("_merge_associative", dict.fromkeys(self.__dict__.get("_defaults", {})))
+        # checkpoints from before precision contracts: no declared contracts
+        self.__dict__.setdefault("_precision", dict.fromkeys(self.__dict__.get("_defaults", {})))
         # checkpoints from before state donation: conservative donation flags
         self.__dict__.setdefault("_donate_opt", None)
         self.__dict__["_state_escaped"] = True

@@ -16,6 +16,7 @@ from jax import Array
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.compute import count_dtype
 
 
 def _unit(x: Array) -> Array:
@@ -62,7 +63,7 @@ class CLIPScore(Metric):
         self.image_encoder = image_encoder
         self.text_encoder = text_encoder
         self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("n_samples", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, images: Union[Array, Sequence], text: Union[str, Sequence[str]]) -> None:
         """Update with images and matching captions."""

@@ -63,7 +63,7 @@ def decay_weights(last_t: Array, t: Array, half_life_s: float) -> Tuple[Array, A
 
 def pane_id(t: Array, pane_s: float) -> Array:
     """Absolute pane number of timestamp ``t``: ``floor(t / pane_s)``, () int32."""
-    return jnp.floor(jnp.asarray(t, jnp.float32) / jnp.float32(pane_s)).astype(jnp.int32)
+    return jnp.floor(jnp.asarray(t, jnp.float32) / jnp.float32(pane_s)).astype(jnp.int32)  # numlint: disable=NL001 — pane_s > 0 validated at window construction
 
 
 def pane_slot_onehot(cur_id: Array, n_panes: int) -> Array:

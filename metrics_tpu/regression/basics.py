@@ -29,6 +29,7 @@ from metrics_tpu.functional.regression.msle import (
     _mean_squared_log_error_compute,
     _mean_squared_log_error_update,
 )
+from metrics_tpu.functional.regression.explained_variance import _batch_moments, _merge_moments
 from metrics_tpu.functional.regression.nrmse import (
     _normalized_root_mean_squared_error_compute,
     _normalized_root_mean_squared_error_update,
@@ -39,6 +40,7 @@ from metrics_tpu.functional.regression.tweedie_deviance import (
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
+from metrics_tpu.utils.compute import count_dtype
 
 __all__ = [
     "CriticalSuccessIndex",
@@ -79,7 +81,7 @@ class MeanSquaredError(Metric):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
         self.add_state("sum_squared_error", jnp.zeros(num_outputs) if num_outputs > 1 else jnp.zeros(()), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -113,7 +115,7 @@ class MeanAbsoluteError(Metric):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
         self.add_state("sum_abs_error", jnp.zeros(num_outputs) if num_outputs > 1 else jnp.zeros(()), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -137,7 +139,7 @@ class MeanSquaredLogError(Metric):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.add_state("sum_squared_log_error", jnp.zeros(()), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -161,7 +163,7 @@ class MeanAbsolutePercentageError(Metric):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.add_state("sum_abs_per_error", jnp.zeros(()), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -185,7 +187,7 @@ class SymmetricMeanAbsolutePercentageError(Metric):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.add_state("sum_abs_per_error", jnp.zeros(()), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -243,7 +245,7 @@ class LogCoshError(Metric):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
         self.add_state("sum_log_cosh_error", jnp.zeros(num_outputs), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -294,7 +296,7 @@ class TweedieDevianceScore(Metric):
             raise ValueError(f"Deviance Score is not defined for power={power}.")
         self.power = power
         self.add_state("sum_deviance_score", jnp.zeros(()), "sum")
-        self.add_state("num_observations", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("num_observations", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, targets: Array) -> None:
         """Update state with predictions and targets."""
@@ -323,9 +325,9 @@ class CriticalSuccessIndex(Metric):
         self.threshold = float(threshold)
         if keep_sequence_dim is None:
             self.keep_sequence_dim = None
-            self.add_state("hits", jnp.zeros((), dtype=jnp.int32), "sum")
-            self.add_state("misses", jnp.zeros((), dtype=jnp.int32), "sum")
-            self.add_state("false_alarms", jnp.zeros((), dtype=jnp.int32), "sum")
+            self.add_state("hits", jnp.zeros((), dtype=count_dtype()), "sum")
+            self.add_state("misses", jnp.zeros((), dtype=count_dtype()), "sum")
+            self.add_state("false_alarms", jnp.zeros((), dtype=count_dtype()), "sum")
         else:
             if not isinstance(keep_sequence_dim, int) or keep_sequence_dim < 0:
                 raise ValueError(f"Expected keep_sequence_dim to be int or None but got {keep_sequence_dim}")
@@ -365,7 +367,13 @@ class NormalizedRootMeanSquaredError(Metric):
     """Compute normalized RMSE (reference ``regression/nrmse.py:30``).
 
     The denominator statistic is itself accumulated streaming-style with a custom
-    per-normalization merge (mean→weighted mean, range→min/max, std→moments, l2→sq-sum).
+    per-normalization merge (range→min/max; mean/std/l2→Welford ``(n, mean, m2)``
+    moments folded by the Chan pairwise merge). The reference's raw
+    ``Σt``/``Σt²`` sums would make the std normalization a single-pass
+    ``E[x²]−E[x]²`` (numlint NL002), which cancels catastrophically once
+    ``|mean| >> std``; the centered moments are algebraically identical and
+    stay exact at arbitrary offsets, and the l2 form ``m2 + n·mean²`` is a sum
+    of positives with no cancellation.
     """
 
     is_differentiable = True
@@ -385,9 +393,12 @@ class NormalizedRootMeanSquaredError(Metric):
         self.num_outputs = num_outputs
         shape = (num_outputs,) if num_outputs > 1 else ()
         self.add_state("sum_squared_error", jnp.zeros(shape), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
-        self.add_state("target_sum", jnp.zeros(shape), "sum")
-        self.add_state("target_squared_sum", jnp.zeros(shape), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
+        # Welford moments of target; custom reduce: gather -> Chan pairwise
+        # fold (same pattern as ExplainedVariance / PearsonCorrCoef)
+        self.add_state("num_obs", jnp.zeros(()), dist_reduce_fx=None)
+        self.add_state("target_mean", jnp.zeros(shape), dist_reduce_fx=None)
+        self.add_state("target_m2", jnp.zeros(shape), dist_reduce_fx=None)
         self.add_state("min_val", jnp.full(shape, jnp.inf), "min")
         self.add_state("max_val", jnp.full(shape, -jnp.inf), "max")
 
@@ -397,19 +408,33 @@ class NormalizedRootMeanSquaredError(Metric):
         self.sum_squared_error = self.sum_squared_error + sum_squared_error
         self.total = self.total + num_obs
         t = (target.reshape(-1) if self.num_outputs == 1 else target).astype(jnp.float32)
-        self.target_sum = self.target_sum + t.sum(0)
-        self.target_squared_sum = self.target_squared_sum + (t * t).sum(0)
+        mean_b, m2_b = _batch_moments(t)
+        self.num_obs, self.target_mean, self.target_m2 = _merge_moments(
+            self.num_obs, self.target_mean, self.target_m2, t.shape[0], mean_b, m2_b
+        )
         self.min_val = jnp.minimum(self.min_val, t.min(0))
         self.max_val = jnp.maximum(self.max_val, t.max(0))
 
+    def _sync_reduce(self) -> tuple:
+        """Fold possibly-stacked per-replica moment states into one (post-sync)."""
+        n, mean, m2 = self.num_obs, self.target_mean, self.target_m2
+        if n.ndim > 0:
+            nf, meanf, m2f = n[0], mean[0], m2[0]
+            for i in range(1, n.shape[0]):
+                nf, meanf, m2f = _merge_moments(nf, meanf, m2f, n[i], mean[i], m2[i])
+            return nf, meanf, m2f
+        return n, mean, m2
+
     def compute(self) -> Array:
         """Compute metric."""
+        num_obs, target_mean, target_m2 = self._sync_reduce()
         if self.normalization == "mean":
-            denom = self.target_sum / self.total
+            denom = target_mean
         elif self.normalization == "range":
             denom = self.max_val - self.min_val
         elif self.normalization == "std":
-            denom = jnp.sqrt(self.target_squared_sum / self.total - (self.target_sum / self.total) ** 2)
+            denom = jnp.sqrt(target_m2 / num_obs)
         else:
-            denom = jnp.sqrt(self.target_squared_sum)
+            # Σt² reassembled from centered moments: both terms nonnegative
+            denom = jnp.sqrt(target_m2 + num_obs * target_mean**2)
         return _normalized_root_mean_squared_error_compute(self.sum_squared_error, self.total, denom)

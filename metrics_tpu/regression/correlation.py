@@ -20,7 +20,9 @@ from metrics_tpu.functional.regression.cosine_similarity import (
 from metrics_tpu.functional.regression.explained_variance import (
     ALLOWED_MULTIOUTPUT,
     _explained_variance_compute,
+    _explained_variance_fold,
     _explained_variance_update,
+    _merge_moments,
 )
 from metrics_tpu.functional.regression.kendall import _kendall_corrcoef_compute, _kendall_corrcoef_update
 from metrics_tpu.functional.regression.kl_divergence import _kld_compute, _kld_update
@@ -38,6 +40,7 @@ from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_comput
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.compute import count_dtype
 
 __all__ = [
     "ConcordanceCorrCoef",
@@ -238,7 +241,7 @@ class R2Score(Metric):
         self.add_state("sum_squared_error", jnp.zeros(shape), "sum")
         self.add_state("sum_error", jnp.zeros(shape), "sum")
         self.add_state("residual", jnp.zeros(shape), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -272,7 +275,7 @@ class RelativeSquaredError(Metric):
         self.add_state("sum_squared_error", jnp.zeros(shape), "sum")
         self.add_state("sum_error", jnp.zeros(shape), "sum")
         self.add_state("residual", jnp.zeros(shape), "sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
@@ -311,29 +314,34 @@ class ExplainedVariance(Metric):
                 f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}"
             )
         self.multioutput = multioutput
-        self.add_state("sum_error", jnp.zeros(()), "sum")
-        self.add_state("sum_squared_error", jnp.zeros(()), "sum")
-        self.add_state("sum_target", jnp.zeros(()), "sum")
-        self.add_state("sum_squared_target", jnp.zeros(()), "sum")
-        self.add_state("num_obs", jnp.zeros(()), "sum")
+        # Welford moments of (target - preds) and target; custom reduce:
+        # gather -> Chan pairwise fold (same pattern as PearsonCorrCoef)
+        for name in ("num_obs", "mean_diff", "m2_diff", "mean_target", "m2_target"):
+            self.add_state(name, jnp.zeros(()), dist_reduce_fx=None)
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with predictions and targets."""
-        num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(
-            preds, target
+        nb, mb_diff, m2b_diff, mb_target, m2b_target = _explained_variance_update(preds, target)
+        n_new, self.mean_diff, self.m2_diff = _merge_moments(
+            self.num_obs, self.mean_diff, self.m2_diff, nb, mb_diff, m2b_diff
         )
-        self.num_obs = self.num_obs + num_obs
-        self.sum_error = self.sum_error + sum_error
-        self.sum_squared_error = self.sum_squared_error + sum_squared_error
-        self.sum_target = self.sum_target + sum_target
-        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+        _, self.mean_target, self.m2_target = _merge_moments(
+            self.num_obs, self.mean_target, self.m2_target, nb, mb_target, m2b_target
+        )
+        self.num_obs = n_new
+
+    def _sync_reduce(self) -> tuple:
+        """Fold possibly-stacked per-replica states into one (used by compute after sync)."""
+        if self.num_obs.ndim > 0:
+            return _explained_variance_fold(
+                self.num_obs, self.mean_diff, self.m2_diff, self.mean_target, self.m2_target
+            )
+        return self.num_obs, self.mean_diff, self.m2_diff, self.mean_target, self.m2_target
 
     def compute(self) -> Array:
         """Compute metric."""
-        return _explained_variance_compute(
-            self.num_obs, self.sum_error, self.sum_squared_error, self.sum_target, self.sum_squared_target,
-            self.multioutput,
-        )
+        num_obs, mean_diff, m2_diff, mean_target, m2_target = self._sync_reduce()
+        return _explained_variance_compute(num_obs, mean_diff, m2_diff, mean_target, m2_target, self.multioutput)
 
 
 class CosineSimilarity(Metric):
@@ -398,7 +406,7 @@ class KLDivergence(Metric):
             self.add_state("measures", jnp.zeros(()), "sum")
         else:
             self.add_state("measures", [], "cat")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), "sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), "sum")
 
     def update(self, p: Array, q: Array) -> None:
         """Update state with two probability distributions."""

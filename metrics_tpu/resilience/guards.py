@@ -35,6 +35,7 @@ import numpy as np
 from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
+from metrics_tpu.utils.compute import count_dtype
 
 __all__ = ["GUARD_POLICIES", "GUARD_STATE", "PoisonedInputError", "install_guard", "poisoned_count"]
 
@@ -74,7 +75,7 @@ def install_guard(metric: Any, policy: str = "skip_batch") -> Any:
                 f"but {type(metric).__name__} state(s) {growable} grow per update. Use policy='propagate'."
             )
     if GUARD_STATE not in metric._defaults:
-        metric.add_state(GUARD_STATE, jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum", persistent=True)
+        metric.add_state(GUARD_STATE, jnp.asarray(0, dtype=count_dtype()), dist_reduce_fx="sum", persistent=True)
     metric._guard_policy = policy
     metric.__dict__["_guard_seen"] = 0
     metric._jitted_update = None  # the cache key changed; re-resolve on next update

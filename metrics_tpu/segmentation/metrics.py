@@ -17,6 +17,7 @@ from metrics_tpu.functional.segmentation.metrics import (
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.compute import count_dtype
 
 
 class DiceScore(Metric):
@@ -114,7 +115,7 @@ class GeneralizedDiceScore(Metric):
         self.input_format = input_format
         self.add_state("score", jnp.zeros(num_classes - (0 if include_background else 1)) if per_class
                        else jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("samples", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("samples", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state."""
@@ -164,7 +165,7 @@ class MeanIoU(Metric):
         self.input_format = input_format
         n_out = num_classes - (0 if include_background else 1)
         self.add_state("score", jnp.zeros(n_out) if per_class else jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("num_batches", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("num_batches", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate batch-mean IoU (reference ``segmentation/mean_iou.py:117-124``)."""
@@ -205,7 +206,7 @@ class HausdorffDistance(Metric):
         self.directed = directed
         self.input_format = input_format
         self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state."""

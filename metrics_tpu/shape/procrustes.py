@@ -9,6 +9,7 @@ from jax import Array
 
 from metrics_tpu.functional.shape.procrustes import procrustes_disparity
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 
 class ProcrustesDisparity(Metric):
@@ -34,7 +35,7 @@ class ProcrustesDisparity(Metric):
             raise ValueError(f"Argument `reduction` must be one of `mean` or `sum`, but got {reduction}")
         self.reduction = reduction
         self.add_state("disparity", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, point_cloud1: Array, point_cloud2: Array) -> None:
         """Update state with a batch (or a single pair) of point clouds."""

@@ -15,6 +15,7 @@ from metrics_tpu.functional.sketches.ecdf import (
     score_hist_delta,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import acc_dtype, count_dtype
 
 __all__ = ["StreamingAUROC", "StreamingCalibrationError"]
 
@@ -43,10 +44,10 @@ class StreamingAUROC(Metric):
             raise ValueError(f"`num_bins` must be >= 2, got {num_bins}")
         self.num_bins = int(num_bins)
         self.add_state(
-            "pos_hist", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+            "pos_hist", default=jnp.zeros((self.num_bins,), count_dtype()), dist_reduce_fx="sum"
         )
         self.add_state(
-            "neg_hist", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+            "neg_hist", default=jnp.zeros((self.num_bins,), count_dtype()), dist_reduce_fx="sum"
         )
 
     def update(self, preds: Array, target: Array) -> None:
@@ -88,13 +89,13 @@ class StreamingCalibrationError(Metric):
             raise ValueError(f"`num_bins` must be >= 2, got {num_bins}")
         self.num_bins = int(num_bins)
         self.add_state(
-            "conf_sum", default=jnp.zeros((self.num_bins,), jnp.float32), dist_reduce_fx="sum"
+            "conf_sum", default=jnp.zeros((self.num_bins,), acc_dtype()), dist_reduce_fx="sum"
         )
         self.add_state(
-            "bin_count", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+            "bin_count", default=jnp.zeros((self.num_bins,), count_dtype()), dist_reduce_fx="sum"
         )
         self.add_state(
-            "bin_correct", default=jnp.zeros((self.num_bins,), jnp.int32), dist_reduce_fx="sum"
+            "bin_correct", default=jnp.zeros((self.num_bins,), count_dtype()), dist_reduce_fx="sum"
         )
 
     def update(self, preds: Array, target: Array) -> None:

@@ -13,6 +13,7 @@ from metrics_tpu.functional.sketches.ddsketch import (
     ddsketch_quantiles,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.compute import count_dtype
 
 __all__ = ["DDSketch"]
 
@@ -61,12 +62,12 @@ class DDSketch(Metric):
         self.num_buckets = int(num_buckets)
         self.key_offset = int(-num_buckets // 2 if key_offset is None else key_offset)
         self.add_state(
-            "pos_buckets", default=jnp.zeros((self.num_buckets,), jnp.int32), dist_reduce_fx="sum"
+            "pos_buckets", default=jnp.zeros((self.num_buckets,), count_dtype()), dist_reduce_fx="sum"
         )
         self.add_state(
-            "neg_buckets", default=jnp.zeros((self.num_buckets,), jnp.int32), dist_reduce_fx="sum"
+            "neg_buckets", default=jnp.zeros((self.num_buckets,), count_dtype()), dist_reduce_fx="sum"
         )
-        self.add_state("zero_count", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("zero_count", default=jnp.zeros((), count_dtype()), dist_reduce_fx="sum")
 
     def update(self, value: Array) -> None:
         value = jnp.asarray(value)

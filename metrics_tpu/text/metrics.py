@@ -30,6 +30,7 @@ from metrics_tpu.functional.text.perplexity import _perplexity_compute, _perplex
 from metrics_tpu.functional.text.rouge import rouge_score
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.compute import count_dtype
 
 _TEXT_KW = {"__jit_ineligible__": True}
 
@@ -157,7 +158,7 @@ class EditDistance(Metric):
         self.reduction = reduction
         if reduction in ("mean", "sum"):
             self.add_state("edit_scores_list", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("num_elements", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("num_elements", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
         else:
             self.add_state("edit_scores", [], dist_reduce_fx="cat")
 
@@ -202,7 +203,7 @@ class Perplexity(Metric):
             raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
         self.ignore_index = ignore_index
         self.add_state("total_log_probs", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("count", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), dtype=count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         """Update state with log-probs/logits and targets."""

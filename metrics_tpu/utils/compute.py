@@ -32,18 +32,75 @@ def _safe_log(x: Array) -> Array:
     return jnp.log(jnp.clip(x, a_min=jnp.finfo(jnp.result_type(x, jnp.float32)).tiny))
 
 
+def count_dtype() -> jnp.dtype:
+    """Widest *available* integer dtype for long-horizon counters.
+
+    ``canonicalize_dtype(int64)``: int64 when ``jax_enable_x64`` is on, int32
+    otherwise. Under the default x32 regime this is bit-identical to a pinned
+    ``jnp.int32`` (same avals — donation/AOT signatures unchanged); flipping
+    x64 widens every counter that uses it past the 2^31 wrap in one move.
+    """
+    return jax.dtypes.canonicalize_dtype(jnp.int64)
+
+
+def acc_dtype() -> jnp.dtype:
+    """Widest available float dtype for long-horizon accumulators (x64-aware twin of :func:`count_dtype`)."""
+    return jax.dtypes.canonicalize_dtype(jnp.float64)
+
+
+def neumaier_add(total: Array, comp: Array, value: Array) -> tuple:
+    """One Neumaier (improved-Kahan) compensated accumulation step.
+
+    Returns the new ``(total, comp)`` pair; the exact running sum is
+    ``total + comp`` (fold via :func:`neumaier_value` at read-out). Unlike
+    classic Kahan this stays correct when ``|value| > |total|``, so it is safe
+    for adversarial orderings. Both branches of the ``where`` are finite, so
+    the step is jit- and grad-safe.
+    """
+    t = total + value
+    comp = comp + jnp.where(jnp.abs(total) >= jnp.abs(value), (total - t) + value, (value - t) + total)
+    return t, comp
+
+
+def neumaier_value(total: Array, comp: Array) -> Array:
+    """Read-out of a compensated pair: the corrected sum ``total + comp``."""
+    return total + comp
+
+
 def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
-    """Element-wise division, 0 (or ``zero_division``) where denominator is 0 (reference ``compute.py:47``).
+    """Element-wise division with pinned zero-denominator semantics (reference ``compute.py:47``).
+
+    Contract (identical under eager, ``jit``, and x64 — pinned by
+    ``tests/test_safe_divide_contract.py``):
+
+    * ``x / 0 -> zero_division`` (default ``0.0``) for every ``x``, including
+      ``0 / 0`` — never ``nan``/``inf`` from a zero denominator;
+    * the masked lane divides by 1, so gradients through it stay finite;
+    * dtype is ``result_type(num, denom, float32)`` — float32 for integer or
+      float32 inputs under x32, float64 once either side is a 64-bit type
+      under x64 (integers are never truncated through float32).
 
     >>> import jax.numpy as jnp
     >>> _safe_divide(jnp.array([1.0, 2.0]), jnp.array([2.0, 0.0]))
     Array([0.5, 0. ], dtype=float32)
     """
-    num = num if jnp.issubdtype(jnp.asarray(num).dtype, jnp.floating) else jnp.asarray(num, jnp.float32)
-    denom = denom if jnp.issubdtype(jnp.asarray(denom).dtype, jnp.floating) else jnp.asarray(denom, jnp.float32)
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+
+    def _as_float(dt: jnp.dtype) -> jnp.dtype:
+        # JAX's lattice promotes i64 & f32 -> f32, which would silently round
+        # 64-bit counters; widen integer inputs to their natural float first.
+        # 64-bit integers only exist under x64, where float64 is available.
+        if jnp.issubdtype(dt, jnp.integer) or jnp.issubdtype(dt, jnp.bool_):
+            return jnp.dtype(jnp.float64) if jnp.dtype(dt).itemsize >= 8 else jnp.dtype(jnp.float32)
+        return jnp.dtype(dt)
+
+    dtype = jnp.result_type(_as_float(num.dtype), _as_float(denom.dtype), jnp.float32)
+    num = num.astype(dtype)
+    denom = denom.astype(dtype)
     zero_mask = denom == 0
-    safe_denom = jnp.where(zero_mask, 1.0, denom)
-    return jnp.where(zero_mask, jnp.asarray(zero_division, dtype=safe_denom.dtype), num / safe_denom)
+    safe_denom = jnp.where(zero_mask, jnp.ones((), dtype), denom)
+    return jnp.where(zero_mask, jnp.asarray(zero_division, dtype=dtype), num / safe_denom)
 
 
 def _adjust_weights_safe_divide(

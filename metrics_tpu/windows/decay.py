@@ -9,6 +9,7 @@ from jax import Array
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.decay import decay_weights
+from metrics_tpu.utils.compute import neumaier_add, neumaier_value
 from metrics_tpu.utils.data import dim_zero_sum
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
 
@@ -101,6 +102,11 @@ class TimeDecayed(Metric):
         metric: base metric; every registered state must use ``sum`` algebra.
             A pristine clone is taken, so the passed instance stays untouched.
         half_life_s: decay half-life in the same unit as ``t`` (> 0).
+        compensated: opt into Neumaier accumulation of the decay fold — each
+            decayed state carries a ``<name>_comp`` residual (itself decayed
+            and summed by the same algebra), so the repeated
+            ``state*w_old + batch*w_new`` additions lose O(eps) instead of
+            O(n*eps) over long horizons (numlint NL004 / DESIGN §25).
     """
 
     is_differentiable = False
@@ -111,8 +117,9 @@ class TimeDecayed(Metric):
     # hashable identity instead
     __jit_key_exclude__ = frozenset({"_base"})
 
-    def __init__(self, metric: Metric, half_life_s: float, **kwargs: Any) -> None:
+    def __init__(self, metric: Metric, half_life_s: float, compensated: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
+        self.compensated = bool(compensated)
         _validate_decay_base(metric, type(self).__name__)
         if not float(half_life_s) > 0.0:
             raise ValueError(f"`half_life_s` must be > 0, got {half_life_s}")
@@ -139,7 +146,14 @@ class TimeDecayed(Metric):
             if not jnp.issubdtype(d.dtype, jnp.floating):
                 # integer counts become fractional the moment they decay
                 d = d.astype(jnp.float32)
-            self.add_state(name, default=d, dist_reduce_fx="sum")
+            self.add_state(
+                name, default=d, dist_reduce_fx="sum",
+                precision="compensated" if self.compensated else None,
+            )
+            if self.compensated:
+                self.add_state(
+                    f"{name}_comp", default=jnp.zeros_like(d), dist_reduce_fx="sum", precision="compensated"
+                )
         self.add_state("last_t", default=jnp.zeros((), jnp.float32), dist_reduce_fx="max")
 
     def update(self, t: Array, *args: Any, **kwargs: Any) -> None:
@@ -147,11 +161,22 @@ class TimeDecayed(Metric):
         ref, w_old, w_new = decay_weights(self.last_t, t, self.half_life_s)
         for name in self._base._defaults:
             cur = getattr(self, name)
-            setattr(self, name, cur * w_old + jnp.asarray(batch[name], cur.dtype) * w_new)
+            add = jnp.asarray(batch[name], cur.dtype) * w_new
+            if self.compensated:
+                # residual decays with its sum; the fold's additions are compensated
+                comp = getattr(self, f"{name}_comp") * w_old
+                total, comp = neumaier_add(cur * w_old, comp, add)
+                setattr(self, name, total)
+                setattr(self, f"{name}_comp", comp)
+            else:
+                setattr(self, name, cur * w_old + add)
         self.last_t = ref
 
     def compute(self) -> Any:
         state = self.__dict__["_state"]
+        if self.compensated:
+            folded = {name: neumaier_value(state[name], state[f"{name}_comp"]) for name in self._base._defaults}
+            return self._base._functional_compute(folded)
         return self._base._functional_compute({name: state[name] for name in self._base._defaults})
 
     def _merge_state_dicts(
@@ -163,6 +188,9 @@ class TimeDecayed(Metric):
         # this override (not `_sync_dist`'s per-state path) is the merge
         # contract for decayed metrics (DESIGN §20)
         ref, w_a, w_b = decay_weights(state_a["last_t"], state_b["last_t"], self.half_life_s)
-        out = {name: state_a[name] * w_a + state_b[name] * w_b for name in self._base._defaults}
+        names = list(self._base._defaults)
+        if self.compensated:
+            names += [f"{n}_comp" for n in self._base._defaults]  # residuals decay like their sums
+        out = {name: state_a[name] * w_a + state_b[name] * w_b for name in names}
         out["last_t"] = ref
         return out

@@ -69,13 +69,19 @@ class DecayedDDSketch(Metric):
         self.quantiles = qs
         self.num_buckets = int(num_buckets)
         self.key_offset = int(-num_buckets // 2 if key_offset is None else key_offset)
+        # float32 is the *declared* contract here, not an oversight: exp2 decay
+        # keeps every bucket's mass bounded by O(rate x half_life), so the
+        # counter never grows past the horizon where f32 ulps matter
+        decay_contract = {"horizon": "decay-bounded", "note": "mass <= update_rate * half_life / ln(2)"}
         self.add_state(
-            "pos_buckets", default=jnp.zeros((self.num_buckets,), jnp.float32), dist_reduce_fx="sum"
+            "pos_buckets", default=jnp.zeros((self.num_buckets,), jnp.float32), dist_reduce_fx="sum",
+            precision=decay_contract,
         )
         self.add_state(
-            "neg_buckets", default=jnp.zeros((self.num_buckets,), jnp.float32), dist_reduce_fx="sum"
+            "neg_buckets", default=jnp.zeros((self.num_buckets,), jnp.float32), dist_reduce_fx="sum",
+            precision=decay_contract,
         )
-        self.add_state("zero_count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("zero_count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum", precision=decay_contract)
         self.add_state("last_t", default=jnp.zeros((), jnp.float32), dist_reduce_fx="max")
 
     def update(self, t: Array, value: Array) -> None:

@@ -1,0 +1,76 @@
+"""The repo must stay numlint-clean: zero non-baselined NL violations.
+
+This is the enforcement point for long-horizon numerical soundness — any new
+unguarded traced division, single-pass ``E[x²]−E[x]²`` cancellation, unclamped
+domain-edge call, pinned-narrow sum accumulator, fold demotion, or undeclared
+float reassociation claim introduced under ``metrics_tpu/`` fails this test.
+Declared horizons/tolerances ride ``add_state(..., precision=...)`` (or the
+``# numlint: horizon=`` marker); exceptions belong in the ``rules`` section of
+``tools/numlint_baseline.json`` (regenerate with ``python tools/lint_metrics.py
+--pass numlint --update-baseline``) or behind an inline
+``# numlint: disable=RULE`` with a justification comment. The ``precision``
+section is equally empty — the x64-oracle harness agrees with the static
+verdicts and declared contracts everywhere.
+"""
+
+import json
+import os
+
+import pytest
+
+from metrics_tpu.analysis import (
+    NUM_RULE_CODES,
+    diff_against_baseline,
+    lint_paths,
+    load_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "numlint_baseline.json")
+
+
+@pytest.fixture(scope="module")
+def lint_result():
+    return lint_paths(
+        [os.path.join(REPO_ROOT, "metrics_tpu")], root=REPO_ROOT, rules=list(NUM_RULE_CODES)
+    )
+
+
+def test_every_module_parses(lint_result):
+    assert not lint_result.parse_errors, "\n".join(lint_result.parse_errors)
+    assert lint_result.files_scanned > 100  # the walk really covered the package
+
+
+def test_zero_non_baselined_violations(lint_result):
+    baseline = load_baseline(BASELINE_PATH, section="rules")
+    new, _, _ = diff_against_baseline(lint_result.violations, baseline)
+    assert not new, "new numlint violations (fix, declare, or baseline):\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_no_stale_baseline_entries(lint_result):
+    baseline = load_baseline(BASELINE_PATH, section="rules")
+    _, _, stale = diff_against_baseline(lint_result.violations, baseline)
+    assert not stale, f"stale baseline entries (remove them): {stale}"
+
+
+def test_both_baseline_sections_are_empty():
+    """The package carries zero numerical-soundness exceptions: every hazard is
+    either fixed (Welford moments, widened counters, compensated folds) or
+    declared at its `add_state` site. The precision section is equally empty —
+    the x64-oracle harness agrees with the static verdicts everywhere."""
+    with open(BASELINE_PATH, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc.get("rules") == {}
+    assert doc.get("precision") == {}
+
+
+def test_cli_exits_zero_against_baseline():
+    from metrics_tpu.analysis.cli import main
+
+    assert main(["--root", REPO_ROOT, os.path.join(REPO_ROOT, "metrics_tpu"), "--pass", "numlint", "-q"]) == 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

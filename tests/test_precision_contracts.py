@@ -1,0 +1,255 @@
+"""The dynamic precision-contract harness (``analysis/precision_contracts.py``).
+
+Synthetic Metric fixtures pin the runtime verdicts (STABLE / DRIFT / ERROR)
+and the three-way agreement logic (static ``classify_precision``, declared
+per-state ``precision=`` contracts, x32-vs-x64 oracle drift); the adversarial
+regimes carry the tentpole acceptance criteria — the Neumaier path tightens
+the large-offset mean error by >= 10^3x over the plain f32 fold, long-horizon
+sums keep below-ulp adds, the Welford restructure survives catastrophic
+cancellation, widened counters cross 2^31 without wrapping, and compensated
+decay folds track the oracle over 2048-step streams.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import Array
+
+from metrics_tpu import Metric
+from metrics_tpu.analysis.num_rules import classify_precision
+from metrics_tpu.analysis.precision_contracts import (
+    _REGIMES,
+    PrecisionResult,
+    check_precision_case,
+    check_regime,
+    diff_precision_baseline,
+    load_precision_baseline,
+    precision_cases,
+    write_precision_baseline,
+)
+from metrics_tpu.observe.costs import ProfileCase
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class PrecisionClean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, x: Array):
+        self.total = self.total + x.sum()
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1)
+
+
+class SinglePassVariance(Metric):
+    # fixture: the textbook E[x^2]-E[x]^2 cancellation (NL002), no contract —
+    # on a large-offset stream the x32 leg loses every significant digit
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sq_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x: Array):
+        self.total = self.total + x.sum()
+        self.sq_sum = self.sq_sum + (x * x).sum()
+        self.n = self.n + x.size
+
+    def compute(self):
+        mean = self.total / self.n
+        return self.sq_sum / self.n - mean**2
+
+
+class DeclaredSinglePassVariance(SinglePassVariance):
+    # same algebra, but the class owns the hazard through a per-state contract
+    def __init__(self, **kwargs):
+        Metric.__init__(self, **kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state(
+            "sq_sum", jnp.asarray(0.0), dist_reduce_fx="sum",
+            precision={"rtol": 1.0, "why": "fixture: single-pass form kept on purpose"},
+        )
+        self.add_state("n", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+
+def _case(ctor, name="HarnessCase", offset=0.0):
+    return ProfileCase(
+        name=name,
+        ctor=ctor,
+        batch=lambda rng: (np.float32(offset + rng.randn(8)),),
+    )
+
+
+# ------------------------------------------------------------------ verdicts
+def test_clean_class_reaches_three_way_agreement():
+    r = check_precision_case(_case(PrecisionClean))
+    assert r.agree, r.render()
+    assert r.runtime == "STABLE"
+    assert r.static_clean
+    assert r.render().startswith("ok ")
+
+
+def test_undeclared_drift_disagrees():
+    # offset 4e3: the f32 single-pass variance of unit-variance data loses
+    # most of its digits (sq_sum ~ 1.6e7 * n, variance ~ 1) while f64 is exact
+    r = check_precision_case(_case(SinglePassVariance, offset=4e3))
+    assert not r.agree, r.render()
+    assert r.runtime.startswith("DRIFT"), r.render()
+    assert not r.declared
+    assert r.render().startswith("DISAGREE")
+
+
+def test_declared_contract_covers_the_same_drift():
+    r = check_precision_case(_case(DeclaredSinglePassVariance, offset=4e3))
+    assert r.agree, r.render()
+    assert r.runtime.startswith("DRIFT"), r.render()
+    assert "sq_sum" in r.declared
+
+
+def test_broken_ctor_becomes_error_verdict_not_exception():
+    def boom():
+        raise RuntimeError("fixture ctor failure")
+
+    r = check_precision_case(_case(boom))
+    assert not r.agree
+    assert r.runtime == "ERROR:RuntimeError"
+    assert "fixture ctor failure" in r.detail
+
+
+def test_static_classifier_flags_single_pass_form():
+    clean, detail = classify_precision(SinglePassVariance)
+    assert not clean
+    assert "NL002" in detail
+    clean, detail = classify_precision(PrecisionClean)
+    assert clean, detail
+
+
+# ------------------------------------------------------------------ registry
+def test_precision_cases_are_the_jit_eligible_slice():
+    cases = precision_cases()
+    assert len(cases) >= 50
+    names = {c.name for c in cases}
+    assert "MeanSquaredError" in names
+
+
+@pytest.mark.slow
+def test_full_registry_three_way_agreement():
+    """Tentpole acceptance: every jit-eligible registry class agrees."""
+    results = [check_precision_case(c) for c in precision_cases()]
+    disagreements = [r.render() for r in results if not r.agree]
+    assert not disagreements, "\n".join(disagreements)
+    stable = sum(1 for r in results if r.runtime == "STABLE")
+    assert stable >= 40  # oracle-stable is the overwhelming norm
+
+
+# ------------------------------------------------------------------- regimes
+def test_compensated_mean_beats_plain_by_1e3():
+    """The acceptance criterion: on the adversarial large-offset stream the
+    Neumaier path's error is >= 10^3x below the plain f32 fold's."""
+    verdict, detail = _REGIMES["regime:mean_large_offset"]()
+    assert verdict == "STABLE", detail
+    ratio = float(detail.split("ratio=")[1].split()[0])
+    assert ratio >= 1e3, detail
+
+
+def test_long_horizon_sum_keeps_below_ulp_adds():
+    verdict, detail = _REGIMES["regime:sum_long_horizon"]()
+    assert verdict == "STABLE", detail
+
+
+def test_welford_variance_survives_large_offset():
+    verdict, detail = _REGIMES["regime:variance_cancellation"]()
+    assert verdict == "STABLE", detail
+
+
+def test_widened_counter_crosses_2_31_without_wrapping():
+    verdict, detail = _REGIMES["regime:counter_overflow"]()
+    assert verdict == "STABLE", detail
+    assert int(detail.split("max_cell=")[1].split()[0]) >= 2**31
+
+
+@pytest.mark.slow
+def test_compensated_decay_fold_tracks_oracle():
+    verdict, detail = _REGIMES["regime:decay_long_horizon"]()
+    assert verdict == "STABLE", detail
+
+
+def test_every_regime_has_a_three_way_verdict():
+    r = check_regime("regime:counter_overflow")
+    assert isinstance(r, PrecisionResult)
+    assert r.agree, r.render()
+
+
+# ------------------------------------------------------------------ baseline
+def _disagreement(name="Ghost"):
+    return PrecisionResult(name, False, "NL002", "", "DRIFT:2.0e-01", False)
+
+
+def _agreement(name="Fine"):
+    return PrecisionResult(name, True, "", "", "STABLE", True)
+
+
+def test_baseline_round_trip_preserves_rules_section(tmp_path):
+    path = str(tmp_path / "numlint_baseline.json")
+    written = write_precision_baseline(path, [_agreement(), _disagreement()])
+    assert set(written) == {"Ghost"}
+    assert load_precision_baseline(path) == written
+    # the writer seeds the static section so one file serves both owners
+    from metrics_tpu.analysis.engine import load_baseline_section
+
+    assert load_baseline_section(path, "rules") == {}
+
+
+def test_diff_baselined_disagreement_is_not_a_failure():
+    results = [_agreement(), _disagreement()]
+    failures, stale = diff_precision_baseline(results, {"Ghost": "known: fixture"})
+    assert failures == [] and stale == []
+    failures, _ = diff_precision_baseline(results, {})
+    assert [r.name for r in failures] == ["Ghost"]
+
+
+def test_diff_reports_stale_entries():
+    _, stale = diff_precision_baseline([_agreement("Fine")], {"Fine": "now agrees", "Gone": "?"})
+    assert stale == ["Fine", "Gone"]
+
+
+def test_run_precision_check_report_and_exit_codes(tmp_path, monkeypatch, capsys):
+    import metrics_tpu.analysis.precision_contracts as pc
+
+    monkeypatch.setattr(pc, "collect_precision_report", lambda root: [_agreement(), _disagreement()])
+    report = {}
+    rc = pc.run_precision_check(str(tmp_path), report=report)
+    assert rc == 1
+    assert report["cases"] == 2 and report["baselined"] == 0
+    assert report["failures"] and "Ghost" in report["failures"][0]
+    assert report["runtime_verdicts"] == {"Fine": "STABLE", "Ghost": "DRIFT:2.0e-01"}
+    assert capsys.readouterr().out == ""  # report mode: the caller owns stdout
+
+    # a justified baseline entry turns the same run green
+    path = str(tmp_path / "tools" / "numlint_baseline.json")
+    (tmp_path / "tools").mkdir()
+    write_precision_baseline(path, [_disagreement()])
+    assert pc.run_precision_check(str(tmp_path), quiet=True) == 0
+
+
+def test_checked_in_baseline_is_empty():
+    with open(os.path.join(REPO_ROOT, "tools", "numlint_baseline.json"), encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc.get("rules") == {}
+    assert doc.get("precision") == {}
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

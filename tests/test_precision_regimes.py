@@ -1,0 +1,163 @@
+"""x32-vs-x64 long-horizon parity for sketches and windows, plus the
+2^31-boundary regressions for every ``count_dtype()``-widened counter family
+(DESIGN §25).
+
+The parity tests replay one host-side stream through the production path
+(x32, jitted update) and the float64 eager oracle via the precision-contract
+harness's ``_run_stream`` and bound the divergence: DDSketch bucket drift is
+confined to values that straddle a bucket edge in one precision but not the
+other (so the quantile estimates stay within the α guarantee of each other),
+HyperLogLog registers are integer ``max`` algebra and must match exactly, and
+compensated decay folds track the oracle over streams far past the f32 ulp.
+The overflow tests pin the satellite-1 widening: under x64 every
+``count_dtype()`` counter is int64 and steps across 2^31 without wrapping.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import enable_x64
+
+import metrics_tpu.metric as metric_mod
+from metrics_tpu.aggregation import MeanMetric, SumMetric
+from metrics_tpu.analysis.precision_contracts import _max_rel_err, _run_stream
+from metrics_tpu.resilience.guards import GUARD_STATE, install_guard, poisoned_count
+from metrics_tpu.sketches import DDSketch, HyperLogLog
+from metrics_tpu.utils.compute import acc_dtype, count_dtype, neumaier_add, neumaier_value
+from metrics_tpu.windows import DecayedDDSketch, TimeDecayed
+
+
+@pytest.fixture
+def eager_x64():
+    """Force the eager path under x64 so injected int64 states survive update."""
+    saved = metric_mod._JIT_UPDATE_DEFAULT
+    metric_mod._JIT_UPDATE_DEFAULT = False
+    try:
+        with enable_x64():
+            yield
+    finally:
+        metric_mod._JIT_UPDATE_DEFAULT = saved
+
+
+# ---------------------------------------------------------------- sketches
+def test_ddsketch_long_horizon_bucket_drift_is_bounded():
+    rng = np.random.RandomState(0xDD5)
+    batches = [(rng.lognormal(0.0, 2.0, 512).astype(np.float32),) for _ in range(32)]
+    values = np.concatenate([np.float64(b[0]) for b in batches])
+
+    alpha = 0.01
+    ctor = lambda: DDSketch(alpha=alpha, quantiles=(0.5, 0.9, 0.99))  # noqa: E731
+    oracle = _run_stream(ctor, batches, x64=True)
+    probe = _run_stream(ctor, batches, x64=False)
+    # f32-vs-f64 key rounding can move edge-straddling values one bucket, so
+    # the legs may disagree by O(alpha) — never more
+    assert _max_rel_err(oracle, probe) <= 4 * alpha
+    # and both keep the sketch's own accuracy contract against exact quantiles
+    for leaves in (oracle, probe):
+        est = np.asarray(leaves[0], dtype=np.float64)
+        exact = np.quantile(values, [0.5, 0.9, 0.99])
+        assert (np.abs(est - exact) / exact <= 3 * alpha).all()
+
+
+def test_hll_estimate_is_precision_invariant():
+    # integer ids hash identically in both regimes: registers — and therefore
+    # the estimate — must agree to float roundoff, not just statistically
+    rng = np.random.RandomState(0x117)
+    batches = [(rng.randint(0, 50_000, 2048).astype(np.int32),) for _ in range(16)]
+    distinct = len(np.unique(np.concatenate([b[0] for b in batches])))
+
+    m = HyperLogLog(p=12)
+    oracle = _run_stream(lambda: HyperLogLog(p=12), batches, x64=True)
+    probe = _run_stream(lambda: HyperLogLog(p=12), batches, x64=False)
+    assert _max_rel_err(oracle, probe) <= 1e-5
+    est = float(np.asarray(probe[0]))
+    assert abs(est - distinct) / distinct <= 5 * m.std_error
+
+
+# ----------------------------------------------------------------- windows
+def test_time_decayed_compensated_fold_tracks_x64_oracle():
+    rng = np.random.RandomState(0x7D3)
+    n = 384
+    batches = [
+        (np.float32(i / 8.0), np.float32(1e4 + rng.standard_normal(16)))
+        for i in range(n)
+    ]
+    ctor = lambda c: lambda: TimeDecayed(  # noqa: E731
+        MeanMetric(nan_strategy="disable"), half_life_s=30.0, compensated=c
+    )
+    oracle = _run_stream(ctor(False), batches, x64=True)
+    comp = _run_stream(ctor(True), batches, x64=False)
+    assert _max_rel_err(oracle, comp) <= 1e-4
+
+
+def test_decayed_ddsketch_long_horizon_parity():
+    rng = np.random.RandomState(0xDCA)
+    n = 384
+    batches = [
+        (np.float32(i / 8.0), rng.lognormal(0.0, 1.0, 64).astype(np.float32))
+        for i in range(n)
+    ]
+    alpha = 0.02
+    ctor = lambda: DecayedDDSketch(  # noqa: E731
+        alpha=alpha, quantiles=(0.5, 0.9), half_life_s=20.0
+    )
+    oracle = _run_stream(ctor, batches, x64=True)
+    probe = _run_stream(ctor, batches, x64=False)
+    assert _max_rel_err(oracle, probe) <= 5 * alpha
+
+
+# ------------------------------------------------------- counter widening
+def test_count_dtype_follows_the_precision_regime():
+    assert count_dtype() == jnp.int32
+    assert acc_dtype() == jnp.float32
+    with enable_x64():
+        assert count_dtype() == jnp.int64
+        assert acc_dtype() == jnp.float64
+
+
+def test_ddsketch_counts_cross_2_31_without_wrapping(eager_x64):
+    m = DDSketch(quantiles=(0.5,))
+    assert m.zero_count.dtype == jnp.int64
+    seed = 2**31 - 2
+    m.__dict__["_state"]["zero_count"] = jnp.asarray(seed, dtype=jnp.int64)
+    m.update(jnp.zeros(8))
+    out = int(m.zero_count)
+    assert out == seed + 8
+    assert out > 2**31  # an int32 counter would have wrapped negative here
+
+
+def test_guard_poisoned_counter_crosses_2_31_without_wrapping(eager_x64):
+    m = install_guard(SumMetric(nan_strategy="disable"), policy="skip_batch")
+    assert m.__dict__["_state"][GUARD_STATE].dtype == jnp.int64
+    seed = 2**31 - 1
+    m.__dict__["_state"][GUARD_STATE] = jnp.asarray(seed, dtype=jnp.int64)
+    m.update(jnp.asarray([1.0, float("nan")]))
+    assert poisoned_count(m) == seed + 1 == 2**31
+
+
+# -------------------------------------------------------------- primitives
+def test_neumaier_pair_recovers_below_ulp_adds():
+    total = jnp.asarray(1e8, jnp.float32)
+    comp = jnp.zeros((), jnp.float32)
+    plain = total
+    one = jnp.asarray(1.0, jnp.float32)
+    for _ in range(1000):
+        total, comp = neumaier_add(total, comp, one)
+        plain = plain + one
+    assert float(plain) == 1e8  # every add fell below ulp(1e8) = 8
+    assert abs(float(neumaier_value(total, comp)) - (1e8 + 1000.0)) <= 8.0
+
+
+def test_neumaier_handles_value_larger_than_total():
+    # the improved-Kahan branch: |value| > |total| must not lose the total —
+    # classic Kahan drops it. The residual lands in `comp`; the f32 read-out
+    # fold still rounds, but the pair itself is exact in f64.
+    total, comp = neumaier_add(
+        jnp.asarray(1.0, jnp.float32), jnp.zeros((), jnp.float32), jnp.asarray(1e8, jnp.float32)
+    )
+    assert float(comp) == 1.0
+    assert float(total) + float(comp) == 1e8 + 1.0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

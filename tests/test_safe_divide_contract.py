@@ -1,0 +1,93 @@
+"""The `_safe_divide` contract, pinned across eager / jit / x64 (DESIGN §25).
+
+``metrics_tpu.utils.compute._safe_divide`` documents exactly three promises:
+``x / 0 -> zero_division`` for every ``x`` (``0 / 0`` included, never
+``nan``/``inf`` from a zero denominator), finite gradients through the masked
+lane, and ``result_type(num, denom, float32)`` output dtype. Every aggregate
+boundary in the package leans on those semantics, so they are pinned here in
+one parametrized matrix rather than re-proved ad hoc per metric.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import enable_x64
+
+from metrics_tpu.utils.compute import _safe_divide
+
+# (num, denom, expected with zero_division=0.0)
+CASES = [
+    ("plain", [1.0, 6.0], [2.0, 3.0], [0.5, 2.0]),
+    ("x_over_zero", [1.0, -3.0], [0.0, 0.0], [0.0, 0.0]),
+    ("zero_over_zero", [0.0], [0.0], [0.0]),
+    ("mixed_lanes", [4.0, 5.0, 0.0], [2.0, 0.0, 0.0], [2.0, 0.0, 0.0]),
+    ("int_inputs", [3, 1], [2, 0], [1.5, 0.0]),
+    ("inf_num_zero_denom", [np.inf], [0.0], [0.0]),
+]
+
+MODES = ["eager", "jit", "x64_eager", "x64_jit"]
+
+
+def _run(mode, num, denom, zero_division=0.0):
+    fn = lambda n, d: _safe_divide(n, d, zero_division)  # noqa: E731
+    if mode == "eager":
+        return fn(jnp.asarray(num), jnp.asarray(denom))
+    if mode == "jit":
+        return jax.jit(fn)(jnp.asarray(num), jnp.asarray(denom))
+    with enable_x64():
+        if mode == "x64_eager":
+            return np.asarray(fn(jnp.asarray(num), jnp.asarray(denom)))
+        return np.asarray(jax.jit(fn)(jnp.asarray(num), jnp.asarray(denom)))
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("name,num,denom,expected", CASES, ids=[c[0] for c in CASES])
+def test_zero_denominator_semantics(mode, name, num, denom, expected):
+    out = np.asarray(_run(mode, num, denom))
+    assert np.isfinite(out).all(), f"{name}/{mode}: {out}"
+    np.testing.assert_allclose(out, np.asarray(expected), rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_custom_zero_division_fill(mode):
+    out = np.asarray(_run(mode, [1.0, 1.0], [0.0, 4.0], zero_division=7.5))
+    np.testing.assert_allclose(out, [7.5, 0.25], rtol=1e-6)
+
+
+def test_output_dtype_follows_result_type():
+    assert _safe_divide(jnp.array([1.0]), jnp.array([2.0])).dtype == jnp.float32
+    assert _safe_divide(jnp.array([1]), jnp.array([2])).dtype == jnp.float32
+    with enable_x64():
+        # 64-bit inputs keep 64-bit output — integers are never truncated
+        # through a float32 bottleneck under x64
+        assert _safe_divide(jnp.array([1.0]), jnp.array([2.0])).dtype == jnp.float64
+        assert _safe_divide(jnp.array([1]), jnp.array([2])).dtype == jnp.float64
+        big = 2**53 + 2  # exactly representable in f64, rounds in f32
+        out = _safe_divide(jnp.array([big], dtype=jnp.int64), jnp.array([2], dtype=jnp.int64))
+        assert float(out[0]) == big / 2
+
+
+def test_gradient_through_masked_lane_is_finite():
+    def loss(n, d):
+        return _safe_divide(n, d).sum()
+
+    g_n, g_d = jax.grad(loss, argnums=(0, 1))(
+        jnp.array([1.0, 1.0]), jnp.array([0.0, 2.0])
+    )
+    assert np.isfinite(np.asarray(g_n)).all()
+    assert np.isfinite(np.asarray(g_d)).all()
+
+
+def test_eager_and_jit_agree_bitwise():
+    num = jnp.asarray(np.random.RandomState(7).randn(64).astype(np.float32))
+    denom = jnp.asarray(
+        np.where(np.arange(64) % 5 == 0, 0.0, np.random.RandomState(8).randn(64)).astype(np.float32)
+    )
+    eager = np.asarray(_safe_divide(num, denom))
+    jitted = np.asarray(jax.jit(_safe_divide)(num, denom))
+    np.testing.assert_array_equal(eager, jitted)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

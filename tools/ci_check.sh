@@ -1,8 +1,17 @@
 #!/usr/bin/env bash
 # One CI entry point, one verdict: every static lint pass (jitlint + distlint
-# + donlint + hotlint, the last covering host-sync & dispatch-economy rules
-# HL001–HL006 over the hot-path modules, baselined expected-empty in
-# tools/hotlint_baseline.json), the telemetry overhead smoke (disabled-mode
+# + donlint + hotlint + numlint — hotlint covering host-sync & dispatch-economy
+# rules HL001–HL006 over the hot-path modules, baselined expected-empty in
+# tools/hotlint_baseline.json; numlint covering numerical-soundness rules
+# NL001–NL006 — unguarded division, cancellation, domain edges, narrow
+# accumulators, fold demotion, undeclared reassociation — baselined
+# expected-empty in the `rules` section of tools/numlint_baseline.json),
+# the precision-contract cross-check (every jit-eligible class replayed
+# through the x32 jitted path vs a float64 eager oracle plus adversarial
+# large-offset / long-horizon / cancellation / 2^31-overflow / decay regimes,
+# with static verdict, declared per-state precision= contract and observed
+# drift in three-way agreement against the `precision` section of the same
+# baseline), the telemetry overhead smoke (disabled-mode
 # cost pin plus the enabled-watchdog sampling budget and the enabled-meter
 # attribution budget: per-session dispatch share, loose path, rate-limited
 # quota poll), the donation
