@@ -78,6 +78,13 @@ SHARDED_BATCH = 16
 SHARDED_CAPACITY = 1 << 14
 SHARDED_RECOVERY_PER_SHARD = 900
 SHARDED_RECOVERY_RATIO_MAX = 3.0
+SERVE_SESSIONS = 96
+SERVE_CAPACITY = 128
+SERVE_STEADY_TICKS = 10
+SERVE_WARMUP_TICKS = 3
+SERVE_OVERLOAD_ARRIVALS = 30
+SERVE_BATCH = 16
+SERVE_P99_TICK_MS_MAX = 500.0
 
 
 # ----------------------------------------------------------------- roofline
@@ -1272,6 +1279,242 @@ def bench_sketches(with_ref: bool = True):
     }
 
 
+def bench_serve_soak(with_ref: bool = True):
+    """Serve front door (``serve/``, DESIGN §26): sustained mixed churn through
+    a real loopback socket — arrivals, submit waves, poison records, an abrupt
+    producer disconnect + reconnect-with-resend, and one forced overload leg
+    that must trip all three autonomic reflex rungs (capacity double, quota
+    demote, loose-first shed). Asserts bounded p99 tick latency, zero
+    steady-state recompiles, an alert-free watchdog at the end, and bit-exact
+    state vs a never-shed oracle for every surviving session. No torch analog;
+    reports ingest/admission/reflex numbers and stays out of the geomean."""
+    import shutil
+    import tempfile
+
+    from metrics_tpu import observe
+    from metrics_tpu.classification import MulticlassAccuracy
+    from metrics_tpu.engine import StreamEngine
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.observe import recorder as rec_mod
+    from metrics_tpu.observe.metering import MeterPolicy
+    from metrics_tpu.serve.admission import AdmissionController, AdmissionRule, DEFAULT_ADMISSION_TABLE
+    from metrics_tpu.serve.autonomic import AutonomicController
+    from metrics_tpu.serve.protocol import Producer
+    from metrics_tpu.serve.server import MetricsServer
+
+    rng = np.random.default_rng(23)
+    ctor = lambda: MulticlassAccuracy(num_classes=8, validate_args=False)  # noqa: E731
+    pool = [
+        (rng.integers(0, 8, SERVE_BATCH), rng.integers(0, 8, SERVE_BATCH)) for _ in range(16)
+    ]
+
+    saved_enabled, saved_recorder = rec_mod.ENABLED, rec_mod.RECORDER
+    probe = rec_mod.Recorder()
+    rec_mod.RECORDER, rec_mod.ENABLED = probe, True
+    _FLEET_JIT_CACHE.clear()
+    # soak-local watchdog + quota meter: the demote reflex rides the meter's
+    # pending-demotion handshake, fed by per-session update counts
+    saved_wd = observe.installed_watchdog()
+    observe.install_watchdog(min_interval_s=0.0)
+    observe.install_meter(
+        top_k=256, policy=MeterPolicy(max_updates=SERVE_STEADY_TICKS * 3, action="demote")
+    )
+    tmp = tempfile.mkdtemp(prefix="bench_serve_soak_")
+    try:
+        engine = StreamEngine(
+            initial_capacity=SERVE_CAPACITY, wal_path=os.path.join(tmp, "serve.wal")
+        )
+        autonomic = AutonomicController(
+            engine, min_interval_s={"double": 0.0, "demote": 0.0, "resize": 0.0, "shed": 0.0}
+        )
+        server = MetricsServer(engine, "soak-key", host="127.0.0.1", autonomic=autonomic)
+        drive = lambda _t=None: server.poll(0.0)  # noqa: E731
+        prod = Producer(server.address, "soak-key", name="soak-a", drive=drive)
+        flaky = Producer(server.address, "soak-key", name="soak-b", drive=drive)
+
+        oracles = {}
+        for i in range(SERVE_SESSIONS):
+            sid = f"s{i}"
+            prod.add_session(ctor(), session_id=sid)
+            oracles[sid] = ctor()
+        prod.flush(30.0)
+
+        # abrupt disconnect: the flaky producer queues records, its socket dies
+        # mid-window, and the reconnect resends everything unacked — the
+        # watermark turns anything the server already journaled into dups.
+        # (This leg runs before the hot sessions breach their quota: once any
+        # session is permanently over a cumulative max_updates quota, the
+        # default table's quota_pressure row defers every later arrival.)
+        flaky.add_session(ctor(), session_id="flaky-s")
+        flaky.flush(30.0)
+        for _ in range(4):
+            flaky.submit("flaky-s", *pool[0])
+        flaky.pump()
+        server.poll(0.0)  # journal + ack what arrived; acks are lost below
+        flaky._sock.close()
+        flaky.reconnect()
+        flaky.flush(30.0)
+        oracles["flaky-s"] = ctor()
+        for _ in range(4):
+            oracles["flaky-s"].update(*pool[0])
+
+        # two hot sessions get triple traffic so only they breach the quota
+        hot = ["s0", "s1"]
+
+        tick_walls = []
+        compiles_at_steady = None
+        for t in range(SERVE_WARMUP_TICKS + SERVE_STEADY_TICKS):
+            for i, sid in enumerate(list(oracles)):
+                args = pool[(i + t) % 16]
+                reps = 3 if sid in hot else 1
+                for _ in range(reps):
+                    prod.submit(sid, *args)
+                    oracles[sid].update(*args)
+            prod.flush(30.0)
+            start = time.perf_counter()
+            server.tick()
+            tick_walls.append(time.perf_counter() - start)
+            if t == SERVE_WARMUP_TICKS - 1:
+                compiles_at_steady = sum(
+                    v for (n, _l), v in probe.counters.items() if n == "fleet_compile"
+                )
+        steady_recompiles = (
+            sum(v for (n, _l), v in probe.counters.items() if n == "fleet_compile")
+            - compiles_at_steady
+        )
+
+        # poison: records for a session that does not exist — per-record "err"
+        # acks, the connection (and the fleet) survive
+        poison_pseq = prod.submit("no-such-session", *pool[0])
+        prod.flush(30.0)
+        poison_errs = [e for e in prod.errors if e[0] >= poison_pseq]
+        server.tick()
+
+        # forced overload: a burst of arrivals pushes occupancy over the double
+        # threshold; a shed-on-arrival admission table exercises the shed rung;
+        # the hot sessions' quota breach drives the demote rung
+        server.admission = AdmissionController(
+            (AdmissionRule("forced_overload", "occupancy_pct", ">=", 0.0, "shed", None),)
+        )
+        for i in range(SERVE_OVERLOAD_ARRIVALS):
+            sid = f"burst{i}"
+            prod.add_session(ctor(), session_id=sid)
+            oracles[sid] = ctor()
+        prod.flush(30.0)
+        mt = observe.installed_meter()
+        deadline = time.perf_counter() + 10.0
+        extra = 0
+        while (
+            autonomic.counts["double"] < 1
+            or autonomic.counts["demote"] < 1
+            or autonomic.counts["shed"] < 1
+        ) and time.perf_counter() < deadline:
+            # each extra arrival carries the forced shed verdict, so once the
+            # demote rung has produced loose sessions the shed rung fires
+            sid = f"extra{extra}"
+            extra += 1
+            prod.add_session(ctor(), session_id=sid)
+            oracles[sid] = ctor()
+            for sid in hot:
+                if sid in engine._sessions:
+                    prod.submit(sid, *pool[0])
+                    oracles[sid].update(*pool[0])
+            prod.flush(30.0)
+            # reopen the meter's rate-limited scan window right before the
+            # tick: the autonomic step inside the tick's poll is then
+            # deterministically the poll that sees the quota breach, not the
+            # engine's own post-dispatch quota walk
+            mt._last_poll = 0.0
+            server.tick()
+        reflexes = dict(autonomic.counts)
+
+        # recover: default admission back, drain to a clean steady state
+        server.admission = AdmissionController(DEFAULT_ADMISSION_TABLE)
+        for t in range(3):
+            for i, sid in enumerate(list(engine._sessions)):
+                if sid in oracles:
+                    args = pool[(i + t) % 16]
+                    prod.submit(sid, *args)
+                    oracles[sid].update(*args)
+            prod.flush(30.0)
+            server.tick()
+        health = observe.installed_watchdog().health()
+
+        # bit-exact vs the never-shed oracle: every surviving session's state
+        # matches an oracle fed the identical batches; shed sessions are gone
+        # from the fleet but their oracles never were — survivors must not
+        # have been perturbed by the sheds around them
+        bit_exact = True
+        survivors = 0
+        for sid, sess in engine._sessions.items():
+            oracle = oracles.get(sid)
+            if oracle is None:
+                continue
+            survivors += 1
+            row = (
+                sess.metric._state
+                if sess.bucket is None
+                else {k: v[sess.slot] for k, v in sess.bucket.stacked.items()}
+            )
+            for k, ref in oracle._state.items():
+                if not np.array_equal(np.asarray(row[k]), np.asarray(ref)):
+                    bit_exact = False
+
+        steady_ms = sorted(1000 * w for w in tick_walls[SERVE_WARMUP_TICKS:])
+        p99_ms = steady_ms[min(len(steady_ms) - 1, int(0.99 * len(steady_ms)))]
+        stats = server.stats()
+        # verdict totals from the recorder, not the controller: the overload
+        # leg swapped admission tables, and each controller counts only its own
+        admission = {
+            verdict: sum(
+                c for (n, lbl), c in probe.counters.items()
+                if n == "serve_admission" and lbl == verdict
+            )
+            for verdict in ("accept", "defer", "shed", "reject")
+        }
+        prod.close()
+        flaky.close()
+        server.close()
+    finally:
+        observe.uninstall_meter()
+        observe.uninstall_watchdog()
+        if saved_wd is not None:
+            observe.install_watchdog(saved_wd)
+        rec_mod.RECORDER, rec_mod.ENABLED = saved_recorder, saved_enabled
+        _FLEET_JIT_CACHE.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # the soak's contract, checked from live state:
+    assert p99_ms <= SERVE_P99_TICK_MS_MAX, (p99_ms, steady_ms)
+    assert steady_recompiles == 0, steady_recompiles
+    assert not health["firing"], health
+    assert poison_errs, "poison records produced no err acks"
+    assert reflexes["double"] >= 1, reflexes
+    assert reflexes["demote"] >= 1, reflexes
+    assert reflexes["shed"] >= 1, reflexes
+    assert bit_exact, "surviving sessions diverged from the never-shed oracle"
+    return {
+        "sessions_final": survivors,
+        "steady_ticks": SERVE_STEADY_TICKS,
+        "p99_tick_ms": round(p99_ms, 3),
+        "steady_recompiles": steady_recompiles,
+        "frames_total": stats["frames_total"],
+        "bytes_in_total": stats["bytes_in_total"],
+        "dedup_skipped": stats["dedup_skipped"],
+        "admission": admission,
+        "autonomic": reflexes,
+        "poison_errs": len(poison_errs),
+        "watchdog_firing": health["firing"],
+        "bit_exact_vs_never_shed_oracle": bit_exact,
+        "workload": (
+            f"{SERVE_SESSIONS}+{SERVE_OVERLOAD_ARRIVALS} sessions over loopback TCP x "
+            f"{SERVE_WARMUP_TICKS + SERVE_STEADY_TICKS} ticks with poison, disconnect+resend "
+            "and one forced overload->shed->recover cycle "
+            "[all 3 reflex rungs, bit-exact survivors; not in geomean]"
+        ),
+    }
+
+
 def _drain_flight(cap: int = 24):
     """Per-config flight-recorder digest: drain the span ring accumulated by
     the config that just ran and fold it into {span count, per-phase wall +
@@ -1554,6 +1797,12 @@ def main():
         configs["cold_start"] = {"error": f"{type(err).__name__}: {err}"}
     _attach_flight(configs, "cold_start")
     _attach_watchdog(configs, "cold_start")
+    # serve front door: loopback soak with forced overload + autonomic reflexes
+    try:
+        configs["serve_soak"] = bench_serve_soak(with_ref=with_ref)
+    except Exception as err:  # noqa: BLE001
+        configs["serve_soak"] = {"error": f"{type(err).__name__}: {err}"}
+    _attach_flight(configs, "serve_soak")
     snap = observe.snapshot()
     if with_ref:
         geomean = math.exp(sum(math.log(s) for s in speedups) / len(speedups)) if speedups else -1.0
@@ -1584,5 +1833,8 @@ def main():
 if __name__ == "__main__":
     if "--sharded-child" in sys.argv[1:]:
         _bench_fleet_sharded_child()
+    elif "serve_soak" in sys.argv[1:]:
+        # just the serve front-door soak, one JSON line (`bench.py serve_soak`)
+        print(json.dumps({"serve_soak": bench_serve_soak()}, sort_keys=True))
     else:
         main()

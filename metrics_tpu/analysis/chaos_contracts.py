@@ -47,12 +47,23 @@ bit-exact AND keeps its one-dispatch-per-bucket-per-tick economy; a torn
 manifest must be rejected outright; and an elastic resize (grow and shrink)
 must re-route every session bit-exactly versus the never-crashed oracle.
 
+A fourth suite covers the network front door's DESIGN §26 contract
+(:func:`check_serve_chaos_case`): a producer that dies mid-frame must leave
+the engine holding exactly the acked records (the torn tail never decodes,
+zero protocol errors); a frame torn at a socket read boundary must apply
+exactly once when the remainder arrives, and framing damage (bit-flipped CRC)
+must keep every intact record decoded before it while the connection drops;
+a byte-identical replayed ``pseq`` must dedup against the shard's per-producer
+watermark (state bit-exact vs a once-applied oracle); and an autonomic
+demote/shed racing an expiry of its target must confirm the ghost without
+wedging the meter handshake or perturbing surviving sessions.
+
 Every broken promise is a violation keyed by class name, baselined in the
-``chaos`` (metric faults), ``fleet`` (engine recovery) and ``shard`` (sharded
-fleet) sections of ``tools/chaos_baseline.json`` (expected empty; every entry
-needs a justification string). Runs as the ``chaos`` pass of
-``tools/lint_metrics --all`` / the ``chaoslint`` console script and standalone
-via ``python -m metrics_tpu.analysis.chaos_contracts``.
+``chaos`` (metric faults), ``fleet`` (engine recovery), ``shard`` (sharded
+fleet) and ``serve`` (front door) sections of ``tools/chaos_baseline.json``
+(expected empty; every entry needs a justification string). Runs as the
+``chaos`` pass of ``tools/lint_metrics --all`` / the ``chaoslint`` console
+script and standalone via ``python -m metrics_tpu.analysis.chaos_contracts``.
 """
 
 from __future__ import annotations
@@ -66,6 +77,7 @@ __all__ = [
     "chaos_cases",
     "check_chaos_case",
     "check_fleet_chaos_case",
+    "check_serve_chaos_case",
     "check_shard_chaos_case",
     "diff_chaos_baseline",
     "main",
@@ -1080,6 +1092,276 @@ def collect_shard_chaos_report(cases: Optional[Sequence[Any]] = None) -> List[Ch
     return [check_shard_chaos_case(c) for c in (cases if cases is not None else chaos_cases())]
 
 
+# ----------------------------------------------------- serve front-door suite
+_SERVE_KEY = "chaos-serve-key"
+
+
+def _serve_rig(tmp: str, sub: str, autonomic: bool = False) -> Tuple[Any, Any, Any, Any]:
+    """A listener-less server over one half of a socketpair, WAL on disk.
+
+    Returns ``(engine, server, client_socket, autonomic_or_None)`` — the
+    harness drives the client end with raw bytes (no :class:`Producer`: the
+    scenarios need frame surgery a well-behaved producer cannot perform).
+    """
+    import socket
+
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.serve.autonomic import AutonomicController
+    from metrics_tpu.serve.server import MetricsServer
+
+    engine = StreamEngine(wal_path=os.path.join(tmp, f"{sub}.wal"))
+    auto = (
+        AutonomicController(
+            engine, min_interval_s={"double": 0.0, "demote": 0.0, "resize": 0.0, "shed": 0.0}
+        )
+        if autonomic
+        else None
+    )
+    server = MetricsServer(engine, _SERVE_KEY, host=None, autonomic=auto, name=f"chaos-{sub}")
+    srv_sock, cli = socket.socketpair()
+    server.adopt(srv_sock)
+    cli.setblocking(False)
+    return engine, server, cli, auto
+
+
+def _serve_hello(producer: str = "chaos") -> bytes:
+    from metrics_tpu.serve.protocol import PROTO_VERSION, WAL_MAGIC, encode_frame
+
+    return WAL_MAGIC + encode_frame(
+        "hello", 0, producer, {"key": _SERVE_KEY, "producer": producer, "proto": PROTO_VERSION}
+    )
+
+
+def _serve_np(batch: Tuple[Any, ...]) -> Tuple[Any, ...]:
+    """Host copies of a case batch: what a remote producer would pickle."""
+    import jax
+    import numpy as np
+
+    return tuple(
+        np.asarray(jax.device_get(a)) if hasattr(a, "shape") else a for a in batch
+    )
+
+
+def _serve_oracle(case: Any, batches: Sequence[Tuple[Any, ...]]) -> str:
+    """Fingerprint of a never-networked engine fed the same records."""
+    from metrics_tpu.engine.stream import StreamEngine
+
+    eng = StreamEngine()
+    eng.add_session(case.ctor(), "s0")
+    for batch in batches:
+        eng.submit("s0", *batch)
+    eng.tick()
+    return eng.expire("s0").state_fingerprint()
+
+
+def _serve_scenario_mid_frame(case: Any, tmp: str) -> List[str]:
+    """Producer dies mid-frame: the engine must hold exactly the acked
+    records — the torn tail never decodes and is not a framing error."""
+    from metrics_tpu.serve.protocol import encode_frame
+
+    engine, server, cli, _ = _serve_rig(tmp, "mid_frame")
+    out: List[str] = []
+    try:
+        script = [_serve_np(case.batch(_rng_for(case))) for _ in range(4)]
+        blob = _serve_hello() + encode_frame("add", 1, "s0", case.ctor())
+        for i, batch in enumerate(script[:3]):
+            blob += encode_frame("submit", 2 + i, "s0", (batch, {}))
+        cli.sendall(blob)
+        server.poll(0.0)  # all four records applied, journaled, acked
+        torn = encode_frame("submit", 5, "s0", (script[3], {}))
+        cli.sendall(torn[: len(torn) // 2])
+        cli.close()
+        server.poll(0.0)  # reads the half frame, then EOF
+        engine.tick()
+        if server.protocol_errors:
+            out.append("serve_kill[mid_frame]: a torn tail at EOF is not a framing error")
+        if server.disconnects != 1:
+            out.append(f"serve_kill[mid_frame]: {server.disconnects} disconnects, expected 1")
+        got = engine.expire("s0").state_fingerprint()
+        if got != _serve_oracle(case, script[:3]):
+            out.append("serve_kill[mid_frame]: state not bit-exact vs the acked-records oracle")
+    finally:
+        server.close()
+    return out
+
+
+def _serve_scenario_torn_boundary(case: Any, tmp: str) -> List[str]:
+    """A frame split across two reads applies exactly once; a bit-flipped CRC
+    keeps the intact records decoded before it and drops the connection."""
+    from metrics_tpu.serve.protocol import encode_frame
+
+    engine, server, cli, _ = _serve_rig(tmp, "torn_boundary")
+    out: List[str] = []
+    try:
+        script = [_serve_np(case.batch(_rng_for(case))) for _ in range(3)]
+        cli.sendall(_serve_hello() + encode_frame("add", 1, "s0", case.ctor()))
+        server.poll(0.0)
+        split = encode_frame("submit", 2, "s0", (script[0], {}))
+        cli.sendall(split[:7])  # mid-header: not even the length is whole
+        server.poll(0.0)
+        cli.sendall(split[7:])
+        server.poll(0.0)
+        good = encode_frame("submit", 3, "s0", (script[1], {}))
+        bad = bytearray(encode_frame("submit", 4, "s0", (script[2], {})))
+        bad[-1] ^= 0xFF  # body bit-flip: the CRC no longer matches
+        cli.sendall(good + bytes(bad))
+        server.poll(0.0)
+        engine.tick()
+        if server.protocol_errors != 1:
+            out.append(
+                f"serve_torn[boundary]: {server.protocol_errors} framing errors, expected 1"
+            )
+        if server.disconnects != 1:
+            out.append("serve_torn[boundary]: damaged framing must drop the connection")
+        got = engine.expire("s0").state_fingerprint()
+        if got != _serve_oracle(case, script[:2]):
+            out.append(
+                "serve_torn[boundary]: state not bit-exact vs the intact-records oracle"
+            )
+    finally:
+        server.close()
+    return out
+
+
+def _serve_scenario_dup_replay(case: Any, tmp: str) -> List[str]:
+    """A byte-identical replayed ``pseq`` dedups against the shard watermark:
+    applied exactly once, acked ``dup``, state bit-exact."""
+    from metrics_tpu.serve.protocol import encode_frame
+
+    engine, server, cli, _ = _serve_rig(tmp, "dup_replay")
+    out: List[str] = []
+    try:
+        batch = _serve_np(case.batch(_rng_for(case)))
+        frame = encode_frame("submit", 2, "s0", (batch, {}))
+        cli.sendall(_serve_hello() + encode_frame("add", 1, "s0", case.ctor()) + frame)
+        server.poll(0.0)
+        cli.sendall(frame)  # the replay: same bytes, same pseq
+        server.poll(0.0)
+        engine.tick()
+        if server.dedup_skipped != 1:
+            out.append(
+                f"serve_dup[replay]: {server.dedup_skipped} dedups, expected exactly 1"
+            )
+        if engine.serve_watermark("chaos") != 2:
+            out.append(
+                f"serve_dup[replay]: watermark {engine.serve_watermark('chaos')}, expected 2"
+            )
+        got = engine.expire("s0").state_fingerprint()
+        if got != _serve_oracle(case, [batch]):
+            out.append("serve_dup[replay]: state not bit-exact vs the once-applied oracle")
+    finally:
+        server.close()
+    return out
+
+
+def _serve_scenario_autonomic_race(case: Any, tmp: str) -> List[str]:
+    """An autonomic demote/shed whose target expires first must confirm the
+    ghost (handshake cannot wedge) and leave survivors untouched."""
+    from metrics_tpu import observe
+    from metrics_tpu.observe.metering import MeterPolicy
+    from metrics_tpu.serve.protocol import encode_frame
+
+    engine, server, cli, auto = _serve_rig(tmp, "autonomic_race", autonomic=True)
+    saved_meter = observe.installed_meter()
+    mt = observe.install_meter(top_k=8, policy=MeterPolicy(action="demote"))
+    out: List[str] = []
+    try:
+        batch = _serve_np(case.batch(_rng_for(case)))
+        cli.sendall(
+            _serve_hello()
+            + encode_frame("add", 1, "s0", case.ctor())
+            + encode_frame("add", 2, "s1", case.ctor())
+            + encode_frame("submit", 3, "s0", (batch, {}))
+        )
+        server.poll(0.0)
+        engine.tick()
+        survivor = engine._sessions["s0"].metric.state_fingerprint()
+        engine._demote_session(engine._sessions["s1"])  # the shed candidate
+        # inject the race: the meter queues a demotion for s1, then the
+        # expiry lands before the reflex runs — step() must confirm the ghost
+        with mt._lock:
+            mt._pending_demote.add("s1")
+        cli.sendall(encode_frame("expire", 4, "s1"))
+        server.poll(0.0)  # applies the expiry, then runs autonomic.step()
+        if mt.pending_demotions():
+            out.append(
+                f"serve_race[expire]: handshake wedged on {mt.pending_demotions()}"
+            )
+        # and the on-demand shed path, with the only loose session gone
+        if auto.shed(1, reason="chaos"):
+            out.append("serve_race[expire]: shed returned a session that no longer exists")
+        engine.tick()
+        if engine._sessions["s0"].metric.state_fingerprint() != survivor:
+            out.append("serve_race[expire]: the race perturbed a surviving session")
+    finally:
+        observe.uninstall_meter()
+        if saved_meter is not None:
+            observe.install_meter(saved_meter)
+        server.close()
+    return out
+
+
+def check_serve_chaos_case(case: Any) -> ChaosResult:
+    """One class through the front-door scenarios; never raises."""
+    import tempfile
+
+    import metrics_tpu.metric as metric_mod
+    from metrics_tpu.engine.core import _FLEET_JIT_CACHE
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.metric import _SHARED_JIT_CACHE, clear_jit_cache
+    from metrics_tpu.observe import recorder as _observe
+
+    probe = _observe.Recorder()
+    saved_cache = dict(_SHARED_JIT_CACHE)
+    saved_enabled = _observe.ENABLED
+    saved_jit = metric_mod._JIT_UPDATE_DEFAULT
+    saved_donate = metric_mod._DONATE_UPDATE_DEFAULT
+    real = _observe.RECORDER
+    _observe.RECORDER = probe
+    violations: List[str] = []
+    ran: List[str] = []
+    skipped: List[str] = []
+    try:
+        _observe.ENABLED = True
+        metric_mod._JIT_UPDATE_DEFAULT = True
+        metric_mod._DONATE_UPDATE_DEFAULT = True
+        clear_jit_cache()
+        _FLEET_JIT_CACHE.clear()
+
+        probe_engine = StreamEngine()
+        sid = probe_engine.add_session(case.ctor())
+        bucketable = probe_engine._sessions[sid].bucket is not None
+        probe_engine.expire(sid)
+        if not bucketable:
+            return ChaosResult(case.name, (), ("serve",), ())
+
+        with tempfile.TemporaryDirectory(prefix="chaos_serve_") as tmp:
+            violations += _serve_scenario_mid_frame(case, tmp)
+            ran.append("serve_kill[mid_frame]")
+            violations += _serve_scenario_torn_boundary(case, tmp)
+            ran.append("serve_torn[boundary]")
+            violations += _serve_scenario_dup_replay(case, tmp)
+            ran.append("serve_dup[replay]")
+            violations += _serve_scenario_autonomic_race(case, tmp)
+            ran.append("serve_race[expire]")
+    except Exception as exc:  # noqa: BLE001 — a crash in the harness is itself a verdict
+        violations.append(f"harness: {type(exc).__name__}: {str(exc)[:200]}")
+    finally:
+        _observe.RECORDER = real
+        _observe.ENABLED = saved_enabled
+        metric_mod._JIT_UPDATE_DEFAULT = saved_jit
+        metric_mod._DONATE_UPDATE_DEFAULT = saved_donate
+        clear_jit_cache()
+        _FLEET_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.clear()
+        _SHARED_JIT_CACHE.update(saved_cache)
+    return ChaosResult(case.name, tuple(ran), tuple(skipped), tuple(violations))
+
+
+def collect_serve_chaos_report(cases: Optional[Sequence[Any]] = None) -> List[ChaosResult]:
+    return [check_serve_chaos_case(c) for c in (cases if cases is not None else chaos_cases())]
+
+
 # ------------------------------------------------------------------- baseline
 def load_chaos_baseline(path: str, section: str = "chaos") -> Dict[str, str]:
     from metrics_tpu.analysis.engine import load_baseline_section
@@ -1128,22 +1410,26 @@ def run_chaos_check(
 ) -> int:
     """The ``chaos`` pass of ``lint_metrics --all``: inject, verify, verdict.
 
-    Runs all THREE suites — the per-metric fault taxonomy (baselined under
-    ``chaos``), the fleet durability scenarios (baselined under ``fleet``) and
-    the sharded-fleet scenarios (baselined under ``shard``).
+    Runs all FOUR suites — the per-metric fault taxonomy (baselined under
+    ``chaos``), the fleet durability scenarios (baselined under ``fleet``),
+    the sharded-fleet scenarios (baselined under ``shard``) and the network
+    front-door scenarios (baselined under ``serve``).
     """
     path = baseline_path or os.path.join(root, _DEFAULT_BASELINE)
     results = collect_chaos_report()
     fleet_results = collect_fleet_chaos_report()
     shard_results = collect_shard_chaos_report()
+    serve_results = collect_serve_chaos_report()
     if update_baseline:
         chaos = write_chaos_baseline(path, results, section="chaos")
         fleet = write_chaos_baseline(path, fleet_results, section="fleet")
         shard = write_chaos_baseline(path, shard_results, section="shard")
+        serve = write_chaos_baseline(path, serve_results, section="serve")
         if not quiet:
             print(
                 f"chaos: baseline written to {path} "
-                f"({len(chaos)} chaos / {len(fleet)} fleet / {len(shard)} shard violation(s))"
+                f"({len(chaos)} chaos / {len(fleet)} fleet / {len(shard)} shard / "
+                f"{len(serve)} serve violation(s))"
             )
         return 0
     failures, stale = diff_chaos_baseline(results, load_chaos_baseline(path, "chaos"))
@@ -1152,6 +1438,9 @@ def run_chaos_check(
     )
     shard_failures, shard_stale = diff_chaos_baseline(
         shard_results, load_chaos_baseline(path, "shard")
+    )
+    serve_failures, serve_stale = diff_chaos_baseline(
+        serve_results, load_chaos_baseline(path, "serve")
     )
     if report is not None:
         report.update(
@@ -1172,15 +1461,22 @@ def run_chaos_check(
                 "shard_failures": [r.render() for r in shard_failures],
                 "shard_baselined": sum(1 for r in shard_results if not r.ok) - len(shard_failures),
                 "shard_stale_baseline_keys": shard_stale,
+                "serve_cases": len(serve_results),
+                "serve_scenarios": sum(len(r.ran) for r in serve_results),
+                "serve_failures": [r.render() for r in serve_failures],
+                "serve_baselined": sum(1 for r in serve_results if not r.ok) - len(serve_failures),
+                "serve_stale_baseline_keys": serve_stale,
             }
         )
-        return 1 if failures or fleet_failures or shard_failures else 0
+        return 1 if failures or fleet_failures or shard_failures or serve_failures else 0
     for r in failures:
         print(f"chaos: {r.render()}")
     for r in fleet_failures:
         print(f"chaos[fleet]: {r.render()}")
     for r in shard_failures:
         print(f"chaos[shard]: {r.render()}")
+    for r in serve_failures:
+        print(f"chaos[serve]: {r.render()}")
     if not quiet:
         for key in stale:
             print(f"chaos: stale baseline entry: {key}")
@@ -1188,21 +1484,27 @@ def run_chaos_check(
             print(f"chaos[fleet]: stale baseline entry: {key}")
         for key in shard_stale:
             print(f"chaos[shard]: stale baseline entry: {key}")
+        for key in serve_stale:
+            print(f"chaos[serve]: stale baseline entry: {key}")
         ok = sum(1 for r in results if r.ok)
         faults = sum(len(r.ran) for r in results)
         fleet_ok = sum(1 for r in fleet_results if r.ok)
         fleet_n = sum(len(r.ran) for r in fleet_results)
         shard_ok = sum(1 for r in shard_results if r.ok)
         shard_n = sum(len(r.ran) for r in shard_results)
+        serve_ok = sum(1 for r in serve_results if r.ok)
+        serve_n = sum(len(r.ran) for r in serve_results)
         print(
             f"chaos: {ok}/{len(results)} classes survived {faults} injected fault(s), "
             f"{len(failures)} failure(s), {len(stale)} stale; "
             f"fleet: {fleet_ok}/{len(fleet_results)} classes survived {fleet_n} "
             f"recovery scenario(s), {len(fleet_failures)} failure(s), {len(fleet_stale)} stale; "
             f"shard: {shard_ok}/{len(shard_results)} classes survived {shard_n} "
-            f"sharded scenario(s), {len(shard_failures)} failure(s), {len(shard_stale)} stale"
+            f"sharded scenario(s), {len(shard_failures)} failure(s), {len(shard_stale)} stale; "
+            f"serve: {serve_ok}/{len(serve_results)} classes survived {serve_n} "
+            f"front-door scenario(s), {len(serve_failures)} failure(s), {len(serve_stale)} stale"
         )
-    return 1 if failures or fleet_failures or shard_failures else 0
+    return 1 if failures or fleet_failures or shard_failures or serve_failures else 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -1230,13 +1532,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             collect_chaos_report(picked)
             + collect_fleet_chaos_report(picked)
             + collect_shard_chaos_report(picked)
+            + collect_serve_chaos_report(picked)
         )
         for r in results:
             print(r.render())
         return 1 if any(not r.ok for r in results) else 0
     if args.verbose:
         for r in (
-            collect_chaos_report() + collect_fleet_chaos_report() + collect_shard_chaos_report()
+            collect_chaos_report()
+            + collect_fleet_chaos_report()
+            + collect_shard_chaos_report()
+            + collect_serve_chaos_report()
         ):
             print(r.render())
     return run_chaos_check(

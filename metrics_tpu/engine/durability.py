@@ -295,6 +295,7 @@ def _save_fleet_checkpoint(
         "initial_capacity": int(engine._initial_capacity),
         "next_auto": int(engine._next_auto),
         "nan_guard": bool(engine._nan_guard),
+        "serve_marks": {str(p): int(v) for p, v in engine._serve_marks.items()},
         "buckets": bucket_blobs,
         "sessions": sessions,
     }
@@ -460,6 +461,7 @@ def _restore_fleet_checkpoint(
     engine._initial_capacity = int(node.get("initial_capacity", engine._initial_capacity))
     engine._next_auto = int(node.get("next_auto", 0))
     engine._nan_guard = engine._nan_guard or bool(node.get("nan_guard", False))
+    engine._serve_marks = {str(p): int(v) for p, v in node.get("serve_marks", {}).items()}
     buckets: List[Any] = []
     for (bnode, template), bblob in zip(validated, bucket_blobs):
         key = engine._bucket_key(template)
@@ -556,6 +558,12 @@ def replay_wal(engine: Any, wal_path: Union[str, os.PathLike]) -> int:
                 engine._mark_applied(seq)
             elif kind == "reset":
                 engine._apply_reset(sid)
+                engine._mark_applied(seq)
+            elif kind == "serve_mark":
+                # serve/ front door (DESIGN §26): remote producer watermark —
+                # sid is the producer name, payload its highest applied pseq
+                marks = engine._serve_marks
+                marks[sid] = max(marks.get(sid, 0), int(payload))
                 engine._mark_applied(seq)
             else:
                 raise CorruptCheckpointError(

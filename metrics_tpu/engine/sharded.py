@@ -208,6 +208,65 @@ class ShardedStreamEngine:
         with self._on_shard(k):
             self._shards[k].reset(session_id)
 
+    # ------------------------------------------------------------------ serve front door
+    def serve_mark(self, producer: str, pseq: int, session_id: Hashable) -> None:
+        """Journal a remote producer watermark on the shard that applied the
+        record (routing is the stable crc32 hash, so a resent record lands on
+        the same shard and meets the same watermark)."""
+        self._shards[self.shard_of(session_id)].serve_mark(producer, pseq)
+
+    def serve_watermark(self, producer: str, session_id: Optional[Hashable] = None) -> int:
+        """With ``session_id``: the watermark on that id's target shard (the
+        dedup authority for a record). Without: the fleet-wide max — an upper
+        bound handed to reconnecting producers as informational only, since a
+        crash can leave shards at different durable prefixes."""
+        if session_id is not None:
+            return self._shards[self.shard_of(session_id)].serve_watermark(producer)
+        return max((s.serve_watermark(producer) for s in self._shards), default=0)
+
+    def serve_watermarks(self) -> Dict[str, int]:
+        marks: Dict[str, int] = {}
+        for shard in self._shards:
+            for p, v in shard._serve_marks.items():
+                marks[p] = max(marks.get(p, 0), v)
+        return marks
+
+    def loose_session_ids(self) -> List[Hashable]:
+        out: List[Hashable] = []
+        for shard in self._shards:
+            out.extend(shard.loose_session_ids())
+        return out
+
+    def preexpand(self, occupancy_pct: float = 85.0) -> List[str]:
+        """Pre-emptively double near-full buckets on every shard (pinned to
+        each shard's device); returns the labels grown fleet-wide."""
+        grown: List[str] = []
+        for k, shard in enumerate(self._shards):
+            with self._on_shard(k):
+                grown.extend(shard.preexpand(occupancy_pct))
+        return grown
+
+    def resize(self, n_shards: int) -> "ShardedStreamEngine":
+        """Rendezvous-free elastic resize, in place: every session re-enters a
+        fresh ``n_shards`` topology through the normal arrival path (pending
+        submissions preserved in order, journals rebuilt self-sufficient).
+        The last manifest describes the old topology, so ``_ckpt_dir`` resets
+        until the next :meth:`checkpoint`. Returns ``self``.
+        """
+        new_n = int(n_shards)
+        if new_n < 1:
+            raise TPUMetricsUserError("ShardedStreamEngine.resize needs n_shards >= 1")
+        if new_n == self.n_shards:
+            return self
+        fleet = type(self)._rehash(
+            self, new_n, self._wal_dir, self._initial_capacity, self._nan_guard, self._devices
+        )
+        self.__dict__.update(fleet.__dict__)
+        _observe.record_event(
+            "fleet_resized", name=self._name, shards=self.n_shards, sessions=len(self)
+        )
+        return self
+
     # ------------------------------------------------------------------ dispatch
     def tick(self) -> int:
         """Flush every shard (one dispatch per touched bucket per shard).
@@ -623,7 +682,7 @@ class ShardedStreamEngine:
         cls,
         old: "ShardedStreamEngine",
         new_n: int,
-        wal_dir: str,
+        wal_dir: Optional[str],
         initial_capacity: int,
         nan_guard: bool,
         devices: Optional[List[Any]],
@@ -637,6 +696,15 @@ class ShardedStreamEngine:
         pending: Dict[Hashable, List[Tuple[int, Tuple[Any, ...], Dict[str, Any]]]] = {}
         health: Dict[Hashable, str] = {}
         order: List[Tuple[Hashable, Metric]] = []
+        # remote-producer watermarks (serve/, DESIGN §26): the fold below is a
+        # clean topology change — every processed record is fully applied
+        # before it starts — so the fleet-wide max per producer is exact here
+        # (unlike crash recovery, where shards may hold different durable
+        # prefixes) and every new shard can be seeded with it
+        serve_marks: Dict[str, int] = {}
+        for shard in old._shards:
+            for p, v in shard._serve_marks.items():
+                serve_marks[p] = max(serve_marks.get(p, 0), v)
         for shard in old._shards:
             for bucket in shard._buckets.values():
                 for slot, seq, args, kwargs in bucket.queue:
@@ -653,8 +721,10 @@ class ShardedStreamEngine:
             for sid in list(shard._sessions):
                 order.append((sid, shard.expire(sid)))
         for k in range(old.n_shards):
-            p = old._shard_wal_path(k) or os.path.join(wal_dir, f"shard-{k:03d}.wal")
-            if os.path.exists(p):
+            p = old._shard_wal_path(k)
+            if p is None and wal_dir is not None:
+                p = os.path.join(wal_dir, f"shard-{k:03d}.wal")
+            if p is not None and os.path.exists(p):
                 os.remove(p)
         fleet = cls(
             n_shards=new_n,
@@ -669,6 +739,9 @@ class ShardedStreamEngine:
         # the last manifest describes the OLD topology: self-healing needs a
         # fresh checkpoint of the resized fleet before it can trust the dir
         fleet._ckpt_dir = None
+        for shard in fleet._shards:
+            for p, v in serve_marks.items():
+                shard.serve_mark(p, v)
         for sid, metric in order:
             fleet.add_session(metric, sid)
             if health.get(sid, "healthy") != "healthy":

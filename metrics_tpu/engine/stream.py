@@ -280,6 +280,11 @@ class StreamEngine:
         # (frame_index, byte_offset) of the torn tail the last WAL replay hit,
         # or None — surfaced by stats() and the wal_torn_tail observe event
         self._wal_torn: Optional[Tuple[int, int]] = None
+        # serve/ front door (DESIGN §26): per-producer ingest watermarks —
+        # highest remote pseq applied through this engine. Journaled as
+        # "serve_mark" records and carried by checkpoints, so a restore can
+        # tell a remote producer's resent record from a fresh one.
+        self._serve_marks: Dict[str, int] = {}
         if wal_path is not None:
             from metrics_tpu.engine.durability import IngestWAL
 
@@ -949,6 +954,50 @@ class StreamEngine:
             for k, d in bucket.template._defaults.items():
                 bucket.stacked[k] = bucket.stacked[k].at[sess.slot].set(jnp.asarray(d))
         bucket.version += 1
+
+    # ------------------------------------------------------------------ serve front door
+    def serve_mark(self, producer: str, pseq: int) -> None:
+        """Record that remote ``producer``'s record ``pseq`` was applied here.
+
+        Write-ahead like every other ingest record: the mark is journaled
+        (kind ``serve_mark``) before the in-memory watermark moves, so a
+        restore replays exactly the marks whose data records it replays — an
+        acked-but-crashed record can never be double-applied on resend.
+        """
+        seq = self._log("serve_mark", str(producer), int(pseq))
+        self._serve_marks[str(producer)] = max(self._serve_marks.get(str(producer), 0), int(pseq))
+        self._mark_applied(seq)
+
+    def serve_watermark(self, producer: str) -> int:
+        """Highest remote pseq applied through this engine for ``producer``."""
+        return self._serve_marks.get(str(producer), 0)
+
+    def serve_watermarks(self) -> Dict[str, int]:
+        return dict(self._serve_marks)
+
+    def loose_session_ids(self) -> List[Hashable]:
+        """Sessions running off the bucketed hot path (loose or quarantined) —
+        the cheapest rows to shed under overload: expiring one costs no
+        bucket state change and no recompile."""
+        return [sid for sid, sess in self._sessions.items() if sess.bucket is None]
+
+    def preexpand(self, occupancy_pct: float = 85.0) -> List[str]:
+        """Pre-emptively double every bucket at/above ``occupancy_pct`` full.
+
+        The autonomic controller's capacity reflex: growing *before* the
+        free-list empties means the compile for the doubled capacity (exactly
+        one — the padded capacity is the only shape in the program cache key)
+        happens on the operator's schedule instead of inside an arrival
+        burst. Returns the labels of the buckets grown.
+        """
+        grown: List[str] = []
+        for bucket in self._buckets.values():
+            if bucket.capacity and 100.0 * bucket.active() / bucket.capacity >= occupancy_pct:
+                bucket.grow()
+                grown.append(bucket.label)
+        if grown:
+            self._publish_gauges()
+        return grown
 
     # ------------------------------------------------------------------ durability
     def checkpoint(self, path: str) -> str:

@@ -337,6 +337,10 @@ class FleetMeter:
         self._quota_exceeded_total = 0
         self._pending_demote: set = set()
         self._demoted: set = set()
+        # per-producer network-ingest ledger (serve front door, DESIGN §26):
+        # producer name -> {"records", "bytes", "dedup_skipped"}; producers are
+        # operator-named connections, so cardinality is fleet-operator-bounded
+        self._ingest: Dict[str, Dict[str, int]] = {}
 
     # ------------------------------------------------------------------ charging
     def _ledger(self, skey: str) -> Optional[SessionLedger]:
@@ -442,6 +446,26 @@ class FleetMeter:
                 led = self._ledger(skey)
                 if led is not None:
                     led.ckpt_bytes += share
+
+    def note_ingest(self, producer: str, records: int = 0, nbytes: int = 0, dedup_skipped: int = 0) -> None:
+        """Charge one front-door poll's intake to its producer connection."""
+        with self._lock:
+            row = self._ingest.get(producer)
+            if row is None:
+                row = self._ingest[producer] = {"records": 0, "bytes": 0, "dedup_skipped": 0}
+            row["records"] += int(records)
+            row["bytes"] += int(nbytes)
+            row["dedup_skipped"] += int(dedup_skipped)
+
+    def ingest_ledger(self) -> Dict[str, Any]:
+        """Per-producer ingest rows plus fleet-wide totals."""
+        with self._lock:
+            rows = {p: dict(row) for p, row in sorted(self._ingest.items())}
+        totals = {"records": 0, "bytes": 0, "dedup_skipped": 0}
+        for row in rows.values():
+            for f in totals:
+                totals[f] += row[f]
+        return {"producers": rows, "totals": totals}
 
     # ------------------------------------------------------------------ memory ledger
     def note_bucket_memory(self, engine: str, label: str, capacity: int, active: int, row_bytes: int) -> None:
@@ -621,6 +645,7 @@ class FleetMeter:
                 "memory": [
                     [eng, lbl, dict(row)] for (eng, lbl), row in sorted(self._memory.items())
                 ],
+                "ingest": {p: dict(row) for p, row in sorted(self._ingest.items())},
             }
 
     def sync_telemetry(self, peer_states: Iterable[Mapping[str, Any]]) -> "FleetMeter":
@@ -647,6 +672,12 @@ class FleetMeter:
                 sketch_state = state.get("sketch")
                 if sketch_state:
                     self._sketch.merge_state(sketch_state)
+                for producer, row in (state.get("ingest") or {}).items():
+                    mine_row = self._ingest.setdefault(
+                        producer, {"records": 0, "bytes": 0, "dedup_skipped": 0}
+                    )
+                    for f in mine_row:
+                        mine_row[f] += int(row.get(f, 0))
                 for eng, lbl, row in state.get("memory") or []:
                     key = (str(eng), str(lbl))
                     mine = self._memory.get(key)
@@ -677,6 +708,7 @@ class FleetMeter:
             "totals": totals,
             "top_sessions": self.top_sessions(top_n),
             "memory": self.memory_ledger(),
+            "ingest": self.ingest_ledger(),
             "policy": None if self.policy is None else {
                 "action": self.policy.action,
                 "max_dispatch_share": self.policy.max_dispatch_share,

@@ -47,6 +47,7 @@ __all__ = [
     "note_aot_miss",
     "note_aot_stale",
     "note_aot_store",
+    "note_autonomic_action",
     "note_compile_miss",
     "note_eager_fallback",
     "note_engine_compile",
@@ -72,6 +73,14 @@ __all__ = [
     "note_replica_dispatch",
     "note_replica_fallback",
     "note_replica_hit",
+    "note_serve_admission",
+    "note_serve_bytes",
+    "note_serve_connect",
+    "note_serve_dedup",
+    "note_serve_disconnect",
+    "note_serve_frame",
+    "note_serve_protocol_error",
+    "note_serve_shed",
     "note_wal_append",
     "note_wal_gauges",
     "note_wal_replay",
@@ -82,6 +91,7 @@ __all__ = [
     "reset",
     "scope",
     "set_fleet_gauges",
+    "set_serve_gauges",
     "snapshot",
     "snapshot_json",
 ]
@@ -95,7 +105,9 @@ ENABLED = False
 # scrapers) can detect which contract a serialized snapshot file carries.
 # 2 = PR 14 (schema_version itself + watchdog/SLO/compile-explain deriveds).
 # 3 = PR 15 (top-level "metering" section + meter/sync-bytes deriveds).
-SCHEMA_VERSION = 3
+# 4 = PR 18 (serve front-door + autonomic deriveds: ingest volume, admission
+#     verdict totals, dedup/protocol-error/shed totals, reflex action total).
+SCHEMA_VERSION = 4
 
 # process-wide watchdog (observe/watchdog.py) registered via _set_watchdog;
 # held here — not in the watchdog module — so engine hot paths can poke it
@@ -626,6 +638,74 @@ def note_shard_restore(label: str, n_sessions: int, n_replayed: int, recovered: 
         )
 
 
+# serve front-door hooks (serve/server.py, serve/autonomic.py — DESIGN §26):
+# network ingest, admission verdicts, and the autonomic observe→act loop
+def note_serve_connect(producer: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("serve_connect", producer)
+        RECORDER.add_event("serve_connect", producer=producer)
+
+
+def note_serve_disconnect(producer: str, reason: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("serve_disconnect", producer)
+        RECORDER.add_event("serve_disconnect", producer=producer, reason=reason[:200])
+
+
+def note_serve_frame(kind: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("serve_frames", kind)
+
+
+def note_serve_bytes(n: int) -> None:
+    if ENABLED:
+        RECORDER.add_count("serve_bytes_in", "serve", n)
+
+
+def note_serve_admission(verdict: str, rule: Optional[str] = None) -> None:
+    """One admission decision; non-accept verdicts also land an event naming
+    the table row that tripped."""
+    if ENABLED:
+        RECORDER.add_count("serve_admission", verdict)
+        if verdict != "accept":
+            RECORDER.add_event("serve_admission", verdict=verdict, rule=rule)
+
+
+def note_serve_dedup(producer: str) -> None:
+    """A resent record was squelched by the target shard's producer watermark
+    — at-least-once delivery collapsed to exactly-once application."""
+    if ENABLED:
+        RECORDER.add_count("serve_dedup_skipped", producer)
+
+
+def note_serve_protocol_error(reason: str) -> None:
+    if ENABLED:
+        RECORDER.add_count("serve_protocol_errors", "serve")
+        RECORDER.add_event("serve_protocol_error", reason=reason[:200])
+
+
+def note_serve_shed(session: str, reason: str) -> None:
+    """One loose session was shed under overload (the gentlest eviction)."""
+    if ENABLED:
+        RECORDER.add_count("serve_shed_sessions", "serve")
+        RECORDER.add_event("serve_shed", session=session, reason=reason[:200])
+
+
+def note_autonomic_action(action: str, dry_run: bool = False) -> None:
+    """One autonomic reflex fired (or, dry-run, would have): double / demote /
+    resize / shed. The structured ``autonomic_action`` event carries the why."""
+    if ENABLED:
+        RECORDER.add_count("autonomic_actions", f"dry:{action}" if dry_run else action)
+
+
+def set_serve_gauges(producers: int, queue_depth: int) -> None:
+    """Publish the front door's live levels: authenticated producer
+    connections and the ingest queue depth (decoded records not yet applied)."""
+    if ENABLED:
+        RECORDER.set_gauge("serve_producers", "serve", producers)
+        RECORDER.set_gauge("serve_queue_depth", "serve", queue_depth)
+
+
 def set_fleet_gauges(
     label: str, active: int, capacity: int, fragmented: int, bytes_stacked: int, bytes_active: int
 ) -> None:
@@ -752,7 +832,17 @@ def snapshot() -> Dict[str, Any]:
                       "meter_live_bytes": int,
                       "meter_pad_waste_bytes": int,
                       "meter_quota_exceeded_total": int,
-                      "sync_bytes_total": int}}
+                      "sync_bytes_total": int,
+                      "serve_producers_connected": int,
+                      "serve_frames_total": int,
+                      "serve_bytes_in_total": int,
+                      "serve_admitted_total": int,
+                      "serve_deferred_total": int,
+                      "serve_shed_total": int,
+                      "serve_rejected_total": int,
+                      "serve_dedup_skipped_total": int,
+                      "serve_protocol_errors_total": int,
+                      "autonomic_actions_total": int}}
 
     The ``fleet_*`` totals aggregate the StreamEngine gauges/counters across
     buckets: occupancy is live rows over padded capacity, pad waste is the
@@ -775,7 +865,12 @@ def snapshot() -> Dict[str, Any]:
     full payload under ``metering`` (``{"installed": False}`` when none is
     installed), per-tenant attribution deriveds (``meter_*``), and the
     summed per-state collective traffic from ``parallel/sync.py``
-    (``sync_bytes_total``).
+    (``sync_bytes_total``). The serve rung (DESIGN §26) adds front-door
+    deriveds: live producer connections, total frames/bytes ingested, the
+    four admission verdict totals, watermark-dedup squelches, protocol
+    errors, loose-first sheds, and the autonomic reflex action total
+    (dry-run decisions count — they carry a ``dry:`` label prefix in the
+    raw ``autonomic_actions`` counter but roll into the same derived).
     """
     if RECORDER.latency:
         # lazy: latency.py pulls in numpy, which this stdlib-only module must not
@@ -885,6 +980,16 @@ def snapshot() -> Dict[str, Any]:
             "meter_pad_waste_bytes": int(meter_memory.get("pad_waste_bytes", 0)),
             "meter_quota_exceeded_total": sum(counters.get("quota_exceeded", {}).values()),
             "sync_bytes_total": sum(counters.get("sync_bytes", {}).values()),
+            "serve_producers_connected": int(sum(gauges.get("serve_producers", {}).values())),
+            "serve_frames_total": sum(counters.get("serve_frames", {}).values()),
+            "serve_bytes_in_total": sum(counters.get("serve_bytes_in", {}).values()),
+            "serve_admitted_total": counters.get("serve_admission", {}).get("accept", 0),
+            "serve_deferred_total": counters.get("serve_admission", {}).get("defer", 0),
+            "serve_shed_total": counters.get("serve_admission", {}).get("shed", 0),
+            "serve_rejected_total": counters.get("serve_admission", {}).get("reject", 0),
+            "serve_dedup_skipped_total": sum(counters.get("serve_dedup_skipped", {}).values()),
+            "serve_protocol_errors_total": sum(counters.get("serve_protocol_errors", {}).values()),
+            "autonomic_actions_total": sum(counters.get("autonomic_actions", {}).values()),
         },
     }
 

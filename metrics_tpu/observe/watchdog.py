@@ -317,7 +317,14 @@ class Watchdog:
     ``dispatch_p99_s``                        windowed DDSketch p99, phase "dispatch"
     ``wal_lag_records``                       summed durability-lag gauge
     ``occupancy_psi``                         PSI of the bucket-occupancy histogram
+    ``serve_ingest_rate_per_s``               time-decayed front-door record ingest
+    ``serve_shed_rate_per_s``                 time-decayed loose-first sheds
+    ``serve_queue_depth``                     front-door decoded-not-yet-applied gauge
     ========================================  =====================================
+
+    The three ``serve_*`` signals (DESIGN §26) are additive — no default SLO
+    reads them, so fleets without a network front door see them as 0/None and
+    operators with one can pin their own :class:`SloRule` rows on top.
     """
 
     def __init__(
@@ -334,6 +341,8 @@ class Watchdog:
             "eviction": HostTimeDecayedRate(half_life_s),
             "fallback": HostTimeDecayedRate(half_life_s),
             "rollback": HostTimeDecayedRate(half_life_s),
+            "serve_ingest": HostTimeDecayedRate(half_life_s),
+            "serve_shed": HostTimeDecayedRate(half_life_s),
         }
         self._cusums = {
             "recompile": HostCUSUM(target=0.0, k=1.0),
@@ -370,6 +379,7 @@ class Watchdog:
             active: Dict[str, float] = {}
             capacity: Dict[str, float] = {}
             wal_lag = 0.0
+            serve_queue = 0.0
             for (name, label), v in rec.gauges.items():
                 if name == "fleet_rows_active":
                     active[label] = v
@@ -377,12 +387,15 @@ class Watchdog:
                     capacity[label] = v
                 elif name == "wal_lag_records":
                     wal_lag += v
+                elif name == "serve_queue_depth":
+                    serve_queue += v
             tick_sketches = [sk.copy() for (ph, _l), sk in rec.latency.items() if ph == "tick"]
             dispatch_sketches = [sk.copy() for (ph, _l), sk in rec.latency.items() if ph == "dispatch"]
         fractions = [active.get(lbl, 0.0) / cap for lbl, cap in capacity.items() if cap > 0]
         return {
             "sums": sums,
             "wal_lag_records": wal_lag,
+            "serve_queue_depth": serve_queue,
             "occupancy_fractions": fractions,
             "tick_sketches": tick_sketches,
             "dispatch_sketches": dispatch_sketches,
@@ -451,11 +464,15 @@ class Watchdog:
             d_aot_misses = delta("aot_misses", float(sums.get("aot_miss", 0.0)))
             d_dispatches = delta("dispatches", float(sums.get("fleet_dispatch", 0.0)))
             d_flushes = delta("flushes", float(sums.get("fleet_flush", 0.0)))
+            d_serve_frames = delta("serve_frames", float(sums.get("serve_frames", 0.0)))
+            d_serve_shed = delta("serve_shed", float(sums.get("serve_shed_sessions", 0.0)))
 
             self._rates["compile"].observe(d_compiles, t)
             self._rates["eviction"].observe(d_evicts, t)
             self._rates["fallback"].observe(d_fallbacks, t)
             self._rates["rollback"].observe(d_rollbacks, t)
+            self._rates["serve_ingest"].observe(d_serve_frames, t)
+            self._rates["serve_shed"].observe(d_serve_shed, t)
 
             self._cusums["recompile"].observe(d_compiles)
             per_bucket = (d_dispatches / d_flushes) if d_flushes > 0 else None
@@ -495,6 +512,9 @@ class Watchdog:
                 "dispatch_p99_s": self._windowed_p99("dispatch", raw["dispatch_sketches"]),
                 "wal_lag_records": raw["wal_lag_records"],
                 "occupancy_psi": psi,
+                "serve_ingest_rate_per_s": self._rates["serve_ingest"].rate(),
+                "serve_shed_rate_per_s": self._rates["serve_shed"].rate(),
+                "serve_queue_depth": raw["serve_queue_depth"],
             }
 
             for rule in self.rules:

@@ -203,7 +203,7 @@ def test_snapshot_schema_is_stable_and_json_able():
         "latency", "series", "derived", "metering",
     }
     assert snap["enabled"] is True
-    assert snap["schema_version"] == observe.SCHEMA_VERSION == 3
+    assert snap["schema_version"] == observe.SCHEMA_VERSION == 4
     assert snap["metering"] == {"installed": False}  # no FleetMeter installed here
     assert set(snap["derived"]) == {
         "jit_cache_hit_rate", "jit_compiles_total", "jit_cache_hits_total",
@@ -225,6 +225,10 @@ def test_snapshot_schema_is_stable_and_json_able():
         "meter_sessions_tracked", "meter_attributed_dispatch_s",
         "meter_attribution_pct", "meter_live_bytes", "meter_pad_waste_bytes",
         "meter_quota_exceeded_total", "sync_bytes_total",
+        "serve_producers_connected", "serve_frames_total", "serve_bytes_in_total",
+        "serve_admitted_total", "serve_deferred_total", "serve_shed_total",
+        "serve_rejected_total", "serve_dedup_skipped_total",
+        "serve_protocol_errors_total", "autonomic_actions_total",
     }
     for by_label in snap["timers"].values():
         for agg in by_label.values():

@@ -1,0 +1,213 @@
+"""The observe→act reflexes (``serve/autonomic.py``, DESIGN §26).
+
+Each rung is tripped in isolation with the clock pinned (``step(now=...)``),
+so the rate limiter and the trip condition are both under test control:
+double on occupancy pressure, demote through the meter's pending-demotion
+handshake (including the ghost-confirmation path that keeps the queue from
+wedging on an expired offender), resize on shard population skew, and shed
+loose-first on overload. ``dry_run`` must decide, log and count — and mutate
+nothing.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.observe.metering import MeterPolicy
+from metrics_tpu.serve.autonomic import (
+    AUTONOMIC_ACTIONS,
+    AutonomicController,
+    shed_loose,
+)
+
+
+@pytest.fixture(autouse=True)
+def _scoped():
+    with observe.scope(reset=True):
+        yield
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=4, validate_args=False)
+
+
+def _full_engine(n=4, capacity=4, loose=0):
+    engine = StreamEngine(initial_capacity=capacity)
+    for i in range(n):
+        engine.add_session(_metric(), session_id=f"s{i}")
+    for i in range(loose):
+        engine._demote_session(engine._sessions[f"s{i}"])
+    # demotion frees bucket slots — refill them so occupancy stays at n/capacity
+    for i in range(loose):
+        engine.add_session(_metric(), session_id=f"r{i}")
+    return engine
+
+
+# ------------------------------------------------------------------- double
+def test_double_fires_on_occupancy_and_respects_its_rate_limit():
+    engine = _full_engine(n=4, capacity=4)  # 100% occupancy
+    auto = AutonomicController(engine, occupancy_high_pct=85.0)
+    capacity_before = engine.stats()["rows_capacity"]
+    actions = auto.step(now=0.0)
+    assert [a.action for a in actions] == ["double"]
+    assert actions[0].executed and not actions[0].dry_run
+    assert engine.stats()["rows_capacity"] > capacity_before
+    assert auto.counts["double"] == 1
+    # re-fill to the threshold: still silent inside the rate window...
+    grown = engine.stats()["rows_capacity"]
+    for i in range(4, int(grown * 0.9)):
+        engine.add_session(_metric(), session_id=f"s{i}")
+    assert auto.step(now=1.0) == []
+    # ...and firing again once the window has passed
+    assert [a.action for a in auto.step(now=2.5)] == ["double"]
+    assert auto.counts["double"] == 2
+
+
+def test_double_stays_quiet_below_the_threshold():
+    engine = _full_engine(n=1, capacity=8)
+    auto = AutonomicController(engine)
+    assert auto.step(now=0.0) == []
+    assert auto.counts == {a: 0 for a in AUTONOMIC_ACTIONS}
+
+
+# ------------------------------------------------------------------- demote
+def test_demote_drives_the_meter_handshake():
+    engine = _full_engine(n=2, capacity=8)
+    mt = observe.install_meter(top_k=8, policy=MeterPolicy(action="demote"))
+    try:
+        with mt._lock:
+            mt._pending_demote.add("s1")
+            mt._demoted.add("s1")  # breach already latched by the meter
+        auto = AutonomicController(engine)
+        actions = auto.step(now=0.0)
+        assert [a.action for a in actions] == ["demote"]
+        assert actions[0].detail["sessions"] == ["s1"]
+        assert mt.pending_demotions() == []  # handshake closed
+        assert "s1" in [str(s) for s in engine.loose_session_ids()]
+        assert "s0" not in [str(s) for s in engine.loose_session_ids()]
+    finally:
+        observe.uninstall_meter()
+
+
+def test_demote_confirms_ghosts_so_the_queue_cannot_wedge():
+    engine = _full_engine(n=1, capacity=8)
+    mt = observe.install_meter(top_k=8, policy=MeterPolicy(action="demote"))
+    try:
+        with mt._lock:
+            mt._pending_demote.add("long-gone")
+            mt._demoted.add("long-gone")
+        auto = AutonomicController(engine)
+        actions = auto.step(now=0.0)
+        # nothing demoted (no record), but the ghost is confirmed away
+        assert actions == []
+        assert mt.pending_demotions() == []
+    finally:
+        observe.uninstall_meter()
+
+
+# ------------------------------------------------------------------- resize
+def test_resize_fires_on_shard_imbalance():
+    from metrics_tpu.engine.sharded import ShardedStreamEngine, shard_of
+
+    fleet = ShardedStreamEngine(n_shards=2)
+    added = 0
+    i = 0
+    while added < 5:  # load one shard only: hi=5, lo=0 >= 4:1 skew
+        sid = f"s{i}"
+        i += 1
+        if shard_of(sid, 2) == 0:
+            fleet.add_session(_metric(), session_id=sid)
+            added += 1
+    auto = AutonomicController(fleet, imbalance_ratio=4.0)
+    actions = auto.step(now=0.0)
+    assert [a.action for a in actions] == ["resize"]
+    assert actions[0].detail["to_shards"] == 3
+    assert fleet.stats()["n_shards"] == 3
+    assert len(fleet) == 5  # every session survived the re-entry
+
+
+def test_resize_is_capped_by_max_shards():
+    from metrics_tpu.engine.sharded import ShardedStreamEngine, shard_of
+
+    fleet = ShardedStreamEngine(n_shards=2)
+    added = 0
+    i = 0
+    while added < 5:
+        sid = f"s{i}"
+        i += 1
+        if shard_of(sid, 2) == 0:
+            fleet.add_session(_metric(), session_id=sid)
+            added += 1
+    auto = AutonomicController(fleet, imbalance_ratio=4.0, max_shards=2)
+    assert auto.step(now=0.0) == []
+    assert fleet.stats()["n_shards"] == 2
+
+
+# --------------------------------------------------------------------- shed
+def test_shed_takes_loose_sessions_first_and_is_bounded():
+    engine = _full_engine(n=4, capacity=4, loose=3)  # 100% occupancy, 3 loose
+    auto = AutonomicController(engine, max_shed_per_step=2)
+    actions = auto.step(now=0.0)
+    shed_acts = [a for a in actions if a.action == "shed"]
+    assert len(shed_acts) == 1
+    assert len(shed_acts[0].detail["sessions"]) == 2  # bounded per step
+    assert "s3" in engine._sessions  # the bucketed session is untouchable
+    assert len(engine.loose_session_ids()) == 1
+
+
+def test_on_demand_shed_is_rate_limited():
+    engine = _full_engine(n=3, capacity=8, loose=2)
+    auto = AutonomicController(engine)  # default shed interval: 0.5s
+    assert len(auto.shed(1, reason="admission")) == 1
+    assert auto.shed(1, reason="admission") == []  # inside the window
+    assert auto.counts["shed"] == 1
+
+
+def test_shed_loose_helper_never_touches_bucketed_sessions():
+    engine = _full_engine(n=3, capacity=8, loose=1)
+    assert shed_loose(engine, n=5) == ["s0"]
+    assert set(engine._sessions) == {"s1", "s2", "r0"}
+
+
+# ------------------------------------------------------------------ dry run
+def test_dry_run_decides_and_counts_but_never_mutates():
+    engine = _full_engine(n=4, capacity=4, loose=2)  # trips double AND shed
+    mt = observe.install_meter(top_k=8, policy=MeterPolicy(action="demote"))
+    try:
+        with mt._lock:
+            mt._pending_demote.add("s3")
+            mt._demoted.add("s3")
+        auto = AutonomicController(engine, dry_run=True)
+        actions = auto.step(now=0.0)
+        assert {a.action for a in actions} == {"double", "demote", "shed"}
+        assert all(a.dry_run and not a.executed for a in actions)
+        # decided and counted...
+        assert auto.counts["double"] == auto.counts["shed"] == 1
+        assert len(auto.history) == 3
+        # ...but nothing moved: capacity, population, meter queue all intact
+        assert engine.stats()["rows_capacity"] == 4
+        assert set(engine._sessions) == {"s0", "s1", "s2", "s3", "r0", "r1"}
+        assert mt.pending_demotions() == ["s3"]
+        assert auto.shed(5) == []  # on-demand shed refuses under dry_run
+        assert set(engine._sessions) == {"s0", "s1", "s2", "s3", "r0", "r1"}
+    finally:
+        observe.uninstall_meter()
+
+
+# ------------------------------------------------------------- bookkeeping
+def test_counts_are_preseeded_and_history_is_structured():
+    engine = _full_engine(n=1, capacity=8)
+    auto = AutonomicController(engine)
+    assert auto.counts == {a: 0 for a in AUTONOMIC_ACTIONS}
+    assert list(auto.history) == []
+    engine2 = _full_engine(n=4, capacity=4)
+    auto2 = AutonomicController(engine2)
+    (act,) = auto2.step(now=0.0)
+    assert act == auto2.history[-1]
+    assert act.action == "double" and act.reason in ("occupancy", "occupancy_psi")
+    # the action is also exported as an observe counter for fleet_top
+    snap = observe.snapshot()
+    assert snap["derived"]["autonomic_actions_total"] >= 1

@@ -1,0 +1,176 @@
+"""Serve telemetry surfaces (DESIGN §26): snapshot schema v4, the Prometheus
+export of the ``metrics_tpu_serve_*`` families, and the ``fleet_top``
+``== serve ==`` report section — all driven by real front-door traffic over
+a socketpair, never by hand-poked counters."""
+
+from __future__ import annotations
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.serve.admission import AdmissionController, AdmissionRule
+from metrics_tpu.serve.autonomic import AutonomicController
+from metrics_tpu.serve.protocol import Producer, WAL_MAGIC, encode_frame
+from metrics_tpu.serve.server import MetricsServer
+
+KEY = "observe-key"
+
+
+@pytest.fixture(autouse=True)
+def _scoped():
+    with observe.scope(reset=True):
+        yield
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=4, validate_args=False)
+
+
+def _traffic(tmp_path):
+    """One connected producer, a few applied records, one reject, one dup,
+    one protocol error, one autonomic double — every serve family nonzero."""
+    engine = StreamEngine(initial_capacity=4, wal_path=str(tmp_path / "serve.wal"))
+    auto = AutonomicController(engine, min_interval_s={"double": 0.0})
+    server = MetricsServer(engine, KEY, host=None, autonomic=auto)
+    srv_sock, cli_sock = socket.socketpair()
+    server.adopt(srv_sock)
+    prod = Producer(None, KEY, name="prod-a", sock=cli_sock, drive=lambda: server.poll(0.0))
+    rng = np.random.default_rng(3)
+    for i in range(4):  # fills capacity: the autonomic double reflex trips
+        prod.add_session(_metric(), session_id=f"s{i}")
+        prod.submit(f"s{i}", rng.integers(0, 4, 8), rng.integers(0, 4, 8))
+    prod.flush(5.0)
+    server.tick()
+    # one dup (replay of pseq 1), one reject, then one protocol error
+    prod._send_raw(encode_frame("add", 1, "s0", _metric()))
+    server.poll(0.0)
+    server.admission = AdmissionController((
+        AdmissionRule("closed", "occupancy_pct", ">=", 0.0, "reject"),
+    ))
+    prod.add_session(_metric(), session_id="late")
+    try:
+        prod.flush(5.0)
+    finally:
+        server.admission = AdmissionController()
+    bad_srv, bad_cli = socket.socketpair()
+    server.adopt(bad_srv)
+    bad_cli.sendall(WAL_MAGIC + encode_frame("submit", 1, "s0", ((), {})))
+    server.poll(0.0)
+    bad_cli.close()
+    server.poll(0.0)
+    return engine, server, prod
+
+
+def test_snapshot_schema_v4_carries_populated_serve_keys(tmp_path):
+    engine, server, prod = _traffic(tmp_path)
+    try:
+        snap = observe.snapshot()
+        assert snap["schema_version"] == observe.SCHEMA_VERSION == 4
+        d = snap["derived"]
+        assert d["serve_producers_connected"] == 1  # the bad conn is gone
+        assert d["serve_frames_total"] >= 10
+        assert d["serve_bytes_in_total"] > 0
+        assert d["serve_admitted_total"] == 8
+        assert d["serve_rejected_total"] == 1
+        assert d["serve_dedup_skipped_total"] == 1
+        assert d["serve_protocol_errors_total"] == 1
+        assert d["serve_deferred_total"] == 0 and d["serve_shed_total"] == 0
+        assert d["autonomic_actions_total"] >= 1
+        json.dumps(snap)  # the whole snapshot must stay JSON-able
+    finally:
+        server.close()
+
+
+def test_prometheus_round_trips_the_serve_families(tmp_path):
+    engine, server, prod = _traffic(tmp_path)
+    try:
+        snap = observe.snapshot()
+        text = observe.prometheus()
+    finally:
+        server.close()
+    # parse every sample line: `name{labels} value` or `name value`
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        float(value)  # must parse
+        samples[name_part] = float(value)
+
+    def family_total(prefix):
+        return sum(v for k, v in samples.items() if k.startswith(prefix))
+
+    d = snap["derived"]
+    assert family_total("metrics_tpu_serve_frames_total") == d["serve_frames_total"]
+    assert family_total("metrics_tpu_serve_bytes_in_total") == d["serve_bytes_in_total"]
+    assert (
+        samples['metrics_tpu_serve_admission_total{metric="accept"}']
+        == d["serve_admitted_total"]
+    )
+    assert (
+        samples['metrics_tpu_serve_admission_total{metric="reject"}']
+        == d["serve_rejected_total"]
+    )
+    assert family_total("metrics_tpu_serve_dedup_skipped_total") == 1
+    assert family_total("metrics_tpu_serve_protocol_errors_total") == 1
+    assert family_total("metrics_tpu_autonomic_actions_total") >= 1
+    # the producers gauge exports per-label, no _total suffix
+    assert samples['metrics_tpu_serve_producers{metric="serve"}'] == 1
+
+
+def _load_fleet_top():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "tools", "fleet_top.py")
+    spec = importlib.util.spec_from_file_location("fleet_top", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_top_serve_section_renders_and_diffs(tmp_path, capsys):
+    fleet_top = _load_fleet_top()
+    engine, server, prod = _traffic(tmp_path)
+    try:
+        snap = observe.snapshot()
+    finally:
+        server.close()
+
+    report = fleet_top.build_report(snap)
+    sv = report["serve"]
+    assert sv["producers"] == 1
+    assert sv["frames"] == snap["derived"]["serve_frames_total"]
+    assert sv["admission"] == {"accept": 8, "defer": 0, "shed": 0, "reject": 1}
+    assert sv["dedup_skipped"] == 1 and sv["protocol_errors"] == 1
+    assert sv["autonomic"].get("double", 0) >= 1
+
+    rendered = fleet_top.render_report(snap)
+    assert "== serve ==" in rendered
+    assert "producer(s) connected" in rendered
+    assert "accept=8" in rendered and "reject=1" in rendered
+    assert "autonomic" in rendered
+
+    # the --json path must carry the serve block verbatim
+    p = tmp_path / "snap.json"
+    p.write_text(json.dumps(snap))
+    assert fleet_top.main(["--json", str(p)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["serve"] == json.loads(json.dumps(sv))
+
+
+def test_serve_section_absent_without_traffic():
+    fleet_top = _load_fleet_top()
+    engine = StreamEngine(initial_capacity=4)
+    engine.add_session(_metric(), session_id="s0")
+    engine.tick()
+    snap = observe.snapshot()
+    report = fleet_top.build_report(snap)
+    assert report["serve"] is None
+    assert "== serve ==" not in fleet_top.render_report(snap)

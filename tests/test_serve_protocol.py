@@ -1,0 +1,199 @@
+"""MTWAL001 wire protocol (``serve/protocol.py``, DESIGN §26).
+
+The socket stream IS the journal format: the stream decoder must accept and
+reject bytes under exactly the rules of ``IngestWAL.read_records_detailed``.
+These tests pin that equivalence byte-for-byte — over truncations at every
+byte boundary, single bit-flips at every byte, oversized declared lengths and
+alien magic — plus the one documented divergence (the streaming decoder
+rejects a declared length above ``max_frame_bytes`` before buffering the
+body), the writer identity (``encode_frame`` == ``IngestWAL.append`` bytes),
+and the damage contract (records decoded before the damage ride on the
+exception, with the byte offset where trust ended).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pytest
+
+from metrics_tpu.engine.durability import IngestWAL, WAL_MAGIC
+from metrics_tpu.serve.protocol import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameDecoder,
+    ProtocolError,
+    decode_blob,
+    encode_frame,
+)
+
+# payload shapes a real producer sends: tagged metric blob, submit args,
+# bare expire, a dict control payload — small enough that the fuzz sweeps
+# (every truncation boundary, every byte flipped) stay cheap
+RECORDS = [
+    ("add", 1, "s0", ("__metric__", b"\x80\x05N.")),
+    ("submit", 2, "s0", ((np.arange(6, dtype=np.int32).reshape(2, 3),), {})),
+    ("expire", 3, "sess with spaces é", None),
+    ("hello", 0, "prod-a", {"key": "k", "producer": "prod-a", "proto": 1}),
+]
+
+
+def _same(a, b) -> bool:
+    """Structural equality that treats ndarrays by value (== would vectorize)."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (
+            isinstance(a, np.ndarray)
+            and isinstance(b, np.ndarray)
+            and a.dtype == b.dtype
+            and np.array_equal(a, b)
+        )
+    if isinstance(a, (tuple, list)):
+        return type(a) is type(b) and len(a) == len(b) and all(
+            _same(x, y) for x, y in zip(a, b)
+        )
+    if isinstance(a, dict):
+        return (
+            isinstance(b, dict)
+            and set(a) == set(b)
+            and all(_same(v, b[k]) for k, v in a.items())
+        )
+    return type(a) is type(b) and a == b
+
+
+def _blob() -> bytes:
+    return WAL_MAGIC + b"".join(encode_frame(*rec) for rec in RECORDS)
+
+
+def _file_verdict(tmp_path, blob: bytes):
+    path = tmp_path / "pin.wal"
+    path.write_bytes(blob)
+    return IngestWAL.read_records_detailed(path)
+
+
+def _pin(tmp_path, blob: bytes) -> None:
+    """The pin itself: stream and file readers agree on records AND tear site."""
+    want_records, want_torn = _file_verdict(tmp_path, blob)
+    got_records, got_torn = decode_blob(blob)
+    assert got_torn == want_torn, (got_torn, want_torn)
+    assert _same(got_records, want_records)
+
+
+# ------------------------------------------------------------------- writer
+def test_encode_frame_writes_exactly_what_ingest_wal_appends(tmp_path):
+    path = tmp_path / "w.wal"
+    wal = IngestWAL(path)
+    for kind, seq, sid, payload in RECORDS:
+        wal.append(kind, seq, sid, payload)
+    wal.close()
+    assert path.read_bytes() == _blob()
+
+
+def test_metric_payloads_get_the_wal_tagging(tmp_path):
+    from metrics_tpu.aggregation import SumMetric
+
+    path = tmp_path / "m.wal"
+    wal = IngestWAL(path)
+    wal.append("add", 1, "s0", SumMetric())
+    wal.close()
+    assert path.read_bytes() == WAL_MAGIC + encode_frame("add", 1, "s0", SumMetric())
+
+
+# ---------------------------------------------------------------- fuzz pins
+def test_clean_blob_decodes_identically(tmp_path):
+    blob = _blob()
+    _pin(tmp_path, blob)
+    records, torn = decode_blob(blob)
+    assert torn is None
+    assert [r[0] for r in records] == [r[0] for r in RECORDS]
+
+
+def test_truncation_at_every_byte_boundary_pins_the_file_reader(tmp_path):
+    blob = _blob()
+    for cut in range(len(blob)):
+        _pin(tmp_path, blob[:cut])
+
+
+def test_single_bit_flip_at_every_byte_pins_the_file_reader(tmp_path):
+    blob = _blob()
+    rng = np.random.default_rng(7)
+    for i in range(len(blob)):
+        flipped = bytearray(blob)
+        flipped[i] ^= 1 << int(rng.integers(0, 8))
+        _pin(tmp_path, bytes(flipped))
+
+
+def test_alien_magic_is_torn_at_offset_zero(tmp_path):
+    blob = b"ALIENMAG" + _blob()[len(WAL_MAGIC):]
+    _pin(tmp_path, blob)
+    records, torn = decode_blob(blob)
+    assert records == [] and torn == {"frame_index": 0, "byte_offset": 0}
+
+
+def test_oversized_declared_length_pins_the_file_reader(tmp_path):
+    # the declared length exceeds the bytes on hand: on a finite blob both
+    # readers see a torn tail at the same frame and offset
+    blob = _blob() + struct.pack(">II", 1 << 30, 0)
+    _pin(tmp_path, blob)
+
+
+# ------------------------------------------------- the documented divergence
+def test_streaming_decoder_rejects_oversized_frames_before_the_body():
+    # a socket peer must not be able to make the host buffer an unbounded
+    # frame: the streaming decoder rejects the declared length immediately,
+    # even though on a finite file the same bytes merely read as torn
+    dec = FrameDecoder(max_frame_bytes=1024)
+    dec.feed(WAL_MAGIC)
+    with pytest.raises(ProtocolError, match="oversized"):
+        dec.feed(struct.pack(">II", 2048, 0))
+    assert DEFAULT_MAX_FRAME_BYTES == 64 << 20  # the default guard is pinned
+
+
+# ----------------------------------------------------------- damage contract
+def test_damage_carries_prior_records_and_the_byte_offset():
+    f1 = encode_frame(*RECORDS[0])
+    f2 = encode_frame(*RECORDS[1])
+    bad = bytearray(encode_frame(*RECORDS[2]))
+    bad[-1] ^= 0xFF
+    dec = FrameDecoder()
+    with pytest.raises(ProtocolError, match="crc") as exc_info:
+        dec.feed(WAL_MAGIC + f1 + f2 + bytes(bad))
+    exc = exc_info.value
+    assert [r[0] for r in exc.records] == ["add", "submit"]
+    assert exc.byte_offset == len(WAL_MAGIC) + len(f1) + len(f2)
+
+
+def test_unpicklable_and_non_record_bodies_are_framing_damage():
+    import pickle
+    import zlib
+
+    def _frame_of(body: bytes) -> bytes:
+        return struct.pack(">II", len(body), zlib.crc32(body) & 0xFFFFFFFF) + body
+
+    dec = FrameDecoder(expect_magic=False)
+    with pytest.raises(ProtocolError, match="unpickle"):
+        dec.feed(_frame_of(b"\x00not a pickle"))
+    dec = FrameDecoder(expect_magic=False)
+    with pytest.raises(ProtocolError, match="record"):
+        dec.feed(_frame_of(pickle.dumps(("only", "three", "fields"))))
+
+
+# ------------------------------------------------------------------ streaming
+def test_byte_at_a_time_streaming_equals_the_one_shot_decode():
+    blob = _blob()
+    dec = FrameDecoder()
+    records = []
+    for i in range(len(blob)):
+        records.extend(dec.feed(blob[i:i + 1]))
+    assert _same(records, decode_blob(blob)[0])
+    assert dec.pending_bytes() == 0
+    assert dec.bytes_consumed == len(blob)
+    assert dec.frames_decoded == len(RECORDS)
+
+
+def test_partial_magic_waits_and_wrong_magic_fails_fast():
+    dec = FrameDecoder()
+    assert dec.feed(WAL_MAGIC[:4]) == []
+    assert dec.feed(WAL_MAGIC[4:]) == []
+    dec = FrameDecoder()
+    with pytest.raises(ProtocolError, match="magic"):
+        dec.feed(b"MTX")  # diverges inside the prefix: no point waiting

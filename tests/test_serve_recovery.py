@@ -1,0 +1,217 @@
+"""Crash recovery through the front door (DESIGN §26) — the acceptance pin.
+
+The contract under test: **no record the server ever acked may be lost by a
+crash**, because the ack is issued only after the record (and its
+``serve_mark``) is fsynced into the shard journal. Two rigs pin it:
+
+* an in-process crash simulation over a socketpair (fast; runs the full
+  replay + reconcile path without a real process boundary), and
+* a real ``kill -9`` of a child server process mid-stream over TCP, restart
+  from the surviving WAL, producer reconnect, and seq-watermark
+  reconciliation — acked records dedup as ``dup``, unacked records resend
+  and apply exactly once, and the final state is bit-exact against an oracle
+  fed every unique record once.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.engine.durability import IngestWAL, replay_wal
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.serve.protocol import Producer
+from metrics_tpu.serve.server import MetricsServer
+
+KEY = "recovery-key"
+
+
+@pytest.fixture(autouse=True)
+def _scoped():
+    with observe.scope(reset=True):
+        yield
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=4, validate_args=False)
+
+
+def _batch(seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 4, 8), rng.integers(0, 4, 8)
+
+
+def _wal_only_restart(wal_path):
+    """The WAL-only restart pattern: fresh engine, replay the journal, then
+    attach it for appends — no checkpoint required."""
+    eng = StreamEngine()
+    replay_wal(eng, wal_path)
+    eng._wal = IngestWAL(wal_path)
+    eng._wal_path = str(wal_path)
+    return eng
+
+
+def _oracle(batches):
+    """A never-crashed engine fed every unique record exactly once."""
+    eng = StreamEngine()
+    eng.add_session(_metric(), session_id="s0")
+    for b in batches:
+        eng.submit("s0", *b)
+    eng.tick()
+    return eng.expire("s0").state_fingerprint()
+
+
+# -------------------------------------------------------- in-process crash sim
+def test_crash_sim_replays_wal_and_reconciles_watermarks(tmp_path):
+    wal = tmp_path / "serve.wal"
+    engine = StreamEngine(wal_path=str(wal))
+    server = MetricsServer(engine, KEY, host=None)
+    srv_sock, cli_sock = socket.socketpair()
+    server.adopt(srv_sock)
+    prod = Producer(None, KEY, name="prod-a", sock=cli_sock, drive=lambda: server.poll(0.0))
+
+    batches = [_batch(i) for i in range(4)]
+    prod.add_session(_metric(), session_id="s0")
+    for b in batches[:2]:
+        prod.submit("s0", *b)
+    prod.flush(5.0)
+    acked_before_crash = prod.acked
+    assert acked_before_crash == 3  # add + 2 submits, all fsynced
+
+    # two more submits the server never sees: they stay unacked client-side
+    lost = [prod.submit("s0", *b) for b in batches[2:]]
+    assert prod.outstanding == 2
+
+    # crash: the server process "dies" taking its socket and engine with it
+    prod._drive = None
+    server.close()
+    del engine
+
+    # restart from the journal alone and let the producer reconcile
+    recovered = _wal_only_restart(wal)
+    assert recovered.serve_watermark("prod-a") == acked_before_crash
+    server2 = MetricsServer(recovered, KEY, host=None)
+    srv2, cli2 = socket.socketpair()
+    server2.adopt(srv2)
+    prod._drive = lambda: server2.poll(0.0)
+    prod.reconnect(cli2)
+    assert prod.server_watermark == acked_before_crash
+    prod.flush(5.0)
+    server2.tick()
+
+    assert prod.outstanding == 0
+    assert prod.errors == []  # nothing acked was lost, nothing resent errored
+    assert recovered.serve_watermark("prod-a") == max(lost)
+    assert recovered.expire("s0").state_fingerprint() == _oracle(batches)
+    server2.close()
+
+
+def test_resending_every_acked_record_dedups_after_restart(tmp_path):
+    wal = tmp_path / "serve.wal"
+    engine = StreamEngine(wal_path=str(wal))
+    server = MetricsServer(engine, KEY, host=None)
+    srv_sock, cli_sock = socket.socketpair()
+    server.adopt(srv_sock)
+    prod = Producer(None, KEY, name="prod-a", sock=cli_sock, drive=lambda: server.poll(0.0))
+    batches = [_batch(i) for i in range(3)]
+    prod.add_session(_metric(), session_id="s0")
+    for b in batches:
+        prod.submit("s0", *b)
+    prod.flush(5.0)
+    server.close()
+
+    recovered = _wal_only_restart(wal)
+    server2 = MetricsServer(recovered, KEY, host=None)
+    srv2, cli2 = socket.socketpair()
+    server2.adopt(srv2)
+    # a paranoid producer that lost its ack state replays EVERYTHING
+    prod2 = Producer(None, KEY, name="prod-a", sock=cli2, drive=lambda: server2.poll(0.0))
+    prod2.add_session(_metric(), session_id="s0")
+    for b in batches:
+        prod2.submit("s0", *b)
+    prod2.flush(5.0)
+    server2.tick()
+    assert server2.dedup_skipped == 4  # every replayed record was a dup
+    assert recovered.expire("s0").state_fingerprint() == _oracle(batches)
+    server2.close()
+
+
+# ------------------------------------------------------------- real kill -9
+_CHILD = """
+import sys
+from metrics_tpu.classification import MulticlassAccuracy  # preload for unpickling
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.serve.server import MetricsServer
+
+engine = StreamEngine(wal_path=sys.argv[1])
+server = MetricsServer(engine, {key!r}, host="127.0.0.1")
+print(server.address[1], flush=True)
+n = 0
+while True:
+    server.poll(0.05)
+    n += 1
+    if n % 8 == 0:
+        engine.tick()
+"""
+
+
+def test_kill_dash_nine_mid_stream_loses_no_acked_record(tmp_path):
+    wal = tmp_path / "serve.wal"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD.format(key=KEY), str(wal)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+    try:
+        port = int(child.stdout.readline())
+        prod = Producer(("127.0.0.1", port), KEY, name="prod-a")
+        batches = [_batch(i) for i in range(6)]
+        prod.add_session(_metric(), session_id="s0")
+        for b in batches[:3]:
+            prod.submit("s0", *b)
+        prod.flush(30.0)  # wave 1 fully acked: it is on disk, by contract
+        acked_before_kill = prod.acked
+
+        # wave 2 in flight: pump until at least one more ack lands, then KILL
+        wave2 = [prod.submit("s0", *b) for b in batches[3:]]
+        deadline = time.monotonic() + 30.0
+        while prod.acked < acked_before_kill + 1:
+            prod.pump()
+            assert time.monotonic() < deadline, "no wave-2 ack before deadline"
+            time.sleep(0.005)
+        acked_at_kill = prod.acked
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(30.0)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(30.0)
+
+    # restart from the surviving journal: every acked pseq must be marked
+    recovered = _wal_only_restart(wal)
+    assert recovered.serve_watermark("prod-a") >= acked_at_kill
+    server2 = MetricsServer(recovered, KEY, host="127.0.0.1")
+    try:
+        sock = socket.create_connection(server2.address)
+        prod._drive = lambda: server2.poll(0.0)
+        prod.reconnect(sock)
+        # the welcome reconciles the producer's watermark with the journal
+        assert prod.server_watermark >= acked_at_kill
+        prod.flush(30.0)  # unacked tail resends; acked resends dedup as dup
+        server2.tick()
+        assert prod.outstanding == 0
+        assert prod.errors == []
+        assert recovered.serve_watermark("prod-a") == max(wave2)
+        assert recovered.expire("s0").state_fingerprint() == _oracle(batches)
+        prod.close()
+    finally:
+        server2.close()

@@ -1,0 +1,251 @@
+"""Front-door reactor semantics (``serve/server.py``, DESIGN §26).
+
+Socketpair-driven (``server.adopt``), single-threaded: the test plays both
+ends. Pins the handshake (auth before any data record), the admission verdict
+mechanics (defer is retried and NOT watermarked; reject IS watermarked so
+resends dedup), the per-record ``err`` ack that keeps the connection alive,
+shard routing + per-shard watermarks on a sharded engine, the
+fsync-before-ack ordering (every acked record is on disk in the target
+shard's journal), and the shed verdict driving the autonomic loose-first
+path before admitting the arrival.
+"""
+
+from __future__ import annotations
+
+import socket
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.engine.durability import IngestWAL
+from metrics_tpu.engine.stream import StreamEngine
+from metrics_tpu.serve.admission import AdmissionController, AdmissionRule
+from metrics_tpu.serve.autonomic import AutonomicController
+from metrics_tpu.serve.protocol import (
+    Producer,
+    ProtocolError,
+    WAL_MAGIC,
+    encode_frame,
+)
+from metrics_tpu.serve.server import MetricsServer
+
+KEY = "test-key"
+
+
+@pytest.fixture(autouse=True)
+def _scoped():
+    with observe.scope(reset=True):
+        yield
+
+
+def _rig(tmp_path, engine=None, **kwargs):
+    """Listener-less server + adopted socketpair + in-process Producer."""
+    if engine is None:
+        engine = StreamEngine(wal_path=str(tmp_path / "serve.wal"))
+    server = MetricsServer(engine, KEY, host=None, **kwargs)
+    srv_sock, cli_sock = socket.socketpair()
+    server.adopt(srv_sock)
+    prod = Producer(
+        None, KEY, name="prod-a", sock=cli_sock, drive=lambda: server.poll(0.0)
+    )
+    return engine, server, prod
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=4, validate_args=False)
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 4, 8), rng.integers(0, 4, 8)
+
+
+# ------------------------------------------------------------------ handshake
+def test_wrong_session_key_is_rejected_before_any_data(tmp_path):
+    engine = StreamEngine(wal_path=str(tmp_path / "serve.wal"))
+    server = MetricsServer(engine, KEY, host=None)
+    srv_sock, cli = socket.socketpair()
+    server.adopt(srv_sock)
+    with pytest.raises(ProtocolError):
+        Producer(None, "wrong-key", name="evil", sock=cli, drive=lambda: server.poll(0.0))
+    assert server.stats()["producers"] == []
+    assert len(engine) == 0
+
+
+def test_data_before_hello_is_a_protocol_error(tmp_path):
+    engine = StreamEngine(wal_path=str(tmp_path / "serve.wal"))
+    server = MetricsServer(engine, KEY, host=None)
+    srv_sock, cli = socket.socketpair()
+    server.adopt(srv_sock)
+    cli.sendall(WAL_MAGIC + encode_frame("submit", 1, "s0", ((), {})))
+    server.poll(0.0)
+    assert server.protocol_errors == 1
+    assert len(engine) == 0
+
+
+def test_welcome_carries_the_fleet_watermark_and_credits(tmp_path):
+    engine, server, prod = _rig(tmp_path, window=7)
+    assert prod.window == 7  # granted by the welcome
+    assert prod.server_watermark == 0
+    prod.add_session(_metric(), session_id="s0")
+    prod.flush(5.0)
+    # a second producer under the same name sees its recovered watermark
+    srv2, cli2 = socket.socketpair()
+    server.adopt(srv2)
+    prod2 = Producer(None, KEY, name="prod-a", sock=cli2, drive=lambda: server.poll(0.0))
+    assert prod2.server_watermark == 1
+
+
+# ----------------------------------------------------------- admission verdicts
+def test_defer_is_not_watermarked_and_retries_to_acceptance(tmp_path):
+    defer_once = AdmissionController((
+        AdmissionRule("always_defer", "occupancy_pct", ">=", 0.0, "defer", 0.0),
+    ))
+    engine, server, prod = _rig(tmp_path, admission=defer_once)
+    pseq = prod.add_session(_metric(), session_id="s0")
+    prod.pump()
+    server.poll(0.0)
+    prod.pump()
+    assert prod.deferred >= 1
+    assert engine.serve_watermark("prod-a") < pseq  # NOT marked: will be retried
+    server.admission = AdmissionController()  # default table: accepts
+    prod.flush(5.0)
+    assert len(engine) == 1
+    assert engine.serve_watermark("prod-a") == pseq
+
+
+def test_reject_is_watermarked_so_resends_dedup(tmp_path):
+    reject_all = AdmissionController((
+        AdmissionRule("always_reject", "occupancy_pct", ">=", 0.0, "reject"),
+    ))
+    engine, server, prod = _rig(tmp_path, admission=reject_all)
+    pseq = prod.add_session(_metric(), session_id="s0")
+    prod.flush(5.0)
+    assert prod.rejected == 1
+    assert len(engine) == 0  # refused: never applied
+    assert engine.serve_watermark("prod-a") == pseq  # but final: marked
+    # a byte-level resend of the refused record dedups instead of re-judging
+    prod._send_raw(encode_frame("add", pseq, "s0", _metric()))
+    server.poll(0.0)
+    prod.pump()
+    assert server.dedup_skipped == 1
+    assert len(engine) == 0
+
+
+def test_shed_verdict_evicts_loose_first_then_admits(tmp_path):
+    engine = StreamEngine(wal_path=str(tmp_path / "serve.wal"))
+    auto = AutonomicController(engine, min_interval_s={"shed": 0.0})
+    shed_table = AdmissionController((
+        AdmissionRule("overload", "occupancy_pct", ">=", 0.0, "shed"),
+    ))
+    engine, server, prod = _rig(tmp_path, engine=engine, admission=shed_table, autonomic=auto)
+    # seed sessions through the engine directly, demote one to loose
+    engine.add_session(_metric(), session_id="bucketed")
+    engine.add_session(_metric(), session_id="loose")
+    engine._demote_session(engine._sessions["loose"])
+    prod.add_session(_metric(), session_id="arrival")
+    prod.flush(5.0)
+    assert "loose" not in engine._sessions  # shed loose-first...
+    assert "bucketed" in engine._sessions  # ...never a bucketed survivor
+    assert "arrival" in engine._sessions  # and the arrival was admitted
+    assert auto.counts["shed"] == 1
+
+
+# ------------------------------------------------------------- per-record faults
+def test_bad_api_call_gets_err_ack_and_the_connection_survives(tmp_path):
+    engine, server, prod = _rig(tmp_path)
+    prod.add_session(_metric(), session_id="s0")
+    prod.flush(5.0)
+    bad = prod.submit("no-such-session", *_batch())
+    prod.flush(5.0)
+    assert [e[0] for e in prod.errors] == [bad]
+    assert "KeyError" in prod.errors[0][3] or "unknown" in prod.errors[0][3].lower()
+    # the connection is still healthy: the next record applies normally
+    prod.submit("s0", *_batch())
+    prod.flush(5.0)
+    server.tick()
+    sess = engine._sessions["s0"]
+    assert sess.base_count + sess.engine_count >= 1
+
+
+def test_duplicate_pseq_dedups_against_the_watermark(tmp_path):
+    engine, server, prod = _rig(tmp_path)
+    prod.add_session(_metric(), session_id="s0")
+    pseq = prod.submit("s0", *_batch())
+    prod.flush(5.0)
+    server.tick()
+    sess = engine._sessions["s0"]
+    applied = sess.base_count + sess.engine_count
+    assert applied == 1
+    prod._send_raw(encode_frame("submit", pseq, "s0", (_batch(), {})))
+    server.poll(0.0)
+    prod.pump()
+    server.tick()
+    assert server.dedup_skipped == 1
+    assert sess.base_count + sess.engine_count == applied  # not double-applied
+
+
+# ------------------------------------------------------------ durability ordering
+def test_every_acked_record_is_on_disk_before_the_ack(tmp_path):
+    wal = tmp_path / "serve.wal"
+    engine, server, prod = _rig(tmp_path)
+    prod.add_session(_metric(), session_id="s0")
+    prod.submit("s0", *_batch())
+    prod.flush(5.0)
+    # both records acked -> both journaled (with their serve_marks) and fsynced
+    records, torn = IngestWAL.read_records_detailed(str(wal))
+    assert torn is None
+    kinds = [r[0] for r in records]
+    assert kinds.count("add") == 1 and kinds.count("submit") == 1
+    assert kinds.count("serve_mark") == 2
+    marks = [(r[2], r[3]) for r in records if r[0] == "serve_mark"]
+    assert marks == [("prod-a", 1), ("prod-a", 2)]
+
+
+# ------------------------------------------------------------------ sharded routing
+def test_sharded_engine_routes_and_watermarks_per_shard(tmp_path):
+    from metrics_tpu.engine.sharded import ShardedStreamEngine, shard_of
+
+    fleet = ShardedStreamEngine(n_shards=2, wal_dir=str(tmp_path / "fleet"))
+    server = MetricsServer(fleet, KEY, host=None)
+    srv_sock, cli_sock = socket.socketpair()
+    server.adopt(srv_sock)
+    prod = Producer(None, KEY, name="prod-a", sock=cli_sock, drive=lambda: server.poll(0.0))
+    # find session ids landing on different shards
+    sids = {}
+    i = 0
+    while len(sids) < 2:
+        sids.setdefault(shard_of(f"s{i}", 2), f"s{i}")
+        i += 1
+    for sid in sids.values():
+        prod.add_session(_metric(), session_id=sid)
+        prod.submit(sid, *_batch())
+    prod.flush(5.0)
+    server.tick()
+    for shard_idx, sid in sids.items():
+        shard = fleet._shards[shard_idx]
+        assert sid in shard._sessions  # routed by the same stable hash
+        # each shard's watermark covers exactly the records it applied
+        assert shard.serve_watermark("prod-a") >= 1
+    # the fleet watermark is the max across shards
+    assert fleet.serve_watermark("prod-a") == 4
+
+
+# ------------------------------------------------------------------ lifecycle
+def test_loopback_listener_and_thread_loop(tmp_path):
+    engine = StreamEngine(wal_path=str(tmp_path / "serve.wal"))
+    server = MetricsServer(engine, KEY, host="127.0.0.1")
+    assert server.address is not None
+    server.serve_in_thread(poll_interval_s=0.005, tick_every=2)
+    try:
+        prod = Producer(server.address, KEY, name="prod-a")
+        prod.add_session(_metric(), session_id="s0")
+        prod.submit("s0", *_batch())
+        prod.flush(10.0)
+        assert prod.outstanding == 0
+        prod.close()
+    finally:
+        server.close()
+    assert len(engine) == 1

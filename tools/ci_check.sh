@@ -35,15 +35,21 @@
 #   tools/ci_check.sh --json     # one machine-readable document on stdout
 #   tools/ci_check.sh --tier1    # the tier-1 test suite (CPU, not-slow) with
 #                                # --durations=20 so CI logs name the slowest
-#                                # tests when the timing budget drifts
+#                                # tests when the timing budget drifts, then
+#                                # the <=30s serve front-door smoke (loopback
+#                                # producer, 100 sessions, one forced
+#                                # overload -> shed -> recover cycle)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" == "--tier1" ]]; then
   shift
-  exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  rc=0
+  env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors --durations=20 \
-    -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=$?
+  env JAX_PLATFORMS=cpu timeout -k 5 60 python -m metrics_tpu.serve.smoke || rc=1
+  exit "$rc"
 fi
 
 exec python tools/lint_metrics.py --all "$@"

@@ -234,6 +234,54 @@ def build_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) ->
             ],
         }
 
+    # serve front door (DESIGN §26): network ingest, admission verdicts, and
+    # the autonomic reflex counters — None when no producer ever connected
+    serve = None
+    frames = derived.get("serve_frames_total", _counter_total(snap, "serve_frames"))
+    producers = derived.get("serve_producers_connected", _gauge_total(snap, "serve_producers"))
+    if frames or producers:
+        deferred = int(derived.get("serve_deferred_total", 0))
+        shed = int(
+            derived.get(
+                "serve_shed_total", (snap.get("counters", {}).get("serve_admission") or {}).get("shed", 0)
+            )
+        )
+        admission = {
+            "accept": int(derived.get("serve_admitted_total", 0)),
+            "defer": deferred,
+            "shed": shed,
+            "reject": int(derived.get("serve_rejected_total", 0)),
+        }
+        actions = snap.get("counters", {}).get("autonomic_actions") or {}
+        serve = {
+            "producers": int(producers),
+            "queue_depth": int(_gauge_total(snap, "serve_queue_depth")),
+            "frames": int(frames),
+            "frames_rate_per_s": (
+                ((frames - pderived["serve_frames_total"]) / window)
+                if (prev and "serve_frames_total" in pderived and window)
+                else None
+            ),
+            "bytes_in": int(derived.get("serve_bytes_in_total", _counter_total(snap, "serve_bytes_in"))),
+            "admission": admission,
+            "defer_rate_per_s": (
+                ((deferred - pderived["serve_deferred_total"]) / window)
+                if (prev and "serve_deferred_total" in pderived and window)
+                else None
+            ),
+            "shed_sessions": int(_counter_total(snap, "serve_shed_sessions")),
+            "shed_rate_per_s": (
+                ((shed - pderived["serve_shed_total"]) / window)
+                if (prev and "serve_shed_total" in pderived and window)
+                else None
+            ),
+            "dedup_skipped": int(derived.get("serve_dedup_skipped_total", _counter_total(snap, "serve_dedup_skipped"))),
+            "protocol_errors": int(
+                derived.get("serve_protocol_errors_total", _counter_total(snap, "serve_protocol_errors"))
+            ),
+            "autonomic": {action: int(actions[action]) for action in sorted(actions)},
+        }
+
     latency = snap.get("latency") or {}
     ordered = [p for p in _PHASE_ORDER if p in latency]
     ordered += sorted(p for p in latency if p not in _PHASE_ORDER)
@@ -272,6 +320,7 @@ def build_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) ->
         "compiles": compiles,
         "tenants": tenants,
         "memory": memory,
+        "serve": serve,
         "phases": phase_rows,
         "footer": footer,
     }
@@ -462,6 +511,32 @@ def render_report(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None) -
                 f"{_fmt_bytes(row['live_bytes']):>10}{_fmt_bytes(row['pad_waste_bytes']):>10}"
                 f"{_fmt_bytes(row['projected_2x_bytes']):>10}"
             )
+
+    if r["serve"]:
+        sv = r["serve"]
+        lines.append("")
+        lines.append("== serve ==")
+        frate = f"  ({sv['frames_rate_per_s']:+.1f}/s)" if sv["frames_rate_per_s"] is not None else ""
+        lines.append(
+            f"ingest           {sv['producers']} producer(s) connected, "
+            f"queue depth {sv['queue_depth']}; {sv['frames']} frames / "
+            f"{_fmt_bytes(float(sv['bytes_in']))}{frate}"
+        )
+        adm = sv["admission"]
+        drate = f", defer {sv['defer_rate_per_s']:+.1f}/s" if sv["defer_rate_per_s"] is not None else ""
+        srate = f", shed {sv['shed_rate_per_s']:+.1f}/s" if sv["shed_rate_per_s"] is not None else ""
+        lines.append(
+            f"admission        accept={adm['accept']} defer={adm['defer']} "
+            f"shed={adm['shed']} reject={adm['reject']}{drate}{srate}"
+        )
+        lines.append(
+            f"dedup/errors     {sv['dedup_skipped']} resends squelched; "
+            f"{sv['protocol_errors']} protocol errors; "
+            f"{sv['shed_sessions']} session(s) shed"
+        )
+        if sv["autonomic"]:
+            act_str = ", ".join(f"{a}={n}" for a, n in sv["autonomic"].items())
+            lines.append(f"autonomic        {act_str}")
 
     lines.append("")
     lines.append("== phases (DDSketch quantiles) ==")
