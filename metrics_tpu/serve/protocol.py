@@ -10,10 +10,14 @@ starts with the same magic and then carries nothing but CRC-framed records,
 so a captured client stream written to disk byte-for-byte *is* a readable
 WAL file, and the decoder here accepts/rejects frames under the same rules
 as :meth:`IngestWAL.read_records_detailed` (pinned by the protocol fuzz
-test). The one deliberate divergence: a socket peer must not be able to make
-the host buffer an unbounded frame, so the streaming decoder rejects any
-declared length above ``max_frame_bytes`` — on a finite file the same bytes
-simply read as a torn tail.
+test). Two deliberate divergences, both because a socket peer is untrusted
+where a local journal file is not: the streaming decoder rejects any
+declared length above ``max_frame_bytes`` before buffering the body (on a
+finite file the same bytes simply read as a torn tail), and it unpickles
+record bodies under the :data:`SAFE_PICKLE_GLOBALS` allowlist — a CRC only
+proves integrity, not trust, and frames arrive *before* any
+authentication, so a frame whose pickle references any global outside the
+allowlist reads as damage (``ProtocolError``), never as code execution.
 
 **Record kinds.** Client→server data records reuse the WAL kinds verbatim —
 ``add`` / ``submit`` / ``expire`` / ``reset`` — with ``seq`` drawn from the
@@ -33,7 +37,11 @@ most unacked records, and after reconnecting the producer simply resends its
 unacked buffer. Routing is a stable hash of the session id, so a resent
 record lands on the same shard; the shard's recovered per-producer watermark
 makes the duplicate detectable (``status="dup"``) and application
-exactly-once.
+exactly-once. The server resolves a producer's records strictly in ``pseq``
+order: while a record sits deferred, every later ``pseq`` is answered
+``defer`` (rule ``ordering``) instead of applied, so the watermark always
+describes a contiguous resolved prefix and a deferred record's retry can
+never be mistaken for a duplicate.
 
 **Backpressure.** The ``welcome`` grants a credit window: the producer keeps
 at most ``window`` data records in flight (sent, unacked); each ack returns
@@ -43,6 +51,7 @@ buffer and are retried after ``retry_after_s``.
 
 from __future__ import annotations
 
+import io
 import pickle
 import select
 import socket
@@ -63,9 +72,11 @@ __all__ = [
     "PROTO_VERSION",
     "Producer",
     "ProtocolError",
+    "SAFE_PICKLE_GLOBALS",
     "WAL_MAGIC",
     "decode_blob",
     "encode_frame",
+    "restricted_loads",
 ]
 
 PROTO_VERSION = 1
@@ -77,6 +88,44 @@ CONTROL_KINDS = ("hello", "welcome", "ack", "ping", "pong", "bye")
 
 class ProtocolError(RuntimeError):
     """Framing or handshake violation; the connection cannot be trusted past it."""
+
+
+# The outer (kind, seq, sid, payload) record is pure data — containers,
+# scalars, strings, bytes — plus the reconstruction callables numpy and jax
+# array payloads pickle through. Anything else (the classic ``os.system``
+# reduce gadget included) raises, and the frame reads as damage. Metric
+# objects are unaffected: they travel as tagged ``("__metric__", bytes)``
+# blobs that the server unpickles only after the session key authenticated
+# the producer.
+SAFE_PICKLE_GLOBALS = frozenset({
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy.core.multiarray", "_reconstruct"),  # frames from pre-numpy-2 writers
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("jax._src.array", "_reconstruct_array"),
+})
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str) -> Any:
+        if (module, name) in SAFE_PICKLE_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(f"disallowed global {module}.{name}")
+
+
+def restricted_loads(blob: bytes) -> Any:
+    """``pickle.loads`` confined to :data:`SAFE_PICKLE_GLOBALS`.
+
+    Safe for bytes from an unauthenticated socket peer: a pickle that names
+    any other global — i.e. anything that could execute code — raises
+    ``UnpicklingError`` instead of importing it. Used by :class:`FrameDecoder`
+    for every frame, on both the server and the client side.
+    """
+    return _RestrictedUnpickler(io.BytesIO(blob)).load()
 
 
 def encode_frame(kind: str, seq: int, sid: Any, payload: Any = None) -> bytes:
@@ -145,9 +194,10 @@ class FrameDecoder:
             if zlib.crc32(body) & 0xFFFFFFFF != crc:
                 raise self._damage("frame crc mismatch", out)
             try:
-                rec = pickle.loads(body)
-            except Exception as exc:  # noqa: BLE001 — CRC passed but the record is garbage
-                raise self._damage(f"frame does not unpickle: {type(exc).__name__}", out) from exc
+                rec = restricted_loads(body)
+            except Exception as exc:  # noqa: BLE001 — CRC passed but the record is garbage or hostile
+                detail = str(exc) if isinstance(exc, pickle.UnpicklingError) else type(exc).__name__
+                raise self._damage(f"frame does not unpickle: {detail}", out) from exc
             if not (isinstance(rec, tuple) and len(rec) == 4):
                 raise self._damage("frame is not a (kind, seq, sid, payload) record", out)
             del self._buf[: _FRAME.size + length]
@@ -369,13 +419,30 @@ class Producer:
             else:
                 select.select([self._sock], [], [], 0.05)
 
+    def resume_from_watermark(self) -> int:
+        """Skip pseq numbering past the server's recovered watermark.
+
+        For a *fresh* producer process that reuses a durable name but has NEW
+        data to send (no replay): without this, its numbering restarts at 1
+        and every new record is silently squelched as a ``dup`` of the
+        recovered prefix. Never call it when replaying old records for
+        idempotence — replay relies on reusing the original numbering.
+        Returns the pseq the next record will follow.
+        """
+        if self._unacked:
+            raise ProtocolError("resume_from_watermark with records unacked: replay them instead")
+        self._seq = max(self._seq, self.server_watermark)
+        return self._seq
+
     def reconnect(self, sock: Optional[socket.socket] = None) -> None:
         """Re-handshake after a drop and resend the whole unacked buffer.
 
         The welcome watermark is informational only: after a crash, shards
         may have durably applied *different* prefixes of the producer's
         stream, so the only safe recovery is resending everything unacked
-        and letting per-shard watermarks squelch the duplicates.
+        and letting per-shard watermarks squelch the duplicates. (A fresh
+        process with new data under a recovered name is the opposite case:
+        see :meth:`resume_from_watermark`.)
         """
         if self._sock is not None:
             try:

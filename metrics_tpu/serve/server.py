@@ -15,7 +15,11 @@ in front of a ``StreamEngine`` or ``ShardedStreamEngine``:
   poll batch before the batch's acks go out — an acked record is durable;
 * **dedups** resends against the target shard's per-producer watermark
   (``status="dup"``), turning the protocol's at-least-once delivery into
-  exactly-once application;
+  exactly-once application — and keeps that watermark sound by resolving a
+  producer's records strictly in ``pseq`` order: while a record sits
+  deferred, every later ``pseq`` is answered ``defer`` (rule ``ordering``)
+  instead of applied, so the watermark never advances over an unresolved
+  gap and a deferred record's retry can never be mistaken for a duplicate;
 * **admits** through the explicit verdict table (``serve/admission.py``),
   refreshing one signal snapshot per poll pass;
 * optionally drives an :class:`AutonomicController` every poll, so the
@@ -82,13 +86,20 @@ class MetricsServer:
         autonomic: Optional[AutonomicController] = None,
         window: int = DEFAULT_WINDOW,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        read_budget_bytes: int = 1 << 20,
         backlog: int = 16,
         name: str = "serve",
     ) -> None:
         self.engine = engine
-        self._key = str(session_key)
+        # the key is compared as bytes: hmac.compare_digest rejects non-ASCII
+        # str input with a TypeError, which a hostile hello could trigger
+        self._key_bytes = str(session_key).encode("utf-8", "replace")
         self.window = int(window)
         self.max_frame_bytes = int(max_frame_bytes)
+        # fairness + memory guards per connection (one fast or non-conforming
+        # producer must not monopolize a poll pass or grow pending unboundedly)
+        self.read_budget_bytes = int(read_budget_bytes)
+        self.pending_cap = max(2 * self.window, 8)
         self._name = str(name)
         self.admission = admission if admission is not None else AdmissionController()
         self.autonomic = autonomic
@@ -106,10 +117,16 @@ class MetricsServer:
             self.address = lsock.getsockname()[:2]
         self._conns: Dict[socket.socket, _Conn] = {}
         self._signals: Dict[str, float] = {}
+        # per-producer contiguous resolved prefix: every pseq <= this was
+        # applied, rejected, errored or deduped. Seeded from the journal's
+        # recovered watermarks at hello; the in-order gate in _apply keeps it
+        # (and therefore the durable serve_marks) free of gaps.
+        self._resolved: Dict[str, int] = {}
         self.frames_total = 0
         self.bytes_in_total = 0
         self.dedup_skipped = 0
         self.protocol_errors = 0
+        self.ordering_defers = 0
         self.disconnects = 0
         self.queue_high_water = 0
         self._thread: Optional[threading.Thread] = None
@@ -166,9 +183,14 @@ class MetricsServer:
             _observe.note_serve_disconnect(conn.producer, reason)
 
     def _read(self, conn: _Conn) -> None:
-        while True:
+        budget = self.read_budget_bytes
+        while budget > 0:
+            if len(conn.pending) >= self.pending_cap:
+                # a peer far past its advertised credit window: stop reading
+                # and let TCP backpressure hold the rest in its send buffer
+                return
             try:
-                chunk = conn.sock.recv(65536)
+                chunk = conn.sock.recv(min(65536, budget))
             except (BlockingIOError, InterruptedError):
                 return
             except (ConnectionResetError, OSError):
@@ -179,6 +201,7 @@ class MetricsServer:
                 # decoded, so the engine saw only whole records
                 self._drop(conn, "eof")
                 return
+            budget -= len(chunk)
             self.bytes_in_total += len(chunk)
             conn.bytes_unmetered += len(chunk)
             _observe.note_serve_bytes(len(chunk))
@@ -186,11 +209,17 @@ class MetricsServer:
                 conn.pending.extend(conn.decoder.feed(chunk))
             except ProtocolError as exc:
                 # intact records decoded before the damage still count; the
-                # framing itself can no longer be trusted past it
+                # framing itself can no longer be trusted past it. They face
+                # the same admission signals and durability point as a normal
+                # poll batch (acks queued here die with the drop, so fsyncing
+                # before it keeps the ack-implies-durable contract vacuously
+                # true and the journal consistent with what was applied).
                 conn.pending.extend(getattr(exc, "records", []))
                 self.protocol_errors += 1
                 _observe.note_serve_protocol_error(str(exc))
+                self._signals = self.admission.signals(self.engine)
                 self._process(conn)
+                self._sync_wals()
                 self._drop(conn, "protocol_error")
                 return
 
@@ -199,6 +228,11 @@ class MetricsServer:
         conn.out += encode_frame(kind, pseq, sid, payload)
 
     def _materialize_metric(self, payload: Any) -> Metric:
+        # the nested blob is full pickle by design — it reconstructs arbitrary
+        # Metric subclasses — and is only ever loaded here, after the session
+        # key authenticated the producer and admission accepted the arrival;
+        # pre-auth bytes never reach pickle machinery beyond the restricted
+        # frame decoder (protocol.SAFE_PICKLE_GLOBALS)
         if isinstance(payload, tuple) and len(payload) == 2 and payload[0] == "__metric__":
             payload = pickle.loads(payload[1])
         if not isinstance(payload, Metric):
@@ -221,17 +255,21 @@ class MetricsServer:
                         _observe.note_serve_protocol_error("data before hello")
                         conn.closing = True
                         return
-                    key = str((payload or {}).get("key", ""))
-                    producer = str((payload or {}).get("producer", sid))
-                    if not hmac.compare_digest(key, self._key):
+                    hello = payload if isinstance(payload, dict) else {}
+                    key = str(hello.get("key", "")).encode("utf-8", "replace")
+                    producer = str(hello.get("producer", sid))
+                    if not hmac.compare_digest(key, self._key_bytes):
                         _observe.note_serve_admission("reject", "auth")
                         self._respond(conn, "ack", 0, None, {"status": "reject", "reason": "auth"})
                         conn.closing = True
                         return
                     conn.producer = producer
+                    wm = self._fleet_watermark(producer)
+                    if wm > self._resolved.get(producer, 0):
+                        self._resolved[producer] = wm
                     _observe.note_serve_connect(producer)
                     self._respond(conn, "welcome", 0, producer, {
-                        "watermark": self._fleet_watermark(producer),
+                        "watermark": wm,
                         "credits": self.window,
                         "proto": PROTO_VERSION,
                     })
@@ -247,8 +285,20 @@ class MetricsServer:
                     _observe.note_serve_protocol_error(f"unknown kind {kind!r}")
                     conn.closing = True
                     return
+                if not isinstance(pseq, int) or isinstance(pseq, bool) or pseq < 1:
+                    self.protocol_errors += 1
+                    _observe.note_serve_protocol_error(f"bad pseq for {kind!r} record")
+                    conn.closing = True
+                    return
                 n_data += 1
-                self._apply(conn, kind, int(pseq), sid, payload)
+                self._apply(conn, kind, pseq, sid, payload)
+        except Exception as exc:  # noqa: BLE001 — a malformed CRC-valid record
+            # must cost only its own connection, never the reactor: anything
+            # escaping per-record handling would otherwise propagate out of
+            # poll() and kill service for every connected producer
+            self.protocol_errors += 1
+            _observe.note_serve_protocol_error(f"malformed record: {type(exc).__name__}")
+            conn.closing = True
         finally:
             # per-producer ingest attribution (observe/metering.py): one meter
             # call per processed batch, covering early exits too
@@ -263,11 +313,25 @@ class MetricsServer:
     def _apply(self, conn: _Conn, kind: str, pseq: int, sid: Any, payload: Any) -> None:
         producer = conn.producer
         target = self._target_engine(sid) if sid is not None else self._engines()[0]
+        resolved = self._resolved.get(producer, 0)
         if pseq <= target.serve_watermark(producer):
-            # a resend of something this shard already durably applied
+            # a resend of something this shard already durably resolved
             self.dedup_skipped += 1
             _observe.note_serve_dedup(producer)
             self._respond(conn, "ack", pseq, sid, {"status": "dup"})
+            if pseq > resolved:
+                self._resolved[producer] = pseq
+            return
+        if pseq > resolved + 1:
+            # in-order resolution: an earlier record from this producer is
+            # still unresolved (deferred). Applying or watermarking this one
+            # would advance the shard watermark over the gap and the deferred
+            # record's retry would be falsely acked "dup" — applied never.
+            self.ordering_defers += 1
+            _observe.note_serve_admission("defer", "ordering")
+            self._respond(conn, "ack", pseq, sid, {
+                "status": "defer", "rule": "ordering", "retry_after_s": 0.05,
+            })
             return
         decision = self.admission.decide(kind, self._signals)
         _observe.note_serve_admission(decision.verdict, decision.rule)
@@ -276,9 +340,10 @@ class MetricsServer:
                 "status": "defer", "rule": decision.rule,
                 "retry_after_s": decision.retry_after_s if decision.retry_after_s is not None else 0.25,
             })
-            return  # not marked: the producer retries and is judged again
+            return  # unresolved: the ordering gate holds later pseqs back until the retry
         if decision.verdict == "reject":
             target.serve_mark(producer, pseq)  # refusals are final: dedup resends
+            self._resolved[producer] = max(resolved, pseq)
             self._respond(conn, "ack", pseq, sid, {"status": "reject", "reason": decision.rule})
             return
         if decision.verdict == "shed" and self.autonomic is not None:
@@ -297,6 +362,7 @@ class MetricsServer:
         except Exception as exc:  # noqa: BLE001 — per-record failure, connection survives
             status = {"status": "err", "reason": f"{type(exc).__name__}: {str(exc)[:200]}"}
         target.serve_mark(producer, pseq)
+        self._resolved[producer] = max(resolved, pseq)
         self._respond(conn, "ack", pseq, sid, status)
 
     # ---------------------------------------------------------------- IO pump
@@ -402,6 +468,7 @@ class MetricsServer:
             "bytes_in_total": self.bytes_in_total,
             "dedup_skipped": self.dedup_skipped,
             "protocol_errors": self.protocol_errors,
+            "ordering_defers": self.ordering_defers,
             "disconnects": self.disconnects,
             "queue_high_water": self.queue_high_water,
             "admission": dict(self.admission.counts),

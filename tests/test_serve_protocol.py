@@ -4,11 +4,13 @@ The socket stream IS the journal format: the stream decoder must accept and
 reject bytes under exactly the rules of ``IngestWAL.read_records_detailed``.
 These tests pin that equivalence byte-for-byte — over truncations at every
 byte boundary, single bit-flips at every byte, oversized declared lengths and
-alien magic — plus the one documented divergence (the streaming decoder
+alien magic — plus the two documented divergences (the streaming decoder
 rejects a declared length above ``max_frame_bytes`` before buffering the
-body), the writer identity (``encode_frame`` == ``IngestWAL.append`` bytes),
-and the damage contract (records decoded before the damage ride on the
-exception, with the byte offset where trust ended).
+body, and unpickles record bodies under the ``SAFE_PICKLE_GLOBALS``
+allowlist so a hostile pre-auth frame can never execute code), the writer
+identity (``encode_frame`` == ``IngestWAL.append`` bytes), and the damage
+contract (records decoded before the damage ride on the exception, with the
+byte offset where trust ended).
 """
 
 from __future__ import annotations
@@ -136,7 +138,59 @@ def test_oversized_declared_length_pins_the_file_reader(tmp_path):
     _pin(tmp_path, blob)
 
 
-# ------------------------------------------------- the documented divergence
+# ------------------------------------------------ the documented divergences
+_EXECUTED = []
+
+
+def _boom(arg):
+    _EXECUTED.append(arg)
+    return arg
+
+
+class _Gadget:
+    """The classic pickle RCE shape: __reduce__ names an arbitrary callable."""
+
+    def __reduce__(self):
+        return (_boom, ("pwned",))
+
+
+def test_hostile_pickle_frame_is_damage_not_code_execution():
+    # a CRC-valid frame whose pickle smuggles a callable: the restricted
+    # decoder must raise without ever importing/calling the gadget — this is
+    # exactly the pre-auth byte stream an unauthenticated peer controls
+    _EXECUTED.clear()
+    evil = encode_frame("submit", 1, "s0", _Gadget())
+    dec = FrameDecoder()
+    with pytest.raises(ProtocolError, match="disallowed global"):
+        dec.feed(WAL_MAGIC + evil)
+    assert _EXECUTED == []  # the payload never ran
+    # on the streaming side the frame is damage like any other: decode_blob
+    # reports a tear where trust ended instead of records
+    records, torn = decode_blob(WAL_MAGIC + evil)
+    assert records == [] and torn == {"frame_index": 0, "byte_offset": len(WAL_MAGIC)}
+
+
+def test_safe_globals_cover_real_producer_payloads():
+    # everything a conforming producer actually pickles decodes: plain data,
+    # numpy arrays and scalars, jax arrays, and the tagged metric blob
+    import jax.numpy as jnp
+
+    payloads = [
+        {"key": "k", "proto": 1},
+        ((np.arange(6, dtype=np.int32).reshape(2, 3), np.float32(0.5)), {"w": np.int64(2)}),
+        ((jnp.arange(4),), {}),
+        ("__metric__", b"\x80\x05N."),
+    ]
+    blob = WAL_MAGIC + b"".join(
+        encode_frame("submit", i + 1, "s0", p) for i, p in enumerate(payloads)
+    )
+    records, torn = decode_blob(blob)
+    assert torn is None and len(records) == len(payloads)
+    got = records[1][3]
+    assert isinstance(got[0][0], np.ndarray) and got[0][0].dtype == np.int32
+    assert np.array_equal(np.asarray(records[2][3][0][0]), np.arange(4))
+
+
 def test_streaming_decoder_rejects_oversized_frames_before_the_body():
     # a socket peer must not be able to make the host buffer an unbounded
     # frame: the streaming decoder rejects the declared length immediately,

@@ -145,6 +145,49 @@ def test_resending_every_acked_record_dedups_after_restart(tmp_path):
     server2.close()
 
 
+def test_fresh_producer_with_new_data_resumes_past_the_watermark(tmp_path):
+    # the opposite restart case from the paranoid replay above: a fresh
+    # process reuses a durable name but brings NEW records. Without
+    # resume_from_watermark its numbering restarts at 1 and every new record
+    # is silently squelched as a dup of the recovered prefix.
+    wal = tmp_path / "serve.wal"
+    engine = StreamEngine(wal_path=str(wal))
+    server = MetricsServer(engine, KEY, host=None)
+    srv_sock, cli_sock = socket.socketpair()
+    server.adopt(srv_sock)
+    prod = Producer(None, KEY, name="prod-a", sock=cli_sock, drive=lambda: server.poll(0.0))
+    prod.add_session(_metric(), session_id="s0")
+    prod.submit("s0", *_batch(0))
+    prod.flush(5.0)
+    server.close()
+
+    recovered = _wal_only_restart(wal)
+    server2 = MetricsServer(recovered, KEY, host=None)
+    srv2, cli2 = socket.socketpair()
+    server2.adopt(srv2)
+    prod2 = Producer(None, KEY, name="prod-a", sock=cli2, drive=lambda: server2.poll(0.0))
+    assert prod2.resume_from_watermark() == 2  # add + one submit recovered
+    prod2.submit("s0", *_batch(1))
+    prod2.flush(5.0)
+    server2.tick()
+    assert server2.dedup_skipped == 0  # the new record really applied
+    assert recovered.serve_watermark("prod-a") == 3
+    assert recovered.expire("s0").state_fingerprint() == _oracle(
+        [_batch(0), _batch(1)]
+    )
+    # and it refuses to fast-forward over an unflushed buffer
+    live = {"on": True}
+    s_srv, s_cli = socket.socketpair()
+    server2.adopt(s_srv)
+    p = Producer(None, KEY, name="prod-b", sock=s_cli,
+                 drive=lambda: server2.poll(0.0) if live["on"] else None)
+    live["on"] = False
+    p.submit("s0", *_batch(2))  # sent but never acked: the server is not polled
+    with pytest.raises(Exception, match="unacked"):
+        p.resume_from_watermark()
+    server2.close()
+
+
 # ------------------------------------------------------------- real kill -9
 _CHILD = """
 import sys

@@ -187,6 +187,167 @@ def test_duplicate_pseq_dedups_against_the_watermark(tmp_path):
     assert sess.base_count + sess.engine_count == applied  # not double-applied
 
 
+# ----------------------------------------------------- in-order resolution
+def test_deferred_record_is_not_lost_behind_later_pseqs(tmp_path):
+    """REVIEW regression: a deferred add followed by records the defer rule
+    does not cover (submits bypass arrivals_only rows) must not advance the
+    watermark over the gap — the retry applies instead of false-dup'ing."""
+    defer_arrivals = AdmissionController((
+        AdmissionRule("arrivals_defer", "occupancy_pct", ">=", 0.0, "defer", 0.0),
+    ))
+    engine, server, prod = _rig(tmp_path, admission=defer_arrivals)
+    add_pseq = prod.add_session(_metric(), session_id="s0")
+    sub_pseq = prod.submit("s0", *_batch())
+    for _ in range(4):
+        prod.pump()
+        server.poll(0.0)
+        prod.pump()
+    # the add is deferred by the table; the submit must be held back by the
+    # ordering gate, NOT applied — so nothing is watermarked yet
+    assert engine.serve_watermark("prod-a") == 0
+    assert server.ordering_defers >= 1
+    assert len(engine) == 0
+    server.admission = AdmissionController()  # pressure clears: default accepts
+    prod.flush(5.0)
+    server.tick()
+    # both records landed, in order: the session exists and took the submit
+    assert "s0" in engine._sessions
+    sess = engine._sessions["s0"]
+    assert sess.base_count + sess.engine_count == 1
+    assert engine.serve_watermark("prod-a") == max(add_pseq, sub_pseq)
+    assert prod.errors == []
+
+
+def test_reject_behind_a_deferred_record_does_not_watermark_the_gap(tmp_path):
+    """The reject verdict is final and watermarked — but only once every
+    earlier pseq is resolved, else it would open the same false-dup gap."""
+    defer_arrivals = AdmissionController((
+        AdmissionRule("arrivals_defer", "occupancy_pct", ">=", 0.0, "defer", 0.0),
+        AdmissionRule("reject_rest", "occupancy_pct", ">=", 0.0, "reject", None, False),
+    ))
+    engine, server, prod = _rig(tmp_path, admission=defer_arrivals)
+    prod.add_session(_metric(), session_id="s0")  # deferred, unresolved
+    prod.submit("s0", *_batch())  # would be rejected — must wait its turn
+    for _ in range(3):
+        prod.pump()
+        server.poll(0.0)
+        prod.pump()
+    assert engine.serve_watermark("prod-a") == 0  # no gap was watermarked
+    server.admission = AdmissionController()
+    prod.flush(5.0)
+    assert engine.serve_watermark("prod-a") == 2  # both resolved, in order
+
+
+# ------------------------------------------------------- hostile-peer fencing
+def test_preauth_hostile_pickle_drops_the_connection_only(tmp_path):
+    """A crafted pickle on the raw socket (pre-hello) must read as framing
+    damage: no code runs, the peer is dropped, and the reactor keeps serving
+    its honest producer."""
+    import struct
+    import zlib
+
+    engine, server, prod = _rig(tmp_path)
+    srv2, evil = socket.socketpair()
+    server.adopt(srv2)
+    # a frame whose pickle names a non-allowlisted global, CRC intact
+    gadget = b"c__builtin__\neval\n(V1+1\ntR."
+    frame = struct.pack(">II", len(gadget), zlib.crc32(gadget) & 0xFFFFFFFF) + gadget
+    evil.sendall(WAL_MAGIC + frame)
+    server.poll(0.0)
+    assert server.protocol_errors == 1
+    assert server.disconnects == 1  # the hostile peer alone
+    # the honest producer is unaffected
+    prod.add_session(_metric(), session_id="s0")
+    prod.flush(5.0)
+    assert len(engine) == 1
+
+
+def test_malformed_crc_valid_records_do_not_kill_the_reactor(tmp_path):
+    """REVIEW regression: non-dict hello payloads, non-int pseqs and
+    non-ASCII keys are CRC-valid frames; each must cost only the offending
+    connection, never the poll loop."""
+    engine, server, prod = _rig(tmp_path)
+    hostile_frames = [
+        encode_frame("hello", 0, "h1", ["not", "a", "dict"]),  # non-dict hello
+        encode_frame("hello", 0, "h2", {"key": "éé-key", "producer": "h2"}),  # non-ASCII key
+    ]
+    for frame in hostile_frames:
+        srv_n, cli_n = socket.socketpair()
+        server.adopt(srv_n)
+        cli_n.sendall(WAL_MAGIC + frame)
+        server.poll(0.0)  # must not raise
+        cli_n.close()
+    # a non-int pseq after a valid hello
+    srv_n, cli_n = socket.socketpair()
+    server.adopt(srv_n)
+    cli_n.sendall(
+        WAL_MAGIC
+        + encode_frame("hello", 0, "h3", {"key": KEY, "producer": "h3"})
+        + encode_frame("submit", "not-an-int", "s0", ((), {}))
+    )
+    server.poll(0.0)  # must not raise
+    assert server.protocol_errors >= 1
+    # the honest producer sails through it all
+    prod.add_session(_metric(), session_id="s0")
+    prod.flush(5.0)
+    assert len(engine) == 1
+
+
+def test_drained_records_from_a_dying_connection_face_live_admission(tmp_path):
+    """REVIEW regression: records decoded before framing damage must be
+    judged under a fresh signal snapshot, not a stale (possibly empty) one
+    that silently admits everything."""
+    reject_all = AdmissionController((
+        AdmissionRule("always_reject", "occupancy_pct", ">=", 0.0, "reject"),
+    ))
+    engine = StreamEngine(wal_path=str(tmp_path / "serve.wal"))
+    server = MetricsServer(engine, KEY, host=None, admission=reject_all)
+    srv_sock, cli = socket.socketpair()
+    server.adopt(srv_sock)
+    good = encode_frame("add", 1, "s0", _metric())
+    bad = bytearray(encode_frame("add", 2, "s1", _metric()))
+    bad[-1] ^= 0xFF  # CRC damage: the connection dies on this frame
+    # hello + intact record + damage in one burst: the server has never run a
+    # poll batch, so before the fix the drained record saw empty signals
+    cli.sendall(
+        WAL_MAGIC
+        + encode_frame("hello", 0, "p", {"key": KEY, "producer": "p"})
+        + good
+        + bytes(bad)
+    )
+    server.poll(0.0)
+    assert server.protocol_errors == 1
+    assert len(engine) == 0  # the reject row tripped: nothing was admitted
+    assert server.admission.counts["reject"] == 1
+
+
+def test_read_budget_and_pending_cap_bound_one_connection(tmp_path):
+    """A firehose peer is paced: one poll reads at most ``read_budget_bytes``
+    and decodes at most ``pending_cap`` records ahead of processing; the
+    backlog drains over subsequent polls without loss."""
+    engine, server, prod = _rig(tmp_path)
+    prod.add_session(_metric(), session_id="s0")
+    prod.flush(5.0)
+    server.read_budget_bytes = 4096
+    server.pending_cap = 4
+    burst = b"".join(
+        encode_frame("submit", 2 + i, "s0", (_batch(i), {})) for i in range(64)
+    )
+    prod._sock.sendall(burst)
+    polled_bytes_before = server.bytes_in_total
+    server.poll(0.0)
+    assert server.bytes_in_total - polled_bytes_before <= 4096  # budget bound one pass
+    # the rest drains across polls, the decoded backlog pinned near the cap;
+    # every record still resolves exactly once
+    for _ in range(256):
+        server.poll(0.0)
+    server.tick()
+    assert server.queue_high_water < 64  # never the whole burst at once
+    assert engine.serve_watermark("prod-a") == 65  # add + 64 submits
+    sess = engine._sessions["s0"]
+    assert sess.base_count + sess.engine_count == 64
+
+
 # ------------------------------------------------------------ durability ordering
 def test_every_acked_record_is_on_disk_before_the_ack(tmp_path):
     wal = tmp_path / "serve.wal"
