@@ -30,12 +30,14 @@ the documented contract after each one:
 A second suite covers the fleet runtime's DESIGN §17 durability contract
 (:func:`check_fleet_chaos_case`): for every bucketable class a
 ``StreamEngine`` with an ingest WAL is killed mid-tick, mid-flush and
-mid-checkpoint, its journal is torn and bit-flipped, and one poisoned row is
-injected into a full bucket — each recovered engine must be *bit-exact*
+mid-checkpoint, its journal is torn and bit-flipped, one poisoned row is
+injected into a full bucket, and the fused tick program is killed at runtime
+with its buffers intact — each recovered engine must be *bit-exact*
 (``Metric.state_fingerprint``) versus a never-crashed oracle engine, corrupt
-snapshots must be rejected with the previous snapshot still recoverable, and
-a quarantined row must never cost its bucket the one-dispatch-per-tick
-economy.
+snapshots must be rejected with the previous snapshot still recoverable, a
+quarantined row must never cost the fleet its one-fused-dispatch-per-tick
+economy, and a dead dispatch must quarantine exactly the poison row while
+every survivor replays bit-exact.
 
 A third suite covers the sharded fleet's DESIGN §21 contract
 (:func:`check_shard_chaos_case`): a :class:`ShardedStreamEngine` whose host is
@@ -715,9 +717,10 @@ def _scenario_poison_row(case: Any) -> Tuple[List[str], bool]:
         eng.submit(sids[idx], *(poisoned if i == 1 else batch))
     dispatches = eng.tick()
     # wave 1 (first submission per slot) carries the poison; wave 2 is clean:
-    # the surviving rows must still coalesce — 2 waves, 2 dispatches, never more
-    if dispatches > 2:
-        bad.append(f"poison[row]: quarantine broke wave coalescing ({dispatches} dispatches for 2 waves)")
+    # both waves chain inside the ONE fused program (DESIGN §27), so even a
+    # quarantine-bearing tick must cost exactly one dispatch, never more
+    if dispatches > 1:
+        bad.append(f"poison[row]: quarantine broke tick fusion ({dispatches} dispatches for 2 waves)")
     if eng.session_health(sids[1]) != "quarantined":
         bad.append(f"poison[row]: poisoned session health is {eng.session_health(sids[1])!r}, expected 'quarantined'")
     for i in (0, 2):
@@ -728,6 +731,88 @@ def _scenario_poison_row(case: Any) -> Tuple[List[str], bool]:
     got = [eng.expire(sid).state_fingerprint() for sid in sids]
     bad += _diff_fingerprints("poison[row]", got, want)
     return bad, True
+
+
+def _scenario_dispatch_death(case: Any) -> List[str]:
+    """Fused-program runtime death with INTACT buffers (DESIGN §17/§27): the
+    per-bucket fallback is also dead, so the engine walks down to per-row
+    eager replay — which must quarantine exactly the poison row (state rolled
+    back, batch dropped) and land every surviving row bit-exact."""
+    import metrics_tpu.engine.stream as stream_mod
+    from metrics_tpu.engine.stream import StreamEngine
+    from metrics_tpu.metric import Metric
+
+    script = _fleet_script(case, _FLEET_SESSIONS)  # one wave, one row per session
+    bad: List[str] = []
+    eng = StreamEngine()
+    sids = [eng.add_session(case.ctor()) for _ in range(_FLEET_SESSIONS)]
+    for idx, batch in script:
+        eng.submit(sids[idx], *batch)
+
+    def dead_dispatch(*_a: Any, **_k: Any) -> Any:
+        raise RuntimeError("chaos: injected runtime dispatch death (buffers intact)")
+
+    real_fused = stream_mod.engine_update_fused
+    real_update = stream_mod.engine_update
+    real_fu = Metric._functional_update
+    calls = {"n": 0, "depth": 0}
+
+    def trapdoor(self: Any, state: Any, *a: Any, **k: Any) -> Any:
+        # count TOP-LEVEL calls only: composite kernels (TimeDecayed, pane
+        # windows) re-enter _functional_update on their base metric, and a
+        # raw call count would land the poison on the wrong session's row
+        if calls["depth"] == 0:
+            i = calls["n"]
+            calls["n"] += 1
+            if i == 1:  # rows replay in wave order: call 1 is session 1's row
+                raise RuntimeError("chaos: poison row")
+        calls["depth"] += 1
+        try:
+            return real_fu(self, state, *a, **k)
+        finally:
+            calls["depth"] -= 1
+
+    stream_mod.engine_update_fused = dead_dispatch
+    stream_mod.engine_update = dead_dispatch
+    Metric._functional_update = trapdoor
+    try:
+        eng.tick()
+    finally:
+        stream_mod.engine_update_fused = real_fused
+        stream_mod.engine_update = real_update
+        Metric._functional_update = real_fu
+
+    if eng.session_health(sids[1]) != "quarantined":
+        bad.append(
+            f"death[replay]: poison session health is {eng.session_health(sids[1])!r}, "
+            "expected 'quarantined'"
+        )
+    for i in (0, 2):
+        if eng.session_health(sids[i]) != "healthy":
+            bad.append(f"death[replay]: surviving session {i} health is {eng.session_health(sids[i])!r}")
+    # the poison row's batch is dropped; every other row replays eagerly
+    # through the pure per-row kernel. The never-crashed oracle ran the
+    # vmapped jitted program instead, and eager-vs-jit bit-exactness is
+    # kernel-dependent (XLA may reassociate differently under vmap), so a
+    # fingerprint mismatch falls back to the fleet pass's tolerance verdict
+    # before being called a fault — the same EXACT/LOOSE ladder that pass
+    # applies to engine-vs-eager state agreement.
+    from metrics_tpu.analysis.fleet_contracts import _compare
+
+    from metrics_tpu.engine.stream import StreamEngine as _SE
+
+    oracle = _SE()
+    osids = [oracle.add_session(case.ctor()) for _ in range(_FLEET_SESSIONS)]
+    for idx, batch in (sb for i, sb in enumerate(script) if i != 1):
+        oracle.submit(osids[idx], *batch)
+    oracle.tick()
+    for i, (sid, osid) in enumerate(zip(sids, osids)):
+        g, w = eng.expire(sid), oracle.expire(osid)
+        if g.state_fingerprint() == w.state_fingerprint():
+            continue
+        if _compare(dict(g.__dict__["_state"]), dict(w.__dict__["_state"])) == "diverged":
+            bad.append(f"death[replay]: session {i} not bit-exact vs the never-crashed oracle")
+    return bad
 
 
 def check_fleet_chaos_case(case: Any) -> ChaosResult:
@@ -779,6 +864,8 @@ def check_fleet_chaos_case(case: Any) -> ChaosResult:
             ran.append("poison[row]")
         else:
             skipped.append("poison[row]")
+        violations += _scenario_dispatch_death(case)
+        ran.append("death[replay]")
     except Exception as exc:  # noqa: BLE001 — a crash in the harness is itself a verdict
         violations.append(f"harness: {type(exc).__name__}: {str(exc)[:200]}")
     finally:

@@ -25,8 +25,9 @@ drops both caches alongside the per-metric shared cache.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import OrderedDict
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +43,15 @@ from metrics_tpu.metric import (
 from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.utils.exceptions import TraceIneligibleError
 
-__all__ = ["DispatchConsumedError", "ProgramCache", "TRACER_ERRORS", "engine_compute", "engine_update"]
+__all__ = [
+    "DispatchConsumedError",
+    "FusedEntry",
+    "ProgramCache",
+    "TRACER_ERRORS",
+    "engine_compute",
+    "engine_update",
+    "engine_update_fused",
+]
 
 # Trace-time failures only: they abort before execution, so donated stacked
 # buffers are still intact and the caller can safely fall back to a loop (or,
@@ -297,6 +306,301 @@ def engine_update(
     if entry.probation:
         return _probation_dispatch(entry, label, call_args, {})
     return entry(*call_args)
+
+
+@dataclasses.dataclass
+class FusedEntry:
+    """One bucket's slice of a fused tick dispatch (DESIGN §27).
+
+    ``groups`` is the bucket's flush plan in wave order: each ``(args, kwargs,
+    mask)`` triple is one masked-vmap application over the padded capacity, so
+    chaining them inside the fused body preserves exactly the per-session
+    submission order the sequential per-bucket dispatches used to.
+
+    ``want_values`` asks the program to also emit the bucket's per-row computes
+    and a live-masked per-state column sum (the incremental-fold partial). The
+    caller must only set it for buckets whose compute is trace-eligible and
+    whose declared merge algebra is all-sum — the fused program sums columns
+    unconditionally, which is only a valid aggregate under that algebra.
+    """
+
+    template: Metric
+    n: int
+    stacked: Dict[str, Any]
+    groups: Sequence[Tuple[Tuple[Any, ...], Dict[str, Any], Any]]
+    want_values: bool = False
+    live: Optional[Any] = None  # (n,) bool occupancy; required when want_values
+    label: str = ""
+
+
+def _fused_spec(entry: FusedEntry) -> Tuple[Any, ...]:
+    """The static identity of one entry inside the fused cache key: everything
+    that forces a distinct traced program for its slice of the body."""
+    groups_sig = []
+    for args, kwargs, _mask in entry.groups:
+        kw_names = tuple(sorted(kwargs))
+        flat = tuple(args) + tuple(kwargs[k] for k in kw_names)
+        groups_sig.append((len(args), kw_names, tuple(_batch_leaf_sig(a) for a in flat)))
+    return (
+        entry.template._jit_cache_key(),
+        entry.n,
+        tuple(groups_sig),
+        entry.template._donation_eligible(),
+        bool(entry.want_values),
+    )
+
+
+def _fused_plan(specs: Sequence[Tuple[Any, ...]]) -> List[Tuple[Tuple[Any, ...], List[int]]]:
+    """Group entry indices into dispatch units, derived from the statics alone
+    (call-time assembly and build-time tracing must agree on the layout).
+
+    Entries with an identical spec whose batch leaves are all arrays share one
+    unit: their operands stack under an extra leading axis and the unit body
+    runs once under ``vmap`` — the same-aval batching half of the tentpole.
+    Specs carrying python-scalar operands stay singleton units (stacking would
+    rematerialize weak-typed scalars as committed arrays).
+    """
+    plan: List[Tuple[Tuple[Any, ...], List[int]]] = []
+    batchable: Dict[Any, int] = {}
+    for i, spec in enumerate(specs):
+        all_arr = all(s[0] == "arr" for _, _, bsig in spec[2] for s in bsig)
+        if all_arr and spec in batchable:
+            plan[batchable[spec]][1].append(i)
+        else:
+            if all_arr:
+                batchable[spec] = len(plan)
+            plan.append((spec, [i]))
+    return plan
+
+
+def _stack_tree(trees: Sequence[Any]) -> Any:
+    if len(trees) == 1:
+        return trees[0]
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *trees)
+
+
+def engine_update_fused(
+    entries: Sequence[FusedEntry],
+    *,
+    cache: ProgramCache = _FLEET_JIT_CACHE,
+    label: Optional[str] = None,
+) -> List[Tuple[Dict[str, Any], Any, Optional[Dict[str, Any]]]]:
+    """Run every entry's masked update chain inside ONE jitted XLA program.
+
+    Returns, aligned with ``entries``, ``(new_stacked, values, partial)`` per
+    entry — ``values``/``partial`` are None unless the entry asked for them.
+    The program donates the stacked states of donation-eligible entries (one
+    donated operand pytree, so XLA aliases input→output buffers across the
+    whole mega-pytree) and chains each bucket's wave groups in order: guards →
+    masked update → per-bucket live-masked partial aggregate, one dispatch.
+
+    Fused executables bind to the disk AOT cache (DESIGN §18) when EVERY
+    chained template carries a ``config_fingerprint`` — the disk key spans all
+    of them, so one unfingerprintable member keeps the whole program
+    memory-only. Dirty-set composition churn mints one artifact per distinct
+    composition; steady-state ticks have a stable composition by construction.
+
+    Failure semantics match ``engine_update``: TRACER_ERRORS abort before
+    execution with every buffer intact; a runtime death after the donation
+    probation consumed its operands surfaces to the caller, which walks the
+    blast-radius ladder per bucket exactly as before.
+    """
+    if not entries:
+        return []
+    specs = tuple(_fused_spec(e) for e in entries)
+    plan = _fused_plan(specs)
+    donors = tuple(spec[3] for spec, _ in plan)
+    donate_any = any(donors)
+    key = ("fused", specs)
+    if label is None:
+        label = "+".join(e.label or type(e.template).__name__ for e in entries)
+        if len(label) > 120:
+            label = f"{label[:117]}..."
+    components = None
+    if _observe.ENABLED:
+        # per-entry decomposition with the SAME component names the masked
+        # per-bucket path used ("capacity", "batch_avals", ...), suffixed by
+        # bucket label only when the tick chains several buckets — so growing
+        # one bucket still attributes as exactly "capacity", not as an opaque
+        # per-entry spec blob
+        comps: List[Tuple[str, Any]] = [("mode", "fused")]
+        if len(entries) > 1:
+            comps.append(("buckets", tuple(e.label or type(e.template).__name__ for e in entries)))
+        for i, e in enumerate(entries):
+            sfx = "" if len(entries) == 1 else f"[{e.label or i}]"
+            cfg = e.template._jit_cache_key()
+            groups_sig = specs[i][2]
+            comps.append((f"class{sfx}", type(e.template).__name__))
+            comps.extend(
+                (f"config{sfx}:" + k.lstrip("_"), v)
+                for k, v in (cfg[1] if cfg is not None else ())
+            )
+            comps.append((f"capacity{sfx}", e.n))
+            comps.append((f"arg_structure{sfx}", tuple((na, kw) for na, kw, _b in groups_sig)))
+            # stacked array operands carry the capacity-sized row axis;
+            # capacity is its own component, so strip it from the reported
+            # avals (same rule as the masked mode)
+            comps.append((
+                f"batch_avals{sfx}",
+                tuple(
+                    tuple(
+                        (s[0], s[1][1:], s[2]) if s[0] == "arr" and len(s[1]) else s
+                        for s in bsig
+                    )
+                    for _na, _kw, bsig in groups_sig
+                ),
+            ))
+            comps.append((f"donation{sfx}", bool(specs[i][3])))
+            comps.append((f"want_values{sfx}", bool(specs[i][4])))
+        comps.append(("x64", bool(jax.config.jax_enable_x64)))
+        components = tuple(comps)
+
+    def build() -> _CompiledUpdate:
+        chains = []
+        for u, (spec, idxs) in enumerate(plan):
+            _cfg, _n, groups_sig, _donate, want_values = spec
+            rep = entries[idxs[0]].template.clone()
+            rep.reset()
+            upd = _named_for_profiler(
+                rep._functional_update, f"{type(rep).__name__}_{cache.kind}_update"
+            )
+            comp = None
+            if want_values:
+                comp = _named_for_profiler(
+                    rep._functional_compute, f"{type(rep).__name__}_{cache.kind}_compute"
+                )
+
+            def chain(st, gops, live, _upd=upd, _comp=comp, _sig=groups_sig, _want=want_values):
+                for (mask, flat), (nargs, kw_names, bsig) in zip(gops, _sig):
+                    arr_flags = tuple(s[0] == "arr" for s in bsig)
+
+                    def one(row, keep, *leaves, _f=_upd, _na=nargs, _kw=kw_names):
+                        new = _f(row, *leaves[:_na], **dict(zip(_kw, leaves[_na:])))
+                        # scalar-predicate where: inactive rows keep their old
+                        # leaves bit-exactly, same contract as the masked mode
+                        return {k: jnp.where(keep, new[k], row[k]) for k in row}
+
+                    in_axes = (0, 0) + tuple(0 if f else None for f in arr_flags)
+                    st = jax.vmap(one, in_axes=in_axes)(st, mask, *flat)
+                if not _want:
+                    return st, None, None
+                vals = jax.vmap(lambda s: _squeeze_if_scalar(_comp(s)), in_axes=(0,))(st)
+                part = {
+                    k: jnp.sum(
+                        jnp.where(
+                            live.reshape(live.shape + (1,) * (v.ndim - 1)),
+                            v,
+                            jnp.zeros((), v.dtype),
+                        ),
+                        axis=0,
+                    )
+                    for k, v in st.items()
+                }
+                return st, vals, part
+
+            chains.append(chain)
+
+        def fused(don, keep, aux):
+            di = ki = 0
+            out_states, out_vals, out_parts = [], [], []
+            for u, (spec, idxs) in enumerate(plan):
+                if donors[u]:
+                    st = don[di]
+                    di += 1
+                else:
+                    st = keep[ki]
+                    ki += 1
+                gops, live = aux[u]
+                if len(idxs) > 1:
+                    st, vals, part = jax.vmap(chains[u])(st, gops, live)
+                else:
+                    st, vals, part = chains[u](st, gops, live)
+                out_states.append(st)
+                out_vals.append(vals)
+                out_parts.append(part)
+            return out_states, out_vals, out_parts
+
+        built = _CompiledUpdate(
+            _named_for_profiler(fused, f"{cache.kind}_fused_tick"), donate_any
+        )
+        aot = _aot_runtime()
+        if aot is not None:
+            # the disk key spans every chained template: bindable only when each
+            # one carries a process-stable fingerprint. The spec tails (n,
+            # groups signature, donation, want_values) are rendered from
+            # primitives, so their repr hashes identically across processes.
+            fps = tuple(e.template.config_fingerprint() for e in entries)
+            if all(fp is not None for fp in fps):
+                built.aot = aot.AotBinding(
+                    base_key=(
+                        "engine",
+                        cache.kind,
+                        tuple(
+                            f"{type(e.template).__module__}.{type(e.template).__qualname__}"
+                            for e in entries
+                        ),
+                        fps,
+                        tuple(e.template.state_avals() for e in entries),
+                        tuple(e.n for e in entries),
+                        "fused",
+                        tuple(s[2:] for s in specs),
+                    ),
+                    label=label,
+                    on_compile=lambda: _observe.note_engine_compile(
+                        cache.kind, label, max(e.n for e in entries)
+                    ),
+                )
+        return built
+
+    entry = cache.lookup(key, build, label, max(e.n for e in entries), components)
+
+    don: List[Dict[str, Any]] = []
+    keep: List[Dict[str, Any]] = []
+    aux: List[Tuple[Any, Any]] = []
+    for spec, idxs in plan:
+        unit_states = _stack_tree([entries[i].stacked for i in idxs])
+        if spec[3]:
+            don.append(unit_states)
+        else:
+            keep.append(unit_states)
+        unit_gops = []
+        for g in range(len(spec[2])):
+            masks = _stack_tree([entries[i].groups[g][2] for i in idxs])
+            kw_names = spec[2][g][1]
+            flats = [
+                tuple(entries[i].groups[g][0])
+                + tuple(entries[i].groups[g][1][k] for k in kw_names)
+                for i in idxs
+            ]
+            flat = tuple(_stack_tree([f[j] for f in flats]) for j in range(len(flats[0])))
+            unit_gops.append((masks, flat))
+        live = _stack_tree([entries[i].live for i in idxs]) if spec[4] else None
+        aux.append((unit_gops, live))
+
+    if entry.probation and entry.donate:
+        # transactional-update contract (DESIGN §14): donate fresh copies while
+        # the fused program is unproven, so the callers' live stacked pytrees
+        # survive as the rescue reference if the first dispatch dies mid-flight
+        don = [{k: jnp.copy(v) for k, v in d.items()} for d in don]
+    call_args = (don, keep, aux)
+    if entry.probation:
+        out_states, out_vals, out_parts = _probation_dispatch(entry, label, call_args, {})
+    else:
+        out_states, out_vals, out_parts = entry(*call_args)
+
+    results: List[Tuple[Dict[str, Any], Any, Optional[Dict[str, Any]]]] = [None] * len(entries)  # type: ignore[list-item]
+    for u, (spec, idxs) in enumerate(plan):
+        st, vals, part = out_states[u], out_vals[u], out_parts[u]
+        if len(idxs) == 1:
+            results[idxs[0]] = (st, vals, part)
+        else:
+            for j, i in enumerate(idxs):
+                results[i] = (
+                    {k: v[j] for k, v in st.items()},
+                    jax.tree_util.tree_map(lambda a, _j=j: a[_j], vals) if vals is not None else None,
+                    {k: v[j] for k, v in part.items()} if part is not None else None,
+                )
+    return results
 
 
 def engine_compute(

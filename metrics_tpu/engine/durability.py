@@ -473,6 +473,7 @@ def _restore_fleet_checkpoint(
         bucket = _Bucket(template, bnode["label"], key, bnode["capacity"])
         bucket.stacked = {k: jnp.asarray(v) for k, v in bnode["stacked"].items()}
         bucket.slot_sids = list(bnode["slot_sids"])
+        bucket.slot_skeys = [None if s is None else str(s) for s in bucket.slot_sids]
         bucket.free = list(bnode["free"])
         bucket.high_water = int(bnode["high_water"])
         bucket.version = int(bnode["version"])
@@ -493,6 +494,7 @@ def _restore_fleet_checkpoint(
         sess.engine_count = int(snode["engine_count"])
         sess.health = snode["health"]
         engine._sessions[sid] = sess
+        engine._skey_index[str(sid)] = sid
     # ---- replay the journal, original seqs ----
     n_replayed = replay_wal(engine, wal_path) if wal_path is not None else 0
     if wal_path is not None:

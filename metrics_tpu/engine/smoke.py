@@ -4,13 +4,18 @@ The StreamEngine's whole value is a pair of invariants that are easy to break
 silently — a bucketing-key regression splits one bucket into many dispatches;
 a cache-key regression recompiles on every arrival. This module runs a small
 heterogeneous fleet (MulticlassAccuracy + BinaryAUROC streams, mid-run churn)
-under a private telemetry probe and reduces it to three numbers the perf
+under a private telemetry probe and reduces it to the numbers the perf
 ratchet pins in the ``fleet`` section of ``tools/perf_baseline.json``:
 
-* ``dispatches_per_bucket_tick`` — update dispatches over bucket flushes;
-  1.0 means every touched bucket cost exactly one XLA dispatch per tick;
-* ``update_compiles_per_bucket`` — compiled update programs per bucket; 1
-  means arrival/expiry churn within padded capacity never recompiled;
+* ``dispatches_per_shard_tick`` — update dispatches over ticks; 1.0 means a
+  whole shard's tick (every touched bucket, every wave) lowered to exactly ONE
+  fused XLA dispatch (DESIGN §27);
+* ``update_compiles`` — total compiled update programs; 1 means the fused
+  program compiled once and arrival/expiry churn within padded capacity never
+  recompiled;
+* ``poll_dispatches_per_poll`` — compute dispatches per ``compute_all`` poll;
+  0.0 means every dashboard poll was answered from the incremental-fold caches
+  the fused tick maintains, never by a device compute dispatch;
 * ``bit_exact`` — every stream's accumulated *state* (live and expired) is
   bit-identical to a per-instance oracle metric fed the identical batches,
   expired streams' computed values are bit-identical too (they compute on
@@ -24,6 +29,7 @@ every ``tools/ci_check.sh`` invocation.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -39,7 +45,11 @@ __all__ = [
     "write_fleet_baseline",
 ]
 
-_RATCHETED_MAX = ("dispatches_per_bucket_tick", "update_compiles_per_bucket")
+_RATCHETED_MAX = (
+    "dispatches_per_shard_tick",
+    "update_compiles",
+    "poll_dispatches_per_poll",
+)
 
 
 def _stream_ctors() -> List[Tuple[str, Any, Any]]:
@@ -91,12 +101,17 @@ def run_fleet_smoke(
                 batchers[sid] = batch
                 kinds[sid] = kind
         next_family = 0
+        polls = 0
         for t in range(ticks):
             for sid in list(oracles):
                 args = batchers[sid](rng)
                 engine.submit(sid, *args)
                 oracles[sid].update(*args)
             engine.tick()
+            # the 1 Hz dashboard poll: must ride the fold caches the fused
+            # tick maintains, never a device compute dispatch
+            engine.compute_all()
+            polls += 1
             if t == ticks // 2:
                 # mid-run churn: retire `churn` sessions round-robin across the
                 # families (so no bucket outgrows its padded capacity — the
@@ -120,6 +135,13 @@ def run_fleet_smoke(
                     batchers[sid] = batch
                     kinds[sid] = kind
         values = engine.compute_all()
+        polls += 1
+        # steady-state poll latency (informational, not ratcheted: wall clock):
+        # nothing changed since the last poll, so this is the pure cached path
+        t0 = time.perf_counter()
+        engine.compute_all()
+        poll_ms = (time.perf_counter() - t0) * 1000.0
+        polls += 1
         live_exact = True
         for sid, oracle in oracles.items():
             sess = engine._sessions[sid]
@@ -145,15 +167,18 @@ def run_fleet_smoke(
     }
     n_buckets = len(counters.get("fleet_flush", {}))
     dispatches = sum(counters.get("fleet_dispatch", {}).values())
-    flushes = sum(counters.get("fleet_flush", {}).values())
+    compute_dispatches = sum(counters.get("fleet_compute_dispatch", {}).values())
     return {
         "streams": n_streams,
         "buckets": n_buckets,
         "ticks": ticks,
         "churn": churn,
-        "dispatches_per_bucket_tick": round(dispatches / flushes, 4) if flushes else None,
-        "update_compiles_per_bucket": max(update_compiles.values(), default=0),
+        "dispatches_per_shard_tick": round(dispatches / ticks, 4) if ticks else None,
+        "update_compiles": sum(update_compiles.values()),
+        "poll_dispatches_per_poll": round(compute_dispatches / polls, 4) if polls else None,
+        "poll_latency_ms": round(poll_ms, 3),
         "loose_updates": sum(counters.get("fleet_loose_update", {}).values()),
+        "fused_fallbacks": sum(counters.get("fleet_fused_fallback", {}).values()),
         "bit_exact": bool(live_exact and retired_exact),
     }
 
@@ -191,6 +216,11 @@ def diff_fleet_baseline(observed: Dict[str, Any], baseline: Dict[str, Any]) -> T
         regressions.append(
             f"fleet: {observed['loose_updates']} update(s) fell off the bucketed path "
             "(sessions demoted to loose eager metrics)"
+        )
+    if observed.get("fused_fallbacks", 0):
+        regressions.append(
+            f"fleet: {observed['fused_fallbacks']} fused dispatch(es) fell back to "
+            "per-bucket programs (the one-program tick failed to trace or run)"
         )
     if not baseline:
         new.append("fleet: no baseline section (record with --update-baseline)")

@@ -61,6 +61,7 @@ the replica engine's loop fallback.
 from __future__ import annotations
 
 import contextlib
+import itertools
 from collections import OrderedDict
 from typing import Any, Dict, Hashable, Iterator, List, Optional, Set, Tuple
 
@@ -68,8 +69,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.engine.core import _FLEET_JIT_CACHE, TRACER_ERRORS, DispatchConsumedError, engine_compute, engine_update
-from metrics_tpu.metric import Metric, _squeeze_if_scalar
+from metrics_tpu.engine.core import (
+    _FLEET_JIT_CACHE,
+    TRACER_ERRORS,
+    DispatchConsumedError,
+    FusedEntry,
+    engine_compute,
+    engine_update,
+    engine_update_fused,
+)
+from metrics_tpu.metric import _REDUCE_ALIASES, Metric, _squeeze_if_scalar
 from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.observe import tracing as _trace
 from metrics_tpu.utils.exceptions import TPUMetricsUserError
@@ -156,6 +165,12 @@ class _Session:
         self.health = "healthy" if bucket is not None else "loose"
 
 
+# Process-wide bucket creation order: the fused dispatch plan sorts dirty
+# buckets by it, so the fused cache key's entry order is stable across ticks
+# no matter which session submitted first.
+_BUCKET_SERIAL = itertools.count()
+
+
 class _Bucket:
     """All sessions sharing one compiled program: a padded stacked state pytree."""
 
@@ -163,7 +178,9 @@ class _Bucket:
         "key", "label", "template", "capacity", "stacked", "slot_sids",
         "slot_skeys", "free",
         "high_water", "queue", "version", "computed", "computed_version",
-        "compute_eager", "row_bytes", "faults",
+        "compute_eager", "row_bytes", "faults", "order",
+        "fold_eligible", "partial", "partial_version", "partial_slots",
+        "values_dev", "values_dev_version", "values_np", "values_np_version",
     )
 
     def __init__(self, template: Metric, label: str, key: Any, capacity: int) -> None:
@@ -186,6 +203,17 @@ class _Bucket:
         self.computed_version = -1
         self.compute_eager = False  # latched when the vmapped compute cannot trace
         self.faults = 0  # wave fallbacks + quarantines this bucket has absorbed
+        self.order = next(_BUCKET_SERIAL)
+        # --- incremental-fold poll caches (DESIGN §27), all version-stale ---
+        # None = not yet probed: all-sum merge algebra + trace-eligible compute
+        self.fold_eligible: Optional[bool] = None
+        self.partial: Optional[Dict[str, Any]] = None  # live-masked per-state column sums
+        self.partial_version = -1
+        self.partial_slots: Tuple[int, ...] = ()  # slots live at fold time
+        self.values_dev: Any = None  # per-row computes emitted by the fused tick
+        self.values_dev_version = -1
+        self.values_np: Any = None  # host mirror of the per-row values (one fetch)
+        self.values_np_version = -1
         self.row_bytes = sum(
             int(np.prod(np.asarray(d).shape, dtype=np.int64)) * np.dtype(np.asarray(d).dtype).itemsize
             for d in template._defaults.values()
@@ -221,6 +249,24 @@ class _Bucket:
         bucket has latched eager compute or absorbed a fault (a demoted wave or
         quarantined row) — its surviving rows still dispatch normally."""
         return "degraded" if (self.compute_eager or self.faults) else "healthy"
+
+
+class _BucketPlan:
+    """One bucket's flush plan: its popped queue coalesced into ordered waves,
+    nan-guard swept, with the staging buffers assembled host-side — everything
+    a fused dispatch (or the per-wave fallback) needs, no device work done."""
+
+    __slots__ = ("bucket", "queue", "waves", "subs", "sigs", "staged", "done", "dead_slots")
+
+    def __init__(self, bucket: _Bucket, queue: List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]]) -> None:
+        self.bucket = bucket
+        self.queue = queue
+        self.waves: List[Tuple[Any, List[int]]] = []  # (signature, queue indices), wave order
+        self.subs: List[List[Tuple[int, int, Tuple[Any, ...], Dict[str, Any]]]] = []
+        self.sigs: List[Any] = []
+        self.staged: List[Tuple[Tuple[Any, ...], Dict[str, Any], Any]] = []
+        self.done: Set[int] = set()
+        self.dead_slots: Set[int] = set()  # slots whose sessions left the bucket mid-flush
 
 
 class StreamEngine:
@@ -264,6 +310,14 @@ class StreamEngine:
         self._initial_capacity = 1 << (int(initial_capacity) - 1).bit_length()
         self._buckets: "OrderedDict[Any, _Bucket]" = OrderedDict()
         self._sessions: Dict[Hashable, _Session] = {}
+        # dirty sets (insertion-ordered dicts used as sets): which bucket keys /
+        # loose session ids have queued work, so an idle tick is O(pending)
+        # instead of O(buckets + sessions)
+        self._dirty_buckets: Dict[Any, None] = {}
+        self._dirty_loose: Dict[Hashable, None] = {}
+        # str(sid) -> sid, so the meter's quota-demotion handshake (keyed by
+        # meter session keys) resolves in O(1) instead of scanning the fleet
+        self._skey_index: Dict[str, Hashable] = {}
         self._next_auto = 0  # plain int (not itertools.count) so restore can resume it
         self._ticks = 0
         self._nan_guard = bool(nan_guard)
@@ -363,6 +417,7 @@ class StreamEngine:
 
     def _apply_add(self, sid: Hashable, metric: Metric) -> None:
         key = self._bucket_key(metric)
+        self._skey_index[str(sid)] = sid
         if key is None:
             self._sessions[sid] = _Session(sid, metric, None, -1)
             _observe.note_fleet_session("loose", "add")
@@ -441,13 +496,23 @@ class StreamEngine:
             bucket = None
         if bucket is None:
             sess.queue.append((seq, args, kwargs))
+            self._dirty_loose[sess.sid] = None
         else:
             bucket.queue.append((sess.slot, seq, args, kwargs))
+            self._dirty_buckets[bucket.key] = None
 
     def tick(self) -> int:
         """Flush every pending queue; returns the number of XLA update dispatches."""
         with _trace.span("tick", self._name):
             dispatches = self._flush_pending()
+        self._tick_epilogue(dispatches)
+        return dispatches
+
+    def _tick_epilogue(self, dispatches: int) -> None:
+        """Per-tick bookkeeping shared by :meth:`tick` and the sharded fleet's
+        pipelined stage/dispatch walk (which drives :meth:`_stage_flush` /
+        :meth:`_dispatch_flush` directly to overlap host assembly with an
+        in-flight dispatch)."""
         self._ticks += 1
         _observe.note_fleet_tick(dispatches)
         self._publish_gauges()
@@ -464,17 +529,19 @@ class StreamEngine:
                 mt.poll_quota()
                 for skey in mt.pending_demotions():
                     self._demote_by_meter(mt, skey)
-        return dispatches
 
     def _demote_by_meter(self, mt: Any, skey: str) -> None:
-        """Demote the session whose ``str(sid)`` matches a quota breach."""
-        for sid, sess in self._sessions.items():
-            if str(sid) == skey:
-                if sess.bucket is not None:
-                    self._demote_session(sess)
-                    _observe.record_event("quota_demoted", session=skey, engine=self._name)
-                mt.confirm_demotion(skey)
-                return
+        """Demote the session whose ``str(sid)`` matches a quota breach — an
+        O(1) index lookup, so the autonomic demote rung costs the same at
+        100k sessions as at 10."""
+        sid = self._skey_index.get(skey)
+        if sid is None or sid not in self._sessions:
+            return
+        sess = self._sessions[sid]
+        if sess.bucket is not None:
+            self._demote_session(sess)
+            _observe.record_event("quota_demoted", session=skey, engine=self._name)
+        mt.confirm_demotion(skey)
 
     def _record_sample(self, dispatches: int) -> None:
         """One rolling time-series sample of fleet health (telemetry on only)."""
@@ -494,17 +561,56 @@ class StreamEngine:
         )
 
     def _flush_pending(self) -> int:
+        staged = self._stage_flush()
+        return self._dispatch_flush(staged)
+
+    def _stage_flush(self) -> Optional[Tuple[List["_BucketPlan"], List[Hashable]]]:
+        """Host half of a flush: WAL sync, plan the dirty buckets, assemble every
+        wave's staging buffers. No device dispatch happens here, so a sharded
+        fleet can overlap this work with another shard's in-flight dispatch.
+
+        The dirty sets make the idle path O(pending): a tick with nothing
+        queued is two empty-dict checks, not a walk of every bucket and session.
+        """
+        if not self._dirty_buckets and not self._dirty_loose:
+            return None
         if self._wal is not None and not self._replaying:
             # durability point: every record whose effect is about to land must
             # be on disk first, so recovery can always redo this flush
             with _trace.span("wal", "sync"):
                 self._wal.sync()
-        dispatches = 0
-        for bucket in list(self._buckets.values()):
-            if bucket.queue:
-                dispatches += self._flush_bucket(bucket)
-        for sess in list(self._sessions.values()):
-            if sess.bucket is None and sess.queue:
+        # plan in bucket-creation order (not dirty-marking order) so the fused
+        # program's cache key is stable across ticks under churn
+        keys = sorted(
+            (k for k in self._dirty_buckets if k in self._buckets),
+            key=lambda k: self._buckets[k].order,
+        )
+        self._dirty_buckets.clear()
+        plans: List[_BucketPlan] = []
+        for key in keys:
+            bucket = self._buckets[key]
+            if not bucket.queue:
+                continue
+            # the per-bucket "flush" phase is the host-side drain (plan +
+            # wave assembly); the device dispatch is fused fleet-wide and
+            # carries its own span
+            with _trace.span("flush", bucket.label):
+                plan = self._plan_bucket(bucket)
+                self._stage_plan(plan)
+            if plan.staged:
+                plans.append(plan)
+        loose_sids = list(self._dirty_loose)
+        self._dirty_loose.clear()
+        return plans, loose_sids
+
+    def _dispatch_flush(self, staged: Optional[Tuple[List["_BucketPlan"], List[Hashable]]]) -> int:
+        if staged is None:
+            return 0
+        plans, loose_sids = staged
+        dispatches = self._flush_fleet(plans)
+        for sid in loose_sids:
+            sess = self._sessions.get(sid)
+            if sess is not None and sess.bucket is None and sess.queue:
                 self._flush_loose(sess)
         return dispatches
 
@@ -524,6 +630,8 @@ class StreamEngine:
                 # submission is consumed, the rest stay queued for the next flush
                 self._mark_applied(seq)
                 sess.queue = pending[i + 1 :] + sess.queue
+                if sess.queue:
+                    self._dirty_loose[sess.sid] = None  # requeued work stays flushable
                 raise
             self._mark_applied(seq)
             _observe.note_fleet_loose_update(type(sess.metric).__name__)
@@ -540,24 +648,25 @@ class StreamEngine:
         return False
 
     def _flush_bucket(self, bucket: _Bucket) -> int:
-        """Coalesce the bucket's queue into waves; dispatch each surviving wave once.
-
-        Failure containment per wave (DESIGN §17): a NaN-guarded poisoned
-        submission or a trace failure ejects exactly the sessions involved,
-        a runtime dispatch death falls back to per-row replay with per-row
-        quarantine — in every case the rest of the bucket keeps its rows, its
-        compiled program, and its one-dispatch-per-tick economy.
-        """
+        """Flush one bucket's queue outside the fused tick path (demotions,
+        expiry): same plan → fused dispatch → fallback ladder, fleet of one."""
+        self._dirty_buckets.pop(bucket.key, None)
         with _trace.span("flush", bucket.label):
-            return self._flush_bucket_traced(bucket)
+            plan = self._plan_bucket(bucket)
+            self._stage_plan(plan)
+        return self._flush_fleet([plan]) if plan.staged else 0
 
-    def _flush_bucket_traced(self, bucket: _Bucket) -> int:
+    def _plan_bucket(self, bucket: _Bucket) -> "_BucketPlan":
+        """Coalesce the bucket's queue into ordered waves and run the nan-guard
+        sweep — the host-side half of a flush, no device work.
+
+        Failure containment starts here (DESIGN §17): a NaN-guarded poisoned
+        submission quarantines exactly the session involved before it can
+        enter any dispatch, and its not-yet-flushed tail replays eagerly in
+        order. Everything else is deferred to dispatch time.
+        """
         queue, bucket.queue = bucket.queue, []
         _observe.note_fleet_flush(bucket.label)
-        # fleet meter (observe/metering.py): one attribute read when disabled
-        # or uninstalled; when live, every dispatch's wall time is measured
-        # here and amortized over its wave's sessions
-        mt = _observe._METER if _observe.ENABLED else None
         # wave = how many earlier submissions this slot already has in the queue;
         # grouping on (wave, signature) keeps per-session ordering while letting
         # every first-submission-per-slot coalesce into one dispatch
@@ -568,101 +677,303 @@ class StreamEngine:
                 wave = seen.get(slot, 0)
                 seen[slot] = wave + 1
                 groups.setdefault((wave, _submission_sig(args, kwargs)), []).append(idx)
-        dispatches = 0
-        done: Set[int] = set()
-        dead_slots: Set[int] = set()  # slots whose sessions left the bucket mid-flush
-        for (_wave, _sig), idxs in sorted(groups.items(), key=lambda kv: kv[0][0]):
-            live = [i for i in idxs if i not in done and queue[i][0] not in dead_slots]
+        plan = _BucketPlan(bucket, queue)
+        for (_wave, sig), idxs in sorted(groups.items(), key=lambda kv: kv[0][0]):
+            live = [i for i in idxs if i not in plan.done and queue[i][0] not in plan.dead_slots]
             if self._nan_guard:
                 clean: List[int] = []
                 for i in live:
+                    if i in plan.done or queue[i][0] in plan.dead_slots:
+                        continue  # a tail replay above consumed it
                     slot, seq, args, kwargs = queue[i]
                     if self._poisoned(args, kwargs):
                         sess = self._sessions[bucket.slot_sids[slot]]
                         self._quarantine(sess, "nan_guard")
                         self._mark_applied(seq)  # the poisoned batch is consumed (dropped)
-                        done.add(i)
-                        dead_slots.add(slot)
-                        self._replay_tail(queue, done, slot, sess)
+                        plan.done.add(i)
+                        plan.dead_slots.add(slot)
+                        self._replay_tail(queue, plan.done, slot, sess)
                     else:
                         clean.append(i)
-                live = clean
-            if not live:
-                continue
-            subs = [queue[i] for i in live]
-            m_t0: Optional[float] = None
-            try:
-                with _trace.span("wave_assembly", bucket.label):
-                    stacked_args, stacked_kwargs, mask = self._stage(bucket, subs)
-                if mt is not None:
-                    m_t0 = _observe.clock()
-                with _trace.span("dispatch", bucket.label):
-                    new_stacked = engine_update(
-                        bucket.template, bucket.capacity, bucket.stacked,
-                        stacked_args, stacked_kwargs, mask=mask,
-                        cache=_FLEET_JIT_CACHE, label=bucket.label,
-                    )
-                if mt is not None:
-                    # amortization rule (DESIGN §23): measured wall + the
-                    # program's static cost, split equally over the wave
-                    mt.note_dispatch(
-                        bucket.label,
-                        [bucket.slot_skeys[s] for s, _q, _a, _k in subs],
-                        _observe.clock() - m_t0,
-                        cost_key=(bucket.label, bucket.capacity, _sig),
-                        cost_fn=lambda b=bucket, a=stacked_args, k=stacked_kwargs: _metering_cost(
-                            b.template, b.capacity, a, k
+                live = [i for i in clean if i not in plan.done and queue[i][0] not in plan.dead_slots]
+            if live:
+                plan.waves.append((sig, live))
+        return plan
+
+    def _stage_plan(self, plan: "_BucketPlan") -> None:
+        """Assemble every planned wave's (capacity, ...) staging buffers."""
+        bucket = plan.bucket
+        with _trace.span("wave_assembly", bucket.label):
+            for sig, live in plan.waves:
+                subs = [plan.queue[i] for i in live]
+                plan.subs.append(subs)
+                plan.sigs.append(sig)
+                plan.staged.append(self._stage(bucket, subs))
+
+    def _fold_eligible(self, bucket: _Bucket) -> bool:
+        """May the fused tick maintain this bucket's incremental-fold caches?
+
+        True only when every declared state reduces by ``dim_zero_sum`` with an
+        associative merge (the partial IS the column sum, DESIGN §27) AND the
+        vmapped compute abstractly traces (``jax.eval_shape`` — no compile).
+        The probe is silent and latched: a False here just keeps the bucket on
+        the cached full-recompute path, it is not a fault.
+        """
+        if bucket.compute_eager:
+            return False
+        if bucket.fold_eligible is None:
+            tmpl = bucket.template
+            reds = getattr(tmpl, "_reductions", {})
+            assoc = getattr(tmpl, "_merge_associative", {})
+            sum_fn = _REDUCE_ALIASES["sum"]
+            ok = bool(reds) and all(fn is sum_fn for fn in reds.values()) and all(
+                assoc.get(k, False) for k in reds
+            )
+            if ok:
+                try:
+                    avals = {
+                        k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                        for k, v in bucket.stacked.items()
+                    }
+                    jax.eval_shape(
+                        jax.vmap(
+                            lambda st: _squeeze_if_scalar(tmpl._functional_compute(st)),
+                            in_axes=(0,),
                         ),
+                        avals,
                     )
-            except TRACER_ERRORS as exc:
-                if mt is not None and m_t0 is not None:
-                    mt.note_failed_dispatch(bucket.label, _observe.clock() - m_t0)
-                # trace failure aborts before execution (stacked buffers intact):
-                # demote ONLY this wave's sessions to loose and replay their
-                # submissions eagerly — the rest of the bucket keeps its rows
-                _observe.note_fleet_fallback(bucket.label, exc)
-                bucket.faults += 1
-                for i in live:
-                    slot, seq, args, kwargs = queue[i]
-                    sess = self._sessions[bucket.slot_sids[slot]]
-                    self._materialize(sess)
-                    self._release_slot(sess)
-                    sess.health = "loose"
-                    done.add(i)
-                    dead_slots.add(slot)
-                    sess.metric.update(*args, **kwargs)
-                    self._mark_applied(seq)
-                    _observe.note_fleet_loose_update(type(sess.metric).__name__)
-                    self._meter_loose(sess)
-                    self._replay_tail(queue, done, slot, sess)
-                if bucket.active() == 0:
-                    self._drop_bucket(bucket)
-                continue
-            except Exception as exc:  # noqa: BLE001 — runtime dispatch death
-                if mt is not None and m_t0 is not None:
-                    mt.note_failed_dispatch(bucket.label, _observe.clock() - m_t0)
+                except Exception:  # noqa: BLE001 — any trace refusal means "recompute path"
+                    ok = False
+            bucket.fold_eligible = ok
+        return bucket.fold_eligible
+
+    def _live_mask(self, bucket: _Bucket) -> np.ndarray:
+        # slot_sids is a host-side registry list (str | None), never a device
+        # value — the allocation below touches no device buffer
+        return np.array([sid is not None for sid in bucket.slot_sids], dtype=bool)  # hotlint: disable=HL006
+
+    def _flush_fleet(self, plans: List["_BucketPlan"]) -> int:
+        """Dispatch every planned bucket's every wave as ONE fused XLA program.
+
+        The fused body chains each bucket's waves in order (per-session order
+        preserved) and, for fold-eligible buckets, emits per-row values and the
+        live-masked partial aggregate in the same program — so a steady-state
+        tick is exactly one dispatch and a dashboard poll touches no device.
+
+        Blast-radius ladder (DESIGN §17/§27): a fused trace failure or a
+        runtime death with buffers intact falls back to the per-bucket masked
+        dispatches, where the existing per-wave demotion and per-row quarantine
+        machinery isolates exactly the rows involved; a runtime death that
+        consumed the donated mega-pytree raises ``DispatchConsumedError`` for
+        the durability / shard-ladder rungs, same as before.
+        """
+        plans = [p for p in plans if p.staged]
+        if not plans:
+            return 0
+        mt = _observe._METER if _observe.ENABLED else None
+        entries: List[FusedEntry] = []
+        for p in plans:
+            b = p.bucket
+            fold = self._fold_eligible(b)
+            entries.append(
+                FusedEntry(
+                    template=b.template,
+                    n=b.capacity,
+                    stacked=b.stacked,
+                    groups=[(a, k, m) for (a, k, m) in p.staged],
+                    want_values=fold,
+                    live=self._live_mask(b) if fold else None,
+                    label=b.label,
+                )
+            )
+        label = plans[0].bucket.label if len(plans) == 1 else "+".join(
+            p.bucket.label for p in plans
+        )
+        m_t0: Optional[float] = None
+        try:
+            if mt is not None:
+                m_t0 = _observe.clock()
+            with _trace.span("dispatch", label):
+                results = engine_update_fused(entries, cache=_FLEET_JIT_CACHE, label=label)
+        except TRACER_ERRORS as exc:
+            if mt is not None and m_t0 is not None:
+                mt.note_failed_dispatch(label, _observe.clock() - m_t0)
+            # trace failure aborts before execution with every buffer intact:
+            # re-run per bucket so the per-wave ladder isolates the poison wave
+            _observe.note_fleet_fused_fallback(label, exc)
+            return sum(self._flush_plan_fallback(p) for p in plans)
+        except Exception as exc:  # noqa: BLE001 — fused runtime dispatch death
+            if mt is not None and m_t0 is not None:
+                mt.note_failed_dispatch(label, _observe.clock() - m_t0)
+            consumed = [
+                p.bucket.label
+                for p in plans
                 if any(
-                    getattr(v, "is_deleted", lambda: False)() for v in bucket.stacked.values()
-                ):
-                    # the dead dispatch consumed its donated inputs: in-memory
-                    # state is unrecoverable — this is exactly what checkpoints
-                    # + the ingest WAL exist for. A sharded fleet catches this
-                    # typed error to self-heal or demote just this shard.
-                    raise DispatchConsumedError(
-                        f"fleet bucket {bucket.label!r}: dispatch died after consuming its "
-                        "donated state buffers; in-memory recovery is impossible. Recover "
-                        "via StreamEngine.restore(checkpoint, wal_path=...)."
-                    ) from exc
-                self._replay_wave_rows(bucket, queue, live, done, dead_slots)
-                continue
-            bucket.stacked = new_stacked
-            bucket.version += 1
-            for slot, seq, _a, _k in subs:
-                self._sessions[bucket.slot_sids[slot]].engine_count += 1
-                self._mark_applied(seq)
-            done.update(live)
-            _observe.note_engine_dispatch("fleet", bucket.label)
-            dispatches += 1
+                    getattr(v, "is_deleted", lambda: False)()
+                    for v in p.bucket.stacked.values()
+                )
+            ]
+            if consumed:
+                # the dead dispatch consumed its donated inputs: in-memory
+                # state is unrecoverable — this is exactly what checkpoints
+                # + the ingest WAL exist for. A sharded fleet catches this
+                # typed error to self-heal or demote just this shard.
+                raise DispatchConsumedError(
+                    f"fused fleet dispatch {label!r} died after consuming donated state "
+                    f"buffers (buckets: {', '.join(consumed)}); in-memory recovery is "
+                    "impossible. Recover via StreamEngine.restore(checkpoint, wal_path=...)."
+                ) from exc
+            # buffers intact: the per-bucket fallback finds the failing bucket
+            # and walks it down to per-row replay + per-row quarantine
+            _observe.note_fleet_fused_fallback(label, exc)
+            return sum(self._flush_plan_fallback(p) for p in plans)
+        for p, (new_stacked, values, partial) in zip(plans, results):
+            b = p.bucket
+            b.stacked = new_stacked
+            b.version += 1
+            for subs in p.subs:
+                for slot, seq, _a, _k in subs:
+                    self._sessions[b.slot_sids[slot]].engine_count += 1
+                    self._mark_applied(seq)
+            if values is not None:
+                # the tick program already computed this version's per-row
+                # values and running partial: polls are now device-free
+                b.values_dev = values
+                b.values_dev_version = b.version
+                b.values_np_version = -1
+                b.partial = partial
+                b.partial_version = b.version
+                # the partial folded exactly these live rows: aggregate()'s
+                # fast path must see the same occupancy or fall back to slices
+                b.partial_slots = tuple(
+                    i for i, sid in enumerate(b.slot_sids) if sid is not None
+                )
+        if mt is not None:
+            # amortization rule (DESIGN §23): one fused dispatch's measured
+            # wall + the summed static cost of every wave program, split
+            # equally over every submission that rode it
+            skeys = [
+                p.bucket.slot_skeys[s]
+                for p in plans
+                for subs in p.subs
+                for s, _q, _a, _k in subs
+            ]
+            cost_items = [
+                (p.bucket.template, p.bucket.capacity, a, k)
+                for p in plans
+                for (a, k, _m) in p.staged
+            ]
+
+            def cost_fn(items: Any = tuple(cost_items)) -> Tuple[float, float]:
+                flops = traffic = 0.0
+                for tmpl, cap, a, k in items:
+                    cf, cb = _metering_cost(tmpl, cap, a, k)
+                    flops += cf
+                    traffic += cb
+                return flops, traffic
+
+            mt.note_dispatch(
+                label,
+                skeys,
+                _observe.clock() - m_t0,
+                cost_key=(
+                    "fused",
+                    tuple(
+                        (p.bucket.label, p.bucket.capacity, sig)
+                        for p in plans
+                        for sig in p.sigs
+                    ),
+                ),
+                cost_fn=cost_fn,
+            )
+        _observe.note_engine_dispatch("fleet", label)
+        return 1
+
+    def _flush_plan_fallback(self, plan: "_BucketPlan") -> int:
+        """The pre-fusion dispatch path, one masked dispatch per wave: isolates
+        which bucket/wave poisoned a failed fused program, at the old cost."""
+        bucket, queue = plan.bucket, plan.queue
+        done, dead_slots = plan.done, plan.dead_slots
+        mt = _observe._METER if _observe.ENABLED else None
+        dispatches = 0
+        with _trace.span("flush", bucket.label):
+            for _sig, live0 in plan.waves:
+                # earlier waves may have demoted sessions: re-filter, re-stage
+                live = [i for i in live0 if i not in done and queue[i][0] not in dead_slots]
+                if not live:
+                    continue
+                subs = [queue[i] for i in live]
+                m_t0: Optional[float] = None
+                try:
+                    with _trace.span("wave_assembly", bucket.label):
+                        stacked_args, stacked_kwargs, mask = self._stage(bucket, subs)
+                    if mt is not None:
+                        m_t0 = _observe.clock()
+                    with _trace.span("dispatch", bucket.label):
+                        new_stacked = engine_update(
+                            bucket.template, bucket.capacity, bucket.stacked,
+                            stacked_args, stacked_kwargs, mask=mask,
+                            cache=_FLEET_JIT_CACHE, label=bucket.label,
+                        )
+                    if mt is not None:
+                        mt.note_dispatch(
+                            bucket.label,
+                            [bucket.slot_skeys[s] for s, _q, _a, _k in subs],
+                            _observe.clock() - m_t0,
+                            cost_key=(bucket.label, bucket.capacity, _sig),
+                            cost_fn=lambda b=bucket, a=stacked_args, k=stacked_kwargs: _metering_cost(
+                                b.template, b.capacity, a, k
+                            ),
+                        )
+                except TRACER_ERRORS as exc:
+                    if mt is not None and m_t0 is not None:
+                        mt.note_failed_dispatch(bucket.label, _observe.clock() - m_t0)
+                    # trace failure aborts before execution (stacked buffers intact):
+                    # demote ONLY this wave's sessions to loose and replay their
+                    # submissions eagerly — the rest of the bucket keeps its rows
+                    _observe.note_fleet_fallback(bucket.label, exc)
+                    bucket.faults += 1
+                    for i in live:
+                        slot, seq, args, kwargs = queue[i]
+                        sess = self._sessions[bucket.slot_sids[slot]]
+                        self._materialize(sess)
+                        self._release_slot(sess)
+                        sess.health = "loose"
+                        done.add(i)
+                        dead_slots.add(slot)
+                        sess.metric.update(*args, **kwargs)
+                        self._mark_applied(seq)
+                        _observe.note_fleet_loose_update(type(sess.metric).__name__)
+                        self._meter_loose(sess)
+                        self._replay_tail(queue, done, slot, sess)
+                    if bucket.active() == 0:
+                        self._drop_bucket(bucket)
+                    continue
+                except Exception as exc:  # noqa: BLE001 — runtime dispatch death
+                    if mt is not None and m_t0 is not None:
+                        mt.note_failed_dispatch(bucket.label, _observe.clock() - m_t0)
+                    if any(
+                        getattr(v, "is_deleted", lambda: False)() for v in bucket.stacked.values()
+                    ):
+                        # the dead dispatch consumed its donated inputs: in-memory
+                        # state is unrecoverable — this is exactly what checkpoints
+                        # + the ingest WAL exist for. A sharded fleet catches this
+                        # typed error to self-heal or demote just this shard.
+                        raise DispatchConsumedError(
+                            f"fleet bucket {bucket.label!r}: dispatch died after consuming its "
+                            "donated state buffers; in-memory recovery is impossible. Recover "
+                            "via StreamEngine.restore(checkpoint, wal_path=...)."
+                        ) from exc
+                    self._replay_wave_rows(bucket, queue, live, done, dead_slots)
+                    continue
+                bucket.stacked = new_stacked
+                bucket.version += 1
+                for slot, seq, _a, _k in subs:
+                    self._sessions[bucket.slot_sids[slot]].engine_count += 1
+                    self._mark_applied(seq)
+                done.update(live)
+                _observe.note_engine_dispatch("fleet", bucket.label)
+                dispatches += 1
         return dispatches
 
     def _replay_wave_rows(
@@ -808,6 +1119,7 @@ class StreamEngine:
         """Remove an emptied bucket (every session demoted/quarantined away)."""
         self._buckets.pop(bucket.key, None)
         self._ckpt_cache.pop(bucket.key, None)
+        self._dirty_buckets.pop(bucket.key, None)
         _observe.set_fleet_gauges(bucket.label, 0, 0, 0, 0, 0)
         mt = _observe._METER if _observe.ENABLED else None
         if mt is not None:
@@ -822,26 +1134,53 @@ class StreamEngine:
         self._flush_pending()
         if sess.bucket is None:
             return sess.metric.compute()
-        values = self._bucket_values(sess.bucket)
+        values = self._bucket_values_np(sess.bucket)
         if values is None:
             return self._row_value(sess.bucket, sess.slot)
         return jax.tree_util.tree_map(lambda a: a[sess.slot], values)
 
     def compute_all(self) -> Dict[Hashable, Any]:
-        """Flush pending work, then compute every live session (one vmapped
-        dispatch per bucket, cached until the bucket's state changes)."""
+        """Flush pending work, then compute every live session.
+
+        O(1) device cost per bucket per poll: fold-eligible buckets were
+        already computed inside the tick's fused program, every other bucket's
+        vmapped compute is cached by state version — and either way the whole
+        bucket's values come to host in ONE annotated ``device_get``, with
+        per-session rows sliced from the numpy mirror (no per-session
+        ``tree_map`` over device arrays). A poll with no state change since
+        the last one touches no device at all.
+        """
         self._flush_pending()
         out: Dict[Hashable, Any] = {}
         for sid, sess in self._sessions.items():
             if sess.bucket is None:
                 out[sid] = sess.metric.compute()
                 continue
-            values = self._bucket_values(sess.bucket)
+            values = self._bucket_values_np(sess.bucket)
             if values is None:
                 out[sid] = self._row_value(sess.bucket, sess.slot)
             else:
                 out[sid] = jax.tree_util.tree_map(lambda a, s=sess.slot: a[s], values)
         return out
+
+    def _bucket_values_np(self, bucket: _Bucket) -> Any:
+        """Host-cached per-row values for the whole bucket; None → eager rows.
+
+        One batched device→host fetch per bucket per state version — either of
+        the fused tick's already-computed values (fold-eligible buckets: zero
+        poll-time dispatches) or of the cached vmapped compute.
+        """
+        if bucket.values_np_version == bucket.version:
+            return bucket.values_np
+        if bucket.values_dev_version == bucket.version:
+            values = bucket.values_dev
+        else:
+            values = self._bucket_values(bucket)
+        if values is None:
+            return None
+        bucket.values_np = _host_fetch(values, "poll_readout")
+        bucket.values_np_version = bucket.version
+        return bucket.values_np
 
     def _bucket_values(self, bucket: _Bucket) -> Any:
         """Whole-bucket vmapped compute, cached by state version; None → eager rows."""
@@ -896,6 +1235,9 @@ class StreamEngine:
             label = "loose"
             self._flush_loose(sess)
         del self._sessions[session_id]
+        if self._skey_index.get(str(session_id)) == session_id:
+            del self._skey_index[str(session_id)]
+        self._dirty_loose.pop(session_id, None)
         _observe.note_fleet_session(label, "expire")
         self._publish_gauges()
         return sess.metric

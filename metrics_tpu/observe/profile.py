@@ -201,8 +201,9 @@ def run_perf_check(
         print(f"perf: {ok}/{len(results)} classes profiled, {len(regressions)} regression(s), "
               f"{len(stale)} stale, {len(new)} new; fleet smoke: "
               f"{fleet_obs['streams']} streams / {fleet_obs['buckets']} buckets, "
-              f"{fleet_obs['dispatches_per_bucket_tick']} dispatches/bucket-tick, "
-              f"{fleet_obs['update_compiles_per_bucket']} compile(s)/bucket")
+              f"{fleet_obs['dispatches_per_shard_tick']} dispatch(es)/tick, "
+              f"{fleet_obs['update_compiles']} update compile(s), "
+              f"{fleet_obs['poll_dispatches_per_poll']} compute dispatch(es)/poll")
     return 1 if regressions else 0
 
 

@@ -543,6 +543,15 @@ def note_fleet_fallback(label: str, exc: BaseException) -> None:
         RECORDER.add_event("fleet_fallback", engine=label, error=type(exc).__name__, detail=str(exc)[:200])
 
 
+def note_fleet_fused_fallback(label: str, exc: BaseException) -> None:
+    """The fused whole-tick program failed (trace refusal or a runtime death
+    with buffers intact) and the flush fell back to per-bucket dispatches —
+    where the per-wave/per-row ladder isolates the actual poison."""
+    if ENABLED:
+        RECORDER.add_count("fleet_fused_fallback", label)
+        RECORDER.add_event("fleet_fused_fallback", engine=label, error=type(exc).__name__, detail=str(exc)[:200])
+
+
 def note_fleet_quarantine(label: str, reason: str, exc: Optional[BaseException] = None) -> None:
     """One session was individually quarantined out of its bucket (blast-radius
     isolation): ``reason`` is "update_error", "nan_guard" or "probation"."""

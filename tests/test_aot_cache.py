@@ -273,15 +273,17 @@ def test_fleet_engine_programs_reload_from_disk(aot_env):
         eng.tick()
         return [float(np.asarray(eng.compute(sid))) for sid in sids]
 
-    first = drive()  # compiles the vmapped update + compute, stores both
+    # one fused tick program: update + per-row values in the same executable
+    # (DESIGN §27), so compute() never compiles — exactly one disk artifact
+    first = drive()
     c = _counters(probe)
     stores = sum(v for k, v in c["aot_store"].items() if k.startswith("MeanSquaredError@"))
-    assert stores == 2  # the update program and the compute program
+    assert stores == 1
 
     clear_jit_cache()
     second = drive()
     c = _counters(probe)
     hits = sum(v for k, v in c["aot_hit"].items() if k.startswith("MeanSquaredError@"))
-    assert hits == 2
+    assert hits == 1
     assert sum(c.get("fleet_compile", {}).values()) == 0
     assert first == second

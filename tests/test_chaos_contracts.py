@@ -44,7 +44,7 @@ def test_one_class_survives_the_fleet_recovery_scenarios():
     # every recovery scenario fired for a float-input, bucketable classifier
     assert set(result.ran) == {
         "kill[mid_tick]", "kill[mid_flush]", "kill[mid_ckpt]",
-        "journal[torn]", "journal[bitflip]", "poison[row]",
+        "journal[torn]", "journal[bitflip]", "poison[row]", "death[replay]",
     }
     assert result.skipped == ()
 

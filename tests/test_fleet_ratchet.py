@@ -1,0 +1,42 @@
+"""Tier-1 perf ratchet for the fused one-program tick (DESIGN §27).
+
+``tools/ci_check.sh --tier1`` runs pytest, so the dispatch-economy claims the
+paper's fleet engine stands on are asserted here, directly against the pinned
+``tools/perf_baseline.json`` — not only in the slower ``--all`` lint pass:
+
+* a steady-state shard tick is exactly ONE fused XLA dispatch,
+* churn within padded capacity compiles exactly one update program,
+* a dashboard poll costs zero device compute dispatches, and
+* the fleet stays bit-exact against the per-instance oracle throughout.
+"""
+
+import os
+
+from metrics_tpu.engine.smoke import (
+    diff_fleet_baseline,
+    load_fleet_baseline,
+    run_fleet_smoke,
+)
+
+_BASELINE = os.path.join(os.path.dirname(__file__), "..", "tools", "perf_baseline.json")
+
+
+def test_fused_tick_dispatch_economy_is_ratcheted():
+    observed = run_fleet_smoke()
+    baseline = load_fleet_baseline(_BASELINE)
+    assert baseline, "tools/perf_baseline.json lost its fleet section"
+    regressions, _stale, new = diff_fleet_baseline(observed, baseline)
+    assert not regressions, f"fleet smoke regressed: {regressions} (observed {observed})"
+    assert not new, f"fleet baseline incomplete: {new}"
+
+
+def test_fused_tick_hits_the_paper_targets():
+    # the ratchet floor can only tighten; the paper's headline numbers are
+    # pinned absolutely so a loosened baseline cannot hide a regression
+    observed = run_fleet_smoke()
+    assert observed["dispatches_per_shard_tick"] == 1.0, observed
+    assert observed["update_compiles"] == 1, observed
+    assert observed["poll_dispatches_per_poll"] == 0.0, observed
+    assert observed["fused_fallbacks"] == 0, observed
+    assert observed["loose_updates"] == 0, observed
+    assert observed["bit_exact"], observed

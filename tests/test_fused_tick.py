@@ -1,0 +1,395 @@
+"""One-program tick + O(1) polling (DESIGN §27).
+
+The fused fleet dispatch collapses a whole shard's tick — every touched
+bucket, every wave — into ONE donated XLA program, and for all-sum-algebra
+metrics that same program emits per-row computed values and a live-masked
+running partial, so dashboard polls never touch the device. This file pins
+the contracts the refactor must keep:
+
+* one ``tick()`` == one XLA dispatch, regardless of bucket count and wave
+  depth, bit-exact against per-instance oracles;
+* fold-eligible polls cost zero compute dispatches and stay correct across
+  churn, expiry, reset, and checkpoint/restore;
+* the blast-radius ladder survives fusion: a fused trace failure falls back
+  to per-bucket programs (everything still lands), and a fused runtime death
+  with intact buffers quarantines exactly the poison row;
+* same-spec buckets batch under one shared vmap inside the fused program;
+* the dirty-set ingest index keeps the idle tick O(pending).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.engine.core as engine_core
+import metrics_tpu.engine.stream as stream_mod
+from metrics_tpu import Metric, StreamEngine, observe
+from metrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
+from metrics_tpu.engine.core import FusedEntry, engine_update, engine_update_fused
+from metrics_tpu.engine.sharded import ShardedStreamEngine
+from metrics_tpu.metric import clear_jit_cache, jit_update_enabled
+from metrics_tpu.utils.exceptions import TraceIneligibleError
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    clear_jit_cache()
+    jit_update_enabled(True)
+    with observe.scope(reset=True):
+        yield
+    clear_jit_cache()
+    jit_update_enabled(True)
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=4)
+
+
+def _acc_batch(rng, n=8):
+    return jnp.asarray(rng.randint(4, size=n)), jnp.asarray(rng.randint(4, size=n))
+
+
+def _auroc():
+    return BinaryAUROC(thresholds=16)
+
+
+def _auroc_batch(rng, n=8):
+    return jnp.asarray(rng.rand(n).astype(np.float32)), jnp.asarray(rng.randint(2, size=n))
+
+
+def _counter(name):
+    return sum(observe.snapshot()["counters"].get(name, {}).values())
+
+
+# ------------------------------------------------------------- one-program tick
+def test_two_buckets_many_waves_one_dispatch_bit_exact():
+    rng = np.random.RandomState(3)
+    engine = StreamEngine()
+    sids, oracles, batchers = [], {}, {}
+    for ctor, batch in ((_acc, _acc_batch), (_auroc, _auroc_batch)):
+        for _ in range(4):
+            sid = engine.add_session(ctor())
+            sids.append(sid)
+            oracles[sid] = ctor()
+            batchers[sid] = batch
+    for _t in range(4):
+        for sid in sids:
+            for _wave in range(3):  # three waves per bucket chain in-program
+                args = batchers[sid](rng)
+                engine.submit(sid, *args)
+                oracles[sid].update(*args)
+        assert engine.tick() == 1  # the WHOLE fleet: one XLA dispatch
+    for sid in sids:
+        sess = engine._sessions[sid]
+        row = {k: v[sess.slot] for k, v in sess.bucket.stacked.items()}
+        for k, ref in oracles[sid]._state.items():
+            # bit-exact, not allclose: wave chaining must preserve each
+            # session's submission order (float reduction order and all)
+            np.testing.assert_array_equal(np.asarray(row[k]), np.asarray(ref), err_msg=f"{sid}:{k}")
+    values = engine.compute_all()
+    for sid in sids:
+        np.testing.assert_allclose(
+            np.asarray(values[sid]), np.asarray(oracles[sid].compute()), rtol=1e-6
+        )
+
+
+def test_fused_program_compiles_once_across_ticks():
+    rng = np.random.RandomState(5)
+    engine = StreamEngine()
+    sids = [engine.add_session(_acc()) for _ in range(3)] + [
+        engine.add_session(_auroc()) for _ in range(3)
+    ]
+    for _t in range(3):
+        for i, sid in enumerate(sids):
+            args = _acc_batch(rng) if i < 3 else _auroc_batch(rng)
+            engine.submit(sid, *args)
+        engine.tick()
+    compiles = observe.snapshot()["counters"].get("fleet_compile", {})
+    update_compiles = {k: v for k, v in compiles.items() if not k.endswith(":compute")}
+    assert sum(update_compiles.values()) == 1, update_compiles
+
+
+# ------------------------------------------------------------- O(1) poll caches
+def test_fold_poll_matches_full_recompute_across_churn_expiry_reset_restore(tmp_path):
+    rng = np.random.RandomState(7)
+    engine = StreamEngine(wal_path=str(tmp_path / "fleet.wal"))
+    oracles = {}
+    for i in range(6):
+        sid = engine.add_session(_acc())
+        oracles[sid] = _acc()
+
+    def _submit_round():
+        for sid in list(oracles):
+            args = _acc_batch(rng)
+            engine.submit(sid, *args)
+            oracles[sid].update(*args)
+
+    def _assert_polls_match():
+        values = engine.compute_all()
+        assert set(values) == set(oracles)
+        for sid, oracle in oracles.items():
+            np.testing.assert_allclose(
+                np.asarray(values[sid]), np.asarray(oracle.compute()), rtol=1e-6,
+                err_msg=str(sid),
+            )
+
+    _submit_round()
+    engine.tick()
+    _assert_polls_match()
+    # churn: expire two, arrive two, keep polling
+    for sid in list(oracles)[:2]:
+        engine.expire(sid)
+        del oracles[sid]
+    _assert_polls_match()
+    for _ in range(2):
+        sid = engine.add_session(_acc())
+        oracles[sid] = _acc()
+    _submit_round()
+    engine.tick()
+    _assert_polls_match()
+    # reset one session invalidates the fold caches; polls stay correct
+    victim = next(iter(oracles))
+    engine.reset(victim)
+    oracles[victim] = _acc()
+    _submit_round()
+    engine.tick()
+    _assert_polls_match()
+    # checkpoint/restore: the rebuilt fleet answers polls identically
+    ckpt = engine.checkpoint(str(tmp_path / "fleet.mtckpt"))
+    rebuilt = StreamEngine.restore(ckpt, wal_path=str(tmp_path / "fleet.wal"))
+    values = rebuilt.compute_all()
+    for sid, oracle in oracles.items():
+        np.testing.assert_allclose(
+            np.asarray(values[sid]), np.asarray(oracle.compute()), rtol=1e-6
+        )
+
+
+def test_fold_poll_zero_compute_dispatches_and_one_transfer_per_version():
+    rng = np.random.RandomState(11)
+    engine = StreamEngine()
+    sids = [engine.add_session(_acc()) for _ in range(4)]
+    for sid in sids:
+        engine.submit(sid, *_acc_batch(rng))
+    engine.tick()
+    engine.compute_all()
+    transfers = observe.snapshot()["counters"].get("explicit_transfer", {}).get("poll_readout", 0)
+    assert transfers == 1  # one batched device_get for the whole bucket
+    # polls between ticks are pure host work: no dispatch, no new transfer
+    for _ in range(5):
+        engine.compute_all()
+        engine.compute(sids[0])
+    snap = observe.snapshot()["counters"]
+    assert "fleet_compute_dispatch" not in snap
+    assert snap.get("explicit_transfer", {}).get("poll_readout", 0) == 1
+
+
+def test_fold_poll_bit_exact_under_x64():
+    import jax
+
+    assert jax.config.jax_enable_x64 is False
+    jax.config.update("jax_enable_x64", True)
+    try:
+        clear_jit_cache()
+        rng = np.random.RandomState(13)
+        engine = StreamEngine()
+        sids = [engine.add_session(_acc()) for _ in range(3)]
+        oracles = {sid: _acc() for sid in sids}
+        for _ in range(2):
+            for sid in sids:
+                args = _acc_batch(rng)
+                engine.submit(sid, *args)
+                oracles[sid].update(*args)
+            assert engine.tick() == 1
+        values = engine.compute_all()
+        assert "fleet_compute_dispatch" not in observe.snapshot()["counters"]
+        for sid in sids:
+            got, want = np.asarray(values[sid]), np.asarray(oracles[sid].compute())
+            assert got.dtype == want.dtype
+            np.testing.assert_allclose(got, want, rtol=1e-12)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+        clear_jit_cache()
+
+
+def test_sharded_aggregate_uses_tick_partial_and_survives_expiry():
+    rng = np.random.RandomState(17)
+    fleet = ShardedStreamEngine(n_shards=2)
+    template = _acc()
+    sids, oracle_batches = [], []
+    for i in range(6):
+        sid = f"agg-{i}"
+        fleet.add_session(_acc(), sid)
+        sids.append(sid)
+        args = _acc_batch(rng)
+        fleet.submit(sid, *args)
+        oracle_batches.append((sid, args))
+    fleet.tick()
+
+    def _oracle(skip=()):
+        m = _acc()
+        for sid, args in oracle_batches:
+            if sid not in skip:
+                m.update(*args)
+        return np.asarray(m.compute())
+
+    merged = fleet.aggregate(template)
+    np.testing.assert_allclose(np.asarray(merged.compute()), _oracle(), rtol=1e-6)
+    # post-tick expiry leaves the tick-time partial stale for that bucket:
+    # the fast path must refuse it and fall back to per-row slices
+    fleet.expire(sids[0])
+    merged = fleet.aggregate(template)
+    np.testing.assert_allclose(
+        np.asarray(merged.compute()), _oracle(skip={sids[0]}), rtol=1e-6
+    )
+
+
+# --------------------------------------------------------- blast-radius ladder
+def test_fused_trace_failure_falls_back_per_bucket_and_loses_nothing(monkeypatch):
+    rng = np.random.RandomState(19)
+    engine = StreamEngine()
+    sids = [engine.add_session(_acc()) for _ in range(3)] + [
+        engine.add_session(_auroc()) for _ in range(3)
+    ]
+    oracles = {sid: (_acc() if i < 3 else _auroc()) for i, sid in enumerate(sids)}
+    for i, sid in enumerate(sids):
+        args = _acc_batch(rng) if i < 3 else _auroc_batch(rng)
+        engine.submit(sid, *args)
+        oracles[sid].update(*args)
+
+    def fused_refuses(*args, **kwargs):
+        raise TraceIneligibleError("injected: fused program refused to trace")
+
+    monkeypatch.setattr(stream_mod, "engine_update_fused", fused_refuses)
+    dispatches = engine.tick()
+    assert dispatches == 2  # one per-bucket fallback dispatch per bucket
+    snap = observe.snapshot()["counters"]
+    assert sum(snap.get("fleet_fused_fallback", {}).values()) == 1
+    for sid in sids:  # nothing demoted, nothing lost
+        assert engine.session_health(sid) == "healthy"
+        np.testing.assert_allclose(
+            np.asarray(engine.compute(sid)), np.asarray(oracles[sid].compute()), rtol=1e-6
+        )
+
+
+def test_fused_runtime_death_quarantines_exactly_the_poison_row(monkeypatch):
+    rng = np.random.RandomState(23)
+    engine = StreamEngine()
+    sids = [engine.add_session(_acc()) for _ in range(4)]
+    oracles = {sid: _acc() for sid in sids}
+    for sid in sids:  # a clean warm-up tick so every row carries real state
+        args = _acc_batch(rng)
+        engine.submit(sid, *args)
+        oracles[sid].update(*args)
+    assert engine.tick() == 1
+
+    poison_tick = {sid: _acc_batch(rng) for sid in sids}
+    for sid in sids:
+        engine.submit(sid, *poison_tick[sid])
+        if sid != sids[1]:  # the oracle never sees the poison row's dropped batch
+            oracles[sid].update(*poison_tick[sid])
+
+    def dead_dispatch(*args, **kwargs):
+        raise RuntimeError("injected: dispatch died at runtime, buffers intact")
+
+    real_fu = Metric._functional_update
+    calls = {"n": 0}
+
+    def trapdoor(self, state, *args, **kwargs):
+        i = calls["n"]
+        calls["n"] += 1
+        if i == 1:  # rows replay in wave order: call 1 is sids[1]'s row
+            raise RuntimeError("injected: poison row")
+        return real_fu(self, state, *args, **kwargs)
+
+    monkeypatch.setattr(stream_mod, "engine_update_fused", dead_dispatch)
+    monkeypatch.setattr(stream_mod, "engine_update", dead_dispatch)
+    monkeypatch.setattr(Metric, "_functional_update", trapdoor)
+    engine.tick()
+    monkeypatch.undo()
+
+    assert engine.session_health(sids[1]) == "quarantined"
+    for sid in sids:
+        if sid != sids[1]:
+            assert engine.session_health(sid) == "healthy"
+        np.testing.assert_allclose(
+            np.asarray(engine.compute(sid)), np.asarray(oracles[sid].compute()),
+            rtol=1e-6, err_msg=str(sid),
+        )
+    snap = observe.snapshot()["counters"]
+    assert sum(snap.get("fleet_quarantine", {}).values()) == 1
+    assert sum(snap.get("fleet_row_replay", {}).values()) == len(sids) - 1
+    # the next tick is clean: survivors ride one fused dispatch again
+    for sid in sids:
+        args = _acc_batch(rng)
+        engine.submit(sid, *args)
+        oracles[sid].update(*args)
+    assert engine.tick() == 1
+    for sid in sids:
+        np.testing.assert_allclose(
+            np.asarray(engine.compute(sid)), np.asarray(oracles[sid].compute()), rtol=1e-6
+        )
+
+
+# --------------------------------------------------- core: same-spec vmap batch
+def test_same_spec_entries_batch_and_match_per_entry_oracle():
+    rng = np.random.RandomState(29)
+    tmpl_a, tmpl_b = _acc(), _acc()
+    n = 4
+
+    def entry_for(tmpl, rows_rng):
+        stacked = {
+            k: jnp.repeat(jnp.asarray(d)[None], n, axis=0)
+            for k, d in tmpl._defaults.items()
+        }
+        preds = jnp.asarray(rows_rng.randint(4, size=(n, 8)))
+        target = jnp.asarray(rows_rng.randint(4, size=(n, 8)))
+        mask = jnp.asarray([True, True, False, True])
+        return stacked, ((preds, target), {}, mask)
+
+    stacked_a, group_a = entry_for(tmpl_a, rng)
+    stacked_b, group_b = entry_for(tmpl_b, rng)
+    entries = [
+        FusedEntry(template=tmpl_a, n=n, stacked=stacked_a, groups=[group_a], label="a"),
+        FusedEntry(template=tmpl_b, n=n, stacked=stacked_b, groups=[group_b], label="b"),
+    ]
+    results = engine_update_fused(entries, label="samespec")
+    assert len(engine_core._FLEET_JIT_CACHE) == 1  # one program for both entries
+    for (stacked, (args, kwargs, mask)), (new_stacked, _v, _p) in zip(
+        ((stacked_a, group_a), (stacked_b, group_b)), results
+    ):
+        oracle = engine_update(
+            tmpl_a, n, stacked, args, kwargs, mask=mask, label="oracle"
+        )
+        for k in oracle:
+            np.testing.assert_array_equal(np.asarray(new_stacked[k]), np.asarray(oracle[k]))
+
+
+# -------------------------------------------------------------- dirty-set index
+def test_idle_tick_touches_nothing_and_partial_flush_is_o_pending():
+    rng = np.random.RandomState(31)
+    engine = StreamEngine()
+    acc_sids = [engine.add_session(_acc()) for _ in range(3)]
+    auroc_sids = [engine.add_session(_auroc()) for _ in range(3)]
+    for sid in acc_sids:
+        engine.submit(sid, *_acc_batch(rng))
+    for sid in auroc_sids:
+        engine.submit(sid, *_auroc_batch(rng))
+    assert engine.tick() == 1
+    assert not engine._dirty_buckets and not engine._dirty_loose
+    assert engine.tick() == 0  # idle: two empty-dict checks, no bucket walk
+    flushes_before = dict(observe.snapshot()["counters"].get("fleet_flush", {}))
+    # one pending submission: only ITS bucket plans/flushes
+    engine.submit(acc_sids[0], *_acc_batch(rng))
+    assert engine.tick() == 1
+    flushes_after = observe.snapshot()["counters"].get("fleet_flush", {})
+    changed = {k for k in flushes_after if flushes_after[k] != flushes_before.get(k, 0)}
+    assert len(changed) == 1 and "MulticlassAccuracy" in next(iter(changed))
+
+
+def test_skey_index_tracks_add_expire():
+    engine = StreamEngine()
+    sid = engine.add_session(_acc(), "meter-me")
+    assert engine._skey_index[str(sid)] == sid
+    engine.expire(sid)
+    assert str(sid) not in engine._skey_index

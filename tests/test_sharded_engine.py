@@ -305,10 +305,12 @@ def test_torn_manifest_is_rejected(tmp_path):
 
 # -------------------------------------------------------- blast-radius ladder
 def _poison_tick(shard):
-    def dead_tick():
+    # the pipelined sharded tick drives the stage/dispatch halves directly;
+    # the dispatch half is where a consumed-buffer death surfaces
+    def dead_dispatch(staged):
         raise DispatchConsumedError("injected: buffers donated to a dead dispatch")
 
-    shard.tick = dead_tick
+    shard._dispatch_flush = dead_dispatch
 
 
 def _durable_two_shard_fleet(tmp_path, rng):

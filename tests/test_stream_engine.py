@@ -255,8 +255,9 @@ def test_ingest_waves_preserve_per_session_order():
     oa.update(*a1)
     oa.update(*a2)
     ob.update(*b1)
-    # wave 0 coalesces {a1, b1} into one dispatch; wave 1 carries a2 alone
-    assert engine.tick() == 2
+    # wave 0 coalesces {a1, b1}, wave 1 carries a2 alone — and both waves
+    # chain inside ONE fused program, in order (DESIGN §27)
+    assert engine.tick() == 1
     _assert_state_equal(engine, a, oa)
     _assert_state_equal(engine, b, ob)
 
@@ -271,7 +272,9 @@ def test_distinct_batch_signatures_split_waves():
     engine.submit(b, *narrow)  # different aval: cannot share staging buffers
     oa.update(*wide)
     ob.update(*narrow)
-    assert engine.tick() == 2
+    # distinct signatures still split into separate masked waves, but the
+    # waves fuse into one dispatch per tick
+    assert engine.tick() == 1
     _assert_state_equal(engine, a, oa)
     _assert_state_equal(engine, b, ob)
 
@@ -286,7 +289,7 @@ def test_submit_is_lazy_until_tick():
 
 
 # ------------------------------------------------------------------ buckets
-def test_heterogeneous_classes_one_dispatch_per_bucket():
+def test_heterogeneous_classes_one_fused_dispatch_per_tick():
     rng = np.random.RandomState(8)
     engine = StreamEngine()
     for _ in range(4):
@@ -296,7 +299,8 @@ def test_heterogeneous_classes_one_dispatch_per_bucket():
         sid = engine.add_session(_auroc())
         engine.submit(sid, *_auroc_batch(rng))
     assert len(engine._buckets) == 2
-    assert engine.tick() == 2  # 8 streams, 2 buckets, 2 dispatches
+    # 8 streams, 2 heterogeneous buckets, ONE fused XLA dispatch (DESIGN §27)
+    assert engine.tick() == 1
 
 
 def test_config_fingerprint_splits_buckets():
@@ -352,21 +356,64 @@ def test_capacity_doubling_compiles_exactly_once_per_bucket():
         _assert_state_equal(engine, sid, oracles[sid])
 
 
+class _RunningMax(Metric):
+    """Bucketable, but its merge algebra is max — NOT fold-eligible, so polls
+    ride the cached full-recompute path (DESIGN §27)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("peak", jnp.asarray(0.0), dist_reduce_fx="max")
+
+    def update(self, x):
+        self.peak = jnp.maximum(self.peak, jnp.max(jnp.asarray(x, dtype=jnp.float32)))
+
+    def compute(self):
+        return self.peak
+
+
 def test_compute_is_cached_until_state_changes():
     rng = np.random.RandomState(11)
     engine = StreamEngine()
-    sids = [engine.add_session(_acc()) for _ in range(3)]
+    sids = [engine.add_session(_RunningMax()) for _ in range(3)]
     for sid in sids:
-        engine.submit(sid, *_acc_batch(rng))
+        engine.submit(sid, np.abs(rng.randn(8)).astype(np.float32))
     engine.tick()
     engine.compute_all()
     engine.compute(sids[0])  # same bucket version: served from the cached stack
     counters = observe.snapshot()["counters"]
     assert sum(counters["fleet_compute_dispatch"].values()) == 1
-    engine.submit(sids[0], *_acc_batch(rng))
+    engine.submit(sids[0], np.abs(rng.randn(8)).astype(np.float32))
     engine.compute(sids[0])  # flushes, version bumps, recomputes
     counters = observe.snapshot()["counters"]
     assert sum(counters["fleet_compute_dispatch"].values()) == 2
+
+
+def test_fold_eligible_bucket_polls_without_compute_dispatches():
+    # all-sum-algebra metrics get their per-row values computed INSIDE the
+    # fused tick program: a dashboard poll issues zero compute dispatches
+    rng = np.random.RandomState(11)
+    engine = StreamEngine()
+    sids = [engine.add_session(_acc()) for _ in range(3)]
+    oracles = {sid: _acc() for sid in sids}
+    for sid in sids:
+        args = _acc_batch(rng)
+        engine.submit(sid, *args)
+        oracles[sid].update(*args)
+    engine.tick()
+    values = engine.compute_all()
+    engine.compute(sids[0])
+    counters = observe.snapshot()["counters"]
+    assert "fleet_compute_dispatch" not in counters
+    for sid in sids:
+        np.testing.assert_allclose(
+            np.asarray(values[sid]), np.asarray(oracles[sid].compute()), rtol=1e-6
+        )
+    # a second poll with no state change touches nothing at all
+    before = observe.snapshot()["counters"].get("explicit_transfer", {}).copy()
+    engine.compute_all()
+    assert observe.snapshot()["counters"].get("explicit_transfer", {}) == before
 
 
 # ------------------------------------------------------------------ loose path
@@ -530,7 +577,9 @@ def test_clear_jit_cache_drops_fleet_cache():
     engine.submit(sid, *_acc_batch(rng))
     engine.tick()
     engine.compute(sid)
-    assert len(engine_core._FLEET_JIT_CACHE) >= 2  # update + compute programs
+    # fused tick program (fold-eligible buckets compute inside it, so a
+    # separate compute program may never build)
+    assert len(engine_core._FLEET_JIT_CACHE) >= 1
     clear_jit_cache()
     assert len(engine_core._FLEET_JIT_CACHE) == 0
 
