@@ -1,6 +1,6 @@
-"""Static & dynamic analysis for metrics_tpu: jitlint + distlint + donlint + hotlint + numlint.
+"""Static & dynamic analysis: jitlint + distlint + donlint + hotlint + numlint + racelint.
 
-Ten complementary passes guard the invariants the runtime cannot check:
+Twelve complementary passes guard the invariants the runtime cannot check:
 
 * **jitlint AST pass** (:mod:`metrics_tpu.analysis.rules`, rules JL001–JL006)
   flags tracer concretization, recompilation keys, state-contract breaches,
@@ -55,10 +55,25 @@ Ten complementary passes guard the invariants the runtime cannot check:
   long-horizon, cancellation, 2^31-overflow and decay regimes — and the
   static rule, the declared per-state ``precision=`` contract and the
   observed drift must agree.
+* **racelint AST pass** (:mod:`metrics_tpu.analysis.race_rules`, rules
+  RC001–RC006) polices concurrency & ordering in the host-side control plane:
+  shared attributes written from more than one control-plane context without
+  a declared single writer, ack/watermark advances that a durability barrier
+  does not dominate, mutation of double-buffered wave state while a dispatch
+  may be in flight, autonomic reflexes off the declared engine allowlist or
+  outside the rate-limit/dry-run gate, WAL appends blind to the replay latch,
+  and iteration over containers a reachable callee mutates (DESIGN §28).
+* the **interleaving harness**
+  (:mod:`metrics_tpu.analysis.interleave_contracts`) proves racelint's
+  ordering claims dynamically: a deterministic virtual scheduler drives the
+  real server/engine/producer/autonomic stack through 1000+ permuted and
+  adversarial segment interleavings (with kill-points), asserting the
+  contiguous resolved-pseq prefix, acked⇒durable across crashes, oracle-exact
+  aggregate reads and tick/autonomic serialization after every segment.
 
-CLI: ``python tools/lint_metrics.py [--pass <name> | --all] [--json]`` or the
-``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` / ``numlint`` console
-scripts.
+CLI: ``python tools/lint_metrics.py [--pass <name> | --all | --list-rules]
+[--json]`` or the ``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` /
+``numlint`` / ``racelint`` console scripts.
 """
 
 from metrics_tpu.analysis.contexts import (
@@ -66,6 +81,7 @@ from metrics_tpu.analysis.contexts import (
     LINT_PREFIXES,
     MEM_RULE_CODES,
     NUM_RULE_CODES,
+    RACE_RULE_CODES,
     RULE_CODES,
     SYNC_RULE_CODES,
     Suppressions,
@@ -85,6 +101,7 @@ from metrics_tpu.analysis.engine import (
 )
 from metrics_tpu.analysis.mem_rules import MEM_RULES
 from metrics_tpu.analysis.num_rules import NUM_RULES, classify_precision
+from metrics_tpu.analysis.race_rules import RACE_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 from metrics_tpu.analysis.sync_rules import SYNC_RULES
 
@@ -99,6 +116,8 @@ __all__ = [
     "ModuleInfo",
     "NUM_RULES",
     "NUM_RULE_CODES",
+    "RACE_RULES",
+    "RACE_RULE_CODES",
     "RULE_CODES",
     "SYNC_RULES",
     "SYNC_RULE_CODES",

@@ -1,6 +1,6 @@
-"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` / ``numlint`` / ``chaoslint``.
+"""Lint command line: ``python tools/lint_metrics.py`` / ``jitlint`` / ``distlint`` / ``donlint`` / ``hotlint`` / ``numlint`` / ``racelint`` / ``chaoslint``.
 
-Five static passes share one engine and one exit-code contract:
+Six static passes share one engine and one exit-code contract:
 
 * ``jitlint``  — tracer-safety & recompilation rules JL001–JL006, baselined in
   ``tools/jitlint_baseline.json``
@@ -14,8 +14,14 @@ Five static passes share one engine and one exit-code contract:
   catastrophic cancellation, domain-edge math, narrow accumulators, fold
   demotion, undeclared reassociation tolerance), baselined in the ``rules``
   section of ``tools/numlint_baseline.json`` (expected empty)
+* ``racelint`` — concurrency & ordering rules RC001–RC006 over the control
+  plane (multi-context attribute writes, fsync-before-ack/watermark
+  domination, staged-buffer mutation during in-flight dispatch, autonomic
+  allowlist/gate, replay re-entrancy latch, iterate-while-mutate), baselined
+  in the ``rules`` section of ``tools/racelint_baseline.json`` (MUST stay
+  empty — ordering bugs get fixed, never baselined)
 
-Seven dynamic passes ride the same selection/exit-code contract:
+Eight dynamic passes ride the same selection/exit-code contract:
 
 * ``donation`` — 3-step donate-enabled update loops cross-checking static
   donlint verdicts, ``costs.py`` eligibility, and runtime buffer deletion
@@ -49,6 +55,16 @@ Seven dynamic passes ride the same selection/exit-code contract:
   recovery bit-exact vs a never-crashed oracle;
   :mod:`metrics_tpu.analysis.chaos_contracts`), violations baselined in the
   ``chaos`` / ``fleet`` sections of ``tools/chaos_baseline.json``
+* ``interleave`` — the deterministic schedule-exploration harness: real
+  server/engine/autonomic objects driven through thousands of permuted and
+  adversarial ingest/tick/poll/autonomic/aggregate interleavings (bounded
+  exhaustive for small schedules, seeded-random beyond), asserting the
+  invariants racelint claims statically — contiguous resolved pseq prefix,
+  no acked-record loss across kill-points, aggregate never observing a
+  half-assembled wave, autonomic serialized with tick
+  (:mod:`metrics_tpu.analysis.interleave_contracts`), violations baselined
+  in the ``interleave`` section of ``tools/racelint_baseline.json``
+  (expected empty)
 * ``perf`` — XLA cost profiling of compiled metric updates + the 64-stream
   fleet smoke (:mod:`metrics_tpu.observe.profile`), ratcheted against
   ``tools/perf_baseline.json``
@@ -74,6 +90,7 @@ from metrics_tpu.analysis.contexts import (
     DIST_RULE_CODES,
     MEM_RULE_CODES,
     NUM_RULE_CODES,
+    RACE_RULE_CODES,
     RULE_CODES,
     SYNC_RULE_CODES,
 )
@@ -84,7 +101,15 @@ from metrics_tpu.analysis.engine import (
     write_baseline,
 )
 
-__all__ = ["main", "main_chaoslint", "main_distlint", "main_donlint", "main_hotlint", "main_numlint"]
+__all__ = [
+    "main",
+    "main_chaoslint",
+    "main_distlint",
+    "main_donlint",
+    "main_hotlint",
+    "main_numlint",
+    "main_racelint",
+]
 
 # "section" names the baseline-JSON section the static pass owns; the default
 # is the historical "entries" (numlint shares its document with the precision
@@ -111,6 +136,11 @@ _PASSES: Dict[str, Dict[str, object]] = {
         "baseline": os.path.join("tools", "numlint_baseline.json"),
         "section": "rules",
     },
+    "racelint": {
+        "rules": RACE_RULE_CODES,
+        "baseline": os.path.join("tools", "racelint_baseline.json"),
+        "section": "rules",
+    },
 }
 
 # dynamic passes: no rule codes, run programs instead of parsing them.
@@ -122,7 +152,7 @@ _PASSES: Dict[str, Dict[str, object]] = {
 # once AOT to disk, once as the fresh oracle — fleet churns a 4-slot
 # StreamEngine bucket per class, chaos injects the full fault suite per
 # class, perf lowers the whole registry + runs the fleet smoke).
-_DYNAMIC = ("telemetry", "donation", "transfer", "precision", "aot", "fleet", "chaos", "perf")
+_DYNAMIC = ("telemetry", "donation", "interleave", "transfer", "precision", "aot", "fleet", "chaos", "perf")
 
 
 def _dynamic_runner(name: str):
@@ -156,6 +186,10 @@ def _dynamic_runner(name: str):
         from metrics_tpu.analysis.precision_contracts import run_precision_check  # noqa: PLC0415
 
         return run_precision_check
+    if name == "interleave":
+        from metrics_tpu.analysis.interleave_contracts import run_interleave_check  # noqa: PLC0415
+
+        return run_interleave_check
     from metrics_tpu.analysis.donation_contracts import run_donation_check  # noqa: PLC0415
 
     return run_donation_check
@@ -179,8 +213,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="which pass to run (repeatable; default: jitlint)")
     p.add_argument("--all", action="store_true", dest="run_all",
                    help="run every pass (jitlint + distlint + donlint + hotlint "
-                        "+ numlint + telemetry + donation + transfer + precision "
-                        "+ aot + fleet + chaos + perf) in one invocation")
+                        "+ numlint + racelint + telemetry + donation + interleave "
+                        "+ transfer + precision + aot + fleet + chaos + perf) in "
+                        "one invocation")
+    p.add_argument("--list-rules", action="store_true", dest="list_rules",
+                   help="print every rule ID + one-liner across all six static "
+                        "passes (plus the dynamic passes) and exit 0")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (overrides --pass selection, "
                         "e.g. JL001,DL004,ML002; baseline follows each code's own pass)")
@@ -218,8 +256,33 @@ def _pass_rules(name: str, explicit: Optional[List[str]]) -> List[str]:
     return [c for c in explicit if c in codes]
 
 
+def _list_rules(fmt: str) -> int:
+    """Every rule ID + one-liner across the six static passes, one table."""
+    from metrics_tpu.analysis import dist_rules, mem_rules, num_rules, race_rules, rules, sync_rules  # noqa: PLC0415
+
+    summaries: Dict[str, Dict[str, str]] = {
+        "jitlint": rules.SUMMARIES,
+        "distlint": dist_rules.SUMMARIES,
+        "donlint": mem_rules.SUMMARIES,
+        "hotlint": sync_rules.SUMMARIES,
+        "numlint": num_rules.SUMMARIES,
+        "racelint": race_rules.SUMMARIES,
+    }
+    if fmt == "json":
+        print(json.dumps({"passes": summaries, "dynamic": list(_DYNAMIC)}, indent=2))
+        return 0
+    for name in sorted(_PASSES):
+        codes = summaries[name]
+        for code in _PASSES[name]["rules"]:  # type: ignore[index]
+            print(f"{code}  [{name}]  {codes.get(code, '(no summary)')}")
+    print(f"dynamic passes (no rule codes): {', '.join(_DYNAMIC)}")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules(args.fmt)
     root = os.path.abspath(args.root or os.getcwd())
     targets = [t if os.path.isabs(t) else os.path.join(root, t) for t in args.targets]
     missing = [t for t in targets if not os.path.exists(t)]
@@ -348,6 +411,12 @@ def main_numlint(argv: Optional[Sequence[str]] = None) -> int:
     """Entry point for the ``numlint`` console script — NL rules + precision cross-check."""
     argv = list(sys.argv[1:] if argv is None else argv)
     return main(["--pass", "numlint", "--pass", "precision", *argv])
+
+
+def main_racelint(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point for the ``racelint`` console script — RC rules + interleave harness."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return main(["--pass", "racelint", "--pass", "interleave", *argv])
 
 
 def main_chaoslint(argv: Optional[Sequence[str]] = None) -> int:

@@ -40,6 +40,7 @@ __all__ = [
     "MEM_RULE_CODES",
     "SYNC_RULE_CODES",
     "NUM_RULE_CODES",
+    "RACE_RULE_CODES",
 ]
 
 RULE_CODES = ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006")
@@ -47,6 +48,7 @@ DIST_RULE_CODES = ("DL001", "DL002", "DL003", "DL004", "DL005")
 MEM_RULE_CODES = ("ML001", "ML002", "ML003", "ML004", "ML005", "ML006")
 SYNC_RULE_CODES = ("HL001", "HL002", "HL003", "HL004", "HL005", "HL006")
 NUM_RULE_CODES = ("NL001", "NL002", "NL003", "NL004", "NL005", "NL006")
+RACE_RULE_CODES = ("RC001", "RC002", "RC003", "RC004", "RC005", "RC006")
 
 # `# jitlint: disable=JL001`, `# distlint: disable=DL002`, `# donlint:
 # disable=ML003`, `# hotlint: disable=HL001` and `# numlint: disable=NL004`
@@ -54,7 +56,7 @@ NUM_RULE_CODES = ("NL001", "NL002", "NL003", "NL004", "NL005", "NL006")
 # globally unique). A new pass registers its prefix here ONCE and both
 # suppression forms — per-line and file-wide — work for it; nothing else
 # needs a parser.
-LINT_PREFIXES = ("jitlint", "distlint", "donlint", "hotlint", "numlint")
+LINT_PREFIXES = ("jitlint", "distlint", "donlint", "hotlint", "numlint", "racelint")
 _PREFIX_ALT = "|".join(LINT_PREFIXES)
 _SUPPRESS_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable=([A-Za-z0-9_,\s]+)")
 _SUPPRESS_FILE_RE = re.compile(rf"#\s*(?:{_PREFIX_ALT}):\s*disable-file=([A-Za-z0-9_,\s]+)")

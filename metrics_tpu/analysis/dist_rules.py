@@ -363,3 +363,13 @@ DIST_RULES: Dict[str, Callable[[ModuleInfo], List[Violation]]] = {
     "DL004": rule_dl004_raw_collectives,
     "DL005": rule_dl005_merge_override_drops_state,
 }
+
+
+# one-liner per rule for `lint_metrics.py --list-rules`
+SUMMARIES = {
+    "DL001": "custom dist_reduce_fx without a declared merge_associative= algebra",
+    "DL002": "update folds state through an operation outside the merge-sound set",
+    "DL003": "compute depends on _update_count or positional list-state indexing",
+    "DL004": "raw lax collective outside parallel/sync.py bypasses the reduction registry",
+    "DL005": "merge_state override silently drops a registered state",
+}

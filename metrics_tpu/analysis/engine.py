@@ -28,6 +28,7 @@ from metrics_tpu.analysis.contexts import (
 from metrics_tpu.analysis.dist_rules import DIST_RULES
 from metrics_tpu.analysis.mem_rules import MEM_RULES
 from metrics_tpu.analysis.num_rules import NUM_RULES
+from metrics_tpu.analysis.race_rules import RACE_RULES
 from metrics_tpu.analysis.rules import ALL_RULES, ModuleInfo
 from metrics_tpu.analysis.sync_rules import SYNC_RULES
 from metrics_tpu.utils.io import atomic_write_text
@@ -46,7 +47,7 @@ __all__ = [
 
 # one registry across all passes; rule codes are globally unique so a
 # ``--rules JL001,DL004,ML002`` mix selects freely across them
-_REGISTRY = {**ALL_RULES, **DIST_RULES, **MEM_RULES, **SYNC_RULES, **NUM_RULES}
+_REGISTRY = {**ALL_RULES, **DIST_RULES, **MEM_RULES, **SYNC_RULES, **NUM_RULES, **RACE_RULES}
 
 
 class SourceMarkers:

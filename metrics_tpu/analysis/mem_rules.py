@@ -637,3 +637,14 @@ def classify_donation(cls: type) -> Tuple[bool, str]:
         if isinstance(node, ast.ClassDef):
             blockers.extend(f"{klass.__name__}: {b}" for b in class_donation_blockers(node))
     return (not blockers, "; ".join(blockers))
+
+
+# one-liner per rule for `lint_metrics.py --list-rules`
+SUMMARIES = {
+    "ML001": "state buffer escapes a donated update (return/closure/stash/external splice)",
+    "ML002": "two state names bind one buffer — double donation forces donate_copy",
+    "ML003": "append-only fixed-shape list state could be an array state (blocks jit+donation)",
+    "ML004": "donate_states=False opt-out without a justifying comment",
+    "ML005": "compute stashes state reads into instance attributes (copy-before-donate)",
+    "ML006": "reset re-binds states to shared default buffers instead of super().reset()",
+}

@@ -826,3 +826,14 @@ def classify_precision(cls: type) -> Tuple[bool, str]:
                 continue
             hazards.extend(_lint(fn_source, fn_tree, fn_obj.__name__, ("NL002",)))
     return (not hazards, "; ".join(hazards))
+
+
+# one-liner per rule for `lint_metrics.py --list-rules`
+SUMMARIES = {
+    "NL001": "unguarded traced division by an array denominator not proven nonzero",
+    "NL002": "catastrophic-cancellation moment form (E[x^2]-E[x]^2) in traced code",
+    "NL003": "unclamped domain-edge math (log/sqrt/arccos/exp) on computed values",
+    "NL004": "pinned-narrow accumulator without widening, compensation, or a declared horizon",
+    "NL005": "dtype demotion inside a state fold / mixed-dtype where into an int state",
+    "NL006": "associative float-sum merge without a declared reassociation tolerance",
+}

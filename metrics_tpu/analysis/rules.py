@@ -445,3 +445,15 @@ ALL_RULES: Dict[str, Callable[[ModuleInfo], List[Violation]]] = {
     "JL005": rule_jl005_side_effects,
     "JL006": rule_jl006_namespace,
 }
+
+
+# one-liner per rule for `lint_metrics.py --list-rules` (the full invariants
+# live in the module docstring table above)
+SUMMARIES = {
+    "JL001": "tracer concretization (float/int/bool, .item(), if/while on arrays) in traced code",
+    "JL002": "recompilation hazard: undeclared static config params / str() of traced values",
+    "JL003": "Metric state contract: unused states, missing dist_reduce_fx, unmarked host updates",
+    "JL004": "dtype-promotion hazard: bare np. calls or explicit 64-bit dtypes in traced code",
+    "JL005": "side effects under trace: print, block_until_ready, io_callback/host_callback",
+    "JL006": "namespace consistency: __all__ present, every name bound, public imports exported",
+}

@@ -554,3 +554,14 @@ def classify_transfers(cls: type) -> Tuple[bool, str]:
         if isinstance(node, ast.ClassDef):
             hazards.extend(f"{klass.__name__}: {h}" for h in class_sync_hazards(node))
     return (not hazards, "; ".join(hazards))
+
+
+# one-liner per rule for `lint_metrics.py --list-rules`
+SUMMARIES = {
+    "HL001": "implicit device->host sync (float/.item()/np.asarray on device values) in hot host code",
+    "HL002": "Python truthiness/branching on device arrays outside traced bodies",
+    "HL003": "per-element Python loop over a device array (one dispatch per element)",
+    "HL004": "per-call jax.jit construction inside a function body",
+    "HL005": "blocking call without a `# hotlint: intentional-transfer` annotation",
+    "HL006": "host allocation from device buffers inside per-tick engine paths",
+}

@@ -22,10 +22,17 @@ recent wins ties) held in a bounded per-kind history, and classifies:
 * a single component name (``"config:num_classes"``, ``"capacity"``,
   ``"batch_avals"``, ``"donation"``, ``"x64"``, …) — the actionable case:
   exactly one thing changed;
-* ``"multiple"`` — several components moved at once. One collapse rule
-  applies first: an x64-regime flip implies every aval-carrying component
+* ``"multiple"`` — several components moved at once. Two collapse rules
+  apply first: an x64-regime flip implies every aval-carrying component
   (``batch_avals`` / ``state_avals`` / ``call_signature``) changes with it,
-  so those are dropped from the diff before counting.
+  so those are dropped from the diff before counting; and a change to a fused
+  key's member roster (``buckets`` on the fused-tick key, ``leaders`` on the
+  fused collection key) implies every component that exists on only one side
+  of the diff (a member joining or leaving brings its whole
+  ``capacity[label]`` / ``batch_avals[label]`` / ``config[label]:…`` family
+  with it), so one-sided components are dropped too. Both rules see through
+  the per-member ``[label]`` suffix the decomposed fused key puts on each
+  per-entry component.
 
 The history deliberately survives ``clear_jit_cache()`` — that is what lets a
 post-clear miss attribute as ``"rebuild"`` instead of ``"first"`` — and is
@@ -42,6 +49,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import threading
 from collections import deque
@@ -59,6 +67,21 @@ __all__ = [
 # rewrites all of them, so they are implied (not independent causes) whenever
 # "x64" itself is in the diff
 _AVAL_COMPONENTS = frozenset({"batch_avals", "state_avals", "call_signature"})
+
+# roster components: the member list of a fused key — "buckets" on the engine's
+# fused-tick key, "leaders" on the fused collection key. A roster change
+# implies every component that exists on only one side of the diff.
+_ROSTER_COMPONENTS = frozenset({"buckets", "leaders"})
+
+# the decomposed fused key suffixes each per-entry component with its bucket
+# label: ``batch_avals[cls]``, ``config[cls]:k``. Collapse rules match on the
+# base name so they keep working on fused multi-bucket keys.
+_SUFFIX_RE = re.compile(r"\[[^\][]*\]")
+
+
+def _component_base(name: str) -> str:
+    """``batch_avals[cls]`` → ``batch_avals``; ``config[cls]:k`` → ``config:k``."""
+    return _SUFFIX_RE.sub("", name, count=1)
 
 _HISTORY_DEPTH = 128
 _VALUE_CAP = 160  # rendered component values are bounded for the event log
@@ -117,13 +140,23 @@ def attribute(
         return "first", (), {}
     if not nearest_diff:
         return "rebuild", (), {}
+    assert nearest is not None
     changed = nearest_diff
+    if len(changed) > 1 and any(r in changed for r in _ROSTER_COMPONENTS):
+        # the fused key's member roster changed: every component that exists
+        # on only one side of the diff was brought (or taken) by the
+        # joining/leaving member itself, not independently changed
+        collapsed = tuple(
+            c for c in changed
+            if c in _ROSTER_COMPONENTS or ((c in now) == (c in nearest))
+        )
+        if collapsed:
+            changed = collapsed
     if "x64" in changed and len(changed) > 1:
-        collapsed = tuple(c for c in changed if c not in _AVAL_COMPONENTS)
+        collapsed = tuple(c for c in changed if _component_base(c) not in _AVAL_COMPONENTS)
         if collapsed:
             changed = collapsed
     cause = changed[0] if len(changed) == 1 else "multiple"
-    assert nearest is not None
     detail = {c: {"prior": nearest.get(c), "now": now.get(c)} for c in changed}
     return cause, changed, detail
 

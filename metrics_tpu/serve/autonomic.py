@@ -33,9 +33,21 @@ from metrics_tpu.observe import recorder as _observe
 from metrics_tpu.observe.metering import installed_meter
 from metrics_tpu.observe.watchdog import installed_watchdog
 
-__all__ = ["AUTONOMIC_ACTIONS", "AutonomicAction", "AutonomicController", "shed_loose"]
+__all__ = [
+    "AUTONOMIC_ACTIONS",
+    "AUTONOMIC_ENGINE_ALLOWLIST",
+    "AutonomicAction",
+    "AutonomicController",
+    "shed_loose",
+]
 
 AUTONOMIC_ACTIONS = ("double", "demote", "resize", "shed")
+
+# The declared action surface: the ONLY engine entry points a reflex may
+# mutate through. racelint RC004 reads this literal from the AST and fails the
+# build on any engine-mutating call not named here, so widening the autonomic
+# blast radius is always an explicit, reviewable diff on this line.
+AUTONOMIC_ENGINE_ALLOWLIST = ("preexpand", "resize", "expire", "_demote_by_meter")
 
 
 class AutonomicAction(NamedTuple):

@@ -91,7 +91,9 @@ def test_diff_reports_unmatched_entries_as_stale():
 
 # ------------------------------------------------------------------ suppressions
 def test_every_registered_prefix_parses():
-    assert set(LINT_PREFIXES) == {"jitlint", "distlint", "donlint", "hotlint", "numlint"}
+    assert set(LINT_PREFIXES) == {
+        "jitlint", "distlint", "donlint", "hotlint", "numlint", "racelint",
+    }
     for prefix in LINT_PREFIXES:
         s = Suppressions(f"x = 1  # {prefix}: disable=ML001\n")
         assert s.is_suppressed(1, "ML001")

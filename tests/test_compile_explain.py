@@ -174,6 +174,75 @@ def test_fused_leader_config_change_attributes_single_component():
     assert event["changed"] == ["config[0]:squared"]
 
 
+def test_attribute_x64_collapse_sees_through_fused_suffixes():
+    # the decomposed fused key suffixes per-entry components with the bucket
+    # label; the x64 collapse must match on the base name, not the exact name
+    explain.attribute(
+        "fz", (("batch_avals[a]", "f32"), ("batch_avals[b]", "f32"), ("x64", False))
+    )
+    cause, changed, _ = explain.attribute(
+        "fz", (("batch_avals[a]", "f64"), ("batch_avals[b]", "f64"), ("x64", True))
+    )
+    assert cause == "x64" and changed == ("x64",)
+
+
+def test_attribute_bucket_roster_change_collapses_one_sided_components():
+    # one bucket -> two: the roster appears and every per-entry component
+    # swaps its name for a suffixed one. All of that is ONE cause: buckets.
+    explain.attribute(
+        "fb", (("mode", "fused"), ("capacity", 8), ("batch_avals", "f32"), ("x64", False))
+    )
+    cause, changed, detail = explain.attribute(
+        "fb",
+        (
+            ("mode", "fused"), ("buckets", ("a", "b")),
+            ("capacity[a]", 8), ("batch_avals[a]", "f32"),
+            ("capacity[b]", 4), ("batch_avals[b]", "i32"), ("x64", False),
+        ),
+    )
+    assert cause == "buckets" and changed == ("buckets",)
+    assert detail["buckets"]["prior"] is None
+    # a bucket joins AND a surviving bucket's avals independently change:
+    # the collapse must keep the two-sided change visible -> "multiple"
+    cause, changed, _ = explain.attribute(
+        "fb",
+        (
+            ("mode", "fused"), ("buckets", ("a", "b", "c")),
+            ("capacity[a]", 8), ("batch_avals[a]", "f64"),
+            ("capacity[b]", 4), ("batch_avals[b]", "i32"),
+            ("capacity[c]", 2), ("batch_avals[c]", "f32"), ("x64", False),
+        ),
+    )
+    assert cause == "multiple"
+    assert "buckets" in changed and "batch_avals[a]" in changed
+    assert "capacity[c]" not in changed  # brought by bucket c, not independent
+
+
+def test_fused_bucket_roster_growth_attributes_buckets():
+    from metrics_tpu import (
+        MeanAbsoluteError,
+        MeanAbsolutePercentageError,
+        MeanSquaredError,
+        MetricCollection,
+    )
+
+    p, t = jnp.asarray([0.1, 0.9]), jnp.asarray([0.5, 1.0])
+    col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    col.update(p, t)
+    col.update(p, t)
+    assert _explains("fused")[-1]["cause"] == "first"
+    # a third metric joins the fused group: the whole component family of the
+    # new bucket is implied by the roster change, so the cause is singular
+    col3 = MetricCollection(
+        [MeanSquaredError(), MeanAbsoluteError(), MeanAbsolutePercentageError()]
+    )
+    col3.update(p, t)
+    col3.update(p, t)
+    event = _explains("fused")[-1]
+    assert event["cause"] == "leaders"
+    assert event["changed"] == ["leaders"]
+
+
 # -------------------------------------------------------------------- AOT cache
 
 def test_aot_new_call_signature_attributes_call_signature(tmp_path):

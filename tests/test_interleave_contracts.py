@@ -1,0 +1,143 @@
+"""The interleaving harness (``analysis/interleave_contracts.py``, DESIGN §28).
+
+Three layers: (1) the acceptance pin — the full deterministic exploration
+(≥ 1000 distinct schedules: bounded-exhaustive permutations, adversarial
+kill-points, seeded-random tails) runs the real server/engine/autonomic stack
+with ZERO invariant violations and an empty ``interleave`` baseline section;
+(2) the harness is no rubber stamp — seeding a real ordering bug (a WAL that
+drops appends, an overlapping tick) makes it fail loudly; (3) the
+``resume_from_watermark`` vs reconnect/resend race: resuming while the
+recovered prefix is still being resent must refuse (pseq reuse), and the
+post-quiesce resume must stay exactly-once under the same oracle.
+"""
+
+import os
+
+import pytest
+
+from metrics_tpu import observe
+from metrics_tpu.analysis.interleave_contracts import (
+    DEFAULT_TARGET_SCHEDULES,
+    _Rig,
+    _SerializationProbe,
+    _run_schedule,
+    _schedules,
+    run_interleave_check,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _scoped():
+    with observe.scope(reset=True):
+        yield
+
+
+# ------------------------------------------------------------ schedule generation
+
+def test_schedule_set_is_deterministic_and_large_enough():
+    a = _schedules(DEFAULT_TARGET_SCHEDULES)
+    b = _schedules(DEFAULT_TARGET_SCHEDULES)
+    assert a == b  # fixed seed, no wall-clock: byte-identical across runs
+    assert len(a) >= 1000
+    assert len(set(a)) == len(a)  # distinct
+    # all three generation modes are represented
+    assert any("kill" in s for s in a)
+    segs = {seg for s in a for seg in s}
+    assert {"ingest", "poll", "pump", "tick", "autonomic", "aggregate", "kill"} <= segs
+
+
+# ------------------------------------------------------------ the acceptance pin
+
+def test_full_exploration_zero_violations(tmp_path):
+    """≥ 1000 distinct schedules across the serve/tick/autonomic invariants,
+    zero violations — the dynamic proof of racelint's static claims."""
+    report = {}
+    rc = run_interleave_check(REPO_ROOT, report=report)
+    assert report["schedules_explored"] >= 1000
+    assert report["violations"] == {}, "\n".join(report["details"])
+    assert report["new"] == {} and rc == 0
+    assert report["stale_baseline_keys"] == []
+
+
+# ------------------------------------------------------- the harness is not inert
+
+def test_probe_flags_overlapping_segments():
+    probe = _SerializationProbe()
+    tick = probe.wrap("tick", lambda: None)
+    step = probe.wrap("autonomic", lambda: tick())  # tick entered under step
+    step()
+    assert probe.violations and "tick" in probe.violations[0]
+
+
+def test_harness_catches_a_wal_that_drops_appends(tmp_path, monkeypatch):
+    """Seed the `death[replay]` family's dual: records acked but never
+    journaled. A kill-point must surface acked-record loss."""
+    from metrics_tpu.engine.durability import IngestWAL
+
+    monkeypatch.setattr(IngestWAL, "append", lambda self, *a, **k: None)
+    violations = _run_schedule(("ingest", "poll", "pump", "kill"), str(tmp_path))
+    kinds = {v.split(":", 1)[0] for v in violations}
+    assert "acked-durable" in kinds, violations
+
+
+def test_harness_catches_a_lying_aggregate(tmp_path, monkeypatch):
+    """Seed a half-assembled read: compute_all returning garbage must trip the
+    oracle on the very next aggregate segment."""
+    from metrics_tpu.engine.stream import StreamEngine
+
+    real = StreamEngine.compute_all
+
+    def skewed(self):
+        out = dict(real(self))
+        if out:
+            out = {k: float(v) + 1000.0 for k, v in out.items()}
+        return out
+
+    monkeypatch.setattr(StreamEngine, "compute_all", skewed)
+    violations = _run_schedule(("ingest", "poll", "tick", "aggregate"), str(tmp_path))
+    kinds = {v.split(":", 1)[0] for v in violations}
+    assert "aggregate-oracle" in kinds, violations
+
+
+# --------------------------------------- resume_from_watermark vs reconnect/resend
+
+def test_resume_refuses_while_recovered_prefix_is_resending(tmp_path):
+    """The race from PR 18's recovery path: after a crash+reconnect the
+    producer is mid-resend of its unacked tail. ``resume_from_watermark()``
+    at that moment would fast-forward ``_seq`` past frames still on the wire
+    and reuse their pseqs — the producer must refuse until the tail drains."""
+    rig = _Rig(str(tmp_path))
+    try:
+        for seg in ("ingest", "poll", "pump", "ingest"):
+            rig.segment(seg)  # second record is submitted but never acked
+        rig.segment("kill")  # restart + reconnect: the tail resends
+        assert rig.producer.outstanding > 0
+        with pytest.raises(Exception, match="unacked"):
+            rig.producer.resume_from_watermark()
+        assert rig.violations == []
+    finally:
+        rig.close()
+
+
+def test_resume_after_quiesce_is_seq_safe_and_exactly_once(tmp_path):
+    rig = _Rig(str(tmp_path))
+    try:
+        for seg in ("ingest", "poll", "pump", "ingest"):
+            rig.segment(seg)
+        rig.segment("kill")
+        rig.producer.flush(10.0)  # drain the resent tail first
+        acked = rig.producer.acked
+        rig.producer.resume_from_watermark()  # legal now: nothing unacked
+        pseq = rig.producer.submit("s0", 99.0)
+        rig.values[pseq] = 99.0
+        assert pseq > acked  # resumed past the recovered prefix, no pseq reuse
+        rig.finish()  # quiesce + contiguity + exactly-once oracle
+        assert rig.violations == [], rig.violations
+    finally:
+        rig.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

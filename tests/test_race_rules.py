@@ -1,0 +1,495 @@
+"""Unit tests for the racelint AST rules (RC001–RC006).
+
+Every rule gets at least two positive fixtures (the concurrency/ordering
+hazard is reported) and negative fixtures (disciplined control-plane code
+stays clean). racelint only fires inside the concurrent control plane —
+``metrics_tpu/serve/`` and ``metrics_tpu/engine/`` (minus the single-threaded
+``engine/smoke.py`` bench) — so fixtures are written at those relative paths,
+and the scope gate itself is pinned here. ``test_seed_corpus_coverage`` holds
+the whole suite to the acceptance floor: ≥ 12 seeded violations, ≥ 2 per rule.
+"""
+
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis import RACE_RULE_CODES, lint_file
+
+SERVE = "metrics_tpu/serve/mod.py"
+ENGINE = "metrics_tpu/engine/mod.py"
+AUTONOMIC = "metrics_tpu/serve/autonomic.py"
+
+
+def run_lint(tmp_path, source, rel=SERVE, rules=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_file(str(path), root=str(tmp_path), rules=rules or list(RACE_RULE_CODES))
+
+
+def codes(result):
+    return [v.rule for v in result.violations]
+
+
+# ---------------------------------------------------------------- seed corpus
+# (rule, fixture path, source, expected violation count). Positive fixtures
+# live here so the aggregate coverage test below can hold the suite to the
+# acceptance floor; the per-rule test classes reference the same sources.
+
+RC001_TWO_CONTEXTS = """
+    class Server:
+        def __init__(self):
+            self._resolved = {}
+
+        def poll(self):
+            self._resolved = {}
+
+        def tick(self):
+            self._resolved = {}
+    """
+
+RC001_HELPER_CONTEXT = """
+    class Server:
+        def poll(self):
+            self._on_read()
+
+        def _on_read(self):
+            self.backlog = 1
+
+        def submit(self, rec):
+            self.backlog = 2
+    """
+
+RC002_ACK_BEFORE_SYNC = """
+    class Server:
+        def _pump(self, rec):
+            self._process(rec)
+            self._flush_writes()
+    """
+
+RC002_UNDOMINATED_WATERMARK = """
+    class Server:
+        def mark(self, producer, pseq):
+            self._serve_marks[producer] = pseq
+    """
+
+RC003_MUTATE_INFLIGHT = """
+    class Engine:
+        def tick(self):
+            staged = self._stage_flush()
+            self._dispatch_flush(staged)
+            staged.append(1)
+    """
+
+RC003_STORE_INFLIGHT = """
+    class Engine:
+        def tick(self):
+            staged = self._stage_flush()
+            self._dispatch_flush(staged)
+            staged[0] = 1
+    """
+
+RC003_ALIAS_INFLIGHT = """
+    class Engine:
+        def tick(self):
+            staged = self._stage_flush()
+            alias = staged
+            self._dispatch_flush(staged)
+            alias.extend([1])
+    """
+
+RC004_NO_ALLOWLIST = """
+    class Reflex:
+        def step(self):
+            if self._allowed("shed", 0.0):
+                self.engine.expire("sid")
+    """
+
+RC004_OFF_ALLOWLIST = """
+    AUTONOMIC_ENGINE_ALLOWLIST = ("expire",)
+
+    class Reflex:
+        def step(self):
+            if self._allowed("reset", 0.0):
+                self.engine.reset()
+    """
+
+RC004_UNGATED = """
+    AUTONOMIC_ENGINE_ALLOWLIST = ("expire",)
+
+    class Reflex:
+        def helper(self):
+            self.engine.expire("sid")
+    """
+
+RC005_RESTORE_EXPOSED = """
+    class Engine:
+        def restore(self, snapshot):
+            self.state = snapshot
+
+        def _log(self, rec):
+            self._wal.append(rec)
+    """
+
+RC005_LATCH_IN_USE = """
+    class Engine:
+        def apply(self, rec):
+            self._wal.append(rec)
+
+        def replay_done(self):
+            self._replaying = False
+    """
+
+RC006_BODY_MUTATES = """
+    class Registry:
+        def expire_all(self):
+            for sid in self._sessions:
+                del self._sessions[sid]
+    """
+
+RC006_CALLEE_MUTATES = """
+    class Registry:
+        def sweep(self):
+            for sid in self._sessions.keys():
+                self._drop(sid)
+
+        def _drop(self, sid):
+            self._sessions.pop(sid, None)
+    """
+
+SEEDS = [
+    ("RC001", SERVE, RC001_TWO_CONTEXTS, 2),
+    ("RC001", SERVE, RC001_HELPER_CONTEXT, 2),
+    ("RC002", SERVE, RC002_ACK_BEFORE_SYNC, 1),
+    ("RC002", SERVE, RC002_UNDOMINATED_WATERMARK, 1),
+    ("RC003", ENGINE, RC003_MUTATE_INFLIGHT, 1),
+    ("RC003", ENGINE, RC003_STORE_INFLIGHT, 1),
+    ("RC003", ENGINE, RC003_ALIAS_INFLIGHT, 1),
+    ("RC004", AUTONOMIC, RC004_NO_ALLOWLIST, 1),
+    ("RC004", AUTONOMIC, RC004_OFF_ALLOWLIST, 1),
+    ("RC004", AUTONOMIC, RC004_UNGATED, 1),
+    ("RC005", ENGINE, RC005_RESTORE_EXPOSED, 1),
+    ("RC005", ENGINE, RC005_LATCH_IN_USE, 1),
+    ("RC006", ENGINE, RC006_BODY_MUTATES, 1),
+    ("RC006", ENGINE, RC006_CALLEE_MUTATES, 1),
+]
+
+
+def test_seed_corpus_coverage(tmp_path):
+    """The acceptance floor: ≥ 12 seeded violations overall, ≥ 2 per rule."""
+    per_rule = {code: 0 for code in RACE_RULE_CODES}
+    total = 0
+    for i, (rule, rel, source, expected) in enumerate(SEEDS):
+        res = run_lint(tmp_path / str(i), source, rel=rel, rules=[rule])
+        assert codes(res) == [rule] * expected, f"seed {i} ({rule}): {res.violations}"
+        per_rule[rule] += expected
+        total += expected
+    assert total >= 12
+    assert all(n >= 2 for n in per_rule.values()), per_rule
+
+
+# =========================================================================== scope
+class TestScope:
+    def test_control_plane_paths_are_linted(self, tmp_path):
+        assert codes(run_lint(tmp_path, RC001_TWO_CONTEXTS, rel=SERVE)) == ["RC001"] * 2
+        assert codes(run_lint(tmp_path, RC001_TWO_CONTEXTS, rel=ENGINE)) == ["RC001"] * 2
+
+    def test_non_control_plane_is_out_of_scope(self, tmp_path):
+        # single-threaded metric code cannot race with itself — hotlint's turf
+        assert codes(run_lint(tmp_path, RC001_TWO_CONTEXTS, rel="metrics_tpu/metric.py")) == []
+
+    def test_smoke_bench_is_exempt(self, tmp_path):
+        assert codes(run_lint(tmp_path, RC001_TWO_CONTEXTS, rel="metrics_tpu/engine/smoke.py")) == []
+
+
+# =========================================================================== RC001
+class TestRC001MultiContextWrites:
+    def test_reactor_and_tick_write_sites_both_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC001_TWO_CONTEXTS, rules=["RC001"])
+        assert codes(res) == ["RC001", "RC001"]
+        assert {v.context for v in res.violations} == {"Server.poll", "Server.tick"}
+
+    def test_context_reaches_through_self_call_helpers(self, tmp_path):
+        # _on_read is only reachable from poll -> it inherits the reactor
+        # context; submit is a tick root -> two contexts write `backlog`
+        res = run_lint(tmp_path, RC001_HELPER_CONTEXT, rules=["RC001"])
+        assert codes(res) == ["RC001", "RC001"]
+
+    def test_single_context_class_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Engine:
+                def tick(self):
+                    self.waves = []
+
+                def submit(self, rec):
+                    self.waves = [rec]
+            """, rules=["RC001"])
+        assert codes(res) == []
+
+    def test_init_writes_do_not_count_as_a_context(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def __init__(self):
+                    self.backlog = 0
+
+                def poll(self):
+                    self.backlog = 1
+
+                def stats(self):
+                    return self.backlog
+            """, rules=["RC001"])
+        assert codes(res) == []
+
+    def test_write_site_marker_sanctions_each_site(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def poll(self):
+                    self._resolved = {}  # racelint: single-writer — reactor hand-off
+
+                def tick(self):
+                    # racelint: single-writer — benign overwrite, reactor quiesced
+                    self._resolved = {}
+            """, rules=["RC001"])
+        assert codes(res) == []
+
+    def test_init_declaration_marker_sanctions_the_attribute(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def __init__(self):
+                    # racelint: single-writer — reactor owns; tick only resets on quiesce
+                    self._resolved = {}
+
+                def poll(self):
+                    self._resolved = {}
+
+                def tick(self):
+                    self._resolved = {}
+            """, rules=["RC001"])
+        assert codes(res) == []
+
+
+# =========================================================================== RC002
+class TestRC002DurabilityOrdering:
+    def test_ack_flush_after_apply_without_sync_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC002_ACK_BEFORE_SYNC, rules=["RC002"])
+        assert codes(res) == ["RC002"]
+
+    def test_sync_between_apply_and_ack_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def _pump(self, rec):
+                    self._process(rec)
+                    self._sync_wals()
+                    self._flush_writes()
+            """, rules=["RC002"])
+        assert codes(res) == []
+
+    def test_ack_ordering_only_polices_serve(self, tmp_path):
+        # engine/ has no ack path; the (a) sub-rule is serve/-only
+        res = run_lint(tmp_path, RC002_ACK_BEFORE_SYNC, rel=ENGINE, rules=["RC002"])
+        assert codes(res) == []
+
+    def test_undominated_watermark_advance_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC002_UNDOMINATED_WATERMARK, rules=["RC002"])
+        assert codes(res) == ["RC002"]
+
+    def test_watermark_dominated_by_wal_append_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def mark(self, producer, pseq, rec):
+                    self._wal.append(rec)
+                    self._serve_marks[producer] = pseq
+            """, rules=["RC002"])
+        assert codes(res) == []
+
+    def test_watermark_store_without_seq_value_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def reset_marks(self):
+                    self._serve_marks = {}
+            """, rules=["RC002"])
+        assert codes(res) == []
+
+
+# =========================================================================== RC003
+class TestRC003StagedBufferMutation:
+    def test_struct_mutation_while_inflight_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC003_MUTATE_INFLIGHT, rules=["RC003"])
+        assert codes(res) == ["RC003"]
+
+    def test_subscript_store_while_inflight_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC003_STORE_INFLIGHT, rules=["RC003"])
+        assert codes(res) == ["RC003"]
+
+    def test_mutation_through_alias_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC003_ALIAS_INFLIGHT, rules=["RC003"])
+        assert codes(res) == ["RC003"]
+
+    def test_sync_point_releases_the_buffer(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Engine:
+                def tick(self):
+                    staged = self._stage_flush()
+                    out = self._dispatch_flush(staged)
+                    out.block_until_ready()
+                    staged.append(1)
+            """, rules=["RC003"])
+        assert codes(res) == []
+
+    def test_restage_swaps_in_a_fresh_buffer(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Engine:
+                def tick(self):
+                    staged = self._stage_flush()
+                    self._dispatch_flush(staged)
+                    staged = self._stage_flush()
+                    staged.append(1)
+            """, rules=["RC003"])
+        assert codes(res) == []
+
+    def test_rebinding_the_name_is_not_a_mutation(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Engine:
+                def tick(self):
+                    staged = self._stage_flush()
+                    self._dispatch_flush(staged)
+                    staged = []
+                    staged.append(1)
+            """, rules=["RC003"])
+        assert codes(res) == []
+
+
+# =========================================================================== RC004
+class TestRC004AutonomicSurface:
+    def test_engine_mutation_without_declared_allowlist_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC004_NO_ALLOWLIST, rel=AUTONOMIC, rules=["RC004"])
+        assert codes(res) == ["RC004"]
+
+    def test_call_off_the_allowlist_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC004_OFF_ALLOWLIST, rel=AUTONOMIC, rules=["RC004"])
+        assert codes(res) == ["RC004"]
+
+    def test_ungated_reflex_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC004_UNGATED, rel=AUTONOMIC, rules=["RC004"])
+        assert codes(res) == ["RC004"]
+
+    def test_gate_inherited_from_caller_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            AUTONOMIC_ENGINE_ALLOWLIST = ("expire",)
+
+            class Reflex:
+                def step(self):
+                    if self._allowed("shed", 0.0):
+                        self._do_shed()
+
+                def _do_shed(self):
+                    self.engine.expire("sid")
+            """, rel=AUTONOMIC, rules=["RC004"])
+        assert codes(res) == []
+
+    def test_read_only_engine_calls_are_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Reflex:
+                def observe(self):
+                    return self.engine.stats(), self.engine.loose_session_ids()
+            """, rel=AUTONOMIC, rules=["RC004"])
+        assert codes(res) == []
+
+    def test_rule_only_polices_autonomic_module(self, tmp_path):
+        res = run_lint(tmp_path, RC004_NO_ALLOWLIST, rel=SERVE, rules=["RC004"])
+        assert codes(res) == []
+
+
+# =========================================================================== RC005
+class TestRC005ReplayReentrancy:
+    def test_append_without_latch_in_restore_exposed_class_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC005_RESTORE_EXPOSED, rel=ENGINE, rules=["RC005"])
+        assert codes(res) == ["RC005"]
+
+    def test_latch_in_use_elsewhere_exposes_the_class(self, tmp_path):
+        res = run_lint(tmp_path, RC005_LATCH_IN_USE, rel=ENGINE, rules=["RC005"])
+        assert codes(res) == ["RC005"]
+
+    def test_latched_append_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Engine:
+                def restore(self, snapshot):
+                    self.state = snapshot
+
+                def _log(self, rec):
+                    if not self._replaying:
+                        self._wal.append(rec)
+            """, rel=ENGINE, rules=["RC005"])
+        assert codes(res) == []
+
+    def test_class_without_replay_exposure_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Journal:
+                def log(self, rec):
+                    self._wal.append(rec)
+            """, rel=ENGINE, rules=["RC005"])
+        assert codes(res) == []
+
+
+# =========================================================================== RC006
+class TestRC006IterateWhileMutate:
+    def test_body_mutation_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC006_BODY_MUTATES, rel=ENGINE, rules=["RC006"])
+        assert codes(res) == ["RC006"]
+
+    def test_mutation_through_callee_flagged(self, tmp_path):
+        res = run_lint(tmp_path, RC006_CALLEE_MUTATES, rel=ENGINE, rules=["RC006"])
+        assert codes(res) == ["RC006"]
+
+    def test_snapshot_idiom_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Registry:
+                def expire_all(self):
+                    for sid in list(self._sessions):
+                        del self._sessions[sid]
+            """, rel=ENGINE, rules=["RC006"])
+        assert codes(res) == []
+
+    def test_mutating_a_different_container_is_clean(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Registry:
+                def collect(self):
+                    for sid in self._sessions:
+                        self._dead.append(sid)
+            """, rel=ENGINE, rules=["RC006"])
+        assert codes(res) == []
+
+
+# ==================================================================== suppression
+class TestSuppression:
+    def test_inline_disable_suppresses(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def poll(self):
+                    self._resolved = {}  # racelint: disable=RC001
+
+                def tick(self):
+                    self._resolved = {}  # racelint: disable=RC001
+            """, rules=["RC001"])
+        assert codes(res) == []
+        assert res.suppressed == 2
+
+    def test_file_wide_disable_suppresses(self, tmp_path):
+        res = run_lint(tmp_path, "# racelint: disable-file=all\n" + textwrap.dedent(
+            RC001_TWO_CONTEXTS), rules=["RC001"])
+        assert codes(res) == []
+
+    def test_other_pass_markers_do_not_leak(self, tmp_path):
+        res = run_lint(tmp_path, """
+            class Server:
+                def poll(self):
+                    self._resolved = {}  # hotlint: disable=RC001
+
+                def tick(self):
+                    self._resolved = {}
+            """, rules=["RC001"])
+        # the shared grammar suppresses by CODE, not by prefix — a rule code
+        # under any registered prefix counts (one grammar, six prefixes), so
+        # only the unannotated tick site survives
+        assert codes(res) == ["RC001"]
+        assert res.suppressed == 1

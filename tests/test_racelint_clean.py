@@ -1,0 +1,69 @@
+"""The repo must stay racelint-clean: zero RC violations, an EMPTY baseline.
+
+This is the enforcement point for control-plane ordering discipline — any new
+multi-context attribute write, ack not dominated by its fsync, mutation of
+in-flight wave state, off-allowlist or ungated autonomic action, latch-blind
+WAL append, or iterate-while-mutate loop introduced under ``metrics_tpu/serve``
+or ``metrics_tpu/engine`` fails this test. Unlike the other passes, racelint
+admits NO baselined exceptions: an ordering bug gets fixed (or explicitly
+annotated ``# racelint: single-writer — why`` at the write site) in the same
+PR, never recorded in ``tools/racelint_baseline.json`` — both of that file's
+sections are pinned empty here, the ``interleave`` section by the
+schedule-exploration suite in ``tests/test_interleave_contracts.py``.
+"""
+
+import json
+import os
+
+import pytest
+
+from metrics_tpu.analysis import (
+    RACE_RULE_CODES,
+    diff_against_baseline,
+    lint_paths,
+    load_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "racelint_baseline.json")
+
+
+@pytest.fixture(scope="module")
+def lint_result():
+    return lint_paths(
+        [os.path.join(REPO_ROOT, "metrics_tpu")], root=REPO_ROOT, rules=list(RACE_RULE_CODES)
+    )
+
+
+def test_every_module_parses(lint_result):
+    assert not lint_result.parse_errors, "\n".join(lint_result.parse_errors)
+    assert lint_result.files_scanned > 100  # the walk really covered the package
+
+
+def test_zero_violations(lint_result):
+    baseline = load_baseline(BASELINE_PATH, section="rules")
+    new, _, _ = diff_against_baseline(lint_result.violations, baseline)
+    assert not new, (
+        "new racelint violations (fix or annotate — never baseline):\n"
+        + "\n".join(v.render() for v in new)
+    )
+
+
+def test_both_baseline_sections_are_pinned_empty():
+    """racelint's contract is stricter than the other passes': the control
+    plane carries zero ordering exceptions, so the baseline file is a tripwire,
+    not a ledger. Anything landing in either section is a bug to fix."""
+    with open(BASELINE_PATH, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc.get("rules") == {}
+    assert doc.get("interleave") == {}
+
+
+def test_cli_exits_zero_against_baseline():
+    from metrics_tpu.analysis.cli import main
+
+    assert main(["--root", REPO_ROOT, os.path.join(REPO_ROOT, "metrics_tpu"), "--pass", "racelint", "-q"]) == 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

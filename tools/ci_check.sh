@@ -25,7 +25,16 @@
 # `chaos`/`fleet`/`shard` sections of tools/chaos_baseline.json), and the
 # perf cost ratchet (which
 # also drives the 64-stream StreamEngine smoke and pins its dispatch economy
-# against the `fleet` section of tools/perf_baseline.json) — all via
+# against the `fleet` section of tools/perf_baseline.json), the racelint
+# concurrency & ordering pass (rules RC001–RC006 over serve/ + engine/ —
+# multi-context attribute writes, ack-before-fsync, in-flight wave-buffer
+# mutation, off-allowlist/ungated autonomic actions, latch-blind WAL appends,
+# iterate-while-mutate — with a PINNED-EMPTY baseline in
+# tools/racelint_baseline.json: ordering bugs get fixed, never baselined),
+# and the interleaving harness (1000+ permuted/adversarial schedules with
+# kill-points driven through the real server/engine/autonomic stack, asserting
+# contiguous resolved prefix, acked=>durable, oracle-exact reads and
+# tick/autonomic serialization) — all via
 # `lint_metrics.py --all`, which aggregates their exit codes. The default
 # target sweeps all of metrics_tpu/ including the sketch family
 # (sketches/ + functional/sketches/, registered in every dynamic-pass
@@ -38,9 +47,18 @@
 #                                # tests when the timing budget drifts, then
 #                                # the <=30s serve front-door smoke (loopback
 #                                # producer, 100 sessions, one forced
-#                                # overload -> shed -> recover cycle)
+#                                # overload -> shed -> recover cycle), then
+#                                # the full lint sweep (`lint_metrics.py
+#                                # --all`, all six static passes incl.
+#                                # racelint + every dynamic harness incl. the
+#                                # interleave scheduler) under a hard
+#                                # wall-clock budget so a wedged harness fails
+#                                # the gate instead of hanging it
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# wall-clock budget (seconds) for the tier-1 lint sweep; override per-runner
+LINT_ALL_BUDGET="${LINT_ALL_BUDGET:-900}"
 
 if [[ "${1:-}" == "--tier1" ]]; then
   shift
@@ -49,6 +67,8 @@ if [[ "${1:-}" == "--tier1" ]]; then
     --continue-on-collection-errors --durations=20 \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=$?
   env JAX_PLATFORMS=cpu timeout -k 5 60 python -m metrics_tpu.serve.smoke || rc=1
+  env JAX_PLATFORMS=cpu timeout -k 10 "$LINT_ALL_BUDGET" \
+    python tools/lint_metrics.py --all || rc=1
   exit "$rc"
 fi
 

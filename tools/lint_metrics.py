@@ -1,10 +1,13 @@
 #!/usr/bin/env python
-"""Lint CLI — jitlint + distlint + donlint + hotlint analysis over metrics_tpu.
+"""Lint CLI — the six static passes + dynamic harnesses over metrics_tpu.
 
 Usage:
     python tools/lint_metrics.py [targets...]
-                                 [--pass jitlint|distlint|donlint|hotlint|donation|transfer|aot|fleet|chaos|perf]
-                                 [--all] [--json] [--rules JL001,DL004,ML002,HL005]
+                                 [--pass jitlint|distlint|donlint|hotlint|numlint|racelint
+                                        |telemetry|donation|interleave|transfer|precision
+                                        |aot|fleet|chaos|perf]
+                                 [--all] [--json] [--list-rules]
+                                 [--rules JL001,DL004,ML002,HL005,NL003,RC001]
                                  [--update-baseline]
 
 Thin wrapper over :mod:`metrics_tpu.analysis.cli` so the tool works from a
